@@ -1,0 +1,90 @@
+"""mxnet_tpu — a TPU-native deep learning framework.
+
+A ground-up re-design of Apache MXNet 1.6's capability surface
+(reference: Caenorst/incubator-mxnet, see SURVEY.md) for TPU hardware:
+jax/XLA is the compute path (MXU-tiled matmuls, fused elementwise, ICI
+collectives), the imperative NDArray/autograd/Gluon/Module APIs match the
+reference so user code ports with ``import mxnet_tpu as mx`` and
+``ctx=mx.tpu()``.
+
+Layer map (vs SURVEY.md §1): storage/engine → XLA+PJRT runtime; operators →
+mxnet_tpu/ops (pure jax); imperative+autograd → NDArray + vjp tape; CachedOp
+→ jit'd hybridize; kvstore → mesh collectives (mxnet_tpu/kvstore, parallel);
+C ABI + frontends → this Python package.
+"""
+from __future__ import annotations
+
+__version__ = "1.6.0.tpu1"
+
+from .base import MXNetError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
+                      num_gpus, num_tpus)
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .ndarray import NDArray
+
+# re-export seed at top level like the reference (mx.random.seed exists too)
+
+
+def seed(s):
+    random.seed(s)
+
+
+def waitall():
+    nd.waitall()
+
+
+# Heavier subsystems are imported lazily to keep `import mxnet_tpu` fast.
+_LAZY = {
+    "gluon": ".gluon",
+    "optimizer": ".optimizer",
+    "initializer": ".initializer",
+    "init": ".initializer",
+    "metric": ".metric",
+    "lr_scheduler": ".lr_scheduler",
+    "kvstore": ".kvstore",
+    "kv": ".kvstore",
+    "io": ".io",
+    "image": ".image",
+    "symbol": ".symbol",
+    "sym": ".symbol",
+    "module": ".module",
+    "mod": ".module",
+    "model": ".model",
+    "callback": ".callback",
+    "monitor": ".monitor",
+    "profiler": ".profiler",
+    "parallel": ".parallel",
+    "models": ".models",
+    "recordio": ".recordio",
+    "runtime": ".runtime",
+    "test_utils": ".test_utils",
+    "util": ".util",
+    "amp": ".contrib.amp",
+    "contrib": ".contrib",
+    "engine": ".engine",
+    "executor": ".executor",
+    "jit": ".jit",
+    "numpy": ".numpy",
+    "np": ".numpy",
+    "numpy_extension": ".numpy_extension",
+    "npx": ".numpy_extension",
+    "lib_api": ".lib_api",
+    "storage": ".storage",
+}
+
+
+def __getattr__(name):
+    import importlib
+    if name in _LAZY:
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_LAZY.keys()))

@@ -1,0 +1,430 @@
+"""Autograd: imperative tape over per-op ``jax.vjp`` closures.
+
+TPU-native re-design of the reference's autograd (ref: python/mxnet/autograd.py,
+src/imperative/imperative.cc:40-330 Imperative::InvokeOp/RecordOp/Backward).
+The reference stores an nnvm tape node per recorded op and replays a gradient
+graph; here each recorded op captures its own ``jax.vjp`` closure (residuals
+live on device), and ``backward`` walks the Python tape in reverse topological
+order. Under a hybridized block one whole jitted computation appears as a
+single tape node, which is the ``CachedOp`` analog
+(ref: src/imperative/cached_op.cc:231 CachedOp::Gradient).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "get_symbol", "Function"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording():
+    """ref: autograd.is_recording (python/mxnet/autograd.py:84)."""
+    return _STATE.recording
+
+
+def is_training():
+    """ref: autograd.is_training (python/mxnet/autograd.py:94)."""
+    return _STATE.training
+
+
+def set_recording(is_record):
+    prev = _STATE.recording
+    _STATE.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    prev = _STATE.training
+    _STATE.training = bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    """Scope guard flipping (recording, training) like the reference's
+    _RecordingStateScope (python/mxnet/autograd.py:37)."""
+
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *args):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """Record ops for autograd. ref: python/mxnet/autograd.py:122."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """Stop recording inside a record scope. ref: python/mxnet/autograd.py:146."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    """ref: python/mxnet/autograd.py:168."""
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    """ref: python/mxnet/autograd.py:188."""
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape graph. A Node is one recorded op; NDArrays produced while recording
+# carry ``_autograd_entry = (node, output_index)``. Analog of AGInfo on nnvm
+# nodes (ref: include/mxnet/imperative.h:42-77).
+# ---------------------------------------------------------------------------
+
+class Node:
+    __slots__ = ("inputs", "vjp_fn", "num_outputs", "name", "saved_entries",
+                 "out_shapes", "out_dtypes", "fwd_fn", "in_datas")
+
+    def __init__(self, inputs, vjp_fn, num_outputs, name, out_shapes, out_dtypes):
+        self.inputs = inputs              # list[NDArray] (op's array inputs)
+        self.vjp_fn = vjp_fn              # cotangents(tuple) -> input cotangents
+        self.num_outputs = num_outputs
+        self.name = name
+        # entries of the inputs at record time (an input may later be detached)
+        self.saved_entries = [getattr(a, "_autograd_entry", None) for a in inputs]
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self.fwd_fn = None                # pure replay fn (for create_graph)
+        self.in_datas = [a._data for a in inputs]  # record-time input buffers
+
+
+def _is_inexact(dtype):
+    return _np.issubdtype(_np.dtype(dtype), _np.inexact)
+
+
+def record_op(name, out_arrays, input_ndarrays, vjp_fn):
+    """Attach a tape node to the freshly produced output NDArrays.
+
+    Called by the generated op wrappers (ndarray/register.py) when
+    ``is_recording()``; analog of Imperative::RecordOp
+    (ref: src/imperative/imperative.cc:193).
+    """
+    node = Node(list(input_ndarrays), vjp_fn, len(out_arrays), name,
+                [a.shape for a in out_arrays], [a.dtype for a in out_arrays])
+    for i, arr in enumerate(out_arrays):
+        arr._autograd_entry = (node, i)
+    return node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """ref: autograd.mark_variables (python/mxnet/autograd.py:217)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradient, req in zip(variables, gradients, grad_reqs):
+        var._grad = gradient if req != "null" else None
+        var._grad_req = req
+        var._autograd_entry = None
+
+
+def _toposort(heads):
+    """Topological order (producers before consumers) of reachable Nodes,
+    via iterative post-order DFS."""
+    order, emitted, visiting = [], set(), set()
+    stack = []
+    for h in heads:
+        entry = getattr(h, "_autograd_entry", None)
+        if entry is not None:
+            stack.append((entry[0], False))
+    while stack:
+        node, children_done = stack.pop()
+        if id(node) in emitted:
+            continue
+        if children_done:
+            emitted.add(id(node))
+            order.append(node)
+            continue
+        if id(node) in visiting:
+            continue
+        visiting.add(id(node))
+        stack.append((node, True))
+        for e in node.saved_entries:
+            if e is not None and id(e[0]) not in emitted:
+                stack.append((e[0], False))
+    return order  # children before parents; iterate reversed for backward
+
+
+def _zeros_cotangent(shape, dtype):
+    return jnp.zeros(shape, dtype)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of ``heads`` w.r.t. all marked variables on the tape.
+
+    ref: autograd.backward (python/mxnet/autograd.py:246) →
+    Imperative::Backward (src/imperative/imperative.cc:280).
+    """
+    from .ndarray import NDArray  # local import to avoid cycle
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if len(heads) != len(head_grads):
+        raise ValueError("heads and head_grads must have the same length")
+
+    grads = _run_backward(heads, head_grads, retain_graph)
+
+    # accumulate into .grad of marked leaves
+    for var, g in grads.items():
+        if var._grad is None:
+            continue
+        if getattr(var, "_grad_req", "write") == "add":
+            var._grad._data = var._grad._data + g
+        else:
+            var._grad._data = g.astype(var._grad._data.dtype)
+    return None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients w.r.t. ``variables`` instead of accumulating into
+    ``.grad``. ref: autograd.grad (python/mxnet/autograd.py:273).
+
+    ``create_graph=True`` re-records the backward pass so higher-order
+    gradients work (ref: test_higher_order_grad.py coverage).
+    """
+    from .ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    if create_graph:
+        return _grad_create_graph(heads, variables, head_grads, single)
+
+    grads = _run_backward(heads, head_grads, retain_graph, targets=variables)
+    out = []
+    for v in variables:
+        g = grads.get(v)
+        if g is None:
+            g = jnp.zeros(v.shape, v.dtype)
+        out.append(NDArray(g, ctx=v.context))
+    return out[0] if single else out
+
+
+def _grad_create_graph(heads, variables, head_grads, single):
+    """Differentiable grad for higher-order autograd: replay the recorded
+    subgraph as one pure function G(variables) -> heads, then take
+    ``jax.vjp`` of the *gradient* function so the returned grads carry a tape
+    node whose vjp differentiates through the backward pass
+    (ref coverage: tests/python/unittest/test_higher_order_grad.py)."""
+    from .ndarray import NDArray
+
+    order = _toposort(heads)
+    for node in order:
+        if node.fwd_fn is None:
+            raise RuntimeError(
+                "create_graph=True requires the full tape (an op is missing "
+                "its replay function: %s)" % node.name)
+
+    var_ids = {id(v): i for i, v in enumerate(variables)}
+
+    def replay_heads(*var_datas):
+        env = {}  # (id(node), idx) -> data
+
+        def lookup(arr, entry):
+            if entry is not None and (id(entry[0]), entry[1]) in env:
+                return env[(id(entry[0]), entry[1])]
+            if id(arr) in var_ids:
+                return var_datas[var_ids[id(arr)]]
+            return arr._data
+
+        for node in order:
+            ins = [lookup(a, e) for a, e in zip(node.inputs, node.saved_entries)]
+            outs = node.fwd_fn(*ins)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+        return tuple(lookup(h, getattr(h, "_autograd_entry", None))
+                     for h in heads)
+
+    seeds = tuple(
+        (hg._data if isinstance(hg, NDArray) else jnp.asarray(hg))
+        if hg is not None else jnp.ones(h.shape, h.dtype)
+        for h, hg in zip(heads, head_grads))
+
+    def grad_fn(*var_datas):
+        outs, vjp = jax.vjp(replay_heads, *var_datas)
+        g = vjp(seeds)
+        # single-output convention: bare array, matching how backward() calls
+        # vjp_fn(cts[0]) for num_outputs == 1
+        return g[0] if len(variables) == 1 else g
+
+    var_datas = tuple(v._data for v in variables)
+    if is_recording():
+        g_datas, vjp2 = jax.vjp(grad_fn, *var_datas)
+        raw = [g_datas] if len(variables) == 1 else list(g_datas)
+        outs = [NDArray(g) for g in raw]
+        node = record_op("grad", outs, list(variables), vjp2)
+        node.fwd_fn = grad_fn
+    else:
+        g_datas = grad_fn(*var_datas)
+        raw = [g_datas] if len(variables) == 1 else list(g_datas)
+        outs = [NDArray(g) for g in raw]
+    return outs[0] if single else outs
+
+
+def _run_backward(heads, head_grads, retain_graph, targets=None):
+    """Shared reverse sweep. Returns {leaf NDArray: cotangent jax array}."""
+    from .ndarray import NDArray
+
+    order = _toposort(heads)
+    if not order:
+        # heads are leaves; gradient of head w.r.t itself is head_grad
+        result = {}
+        for h, hg in zip(heads, head_grads):
+            if h._grad is not None or (targets is not None and any(h is t for t in targets)):
+                seed = hg._data if hg is not None else jnp.ones(h.shape, h.dtype)
+                result[h] = seed
+        if not result and targets is None:
+            raise ValueError("cannot differentiate: outputs are not on the "
+                             "recorded tape (did you forget autograd.record()?)")
+        return result
+
+    # cotangent storage: per node output slot, plus per leaf NDArray
+    node_cts = {}  # id(node) -> [ct or None] * num_outputs
+    leaf_cts = {}  # NDArray -> ct
+    id2node = {id(n): n for n in order}
+
+    def _seed(arr, ct):
+        entry = getattr(arr, "_autograd_entry", None)
+        if entry is not None and id(entry[0]) in id2node:
+            node, idx = entry
+            slots = node_cts.setdefault(id(node), [None] * node.num_outputs)
+            slots[idx] = ct if slots[idx] is None else slots[idx] + ct
+        else:
+            leaf_cts[arr] = ct if arr not in leaf_cts else leaf_cts[arr] + ct
+
+    for h, hg in zip(heads, head_grads):
+        if hg is not None:
+            seed = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        else:
+            seed = jnp.ones(h.shape, h.dtype)
+        _seed(h, seed)
+
+    for node in reversed(order):
+        slots = node_cts.get(id(node))
+        if slots is None:
+            continue
+        cts = tuple(
+            slots[i] if slots[i] is not None
+            else _zeros_cotangent(node.out_shapes[i], node.out_dtypes[i])
+            for i in range(node.num_outputs))
+        in_cts = node.vjp_fn(cts if node.num_outputs > 1 else cts[0])
+        if not isinstance(in_cts, (tuple, list)):
+            in_cts = (in_cts,)
+        for inp, entry, ct in zip(node.inputs, node.saved_entries, in_cts):
+            if ct is None:
+                continue
+            ctd = ct._data if hasattr(ct, "_data") else ct
+            if ctd.dtype == jax.dtypes.float0:
+                continue
+            if entry is not None and id(entry[0]) in id2node:
+                n2, idx = entry
+                slots2 = node_cts.setdefault(id(n2), [None] * n2.num_outputs)
+                slots2[idx] = ctd if slots2[idx] is None else slots2[idx] + ctd
+            else:
+                prev = leaf_cts.get(inp)
+                leaf_cts[inp] = ctd if prev is None else prev + ctd
+
+    if not retain_graph:
+        for h in heads:
+            h._autograd_entry = None
+        for node in order:
+            node.vjp_fn = None
+            node.inputs = []
+            node.saved_entries = []
+            node.in_datas = []
+
+    return leaf_cts
+
+
+def get_symbol(x):
+    """The reference returns the recorded Symbol (python/mxnet/autograd.py:304);
+    here the tape has no nnvm graph — export via symbol tracing instead."""
+    raise NotImplementedError(
+        "get_symbol: use mxnet_tpu.symbol tracing (hybridize/export) instead")
+
+
+class Function:
+    """User-defined differentiable function, ref: autograd.Function
+    (python/mxnet/autograd.py:368).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def vjp_fn(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                with pause():
+                    in_grads = func.backward(
+                        *[NDArray(c) for c in cts])
+                if isinstance(in_grads, NDArray):
+                    in_grads = [in_grads]
+                return tuple(g._data if isinstance(g, NDArray) else g
+                             for g in in_grads)
+
+            record_op(type(self).__name__, outs, list(inputs), vjp_fn)
+        return outputs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

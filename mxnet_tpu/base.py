@@ -1,0 +1,83 @@
+"""Base types, dtype tables and small shared helpers.
+
+TPU-native re-design of the reference's base layer (ref: include/mxnet/base.h,
+python/mxnet/base.py). There is no ctypes FFI here: the "C ABI" choke point of
+the reference is replaced by the JAX/XLA runtime; this module only holds shared
+plumbing (dtype canonicalisation, registries, errors).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError", "string_types", "numeric_types",
+    "canonical_dtype", "DTYPE_NAMES",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (name kept for API parity with the reference,
+    ref: python/mxnet/base.py:75)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+# Canonical dtype table. bfloat16 is first-class on TPU (the reference's fp16
+# AMP path maps to bf16 here). ref: python/mxnet/base.py dtype handling.
+import jax.numpy as _jnp
+
+DTYPE_NAMES = {
+    "float32": _jnp.float32,
+    "float64": _jnp.float64,
+    "float16": _jnp.float16,
+    "bfloat16": _jnp.bfloat16,
+    "uint8": _jnp.uint8,
+    "int8": _jnp.int8,
+    "int32": _jnp.int32,
+    "int64": _jnp.int64,
+    "bool": _jnp.bool_,
+}
+
+
+def canonical_dtype(dtype):
+    """Map a user dtype spec (str | numpy dtype | jnp dtype | None) to a numpy
+    dtype object usable by jax."""
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype not in DTYPE_NAMES:
+            raise TypeError("unknown dtype %r" % (dtype,))
+        return _np.dtype(DTYPE_NAMES[dtype])
+    return _np.dtype(dtype)
+
+
+class _Registry:
+    """Minimal named registry (replaces dmlc::Registry,
+    ref: 3rdparty/dmlc-core dmlc/registry.h usage across src/)."""
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._entries = {}
+
+    def register(self, name, obj=None):
+        if obj is None:  # decorator form
+            def _reg(o):
+                self._entries[name.lower()] = o
+                return o
+            return _reg
+        self._entries[name.lower()] = obj
+        return obj
+
+    def get(self, name):
+        try:
+            return self._entries[name.lower()]
+        except KeyError:
+            raise KeyError("%s %r not registered. Known: %s"
+                           % (self.kind, name, sorted(self._entries)))
+
+    def __contains__(self, name):
+        return name.lower() in self._entries
+
+    def entries(self):
+        return dict(self._entries)

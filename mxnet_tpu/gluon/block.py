@@ -1,0 +1,481 @@
+"""Block / HybridBlock / SymbolBlock.
+
+TPU-native re-design of Gluon blocks (ref: python/mxnet/gluon/block.py:178
+Block, :765 HybridBlock, :966 hybridize, :859-896 _build_cache→CachedOp,
+:1129 SymbolBlock). The CachedOp analog here IS ``jax.jit``: hybridize()
+traces ``hybrid_forward`` once per input signature into a single XLA
+computation (ref: src/imperative/cached_op.cc:96-822), with:
+
+- cache keyed on input shapes/dtypes + train mode (SetForwardGraph's
+  shape-keyed cache, cached_op.cc:307),
+- whole-graph backward captured as ONE tape node via jax.vjp
+  (CachedOp::Gradient, cached_op.cc:231),
+- ``static_alloc`` mapping to XLA buffer donation semantics (no-op knob
+  kept for API parity — XLA plans memory statically always),
+- BatchNorm-style aux-state updates threaded out of the pure function and
+  applied after each call (the reference mutates aux in-place inside the op).
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import ndarray as nd
+from .. import random as _random
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope(threading.local):
+    def __init__(self):
+        self.counters = {}
+
+
+_SCOPE = _BlockScope()
+
+
+def _gen_prefix(hint):
+    cnt = _SCOPE.counters.get(hint, 0)
+    _SCOPE.counters[hint] = cnt + 1
+    return "%s%d_" % (hint, cnt)
+
+
+class _AuxCollector(threading.local):
+    """Collects (param, new_data) aux updates produced during a traced
+    forward so they can be returned from the pure function."""
+
+    def __init__(self):
+        self.stack = []
+
+    def active(self):
+        return bool(self.stack)
+
+    def add(self, param, new_data):
+        self.stack[-1].append((param, new_data))
+
+
+_AUX = _AuxCollector()
+
+
+class Block:
+    """Base for all layers/models (ref: gluon/block.py:178)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix = prefix if prefix is not None else _gen_prefix(
+            self._alias())
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return type(self).__name__.lower()
+
+    # -- attribute magic: auto-register children & params -----------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+                self._params._params[value.name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    def name_scope(self):
+        class _NS:
+            def __enter__(self_ns):
+                return self
+            def __exit__(self_ns, *a):
+                return None
+        return _NS()
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """ref: block.py collect_params — regex select supported."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            ret.update({n: p for n, p in self._params.items() if pat.match(n)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    # -- persistence (ref: block.py:366 save_parameters, :408 load) -------
+    def save_parameters(self, filename, deduplicate=False):
+        params = self.collect_params()
+        arg = {n[len(self._prefix):] if n.startswith(self._prefix) else n:
+               p.data() for n, p in params.items() if p._data is not None}
+        nd.save(filename, arg)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        loaded = nd.load(filename)
+        params = self.collect_params()
+        canonical = {}
+        for n, p in params.items():
+            short = n[len(self._prefix):] if n.startswith(self._prefix) else n
+            canonical[short] = p
+        for k, v in loaded.items():
+            if k in canonical:
+                canonical[k].set_data(v)
+            elif not ignore_extra:
+                raise KeyError("Parameter %r in file not found in Block" % k)
+        if not allow_missing:
+            missing = [k for k, p in canonical.items()
+                       if p._data is None and p._deferred_init is None
+                       and k not in loaded]
+            if missing:
+                raise KeyError("Missing parameters in file: %s" % missing)
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- call path --------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        lines = ["%s: %d parameters" % (self.name, sum(
+            int(p.data().size) for p in self.collect_params().values()
+            if p._data is not None))]
+        return "\n".join(lines)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def __repr__(self):
+        s = "%s(\n" % type(self).__name__
+        for key, child in self._children.items():
+            s += "  (%s): %s\n" % (key, repr(child).replace("\n", "\n  "))
+        return s + ")"
+
+
+class HybridBlock(Block):
+    """Block that can be traced to one XLA computation (ref: block.py:765)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = {}
+        self._static_alloc = False
+        self._static_shape = False
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=None, forward_bulk_size=None,
+                  backward_bulk_size=None):
+        """ref: block.py:966. static_alloc/static_shape accepted for parity;
+        XLA always plans memory statically."""
+        self._active = active
+        self._static_alloc = static_alloc
+        self._static_shape = static_shape
+        self._cached_graph = {}
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape)
+
+    def infer_shape(self, *args):
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        """Run an abstract (shape-only) forward to finish deferred param
+        init — the analog of the reference's shape-inference pass before
+        CachedOp creation (ref: block.py _deferred_infer_shape)."""
+        try:
+            with autograd.pause():
+                jax.eval_shape(self._abstract_forward,
+                               *[jax.ShapeDtypeStruct(a.shape, a.dtype)
+                                 for a in args])
+        except DeferredInitializationError:
+            raise
+        except Exception:
+            # fall back: eager forward on zeros would also trigger init;
+            # abstract pass can fail when params are entirely uninitialized
+            raise
+
+    def _abstract_forward(self, *datas):
+        outs = self.forward(*[NDArray(d) for d in datas])
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        return tuple(o._data for o in outs)
+
+    def cast(self, dtype):
+        super().cast(dtype)
+        self._cached_graph = {}
+
+    def _collect_params_with_prefix(self, prefix=""):
+        out = {}
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            if isinstance(child, HybridBlock) or isinstance(child, Block):
+                out.update(child._collect_params_with_prefix(
+                    prefix + cname + "."))
+        return out
+
+    # -- forward ----------------------------------------------------------
+    def __call__(self, *args):
+        if self._active:
+            return self._call_cached_op(*args)
+        return super().__call__(*args)
+
+    def forward(self, x, *args):
+        """Eager path: pass NDArrays + param NDArrays to hybrid_forward
+        (ref: block.py:1054 HybridBlock.forward)."""
+        params = {}
+        for name, p in self._reg_params.items():
+            try:
+                params[name] = p.data()
+            except DeferredInitializationError:
+                self._infer_param_shapes(x, *args)
+                params[name] = p.data()
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def _infer_param_shapes(self, *args):
+        """Finish deferred init by running shape inference via eval_shape of
+        hybrid_forward with zero-filled placeholder params."""
+        hinted = self._shape_hint(*args)
+        for p in self._reg_params.values():
+            if p._data is None and p._deferred_init is not None:
+                shape = hinted.get(p)
+                if shape is None:
+                    raise DeferredInitializationError(
+                        "cannot infer shape for %s" % p.name)
+                p._finish_deferred_init(shape)
+
+    def _shape_hint(self, *args):
+        """Subclasses (Dense/Conv/...) override to map input shapes to param
+        shapes for deferred init."""
+        return {}
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- CachedOp analog ---------------------------------------------------
+    def _call_cached_op(self, *args):
+        nd_args = [a for a in args if isinstance(a, NDArray)]
+        # finish deferred init first (eager trace of shapes)
+        for p in self._all_params_list():
+            if p._data is None and p._deferred_init is not None:
+                with autograd.pause():
+                    Block.__call__(self, *args)  # eager forward initializes
+                break
+        params = self._all_params_list()
+        param_datas = [p.data()._data for p in params]
+        training = autograd.is_training()
+        sig = (tuple((a.shape, str(a.dtype)) for a in nd_args), training)
+        entry = self._cached_graph.get(sig)
+        if entry is None:
+            entry = self._build_cached_graph(params, training)
+            self._cached_graph[sig] = entry
+        jitted, n_outs, aux_params = entry
+
+        rng = _random.next_key()
+        in_datas = tuple(a._data for a in nd_args)
+
+        if autograd.is_recording():
+            def run(pd, xd):
+                return jitted(pd, xd, rng)
+            (out_datas, aux_datas), vjp_fn = jax.vjp(
+                run, tuple(param_datas), in_datas)
+
+            def vjp_flat(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                zero_aux = tuple(jnp.zeros(a.shape, a.dtype)
+                                 for a in aux_datas)
+                pd_cts, xd_cts = vjp_fn((tuple(cts), zero_aux))
+                return tuple(pd_cts) + tuple(xd_cts)
+
+            out_nds = [NDArray(o) for o in out_datas]
+            inputs = [p.data() for p in params] + nd_args
+            node = autograd.record_op(
+                "CachedOp(%s)" % self.name, out_nds, inputs, vjp_flat)
+            node.fwd_fn = None  # create_graph through cached op unsupported
+        else:
+            out_datas, aux_datas = jitted(tuple(param_datas), in_datas, rng)
+            out_nds = [NDArray(o) for o in out_datas]
+
+        # apply aux updates (moving stats)
+        for p, new in zip(aux_params, aux_datas):
+            p.data()._data = new
+        return out_nds[0] if len(out_nds) == 1 else tuple(out_nds)
+
+    def _all_params_list(self):
+        seen, out = set(), []
+        for _, p in sorted(self._collect_params_with_prefix().items()):
+            if id(p) not in seen:
+                seen.add(id(p))
+                out.append(p)
+        return out
+
+    def _build_cached_graph(self, params, training):
+        """Trace the block's forward into one jitted pure function.
+        Analog of CachedOp::SetForwardGraph + StaticInitExec
+        (ref: src/imperative/cached_op.cc:307,584)."""
+        aux_params = []
+
+        def pure_fn(param_datas, input_datas, rng_key):
+            # swap traced data into the parameters, run eager forward
+            originals = [p.data()._data for p in params]
+            for p, d in zip(params, param_datas):
+                p.data()._data = d
+            _random.push_trace_key(rng_key)
+            collected = []
+            _AUX.stack.append(collected)
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            try:
+                out = Block.__call__(
+                    self, *[NDArray(d) for d in input_datas])
+            finally:
+                autograd.set_training(prev_train)
+                autograd.set_recording(prev_rec)
+                _AUX.stack.pop()
+                _random.pop_trace_key()
+                for p, d in zip(params, originals):
+                    p.data()._data = d
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            aux_params.clear()
+            aux_datas = []
+            for p, new_data in collected:
+                aux_params.append(p)
+                aux_datas.append(new_data)
+            return tuple(o._data for o in outs), tuple(aux_datas)
+
+        jitted = jax.jit(pure_fn)
+        # trigger nothing yet; n_outs resolved on first call via structure
+        return jitted, None, aux_params
+
+    def export(self, path, epoch=0):
+        """Serialize architecture + params for deployment
+        (ref: block.py:1004 export)."""
+        params = self.collect_params()
+        arg = {("arg:%s" % n): p.data() for n, p in params.items()
+               if p._data is not None}
+        nd.save("%s-%04d.params" % (path, epoch), arg)
+        import json
+        graph = {"framework": "mxnet_tpu", "block": type(self).__name__,
+                 "params": sorted(params.keys())}
+        with open("%s-symbol.json" % path, "w") as f:
+            json.dump(graph, f, indent=2)
+
+    # optimization barrier for API parity
+    def optimize_for(self, x, backend=None, **kwargs):
+        self.hybridize(True)
+        return self(x)
+
+
+def report_aux_update(param, new_data):
+    """Called by stateful layers (BatchNorm) to publish running-stat updates.
+    Under a cached-op trace the update is collected and threaded out of the
+    pure function; eagerly it is applied immediately."""
+    if _AUX.active():
+        _AUX.add(param, new_data)
+    else:
+        param.data()._data = new_data
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol graph as a block (ref: block.py:1129). Takes a Symbol
+    and input symbols; parameters come from the symbol's arguments."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from ..symbol import Symbol
+        self._outputs = outputs if isinstance(outputs, Symbol) else outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        input_names = {s.name for s in self._inputs}
+        for argname in self._outputs.list_arguments():
+            if argname not in input_names:
+                p = Parameter(argname, allow_deferred_init=True)
+                self._params._params[argname] = p
+                self._reg_params[argname] = p
+
+    @classmethod
+    def imports(cls, symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+        sym = sym_load(symbol_file)
+        from ..symbol import Symbol
+        inputs = [Symbol.var(n) for n in (input_names if isinstance(
+            input_names, (list, tuple)) else [input_names])]
+        ret = cls(sym, inputs)
+        if param_file:
+            loaded = nd.load(param_file)
+            cleaned = {}
+            for k, v in loaded.items():
+                cleaned[k.split(":", 1)[-1]] = v
+            for name, p in ret._params.items():
+                if name in cleaned:
+                    p.set_data(cleaned[name])
+        return ret
+
+    def forward(self, *args):
+        feed = {s.name: a for s, a in zip(self._inputs, args)}
+        for name, p in self._reg_params.items():
+            if p._data is not None:
+                feed[name] = p.data()
+        return self._outputs.eval_dict(feed)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise RuntimeError("SymbolBlock uses forward directly")

@@ -1,0 +1,238 @@
+"""Weight initializers (ref: python/mxnet/initializer.py:57 Initializer and
+the ~15 registered subclasses). Initializers fill host numpy buffers which are
+then placed on device — keeping init off the TPU hot path.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from .base import _Registry
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "Mixed", "get", "register"]
+
+_REG = _Registry("initializer")
+
+
+def register(klass):
+    _REG.register(klass.__name__.lower(), klass)
+    return klass
+
+
+def get(name):
+    if isinstance(name, Initializer):
+        return name
+    return _REG.get(name)()
+
+
+class InitDesc(str):
+    """Name with attrs, ref: python/mxnet/initializer.py:37."""
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base class. Subclasses override ``_init_weight``; dispatch by
+    parameter-name suffix mirrors the reference (initializer.py __call__)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        from .ndarray import NDArray
+        import jax.numpy as jnp
+        if isinstance(arr, NDArray):
+            host = arr.asnumpy()
+            self._init_weight_dispatch(str(desc), host)
+            arr._data = jnp.asarray(host)
+        else:
+            self._init_weight_dispatch(str(desc), arr)
+
+    def _init_weight_dispatch(self, name, arr):
+        name = name.lower()
+        if name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_bias(self, _, arr):
+        arr[...] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[...] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[...] = 0.0
+
+    def _init_zero(self, _, arr):
+        arr[...] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[...] = 1.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return "%s(%s)" % (type(self).__name__, self._kwargs)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[...] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[...] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr[...] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr[...] = _np.random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr[...] = _np.random.normal(0, self.sigma, arr.shape)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[...] = (self.scale * q).reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    """ref: python/mxnet/initializer.py Xavier."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, _, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError("Xavier requires ndim >= 2")
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[...] = _np.random.uniform(-scale, scale, shape)
+        else:
+            arr[...] = _np.random.normal(0, scale, shape)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, _, arr):
+        weight = _np.zeros(arr.size, dtype=arr.dtype)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(arr.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[...] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, _, arr):
+        arr[...] = 0.0
+        num_hidden = arr.shape[0] // 4
+        arr[num_hidden:2 * num_hidden] = self.forget_bias
+
+
+class Mixed:
+    """Patterns → initializers (ref: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for regex, init in self.map:
+            if regex.search(str(name)):
+                init(name, arr)
+                return
+        raise ValueError("no initializer matches %r" % name)
+
+    def _init_weight_dispatch(self, name, arr):
+        for regex, init in self.map:
+            if regex.search(str(name)):
+                init._init_weight_dispatch(name, arr)
+                return
+        raise ValueError("no initializer matches %r" % name)

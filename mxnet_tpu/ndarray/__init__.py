@@ -1,0 +1,123 @@
+"""``mxnet_tpu.nd`` — the imperative NDArray API namespace.
+
+Mirrors the reference's ``mx.nd`` module layout
+(ref: python/mxnet/ndarray/__init__.py): the NDArray class, creation
+functions, and one generated wrapper per registered operator.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import canonical_dtype
+from ..context import current_context, Context
+from .ndarray import NDArray, array, concatenate
+from . import register as _register_mod
+
+__all__ = ["NDArray", "array", "concatenate", "zeros", "ones", "full",
+           "empty", "arange", "eye", "linspace", "waitall", "save", "load",
+           "imperative_invoke"]
+
+
+# -- creation ---------------------------------------------------------------
+
+def _ctx_place(data, ctx):
+    ctx = ctx or current_context()
+    try:
+        return NDArray(jax.device_put(data, ctx.jax_device()), ctx=ctx)
+    except Exception:
+        return NDArray(data, ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _ctx_place(jnp.zeros(shape, canonical_dtype(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _ctx_place(jnp.ones(shape, canonical_dtype(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _ctx_place(jnp.full(shape, val, canonical_dtype(dtype)), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    out = jnp.arange(start, stop, step, canonical_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return _ctx_place(out, ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    return _ctx_place(jnp.eye(N, M if M else None, k, canonical_dtype(dtype)), ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    return _ctx_place(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                   dtype=canonical_dtype(dtype)), ctx)
+
+
+def waitall():
+    """ref: mx.nd.waitall → Engine::WaitForAll. XLA async dispatch drains when
+    we block on effects; jax exposes no global barrier, so this is a no-op
+    fence plus a tiny device sync."""
+    try:
+        jax.block_until_ready(jnp.zeros(()))
+    except Exception:
+        pass
+
+
+def imperative_invoke(name, *args, **kwargs):
+    return _register_mod.invoke_by_name(name, *args, **kwargs)
+
+
+# -- serialization (ref: MXNDArraySave/Load, include/mxnet/c_api.h:638-672) --
+
+_MAGIC = b"MXTPU_ND1"
+
+
+def save(fname, data):
+    """Save an NDArray, list of NDArrays, or dict str->NDArray."""
+    if isinstance(data, NDArray):
+        payload = ("single", _np.asarray(data.asnumpy()))
+    elif isinstance(data, (list, tuple)):
+        payload = ("list", [_np.asarray(a.asnumpy()) for a in data])
+    elif isinstance(data, dict):
+        payload = ("dict", {k: _np.asarray(v.asnumpy()) for k, v in data.items()})
+    else:
+        raise TypeError("unsupported save payload %r" % type(data))
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(payload, f, protocol=4)
+
+
+def load(fname):
+    with open(fname, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError("not a %s NDArray file: %s" % ("mxnet_tpu", fname))
+        kind, payload = pickle.load(f)
+    if kind == "single":
+        return array(payload)
+    if kind == "list":
+        return [array(a) for a in payload]
+    return {k: array(v) for k, v in payload.items()}
+
+
+# -- generated op wrappers --------------------------------------------------
+_register_mod.populate(globals())
+
+# submodule-style namespaces (mx.nd.random, mx.nd.linalg)
+from . import random   # noqa: E402,F401
+from . import linalg   # noqa: E402,F401
+from . import sparse   # noqa: E402,F401

@@ -1,0 +1,541 @@
+"""NDArray: imperative tensor with MXNet semantics on a jax.Array.
+
+TPU-native re-design of the reference NDArray
+(ref: include/mxnet/ndarray.h:82, src/ndarray/ndarray.cc,
+python/mxnet/ndarray/ndarray.py). Differences by design:
+
+- The reference pairs every array with a dependency-engine variable and
+  schedules kernels through the threaded engine (ref: src/engine/). JAX's
+  async dispatch gives the same ops-return-immediately behaviour, so
+  ``wait_to_read`` maps to ``jax.block_until_ready`` and there is no engine to
+  re-implement.
+- Mutation (``x += 1``, ``x[1:3] = v``) is implemented by functional update:
+  the wrapper swaps the underlying immutable buffer. Version semantics match
+  the reference's write-dependency ordering because Python program order is
+  the only ordering eager code can observe.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import canonical_dtype
+from ..context import Context, current_context
+from .. import autograd
+
+__all__ = ["NDArray", "array", "concatenate"]
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+class NDArray:
+    """N-dimensional array on a device context."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_autograd_entry",
+                 "_deferred_init", "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_entry = None
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(_np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        if _is_tracer(self._data):
+            return current_context()
+        try:
+            dev = list(self._data.devices())[0]
+            if dev.platform == "cpu":
+                return Context("cpu", dev.id)
+            return Context("tpu", dev.id)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        from . import transpose
+        return transpose(self)
+
+    # -- sync / host transfer --------------------------------------------
+    def wait_to_read(self):
+        """ref: NDArray::WaitToRead (include/mxnet/ndarray.h) — block until
+        all pending async work producing this array is done."""
+        jax.block_until_ready(self._data)
+        return self
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    def asnumpy(self):
+        return _np.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return "\n<%s %s @%s (traced)>" % (
+                type(self).__name__, "x".join(map(str, self.shape)), self.context)
+        return "\n%s\n<%s %s @%s>" % (
+            str(self.asnumpy()), type(self).__name__,
+            "x".join(map(str, self.shape)), self.context)
+
+    # -- conversion / copy ------------------------------------------------
+    def astype(self, dtype, copy=True):
+        from . import cast
+        return cast(self, dtype=_np.dtype(canonical_dtype(dtype)).name
+                    if not isinstance(dtype, str) else dtype)
+
+    def copy(self):
+        # buffers are immutable; sharing is an O(1) copy with value semantics
+        return NDArray(self._data, ctx=self._ctx)
+
+    def copyto(self, other):
+        """ref: python/mxnet/ndarray/ndarray.py copyto."""
+        if isinstance(other, NDArray):
+            other._data = _place(self._data, other.context)
+            return other
+        if isinstance(other, Context):
+            return NDArray(_place(self._data, other), ctx=other)
+        raise TypeError("copyto target must be NDArray or Context")
+
+    def as_in_context(self, context):
+        if context == self.context:
+            return self
+        return NDArray(_place(self._data, context), ctx=context)
+
+    def as_in_ctx(self, ctx):
+        return self.as_in_context(ctx)
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    # -- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """ref: python/mxnet/ndarray/ndarray.py attach_grad."""
+        self._grad = NDArray(jnp.zeros(self.shape, self.dtype)) \
+            if grad_req != "null" else None
+        self._grad_req = grad_req
+        self._autograd_entry = None
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad], retain_graph=retain_graph,
+                          train_mode=train_mode)
+
+    # -- shape ops (method forms) ----------------------------------------
+    def reshape(self, *shape, **kwargs):
+        from . import reshape as _reshape
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if not shape and "shape" in kwargs:
+            shape = kwargs["shape"]
+        return _reshape(self, shape=shape, reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def expand_dims(self, axis):
+        from . import expand_dims as _f
+        return _f(self, axis=axis)
+
+    def squeeze(self, axis=None):
+        from . import squeeze as _f
+        return _f(self, axis=axis)
+
+    def transpose(self, *axes):
+        from . import transpose as _f
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _f(self, axes=axes if axes else None)
+
+    def swapaxes(self, dim1, dim2):
+        from . import swapaxes as _f
+        return _f(self, dim1=dim1, dim2=dim2)
+
+    def flatten(self):
+        from . import flatten as _f
+        return _f(self)
+
+    def flip(self, axis):
+        from . import reverse as _f
+        return _f(self, axis=axis)
+
+    def tile(self, reps):
+        from . import tile as _f
+        return _f(self, reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        from . import repeat as _f
+        return _f(self, repeats=repeats, axis=axis)
+
+    def broadcast_to(self, shape):
+        from . import broadcast_to as _f
+        return _f(self, shape=shape)
+
+    def broadcast_like(self, other):
+        from . import broadcast_like as _f
+        return _f(self, other)
+
+    def slice(self, begin, end, step=None):
+        from . import slice as _f
+        return _f(self, begin=begin, end=end, step=step or ())
+
+    def slice_axis(self, axis, begin, end):
+        from . import slice_axis as _f
+        return _f(self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        from . import take as _f
+        return _f(self, indices, axis=axis, mode=mode)
+
+    def one_hot(self, depth, **kw):
+        from . import one_hot as _f
+        return _f(self, depth=depth, **kw)
+
+    def pick(self, index, axis=-1, keepdims=False):
+        from . import pick as _f
+        return _f(self, index, axis=axis, keepdims=keepdims)
+
+    def clip(self, a_min, a_max):
+        from . import clip as _f
+        return _f(self, a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        from . import abs as _f
+        return _f(self)
+
+    def sign(self):
+        from . import sign as _f
+        return _f(self)
+
+    def sqrt(self):
+        from . import sqrt as _f
+        return _f(self)
+
+    def square(self):
+        from . import square as _f
+        return _f(self)
+
+    def exp(self):
+        from . import exp as _f
+        return _f(self)
+
+    def log(self):
+        from . import log as _f
+        return _f(self)
+
+    def sigmoid(self):
+        from . import sigmoid as _f
+        return _f(self)
+
+    def tanh(self):
+        from . import tanh as _f
+        return _f(self)
+
+    def relu(self):
+        from . import relu as _f
+        return _f(self)
+
+    def softmax(self, axis=-1):
+        from . import softmax as _f
+        return _f(self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        from . import log_softmax as _f
+        return _f(self, axis=axis)
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        from . import sum as _f
+        return _f(self, axis=axis, keepdims=keepdims, **kw)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        from . import mean as _f
+        return _f(self, axis=axis, keepdims=keepdims, **kw)
+
+    def prod(self, axis=None, keepdims=False):
+        from . import prod as _f
+        return _f(self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        from . import max as _f
+        return _f(self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        from . import min as _f
+        return _f(self, axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        from . import norm as _f
+        return _f(self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        from . import argmax as _f
+        return _f(self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        from . import argmin as _f
+        return _f(self, axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        from . import argsort as _f
+        return _f(self, axis=axis, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        from . import sort as _f
+        return _f(self, axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        from . import topk as _f
+        return _f(self, axis=axis, k=k, ret_typ=ret_typ, is_ascend=is_ascend)
+
+    def dot(self, other, **kw):
+        from . import dot as _f
+        return _f(self, other, **kw)
+
+    def zeros_like(self):
+        return NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
+
+    def ones_like(self):
+        return NDArray(jnp.ones(self.shape, self.dtype), ctx=self._ctx)
+
+    # -- arithmetic operators --------------------------------------------
+    def _binop(self, name, other, reverse=False):
+        from . import register as _r
+        a, b = (other, self) if reverse else (self, other)
+        return _r.invoke_by_name(name, a, b)
+
+    def __add__(self, other):
+        return self._binop("add", other)
+
+    def __radd__(self, other):
+        return self._binop("add", other, True)
+
+    def __sub__(self, other):
+        return self._binop("subtract", other)
+
+    def __rsub__(self, other):
+        return self._binop("subtract", other, True)
+
+    def __mul__(self, other):
+        return self._binop("multiply", other)
+
+    def __rmul__(self, other):
+        return self._binop("multiply", other, True)
+
+    def __truediv__(self, other):
+        return self._binop("divide", other)
+
+    def __rtruediv__(self, other):
+        return self._binop("divide", other, True)
+
+    def __div__(self, other):
+        return self._binop("divide", other)
+
+    def __mod__(self, other):
+        return self._binop("mod", other)
+
+    def __rmod__(self, other):
+        return self._binop("mod", other, True)
+
+    def __pow__(self, other):
+        return self._binop("power", other)
+
+    def __rpow__(self, other):
+        return self._binop("power", other, True)
+
+    def __neg__(self):
+        from . import negative as _f
+        return _f(self)
+
+    def __abs__(self):
+        return self.abs()
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._binop("equal", other)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._binop("not_equal", other)
+
+    def __gt__(self, other):
+        return self._binop("greater", other)
+
+    def __ge__(self, other):
+        return self._binop("greater_equal", other)
+
+    def __lt__(self, other):
+        return self._binop("lesser", other)
+
+    def __le__(self, other):
+        return self._binop("lesser_equal", other)
+
+    __hash__ = object.__hash__
+
+    # in-place (functional update under the hood)
+    def _check_inplace(self):
+        if autograd.is_recording() and self._autograd_entry is not None:
+            raise RuntimeError(
+                "in-place mutation of a recorded NDArray inside "
+                "autograd.record() is not supported (matches reference "
+                "restriction on arrays that need grad)")
+
+    def __iadd__(self, other):
+        self._check_inplace()
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = self._data + o
+        return self
+
+    def __isub__(self, other):
+        self._check_inplace()
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = self._data - o
+        return self
+
+    def __imul__(self, other):
+        self._check_inplace()
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = self._data * o
+        return self
+
+    def __itruediv__(self, other):
+        self._check_inplace()
+        o = other._data if isinstance(other, NDArray) else other
+        self._data = self._data / o
+        return self
+
+    # -- indexing ---------------------------------------------------------
+    @staticmethod
+    def _clean_index(key):
+        if isinstance(key, NDArray):
+            return key._data if _np.issubdtype(key.dtype, _np.bool_) \
+                else key._data.astype(jnp.int32)
+        if isinstance(key, tuple):
+            return tuple(NDArray._clean_index(k) for k in key)
+        if isinstance(key, list):
+            return jnp.asarray(key)
+        return key
+
+    def __getitem__(self, key):
+        from . import register as _r
+        return _r.invoke_getitem(self, self._clean_index(key))
+
+    def __setitem__(self, key, value):
+        self._check_inplace()
+        k = self._clean_index(key)
+        v = value._data if isinstance(value, NDArray) else value
+        if isinstance(v, _np.ndarray):
+            v = jnp.asarray(v)
+        self._data = self._data.at[k].set(v)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # numpy protocol
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    @property
+    def dlpack(self):
+        return jax.dlpack.to_dlpack(self._data)
+
+
+def _place(data, ctx):
+    if _is_tracer(data):
+        return data
+    return jax.device_put(data, ctx.jax_device())
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like.
+    ref: python/mxnet/ndarray/utils.py array()."""
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+    else:
+        npv = _np.asarray(source_array,
+                          dtype=canonical_dtype(dtype) if dtype is not None
+                          else None)
+        if npv.dtype == _np.float64 and dtype is None:
+            # reference defaults to float32 (python/mxnet/ndarray/ndarray.py)
+            npv = npv.astype(_np.float32)
+        data = jnp.asarray(npv)
+    ctx = ctx or current_context()
+    return NDArray(_place(data, ctx) if not _is_tracer(data) else data, ctx=ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    from . import concat
+    return concat(*arrays, dim=axis)

@@ -1,0 +1,76 @@
+"""``mx.nd.random`` namespace (ref: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from .register import invoke_by_name as _inv
+
+__all__ = ["uniform", "normal", "randn", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial", "multinomial",
+           "randint", "shuffle"]
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    from .ndarray import NDArray
+    if isinstance(low, NDArray):
+        return _inv("sample_uniform", low, high, shape=_shape(shape), dtype=dtype)
+    return _inv("random_uniform", low=low, high=high, shape=_shape(shape),
+                dtype=dtype)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    from .ndarray import NDArray
+    if isinstance(loc, NDArray):
+        return _inv("sample_normal", loc, scale, shape=_shape(shape), dtype=dtype)
+    return _inv("random_normal", loc=loc, scale=scale, shape=_shape(shape),
+                dtype=dtype)
+
+
+def randn(*shape, **kwargs):
+    return normal(shape=shape, **kwargs)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    from .ndarray import NDArray
+    if isinstance(alpha, NDArray):
+        return _inv("sample_gamma", alpha, beta, shape=_shape(shape), dtype=dtype)
+    return _inv("random_gamma", alpha=alpha, beta=beta, shape=_shape(shape),
+                dtype=dtype)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return _inv("random_exponential", lam=1.0 / scale, shape=_shape(shape),
+                dtype=dtype)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return _inv("random_poisson", lam=lam, shape=_shape(shape), dtype=dtype)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return _inv("random_negative_binomial", k=k, p=p, shape=_shape(shape),
+                dtype=dtype)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, out=None):
+    return _inv("random_generalized_negative_binomial", mu=mu, alpha=alpha,
+                shape=_shape(shape), dtype=dtype)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    return _inv("sample_multinomial", data, shape=_shape(shape),
+                get_prob=get_prob, dtype=dtype)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    return _inv("random_randint", low=low, high=high, shape=_shape(shape),
+                dtype=dtype)
+
+
+def shuffle(data, **kw):
+    return _inv("shuffle", data)

@@ -1,0 +1,137 @@
+"""Generate NDArray-level op wrappers from the functional registry.
+
+Analog of the reference's import-time op wrapper generation
+(ref: python/mxnet/ndarray/register.py, python/mxnet/_ctypes/ndarray.py
+_imperative_invoke) and of Imperative::Invoke's dispatch
+(ref: src/imperative/imperative.cc:89). Each call:
+
+1. unwraps NDArray args to jax arrays,
+2. threads PRNG keys / train-mode flags for ops that need them,
+3. runs the pure function (XLA async-dispatches — the engine analog),
+4. if autograd is recording and the outputs are differentiable, captures the
+   ``jax.vjp`` closure on the tape (Imperative::RecordOp analog).
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import autograd
+from .. import random as _random
+from ..ops import registry as _registry
+from .ndarray import NDArray
+
+__all__ = ["invoke", "invoke_by_name", "make_op_func", "populate",
+           "invoke_getitem"]
+
+_SPEC_CACHE = {}
+
+
+def _spec(opdef):
+    sp = _SPEC_CACHE.get(opdef.name)
+    if sp is None:
+        params = inspect.signature(opdef.fn).parameters
+        sp = {
+            "has_key": "key" in params,
+            "has_training": "_training" in params,
+        }
+        _SPEC_CACHE[opdef.name] = sp
+    return sp
+
+
+def _is_inexact(dt):
+    return _np.issubdtype(_np.dtype(dt), _np.inexact)
+
+
+def invoke(opdef, args, kwargs):
+    spec = _spec(opdef)
+    kwargs = dict(kwargs)
+    if spec["has_key"] and kwargs.get("key") is None:
+        kwargs["key"] = _random.next_key()
+    if spec["has_training"] and "_training" not in kwargs:
+        kwargs["_training"] = autograd.is_training()
+
+    # collect differentiable NDArray inputs from args and kwargs
+    arg_slots = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    kw_slots = [k for k, v in kwargs.items()
+                if isinstance(v, NDArray) and k != "key"]
+    nd_inputs = [args[i] for i in arg_slots] + [kwargs[k] for k in kw_slots]
+    datas = tuple(a._data for a in nd_inputs)
+
+    def fwd(*xs):
+        new_args = list(args)
+        new_kwargs = dict(kwargs)
+        for slot, x in zip(arg_slots, xs[:len(arg_slots)]):
+            new_args[slot] = x
+        for k, x in zip(kw_slots, xs[len(arg_slots):]):
+            new_kwargs[k] = x
+        return opdef.fn(*new_args, **new_kwargs)
+
+    recording = (autograd.is_recording() and not opdef.no_grad
+                 and len(datas) > 0
+                 and any(_is_inexact(d.dtype) for d in datas))
+    if recording:
+        out, vjp_fn = jax.vjp(fwd, *datas)
+    else:
+        out = fwd(*datas)
+
+    multi = isinstance(out, (tuple, list))
+    raw_outs = list(out) if multi else [out]
+    outs = [NDArray(o) for o in raw_outs]
+
+    if recording:
+        if all(_is_inexact(o.dtype) for o in raw_outs):
+            node = autograd.record_op(opdef.name, outs, nd_inputs, vjp_fn)
+            node.fwd_fn = fwd
+        # else: non-differentiable output — gradient stops here
+    return tuple(outs) if multi else outs[0]
+
+
+def invoke_by_name(name, *args, **kwargs):
+    return invoke(_registry.get_op(name), args, kwargs)
+
+
+def _as_data(v):
+    return v._data if isinstance(v, NDArray) else v
+
+
+def invoke_getitem(arr, key):
+    """Basic+advanced indexing as a recorded op (differentiable gather)."""
+
+    def fwd(x):
+        return x[key]
+
+    if autograd.is_recording() and _is_inexact(arr.dtype):
+        out, vjp_fn = jax.vjp(fwd, arr._data)
+        res = NDArray(out)
+        node = autograd.record_op("getitem", [res], [arr], vjp_fn)
+        node.fwd_fn = fwd
+        return res
+    return NDArray(fwd(arr._data))
+
+
+def make_op_func(opdef, name):
+    def op_func(*args, **kwargs):
+        # accept and drop common reference-only kwargs
+        kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        return invoke(opdef, args, kwargs)
+    op_func.__name__ = name
+    op_func.__doc__ = opdef.fn.__doc__
+    return op_func
+
+
+def populate(namespace_dict):
+    """Install one wrapper per registered op name/alias into the module
+    namespace (mirrors _init_op_module, ref: python/mxnet/ndarray/register.py)."""
+    seen = {}
+    for name in _registry.list_ops():
+        opdef = _registry.get_op(name)
+        if name not in namespace_dict:
+            if id(opdef) not in seen:
+                seen[id(opdef)] = make_op_func(opdef, opdef.name)
+            fn = seen[id(opdef)]
+            namespace_dict[name] = fn

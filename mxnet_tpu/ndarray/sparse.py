@@ -1,0 +1,174 @@
+"""Sparse NDArray: row_sparse and CSR.
+
+TPU-native take on the reference's sparse storage types
+(ref: include/mxnet/ndarray.h:63-82 kRowSparseStorage/kCSRStorage,
+python/mxnet/ndarray/sparse.py). XLA has no native sparse tensors; the
+design keeps the *API and storage format* (indices+values / indptr+indices+
+data) on host-visible arrays, while compute densifies. Row-sparse remains
+valuable as a communication format (kvstore push/pull of embedding grads
+ships only touched rows — ref: src/kvstore/kvstore_dist.h:522).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .ndarray import NDArray, array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros"]
+
+
+class RowSparseNDArray(NDArray):
+    """Row-sparse: (indices[k], values[k, ...]) with dense shape (n, ...)."""
+
+    __slots__ = ("_indices", "_values")
+
+    def __init__(self, data, indices=None, values=None, ctx=None):
+        super().__init__(data, ctx=ctx)
+        self._indices = indices
+        self._values = values
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        if self._indices is None:
+            nz = _np.nonzero(_np.abs(self.asnumpy()).reshape(
+                self.shape[0], -1).sum(axis=1))[0]
+            self._indices = array(nz.astype(_np.int64))
+        return self._indices
+
+    @property
+    def data(self):
+        if self._values is None:
+            idx = self.indices.asnumpy().astype(_np.int64)
+            self._values = array(self.asnumpy()[idx])
+        return self._values
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data, ctx=self._ctx)
+        return cast_storage(self, stype)
+
+    def retain(self, indices):
+        """Keep only given rows (ref: sparse retain op)."""
+        idx = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) \
+            else _np.asarray(indices, _np.int64)
+        mask = _np.zeros(self.shape[0], bool)
+        mask[idx] = True
+        dense = self.asnumpy() * mask.reshape((-1,) + (1,) * (self.ndim - 1))
+        return RowSparseNDArray(jnp.asarray(dense), ctx=self._ctx)
+
+
+class CSRNDArray(NDArray):
+    """Compressed sparse row matrix."""
+
+    __slots__ = ("_indptr", "_indices", "_values")
+
+    def __init__(self, data, indptr=None, indices=None, values=None, ctx=None):
+        super().__init__(data, ctx=ctx)
+        self._indptr = indptr
+        self._indices = indices
+        self._values = values
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self):
+        self._materialize()
+        return self._indptr
+
+    @property
+    def indices(self):
+        self._materialize()
+        return self._indices
+
+    @property
+    def data(self):
+        self._materialize()
+        return self._values
+
+    def _materialize(self):
+        if self._indptr is None:
+            dense = self.asnumpy()
+            indptr = [0]
+            indices, values = [], []
+            for row in dense:
+                nz = _np.nonzero(row)[0]
+                indices.extend(nz.tolist())
+                values.extend(row[nz].tolist())
+                indptr.append(len(indices))
+            self._indptr = array(_np.asarray(indptr, _np.int64))
+            self._indices = array(_np.asarray(indices, _np.int64))
+            self._values = array(_np.asarray(values, dense.dtype))
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data, ctx=self._ctx)
+        return cast_storage(self, stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Build from (values, indices) or a dense array-like.
+    ref: python/mxnet/ndarray/sparse.py row_sparse_array."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        values, indices = arg1
+        values = values.asnumpy() if isinstance(values, NDArray) \
+            else _np.asarray(values, _np.float32 if dtype is None else dtype)
+        indices = indices.asnumpy() if isinstance(indices, NDArray) \
+            else _np.asarray(indices, _np.int64)
+        n = shape[0] if shape else int(indices.max()) + 1 if len(indices) else 0
+        full_shape = (n,) + tuple(values.shape[1:]) if shape is None else tuple(shape)
+        dense = _np.zeros(full_shape, values.dtype)
+        dense[indices.astype(_np.int64)] = values
+        return RowSparseNDArray(jnp.asarray(dense),
+                                indices=array(indices), values=array(values),
+                                ctx=ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return RowSparseNDArray(jnp.asarray(src), ctx=ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Build from (data, indices, indptr) or dense. ref: sparse.py csr_matrix."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _np.asarray(data.asnumpy() if isinstance(data, NDArray) else data)
+        indices = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                              else indices, _np.int64)
+        indptr = _np.asarray(indptr.asnumpy() if isinstance(indptr, NDArray)
+                             else indptr, _np.int64)
+        nrow = len(indptr) - 1
+        ncol = shape[1] if shape else (int(indices.max()) + 1 if len(indices) else 0)
+        dense = _np.zeros((nrow, ncol), data.dtype)
+        for r in range(nrow):
+            cols = indices[indptr[r]:indptr[r + 1]]
+            dense[r, cols] = data[indptr[r]:indptr[r + 1]]
+        return CSRNDArray(jnp.asarray(dense), ctx=ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return CSRNDArray(jnp.asarray(src), ctx=ctx)
+
+
+def cast_storage(arr, stype):
+    """ref: src/operator/tensor/cast_storage.cc."""
+    if stype == "default":
+        return NDArray(arr._data, ctx=arr._ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(arr._data, ctx=arr._ctx)
+    if stype == "csr":
+        return CSRNDArray(arr._data, ctx=arr._ctx)
+    raise ValueError("unknown stype %r" % (stype,))
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    from . import zeros as _zeros
+    dense = _zeros(shape, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, stype)

@@ -1,0 +1,16 @@
+"""Functional operator layer: pure jax-array functions behind a registry.
+
+This package replaces the reference's src/operator/ C++/CUDA kernel corpus
+(578 files; ref: SURVEY.md §2.1) with XLA-lowered pure functions. Import
+order registers the op families; user-facing NDArray/Symbol wrappers are
+generated from the registry (mxnet_tpu/ndarray/register.py).
+"""
+from .registry import register, get_op, list_ops, OpDef
+from . import elemwise       # noqa: F401
+from . import tensor         # noqa: F401
+from . import linalg         # noqa: F401
+from . import nn             # noqa: F401
+from . import random_ops     # noqa: F401
+from . import ctc            # noqa: F401
+
+__all__ = ["register", "get_op", "list_ops", "OpDef"]

@@ -1,0 +1,146 @@
+"""Linear algebra ops — the MXU hot path.
+
+TPU-native re-design of the reference's dot/batch_dot and linalg families
+(ref: src/operator/tensor/dot-inl.h, src/operator/tensor/la_op.cc). All
+products lower to XLA dot_general which tiles onto the MXU; there is no BLAS
+dispatch layer (ref: 3rdparty/mshadow/mshadow/dot_engine-inl.h is replaced by
+the compiler).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("dot", num_inputs=2)
+def dot(a, b, transpose_a=False, transpose_b=False):
+    # MXNet dot: contract last axis of a with first axis of b
+    if transpose_a:
+        a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot", num_inputs=2)
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            out.shape[0] * m.shape[0], *out.shape[1:])
+    return out
+
+
+# -- linalg_* family (ref: src/operator/tensor/la_op.cc) --------------------
+
+@register("linalg_gemm", num_inputs=3)
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2", num_inputs=2)
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf", num_inputs=1)
+def linalg_potrf(A, lower=True):
+    L = jnp.linalg.cholesky(A)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register("linalg_potri", num_inputs=1)
+def linalg_potri(A, lower=True):
+    L = A if lower else jnp.swapaxes(A, -1, -2)
+    n = L.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=L.dtype), L.shape)
+    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+
+
+@register("linalg_trmm", num_inputs=2)
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("linalg_trsm", num_inputs=2)
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if rightside:
+        # solve X A = alpha B  ->  A^T X^T = alpha B^T
+        sol = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(A, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(sol, -1, -2)
+    return jax.scipy.linalg.solve_triangular(
+        A, alpha * B, lower=lower, trans=1 if transpose else 0)
+
+
+@register("linalg_sumlogdiag", num_inputs=1)
+def linalg_sumlogdiag(A):
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("linalg_extractdiag", num_inputs=1)
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag", num_inputs=1)
+def linalg_makediag(d, offset=0):
+    return jax.vmap(lambda v: jnp.diag(v, k=offset))(d.reshape(-1, d.shape[-1])) \
+        .reshape(d.shape[:-1] + (d.shape[-1] + abs(offset),) * 2) \
+        if d.ndim > 1 else jnp.diag(d, k=offset)
+
+
+@register("linalg_syrk", num_inputs=1)
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register("linalg_gelqf", num_inputs=1)
+def linalg_gelqf(A):
+    # LQ factorization: A = L Q. Via QR of A^T.
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_inverse", num_inputs=1)
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det", num_inputs=1)
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", num_inputs=1)
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("norm_fro", num_inputs=1)
+def norm_fro(A):
+    return jnp.sqrt(jnp.sum(jnp.square(A)))

@@ -1,0 +1,426 @@
+"""Neural-network ops: conv, pooling, norm layers, softmax, dropout, FC.
+
+TPU-native re-design of the reference's nn operator family
+(ref: src/operator/nn/convolution.cc, pooling.cc, batch_norm.cc,
+layer_norm.cc, softmax.cc, fully_connected.cc, dropout-inl.h, lrn.cc,
+activation.cc, src/operator/leaky_relu-inl.h). The cuDNN wrapper layer
+(ref: src/operator/nn/cudnn/) has no analog: XLA:TPU lowers
+conv_general_dilated / reduce_window straight onto the MXU/VPU, and algorithm
+selection (ref: cudnn_algoreg-inl.h) is the compiler's autotuner's job.
+
+Layout: the reference default is NCHW. XLA:TPU handles NCHW natively (it
+relayouts internally), so the public API keeps NCHW for parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _pair(v, n=2):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v if len(v) == n else v * n
+
+
+@register("FullyConnected", num_inputs=None, aliases=("fully_connected",))
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    if flatten:
+        x2 = x.reshape(x.shape[0], -1)
+    else:
+        x2 = x
+    out = jnp.matmul(x2, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout="NCHW", cudnn_tune=None, cudnn_off=False,
+                workspace=1024):
+    """N-D convolution (1D/2D/3D by kernel length), NCHW/NCW/NCDHW layouts.
+    ref: src/operator/nn/convolution-inl.h ConvolutionParam/ConvolutionCompute.
+    """
+    nd = len(kernel) if kernel is not None else x.ndim - 2
+    stride = _pair(stride, nd)
+    dilate = _pair(dilate, nd)
+    pad = _pair(pad if pad is not None else 0, nd)
+    padding = [(p, p) for p in pad]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW"[:2] + "DHW"[3 - nd:], "OIDHW"[:2] + "DHW"[3 - nd:],
+         "NCHW"[:2] + "DHW"[3 - nd:]) if nd != 2 else ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        lhs_dilation=(1,) * nd, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=None)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, target_shape=None, num_filter=None,
+                  num_group=1, no_bias=True, layout="NCHW", cudnn_tune=None,
+                  cudnn_off=False, workspace=512):
+    """Transposed convolution. ref: src/operator/nn/deconvolution-inl.h.
+    Implemented as conv_general_dilated with lhs_dilation (fractional stride).
+    """
+    nd = len(kernel)
+    stride = _pair(stride, nd)
+    dilate = _pair(dilate, nd)
+    pad = _pair(pad if pad is not None else 0, nd)
+    adj = _pair(adj if adj is not None else 0, nd)
+    # effective kernel
+    k_eff = [dilate[i] * (kernel[i] - 1) + 1 for i in range(nd)]
+    padding = [(k_eff[i] - 1 - pad[i], k_eff[i] - 1 - pad[i] + adj[i])
+               for i in range(nd)]
+    # weight layout in reference deconv: (in_channels, out_channels/g, *k)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if num_group > 1:
+        cin, cog = w.shape[0], w.shape[1]
+        w = w.reshape(num_group, cin // num_group, cog, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(num_group * cog, cin // num_group,
+                                          *w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn_spec = ("NCHW", "OIHW", "NCHW") if nd == 2 else (
+        "NC" + "DHW"[3 - nd:], "OI" + "DHW"[3 - nd:], "NC" + "DHW"[3 - nd:])
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_spec)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Pooling", num_inputs=1, aliases=("pooling",))
+def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
+            global_pool=False, pooling_convention="valid", cudnn_off=False,
+            p_value=2, count_include_pad=True, layout=None):
+    """ref: src/operator/nn/pooling-inl.h PoolingParam."""
+    nd = x.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, 2 + nd))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            red = jnp.sum if pool_type == "sum" else jnp.mean
+            return red(x, axis=axes, keepdims=True)
+        if pool_type == "lp":
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p_value), axis=axes,
+                                     keepdims=True), 1.0 / p_value)
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride, nd)
+    pad = _pair(pad if pad is not None else 0, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil division output size (ref: pooling-inl.h kFull)
+        padding = [(0, 0), (0, 0)]
+        for i in range(nd):
+            in_sz = x.shape[2 + i] + 2 * pad[i]
+            out_sz = -(-(in_sz - kernel[i]) // stride[i]) + 1
+            needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz
+            padding.append((pad[i], pad[i] + max(needed, 0)))
+    else:
+        padding = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max,
+                                     window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add,
+                                  window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, jnp.asarray(0, x.dtype), jax.lax.add,
+                                    window, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        s = jax.lax.reduce_window(jnp.power(jnp.abs(x), p_value),
+                                  jnp.asarray(0, x.dtype), jax.lax.add,
+                                  window, strides, padding)
+        return jnp.power(s, 1.0 / p_value)
+    raise ValueError("unknown pool_type %r" % (pool_type,))
+
+
+@register("Activation", num_inputs=1, aliases=("activation",))
+def activation(x, act_type="relu"):
+    # ref: src/operator/nn/activation-inl.h
+    if act_type == "relu":
+        return jnp.maximum(x, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return x / (1 + jnp.abs(x))
+    raise ValueError("unknown act_type %r" % (act_type,))
+
+
+@register("LeakyReLU", aliases=("leaky_relu",))
+def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    # ref: src/operator/leaky_relu-inl.h
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (x.ndim - 2)) if gamma.ndim == 1 \
+            else gamma
+        return jnp.where(x > 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(x > 0, x, mid * x)
+    raise ValueError("unknown act_type %r" % (act_type,))
+
+
+@register("softmax", num_inputs=1)
+def softmax(x, axis=-1, temperature=None, length=None, use_length=False,
+            dtype=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if use_length and length is not None:
+        T = x.shape[axis]
+        pos = jnp.arange(T)
+        shp = [1] * x.ndim
+        shp[axis] = T
+        mask = pos.reshape(shp) < length.reshape(
+            length.shape + (1,) * (x.ndim - length.ndim))
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    out = jax.nn.softmax(x, axis=axis)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("log_softmax", num_inputs=1)
+def log_softmax(x, axis=-1, temperature=None, dtype=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    out = jax.nn.log_softmax(x, axis=axis)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("softmin", num_inputs=1)
+def softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register("softmax_cross_entropy", num_inputs=2)
+def softmax_cross_entropy(data, label):
+    # ref: src/operator/loss_binary_op.cc
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], -1)
+    return jnp.sum(nll)
+
+
+@register("SoftmaxOutput", num_inputs=2, aliases=("softmax_output",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Forward = softmax; the custom backward (softmax - onehot(label)) is
+    wired by the symbol layer. ref: src/operator/softmax_output-inl.h."""
+    axis = 1 if multi_output else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("BatchNorm", aliases=("batch_norm",))
+def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+               fix_gamma=True, use_global_stats=False, output_mean_var=False,
+               axis=1, cudnn_off=False, min_calib_range=None,
+               max_calib_range=None, _training=True):
+    """Returns (out, batch_mean, batch_var). Moving-stat update is done by the
+    caller (gluon layer / stateful executor) — functional purity for XLA.
+    ref: src/operator/nn/batch_norm-inl.h.
+    """
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    use_batch = _training and not use_global_stats
+    if use_batch:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    else:
+        mean, var = moving_mean, moving_var
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+    inv = jax.lax.rsqrt(var + eps).reshape(shape)
+    out = (x - mean.reshape(shape)) * inv * g.reshape(shape) + beta.reshape(shape)
+    return out, mean, var
+
+
+@register("LayerNorm", num_inputs=3, aliases=("layer_norm",))
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    # ref: src/operator/nn/layer_norm.cc
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+    out = (x - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@register("InstanceNorm", num_inputs=3, aliases=("instance_norm",))
+def instance_norm(x, gamma, beta, eps=1e-3):
+    # ref: src/operator/instance_norm-inl.h (normalize over spatial dims)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+
+
+@register("GroupNorm", num_inputs=3, aliases=("group_norm",))
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
+    # ref: src/operator/nn/group_norm.cc
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization", num_inputs=1, aliases=("l2_normalization",))
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    # ref: src/operator/l2_normalization-inl.h
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise ValueError(mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+@register("LRN", num_inputs=1, aliases=("lrn",))
+def lrn(x, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    # cross-channel local response norm, ref: src/operator/nn/lrn.cc
+    half = nsize // 2
+    sq = jnp.square(x)
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (x.ndim - 2))
+    acc = jnp.zeros_like(x)
+    for i in range(nsize):
+        acc = acc + jax.lax.dynamic_slice_in_dim(padded, i, x.shape[1], axis=1)
+    return x / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+@register("Dropout", num_inputs=None, aliases=("dropout",))
+def dropout(x, key=None, p=0.5, mode="training", axes=(), _training=True,
+            cudnn_off=False):
+    """ref: src/operator/nn/dropout-inl.h. ``key`` is a jax PRNG key threaded
+    by the wrapper (global RNG eagerly; trace key under jit)."""
+    if not _training and mode != "always":
+        return x
+    if p <= 0.0:
+        return x
+    shape = x.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(x.shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape)
+    return jnp.where(mask, x / keep, jnp.zeros((), x.dtype))
+
+
+@register("UpSampling", aliases=("upsampling",))
+def upsampling(*data, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    # ref: src/operator/nn/upsampling-inl.h (nearest only; bilinear via deconv)
+    x = data[0]
+    out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    if len(data) > 1 and multi_input_mode == "concat":
+        outs = [out]
+        for d in data[1:]:
+            s = x.shape[2] * scale // d.shape[2]
+            outs.append(jnp.repeat(jnp.repeat(d, s, axis=2), s, axis=3))
+        return jnp.concatenate(outs, axis=1)
+    return out
+
+
+@register("BilinearSampler", num_inputs=2, aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, cudnn_off=False):
+    # ref: src/operator/bilinear_sampler.cc — grid channels (x, y) in [-1, 1]
+    n, c, h, w = data.shape
+
+    def one(img, gxy):  # img (c,h,w), gxy (2,ho,wo)
+        gx = (gxy[0] + 1) * (w - 1) / 2.0
+        gy = (gxy[1] + 1) * (h - 1) / 2.0
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx1, wy1 = gx - x0, gy - y0
+        wx0, wy0 = 1 - wx1, 1 - wy1
+
+        def sample(yi, xi):
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+            valid = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+            return img[:, yc, xc] * valid.astype(img.dtype)  # (c,ho,wo)
+
+        return (sample(y0, x0) * (wy0 * wx0) + sample(y0, x1) * (wy0 * wx1)
+                + sample(y1, x0) * (wy1 * wx0) + sample(y1, x1) * (wy1 * wx1))
+
+    return jax.vmap(one)(data, grid)
+
+
+@register("GridGenerator", num_inputs=1, aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    # ref: src/operator/grid_generator-inl.h
+    h, w = target_shape
+    if transform_type == "affine":
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], axis=0)
+        grid = jnp.einsum("nij,jk->nik", theta, coords)
+        return grid.reshape(n, 2, h, w)
+    if transform_type == "warp":
+        n, _, hh, ww = data.shape
+        ys = jnp.arange(hh, dtype=data.dtype)
+        xs = jnp.arange(ww, dtype=data.dtype)
+        gx, gy = jnp.meshgrid(xs, ys)
+        fx = (data[:, 0] + gx) * 2 / max(ww - 1, 1) - 1
+        fy = (data[:, 1] + gy) * 2 / max(hh - 1, 1) - 1
+        return jnp.stack([fx, fy], axis=1)
+    raise ValueError(transform_type)

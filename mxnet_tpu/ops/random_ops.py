@@ -1,0 +1,131 @@
+"""Random sampling ops.
+
+TPU-native re-design of the reference's random operator family
+(ref: src/operator/random/sample_op.cc, multisample_op.cc,
+unique_sample_op.cc, src/common/random_generator.h). Every op takes an
+explicit ``key`` (threaded by the NDArray wrapper from the global / trace RNG
+in mxnet_tpu/random.py) — functional purity keeps them jittable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype if dtype and dtype != "None" else "float32")
+
+
+@register("random_uniform", no_grad=True, aliases=("uniform", "_random_uniform"))
+def random_uniform(key=None, low=0.0, high=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.uniform(key, shape, _dt(dtype), low, high)
+
+
+@register("random_normal", no_grad=True,
+          aliases=("normal", "_random_normal", "randn"))
+def random_normal(key=None, loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None):
+    return loc + scale * jax.random.normal(key, shape, _dt(dtype))
+
+
+@register("random_gamma", no_grad=True, aliases=("_random_gamma",))
+def random_gamma(key=None, alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.gamma(key, alpha, shape, _dt(dtype)) * beta
+
+
+@register("random_exponential", no_grad=True, aliases=("_random_exponential",))
+def random_exponential(key=None, lam=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.exponential(key, shape, _dt(dtype)) / lam
+
+
+@register("random_poisson", no_grad=True, aliases=("_random_poisson",))
+def random_poisson(key=None, lam=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.poisson(key, lam, shape).astype(_dt(dtype))
+
+
+@register("random_negative_binomial", no_grad=True,
+          aliases=("_random_negative_binomial",))
+def random_negative_binomial(key=None, k=1, p=1.0, shape=(), dtype="float32",
+                             ctx=None):
+    # NB(k, p) == Poisson(Gamma(k, (1-p)/p))
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(kp, lam, shape).astype(_dt(dtype))
+
+
+@register("random_generalized_negative_binomial", no_grad=True,
+          aliases=("_random_generalized_negative_binomial",))
+def random_generalized_negative_binomial(key=None, mu=1.0, alpha=1.0, shape=(),
+                                         dtype="float32", ctx=None):
+    if alpha <= 0:
+        return jax.random.poisson(key, mu, shape).astype(_dt(dtype))
+    kg, kp = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(kg, r, shape) * (mu * alpha)
+    return jax.random.poisson(kp, lam, shape).astype(_dt(dtype))
+
+
+@register("random_randint", no_grad=True, aliases=("randint", "_random_randint"))
+def random_randint(key=None, low=0, high=1, shape=(), dtype="int32", ctx=None):
+    return jax.random.randint(key, shape, low, high, _dt(dtype))
+
+
+@register("sample_uniform", no_grad=True, num_inputs=2)
+def sample_uniform(low, high, key=None, shape=(), dtype="float32"):
+    shp = low.shape + (tuple(shape) if shape else ())
+    u = jax.random.uniform(key, shp, _dt(dtype))
+    ex = (Ellipsis,) + (None,) * (len(shp) - low.ndim)
+    return low[ex] + u * (high - low)[ex]
+
+
+@register("sample_normal", no_grad=True, num_inputs=2)
+def sample_normal(mu, sigma, key=None, shape=(), dtype="float32"):
+    shp = mu.shape + (tuple(shape) if shape else ())
+    z = jax.random.normal(key, shp, _dt(dtype))
+    ex = (Ellipsis,) + (None,) * (len(shp) - mu.ndim)
+    return mu[ex] + z * sigma[ex]
+
+
+@register("sample_gamma", no_grad=True, num_inputs=2)
+def sample_gamma(alpha, beta, key=None, shape=(), dtype="float32"):
+    shp = alpha.shape + (tuple(shape) if shape else ())
+    ex = (Ellipsis,) + (None,) * (len(shp) - alpha.ndim)
+    g = jax.random.gamma(key, jnp.broadcast_to(alpha[ex], shp), dtype=_dt(dtype))
+    return g * beta[ex]
+
+
+@register("sample_multinomial", no_grad=True, num_inputs=1,
+          aliases=("multinomial", "_sample_multinomial"))
+def sample_multinomial(data, key=None, shape=(), get_prob=False, dtype="int32"):
+    # data: (..., k) probabilities; sample `shape` draws per distribution
+    nsamp = 1
+    if shape:
+        for s in (shape if isinstance(shape, (tuple, list)) else (shape,)):
+            nsamp *= s
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    draws = jax.random.categorical(key, logits, axis=-1,
+                                   shape=(nsamp,) + data.shape[:-1])
+    draws = jnp.moveaxis(draws, 0, -1)
+    out_shape = data.shape[:-1] + (tuple(shape) if shape else ())
+    if not shape:
+        draws = draws[..., 0]
+        out_shape = data.shape[:-1]
+    samples = draws.reshape(out_shape).astype(_dt(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits),
+            samples.astype(jnp.int32).reshape(data.shape[:-1] + (-1,)),
+            axis=-1).reshape(out_shape)
+        return samples, lp
+    return samples
+
+
+@register("shuffle", no_grad=True, num_inputs=1, aliases=("_shuffle",))
+def shuffle(data, key=None):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("bernoulli", no_grad=True, num_inputs=1)
+def bernoulli(p, key=None, dtype="float32"):
+    return jax.random.bernoulli(key, p).astype(_dt(dtype))

@@ -1,0 +1,51 @@
+"""Operator registry.
+
+TPU-native analog of the nnvm op registry + FCompute attribute system
+(ref: include/mxnet/op_attr_types.h:124-304, src/operator/ NNVM_REGISTER_OP).
+Each op is a pure function on jax arrays: ``fn(*arrays, **static_params)``.
+Gradients come from ``jax.vjp`` of the same function, so there is no separate
+FGradient registration; XLA fuses the forward and backward pipelines.
+
+The Python user-facing wrappers (NDArray level, autograd-aware) are generated
+from this registry by ``mxnet_tpu/ndarray/register.py``, mirroring how the
+reference autogenerates wrappers at import time
+(ref: python/mxnet/ndarray/register.py).
+"""
+from __future__ import annotations
+
+__all__ = ["register", "get_op", "list_ops", "OpDef"]
+
+_OPS = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "no_grad", "num_inputs", "aliases", "wrap_kwargs")
+
+    def __init__(self, name, fn, no_grad=False, num_inputs=None, aliases=(),
+                 wrap_kwargs=None):
+        self.name = name
+        self.fn = fn
+        self.no_grad = no_grad          # outputs not differentiable (int/bool)
+        self.num_inputs = num_inputs    # None = variadic / inspect at call
+        self.aliases = aliases
+        self.wrap_kwargs = wrap_kwargs or {}
+
+
+def register(name, no_grad=False, num_inputs=None, aliases=()):
+    """Decorator: register a functional op under ``name`` (+ aliases)."""
+    def _reg(fn):
+        opdef = OpDef(name, fn, no_grad=no_grad, num_inputs=num_inputs,
+                      aliases=aliases)
+        _OPS[name] = opdef
+        for a in aliases:
+            _OPS[a] = opdef
+        return fn
+    return _reg
+
+
+def get_op(name):
+    return _OPS[name]
+
+
+def list_ops():
+    return sorted(_OPS)

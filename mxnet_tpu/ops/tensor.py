@@ -1,0 +1,464 @@
+"""Shape manipulation, indexing and reduction ops.
+
+TPU-native re-design of the reference's tensor op families
+(ref: src/operator/tensor/matrix_op.cc, broadcast_reduce_op_value.cc,
+indexing_op.cc, ordering_op.cc, init_op.cc). MXNet reshape special codes
+(0/-1/-2/-3/-4, ref: src/operator/tensor/matrix_op-inl.h InferReshapeShape)
+are honoured. All shapes are static for XLA; ops with data-dependent output
+shapes (boolean mask) take a static max size or fall back to host.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# reshape & friends
+# ---------------------------------------------------------------------------
+
+def infer_reshape(src_shape, target):
+    """Implement MXNet reshape codes (ref: matrix_op-inl.h:InferReshapeShape):
+    0 copy dim, -1 infer, -2 copy all remaining, -3 merge two dims,
+    -4 split one dim into two (one may be -1)."""
+    src = list(src_shape)
+    out = []
+    i = 0  # index into src
+    t = list(target)
+    j = 0
+    while j < len(t):
+        d = t[j]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1 if i < len(src) else 0
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            d1, d2 = t[j + 1], t[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(d); i += 1
+        j += 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src_shape:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("reshape", num_inputs=1, aliases=("Reshape",))
+def reshape(x, shape=None, reverse=False):
+    if reverse:
+        rshape = infer_reshape(x.shape[::-1], list(shape)[::-1])[::-1]
+        return jnp.reshape(x, rshape)
+    return jnp.reshape(x, infer_reshape(x.shape, shape))
+
+
+@register("flatten", num_inputs=1, aliases=("Flatten",))
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose", num_inputs=1)
+def transpose(x, axes=None):
+    if axes is None or len(axes) == 0:
+        axes = tuple(range(x.ndim))[::-1]
+    return jnp.transpose(x, axes)
+
+
+@register("swapaxes", num_inputs=1, aliases=("SwapAxis",))
+def swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("expand_dims", num_inputs=1)
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze", num_inputs=1)
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@register("broadcast_to", num_inputs=1)
+def broadcast_to(x, shape=None):
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_like", num_inputs=2)
+def broadcast_like(x, like):
+    return jnp.broadcast_to(x, like.shape)
+
+
+@register("broadcast_axis", num_inputs=1, aliases=("broadcast_axes",))
+def broadcast_axis(x, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("tile", num_inputs=1)
+def tile(x, reps=()):
+    return jnp.tile(x, reps)
+
+
+@register("repeat", num_inputs=1)
+def repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("reverse", num_inputs=1, aliases=("flip",))
+def reverse(x, axis=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axis)
+
+
+@register("concat", aliases=("Concat", "concatenate"))
+def concat(*xs, dim=1):
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register("stack")
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("split", num_inputs=1, aliases=("SliceChannel",))
+def split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("slice", num_inputs=1, aliases=("crop",))
+def slice_op(x, begin=(), end=(), step=()):
+    idx = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return x[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register("slice_axis", num_inputs=1)
+def slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like", num_inputs=2)
+def slice_like(x, like, axes=()):
+    axes = tuple(axes) if axes else tuple(range(min(x.ndim, like.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, like.shape[a])
+    return x[tuple(idx)]
+
+
+@register("pad", num_inputs=1, aliases=("Pad",))
+def pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(x.ndim)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise ValueError("unknown pad mode %r" % (mode,))
+
+
+@register("where", num_inputs=3)
+def where(cond, x, y):
+    return jnp.where(cond.astype(bool), x, y)
+
+
+@register("diag", num_inputs=1)
+def diag(x, k=0, axis1=0, axis2=1):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register("depth_to_space", num_inputs=1)
+def depth_to_space(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth", num_inputs=1)
+def space_to_depth(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+@register("take", num_inputs=2)
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+        mode = "clip"
+    return jnp.take(a, idx, axis=axis, mode=mode)
+
+
+@register("pick", num_inputs=2)
+def pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    out = jnp.take_along_axis(x, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot", num_inputs=1, no_grad=True)
+def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("gather_nd", num_inputs=2)
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd", num_inputs=2, no_grad=False)
+def scatter_nd(data, indices, shape=None):
+    out = jnp.zeros(shape, data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].add(data)
+
+
+@register("Embedding", num_inputs=2, aliases=("embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+
+
+@register("SequenceMask", num_inputs=2, aliases=("sequence_mask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=True,
+                  value=0.0, axis=0):
+    # data: (T, B, ...) when axis=0, (B, T, ...) when axis=1
+    # ref: src/operator/sequence_mask.cc
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    if axis == 0:
+        mask = pos[:, None] < sequence_length[None, :]
+    else:
+        mask = pos[None, :] < sequence_length[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", num_inputs=2, aliases=("sequence_last",))
+def sequence_last(data, sequence_length=None, use_sequence_length=True, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:  # (T, B, ...)
+        return jnp.take_along_axis(
+            data, last.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, last.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("SequenceReverse", num_inputs=2, aliases=("sequence_reverse",))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=True, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    pos = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(pos < L, L - 1 - pos, pos)  # (T, B)
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, src, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# reductions & ordering
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        return axis
+    ax = tuple(axis)
+    return ax if ax else None
+
+
+def _reduce(jfn):
+    def _fn(x, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            ax_set = {a % x.ndim for a in (ax if isinstance(ax, tuple) else (ax,))}
+            ax = tuple(i for i in range(x.ndim) if i not in ax_set)
+        return jfn(x, axis=ax, keepdims=keepdims)
+    return _fn
+
+
+register("sum", num_inputs=1, aliases=("sum_axis",))(_reduce(jnp.sum))
+register("mean", num_inputs=1)(_reduce(jnp.mean))
+register("prod", num_inputs=1)(_reduce(jnp.prod))
+register("nansum", num_inputs=1)(_reduce(jnp.nansum))
+register("nanprod", num_inputs=1)(_reduce(jnp.nanprod))
+register("max", num_inputs=1, aliases=("max_axis",))(_reduce(jnp.max))
+register("min", num_inputs=1, aliases=("min_axis",))(_reduce(jnp.min))
+
+
+@register("norm", num_inputs=1)
+def norm(x, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", num_inputs=1, no_grad=True)
+def argmax(x, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", num_inputs=1, no_grad=True)
+def argmin(x, axis=None, keepdims=False):
+    out = jnp.argmin(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", num_inputs=1, no_grad=True)
+def argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("cumsum", num_inputs=1)
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("sort", num_inputs=1)
+def sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", num_inputs=1, no_grad=True)
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+@register("topk", num_inputs=1, no_grad=True)
+def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    # ref: src/operator/tensor/ordering_op.cc TopK
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    key = -moved if is_ascend else moved
+    _, idxs = jax.lax.top_k(key, k)
+    values = jnp.moveaxis(jnp.take_along_axis(moved, idxs, -1), -1, axis)
+    indices = jnp.moveaxis(idxs, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "indices":
+        return indices
+    if ret_typ == "value":
+        return values
+    if ret_typ == "both":
+        return values, indices
+    if ret_typ == "mask":
+        oh = jax.nn.one_hot(idxs, x.shape[axis], dtype=jnp.dtype(dtype)).sum(-2)
+        return jnp.moveaxis(oh, -1, axis)
+    raise ValueError(ret_typ)
+
+
+@register("shape_array", num_inputs=1, no_grad=True)
+def shape_array(x):
+    return jnp.asarray(x.shape, jnp.int64)
+
+
+@register("size_array", num_inputs=1, no_grad=True)
+def size_array(x):
+    return jnp.asarray([x.size], jnp.int64)
+
+
+@register("cast", num_inputs=1, aliases=("Cast",))
+def cast(x, dtype="float32"):
+    from ..base import canonical_dtype
+    return x.astype(canonical_dtype(dtype))
+
+
+@register("amp_cast", num_inputs=1)
+def amp_cast(x, dtype="bfloat16"):
+    from ..base import canonical_dtype
+    return x.astype(canonical_dtype(dtype))
+
+
+@register("zeros_like", num_inputs=1, no_grad=True)
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like", num_inputs=1, no_grad=True)
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("identity", num_inputs=1, aliases=("_copy", "BlockGrad_inner"))
+def identity(x):
+    return x
+
+
+@register("stop_gradient", num_inputs=1, aliases=("BlockGrad",))
+def stop_gradient(x):
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss", num_inputs=1, aliases=("MakeLoss",))
+def make_loss(x, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return x

@@ -1,0 +1,56 @@
+"""Global RNG state.
+
+TPU-native re-design of the reference's random resource
+(ref: src/resource.cc kRandom/kParallelRandom pools,
+python/mxnet/random.py seed()). JAX PRNG is functional; this module owns a
+global key that eager ops split from, and a *trace key* stack so that under a
+jitted CachedOp the key is a traced argument (fold_in by call counter) rather
+than a baked-in constant — keeping dropout/random ops fresh across steps.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_key", "push_trace_key", "pop_trace_key"]
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.trace_keys = []      # stack of (key, counter) used under tracing
+        self.counter = 0
+
+
+_STATE = _RngState()
+
+
+def seed(seed_state, ctx="all"):
+    """Set the global seed. ref: python/mxnet/random.py:34 (ctx arg kept for
+    API parity; there is one logical RNG stream per host)."""
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+    _STATE.counter = 0
+
+
+def next_key():
+    """Return a fresh PRNG key. Under a trace scope, derive from the traced
+    key so each eager-traced random op gets a distinct but traced key."""
+    if _STATE.trace_keys:
+        key, counter = _STATE.trace_keys[-1]
+        _STATE.trace_keys[-1] = (key, counter + 1)
+        return jax.random.fold_in(key, counter)
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+def current_key():
+    return _STATE.key
+
+
+def push_trace_key(key):
+    _STATE.trace_keys.append((key, 0))
+
+
+def pop_trace_key():
+    _STATE.trace_keys.pop()

@@ -1,0 +1,93 @@
+"""Shared test utilities, shipped as library code like the reference's
+``python/mxnet/test_utils.py`` (ref: test_utils.py:55 default_context,
+:364 rand_ndarray, :512 assert_almost_equal, :883 check_numeric_gradient,
+:1314 check_consistency).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .context import current_context, cpu
+from . import ndarray as nd
+from . import autograd
+
+__all__ = ["default_context", "assert_almost_equal", "rand_ndarray",
+           "rand_shape_nd", "check_numeric_gradient", "check_consistency",
+           "almost_equal"]
+
+
+def default_context():
+    return current_context()
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    arr = _np.random.uniform(-1, 1, size=shape).astype(dtype or _np.float32)
+    out = nd.array(arr, ctx=ctx)
+    if stype != "default":
+        return out.tostype(stype)
+    return out
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8):
+    return _np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else _np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else _np.asarray(b)
+    if not _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True):
+        idx = _np.unravel_index(_np.argmax(_np.abs(a - b)), a.shape) \
+            if a.shape else ()
+        raise AssertionError(
+            "arrays not almost equal (rtol=%g atol=%g): max |diff| %g at %s\n"
+            "%s=%s\n%s=%s" % (rtol, atol, float(_np.max(_np.abs(a - b))), idx,
+                              names[0], a, names[1], b))
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
+    """Finite-difference gradient check of ``fn(*inputs) -> scalar NDArray``.
+    ref: test_utils.py:883 check_numeric_gradient."""
+    inputs = [x if isinstance(x, nd.NDArray) else nd.array(x) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        y = fn(*inputs)
+    y.backward()
+    for x in inputs:
+        xa = x.asnumpy().astype(_np.float64)
+        num = _np.zeros_like(xa)
+        flat = xa.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            yp = fn(*[nd.array(a.asnumpy()) if a is not x else
+                      nd.array(xa.astype(_np.float32)) for a in inputs])
+            fp = float(yp.asnumpy())
+            flat[i] = orig - eps
+            ym = fn(*[nd.array(a.asnumpy()) if a is not x else
+                      nd.array(xa.astype(_np.float32)) for a in inputs])
+            fm = float(ym.asnumpy())
+            flat[i] = orig
+            num.reshape(-1)[i] = (fp - fm) / (2 * eps)
+        assert_almost_equal(x.grad.asnumpy(), num.astype(_np.float32),
+                            rtol=rtol, atol=atol,
+                            names=("autograd", "numeric"))
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-6):
+    """Run ``fn`` under each context and compare outputs — the reference's
+    cross-backend validator (ref: test_utils.py:1314)."""
+    ctx_list = ctx_list or [cpu()]
+    outs = []
+    for ctx in ctx_list:
+        with ctx:
+            ins = [nd.array(x.asnumpy() if isinstance(x, nd.NDArray) else x,
+                            ctx=ctx) for x in inputs]
+            outs.append(fn(*ins).asnumpy())
+    for o in outs[1:]:
+        assert_almost_equal(outs[0], o, rtol=rtol, atol=atol)
+    return outs[0]

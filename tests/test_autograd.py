@@ -1,0 +1,160 @@
+"""Autograd tests (model: tests/python/unittest/test_autograd.py,
+test_higher_order_grad.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x + 2 * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 2)
+
+
+def test_chain_and_branches():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = a + x        # x used twice
+        y = (b * b).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * (3 * x.asnumpy()) * 3)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 300.0], np.float32))
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 3 * 2 * x.asnumpy())
+
+
+def test_pause_and_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            z = y * 3          # not recorded
+        w = y + z.detach()
+    w.backward()
+    assert_almost_equal(x.grad, np.array([4.0], np.float32))
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training() and autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training() and not autograd.is_recording()
+
+
+def test_multi_head_backward():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = (x * x).sum()
+        y2 = (x * 3).sum()
+    autograd.backward([y1, y2])
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 3)
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 2).sum()
+        g = autograd.grad(y, x)
+    assert_almost_equal(g, 2 * x.asnumpy())
+
+
+def test_higher_order():
+    x = nd.array([0.5, 1.0, 1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        gx = autograd.grad(y, x, create_graph=True)
+        z = gx.sum()
+    z.backward()
+    # d2y/dx2 = 12 x^2
+    assert_almost_equal(x.grad, 12 * x.asnumpy() ** 2, rtol=1e-4)
+
+
+def test_third_order():
+    x = nd.array([0.7])
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 4
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1, x, create_graph=True)
+        z = g2.sum()
+    z.backward()
+    assert_almost_equal(x.grad, 24 * x.asnumpy(), rtol=1e-4)
+
+
+def test_stop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * nd.stop_gradient(x)  # d/dx = x (second factor constant)
+    y.backward()
+    assert_almost_equal(x.grad, x.asnumpy())
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5)
+
+
+def test_inplace_raises_when_recorded():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y += 1
+
+
+def test_exception_propagation():
+    # errors inside ops surface at call site (engine exception analog,
+    # ref: tests/python/unittest/test_exc_handling.py)
+    a = nd.ones((2, 3))
+    b = nd.ones((4, 5))
+    with pytest.raises(Exception):
+        nd.dot(a, b).wait_to_read()

@@ -1,0 +1,181 @@
+"""NDArray API tests (model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3) and a.dtype == np.float32
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.0)
+    assert_almost_equal(c, np.full((2, 2), 7.0, np.float32))
+    d = nd.array([[1, 2], [3, 4]], dtype="float32")
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert_almost_equal(e, np.arange(0, 10, 2, dtype=np.float32))
+    assert nd.eye(3).asnumpy().trace() == 3.0
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, np.array([[6, 8], [10, 12]], np.float32))
+    assert_almost_equal(a - b, -np.array([[4, 4], [4, 4]], np.float32))
+    assert_almost_equal(a * 2 + 1, a.asnumpy() * 2 + 1)
+    assert_almost_equal(2 / a, 2 / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(-a), a.asnumpy())
+    assert_almost_equal(nd.maximum(a, 2.5), np.maximum(a.asnumpy(), 2.5))
+
+
+def test_inplace():
+    a = nd.ones((3,))
+    a += 2
+    assert_almost_equal(a, np.full((3,), 3.0, np.float32))
+    a *= 2
+    assert_almost_equal(a, np.full((3,), 6.0, np.float32))
+    a /= 3
+    assert_almost_equal(a, np.full((3,), 2.0, np.float32))
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert_almost_equal(a > b, np.array([0, 0, 1], np.float32))
+    assert_almost_equal(a == b, np.array([0, 1, 0], np.float32))
+    assert_almost_equal(a <= b, np.array([1, 1, 0], np.float32))
+
+
+def test_indexing():
+    a = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert_almost_equal(a[0], a.asnumpy()[0])
+    assert_almost_equal(a[1, 2], a.asnumpy()[1, 2])
+    assert_almost_equal(a[:, 1:3], a.asnumpy()[:, 1:3])
+    assert float(a[1, 2, 3].asscalar()) == 23.0
+    idx = nd.array([0, 1], dtype="int32")
+    assert_almost_equal(a[idx], a.asnumpy()[[0, 1]])
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1] = 1.0
+    a[2, 2] = 5.0
+    expect = np.zeros((3, 3), np.float32)
+    expect[1] = 1
+    expect[2, 2] = 5
+    assert_almost_equal(a, expect)
+    a[0:2, 0] = nd.array([7.0, 8.0])
+    expect[0:2, 0] = [7, 8]
+    assert_almost_equal(a, expect)
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((4, -1)).shape == (4, 6)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert a.reshape((2, 3, 2, 2)).shape == (2, 3, 2, 2)
+
+
+def test_shape_ops():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.T.shape == (3, 2)
+    assert a.expand_dims(0).shape == (1, 2, 3)
+    assert nd.concat(a, a, dim=0).shape == (4, 3)
+    assert nd.stack(a, a, axis=0).shape == (2, 2, 3)
+    parts = nd.split(a, num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+    assert nd.tile(a, reps=(2, 2)).shape == (4, 6)
+    assert a.flatten().shape == (2, 3)
+    assert nd.flip(a, axis=1).asnumpy()[0, 0] == 2.0
+    assert nd.pad(a.reshape(1, 1, 2, 3), mode="constant",
+                  pad_width=(0, 0, 0, 0, 1, 1, 1, 1)).shape == (1, 1, 4, 5)
+
+
+def test_reduce():
+    a = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert_almost_equal(a.sum(), a.asnumpy().sum())
+    assert_almost_equal(a.sum(axis=1), a.asnumpy().sum(1))
+    assert_almost_equal(a.mean(axis=(0, 2)), a.asnumpy().mean((0, 2)))
+    assert_almost_equal(a.max(axis=2, keepdims=True), a.asnumpy().max(2, keepdims=True))
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True), a.asnumpy().sum((0, 2)))
+    assert_almost_equal(a.norm(), np.linalg.norm(a.asnumpy().ravel()))
+    assert float(a.argmax().asscalar()) == 23
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    assert_almost_equal(nd.dot(a, b), a.asnumpy() @ b.asnumpy(), rtol=1e-4)
+    assert_almost_equal(nd.dot(a, b.T, transpose_b=True),
+                        a.asnumpy() @ b.asnumpy(), rtol=1e-4)
+    x = nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    y = nd.array(np.random.rand(2, 4, 5).astype(np.float32))
+    assert_almost_equal(nd.batch_dot(x, y),
+                        np.matmul(x.asnumpy(), y.asnumpy()), rtol=1e-4)
+
+
+def test_astype_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c += 1
+    assert_almost_equal(a, np.ones((2, 2), np.float32))
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context.device_type == "cpu"
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "nd.bin")
+    a = nd.array([[1.0, 2.0]])
+    nd.save(f, {"w": a, "b": a * 2})
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["b"], a.asnumpy() * 2)
+    nd.save(f, [a, a])
+    assert len(nd.load(f)) == 2
+
+
+def test_take_pick_onehot():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = nd.array([2, 0], dtype="int32")
+    assert_almost_equal(nd.take(a, idx, axis=0), a.asnumpy()[[2, 0]])
+    p = nd.pick(a, nd.array([1, 2, 3], dtype="int32"), axis=1)
+    assert_almost_equal(p, np.array([1, 6, 11], np.float32))
+    oh = nd.one_hot(idx, depth=4)
+    assert oh.shape == (2, 4) and float(oh.asnumpy()[0, 2]) == 1.0
+
+
+def test_ordering():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    assert_almost_equal(nd.sort(a, axis=1), np.sort(a.asnumpy(), 1))
+    assert_almost_equal(nd.argsort(a, axis=1), np.argsort(a.asnumpy(), 1).astype(np.float32))
+    vals = nd.topk(a, k=2, axis=1, ret_typ="value")
+    assert_almost_equal(vals, np.array([[3, 2], [5, 4]], np.float32))
+
+
+def test_wait_and_scalar():
+    a = nd.ones((2,))
+    a.wait_to_read()
+    assert float((a.sum()).asscalar()) == 2.0
+    mx.waitall()
+
+
+def test_bool_len_iter():
+    a = nd.array([1.0])
+    assert bool(a)
+    b = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert len(b) == 2
+    rows = [r for r in b]
+    assert rows[1].shape == (2,)
+    with pytest.raises(ValueError):
+        bool(b)

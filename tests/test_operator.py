@@ -1,0 +1,253 @@
+"""Operator tests (model: tests/python/unittest/test_operator.py).
+Forward values vs numpy; gradients vs finite differences for a core subset."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_unary_math():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                      ("square", np.square), ("abs", np.abs),
+                      ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+                      ("floor", np.floor), ("ceil", np.ceil),
+                      ("log1p", np.log1p), ("expm1", np.expm1)]:
+        assert_almost_equal(getattr(nd, name)(a), ref(x), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_almost_equal(nd.relu(nd.array(x - 1)), np.maximum(x - 1, 0))
+    assert_almost_equal(nd.rsqrt(a), 1 / np.sqrt(x), rtol=1e-5)
+    assert_almost_equal(nd.reciprocal(a), 1 / x, rtol=1e-5)
+    assert_almost_equal(nd.clip(a, a_min=0.8, a_max=1.5), np.clip(x, 0.8, 1.5))
+
+
+def test_broadcast_binary():
+    a = np.random.rand(2, 1, 4).astype(np.float32)
+    b = np.random.rand(1, 3, 4).astype(np.float32)
+    na, nb = nd.array(a), nd.array(b)
+    assert_almost_equal(nd.broadcast_add(na, nb), a + b)
+    assert_almost_equal(nd.broadcast_mul(na, nb), a * b)
+    assert_almost_equal(nd.broadcast_maximum(na, nb), np.maximum(a, b))
+    assert_almost_equal(nd.broadcast_power(na, nb), a ** b, rtol=1e-4)
+
+
+def test_gradients_numeric():
+    check_numeric_gradient(lambda x: (nd.tanh(x)).sum(), [np.random.rand(3, 2)])
+    check_numeric_gradient(lambda x: (nd.sigmoid(x) ** 2).sum(),
+                           [np.random.rand(4)])
+    check_numeric_gradient(lambda a, b: nd.dot(a, b).sum(),
+                           [np.random.rand(2, 3), np.random.rand(3, 2)])
+    check_numeric_gradient(lambda x: nd.softmax(x, axis=-1).sum(axis=0)[0],
+                           [np.random.rand(3, 4)])
+
+
+def test_fully_connected():
+    x = nd.array(np.random.rand(5, 8).astype(np.float32))
+    w = nd.array(np.random.rand(3, 8).astype(np.float32))
+    b = nd.array(np.random.rand(3).astype(np.float32))
+    out = nd.FullyConnected(x, w, b, num_hidden=3)
+    assert_almost_equal(out, x.asnumpy() @ w.asnumpy().T + b.asnumpy(),
+                        rtol=1e-4)
+    out2 = nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+    assert_almost_equal(out2, x.asnumpy() @ w.asnumpy().T, rtol=1e-4)
+
+
+def test_convolution_shapes_and_values():
+    # identity kernel check
+    x = nd.array(np.random.rand(1, 1, 5, 5).astype(np.float32))
+    w = nd.zeros((1, 1, 3, 3))
+    w[0, 0, 1, 1] = 1.0
+    out = nd.Convolution(x, w, None, kernel=(3, 3), pad=(1, 1), num_filter=1,
+                         no_bias=True)
+    assert_almost_equal(out, x.asnumpy(), rtol=1e-5)
+    # shape math: stride + dilate
+    x2 = nd.zeros((2, 3, 16, 16))
+    w2 = nd.zeros((8, 3, 3, 3))
+    out2 = nd.Convolution(x2, w2, None, kernel=(3, 3), stride=(2, 2),
+                          pad=(1, 1), num_filter=8, no_bias=True)
+    assert out2.shape == (2, 8, 8, 8)
+    # grouped conv
+    w3 = nd.zeros((8, 1, 3, 3))
+    xg = nd.zeros((2, 8, 8, 8))
+    out3 = nd.Convolution(xg, w3, None, kernel=(3, 3), pad=(1, 1),
+                          num_filter=8, num_group=8, no_bias=True)
+    assert out3.shape == (2, 8, 8, 8)
+    # 1D conv
+    x1 = nd.zeros((2, 4, 10))
+    w1 = nd.zeros((6, 4, 3))
+    assert nd.Convolution(x1, w1, None, kernel=(3,), num_filter=6,
+                          no_bias=True).shape == (2, 6, 8)
+
+
+def test_conv_gradient():
+    np.random.seed(3)
+    x = np.random.rand(1, 2, 4, 4)
+    w = np.random.rand(2, 2, 3, 3)
+
+    def f(xx, ww):
+        return nd.Convolution(xx, ww, None, kernel=(3, 3), pad=(1, 1),
+                              num_filter=2, no_bias=True).sum()
+    check_numeric_gradient(f, [x, w], rtol=2e-2, atol=1e-3)
+
+
+def test_deconvolution():
+    x = nd.array(np.random.rand(1, 3, 4, 4).astype(np.float32))
+    w = nd.array(np.random.rand(3, 5, 3, 3).astype(np.float32))
+    out = nd.Deconvolution(x, w, None, kernel=(3, 3), stride=(2, 2),
+                           num_filter=5, no_bias=True)
+    assert out.shape == (1, 5, 9, 9)
+    # parity with torch-style formula: (in-1)*stride - 2*pad + kernel
+    out2 = nd.Deconvolution(x, w, None, kernel=(3, 3), stride=(1, 1),
+                            pad=(1, 1), num_filter=5, no_bias=True)
+    assert out2.shape == (1, 5, 4, 4)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    a = nd.array(x)
+    mp = nd.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert_almost_equal(mp, np.array([[[[5, 7], [13, 15]]]], np.float32))
+    ap = nd.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert_almost_equal(ap, np.array([[[[2.5, 4.5], [10.5, 12.5]]]], np.float32))
+    gp = nd.Pooling(a, pool_type="max", global_pool=True)
+    assert gp.shape == (1, 1, 1, 1) and float(gp.asnumpy().ravel()[0]) == 15
+    fp = nd.Pooling(a, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                    pooling_convention="full")
+    assert fp.shape == (1, 1, 2, 2)
+
+
+def test_batchnorm_train_and_inference():
+    x = nd.array(np.random.rand(4, 3, 5, 5).astype(np.float32))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mean, var = nd.zeros((3,)), nd.ones((3,))
+    with autograd.record():
+        out, bm, bv = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    o = out.asnumpy()
+    assert abs(o.mean()) < 1e-4 and abs(o.std() - 1) < 1e-2
+    # inference mode uses moving stats
+    out2, _, _ = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    assert_almost_equal(out2, x.asnumpy() / np.sqrt(1 + 1e-3), rtol=1e-3)
+
+
+def test_layernorm():
+    x = nd.array(np.random.rand(2, 6).astype(np.float32))
+    out = nd.LayerNorm(x, nd.ones((6,)), nd.zeros((6,)))
+    o = out.asnumpy()
+    assert np.allclose(o.mean(axis=-1), 0, atol=1e-5)
+    assert np.allclose(o.std(axis=-1), 1, atol=1e-2)
+
+
+def test_activation_and_leaky():
+    x = nd.array(np.array([-2.0, -0.5, 0.5, 2.0], np.float32))
+    assert_almost_equal(nd.Activation(x, act_type="relu"),
+                        np.maximum(x.asnumpy(), 0))
+    lr = nd.LeakyReLU(x, act_type="leaky", slope=0.1)
+    assert_almost_equal(lr, np.where(x.asnumpy() > 0, x.asnumpy(),
+                                     0.1 * x.asnumpy()))
+    el = nd.LeakyReLU(x, act_type="elu", slope=1.0)
+    assert_almost_equal(el, np.where(x.asnumpy() > 0, x.asnumpy(),
+                                     np.expm1(x.asnumpy())), rtol=1e-5)
+    g = nd.LeakyReLU(x, act_type="gelu")
+    assert g.shape == x.shape
+
+
+def test_softmax_family():
+    x = np.random.rand(3, 5).astype(np.float32)
+    a = nd.array(x)
+    sm = nd.softmax(a, axis=-1).asnumpy()
+    assert np.allclose(sm.sum(-1), 1, atol=1e-5)
+    lsm = nd.log_softmax(a, axis=-1).asnumpy()
+    assert_almost_equal(np.exp(lsm), sm, rtol=1e-5)
+    ce = nd.softmax_cross_entropy(a, nd.array([1, 2, 3], dtype="int32"))
+    expect = -np.log(sm[np.arange(3), [1, 2, 3]]).sum()
+    assert_almost_equal(ce, expect, rtol=1e-4)
+
+
+def test_embedding():
+    w = nd.array(np.random.rand(10, 4).astype(np.float32))
+    idx = nd.array([1, 3, 5], dtype="int32")
+    out = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+    assert_almost_equal(out, w.asnumpy()[[1, 3, 5]])
+    # gradient flows into weight rows
+    w.attach_grad()
+    with autograd.record():
+        y = nd.Embedding(idx, w, input_dim=10, output_dim=4).sum()
+    y.backward()
+    g = w.grad.asnumpy()
+    assert g[1].sum() == 4 and g[0].sum() == 0
+
+
+def test_sequence_ops():
+    data = nd.array(np.arange(24, dtype=np.float32).reshape(4, 3, 2))  # (T,B,E)
+    length = nd.array([2, 4, 1], dtype="int32")
+    masked = nd.SequenceMask(data, length, use_sequence_length=True, value=-1)
+    m = masked.asnumpy()
+    assert m[3, 0, 0] == -1 and m[1, 0, 0] == data.asnumpy()[1, 0, 0]
+    last = nd.SequenceLast(data, length, use_sequence_length=True)
+    assert_almost_equal(last, data.asnumpy()[[1, 3, 0], [0, 1, 2]])
+    rev = nd.SequenceReverse(data, length, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], data.asnumpy()[1, 0])
+
+
+def test_lrn_l2norm():
+    x = nd.array(np.random.rand(2, 8, 4, 4).astype(np.float32))
+    out = nd.LRN(x, nsize=5)
+    assert out.shape == x.shape
+    l2 = nd.L2Normalization(x, mode="instance")
+    n = np.sqrt((x.asnumpy().reshape(2, -1) ** 2).sum(1) + 1e-10)
+    assert_almost_equal(l2.asnumpy()[0], x.asnumpy()[0] / n[0], rtol=1e-4)
+
+
+def test_where_gather_scatter():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x, y = nd.array([1.0, 2.0, 3.0]), nd.array([10.0, 20.0, 30.0])
+    assert_almost_equal(nd.where(cond, x, y), np.array([1, 20, 3], np.float32))
+    data = nd.array(np.arange(9, dtype=np.float32).reshape(3, 3))
+    idx = nd.array([[0, 2], [1, 0]], dtype="int32")  # 2 points (0,1),(2,0)
+    out = nd.gather_nd(data, idx)
+    assert_almost_equal(out, np.array([1.0, 6.0], np.float32))
+    sc = nd.scatter_nd(nd.array([5.0, 7.0]), idx, shape=(3, 3))
+    assert float(sc.asnumpy()[0, 1]) == 5.0 and float(sc.asnumpy()[2, 0]) == 7.0
+
+
+def test_linalg():
+    a = np.random.rand(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = nd.linalg.potrf(nd.array(spd))
+    assert_almost_equal(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-3)
+    A = nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    B = nd.array(np.random.rand(2, 4, 5).astype(np.float32))
+    out = nd.linalg.gemm2(A, B)
+    assert_almost_equal(out, np.matmul(A.asnumpy(), B.asnumpy()), rtol=1e-4)
+    C = nd.array(np.random.rand(3, 3).astype(np.float32))
+    inv = nd.linalg.inverse(C)
+    assert_almost_equal(inv.asnumpy() @ C.asnumpy(), np.eye(3), atol=1e-3)
+
+
+def test_cast_and_dtype_ops():
+    x = nd.array([1.5, 2.5])
+    assert nd.cast(x, dtype="int32").dtype == np.int32
+    assert nd.cast(x, dtype="bfloat16").asnumpy().dtype.name in ("bfloat16",
+                                                                 "float32")
+    assert nd.zeros_like(x).shape == x.shape
+    assert float(nd.ones_like(x).sum().asscalar()) == 2.0
+
+
+def test_smooth_l1():
+    x = nd.array([-2.0, -0.5, 0.5, 2.0])
+    out = nd.smooth_l1(x, scalar=1.0)
+    expect = np.where(np.abs(x.asnumpy()) < 1, 0.5 * x.asnumpy() ** 2,
+                      np.abs(x.asnumpy()) - 0.5)
+    assert_almost_equal(out, expect)
+
+
+def test_upsampling_depthspace():
+    x = nd.array(np.random.rand(1, 4, 2, 2).astype(np.float32))
+    up = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert up.shape == (1, 4, 4, 4)
+    d2s = nd.depth_to_space(x, block_size=2)
+    assert d2s.shape == (1, 1, 4, 4)
+    assert_almost_equal(nd.space_to_depth(d2s, block_size=2), x.asnumpy())

@@ -1,0 +1,64 @@
+"""Random op tests (model: tests/python/unittest/test_random.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_seed_reproducible():
+    mx.random.seed(42)
+    a = nd.random.uniform(shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.uniform(shape=(100,)).asnumpy()
+    assert np.array_equal(a, b)
+    c = nd.random.uniform(shape=(100,)).asnumpy()
+    assert not np.array_equal(b, c)
+
+
+def test_uniform_range():
+    x = nd.random.uniform(low=2.0, high=5.0, shape=(1000,)).asnumpy()
+    assert x.min() >= 2.0 and x.max() < 5.0
+    assert abs(x.mean() - 3.5) < 0.2
+
+
+def test_normal_moments():
+    x = nd.random.normal(loc=1.0, scale=2.0, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.1
+    assert abs(x.std() - 2.0) < 0.1
+
+
+def test_randint():
+    x = nd.random.randint(0, 10, shape=(1000,)).asnumpy()
+    assert x.min() >= 0 and x.max() <= 9
+    assert x.dtype == np.int32
+
+
+def test_sample_parameterized():
+    mu = nd.array([0.0, 10.0])
+    sigma = nd.array([1.0, 1.0])
+    x = nd.random.normal(mu, sigma, shape=(500,)).asnumpy()
+    assert x.shape == (2, 500)
+    assert abs(x[0].mean()) < 0.3 and abs(x[1].mean() - 10) < 0.3
+
+
+def test_multinomial():
+    probs = nd.array([[0.0, 1.0, 0.0], [0.5, 0.0, 0.5]])
+    s = nd.random.multinomial(probs, shape=(200,)).asnumpy()
+    assert s.shape == (2, 200)
+    assert (s[0] == 1).all()
+    assert set(np.unique(s[1])).issubset({0, 2})
+
+
+def test_shuffle():
+    x = nd.array(np.arange(50, dtype=np.float32))
+    y = nd.random.shuffle(x).asnumpy()
+    assert sorted(y.tolist()) == list(range(50))
+
+
+def test_poisson_exponential_gamma():
+    p = nd.random.poisson(lam=4.0, shape=(5000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.2
+    e = nd.random.exponential(scale=2.0, shape=(5000,)).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.2
+    g = nd.random.gamma(alpha=3.0, beta=2.0, shape=(5000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.5

@@ -206,6 +206,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             var._grad._data = var._grad._data + g
         else:
             var._grad._data = g.astype(var._grad._data.dtype)
+        # stale-grad tracking: Trainer clears this after each update
+        # (ref: NDArray fresh_grad flag, src/ndarray/ndarray.cc)
+        var._fresh_grad = True
     return None
 
 

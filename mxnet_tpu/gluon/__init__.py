@@ -1,0 +1,22 @@
+"""Gluon: the imperative neural-network API.
+
+ref: python/mxnet/gluon/__init__.py.
+"""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401
+
+
+def __getattr__(name):
+    # heavier subpackages loaded lazily (data has worker machinery, rnn has
+    # scan kernels, model_zoo has model definitions, contrib has estimator)
+    import importlib
+    if name in ("data", "rnn", "model_zoo", "contrib"):
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -312,8 +312,9 @@ class HybridLambda(HybridBlock):
 
 class Activation(HybridBlock):
     def __init__(self, activation, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
+        # _act_type must exist before Block.__init__ calls _alias()
         self._act_type = activation
+        super().__init__(prefix=prefix, params=params)
 
     def _alias(self):
         return str(self._act_type)

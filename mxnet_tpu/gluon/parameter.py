@@ -44,6 +44,18 @@ class Parameter:
         self._grad = None
         self._deferred_init = None  # (init, ctx, default_init)
         self._sharding = None      # parallel placement hint (PartitionSpec-like)
+        self._trainer = None
+
+    def _set_trainer(self, trainer):
+        """ref: parameter.py _set_trainer — row_sparse params are bound to
+        one trainer (they pull rows through it); dense params may move."""
+        if self._stype != "default" and self._trainer is not None and \
+                trainer is not None and self._trainer is not trainer:
+            raise RuntimeError(
+                "Failed to set the trainer for Parameter '%s' because it "
+                "was already set. More than one trainers for a %s Parameter "
+                "is not supported." % (self.name, self._stype))
+        self._trainer = trainer
 
     # -- core -------------------------------------------------------------
     @property

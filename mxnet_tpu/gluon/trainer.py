@@ -1,0 +1,264 @@
+"""Gluon Trainer: applies an Optimizer to a set of Parameters.
+
+ref: python/mxnet/gluon/trainer.py:27 (Trainer, _init_kvstore :169,
+step :305, allreduce_grads :334, update :365, save_states :436,
+load_states :465).
+
+TPU-native differences: the reference keeps one weight copy per GPU and
+reduces gradients through the kvstore before updating every copy. Here a
+Parameter is ONE logical array — under data parallelism it is replicated (or
+sharded, FSDP-style) over the mesh by mxnet_tpu.parallel, and gradient
+reduction happens inside the jitted step as an XLA collective. So
+`allreduce_grads` is a no-op unless a multi-host kvstore is attached, and
+`update` is the only real work: one fused optimizer step per parameter.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        param_list = []
+        if isinstance(params, (dict,)) or hasattr(params, "items"):
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._set_trainer(self)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore,
+            "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _reset_kvstore(self):
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [p for p in self._params]
+
+    def _init_kvstore(self):
+        """ref: trainer.py:169. Multi-host (dist_*) attaches a kvstore whose
+        push performs the cross-process allreduce; in-process training needs
+        none (collectives live inside the jitted step)."""
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        kv = None
+        if kvstore and isinstance(kvstore, str) and \
+                kvstore.startswith("dist"):
+            from .. import kvstore as kvs
+            kv = kvs.create(kvstore)
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            if update_on_kvstore is None:
+                update_on_kvstore = True
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        elif not isinstance(kvstore, str) and kvstore is not None:
+            kv = kvstore  # user-provided KVStore object
+            if update_on_kvstore is None:
+                update_on_kvstore = False
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        else:
+            update_on_kvstore = False
+        self._kvstore = kv
+        self._update_on_kvstore = bool(update_on_kvstore)
+        self._kv_initialized = True
+
+    def _init_params(self):
+        for param in self._params_to_init:
+            if param._deferred_init is not None:
+                continue
+            if self._kvstore is not None and param._data is not None:
+                idx = self._param2idx[param.name]
+                self._kvstore.init(idx, param.data())
+        self._params_to_init = [p for p in self._params_to_init
+                                if p._deferred_init is not None]
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its "
+                              "learning rate can be accessed.")
+        if self._optimizer.lr_scheduler is not None:
+            return self._optimizer.lr_scheduler(self._optimizer.num_update)
+        return self._optimizer.lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its "
+                              "learning rate is mutated.")
+        self._optimizer.set_learning_rate(lr)
+
+    def _check_and_rescale_grad(self, scale):
+        """ref: trainer.py _check_and_rescale_grad — must happen BEFORE the
+        kvstore pickles the optimizer (server-side copy sees the scale)."""
+        if self._update_on_kvstore and self._kv_initialized and \
+                self._optimizer.rescale_grad != scale:
+            raise UserWarning(
+                "Possible change in the `batch_size` from previous "
+                "`step` detected. Optimizer gradient normalizing factor "
+                "will not change w.r.t new batch_size when "
+                "update_on_kvstore=True and when distributed kvstore is "
+                "used.")
+        self._optimizer.rescale_grad = scale
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Make one parameter update: rescale by 1/batch_size, reduce, apply
+        (ref: trainer.py:305)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Explicit reduce step for when update() is called separately
+        (ref: trainer.py:334)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "allreduce_grads() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` to False " \
+            "when creating trainer."
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                idx = self._param2idx[param.name]
+                if self._update_on_kvstore:
+                    self._kvstore.pushpull(idx, param.grad(),
+                                           out=param.data(), priority=-i)
+                else:
+                    self._kvstore.push(idx, param.grad(), priority=-i)
+                    self._kvstore.pull(idx, param.grad(), priority=-i,
+                                       ignore_sparse=False)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Make one step using gradients already reduced
+        (ref: trainer.py:365)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "update() when parameters are updated on kvstore is not " \
+            "supported. Try setting `update_on_kvstore` to False when " \
+            "creating trainer."
+        self._check_and_rescale_grad(self._scale / batch_size)
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updates = [[] for _ in self._updaters]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if not ignore_stale_grad:
+                data = param.data()
+                if not getattr(data, "_fresh_grad", True):
+                    raise UserWarning(
+                        "Gradient of Parameter `%s` on context %s has not "
+                        "been updated by backward since last `step`. This "
+                        "could mean a bug in your model that made it only "
+                        "use a subset of the Parameters (Blocks) for this "
+                        "iteration. If you are intentionally only using a "
+                        "subset, call step with ignore_stale_grad=True to "
+                        "suppress this warning" % (
+                            param.name, str(data.context)))
+            param.data()._fresh_grad = False
+            if self._kvstore and self._update_on_kvstore:
+                continue
+            updates[0].append((i, param.grad(), param.data()))
+        for updater, upd in zip(self._updaters, updates):
+            if upd:
+                i, g, w = zip(*upd)
+                updater(list(i), list(g), list(w))
+
+    def save_states(self, fname):
+        """Save optimizer/updater states (ref: trainer.py:436)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            assert not self._params_to_init, \
+                "Cannot save trainer states when some parameters are not " \
+                "yet initialized in kvstore."
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(
+                    dump_optimizer=True))
+
+    def load_states(self, fname):
+        """ref: trainer.py:465."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        self._optimizer.param_dict = param_dict

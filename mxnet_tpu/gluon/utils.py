@@ -1,0 +1,152 @@
+"""Gluon utility functions.
+
+ref: python/mxnet/gluon/utils.py (split_data :31, split_and_load :81,
+clip_global_norm :115, check_sha1 :159, download :190).
+
+TPU-native note: `split_and_load` in the reference copies slices to per-GPU
+contexts; on a TPU mesh, data parallelism shards the batch axis of ONE
+logical array across devices (see mxnet_tpu.parallel). For API parity,
+splitting across a ctx_list still returns per-slice NDArrays, and a
+ctx_list of one context returns a single-element list.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray into `num_slice` slices along `batch_axis`
+    (ref: gluon/utils.py:31)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    if not even_split:
+        slices = [
+            data.slice_axis(batch_axis, i * step,
+                            (i + 1) * step if i < num_slice - 1 else size)
+            for i in range(num_slice)]
+    else:
+        slices = [data.slice_axis(batch_axis, i * step, (i + 1) * step)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data into len(ctx_list) slices and load each to one context
+    (ref: gluon/utils.py:81)."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the sum of their 2-norms is at most max_norm
+    (ref: gluon/utils.py:115)."""
+    def _norm(array):
+        if array.stype == "default":
+            x = array.reshape((-1,))
+            return nd.dot(x, x)
+        return array.norm().square()
+
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = nd.add_n(*[_norm(arr).as_in_context(ctx) for arr in arrays])
+    total_norm = nd.sqrt(total_norm)
+    if check_isfinite:
+        if not _np.isfinite(total_norm.asscalar()):
+            import warnings
+            warnings.warn(
+                UserWarning("nan or inf is detected. Clipping results will "
+                            "be undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    scale = nd.minimum(nd.ones(1, ctx=ctx), scale)
+    for arr in arrays:
+        arr._data = arr._data * scale._data.astype(arr.dtype)
+    if check_isfinite:
+        return total_norm.asscalar()
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Check whether the sha1 hash of the file matches (ref: utils.py:159)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Download a file (ref: gluon/utils.py:190). This environment has no
+    egress; only file:// URLs and existing files resolve."""
+    if path is None:
+        fname = url.split("/")[-1]
+        assert fname, ("Can't construct file-name from this URL. Please set "
+                       "the `path` option manually.")
+    else:
+        path = os.path.expanduser(path)
+        if os.path.isdir(path):
+            fname = os.path.join(path, url.split("/")[-1])
+        else:
+            fname = path
+    if url.startswith("file://"):
+        src = url[len("file://"):]
+        if overwrite or not os.path.exists(fname):
+            import shutil
+            os.makedirs(os.path.dirname(os.path.abspath(fname)), exist_ok=True)
+            shutil.copyfile(src, fname)
+        return fname
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise IOError(
+        "download(%r): network egress is disabled in this environment; "
+        "place the file at %r beforehand or use a file:// URL" % (url, fname))
+
+
+def shape_is_known(shape):
+    """ref: gluon/utils.py shape_is_known."""
+    if shape is None:
+        return False
+    unknown_dim_size = -1
+    if len(shape) == 0:
+        return unknown_dim_size == -1
+    for dim_size in shape:
+        if dim_size in (unknown_dim_size, 0):
+            return False
+    return True
+
+
+def _indent(s_, num_spaces):
+    """Indent string for pretty-print (ref: gluon/utils.py _indent)."""
+    s = s_.split("\n")
+    if len(s) == 1:
+        return s_
+    first = s.pop(0)
+    s = [first] + [(num_spaces * " ") + line for line in s]
+    return "\n".join(s)

@@ -22,10 +22,15 @@ def register(klass):
     return klass
 
 
+# the reference registers plural aliases via @init.register decorators
+# (ref: python/mxnet/initializer.py "zeros"/"ones" registry names)
+_ALIASES = {"zeros": "zero", "ones": "one"}
+
+
 def get(name):
     if isinstance(name, Initializer):
         return name
-    return _REG.get(name)()
+    return _REG.get(_ALIASES.get(name.lower(), name))()
 
 
 class InitDesc(str):

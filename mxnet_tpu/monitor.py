@@ -1,0 +1,111 @@
+"""Monitor outputs, weights, and gradients for debugging.
+
+ref: python/mxnet/monitor.py (Monitor :33). The reference installs a C++
+monitor callback on executors; here `install` wraps Gluon block forward hooks
+and Module executors call `tic/toc` around forward, collecting the same
+(batch, name, stat) rows.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect per-tensor stats every `interval` batches (ref: monitor.py:33)."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return x.abs().mean()
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, array):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        array = array if isinstance(array, NDArray) else nd.array(array)
+        self.queue.append((self.step, name, self.stat_func(array)))
+
+    def install(self, exe):
+        """Install the monitor on an executor or Gluon block."""
+        if hasattr(exe, "register_forward_hook"):
+            mon = self
+
+            def hook(block, inputs, output):
+                outs = output if isinstance(output, (list, tuple)) \
+                    else [output]
+                for i, o in enumerate(outs):
+                    mon.stat_helper("%s_output%d" % (block.name, i), o)
+            exe.register_forward_hook(hook)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval hits
+        (ref: monitor.py tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting and return (step, name, stat) rows."""
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self.exes:
+            if hasattr(exe, "collect_params"):
+                for name, p in exe.collect_params().items():
+                    if p._data is not None:
+                        self.stat_helper_always(name, p.data())
+                        if p._data._grad is not None:
+                            self.stat_helper_always(name + "_grad", p.grad())
+            elif hasattr(exe, "arg_dict"):
+                for name, array in exe.arg_dict.items():
+                    self.stat_helper_always(name, array)
+                if hasattr(exe, "grad_dict"):
+                    for name, array in exe.grad_dict.items():
+                        if array is not None:
+                            self.stat_helper_always(name + "_grad", array)
+        res = []
+        queue = self.queue
+        if self.sort:
+            queue = sorted(queue, key=lambda x: x[1])
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def stat_helper_always(self, name, array):
+        if not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(array)))
+
+    def toc_print(self):
+        """Collect and print stats (ref: monitor.py toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)
+        return res

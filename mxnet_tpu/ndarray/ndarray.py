@@ -35,7 +35,7 @@ class NDArray:
     """N-dimensional array on a device context."""
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_autograd_entry",
-                 "_deferred_init", "__weakref__")
+                 "_deferred_init", "_fresh_grad", "__weakref__")
 
     def __init__(self, data, ctx=None):
         self._data = data

@@ -109,6 +109,17 @@ for _name, _fn in _UNARY.items():
              aliases=(("gamma",) if _name == "gammaln" else ()))(_fn)
 
 
+@register("add_n", aliases=("ElementWiseSum", "elemwise_sum"))
+def add_n(*xs):
+    """Variadic sum (ref: src/ndarray/ndarray_function.cc ElementwiseSum,
+    src/operator/tensor/elemwise_sum.cc add_n) — XLA fuses the chain into
+    one HBM pass."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
 @register("reciprocal", num_inputs=1)
 def reciprocal(x):
     return 1.0 / x

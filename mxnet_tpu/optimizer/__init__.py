@@ -1,0 +1,8 @@
+"""``mxnet_tpu.optimizer`` — weight-update rules.
+
+ref: python/mxnet/optimizer/__init__.py.
+"""
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import Optimizer, Updater, create, register, get_updater
+
+opt_registry = Optimizer.opt_registry

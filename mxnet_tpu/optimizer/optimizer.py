@@ -1,0 +1,1049 @@
+"""Optimizers.
+
+TPU-native re-design of the reference optimizer package
+(ref: python/mxnet/optimizer/optimizer.py — base `Optimizer` :44, SGD :518,
+Signum :934, FTML :1005, LARS :788, LBSGD :1061, DCASGD :1236, NAG :1285,
+SGLD :1342, Adam :1412, AdaGrad :1520, AdaDelta :1635, RMSProp :1553,
+Adamax :1688, Nadam :1742, Ftrl :1447-ish, `Updater` :1935).
+
+Design differences (TPU-first):
+
+- The reference dispatches to hand-fused CUDA kernels (`sgd_mom_update`,
+  `adam_update`, ... in src/operator/optimizer_op.cc). Here every optimizer
+  defines ONE pure function ``_step(weight, grad, states, lr, wd, ...)`` that
+  is ``jax.jit``-compiled per (shape, dtype) — XLA fuses the whole update
+  chain (rescale → clip → wd → momentum → write) into a single HBM pass,
+  which is exactly what the hand-written kernels did.
+- Hyperparameters that change per step (lr, wd, loss-scale) are traced
+  scalars, so stepping the LR schedule never recompiles.
+- ``multi_precision`` keeps an fp32 master weight next to bf16/fp16 weights
+  (ref: optimizer.py:591 create_state_multi_precision) — on TPU the natural
+  pairing is bf16 weights + fp32 master.
+- Aggregated multi-weight updates (ref env `MXNET_OPTIMIZER_AGGREGATION_SIZE`)
+  are unnecessary: ops on distinct weights are independently async-dispatched
+  and XLA overlaps them; the knob is accepted for parity.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import canonical_dtype
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = [
+    "Optimizer", "SGD", "Signum", "FTML", "LARS", "LBSGD", "DCASGD", "NAG",
+    "SGLD", "Adam", "AdamW", "AdaGrad", "AdaDelta", "RMSProp", "Adamax",
+    "Nadam", "Ftrl", "LAMB", "Test", "Updater", "create", "register",
+    "get_updater",
+]
+
+
+def _as_data(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _is_low_precision(dtype):
+    return _np.dtype(dtype) in (_np.dtype("float16"), _np.dtype(jnp.bfloat16))
+
+
+class Optimizer:
+    """Base optimizer (ref: python/mxnet/optimizer/optimizer.py:44)."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self._jit_cache = {}
+
+    # -- registry (ref: optimizer.py register/create_optimizer) ------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        """Return optimizer state for one weight (None | NDArray | tuple)."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """ref: optimizer.py:591 — fp32 master copy for low-precision
+        weights."""
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            master = NDArray(weight._data.astype(jnp.float32))
+            return (master, self.create_state(index, master))
+        if _is_low_precision(weight.dtype) and not self.multi_precision:
+            logging.warning(
+                "Accumulating with float16/bfloat16 in optimizer can lead to "
+                "poor accuracy or slow convergence. Consider using "
+                "multi_precision=True option of the optimizer")
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            master, base_state = state
+            grad32 = NDArray(grad._data.astype(jnp.float32))
+            self.update(index, master, grad32, base_state)
+            weight._data = master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- schedule / multipliers -------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        """ref: optimizer.py set_lr_mult."""
+        self.lr_mult = {}
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """ref: optimizer.py:381 — biases/beta get no wd, but _weight and
+        _gamma keep it."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    # -- jit plumbing ------------------------------------------------------
+    def _preprocess_grad(self, grad, rescale, clip):
+        g = grad * rescale
+        if clip is not None:
+            g = jnp.clip(g, -clip, clip)
+        return g
+
+    def _jitted(self, key, fn):
+        f = self._jit_cache.get(key)
+        if f is None:
+            f = jax.jit(fn)
+            self._jit_cache[key] = f
+        return f
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        ret["_jit_cache"] = {}
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._jit_cache = {}
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by the reference's tests
+    (ref: optimizer.py Test)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data + grad._data * self.rescale_grad
+        state._data = weight._data
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional lazy/multi-precision updates
+    (ref: optimizer.py:518; fused kernels src/operator/optimizer_op.cc
+    sgd_update/sgd_mom_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient
+        mom = self.momentum
+
+        if mom == 0.0:
+            def step(w, g, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip)
+                return w - lr * (g + wd * w)
+            f = self._jitted(("sgd", weight.shape, str(weight.dtype)), step)
+            weight._data = f(weight._data, grad._data, lr, wd,
+                             self.rescale_grad)
+        else:
+            def step(w, g, m, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip)
+                m2 = mom * m - lr * (g + wd * w)
+                return w + m2, m2
+            f = self._jitted(("sgdm", weight.shape, str(weight.dtype)), step)
+            weight._data, state._data = f(weight._data, grad._data,
+                                          state._data, lr, wd,
+                                          self.rescale_grad)
+
+
+@register
+class Signum(Optimizer):
+    """Sign-of-gradient SGD (ref: optimizer.py:934, Bernstein et al. 2018)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip, mom, wd_lh = self.clip_gradient, self.momentum, self.wd_lh
+
+        if mom == 0.0:
+            def step(w, g, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip)
+                return (1 - lr * (wd + wd_lh)) * w - lr * jnp.sign(g)
+            f = self._jitted(("signsgd", weight.shape, str(weight.dtype)),
+                             step)
+            weight._data = f(weight._data, grad._data, lr, wd,
+                             self.rescale_grad)
+        else:
+            def step(w, g, m, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip)
+                m2 = mom * m - (1 - mom) * (g + wd * w)
+                w2 = (1 - lr * wd_lh) * w + lr * jnp.sign(m2)
+                return w2, m2
+            f = self._jitted(("signum", weight.shape, str(weight.dtype)), step)
+            weight._data, state._data = f(weight._data, grad._data,
+                                          state._data, lr, wd,
+                                          self.rescale_grad)
+
+
+@register
+class FTML(Optimizer):
+    """Follow-the-moving-leader (ref: optimizer.py:1005)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        return (NDArray(z), NDArray(z), NDArray(z))  # d, v, z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        b1, b2, eps, clip = self.beta1, self.beta2, self.epsilon, \
+            self.clip_gradient
+        d, v, z = state
+
+        def step(w, g, d_, v_, z_, lr, wd, rescale, t):
+            g = self._preprocess_grad(g, rescale, clip) + wd * w
+            v2 = b2 * v_ + (1 - b2) * g * g
+            d2 = (1 - b1 ** t) / lr * (jnp.sqrt(v2 / (1 - b2 ** t)) + eps)
+            sigma = d2 - b1 * d_
+            z2 = b1 * z_ + (1 - b1) * g - sigma * w
+            w2 = -z2 / d2
+            return w2, d2, v2, z2
+        f = self._jitted(("ftml", weight.shape, str(weight.dtype)), step)
+        weight._data, d._data, v._data, z._data = f(
+            weight._data, grad._data, d._data, v._data, z._data, lr, wd,
+            self.rescale_grad, t)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (ref: optimizer.py:788)."""
+
+    def __init__(self, momentum=0.0, lars_eta=0.001, lars_epsilon=0,
+                 momentum_correction=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lars_eta = lars_eta
+        self.lars_epsilon = lars_epsilon
+        self.momentum_correction = momentum_correction
+        self.last_lr = None
+        self.cur_lr = None
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def _l2norm(self, v):
+        return jnp.sqrt(jnp.sum((v * v).astype(jnp.float32)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        eta, eps, clip = self.lars_eta, self.lars_epsilon, self.clip_gradient
+        mom = self.momentum
+        if self.momentum_correction and self.last_lr is not None \
+                and self.last_lr != 0:
+            mom = mom * (lr / self.last_lr)
+        self.last_lr, self.cur_lr = self.cur_lr if self.cur_lr is not None \
+            else lr, lr
+
+        name = self.idx2name.get(index, str(index))
+        is_bias_or_gamma = name.endswith(("gamma", "beta", "bias"))
+
+        def step(w, g, m, lr, wd, rescale, mom_):
+            g = self._preprocess_grad(g, rescale, clip)
+            if is_bias_or_gamma:
+                ratio = 1.0
+            else:
+                w_norm = self._l2norm(w)
+                g_norm = self._l2norm(g)
+                ratio = jnp.where(
+                    (w_norm > 0) & (g_norm > 0),
+                    eta * w_norm / (g_norm + wd * w_norm + eps), 1.0)
+            scaled_lr = lr * ratio
+            upd = scaled_lr * (g + wd * w)
+            if m is None:
+                return w - upd, None
+            m2 = mom_ * m + upd
+            return w - m2, m2
+
+        # momentum correction makes mom lr-dependent → traced arg, not key
+        key = ("lars", weight.shape, str(weight.dtype), is_bias_or_gamma,
+               state is None)
+        f = self._jitted(key, step)
+        if state is None:
+            weight._data, _ = f(weight._data, grad._data, None, lr, wd,
+                                self.rescale_grad, mom)
+        else:
+            weight._data, state._data = f(weight._data, grad._data,
+                                          state._data, lr, wd,
+                                          self.rescale_grad, mom)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with warmup strategies (ref: optimizer.py:1061).
+    Implements the 'lars' adaptive rate + linear/power warmup schedule."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1.0
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def _get_lbmult(self, nup):
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        strategy = self.warmup_strategy
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            mult = maxmult
+        elif nwup <= 1:
+            mult = 1.0
+        else:
+            if strategy == "linear":
+                mult = 1.0 + (maxmult - 1) * nup / nwup
+            elif strategy == "power2":
+                mult = 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+            elif strategy == "sqrt":
+                mult = 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+            else:
+                mult = 1.0
+        return mult
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.warmup_strategy == "lars":
+            w_norm = float(jnp.linalg.norm(weight._data.astype(jnp.float32)))
+            g_norm = float(jnp.linalg.norm(
+                (grad._data * self.rescale_grad).astype(jnp.float32)))
+            if w_norm > 0 and g_norm > 0:
+                self.lbmult = w_norm / (g_norm + wd * w_norm + 1e-9) * 0.001
+            else:
+                self.lbmult = 1.0
+        else:
+            self.lbmult = self._get_lbmult(self.num_update)
+        lr = lr * self.lbmult
+        clip, mom = self.clip_gradient, self.momentum
+
+        if mom == 0.0:
+            def step(w, g, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip)
+                return w - lr * (g + wd * w)
+            f = self._jitted(("lbsgd", weight.shape, str(weight.dtype)), step)
+            weight._data = f(weight._data, grad._data, lr, wd,
+                             self.rescale_grad)
+        else:
+            def step(w, g, m, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip)
+                m2 = mom * m - lr * (g + wd * w)
+                return w + m2, m2
+            f = self._jitted(("lbsgdm", weight.shape, str(weight.dtype)), step)
+            weight._data, state._data = f(weight._data, grad._data,
+                                          state._data, lr, wd,
+                                          self.rescale_grad)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref: optimizer.py:1236)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, NDArray(weight._data))
+        return (NDArray(jnp.zeros_like(weight._data)), NDArray(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, lamda, clip = self.momentum, self.lamda, self.clip_gradient
+        m, prev = state
+
+        def step(w, g, m_, prev_, lr, wd, rescale):
+            g = self._preprocess_grad(g, rescale, clip)
+            comp = g + wd * w + lamda * g * g * (w - prev_)
+            if m_ is None:
+                m2 = -lr * comp
+            else:
+                m2 = mom * m_ - lr * comp
+            return w + m2, m2, w
+        f = self._jitted(("dcasgd", weight.shape, str(weight.dtype),
+                          m is None), step)
+        if m is None:
+            weight._data, _, prev._data = f(
+                weight._data, grad._data, None, prev._data, lr, wd,
+                self.rescale_grad)
+        else:
+            weight._data, m._data, prev._data = f(
+                weight._data, grad._data, m._data, prev._data, lr, wd,
+                self.rescale_grad)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (ref: optimizer.py:1342)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _random
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient
+        key = _random.next_key()
+
+        def step(w, g, lr, wd, rescale, key):
+            g = self._preprocess_grad(g, rescale, clip)
+            noise = jax.random.normal(key, w.shape, w.dtype) * \
+                jnp.sqrt(lr).astype(w.dtype)
+            return w - lr / 2 * (g + wd * w) + noise
+        f = self._jitted(("sgld", weight.shape, str(weight.dtype)), step)
+        weight._data = f(weight._data, grad._data, lr, wd, self.rescale_grad,
+                         key)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (ref: optimizer.py:1412; fused kernel adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        b1, b2, eps, clip = self.beta1, self.beta2, self.epsilon, \
+            self.clip_gradient
+        m, v = state
+
+        def step(w, g, m_, v_, lr_t, wd, rescale):
+            g = self._preprocess_grad(g, rescale, clip) + wd * w
+            m2 = b1 * m_ + (1 - b1) * g
+            v2 = b2 * v_ + (1 - b2) * g * g
+            w2 = w - lr_t * m2 / (jnp.sqrt(v2) + eps)
+            return w2, m2, v2
+        f = self._jitted(("adam", weight.shape, str(weight.dtype)), step)
+        weight._data, m._data, v._data = f(weight._data, grad._data, m._data,
+                                           v._data, lr_t, wd,
+                                           self.rescale_grad)
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay
+    (ref: src/operator/contrib/adamw.cc, python contrib.optimizer)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        b1, b2, eps, clip = self.beta1, self.beta2, self.epsilon, \
+            self.clip_gradient
+        m, v = state
+
+        def step(w, g, m_, v_, lr_t, lr, wd, rescale):
+            g = self._preprocess_grad(g, rescale, clip)
+            m2 = b1 * m_ + (1 - b1) * g
+            v2 = b2 * v_ + (1 - b2) * g * g
+            w2 = w - lr_t * m2 / (jnp.sqrt(v2) + eps) - lr * wd * w
+            return w2, m2, v2
+        f = self._jitted(("adamw", weight.shape, str(weight.dtype)), step)
+        weight._data, m._data, v._data = f(weight._data, grad._data, m._data,
+                                           v._data, lr_t, lr, wd,
+                                           self.rescale_grad)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (ref: optimizer.py:1520)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        eps, clip = self.float_stable_eps, self.clip_gradient
+
+        def step(w, g, h, lr, wd, rescale):
+            g = self._preprocess_grad(g, rescale, clip) + wd * w
+            h2 = h + g * g
+            return w - lr * g / (jnp.sqrt(h2) + eps), h2
+        f = self._jitted(("adagrad", weight.shape, str(weight.dtype)), step)
+        weight._data, state._data = f(weight._data, grad._data, state._data,
+                                      lr, wd, self.rescale_grad)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (ref: optimizer.py:1635)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        rho, eps, clip = self.rho, self.epsilon, self.clip_gradient
+        acc_g, acc_delta = state
+
+        def step(w, g, ag, ad, wd, rescale):
+            g = self._preprocess_grad(g, rescale, clip) + wd * w
+            ag2 = rho * ag + (1 - rho) * g * g
+            delta = jnp.sqrt(ad + eps) / jnp.sqrt(ag2 + eps) * g
+            ad2 = rho * ad + (1 - rho) * delta * delta
+            return w - delta, ag2, ad2
+        f = self._jitted(("adadelta", weight.shape, str(weight.dtype)), step)
+        weight._data, acc_g._data, acc_delta._data = f(
+            weight._data, grad._data, acc_g._data, acc_delta._data, wd,
+            self.rescale_grad)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, non-centered (Hinton) and centered (Graves 2013) variants
+    (ref: optimizer.py:1553; kernels rmsprop_update/rmspropalex_update)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (NDArray(jnp.zeros_like(weight._data)),
+                    NDArray(jnp.zeros_like(weight._data)),
+                    NDArray(jnp.zeros_like(weight._data)))  # n, g, delta
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
+        clip, clip_w = self.clip_gradient, self.clip_weights
+
+        if not self.centered:
+            def step(w, g, n, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip) + wd * w
+                n2 = (1 - g1) * g * g + g1 * n
+                w2 = w - lr * g / jnp.sqrt(n2 + eps)
+                if clip_w is not None:
+                    w2 = jnp.clip(w2, -clip_w, clip_w)
+                return w2, n2
+            f = self._jitted(("rmsprop", weight.shape, str(weight.dtype)),
+                             step)
+            weight._data, state._data = f(weight._data, grad._data,
+                                          state._data, lr, wd,
+                                          self.rescale_grad)
+        else:
+            n, gbar, delta = state
+
+            def step(w, g, n_, gb, d, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip) + wd * w
+                n2 = (1 - g1) * g * g + g1 * n_
+                gb2 = (1 - g1) * g + g1 * gb
+                d2 = g2 * d - lr * g / jnp.sqrt(n2 - gb2 * gb2 + eps)
+                w2 = w + d2
+                if clip_w is not None:
+                    w2 = jnp.clip(w2, -clip_w, clip_w)
+                return w2, n2, gb2, d2
+            f = self._jitted(("rmspropalex", weight.shape, str(weight.dtype)),
+                             step)
+            weight._data, n._data, gbar._data, delta._data = f(
+                weight._data, grad._data, n._data, gbar._data, delta._data,
+                lr, wd, self.rescale_grad)
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax — infinity-norm Adam variant (ref: optimizer.py:1688)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr / (1. - self.beta1 ** t)
+        b1, b2, clip = self.beta1, self.beta2, self.clip_gradient
+        m, u = state
+
+        def step(w, g, m_, u_, lr_t, wd, rescale):
+            g = self._preprocess_grad(g, rescale, clip) + wd * w
+            m2 = b1 * m_ + (1 - b1) * g
+            u2 = jnp.maximum(b2 * u_, jnp.abs(g))
+            return w - lr_t * m2 / (u2 + 1e-8), m2, u2
+        f = self._jitted(("adamax", weight.shape, str(weight.dtype)), step)
+        weight._data, m._data, u._data = f(weight._data, grad._data, m._data,
+                                           u._data, lr_t, wd,
+                                           self.rescale_grad)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (ref: optimizer.py:1742)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        b1, b2, eps, clip = self.beta1, self.beta2, self.epsilon, \
+            self.clip_gradient
+        momentum_t = b1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = b1 * (1. - 0.5 * 0.96 **
+                             ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+
+        # t-dependent scalars enter as traced args so stepping never
+        # recompiles (cache key is shape/dtype only)
+        def step(w, g, m_, v_, lr, wd, rescale, m_sched, m_sched_next,
+                 mom_t, mom_t_1, v_corr):
+            g = self._preprocess_grad(g, rescale, clip) + wd * w
+            g_prime = g / (1. - m_sched)
+            m2 = b1 * m_ + (1. - b1) * g
+            m2_prime = m2 / (1. - m_sched_next)
+            v2 = b2 * v_ + (1. - b2) * g * g
+            v2_prime = v2 / v_corr
+            m_bar = (1. - mom_t) * g_prime + mom_t_1 * m2_prime
+            return w - lr * m_bar / (jnp.sqrt(v2_prime) + eps), m2, v2
+        f = self._jitted(("nadam", weight.shape, str(weight.dtype)), step)
+        weight._data, m._data, v._data = f(
+            weight._data, grad._data, m._data, v._data, lr, wd,
+            self.rescale_grad, self.m_schedule, m_schedule_next, momentum_t,
+            momentum_t_1, 1. - b2 ** t)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (ref: optimizer.py Ftrl; kernel ftrl_update)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),   # z
+                NDArray(jnp.zeros_like(weight._data)))   # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        l1, beta, clip = self.lamda1, self.beta, self.clip_gradient
+        z, n = state
+
+        def step(w, g, z_, n_, lr, wd, rescale):
+            g = self._preprocess_grad(g, rescale, clip)
+            sigma = (jnp.sqrt(n_ + g * g) - jnp.sqrt(n_)) / lr
+            z2 = z_ + g - sigma * w
+            n2 = n_ + g * g
+            w2 = jnp.where(
+                jnp.abs(z2) > l1,
+                (jnp.sign(z2) * l1 - z2) /
+                ((beta + jnp.sqrt(n2)) / lr + wd), 0.0).astype(w.dtype)
+            return w2, z2, n2
+        f = self._jitted(("ftrl", weight.shape, str(weight.dtype)), step)
+        weight._data, z._data, n._data = f(weight._data, grad._data, z._data,
+                                           n._data, lr, wd, self.rescale_grad)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (ref: optimizer.py:1285)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, clip = self.momentum, self.clip_gradient
+
+        if state is None:
+            def step(w, g, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip)
+                return w - lr * (g + wd * w)
+            f = self._jitted(("nag0", weight.shape, str(weight.dtype)), step)
+            weight._data = f(weight._data, grad._data, lr, wd,
+                             self.rescale_grad)
+        else:
+            def step(w, g, m, lr, wd, rescale):
+                g = self._preprocess_grad(g, rescale, clip) + wd * w
+                m2 = mom * m + g
+                return w - lr * (g + mom * m2), m2
+            f = self._jitted(("nag", weight.shape, str(weight.dtype)), step)
+            weight._data, state._data = f(weight._data, grad._data,
+                                          state._data, lr, wd,
+                                          self.rescale_grad)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for batch training (LAMB), as added to the
+    reference in 1.6 (ref: src/operator/optimizer_op.cc lamb_update_phase1/2)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        b1, b2, eps, clip = self.beta1, self.beta2, self.epsilon, \
+            self.clip_gradient
+        lo, hi, bias_corr = self.lower_bound, self.upper_bound, \
+            self.bias_correction
+        m, v = state
+
+        def step(w, g, m_, v_, lr, wd, rescale, coef1, coef2):
+            g = self._preprocess_grad(g, rescale, clip)
+            m2 = b1 * m_ + (1 - b1) * g
+            v2 = b2 * v_ + (1 - b2) * g * g
+            if bias_corr:
+                mhat = m2 / coef1
+                vhat = v2 / coef2
+            else:
+                mhat, vhat = m2, v2
+            r = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+            w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+            r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+            if lo is not None:
+                w_norm = jnp.maximum(w_norm, lo)
+            if hi is not None:
+                w_norm = jnp.minimum(w_norm, hi)
+            ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm,
+                              1.0).astype(w.dtype)
+            return w - lr * ratio * r, m2, v2
+        f = self._jitted(("lamb", weight.shape, str(weight.dtype)), step)
+        weight._data, m._data, v._data = f(
+            weight._data, grad._data, m._data, v._data, lr, wd,
+            self.rescale_grad, 1 - b1 ** t, 1 - b2 ** t)
+
+
+# backward-compat alias (ref: optimizer.py ccSGD deprecated alias)
+ccSGD = SGD
+
+
+class Updater:
+    """Applies an optimizer to indexed weights, owning per-index state
+    (ref: optimizer.py:1935 Updater, get_updater :2035; this is what kvstore
+    set_optimizer installs server-side)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices = [index]
+            grads = [grad]
+            weights = [weight]
+        else:
+            indices, grads, weights = index, grad, weight
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            elif not self.states_synced[i]:
+                self.states[i] = self.sync_state_context(self.states[i],
+                                                         w.context)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            return type(state)(self.sync_state_context(i, context)
+                               for i in state)
+        return state
+
+    def set_states(self, states):
+        """ref: optimizer.py Updater.set_states — accepts (states, optimizer)
+        pickles for checkpoint resume."""
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        # stored states are numpy trees; rehydrate lazily
+        self.states = {k: _rehydrate(v) for k, v in self.states.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        dehydrated = {k: _dehydrate(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((dehydrated, self.optimizer))
+        return pickle.dumps(dehydrated)
+
+
+def _dehydrate(state):
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    if isinstance(state, (tuple, list)):
+        return type(state)(_dehydrate(s) for s in state)
+    return state
+
+
+def _rehydrate(state):
+    if isinstance(state, _np.ndarray):
+        return nd.array(state, dtype=canonical_dtype(state.dtype))
+    if isinstance(state, (tuple, list)):
+        return type(state)(_rehydrate(s) for s in state)
+    return state
+
+
+def get_updater(optimizer):
+    """ref: optimizer.py:2035."""
+    return Updater(optimizer)

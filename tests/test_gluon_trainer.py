@@ -1,0 +1,176 @@
+"""Gluon Trainer + KVStore tests
+(ref: tests/python/unittest/test_gluon_trainer.py, test_kvstore.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def _make_net():
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize(mx.init.Uniform(0.1))
+    return net
+
+
+def test_trainer_step_reduces_loss():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.uniform(-1, 1, (32, 4)))
+    true_w = rng.uniform(-1, 1, (4, 1)).astype("float32")
+    y = mx.nd.array(rng.uniform(-1, 1, (32, 4)).dot(true_w))
+    # use same x for y computation
+    y = mx.nd.array(x.asnumpy().dot(true_w))
+
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch_size=32)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.3, losses
+
+
+def test_trainer_lr_access_and_set():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.25})
+    assert trainer.learning_rate == 0.25
+    trainer.set_learning_rate(0.1)
+    assert trainer.learning_rate == 0.1
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    x = mx.nd.ones((8, 4))
+    y = mx.nd.ones((8, 1))
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(8)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    assert os.path.exists(fname)
+
+    net2 = _make_net()
+    trainer2 = gluon.Trainer(net2.collect_params(), "adam",
+                             {"learning_rate": 0.1})
+    with autograd.record():
+        loss = loss_fn(net2(x), y)
+    loss.backward()
+    trainer2.step(8)
+    trainer2.load_states(fname)
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update
+
+
+def test_trainer_allreduce_then_update():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.ones((4, 4))
+    y = mx.nd.ones((4, 1))
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.allreduce_grads()
+    trainer.update(4)
+
+
+# -- kvstore ----------------------------------------------------------------
+
+def test_kvstore_push_pull_single():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 3)))
+    out = mx.nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
+    kv.push(3, mx.nd.ones((2, 3)) * 4)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 4.0))
+
+
+def test_kvstore_aggregation():
+    kv = mx.kv.create("device")
+    kv.init("w", mx.nd.zeros((2,)))
+    vals = [mx.nd.ones((2,)), mx.nd.ones((2,)) * 2, mx.nd.ones((2,)) * 3]
+    kv.push("w", vals)
+    out = mx.nd.zeros((2,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2,), 6.0))
+
+
+def test_kvstore_list_keys():
+    kv = mx.kv.create()
+    keys = [5, 7, 9]
+    kv.init(keys, [mx.nd.ones((2,))] * 3)
+    outs = [mx.nd.zeros((2,)) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), np.ones((2,)))
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((2,)))
+
+    def updater(key, grad, weight):
+        weight += grad * 2
+    kv.set_updater(updater)
+    kv.push(0, mx.nd.ones((2,)))
+    out = mx.nd.zeros((2,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2,), 3.0))
+
+
+def test_kvstore_set_optimizer_server_side():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.push(0, mx.nd.ones((4,)))
+    out = mx.nd.zeros((4,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((4,), 0.5))
+
+
+def test_kvstore_pushpull_and_broadcast():
+    kv = mx.kv.create("tpu")
+    out = mx.nd.zeros((3,))
+    kv.broadcast("b", mx.nd.ones((3,)), out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((3,)))
+    res = mx.nd.zeros((3,))
+    kv.pushpull("b", mx.nd.ones((3,)) * 2, out=res)
+    np.testing.assert_allclose(res.asnumpy(), np.full((3,), 2.0))
+
+
+def test_kvstore_invalid_type():
+    with pytest.raises(ValueError):
+        mx.kv.create("bogus")
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((2, 2)) * 3, mx.nd.ones((2,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert norm <= 1.0 + 1e-5
+    assert total > 1.0
+
+
+def test_split_and_load():
+    data = mx.nd.arange(8).reshape((4, 2))
+    slices = gluon.utils.split_data(data, 2)
+    assert len(slices) == 2 and slices[0].shape == (2, 2)
+    with pytest.raises(ValueError):
+        gluon.utils.split_data(data, 3)
+    loaded = gluon.utils.split_and_load(data.asnumpy(), [mx.cpu()])
+    assert loaded[0].shape == (4, 2)

@@ -1,0 +1,111 @@
+"""Metric tests (ref: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert acc == pytest.approx(2.0 / 3)
+
+
+def test_accuracy_same_shape_pred():
+    m = metric.Accuracy()
+    m.update([mx.nd.array([1, 1, 0])], [mx.nd.array([1, 0, 0])])
+    assert m.get()[1] == pytest.approx(2.0 / 3)
+
+
+def test_top_k_accuracy():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = mx.nd.array([2, 2])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1():
+    m = metric.F1()
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7], [0.6, 0.4]])
+    label = mx.nd.array([1, 0, 0, 1])
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=1 → precision=recall=0.5 → f1=0.5
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mcc_perfect():
+    m = metric.MCC()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_regression_metrics():
+    pred = mx.nd.array([1.0, 2.0, 3.0])
+    label = mx.nd.array([1.5, 2.0, 2.5])
+    mae = metric.MAE(); mae.update([label], [pred])
+    mse = metric.MSE(); mse.update([label], [pred])
+    rmse = metric.RMSE(); rmse.update([label], [pred])
+    assert mae.get()[1] == pytest.approx(1.0 / 3)
+    assert mse.get()[1] == pytest.approx((0.25 + 0 + 0.25) / 3)
+    assert rmse.get()[1] == pytest.approx(np.sqrt((0.25 + 0 + 0.25) / 3))
+
+
+def test_perplexity():
+    m = metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    expect = np.exp(-(np.log(0.75) + np.log(0.5)) / 2)
+    assert m.get()[1] == pytest.approx(expect, rel=1e-5)
+
+
+def test_cross_entropy_nll():
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    ce = metric.CrossEntropy(); ce.update([label], [pred])
+    expect = -(np.log(0.75) + np.log(0.5)) / 2
+    assert ce.get()[1] == pytest.approx(expect, rel=1e-5)
+    nll = metric.NegativeLogLikelihood(); nll.update([label], [pred])
+    assert nll.get()[1] == pytest.approx(expect, rel=1e-5)
+
+
+def test_pearson():
+    m = metric.PearsonCorrelation()
+    pred = mx.nd.array([1.0, 2.0, 3.0, 4.0])
+    label = mx.nd.array([2.0, 4.0, 6.0, 8.0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0, rel=1e-6)
+
+
+def test_composite_and_create():
+    m = metric.create(["acc", "mse"])
+    assert isinstance(m, metric.CompositeEvalMetric)
+    pred = mx.nd.array([[0.3, 0.7]])
+    label = mx.nd.array([1])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names and "mse" in names
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.abs(label - pred).sum())
+    m = metric.np(feval)
+    m.update([mx.nd.array([1.0])], [mx.nd.array([0.5])])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_loss_metric_and_reset():
+    m = metric.Loss()
+    m.update(None, [mx.nd.array([1.0, 2.0])])
+    assert m.get()[1] == pytest.approx(1.5)
+    m.reset()
+    assert np.isnan(m.get()[1])

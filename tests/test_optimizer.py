@@ -1,0 +1,150 @@
+"""Optimizer tests — mirror the reference's strategy of comparing fused
+updates against straightforward numpy implementations
+(ref: tests/python/unittest/test_optimizer.py compare_optimizer)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.optimizer as opt
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    w0 = rng.uniform(-1, 1, (5, 4)).astype("float32")
+    g0 = rng.uniform(-1, 1, (5, 4)).astype("float32")
+    lr, wd, mom = 0.1, 0.01, 0.9
+
+    o = opt.SGD(learning_rate=lr, momentum=mom, wd=wd)
+    u = opt.get_updater(o)
+    w = mx.nd.array(w0)
+    g = mx.nd.array(g0)
+
+    w_np, m_np = w0.copy(), np.zeros_like(w0)
+    for _ in range(3):
+        u(0, g, w)
+        m_np = mom * m_np - lr * (g0 + wd * w_np)
+        w_np = w_np + m_np
+    np.testing.assert_allclose(w.asnumpy(), w_np, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum_and_clip():
+    w0 = np.ones((3,), "float32")
+    g0 = np.array([10.0, -10.0, 0.1], "float32")
+    o = opt.SGD(learning_rate=0.1, clip_gradient=1.0)
+    u = opt.get_updater(o)
+    w = mx.nd.array(w0)
+    u(0, mx.nd.array(g0), w)
+    expect = w0 - 0.1 * np.clip(g0, -1, 1)
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(1)
+    w0 = rng.uniform(-1, 1, (6,)).astype("float32")
+    g0 = rng.uniform(-1, 1, (6,)).astype("float32")
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+
+    o = opt.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    u = opt.get_updater(o)
+    w = mx.nd.array(w0)
+    g = mx.nd.array(g0)
+
+    w_np = w0.copy()
+    m_np, v_np = np.zeros_like(w0), np.zeros_like(w0)
+    for t in range(1, 4):
+        u(0, g, w)
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m_np = b1 * m_np + (1 - b1) * g0
+        v_np = b2 * v_np + (1 - b2) * g0 * g0
+        w_np = w_np - lr_t * m_np / (np.sqrt(v_np) + eps)
+    np.testing.assert_allclose(w.asnumpy(), w_np, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_precision_bf16():
+    w = mx.nd.array(np.ones((4,)), dtype="bfloat16")
+    g = mx.nd.array(np.full((4,), 0.5), dtype="bfloat16")
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    u = opt.get_updater(o)
+    for _ in range(5):
+        u(0, g, w)
+    assert w.dtype == np.dtype(mx.base.DTYPE_NAMES["bfloat16"])
+    # master copy is fp32
+    master, state = u.states[0]
+    assert master.dtype == np.float32
+    assert np.isfinite(w.asnumpy().astype("float32")).all()
+
+
+def test_updater_state_roundtrip():
+    o = opt.Adam(learning_rate=0.1)
+    u = opt.get_updater(o)
+    w = mx.nd.array(np.ones((3,)))
+    g = mx.nd.array(np.full((3,), 0.2))
+    u(0, g, w)
+    blob = u.get_states(dump_optimizer=True)
+
+    u2 = opt.get_updater(opt.Adam())
+    u2.set_states(blob)
+    w1 = mx.nd.array(w.asnumpy())
+    w2 = mx.nd.array(w.asnumpy())
+    u(0, g, w1)
+    u2(0, g, w2)
+    np.testing.assert_allclose(w1.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0, param_idx2name={0: "a_weight",
+                                                   1: "b_bias"})
+    o.set_lr_mult({"a_weight": 0.5})
+    o.set_wd_mult({})
+    assert o._get_lr(0) == 0.5
+    assert o._get_lr(1) == 1.0
+    # bias gets wd_mult 0 automatically (non-_weight names)
+    assert o._get_wd(1) == 0.0
+
+
+def test_create_by_name_registry():
+    for name in ("sgd", "adam", "rmsprop", "adagrad", "adadelta", "adamax",
+                 "nadam", "ftrl", "signum", "nag", "ftml", "lamb", "lars",
+                 "dcasgd", "sgld", "lbsgd", "adamw", "test"):
+        o = opt.create(name)
+        assert isinstance(o, opt.Optimizer), name
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+
+def test_lr_scheduler_multifactor():
+    from mxnet_tpu.lr_scheduler import MultiFactorScheduler
+    s = MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert s(1) == 1.0
+    assert abs(s(6) - 0.1) < 1e-12
+    assert abs(s(11) - 0.01) < 1e-12
+
+
+def test_lr_scheduler_poly_cosine_warmup():
+    from mxnet_tpu.lr_scheduler import PolyScheduler, CosineScheduler
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=2,
+                      warmup_steps=10, warmup_begin_lr=0.0)
+    assert p(5) == pytest.approx(0.5)       # linear warmup
+    assert p(100) == pytest.approx(0.0)
+    c = CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.1)
+    assert c(0) == pytest.approx(1.0)
+    assert c(100) == pytest.approx(0.1)
+    assert 0.1 < c(50) < 1.0
+
+
+def test_optimizer_with_scheduler_steps_lr():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    o = opt.SGD(learning_rate=1.0,
+                lr_scheduler=FactorScheduler(step=2, factor=0.5))
+    u = opt.get_updater(o)
+    w = mx.nd.array(np.ones((2,)))
+    g = mx.nd.array(np.zeros((2,)))
+    for _ in range(6):
+        u(0, g, w)
+    assert o._get_lr(0) < 1.0

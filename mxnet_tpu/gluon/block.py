@@ -152,24 +152,54 @@ class Block:
     def zero_grad(self):
         self.collect_params().zero_grad()
 
+    def _collect_params_with_prefix(self, prefix=""):
+        """Structural parameter paths ("features.0.weight"), stable across
+        model instances (ref: block.py _collect_params_with_prefix) — the
+        serialization key space for save/load_parameters."""
+        out = {}
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            out.update(child._collect_params_with_prefix(
+                prefix + cname + "."))
+        return out
+
     # -- persistence (ref: block.py:366 save_parameters, :408 load) -------
     def save_parameters(self, filename, deduplicate=False):
-        params = self.collect_params()
-        arg = {n[len(self._prefix):] if n.startswith(self._prefix) else n:
-               p.data() for n, p in params.items() if p._data is not None}
+        params = self._collect_params_with_prefix()
+        if deduplicate:
+            seen = {}
+            arg = {}
+            for n, p in params.items():
+                if p._data is None:
+                    continue
+                if id(p) in seen:
+                    continue
+                seen[id(p)] = n
+                arg[n] = p.data()
+        else:
+            arg = {n: p.data() for n, p in params.items()
+                   if p._data is not None}
         nd.save(filename, arg)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
                         dtype_source="current"):
         loaded = nd.load(filename)
-        params = self.collect_params()
-        canonical = {}
-        for n, p in params.items():
-            short = n[len(self._prefix):] if n.startswith(self._prefix) else n
-            canonical[short] = p
+        canonical = self._collect_params_with_prefix()
+        if loaded and canonical and not any(k in canonical for k in loaded):
+            # fall back to full-name keys written by older ParameterDict.save
+            params = self.collect_params()
+            canonical = {}
+            for n, p in params.items():
+                short = n[len(self._prefix):] \
+                    if n.startswith(self._prefix) else n
+                canonical[short] = p
         for k, v in loaded.items():
             if k in canonical:
+                if cast_dtype and dtype_source == "current" \
+                        and canonical[k]._data is not None:
+                    v = v.astype(canonical[k].dtype)
                 canonical[k].set_data(v)
             elif not ignore_extra:
                 raise KeyError("Parameter %r in file not found in Block" % k)
@@ -262,16 +292,6 @@ class HybridBlock(Block):
     def cast(self, dtype):
         super().cast(dtype)
         self._cached_graph = {}
-
-    def _collect_params_with_prefix(self, prefix=""):
-        out = {}
-        for name, p in self._reg_params.items():
-            out[prefix + name] = p
-        for cname, child in self._children.items():
-            if isinstance(child, HybridBlock) or isinstance(child, Block):
-                out.update(child._collect_params_with_prefix(
-                    prefix + cname + "."))
-        return out
 
     # -- forward ----------------------------------------------------------
     def __call__(self, *args):

@@ -1,0 +1,12 @@
+"""Model zoo: pre-defined network architectures.
+
+TPU-native re-design of the reference model zoo
+(ref: python/mxnet/gluon/model_zoo/__init__.py). Pretrained-weight download
+is stubbed out (zero-egress environment); architectures, parameter shapes and
+`get_model` names match the reference so checkpoints written by
+`save_parameters` round-trip.
+"""
+from . import vision
+from .vision import get_model
+
+__all__ = ["vision", "get_model"]

@@ -1,0 +1,9 @@
+"""Top-level model registry: alias of `gluon.model_zoo.vision`.
+
+Convenience namespace so `mx.models.get_model('resnet50_v1')` works alongside
+the reference-compatible `mx.gluon.model_zoo.vision.get_model`.
+"""
+from .gluon.model_zoo import vision
+from .gluon.model_zoo.vision import get_model  # noqa: F401
+
+__all__ = ["vision", "get_model"]

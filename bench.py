@@ -1,0 +1,79 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Matches the reference's own headline (ref: docs perf.md — ResNet-50 training
+batch 32: 298.51 img/s on V100 fp32; BASELINE.md). Runs the full Gluon
+training step (forward + backward + SGD-momentum update + BN stat updates)
+as ONE fused XLA program via ShardedTrainStep on whatever chip is attached.
+
+Prints one JSON line:
+  {"metric": "resnet50_train_imgs_per_sec_per_chip", "value": N,
+   "unit": "images/sec", "vs_baseline": N / 298.51}
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 298.51  # ref V100 fp32 training, batch 32 (perf.md)
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    import mxnet_tpu.optimizer as opt
+    from mxnet_tpu.parallel import create_mesh, data_parallel, \
+        ShardedTrainStep
+
+    platform = jax.devices()[0].platform
+    batch = int(os.environ.get("BENCH_BATCH",
+                               128 if platform != "cpu" else 8))
+    dtype = os.environ.get("BENCH_DTYPE",
+                           "bfloat16" if platform != "cpu" else "float32")
+
+    net = resnet50_v1()
+    net.initialize()
+    net(mx.nd.array(np.zeros((1, 3, 224, 224), "float32")))  # deferred init
+    if dtype != "float32":
+        net.cast(dtype)
+
+    mesh = create_mesh(devices=jax.devices()[:1], dp=1)
+    step = ShardedTrainStep(net, SoftmaxCrossEntropyLoss(),
+                            opt.create("sgd", learning_rate=0.01,
+                                       momentum=0.9),
+                            strategy=data_parallel(mesh))
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 3, 224, 224).astype(dtype)
+    y = rng.randint(0, 1000, (batch,)).astype("float32")
+    xd, yd = step.place_batch(x, y)  # on-device once; input pipeline is
+    # benchmarked separately (the reference prefetches via iter_prefetcher.h)
+
+    float(step.step(xd, yd))  # compile + warm
+    float(step.step(xd, yd))
+
+    iters = int(os.environ.get("BENCH_ITERS", 20 if platform != "cpu" else 3))
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(iters):
+        loss = step.step(xd, yd)
+    loss = float(loss)  # sync once at the end
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 4),
+        "platform": platform,
+        "batch": batch,
+        "dtype": dtype,
+        "final_loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

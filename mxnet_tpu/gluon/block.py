@@ -197,9 +197,10 @@ class Block:
                 canonical[short] = p
         for k, v in loaded.items():
             if k in canonical:
-                if cast_dtype and dtype_source == "current" \
-                        and canonical[k]._data is not None:
-                    v = v.astype(canonical[k].dtype)
+                if cast_dtype and dtype_source == "saved":
+                    # adopt the checkpoint's dtype (ref: block.py:408
+                    # load_parameters cast_dtype semantics)
+                    canonical[k].cast(str(v.dtype))
                 canonical[k].set_data(v)
             elif not ignore_extra:
                 raise KeyError("Parameter %r in file not found in Block" % k)

@@ -139,12 +139,15 @@ def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
         padding = [(0, 0), (0, 0)] + [(p, p) for p in pad]
 
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
-            jnp.iinfo(x.dtype).min
-        return jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max,
+        # NB: init must stay a weak-typed Python scalar — an array init value
+        # breaks reverse-mode linearization of reduce_window under jit
+        init = -float("inf") if jnp.issubdtype(x.dtype, jnp.floating) else \
+            int(jnp.iinfo(x.dtype).min)
+        return jax.lax.reduce_window(x, init, jax.lax.max,
                                      window, strides, padding)
     if pool_type in ("avg", "sum"):
-        s = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add,
+        s = jax.lax.reduce_window(x, 0.0 if jnp.issubdtype(
+            x.dtype, jnp.floating) else 0, jax.lax.add,
                                   window, strides, padding)
         if pool_type == "sum":
             return s
@@ -154,12 +157,13 @@ def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
                 denom *= k
             return s / denom
         ones = jnp.ones_like(x)
-        cnt = jax.lax.reduce_window(ones, jnp.asarray(0, x.dtype), jax.lax.add,
+        cnt = jax.lax.reduce_window(ones, 0.0 if jnp.issubdtype(
+            x.dtype, jnp.floating) else 0, jax.lax.add,
                                     window, strides, padding)
         return s / cnt
     if pool_type == "lp":
         s = jax.lax.reduce_window(jnp.power(jnp.abs(x), p_value),
-                                  jnp.asarray(0, x.dtype), jax.lax.add,
+                                  0.0, jax.lax.add,
                                   window, strides, padding)
         return jnp.power(s, 1.0 / p_value)
     raise ValueError("unknown pool_type %r" % (pool_type,))

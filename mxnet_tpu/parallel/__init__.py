@@ -1,0 +1,50 @@
+"""Parallelism: device meshes, collectives, sharding strategies.
+
+TPU-native replacement for the reference's entire distributed stack
+(ref: src/kvstore/ comm.h/comm_tree.h/kvstore_nccl.h/kvstore_dist.h,
+3rdparty/ps-lite): instead of reduction trees, NCCL calls and a ZMQ
+parameter server, ONE device mesh (`jax.sharding.Mesh`) carries every
+strategy as a sharding spec, and XLA inserts the ICI/DCN collectives:
+
+- data parallel        ≙ kvstore local/device/nccl/dist_sync  → psum over 'dp'
+- ZeRO/FSDP            ≙ server-held optimizer state          → shard over 'fsdp'
+- tensor parallel      ≙ (not in reference)                   → shard over 'tp'
+- pipeline parallel    ≙ group2ctx model parallelism          → stages over 'pp'
+- sequence/context par ≙ (not in reference; BucketingModule)  → ring attention over 'sp'
+- expert parallel      ≙ (not in reference)                   → MoE over 'ep'
+
+See SURVEY.md §2.4 and §5 "distributed communication backend".
+"""
+from .mesh import (DeviceMesh, create_mesh, current_mesh, default_mesh_axes,
+                   mesh_scope)
+from .collectives import (all_reduce, all_gather, reduce_scatter, all_to_all,
+                          ppermute, ring_exchange, host_allreduce,
+                          host_barrier, num_hosts, host_rank,
+                          initialize_distributed)
+from .sharding import (ShardingStrategy, PartitionRules, data_parallel,
+                       fsdp, tensor_parallel, make_param_sharding,
+                       infer_rules_for_block)
+from .ring_attention import ring_attention, ring_self_attention, \
+    blockwise_attention
+from .ulysses import ulysses_attention
+from .pipeline import pipeline_stages, PipelineStage
+from .expert import MoELayer, top_k_routing
+from .train import ShardedTrainStep, functional_call, extract_params, \
+    attach_params
+from . import transformer
+
+__all__ = [
+    "DeviceMesh", "create_mesh", "current_mesh", "default_mesh_axes",
+    "mesh_scope",
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute",
+    "ring_exchange", "host_allreduce", "host_barrier", "num_hosts",
+    "host_rank", "initialize_distributed",
+    "ShardingStrategy", "PartitionRules", "data_parallel", "fsdp",
+    "tensor_parallel", "make_param_sharding", "infer_rules_for_block",
+    "ring_attention", "ring_self_attention", "blockwise_attention",
+    "ulysses_attention",
+    "pipeline_stages", "PipelineStage",
+    "MoELayer", "top_k_routing",
+    "ShardedTrainStep", "functional_call", "extract_params", "attach_params",
+    "transformer",
+]

@@ -1,0 +1,95 @@
+"""Expert parallelism: Mixture-of-Experts FFN sharded over the 'ep' axis.
+
+Not present in the reference (2019-era, SURVEY.md §2.4 item 7) but part of
+the required capability surface. Design: experts live on the 'ep' mesh axis
+(weights [E, ...] sharded P('ep', ...)); routing is computed densely and
+tokens reach their experts via einsum dispatch/combine (Shazeer et al.
+arXiv:1701.06538, GShard arXiv:2006.16668). GSPMD turns the dispatch einsum
+into an all-to-all over ICI. Dense dispatch keeps shapes static — the XLA
+requirement — with capacity_factor bounding per-expert load.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["top_k_routing", "moe_ffn", "MoELayer"]
+
+
+def top_k_routing(logits, k=2, capacity=None):
+    """Token->expert assignment with capacity. logits: [T, E].
+
+    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weights, aux_loss).
+    aux_loss is the load-balancing loss (mean_prob * mean_assignment * E).
+    """
+    T, E = logits.shape
+    if capacity is None:
+        capacity = max(1, (k * T + E - 1) // E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, experts = jax.lax.top_k(probs, k)            # [T, k]
+    # position of each token within its expert's queue
+    onehot = jax.nn.one_hot(experts, E, dtype=jnp.int32)     # [T, k, E]
+    # cumulative count per expert across (token, choice) in order
+    flat = onehot.reshape(T * k, E)
+    pos_in_expert = jnp.cumsum(flat, axis=0) - flat          # [T*k, E]
+    pos = (pos_in_expert * flat).sum(-1).reshape(T, k)       # [T, k]
+    keep = pos < capacity
+    gates = gates * keep
+    denom = jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    gates = gates / denom
+    disp = jnp.zeros((T, E, capacity), jnp.float32)
+    comb = jnp.zeros((T, E, capacity), jnp.float32)
+    t_idx = jnp.arange(T)[:, None].repeat(k, 1)
+    disp = disp.at[t_idx, experts, jnp.clip(pos, 0, capacity - 1)].add(
+        keep.astype(jnp.float32))
+    comb = comb.at[t_idx, experts, jnp.clip(pos, 0, capacity - 1)].add(
+        gates * keep)
+    # load-balance aux loss
+    me = probs.mean(0)                                   # [E]
+    ce = flat.reshape(T, k, E).sum(1).astype(jnp.float32).mean(0)
+    aux = (me * ce).sum() * E
+    return disp, comb, aux
+
+
+def moe_ffn(x, router_w, w1, w2, k=2, capacity_factor=1.25,
+            activation=jax.nn.gelu):
+    """MoE FFN. x: [B, S, D]; router_w: [D, E]; w1: [E, D, F]; w2: [E, F, D].
+    Shard w1/w2 P('ep', None, 'tp')/P('ep', 'tp', None) for ep x tp."""
+    B, S, D = x.shape
+    E = router_w.shape[1]
+    T = B * S
+    xt = x.reshape(T, D)
+    logits = xt @ router_w                               # [T, E]
+    capacity = max(1, int(capacity_factor * k * T / E))
+    disp, comb, aux = top_k_routing(logits, k=k, capacity=capacity)
+    # dispatch: [E, C, D] expert inputs (GSPMD: all-to-all over 'ep')
+    xe = jnp.einsum("td,tec->ecd", xt, disp)
+    h = activation(jnp.einsum("ecd,edf->ecf", xe, w1))
+    ye = jnp.einsum("ecf,efd->ecd", h, w2)
+    yt = jnp.einsum("ecd,tec->td", ye, comb)
+    return yt.reshape(B, S, D), aux
+
+
+class MoELayer:
+    """Functional MoE layer bundle (params created via init())."""
+
+    def __init__(self, dim, hidden, num_experts, k=2, capacity_factor=1.25):
+        self.dim, self.hidden = dim, hidden
+        self.num_experts, self.k = num_experts, k
+        self.capacity_factor = capacity_factor
+
+    def init(self, key):
+        import jax.random as jr
+        k1, k2, k3 = jr.split(key, 3)
+        scale = self.dim ** -0.5
+        return {
+            "router": jr.normal(k1, (self.dim, self.num_experts)) * scale,
+            "w1": jr.normal(k2, (self.num_experts, self.dim,
+                                 self.hidden)) * scale,
+            "w2": jr.normal(k3, (self.num_experts, self.hidden,
+                                 self.dim)) * (self.hidden ** -0.5),
+        }
+
+    def __call__(self, params, x):
+        return moe_ffn(x, params["router"], params["w1"], params["w2"],
+                       k=self.k, capacity_factor=self.capacity_factor)

@@ -1,0 +1,112 @@
+"""Device mesh management.
+
+One logical mesh with named axes replaces the reference's separate comm
+paths (intra-node reduce trees, NCCL rings, ps-lite key sharding — ref:
+src/kvstore/comm.h:451, kvstore_nccl.h:62, kvstore_dist.h:44). Axis layout
+follows the ICI-torus-first rule: model axes (tp/sp) innermost so their
+collectives ride the fastest links; dp outermost so gradient psum can cross
+DCN between slices.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as _np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["DeviceMesh", "create_mesh", "current_mesh", "default_mesh_axes",
+           "mesh_scope"]
+
+# canonical axis order, outermost (slowest/DCN-friendly) to innermost (ICI)
+default_mesh_axes = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+_state = threading.local()
+
+
+class DeviceMesh:
+    """A named-axis device mesh; thin wrapper over jax.sharding.Mesh."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    @property
+    def axis_names(self):
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def shape(self):
+        return dict(self.mesh.shape)
+
+    def size(self, axis=None):
+        if axis is None:
+            return int(_np.prod(list(self.mesh.shape.values())))
+        return int(self.mesh.shape[axis])
+
+    def sharding(self, *spec):
+        """NamedSharding for a PartitionSpec over this mesh."""
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def __enter__(self):
+        self.mesh.__enter__()
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return self.mesh.__exit__(*exc)
+
+    def __repr__(self):
+        return "DeviceMesh(%s)" % (dict(self.mesh.shape),)
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+def create_mesh(axes=None, devices=None, **axis_sizes):
+    """Create a DeviceMesh.
+
+    create_mesh(dp=2, tp=4)           — explicit sizes (product must divide
+                                        device count; remainder goes to 'dp')
+    create_mesh()                     — all devices on 'dp'
+
+    Axes not mentioned get size 1 so PartitionSpecs referencing any canonical
+    axis are always valid.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = default_mesh_axes
+    sizes = {a: int(axis_sizes.get(a, 1)) for a in axes}
+    explicit = int(_np.prod([s for s in sizes.values()]))
+    if n % explicit != 0:
+        raise ValueError("mesh axes %s (product %d) do not divide %d devices"
+                         % (sizes, explicit, n))
+    if "dp" in sizes and "dp" not in axis_sizes:
+        sizes["dp"] = n // explicit
+    elif explicit != n:
+        raise ValueError("mesh axes %s use %d of %d devices"
+                         % (sizes, explicit, n))
+    shape = tuple(sizes[a] for a in axes)
+    dev_array = _np.array(devices).reshape(shape)
+    return DeviceMesh(Mesh(dev_array, axes))
+
+
+def current_mesh():
+    """Innermost active mesh, or None."""
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh):
+    with mesh:
+        yield mesh

@@ -1,0 +1,147 @@
+"""Ring attention: exact attention over a sequence sharded across a mesh axis.
+
+The reference scales sequence length only by bucketing and fused RNN kernels
+(ref: python/mxnet/module/bucketing_module.py:40, src/operator/rnn-inl.h) —
+it has no context parallelism. Here long context is first-class: the
+sequence dim is sharded over the 'sp' mesh axis and K/V blocks rotate around
+the ICI ring with `ppermute` while each device accumulates its queries'
+attention online (flash-attention style m/l/o running softmax, Liu et al.
+arXiv:2310.01889). Compute on one block overlaps the transfer of the next —
+XLA schedules ppermute as async collective-permute.
+
+Use inside `shard_map` over the 'sp' axis, or via `ring_self_attention`
+which wraps the shard_map given a mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_self_attention", "blockwise_attention"]
+
+
+def _attn_block(q, k, v, bias, m_prev, l_prev, o_prev, scale):
+    """One q-block x kv-block step of online-softmax attention.
+    q: [B,H,Sq,D] k,v: [B,H,Sk,D] bias: [B,1|H,Sq,Sk] or None."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m_cur = jnp.max(s, axis=-1)                      # [B,H,Sq]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (m == -inf) against nan exp
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])               # [B,H,Sq,Sk]
+    if bias is not None:
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+    corr = jnp.exp(jnp.where(jnp.isneginf(m_prev), -jnp.inf,
+                             m_prev - m_safe))
+    corr = jnp.where(jnp.isneginf(m_prev), 0.0, corr)
+    l_new = corr * l_prev + jnp.sum(p, axis=-1)
+    o_new = corr[..., None] * o_prev + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   q_offset=None):
+    """Exact attention with K/V rotating around `axis_name`.
+
+    Must run inside shard_map/pmap. Shapes per device:
+      q, k, v: [B, H, S_local, D] → out [B, H, S_local, D]
+
+    causal=True masks by GLOBAL position: device i holds queries
+    [i*S_local, (i+1)*S_local); kv blocks carry their origin index around
+    the ring so the mask is computed per step.
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if q_offset is None:
+        q_offset = my_idx * Sq
+
+    m0 = jnp.full((B, H, Sq), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((B, H, Sq), q.dtype)
+    o0 = jnp.zeros((B, H, Sq, D), q.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = q_offset + jnp.arange(Sq)                # [Sq] global q positions
+
+    def step(carry, _):
+        m, l, o, kk, vv, kv_idx = carry
+        if causal:
+            k_pos = kv_idx * Sk + jnp.arange(Sk)
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0,
+                             -jnp.inf)[None, None]   # [1,1,Sq,Sk]
+        else:
+            bias = None
+        m, l, o = _attn_block(q, kk, vv, bias, m, l, o, scale)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        kv_idx = lax.ppermute(kv_idx, axis_name, perm)
+        return (m, l, o, kk, vv, kv_idx), None
+
+    carry = (m0, l0, o0, k, v, my_idx)
+    carry, _ = lax.scan(step, carry, None, length=n)
+    m, l, o = carry[0], carry[1], carry[2]
+    l = jnp.where(l == 0.0, 1.0, l)                  # fully-masked rows → 0
+    return o / l[..., None]
+
+
+def ring_self_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                        scale=None, batch_axis="dp", head_axis="tp"):
+    """shard_map wrapper: q/k/v are [B, H, S, D] arrays (sharded or not);
+    sequence axis is sharded over `axis_name`, batch over `batch_axis`,
+    heads over `head_axis`."""
+    from jax import shard_map
+    spec = P(batch_axis, head_axis, axis_name, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return shard_map(fn, mesh=getattr(mesh, "mesh", mesh),
+                     in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)(q, k, v)
+
+
+def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
+    """Single-device memory-efficient attention: lax.scan over KV blocks with
+    the same online-softmax accumulation (the local building block of ring
+    attention; also useful alone to fit long context in HBM)."""
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    nblk = -(-S // block_size)
+    pad = nblk * block_size - S
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(B, H, nblk, block_size, D)
+    vb = v.reshape(B, H, nblk, block_size, D)
+    q_pos = jnp.arange(S)
+
+    m0 = jnp.full((B, H, S), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((B, H, S), q.dtype)
+    o0 = jnp.zeros((B, H, S, D), q.dtype)
+
+    def step(carry, blk):
+        m, l, o = carry
+        kk, vv, idx = blk
+        k_pos = idx * block_size + jnp.arange(block_size)
+        valid = k_pos < S
+        if causal:
+            ok = (q_pos[:, None] >= k_pos[None, :]) & valid[None, :]
+        else:
+            ok = jnp.broadcast_to(valid[None, :], (S, block_size))
+        bias = jnp.where(ok, 0.0, -jnp.inf)[None, None]
+        m, l, o = _attn_block(q, kk, vv, bias, m, l, o, scale)
+        return (m, l, o), None
+
+    idxs = jnp.arange(nblk)
+    (m, l, o), _ = lax.scan(step, (m0, l0, o0),
+                            (kb.transpose(2, 0, 1, 3, 4),
+                             vb.transpose(2, 0, 1, 3, 4), idxs))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return o / l[..., None]

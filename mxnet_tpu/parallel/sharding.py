@@ -1,0 +1,158 @@
+"""Sharding strategies: parameter partition rules over the mesh.
+
+The strategy object plays the role the kvstore *type string* plays in the
+reference ("local"/"device"/"nccl"/"dist_sync", ref: src/kvstore/kvstore.cc:40):
+it names HOW state and compute are distributed. Here a strategy is data — a
+list of (param-path regex, PartitionSpec) rules plus batch/activation specs —
+and GSPMD compiles it, instead of each mode being a separate C++ backend.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["PartitionRules", "ShardingStrategy", "data_parallel", "fsdp",
+           "tensor_parallel", "make_param_sharding", "infer_rules_for_block"]
+
+
+class PartitionRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins.
+
+    Analog of the reference's per-key sharding decisions in
+    EncodeDefaultKey (ref: src/kvstore/kvstore_dist.h:263) — but declarative
+    and per-parameter-path instead of hashed key ranges.
+    """
+
+    def __init__(self, rules=()):
+        self.rules = [(re.compile(pat), P(*spec) if isinstance(spec, tuple)
+                       else spec) for pat, spec in rules]
+
+    def spec_for(self, path, shape=None):
+        for pat, spec in self.rules:
+            if pat.search(path):
+                if shape is not None:
+                    spec = _fit_spec(spec, shape)
+                return spec
+        return P()
+
+    def __add__(self, other):
+        out = PartitionRules()
+        out.rules = list(self.rules) + list(other.rules)
+        return out
+
+
+def _fit_spec(spec, shape):
+    """Trim a PartitionSpec to the array rank and drop axes that don't divide
+    the dimension (GSPMD requires divisibility; replicate instead)."""
+    parts = list(spec)[:len(shape)]
+    parts += [None] * (len(shape) - len(parts))
+    return P(*parts)
+
+
+class ShardingStrategy:
+    """Bundle of: mesh, param rules, data-batch spec, gradient-reduce axes.
+
+    grad_reduce_axes name the mesh axes over which per-device gradients are
+    summed (≙ the kvstore push reduction). With pure GSPMD jit this happens
+    implicitly; the field documents and drives the shard_map paths.
+    """
+
+    def __init__(self, mesh, param_rules=None, batch_axes=("dp",),
+                 grad_reduce_axes=("dp",), name="custom"):
+        self.mesh = mesh
+        self.param_rules = param_rules or PartitionRules()
+        self.batch_axes = tuple(batch_axes)
+        self.grad_reduce_axes = tuple(grad_reduce_axes)
+        self.name = name
+
+    def param_sharding(self, params):
+        """Map a {path: array-or-shape} dict to NamedShardings."""
+        return make_param_sharding(self.mesh, params, self.param_rules)
+
+    def batch_spec(self, extra=()):
+        return P(self.batch_axes if len(self.batch_axes) > 1
+                 else self.batch_axes[0], *extra)
+
+    def batch_sharding(self):
+        return NamedSharding(getattr(self.mesh, "mesh", self.mesh),
+                             self.batch_spec())
+
+    def __repr__(self):
+        return "ShardingStrategy(%s, batch=%s)" % (self.name,
+                                                   self.batch_axes)
+
+
+def make_param_sharding(mesh, params, rules):
+    raw_mesh = getattr(mesh, "mesh", mesh)
+    out = {}
+    for path, v in params.items():
+        shape = tuple(v.shape) if hasattr(v, "shape") else tuple(v)
+        out[path] = NamedSharding(raw_mesh, rules.spec_for(path, shape))
+    return out
+
+
+def data_parallel(mesh):
+    """Pure DP: replicated params, batch sharded on 'dp'.
+    ≙ kvstore 'device'/'nccl' (ref: src/kvstore/comm.h:451)."""
+    return ShardingStrategy(mesh, PartitionRules(), batch_axes=("dp",),
+                            grad_reduce_axes=("dp",), name="data_parallel")
+
+
+def fsdp(mesh, axis="fsdp", min_size=1024):
+    """ZeRO-3/FSDP: every param sharded on its largest dim over `axis`.
+    ≙ dist kvstore server-held sharded state (ref: kvstore_dist_server.h:155)
+    without the separate server processes."""
+
+    raw_mesh = getattr(mesh, "mesh", mesh)
+
+    class _FsdpRules(PartitionRules):
+        def spec_for(self, path, shape=None):
+            if shape is None or not shape:
+                return P()
+            import numpy as _np
+            if int(_np.prod(shape)) < min_size:
+                return P()
+            n = int(dict(raw_mesh.shape).get(axis, 1))
+            # shard the largest dim divisible by the axis size
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in order:
+                if n and shape[i] % max(n, 1) == 0:
+                    parts = [None] * len(shape)
+                    parts[i] = axis
+                    return P(*parts)
+            return P()
+
+    return ShardingStrategy(mesh, _FsdpRules(), batch_axes=("dp", axis),
+                            grad_reduce_axes=("dp",), name="fsdp")
+
+
+def tensor_parallel(mesh, extra_rules=(), axis="tp"):
+    """Megatron-style TP rules for common layer shapes:
+    - column-parallel then row-parallel pairs for attention/FFN
+    - embedding sharded on vocab
+    Dense weight layout here is (out, in) (ref FullyConnected convention),
+    so column-parallel = shard dim 0, row-parallel = shard dim 1.
+    """
+    rules = PartitionRules(list(extra_rules) + [
+        (r"(qkv|query|key|value|wq|wk|wv|w1|wi|gate|up|expand|fc1)"
+         r".*weight$", (axis, None)),
+        (r"(out_proj|wo|w2|down|proj|fc2|contract).*weight$", (None, axis)),
+        (r"(qkv|query|key|value|wq|wk|wv|w1|wi|gate|up|expand|fc1)"
+         r".*bias$", (axis,)),
+        (r"embed.*weight$", (None, axis)),
+    ])
+    return ShardingStrategy(mesh, rules, batch_axes=("dp",),
+                            grad_reduce_axes=("dp",), name="tensor_parallel")
+
+
+def infer_rules_for_block(block, mesh, strategy="dp"):
+    """Choose rules for a gluon Block by inspecting its parameter paths."""
+    if strategy in ("dp", "data_parallel", "local", "device", "nccl"):
+        return data_parallel(mesh)
+    if strategy in ("fsdp", "zero", "dist_sync"):
+        return fsdp(mesh)
+    if strategy in ("tp", "tensor_parallel"):
+        return tensor_parallel(mesh)
+    raise ValueError("unknown strategy %r" % strategy)

@@ -1,0 +1,419 @@
+"""Flagship distributed model: decoder-only transformer LM, mesh-native.
+
+The reference's largest-scale story is ResNet-152 data-parallel on 256 GPUs
+(ref: example/image-classification/README.md:309); its sequence story is
+bucketed RNNs. This module is the modern capability equivalent: one
+transformer whose training step composes EVERY parallelism axis —
+
+  dp    batch                       (≙ kvstore data parallel)
+  fsdp  sharded params/optimizer    (≙ server-held state, ZeRO)
+  tp    Megatron column/row splits  (psum on row-parallel outputs)
+  sp    ring attention over ICI     (context parallelism)
+  pp    GPipe stages over 'pp'      (≙ group2ctx model parallelism)
+  ep    MoE experts                 (GShard-style dense dispatch)
+
+Two execution modes:
+- GSPMD mode (pp=1): params carry PartitionSpecs, jit compiles, XLA inserts
+  collectives. Attention can be 'local', 'ring' (shard_map ppermute ring)
+  or 'ulysses' (all-to-all head swap).
+- Explicit mode (pp>1): the whole step runs in one shard_map over
+  (pp, dp, sp, tp) with hand-written psum/ppermute — the scaling-book
+  recipe, stage-homogeneous GPipe with microbatching.
+
+RoPE positions, RMSNorm, SwiGLU FFN: bf16-friendly, static shapes, scan
+over layers (single compiled layer body, MXU-sized matmuls).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .ring_attention import ring_attention, blockwise_attention
+from .ulysses import ulysses_attention_local
+from .expert import moe_ffn
+
+__all__ = ["TransformerConfig", "init_params", "apply", "loss_fn",
+           "make_train_step", "param_specs"]
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    ffn_hidden: int = 1376
+    max_seq_len: int = 2048
+    dtype: str = "float32"
+    # parallelism
+    attn_mode: str = "local"          # 'local' | 'ring' | 'ulysses' | 'blockwise'
+    pp: int = 1                        # pipeline stages (>1 = explicit mode)
+    n_microbatch: int = 1
+    # MoE: every `moe_every`-th layer is an expert layer when num_experts > 0
+    num_experts: int = 0
+    moe_k: int = 2
+    causal: bool = True
+
+    @property
+    def head_dim(self):
+        return self.dim // self.n_heads
+
+
+def _rms_norm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _rope(x, positions):
+    """Rotary position embedding. x: [B, H, S, D_h], positions: [S]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return rot.astype(x.dtype)
+
+
+def init_params(key, cfg: TransformerConfig):
+    """Param pytree. Layer params are STACKED on a leading axis: [L, ...]
+    in GSPMD mode, [pp, L/pp, ...] in explicit pipeline mode — the leading
+    axis is scanned (one compiled layer body) and, for pp, mesh-sharded."""
+    dt = jnp.dtype(cfg.dtype)
+    D, H, Dh, F = cfg.dim, cfg.n_heads, cfg.head_dim, cfg.ffn_hidden
+    L = cfg.n_layers
+    keys = jr.split(key, 8)
+
+    def norm(k, shape, fan_in):
+        return (jr.normal(k, shape) * (fan_in ** -0.5)).astype(dt)
+
+    layer = {
+        "ln1": jnp.ones((L, D), dt),
+        "wq": norm(keys[0], (L, D, H, Dh), D),
+        "wk": norm(keys[1], (L, D, H, Dh), D),
+        "wv": norm(keys[2], (L, D, H, Dh), D),
+        "wo": norm(keys[3], (L, H, Dh, D), H * Dh),
+        "ln2": jnp.ones((L, D), dt),
+        "w_gate": norm(keys[4], (L, D, F), D),
+        "w_up": norm(keys[5], (L, D, F), D),
+        "w_down": norm(keys[6], (L, F, D), F),
+    }
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        ek = jr.split(keys[7], 4)
+        layer["moe_router"] = norm(ek[0], (L, D, E), D)
+        layer["moe_w1"] = norm(ek[1], (L, E, D, F), D)
+        layer["moe_w2"] = norm(ek[2], (L, E, F, D), F)
+    if cfg.pp > 1:
+        assert L % cfg.pp == 0, "n_layers must divide pp"
+        layer = {k: v.reshape((cfg.pp, L // cfg.pp) + v.shape[1:])
+                 for k, v in layer.items()}
+    emb_key, out_key = jr.split(jr.fold_in(key, 99))
+    return {
+        "embed": norm(emb_key, (cfg.vocab_size, D), D) * (D ** 0.5),
+        "layers": layer,
+        "ln_f": jnp.ones((D,), dt),
+        "w_out": norm(out_key, (D, cfg.vocab_size), D),
+    }
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpecs matching init_params structure (GSPMD mode).
+    Column-parallel on heads/ffn over 'tp'; fsdp composes by sharding the
+    layer-stack axis? No — fsdp shards the largest non-tp dim via
+    sharding.fsdp rules; here we give the Megatron TP layout."""
+    lead = ("pp",) if cfg.pp > 1 else (None,)
+    lead = lead + ((None,) if cfg.pp > 1 else ())
+
+    def ls(*rest):  # layer-stacked spec
+        return P(*(lead + rest))
+
+    layer = {
+        "ln1": ls(None),
+        "wq": ls(None, "tp", None),
+        "wk": ls(None, "tp", None),
+        "wv": ls(None, "tp", None),
+        "wo": ls("tp", None, None),
+        "ln2": ls(None),
+        "w_gate": ls(None, "tp"),
+        "w_up": ls(None, "tp"),
+        "w_down": ls("tp", None),
+    }
+    if cfg.num_experts > 0:
+        layer["moe_router"] = ls(None, None)
+        layer["moe_w1"] = ls("ep", None, "tp")
+        layer["moe_w2"] = ls("ep", "tp", None)
+    if cfg.pp > 1:
+        # explicit mode indexes embed/w_out with global token ids inside the
+        # shard_map body, so they stay replicated across tp
+        embed_spec, out_spec = P(None, None), P(None, None)
+    else:
+        embed_spec, out_spec = P("tp", None), P(None, "tp")
+    return {
+        "embed": embed_spec,
+        "layers": layer,
+        "ln_f": P(None),
+        "w_out": out_spec,
+    }
+
+
+# --------------------------------------------------------------------------
+# GSPMD mode forward (pp == 1)
+# --------------------------------------------------------------------------
+
+def _attention(cfg, mesh, q, k, v, positions):
+    """q/k/v: [B, S, H, Dh] -> [B, S, H, Dh]. Global arrays (GSPMD mode)."""
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, Dh]
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if cfg.attn_mode == "ring" and mesh is not None:
+        from .ring_attention import ring_self_attention
+        ot = ring_self_attention(qt, kt, vt, mesh, axis_name="sp",
+                                 causal=cfg.causal)
+    elif cfg.attn_mode == "ulysses" and mesh is not None:
+        from .ulysses import ulysses_attention
+        ot = ulysses_attention(qt, kt, vt, mesh, axis_name="sp",
+                               causal=cfg.causal)
+    elif cfg.attn_mode == "blockwise":
+        ot = blockwise_attention(qt, kt, vt, causal=cfg.causal)
+    else:
+        scale = cfg.head_dim ** -0.5
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+        if cfg.causal:
+            S = qt.shape[2]
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(qt.dtype)
+        ot = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.transpose(ot, (0, 2, 1, 3))
+
+
+def _layer_body(cfg, mesh, positions, x, lp):
+    """One transformer layer. x: [B, S, D]; lp: this layer's params."""
+    h = _rms_norm(x, lp["ln1"])
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = jnp.transpose(_rope(jnp.transpose(q, (0, 2, 1, 3)), positions),
+                      (0, 2, 1, 3))
+    k = jnp.transpose(_rope(jnp.transpose(k, (0, 2, 1, 3)), positions),
+                      (0, 2, 1, 3))
+    o = _attention(cfg, mesh, q, k, v, positions)
+    x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+    h = _rms_norm(x, lp["ln2"])
+    if cfg.num_experts > 0:
+        y, aux = moe_ffn(h, lp["moe_router"], lp["moe_w1"], lp["moe_w2"],
+                         k=cfg.moe_k)
+        return x + y, aux
+    g = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w_gate"]))
+    u = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+    return x + jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"]), 0.0
+
+
+def apply(params, tokens, cfg: TransformerConfig, mesh=None,
+          return_aux=False):
+    """Forward: tokens [B, S] int32 -> logits [B, S, V]. GSPMD mode.
+    With return_aux, also returns the summed MoE load-balance loss."""
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = jnp.arange(tokens.shape[1])
+
+    def body(x, lp):
+        x, aux = _layer_body(cfg, mesh, positions, x, lp)
+        return x, aux
+
+    x, auxs = lax.scan(body, x, params["layers"])
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["w_out"])
+    if return_aux:
+        return logits, jnp.sum(auxs)
+    return logits
+
+
+def loss_fn(params, tokens, targets, cfg, mesh=None, aux_weight=0.01):
+    logits, aux = apply(params, tokens, cfg, mesh, return_aux=True)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    if cfg.num_experts > 0:
+        loss = loss + aux_weight * aux  # GShard load-balance pressure
+    return loss
+
+
+# --------------------------------------------------------------------------
+# Explicit SPMD mode (pp > 1): whole step inside one shard_map
+# --------------------------------------------------------------------------
+
+def _layer_body_local(cfg, positions, x, lp):
+    """Per-device layer body used inside shard_map: tp dims of lp are LOCAL
+    shards; row-parallel outputs need psum over 'tp'. Sequence dim of x is
+    the local 'sp' shard; attention uses the ppermute ring."""
+    h = _rms_norm(x, lp["ln1"])
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = jnp.transpose(_rope(jnp.transpose(q, (0, 2, 1, 3)), positions),
+                      (0, 2, 1, 3))
+    kq = jnp.transpose(_rope(jnp.transpose(k, (0, 2, 1, 3)), positions),
+                       (0, 2, 1, 3))
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(kq, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    ot = ring_attention(qt, kt, vt, "sp", causal=cfg.causal,
+                        q_offset=positions[0])
+    o = jnp.transpose(ot, (0, 2, 1, 3))
+    attn_out = lax.psum(jnp.einsum("bshk,hkd->bsd", o, lp["wo"]), "tp")
+    x = x + attn_out
+    h = _rms_norm(x, lp["ln2"])
+    g = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w_gate"]))
+    u = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+    ffn_out = lax.psum(jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"]), "tp")
+    return x + ffn_out
+
+
+def _pipeline_forward_local(cfg, params, tokens):
+    """Inside shard_map over (pp, dp, sp, tp). tokens: [B_local, S_local].
+    GPipe fill-drain over microbatches (pipeline.gpipe_loop); activations
+    rotate over 'pp'."""
+    from .pipeline import gpipe_loop
+    sp_idx = lax.axis_index("sp")
+    B, S_local = tokens.shape
+    M = cfg.n_microbatch
+    assert B % M == 0
+    mb = B // M
+    positions = sp_idx * S_local + jnp.arange(S_local)
+
+    x_all = jnp.take(params["embed"], tokens, axis=0)       # [B, S_l, D]
+    x_mb = x_all.reshape(M, mb, S_local, cfg.dim)
+
+    stage_params = jax.tree_util.tree_map(lambda p: p[0], params["layers"])
+
+    def stage_fn(x):
+        def body(x, lp):
+            return _layer_body_local(cfg, positions, x, lp), None
+        x, _ = lax.scan(body, x, stage_params)
+        return x
+
+    outs = gpipe_loop(stage_fn, x_mb, "pp")
+    x = outs.reshape(B, S_local, cfg.dim)
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["w_out"])
+    return logits
+
+
+def _pipeline_loss_local(cfg, params, tokens, targets):
+    logits = _pipeline_forward_local(cfg, params, tokens)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # mean over local tokens, then over dp & sp shards
+    return lax.pmean(lax.pmean(jnp.mean(ll), "dp"), "sp") * -1.0
+
+
+# --------------------------------------------------------------------------
+# Train-step builders
+# --------------------------------------------------------------------------
+
+def make_train_step(cfg: TransformerConfig, mesh, learning_rate=1e-3):
+    """Return (init_fn, step_fn).
+
+    init_fn(key) -> (params, opt_state) placed on the mesh.
+    step_fn(state, tokens, targets) -> (state, loss): one fused SGD-momentum
+    update. GSPMD mode when cfg.pp == 1, explicit shard_map mode otherwise.
+    """
+    raw_mesh = getattr(mesh, "mesh", mesh)
+    specs = param_specs(cfg)
+
+    def _sharding(spec_tree):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(raw_mesh, s), spec_tree,
+            is_leaf=lambda l: isinstance(l, P))
+
+    param_sh = _sharding(specs)
+
+    def init_fn(key):
+        params = init_params(key, cfg)
+        params = jax.tree_util.tree_map(
+            lambda v, sh: jax.device_put(v, sh), params, param_sh)
+        momentum = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return params, momentum
+
+    if cfg.pp == 1:
+        def loss_of(params, tokens, targets):
+            return loss_fn(params, tokens, targets, cfg, mesh)
+
+        batch_sh = NamedSharding(raw_mesh, P("dp", "sp"))
+
+        @functools.partial(
+            jax.jit,
+            in_shardings=((param_sh, param_sh), batch_sh, batch_sh),
+            out_shardings=((param_sh, param_sh), None),
+            donate_argnums=(0,))
+        def step_fn(state, tokens, targets):
+            params, mom = state
+            loss, grads = jax.value_and_grad(loss_of)(params, tokens,
+                                                      targets)
+            new_mom = jax.tree_util.tree_map(
+                lambda m, g: 0.9 * m + g, mom, grads)
+            new_params = jax.tree_util.tree_map(
+                lambda p, m: p - learning_rate * m, params, new_mom)
+            return (new_params, new_mom), loss
+    else:
+        from jax import shard_map
+        data_spec = P("dp", "sp")
+
+        def spmd_step(params, mom, tokens, targets):
+            def loss_of(ps):
+                return _pipeline_loss_local(cfg, ps, tokens, targets)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            # grads of replicated params need reduction over dp/sp
+            # (shard_map grads are per-device partials on replicated leaves)
+            def reduce_grad(g, spec):
+                # replicated-axis partial grads must be summed; grads of
+                # leaves sharded on an axis are already that shard's grad.
+                # 'pp' matters for embed/w_out/ln_f: only one stage touches
+                # them, the others contribute zero
+                axes = [a for a in ("dp", "sp", "tp", "pp")
+                        if not _spec_mentions(spec, a)]
+                for a in axes:
+                    g = lax.psum(g, a)
+                return g
+
+            grads = jax.tree_util.tree_map(
+                reduce_grad, grads, specs,
+                is_leaf=lambda l: hasattr(l, "shape"))
+            new_mom = jax.tree_util.tree_map(
+                lambda m, g: 0.9 * m + g, mom, grads)
+            new_params = jax.tree_util.tree_map(
+                lambda p, m: p - learning_rate * m, params, new_mom)
+            loss = lax.pmean(lax.pmean(loss, "dp"), "sp")
+            return new_params, new_mom, loss
+
+        smapped = shard_map(
+            spmd_step, mesh=raw_mesh,
+            in_specs=(specs, specs, data_spec, data_spec),
+            out_specs=(specs, specs, P()), check_vma=False)
+
+        @jax.jit
+        def step_fn(state, tokens, targets):
+            params, mom = state
+            new_params, new_mom, loss = smapped(params, mom, tokens, targets)
+            return (new_params, new_mom), loss
+
+    return init_fn, step_fn
+
+
+def _spec_mentions(spec, axis):
+    for part in spec:
+        if part == axis:
+            return True
+        if isinstance(part, (tuple, list)) and axis in part:
+            return True
+    return False

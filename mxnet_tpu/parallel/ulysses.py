@@ -1,0 +1,62 @@
+"""Ulysses sequence parallelism: all-to-all head<->sequence resharding.
+
+Alternative context-parallel scheme to ring attention (DeepSpeed-Ulysses,
+arXiv:2309.14509): instead of rotating K/V, ONE all-to-all converts
+sequence-sharded projections [B, H, S/n, D] into head-sharded full-sequence
+tensors [B, H/n, S, D]; attention is then purely local per head group, and a
+second all-to-all restores sequence sharding. On TPU the all-to-all lowers
+to an ICI all-to-all, efficient on the torus. Requires n_heads % n == 0.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ulysses_attention"]
+
+
+def _local_attention(q, k, v, causal, scale, q_offset=0):
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qp = q_offset + jnp.arange(S)
+        kp = jnp.arange(k.shape[2])
+        s = jnp.where(qp[:, None] >= kp[None, :], s, -jnp.inf)
+    return jnp.einsum("bhqk,bhkd->bhqd", _softmax(s), v)
+
+
+def _softmax(s):
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isneginf(m), 0.0, m)
+    e = jnp.exp(s - m)
+    e = jnp.where(jnp.isneginf(s), 0.0, e)
+    return e / jnp.maximum(e.sum(axis=-1, keepdims=True), 1e-30)
+
+
+def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None):
+    """Inside shard_map: q/k/v [B, H, S_local, D] sequence-sharded on
+    `axis_name` → out [B, H, S_local, D]."""
+    # [B,H,S/n,D] -> all2all over heads -> [B,H/n,S,D]
+    q = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    o = _local_attention(q, k, v, causal, scale)
+    # [B,H/n,S,D] -> back to sequence-sharded [B,H,S/n,D]
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                      scale=None, batch_axis="dp", head_axis="tp"):
+    """shard_map wrapper over full [B, H, S, D] arrays."""
+    from jax import shard_map
+    spec = P(batch_axis, head_axis, axis_name, None)
+    fn = functools.partial(ulysses_attention_local, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return shard_map(fn, mesh=getattr(mesh, "mesh", mesh),
+                     in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)(q, k, v)

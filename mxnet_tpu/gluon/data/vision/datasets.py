@@ -1,0 +1,224 @@
+"""Vision datasets (ref: python/mxnet/gluon/data/vision/datasets.py).
+
+Zero-egress environment: datasets read from a local `root` directory in the
+standard file formats (idx-ubyte for MNIST, python pickles for CIFAR). When
+files are absent and MXTPU_SYNTHETIC_DATA=1 is set, a deterministic
+synthetic set with the right shapes/classes is generated so examples and
+tests run offline.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..dataset import Dataset
+from ....ndarray import array as nd_array
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+def _synth_ok():
+    return os.environ.get("MXTPU_SYNTHETIC_DATA", "0") == "1"
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        x = nd_array(self._data[idx])
+        y = self._label[idx]
+        if self._transform is not None:
+            return self._transform(x, y)
+        return x, y
+
+    def __len__(self):
+        return len(self._label)
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        _, n, h, w = struct.unpack(">IIII", f.read(16))
+        return np.frombuffer(f.read(), np.uint8).reshape(n, h, w, 1)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), np.uint8).astype(np.int32)
+
+
+class MNIST(_DownloadedDataset):
+    """ref: datasets.py MNIST. Looks for train-images-idx3-ubyte[.gz] etc."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+    _shape = (28, 28, 1)
+    _classes = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        img, lab = self._files[self._train]
+        for ext in ("", ".gz"):
+            ip = os.path.join(self._root, img + ext)
+            lp = os.path.join(self._root, lab + ext)
+            if os.path.exists(ip) and os.path.exists(lp):
+                self._data = _read_idx_images(ip)
+                self._label = _read_idx_labels(lp)
+                return
+        if _synth_ok():
+            n = 1024 if self._train else 256
+            rng = np.random.RandomState(0 if self._train else 1)
+            self._data = (rng.rand(n, *self._shape) * 255).astype(np.uint8)
+            self._label = rng.randint(0, self._classes, n).astype(np.int32)
+            return
+        raise IOError(
+            "MNIST files not found under %s (offline build: place the "
+            "idx-ubyte files there, or set MXTPU_SYNTHETIC_DATA=1)"
+            % self._root)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """ref: datasets.py CIFAR10. Reads cifar-10-batches-py pickles."""
+
+    _classes = 10
+    _shape = (32, 32, 3)
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+    def _batch_files(self):
+        base = os.path.join(self._root, "cifar-10-batches-py")
+        if self._train:
+            return [os.path.join(base, "data_batch_%d" % i)
+                    for i in range(1, 6)]
+        return [os.path.join(base, "test_batch")]
+
+    def _label_key(self):
+        return b"labels"
+
+    def _get_data(self):
+        files = self._batch_files()
+        if all(os.path.exists(f) for f in files):
+            datas, labels = [], []
+            for fn in files:
+                with open(fn, "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                datas.append(d[b"data"].reshape(-1, 3, 32, 32)
+                             .transpose(0, 2, 3, 1))
+                labels.extend(d[self._label_key()])
+            self._data = np.concatenate(datas).astype(np.uint8)
+            self._label = np.asarray(labels, np.int32)
+            return
+        if _synth_ok():
+            n = 1024 if self._train else 256
+            rng = np.random.RandomState(2 if self._train else 3)
+            self._data = (rng.rand(n, *self._shape) * 255).astype(np.uint8)
+            self._label = rng.randint(0, self._classes, n).astype(np.int32)
+            return
+        raise IOError("CIFAR files not found under %s (offline build: "
+                      "place cifar-10-batches-py there, or set "
+                      "MXTPU_SYNTHETIC_DATA=1)" % self._root)
+
+
+class CIFAR100(CIFAR10):
+    _classes = 100
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 train=True, fine_label=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _batch_files(self):
+        base = os.path.join(self._root, "cifar-100-python")
+        return [os.path.join(base, "train" if self._train else "test")]
+
+    def _label_key(self):
+        return b"fine_labels" if self._fine else b"coarse_labels"
+
+
+class ImageRecordDataset(Dataset):
+    """Decoded images from a .rec file (ref: datasets.py ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._record)
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+        record = self._record[idx]
+        header, img = unpack_img(record, self._flag)
+        import cv2
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        x = nd_array(img)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(x, label)
+        return x, label
+
+
+class ImageFolderDataset(Dataset):
+    """root/<class>/<image> layout (ref: datasets.py ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None,
+                 exts=(".jpg", ".jpeg", ".png")):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fn in sorted(os.listdir(path)):
+                if fn.lower().endswith(exts):
+                    self.items.append((os.path.join(path, fn), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        import cv2
+        fn, label = self.items[idx]
+        img = cv2.imread(fn, cv2.IMREAD_COLOR if self._flag else
+                         cv2.IMREAD_GRAYSCALE)
+        if self._flag:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        x = nd_array(img if img.ndim == 3 else img[..., None])
+        if self._transform is not None:
+            return self._transform(x, label)
+        return x, label

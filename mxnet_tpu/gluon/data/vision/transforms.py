@@ -1,0 +1,241 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py).
+
+Blocks operating on HWC uint8/float images host-side (numpy/cv2) or on
+device NDArrays. Compose chains them; ToTensor converts HWC uint8 ->
+CHW float32/255 like the reference.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+
+from ...block import Block, HybridBlock
+from ....ndarray import NDArray, array as nd_array
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class Compose(Block):
+    """ref: transforms.py Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = transforms
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return nd_array(_to_np(x).astype(self._dtype))
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (ref: transforms.py ToTensor)."""
+
+    def forward(self, x):
+        img = _to_np(x).astype(np.float32) / 255.0
+        if img.ndim == 3:
+            img = img.transpose(2, 0, 1)
+        elif img.ndim == 4:
+            img = img.transpose(0, 3, 1, 2)
+        return nd_array(img)
+
+
+class Normalize(Block):
+    """(x - mean) / std on CHW images (ref: transforms.py Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32)
+        self._std = np.asarray(std, np.float32)
+
+    def forward(self, x):
+        img = _to_np(x).astype(np.float32)
+        c = img.shape[-3]
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return nd_array((img - mean) / std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+        self._keep = keep_ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        import cv2
+        img = _to_np(x)
+        w, h = self._size
+        if self._keep:
+            ih, iw = img.shape[:2]
+            scale = min(w / iw, h / ih)
+            w, h = int(iw * scale + 0.5), int(ih * scale + 0.5)
+        out = cv2.resize(img, (w, h), interpolation=self._interp)
+        if out.ndim == 2:
+            out = out[..., None]
+        return nd_array(out)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+        self._interp = interpolation
+
+    def forward(self, x):
+        import cv2
+        img = _to_np(x)
+        cw, ch = self._size
+        h, w = img.shape[:2]
+        if h < ch or w < cw:
+            img = cv2.resize(img, (max(w, cw), max(h, ch)),
+                             interpolation=self._interp)
+            h, w = img.shape[:2]
+        y0, x0 = (h - ch) // 2, (w - cw) // 2
+        out = img[y0:y0 + ch, x0:x0 + cw]
+        if out.ndim == 2:
+            out = out[..., None]
+        return nd_array(out)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        import cv2
+        img = _to_np(x)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = _pyrandom.uniform(*self._scale) * area
+            ar = _pyrandom.uniform(*self._ratio)
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if cw <= w and ch <= h:
+                x0 = _pyrandom.randint(0, w - cw)
+                y0 = _pyrandom.randint(0, h - ch)
+                crop = img[y0:y0 + ch, x0:x0 + cw]
+                out = cv2.resize(crop, self._size,
+                                 interpolation=self._interp)
+                if out.ndim == 2:
+                    out = out[..., None]
+                return nd_array(out)
+        return CenterCrop(self._size, self._interp)(nd_array(img))
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        img = _to_np(x)
+        if _pyrandom.random() < self._p:
+            img = img[:, ::-1].copy()
+        return nd_array(img)
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        img = _to_np(x)
+        if _pyrandom.random() < self._p:
+            img = img[::-1].copy()
+        return nd_array(img)
+
+
+class _RandomJitter(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _alpha(self):
+        return 1.0 + _pyrandom.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        return nd_array(_to_np(x).astype(np.float32) * self._alpha())
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        img = _to_np(x).astype(np.float32)
+        coef = np.array([0.299, 0.587, 0.114], np.float32)
+        alpha = self._alpha()
+        gray = (img * coef).sum(-1, keepdims=True)
+        return nd_array(img * alpha + gray.mean() * (1 - alpha))
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        img = _to_np(x).astype(np.float32)
+        coef = np.array([0.299, 0.587, 0.114], np.float32)
+        alpha = self._alpha()
+        gray = (img * coef).sum(-1, keepdims=True)
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (ref: transforms.py RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        img = _to_np(x).astype(np.float32)
+        a = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        rgb = (self._eigvec * a * self._eigval).sum(-1)
+        return nd_array(img + rgb)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        ts = list(self._ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x

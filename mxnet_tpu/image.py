@@ -1,0 +1,388 @@
+"""mx.image: image decode/resize/augment utilities + ImageIter.
+
+ref: python/mxnet/image/image.py. The reference backs these with C++ OpenCV
+ops behind the C ABI (src/io/image_aug_default.cc); here cv2 runs host-side
+(decode/augment is host work on TPU too — the chip only sees ready tensors).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "HorizontalFlipAug",
+           "CastAug", "ColorNormalizeAug", "ResizeAug", "ForceResizeAug",
+           "CenterCropAug", "RandomCropAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "LightingAug",
+           "ColorJitterAug", "CreateAugmenter", "Augmenter", "ImageIter"]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def _np_img(img):
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """ref: image.py imread."""
+    cv2 = _cv2()
+    img = cv2.imread(filename, cv2.IMREAD_COLOR if flag else
+                     cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise IOError("cannot read image %s" % filename)
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[..., None]
+    return nd_array(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """ref: image.py imdecode (src/io JPEG decode via OpenCV)."""
+    cv2 = _cv2()
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().astype(np.uint8)
+    img = cv2.imdecode(np.frombuffer(bytes(buf), np.uint8),
+                       cv2.IMREAD_COLOR if flag else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise IOError("cannot decode image buffer")
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[..., None]
+    return nd_array(img)
+
+
+def imresize(src, w, h, interp=1):
+    cv2 = _cv2()
+    out = cv2.resize(_np_img(src), (w, h), interpolation=interp)
+    if out.ndim == 2:
+        out = out[..., None]
+    return nd_array(out)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to `size` (ref: image.py resize_short)."""
+    img = _np_img(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(img, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    img = _np_img(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != tuple(size):
+        return imresize(img, size[0], size[1], interp)
+    return nd_array(img)
+
+
+def center_crop(src, size, interp=2):
+    img = _np_img(src)
+    h, w = img.shape[:2]
+    cw, ch = size
+    x0, y0 = (w - cw) // 2, (h - ch) // 2
+    return fixed_crop(img, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
+
+
+def random_crop(src, size, interp=2):
+    img = _np_img(src)
+    h, w = img.shape[:2]
+    cw, ch = size
+    x0 = _pyrandom.randint(0, max(0, w - cw))
+    y0 = _pyrandom.randint(0, max(0, h - ch))
+    return fixed_crop(img, x0, y0, min(cw, w), min(ch, h), size, interp), \
+        (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None):
+    img = _np_img(src).astype(np.float32)
+    img -= np.asarray(mean, np.float32)
+    if std is not None:
+        img /= np.asarray(std, np.float32)
+    return nd_array(img)
+
+
+class Augmenter:
+    """ref: image.py Augmenter."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return nd_array(_np_img(src)[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, dtype="float32"):
+        super().__init__(type=dtype)
+        self.dtype = dtype
+
+    def __call__(self, src):
+        return nd_array(_np_img(src).astype(self.dtype))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=list(np.ravel(mean)), std=list(np.ravel(std)))
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return nd_array(_np_img(src).astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _np_img(src).astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        coef = np.array([0.299, 0.587, 0.114], np.float32)
+        gray = (img * coef).sum(-1).mean()
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _np_img(src).astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        coef = np.array([0.299, 0.587, 0.114], np.float32)
+        gray = (img * coef).sum(-1, keepdims=True)
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, 3).astype(np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(-1)
+        return nd_array(_np_img(src).astype(np.float32) + rgb)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self.augs = []
+        if brightness:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        augs = list(self.augs)
+        _pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Standard augmenter list (ref: image.py CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and np.asarray(mean).any():
+        auglist.append(ColorNormalizeAug(mean, std if std is not None
+                                         else np.ones(3)))
+    return auglist
+
+
+class ImageIter:
+    """Python-side flexible image iterator (ref: image.py ImageIter),
+    over .rec or .lst+raw images, applying an augmenter list."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 aug_list=None, label_width=1, **kwargs):
+        from .io.io import DataBatch, DataDesc
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self._shuffle = shuffle
+        aug_keys = ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                    "mean", "std", "brightness", "contrast", "saturation",
+                    "pca_noise", "inter_method")
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
+                                           if k in aug_keys})
+        self._items = []
+        if path_imgrec:
+            from .recordio import MXRecordIO, unpack
+            rec = MXRecordIO(path_imgrec, "r")
+            while True:
+                raw = rec.read()
+                if raw is None:
+                    break
+                self._items.append(("rec", raw))
+        elif path_imglist:
+            import os
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = float(parts[1])
+                    self._items.append(
+                        ("file", (os.path.join(path_root or "", parts[-1]),
+                                  label)))
+        else:
+            raise ValueError("need path_imgrec or path_imglist")
+        self.reset()
+
+    @property
+    def provide_data(self):
+        from .io.io import DataDesc
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        from .io.io import DataDesc
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._order = list(range(len(self._items)))
+        if self._shuffle:
+            _pyrandom.shuffle(self._order)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def _load(self, item):
+        kind, payload = item
+        if kind == "rec":
+            from .recordio import unpack
+            header, buf = unpack(payload)
+            img = imdecode(buf)
+            label = header.label
+        else:
+            fn, label = payload
+            img = imread(fn)
+        for aug in self.auglist:
+            img = aug(img)
+        arr = img.asnumpy()
+        if arr.ndim == 3 and arr.shape[-1] in (1, 3):
+            arr = arr.transpose(2, 0, 1)
+        lab = label if np.isscalar(label) or getattr(label, "ndim", 0) == 0 \
+            else np.asarray(label).ravel()[0]
+        return arr.astype(np.float32), np.float32(lab)
+
+    def next(self):
+        from .io.io import DataBatch
+        n = len(self._order)
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        idxs = [self._order[i % n] for i in range(self._cursor, end)]
+        pad = max(0, end - n)
+        self._cursor = end
+        imgs, labels = zip(*[self._load(self._items[i]) for i in idxs])
+        return DataBatch(data=[nd_array(np.stack(imgs))],
+                         label=[nd_array(np.asarray(labels))], pad=pad)
+
+    def __next__(self):
+        return self.next()

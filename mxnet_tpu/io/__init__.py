@@ -1,0 +1,17 @@
+"""Data IO: iterators feeding the training loop.
+
+TPU-native redesign of the reference's two-layer IO stack (ref: src/io/
+C++ iterators + python/mxnet/io/io.py wrappers): here there is one Python
+layer; heavy decode work runs in a thread pool (the C++ ThreadedParser
+analog, ref: src/io/iter_image_recordio_2.cc:79) and batches are prefetched
+on a background thread (ref: src/io/iter_prefetcher.h) so the accelerator
+never waits on the host. Host->HBM transfer is the jax device_put double
+buffer in PrefetchingIter.
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
+                 LibSVMIter, ResizeIter, PrefetchingIter, MNISTIter)
+from .image_iter import ImageRecordIter
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "LibSVMIter", "ResizeIter", "PrefetchingIter", "MNISTIter",
+           "ImageRecordIter"]

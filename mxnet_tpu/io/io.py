@@ -1,0 +1,418 @@
+"""Core data iterators (ref: python/mxnet/io/io.py)."""
+from __future__ import annotations
+
+import collections
+import threading
+import queue as _queue
+
+import numpy as np
+
+from ..ndarray import NDArray, array as nd_array
+from .. import ndarray as nd
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "LibSVMIter", "ResizeIter", "PrefetchingIter", "MNISTIter"]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
+    """Name/shape/type descriptor (ref: io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One mini-batch (ref: io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [d.shape for d in self.data] if self.data else []
+        lshapes = [l.shape for l in self.label] if self.label else []
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, shapes, lshapes)
+
+
+class DataIter:
+    """Iterator base (ref: io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize to list of (name, numpy) (ref: io/utils.py _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) <= 1:
+            data = collections.OrderedDict(
+                [(default_name, d) for d in data])
+        else:
+            data = collections.OrderedDict(
+                [("_%d_%s" % (i, default_name), d)
+                 for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, list or dict")
+    out = collections.OrderedDict()
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out[k] = np.asarray(v)
+    return list(out.items())
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays with shuffle/pad/discard batch handling
+    (ref: io.py:491 NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        self.cursor = -batch_size
+        self._cache_data = None
+        self._cache_label = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) \
+                % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        start = self.cursor
+        end = min(start + self.batch_size, self.num_data)
+        out = []
+        for _, v in arrays:
+            part = v[self.idx[start:end]]
+            if end - start < self.batch_size:
+                if self.last_batch_handle == "discard":
+                    return None
+                # pad by wrapping from the start
+                padn = self.batch_size - (end - start)
+                part = np.concatenate([part, v[self.idx[:padn]]], axis=0)
+            out.append(nd_array(part))
+        return out
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        data = self._slice(self.data)
+        if data is None:  # discard
+            raise StopIteration
+        label = self._slice(self.label) if self.label else []
+        return DataBatch(data=data, label=label, pad=self.getpad(),
+                         index=None, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV reader (ref: src/io/iter_csv.cc CSVIter). Loads host-side with
+    numpy, slices batches; shapes given by data_shape/label_shape."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = np.zeros((data.shape[0],) + tuple(label_shape),
+                             np.float32)
+        self._iter = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM sparse text format -> dense batches (ref: src/io/iter_libsvm.cc;
+    sparse storage is emulated densely on TPU, SURVEY §7 hard part c)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, label_shape=None, **kwargs):
+        super().__init__(batch_size)
+        feat_dim = int(np.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = np.zeros(feat_dim, np.float32)
+                for kv in parts[1:]:
+                    k, v = kv.split(":")
+                    row[int(k)] = float(v)
+                rows.append(row)
+        data = np.stack(rows).reshape((-1,) + tuple(data_shape))
+        label = np.asarray(labels, np.float32)
+        self._iter = NDArrayIter(data, label, batch_size,
+                                 last_batch_handle="pad")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (ref: io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (ref: io.py:347 PrefetchingIter; C++
+    analog src/io/iter_prefetcher.h). Overlaps host batch prep with device
+    compute — the double-buffer the reference implements with
+    dmlc::ThreadedIter."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        assert len(iters) == 1, "composite prefetch not needed on TPU"
+        self.iter = iters[0]
+        self._depth = prefetch_depth
+        self._queue = _queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._epoch = 0  # generation tag: stale pre-reset batches discarded
+        self._start()
+
+    def _start(self):
+        epoch = self._epoch
+
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put((epoch, None))
+                    return
+                self._queue.put((epoch, batch))
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def reset(self):
+        self._stop.set()
+        # drain so a worker blocked in put() can finish and observe _stop
+        while self._thread is not None and self._thread.is_alive():
+            try:
+                self._queue.get(timeout=0.05)
+            except _queue.Empty:
+                pass
+        while not self._queue.empty():
+            self._queue.get_nowait()
+        self._stop.clear()
+        self._epoch += 1
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        while True:
+            epoch, batch = self._queue.get()
+            if epoch != self._epoch:
+                continue  # stale batch from before a reset
+            if batch is None:
+                raise StopIteration
+            return batch
+
+    def __del__(self):
+        self._stop.set()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format reader (ref: src/io/iter_mnist.cc). Reads the
+    classic ubyte files; flat or (1,28,28) image layout."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, **kwargs):
+        super().__init__(batch_size)
+        with open(image, "rb") as f:
+            magic, n, h, w = np.frombuffer(f.read(16), ">i4")
+            data = np.frombuffer(f.read(), np.uint8).reshape(n, h, w)
+        with open(label, "rb") as f:
+            magic, n2 = np.frombuffer(f.read(8), ">i4")
+            lab = np.frombuffer(f.read(), np.uint8).astype(np.float32)
+        data = data.astype(np.float32) / 255.0
+        data = data.reshape(n, h * w) if flat else data.reshape(n, 1, h, w)
+        self._iter = NDArrayIter(data, lab, batch_size, shuffle=shuffle,
+                                 last_batch_handle="pad")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()

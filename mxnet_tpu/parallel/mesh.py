@@ -85,6 +85,10 @@ def create_mesh(axes=None, devices=None, **axis_sizes):
     n = len(devices)
     if axes is None:
         axes = default_mesh_axes
+    unknown = set(axis_sizes) - set(axes)
+    if unknown:
+        raise ValueError("unknown mesh axes %s; valid axes: %s"
+                         % (sorted(unknown), list(axes)))
     sizes = {a: int(axis_sizes.get(a, 1)) for a in axes}
     explicit = int(_np.prod([s for s in sizes.values()]))
     if n % explicit != 0:

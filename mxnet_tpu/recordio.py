@@ -1,0 +1,237 @@
+"""RecordIO: binary record file format, byte-compatible with dmlc recordio.
+
+TPU-native rewrite of the reference's Python recordio layer
+(ref: python/mxnet/recordio.py, dmlc-core recordio format). The format is
+kept byte-identical so .rec datasets produced for the reference load here
+unchanged: each record is
+
+    uint32 magic = 0xced7230a
+    uint32 lrec  = cflag << 29 | length      (cflag: 0 whole, 1/2/3 split)
+    data[length] padded to a 4-byte boundary
+
+Unlike the reference (C++ dmlc::RecordIOWriter behind the C ABI), this is
+pure Python over buffered file IO — record parsing is not the TPU hot path;
+the batch decode/augment pipeline is where the time goes (see io/).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+_LREC_KIND_BITS = 29
+_LREC_LEN_MASK = (1 << _LREC_KIND_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (ref: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.writable = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        flag = "w" if self.writable else "r"
+        self.flag = flag
+        self.open()
+
+    def _check_pid(self):
+        # reopen after fork, like the reference's pid check
+        if self.pid != os.getpid():
+            self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid()
+        length = len(buf)
+        # no multi-part splitting: records here are written whole (cflag=0);
+        # readers still understand split records produced by dmlc writers
+        self.handle.write(struct.pack("<II", _kMagic,
+                                      length & _LREC_LEN_MASK))
+        self.handle.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def tell(self):
+        return self.handle.tell()
+
+    def read(self):
+        assert not self.writable
+        self._check_pid()
+        parts = []
+        magic_bytes = struct.pack("<I", _kMagic)
+        while True:
+            head = self.handle.read(8)
+            if len(head) < 8:
+                if parts:
+                    raise IOError("truncated split RecordIO record")
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _kMagic:
+                raise IOError("invalid RecordIO magic at offset %d"
+                              % (self.handle.tell() - 8))
+            cflag = lrec >> _LREC_KIND_BITS
+            length = lrec & _LREC_LEN_MASK
+            data = self.handle.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            if parts:
+                # dmlc writers split records wherever the payload contains
+                # kMagic, DROPPING those 4 bytes; readers re-insert the magic
+                # word between parts (dmlc-core recordio semantics)
+                parts.append(magic_bytes)
+            parts.append(data)
+            # cflag: 0 = complete, 1 = start, 2 = middle, 3 = end
+            if cflag in (0, 3):
+                return b"".join(parts)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access .rec with .idx sidecar (ref: MXIndexedRecordIO).
+    idx format: "<key>\\t<byte offset>\\n" per record."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = None
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["fidx"] = None
+        return d
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid()
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# header layout for packed image records (ref: recordio.py IRHeader/_IR_FORMAT)
+IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a (header, bytes) into a record payload (ref: recordio.py pack).
+    flag > 0 means `label` is a float array of that length, stored after the
+    fixed header."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        label = float(header.label)
+        header = header._replace(label=label)
+        return struct.pack(_IR_FORMAT, *header) + s
+    label = np.asarray(header.label, dtype=np.float32)
+    header = header._replace(flag=label.size, label=0.0)
+    return struct.pack(_IR_FORMAT, *header) + label.tobytes() + s
+
+
+def unpack(s):
+    """(header, payload) from a record (ref: recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image array and pack (ref: recordio.py pack_img)."""
+    import cv2
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        encode_params = None
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=1):
+    """(header, BGR image array) from a record (ref: recordio.py unpack_img)."""
+    import cv2
+    header, s = unpack(s)
+    img = cv2.imdecode(np.frombuffer(s, np.uint8), iscolor)
+    return header, img

@@ -1,0 +1,254 @@
+"""Data IO tests (model: tests/python/unittest/test_io.py,
+test_recordio.py, test_gluon_data.py in the reference)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.io import (NDArrayIter, CSVIter, PrefetchingIter, ResizeIter,
+                          ImageRecordIter)
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype("float32")
+    label = np.arange(10).astype("float32")
+    it = NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    # pad wraps around to the beginning
+    np.testing.assert_allclose(batches[-1].data[0].asnumpy()[1:],
+                               data[:2])
+
+
+def test_ndarray_iter_discard_and_reset():
+    data = np.arange(40).reshape(10, 4).astype("float32")
+    it = NDArrayIter(data, None, batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 3
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    data = np.arange(8).astype("float32").reshape(8, 1)
+    it = NDArrayIter(data, None, batch_size=4, shuffle=True)
+    got = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(got.tolist()) == list(range(8))
+
+
+def test_ndarray_iter_dict_input():
+    it = NDArrayIter({"a": np.zeros((6, 2)), "b": np.ones((6, 3))},
+                     batch_size=2)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(10, 3).astype("float32")
+    f = str(tmp_path / "d.csv")
+    np.savetxt(f, data, delimiter=",")
+    it = CSVIter(data_csv=f, data_shape=(3,), batch_size=5)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:5], rtol=1e-6)
+
+
+def test_prefetching_iter():
+    data = np.arange(24).reshape(12, 2).astype("float32")
+    base = NDArrayIter(data, None, batch_size=4)
+    it = PrefetchingIter(base)
+    batches = [b.data[0].asnumpy() for b in it]
+    assert len(batches) == 3
+    it.reset()
+    assert len([b for b in it]) == 3
+
+
+def test_resize_iter():
+    data = np.arange(24).reshape(12, 2).astype("float32")
+    it = ResizeIter(NDArrayIter(data, None, batch_size=4), size=7)
+    assert len(list(it)) == 7
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"abc\x00def"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    assert r.keys == list(range(5))
+
+
+def test_pack_unpack_label_array():
+    h = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, data = recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert data == b"payload"
+    assert h2.id == 7
+
+
+def _write_image_rec(tmp_path, n=8, size=40):
+    import cv2
+    path = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img, quality=90))
+    w.close()
+    return path, idx
+
+
+def test_image_record_iter(tmp_path):
+    path, idx = _write_image_rec(tmp_path)
+    it = ImageRecordIter(path_imgrec=path, path_imgidx=idx,
+                         data_shape=(3, 32, 32), batch_size=4,
+                         shuffle=True, rand_crop=True, rand_mirror=True,
+                         preprocess_threads=2)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert b.label[0].shape == (4,)
+    labels = set()
+    it.reset()
+    for b in it:
+        labels.update(b.label[0].asnumpy().tolist())
+    assert labels <= {0.0, 1.0, 2.0}
+
+
+def test_gluon_dataset_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.random.rand(20, 3).astype("float32")
+    Y = np.arange(20).astype("float32")
+    ds = ArrayDataset(X, Y)
+    assert len(ds) == 20
+    x0, y0 = ds[0]
+    loader = DataLoader(ds, batch_size=6, shuffle=True, last_batch="keep")
+    bs = list(loader)
+    assert len(bs) == 4
+    assert bs[0][0].shape == (6, 3)
+
+
+def test_gluon_dataloader_workers():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.arange(64).reshape(16, 4).astype("float32")
+    ds = ArrayDataset(X)
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    got = np.concatenate([b.asnumpy() for b in loader])
+    np.testing.assert_allclose(got, X)
+
+
+def test_gluon_dataset_transform():
+    from mxnet_tpu.gluon.data import ArrayDataset
+    X = np.ones((4, 2), "float32")
+    Y = np.zeros(4, "float32")
+    ds = ArrayDataset(X, Y).transform(lambda x, y: (x * 2, y + 1))
+    x, y = ds[1]
+    np.testing.assert_allclose(np.asarray(x), [2, 2])
+    assert y == 1
+
+
+def test_vision_synthetic_mnist(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_SYNTHETIC_DATA", "1")
+    from mxnet_tpu.gluon.data.vision import MNIST
+    ds = MNIST(root=str(tmp_path), train=True)
+    assert len(ds) == 1024
+    x, y = ds[0]
+    assert x.shape == (28, 28, 1)
+    assert 0 <= int(y) < 10
+
+
+def test_transforms_chain():
+    from mxnet_tpu.gluon.data.vision import transforms as Tf
+    img = mx.nd.array((np.random.rand(36, 36, 3) * 255).astype("uint8"))
+    tf = Tf.Compose([Tf.Resize(32), Tf.CenterCrop(28), Tf.ToTensor(),
+                     Tf.Normalize(mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))])
+    out = tf(img)
+    assert out.shape == (3, 28, 28)
+
+
+def test_image_imdecode_imresize():
+    import cv2
+    from mxnet_tpu import image as img_mod
+    arr = (np.random.rand(20, 30, 3) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".png", arr)
+    img = img_mod.imdecode(buf.tobytes())
+    assert img.shape == (20, 30, 3)
+    r = img_mod.imresize(img, 15, 10)
+    assert r.shape == (10, 15, 3)
+    s = img_mod.resize_short(img, 10)
+    assert min(s.shape[:2]) == 10
+
+
+def test_mnist_iter(tmp_path):
+    # write tiny idx-ubyte files
+    import struct
+    n, h, w = 32, 8, 8
+    imgs = (np.random.rand(n, h, w) * 255).astype(np.uint8)
+    labs = np.random.randint(0, 10, n).astype(np.uint8)
+    ip, lp = str(tmp_path / "im"), str(tmp_path / "lb")
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, h, w))
+        f.write(imgs.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labs.tobytes())
+    from mxnet_tpu.io import MNISTIter
+    it = MNISTIter(image=ip, label=lp, batch_size=8, shuffle=False)
+    b = next(iter(it))
+    assert b.data[0].shape == (8, 1, 8, 8)
+    np.testing.assert_allclose(b.label[0].asnumpy(), labs[:8])
+
+
+def test_prefetching_iter_reset_mid_epoch():
+    """Regression: reset mid-epoch must not leak pre-reset batches."""
+    data = np.arange(10).reshape(10, 1).astype("float32")
+    it = PrefetchingIter(NDArrayIter(data, None, batch_size=1))
+    for _ in range(3):
+        it.next()
+    it.reset()
+    b = it.next()
+    assert float(b.data[0].asnumpy()[0, 0]) == 0.0
+
+
+def test_create_mesh_unknown_axis_raises():
+    from mxnet_tpu.parallel import create_mesh
+    with pytest.raises(ValueError):
+        create_mesh(tp_size=4)
+
+
+def test_recordio_split_record_rejoin(tmp_path):
+    """Records written split (dmlc-style, magic stripped) rejoin correctly."""
+    import struct as _s
+    path = str(tmp_path / "split.rec")
+    magic = 0xced7230a
+    magic_b = _s.pack("<I", magic)
+    payload = b"AAAA" + magic_b + b"BBBB"   # contains the magic word
+    p1, p2 = b"AAAA", b"BBBB"               # dmlc drops the magic at split
+    with open(path, "wb") as f:
+        for cflag, part in ((1, p1), (3, p2)):
+            f.write(_s.pack("<II", magic, (cflag << 29) | len(part)))
+            f.write(part)
+            f.write(b"\x00" * ((4 - len(part) % 4) % 4))
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payload

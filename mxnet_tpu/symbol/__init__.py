@@ -1,0 +1,10 @@
+"""Symbolic graph API (ref: python/mxnet/symbol/__init__.py)."""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     zeros, ones)
+from . import symbol as _symbol_mod
+from .register import populate as _populate
+
+_populate(globals())
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones"]

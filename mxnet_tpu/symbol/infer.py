@@ -1,0 +1,161 @@
+"""Shape inference over symbol graphs.
+
+TPU-native equivalent of the reference InferShape pass
+(ref: src/executor/infer_graph_attr_pass.cc): forward-propagates shapes in
+topo order. Per-op output shapes come from `jax.eval_shape` of the
+registered pure function (XLA's abstract evaluation does the per-op rules
+the reference registers as FInferShape); unknown PARAMETER shapes are
+deduced first from data shapes via the hint table below (the reference's
+backward-inference for weights).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import registry as _registry
+
+__all__ = ["infer_shape"]
+
+
+def _pairify(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _hint_param_shapes(node, in_shapes):
+    """Deduce parameter-input shapes from the data shape + attrs.
+    in_shapes: {input_name: shape or None}. Returns updates dict."""
+    op = node.op
+    a = node.attrs
+    data = in_shapes.get("x") or in_shapes.get("data")
+    out = {}
+    if data is None:
+        return out
+    if op == "FullyConnected":
+        nh = int(a.get("num_hidden"))
+        flatten = a.get("flatten", True)
+        in_units = int(_np.prod(data[1:])) if flatten else data[-1]
+        out["weight"] = (nh, in_units)
+        out["bias"] = (nh,)
+    elif op in ("Convolution", "Deconvolution"):
+        kernel = a.get("kernel")
+        nd = len(kernel) if kernel is not None else len(data) - 2
+        kernel = _pairify(kernel, nd)
+        nf = int(a.get("num_filter"))
+        g = int(a.get("num_group", 1))
+        cin = data[1]
+        if op == "Convolution":
+            out["weight"] = (nf, cin // g) + kernel
+        else:
+            out["weight"] = (cin, nf // g) + kernel
+        out["bias"] = (nf,)
+    elif op in ("BatchNorm", "InstanceNorm", "GroupNorm"):
+        axis = int(a.get("axis", 1))
+        c = data[axis % len(data)]
+        for nm in ("gamma", "beta", "moving_mean", "moving_var"):
+            out[nm] = (c,)
+    elif op == "LayerNorm":
+        axis = int(a.get("axis", -1))
+        c = data[axis % len(data)]
+        out["gamma"] = (c,)
+        out["beta"] = (c,)
+    elif op == "Embedding":
+        out["weight"] = (int(a.get("input_dim")), int(a.get("output_dim")))
+    return out
+
+
+def infer_shape(sym, *args, partial=False, **kwargs):
+    """Returns (arg_shapes, out_shapes, aux_shapes) in the list orders of
+    list_arguments()/list_outputs()/list_auxiliary_states()."""
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    known = {}
+    if args:
+        assert len(args) <= len(arg_names)
+        for n, s in zip(arg_names, args):
+            if s is not None:
+                known[n] = tuple(s)
+    for k, v in kwargs.items():
+        if v is not None:
+            known[k] = tuple(v)
+
+    nodes = sym._topo()
+    # shapes per (node id, out_index)
+    shapes = {}
+    for node in nodes:
+        if node.is_variable():
+            s = known.get(node.name) or node._shape or \
+                (tuple(node.attrs["__shape__"])
+                 if "__shape__" in node.attrs else None)
+            shapes[(id(node), 0)] = tuple(s) if s else None
+
+    # pass 1+2: deduce parameter variable shapes from hints, then eval
+    for node in nodes:
+        if node.is_variable():
+            continue
+        input_names = node.attrs.get("__input_names__")
+        in_shapes = {}
+        if input_names:
+            for iname, (src, oi) in zip(input_names, node.inputs):
+                in_shapes[iname] = shapes.get((id(src), oi))
+        hints = _hint_param_shapes(node, in_shapes)
+        if input_names:
+            for iname, (src, oi) in zip(input_names, node.inputs):
+                if shapes.get((id(src), oi)) is None and iname in hints:
+                    shapes[(id(src), oi)] = tuple(hints[iname])
+        # now try abstract eval
+        ins = [shapes.get((id(src), oi)) for src, oi in node.inputs]
+        if any(s is None for s in ins):
+            if partial:
+                for i in range(node.num_outputs):
+                    shapes[(id(node), i)] = None
+                continue
+            missing = [src.name for (src, oi), s in zip(node.inputs, ins)
+                       if s is None]
+            raise ValueError("cannot infer shape for inputs %s of %s(%s)"
+                             % (missing, node.op, node.name))
+        outs = _abstract_eval(node, ins)
+        for i, s in enumerate(outs):
+            shapes[(id(node), i)] = s
+
+    def var_shape(name):
+        for node in nodes:
+            if node.is_variable() and node.name == name:
+                return shapes.get((id(node), 0))
+        return None
+
+    arg_shapes = [var_shape(n) for n in arg_names]
+    aux_shapes = [var_shape(n) for n in aux_names]
+    out_shapes = [shapes.get((id(node), oi)) for node, oi in sym._outputs]
+    return arg_shapes, out_shapes, aux_shapes
+
+
+def _abstract_eval(node, in_shapes):
+    opdef = _registry.get_op(node.op)
+    attrs = {k: v for k, v in node.attrs.items()
+             if not k.startswith("__")}
+    input_names = node.attrs.get("__input_names__")
+    structs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+
+    import inspect as _inspect
+    params = _inspect.signature(opdef.fn).parameters
+    if "key" in params and "key" not in attrs:
+        attrs["key"] = jax.random.PRNGKey(0)
+
+    def fn(*xs):
+        if input_names:
+            kw = dict(zip(input_names, xs))
+            kw.update(attrs)
+            return opdef.fn(**kw)
+        return opdef.fn(*xs, **attrs)
+
+    out = jax.eval_shape(fn, *structs)
+    if isinstance(out, (tuple, list)):
+        return [tuple(o.shape) for o in out]
+    return [tuple(out.shape)]

@@ -1,0 +1,134 @@
+"""Symbol-level op wrappers generated from the functional registry.
+
+Mirrors the reference's import-time symbol wrapper generation
+(ref: python/mxnet/symbol/register.py): every registered op gets a function
+accepting Symbols (positional or by keyword), auto-creating weight/bias
+Variables it needs (reference behavior for missing param inputs), and
+returning a new Symbol node.
+"""
+from __future__ import annotations
+
+import inspect
+
+from ..ops import registry as _registry
+from .symbol import Symbol, _Node, _auto_name, Variable, INPUT_PARAM_NAMES
+
+__all__ = ["populate", "create_symbol_op", "op_input_names"]
+
+_INPUT_CACHE = {}
+
+
+def op_input_names(opdef):
+    """Ordered tensor-input parameter names of an op fn; None if variadic."""
+    if opdef.name in _INPUT_CACHE:
+        return _INPUT_CACHE[opdef.name]
+    sig = inspect.signature(opdef.fn)
+    names = []
+    variadic = False
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            variadic = True
+            break
+        if p.name in INPUT_PARAM_NAMES:
+            names.append(p.name)
+        elif p.name in ("key", "_training"):
+            continue
+        else:
+            # first non-input, non-special param ends the input prefix
+            break
+    res = None if variadic else names
+    _INPUT_CACHE[opdef.name] = res
+    return res
+
+
+def create_symbol_op(op_name, sym_inputs, attrs, name=None):
+    """Build a Symbol node for `op_name` with the given input Symbols."""
+    opdef = _registry.get_op(op_name)
+    node_name = name or _auto_name(opdef.name.lower())
+    inputs = []
+    for s in sym_inputs:
+        assert isinstance(s, Symbol), type(s)
+        assert len(s._outputs) == 1, "op inputs must be single-output symbols"
+        inputs.append(s._outputs[0])
+    node = _Node(opdef.name, node_name, attrs, inputs)
+    from .symbol import _num_outputs_of
+    node.num_outputs = _num_outputs_of(node)
+    return Symbol([(node, 0)])
+
+
+def make_symbol_op_func(opdef, public_name):
+    input_names = op_input_names(opdef)
+
+    def op_func(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        node_name = name or _auto_name(opdef.name.lower())
+        sym_inputs = []
+        attrs = {}
+        if input_names is None:
+            # variadic op: all positional Symbol args are inputs
+            for a in args:
+                if isinstance(a, Symbol):
+                    sym_inputs.append(a)
+                else:
+                    raise TypeError("positional args must be Symbols")
+            for k, v in kwargs.items():
+                if isinstance(v, Symbol):
+                    sym_inputs.append(v)
+                else:
+                    attrs[k] = v
+        else:
+            provided = {}
+            pos = list(args)
+            for iname in input_names:
+                if iname in kwargs:
+                    provided[iname] = kwargs.pop(iname)
+                elif pos:
+                    provided[iname] = pos.pop(0)
+            # remaining kwargs are static attrs
+            for k, v in kwargs.items():
+                if isinstance(v, Symbol):
+                    provided[k] = v
+                else:
+                    attrs[k] = v
+            no_bias = bool(attrs.get("no_bias", False))
+            for iname in input_names:
+                v = provided.get(iname)
+                if v is None:
+                    if iname == "bias" and no_bias:
+                        continue
+                    if iname in ("label",):
+                        v = Variable("%s_%s" % (node_name, iname))
+                    elif iname in ("weight", "bias", "gamma", "beta",
+                                   "moving_mean", "moving_var"):
+                        # auto-created parameter variable (ref behavior)
+                        v = Variable("%s_%s" % (node_name, iname))
+                    else:
+                        continue
+                if not isinstance(v, Symbol):
+                    raise TypeError("input %s must be a Symbol, got %s"
+                                    % (iname, type(v)))
+                provided[iname] = v
+            sym_inputs = [provided[i] for i in input_names if i in provided]
+            attrs["__input_names__"] = [i for i in input_names
+                                        if i in provided]
+        inputs = []
+        for s in sym_inputs:
+            assert len(s._outputs) == 1, \
+                "op inputs must be single-output symbols"
+            inputs.append(s._outputs[0])
+        node = _Node(opdef.name, node_name, attrs, inputs)
+        from .symbol import _num_outputs_of
+        node.num_outputs = _num_outputs_of(node)
+        return Symbol([(node, 0)])
+
+    op_func.__name__ = public_name
+    op_func.__doc__ = opdef.fn.__doc__
+    return op_func
+
+
+def populate(namespace_dict):
+    for name in _registry.list_ops():
+        opdef = _registry.get_op(name)
+        if name not in namespace_dict:
+            namespace_dict[name] = make_symbol_op_func(opdef, name)

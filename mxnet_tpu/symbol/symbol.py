@@ -1,0 +1,349 @@
+"""Symbol: declarative graph nodes compiled to ONE XLA computation at bind.
+
+TPU-native redesign of the reference symbolic layer (ref:
+python/mxnet/symbol/symbol.py, nnvm::Symbol/Graph). The reference interprets
+the bound graph node-by-node through the engine
+(ref: src/executor/graph_executor.cc:1384 RunOps); here `bind` compiles the
+whole graph into a single jitted function — the design SURVEY.md §3.3 calls
+the natural TPU seam ("one CachedOp == one XLA computation"), applied to the
+symbolic API as well.
+
+JSON schema mirrors the reference's nnvm graph json (nodes/arg_nodes/heads,
+ref: Symbol.tojson symbol.py:1364) so architecture checkpoints round-trip
+structurally.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as _np
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+_name_lock = threading.local()
+
+
+def _counter():
+    if not hasattr(_name_lock, "counts"):
+        _name_lock.counts = {}
+    return _name_lock.counts
+
+
+def _auto_name(hint):
+    counts = _counter()
+    idx = counts.get(hint, 0)
+    counts[hint] = idx + 1
+    return "%s%d" % (hint, idx)
+
+
+# parameter names that denote graph inputs (tensor-valued) in op signatures
+INPUT_PARAM_NAMES = (
+    "x", "data", "lhs", "rhs", "weight", "bias", "gamma", "beta",
+    "moving_mean", "moving_var", "label", "grid", "indices", "index",
+    "condition", "a", "b", "mu", "sigma", "low", "high", "lam", "alpha",
+    "loc", "scale", "shape_like", "data1", "data2", "rois", "anchors",
+    "cls_pred", "loc_pred", "parameters", "state", "state_cell",
+)
+
+# aux-state naming convention (BatchNorm moving stats et al.)
+AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean", "running_var")
+
+
+class _Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_shape")
+
+    def __init__(self, op, name, attrs=None, inputs=(), num_outputs=1,
+                 shape=None):
+        self.op = op               # registry op name; None for variables
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs)  # list[(Symbol's node, out_index)]
+        self.num_outputs = num_outputs
+        self._shape = shape        # user-annotated shape for variables
+
+    def is_variable(self):
+        return self.op is None
+
+
+class Symbol:
+    """A (multi-)output handle onto graph nodes (ref: symbol.py Symbol)."""
+
+    def __init__(self, outputs):
+        # outputs: list[(node, out_index)]
+        self._outputs = list(outputs)
+
+    # -- construction helpers ---------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group[%d]" % len(self._outputs))
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            for i, (node, oi) in enumerate(self._outputs):
+                if node.name == idx:
+                    return Symbol([self._outputs[i]])
+            raise ValueError("no output named %r" % idx)
+        out = self._outputs[idx]
+        if isinstance(idx, slice):
+            return Symbol(out)
+        return Symbol([out])
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # -- graph traversal ---------------------------------------------------
+    def _topo(self):
+        seen = {}
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = True
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def list_arguments(self):
+        """Free variables in topo order, aux excluded (ref: symbol.py)."""
+        return [n.name for n in self._topo() if n.is_variable()
+                and not n.name.endswith(AUX_SUFFIXES)]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo() if n.is_variable()
+                and n.name.endswith(AUX_SUFFIXES)]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_variable()]
+
+    def list_outputs(self):
+        names = []
+        for node, oi in self._outputs:
+            if node.num_outputs > 1:
+                names.append("%s_output%d" % (node.name, oi))
+            else:
+                names.append("%s_output" % node.name)
+        return names
+
+    def get_internals(self):
+        outs = []
+        for n in self._topo():
+            if not n.is_variable():
+                for i in range(n.num_outputs):
+                    outs.append((n, i))
+            else:
+                outs.append((n, 0))
+        return Symbol(outs)
+
+    def get_children(self):
+        kids = []
+        for node, _ in self._outputs:
+            kids.extend(node.inputs)
+        return Symbol(kids) if kids else None
+
+    @property
+    def attr_dict(self):
+        return {n.name: dict(n.attrs) for n in self._topo()}
+
+    def attr(self, key):
+        return self._outputs[0][0].attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].attrs.update(
+            {k: str(v) for k, v in kwargs.items()})
+
+    # -- composition --------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        raise NotImplementedError("composition via call is not supported; "
+                                  "pass symbols as op arguments")
+
+    # arithmetic (mirrors ndarray ops on symbols)
+    def __add__(self, other):
+        return _binop("elemwise_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _binop("elemwise_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _binop("_rminus_scalar", None, self, other, swap=True)
+
+    def __mul__(self, other):
+        return _binop("elemwise_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _binop("elemwise_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _binop("_rdiv_scalar", None, self, other, swap=True)
+
+    def __pow__(self, other):
+        return _binop("_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        from .infer import infer_shape as _infer
+        return _infer(self, *args, **kwargs)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        from .infer import infer_shape as _infer
+        return _infer(self, partial=True, *args, **kwargs)
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        dt = _np.float32
+        return ([kwargs.get(a, dt) for a in args], [dt] * len(self._outputs),
+                [dt] * len(self.list_auxiliary_states()))
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        nodes = self._topo()
+        index = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_variable() else n.op,
+                "name": n.name,
+                "attrs": {k: json.dumps(v) for k, v in n.attrs.items()},
+                "inputs": [[index[id(src)], oi, 0] for src, oi in n.inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable()]
+        heads = [[index[id(node)], oi, 0] for node, oi in self._outputs]
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": arg_nodes,
+            "heads": heads,
+            "attrs": {"mxnet_tpu_version": [1, "1.6.0.tpu1"]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- evaluation / binding ----------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        from ..executor import Executor
+        exe = self.bind(ctx, args=kwargs)
+        return exe.forward()
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **kwargs):
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req, **kwargs)
+
+    # convenience used by module/model code
+    def debug_str(self):
+        lines = []
+        for n in self._topo():
+            kind = "Variable" if n.is_variable() else n.op
+            lines.append("%s %s <- %s" % (kind, n.name,
+                                          [s.name for s, _ in n.inputs]))
+        return "\n".join(lines)
+
+
+def _binop(op_name, scalar_op, lhs, rhs, swap=False):
+    from .register import create_symbol_op
+    if isinstance(rhs, Symbol):
+        return create_symbol_op(op_name, [lhs, rhs], {})
+    # scalar path
+    if swap:
+        return create_symbol_op(op_name, [lhs], {"scalar": float(rhs)})
+    return create_symbol_op(scalar_op, [lhs], {"scalar": float(rhs)})
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """ref: symbol.py var/Variable."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = list(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(_np.dtype(dtype))
+    node = _Node(None, name, attrs, shape=tuple(shape) if shape else None)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    g = json.loads(json_str)
+    nodes = []
+    for jn in g["nodes"]:
+        attrs = {k: json.loads(v) if isinstance(v, str) else v
+                 for k, v in jn.get("attrs", {}).items()}
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"], attrs)
+        else:
+            node = _Node(jn["op"], jn["name"], attrs)
+        nodes.append(node)
+    for jn, node in zip(g["nodes"], nodes):
+        node.inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
+        if not node.is_variable():
+            node.num_outputs = _num_outputs_of(node)
+    return Symbol([(nodes[i], oi) for i, oi, _ in g["heads"]])
+
+
+def _num_outputs_of(node):
+    # multi-output ops known to the framework
+    if node.op in ("BatchNorm", "batch_norm"):
+        return 3
+    return 1
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    from .register import create_symbol_op
+    return create_symbol_op("_zeros", [], {"shape": shape, "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    from .register import create_symbol_op
+    return create_symbol_op("_ones", [], {"shape": shape, "dtype": dtype})

@@ -5,41 +5,30 @@ reference's local-process cluster simulation for dist tests
 --launcher local).
 
 The environment may preload an accelerator plugin (sitecustomize on
-PYTHONPATH) and pin JAX_PLATFORMS to it before conftest runs. In that case we
-re-exec pytest once with a clean environment: PYTHONPATH stripped,
-JAX_PLATFORMS=cpu, and the 8-device host-platform flag set before any jax
-import in the child.
+PYTHONPATH) that registers a TPU PJRT backend and pins JAX_PLATFORMS before
+conftest runs. JAX resolves backends lazily, so as long as no computation has
+executed yet we can redirect to an 8-device virtual CPU platform in-process:
+set XLA_FLAGS before the CPU client is created and override the platform via
+jax.config (the env var alone is too late once jax is imported).
+
+NOTE: do NOT os.exec-re-exec pytest from here. pytest's fd-level capture is
+already active while conftest imports, so an exec'd child inherits fds
+pointing at the dead parent's capture tempfiles and every byte of test output
+is silently lost (exit code still propagates, which makes it look like an
+empty-but-green run).
 """
 import os
-import sys
 
 _WANT_FLAG = "--xla_force_host_platform_device_count"
 
-
-def _needs_reexec():
-    if os.environ.get("MXTPU_TEST_CHILD") == "1":
-        return False
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        return True
-    if _WANT_FLAG not in os.environ.get("XLA_FLAGS", ""):
-        return True
-    return False
-
-
-if _needs_reexec():
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)  # drop preloaded accelerator sitecustomize
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " %s=8" % _WANT_FLAG).strip()
-    env["MXTPU_TEST_CHILD"] = "1"
-    os.execve(sys.executable,
-              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if _WANT_FLAG not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " %s=8" % _WANT_FLAG).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as _np  # noqa: E402
 import pytest  # noqa: E402

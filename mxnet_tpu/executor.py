@@ -1,0 +1,363 @@
+"""Executor: a bound symbolic graph compiled to ONE XLA computation.
+
+TPU-native redesign of the reference's GraphExecutor
+(ref: src/executor/graph_executor.cc:388 Init, :78 Forward, :91 Backward;
+python Executor wrapper python/mxnet/executor.py). The reference binds a
+graph by planning memory, attaching per-op engine closures and interpreting
+the topo order through the threaded engine (RunOps graph_executor.cc:1384).
+Here the whole graph is traced once into a jitted function — forward and
+forward+backward each become a single fused XLA program, which is the
+design seam SURVEY.md §3.3 identifies ("one CachedOp == one XLA
+computation"). Memory planning (MXPlanMemory), in-place detection and op
+bulking all fall out of XLA's buffer assignment and fusion instead of
+hand-written passes.
+
+grad_req semantics ('write'/'add'/'null') follow the reference
+(ref: include/mxnet/op_attr_types.h OpReqType, python/mxnet/executor.py).
+Aux states (BatchNorm moving stats) are updated on forward(is_train=True)
+like the reference's stateful BatchNorm (ref: src/operator/nn/batch_norm-inl.h).
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from .context import current_context
+from .ndarray import NDArray
+from .ops import registry as _registry
+
+__all__ = ["Executor"]
+
+_SIG_CACHE = {}
+
+
+def _fn_params(opdef):
+    sp = _SIG_CACHE.get(opdef.name)
+    if sp is None:
+        sig = inspect.signature(opdef.fn)
+        names = set(sig.parameters)
+        has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                         for p in sig.parameters.values())
+        sp = (names, has_var_kw)
+        _SIG_CACHE[opdef.name] = sp
+    return sp
+
+
+def _tuplify(v):
+    if isinstance(v, list):
+        return tuple(_tuplify(x) for x in v)
+    return v
+
+
+class _GraphProgram:
+    """Evaluates a Symbol graph on jax values (the trace body)."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.nodes = symbol._topo()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.heads = list(symbol._outputs)
+
+    def run(self, values, is_train, key):
+        """values: {var_name: jax array}. Returns (outputs, aux_updates)."""
+        vals = {}
+        aux_updates = {}
+        for idx, node in enumerate(self.nodes):
+            if node.is_variable():
+                if node.name not in values:
+                    raise MXNetError("unbound variable %r" % node.name)
+                vals[(id(node), 0)] = values[node.name]
+                continue
+            opdef = _registry.get_op(node.op)
+            pnames, has_var_kw = _fn_params(opdef)
+            attrs = {}
+            for k, v in node.attrs.items():
+                if k.startswith("__"):
+                    continue
+                if has_var_kw or k in pnames:
+                    attrs[k] = _tuplify(v)
+            if "key" in pnames:
+                attrs.setdefault("key", jax.random.fold_in(key, idx))
+            if "_training" in pnames:
+                attrs["_training"] = is_train
+            ins = [vals[(id(src), oi)] for src, oi in node.inputs]
+            input_names = node.attrs.get("__input_names__")
+            if input_names:
+                kw = dict(zip(input_names, ins))
+                kw.update(attrs)
+                out = opdef.fn(**kw)
+            else:
+                out = opdef.fn(*ins, **attrs)
+            raw = list(out) if isinstance(out, (tuple, list)) else [out]
+            for i, o in enumerate(raw):
+                vals[(id(node), i)] = o
+            if node.op in ("BatchNorm", "batch_norm") and is_train \
+                    and not node.attrs.get("use_global_stats", False) \
+                    and input_names:
+                momentum = float(node.attrs.get("momentum", 0.9))
+                name_of = dict(zip(input_names,
+                                   [src.name for src, _ in node.inputs]))
+                batch_mean, batch_var = raw[1], raw[2]
+                for pname, newv in (("moving_mean", batch_mean),
+                                    ("moving_var", batch_var)):
+                    vname = name_of.get(pname)
+                    if vname is not None and vname in values:
+                        aux_updates[vname] = (momentum * values[vname]
+                                              + (1.0 - momentum) * newv)
+        outs = [vals[(id(node), oi)] for node, oi in self.heads]
+        return outs, aux_updates
+
+
+class Executor:
+    """Bound graph with allocated arguments/gradients/aux states."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self._prog = _GraphProgram(symbol)
+        arg_names = self._prog.arg_names
+        aux_names = self._prog.aux_names
+
+        self.arg_dict = self._normalize(args, arg_names, "args")
+        self.aux_dict = self._normalize(aux_states, aux_names, "aux_states",
+                                        allow_none=True)
+        self.grad_dict = self._normalize(args_grad, arg_names, "args_grad",
+                                         allow_none=True, partial_ok=True)
+        self._grad_req = self._normalize_req(grad_req, arg_names)
+        # grads are only computed for float args with a buffer and req!=null
+        self._grad_names = [n for n in arg_names
+                            if self._grad_req.get(n, "null") != "null"
+                            and n in self.grad_dict
+                            and _np.issubdtype(self.arg_dict[n].dtype,
+                                               _np.inexact)]
+        self.outputs = []
+        self._monitor = None
+        self._seed = 0
+
+        self._fwd = jax.jit(self._raw_forward, static_argnums=(0,))
+        self._fwd_bwd = jax.jit(self._raw_forward_backward)
+
+    # -- binding helpers ----------------------------------------------------
+    @staticmethod
+    def _normalize(vals, names, what, allow_none=False, partial_ok=False):
+        if vals is None:
+            if allow_none:
+                return {}
+            raise MXNetError("%s must be provided to bind" % what)
+        if isinstance(vals, dict):
+            out = {}
+            for k, v in vals.items():
+                if k not in names:
+                    continue
+                out[k] = v if isinstance(v, NDArray) else NDArray(
+                    jnp.asarray(v))
+            missing = [n for n in names if n not in out]
+            if missing and not (allow_none or partial_ok):
+                raise MXNetError("missing %s for %s" % (what, missing))
+            return out
+        vals = list(vals)
+        if len(vals) != len(names) and not partial_ok:
+            raise MXNetError("%s length %d != expected %d"
+                             % (what, len(vals), len(names)))
+        out = {}
+        for n, v in zip(names, vals):
+            if v is None:
+                continue
+            out[n] = v if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
+        return out
+
+    @staticmethod
+    def _normalize_req(grad_req, arg_names):
+        if isinstance(grad_req, str):
+            return {n: grad_req for n in arg_names}
+        if isinstance(grad_req, (list, tuple)):
+            return dict(zip(arg_names, grad_req))
+        return dict(grad_req)
+
+    @classmethod
+    def simple_bind(cls, symbol, ctx=None, grad_req="write", type_dict=None,
+                    **kwargs):
+        """Allocate all arguments/grads/aux from inferred shapes
+        (ref: graph_executor.cc:780 SimpleBind)."""
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        for n, s in zip(arg_names, arg_shapes):
+            if s is None:
+                raise MXNetError("cannot infer shape of argument %r" % n)
+            dt = type_dict.get(n, _np.float32)
+            args[n] = NDArray(jnp.zeros(s, dt))
+        aux = {n: NDArray(jnp.zeros(s, type_dict.get(n, _np.float32)))
+               for n, s in zip(aux_names, aux_shapes) if s is not None}
+        req = cls._normalize_req(grad_req, arg_names)
+        grads = {n: NDArray(jnp.zeros_like(args[n]._data))
+                 for n in arg_names
+                 if req.get(n, "null") != "null"
+                 and _np.issubdtype(args[n].dtype, _np.inexact)}
+        return cls(symbol, ctx, args=args, args_grad=grads, grad_req=req,
+                   aux_states=aux)
+
+    # -- compiled bodies ----------------------------------------------------
+    def _values(self, arg_vals, aux_vals):
+        values = dict(zip(self._prog.arg_names, arg_vals))
+        values.update(zip(self._prog.aux_names, aux_vals))
+        return values
+
+    def _raw_forward(self, is_train, key, arg_vals, aux_vals):
+        outs, aux_up = self._prog.run(self._values(arg_vals, aux_vals),
+                                      is_train, key)
+        aux_out = tuple(aux_up.get(n, v) for n, v in
+                        zip(self._prog.aux_names, aux_vals))
+        return tuple(outs), aux_out
+
+    def _raw_forward_backward(self, key, arg_vals, aux_vals, out_grads):
+        grad_names = self._grad_names
+        fixed = {n: v for n, v in self._values(arg_vals, aux_vals).items()
+                 if n not in grad_names}
+        base_vals = dict(zip(self._prog.arg_names, arg_vals))
+
+        def f(gvals):
+            values = dict(fixed)
+            values.update(gvals)
+            outs, aux_up = self._prog.run(values, True, key)
+            aux_out = tuple(aux_up.get(n, v) for n, v in
+                            zip(self._prog.aux_names, aux_vals))
+            return tuple(outs), aux_out
+
+        gvals = {n: base_vals[n] for n in grad_names}
+        (outs, aux_out), vjp = jax.vjp(f, gvals)
+        zero_aux = tuple(jnp.zeros_like(a) for a in aux_out)
+        (grads,) = vjp((tuple(out_grads), zero_aux))
+        return outs, aux_out, grads
+
+    # -- public API ---------------------------------------------------------
+    def _next_key(self):
+        self._seed += 1
+        return jax.random.PRNGKey(self._seed)
+
+    def _arg_vals(self):
+        return tuple(self.arg_dict[n]._data for n in self._prog.arg_names)
+
+    def _aux_vals(self):
+        return tuple(self.aux_dict[n]._data for n in self._prog.aux_names)
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %r" % k)
+            data = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            self.arg_dict[k]._data = data.astype(self.arg_dict[k]._data.dtype)
+        outs, aux_out = self._fwd(bool(is_train), self._next_key(),
+                                  self._arg_vals(), self._aux_vals())
+        if is_train:
+            for n, v in zip(self._prog.aux_names, aux_out):
+                self.aux_dict[n]._data = v
+        self.outputs = [NDArray(o) for o in outs]
+        if self._monitor is not None:
+            for name, arr in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor(name, arr)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Requires a prior forward(is_train=True); recomputes fwd+bwd as one
+        fused XLA program (rematerialisation is cheaper than keeping the
+        interpreter-style per-op buffers of the reference)."""
+        heads = self._prog.heads
+        if out_grads is None:
+            out_grads = [jnp.ones(self.outputs[i].shape,
+                                  self.outputs[i].dtype)
+                         for i in range(len(heads))]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            out_grads = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                         for g in out_grads]
+        outs, aux_out, grads = self._fwd_bwd(
+            self._next_key(), self._arg_vals(), self._aux_vals(),
+            tuple(out_grads))
+        for n, v in zip(self._prog.aux_names, aux_out):
+            self.aux_dict[n]._data = v
+        self.outputs = [NDArray(o) for o in outs]
+        for n in self._grad_names:
+            g = grads[n]
+            req = self._grad_req.get(n, "write")
+            buf = self.grad_dict[n]
+            if req == "add":
+                buf._data = buf._data + g.astype(buf._data.dtype)
+            else:
+                buf._data = g.astype(buf._data.dtype)
+
+    # convenience views matching the reference Executor
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._prog.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._prog.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._prog.aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = jnp.asarray(
+                    v.asnumpy() if isinstance(v, NDArray) else v,
+                    self.arg_dict[k]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg param %r" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._data = jnp.asarray(
+                    v.asnumpy() if isinstance(v, NDArray) else v,
+                    self.aux_dict[k]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux param %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes, sharing parameter values
+        (ref: executor.py Executor.reshape)."""
+        new_shapes = {}
+        for n in self._prog.arg_names:
+            if n in kwargs:
+                new_shapes[n] = kwargs[n]
+            else:
+                new_shapes[n] = self.arg_dict[n].shape
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**new_shapes)
+        args = {}
+        for n, s in zip(self._prog.arg_names, arg_shapes):
+            old = self.arg_dict[n]
+            if tuple(old.shape) == tuple(s):
+                args[n] = old
+            else:
+                args[n] = NDArray(jnp.zeros(s, old.dtype))
+        aux = {}
+        for n, s in zip(self._prog.aux_names, aux_shapes):
+            old = self.aux_dict[n]
+            aux[n] = old if tuple(old.shape) == tuple(s) else NDArray(
+                jnp.zeros(s, old.dtype))
+        grads = {n: NDArray(jnp.zeros_like(args[n]._data))
+                 for n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, args=args, args_grad=grads,
+                        grad_req=self._grad_req, aux_states=aux)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback
+
+    def debug_str(self):
+        return self._symbol.debug_str()

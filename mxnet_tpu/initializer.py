@@ -53,7 +53,8 @@ class Initializer:
         from .ndarray import NDArray
         import jax.numpy as jnp
         if isinstance(arr, NDArray):
-            host = arr.asnumpy()
+            # asnumpy() of a jax buffer is a read-only view; copy for in-place
+            host = _np.array(arr.asnumpy())
             self._init_weight_dispatch(str(desc), host)
             arr._data = jnp.asarray(host)
         else:

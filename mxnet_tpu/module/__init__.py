@@ -1,0 +1,14 @@
+"""Module API: symbolic training interface (ref: python/mxnet/module/).
+
+The reference's layer split (BaseModule -> Module / BucketingModule over
+DataParallelExecutorGroup over Executor) is preserved; execution is one XLA
+computation per bound graph with GSPMD data parallelism over the module's
+contexts.
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["BaseModule", "Module", "BucketingModule",
+           "DataParallelExecutorGroup"]

@@ -1,0 +1,202 @@
+"""Data-parallel execution group.
+
+TPU-native counterpart of DataParallelExecutorGroup
+(ref: python/mxnet/module/executor_group.py:144, decide_slices :282). The
+reference creates one Executor per GPU, slices each batch across them on the
+host, and reduces gradients through kvstore. On TPU the idiomatic design is
+the opposite: ONE compiled executor whose inputs are laid out over a
+`jax.sharding.Mesh` of the bound contexts with the batch axis sharded —
+XLA/GSPMD partitions the single program and inserts the gradient
+all-reduce on ICI, replacing both the host-side slicing loop and the
+kvstore reduce. `decide_slices` is kept because BucketingModule and user
+code consult it for workload partitioning.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..executor import Executor
+from ..io import DataDesc
+from ..ndarray import NDArray
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _as_desc(shapes):
+    out = []
+    for s in shapes or []:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], s[1]
+            out.append(DataDesc(name, tuple(shape)))
+    return out
+
+
+class DataParallelExecutorGroup:
+    """One XLA-partitioned executor over the contexts' device mesh."""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = list(contexts)
+        self.param_names = list(param_names)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.data_shapes = _as_desc(data_shapes)
+        self.label_shapes = _as_desc(label_shapes)
+        self.batch_size = self.data_shapes[0].shape[0]
+        self.slices = self.decide_slices(self.data_shapes)
+
+        devices = []
+        for c in self.contexts:
+            d = c.jax_device()
+            if d not in devices:
+                devices.append(d)
+        self._mesh = None
+        if len(devices) > 1:
+            self._mesh = Mesh(_np.array(devices), ("dp",))
+
+        input_names = {d.name for d in self.data_shapes}
+        input_names |= {d.name for d in self.label_shapes}
+        self._input_names = input_names
+
+        arg_names = symbol.list_arguments()
+        req = {}
+        for name in arg_names:
+            if name in input_names:
+                req[name] = "write" if (inputs_need_grad and
+                                        name not in
+                                        {d.name for d in self.label_shapes}) \
+                    else "null"
+            elif name in self.fixed_param_names or not for_training:
+                req[name] = "null"
+            else:
+                req[name] = grad_req if isinstance(grad_req, str) else \
+                    grad_req.get(name, "write")
+        shapes = {d.name: d.shape for d in self.data_shapes}
+        shapes.update({d.name: d.shape for d in self.label_shapes})
+
+        if shared_group is not None:
+            # share parameter buffers with the donor group (BucketingModule;
+            # ref: executor_group.py shared_group / CachedOp param sharing)
+            donor = shared_group.executor
+            args = {}
+            arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+            for n, s in zip(arg_names, arg_shapes):
+                if n in donor.arg_dict and tuple(
+                        donor.arg_dict[n].shape) == tuple(s):
+                    args[n] = donor.arg_dict[n]
+                else:
+                    args[n] = NDArray(jnp.zeros(s, _np.float32))
+            aux = {}
+            for n, s in zip(symbol.list_auxiliary_states(), aux_shapes):
+                if n in donor.aux_dict and tuple(
+                        donor.aux_dict[n].shape) == tuple(s):
+                    aux[n] = donor.aux_dict[n]
+                else:
+                    aux[n] = NDArray(jnp.zeros(s, _np.float32))
+            grads = {n: NDArray(jnp.zeros_like(args[n]._data))
+                     for n in arg_names
+                     if req.get(n, "null") != "null"
+                     and _np.issubdtype(args[n].dtype, _np.inexact)}
+            self.executor = Executor(symbol, self.contexts[0], args=args,
+                                     args_grad=grads, grad_req=req,
+                                     aux_states=aux)
+        else:
+            self.executor = Executor.simple_bind(
+                symbol, self.contexts[0], grad_req=req, **shapes)
+        self.execs = [self.executor]   # reference exposes one per device
+
+    def decide_slices(self, data_shapes):
+        """Per-context batch ranges (ref: executor_group.py:282). On TPU the
+        split is realised by GSPMD sharding, but the ranges are still the
+        contract for workload partitioning."""
+        n = len(self.contexts)
+        bs = data_shapes[0].shape[0]
+        step = (bs + n - 1) // n
+        slices = []
+        start = 0
+        for _ in range(n):
+            stop = min(start + step, bs)
+            slices.append(slice(start, stop))
+            start = stop
+        return slices
+
+    def _shard(self, value):
+        if self._mesh is None:
+            return value
+        spec = P("dp") if value.ndim >= 1 and \
+            value.shape[0] % self._mesh.size == 0 else P()
+        return jax.device_put(value, NamedSharding(self._mesh, spec))
+
+    # -- data movement ------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for desc, arr in zip(self.data_shapes, data_batch.data):
+            feeds[desc.name] = arr
+        if self.label_shapes and getattr(data_batch, "label", None):
+            for desc, arr in zip(self.label_shapes, data_batch.label):
+                feeds[desc.name] = arr
+        for name, arr in feeds.items():
+            data = arr._data if isinstance(arr, NDArray) else jnp.asarray(
+                _np.asarray(arr))
+            tgt = self.executor.arg_dict[name]
+            data = data.astype(tgt._data.dtype)
+            if data.shape != tgt.shape:
+                raise MXNetError(
+                    "shape mismatch for %r: got %s, bound %s"
+                    % (name, data.shape, tgt.shape))
+            tgt._data = self._shard(data)
+        self.executor.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to call backward")
+        self.executor.backward(out_grads=out_grads)
+
+    # -- views --------------------------------------------------------------
+    def get_outputs(self, merge_multi_context=True):
+        return list(self.executor.outputs)
+
+    def get_params(self, arg_params, aux_params):
+        for n in self.param_names:
+            if n in self.executor.arg_dict:
+                arg_params[n] = self.executor.arg_dict[n].copy()
+        for n, v in self.executor.aux_dict.items():
+            aux_params[n] = v.copy()
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        self.executor.copy_params_from(arg_params, aux_params,
+                                       allow_extra_params=allow_extra)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        return [self.executor.grad_dict.get(d.name)
+                for d in self.data_shapes]
+
+    @property
+    def grad_arrays(self):
+        """grads in param_names order (None where grad_req='null')."""
+        return [self.executor.grad_dict.get(n) for n in self.param_names]
+
+    @property
+    def param_arrays(self):
+        return [self.executor.arg_dict[n] for n in self.param_names
+                if n in self.executor.arg_dict]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        mon.install(self.executor)

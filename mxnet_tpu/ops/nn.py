@@ -252,10 +252,100 @@ def softmax_cross_entropy(data, label):
 def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                    multi_output=False, use_ignore=False, preserve_shape=False,
                    normalization="null", out_grad=False, smooth_alpha=0.0):
-    """Forward = softmax; the custom backward (softmax - onehot(label)) is
-    wired by the symbol layer. ref: src/operator/softmax_output-inl.h."""
+    """Forward = softmax. Backward is the reference's custom gradient
+    (softmax - onehot(label)) * grad_scale, which IGNORES the incoming
+    cotangent unless out_grad=True — this is what makes bare
+    ``backward()`` on a SoftmaxOutput head train the net.
+    ref: src/operator/softmax_output-inl.h (SoftmaxOutputGrad).
+    """
     axis = 1 if multi_output else -1
-    return jax.nn.softmax(data, axis=axis)
+
+    @jax.custom_vjp
+    def core(data, label):
+        return jax.nn.softmax(data, axis=axis)
+
+    def core_fwd(data, label):
+        out = jax.nn.softmax(data, axis=axis)
+        return out, (out, label)
+
+    def core_bwd(res, g):
+        out, label = res
+        num_classes = out.shape[axis]
+        lbl = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lbl, num_classes, axis=axis, dtype=out.dtype)
+        if smooth_alpha:
+            onehot = onehot * (1.0 - smooth_alpha) \
+                + smooth_alpha / (num_classes - 1) * (1.0 - onehot)
+        grad = out - onehot
+        valid = None
+        if use_ignore:
+            keep = (label != ignore_label)
+            grad = grad * jnp.expand_dims(keep, axis).astype(grad.dtype)
+            valid = jnp.sum(keep)
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid":
+            n = valid if valid is not None else label.size
+            grad = grad / jnp.maximum(n, 1).astype(grad.dtype)
+        grad = grad * grad_scale
+        if out_grad:
+            grad = grad * g
+        return grad, jnp.zeros_like(label)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core(data, label)
+
+
+def _regression_head(fwd, grad_fn):
+    """Loss-head pattern shared by the *RegressionOutput ops: forward is the
+    prediction, backward is a fixed (pred, label) -> grad rule scaled by
+    grad_scale / num-outputs-per-example, ignoring the incoming cotangent
+    (ref: src/operator/regression_output-inl.h RegressionBackward,
+    num_output = label.Size()/label.shape_[0])."""
+    def op(data, label, grad_scale=1.0):
+        @jax.custom_vjp
+        def core(data, label):
+            return fwd(data)
+
+        def core_fwd(data, label):
+            out = fwd(data)
+            return out, (out, label)
+
+        def core_bwd(res, g):
+            pred, lbl = res
+            lbl = jnp.reshape(lbl, pred.shape)
+            batch = pred.shape[0] if pred.ndim else 1
+            num_output = max(pred.size // max(batch, 1), 1)
+            grad = grad_fn(pred, lbl) * (grad_scale / num_output)
+            return grad.astype(pred.dtype), jnp.zeros_like(res[1])
+
+        core.defvjp(core_fwd, core_bwd)
+        return core(data, label)
+    return op
+
+
+@register("LinearRegressionOutput", num_inputs=2,
+          aliases=("linear_regression_output",))
+def linear_regression_output(data, label, grad_scale=1.0):
+    """ref: src/operator/regression_output.cc LinearRegressionOutput."""
+    return _regression_head(lambda x: x, lambda p, l: p - l)(
+        data, label, grad_scale)
+
+
+@register("MAERegressionOutput", num_inputs=2,
+          aliases=("mae_regression_output",))
+def mae_regression_output(data, label, grad_scale=1.0):
+    """ref: src/operator/regression_output.cc MAERegressionOutput."""
+    return _regression_head(lambda x: x, lambda p, l: jnp.sign(p - l))(
+        data, label, grad_scale)
+
+
+@register("LogisticRegressionOutput", num_inputs=2,
+          aliases=("logistic_regression_output",))
+def logistic_regression_output(data, label, grad_scale=1.0):
+    """ref: src/operator/regression_output.cc LogisticRegressionOutput."""
+    return _regression_head(jax.nn.sigmoid, lambda p, l: p - l)(
+        data, label, grad_scale)
 
 
 @register("BatchNorm", aliases=("batch_norm",))

@@ -1,0 +1,250 @@
+"""Module + Executor tests, mirroring the reference's
+tests/python/unittest/test_module.py and test_executor.py strategy:
+bind/fit/score round trips, checkpoint format, bucketing, input grads.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp_symbol(num_hidden=32, num_classes=4):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    fc1 = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    act1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act1, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, label, name="softmax")
+
+
+def _toy_data(n=256, dim=10, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.uniform(-1, 1, (dim, classes))
+    x = rng.uniform(-1, 1, (n, dim)).astype("float32")
+    y = np.argmax(x @ w + 0.1 * rng.randn(n, classes), axis=1)
+    return x, y.astype("float32")
+
+
+class TestExecutor:
+    def test_simple_bind_forward_backward(self):
+        out = _mlp_symbol()
+        exe = out.simple_bind(mx.cpu(), data=(8, 10), softmax_label=(8,))
+        assert set(exe.arg_dict) == {"data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias",
+                                     "softmax_label"}
+        rng = np.random.RandomState(0)
+        for n, arr in exe.arg_dict.items():
+            if n not in ("data", "softmax_label"):
+                arr._data = arr._data + rng.uniform(
+                    -0.1, 0.1, arr.shape).astype("float32")
+        x = rng.uniform(size=(8, 10)).astype("float32")
+        y = rng.randint(0, 4, size=(8,)).astype("float32")
+        outs = exe.forward(is_train=True, data=x, softmax_label=y)
+        p = outs[0].asnumpy()
+        assert p.shape == (8, 4)
+        np.testing.assert_allclose(p.sum(axis=1), np.ones(8), rtol=1e-5)
+        exe.backward()
+        # SoftmaxOutput backward: dfc2 = softmax - onehot
+        g = exe.grad_dict["fc2_bias"].asnumpy()
+        onehot = np.eye(4)[y.astype(int)]
+        np.testing.assert_allclose(g, (p - onehot).sum(axis=0), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_grad_req_add_and_null(self):
+        data = sym.Variable("data")
+        out = sym.FullyConnected(data, num_hidden=3, name="fc")
+        exe = out.simple_bind(mx.cpu(), grad_req="add", data=(2, 5))
+        rng = np.random.RandomState(0)
+        exe.arg_dict["fc_weight"]._data = exe.arg_dict["fc_weight"]._data + \
+            rng.uniform(size=(3, 5)).astype("float32")
+        x = rng.uniform(size=(2, 5)).astype("float32")
+        exe.forward(is_train=True, data=x)
+        exe.backward()
+        g1 = exe.grad_dict["fc_weight"].asnumpy().copy()
+        exe.forward(is_train=True, data=x)
+        exe.backward()
+        g2 = exe.grad_dict["fc_weight"].asnumpy()
+        np.testing.assert_allclose(g2, 2 * g1, rtol=1e-5)
+
+    def test_executor_reshape(self):
+        out = _mlp_symbol()
+        exe = out.simple_bind(mx.cpu(), data=(8, 10), softmax_label=(8,))
+        exe2 = exe.reshape(data=(4, 10), softmax_label=(4,))
+        assert exe2.arg_dict["data"].shape == (4, 10)
+        # params shared
+        assert exe2.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]
+        x = np.random.uniform(size=(4, 10)).astype("float32")
+        y = np.zeros((4,), "float32")
+        outs = exe2.forward(is_train=False, data=x, softmax_label=y)
+        assert outs[0].shape == (4, 4)
+
+    def test_symbol_json_roundtrip_exec(self, tmp_path):
+        out = _mlp_symbol()
+        f = str(tmp_path / "net-symbol.json")
+        out.save(f)
+        out2 = sym.load(f)
+        assert out2.list_arguments() == out.list_arguments()
+        exe = out2.simple_bind(mx.cpu(), data=(2, 10), softmax_label=(2,))
+        exe.forward(is_train=False,
+                    data=np.zeros((2, 10), "float32"),
+                    softmax_label=np.zeros((2,), "float32"))
+
+    def test_eval(self):
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        c = a + 2.0 * b
+        exe = c.bind(mx.cpu(), args={"a": mx.nd.array([1.0, 2.0]),
+                                     "b": mx.nd.array([2.0, 3.0])})
+        out = exe.forward()[0].asnumpy()
+        np.testing.assert_allclose(out, [5.0, 8.0], rtol=1e-6)
+
+
+class TestModule:
+    def test_bind_init_forward(self):
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (16, 10))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params(initializer=mx.init.Xavier())
+        assert mod.binded and mod.params_initialized
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(np.random.uniform(size=(16, 10)))],
+            label=[mx.nd.array(np.zeros((16,)))])
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0]
+        assert out.shape == (16, 4)
+
+    def test_fit_accuracy(self):
+        x, y = _toy_data()
+        train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True)
+        val = mx.io.NDArrayIter(x, y, batch_size=32)
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.fit(train, eval_data=val, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                initializer=mx.init.Xavier(),
+                eval_metric="acc", num_epoch=5)
+        score = mod.score(val, "acc")
+        assert score[0][1] > 0.85, score
+
+    def test_module_input_grads(self):
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (4, 10))],
+                 label_shapes=[("softmax_label", (4,))],
+                 inputs_need_grad=True)
+        mod.init_params()
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(np.random.uniform(size=(4, 10)))],
+            label=[mx.nd.array(np.zeros((4,)))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        [dgrad] = mod.get_input_grads()
+        assert dgrad is not None and dgrad.shape == (4, 10)
+        assert float(np.abs(dgrad.asnumpy()).sum()) > 0
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        x, y = _toy_data(n=64)
+        train = mx.io.NDArrayIter(x, y, batch_size=32)
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.fit(train, num_epoch=2,
+                optimizer_params={"learning_rate": 0.1})
+        prefix = str(tmp_path / "mlp")
+        mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0002.params")
+        assert os.path.exists(prefix + "-0002.states")
+
+        mod2 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True)
+        mod2.bind(data_shapes=[("data", (32, 10))],
+                  label_shapes=[("softmax_label", (32,))])
+        mod2.init_optimizer()
+        batch = mx.io.DataBatch(data=[mx.nd.array(x[:32])],
+                                label=[mx.nd.array(y[:32])])
+        mod.forward(batch, is_train=False)
+        mod2.forward(batch, is_train=False)
+        np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                                   mod2.get_outputs()[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_model_save_load_checkpoint_helpers(self, tmp_path):
+        from mxnet_tpu.model import save_checkpoint, load_checkpoint
+        s = _mlp_symbol()
+        arg = {"fc1_weight": mx.nd.array(np.ones((32, 10)))}
+        aux = {}
+        prefix = str(tmp_path / "m")
+        save_checkpoint(prefix, 7, s, arg, aux)
+        s2, arg2, aux2 = load_checkpoint(prefix, 7)
+        assert s2.list_arguments() == s.list_arguments()
+        np.testing.assert_allclose(arg2["fc1_weight"].asnumpy(),
+                                   np.ones((32, 10)))
+
+    def test_multi_context_data_parallel(self):
+        """DP over several contexts = one GSPMD-sharded executor; numerics
+        must match single-device."""
+        x, y = _toy_data(n=64)
+        batch = mx.io.DataBatch(data=[mx.nd.array(x[:32])],
+                                label=[mx.nd.array(y[:32])])
+        outs = []
+        for ctxs in ([mx.cpu(0)], [mx.cpu(0), mx.cpu(1)]):
+            mod = mx.mod.Module(_mlp_symbol(), context=ctxs)
+            mod.bind(data_shapes=[("data", (32, 10))],
+                     label_shapes=[("softmax_label", (32,))])
+            mod.init_params(initializer=mx.init.One())
+            mod.forward(batch, is_train=False)
+            outs.append(mod.get_outputs()[0].asnumpy())
+        np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
+
+    def test_reshape_on_batch_change(self):
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (16, 10))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params()
+        small = mx.io.DataBatch(
+            data=[mx.nd.array(np.zeros((8, 10), "float32"))],
+            label=[mx.nd.array(np.zeros((8,), "float32"))])
+        mod.forward(small, is_train=False)
+        assert mod.get_outputs()[0].shape == (8, 4)
+
+
+class TestBucketingModule:
+    def test_bucketing_fit(self):
+        """Variable-length sequences via buckets (ref:
+        tests/python/train/test_bucketing.py shape)."""
+        buckets = [8, 16]
+        num_classes = 3
+
+        def sym_gen(seq_len):
+            data = sym.Variable("data")
+            label = sym.Variable("softmax_label")
+            pooled = sym.mean(data, axis=1, keepdims=True, name="pool")
+            fc = sym.FullyConnected(pooled, num_hidden=num_classes,
+                                    name="fc")
+            out = sym.SoftmaxOutput(fc, label, name="softmax")
+            return out, ("data",), ("softmax_label",)
+
+        mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                     context=mx.cpu())
+        mod.bind(data_shapes=[("data", (4, 16))],
+                 label_shapes=[("softmax_label", (4,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+
+        rng = np.random.RandomState(0)
+        for seq_len in (16, 8, 16, 8):
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(rng.uniform(size=(4, seq_len)))],
+                label=[mx.nd.array(rng.randint(0, 3, (4,)))],
+                bucket_key=seq_len,
+                provide_data=[mx.io.DataDesc("data", (4, seq_len))],
+                provide_label=[mx.io.DataDesc("softmax_label", (4,))])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            assert mod.get_outputs()[0].shape == (4, 3)
+        # params are shared across buckets
+        assert len(mod._buckets) == 2
+        e16 = mod._buckets[16]._exec_group.executor
+        e8 = mod._buckets[8]._exec_group.executor
+        assert e16.arg_dict["fc_bias"] is e8.arg_dict["fc_bias"]
+        assert e16.arg_dict["fc_weight"] is e8.arg_dict["fc_weight"]

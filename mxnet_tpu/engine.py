@@ -1,0 +1,110 @@
+"""Execution-engine control surface.
+
+TPU-native re-design of the reference's dependency engine
+(ref: src/engine/, include/mxnet/engine.h:117). The reference schedules every
+op through ThreadedEnginePerDevice with read/write variable queues
+(ThreadedVar, src/engine/threaded_engine.h:120-229). On TPU that machinery is
+replaced by JAX's async dispatch + XLA's dataflow ordering:
+
+* ops return immediately with futures (``jax.Array`` is async) — the analog of
+  ``Engine::PushAsync`` returning before the kernel runs;
+* read-after-write ordering is enforced by SSA dataflow inside XLA programs
+  and by the PJRT stream for program-to-program ordering — the analog of the
+  per-var FIFO queues;
+* ``WaitForVar`` ≙ ``block_until_ready`` on one array; ``WaitForAll`` ≙
+  blocking on everything live.
+
+What remains meaningful — and is implemented here — is the *control* surface:
+engine-type selection (NaiveEngine ≙ force-synchronous dispatch for
+debugging), bulking knobs (≙ how many ops a CachedOp fuses into one XLA
+program), and exception semantics (async errors surface at the next sync
+point, mirroring threaded_engine.cc:422-433).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+__all__ = [
+    "engine_type", "is_naive", "set_bulk_size", "bulk_size", "bulk",
+    "wait_for_var", "wait_for_all", "push_sync",
+]
+
+_local = threading.local()
+
+
+def engine_type():
+    """Selected engine kind. ``MXNET_ENGINE_TYPE=NaiveEngine`` (ref:
+    src/engine/engine.cc:32-48) forces synchronous execution: every op blocks
+    until its result is ready — the serial-debugging mode of the reference."""
+    return os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+def is_naive():
+    return engine_type() == "NaiveEngine"
+
+
+def maybe_sync(data):
+    """Called by the op layer after dispatch; blocks under NaiveEngine so
+    errors surface at the faulting op (serial debugging)."""
+    if is_naive():
+        import jax
+        jax.block_until_ready(data)
+    return data
+
+
+_bulk_size = [int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))]
+
+
+def set_bulk_size(size):
+    """Set the op-bulking segment limit (ref: Engine::set_bulk_size,
+    MXNET_EXEC_BULK_EXEC_* env vars, graph_executor.cc:1288 InitOpSegs).
+    Here it bounds how many traced ops a CachedOp compiles into one XLA
+    program segment. Returns the previous value."""
+    prev = _bulk_size[0]
+    _bulk_size[0] = int(size)
+    return prev
+
+
+def bulk_size():
+    return _bulk_size[0]
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scope form of set_bulk_size (ref: python/mxnet/engine.py bulk)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def wait_for_var(arr):
+    """ref: Engine::WaitForVar (include/mxnet/engine.h). Blocks until the
+    array's producing computation is done; raises its deferred error here."""
+    import jax
+    data = getattr(arr, "_data", arr)
+    jax.block_until_ready(data)
+
+
+def wait_for_all():
+    """ref: Engine::WaitForAll. Barrier over all live device work."""
+    import jax
+    try:
+        for d in jax.live_arrays():
+            d.block_until_ready()
+    except AttributeError:
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def push_sync(fn, *args):
+    """Run a host callback synchronously (ref: Engine::PushSync). The
+    threaded scheduling of the reference is unnecessary — JAX dispatch is
+    already async — so this simply invokes and blocks."""
+    out = fn(*args)
+    if out is not None:
+        import jax
+        jax.block_until_ready(out)
+    return out

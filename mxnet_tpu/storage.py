@@ -1,0 +1,69 @@
+"""Device memory / storage introspection.
+
+TPU-native re-design of the reference storage layer (ref: src/storage/,
+include/mxnet/storage.h:36-137). The reference implements its own pooled
+allocators (GPUPooledStorageManager, pooled_storage_manager.h:52) because
+cudaMalloc is slow; on TPU the PJRT runtime owns the HBM allocator (BFC-style
+pooling lives below XLA), so the framework's job is *introspection and
+control*, not reimplementation:
+
+* per-device usage stats (≙ the pool counters the reference keeps),
+* an explicit release hook (≙ ``Storage::ReleaseAll`` / ``MXStorageEmptyCache``)
+  implemented by dropping framework references and forcing a GC,
+* host-side pinned/shared-memory roles are covered by the data-IO stack
+  (gluon DataLoader shared workers).
+"""
+from __future__ import annotations
+
+import gc
+
+__all__ = ["DeviceStats", "stats", "total_bytes_in_use", "release_all",
+           "empty_cache"]
+
+
+class DeviceStats:
+    """Memory stats for one device (≙ the pool counters in
+    src/storage/pooled_storage_manager.h:61-115)."""
+
+    def __init__(self, device, raw):
+        self.device = device
+        self.bytes_in_use = int(raw.get("bytes_in_use", 0))
+        self.peak_bytes_in_use = int(raw.get("peak_bytes_in_use", 0))
+        self.bytes_limit = int(raw.get("bytes_limit", 0))
+        self.num_allocs = int(raw.get("num_allocs", 0))
+        self.largest_alloc_size = int(raw.get("largest_alloc_size", 0))
+        self.raw = dict(raw)
+
+    def __repr__(self):
+        return ("DeviceStats(%s, in_use=%d, peak=%d, limit=%d)"
+                % (self.device, self.bytes_in_use, self.peak_bytes_in_use,
+                   self.bytes_limit))
+
+
+def stats():
+    """Per-device memory stats from PJRT. CPU devices may not report stats;
+    they yield zeroed entries."""
+    import jax
+    out = []
+    for d in jax.devices():
+        try:
+            raw = d.memory_stats() or {}
+        except Exception:
+            raw = {}
+        out.append(DeviceStats(d, raw))
+    return out
+
+
+def total_bytes_in_use():
+    return sum(s.bytes_in_use for s in stats())
+
+
+def release_all():
+    """Drop unreferenced device buffers (ref: Storage::ReleaseAll,
+    include/mxnet/storage.h; MXStorageEmptyCache in the C API). PJRT frees a
+    buffer when its last reference dies, so this forces a collection pass and
+    deletes donated/aliased temporaries."""
+    gc.collect()
+
+
+empty_cache = release_all

@@ -1,0 +1,164 @@
+"""Utility functions: NumPy-semantics scopes, decorators, misc helpers.
+
+ref: python/mxnet/util.py. The reference gates zero-dim/zero-size shape
+support (``set_np_shape``, util.py:53) and the NumPy array namespace
+(``set_np``/``np_array``, util.py:584,364) behind thread-local scopes because
+its legacy C++ shape encoding reserved 0-dim as "unknown". jnp is natively
+NumPy-shaped, so here the scopes only steer *frontend* behavior: which array
+type ops return (classic NDArray vs mx.np ndarray) and shape legality checks
+in the legacy API.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+__all__ = [
+    "makedirs", "get_gpu_count", "get_gpu_memory",
+    "set_np_shape", "is_np_shape", "np_shape", "use_np_shape",
+    "set_np", "reset_np", "np_array", "is_np_array", "use_np_array",
+    "use_np", "set_module", "wraps_safely",
+]
+
+_scope = threading.local()
+
+
+def _get(name, default=False):
+    return getattr(_scope, name, default)
+
+
+def makedirs(d):
+    """ref: util.py:30."""
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    """ref: util.py:40. Counts accelerator devices (TPU chips here)."""
+    import jax
+    return len([d for d in jax.devices() if d.platform != "cpu"])
+
+
+def get_gpu_memory(gpu_dev_id):
+    """ref: util.py:46. (free, total) bytes for one accelerator device."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    d = devs[gpu_dev_id]
+    st = d.memory_stats() or {}
+    total = st.get("bytes_limit", 0)
+    return total - st.get("bytes_in_use", 0), total
+
+
+# -- np_shape scope (ref: util.py:53-227) ------------------------------------
+
+def set_np_shape(active):
+    """Turn on/off zero-dim & zero-size shape semantics in the classic API
+    (ref: util.py:53). Returns the previous state."""
+    prev = _get("np_shape")
+    _scope.np_shape = bool(active)
+    return prev
+
+
+def is_np_shape():
+    """ref: util.py:98."""
+    return _get("np_shape")
+
+
+class _Scope:
+    def __init__(self, name, active):
+        self._name = name
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _get(self._name)
+        setattr(_scope, self._name, self._active)
+        return self
+
+    def __exit__(self, *a):
+        setattr(_scope, self._name, self._prev)
+
+
+def np_shape(active=True):
+    """``with mx.util.np_shape():`` scope (ref: util.py:160)."""
+    return _Scope("np_shape", active)
+
+
+def wraps_safely(wrapped, assigned=functools.WRAPPER_ASSIGNMENTS):
+    """functools.wraps tolerant of missing attrs (ref: util.py:229)."""
+    return functools.wraps(wrapped,
+                           [a for a in assigned if hasattr(wrapped, a)])
+
+
+def use_np_shape(func):
+    """Decorator running ``func`` under np_shape scope (ref: util.py:240).
+    Works on functions and classes."""
+    if isinstance(func, type):
+        for name, m in vars(func).items():
+            if callable(m):
+                setattr(func, name, use_np_shape(m))
+        return func
+
+    @wraps_safely(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+# -- np_array scope (ref: util.py:339-560) -----------------------------------
+
+def np_array(active=True):
+    """Scope: ops create mx.np ndarrays instead of classic NDArrays
+    (ref: util.py:364)."""
+    return _Scope("np_array", active)
+
+
+def is_np_array():
+    """ref: util.py:393."""
+    return _get("np_array")
+
+
+def use_np_array(func):
+    """ref: util.py:416."""
+    if isinstance(func, type):
+        for name, m in vars(func).items():
+            if callable(m):
+                setattr(func, name, use_np_array(m))
+        return func
+
+    @wraps_safely(func)
+    def wrapper(*args, **kwargs):
+        with np_array(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np(func):
+    """np_shape + np_array combined decorator (ref: util.py:498)."""
+    return use_np_shape(use_np_array(func))
+
+
+def set_np(shape=True, array=True):
+    """Globally activate NumPy semantics (ref: util.py:584)."""
+    if array and not shape:
+        raise ValueError("NumPy array semantics require NumPy shape "
+                         "semantics (ref: util.py:594)")
+    set_np_shape(shape)
+    _scope.np_array = bool(array)
+
+
+def reset_np():
+    """ref: util.py:602."""
+    set_np(False, False)
+    _scope.np_array = False
+    _scope.np_shape = False
+
+
+def set_module(module):
+    """Decorator overriding __module__ for docs (ref: util.py:321)."""
+    def deco(obj):
+        if module is not None:
+            obj.__module__ = module
+        return obj
+    return deco

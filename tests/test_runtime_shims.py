@@ -1,0 +1,150 @@
+"""Tests for the runtime control-surface modules: profiler, runtime
+features, engine, storage, util, jit.
+
+Mirrors coverage from the reference's tests/python/unittest/test_profiler.py,
+test_runtime.py, test_engine.py (ref SURVEY.md §4).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert feats.is_enabled("BF16")
+    with pytest.raises(RuntimeError):
+        feats.is_enabled("NO_SUCH_FEATURE")
+    lst = mx.runtime.feature_list()
+    assert any(f.name == "CPU" and f.enabled for f in lst)
+    assert "CPU" in repr(feats)
+
+
+def test_profiler_roundtrip(tmp_path):
+    from mxnet_tpu import profiler
+    fn = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fn, aggregate_stats=True)
+    profiler.set_state("run")
+    assert profiler.is_running()
+    profiler.record_op("test_op", 123.0)
+    d = profiler.Domain("unit")
+    with d.new_task("work"):
+        pass
+    c = d.new_counter("ctr", 5)
+    c += 2
+    c -= 1
+    d.new_marker("m").mark()
+    ev = profiler.Event("ev")
+    ev.start()
+    ev.stop()
+    profiler.pause()
+    assert not profiler.is_running()
+    profiler.resume()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fn) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "test_op" in names
+    assert "ctr" in names
+    table = profiler.dumps()
+    assert "test_op" in table
+
+
+def test_profiler_bad_config():
+    from mxnet_tpu import profiler
+    with pytest.raises(ValueError):
+        profiler.set_config(bogus_key=1)
+    with pytest.raises(ValueError):
+        profiler.set_state("bogus")
+
+
+def test_engine_bulk_and_naive():
+    from mxnet_tpu import engine
+    assert engine.engine_type() == "ThreadedEnginePerDevice"
+    prev = engine.set_bulk_size(30)
+    assert engine.bulk_size() == 30
+    with engine.bulk(5):
+        assert engine.bulk_size() == 5
+    assert engine.bulk_size() == 30
+    engine.set_bulk_size(prev)
+
+    os.environ["MXNET_ENGINE_TYPE"] = "NaiveEngine"
+    try:
+        assert engine.is_naive()
+        a = mx.nd.array([1.0, 2.0])
+        engine.maybe_sync(a._data)
+    finally:
+        del os.environ["MXNET_ENGINE_TYPE"]
+
+    a = mx.nd.array([1.0, 2.0])
+    engine.wait_for_var(a)
+    engine.wait_for_all()
+    assert engine.push_sync(lambda x: x + 1, 1) == 2
+
+
+def test_storage_stats():
+    from mxnet_tpu import storage
+    a = mx.nd.zeros((64, 64))
+    a.wait_to_read()
+    st = storage.stats()
+    assert len(st) >= 1
+    assert all(s.bytes_in_use >= 0 for s in st)
+    assert storage.total_bytes_in_use() >= 0
+    storage.release_all()
+    repr(st[0])
+
+
+def test_util_scopes():
+    from mxnet_tpu import util
+    assert not util.is_np_shape()
+    with util.np_shape(True):
+        assert util.is_np_shape()
+    assert not util.is_np_shape()
+
+    @util.use_np
+    def f():
+        return util.is_np_array() and util.is_np_shape()
+    assert f()
+    assert not util.is_np_array()
+
+    util.set_np()
+    assert util.is_np_array() and util.is_np_shape()
+    util.reset_np()
+    assert not util.is_np_array()
+    with pytest.raises(ValueError):
+        util.set_np(shape=False, array=True)
+    assert util.get_gpu_count() >= 0
+
+
+def test_jit_function():
+    from mxnet_tpu.jit import CachedOp, jit
+
+    @jit
+    def f(a, b):
+        return a * 2 + b
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([3.0, 4.0])
+    out = f(a, b)
+    np.testing.assert_allclose(out.asnumpy(), [5.0, 8.0])
+
+    op = CachedOp(lambda x: x + 1, static_shape=True)
+    np.testing.assert_allclose(op(a).asnumpy(), [2.0, 3.0])
+    with pytest.raises(ValueError):
+        op(mx.nd.zeros((3, 3)))
+
+
+def test_jit_symbol():
+    from mxnet_tpu.jit import CachedOp
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    z = 2 * x + y
+    op = CachedOp(z)
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([10.0, 20.0])
+    out = op(a, b)
+    np.testing.assert_allclose(out.asnumpy(), [12.0, 24.0])

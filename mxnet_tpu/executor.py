@@ -113,6 +113,26 @@ class _GraphProgram:
         return outs, aux_updates
 
 
+class _LazyOutputs:
+    """Sequence view returned by ``forward(is_train=True)``: reading it
+    materializes the deferred forward via ``Executor.outputs``."""
+
+    def __init__(self, exe):
+        self._exe = exe
+
+    def __getitem__(self, i):
+        return self._exe.outputs[i]
+
+    def __len__(self):
+        return len(self._exe.outputs)
+
+    def __iter__(self):
+        return iter(self._exe.outputs)
+
+    def __repr__(self):
+        return repr(self._exe.outputs)
+
+
 class Executor:
     """Bound graph with allocated arguments/gradients/aux states."""
 
@@ -136,7 +156,8 @@ class Executor:
                             and n in self.grad_dict
                             and _np.issubdtype(self.arg_dict[n].dtype,
                                                _np.inexact)]
-        self.outputs = []
+        self._outputs_cache = []
+        self._pending = None
         self._monitor = None
         self._seed = 0
 
@@ -219,6 +240,8 @@ class Executor:
         return tuple(outs), aux_out
 
     def _raw_forward_backward(self, key, arg_vals, aux_vals, out_grads):
+        """out_grads=None means head gradients of ones (built inside the
+        traced program so no separate forward is needed to learn shapes)."""
         grad_names = self._grad_names
         fixed = {n: v for n, v in self._values(arg_vals, aux_vals).items()
                  if n not in grad_names}
@@ -235,7 +258,9 @@ class Executor:
         gvals = {n: base_vals[n] for n in grad_names}
         (outs, aux_out), vjp = jax.vjp(f, gvals)
         zero_aux = tuple(jnp.zeros_like(a) for a in aux_out)
-        (grads,) = vjp((tuple(out_grads), zero_aux))
+        cot = (tuple(jnp.ones_like(o) for o in outs)
+               if out_grads is None else tuple(out_grads))
+        (grads,) = vjp((cot, zero_aux))
         return outs, aux_out, grads
 
     # -- public API ---------------------------------------------------------
@@ -249,17 +274,44 @@ class Executor:
     def _aux_vals(self):
         return tuple(self.aux_dict[n]._data for n in self._prog.aux_names)
 
+    @property
+    def outputs(self):
+        """Materializes a deferred training forward on first access (same
+        PRNG key that backward() will reuse, so numerics agree)."""
+        if self._outputs_cache is None:
+            key, arg_vals, aux_vals = self._pending
+            outs, aux_out = self._fwd(True, key, arg_vals, aux_vals)
+            for n, v in zip(self._prog.aux_names, aux_out):
+                self.aux_dict[n]._data = v
+            self._outputs_cache = [NDArray(o) for o in outs]
+        return self._outputs_cache
+
+    @outputs.setter
+    def outputs(self, value):
+        self._outputs_cache = value
+
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("unknown argument %r" % k)
             data = v._data if isinstance(v, NDArray) else jnp.asarray(v)
             self.arg_dict[k]._data = data.astype(self.arg_dict[k]._data.dtype)
-        outs, aux_out = self._fwd(bool(is_train), self._next_key(),
-                                  self._arg_vals(), self._aux_vals())
+        key = self._next_key()
         if is_train:
-            for n, v in zip(self._prog.aux_names, aux_out):
-                self.aux_dict[n]._data = v
+            # Deferred: backward() runs forward+backward fused as ONE XLA
+            # program with this same key (one graph execution per step, and
+            # dropout masks in the observed outputs match the gradients).
+            # Outputs materialize lazily if read before backward.
+            self._pending = (key, self._arg_vals(), self._aux_vals())
+            self._outputs_cache = None
+            if self._monitor is not None:
+                for name, arr in zip(self._symbol.list_outputs(),
+                                     self.outputs):
+                    self._monitor(name, arr)
+            return _LazyOutputs(self)
+        outs, aux_out = self._fwd(False, key,
+                                  self._arg_vals(), self._aux_vals())
+        self._pending = None
         self.outputs = [NDArray(o) for o in outs]
         if self._monitor is not None:
             for name, arr in zip(self._symbol.list_outputs(), self.outputs):
@@ -267,22 +319,24 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None):
-        """Requires a prior forward(is_train=True); recomputes fwd+bwd as one
-        fused XLA program (rematerialisation is cheaper than keeping the
-        interpreter-style per-op buffers of the reference)."""
-        heads = self._prog.heads
-        if out_grads is None:
-            out_grads = [jnp.ones(self.outputs[i].shape,
-                                  self.outputs[i].dtype)
-                         for i in range(len(heads))]
+        """Requires a prior forward(is_train=True); runs forward+backward as
+        one fused XLA program with the forward's PRNG key (rematerialisation
+        is cheaper than keeping the interpreter-style per-op buffers of the
+        reference)."""
+        if self._pending is not None:
+            key, arg_vals, aux_vals = self._pending
+            self._pending = None
         else:
+            key = self._next_key()
+            arg_vals, aux_vals = self._arg_vals(), self._aux_vals()
+        if out_grads is not None:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            out_grads = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
-                         for g in out_grads]
-        outs, aux_out, grads = self._fwd_bwd(
-            self._next_key(), self._arg_vals(), self._aux_vals(),
-            tuple(out_grads))
+            out_grads = tuple(
+                g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads)
+        outs, aux_out, grads = self._fwd_bwd(key, arg_vals, aux_vals,
+                                             out_grads)
         for n, v in zip(self._prog.aux_names, aux_out):
             self.aux_dict[n]._data = v
         self.outputs = [NDArray(o) for o in outs]

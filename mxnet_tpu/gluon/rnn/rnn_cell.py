@@ -1,0 +1,574 @@
+"""Recurrent cells (ref: python/mxnet/gluon/rnn/rnn_cell.py).
+
+TPU-native re-design: each cell's step is a HybridBlock built from registry
+ops, so an ``unroll`` (or an enclosing hybridized model) traces the whole
+sequence into ONE XLA program — the per-step engine dispatch of the
+reference disappears. For long sequences prefer the fused layers in
+``rnn_layer.py`` (lax.scan → one XLA while loop, O(1) trace size).
+
+Gate semantics match the reference exactly: LSTM [i, f, g, o]
+(rnn_cell.py:428), GRU [r, z, n] with n = tanh(i2h_n + r * h2h_n)
+(rnn_cell.py:554).
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..block import Block, HybridBlock
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Normalize inputs to (list_of_t | merged tensor, axis, batch_size)
+    (ref: rnn_cell.py _format_sequence)."""
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    if isinstance(inputs, (list, tuple)):
+        length = length or len(inputs)
+        batch_size = inputs[0].shape[batch_axis]
+        if merge:
+            data = nd.stack(*inputs, axis=axis)
+            return data, axis, batch_size
+        return list(inputs), axis, batch_size
+    batch_size = inputs.shape[batch_axis]
+    if merge is False:
+        seq = [nd.squeeze(x, axis=axis)
+               for x in nd.split(inputs, num_outputs=inputs.shape[axis],
+                                 axis=axis, squeeze_axis=False)]
+        return seq, axis, batch_size
+    return inputs, axis, batch_size
+
+
+def _mask_sequence_variable_length(data, length, valid_length, time_axis,
+                                   merged):
+    if merged:
+        return nd.SequenceMask(data, sequence_length=valid_length,
+                               use_sequence_length=True, axis=time_axis)
+    outs = nd.SequenceMask(nd.stack(*data, axis=0),
+                           sequence_length=valid_length,
+                           use_sequence_length=True, axis=0)
+    return [nd.squeeze(x, axis=0) for x in
+            nd.split(outs, num_outputs=len(data), axis=0,
+                     squeeze_axis=False)]
+
+
+class RecurrentCell(Block):
+    """Abstract base for RNN cells (ref: rnn_cell.py:125)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        """Reset the step counter (ref: rnn_cell.py reset)."""
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states (ref: rnn_cell.py begin_state)."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called " \
+            "directly. Call the modifier cell instead."
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape=shape, **info, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell over `length` timesteps (ref: rnn_cell.py:252
+        unroll). The python loop disappears into one XLA program when the
+        enclosing computation is traced."""
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
+                                                    False)
+        begin_state = begin_state or self.begin_state(batch_size=batch_size)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [nd.SequenceLast(nd.stack(*ele_list, axis=0),
+                                      sequence_length=valid_length,
+                                      use_sequence_length=True, axis=0)
+                      for ele_list in zip(*all_states)]
+            outputs = _mask_sequence_variable_length(
+                outputs, length, valid_length, axis, False)
+        if merge_outputs is None:
+            merge_outputs = False
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def _get_activation(self, F, inputs, activation, **kwargs):
+        """ref: rnn_cell.py _get_activation."""
+        func = {"tanh": F.tanh, "relu": F.relu,
+                "sigmoid": F.sigmoid, "softsign": F.softsign}.get(activation)
+        if func is not None:
+            return func(inputs)
+        if isinstance(activation, str):
+            return F.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return self.forward(inputs, states)
+
+
+class HybridRecurrentCell(RecurrentCell, HybridBlock):
+    """RecurrentCell whose step is hybrid-traceable (ref: rnn_cell.py:318)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, x, *args):
+        return HybridBlock.forward(self, x, *args)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell: h' = act(W_i2h x + b_i2h + W_h2h h + b_h2h)
+    (ref: rnn_cell.py:327)."""
+
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _shape_hint(self, x, *args):
+        return {self.i2h_weight: (self._hidden_size, x.shape[-1]),
+                self.h2h_weight: (self._hidden_size, self._hidden_size),
+                self.i2h_bias: (self._hidden_size,),
+                self.h2h_bias: (self._hidden_size,)}
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = self._get_activation(F, i2h + h2h, self._activation)
+        return output, [output]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell, gates [i, f, g, o] (ref: rnn_cell.py:428)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None, activation="tanh",
+                 recurrent_activation="sigmoid"):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _shape_hint(self, x, *args):
+        h = self._hidden_size
+        return {self.i2h_weight: (4 * h, x.shape[-1]),
+                self.h2h_weight: (4 * h, h),
+                self.i2h_bias: (4 * h,), self.h2h_bias: (4 * h,)}
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        h = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=4 * h)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * h)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=-1)
+        in_gate = self._get_activation(F, slices[0],
+                                       self._recurrent_activation)
+        forget_gate = self._get_activation(F, slices[1],
+                                           self._recurrent_activation)
+        in_transform = self._get_activation(F, slices[2], self._activation)
+        out_gate = self._get_activation(F, slices[3],
+                                        self._recurrent_activation)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(F, next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell, gates [r, z, n] (ref: rnn_cell.py:554)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None, activation="tanh",
+                 recurrent_activation="sigmoid"):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(3 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(3 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(3 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(3 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _shape_hint(self, x, *args):
+        h = self._hidden_size
+        return {self.i2h_weight: (3 * h, x.shape[-1]),
+                self.h2h_weight: (3 * h, h),
+                self.i2h_bias: (3 * h,), self.h2h_bias: (3 * h,)}
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        h = self._hidden_size
+        prev_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=3 * h)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias, num_hidden=3 * h)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=-1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=-1)
+        reset_gate = self._get_activation(F, i2h_r + h2h_r,
+                                          self._recurrent_activation)
+        update_gate = self._get_activation(F, i2h_z + h2h_z,
+                                           self._recurrent_activation)
+        next_h_tmp = self._get_activation(F, i2h_n + reset_gate * h2h_n,
+                                          self._activation)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells applied in sequence each step (ref: rnn_cell.py:682)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+        self._params.update(cell.collect_params())
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class HybridSequentialRNNCell(HybridRecurrentCell):
+    """Hybrid stack of cells (ref: rnn_cell.py:760)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+        self._params.update(cell.collect_params())
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(HybridRecurrentCell):
+    """Applies dropout on input each step (ref: rnn_cell.py:835)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert isinstance(rate, (int, float))
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Base for cells that wrap another cell (ref: rnn_cell.py:890)."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified, \
+            "Cell %s is already modified." % base_cell.name
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(),
+                         params=None)
+        self.base_cell = base_cell
+        self.register_child(base_cell, "base_cell")
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def collect_params(self, select=None):
+        return self.base_cell.collect_params(select)
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func or nd.zeros, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (ref: rnn_cell.py:932; Krueger et al. 2016)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout. " \
+            "Apply ZoneoutCell to the cells underneath instead."
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        cell = self.base_cell
+        p_outputs, p_states = self.zoneout_outputs, self.zoneout_states
+        next_output, next_states = cell(inputs, states)
+
+        def mask(p, like):
+            return F.Dropout(F.ones_like(like), p=p)
+
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = F.zeros_like(next_output)
+        output = (F.where(mask(p_outputs, next_output), next_output,
+                          prev_output)
+                  if p_outputs != 0.0 else next_output)
+        new_states = ([F.where(mask(p_states, new_s), new_s, old_s)
+                       for new_s, old_s in zip(next_states, states)]
+                      if p_states != 0.0 else next_states)
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Adds residual connection around the base cell (ref:
+    rnn_cell.py:977)."""
+
+    def __init__(self, base_cell):
+        super().__init__(base_cell)
+
+    def _alias(self):
+        return "residual"
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs, valid_length=valid_length)
+        self.base_cell._modified = True
+        if isinstance(outputs, (list, tuple)):
+            inputs_l, _, _ = _format_sequence(length, inputs, layout, False)
+            outputs = [o + i for o, i in zip(outputs, inputs_l)]
+        else:
+            merged, _, _ = _format_sequence(length, inputs, layout, True)
+            outputs = outputs + merged
+        return outputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Runs l_cell forward and r_cell backward over the sequence and
+    concatenates (ref: rnn_cell.py:1018). Only usable via unroll."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+        self._params.update(l_cell.collect_params())
+        self._params.update(r_cell.collect_params())
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info([self.l_cell, self.r_cell], batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state([self.l_cell, self.r_cell], **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
+                                                    False)
+        reversed_inputs = list(reversed(inputs))
+        begin_state = begin_state or self.begin_state(batch_size=batch_size)
+
+        n_l = len(self.l_cell.state_info(batch_size))
+        l_outputs, l_states = self.l_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state[:n_l],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        r_outputs, r_states = self.r_cell.unroll(
+            length, inputs=reversed_inputs, begin_state=begin_state[n_l:],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        if valid_length is not None:
+            r_outputs = list(reversed(
+                _mask_sequence_variable_length(
+                    list(reversed(r_outputs)), length, valid_length, axis,
+                    False)))
+        r_outputs = list(reversed(r_outputs))
+        outputs = [nd.concat(l_o, r_o, dim=1)
+                   for l_o, r_o in zip(l_outputs, r_outputs)]
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        states = l_states + r_states
+        return outputs, states

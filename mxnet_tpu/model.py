@@ -82,20 +82,16 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         updater(index, g, w)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    remove_amp_cast=True):
-    """ref: model.py:394. Writes prefix-symbol.json + prefix-%04d.params."""
-    if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+def pack_params(arg_params, aux_params):
+    """Build the ``arg:``/``aux:``-prefixed checkpoint dict — the single
+    definition of the param-file key convention (ref: model.py:394)."""
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    return save_dict
 
 
-def load_params(prefix, epoch):
-    """ref: model.py load_params — params only."""
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+def unpack_params(save_dict, strict=False):
+    """Inverse of pack_params: (arg_params, aux_params)."""
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
         tp, _, name = k.partition(":")
@@ -103,7 +99,23 @@ def load_params(prefix, epoch):
             arg_params[name] = v
         elif tp == "aux":
             aux_params[name] = v
+        elif strict:
+            raise ValueError("invalid param key %r" % (k,))
     return arg_params, aux_params
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """ref: model.py:394. Writes prefix-symbol.json + prefix-%04d.params."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, pack_params(arg_params, aux_params))
+
+
+def load_params(prefix, epoch):
+    """ref: model.py load_params — params only."""
+    return unpack_params(nd.load("%s-%04d.params" % (prefix, epoch)))
 
 
 def load_checkpoint(prefix, epoch):
@@ -135,12 +147,19 @@ class FeedForward:
         self._kwargs = kwargs
         self._module = None
 
-    def _make_module(self, data_names, label_names):
+    def _make_module(self, data_names, label_names, work_load_list=None,
+                     logger=None):
         from .module import Module
         ctx = self.ctx if isinstance(self.ctx, (list, tuple)) or \
             self.ctx is None else [self.ctx]
+        kwargs = {}
+        if logger is not None:
+            kwargs["logger"] = logger
+        if ctx is not None:
+            kwargs["context"] = ctx
         return Module(self.symbol, data_names=data_names,
-                      label_names=label_names, context=ctx)
+                      label_names=label_names,
+                      work_load_list=work_load_list, **kwargs)
 
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -148,12 +167,21 @@ class FeedForward:
             eval_end_callback=None, eval_batch_end_callback=None,
             optimizer_params=None):
         train_data = self._as_iter(X, y)
+        if eval_data is not None and not hasattr(eval_data, "reset"):
+            # (X, y) tuple / arrays, like the reference's _init_eval_iter
+            ex, ey = eval_data if isinstance(eval_data, (tuple, list)) \
+                else (eval_data, None)
+            eval_data = self._as_iter(ex, ey)
         data_names = [d[0] for d in train_data.provide_data]
         label_names = [d[0] for d in train_data.provide_label]
-        mod = self._make_module(data_names, label_names)
+        mod = self._make_module(data_names, label_names,
+                                work_load_list=work_load_list, logger=logger)
         mod.fit(train_data, eval_data=eval_data, eval_metric=eval_metric,
                 epoch_end_callback=epoch_end_callback,
                 batch_end_callback=batch_end_callback, kvstore=kvstore,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                monitor=monitor,
                 optimizer=self.optimizer,
                 optimizer_params=optimizer_params or
                 {"learning_rate": self._kwargs.get("learning_rate", 0.01)},

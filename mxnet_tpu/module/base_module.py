@@ -106,24 +106,19 @@ class BaseModule:
     def save_params(self, fname):
         """ref: base_module.py save_params."""
         from .. import ndarray as nd
+        from ..model import pack_params
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        nd.save(fname, pack_params(arg_params, aux_params))
 
     def load_params(self, fname):
         """ref: base_module.py load_params."""
         from .. import ndarray as nd
-        save_dict = nd.load(fname)
-        arg_params, aux_params = {}, {}
-        for k, value in save_dict.items():
-            arg_type, _, name = k.partition(":")
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
-                raise ValueError("Invalid param file " + fname)
+        from ..model import unpack_params
+        try:
+            arg_params, aux_params = unpack_params(nd.load(fname),
+                                                   strict=True)
+        except ValueError:
+            raise ValueError("Invalid param file " + fname)
         self.set_params(arg_params, aux_params)
 
     # -- evaluation ---------------------------------------------------------

@@ -138,13 +138,18 @@ class Module(BaseModule):
         self._sync_params_from_devices()
         return (self._arg_params, self._aux_params)
 
-    def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False, allow_extra=False):
-        """ref: module.py:242."""
+    _DEFAULT_INIT = object()
+
+    def init_params(self, initializer=_DEFAULT_INIT, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """ref: module.py:242 (signature default Uniform(0.01) there, so
+        params absent from arg_params/aux_params still get initialized —
+        while set_params' explicit initializer=None disables fallback)."""
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
-        if initializer is None and not (arg_params or aux_params):
+        if initializer is Module._DEFAULT_INIT:
             initializer = Uniform(0.01)
 
         if self._arg_params is None:
@@ -156,23 +161,25 @@ class Module(BaseModule):
             self._aux_params = dict(self._exec_group.executor.aux_dict)
 
         def _impl(name, arr, cache):
-            if cache is not None and name in cache:
-                src = cache[name]
-                if src is not arr:
-                    arr._data = src._data.astype(arr._data.dtype).reshape(
-                        arr.shape)
-            elif cache is not None and not allow_missing:
-                raise RuntimeError("%s is not presented" % name)
-            elif initializer is not None:
+            # mirrors the reference's _impl (module.py:267): cached value
+            # wins; a missing name raises unless allow_missing, in which
+            # case (and when no cache was given at all) the initializer runs
+            if cache is not None:
+                if name in cache:
+                    src = cache[name]
+                    if src is not arr:
+                        arr._data = src._data.astype(
+                            arr._data.dtype).reshape(arr.shape)
+                    return
+                if not allow_missing:
+                    raise RuntimeError("%s is not presented" % name)
+            if initializer is not None:
                 initializer(InitDesc(name), arr)
 
-        attrs = self._symbol.attr_dict
         for name, arr in sorted(self._arg_params.items()):
-            desc_cache = arg_params if (arg_params or aux_params) else None
-            _impl(name, arr, desc_cache)
+            _impl(name, arr, arg_params)
         for name, arr in sorted(self._aux_params.items()):
-            desc_cache = aux_params if (arg_params or aux_params) else None
-            _impl(name, arr, desc_cache)
+            _impl(name, arr, aux_params)
 
         self.params_initialized = True
         self._params_dirty = False
@@ -240,22 +247,57 @@ class Module(BaseModule):
             self._aux_params = dict(self._exec_group.executor.aux_dict)
 
     def reshape(self, data_shapes, label_shapes=None):
-        """ref: module.py reshape — rebind executors on new shapes, keeping
-        parameters."""
+        """ref: module.py reshape — switch executors on new shapes, keeping
+        parameters. Executor groups are cached per shape signature (like
+        BucketingModule's per-bucket executors) so alternating batch
+        geometries — e.g. a smaller last batch every epoch — reuse the
+        already-compiled XLA programs instead of retracing."""
         assert self.binded
         arg_params, aux_params = (self._arg_params, self._aux_params) \
             if self.params_initialized else (None, None)
-        self.binded = False
-        self._exec_group = None
-        self.bind(data_shapes, label_shapes,
-                  for_training=self.for_training,
-                  inputs_need_grad=self.inputs_need_grad,
-                  force_rebind=True, grad_req=self._grad_req or "write")
+        if self.params_initialized:
+            self._sync_params_from_devices()
+        old_group = self._exec_group
+
+        if not hasattr(self, "_exec_cache"):
+            self._exec_cache = {}
+        curr_key = (tuple((d.name, tuple(d.shape))
+                          for d in self._data_shapes),
+                    tuple((d.name, tuple(d.shape))
+                          for d in self._label_shapes or []))
+        self._exec_cache[curr_key] = old_group
+
+        new_data = _as_desc(data_shapes)
+        new_label = _as_desc(label_shapes) if label_shapes else []
+        new_key = (tuple((d.name, tuple(d.shape)) for d in new_data),
+                   tuple((d.name, tuple(d.shape)) for d in new_label))
+        cached = self._exec_cache.get(new_key)
+        if cached is not None:
+            self._exec_group = cached
+            self._data_shapes = new_data
+            self._label_shapes = new_label
+        else:
+            self.binded = False
+            self._exec_group = None
+            self.bind(data_shapes, label_shapes,
+                      for_training=self.for_training,
+                      inputs_need_grad=self.inputs_need_grad,
+                      force_rebind=True, grad_req=self._grad_req or "write")
+            self._exec_cache[new_key] = self._exec_group
         if arg_params is not None:
             self._exec_group.set_params(arg_params, aux_params,
                                         allow_extra=True)
             self._sync_params_from_devices()
             self.params_initialized = True
+        if old_group is not None and self._exec_group is not old_group \
+                and self._grad_req == "add":
+            # carry accumulated parameter gradients across the switch
+            old_g = old_group.executor.grad_dict
+            new_g = self._exec_group.executor.grad_dict
+            for n, g in old_g.items():
+                tgt = new_g.get(n)
+                if tgt is not None and tgt.shape == g.shape:
+                    tgt._data = g._data
 
     # -- optimizer ----------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from .registry import register
 
@@ -518,3 +519,144 @@ def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
         fy = (data[:, 1] + gy) * 2 / max(hh - 1, 1) - 1
         return jnp.stack([fx, fy], axis=1)
     raise ValueError(transform_type)
+
+
+# -- fused RNN (ref: src/operator/rnn-inl.h, rnn.cc) -------------------------
+
+_RNN_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
+
+
+def _rnn_unpack_params(parameters, mode, input_size, state_size, num_layers,
+                       ndir):
+    """Split the packed 1-D parameter vector into per-(layer, direction)
+    (w_i2h, w_h2h, b_i2h, b_h2h). Packing order matches the reference /
+    cuDNN: all weights layer-major (direction inner), then all biases
+    (ref: rnn-inl.h GetRnnParamSize)."""
+    g = _RNN_GATES[mode]
+    h = state_size
+    shapes_w, shapes_b = [], []
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else h * ndir
+        for _ in range(ndir):
+            shapes_w.append((g * h, isz))
+            shapes_w.append((g * h, h))
+            shapes_b.append((g * h,))
+            shapes_b.append((g * h,))
+    out, off = [], 0
+    for shp in shapes_w + shapes_b:
+        n = int(_np.prod(shp))
+        out.append(parameters[off:off + n].reshape(shp))
+        off += n
+    nw = len(shapes_w)
+    per = []
+    for i in range(0, nw, 2):
+        per.append((out[i], out[i + 1], out[nw + i], out[nw + i + 1]))
+    return per  # index = layer * ndir + direction
+
+
+def _rnn_cell_step(mode, h_prev, c_prev, i2h, h2h):
+    """One timestep's gate math given precomputed i2h and h2h projections.
+    Gate order matches the reference cells: LSTM [i, f, g, o], GRU
+    [r, z, n] with n = tanh(i2h_n + r * h2h_n)
+    (ref: gluon/rnn/rnn_cell.py:487 LSTMCell, :606 GRUCell)."""
+    hsz = h_prev.shape[-1]
+    if mode in ("rnn_relu", "rnn_tanh"):
+        pre = i2h + h2h
+        h = jax.nn.relu(pre) if mode == "rnn_relu" else jnp.tanh(pre)
+        return h, c_prev
+    if mode == "gru":
+        ir, iz, inn = (i2h[..., :hsz], i2h[..., hsz:2 * hsz],
+                       i2h[..., 2 * hsz:])
+        hr, hz, hn = (h2h[..., :hsz], h2h[..., hsz:2 * hsz],
+                      h2h[..., 2 * hsz:])
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(inn + r * hn)
+        h = (1.0 - z) * n + z * h_prev
+        return h, c_prev
+    if mode == "lstm":
+        pre = i2h + h2h
+        i = jax.nn.sigmoid(pre[..., :hsz])
+        f = jax.nn.sigmoid(pre[..., hsz:2 * hsz])
+        gg = jnp.tanh(pre[..., 2 * hsz:3 * hsz])
+        o = jax.nn.sigmoid(pre[..., 3 * hsz:])
+        c = f * c_prev + i * gg
+        h = o * jnp.tanh(c)
+        return h, c
+    raise ValueError("unknown RNN mode %r" % (mode,))
+
+
+def _rnn_layer_scan(mode, x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse):
+    """Run one direction of one layer over the whole sequence: the i2h
+    projection for ALL timesteps is one large (T*N, I)x(I, G*H) matmul on
+    the MXU; the lax.scan carries only the (N, H) state and does the
+    (N, H)x(H, G*H) h2h matmul per step."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    i2h_all = jnp.einsum("tni,gi->tng", x, w_i2h) + b_i2h
+
+    def step(carry, i2h_t):
+        h_prev, c_prev = carry
+        h2h_t = h_prev @ w_h2h.T + b_h2h
+        h, c = _rnn_cell_step(mode, h_prev, c_prev, i2h_t, h2h_t)
+        return (h, c), h
+
+    (h_last, c_last), hs = jax.lax.scan(step, (h0, c0), i2h_all)
+    if reverse:
+        hs = jnp.flip(hs, axis=0)
+    return hs, h_last, c_last
+
+
+@register("RNN", aliases=("rnn",))
+def rnn_fused(data, parameters, state, state_cell=None, key=None, *,
+              mode="lstm", state_size=None, num_layers=1,
+              bidirectional=False, p=0.0, state_outputs=False,
+              projection_size=None, lstm_state_clip_min=None,
+              lstm_state_clip_max=None, lstm_state_clip_nan=False,
+              use_sequence_length=False, _training=True):
+    """Fused multi-layer (bi)directional RNN (ref: src/operator/rnn-inl.h,
+    the cuDNN-RNN-backed `RNN` op). Layout TNC: data (T, N, I); state
+    (L*D, N, H); packed 1-D `parameters`. Between-layer dropout `p` applies
+    to inputs of layers > 0 during training (ref: rnn-inl.h p semantics).
+
+    TPU mapping: per layer+direction, i2h for the whole sequence is one
+    MXU matmul; a lax.scan carries the recurrent state (compiles to one
+    XLA while loop — no per-step dispatch)."""
+    if projection_size:
+        raise NotImplementedError("LSTMP projection is not supported")
+    if state_size is None:
+        raise ValueError("state_size required")
+    ndir = 2 if bidirectional else 1
+    g = _RNN_GATES[mode]
+    del g
+    per = _rnn_unpack_params(parameters, mode, data.shape[-1], state_size,
+                             num_layers, ndir)
+    x = data
+    h_lasts, c_lasts = [], []
+    for layer in range(num_layers):
+        if layer > 0 and p > 0.0 and _training and key is not None:
+            key, sub = jax.random.split(key)
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(sub, keep, x.shape)
+            x = jnp.where(mask, x / keep, jnp.zeros((), x.dtype))
+        outs = []
+        for d in range(ndir):
+            idx = layer * ndir + d
+            w_i2h, w_h2h, b_i2h, b_h2h = per[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None \
+                else jnp.zeros_like(h0)
+            hs, h_last, c_last = _rnn_layer_scan(
+                mode, x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse=(d == 1))
+            if mode == "lstm" and lstm_state_clip_min is not None:
+                c_last = jnp.clip(c_last, lstm_state_clip_min,
+                                  lstm_state_clip_max)
+            outs.append(hs)
+            h_lasts.append(h_last)
+            c_lasts.append(c_last)
+        x = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
+    out_h = jnp.stack(h_lasts, axis=0)
+    if mode == "lstm":
+        out_c = jnp.stack(c_lasts, axis=0)
+        return (x, out_h, out_c) if state_outputs else x
+    return (x, out_h) if state_outputs else x

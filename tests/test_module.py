@@ -248,3 +248,72 @@ class TestBucketingModule:
         e8 = mod._buckets[8]._exec_group.executor
         assert e16.arg_dict["fc_bias"] is e8.arg_dict["fc_bias"]
         assert e16.arg_dict["fc_weight"] is e8.arg_dict["fc_weight"]
+
+
+def test_init_params_arg_only_initializes_aux():
+    """Regression: init_params(arg_params=...) without aux_params must still
+    run the initializer on aux states (moving_var -> ones, not zeros)."""
+    import numpy as np
+    d = mx.sym.Variable("data")
+    b = mx.sym.BatchNorm(mx.sym.FullyConnected(d, num_hidden=4), name="bn")
+    m = mx.mod.Module(b, label_names=None, context=mx.cpu())
+    m.bind([("data", (2, 8))], for_training=False)
+    m.init_params()
+    args, _ = m.get_params()
+    m2 = mx.mod.Module(b, label_names=None, context=mx.cpu())
+    m2.bind([("data", (2, 8))], for_training=False)
+    m2.init_params(arg_params=dict(args))
+    _, aux = m2.get_params()
+    assert np.allclose(aux["bn_moving_var"].asnumpy(), 1.0)
+    assert np.allclose(aux["bn_moving_mean"].asnumpy(), 0.0)
+
+
+def test_deferred_forward_matches_backward_outputs():
+    """Regression: outputs observed after forward(is_train=True) must match
+    the outputs backward() recomputes (same PRNG key; one fused program)."""
+    import numpy as np
+    d = mx.sym.Variable("data")
+    net = mx.sym.Dropout(mx.sym.FullyConnected(d, num_hidden=8), p=0.5)
+    exe = net.simple_bind(mx.cpu(), data=(4, 6))
+    x = np.random.RandomState(3).randn(4, 6).astype("float32")
+    outs = exe.forward(is_train=True, data=x)
+    o1 = outs[0].asnumpy()
+    exe.backward()
+    o2 = exe.outputs[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
+
+
+def test_reshape_caches_executors():
+    """Regression: alternating batch geometries must reuse cached executor
+    groups instead of rebinding/retracing each flip."""
+    d = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(d, num_hidden=4)
+    m = mx.mod.Module(net, label_names=None, context=mx.cpu())
+    m.bind([("data", (8, 6))], for_training=False)
+    m.init_params()
+    g_a = m._exec_group
+    m.reshape([("data", (5, 6))])
+    g_b = m._exec_group
+    assert g_b is not g_a
+    m.reshape([("data", (8, 6))])
+    assert m._exec_group is g_a
+    m.reshape([("data", (5, 6))])
+    assert m._exec_group is g_b
+
+
+def test_feedforward_eval_tuple_and_callbacks():
+    """Regression: FeedForward.fit must accept eval_data=(X, y) and forward
+    eval/batch callbacks to Module.fit."""
+    import numpy as np
+    x = np.random.RandomState(0).randn(40, 8).astype("float32")
+    y = (x.sum(1) > 0).astype("float32")
+    data = mx.sym.Variable("data")
+    lab = mx.sym.Variable("softmax_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2), lab, name="softmax")
+    hits = []
+    ff = mx.model.FeedForward(net, num_epoch=1)
+    ff.fit(x, y, eval_data=(x, y),
+           eval_end_callback=lambda *a: hits.append("eval"),
+           batch_end_callback=lambda *a: hits.append("batch"))
+    assert "eval" in hits and "batch" in hits

@@ -322,11 +322,8 @@ class GRUCell(HybridRecurrentCell):
         return next_h, [next_h]
 
 
-class SequentialRNNCell(RecurrentCell):
-    """Stack of cells applied in sequence each step (ref: rnn_cell.py:682)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
+class _SequentialCellMixin:
+    """Shared stack behavior for the two sequential cell flavors."""
 
     def add(self, cell):
         self.register_child(cell)
@@ -356,45 +353,17 @@ class SequentialRNNCell(RecurrentCell):
 
     def __getitem__(self, i):
         return list(self._children.values())[i]
+
+
+class SequentialRNNCell(_SequentialCellMixin, RecurrentCell):
+    """Stack of cells applied in sequence each step (ref: rnn_cell.py:682)."""
 
     def forward(self, *args):
         raise NotImplementedError
 
 
-class HybridSequentialRNNCell(HybridRecurrentCell):
+class HybridSequentialRNNCell(_SequentialCellMixin, HybridRecurrentCell):
     """Hybrid stack of cells (ref: rnn_cell.py:760)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-
-    def add(self, cell):
-        self.register_child(cell)
-        self._params.update(cell.collect_params())
-
-    def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
-
-    def begin_state(self, **kwargs):
-        assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
-
-    def __call__(self, inputs, states):
-        self._counter += 1
-        next_states = []
-        p = 0
-        for cell in self._children.values():
-            n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
-
-    def __len__(self):
-        return len(self._children)
-
-    def __getitem__(self, i):
-        return list(self._children.values())[i]
 
 
 class DropoutCell(HybridRecurrentCell):
@@ -550,7 +519,17 @@ class BidirectionalCell(HybridRecurrentCell):
         self.reset()
         inputs, axis, batch_size = _format_sequence(length, inputs, layout,
                                                     False)
-        reversed_inputs = list(reversed(inputs))
+        if valid_length is None:
+            reversed_inputs = list(reversed(inputs))
+        else:
+            # reverse each sample only within its valid prefix so the
+            # backward cell sees real tokens first, not padding
+            # (ref: rnn_cell.py:1068 SequenceReverse by valid_length)
+            rev = nd.SequenceReverse(nd.stack(*inputs, axis=0), valid_length,
+                                     use_sequence_length=True)
+            reversed_inputs = [nd.squeeze(x, axis=0) for x in
+                               nd.split(rev, num_outputs=length, axis=0,
+                                        squeeze_axis=False)]
         begin_state = begin_state or self.begin_state(batch_size=batch_size)
 
         n_l = len(self.l_cell.state_info(batch_size))
@@ -560,12 +539,16 @@ class BidirectionalCell(HybridRecurrentCell):
         r_outputs, r_states = self.r_cell.unroll(
             length, inputs=reversed_inputs, begin_state=begin_state[n_l:],
             layout=layout, merge_outputs=False, valid_length=valid_length)
-        if valid_length is not None:
-            r_outputs = list(reversed(
-                _mask_sequence_variable_length(
-                    list(reversed(r_outputs)), length, valid_length, axis,
-                    False)))
-        r_outputs = list(reversed(r_outputs))
+        if valid_length is None:
+            r_outputs = list(reversed(r_outputs))
+        else:
+            # un-reverse within the valid prefix (padding outputs stay put,
+            # already masked to zero by the inner unroll)
+            rev = nd.SequenceReverse(nd.stack(*r_outputs, axis=0),
+                                     valid_length, use_sequence_length=True)
+            r_outputs = [nd.squeeze(x, axis=0) for x in
+                         nd.split(rev, num_outputs=length, axis=0,
+                                  squeeze_axis=False)]
         outputs = [nd.concat(l_o, r_o, dim=1)
                    for l_o, r_o in zip(l_outputs, r_outputs)]
         if merge_outputs:

@@ -1,0 +1,256 @@
+"""Fused recurrent layers (ref: python/mxnet/gluon/rnn/rnn_layer.py).
+
+TPU-native re-design of the reference's cuDNN-backed fused RNN layers
+(ref: src/operator/rnn-inl.h, rnn.cc): parameters are registered unfused
+per layer/direction with the reference's names (``l0_i2h_weight`` …) so
+checkpoints round-trip, then packed into the single 1-D vector the fused
+``RNN`` op consumes.  The op runs each layer's input projection as one
+large MXU matmul over the whole sequence and carries only the recurrent
+state through a ``lax.scan`` (one XLA while loop — no per-step dispatch,
+unlike the reference's per-timestep engine pushes).
+"""
+from __future__ import annotations
+
+from ... import autograd
+from ... import ndarray as nd
+from ..block import HybridBlock
+from .rnn_cell import RNNCell, LSTMCell, GRUCell, HybridSequentialRNNCell
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
+
+
+class _RNNLayer(HybridBlock):
+    """Base for fused RNN layers (ref: rnn_layer.py:33 _RNNLayer)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None,
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if layout not in ("TNC", "NTC"):
+            raise ValueError(
+                "Invalid layout %r; must be one of ['TNC', 'NTC']" % layout)
+        if projection_size:
+            raise NotImplementedError("LSTMP projection is not supported")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        self._gates = _GATES[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(
+                    "{}{}_i2h_weight".format(j, i), (ng * nh, ni),
+                    i2h_weight_initializer, dtype)
+                self._register_param(
+                    "{}{}_h2h_weight".format(j, i), (ng * nh, nh),
+                    h2h_weight_initializer, dtype)
+                self._register_param(
+                    "{}{}_i2h_bias".format(j, i), (ng * nh,),
+                    i2h_bias_initializer, dtype)
+                self._register_param(
+                    "{}{}_h2h_bias".format(j, i), (ng * nh,),
+                    h2h_bias_initializer, dtype)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init, dtype):
+        p = self.params.get(name, shape=shape, init=init, dtype=dtype,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape and shape[1] else None,
+            shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def _shape_hint(self, x, *args):
+        in_size = x.shape[2] if self._layout == "TNC" else x.shape[-1]
+        hints = {}
+        ng, nh = self._gates, self._hidden_size
+        ni = in_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                hints[getattr(self, "{}{}_i2h_weight".format(j, i))] = \
+                    (ng * nh, ni)
+                hints[getattr(self, "{}{}_h2h_weight".format(j, i))] = \
+                    (ng * nh, nh)
+                hints[getattr(self, "{}{}_i2h_bias".format(j, i))] = \
+                    (ng * nh,)
+                hints[getattr(self, "{}{}_h2h_bias".format(j, i))] = \
+                    (ng * nh,)
+            ni = nh * self._dir
+        return hints
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        """Initial recurrent states (ref: rnn_layer.py:159 begin_state)."""
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            states.append(func(name="%sh0_%d" % (self.prefix, i), **info))
+        return states
+
+    def unfuse(self):
+        """Equivalent stack of unfused cells (ref: rnn_layer.py:116)."""
+        get_cell = {
+            "rnn_relu": lambda **kw: RNNCell(self._hidden_size,
+                                             activation="relu", **kw),
+            "rnn_tanh": lambda **kw: RNNCell(self._hidden_size,
+                                             activation="tanh", **kw),
+            "lstm": lambda **kw: LSTMCell(self._hidden_size, **kw),
+            "gru": lambda **kw: GRUCell(self._hidden_size, **kw),
+        }[self._mode]
+        stack = HybridSequentialRNNCell(prefix=self.prefix, params=self.params)
+        with stack.name_scope():
+            ni = self._input_size
+            for i in range(self._num_layers):
+                if self._dir == 2:
+                    raise NotImplementedError(
+                        "unfuse does not support bidirectional layers")
+                stack.add(get_cell(prefix="l%d_" % i, input_size=ni))
+                if self._dropout > 0 and i != self._num_layers - 1:
+                    from .rnn_cell import DropoutCell
+                    stack.add(DropoutCell(self._dropout))
+                ni = self._hidden_size
+        return stack
+
+    def forward(self, inputs, states=None):
+        """Run the fused RNN (ref: rnn_layer.py:234 __call__/forward).
+
+        If ``states`` is None a zero initial state is used and only the
+        output sequence is returned; otherwise ``(output, new_states)``.
+        """
+        skip_states = states is None
+        batch_axis = self._layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        self._infer_param_shapes(inputs)
+        if skip_states:
+            states = self.begin_state(batch_size, dtype=inputs.dtype)
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        for st, info in zip(states, self.state_info(batch_size)):
+            if list(st.shape) != list(info["shape"]):
+                raise ValueError(
+                    "Invalid recurrent state shape. Expecting %s, got %s." % (
+                        str(info["shape"]), str(st.shape)))
+        out = self._forward_kernel(inputs, states)
+        return out[0] if skip_states else out
+
+    def _pack_params(self):
+        """Flatten per-layer params into the fused op's 1-D vector: all
+        weights layer-major (direction inner), then all biases
+        (ref: rnn-inl.h GetRnnParamSize packing)."""
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(getattr(self, "%s%d_i2h_weight" % (j, i))
+                          .data().reshape(-1))
+                ws.append(getattr(self, "%s%d_h2h_weight" % (j, i))
+                          .data().reshape(-1))
+                bs.append(getattr(self, "%s%d_i2h_bias" % (j, i))
+                          .data().reshape(-1))
+                bs.append(getattr(self, "%s%d_h2h_bias" % (j, i))
+                          .data().reshape(-1))
+        return nd.concat(*(ws + bs), dim=0)
+
+    def _forward_kernel(self, inputs, states):
+        if self._layout == "NTC":
+            inputs = nd.swapaxes(inputs, 0, 1)
+        params = self._pack_params()
+        rnn_args = [inputs, params] + list(states)
+        out = nd.RNN(*rnn_args, state_size=self._hidden_size,
+                     num_layers=self._num_layers,
+                     bidirectional=self._dir == 2, p=self._dropout,
+                     state_outputs=True, mode=self._mode,
+                     _training=autograd.is_training())
+        if self._mode == "lstm":
+            outputs, states = out[0], [out[1], out[2]]
+        else:
+            outputs, states = out[0], [out[1]]
+        if self._layout == "NTC":
+            outputs = nd.swapaxes(outputs, 0, 1)
+        return outputs, states
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh/relu (ref: rnn_layer.py:286 RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation,
+                         dtype=dtype, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (ref: rnn_layer.py:388 LSTM). States: [h, c]."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm",
+                         projection_size=projection_size, dtype=dtype,
+                         **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (ref: rnn_layer.py:496 GRU); gate order [r, z, n]."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", dtype=dtype, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -260,12 +260,16 @@ class Module(BaseModule):
         old_group = self._exec_group
 
         if not hasattr(self, "_exec_cache"):
-            self._exec_cache = {}
+            # LRU-bounded: workloads that reshape to many distinct
+            # geometries must not retain every compiled executor forever
+            from collections import OrderedDict
+            self._exec_cache = OrderedDict()
         curr_key = (tuple((d.name, tuple(d.shape))
                           for d in self._data_shapes),
                     tuple((d.name, tuple(d.shape))
                           for d in self._label_shapes or []))
         self._exec_cache[curr_key] = old_group
+        self._exec_cache.move_to_end(curr_key)
 
         new_data = _as_desc(data_shapes)
         new_label = _as_desc(label_shapes) if label_shapes else []
@@ -274,6 +278,7 @@ class Module(BaseModule):
         cached = self._exec_cache.get(new_key)
         if cached is not None:
             self._exec_group = cached
+            self._exec_cache.move_to_end(new_key)
             self._data_shapes = new_data
             self._label_shapes = new_label
         else:
@@ -284,6 +289,8 @@ class Module(BaseModule):
                       inputs_need_grad=self.inputs_need_grad,
                       force_rebind=True, grad_req=self._grad_req or "write")
             self._exec_cache[new_key] = self._exec_group
+        while len(self._exec_cache) > 8:
+            self._exec_cache.popitem(last=False)
         if arg_params is not None:
             self._exec_group.set_params(arg_params, aux_params,
                                         allow_extra=True)

@@ -554,11 +554,14 @@ def _rnn_unpack_params(parameters, mode, input_size, state_size, num_layers,
     return per  # index = layer * ndir + direction
 
 
-def _rnn_cell_step(mode, h_prev, c_prev, i2h, h2h):
+def _rnn_cell_step(mode, h_prev, c_prev, i2h, h2h, clip=None):
     """One timestep's gate math given precomputed i2h and h2h projections.
     Gate order matches the reference cells: LSTM [i, f, g, o], GRU
     [r, z, n] with n = tanh(i2h_n + r * h2h_n)
-    (ref: gluon/rnn/rnn_cell.py:487 LSTMCell, :606 GRUCell)."""
+    (ref: gluon/rnn/rnn_cell.py:487 LSTMCell, :606 GRUCell).
+
+    ``clip`` = (min, max, nan) applies cuDNN CUDNN_RNN_CLIP semantics to the
+    LSTM cell state at EVERY step (ref: rnn-inl.h lstm_state_clip_*)."""
     hsz = h_prev.shape[-1]
     if mode in ("rnn_relu", "rnn_tanh"):
         pre = i2h + h2h
@@ -581,35 +584,68 @@ def _rnn_cell_step(mode, h_prev, c_prev, i2h, h2h):
         gg = jnp.tanh(pre[..., 2 * hsz:3 * hsz])
         o = jax.nn.sigmoid(pre[..., 3 * hsz:])
         c = f * c_prev + i * gg
+        if clip is not None:
+            cmin, cmax, cnan = clip
+            c = jnp.clip(c, cmin, cmax)
+            if cnan:
+                c = jnp.where(jnp.isnan(c),
+                              jnp.clip(jnp.zeros_like(c), cmin, cmax), c)
         h = o * jnp.tanh(c)
         return h, c
     raise ValueError("unknown RNN mode %r" % (mode,))
 
 
-def _rnn_layer_scan(mode, x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse):
+def _sequence_reverse(x, lengths):
+    """Reverse (T, N, C) within each sample's valid prefix, padding kept in
+    place (ref: src/operator/sequence_reverse.cc SequenceReverse)."""
+    T = x.shape[0]
+    t = jnp.arange(T)[:, None]
+    lens = lengths.astype(jnp.int32)[None, :]
+    idx = jnp.where(t < lens, lens - 1 - t, t)
+    return jnp.take_along_axis(x, idx[..., None], axis=0)
+
+
+def _rnn_layer_scan(mode, x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse,
+                    lengths=None, clip=None):
     """Run one direction of one layer over the whole sequence: the i2h
     projection for ALL timesteps is one large (T*N, I)x(I, G*H) matmul on
     the MXU; the lax.scan carries only the (N, H) state and does the
-    (N, H)x(H, G*H) h2h matmul per step."""
+    (N, H)x(H, G*H) h2h matmul per step.
+
+    With ``lengths`` (N,), steps past each sample's valid length freeze the
+    recurrent state and emit zeros; the reverse direction reverses within
+    the valid prefix (SequenceReverse semantics), so final states are taken
+    at each sample's own boundary — matching cuDNN variable-length RNNs."""
     if reverse:
-        x = jnp.flip(x, axis=0)
+        x = _sequence_reverse(x, lengths) if lengths is not None \
+            else jnp.flip(x, axis=0)
     i2h_all = jnp.einsum("tni,gi->tng", x, w_i2h) + b_i2h
+    T = x.shape[0]
 
-    def step(carry, i2h_t):
+    def step(carry, xs):
         h_prev, c_prev = carry
+        i2h_t, t = xs
         h2h_t = h_prev @ w_h2h.T + b_h2h
-        h, c = _rnn_cell_step(mode, h_prev, c_prev, i2h_t, h2h_t)
-        return (h, c), h
+        h, c = _rnn_cell_step(mode, h_prev, c_prev, i2h_t, h2h_t, clip=clip)
+        if lengths is None:
+            return (h, c), h
+        valid = (t < lengths.astype(jnp.int32))[:, None]
+        h = jnp.where(valid, h, h_prev)
+        c = jnp.where(valid, c, c_prev)
+        out = jnp.where(valid, h, jnp.zeros((), h.dtype))
+        return (h, c), out
 
-    (h_last, c_last), hs = jax.lax.scan(step, (h0, c0), i2h_all)
+    (h_last, c_last), hs = jax.lax.scan(
+        step, (h0, c0), (i2h_all, jnp.arange(T)))
     if reverse:
-        hs = jnp.flip(hs, axis=0)
+        hs = _sequence_reverse(hs, lengths) if lengths is not None \
+            else jnp.flip(hs, axis=0)
     return hs, h_last, c_last
 
 
 @register("RNN", aliases=("rnn",))
-def rnn_fused(data, parameters, state, state_cell=None, key=None, *,
-              mode="lstm", state_size=None, num_layers=1,
+def rnn_fused(data, parameters, state, state_cell=None, sequence_length=None,
+              key=None, *, mode="lstm", state_size=None, num_layers=1,
               bidirectional=False, p=0.0, state_outputs=False,
               projection_size=None, lstm_state_clip_min=None,
               lstm_state_clip_max=None, lstm_state_clip_nan=False,
@@ -618,6 +654,8 @@ def rnn_fused(data, parameters, state, state_cell=None, key=None, *,
     the cuDNN-RNN-backed `RNN` op). Layout TNC: data (T, N, I); state
     (L*D, N, H); packed 1-D `parameters`. Between-layer dropout `p` applies
     to inputs of layers > 0 during training (ref: rnn-inl.h p semantics).
+    With ``use_sequence_length`` the trailing input is per-sample valid
+    lengths (N,), matching the reference's variable-length cuDNN path.
 
     TPU mapping: per layer+direction, i2h for the whole sequence is one
     MXU matmul; a lax.scan carries the recurrent state (compiles to one
@@ -626,9 +664,22 @@ def rnn_fused(data, parameters, state, state_cell=None, key=None, *,
         raise NotImplementedError("LSTMP projection is not supported")
     if state_size is None:
         raise ValueError("state_size required")
+    if use_sequence_length:
+        # Positional binding matches the reference op: sequence_length is
+        # the input right after the states, which for non-LSTM modes (no
+        # state_cell input) arrives in the state_cell slot.
+        if mode != "lstm" and sequence_length is None:
+            state_cell, sequence_length = None, state_cell
+        if sequence_length is None:
+            raise ValueError("use_sequence_length=True requires a "
+                             "sequence_length input")
+    else:
+        sequence_length = None
+    clip = None
+    if mode == "lstm" and lstm_state_clip_min is not None:
+        clip = (lstm_state_clip_min, lstm_state_clip_max,
+                lstm_state_clip_nan)
     ndir = 2 if bidirectional else 1
-    g = _RNN_GATES[mode]
-    del g
     per = _rnn_unpack_params(parameters, mode, data.shape[-1], state_size,
                              num_layers, ndir)
     x = data
@@ -647,10 +698,8 @@ def rnn_fused(data, parameters, state, state_cell=None, key=None, *,
             c0 = state_cell[idx] if state_cell is not None \
                 else jnp.zeros_like(h0)
             hs, h_last, c_last = _rnn_layer_scan(
-                mode, x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse=(d == 1))
-            if mode == "lstm" and lstm_state_clip_min is not None:
-                c_last = jnp.clip(c_last, lstm_state_clip_min,
-                                  lstm_state_clip_max)
+                mode, x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h,
+                reverse=(d == 1), lengths=sequence_length, clip=clip)
             outs.append(hs)
             h_lasts.append(h_last)
             c_lasts.append(c_last)

@@ -317,3 +317,22 @@ def test_feedforward_eval_tuple_and_callbacks():
            eval_end_callback=lambda *a: hits.append("eval"),
            batch_end_callback=lambda *a: hits.append("batch"))
     assert "eval" in hits and "batch" in hits
+
+
+def test_bucketing_default_initializer_not_zero():
+    """Regression: init_params() with no initializer must apply the default
+    Uniform(0.01), not leave weights all-zero."""
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+        out = sym.SoftmaxOutput(fc, label, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    args, _ = mod.get_params()
+    assert np.abs(args["fc_weight"].asnumpy()).sum() > 0

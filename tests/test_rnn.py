@@ -1,0 +1,180 @@
+"""Fused RNN layer tests (ref: tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import rnn
+
+
+def _x(t, n, c, seed=0):
+    rng = np.random.RandomState(seed)
+    return mx.nd.array(rng.randn(t, n, c).astype("float32"))
+
+
+@pytest.mark.parametrize("layer_cls,nstates", [(rnn.LSTM, 2), (rnn.GRU, 1),
+                                               (rnn.RNN, 1)])
+def test_layer_shapes(layer_cls, nstates):
+    net = layer_cls(16, num_layers=2)
+    net.initialize()
+    x = _x(5, 3, 8)
+    out = net(x)
+    assert out.shape == (5, 3, 16)
+    states = net.begin_state(batch_size=3)
+    assert len(states) == nstates
+    out, st = net(x, states)
+    assert out.shape == (5, 3, 16)
+    assert all(s.shape == (2, 3, 16) for s in st)
+
+
+def test_bidirectional():
+    net = rnn.LSTM(16, num_layers=2, bidirectional=True)
+    net.initialize()
+    out, st = net(_x(5, 3, 8), net.begin_state(batch_size=3))
+    assert out.shape == (5, 3, 32)
+    assert st[0].shape == (4, 3, 16)
+
+
+def test_ntc_layout():
+    net = rnn.GRU(10, layout="NTC")
+    net.initialize()
+    assert net(_x(3, 5, 4)).shape == (3, 5, 10)
+
+
+def test_fused_matches_cell():
+    """Fused LSTM layer == unfolded LSTMCell with shared weights."""
+    fused = rnn.LSTM(6, input_size=4)
+    fused.initialize()
+    cell = rnn.LSTMCell(6, input_size=4)
+    cell.initialize()
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+    xs = _x(7, 2, 4, seed=3)
+    of = fused(xs)
+    oc, _ = cell.unroll(7, xs, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(of.asnumpy(), oc.asnumpy(), atol=1e-5)
+
+
+def test_gradients_flow():
+    net = rnn.LSTM(8, num_layers=2)
+    net.initialize()
+    x = _x(5, 3, 4)
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    for name in ("l0_i2h_weight", "l1_h2h_weight", "l0_i2h_bias"):
+        g = getattr(net, name).grad().asnumpy()
+        assert np.abs(g).sum() > 0, name
+
+
+def test_deferred_init_and_repr():
+    net = rnn.LSTM(8)
+    net.initialize()
+    net(_x(2, 2, 5))
+    assert net.l0_i2h_weight.shape == (32, 5)
+    assert "LSTM" in repr(net)
+
+
+def test_state_shape_validation():
+    net = rnn.GRU(8, input_size=4)
+    net.initialize()
+    bad = [mx.nd.zeros((1, 9, 8))]
+    with pytest.raises(ValueError):
+        net(_x(3, 2, 4), bad)
+
+
+def test_unfuse():
+    net = rnn.LSTM(6, num_layers=2, input_size=4)
+    net.initialize()
+    stack = net.unfuse()
+    stack.initialize()
+    out, _ = stack.unroll(5, _x(5, 2, 4), layout="TNC", merge_outputs=True)
+    assert out.shape == (5, 2, 6)
+
+
+def test_use_sequence_length():
+    """Variable-length fused RNN: padding must not affect states/outputs."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import rnn_fused
+
+    rng = np.random.RandomState(7)
+    T, N, I, H = 6, 3, 4, 5
+    x = rng.randn(T, N, I).astype("float32")
+    lens = np.array([6, 3, 1], dtype="int32")
+    nparams = 4 * H * I + 4 * H * H + 2 * 4 * H
+    params = rng.randn(nparams).astype("float32") * 0.1
+    h0 = np.zeros((1, N, H), "float32")
+    c0 = np.zeros((1, N, H), "float32")
+
+    out, hT, cT = rnn_fused(jnp.array(x), jnp.array(params), jnp.array(h0),
+                            jnp.array(c0), jnp.array(lens), mode="lstm",
+                            state_size=H, state_outputs=True,
+                            use_sequence_length=True)
+    # sample 1 (len 3): same as running only its first 3 steps unpadded
+    out_ref, hT_ref, cT_ref = rnn_fused(
+        jnp.array(x[:3, 1:2]), jnp.array(params), jnp.array(h0[:, 1:2]),
+        jnp.array(c0[:, 1:2]), mode="lstm", state_size=H, state_outputs=True)
+    np.testing.assert_allclose(np.asarray(out)[:3, 1], np.asarray(out_ref)[:, 0],
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hT)[0, 1], np.asarray(hT_ref)[0, 0],
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cT)[0, 1], np.asarray(cT_ref)[0, 0],
+                               atol=1e-6)
+    # outputs past valid length are zero
+    assert np.abs(np.asarray(out)[3:, 1]).max() == 0
+    assert np.abs(np.asarray(out)[1:, 2]).max() == 0
+
+
+def test_bidirectional_sequence_length():
+    """Reverse direction must see real tokens first (SequenceReverse)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import rnn_fused
+
+    rng = np.random.RandomState(11)
+    T, N, I, H = 5, 2, 3, 4
+    x = rng.randn(T, N, I).astype("float32")
+    lens = np.array([5, 2], dtype="int32")
+    isz = 4 * H * I + 4 * H * H
+    rsz = 4 * H * I + 4 * H * H
+    nparams = isz + rsz + 4 * 4 * H
+    params = rng.randn(nparams).astype("float32") * 0.1
+    h0 = np.zeros((2, N, H), "float32")
+    c0 = np.zeros((2, N, H), "float32")
+    out, hT, _ = rnn_fused(jnp.array(x), jnp.array(params), jnp.array(h0),
+                           jnp.array(c0), jnp.array(lens), mode="lstm",
+                           state_size=H, state_outputs=True,
+                           bidirectional=True, use_sequence_length=True)
+    # sample 1 (len 2): equivalent to unpadded bidirectional run of length 2
+    out_ref, hT_ref, _ = rnn_fused(
+        jnp.array(x[:2, 1:2]), jnp.array(params), jnp.array(h0[:, 1:2]),
+        jnp.array(c0[:, 1:2]), mode="lstm", state_size=H, state_outputs=True,
+        bidirectional=True)
+    np.testing.assert_allclose(np.asarray(out)[:2, 1], np.asarray(out_ref)[:, 0],
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hT)[:, 1], np.asarray(hT_ref)[:, 0],
+                               atol=1e-6)
+
+
+def test_lstm_state_clip_per_step():
+    """Clipping applies to the cell state at every step, not just the end."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import rnn_fused
+
+    rng = np.random.RandomState(3)
+    T, N, I, H = 8, 1, 2, 3
+    x = (rng.randn(T, N, I) * 10).astype("float32")
+    nparams = 4 * H * I + 4 * H * H + 2 * 4 * H
+    params = (rng.randn(nparams) * 2).astype("float32")
+    h0 = np.zeros((1, N, H), "float32")
+    c0 = np.zeros((1, N, H), "float32")
+    out_c, _, _ = rnn_fused(jnp.array(x), jnp.array(params), jnp.array(h0),
+                            jnp.array(c0), mode="lstm", state_size=H,
+                            state_outputs=True, lstm_state_clip_min=-0.1,
+                            lstm_state_clip_max=0.1)
+    out_u, _, _ = rnn_fused(jnp.array(x), jnp.array(params), jnp.array(h0),
+                            jnp.array(c0), mode="lstm", state_size=H,
+                            state_outputs=True)
+    # per-step clip bounds every hidden output by tanh(0.1)
+    assert np.abs(np.asarray(out_c)).max() <= np.tanh(0.1) + 1e-6
+    assert not np.allclose(np.asarray(out_c), np.asarray(out_u))

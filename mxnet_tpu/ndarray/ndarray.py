@@ -503,6 +503,11 @@ class NDArray:
         for i in range(len(self)):
             yield self[i]
 
+    # np-array interop (ref: python/mxnet/ndarray/ndarray.py as_np_ndarray)
+    def as_np_ndarray(self):
+        from ..numpy.multiarray import ndarray as _np_ndarray
+        return _np_ndarray._adopt(self)
+
     # numpy protocol
     def __array__(self, dtype=None):
         a = self.asnumpy()

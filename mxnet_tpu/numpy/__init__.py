@@ -1,0 +1,9 @@
+"""mx.np — NumPy-compatible array API (ref: python/mxnet/numpy/__init__.py).
+
+``from mxnet_tpu import np`` gives the NumPy-semantics surface the reference
+exposes as ``mx.np`` (zero-dim arrays, NumPy promotion/broadcasting), with
+every op autograd-recordable and XLA-compiled."""
+from . import random  # noqa: F401
+from . import linalg  # noqa: F401
+from .multiarray import *  # noqa: F401,F403
+from .multiarray import ndarray, _np_invoke  # noqa: F401

@@ -1,0 +1,28 @@
+"""mx.np.linalg (ref: python/mxnet/numpy/linalg.py; backed in the
+reference by src/operator/numpy/linalg/). Thin autograd-recording wrappers
+over jnp.linalg — XLA lowers decompositions to TPU-friendly kernels."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .multiarray import _np_invoke
+
+__all__ = ["norm", "svd", "cholesky", "inv", "det", "slogdet", "solve",
+           "tensorinv", "tensorsolve", "pinv", "eig", "eigh", "eigvals",
+           "eigvalsh", "qr", "lstsq", "matrix_rank", "matrix_power",
+           "multi_dot", "cond"]
+
+
+def _make(name):
+    jfn = getattr(jnp.linalg, name)
+
+    def fn(*args, **kwargs):
+        return _np_invoke(jfn, args, kwargs, op_name="linalg." + name)
+    fn.__name__ = name
+    fn.__doc__ = "mx.np.linalg.%s (ref: python/mxnet/numpy/linalg.py)" % name
+    return fn
+
+
+for _n in __all__:
+    if hasattr(jnp.linalg, _n):
+        globals()[_n] = _make(_n)

@@ -1,0 +1,509 @@
+"""mx.np — NumPy-semantics array API.
+
+TPU-native analog of the reference's NumPy-compatible frontend
+(ref: python/mxnet/numpy/multiarray.py, 243 defs; backed by
+src/operator/numpy/). The reference re-implements NumPy semantics as a
+separate C++ op namespace (`_np_*` ops) because its legacy ops have MXNet
+semantics (no zero-dim arrays, no true broadcasting on some ops). Here the
+compute path is jax.numpy — already NumPy-semantics end to end — so each
+function is a thin autograd-recording wrapper over the corresponding jnp
+function, and ``ndarray`` is a subclass of the framework NDArray whose
+operators follow NumPy type promotion.
+
+Functions participate in ``autograd.record()`` exactly like registry ops:
+the jax.vjp closure of the traced call is captured on the tape
+(ref: src/imperative/imperative.cc:193 RecordOp analog).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .. import autograd
+from ..base import canonical_dtype
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, _is_tracer, _place
+
+__all__ = ["ndarray", "array", "zeros", "ones", "full", "empty", "arange",
+           "linspace", "logspace", "eye", "identity", "empty_like",
+           "zeros_like", "ones_like", "full_like", "copy", "asarray",
+           "pi", "e", "inf", "nan", "newaxis", "euler_gamma",
+           "float16", "float32", "float64", "int8", "int16", "int32",
+           "int64", "uint8", "bool_", "bfloat16"]
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+euler_gamma = _onp.euler_gamma
+
+# dtype objects re-exported like the reference (mx.np.float32 is np.float32)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+bfloat16 = jnp.bfloat16
+
+
+def _is_inexact(dt):
+    try:
+        return jnp.issubdtype(dt, jnp.inexact)
+    except TypeError:
+        return False
+
+
+def _wrap_out(x):
+    if isinstance(x, NDArray):
+        return x
+    return ndarray(x)
+
+
+def _np_invoke(fn, args, kwargs, op_name=None):
+    """Run a jnp function over NDArray/scalar args with autograd recording
+    (mirrors ndarray/register.py invoke for registry ops)."""
+    out_arr = kwargs.pop("out", None)
+    if kwargs.get("where") is not None:
+        raise TypeError("the where= ufunc argument is not supported "
+                        "(the reference's mx.np rejects it too)")
+    kwargs.pop("where", None)
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (list(args), kwargs), is_leaf=lambda x: isinstance(x, NDArray))
+    slots = [i for i, v in enumerate(leaves) if isinstance(v, NDArray)]
+    nd_inputs = [leaves[i] for i in slots]
+    datas = tuple(a._data for a in nd_inputs)
+
+    def fwd(*xs):
+        new_leaves = list(leaves)
+        for s, x in zip(slots, xs):
+            new_leaves[s] = x
+        a, kw = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return fn(*a, **kw)
+
+    # builtins.any/all: this module also defines np.any/np.all at top level
+    recording = (autograd.is_recording() and len(datas) > 0
+                 and builtins.any(_is_inexact(d.dtype) for d in datas))
+    if recording:
+        out, vjp_fn = jax.vjp(fwd, *datas)
+    else:
+        out = fwd(*datas)
+
+    def wrap(o):
+        return ndarray(o) if isinstance(o, jax.Array) or _is_tracer(o) else o
+
+    multi = isinstance(out, (tuple, list))
+    raw_outs = list(out) if multi else [out]
+    outs = [wrap(o) for o in raw_outs]
+
+    if recording and builtins.all(isinstance(o, ndarray) for o in outs) \
+            and builtins.all(_is_inexact(o.dtype) for o in raw_outs):
+        node = autograd.record_op(op_name or getattr(fn, "__name__", "np_op"),
+                                  outs, nd_inputs, vjp_fn)
+        node.fwd_fn = fwd
+    if out_arr is not None and not multi:
+        out_arr._data = outs[0]._data
+        out_arr._autograd_entry = outs[0]._autograd_entry
+        return out_arr
+    return tuple(outs) if multi else outs[0]
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array (ref: python/mxnet/numpy/multiarray.py:75
+    ndarray). Zero-dim and zero-size shapes are first-class; operators
+    follow NumPy type promotion (jnp's), not the legacy NDArray rules."""
+
+    __slots__ = ()
+
+    # -- conversion bridges (ref: multiarray.py as_nd_ndarray) -----------
+    def as_nd_ndarray(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        out._autograd_entry = self._autograd_entry
+        return out
+
+    def as_np_ndarray(self):
+        return self
+
+    @property
+    def grad(self):
+        g = self._grad
+        if g is not None and not isinstance(g, ndarray):
+            g = ndarray(g._data, ctx=g._ctx)
+        return g
+
+    # -- operators with NumPy promotion ----------------------------------
+    def _binop(self, name, other, reverse=False):
+        if isinstance(other, (list, tuple, _onp.ndarray)):
+            other = array(other)
+        fn = _BINOP_FNS[name]
+        a, b = (other, self) if reverse else (self, other)
+        return _np_invoke(fn, (a, b), {}, op_name=name)
+
+    def __neg__(self):
+        return _np_invoke(jnp.negative, (self,), {})
+
+    def __abs__(self):
+        return _np_invoke(jnp.abs, (self,), {})
+
+    def __matmul__(self, other):
+        return _np_invoke(jnp.matmul, (self, other), {})
+
+    def __rmatmul__(self, other):
+        return _np_invoke(jnp.matmul, (other, self), {})
+
+    def __floordiv__(self, other):
+        return self._binop("floor_divide", other)
+
+    def __rfloordiv__(self, other):
+        return self._binop("floor_divide", other, True)
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an ndarray with more than "
+                             "one element is ambiguous")
+        return bool(self.item())
+
+    def __getitem__(self, key):
+        out = super().__getitem__(key)
+        return ndarray._adopt(out)
+
+    @classmethod
+    def _adopt(cls, arr):
+        """Re-brand a base NDArray result as np.ndarray, keeping its tape
+        entry so backward() still works through it."""
+        if isinstance(arr, cls):
+            return arr
+        out = cls(arr._data, ctx=arr._ctx)
+        out._autograd_entry = arr._autograd_entry
+        return out
+
+    # -- NumPy-style methods ---------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        order = kwargs.pop("order", "C")
+        if order != "C":
+            raise NotImplementedError("only order='C' is supported")
+        return _np_invoke(jnp.reshape, (self, shape), {})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        axes = axes if axes else None
+        return _np_invoke(jnp.transpose, (self,), {"axes": axes})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def astype(self, dtype, copy=True):
+        return _np_invoke(
+            lambda x: x.astype(canonical_dtype(dtype)), (self,), {})
+
+    def copy(self):
+        return ndarray(self._data, ctx=self._ctx)
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def flatten(self, order="C"):
+        return self.reshape(-1)
+
+    def ravel(self, order="C"):
+        return self.reshape(-1)
+
+    def squeeze(self, axis=None):
+        return _np_invoke(jnp.squeeze, (self,), {"axis": axis})
+
+    def repeat(self, repeats, axis=None):
+        return _np_invoke(jnp.repeat, (self,),
+                          {"repeats": repeats, "axis": axis})
+
+    def take(self, indices, axis=None, mode="raise"):
+        idx = indices._data if isinstance(indices, NDArray) \
+            else jnp.asarray(indices)
+        if mode == "raise":
+            # XLA can't raise from device code; check eagerly when concrete
+            # (tracers fall back to clip, like the reference's npx take)
+            if not _is_tracer(idx) and not _is_tracer(self._data):
+                n = self.size if axis is None else self.shape[axis]
+                host = _onp.asarray(idx)
+                if host.size and (host.min() < -n or host.max() >= n):
+                    raise IndexError(
+                        "index out of range for take (size %d)" % n)
+            mode = "clip"
+        return _np_invoke(
+            lambda x: jnp.take(x, idx, axis=axis, mode=mode), (self,), {})
+
+    def clip(self, min=None, max=None):
+        return _np_invoke(jnp.clip, (self, min, max), {})
+
+    def round(self, decimals=0):
+        return _np_invoke(jnp.round, (self,), {"decimals": decimals})
+
+    def nonzero(self):
+        return tuple(ndarray(i) for i in jnp.nonzero(self._data))
+
+    def dot(self, b):
+        return _np_invoke(jnp.dot, (self, b), {})
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        arr = self.asnumpy()
+        prefix = "array("
+        body = _onp.array2string(arr, separator=", ", prefix=prefix)
+        dt = "" if arr.dtype in (_onp.float32, _onp.int64, _onp.bool_) \
+            else ", dtype=%s" % arr.dtype
+        ctx = self.context
+        dev = "" if ctx.device_type == "cpu" else ", ctx=%s" % str(ctx)
+        return "%s%s%s%s)" % (prefix, body, dt, dev)
+
+    def __str__(self):
+        return str(self.asnumpy())
+
+
+def _reduce_method(fn_name):
+    fn = getattr(jnp, fn_name)
+
+    def method(self, axis=None, dtype=None, out=None, keepdims=False):
+        kw = {"axis": axis, "keepdims": keepdims}
+        if fn_name in ("sum", "prod", "cumsum", "cumprod", "mean", "std",
+                       "var") and dtype is not None:
+            kw["dtype"] = canonical_dtype(dtype)
+        if fn_name in ("cumsum", "cumprod"):
+            kw.pop("keepdims")
+        if fn_name in ("argmax", "argmin"):
+            kw.pop("keepdims")
+        res = _np_invoke(fn, (self,), kw, op_name=fn_name)
+        if out is not None:
+            out._data = res._data
+            out._autograd_entry = res._autograd_entry
+            return out
+        return res
+    method.__name__ = fn_name
+    return method
+
+
+for _name in ("sum", "prod", "mean", "std", "var", "max", "min", "argmax",
+              "argmin", "cumsum", "cumprod", "all", "any"):
+    setattr(ndarray, _name, _reduce_method(_name))
+
+_BINOP_FNS = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.true_divide, "mod": jnp.mod, "power": jnp.power,
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less, "lesser_equal": jnp.less_equal,
+    "floor_divide": jnp.floor_divide,
+}
+
+
+# ---------------------------------------------------------------------------
+# creation functions
+# ---------------------------------------------------------------------------
+
+def _dev_wrap(data, ctx=None):
+    ctx = ctx or current_context()
+    return ndarray(_place(data, ctx) if not _is_tracer(data) else data,
+                   ctx=ctx)
+
+
+def array(object, dtype=None, ctx=None):
+    """ref: multiarray.py array(). Float input defaults to float32 (the
+    reference's np default dtype), ints keep their width. Delegates to the
+    nd-level array() so the dtype policy lives in one place."""
+    from ..ndarray.ndarray import array as _nd_array
+    if isinstance(object, NDArray) and dtype is not None:
+        return _dev_wrap(object._data.astype(canonical_dtype(dtype)), ctx)
+    return ndarray._adopt(_nd_array(object, ctx=ctx, dtype=dtype))
+
+
+def asarray(a, dtype=None, ctx=None):
+    return array(a, dtype=dtype, ctx=ctx)
+
+
+def zeros(shape, dtype=float32, order="C", ctx=None):
+    return _dev_wrap(jnp.zeros(shape, canonical_dtype(dtype or float32)), ctx)
+
+
+def ones(shape, dtype=float32, order="C", ctx=None):
+    return _dev_wrap(jnp.ones(shape, canonical_dtype(dtype or float32)), ctx)
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None, out=None):
+    if dtype is not None:
+        dtype = canonical_dtype(dtype)
+    fv = fill_value._data if isinstance(fill_value, NDArray) else fill_value
+    res = _dev_wrap(jnp.full(shape, fv, dtype), ctx)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def empty(shape, dtype=float32, order="C", ctx=None):
+    return zeros(shape, dtype=dtype, ctx=ctx)
+
+
+def empty_like(prototype, dtype=None, order="C"):
+    p = prototype._data if isinstance(prototype, NDArray) else prototype
+    return ndarray(jnp.zeros_like(
+        p, dtype=canonical_dtype(dtype) if dtype else None))
+
+
+def zeros_like(a, dtype=None, order="C", ctx=None):
+    return _np_invoke(
+        lambda x: jnp.zeros_like(
+            x, dtype=canonical_dtype(dtype) if dtype else None), (a,), {})
+
+
+def ones_like(a, dtype=None, order="C", ctx=None):
+    return _np_invoke(
+        lambda x: jnp.ones_like(
+            x, dtype=canonical_dtype(dtype) if dtype else None), (a,), {})
+
+
+def full_like(a, fill_value, dtype=None, order="C", ctx=None):
+    return _np_invoke(
+        lambda x: jnp.full_like(
+            x, fill_value, dtype=canonical_dtype(dtype) if dtype else None),
+        (a,), {})
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    if dtype is not None:
+        dtype = canonical_dtype(dtype)
+    # reference defaults arange to float32 unless dtype given int
+    if dtype is None:
+        dtype = _onp.float32
+    return _dev_wrap(jnp.arange(start, stop, step, dtype=dtype), ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    res = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=canonical_dtype(dtype) if dtype else None,
+                       axis=axis)
+    if retstep:
+        return _dev_wrap(res[0], ctx), float(res[1])
+    return _dev_wrap(res, ctx)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None):
+    return _dev_wrap(
+        jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                     dtype=canonical_dtype(dtype) if dtype else None,
+                     axis=axis), ctx)
+
+
+def eye(N, M=None, k=0, dtype=float32, ctx=None):
+    return _dev_wrap(jnp.eye(N, M, k=k, dtype=canonical_dtype(dtype)), ctx)
+
+
+def identity(n, dtype=float32, ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def copy(a):
+    return array(a)
+
+
+# ---------------------------------------------------------------------------
+# generated jnp-delegating functions (ref: multiarray.py's ~240 op defs)
+# ---------------------------------------------------------------------------
+
+_DELEGATED = [
+    # elementwise math
+    "abs", "absolute", "add", "subtract", "multiply", "divide",
+    "true_divide", "floor_divide", "mod", "remainder", "fmod", "power",
+    "float_power", "sqrt", "cbrt", "square", "reciprocal", "negative",
+    "positive", "sign", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "logaddexp", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "arctan2", "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "degrees", "radians", "deg2rad", "rad2deg", "hypot", "copysign",
+    "fabs", "ceil", "floor", "trunc", "fix", "rint", "around", "round",
+    "clip", "maximum", "minimum", "fmax", "fmin", "nan_to_num", "interp",
+    "gcd", "lcm", "ldexp", "heaviside", "sinc", "i0",
+    # logic / comparison
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "isfinite",
+    "isinf", "isnan", "isneginf", "isposinf", "isclose", "allclose",
+    "array_equal", "array_equiv",
+    # bitwise
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "left_shift", "right_shift",
+    # reductions / statistics
+    "sum", "prod", "mean", "std", "var", "median", "average", "ptp",
+    "percentile", "quantile", "nansum", "nanprod", "nanmean", "nanstd",
+    "nanvar", "nanmax", "nanmin", "amax", "amin", "max", "min", "all",
+    "any", "cumsum", "cumprod", "nancumsum", "nancumprod", "count_nonzero",
+    "bincount", "histogram", "correlate", "cov", "corrcoef", "digitize",
+    # sorting / searching / indexing
+    "argmax", "argmin", "nanargmax", "nanargmin", "argsort", "sort",
+    "lexsort", "partition", "argpartition", "searchsorted", "nonzero",
+    "flatnonzero", "argwhere", "where", "extract", "take",
+    "take_along_axis", "choose", "compress", "diag_indices_from",
+    "unravel_index", "ravel_multi_index", "indices", "tril_indices",
+    "triu_indices", "triu_indices_from", "tril_indices_from", "unique",
+    # shape manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
+    "atleast_1d", "atleast_2d", "atleast_3d", "concatenate", "stack",
+    "vstack", "hstack", "dstack", "column_stack", "row_stack", "split",
+    "array_split", "hsplit", "vsplit", "dsplit", "tile", "repeat",
+    "flip", "fliplr", "flipud", "roll", "rot90", "pad", "insert",
+    "delete", "append", "resize", "trim_zeros",
+    # linear algebra (main namespace part)
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "cross", "trace", "diagonal", "diag", "diagflat", "tril",
+    "triu", "vander",
+    # misc
+    "meshgrid", "diff", "ediff1d", "gradient", "convolve", "polyval",
+    "real", "imag", "conj", "conjugate", "angle", "may_share_memory",
+    "shares_memory", "result_type", "can_cast", "promote_types",
+    "issubdtype", "ndim", "shape", "size", "iscomplex", "isreal",
+    "isscalar", "union1d", "intersect1d", "setdiff1d", "in1d", "isin",
+    "apply_along_axis", "piecewise", "select", "tril", "packbits",
+    "unpackbits", "float_power",
+]
+
+
+def _make_fn(jfn, name):
+    def fn(*args, **kwargs):
+        if "dtype" in kwargs and kwargs["dtype"] is not None:
+            kwargs["dtype"] = canonical_dtype(kwargs["dtype"])
+        return _np_invoke(jfn, args, kwargs, op_name=name)
+    fn.__name__ = name
+    fn.__doc__ = "mx.np.%s — NumPy-semantics op, delegates to jnp.%s\n" \
+        "(ref: python/mxnet/numpy/multiarray.py %s)" % (name, name, name)
+    return fn
+
+
+def _populate(ns):
+    for name in _DELEGATED:
+        if name in ns:
+            continue
+        jfn = getattr(jnp, name, None)
+        if jfn is None:
+            continue
+        ns[name] = _make_fn(jfn, name)
+        __all__.append(name)
+
+
+_populate(globals())

@@ -1,0 +1,213 @@
+"""mx.np.random — NumPy-style samplers (ref: python/mxnet/numpy/random.py).
+
+Each sampler draws a fresh key from the framework PRNG stream
+(mxnet_tpu.random), so ``mx.np.random`` and ``mx.nd.random`` share one
+seeded sequence like the reference's per-context sampler resources
+(ref: src/resource.cc kRandom)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .. import random as _random
+from ..base import canonical_dtype
+from .multiarray import ndarray, _dev_wrap, array as _array
+
+__all__ = ["uniform", "normal", "randint", "rand", "randn", "choice",
+           "shuffle", "permutation", "multinomial", "gamma", "beta",
+           "exponential", "laplace", "gumbel", "logistic", "lognormal",
+           "pareto", "power", "rayleigh", "weibull", "chisquare", "seed"]
+
+
+def seed(s):
+    _random.seed(s)
+
+
+def _size_to_shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _as_val(v):
+    from ..ndarray.ndarray import NDArray
+    return v._data if isinstance(v, NDArray) else v
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None):
+    dtype = canonical_dtype(dtype) if dtype else jnp.float32
+    shape = _size_to_shape(size)
+    low, high = _as_val(low), _as_val(high)
+    res = jax.random.uniform(_random.next_key(), shape, dtype,
+                             minval=low, maxval=high) \
+        if not (hasattr(low, "shape") or hasattr(high, "shape")) else \
+        jnp.asarray(low) + jax.random.uniform(
+            _random.next_key(),
+            jnp.broadcast_shapes(jnp.shape(low), jnp.shape(high), shape),
+            dtype) * (jnp.asarray(high) - jnp.asarray(low))
+    out_arr = _dev_wrap(res, ctx)
+    if out is not None:
+        out._data = out_arr._data
+        return out
+    return out_arr
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    dtype = canonical_dtype(dtype) if dtype else jnp.float32
+    shape = jnp.broadcast_shapes(jnp.shape(_as_val(loc)),
+                                 jnp.shape(_as_val(scale)),
+                                 _size_to_shape(size))
+    res = jnp.asarray(_as_val(loc)) + jnp.asarray(_as_val(scale)) * \
+        jax.random.normal(_random.next_key(), shape, dtype)
+    out_arr = _dev_wrap(res, ctx)
+    if out is not None:
+        out._data = out_arr._data
+        return out
+    return out_arr
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size=size or None)
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size=size or None)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    # the reference defaults to int64; under jax's 32-bit default that
+    # truncates with a warning, so default to the platform int instead
+    dtype = canonical_dtype(dtype) if dtype is not None else jnp.int32
+    res = jax.random.randint(_random.next_key(), _size_to_shape(size),
+                             low, high, dtype=dtype)
+    out_arr = _dev_wrap(res, ctx)
+    if out is not None:
+        out._data = out_arr._data
+        return out
+    return out_arr
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    a_val = _as_val(a)
+    if isinstance(a_val, int):
+        a_val = jnp.arange(a_val)
+    else:
+        a_val = jnp.asarray(a_val)
+    p_val = None if p is None else jnp.asarray(_as_val(p))
+    res = jax.random.choice(_random.next_key(), a_val, _size_to_shape(size),
+                            replace=replace, p=p_val)
+    out_arr = _dev_wrap(res, ctx)
+    if out is not None:
+        out._data = out_arr._data
+        return out
+    return out_arr
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (ref: numpy/random.py shuffle)."""
+    perm = jax.random.permutation(_random.next_key(), x.shape[0])
+    x._data = jnp.take(x._data, perm, axis=0)
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return ndarray(jax.random.permutation(_random.next_key(), x))
+    arr = _array(x)
+    perm = jax.random.permutation(_random.next_key(), arr.shape[0])
+    return ndarray(jnp.take(arr._data, perm, axis=0))
+
+
+def multinomial(n, pvals, size=None):
+    pv = jnp.asarray(_as_val(pvals))
+    shape = _size_to_shape(size)
+    draws = jax.random.categorical(
+        _random.next_key(), jnp.log(pv), shape=shape + (n,))
+    counts = jax.vmap(lambda d: jnp.bincount(d, length=pv.shape[0]))(
+        draws.reshape(-1, n)).reshape(shape + (pv.shape[0],))
+    return ndarray(counts)
+
+
+def _draw(transform, params, size, dtype, ctx):
+    """Shared tail for the parametric samplers: broadcast the distribution
+    parameters against ``size``, draw, place on the target context."""
+    dtype = canonical_dtype(dtype) if dtype else jnp.float32
+    vals = [jnp.asarray(_as_val(p), dtype) for p in params]
+    shape = jnp.broadcast_shapes(*[v.shape for v in vals],
+                                 _size_to_shape(size))
+    return _dev_wrap(transform(_random.next_key(), shape, dtype, *vals), ctx)
+
+
+def gamma(shape=1.0, scale=1.0, size=None, dtype=None, ctx=None):
+    return _draw(lambda k, s, dt, a, sc: jax.random.gamma(k, a, s, dt) * sc,
+                 (shape, scale), size, dtype, ctx)
+
+
+def beta(a=1.0, b=1.0, size=None, dtype=None, ctx=None):
+    return _draw(lambda k, s, dt, av, bv: jax.random.beta(k, av, bv, s, dt),
+                 (a, b), size, dtype, ctx)
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None):
+    return _draw(lambda k, s, dt, sc: jax.random.exponential(k, s, dt) * sc,
+                 (scale,), size, dtype, ctx)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    return _draw(
+        lambda k, s, dt, lo, sc: lo + sc * jax.random.laplace(k, s, dt),
+        (loc, scale), size, dtype, ctx)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    return _draw(
+        lambda k, s, dt, lo, sc: lo + sc * jax.random.gumbel(k, s, dt),
+        (loc, scale), size, dtype, ctx)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    return _draw(
+        lambda k, s, dt, lo, sc: lo + sc * jax.random.logistic(k, s, dt),
+        (loc, scale), size, dtype, ctx)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None):
+    return _draw(
+        lambda k, s, dt, m, sg:
+        jnp.exp(m + sg * jax.random.normal(k, s, dt)),
+        (mean, sigma), size, dtype, ctx)
+
+
+def pareto(a=1.0, size=None, dtype=None, ctx=None):
+    return _draw(lambda k, s, dt, av: jax.random.pareto(k, av, s, dt) - 1.0,
+                 (a,), size, dtype, ctx)
+
+
+def power(a=1.0, size=None, dtype=None, ctx=None):
+    return _draw(
+        lambda k, s, dt, av: jax.random.uniform(k, s, dt) ** (1.0 / av),
+        (a,), size, dtype, ctx)
+
+
+def rayleigh(scale=1.0, size=None, dtype=None, ctx=None):
+    return _draw(
+        lambda k, s, dt, sc:
+        sc * jnp.sqrt(-2.0 * jnp.log1p(-jax.random.uniform(k, s, dt))),
+        (scale,), size, dtype, ctx)
+
+
+def weibull(a=1.0, size=None, dtype=None, ctx=None):
+    return _draw(
+        lambda k, s, dt, av:
+        (-jnp.log1p(-jax.random.uniform(k, s, dt))) ** (1.0 / av),
+        (a,), size, dtype, ctx)
+
+
+def chisquare(df=1.0, size=None, dtype=None, ctx=None):
+    return _draw(
+        lambda k, s, dt, d: 2.0 * jax.random.gamma(k, d / 2.0, s, dt),
+        (df,), size, dtype, ctx)

@@ -1,0 +1,128 @@
+"""mx.npx — operators and utilities beyond the NumPy standard
+(ref: python/mxnet/numpy_extension/__init__.py; op kernels in
+src/operator/numpy/). Bridges the deep-learning op registry (Activation,
+BatchNorm, Convolution, …) into the np-array world: inputs/outputs are
+``mx.np.ndarray`` and everything records on the autograd tape."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import util
+from ..util import (set_np, reset_np, set_np_shape, is_np_shape,
+                    is_np_array, use_np, use_np_shape, use_np_array,
+                    np_shape, np_array)  # noqa: F401
+from ..context import cpu, gpu, tpu, num_gpus, num_tpus, \
+    current_context  # noqa: F401
+from .. import random as _random
+from ..ndarray import register as _register
+from ..ndarray.ndarray import NDArray
+from ..numpy.multiarray import ndarray, _np_invoke
+
+__all__ = ["set_np", "reset_np", "set_np_shape", "is_np_shape",
+           "is_np_array", "use_np", "use_np_shape", "use_np_array",
+           "np_shape", "np_array", "cpu", "gpu", "tpu", "num_gpus",
+           "num_tpus", "current_context", "seed", "waitall", "load",
+           "save", "reshape_like", "arange_like"]
+
+
+def seed(s):
+    _random.seed(s)
+
+
+def waitall():
+    from .. import ndarray as nd
+    nd.waitall()
+
+
+def save(file, arr):
+    """Save np arrays (dict/list/single) (ref: npx save → MXNDArraySave)."""
+    from ..ndarray import save as _save
+    _save(file, arr)
+
+
+def load(file):
+    from ..ndarray import load as _load
+    out = _load(file)
+    if isinstance(out, dict):
+        return {k: ndarray._adopt(v) for k, v in out.items()}
+    if isinstance(out, list):
+        return [ndarray._adopt(v) for v in out]
+    return ndarray._adopt(out)
+
+
+def reshape_like(lhs, rhs):
+    """Reshape lhs to rhs's shape (ref: src/operator/tensor/
+    elemwise_unary_op_basic.cc reshape_like)."""
+    return _np_invoke(lambda a, b: jnp.reshape(a, b.shape), (lhs, rhs), {},
+                      op_name="reshape_like")
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """ref: src/operator/tensor/init_op.cc _npx_arange_like."""
+    def fn(x):
+        if axis is None:
+            n = x.size
+            out = start + step * jnp.arange(n, dtype=x.dtype)
+            return out.reshape(x.shape)
+        n = x.shape[axis]
+        return start + step * jnp.arange(n, dtype=x.dtype)
+    return _np_invoke(fn, (data,), {}, op_name="arange_like")
+
+
+# -- registry-op bridge ------------------------------------------------------
+# npx exposes the nn op surface with np-array outputs; same kernels as mx.nd
+# (ref: python/mxnet/ndarray/numpy_extension/ generated wrappers)
+_NPX_OPS = [
+    "Activation", "BatchNorm", "Convolution", "Deconvolution", "Pooling",
+    "FullyConnected", "Dropout", "Embedding", "LayerNorm", "GroupNorm",
+    "InstanceNorm", "L2Normalization", "LeakyReLU", "RNN", "softmax",
+    "log_softmax", "masked_softmax", "topk", "pick", "one_hot", "batch_dot",
+    "gather_nd", "scatter_nd", "relu", "sigmoid", "smooth_l1",
+    "sequence_mask", "broadcast_like", "SequenceMask", "SequenceLast",
+    "SequenceReverse", "shape_array", "stop_gradient",
+]
+
+
+def _np_op_wrapper(name):
+    try:
+        from ..ops.registry import get_op
+        opdef = get_op(name)
+    except KeyError:
+        return None
+
+    def fn(*args, **kwargs):
+        out = _register.invoke(opdef, args, kwargs)
+        if isinstance(out, tuple):
+            return tuple(ndarray._adopt(o) if isinstance(o, NDArray) else o
+                         for o in out)
+        return ndarray._adopt(out) if isinstance(out, NDArray) else out
+    fn.__name__ = name
+    fn.__doc__ = "mx.npx.%s — registry op with np-array outputs " \
+        "(ref: python/mxnet/ndarray/numpy_extension/)" % name
+    return fn
+
+
+import re as _re
+
+# names whose mechanical camel→snake split is wrong (acronym runs)
+_SNAKE_SPECIAL = {"LeakyReLU": "leaky_relu", "RNN": "rnn",
+                  "L2Normalization": "l2_normalization"}
+
+
+def _snake(name):
+    special = _SNAKE_SPECIAL.get(name)
+    if special is not None:
+        return special
+    return _re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", name).lower()
+
+
+for _name in _NPX_OPS:
+    _fn = _np_op_wrapper(_name)
+    if _fn is not None:
+        globals()[_name] = _fn
+        # npx uses snake_case names for nn ops (npx.fully_connected etc.,
+        # ref: python/mxnet/ndarray/numpy_extension/_op.py)
+        lower = _snake(_name)
+        if lower not in globals():
+            globals()[lower] = _fn
+        __all__.append(_name)

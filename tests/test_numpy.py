@@ -1,0 +1,242 @@
+"""mx.np / mx.npx frontend tests (ref: tests/python/unittest/test_numpy_op.py,
+test_numpy_ndarray.py, numpy_dispatch_protocol tests)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+
+
+class TestNdarray:
+    def test_zero_dim(self):
+        a = np.array(3.5)
+        assert a.shape == ()
+        assert float(a) == 3.5
+        assert a.ndim == 0
+
+    def test_creation(self):
+        assert np.zeros((2, 3)).dtype == onp.float32
+        assert np.ones((2,), dtype=np.int32).dtype == onp.int32
+        assert np.full((2,), 7.0).asnumpy().tolist() == [7.0, 7.0]
+        assert np.arange(5).shape == (5,)
+        assert np.eye(3).asnumpy()[1, 1] == 1
+        a, step = np.linspace(0, 1, 5, retstep=True)
+        assert a.shape == (5,) and abs(step - 0.25) < 1e-6
+
+    def test_float64_input_downcast(self):
+        # reference np default dtype is float32
+        assert np.array([1.0, 2.0]).dtype == onp.float32
+
+    def test_operators_promotion(self):
+        a = np.array([1.0, 2.0])
+        b = np.arange(2)  # float32 by reference convention
+        assert (a + b).dtype == onp.float32
+        assert (a / 2).asnumpy().tolist() == [0.5, 1.0]
+        assert (a // 2).asnumpy().tolist() == [0.0, 1.0]
+        assert (a ** 2).asnumpy().tolist() == [1.0, 4.0]
+        assert (a @ a).shape == ()
+
+    def test_boolean_indexing(self):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]])
+        assert a[a > 2].asnumpy().tolist() == [3.0, 4.0]
+
+    def test_methods(self):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sum(axis=0).asnumpy().tolist() == [4.0, 6.0]
+        assert a.mean() .item() == 2.5
+        assert a.reshape(4).shape == (4,)
+        assert a.reshape(-1, 2).shape == (2, 2)
+        assert a.T.shape == (2, 2)
+        assert a.astype(np.int32).dtype == onp.int32
+        assert a.flatten().shape == (4,)
+        assert int(a.argmax()) == 3
+        assert a.clip(2.0, 3.0).asnumpy().max() == 3.0
+        assert a.tolist() == [[1.0, 2.0], [3.0, 4.0]]
+
+    def test_bool_ambiguity(self):
+        with pytest.raises(ValueError):
+            bool(np.array([1.0, 2.0]))
+        assert bool(np.array(1.0))
+
+    def test_conversions(self):
+        a = np.array([1.0])
+        nd = a.as_nd_ndarray()
+        assert type(nd) is mx.nd.NDArray
+        assert type(nd.as_np_ndarray()) is np.ndarray
+
+
+class TestFunctions:
+    def test_delegated_surface(self):
+        # a broad sample of the reference's mx.np function inventory
+        for name in ("sin", "cos", "exp", "log", "sqrt", "tanh", "where",
+                     "concatenate", "stack", "split", "tile", "repeat",
+                     "einsum", "tensordot", "matmul", "dot", "unique",
+                     "sort", "argsort", "maximum", "minimum", "isnan",
+                     "isinf", "broadcast_to", "expand_dims", "squeeze",
+                     "swapaxes", "moveaxis", "flip", "roll", "pad", "trace",
+                     "tril", "triu", "cumsum", "median", "percentile",
+                     "logical_and", "bincount", "meshgrid", "diff",
+                     "nan_to_num", "take_along_axis", "searchsorted"):
+            assert hasattr(np, name), name
+
+    def test_where_and_unique(self):
+        a = np.array([1.0, 2.0, 1.0])
+        u = np.unique(a)
+        assert u.asnumpy().tolist() == [1.0, 2.0]
+        w = np.where(a > 1.5, a, np.zeros_like(a))
+        assert w.asnumpy().tolist() == [0.0, 2.0, 0.0]
+
+    def test_concat_stack(self):
+        a, b = np.ones((2, 2)), np.zeros((2, 2))
+        assert np.concatenate([a, b], axis=0).shape == (4, 2)
+        assert np.stack([a, b]).shape == (2, 2, 2)
+        parts = np.split(np.ones((4, 6)), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == (4, 2)
+
+    def test_out_kwarg(self):
+        a = np.array([1.0, 2.0])
+        out = np.zeros((2,))
+        r = np.add(a, a, out=out)
+        assert r is out
+        assert out.asnumpy().tolist() == [2.0, 4.0]
+
+    def test_linalg(self):
+        a = np.array([[2.0, 0.0], [0.0, 3.0]])
+        assert abs(float(np.linalg.det(a)) - 6.0) < 1e-5
+        u, s, vt = np.linalg.svd(a)
+        assert sorted(s.asnumpy().tolist()) == [2.0, 3.0]
+        x = np.linalg.solve(a, np.array([2.0, 3.0]))
+        onp.testing.assert_allclose(x.asnumpy(), [1.0, 1.0], atol=1e-5)
+        assert abs(float(np.linalg.norm(a)) - onp.sqrt(13)) < 1e-5
+
+
+class TestAutograd:
+    def test_grad_through_np(self):
+        x = np.array([1.0, 2.0, 3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = (np.sin(x) ** 2).sum()
+        y.backward()
+        expect = 2 * onp.sin([1, 2, 3.0]) * onp.cos([1, 2, 3.0])
+        onp.testing.assert_allclose(x.grad.asnumpy(), expect, atol=1e-6)
+        assert isinstance(x.grad, np.ndarray)
+
+    def test_grad_through_linalg(self):
+        x = np.array([[3.0]])
+        x.attach_grad()
+        with autograd.record():
+            y = np.linalg.norm(x)
+        y.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), [[1.0]], atol=1e-6)
+
+    def test_mixed_np_nd_graph(self):
+        """np ops and registry ops share one tape."""
+        x = np.array([[1.0, -2.0]])
+        x.attach_grad()
+        with autograd.record():
+            h = npx.activation(x, act_type="relu")
+            y = (h * 3.0).sum()
+        y.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), [[3.0, 0.0]], atol=1e-6)
+
+
+class TestRandom:
+    def test_shapes_and_ranges(self):
+        npx.seed(42)
+        u = np.random.uniform(-2.0, 2.0, size=(100,))
+        assert u.shape == (100,)
+        assert float(u.min()) >= -2.0 and float(u.max()) <= 2.0
+        n = np.random.normal(0.0, 1.0, size=(50,))
+        assert n.shape == (50,)
+        r = np.random.randint(0, 10, size=(20,))
+        assert int(r.min()) >= 0 and int(r.max()) < 10
+        assert np.random.rand(2, 3).shape == (2, 3)
+        assert np.random.randn(2, 3).shape == (2, 3)
+        assert np.random.choice(5, size=(7,)).shape == (7,)
+        assert np.random.gamma(2.0, size=(4,)).shape == (4,)
+        assert np.random.exponential(size=(4,)).shape == (4,)
+
+    def test_seed_reproducible(self):
+        npx.seed(7)
+        a = np.random.uniform(size=(5,)).asnumpy()
+        npx.seed(7)
+        b = np.random.uniform(size=(5,)).asnumpy()
+        onp.testing.assert_array_equal(a, b)
+
+    def test_multinomial(self):
+        counts = np.random.multinomial(20, [0.5, 0.5], size=(3,))
+        assert counts.shape == (3, 2)
+        assert (counts.asnumpy().sum(axis=-1) == 20).all()
+
+    def test_shuffle_permutation(self):
+        x = np.arange(10)
+        np.random.shuffle(x)
+        assert sorted(x.asnumpy().tolist()) == list(range(10))
+        p = np.random.permutation(10)
+        assert sorted(p.asnumpy().tolist()) == list(range(10))
+
+
+class TestNpx:
+    def test_nn_ops_return_np(self):
+        x = np.array([[-1.0, 2.0]])
+        h = npx.activation(x, act_type="relu")
+        assert isinstance(h, np.ndarray)
+        assert h.asnumpy().tolist() == [[0.0, 2.0]]
+        s = npx.softmax(np.array([[1.0, 1.0]]))
+        onp.testing.assert_allclose(s.asnumpy(), [[0.5, 0.5]], atol=1e-6)
+
+    def test_fully_connected(self):
+        x = np.ones((2, 3))
+        w = np.ones((4, 3))
+        b = np.zeros((4,))
+        out = npx.fully_connected(x, w, b, num_hidden=4)
+        assert out.shape == (2, 4)
+        assert out.asnumpy()[0, 0] == 3.0
+
+    def test_reshape_arange_like(self):
+        assert npx.reshape_like(np.ones((6,)), np.ones((2, 3))).shape == (2, 3)
+        al = npx.arange_like(np.ones((2, 3)), axis=1)
+        assert al.asnumpy().tolist() == [0.0, 1.0, 2.0]
+        al2 = npx.arange_like(np.ones((2, 2)))
+        assert al2.shape == (2, 2)
+
+    def test_set_np_flags(self):
+        from mxnet_tpu import util
+        npx.set_np()
+        assert npx.is_np_array() and npx.is_np_shape()
+        npx.reset_np()
+        assert not npx.is_np_array()
+
+    def test_save_load_roundtrip(self, tmp_path):
+        f = str(tmp_path / "arrs")
+        npx.save(f, {"w": np.ones((2, 2))})
+        out = npx.load(f)
+        assert isinstance(out["w"], np.ndarray)
+        assert out["w"].asnumpy().tolist() == [[1.0, 1.0], [1.0, 1.0]]
+
+
+class TestReviewRegressions:
+    def test_sampler_kwargs_honored(self):
+        e = np.random.exponential(scale=100.0, size=(20000,))
+        assert abs(float(e.mean()) / 100.0 - 1.0) < 0.1
+        g = np.random.gamma(shape=9.0, size=(20000,))
+        assert abs(float(g.mean()) / 9.0 - 1.0) < 0.1
+        # NumPy positional form: exponential(scale, size)
+        assert np.random.exponential(2.0, 100).shape == (100,)
+
+    def test_where_kwarg_rejected(self):
+        with pytest.raises(TypeError):
+            np.add(np.array([1.0]), np.array([2.0]),
+                   where=np.array([True]))
+
+    def test_take_list_and_modes(self):
+        a = np.array([10.0, 20.0])
+        assert a.take([1, 0]).asnumpy().tolist() == [20.0, 10.0]
+        with pytest.raises(IndexError):
+            a.take(np.array([10], dtype="int32"))
+        assert a.take([5], mode="clip").asnumpy().tolist() == [20.0]
+        assert a.take([3], mode="wrap").asnumpy().tolist() == [20.0]
+
+    def test_leaky_relu_alias(self):
+        out = npx.leaky_relu(np.array([[-1.0, 1.0]]), slope=0.1)
+        onp.testing.assert_allclose(out.asnumpy(), [[-0.1, 1.0]], atol=1e-6)

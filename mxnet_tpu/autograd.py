@@ -120,10 +120,6 @@ class Node:
         self.in_datas = [a._data for a in inputs]  # record-time input buffers
 
 
-def _is_inexact(dtype):
-    return _np.issubdtype(_np.dtype(dtype), _np.inexact)
-
-
 def record_op(name, out_arrays, input_ndarrays, vjp_fn):
     """Attach a tape node to the freshly produced output NDArrays.
 

@@ -40,6 +40,16 @@ DTYPE_NAMES = {
 }
 
 
+def is_inexact_dtype(dt):
+    """True for float dtypes INCLUDING ml_dtypes extensions (bfloat16,
+    fp8...) that numpy's issubdtype does not place under np.inexact.
+    Single source of truth for 'is this differentiable?' checks."""
+    try:
+        return _jnp.issubdtype(dt, _jnp.inexact)
+    except TypeError:
+        return False
+
+
 def canonical_dtype(dtype):
     """Map a user dtype spec (str | numpy dtype | jnp dtype | None) to a numpy
     dtype object usable by jax."""

@@ -1,0 +1,4 @@
+"""Experimental / contributed subsystems
+(ref: python/mxnet/contrib/__init__.py): AMP, INT8 quantization, ONNX."""
+from . import amp  # noqa: F401
+from . import quantization  # noqa: F401

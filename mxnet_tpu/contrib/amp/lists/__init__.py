@@ -1,0 +1,2 @@
+"""AMP op lists (ref: python/mxnet/contrib/amp/lists/symbol.py)."""
+from . import symbol  # noqa: F401

@@ -1,0 +1,41 @@
+"""Op classification for automatic mixed precision
+(ref: python/mxnet/contrib/amp/lists/symbol.py FP16_FUNCS / FP32_FUNCS /
+FP16_FP32_FUNCS / WIDEST_TYPE_CASTS).
+
+TPU re-design: the target low precision is bfloat16, which shares
+float32's exponent range — so the FP32 list only needs ops whose
+*accumulation* precision matters (normalizations, softmax-with-reduction,
+losses), not the overflow-prone ops the fp16 list guards.
+"""
+
+# MXU-bound ops: always cast inputs to the target dtype — these are where
+# the FLOPs are, and bf16 doubles MXU throughput
+# (ref list: FP16_FUNCS — Convolution, FullyConnected, RNN ...)
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "RNN",
+    "dot", "batch_dot", "linalg_gemm", "linalg_gemm2",
+]
+
+# numerically sensitive ops: force float32 inputs
+# (ref list: FP32_FUNCS — softmax outputs, norms, exp/log family, losses)
+FP32_OPS = [
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+    "L2Normalization", "LRN", "softmax", "Softmax", "softmin",
+    "SoftmaxActivation", "SoftmaxOutput", "softmax_cross_entropy",
+    "smooth_l1", "MakeLoss", "exp", "expm1", "log", "log10", "log2",
+    "log1p", "log_softmax", "norm", "mean", "sum", "prod", "cumsum",
+    "erfinv", "gamma", "gammaln", "CTCLoss", "ctc_loss",
+]
+
+# multi-input elementwise ops: cast all inputs to the widest present dtype
+# (ref list: WIDEST_TYPE_CASTS)
+WIDEST_TYPE_CASTS = [
+    "add", "subtract", "multiply", "divide", "broadcast_add",
+    "broadcast_sub", "broadcast_mul", "broadcast_div", "maximum",
+    "minimum", "broadcast_maximum", "broadcast_minimum", "hypot",
+    "concat", "Concat", "stack", "where", "power", "broadcast_power",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+]
+
+# everything else runs in whatever dtype its inputs already have
+# (ref: FP16_FP32_FUNCS — the "don't care" set)

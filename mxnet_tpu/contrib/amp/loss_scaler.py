@@ -1,0 +1,47 @@
+"""Dynamic loss scaling (ref: python/mxnet/contrib/amp/loss_scaler.py).
+
+Kept for API compatibility and for float16 policies. bfloat16 — the TPU
+default — shares float32's exponent range, so overflow-driven rescaling
+is a no-op there in practice; the scaler still guards against inf/nan
+gradients from divergence."""
+from __future__ import annotations
+
+
+class LossScaler:
+    """ref: loss_scaler.py LossScaler — scale up after
+    ``scale_window`` clean steps, halve on overflow."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+        self._min_scale = 1.0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (ref: loss_scaler.py
+        has_overflow, fused multi_all_finite kernel). One device-side
+        reduction over all grads, ONE host sync — not one per parameter."""
+        import jax.numpy as jnp
+        checks = []
+        for p in params:
+            g = p.grad() if callable(getattr(p, "grad", None)) else p.grad
+            if g is None:
+                continue
+            checks.append(jnp.isfinite(g._data).all())
+        if not checks:
+            return False
+        return not bool(jnp.stack(checks).all())
+
+    def update_scale(self, overflow):
+        """ref: loss_scaler.py update_scale."""
+        if overflow:
+            self.loss_scale = max(self._min_scale,
+                                  self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped == self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -344,7 +344,9 @@ class HybridBlock(Block):
         params = self._all_params_list()
         param_datas = [p.data()._data for p in params]
         training = autograd.is_training()
-        sig = (tuple((a.shape, str(a.dtype)) for a in nd_args), training)
+        from ..ndarray import register as _op_register
+        sig = (tuple((a.shape, str(a.dtype)) for a in nd_args), training,
+               _op_register._amp_version)
         entry = self._cached_graph.get(sig)
         if entry is None:
             entry = self._build_cached_graph(params, training)

@@ -1,0 +1,2 @@
+"""Gluon contrib (ref: python/mxnet/gluon/contrib/__init__.py)."""
+from . import estimator  # noqa: F401

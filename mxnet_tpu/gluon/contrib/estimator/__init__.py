@@ -1,0 +1,4 @@
+"""Keras-like training facade (ref:
+python/mxnet/gluon/contrib/estimator/__init__.py)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import *  # noqa: F401,F403

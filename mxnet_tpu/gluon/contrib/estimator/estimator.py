@@ -1,0 +1,198 @@
+"""Estimator: Keras-like fit loop over Gluon models
+(ref: python/mxnet/gluon/contrib/estimator/estimator.py).
+
+Same API as the reference; the train step — forward, loss, backward,
+update — runs through the standard autograd/Trainer path, so a hybridized
+network executes as one fused XLA program per batch."""
+from __future__ import annotations
+
+import copy
+import logging
+
+from .... import autograd
+from ....metric import EvalMetric, Loss as MetricLoss, Accuracy
+from ... import Trainer
+from ...loss import Loss as GluonLoss
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, LoggingHandler, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """ref: estimator.py:44 Estimator."""
+
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        self.net = net
+        self.stop_training = False
+        if isinstance(loss, GluonLoss):
+            self.loss = [loss]
+        elif isinstance(loss, (list, tuple)) and \
+                all(isinstance(l, GluonLoss) for l in loss):
+            self.loss = list(loss)
+        else:
+            raise ValueError("loss must be a Loss or a list of Loss, "
+                             "got %s" % type(loss))
+        self.train_metrics = self._check_metrics(metrics)
+        if not self.train_metrics:
+            self.train_metrics = [Accuracy()]
+        # one Loss metric per loss fn (ref: estimator.py _add_default_training_metrics)
+        for l in self.loss:
+            self.train_metrics.append(
+                MetricLoss(name=l.__class__.__name__.lower()))
+        self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
+        for m in self.val_metrics:
+            m.name = "validation " + m.name
+
+        self.logger = logging.getLogger("Estimator")
+        self.logger.setLevel(logging.INFO)
+
+        from ....context import current_context
+        self.context = context if context is not None else [current_context()]
+        if not isinstance(self.context, (list, tuple)):
+            self.context = [self.context]
+        self._initialize(initializer)
+        self.trainer = trainer if trainer is not None else Trainer(
+            self.net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.max_epoch = None
+        self.max_batch = None
+
+    @staticmethod
+    def _check_metrics(metrics):
+        if metrics is None:
+            return []
+        metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        if not all(isinstance(m, EvalMetric) for m in metrics):
+            raise ValueError("metrics must be EvalMetric instances")
+        return list(metrics)
+
+    def _initialize(self, initializer):
+        params = self.net.collect_params()
+        uninitialized = any(p._data is None and p._deferred_init is None
+                            for p in params.values())
+        if uninitialized or initializer is not None:
+            try:
+                self.net.initialize(init=initializer, force_reinit=False)
+            except Exception:  # already initialized
+                pass
+
+    # -- evaluation -------------------------------------------------------
+    def evaluate_batch(self, val_batch, val_metrics, batch_axis=0):
+        data, label = val_batch
+        pred = self.net(data)
+        loss = [l(pred, label) for l in self.loss]
+        for metric in val_metrics:
+            if isinstance(metric, MetricLoss):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+    def evaluate(self, val_data, val_metrics=None, batch_axis=0):
+        """Run validation (ref: estimator.py evaluate)."""
+        val_metrics = val_metrics or self.val_metrics
+        for metric in val_metrics:
+            metric.reset()
+        for batch in val_data:
+            self.evaluate_batch(self._unpack(batch), val_metrics, batch_axis)
+        return val_metrics
+
+    # -- training ---------------------------------------------------------
+    @staticmethod
+    def _unpack(batch):
+        if hasattr(batch, "data"):  # DataBatch
+            data = batch.data[0]
+            label = batch.label[0] if batch.label else None
+            return data, label
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def fit_batch(self, train_batch, batch_axis=0):
+        """One train step (ref: estimator.py fit_batch)."""
+        data, label = self._unpack(train_batch)
+        with autograd.record():
+            pred = self.net(data)
+            loss = [l(pred, label) for l in self.loss]
+        for l in loss:
+            l.backward()
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        """ref: estimator.py fit — epochs or batches bound the run."""
+        if not (epochs is None) != (batches is None):
+            raise ValueError("one and only one of epochs or batches "
+                             "must be specified")
+        self.max_epoch = epochs
+        self.max_batch = batches
+        self.stop_training = False
+
+        event_handlers = self._prepare_default_handlers(val_data,
+                                                        event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize_handlers(event_handlers)
+
+        for handler in train_begin:
+            handler.train_begin(self)
+
+        while not self.stop_training:
+            for handler in epoch_begin:
+                handler.epoch_begin(self)
+            for batch in train_data:
+                for handler in batch_begin:
+                    handler.batch_begin(self, batch=batch)
+                data, label, pred, loss = self.fit_batch(batch, batch_axis)
+                bs = data.shape[batch_axis]
+                self.trainer.step(bs)
+                for handler in batch_end:
+                    handler.batch_end(self, batch=batch, pred=pred,
+                                      label=label, loss=loss)
+                if self.stop_training:
+                    break
+            for handler in epoch_end:
+                handler.epoch_end(self)
+
+        for handler in train_end:
+            handler.train_end(self)
+
+    def _prepare_default_handlers(self, val_data, event_handlers):
+        event_handlers = list(event_handlers or [])
+        added = []
+        if not any(isinstance(h, StoppingHandler) for h in event_handlers):
+            event_handlers.append(StoppingHandler(self.max_epoch,
+                                                  self.max_batch))
+        if not any(isinstance(h, MetricHandler) for h in event_handlers):
+            event_handlers.append(MetricHandler(self.train_metrics))
+            added.append("MetricHandler")
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler)
+                        for h in event_handlers):
+            event_handlers.append(ValidationHandler(val_data, self.evaluate))
+            added.append("ValidationHandler")
+        if not any(isinstance(h, LoggingHandler) for h in event_handlers):
+            event_handlers.append(LoggingHandler(
+                metrics=self.train_metrics + self.val_metrics))
+            added.append("LoggingHandler")
+        event_handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return event_handlers
+
+    @staticmethod
+    def _categorize_handlers(event_handlers):
+        train_begin, epoch_begin, batch_begin = [], [], []
+        batch_end, epoch_end, train_end = [], [], []
+        for handler in event_handlers:
+            if isinstance(handler, TrainBegin):
+                train_begin.append(handler)
+            if isinstance(handler, EpochBegin):
+                epoch_begin.append(handler)
+            if isinstance(handler, BatchBegin):
+                batch_begin.append(handler)
+            if isinstance(handler, BatchEnd):
+                batch_end.append(handler)
+            if isinstance(handler, EpochEnd):
+                epoch_end.append(handler)
+            if isinstance(handler, TrainEnd):
+                train_end.append(handler)
+        return (train_begin, epoch_begin, batch_begin, batch_end, epoch_end,
+                train_end)

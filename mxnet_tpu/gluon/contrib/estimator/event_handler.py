@@ -1,0 +1,309 @@
+"""Estimator event handlers (ref:
+python/mxnet/gluon/contrib/estimator/event_handler.py).
+
+Same lifecycle protocol as the reference: handlers implement any of the
+TrainBegin/TrainEnd/EpochBegin/EpochEnd/BatchBegin/BatchEnd mixins and are
+dispatched by the Estimator at the matching points of the fit loop.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as _np
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch epochs or max_batch batches
+    (ref: event_handler.py StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.max_epoch = self.max_epoch or estimator.max_epoch
+        self.max_batch = self.max_batch or estimator.max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch == self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch == self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset metrics each epoch, update each batch
+    (ref: event_handler.py MetricHandler)."""
+
+    def __init__(self, train_metrics):
+        self.train_metrics = train_metrics or []
+        self.priority = -_np.inf  # run first
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.train_metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs["pred"]
+        label = kwargs["label"]
+        loss = kwargs["loss"]
+        for metric in self.train_metrics:
+            from ....metric import Loss as _Loss
+            if isinstance(metric, _Loss):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Periodic validation runs (ref: event_handler.py ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.priority = priority
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Log training progress (ref: event_handler.py LoggingHandler)."""
+
+    LOG_PER_EPOCH = 1
+    LOG_PER_BATCH = 2
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=_np.inf):
+        self.metrics = metrics or []
+        self.log_interval = log_interval
+        self.priority = priority  # run last so metrics are updated
+        self.batch_index = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        estimator.logger.info("Training begin: using optimizer %s with "
+                              "current learning rate %.4f",
+                              estimator.trainer.optimizer.__class__.__name__,
+                              estimator.trainer.learning_rate)
+        if estimator.max_epoch:
+            estimator.logger.info("Train for %d epochs.", estimator.max_epoch)
+        else:
+            estimator.logger.info("Train for %d batches.",
+                                  estimator.max_batch)
+
+    def train_end(self, estimator, *args, **kwargs):
+        train_time = time.time() - self.train_start
+        msg = "Train finished using total %ds with %d epochs. " % (
+            train_time, self.current_epoch)
+        for metric in self.metrics:
+            name, value = metric.get()
+            msg += "%s: %.4f, " % (name, value)
+        estimator.logger.info(msg.rstrip(", "))
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        if self.log_interval == "batch" or \
+                self.log_interval == self.LOG_PER_BATCH:
+            self.batch_start = time.time()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if self.log_interval == "batch" or \
+                self.log_interval == self.LOG_PER_BATCH:
+            batch_time = time.time() - self.batch_start
+            msg = "[Epoch %d][Batch %d] " % (self.current_epoch,
+                                             self.batch_index)
+            msg += "time/batch: %.3fs " % batch_time
+            for metric in self.metrics:
+                name, value = metric.get()
+                msg += "%s: %.4f, " % (name, value)
+            estimator.logger.info(msg.rstrip(", "))
+        self.batch_index += 1
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        epoch_time = time.time() - self.epoch_start
+        msg = "[Epoch %d] finished in %.3fs: " % (self.current_epoch,
+                                                  epoch_time)
+        for metric in self.metrics:
+            name, value = metric.get()
+            msg += "%s: %.4f, " % (name, value)
+        estimator.logger.info(msg.rstrip(", "))
+        self.current_epoch += 1
+        self.batch_index = 0
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save model/trainer state periodically, keeping the best by a
+    monitored metric (ref: event_handler.py CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.verbose = verbose
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.saved_checkpoints = []
+        self.current_epoch = 0
+        self.current_batch = 0
+        if save_best and monitor is None:
+            raise ValueError("save_best requires a monitor metric")
+        if mode == "min" or (mode == "auto" and monitor is not None
+                             and "loss" in monitor.get()[0]):
+            self.monitor_op = _np.less
+            self.best = _np.inf
+        else:
+            self.monitor_op = _np.greater
+            self.best = -_np.inf
+
+    def train_begin(self, estimator, *args, **kwargs):
+        if not os.path.exists(self.model_dir):
+            os.makedirs(self.model_dir)
+
+    def _save(self, estimator, tag):
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        param_path = "%s-%s.params" % (prefix, tag)
+        estimator.net.save_parameters(param_path)
+        trainer_path = "%s-%s.states" % (prefix, tag)
+        estimator.trainer.save_states(trainer_path)
+        self.saved_checkpoints.append(tag)
+        while len(self.saved_checkpoints) > self.max_checkpoints:
+            old = self.saved_checkpoints.pop(0)
+            for suffix in (".params", ".states"):
+                path = "%s-%s%s" % (prefix, old, suffix)
+                if os.path.exists(path):
+                    os.remove(path)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator, "batch%d" % self.current_batch)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, "epoch%d" % self.current_epoch)
+        if self.save_best and self.monitor is not None:
+            _, value = self.monitor.get()
+            if self.monitor_op(value, self.best):
+                self.best = value
+                prefix = os.path.join(self.model_dir, self.model_prefix)
+                estimator.net.save_parameters("%s-best.params" % prefix)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when the monitored metric stops improving
+    (ref: event_handler.py EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        if mode == "min" or (mode == "auto" and "loss" in monitor.get()[0]):
+            self.monitor_op = _np.less
+        else:
+            self.monitor_op = _np.greater
+        if self.monitor_op == _np.greater:
+            self.min_delta *= 1
+        else:
+            self.min_delta *= -1
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.best = self.baseline if self.baseline is not None else (
+            _np.inf if self.monitor_op == _np.less else -_np.inf)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, value = self.monitor.get()
+        if self.monitor_op(value - self.min_delta, self.best):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                estimator.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            estimator.logger.info("[Epoch %d] EarlyStoppingHandler: "
+                                  "early stopping due to %s not improving",
+                                  self.stopped_epoch, self.monitor.get()[0])

@@ -42,13 +42,30 @@ def _spec(opdef):
     return sp
 
 
-def _is_inexact(dt):
-    return _np.issubdtype(_np.dtype(dt), _np.inexact)
+from ..base import is_inexact_dtype as _is_inexact  # noqa: E402
+
+
+# AMP input-cast hook (ref: python/mxnet/contrib/amp/amp.py:251 init —
+# the reference rewrites every generated op wrapper at init; here one hook
+# at the single dispatch choke point does the same job).
+# Signature: hook(op_name, args, kwargs) -> (args, kwargs)
+_amp_cast_hook = None
+# bumped on every hook change; HybridBlock mixes it into its compile-cache
+# key so graphs traced before amp.init() are not silently reused after
+_amp_version = 0
+
+
+def set_amp_cast_hook(hook):
+    global _amp_cast_hook, _amp_version
+    _amp_cast_hook = hook
+    _amp_version += 1
 
 
 def invoke(opdef, args, kwargs):
     spec = _spec(opdef)
     kwargs = dict(kwargs)
+    if _amp_cast_hook is not None:
+        args, kwargs = _amp_cast_hook(opdef.name, args, kwargs)
     if spec["has_key"] and kwargs.get("key") is None:
         kwargs["key"] = _random.next_key()
     if spec["has_training"] and "_training" not in kwargs:

@@ -54,11 +54,7 @@ bool_ = _onp.bool_
 bfloat16 = jnp.bfloat16
 
 
-def _is_inexact(dt):
-    try:
-        return jnp.issubdtype(dt, jnp.inexact)
-    except TypeError:
-        return False
+from ..base import is_inexact_dtype as _is_inexact  # noqa: E402
 
 
 def _wrap_out(x):

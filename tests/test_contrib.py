@@ -1,0 +1,289 @@
+"""contrib tests: AMP, quantization, estimator
+(ref: tests/python/gpu/test_contrib_amp.py, tests/python/quantization/,
+tests/python/unittest/test_gluon_estimator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.contrib import amp, quantization
+
+
+@pytest.fixture
+def amp_active():
+    amp.init()
+    yield
+    amp._reset()
+
+
+class TestAMP:
+    def test_dtype_policy(self, amp_active):
+        x = mx.nd.array(onp.random.randn(4, 8).astype("float32"))
+        w = mx.nd.array(onp.random.randn(16, 8).astype("float32"))
+        out = mx.nd.FullyConnected(x, w, None, num_hidden=16, no_bias=True)
+        assert str(out.dtype) == "bfloat16"  # MXU op ran low precision
+        assert str(mx.nd.softmax(out).dtype) == "float32"  # fp32 op
+
+    def test_widest_cast(self, amp_active):
+        a = mx.nd.array(onp.ones((2, 2), "float32")).astype("bfloat16")
+        b = mx.nd.array(onp.ones((2, 2), "float32"))
+        assert str((a + b).dtype) == "float32"
+
+    def test_grad_flows_through_casts(self, amp_active):
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(4, 8).astype("float32"))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        g = net.weight.grad().asnumpy()
+        assert str(net.weight.grad().dtype) == "float32"
+        assert onp.abs(g).sum() > 0
+
+    def test_trainer_overflow_skip(self, amp_active):
+        import jax.numpy as jnp
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        amp.init_trainer(tr)
+        loss_fn = gluon.loss.L2Loss()
+        x = mx.nd.array(onp.random.randn(4, 8).astype("float32"))
+        y = mx.nd.array(onp.random.randn(4, 4).astype("float32"))
+
+        def one_step():
+            with autograd.record():
+                with amp.scale_loss(loss_fn(net(x), y).mean(), tr) as sl:
+                    pass
+                sl.backward()
+
+        one_step()
+        w0 = net.weight.data().asnumpy().copy()
+        tr.step(4)
+        assert not onp.allclose(w0, net.weight.data().asnumpy())
+
+        one_step()
+        g = net.weight.grad()
+        g._data = g._data.at[0, 0].set(jnp.inf)
+        w0 = net.weight.data().asnumpy().copy()
+        s0 = tr._amp_loss_scaler.loss_scale
+        tr.step(4)
+        assert onp.allclose(w0, net.weight.data().asnumpy())  # skipped
+        assert tr._amp_loss_scaler.loss_scale == s0 / 2  # scale halved
+
+    def test_convert_hybrid_block(self, amp_active):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4), nn.BatchNorm(in_channels=8))
+        net.initialize()
+        amp.convert_hybrid_block(net)
+        dense, bn = net[0], net[1]
+        assert str(dense.weight.data().dtype) == "bfloat16"
+        assert str(bn.gamma.data().dtype) == "float32"  # norm stays fp32
+
+    def test_op_lists(self):
+        assert "FullyConnected" in amp.list_lp16_ops()
+        assert "softmax" in amp.list_fp32_ops()
+        assert "add" in amp.list_widest_type_cast()
+
+
+class TestQuantization:
+    def test_quantize_dequantize_roundtrip(self):
+        x = mx.nd.array(onp.linspace(-3, 3, 64).astype("float32"))
+        q, mn, mxr = quantization.quantize(x, -3.0, 3.0)
+        assert str(q.dtype) == "int8"
+        back = quantization.dequantize(q, mn, mxr)
+        assert onp.abs(back.asnumpy() - x.asnumpy()).max() < 3.0 / 127 + 1e-6
+
+    def test_entropy_threshold_gaussian(self):
+        a = onp.random.RandomState(0).randn(100000)
+        hist, edges = onp.histogram(a, bins=8001, range=(-5, 5))
+        t = quantization._get_optimal_threshold(hist, edges)
+        assert 2.0 < t < 5.0  # keeps most mass, clips far tail
+
+    def test_quantize_net_dense(self):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu", in_units=16),
+                nn.Dense(10, in_units=32))
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(32, 16).astype("float32"))
+        ref = net(x).asnumpy()
+        qnet = quantization.quantize_net(net, calib_data=[x],
+                                         calib_mode="naive")
+        out = qnet(x).asnumpy()
+        rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-8)
+        assert rel < 0.05, rel
+
+    def test_quantize_net_conv(self):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, in_channels=3))
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(4, 3, 8, 8).astype("float32"))
+        ref = net(x).asnumpy()
+        qnet = quantization.quantize_net(net, calib_data=[x],
+                                         calib_mode="naive")
+        out = qnet(x).asnumpy()
+        rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-8)
+        assert rel < 0.05, rel
+
+    def test_exclude_layers(self):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4))
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(2, 4).astype("float32"))
+        qnet = quantization.quantize_net(net, calib_data=[x],
+                                         exclude_layers=["0"])
+        assert isinstance(qnet[0], nn.Dense)  # untouched
+
+
+class TestEstimator:
+    def _data(self):
+        rng = onp.random.RandomState(0)
+        X = rng.randn(64, 10).astype("float32")
+        y = (X.sum(axis=1) > 0).astype("int64")
+        return [(mx.nd.array(X[i:i + 16]), mx.nd.array(y[i:i + 16]))
+                for i in range(0, 64, 16)]
+
+    def _net(self):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+        net.initialize()
+        return net
+
+    def test_fit_improves_accuracy(self):
+        from mxnet_tpu.gluon.contrib.estimator import Estimator
+        from mxnet_tpu.metric import Accuracy
+        net = self._net()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.01})
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=Accuracy(), trainer=tr)
+        est.fit(train_data=self._data(), epochs=10)
+        acc = [m for m in est.train_metrics if m.name == "accuracy"][0]
+        assert acc.get()[1] > 0.85
+
+    def test_validation_and_early_stopping(self):
+        from mxnet_tpu.gluon.contrib.estimator import (
+            Estimator, EarlyStoppingHandler)
+        from mxnet_tpu.metric import Accuracy
+        net = self._net()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.01})
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=Accuracy(), trainer=tr)
+        val_acc = [m for m in est.val_metrics
+                   if "accuracy" in m.name][0]
+        stop = EarlyStoppingHandler(monitor=val_acc, patience=2, mode="max")
+        est.fit(train_data=self._data(), val_data=self._data(), epochs=50,
+                event_handlers=[stop])
+        # early stopping must have ended it well before 50 epochs
+        assert stop.current_epoch < 50
+
+    def test_checkpoint_handler(self, tmp_path):
+        from mxnet_tpu.gluon.contrib.estimator import (
+            Estimator, CheckpointHandler)
+        from mxnet_tpu.metric import Accuracy
+        import os
+        net = self._net()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.01})
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=Accuracy(), trainer=tr)
+        ckpt = CheckpointHandler(str(tmp_path), model_prefix="m")
+        est.fit(train_data=self._data(), epochs=2, event_handlers=[ckpt])
+        assert os.path.exists(str(tmp_path / "m-epoch1.params"))
+        assert os.path.exists(str(tmp_path / "m-epoch2.states"))
+
+    def test_max_batches(self):
+        from mxnet_tpu.gluon.contrib.estimator import Estimator
+        from mxnet_tpu.metric import Accuracy
+        net = self._net()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.01})
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=Accuracy(), trainer=tr)
+        est.fit(train_data=self._data(), batches=3)
+        # stopped by batch count, not epochs
+        assert est.stop_training
+
+
+class TestReviewRegressions:
+    def test_unscale_no_double_divide(self, amp_active):
+        net = nn.Dense(2, in_units=2)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 1.0})
+        amp.init_trainer(tr)
+        x = mx.nd.array(onp.ones((1, 2), "float32"))
+        with autograd.record():
+            with amp.scale_loss(net(x).sum(), tr) as sl:
+                pass
+            sl.backward()
+        amp.unscale(tr)
+        g = net.weight.grad().asnumpy().copy()
+        w0 = net.weight.data().asnumpy().copy()
+        tr.step(1)
+        delta = onp.abs(w0 - net.weight.data().asnumpy()).max()
+        # lr=1, batch=1: delta must equal the unscaled grad, not grad/scale
+        assert abs(delta - onp.abs(g).max()) < 1e-5
+
+    def test_amp_applies_to_warm_hybridized_net(self, amp_active):
+        amp._reset()  # start without amp, warm the cache
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        net.hybridize()
+        x = mx.nd.array(onp.random.randn(2, 8).astype("float32"))
+        assert str(net(x).dtype) == "float32"
+        amp.init()
+        assert str(net(x).dtype) == "bfloat16"  # cache not silently reused
+
+    def test_quantize_hybridized_net(self):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(4))
+        net.initialize()
+        net.hybridize()
+        x = mx.nd.array(onp.random.randn(16, 8).astype("float32"))
+        ref = net(x).asnumpy()  # warm the cached graph
+        qnet = quantization.quantize_net(net, calib_data=[x],
+                                         calib_mode="naive")
+        out = qnet(x).asnumpy()
+        rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-8)
+        assert rel < 0.05, rel  # calibration saw real activations
+
+    def test_entropy_hist_accumulates_across_batches(self):
+        col = quantization.CalibrationCollector(mode="entropy")
+        rng = onp.random.RandomState(0)
+        col.collect("l", rng.randn(1000).astype("float32"))
+        col.collect("l", (rng.randn(1000) * 3).astype("float32"))
+        hist, _ = col.hists["l"]
+        assert hist.sum() == 2000  # both batches retained after range grew
+
+    def test_custom_op_lists_do_not_leak(self, amp_active):
+        amp._reset()
+        amp.init(target_precision_ops=["my_custom_op"])
+        assert "my_custom_op" in amp.list_lp16_ops()
+        amp._reset()
+        amp.init()
+        assert "my_custom_op" not in amp.list_lp16_ops()
+        from mxnet_tpu.contrib.amp.lists import symbol as L
+        assert "my_custom_op" not in L.TARGET_DTYPE_OPS
+
+    def test_stopping_handler_user_supplied_max_batch(self):
+        from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                       StoppingHandler)
+        from mxnet_tpu.metric import Accuracy
+        rng = onp.random.RandomState(0)
+        X = rng.randn(64, 10).astype("float32")
+        y = (X.sum(axis=1) > 0).astype("int64")
+        data = [(mx.nd.array(X[i:i + 16]), mx.nd.array(y[i:i + 16]))
+                for i in range(0, 64, 16)]
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.01})
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=Accuracy(), trainer=tr)
+        handler = StoppingHandler()  # user-supplied, unparameterized
+        est.fit(train_data=data, batches=2, event_handlers=[handler])
+        assert handler.current_batch == 2  # synced max_batch, stopped

@@ -1,0 +1,110 @@
+"""ctypes loader for the native C++ runtime library.
+
+Analog of the reference's libmxnet.so discovery + ctypes FFI
+(ref: python/mxnet/libinfo.py find_lib_path, python/mxnet/base.py _load_lib):
+locates ``libmxnet_tpu.so`` next to the package, builds it from ``src/``
+with g++ on first use if missing (the reference ships a prebuilt binary;
+here the toolchain is part of the environment), and exposes the C ABI with
+the reference's error convention — nonzero return → raise with
+``MXTGetLastError()``.
+
+Set ``MXNET_TPU_NO_NATIVE=1`` to force the pure-Python fallbacks.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_TRIED = False
+
+
+def _lib_path():
+    return os.path.join(os.path.dirname(__file__), "libmxnet_tpu.so")
+
+
+def _src_dir():
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "src")
+
+
+def _build():
+    src = _src_dir()
+    if not os.path.isdir(src):
+        return False
+    try:
+        subprocess.run(["make", "-C", src], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_lib_path())
+    except Exception as e:  # compiler missing / build error → fallback
+        logging.debug("native build failed: %s", e)
+        return False
+
+
+def _declare(lib):
+    u64 = ctypes.c_uint64
+    p = ctypes.c_void_p
+    pp = ctypes.POINTER(ctypes.c_void_p)
+    charpp = ctypes.POINTER(ctypes.c_char_p)
+    intp = ctypes.POINTER(ctypes.c_int)
+    u64p = ctypes.POINTER(u64)
+    lib.MXTGetLastError.restype = ctypes.c_char_p
+    for name, argtypes in [
+        ("MXTRecordWriterCreate", [ctypes.c_char_p, pp]),
+        ("MXTRecordWriterWrite", [p, ctypes.c_char_p, u64]),
+        ("MXTRecordWriterTell", [p, u64p]),
+        ("MXTRecordWriterFree", [p]),
+        ("MXTRecordReaderCreate", [ctypes.c_char_p, pp]),
+        ("MXTRecordReaderNext", [p, charpp, u64p, intp]),
+        ("MXTRecordReaderSeek", [p, u64]),
+        ("MXTRecordReaderTell", [p, u64p]),
+        ("MXTRecordReaderFree", [p]),
+        ("MXTThreadedReaderCreate",
+         [ctypes.c_char_p, u64, ctypes.c_int, u64, pp]),
+        ("MXTThreadedReaderNext", [p, charpp, u64p, intp]),
+        ("MXTThreadedReaderReset", [p]),
+        ("MXTThreadedReaderFree", [p]),
+    ]:
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = ctypes.c_int
+    return lib
+
+
+def get_lib():
+    """The loaded native library, or None if unavailable/disabled."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LIB_LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("MXNET_TPU_NO_NATIVE", "0") == "1":
+            return None
+        path = _lib_path()
+        if not os.path.exists(path) and not _build():
+            return None
+        try:
+            _LIB = _declare(ctypes.CDLL(path))
+        except OSError as e:
+            logging.debug("native load failed: %s", e)
+            _LIB = None
+    return _LIB
+
+
+def check_call(ret):
+    """ref: python/mxnet/base.py check_call."""
+    if ret != 0:
+        from .base import MXNetError
+        raise MXNetError(get_lib().MXTGetLastError().decode("utf-8"))
+
+
+def native_available():
+    return get_lib() is not None
+
+
+available = native_available  # runtime.Features probe name

@@ -70,8 +70,9 @@ def _make_hook(target, fp32, widest, target_dtype):
                    if isinstance(a, NDArray) and _is_float(a.dtype)]
             if not dts or len({str(d) for d in dts}) == 1:
                 return args, kwargs
-            dt = "float32" if any(str(d) == "float32" for d in dts) \
-                else str(dts[0])
+            import functools
+            import jax.numpy as jnp
+            dt = str(functools.reduce(jnp.promote_types, dts))
         else:
             return args, kwargs
         args = tuple(_cast_nd(a, dt) for a in args)

@@ -26,6 +26,8 @@ class LossScaler:
         import jax.numpy as jnp
         checks = []
         for p in params:
+            if getattr(p, "grad_req", "write") == "null":
+                continue  # frozen param: no gradient to check
             g = p.grad() if callable(getattr(p, "grad", None)) else p.grad
             if g is None:
                 continue

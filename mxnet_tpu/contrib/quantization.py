@@ -255,15 +255,19 @@ class _QuantizedConv2D(HybridBlock):
 
 # -- driver -----------------------------------------------------------------
 
-def _walk_children(block):
+def _walk_children(block, prefix=""):
+    """Yield (parent, local_name, path, child) with dot-separated paths so
+    nested blocks with the same local name ('0' in two branches) stay
+    distinct in calibration stats and exclude matching."""
     for name, child in list(block._children.items()):
-        yield block, name, child
-        yield from _walk_children(child)
+        path = prefix + name if not prefix else prefix + "." + name
+        yield block, name, path, child
+        yield from _walk_children(child, path)
 
 
 def _iter_blocks(block):
     yield block
-    for _, _, child in _walk_children(block):
+    for _, _, _, child in _walk_children(block):
         yield child
 
 
@@ -280,10 +284,10 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     collector = CalibrationCollector(
         mode=calib_mode if calib_mode != "none" else "naive")
 
-    targets = [(parent, name, child)
-               for parent, name, child in _walk_children(network)
+    targets = [(parent, name, path, child)
+               for parent, name, path, child in _walk_children(network)
                if isinstance(child, (_nn.Dense, _nn.Conv2D))
-               and name not in exclude
+               and name not in exclude and path not in exclude
                and child.__class__.__name__ not in exclude
                and getattr(child, "_groups", 1) == 1
                and (isinstance(child, _nn.Dense)
@@ -299,10 +303,10 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
         for blk, _ in hybrid_state:
             blk._active = False
         hooks = []
-        for _, name, child in targets:
+        for _, _, path, child in targets:
             orig = child.forward
 
-            def hooked(x, *a, _name=name, _orig=orig, **kw):
+            def hooked(x, *a, _name=path, _orig=orig, **kw):
                 collector.collect(_name, x)
                 return _orig(x, *a, **kw)
             child.forward = hooked
@@ -328,8 +332,8 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             "Calibrated %d layers on %d examples (%s mode)",
             len(targets), seen, collector.mode)
 
-    for parent, name, child in targets:
-        thr = collector.threshold(name)
+    for parent, name, path, child in targets:
+        thr = collector.threshold(path)
         if isinstance(child, _nn.Dense):
             q = _QuantizedDense(child, thr)
         else:

@@ -116,7 +116,7 @@ class ImageRecordIter(DataIter):
     def _read_raw(self, key):
         if self._keys is not None:
             return self._rec.read_idx(key)
-        self._rec.handle.seek(self._offsets[key])
+        self._rec.seek_pos(self._offsets[key])
         return self._rec.read()
 
     def next(self):

@@ -9,48 +9,160 @@ unchanged: each record is
     uint32 lrec  = cflag << 29 | length      (cflag: 0 whole, 1/2/3 split)
     data[length] padded to a 4-byte boundary
 
-Unlike the reference (C++ dmlc::RecordIOWriter behind the C ABI), this is
-pure Python over buffered file IO — record parsing is not the TPU hot path;
-the batch decode/augment pipeline is where the time goes (see io/).
+Like the reference (C++ dmlc::RecordIOWriter behind the C ABI), the fast
+path is native: ``src/recordio.cc`` via ctypes (see ``_native.py``),
+including dmlc's split-on-embedded-magic writer semantics. A pure-Python
+implementation remains as fallback (``MXNET_TPU_NO_NATIVE=1``).
 """
 from __future__ import annotations
 
 import collections
+import ctypes
 import os
 import struct
 
 import numpy as np
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+from . import _native
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "ThreadedRecordReader",
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
+
+
+class ThreadedRecordReader:
+    """Background-thread prefetching record stream over the native library
+    (ref: dmlc::ThreadedIter + src/io/iter_prefetcher.h — the C++ producer
+    parses records off the Python GIL while the consumer drains a bounded
+    ring). Iterable; yields bytes. Requires the native build."""
+
+    def __init__(self, uri, capacity=256, shuffle=False, seed=0):
+        if not _native.native_available():
+            raise RuntimeError(
+                "ThreadedRecordReader requires the native library "
+                "(build src/ or unset MXNET_TPU_NO_NATIVE)")
+        self._lib = _native.get_lib()
+        h = ctypes.c_void_p()
+        _native.check_call(self._lib.MXTThreadedReaderCreate(
+            uri.encode("utf-8"), capacity, 1 if shuffle else 0, seed,
+            ctypes.byref(h)))
+        self._h = h
+
+    def read(self):
+        data = ctypes.c_char_p()
+        size = ctypes.c_uint64()
+        eof = ctypes.c_int()
+        _native.check_call(self._lib.MXTThreadedReaderNext(
+            self._h, ctypes.byref(data), ctypes.byref(size),
+            ctypes.byref(eof)))
+        if eof.value:
+            return None
+        return ctypes.string_at(data, size.value)
+
+    def reset(self):
+        _native.check_call(self._lib.MXTThreadedReaderReset(self._h))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.MXTThreadedReaderFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+    def __iter__(self):
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
 
 _kMagic = 0xced7230a
 _LREC_KIND_BITS = 29
 _LREC_LEN_MASK = (1 << _LREC_KIND_BITS) - 1
 
 
+class _NativeBackend:
+    """RecordIO over the C++ library (ref: src/c_api/ MXRecordIO* entries
+    → here src/c_api.cc MXTRecord*)."""
+
+    def __init__(self, uri, writable):
+        self._lib = _native.get_lib()
+        self.writable = writable
+        h = ctypes.c_void_p()
+        path = uri.encode("utf-8")
+        if writable:
+            _native.check_call(self._lib.MXTRecordWriterCreate(
+                path, ctypes.byref(h)))
+        else:
+            _native.check_call(self._lib.MXTRecordReaderCreate(
+                path, ctypes.byref(h)))
+        self._h = h
+
+    def close(self):
+        if self._h:
+            if self.writable:
+                self._lib.MXTRecordWriterFree(self._h)
+            else:
+                self._lib.MXTRecordReaderFree(self._h)
+            self._h = None
+
+    def write(self, buf):
+        _native.check_call(self._lib.MXTRecordWriterWrite(
+            self._h, bytes(buf), len(buf)))
+
+    def read(self):
+        data = ctypes.c_char_p()
+        size = ctypes.c_uint64()
+        eof = ctypes.c_int()
+        _native.check_call(self._lib.MXTRecordReaderNext(
+            self._h, ctypes.byref(data), ctypes.byref(size),
+            ctypes.byref(eof)))
+        if eof.value:
+            return None
+        return ctypes.string_at(data, size.value)
+
+    def tell(self):
+        pos = ctypes.c_uint64()
+        fn = self._lib.MXTRecordWriterTell if self.writable \
+            else self._lib.MXTRecordReaderTell
+        _native.check_call(fn(self._h, ctypes.byref(pos)))
+        return pos.value
+
+    def seek(self, pos):
+        _native.check_call(self._lib.MXTRecordReaderSeek(self._h, pos))
+
+
 class MXRecordIO:
-    """Sequential .rec reader/writer (ref: recordio.py MXRecordIO)."""
+    """Sequential .rec reader/writer (ref: recordio.py MXRecordIO).
+    Uses the native C++ codec when available."""
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._backend = None
         self.writable = None
         self.open()
 
     def open(self):
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("invalid flag %s" % self.flag)
+        if _native.native_available():
+            self._backend = _NativeBackend(self.uri, self.writable)
+            self.handle = None
+        else:
+            self._backend = None
+            self.handle = open(self.uri, "wb" if self.writable else "rb")
         self.pid = os.getpid()
 
     def close(self):
+        if self._backend is not None:
+            self._backend.close()
+            self._backend = None
         if self.handle is not None:
             self.handle.close()
             self.handle = None
@@ -61,6 +173,7 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["handle"] = None
+        d["_backend"] = None
         return d
 
     def __setstate__(self, d):
@@ -78,9 +191,21 @@ class MXRecordIO:
         self.close()
         self.open()
 
+    def seek_pos(self, pos):
+        """Seek to an absolute byte offset (reader only)."""
+        assert not self.writable
+        self._check_pid()
+        if self._backend is not None:
+            self._backend.seek(pos)
+        else:
+            self.handle.seek(pos)
+
     def write(self, buf):
         assert self.writable
         self._check_pid()
+        if self._backend is not None:
+            self._backend.write(buf)
+            return
         length = len(buf)
         # no multi-part splitting: records here are written whole (cflag=0);
         # readers still understand split records produced by dmlc writers
@@ -92,11 +217,15 @@ class MXRecordIO:
             self.handle.write(b"\x00" * pad)
 
     def tell(self):
+        if self._backend is not None:
+            return self._backend.tell()
         return self.handle.tell()
 
     def read(self):
         assert not self.writable
         self._check_pid()
+        if self._backend is not None:
+            return self._backend.read()
         parts = []
         magic_bytes = struct.pack("<I", _kMagic)
         while True:
@@ -167,9 +296,7 @@ class MXIndexedRecordIO(MXRecordIO):
         return d
 
     def seek(self, idx):
-        assert not self.writable
-        self._check_pid()
-        self.handle.seek(self.idx[idx])
+        self.seek_pos(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

@@ -1,0 +1,169 @@
+// C ABI for the native runtime pieces of mxnet_tpu.
+//
+// Mirrors the reference's C API conventions (ref: include/mxnet/c_api.h,
+// src/c_api/c_api_error.cc): every entry point returns 0 on success / -1
+// on failure, with the message retrievable from MXTGetLastError()
+// (thread-local, like the reference's error ring). Handles are opaque
+// pointers owned by the caller until the matching *Free call.
+#include <cstring>
+#include <exception>
+#include <string>
+#include <vector>
+
+#include "recordio.h"
+#include "threaded_reader.h"
+
+namespace {
+thread_local std::string last_error;
+
+int Fail(const std::string& msg) {
+  last_error = msg;
+  return -1;
+}
+
+#define API_BEGIN() try {
+#define API_END()                               \
+  }                                             \
+  catch (const std::exception& e) {             \
+    return Fail(e.what());                      \
+  }                                             \
+  catch (...) {                                 \
+    return Fail("unknown C++ exception");       \
+  }                                             \
+  return 0;
+}  // namespace
+
+extern "C" {
+
+const char* MXTGetLastError() { return last_error.c_str(); }
+
+// -- RecordWriter -----------------------------------------------------------
+int MXTRecordWriterCreate(const char* path, void** out) {
+  API_BEGIN()
+  auto* w = new mxnet_tpu::RecordWriter(path);
+  if (!w->ok()) {
+    delete w;
+    return Fail(std::string("cannot open for write: ") + path);
+  }
+  *out = w;
+  API_END()
+}
+
+int MXTRecordWriterWrite(void* handle, const char* data, uint64_t size) {
+  API_BEGIN()
+  static_cast<mxnet_tpu::RecordWriter*>(handle)->Write(data, size);
+  API_END()
+}
+
+int MXTRecordWriterTell(void* handle, uint64_t* out) {
+  API_BEGIN()
+  *out = static_cast<mxnet_tpu::RecordWriter*>(handle)->Tell();
+  API_END()
+}
+
+int MXTRecordWriterFree(void* handle) {
+  API_BEGIN()
+  delete static_cast<mxnet_tpu::RecordWriter*>(handle);
+  API_END()
+}
+
+// -- RecordReader -----------------------------------------------------------
+int MXTRecordReaderCreate(const char* path, void** out) {
+  API_BEGIN()
+  auto* r = new mxnet_tpu::RecordReader(path);
+  if (!r->ok()) {
+    delete r;
+    return Fail(std::string("cannot open for read: ") + path);
+  }
+  *out = r;
+  API_END()
+}
+
+// *out_data points into an internal buffer valid until the next call on
+// this handle; *out_size==0 with rc==0 and *eof==1 signals end of stream.
+int MXTRecordReaderNext(void* handle, const char** out_data,
+                        uint64_t* out_size, int* eof) {
+  API_BEGIN()
+  thread_local std::vector<char> buf;
+  auto* r = static_cast<mxnet_tpu::RecordReader*>(handle);
+  uint64_t at = r->Tell();
+  switch (r->Next(&buf)) {
+    case mxnet_tpu::ReadStatus::kRecord:
+      *out_data = buf.data();
+      *out_size = buf.size();
+      *eof = 0;
+      break;
+    case mxnet_tpu::ReadStatus::kEOF:
+      *out_data = nullptr;
+      *out_size = 0;
+      *eof = 1;
+      break;
+    case mxnet_tpu::ReadStatus::kCorrupt:
+      return Fail("invalid RecordIO stream at offset " + std::to_string(at));
+  }
+  API_END()
+}
+
+int MXTRecordReaderSeek(void* handle, uint64_t pos) {
+  API_BEGIN()
+  static_cast<mxnet_tpu::RecordReader*>(handle)->Seek(pos);
+  API_END()
+}
+
+int MXTRecordReaderTell(void* handle, uint64_t* out) {
+  API_BEGIN()
+  *out = static_cast<mxnet_tpu::RecordReader*>(handle)->Tell();
+  API_END()
+}
+
+int MXTRecordReaderFree(void* handle) {
+  API_BEGIN()
+  delete static_cast<mxnet_tpu::RecordReader*>(handle);
+  API_END()
+}
+
+// -- ThreadedRecordReader ---------------------------------------------------
+int MXTThreadedReaderCreate(const char* path, uint64_t capacity, int shuffle,
+                            uint64_t seed, void** out) {
+  API_BEGIN()
+  auto* r = new mxnet_tpu::ThreadedRecordReader(path, capacity, shuffle != 0,
+                                                seed);
+  if (!r->ok()) {
+    delete r;
+    return Fail(std::string("cannot open for read: ") + path);
+  }
+  *out = r;
+  API_END()
+}
+
+int MXTThreadedReaderNext(void* handle, const char** out_data,
+                          uint64_t* out_size, int* eof) {
+  API_BEGIN()
+  thread_local std::vector<char> buf;
+  auto* r = static_cast<mxnet_tpu::ThreadedRecordReader*>(handle);
+  if (r->Next(&buf)) {
+    *out_data = buf.data();
+    *out_size = buf.size();
+    *eof = 0;
+  } else {
+    if (!r->error().empty()) return Fail(r->error());
+    *out_data = nullptr;
+    *out_size = 0;
+    *eof = 1;
+  }
+  API_END()
+}
+
+int MXTThreadedReaderReset(void* handle) {
+  API_BEGIN()
+  static_cast<mxnet_tpu::ThreadedRecordReader*>(handle)->Reset();
+  API_END()
+}
+
+int MXTThreadedReaderFree(void* handle) {
+  API_BEGIN()
+  delete static_cast<mxnet_tpu::ThreadedRecordReader*>(handle);
+  API_END()
+}
+
+}  // extern "C"

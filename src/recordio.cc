@@ -1,0 +1,112 @@
+// See recordio.h. ref: dmlc-core recordio semantics as used by
+// src/io/iter_image_recordio_2.cc and python/mxnet/recordio.py.
+#include "recordio.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace mxnet_tpu {
+
+RecordWriter::RecordWriter(const std::string& path)
+    : fp_(std::fopen(path.c_str(), "wb")) {}
+
+RecordWriter::~RecordWriter() { Close(); }
+
+void RecordWriter::Close() {
+  if (fp_ != nullptr) {
+    std::fclose(fp_);
+    fp_ = nullptr;
+  }
+}
+
+uint64_t RecordWriter::Tell() { return static_cast<uint64_t>(std::ftell(fp_)); }
+
+void RecordWriter::WriteChunk(const void* data, size_t size, uint32_t cflag) {
+  if (size > kLRecLenMask) {
+    // the length field is 29 bits; dmlc-core CHECKs the same limit
+    throw std::runtime_error(
+        "RecordIO chunk exceeds 2^29-1 bytes; split the payload");
+  }
+  uint32_t header[2];
+  header[0] = kRecordIOMagic;
+  header[1] = (cflag << kLRecKindBits) | (static_cast<uint32_t>(size) & kLRecLenMask);
+  std::fwrite(header, sizeof(uint32_t), 2, fp_);
+  if (size != 0) std::fwrite(data, 1, size, fp_);
+  size_t pad = (4 - size % 4) % 4;
+  if (pad != 0) {
+    const char zeros[4] = {0, 0, 0, 0};
+    std::fwrite(zeros, 1, pad, fp_);
+  }
+}
+
+void RecordWriter::Write(const void* data, size_t size) {
+  const char* p = static_cast<const char*>(data);
+  // find 4-byte-aligned embedded magic words; split there (dropping them)
+  std::vector<size_t> splits;
+  uint32_t magic = kRecordIOMagic;
+  for (size_t i = 0; i + 4 <= size; i += 4) {
+    if (std::memcmp(p + i, &magic, 4) == 0) splits.push_back(i);
+  }
+  if (splits.empty()) {
+    WriteChunk(p, size, 0);
+    return;
+  }
+  size_t begin = 0;
+  for (size_t k = 0; k < splits.size(); ++k) {
+    uint32_t cflag = (k == 0) ? 1 : 2;
+    WriteChunk(p + begin, splits[k] - begin, cflag);
+    begin = splits[k] + 4;  // the dropped magic word
+  }
+  WriteChunk(p + begin, size - begin, 3);
+}
+
+RecordReader::RecordReader(const std::string& path)
+    : fp_(std::fopen(path.c_str(), "rb")) {}
+
+RecordReader::~RecordReader() { Close(); }
+
+void RecordReader::Close() {
+  if (fp_ != nullptr) {
+    std::fclose(fp_);
+    fp_ = nullptr;
+  }
+}
+
+void RecordReader::Seek(uint64_t pos) {
+  std::fseek(fp_, static_cast<long>(pos), SEEK_SET);
+}
+
+uint64_t RecordReader::Tell() { return static_cast<uint64_t>(std::ftell(fp_)); }
+
+ReadStatus RecordReader::Next(std::vector<char>* out) {
+  out->clear();
+  bool in_split = false;
+  uint32_t magic_word = kRecordIOMagic;
+  while (true) {
+    uint32_t header[2];
+    if (std::fread(header, sizeof(uint32_t), 2, fp_) != 2) {
+      // clean EOF only at a record boundary; mid-split truncation is an
+      // error (matches the Python fallback's IOError)
+      return in_split ? ReadStatus::kCorrupt : ReadStatus::kEOF;
+    }
+    if (header[0] != kRecordIOMagic) return ReadStatus::kCorrupt;
+    uint32_t cflag = header[1] >> kLRecKindBits;
+    size_t length = header[1] & kLRecLenMask;
+    if (in_split) {
+      // re-insert the magic dropped by the writer between parts
+      out->insert(out->end(), reinterpret_cast<char*>(&magic_word),
+                  reinterpret_cast<char*>(&magic_word) + 4);
+    }
+    size_t old = out->size();
+    out->resize(old + length);
+    if (length != 0 && std::fread(out->data() + old, 1, length, fp_) != length) {
+      return ReadStatus::kCorrupt;  // short payload
+    }
+    size_t pad = (4 - length % 4) % 4;
+    if (pad != 0) std::fseek(fp_, static_cast<long>(pad), SEEK_CUR);
+    if (cflag == 0 || cflag == 3) return ReadStatus::kRecord;
+    in_split = true;
+  }
+}
+
+}  // namespace mxnet_tpu

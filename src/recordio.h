@@ -1,0 +1,68 @@
+// RecordIO: dmlc-compatible binary record format.
+//
+// TPU-native reimplementation of the reference's record layer
+// (ref: 3rdparty/dmlc-core dmlc/recordio.h usage in src/io/io.cc;
+// python/mxnet/recordio.py). Byte-identical on disk:
+//
+//   uint32 magic = 0xced7230a
+//   uint32 lrec  = cflag << 29 | length   (cflag: 0 whole, 1 start,
+//                                          2 middle, 3 end)
+//   payload[length], zero-padded to a 4-byte boundary
+//
+// Writers split any payload that itself contains the magic word at those
+// positions (dropping the 4 magic bytes); readers re-insert the magic when
+// joining — dmlc-core RecordIOWriter/RecordIOReader semantics, which the
+// pure-Python layer does not implement (it writes cflag=0 only).
+#ifndef MXNET_TPU_RECORDIO_H_
+#define MXNET_TPU_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu {
+
+static const uint32_t kRecordIOMagic = 0xced7230a;
+static const uint32_t kLRecKindBits = 29;
+static const uint32_t kLRecLenMask = (1u << kLRecKindBits) - 1;
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path);
+  ~RecordWriter();
+  bool ok() const { return fp_ != nullptr; }
+  // Write one record, splitting on embedded magic words like dmlc.
+  void Write(const void* data, size_t size);
+  uint64_t Tell();
+  void Close();
+
+ private:
+  void WriteChunk(const void* data, size_t size, uint32_t cflag);
+  std::FILE* fp_;
+};
+
+enum class ReadStatus {
+  kRecord = 0,   // out holds a complete record
+  kEOF = 1,      // clean end of stream
+  kCorrupt = 2,  // bad magic / truncated split record / short payload
+};
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string& path);
+  ~RecordReader();
+  bool ok() const { return fp_ != nullptr; }
+  // Read the next (joined) record into out.
+  ReadStatus Next(std::vector<char>* out);
+  void Seek(uint64_t pos);
+  uint64_t Tell();
+  void Close();
+
+ private:
+  std::FILE* fp_;
+};
+
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_RECORDIO_H_

@@ -1,0 +1,106 @@
+// See threaded_reader.h.
+#include "threaded_reader.h"
+
+#include <algorithm>
+#include <random>
+
+namespace mxnet_tpu {
+
+ThreadedRecordReader::ThreadedRecordReader(const std::string& path,
+                                           size_t capacity,
+                                           bool shuffle_chunks,
+                                           uint64_t seed)
+    : path_(path), capacity_(capacity == 0 ? 256 : capacity),
+      shuffle_(shuffle_chunks), seed_(seed), ok_(false) {
+  RecordReader probe(path_);
+  ok_ = probe.ok();
+  if (ok_) worker_ = std::thread(&ThreadedRecordReader::Producer, this);
+}
+
+ThreadedRecordReader::~ThreadedRecordReader() { StopProducer(); }
+
+void ThreadedRecordReader::StopProducer() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = true;
+  }
+  cv_not_full_.notify_all();
+  cv_not_empty_.notify_all();
+  if (worker_.joinable()) worker_.join();
+}
+
+void ThreadedRecordReader::Producer() {
+  RecordReader reader(path_);
+  std::mt19937_64 rng(seed_);
+  // shuffle window: read up to capacity records, emit in random order
+  // (ref: iter_image_recordio_2.cc shuffle_chunk semantics)
+  std::vector<std::vector<char>> window;
+  std::vector<char> rec;
+  bool source_eof = false;
+  while (true) {
+    if (!source_eof && window.size() < (shuffle_ ? capacity_ : 1)) {
+      uint64_t at = reader.Tell();
+      ReadStatus st = reader.Next(&rec);
+      if (st == ReadStatus::kRecord) {
+        window.emplace_back(std::move(rec));
+        if (shuffle_ && window.size() < capacity_) continue;
+      } else {
+        if (st == ReadStatus::kCorrupt) {
+          std::lock_guard<std::mutex> lk(mu_);
+          error_ = "invalid RecordIO stream at offset " + std::to_string(at);
+        }
+        source_eof = true;
+      }
+    }
+    if (window.empty() && source_eof) break;
+    size_t pick = 0;
+    if (shuffle_ && window.size() > 1) {
+      pick = rng() % window.size();
+      std::swap(window[pick], window.back());
+    } else if (!window.empty()) {
+      std::swap(window[0], window.back());
+    }
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_not_full_.wait(lk, [this] {
+        return queue_.size() < capacity_ || stop_;
+      });
+      if (stop_) return;
+      queue_.emplace_back(std::move(window.back()));
+    }
+    window.pop_back();
+    cv_not_empty_.notify_one();
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    eof_ = true;
+  }
+  cv_not_empty_.notify_all();
+}
+
+bool ThreadedRecordReader::Next(std::vector<char>* out) {
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_not_empty_.wait(lk, [this] {
+    return !queue_.empty() || eof_ || stop_;
+  });
+  if (queue_.empty()) return false;
+  *out = std::move(queue_.front());
+  queue_.pop_front();
+  lk.unlock();
+  cv_not_full_.notify_one();
+  return true;
+}
+
+void ThreadedRecordReader::Reset() {
+  StopProducer();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.clear();
+    eof_ = false;
+    stop_ = false;
+    error_.clear();
+  }
+  worker_ = std::thread(&ThreadedRecordReader::Producer, this);
+}
+
+}  // namespace mxnet_tpu

@@ -1,0 +1,58 @@
+// Threaded prefetching record pipeline.
+//
+// TPU-native equivalent of the reference's dmlc::ThreadedIter double
+// buffering + prefetcher stack (ref: src/io/iter_prefetcher.h,
+// iter_image_recordio_2.cc ThreadedParser): a background IO thread reads
+// and splits records off the file while the consumer drains a bounded
+// ring — so record parsing never blocks the host->device feed. Runs
+// entirely outside the Python GIL.
+#ifndef MXNET_TPU_THREADED_READER_H_
+#define MXNET_TPU_THREADED_READER_H_
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "recordio.h"
+
+namespace mxnet_tpu {
+
+class ThreadedRecordReader {
+ public:
+  ThreadedRecordReader(const std::string& path, size_t capacity,
+                       bool shuffle_chunks, uint64_t seed);
+  ~ThreadedRecordReader();
+  bool ok() const { return ok_; }
+  // Pop the next record; false at end of stream. After a false return,
+  // error() is non-empty if the stream ended on corruption, not EOF.
+  bool Next(std::vector<char>* out);
+  const std::string& error() const { return error_; }
+  // Restart from the beginning of the file.
+  void Reset();
+
+ private:
+  void Producer();
+  void StopProducer();
+
+  std::string path_;
+  size_t capacity_;
+  bool shuffle_;
+  uint64_t seed_;
+  bool ok_;
+
+  std::string error_;
+  std::mutex mu_;
+  std::condition_variable cv_not_empty_;
+  std::condition_variable cv_not_full_;
+  std::deque<std::vector<char>> queue_;
+  bool eof_ = false;
+  bool stop_ = false;
+  std::thread worker_;
+};
+
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_THREADED_READER_H_

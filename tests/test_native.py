@@ -1,0 +1,202 @@
+"""Native C++ runtime tests: RecordIO codec + threaded reader
+(ref: tests/cpp/ engine/storage unit tests; tests/python/unittest/
+test_recordio.py)."""
+import os
+import struct
+
+import pytest
+
+from mxnet_tpu import _native
+from mxnet_tpu.recordio import (MXRecordIO, MXIndexedRecordIO,
+                                ThreadedRecordReader, _kMagic)
+
+needs_native = pytest.mark.skipif(not _native.native_available(),
+                                  reason="native library not built")
+
+RECORDS = [b"hello", b"x" * 1000,
+           struct.pack("<I", _kMagic) + b"tail",           # leading magic
+           b"abc" + struct.pack("<I", _kMagic) * 2 + b"e",  # unaligned magic
+           b"aaaa" + struct.pack("<I", _kMagic) + b"bbbb",  # aligned magic
+           b""]
+
+
+def _write_all(path, use_native):
+    env = {} if use_native else {"MXNET_TPU_NO_NATIVE": "1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    _native._LIB, _native._TRIED = None, False
+    try:
+        w = MXRecordIO(path, "w")
+        for r in RECORDS:
+            w.write(r)
+        w.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+        _native._LIB, _native._TRIED = None, False
+
+
+def _read_all(path, use_native):
+    env = {} if use_native else {"MXNET_TPU_NO_NATIVE": "1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    _native._LIB, _native._TRIED = None, False
+    try:
+        r = MXRecordIO(path, "r")
+        out = []
+        while True:
+            rec = r.read()
+            if rec is None:
+                break
+            out.append(rec)
+        r.close()
+        return out
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+        _native._LIB, _native._TRIED = None, False
+
+
+@needs_native
+@pytest.mark.parametrize("writer_native,reader_native",
+                         [(True, True), (True, False), (False, True)])
+def test_roundtrip_cross_impl(tmp_path, writer_native, reader_native):
+    """Native and Python implementations are byte-compatible, including
+    dmlc split records (payloads containing the magic word)."""
+    path = str(tmp_path / "t.rec")
+    _write_all(path, writer_native)
+    assert _read_all(path, reader_native) == RECORDS
+
+
+@needs_native
+def test_native_writer_splits_on_magic(tmp_path):
+    """The native writer emits dmlc-style split records for aligned
+    embedded magic words (cflag 1/3), unlike the Python fallback."""
+    path = str(tmp_path / "t.rec")
+    w = MXRecordIO(path, "w")
+    assert w._backend is not None
+    payload = b"aaaa" + struct.pack("<I", _kMagic) + b"bbbb"
+    w.write(payload)
+    w.close()
+    with open(path, "rb") as f:
+        raw = f.read()
+    magic, lrec = struct.unpack_from("<II", raw, 0)
+    assert magic == _kMagic
+    assert lrec >> 29 == 1  # first chunk of a split record
+
+
+@needs_native
+def test_indexed_native(tmp_path):
+    rec, idx = str(tmp_path / "a.rec"), str(tmp_path / "a.idx")
+    w = MXIndexedRecordIO(idx, rec, "w")
+    for i in range(20):
+        w.write_idx(i, b"payload-%03d" % i)
+    w.close()
+    r = MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(13) == b"payload-013"
+    assert r.read_idx(0) == b"payload-000"
+    assert r.keys == list(range(20))
+    r.close()
+
+
+@needs_native
+def test_threaded_reader(tmp_path):
+    path = str(tmp_path / "t.rec")
+    _write_all(path, True)
+    t = ThreadedRecordReader(path)
+    assert list(t) == RECORDS
+    t.reset()
+    assert list(t) == RECORDS
+    t.close()
+
+
+@needs_native
+def test_threaded_reader_shuffle_complete(tmp_path):
+    path = str(tmp_path / "s.rec")
+    w = MXRecordIO(path, "w")
+    recs = [b"r%04d" % i for i in range(100)]
+    for r in recs:
+        w.write(r)
+    w.close()
+    t = ThreadedRecordReader(path, capacity=16, shuffle=True, seed=3)
+    got = list(t)
+    t.close()
+    assert sorted(got) == sorted(recs)  # every record exactly once
+    assert got != recs  # and actually shuffled
+
+
+@needs_native
+def test_error_reporting():
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="cannot open"):
+        MXRecordIO("/nonexistent/dir/x.rec", "r")
+
+
+def test_runtime_feature_flag():
+    import mxnet_tpu.runtime as rt
+    feats = rt.feature_list()
+    names = {f.name for f in feats}
+    assert "NATIVE_ENGINE" in names
+
+
+@needs_native
+def test_corrupt_stream_raises(tmp_path):
+    """Native reader must raise on corruption, not silently truncate
+    (parity with the Python fallback's IOError)."""
+    from mxnet_tpu.base import MXNetError
+    path = str(tmp_path / "c.rec")
+    w = MXRecordIO(path, "w")
+    w.write(b"one")
+    w.write(b"two")
+    w.close()
+    with open(path, "r+b") as f:
+        f.seek(12)  # corrupt the second record's magic
+        f.write(b"\xde\xad\xbe\xef")
+    r = MXRecordIO(path, "r")
+    assert r.read() == b"one"
+    with pytest.raises(MXNetError, match="invalid RecordIO"):
+        r.read()
+    r.close()
+
+
+@needs_native
+def test_amp_widest_promotes_not_narrows():
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import amp
+    amp.init()
+    try:
+        a = mx.nd.array(onp.ones((2, 2), "float32")).astype("bfloat16")
+        b = mx.nd.array(onp.ones((2, 2), "float16"))
+        # bf16 + fp16 promote to fp32 under jnp rules, never narrow
+        assert str((a + b).dtype) == "float32"
+    finally:
+        amp._reset()
+
+
+def test_quantize_nested_blocks_distinct_thresholds():
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.contrib import quantization
+
+    class TwoBranch(nn.HybridSequential):
+        pass
+
+    outer = nn.HybridSequential()
+    b1, b2 = nn.HybridSequential(), nn.HybridSequential()
+    b1.add(nn.Dense(4, in_units=4))
+    b2.add(nn.Dense(4, in_units=4))
+    outer.add(b1, b2)
+    outer.initialize()
+    x = mx.nd.array(onp.random.randn(8, 4).astype("float32"))
+    outer(x)
+    col = quantization.CalibrationCollector()
+    # both branches' inner layers are locally named "0" but must calibrate
+    # under distinct dotted paths
+    paths = [path for _, _, path, child
+             in quantization._walk_children(outer)
+             if isinstance(child, nn.Dense)]
+    assert len(set(paths)) == 2

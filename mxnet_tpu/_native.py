@@ -36,8 +36,18 @@ def _build():
     if not os.path.isdir(src):
         return False
     try:
-        subprocess.run(["make", "-C", src], check=True,
-                       capture_output=True, timeout=120)
+        import fcntl
+        # serialize concurrent first-use builds (forked dataloader workers,
+        # pytest-xdist): without the lock a second process can CDLL a
+        # half-linked .so while make is still writing it
+        with open(os.path.join(src, ".build.lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if not os.path.exists(_lib_path()):
+                    subprocess.run(["make", "-C", src], check=True,
+                                   capture_output=True, timeout=120)
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
         return os.path.exists(_lib_path())
     except Exception as e:  # compiler missing / build error → fallback
         logging.debug("native build failed: %s", e)

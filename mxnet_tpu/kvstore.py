@@ -25,6 +25,7 @@ step (see mxnet_tpu.parallel). The KVStore API survives for user code:
 """
 from __future__ import annotations
 
+import os
 import pickle
 import warnings
 
@@ -62,6 +63,7 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._compression_residuals = {}
         self._barrier_before_exit = True
 
     # -- identity ----------------------------------------------------------
@@ -99,6 +101,7 @@ class KVStore:
             if k not in self._store:
                 raise ValueError("key %r has not been initialized" % (k,))
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
+            merged = self._compress(k, merged)
             merged = self._sync_reduce(merged)
             if self._updater is not None:
                 idx = k if isinstance(k, int) else _str_key_int(k)
@@ -180,6 +183,35 @@ class KVStore:
             raise ValueError("Unsupported compression type %r" % ctype)
         self._compression_params = dict(compression_params)
         self._compression_params.setdefault("threshold", 0.5)
+        # small tensors (biases, norms) train badly when crushed to
+        # {0, +-thr}; gate like the reference gates big-array handling
+        self._compression_params.setdefault(
+            "size_lower_bound",
+            int(os.environ.get("MXNET_KVSTORE_SIZE_LOWER_BOUND", 4096)))
+        self._compression_residuals = {}
+
+    def _compress(self, key, merged):
+        """Apply 2-bit quantize→dequantize with per-key error-feedback
+        residual — what crosses the wire in dist modes is the 16x-smaller
+        words (ref: kvstore_dist.h compressed push path; kernels in
+        pallas_kernels/compression.py, the gradient_compression.cu
+        analog). Tensors below size_lower_bound pass through uncompressed."""
+        if not self._compression_params or \
+                self._compression_params.get("type") == "none":
+            return merged
+        if merged.size < self._compression_params["size_lower_bound"]:
+            return merged
+        import jax.numpy as jnp
+        from .pallas_kernels import quantize_2bit, dequantize_2bit
+        thr = self._compression_params["threshold"]
+        flat = merged._data.reshape(-1)
+        res = self._compression_residuals.get(key)
+        if res is None or res.shape != flat.shape:
+            res = jnp.zeros_like(flat)
+        words, new_res = quantize_2bit(flat, res, thr)
+        self._compression_residuals[key] = new_res
+        deq = dequantize_2bit(words, flat.shape[0], thr)
+        return NDArray(deq.reshape(merged.shape).astype(merged._data.dtype))
 
     # -- optimizer-state checkpointing ------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):

@@ -1,0 +1,12 @@
+"""Hand-written Pallas TPU kernels.
+
+Analog slot of the reference's custom CUDA kernels + NVRTC runtime
+compilation (ref: src/common/rtc.cc, src/operator/nn/cudnn/,
+src/kvstore/gradient_compression.cu): ops where XLA's automatic fusion
+isn't enough get explicit MXU/VMEM tiling here. Everything has a pure
+jnp fallback so CPU runs (and the virtual-device test mesh) work
+unchanged; on TPU the Pallas path is selected automatically.
+"""
+from .flash_attention import flash_attention  # noqa: F401
+from .compression import (quantize_2bit, dequantize_2bit,  # noqa: F401
+                          quantize_2bit_jnp, dequantize_2bit_jnp)

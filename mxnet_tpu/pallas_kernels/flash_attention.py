@@ -1,0 +1,171 @@
+"""Flash attention: tiled online-softmax attention as a Pallas TPU kernel.
+
+The reference has no attention kernel at all (2019-era; its closest analog
+is the fused cuDNN RNN, src/operator/rnn-inl.h). Long-context attention is
+where a modern framework's FLOPs go, so this is the flagship custom
+kernel: per (batch*head, q-block) grid cell, K/V stream through VMEM in
+``block_k`` tiles while the m/l/o running softmax accumulates in
+registers — HBM traffic is O(S·D) instead of the O(S^2) score matrix.
+
+Composition with the parallelism layer: ring attention
+(parallel/ring_attention.py) shards the sequence over the mesh and
+rotates K/V via ppermute; each hop's local block product can use this
+kernel, making the two-level scheme (inter-chip ring x intra-chip flash)
+match Liu et al.'s blockwise formulation.
+
+Backward uses recompute-from-inputs through the jnp reference
+implementation (standard flash practice trades the stored score matrix
+for recompute; here XLA differentiates the recompute).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["flash_attention", "attention_reference"]
+
+
+def attention_reference(q, k, v, causal=False, scale=None):
+    """Plain O(S^2) attention in jnp — fallback + autodiff path.
+    q,k,v: [B, H, S, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # scores + softmax in fp32 regardless of input dtype — same as the
+    # Pallas kernel's accumulators, so the two paths agree under AMP bf16
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col > row, -jnp.inf, s)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype),
+                      v).astype(q.dtype)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal,
+                scale, seq_k):
+    """One (batch*head, q-block) grid cell."""
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    q = (q_ref[0].astype(jnp.float32)) * scale        # (block_q, D)
+    d = q.shape[-1]
+
+    n_blocks = seq_k // block_k
+    if causal:
+        # only k-blocks that intersect the causal triangle of this q-block
+        n_live = (qi * block_q + block_q + block_k - 1) // block_k
+        n_iter = jnp.minimum(n_live, n_blocks)
+    else:
+        n_iter = n_blocks
+
+    def body(i, carry):
+        m_prev, l_prev, o_prev = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_q, block_k)
+        if causal:
+            row = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col > row, -jnp.inf, s)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        corr = jnp.where(jnp.isneginf(m_prev), 0.0, corr)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1)
+        o_new = corr[:, None] * o_prev + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, o = lax.fori_loop(0, n_iter, body, (m0, l0, o0))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, \
+        "sequence lengths must be multiples of the block sizes " \
+        "(pad like BucketingModule pads variable-length batches)"
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    kernel = functools.partial(_fwd_kernel, block_q=block_q,
+                               block_k=block_k, causal=causal, scale=scale,
+                               seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
+def _use_pallas():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    if interpret or _use_pallas():
+        return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    return attention_reference(q, k, v, causal=causal, scale=scale)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), \
+        (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
+                                               scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """Tiled attention. q,k,v: [B, H, S, D]. On TPU runs the Pallas
+    kernel; elsewhere the jnp reference (or the kernel under
+    ``interpret=True`` for testing)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, causal, float(scale), int(block_q), int(block_k),
+                  bool(interpret))

@@ -185,14 +185,15 @@ def _attention(cfg, mesh, q, k, v, positions):
     elif cfg.attn_mode == "blockwise":
         ot = blockwise_attention(qt, kt, vt, causal=cfg.causal)
     else:
-        scale = cfg.head_dim ** -0.5
-        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
-        if cfg.causal:
-            S = qt.shape[2]
-            mask = jnp.tril(jnp.ones((S, S), bool))
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(qt.dtype)
-        ot = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+        # local full attention: Pallas flash kernel on TPU (O(S·D) HBM
+        # traffic), jnp reference elsewhere — see pallas_kernels/
+        from ..pallas_kernels import flash_attention
+        S = qt.shape[2]
+        if S % 128 == 0:
+            ot = flash_attention(qt, kt, vt, causal=cfg.causal)
+        else:
+            from ..pallas_kernels.flash_attention import attention_reference
+            ot = attention_reference(qt, kt, vt, causal=cfg.causal)
     return jnp.transpose(ot, (0, 2, 1, 3))
 
 

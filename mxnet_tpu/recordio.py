@@ -47,7 +47,12 @@ class ThreadedRecordReader:
             ctypes.byref(h)))
         self._h = h
 
+    def _check_open(self):
+        if not getattr(self, "_h", None):
+            raise ValueError("I/O operation on closed ThreadedRecordReader")
+
     def read(self):
+        self._check_open()
         data = ctypes.c_char_p()
         size = ctypes.c_uint64()
         eof = ctypes.c_int()
@@ -59,6 +64,7 @@ class ThreadedRecordReader:
         return ctypes.string_at(data, size.value)
 
     def reset(self):
+        self._check_open()
         _native.check_call(self._lib.MXTThreadedReaderReset(self._h))
 
     def close(self):
@@ -106,11 +112,17 @@ class _NativeBackend:
                 self._lib.MXTRecordReaderFree(self._h)
             self._h = None
 
+    def _check_open(self):
+        if not self._h:
+            raise ValueError("I/O operation on closed RecordIO file")
+
     def write(self, buf):
+        self._check_open()
         _native.check_call(self._lib.MXTRecordWriterWrite(
             self._h, bytes(buf), len(buf)))
 
     def read(self):
+        self._check_open()
         data = ctypes.c_char_p()
         size = ctypes.c_uint64()
         eof = ctypes.c_int()
@@ -122,6 +134,7 @@ class _NativeBackend:
         return ctypes.string_at(data, size.value)
 
     def tell(self):
+        self._check_open()
         pos = ctypes.c_uint64()
         fn = self._lib.MXTRecordWriterTell if self.writable \
             else self._lib.MXTRecordReaderTell
@@ -129,6 +142,7 @@ class _NativeBackend:
         return pos.value
 
     def seek(self, pos):
+        self._check_open()
         _native.check_call(self._lib.MXTRecordReaderSeek(self._h, pos))
 
 
@@ -183,6 +197,8 @@ class MXRecordIO:
         self.open()
 
     def _check_pid(self):
+        if self._backend is None and self.handle is None:
+            raise ValueError("I/O operation on closed RecordIO file")
         # reopen after fork, like the reference's pid check
         if self.pid != os.getpid():
             self.open()
@@ -207,6 +223,9 @@ class MXRecordIO:
             self._backend.write(buf)
             return
         length = len(buf)
+        if length > _LREC_LEN_MASK:
+            # 29-bit length field; the native writer throws the same way
+            raise IOError("RecordIO record exceeds 2^29-1 bytes")
         # no multi-part splitting: records here are written whole (cflag=0);
         # readers still understand split records produced by dmlc writers
         self.handle.write(struct.pack("<II", _kMagic,

@@ -161,7 +161,6 @@ def test_corrupt_stream_raises(tmp_path):
     r.close()
 
 
-@needs_native
 def test_amp_widest_promotes_not_narrows():
     import numpy as onp
     import mxnet_tpu as mx
@@ -200,3 +199,37 @@ def test_quantize_nested_blocks_distinct_thresholds():
              in quantization._walk_children(outer)
              if isinstance(child, nn.Dense)]
     assert len(set(paths)) == 2
+
+
+@needs_native
+def test_closed_handle_raises_not_crashes(tmp_path):
+    path = str(tmp_path / "x.rec")
+    w = MXRecordIO(path, "w")
+    w.write(b"a")
+    w.close()
+    with pytest.raises(ValueError, match="closed"):
+        w.write(b"b")
+    t = ThreadedRecordReader(path)
+    t.close()
+    with pytest.raises(ValueError, match="closed"):
+        t.read()
+    with pytest.raises(ValueError, match="closed"):
+        t.reset()
+
+
+def test_python_writer_rejects_oversize(tmp_path):
+    import os
+    os.environ["MXNET_TPU_NO_NATIVE"] = "1"
+    _native._LIB, _native._TRIED = None, False
+    try:
+        w = MXRecordIO(str(tmp_path / "o.rec"), "w")
+
+        class FakeBuf:
+            def __len__(self):
+                return 1 << 29
+        with pytest.raises(IOError, match="2\\^29"):
+            w.write(FakeBuf())
+        w.close()
+    finally:
+        del os.environ["MXNET_TPU_NO_NATIVE"]
+        _native._LIB, _native._TRIED = None, False

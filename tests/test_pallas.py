@@ -1,0 +1,140 @@
+"""Pallas kernel tests — run under interpret mode on the CPU test platform
+(ref slot: src/common/rtc.cc custom-kernel tests, tests/python/gpu/test_rtc.py;
+gradient compression: tests/nightly/test_kvstore.py compression cases)."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.pallas_kernels import (flash_attention, quantize_2bit,
+                                      dequantize_2bit, quantize_2bit_jnp,
+                                      dequantize_2bit_jnp)
+from mxnet_tpu.pallas_kernels.flash_attention import attention_reference
+
+
+def _qkv(b=2, h=4, s=256, d=64, seed=0):
+    rng = onp.random.RandomState(seed)
+    return (jnp.array(rng.randn(b, h, s, d).astype("float32")),
+            jnp.array(rng.randn(b, h, s, d).astype("float32")),
+            jnp.array(rng.randn(b, h, s, d).astype("float32")))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=causal, block_q=64,
+                              block_k=64, interpret=True)
+        ref = attention_reference(q, k, v, causal=causal)
+        assert float(jnp.abs(out - ref).max()) < 1e-5
+
+    def test_block_sizes_equivalent(self):
+        q, k, v = _qkv(s=128)
+        ref = attention_reference(q, k, v)
+        for bq, bk in [(128, 128), (64, 128), (128, 64), (32, 32)]:
+            out = flash_attention(q, k, v, block_q=bq, block_k=bk,
+                                  interpret=True)
+            assert float(jnp.abs(out - ref).max()) < 1e-5, (bq, bk)
+
+    def test_gradients(self):
+        q, k, v = _qkv(s=128)
+        g = jax.grad(lambda a, b, c: flash_attention(
+            a, b, c, causal=True, interpret=True).sum(), (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: attention_reference(
+            a, b, c, causal=True).sum(), (0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            assert float(jnp.abs(got - want).max()) < 1e-4
+
+    def test_cross_attention_lengths(self):
+        q, _, _ = _qkv(s=128)
+        _, k, v = _qkv(s=256, seed=1)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = attention_reference(q, k, v)
+        assert out.shape == (2, 4, 128, 64)
+        assert float(jnp.abs(out - ref).max()) < 1e-5
+
+    def test_jittable(self):
+        q, k, v = _qkv(s=128)
+        f = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True,
+                                                    interpret=True))
+        ref = attention_reference(q, k, v, causal=True)
+        assert float(jnp.abs(f(q, k, v) - ref).max()) < 1e-5
+
+
+class TestCompression:
+    def test_semantics_match_reference_struct(self):
+        """ref: gradient_compression-inl.h quantize_2bit — +thr / -thr / 0
+        with error feedback."""
+        grad = jnp.array([0.6, -0.7, 0.1, 0.0, 0.49, -0.5])
+        res = jnp.zeros(6)
+        words, new_res = quantize_2bit_jnp(grad, res, 0.5)
+        deq = dequantize_2bit_jnp(words, 6, 0.5)
+        onp.testing.assert_allclose(
+            onp.asarray(deq), [0.5, -0.5, 0.0, 0.0, 0.0, -0.5], atol=1e-6)
+        # residual keeps what quantization dropped
+        onp.testing.assert_allclose(
+            onp.asarray(new_res),
+            [0.1, -0.2, 0.1, 0.0, 0.49, 0.0], atol=1e-6)
+
+    def test_error_feedback_identity(self):
+        rng = onp.random.RandomState(0)
+        grad = jnp.array(rng.randn(1000).astype("float32"))
+        words, new_res = quantize_2bit_jnp(grad, jnp.zeros(1000), 0.5)
+        deq = dequantize_2bit_jnp(words, 1000, 0.5)
+        # deq + residual == grad exactly (nothing lost, only deferred)
+        assert float(jnp.abs((deq + new_res) - grad).max()) < 1e-6
+
+    def test_pallas_matches_jnp(self):
+        rng = onp.random.RandomState(1)
+        grad = jnp.array(rng.randn(4096).astype("float32"))
+        res = jnp.array(rng.randn(4096).astype("float32")) * 0.1
+        w_j, r_j = quantize_2bit_jnp(grad, res, 0.5)
+        w_p, r_p = quantize_2bit(grad, res, 0.5, interpret=True)
+        assert bool((w_j == w_p).all())
+        assert float(jnp.abs(r_j - r_p).max()) == 0.0
+        d_j = dequantize_2bit_jnp(w_j, 4096, 0.5)
+        d_p = dequantize_2bit(w_p, 4096, 0.5, interpret=True)
+        assert bool((d_j == d_p).all())
+
+    def test_ragged_length(self):
+        grad = jnp.ones((37,)) * 0.6
+        words, res = quantize_2bit_jnp(grad, jnp.zeros(37), 0.5)
+        assert words.shape == (3,)  # ceil(37/16)
+        deq = dequantize_2bit_jnp(words, 37, 0.5)
+        assert deq.shape == (37,)
+        assert bool((deq == 0.5).all())
+
+    def test_compression_ratio(self):
+        grad = jnp.zeros((1600,), jnp.float32)
+        words, _ = quantize_2bit_jnp(grad, jnp.zeros(1600), 0.5)
+        assert grad.nbytes / words.nbytes == 16.0
+
+
+class TestKVStoreCompression:
+    def test_kvstore_roundtrip_with_residual(self):
+        import mxnet_tpu as mx
+        kv = mx.kv.create("local")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5,
+                                     "size_lower_bound": 0})
+        kv.init(3, mx.nd.zeros((8, 8)))
+        g = mx.nd.ones((8, 8)) * 0.3  # below threshold -> all zeros, kept
+        kv.push(3, g)
+        out = mx.nd.zeros((8, 8))
+        kv.pull(3, out=out)
+        assert onp.abs(out.asnumpy()).max() == 0.0  # quantized to zero
+        kv.push(3, g)  # residual 0.3 + 0.3 = 0.6 >= thr -> fires now
+        kv.pull(3, out=out)
+        assert onp.allclose(out.asnumpy(), 0.5)
+
+    def test_small_tensors_not_compressed(self):
+        import mxnet_tpu as mx
+        kv = mx.kv.create("local")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init(4, mx.nd.zeros((10,)))
+        g = mx.nd.ones((10,)) * 0.01  # small bias-like gradient
+        kv.push(4, g)
+        out = mx.nd.zeros((10,))
+        kv.pull(4, out=out)
+        # below size_lower_bound: passes through uncompressed
+        assert onp.allclose(out.asnumpy(), 0.01)

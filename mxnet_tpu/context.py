@@ -45,16 +45,20 @@ class Context:
     def jax_device(self):
         """Resolve to a concrete jax.Device (accelerator for tpu/gpu, host cpu
         otherwise). Falls back to the default backend if the requested kind is
-        absent, so cpu-only CI can still run `tpu()` code."""
+        absent, so cpu-only CI can still run `tpu()` code. Under a
+        multi-process runtime only THIS process's devices are addressable,
+        so resolution is over jax.local_devices() (ref: each ps-lite worker
+        owning its local GPUs, kvstore_dist.h)."""
         kind = self.device_type
         if kind in ("cpu", "cpu_pinned", "cpu_shared"):
             try:
-                return jax.devices("cpu")[min(self.device_id, len(jax.devices("cpu")) - 1)]
+                local = jax.local_devices(backend="cpu")
+                return local[min(self.device_id, len(local) - 1)]
             except RuntimeError:
-                return jax.devices()[0]
+                return jax.local_devices()[0]
         devs = _accel_devices()
         if not devs:
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     # -- comparisons / hashing -------------------------------------------
@@ -92,12 +96,12 @@ class Context:
 def _accel_devices():
     for kind in ("tpu", "axon", "gpu"):
         try:
-            devs = jax.devices(kind)
+            devs = jax.local_devices(backend=kind)
             if devs:
                 return devs
         except RuntimeError:
             continue
-    default = jax.devices()
+    default = jax.local_devices()
     return [d for d in default if d.platform != "cpu"]
 
 

@@ -101,8 +101,10 @@ class KVStore:
             if k not in self._store:
                 raise ValueError("key %r has not been initialized" % (k,))
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
-            merged = self._compress(k, merged)
-            merged = self._sync_reduce(merged)
+            if self._compression_active(merged):
+                merged = self._compress_reduce(k, merged)
+            else:
+                merged = self._sync_reduce(merged)
             if self._updater is not None:
                 idx = k if isinstance(k, int) else _str_key_int(k)
                 self._updater(idx, merged, self._store[k])
@@ -190,27 +192,38 @@ class KVStore:
             int(os.environ.get("MXNET_KVSTORE_SIZE_LOWER_BOUND", 4096)))
         self._compression_residuals = {}
 
-    def _compress(self, key, merged):
-        """Apply 2-bit quantize→dequantize with per-key error-feedback
-        residual — what crosses the wire in dist modes is the 16x-smaller
-        words (ref: kvstore_dist.h compressed push path; kernels in
-        pallas_kernels/compression.py, the gradient_compression.cu
-        analog). Tensors below size_lower_bound pass through uncompressed."""
-        if not self._compression_params or \
-                self._compression_params.get("type") == "none":
-            return merged
-        if merged.size < self._compression_params["size_lower_bound"]:
-            return merged
+    def _compression_active(self, merged):
+        return (self._compression_params is not None
+                and self._compression_params.get("type") != "none"
+                and merged.size >=
+                self._compression_params["size_lower_bound"])
+
+    def _compress_reduce(self, key, merged):
+        """2-bit quantize with per-key error-feedback residual; in dist
+        modes the int32 words (16x smaller than fp32) are what crosses the
+        wire — each worker's words are allgathered, dequantized and summed,
+        exactly the server-side decompress-and-accumulate of the reference
+        (ref: kvstore_dist.h compressed push path, gradient_compression.cu;
+        kernels in pallas_kernels/compression.py)."""
         import jax.numpy as jnp
         from .pallas_kernels import quantize_2bit, dequantize_2bit
         thr = self._compression_params["threshold"]
         flat = merged._data.reshape(-1)
+        n = flat.shape[0]
         res = self._compression_residuals.get(key)
         if res is None or res.shape != flat.shape:
             res = jnp.zeros_like(flat)
         words, new_res = quantize_2bit(flat, res, thr)
         self._compression_residuals[key] = new_res
-        deq = dequantize_2bit(words, flat.shape[0], thr)
+        if self._kind.startswith("dist") and self.num_workers > 1:
+            import numpy as _np
+            from jax.experimental import multihost_utils
+            all_words = multihost_utils.process_allgather(
+                _np.asarray(words))                    # (nworker, nwords)
+            deq = sum(dequantize_2bit(jnp.asarray(all_words[r]), n, thr)
+                      for r in range(all_words.shape[0]))
+        else:
+            deq = dequantize_2bit(words, n, thr)
         return NDArray(deq.reshape(merged.shape).astype(merged._data.dtype))
 
     # -- optimizer-state checkpointing ------------------------------------
@@ -228,8 +241,12 @@ class KVStore:
     def _sync_reduce(self, merged):
         """Cross-process allreduce for dist modes; identity in-process."""
         if self._kind.startswith("dist") and self.num_workers > 1:
+            import jax.numpy as jnp
             from .parallel import host_allreduce
-            return host_allreduce(merged)
+            out = host_allreduce(merged)
+            if not isinstance(out, NDArray):  # allgather lands on host
+                out = NDArray(jnp.asarray(out))
+            return out
         return merged
 
     def _barrier(self):
@@ -279,4 +296,22 @@ def create(name="local"):
     if kind == "dist_async":
         warnings.warn("dist_async has no ICI analog on TPU; running "
                       "synchronously (see SURVEY.md §5)")
+    if kind.startswith("dist") and os.environ.get("MXTPU_COORDINATOR"):
+        # join the job the launcher (tools/launch.py) wired via env — the
+        # analog of ps-lite reading DMLC_* at KVStore::Create time
+        # (ref: src/kvstore/kvstore_dist.h:50). jax.distributed must run
+        # before the XLA backends initialize, so gate on the runtime's own
+        # state rather than process_count() (which would initialize them).
+        from jax._src import distributed as _jdist
+        already = getattr(getattr(_jdist, "global_state", None),
+                          "client", None) is not None
+        if not already:
+            from .parallel import initialize_distributed
+            try:
+                initialize_distributed()
+            except RuntimeError as e:
+                warnings.warn(
+                    "could not auto-join the distributed job (%s); call "
+                    "mxnet_tpu.parallel.initialize_distributed() before "
+                    "any JAX computation" % e)
     return KVStore(kind)

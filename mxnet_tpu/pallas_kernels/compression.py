@@ -118,7 +118,10 @@ def quantize_2bit(grad, residual, threshold=0.5, interpret=False):
                    jax.ShapeDtypeStruct((_GROUP, nwords), jnp.float32)],
         interpret=interpret,
     )(r2)
-    return words.reshape(-1), newr.T.reshape(-1)[:n]
+    # trim lane padding: the wire format is ceil(n/16) words, identical to
+    # the jnp path
+    out_words = (n + _GROUP - 1) // _GROUP
+    return words.reshape(-1)[:out_words], newr.T.reshape(-1)[:n]
 
 
 def dequantize_2bit(words, n, threshold=0.5, interpret=False):

@@ -47,54 +47,62 @@ def attention_reference(q, k, v, causal=False, scale=None):
                       v).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal,
-                scale, seq_k):
-    """One (batch*head, q-block) grid cell."""
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, o_scr, *,
+                block_q, block_k, causal, scale, n_kblocks):
+    """One (batch*head, q-block, k-block) grid cell. The TPU grid runs
+    sequentially with the k axis innermost, so VMEM scratch carries the
+    m/l/o online-softmax state across k steps — only one (block_k, D)
+    K/V tile is resident at a time, keeping VMEM O(block) instead of
+    O(seq)."""
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(1)
-    q = (q_ref[0].astype(jnp.float32)) * scale        # (block_q, D)
-    d = q.shape[-1]
+    ki = pl.program_id(2)
 
-    n_blocks = seq_k // block_k
-    if causal:
-        # only k-blocks that intersect the causal triangle of this q-block
-        n_live = (qi * block_q + block_q + block_k - 1) // block_k
-        n_iter = jnp.minimum(n_live, n_blocks)
-    else:
-        n_iter = n_blocks
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        o_scr[:] = jnp.zeros_like(o_scr)
 
-    def body(i, carry):
-        m_prev, l_prev, o_prev = carry
-        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale      # (block_q, D)
+        k = k_ref[0].astype(jnp.float32)              # (block_k, D)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # (block_q, block_k)
         if causal:
             row = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            col = i * block_k + lax.broadcasted_iota(
+            col = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col > row, -jnp.inf, s)
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(jnp.isneginf(s), 0.0, p)
         corr = jnp.exp(m_prev - m_new)
         corr = jnp.where(jnp.isneginf(m_prev), 0.0, corr)
-        l_new = corr * l_prev + jnp.sum(p, axis=-1)
-        o_new = corr[:, None] * o_prev + jax.lax.dot_general(
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = corr * l_scr[:, 0] + jnp.sum(p, axis=-1)
+        o_scr[:] = corr[:, None] * o_scr[:] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, o_new
 
-    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    o0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, o = lax.fori_loop(0, n_iter, body, (m0, l0, o0))
-    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
-    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+    if causal:
+        # blocks strictly above the causal triangle contribute nothing
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == n_kblocks - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
+        o_ref[0] = (o_scr[:] / l[:, None]).astype(o_ref.dtype)
 
 
 def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -111,19 +119,25 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
+    n_kblocks = sk // block_k
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
                                block_k=block_k, causal=causal, scale=scale,
-                               seq_k=sk)
+                               n_kblocks=n_kblocks)
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
+        grid=(b * h, sq // block_q, n_kblocks),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),    # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),    # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),    # unnormalized output
+        ],
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d)

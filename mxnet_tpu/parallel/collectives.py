@@ -93,10 +93,14 @@ def host_allreduce(arrays):
     """
     if jax.process_count() == 1:
         return arrays
+    import numpy as _np
     from jax.experimental import multihost_utils
     single = not isinstance(arrays, (list, tuple))
     seq = [arrays] if single else list(arrays)
-    out = [multihost_utils.process_allgather(a).sum(axis=0) for a in seq]
+    # stage through host numpy: device arrays committed by a jitted step
+    # cannot be re-staged into the global allgather array directly
+    out = [multihost_utils.process_allgather(_np.asarray(a)).sum(axis=0)
+           for a in seq]
     return out[0] if single else out
 
 

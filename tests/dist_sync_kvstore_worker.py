@@ -1,0 +1,100 @@
+"""Worker script for multi-process dist kvstore tests
+(ref: tests/nightly/dist_sync_kvstore.py — the reference launches
+scheduler+servers+workers as local processes via tools/launch.py and
+asserts exact numeric equality of pulled values across ranks).
+
+Run via:  python tools/launch.py -n 3 python tests/dist_sync_kvstore_worker.py
+Each rank pushes rank-dependent values; everyone must pull identical
+aggregates (check_diff_to_scalar analog, dist_sync_kvstore.py:31-45).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# join the coordinator BEFORE anything touches the XLA backend —
+# the same ordering ps-lite requires of its env handshake
+import jax  # noqa: E402
+
+jax.distributed.initialize(os.environ["MXTPU_COORDINATOR"],
+                           int(os.environ["MXTPU_NUM_PROCS"]),
+                           int(os.environ["MXTPU_PROC_ID"]))
+
+import numpy as onp  # noqa: E402,F401
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def check_diff_to_scalar(arr, x, rank):
+    """ref: dist_sync_kvstore.py:31 — exact equality, not allclose."""
+    a = arr.asnumpy()
+    assert (a == x).all(), "rank %d: expected %s, got %s" % (rank, x, a)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker > 1, "must run under tools/launch.py -n N (N>1)"
+    shape = (4, 4)
+
+    # 1. push/pull aggregation: sum over ranks of (rank+1) = N(N+1)/2
+    kv.init(3, mx.nd.zeros(shape))
+    kv.push(3, mx.nd.ones(shape) * (rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out=out)
+    expected = nworker * (nworker + 1) / 2
+    check_diff_to_scalar(out, expected, rank)
+
+    # 2. repeated rounds stay consistent (sync semantics: every round sees
+    #    exactly nworker contributions, ref: kvstore_dist_server.h:349)
+    for rnd in range(3):
+        kv.push(3, mx.nd.ones(shape))
+        kv.pull(3, out=out)
+        check_diff_to_scalar(out, nworker, rank)
+
+    # 3. str keys + pushpull fusion
+    kv.init("w0", mx.nd.zeros(shape))
+    kv.pushpull("w0", mx.nd.ones(shape) * rank, out=out)
+    check_diff_to_scalar(out, sum(range(nworker)), rank)
+
+    # 4. gradient compression path across ranks
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5,
+                                  "size_lower_bound": 0})
+    kvc.init(9, mx.nd.zeros(shape))
+    kvc.push(9, mx.nd.ones(shape) * 0.6)   # quantizes to +0.5 per rank
+    kvc.pull(9, out=out)
+    check_diff_to_scalar(out, 0.5 * nworker, rank)
+
+    # 5. gluon Trainer over dist kvstore: after steps on rank-dependent
+    #    data, weights must be bit-identical across ranks
+    #    (ref: tests/nightly/dist_device_sync_kvstore.py gluon trainer case)
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=4)
+    net.initialize(init=mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv)
+    rng = onp.random.RandomState(100 + rank)  # DIFFERENT data per rank
+    for _ in range(3):
+        x = mx.nd.array(rng.randn(8, 4).astype("float32"))
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(8)
+    w = net.weight.data().asnumpy()
+    from jax.experimental import multihost_utils
+    all_w = multihost_utils.process_allgather(w)
+    for r in range(nworker):
+        assert (all_w[r] == all_w[0]).all(), \
+            "rank %d: weights diverged across ranks" % rank
+
+    # 6. barrier then done
+    mx.parallel.host_barrier("dist-test")
+    print("rank %d/%d: all dist_sync kvstore checks passed" % (rank, nworker))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Multi-process distributed tests, launched as local processes via the
+cluster launcher — the reference's pattern for testing dist kvstore
+without a real cluster (ref: ci/docker/runtime_functions.sh:1281
+`tools/launch.py -n 7 --launcher local python dist_sync_kvstore.py`,
+SURVEY.md §4 blueprint note)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launcher(n, script, timeout=240):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)  # the axon sitecustomize grabs the real TPU
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+           "-n", str(n), sys.executable, os.path.join(REPO, script)]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_dist_sync_kvstore(n):
+    res = _run_launcher(n, "tests/dist_sync_kvstore_worker.py")
+    assert res.returncode == 0, res.stdout + res.stderr
+    for rank in range(n):
+        assert ("rank %d/%d: all dist_sync kvstore checks passed"
+                % (rank, n)) in res.stdout + res.stderr
+
+
+def test_launcher_propagates_failure(tmp_path):
+    bad = tmp_path / "bad_worker.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(bad)],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert res.returncode == 1
+    assert "exit codes" in res.stderr
+
+
+def test_launcher_sets_dmlc_env(tmp_path):
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os\n"
+        "print('R%s/%s' % (os.environ['MXTPU_PROC_ID'],"
+        " os.environ['MXTPU_NUM_PROCS']))\n"
+        "assert os.environ['DMLC_ROLE'] == 'worker'\n"
+        "assert 'MXTPU_COORDINATOR' in os.environ\n")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(probe)],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    assert "R0/2" in res.stdout and "R1/2" in res.stdout

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Re-run a test many times with different seeds to surface flakiness
+(ref: tools/flakiness_checker.py).
+
+Usage:
+    python tools/flakiness_checker.py tests/test_rnn.py::test_gradients_flow
+    python tools/flakiness_checker.py -n 50 tests/test_operator.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="flakiness checker (ref: tools/flakiness_checker.py)")
+    parser.add_argument("test", help="pytest target (file or file::test)")
+    parser.add_argument("-n", "--num-trials", type=int, default=20)
+    parser.add_argument("-s", "--seed", type=int, default=None,
+                        help="fixed seed; default draws a new one per trial")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    failures = []
+    for trial in range(args.num_trials):
+        seed = args.seed if args.seed is not None else \
+            random.randint(0, 2 ** 31 - 1)
+        env = dict(os.environ)
+        env["MXNET_TEST_SEED"] = str(seed)
+        cmd = [sys.executable, "-m", "pytest", args.test, "-q", "-x"]
+        res = subprocess.run(cmd, env=env, capture_output=not args.verbose)
+        status = "PASS" if res.returncode == 0 else "FAIL"
+        print("trial %3d seed %10d : %s" % (trial, seed, status))
+        if res.returncode != 0:
+            failures.append(seed)
+    print("\n%d/%d trials failed" % (len(failures), args.num_trials))
+    if failures:
+        print("failing seeds (reproduce with MXNET_TEST_SEED=<seed>):")
+        for s in failures:
+            print("  ", s)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

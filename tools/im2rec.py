@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Pack an image dataset into RecordIO (ref: tools/im2rec.py).
+
+Two-phase workflow like the reference:
+    python tools/im2rec.py --list prefix image_root     # write prefix.lst
+    python tools/im2rec.py prefix image_root            # write .rec/.idx
+
+List format (tab separated, identical to the reference):
+    <index> \t <label> [\t more labels] \t <relative path>
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.recordio import (MXIndexedRecordIO, IRHeader,  # noqa: E402
+                                pack, pack_img)
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=True, exts=_EXTS):
+    """Yield (relpath, label) with labels from sorted subdirectory names
+    (ref: im2rec.py list_image)."""
+    cat = {}
+    if recursive:
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                if fname.lower().endswith(exts):
+                    rel = os.path.relpath(os.path.join(path, fname), root)
+                    folder = os.path.dirname(rel)
+                    if folder not in cat:
+                        cat[folder] = len(cat)
+                    yield rel, cat[folder]
+    else:
+        for fname in sorted(os.listdir(root)):
+            if fname.lower().endswith(exts):
+                yield fname, 0
+
+
+def write_list(prefix, root, args):
+    entries = list(list_images(root, recursive=not args.no_recursive))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(entries)
+    with open(prefix + ".lst", "w") as f:
+        for i, (rel, label) in enumerate(entries):
+            f.write("%d\t%f\t%s\n" % (i, float(label), rel))
+    return len(entries)
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def write_record(prefix, root, args):
+    record = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        label = labels[0] if len(labels) == 1 else labels
+        header = IRHeader(0, label, idx, 0)
+        path = os.path.join(root, rel)
+        if args.pass_through:
+            with open(path, "rb") as f:
+                record.write_idx(idx, pack(header, f.read()))
+        else:
+            from PIL import Image
+            img = Image.open(path).convert("RGB")
+            if args.resize:
+                w, h = img.size
+                scale = args.resize / min(w, h)
+                img = img.resize((int(w * scale), int(h * scale)))
+            import numpy as np
+            record.write_idx(idx, pack_img(header, np.asarray(img)[..., ::-1],
+                                           quality=args.quality,
+                                           img_fmt=args.encoding))
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images" % count)
+    record.close()
+    return count
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Create a RecordIO image dataset (ref: tools/im2rec.py)")
+    parser.add_argument("prefix", help="prefix of the .lst/.rec/.idx files")
+    parser.add_argument("root", help="image root directory")
+    parser.add_argument("--list", action="store_true",
+                        help="create the image list instead of the record")
+    parser.add_argument("--no-recursive", action="store_true")
+    parser.add_argument("--shuffle", action="store_true", default=True)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", default=".jpg")
+    parser.add_argument("--pass-through", action="store_true",
+                        help="store raw file bytes without re-encoding")
+    args = parser.parse_args(argv)
+    if args.list:
+        n = write_list(args.prefix, args.root, args)
+        print("wrote %s.lst (%d entries)" % (args.prefix, n))
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            write_list(args.prefix, args.root, args)
+        n = write_record(args.prefix, args.root, args)
+        print("wrote %s.rec (%d records)" % (args.prefix, n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

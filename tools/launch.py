@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Multi-process job launcher.
+
+TPU-native analog of the reference's cluster launcher (ref: tools/launch.py
+→ dmlc-core tracker): where the reference's tracker spawns
+scheduler + servers + workers and wires them with DMLC_ROLE /
+DMLC_PS_ROOT_URI / DMLC_NUM_WORKER / DMLC_NUM_SERVER env vars, this spawns
+N worker processes wired to one jax.distributed coordinator (process 0's
+host:port) with MXTPU_COORDINATOR / MXTPU_NUM_PROCS / MXTPU_PROC_ID.
+There is no separate server role: parameter aggregation is XLA collectives
+over ICI/DCN (Gloo on CPU), so every process is a worker
+(SURVEY.md §5 "distributed communication backend").
+
+Usage (mirrors the reference CLI):
+    python tools/launch.py -n 4 python train_script.py --args...
+    python tools/launch.py -n 4 --launcher local python train.py
+
+`--launcher ssh -H hostfile` distributes over hosts via ssh, one process
+per host line (the reference's ssh launcher analog); `local` (default)
+runs all processes on this machine — the CI harness for dist tests, like
+the reference's `--launcher local` used by tests/nightly/test_all.sh.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def launch_local(n, command, env_extra=None):
+    """Spawn n local worker processes; returns the list of exit codes."""
+    port = _free_port()
+    coordinator = "127.0.0.1:%d" % port
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update({
+            "MXTPU_COORDINATOR": coordinator,
+            "MXTPU_NUM_PROCS": str(n),
+            "MXTPU_PROC_ID": str(rank),
+            # DMLC-compatible aliases so reference-era scripts that read
+            # these still see a consistent world
+            "DMLC_NUM_WORKER": str(n),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(command, env=env))
+    codes = []
+    try:
+        for p in procs:
+            codes.append(p.wait())
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        raise
+    return codes
+
+
+def launch_ssh(n, hosts, command):
+    """One process per host over ssh (ref: dmlc-core ssh tracker)."""
+    assert len(hosts) >= 1, "ssh launcher needs a non-empty hostfile"
+    coordinator = "%s:%d" % (hosts[0], 29400)
+    procs = []
+    for rank in range(n):
+        host = hosts[rank % len(hosts)]
+        envs = " ".join("%s=%s" % kv for kv in [
+            ("MXTPU_COORDINATOR", coordinator),
+            ("MXTPU_NUM_PROCS", str(n)),
+            ("MXTPU_PROC_ID", str(rank)),
+        ])
+        remote = "cd %s && env %s %s" % (os.getcwd(), envs,
+                                         " ".join(command))
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no", host,
+                                       remote]))
+    return [p.wait() for p in procs]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job "
+                    "(ref: tools/launch.py)")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes "
+                             "(ref: launch.py -n num_workers)")
+    parser.add_argument("--launcher", choices=["local", "ssh"],
+                        default="local")
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="hostfile for the ssh launcher")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the worker command to run")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    if args.launcher == "local":
+        codes = launch_local(args.num_workers, args.command)
+    else:
+        with open(args.hostfile) as f:
+            hosts = [ln.strip() for ln in f if ln.strip()]
+        codes = launch_ssh(args.num_workers, hosts, args.command)
+    bad = [c for c in codes if c != 0]
+    if bad:
+        print("launch failed: exit codes %s" % codes, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

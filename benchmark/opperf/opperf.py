@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Operator micro-benchmark harness (ref: benchmark/opperf/opperf.py).
+
+Times forward and backward of registered ops on the attached device with
+warmup + repeated runs, like the reference's profiler-driven op benchmark.
+Usage:
+    python benchmark/opperf/opperf.py                  # default op set
+    python benchmark/opperf/opperf.py --ops add,dot    # subset
+    python benchmark/opperf/opperf.py --json out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+
+def _rand(shape, dtype="float32", seed=0):
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+    return mx.nd.array(rng.uniform(0.5, 1.5, shape).astype(dtype))
+
+
+def default_specs():
+    """Representative op set with benchmark shapes (mirrors the
+    reference's per-category default inputs, opperf/rules/default_params.py)."""
+    L = (1024, 1024)
+    return {
+        # unary elementwise
+        "exp": lambda: ([_rand(L)], {}),
+        "log": lambda: ([_rand(L)], {}),
+        "sqrt": lambda: ([_rand(L)], {}),
+        "tanh": lambda: ([_rand(L)], {}),
+        "sigmoid": lambda: ([_rand(L)], {}),
+        "relu": lambda: ([_rand(L)], {}),
+        "erf": lambda: ([_rand(L)], {}),
+        # binary / broadcast
+        "add": lambda: ([_rand(L), _rand(L, seed=1)], {}),
+        "multiply": lambda: ([_rand(L), _rand(L, seed=1)], {}),
+        "broadcast_add": lambda: ([_rand(L), _rand((1024, 1), seed=1)], {}),
+        "maximum": lambda: ([_rand(L), _rand(L, seed=1)], {}),
+        # reductions
+        "sum": lambda: ([_rand(L)], {"axis": 1}),
+        "mean": lambda: ([_rand(L)], {"axis": 1}),
+        "max": lambda: ([_rand(L)], {"axis": 1}),
+        "argmax": lambda: ([_rand(L)], {"axis": 1}),
+        "softmax": lambda: ([_rand(L)], {}),
+        "log_softmax": lambda: ([_rand(L)], {}),
+        # linalg / MXU
+        "dot": lambda: ([_rand(L), _rand(L, seed=1)], {}),
+        "batch_dot": lambda: ([_rand((32, 256, 256)),
+                               _rand((32, 256, 256), seed=1)], {}),
+        "FullyConnected": lambda: (
+            [_rand((128, 1024)), _rand((1024, 1024), seed=1), None],
+            {"num_hidden": 1024, "no_bias": True}),
+        "Convolution": lambda: (
+            [_rand((32, 64, 56, 56)), _rand((64, 64, 3, 3), seed=1), None],
+            {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1),
+             "no_bias": True}),
+        # nn
+        "BatchNorm": lambda: (
+            [_rand((32, 64, 56, 56)), _rand((64,)), _rand((64,)),
+             _rand((64,)), _rand((64,))], {}),
+        "LayerNorm": lambda: (
+            [_rand((128, 1024)), _rand((1024,)), _rand((1024,))], {}),
+        "Pooling": lambda: (
+            [_rand((32, 64, 56, 56))],
+            {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"}),
+        # shape manipulation
+        "transpose": lambda: ([_rand(L)], {}),
+        "reshape": lambda: ([_rand(L)], {"shape": (512, 2048)}),
+        "concat": lambda: ([_rand(L), _rand(L, seed=1)], {"dim": 1}),
+        "tile": lambda: ([_rand((256, 256))], {"reps": (4, 4)}),
+        # indexing
+        "take": lambda: ([_rand(L),
+                          _rand((1024,), "int32")], {}),
+        "one_hot": lambda: ([_rand((4096,), "int32")], {"depth": 128}),
+    }
+
+
+def bench_op(name, make_inputs, warmup=3, runs=20, run_backward=True):
+    """Time one op's forward (and backward through jax.vjp) in ms."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    args, kwargs = make_inputs()
+    fn = getattr(mx.nd, name)
+
+    def fwd():
+        return fn(*args, **kwargs)
+
+    for _ in range(warmup):
+        out = fwd()
+    jax.block_until_ready(out._data if hasattr(out, "_data")
+                          else [o._data for o in out])
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = fwd()
+    jax.block_until_ready(out._data if hasattr(out, "_data")
+                          else [o._data for o in out])
+    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    bwd_ms = None
+    if run_backward:
+        diffable = [a for a in args
+                    if a is not None and np.issubdtype(a.dtype, np.floating)]
+        if diffable:
+            for a in diffable:
+                a.attach_grad()
+
+            def loss():
+                with autograd.record():
+                    out = fwd()
+                    head = out[0] if isinstance(out, tuple) else out
+                    s = head.sum()
+                s.backward()
+                return diffable[0].grad
+            try:
+                for _ in range(warmup):
+                    g = loss()
+                jax.block_until_ready(g._data)
+                t0 = time.perf_counter()
+                for _ in range(runs):
+                    g = loss()
+                jax.block_until_ready(g._data)
+                bwd_ms = (time.perf_counter() - t0) / runs * 1e3
+            except Exception as e:
+                print("backward failed for %s: %s" % (name, str(e)[:80]),
+                      file=sys.stderr)
+    return {"op": name, "fwd_ms": round(fwd_ms, 4),
+            "fwd_bwd_ms": round(bwd_ms, 4) if bwd_ms is not None else None}
+
+
+def run_performance_test(ops=None, warmup=3, runs=20, run_backward=True):
+    """ref: opperf.py run_op_benchmarks — returns a list of result dicts."""
+    specs = default_specs()
+    names = ops if ops else sorted(specs)
+    results = []
+    for name in names:
+        if name not in specs:
+            print("skipping %s (no benchmark spec)" % name, file=sys.stderr)
+            continue
+        try:
+            results.append(bench_op(name, specs[name], warmup, runs,
+                                    run_backward))
+        except Exception as e:
+            results.append({"op": name, "error": str(e)[:120]})
+    return results
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="op micro-benchmarks (ref: benchmark/opperf)")
+    parser.add_argument("--ops", default=None,
+                        help="comma-separated op subset")
+    parser.add_argument("--runs", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--no-backward", action="store_true")
+    parser.add_argument("--json", default=None, help="write results here")
+    args = parser.parse_args(argv)
+    ops = args.ops.split(",") if args.ops else None
+    results = run_performance_test(ops, args.warmup, args.runs,
+                                   not args.no_backward)
+    print("%-18s %12s %12s" % ("op", "fwd (ms)", "fwd+bwd (ms)"))
+    for r in results:
+        if "error" in r:
+            print("%-18s ERROR: %s" % (r["op"], r["error"]))
+        else:
+            print("%-18s %12.4f %12s" % (
+                r["op"], r["fwd_ms"],
+                "%.4f" % r["fwd_bwd_ms"] if r["fwd_bwd_ms"] else "-"))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

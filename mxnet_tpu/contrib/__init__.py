@@ -2,3 +2,5 @@
 (ref: python/mxnet/contrib/__init__.py): AMP, INT8 quantization, ONNX."""
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401
+from . import svrg_optimization  # noqa: F401

@@ -1,0 +1,42 @@
+"""ONNX import/export (ref: python/mxnet/contrib/onnx/__init__.py).
+
+The reference's ONNX bridge requires the external ``onnx`` package at
+call time, as does this one; this environment does not ship it, so the
+entry points raise the same guided ImportError the reference raises
+(ref: contrib/onnx/onnx2mx/import_model.py:30 'Onnx and protobuf need to
+be installed')."""
+from __future__ import annotations
+
+__all__ = ["import_model", "export_model", "get_model_metadata"]
+
+_MSG = ("Onnx and protobuf need to be installed. Instructions to install "
+        "- https://github.com/onnx/onnx")
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise ImportError(_MSG)
+
+
+def import_model(model_file):
+    """ref: contrib/onnx/onnx2mx/import_model.py import_model."""
+    _require_onnx()
+    raise NotImplementedError(
+        "ONNX graph import is planned once the onnx package is available "
+        "in this environment")
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """ref: contrib/onnx/mx2onnx/export_model.py export_model."""
+    _require_onnx()
+    raise NotImplementedError(
+        "ONNX graph export is planned once the onnx package is available "
+        "in this environment")
+
+
+def get_model_metadata(model_file):
+    _require_onnx()
+    raise NotImplementedError

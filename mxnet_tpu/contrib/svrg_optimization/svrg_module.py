@@ -1,0 +1,149 @@
+"""SVRGModule: Module with stochastic variance-reduced gradients (ref:
+python/mxnet/contrib/svrg_optimization/svrg_module.py).
+
+Same algorithm as the reference: every ``update_freq`` epochs, snapshot
+the weights (w0) and compute the full-dataset gradient mu at w0; each
+step then updates with ``g(w) - g_w0(batch) + mu``. A second Module bound
+to the same symbol holds the snapshot, exactly like the reference's
+``_mod_aux``."""
+from __future__ import annotations
+
+import logging
+
+from ... import ndarray as nd
+from ...module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """ref: svrg_module.py:36 SVRGModule(symbol, ..., update_freq)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None, context=None,
+                 update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, **kwargs)
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context, **kwargs)
+        self._param_dict = None   # mu: full grads at the snapshot
+        self._ctx_len = 1
+
+    # -- lifecycle (mirror calls onto the snapshot module) -----------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind, shared_module,
+                               grad_req)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        arg_params, aux_params = self.get_params()
+        self._mod_aux.init_params(arg_params=dict(arg_params),
+                                  aux_params=dict(aux_params),
+                                  allow_missing=False, force_init=True,
+                                  allow_extra=False)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        # route through _SVRGOptimizer so the kvstore path matches the
+        # reference's special-key scheme in spirit
+        params = dict(optimizer_params) if not isinstance(
+            optimizer_params, dict) else dict(optimizer_params)
+        super().init_optimizer(kvstore=kvstore, optimizer="_svrgoptimizer",
+                               optimizer_params=dict(
+                                   params, default_optimizer=optimizer),
+                               force_init=force_init)
+
+    # -- SVRG machinery ----------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Snapshot weights into _mod_aux and accumulate the full-dataset
+        gradient mu at the snapshot (ref: svrg_module.py update_full_grads)."""
+        arg_params, aux_params = self.get_params()
+        self._mod_aux.set_params(arg_params=dict(arg_params),
+                                 aux_params=dict(aux_params))
+        train_data.reset()
+        nbatch = 0
+        accum = None
+        for batch in train_data:
+            self._mod_aux.forward_backward(batch)
+            grads = self._mod_aux._exec_group.executor.grad_dict
+            if accum is None:
+                accum = {k: g.asnumpy().copy() for k, g in grads.items()}
+            else:
+                for k, g in grads.items():
+                    accum[k] += g.asnumpy()
+            nbatch += 1
+        assert nbatch > 0, "empty training data"
+        self._param_dict = {k: nd.array(v / nbatch)
+                            for k, v in accum.items()}
+        train_data.reset()
+
+    def forward_backward(self, data_batch):
+        """Forward/backward on BOTH modules: main at w, aux at w0
+        (ref: svrg_module.py forward_backward)."""
+        super().forward_backward(data_batch)
+        if self._param_dict is not None:
+            self._mod_aux.forward(data_batch, is_train=True)
+            self._mod_aux.backward()
+
+    def update(self):
+        """Apply the variance-reduced update (ref: svrg_module.py update →
+        _update_svrg_gradients)."""
+        if self._param_dict is not None:
+            self._update_svrg_gradients()
+        super().update()
+
+    def _update_svrg_gradients(self):
+        g_main = self._exec_group.executor.grad_dict
+        g_aux = self._mod_aux._exec_group.executor.grad_dict
+        for name, g in g_main.items():
+            mu = self._param_dict.get(name)
+            g0 = g_aux.get(name)
+            if mu is None or g0 is None:
+                continue
+            g._data = g._data - g0._data + mu._data
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=None, **kwargs):
+        """Training loop with the periodic full-gradient pass
+        (ref: svrg_module.py fit)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        from ...metric import create as metric_create
+        from ...initializer import Uniform
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True)
+        self.init_params(initializer=initializer or Uniform(0.01))
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not hasattr(eval_metric, "update"):
+            eval_metric = metric_create(eval_metric)
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            train_data.reset()
+            eval_metric.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    batch_end_callback(epoch=epoch, nbatch=nbatch,
+                                       eval_metric=eval_metric)
+            name, val = eval_metric.get()
+            (self.logger or logging).info("Epoch[%d] Train-%s=%f",
+                                          epoch, name, val)
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch=epoch)

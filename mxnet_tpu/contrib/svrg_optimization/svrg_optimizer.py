@@ -1,0 +1,35 @@
+"""SVRG gradient-correction optimizer (ref:
+python/mxnet/contrib/svrg_optimization/svrg_optimizer.py).
+
+Wraps a base optimizer; the module feeds it variance-reduced gradients
+``g_corrected = g(w) - g(w0) + mu`` where w0 is the epoch snapshot and mu
+the full-dataset gradient at w0 (Johnson & Zhang 2013, as in the
+reference)."""
+from __future__ import annotations
+
+from ... import optimizer as _opt
+
+__all__ = ["_SVRGOptimizer"]
+
+
+@_opt.register
+class _SVRGOptimizer(_opt.Optimizer):
+    """ref: svrg_optimizer.py:_SVRGOptimizer — delegates state and update
+    math to `default_optimizer`, receiving already-corrected gradients."""
+
+    def __init__(self, default_optimizer="sgd", **kwargs):
+        # pull out our own arg; the rest parameterize the base optimizer
+        super().__init__(rescale_grad=kwargs.get("rescale_grad", 1.0))
+        base_kwargs = dict(kwargs)
+        base_kwargs.pop("rescale_grad", None)
+        if isinstance(default_optimizer, str):
+            self.default_opt = _opt.create(default_optimizer, **base_kwargs)
+        else:
+            self.default_opt = default_optimizer
+
+    def create_state(self, index, weight):
+        return self.default_opt.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        self.default_opt.rescale_grad = self.rescale_grad
+        return self.default_opt.update(index, weight, grad, state)

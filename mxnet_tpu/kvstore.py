@@ -309,7 +309,7 @@ def create(name="local"):
             from .parallel import initialize_distributed
             try:
                 initialize_distributed()
-            except RuntimeError as e:
+            except Exception as e:  # late init, malformed env, ...
                 warnings.warn(
                     "could not auto-join the distributed job (%s); call "
                     "mxnet_tpu.parallel.initialize_distributed() before "

@@ -515,7 +515,17 @@ class NDArray:
 
     @property
     def dlpack(self):
-        return jax.dlpack.to_dlpack(self._data)
+        """Zero-copy interchange: jax arrays implement the standard
+        ``__dlpack__`` protocol, so the buffer itself is the capsule
+        carrier (ref: tests/python/unittest/test_dlpack.py;
+        to_dlpack_for_read in python/mxnet/ndarray/ndarray.py)."""
+        return self._data
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._data.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
 
 
 def _place(data, ctx):

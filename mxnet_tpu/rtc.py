@@ -1,0 +1,78 @@
+"""Runtime kernel compilation (ref: python/mxnet/rtc.py CudaModule,
+src/common/rtc.cc NVRTC wrapper).
+
+The reference compiles raw CUDA C at runtime via NVRTC and launches it on
+streams. The TPU-native equivalent is Pallas: kernels are Python functions
+compiled through Mosaic, so ``PallasModule`` fills the ``CudaModule`` API
+slot — construct with kernel functions, get launchable handles, call them
+on arrays. ``CudaModule`` itself remains as a guided error for ported
+code (CUDA C source cannot target the MXU).
+"""
+from __future__ import annotations
+
+import jax
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CudaModule", "PallasModule"]
+
+
+class PallasModule:
+    """Bundle of named Pallas kernels (API mirror of rtc.py:CudaModule).
+
+    ``kernels`` maps name -> a callable built from ``pl.pallas_call`` (or
+    any jax-jittable function). ``get_kernel(name)`` returns a launchable
+    whose ``launch(args, ...)`` runs on the attached device —
+    grid/block configuration lives inside the pallas_call, where the
+    compiler can see it, instead of the launch site like CUDA."""
+
+    def __init__(self, kernels):
+        self._kernels = dict(kernels)
+        self._compiled = {}
+
+    def get_kernel(self, name, signature=None):
+        """signature accepted for CudaModule API compat; shapes/dtypes are
+        inferred per call by tracing (ref: rtc.py get_kernel). Kernels are
+        cached per name so repeated get_kernel().launch() in a loop hits
+        the jit compile cache."""
+        kern = self._compiled.get(name)
+        if kern is None:
+            kern = _Kernel(self._kernels[name], name)
+            self._compiled[name] = kern
+        return kern
+
+    def names(self):
+        return sorted(self._kernels)
+
+
+class _Kernel:
+    """ref: rtc.py CudaKernel.launch."""
+
+    def __init__(self, fn, name):
+        self._fn = jax.jit(fn)
+        self.name = name
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        """grid/block/shared_mem accepted for API compat and ignored —
+        Mosaic owns the schedule (ref: rtc.py launch signature)."""
+        datas = [a._data if isinstance(a, NDArray) else a for a in args]
+        out = self._fn(*datas)
+        if isinstance(out, (tuple, list)):
+            return [NDArray(o) for o in out]
+        return NDArray(out)
+
+    def __call__(self, *args):
+        return self.launch(args)
+
+
+class CudaModule:
+    """ref: python/mxnet/rtc.py:CudaModule — raw CUDA C has no TPU
+    lowering; port kernels to Pallas and use PallasModule."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise NotImplementedError(
+            "CudaModule compiles CUDA C via NVRTC, which cannot target "
+            "the TPU MXU. Write the kernel with jax.experimental.pallas "
+            "and wrap it in mxnet_tpu.rtc.PallasModule (see "
+            "mxnet_tpu/pallas_kernels/ for worked examples).")

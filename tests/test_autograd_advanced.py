@@ -1,0 +1,154 @@
+"""Higher-order gradients, custom Functions, dlpack interop, rtc
+(ref: tests/python/unittest/test_higher_order_grad.py, test_autograd.py
+Function cases, test_dlpack.py, tests/python/gpu/test_rtc.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+class TestHigherOrderGrad:
+    def test_sin_second_order(self):
+        """d2/dx2 sin(x) = -sin(x) (ref: test_higher_order_grad.py:sin)."""
+        x = mx.nd.array(onp.array([0.5, 1.0, 2.0], "float32"))
+        x.attach_grad()
+        with autograd.record():
+            y = mx.nd.sin(x).sum()
+            dx = autograd.grad(y, [x], create_graph=True)[0]
+            z = dx.sum()
+        z.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(),
+                                    -onp.sin([0.5, 1.0, 2.0]), atol=1e-6)
+
+    def test_power_chain(self):
+        """d/dx (d/dx x^3)^2 = d/dx 9x^4 = 36x^3."""
+        x = mx.nd.array(onp.array([1.0, 2.0], "float32"))
+        x.attach_grad()
+        with autograd.record():
+            y = (x ** 3).sum()
+            dx = autograd.grad(y, [x], create_graph=True)[0]
+            z = (dx ** 2).sum()
+        z.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), [36.0, 288.0],
+                                    atol=1e-4)
+
+    def test_log_second_order(self):
+        """d2/dx2 log(x) = -1/x^2 (ref: test_higher_order_grad.py:log)."""
+        x = mx.nd.array(onp.array([1.0, 2.0, 4.0], "float32"))
+        x.attach_grad()
+        with autograd.record():
+            y = mx.nd.log(x).sum()
+            dx = autograd.grad(y, [x], create_graph=True)[0]
+            z = dx.sum()
+        z.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(),
+                                    [-1.0, -0.25, -0.0625], atol=1e-6)
+
+
+class TestFunction:
+    def test_custom_function(self):
+        """ref: python/mxnet/autograd.py:368 Function; tests/python/
+        unittest/test_autograd.py test_function."""
+
+        class Sigmoid(autograd.Function):
+            def forward(self, x):
+                y = 1.0 / (1.0 + mx.nd.exp(-x))
+                self.save_for_backward(y)
+                return y
+
+            def backward(self, dy):
+                y, = self.saved_tensors
+                return dy * y * (1.0 - y)
+
+        x = mx.nd.array(onp.array([0.0, 1.0, -1.0], "float32"))
+        x.attach_grad()
+        fn = Sigmoid()
+        with autograd.record():
+            out = fn(x)
+            loss = out.sum()
+        loss.backward()
+        s = 1.0 / (1.0 + onp.exp(-onp.array([0.0, 1.0, -1.0])))
+        onp.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s),
+                                    atol=1e-6)
+
+
+class TestDLPack:
+    def test_roundtrip_jax(self):
+        import jax.dlpack
+        import jax.numpy as jnp
+        a = mx.nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+        cap = a.dlpack
+        back = jnp.from_dlpack(cap) if hasattr(jnp, "from_dlpack") else \
+            jax.dlpack.from_dlpack(cap)
+        onp.testing.assert_array_equal(onp.asarray(back), a.asnumpy())
+
+    def test_array_protocol(self):
+        a = mx.nd.array(onp.ones((2, 2), "float32"))
+        assert onp.asarray(a).shape == (2, 2)
+
+
+class TestRTC:
+    def test_cuda_module_guided_error(self):
+        from mxnet_tpu import rtc
+        with pytest.raises(NotImplementedError, match="[Pp]allas"):
+            rtc.CudaModule("__global__ void k() {}")
+
+    def test_pallas_module_launch(self):
+        import jax.numpy as jnp
+        from mxnet_tpu import rtc
+
+        mod = rtc.PallasModule({"axpy": lambda a, x, y: a * x + y})
+        kern = mod.get_kernel("axpy")
+        out = kern.launch([mx.nd.array(onp.float32(2.0)),
+                           mx.nd.ones((4,)), mx.nd.ones((4,))])
+        onp.testing.assert_allclose(out.asnumpy(), [3.0] * 4)
+        assert mod.names() == ["axpy"]
+
+
+class TestOpperf:
+    def test_harness_runs(self):
+        import sys
+        sys.path.insert(0, "benchmark/opperf")
+        from opperf import run_performance_test
+        res = run_performance_test(ops=["add", "dot"], warmup=1, runs=2)
+        assert len(res) == 2
+        for r in res:
+            assert "error" not in r, r
+            assert r["fwd_ms"] > 0
+            assert r["fwd_bwd_ms"] is not None
+
+
+class TestSVRG:
+    def test_svrg_converges(self):
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu import io as mio
+        from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+        rng = onp.random.RandomState(0)
+        X = rng.randn(96, 8).astype("float32")
+        y = (X.sum(1) > 0).astype("float32")
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        fc = sym.FullyConnected(data, num_hidden=2, name="fc")
+        out = sym.SoftmaxOutput(fc, label, name="softmax")
+        it = mio.NDArrayIter(X, y, batch_size=16)
+        mod = SVRGModule(out, context=mx.cpu(), update_freq=2)
+        mod.fit(it, num_epoch=6, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.2})
+        it.reset()
+        acc = dict(mod.score(it, "acc"))["accuracy"]
+        assert acc > 0.9, acc
+
+    def test_svrg_optimizer_registered(self):
+        import mxnet_tpu.optimizer as opt
+        from mxnet_tpu.contrib.svrg_optimization import _SVRGOptimizer
+        o = opt.create("_svrgoptimizer", default_optimizer="sgd",
+                       learning_rate=0.1)
+        assert isinstance(o, _SVRGOptimizer)
+
+
+def test_onnx_gated():
+    from mxnet_tpu.contrib import onnx as mxonnx
+    with pytest.raises(ImportError, match="Onnx and protobuf"):
+        mxonnx.import_model("m.onnx")

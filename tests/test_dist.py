@@ -14,8 +14,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _run_launcher(n, script, timeout=240):
     env = dict(os.environ)
-    env.pop("PYTHONPATH", None)  # the axon sitecustomize grabs the real TPU
     env["JAX_PLATFORMS"] = "cpu"
+    # replace (not extend) PYTHONPATH: the axon sitecustomize on it would
+    # grab the real TPU in every worker
     env["PYTHONPATH"] = REPO
     cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
            "-n", str(n), sys.executable, os.path.join(REPO, script)]

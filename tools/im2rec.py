@@ -101,7 +101,10 @@ def main(argv=None):
     parser.add_argument("--list", action="store_true",
                         help="create the image list instead of the record")
     parser.add_argument("--no-recursive", action="store_true")
-    parser.add_argument("--shuffle", action="store_true", default=True)
+    parser.add_argument("--shuffle", dest="shuffle", action="store_true",
+                        default=True,
+                        help="shuffle the list (default; see --no-shuffle)")
+    parser.add_argument("--no-shuffle", dest="shuffle", action="store_false")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--resize", type=int, default=0)
     parser.add_argument("--quality", type=int, default=95)

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -80,8 +81,9 @@ def launch_ssh(n, hosts, command):
             ("MXTPU_NUM_PROCS", str(n)),
             ("MXTPU_PROC_ID", str(rank)),
         ])
-        remote = "cd %s && env %s %s" % (os.getcwd(), envs,
-                                         " ".join(command))
+        remote = "cd %s && env %s %s" % (
+            shlex.quote(os.getcwd()), envs,
+            " ".join(shlex.quote(c) for c in command))
         procs.append(subprocess.Popen(["ssh", "-o",
                                        "StrictHostKeyChecking=no", host,
                                        remote]))
@@ -107,6 +109,8 @@ def main(argv=None):
     if args.launcher == "local":
         codes = launch_local(args.num_workers, args.command)
     else:
+        if not args.hostfile:
+            parser.error("the ssh launcher requires -H/--hostfile")
         with open(args.hostfile) as f:
             hosts = [ln.strip() for ln in f if ln.strip()]
         codes = launch_ssh(args.num_workers, hosts, args.command)

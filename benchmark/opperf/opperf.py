@@ -97,7 +97,7 @@ def bench_op(name, make_inputs, warmup=3, runs=20, run_backward=True):
     def fwd():
         return fn(*args, **kwargs)
 
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):  # >=1: the compile must not be timed
         out = fwd()
     jax.block_until_ready(out._data if hasattr(out, "_data")
                           else [o._data for o in out])
@@ -124,7 +124,7 @@ def bench_op(name, make_inputs, warmup=3, runs=20, run_backward=True):
                 s.backward()
                 return diffable[0].grad
             try:
-                for _ in range(warmup):
+                for _ in range(max(warmup, 1)):
                     g = loss()
                 jax.block_until_ready(g._data)
                 t0 = time.perf_counter()

@@ -30,7 +30,6 @@ class SVRGModule(Module):
                                label_names=label_names, logger=logger,
                                context=context, **kwargs)
         self._param_dict = None   # mu: full grads at the snapshot
-        self._ctx_len = 1
 
     # -- lifecycle (mirror calls onto the snapshot module) -----------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -56,8 +55,7 @@ class SVRGModule(Module):
                        force_init=False):
         # route through _SVRGOptimizer so the kvstore path matches the
         # reference's special-key scheme in spirit
-        params = dict(optimizer_params) if not isinstance(
-            optimizer_params, dict) else dict(optimizer_params)
+        params = dict(optimizer_params)
         super().init_optimizer(kvstore=kvstore, optimizer="_svrgoptimizer",
                                optimizer_params=dict(
                                    params, default_optimizer=optimizer),
@@ -116,12 +114,17 @@ class SVRGModule(Module):
             epoch_end_callback=None, batch_end_callback=None,
             kvstore="local", optimizer="sgd",
             optimizer_params=(("learning_rate", 0.01),),
-            initializer=None, num_epoch=None, **kwargs):
+            initializer=None, num_epoch=None, validation_metric=None,
+            **kwargs):
         """Training loop with the periodic full-gradient pass
-        (ref: svrg_module.py fit)."""
+        (ref: svrg_module.py fit). Callback conventions match
+        BaseModule.fit: BatchEndParam for batch callbacks,
+        (epoch, symbol, arg_params, aux_params) for epoch callbacks."""
         assert num_epoch is not None, "please specify number of epochs"
         from ...metric import create as metric_create
         from ...initializer import Uniform
+        from ...model import BatchEndParam
+        from ...module.base_module import _as_list
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True)
@@ -140,10 +143,21 @@ class SVRGModule(Module):
                 self.update()
                 self.update_metric(eval_metric, batch.label)
                 if batch_end_callback is not None:
-                    batch_end_callback(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric)
+                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(params)
             name, val = eval_metric.get()
             (self.logger or logging).info("Epoch[%d] Train-%s=%f",
                                           epoch, name, val)
             if epoch_end_callback is not None:
-                epoch_end_callback(epoch=epoch)
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric or eval_metric,
+                                 epoch=epoch)
+                for name, val in res:
+                    (self.logger or logging).info(
+                        "Epoch[%d] Validation-%s=%f", epoch, name, val)

@@ -1,0 +1,6 @@
+"""Text utilities: vocabulary + token embeddings
+(ref: python/mxnet/contrib/text/__init__.py)."""
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from . import embedding  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

@@ -1,0 +1,165 @@
+"""Token embeddings (ref: python/mxnet/contrib/text/embedding.py).
+
+The reference downloads pretrained GloVe/fastText tables; this
+environment has no egress, so pretrained classes load from local files in
+the same text format ('token v1 v2 ... vN' per line) via
+``from_file`` / ``CustomEmbedding`` — the reference's own custom-embedding
+path (embedding.py:CustomEmbedding)."""
+from __future__ import annotations
+
+import io
+import logging
+
+import numpy as _np
+
+from ... import ndarray as nd
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding", "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    """ref: embedding.py register."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(embedding_name, **kwargs):
+    """ref: embedding.py create."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError("Cannot find embedding %r; registered: %s"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """ref: embedding.py get_pretrained_file_names. No pretrained archives
+    ship in this environment — load local files via CustomEmbedding."""
+    return {name: [] for name in _REGISTRY} if embedding_name is None else []
+
+
+class TokenEmbedding(Vocabulary):
+    """Vocabulary + dense vectors (ref: embedding.py:60 _TokenEmbedding)."""
+
+    def __init__(self, unknown_token="<unk>", init_unknown_vec=None):
+        super().__init__(counter=None, unknown_token=unknown_token)
+        self._init_unknown_vec = init_unknown_vec or (lambda s: nd.zeros(s))
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding_txt(self, file_handle, elem_delim=" "):
+        """Parse 'token v1 ... vN' lines (ref: embedding.py
+        _load_embedding_txt)."""
+        vecs = []
+        for lineno, line in enumerate(file_handle):
+            parts = line.rstrip().split(elem_delim)
+            if len(parts) < 2:
+                continue
+            if lineno == 0 and len(parts) == 2:
+                # fastText .vec header: "<token_count> <dim>" — both
+                # numeric, not an embedding row (ref: embedding.py FastText
+                # _load_embedding skipping the header)
+                try:
+                    int(parts[0]), int(parts[1])
+                    continue
+                except ValueError:
+                    pass
+            token, elems = parts[0], parts[1:]
+            if self._vec_len == 0:
+                self._vec_len = len(elems)
+                vecs.append(_np.zeros(self._vec_len, "float32"))  # <unk>
+            if len(elems) != self._vec_len:
+                logging.warning("line %d: expected %d dims, got %d — "
+                                "skipped", lineno, self._vec_len, len(elems))
+                continue
+            if token in self._token_to_idx:
+                continue
+            self._idx_to_token.append(token)
+            self._token_to_idx[token] = len(self._idx_to_token) - 1
+            vecs.append(_np.asarray(elems, "float32"))
+        assert vecs, "no embedding vectors found"
+        mat = _np.stack(vecs)
+        unk = self._init_unknown_vec((self._vec_len,))
+        mat[0] = unk.asnumpy() if hasattr(unk, "asnumpy") else unk
+        self._idx_to_vec = nd.array(mat)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """ref: embedding.py get_vecs_by_tokens."""
+        single = isinstance(tokens, str)
+        seq = [tokens] if single else tokens
+        idxs = []
+        for t in seq:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idxs.append(0 if i is None else i)
+        vecs = self._idx_to_vec[nd.array(_np.asarray(idxs, "int32"))]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """ref: embedding.py update_token_vectors."""
+        seq = [tokens] if isinstance(tokens, str) else tokens
+        if not isinstance(new_vectors, (list, tuple)):
+            new_vectors = [new_vectors[i] for i in range(len(seq))] \
+                if len(seq) > 1 else [new_vectors]
+        for t, v in zip(seq, new_vectors):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r is unknown" % t)
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a local text file (ref: embedding.py:CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path=None, elem_delim=" ",
+                 encoding="utf8", unknown_token="<unk>",
+                 init_unknown_vec=None, **kwargs):
+        super().__init__(unknown_token=unknown_token,
+                         init_unknown_vec=init_unknown_vec)
+        if pretrained_file_path is not None:
+            with io.open(pretrained_file_path, "r",
+                         encoding=encoding) as f:
+                self._load_embedding_txt(f, elem_delim)
+
+
+@register
+class GloVe(CustomEmbedding):
+    """GloVe-format loader (ref: embedding.py:GloVe). Pretrained archives
+    are not downloadable here; pass pretrained_file_path to a local copy."""
+
+
+@register
+class FastText(CustomEmbedding):
+    """fastText-format loader (ref: embedding.py:FastText); first line with
+    'count dim' headers is tolerated (skipped by the <2 column check)."""
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (ref: embedding.py:CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        mats = []
+        for emb in token_embeddings:
+            mats.append(emb.get_vecs_by_tokens(self._idx_to_token).asnumpy())
+        mat = _np.concatenate(mats, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = nd.array(mat)

@@ -1,0 +1,21 @@
+"""Text tokenization helpers (ref: python/mxnet/contrib/text/utils.py)."""
+from __future__ import annotations
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency counter (ref: utils.py count_tokens_from_str)."""
+    source_str = re.sub(r"\n+", " ", source_str) if seq_delim == "\n" \
+        else source_str.replace(seq_delim, " ")
+    if to_lower:
+        source_str = source_str.lower()
+    tokens = [t for t in source_str.split(token_delim) if t]
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(tokens)
+    return counter

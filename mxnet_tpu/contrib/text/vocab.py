@@ -1,0 +1,80 @@
+"""Vocabulary: token <-> index mapping
+(ref: python/mxnet/contrib/text/vocab.py:30 Vocabulary)."""
+from __future__ import annotations
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexes tokens by frequency with reserved tokens up front
+    (ref: vocab.py:75 __init__). Index 0 is the unknown token."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("`min_freq` must be set to a positive value.")
+        if reserved_tokens is not None:
+            if unknown_token in reserved_tokens or \
+                    len(set(reserved_tokens)) != len(reserved_tokens):
+                raise ValueError("`reserved_tokens` cannot contain "
+                                 "duplicates or the unknown token.")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens) \
+            if reserved_tokens else None
+        self._idx_to_token = [unknown_token] + (list(reserved_tokens)
+                                               if reserved_tokens else [])
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        # stable order: frequency desc, then insertion (ref: vocab.py:121)
+        token_freqs = sorted(counter.items(), key=lambda x: x[0])
+        token_freqs.sort(key=lambda x: x[1], reverse=True)
+        limit = len(self._idx_to_token) + (most_freq_count
+                                           if most_freq_count is not None
+                                           else len(token_freqs))
+        for token, freq in token_freqs:
+            if freq < min_freq or len(self._idx_to_token) >= limit:
+                break
+            if token not in self._token_to_idx:
+                self._idx_to_token.append(token)
+                self._token_to_idx[token] = len(self._idx_to_token) - 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Tokens -> indices; unknown tokens map to index 0
+        (ref: vocab.py to_indices)."""
+        single = isinstance(tokens, str)
+        seq = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in seq]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Indices -> tokens (ref: vocab.py to_tokens)."""
+        single = isinstance(indices, int)
+        seq = [indices] if single else indices
+        out = []
+        for i in seq:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("Token index %d out of range" % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -259,6 +259,28 @@ class ndarray(NDArray):
             raise TypeError("len() of unsized object")
         return self.shape[0]
 
+    # -- NumPy dispatch protocol (ref: python/mxnet/numpy_dispatch_protocol
+    # .py — onp.mean(mx_array) etc. dispatch to the mx implementation) ----
+    def __array_function__(self, func, types, args, kwargs):
+        import sys
+        mod = sys.modules[__name__.rsplit(".", 1)[0]]  # mxnet_tpu.numpy
+        impl = getattr(mod, func.__name__, None)
+        if impl is None and func.__module__ == "numpy.linalg":
+            impl = getattr(mod.linalg, func.__name__, None)
+        if impl is None:
+            return NotImplemented
+        return impl(*args, **kwargs)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__":
+            return NotImplemented
+        import sys
+        mod = sys.modules[__name__.rsplit(".", 1)[0]]
+        impl = getattr(mod, ufunc.__name__, None)
+        if impl is None:
+            return NotImplemented
+        return impl(*inputs, **kwargs)
+
     def __repr__(self):
         arr = self.asnumpy()
         prefix = "array("

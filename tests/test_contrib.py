@@ -287,3 +287,96 @@ class TestReviewRegressions:
         handler = StoppingHandler()  # user-supplied, unparameterized
         est.fit(train_data=data, batches=2, event_handlers=[handler])
         assert handler.current_batch == 2  # synced max_batch, stopped
+
+
+class TestText:
+    def test_vocab(self):
+        from mxnet_tpu.contrib import text
+        counter = text.utils.count_tokens_from_str("a b b c c c")
+        v = text.Vocabulary(counter, min_freq=2)
+        assert v.idx_to_token[0] == "<unk>"
+        assert v.to_indices("c") == 1
+        assert v.to_indices("nope") == 0
+        assert v.to_tokens(1) == "c"
+        assert len(v) == 3
+
+    def test_embedding_file_and_composite(self, tmp_path):
+        from mxnet_tpu.contrib import text
+        p = tmp_path / "emb.txt"
+        p.write_text("hello 1.0 2.0\nworld 3.0 4.0\n")
+        emb = text.embedding.CustomEmbedding(str(p))
+        assert emb.vec_len == 2
+        onp.testing.assert_allclose(
+            emb.get_vecs_by_tokens("world").asnumpy(), [3.0, 4.0])
+        assert (emb.get_vecs_by_tokens("zz").asnumpy() == 0).all()
+        v = text.Vocabulary({"hello": 2, "zz": 1})
+        comp = text.embedding.CompositeEmbedding(v, [emb])
+        assert comp.idx_to_vec.shape == (3, 2)
+
+    def test_registry(self, tmp_path):
+        from mxnet_tpu.contrib import text
+        p = tmp_path / "emb.txt"
+        p.write_text("a 1.0\n")
+        e = text.embedding.create("glove", pretrained_file_path=str(p))
+        assert e.vec_len == 1
+
+
+class TestNumpyDispatch:
+    def test_array_function_protocol(self):
+        from mxnet_tpu import np as mnp
+        a = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+        m = onp.mean(a, axis=0)
+        assert isinstance(m, mnp.ndarray)
+        assert m.asnumpy().tolist() == [2.0, 3.0]
+        c = onp.concatenate([a, a])
+        assert isinstance(c, mnp.ndarray) and c.shape == (4, 2)
+
+    def test_array_ufunc_protocol(self):
+        from mxnet_tpu import np as mnp
+        a = mnp.array([0.0, 1.0])
+        s = onp.sin(a)
+        assert isinstance(s, mnp.ndarray)
+        onp.testing.assert_allclose(s.asnumpy(), onp.sin([0.0, 1.0]),
+                                    atol=1e-6)
+
+    def test_fasttext_header_skipped(self, tmp_path):
+        from mxnet_tpu.contrib import text
+        p = tmp_path / "ft.vec"
+        p.write_text("2 3\nhello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+        emb = text.embedding.FastText(pretrained_file_path=str(p))
+        assert emb.vec_len == 3
+        assert len(emb) == 3  # <unk> + 2 tokens, header not a token
+        onp.testing.assert_allclose(
+            emb.get_vecs_by_tokens("hello").asnumpy(), [1.0, 2.0, 3.0])
+
+    def test_vocab_most_freq_count_zero(self):
+        from mxnet_tpu.contrib import text
+        v = text.Vocabulary({"a": 5, "b": 3}, most_freq_count=0)
+        assert len(v) == 1  # only <unk>
+
+
+class TestSVRGCallbacks:
+    def test_standard_callbacks_work(self):
+        from mxnet_tpu import symbol as sym, io as mio, callback
+        from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+        import mxnet_tpu as mx
+        rng = onp.random.RandomState(0)
+        X = rng.randn(32, 4).astype("float32")
+        yv = (X.sum(1) > 0).astype("float32")
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        out = sym.SoftmaxOutput(sym.FullyConnected(data, num_hidden=2,
+                                                   name="fc"), label,
+                                name="softmax")
+        it = mio.NDArrayIter(X, yv, batch_size=16)
+        mod = SVRGModule(out, context=mx.cpu(), update_freq=1)
+        seen = {"epoch_end": 0}
+
+        def epoch_cb(epoch, symbol, arg_p, aux_p):
+            assert "fc_weight" in arg_p
+            seen["epoch_end"] += 1
+        mod.fit(it, eval_data=it, num_epoch=2,
+                batch_end_callback=callback.Speedometer(16, 1),
+                epoch_end_callback=epoch_cb,
+                optimizer_params={"learning_rate": 0.1})
+        assert seen["epoch_end"] == 2

@@ -7,6 +7,7 @@ functions, and one generated wrapper per registered operator.
 from __future__ import annotations
 
 import pickle
+import struct
 
 import jax
 import jax.numpy as jnp
@@ -86,32 +87,124 @@ def imperative_invoke(name, *args, **kwargs):
 _MAGIC = b"MXTPU_ND1"
 
 
+# Reference binary .params format, byte-identical to MXNDArraySave
+# (ref: src/ndarray/ndarray.cc:1829 NDArray::Save list writer, :1603 the
+# per-array V2 record; include/mxnet/tuple.h:704 TShape::Save;
+# include/mxnet/base.h:157 Context::Save). Checkpoints written by the
+# reference load here unchanged and vice versa.
+_LIST_MAGIC = 0x112
+_ND_V2_MAGIC = 0xF993fac9
+_ND_V3_MAGIC = 0xF993faca  # np-shape semantics; same layout
+_TYPE_FLAGS = {  # mshadow type_flag <-> numpy dtype
+    0: _np.dtype("float32"), 1: _np.dtype("float64"),
+    2: _np.dtype("float16"), 3: _np.dtype("uint8"),
+    4: _np.dtype("int32"), 5: _np.dtype("int8"), 6: _np.dtype("int64"),
+    7: _np.dtype("bool"),
+}
+_DTYPE_TO_FLAG = {v: k for k, v in _TYPE_FLAGS.items()}
+
+
+def _write_one(f, arr):
+    a = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
+    if a.dtype not in _DTYPE_TO_FLAG:
+        if str(a.dtype) == "bfloat16":
+            # bf16 has no 1.x type flag; store as f32 so reference tools
+            # can read the checkpoint
+            a = a.astype(_np.float32)
+        else:
+            raise TypeError("dtype %s has no reference type flag; cast "
+                            "before saving" % a.dtype)
+    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    f.write(struct.pack("<i", 0))                      # kDefaultStorage
+    f.write(struct.pack("<i", a.ndim))
+    f.write(struct.pack("<%dq" % a.ndim, *a.shape))
+    f.write(struct.pack("<ii", 1, 0))                  # Context: cpu(0)
+    f.write(struct.pack("<i", _DTYPE_TO_FLAG[a.dtype]))
+    f.write(_np.ascontiguousarray(a).tobytes())
+
+
+def _read_one(f):
+    magic, = struct.unpack("<I", f.read(4))
+    if magic not in (_ND_V2_MAGIC, _ND_V3_MAGIC):
+        raise ValueError("unsupported NDArray record magic 0x%x (V1 legacy "
+                         "files are not supported)" % magic)
+    stype, = struct.unpack("<i", f.read(4))
+    if stype != 0:
+        raise ValueError("only dense (default storage) records are "
+                         "supported, got stype=%d" % stype)
+    ndim, = struct.unpack("<i", f.read(4))
+    shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+    struct.unpack("<ii", f.read(8))                    # context, ignored
+    type_flag, = struct.unpack("<i", f.read(4))
+    dtype = _TYPE_FLAGS.get(type_flag)
+    if dtype is None:
+        raise ValueError("NDArray record has unsupported mshadow type "
+                         "flag %d" % type_flag)
+    count = int(_np.prod(shape)) if shape else 1
+    data = _np.frombuffer(f.read(count * dtype.itemsize), dtype=dtype)
+    return array(data.reshape(shape), dtype=str(dtype))
+
+
 def save(fname, data):
-    """Save an NDArray, list of NDArrays, or dict str->NDArray."""
+    """Save NDArrays in the reference's .params binary format
+    (ref: python/mxnet/ndarray/utils.py save → MXNDArraySave)."""
     if isinstance(data, NDArray):
-        payload = ("single", _np.asarray(data.asnumpy()))
+        arrays, names = [data], []
     elif isinstance(data, (list, tuple)):
-        payload = ("list", [_np.asarray(a.asnumpy()) for a in data])
+        if any(not isinstance(a, NDArray) for a in data):
+            raise TypeError("save expects NDArrays")
+        arrays, names = list(data), []
     elif isinstance(data, dict):
-        payload = ("dict", {k: _np.asarray(v.asnumpy()) for k, v in data.items()})
+        names = sorted(data)
+        arrays = [data[k] for k in names]
     else:
         raise TypeError("unsupported save payload %r" % type(data))
     with open(fname, "wb") as f:
-        f.write(_MAGIC)
-        pickle.dump(payload, f, protocol=4)
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_one(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
 
 
 def load(fname):
+    """Load a .params file (reference binary format, plus this framework's
+    earlier pickle snapshots for back compatibility). Like the reference's
+    mx.nd.load: a list when records are unnamed, a dict otherwise."""
     with open(fname, "rb") as f:
-        magic = f.read(len(_MAGIC))
-        if magic != _MAGIC:
-            raise ValueError("not a %s NDArray file: %s" % ("mxnet_tpu", fname))
-        kind, payload = pickle.load(f)
-    if kind == "single":
-        return array(payload)
-    if kind == "list":
-        return [array(a) for a in payload]
-    return {k: array(v) for k, v in payload.items()}
+        head = f.read(len(_MAGIC))
+        if head == _MAGIC:  # early-round pickle snapshot
+            kind, payload = pickle.load(f)
+            if kind == "single":
+                return array(payload)
+            if kind == "list":
+                return [array(a) for a in payload]
+            return {k: array(v) for k, v in payload.items()}
+        f.seek(0)
+        try:
+            header, reserved = struct.unpack("<QQ", f.read(16))
+            if header != _LIST_MAGIC:
+                raise ValueError("not an NDArray file: %s" % fname)
+            count, = struct.unpack("<Q", f.read(8))
+            arrays = [_read_one(f) for _ in range(count)]
+            nnames, = struct.unpack("<Q", f.read(8))
+            names = []
+            for _ in range(nnames):
+                ln, = struct.unpack("<Q", f.read(8))
+                names.append(f.read(ln).decode("utf-8"))
+        except struct.error:
+            raise ValueError("truncated or corrupt NDArray file: %s"
+                             % fname)
+    if not names:
+        return arrays
+    if len(names) != len(arrays):
+        raise ValueError("invalid NDArray file (%d names for %d arrays): %s"
+                         % (len(names), len(arrays), fname))
+    return dict(zip(names, arrays))
 
 
 # -- generated op wrappers --------------------------------------------------

@@ -179,3 +179,103 @@ def test_bool_len_iter():
     assert rows[1].shape == (2,)
     with pytest.raises(ValueError):
         bool(b)
+
+
+def test_save_load_reference_binary_format(tmp_path):
+    """The .params container must be byte-compatible with the reference's
+    MXNDArraySave (ref: src/ndarray/ndarray.cc:1829 list writer, :1603 V2
+    record): uint64 0x112 header, V2 magic per record, int32 ndim +
+    int64 dims, cpu context, mshadow type flag, raw bytes."""
+    import struct
+    f = str(tmp_path / "golden.params")
+    # hand-build the file from the C++ spec, independent of the writer
+    w = np.arange(6, dtype="float32").reshape(2, 3)
+    b = np.array([1, 2], dtype="int64")
+    with open(f, "wb") as fh:
+        fh.write(struct.pack("<QQ", 0x112, 0))
+        fh.write(struct.pack("<Q", 2))
+        for a, flag in ((w, 0), (b, 6)):
+            fh.write(struct.pack("<I", 0xF993fac9))
+            fh.write(struct.pack("<i", 0))
+            fh.write(struct.pack("<i", a.ndim))
+            fh.write(struct.pack("<%dq" % a.ndim, *a.shape))
+            fh.write(struct.pack("<ii", 1, 0))
+            fh.write(struct.pack("<i", flag))
+            fh.write(a.tobytes())
+        fh.write(struct.pack("<Q", 2))
+        for name in ("arg:weight", "arg:bias"):
+            nb = name.encode()
+            fh.write(struct.pack("<Q", len(nb)))
+            fh.write(nb)
+    loaded = nd.load(f)
+    assert set(loaded) == {"arg:weight", "arg:bias"}
+    np.testing.assert_array_equal(loaded["arg:weight"].asnumpy(), w)
+    np.testing.assert_array_equal(loaded["arg:bias"].asnumpy(), b)
+    assert str(loaded["arg:bias"].dtype) == "int64" or \
+        str(loaded["arg:bias"].dtype) == "int32"  # canonical 32-bit jax
+
+    # and the writer round-trips through the same byte layout
+    f2 = str(tmp_path / "rt.params")
+    nd.save(f2, {"arg:weight": loaded["arg:weight"]})
+    with open(f2, "rb") as fh:
+        header, _ = struct.unpack("<QQ", fh.read(16))
+        count, = struct.unpack("<Q", fh.read(8))
+        magic, = struct.unpack("<I", fh.read(4))
+    assert header == 0x112 and count == 1 and magic == 0xF993fac9
+
+
+def test_save_load_v3_npshape_record(tmp_path):
+    """V3 (np-shape) records load identically (ref: ndarray.cc:1601)."""
+    import struct
+    f = str(tmp_path / "v3.params")
+    a = np.float32(7.0).reshape(())  # zero-dim: the V3 case
+    with open(f, "wb") as fh:
+        fh.write(struct.pack("<QQ", 0x112, 0))
+        fh.write(struct.pack("<Q", 1))
+        fh.write(struct.pack("<I", 0xF993faca))
+        fh.write(struct.pack("<i", 0))
+        fh.write(struct.pack("<i", 0))
+        fh.write(struct.pack("<ii", 1, 0))
+        fh.write(struct.pack("<i", 0))
+        fh.write(a.tobytes())
+        fh.write(struct.pack("<Q", 0))
+    out = nd.load(f)
+    assert isinstance(out, list) and len(out) == 1  # reference semantics
+    assert out[0].shape == ()
+    assert float(out[0].asnumpy()) == 7.0
+
+
+def test_save_bfloat16_stored_as_f32(tmp_path):
+    f = str(tmp_path / "bf.params")
+    a = nd.ones((2, 2)).astype("bfloat16")
+    nd.save(f, {"w": a})
+    out = nd.load(f)
+    assert str(out["w"].dtype) == "float32"
+    assert (out["w"].asnumpy() == 1.0).all()
+
+
+def test_load_unnamed_always_list(tmp_path):
+    """Reference mx.nd.load returns a LIST for unnamed records, even one."""
+    f = str(tmp_path / "one.params")
+    a = nd.array(np.ones((3, 2), "float32"))
+    nd.save(f, [a])
+    out = nd.load(f)
+    assert isinstance(out, list) and len(out) == 1
+    assert out[0].shape == (3, 2)
+
+
+def test_save_bool_and_reject_unknown_dtype(tmp_path):
+    f = str(tmp_path / "b.params")
+    m = nd.array(np.array([True, False]))  # bool -> type flag 7
+    nd.save(f, {"mask": m})
+    out = nd.load(f)
+    assert str(out["mask"].dtype) == "bool"
+    assert out["mask"].asnumpy().tolist() == [True, False]
+
+
+def test_load_truncated_raises_valueerror(tmp_path):
+    f = tmp_path / "short.params"
+    f.write_bytes(b"\x12\x01")
+    import pytest
+    with pytest.raises(ValueError, match="truncated|not an NDArray"):
+        nd.load(str(f))

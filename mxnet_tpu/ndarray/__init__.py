@@ -114,7 +114,10 @@ def _write_one(f, arr):
         else:
             raise TypeError("dtype %s has no reference type flag; cast "
                             "before saving" % a.dtype)
-    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    # 0-dim arrays need V3 (np-shape) records: under legacy V2 semantics
+    # ndim==0 means "unknown shape" (ref: ndarray.cc:1600 V3 comment)
+    f.write(struct.pack("<I", _ND_V3_MAGIC if a.ndim == 0
+                        else _ND_V2_MAGIC))
     f.write(struct.pack("<i", 0))                      # kDefaultStorage
     f.write(struct.pack("<i", a.ndim))
     f.write(struct.pack("<%dq" % a.ndim, *a.shape))
@@ -171,40 +174,50 @@ def save(fname, data):
             f.write(b)
 
 
-def load(fname):
-    """Load a .params file (reference binary format, plus this framework's
-    earlier pickle snapshots for back compatibility). Like the reference's
-    mx.nd.load: a list when records are unnamed, a dict otherwise."""
-    with open(fname, "rb") as f:
-        head = f.read(len(_MAGIC))
-        if head == _MAGIC:  # early-round pickle snapshot
-            kind, payload = pickle.load(f)
-            if kind == "single":
-                return array(payload)
-            if kind == "list":
-                return [array(a) for a in payload]
-            return {k: array(v) for k, v in payload.items()}
-        f.seek(0)
-        try:
-            header, reserved = struct.unpack("<QQ", f.read(16))
-            if header != _LIST_MAGIC:
-                raise ValueError("not an NDArray file: %s" % fname)
-            count, = struct.unpack("<Q", f.read(8))
-            arrays = [_read_one(f) for _ in range(count)]
-            nnames, = struct.unpack("<Q", f.read(8))
-            names = []
-            for _ in range(nnames):
-                ln, = struct.unpack("<Q", f.read(8))
-                names.append(f.read(ln).decode("utf-8"))
-        except struct.error:
-            raise ValueError("truncated or corrupt NDArray file: %s"
-                             % fname)
+def _load_stream(f, where="<stream>"):
+    head = f.read(len(_MAGIC))
+    if head == _MAGIC:  # early-round pickle snapshot
+        kind, payload = pickle.load(f)
+        if kind == "single":
+            return array(payload)
+        if kind == "list":
+            return [array(a) for a in payload]
+        return {k: array(v) for k, v in payload.items()}
+    f.seek(0)
+    try:
+        header, reserved = struct.unpack("<QQ", f.read(16))
+        if header != _LIST_MAGIC:
+            raise ValueError("not an NDArray file: %s" % where)
+        count, = struct.unpack("<Q", f.read(8))
+        arrays = [_read_one(f) for _ in range(count)]
+        nnames, = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(nnames):
+            ln, = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    except struct.error:
+        raise ValueError("truncated or corrupt NDArray file: %s" % where)
+    if count == 0:
+        # ambiguous on disk; dict is what every param-dict consumer
+        # (load_parameters, load_checkpoint) expects from an empty save
+        return {}
     if not names:
         return arrays
     if len(names) != len(arrays):
         raise ValueError("invalid NDArray file (%d names for %d arrays): %s"
-                         % (len(names), len(arrays), fname))
+                         % (len(names), len(arrays), where))
     return dict(zip(names, arrays))
+
+
+def load(fname):
+    """Load a .params file or file-like object (reference binary format,
+    plus this framework's earlier pickle snapshots for back compatibility).
+    Like the reference's mx.nd.load: a list when records are unnamed, a
+    dict otherwise (and for empty files)."""
+    if hasattr(fname, "read"):
+        return _load_stream(fname)
+    with open(fname, "rb") as f:
+        return _load_stream(f, where=fname)
 
 
 # -- generated op wrappers --------------------------------------------------

@@ -1,0 +1,147 @@
+"""Deployment predictor — the c_predict_api surface in Python.
+
+ref: include/mxnet/c_predict_api.h (MXPredCreate :84, MXPredSetInput
+:254, MXPredForward :263, MXPredGetOutput :289, MXPredReshape :214),
+src/c_api/c_predict_api.cc. The reference ships this as a standalone C
+ABI for embedding inference into applications; here the same
+create/set_input/forward/get_output workflow binds the symbol to ONE
+compiled XLA program, so repeated forwards at a fixed shape hit the
+compile cache. A native C ABI wrapper over this module is the natural
+round-2 extension of src/c_api.cc.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from . import ndarray as nd
+from .context import cpu
+from .executor import Executor  # noqa: F401  (re-export surface)
+from .symbol import load_json as _sym_load_json
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Fixed-shape inference session (ref: c_predict_api.h:84
+    MXPredCreate: symbol json + param bytes + input shapes)."""
+
+    def __init__(self, symbol_json, param_raw_bytes=None, dev_type=None,
+                 input_shapes=None, arg_params=None, aux_params=None,
+                 output_keys=None):
+        from .symbol.symbol import Symbol
+        if isinstance(symbol_json, Symbol):
+            self._symbol = symbol_json
+        else:
+            if isinstance(symbol_json, (bytes, bytearray)):
+                symbol_json = symbol_json.decode("utf-8")
+            if symbol_json.lstrip().startswith("{"):
+                self._symbol = _sym_load_json(symbol_json)
+            else:  # path
+                with open(symbol_json) as f:
+                    self._symbol = _sym_load_json(f.read())
+        if output_keys:
+            # partial outputs (ref: MXPredCreatePartialOut :155)
+            outs = self._symbol.get_internals()
+            self._symbol = outs[output_keys] if isinstance(output_keys, str) \
+                else outs.select(*output_keys)
+
+        if param_raw_bytes is not None:
+            import io as _io
+            # reference passes raw .params bytes (MXPredCreate param_bytes)
+            loaded = nd.load(_io.BytesIO(param_raw_bytes))
+            if not isinstance(loaded, dict):
+                raise ValueError("param bytes must contain NAMED arrays "
+                                 "('arg:name'/'aux:name' keys, the "
+                                 "save_checkpoint format)")
+            arg_params, aux_params = {}, {}
+            for k, v in loaded.items():
+                if k.startswith("arg:"):
+                    arg_params[k[4:]] = v
+                elif k.startswith("aux:"):
+                    aux_params[k[4:]] = v
+                else:
+                    arg_params[k] = v
+        self._arg_params = dict(arg_params or {})
+        self._aux_params = dict(aux_params or {})
+        self._ctx = dev_type if dev_type is not None else cpu()
+        self._input_shapes = dict(input_shapes or {})
+        self._inputs = {k: nd.zeros(v) for k, v in self._input_shapes.items()}
+        self._outputs = None
+        self._bind()
+
+    def _bind(self):
+        args = dict(self._arg_params)
+        args.update(self._inputs)
+        # infer shapes for auxiliary input vars the caller did not declare
+        # (e.g. SoftmaxOutput's label at inference) and zero-fill them —
+        # what the reference's predictor bind does through the executor's
+        # shape inference (ref: src/c_api/c_predict_api.cc MXPredCreate)
+        missing = [n for n in self._symbol.list_arguments() if n not in args]
+        if missing:
+            shapes = {k: tuple(v) for k, v in self._input_shapes.items()}
+            arg_shapes, _, _ = self._symbol.infer_shape_partial(**shapes)
+            batch = next(iter(self._input_shapes.values()))[0] \
+                if self._input_shapes else 1
+            for n, s in zip(self._symbol.list_arguments(), arg_shapes):
+                if n in missing:
+                    # un-inferable vars (loss labels — forward output does
+                    # not depend on them) default to (batch,) zeros, the
+                    # reference loss ops' default label shape
+                    args[n] = nd.zeros(s if s is not None else (batch,))
+        self._executor = self._symbol.bind(
+            self._ctx, args=args, aux_states=self._aux_params,
+            grad_req="null")
+
+    # -- reference workflow -------------------------------------------------
+    def set_input(self, key, data):
+        """ref: MXPredSetInput (c_predict_api.h:254)."""
+        if key not in self._inputs:
+            raise KeyError("unknown input %r; declared inputs: %s"
+                           % (key, sorted(self._inputs)))
+        arr = data if isinstance(data, nd.NDArray) else nd.array(
+            _np.asarray(data, "float32"))
+        if tuple(arr.shape) != tuple(self._input_shapes[key]):
+            raise ValueError("input %r shape %s != declared %s (use "
+                             "reshape())" % (key, arr.shape,
+                                             self._input_shapes[key]))
+        self._executor.arg_dict[key]._data = arr._data
+
+    def forward(self):
+        """ref: MXPredForward (c_predict_api.h:263)."""
+        self._outputs = self._executor.forward(is_train=False)
+
+    def get_output_shape(self, index=0):
+        """ref: MXPredGetOutputShape (c_predict_api.h:229) — from shape
+        inference, without running the program."""
+        if self._outputs is not None:
+            return tuple(self._outputs[index].shape)
+        shapes = {k: tuple(v) for k, v in self._input_shapes.items()}
+        _, out_shapes, _ = self._symbol.infer_shape_partial(**shapes)
+        return tuple(out_shapes[index])
+
+    def get_output(self, index=0):
+        """ref: MXPredGetOutput (c_predict_api.h:289) — host numpy copy."""
+        if self._outputs is None:
+            raise RuntimeError("call forward() before get_output()")
+        return self._outputs[index].asnumpy()
+
+    def reshape(self, new_input_shapes):
+        """Rebind at new shapes (ref: MXPredReshape :214)."""
+        self._input_shapes.update(new_input_shapes)
+        self._inputs = {k: nd.zeros(v)
+                        for k, v in self._input_shapes.items()}
+        self._outputs = None
+        self._bind()
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, dev_type=None,
+                        output_keys=None):
+        """Load '<prefix>-symbol.json' + '<prefix>-%04d.params'
+        (the reference examples' standard deploy pairing)."""
+        from .model import load_checkpoint
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return cls(sym, dev_type=dev_type, input_shapes=input_shapes,
+                   arg_params=arg_params, aux_params=aux_params,
+                   output_keys=output_keys)

@@ -1,0 +1,66 @@
+"""TensorInspector: interactive/value-check debugging for tensors
+(ref: src/common/tensor_inspector.h — print_string, check for NaN/inf,
+value dumping with visit-count tagging).
+
+The reference's C++ class is constructed around a TBlob inside kernels;
+here the same checks work on any NDArray / jax array / numpy array from
+Python, which is where TPU debugging happens (device-side printing goes
+through jax.debug.print instead)."""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+__all__ = ["TensorInspector"]
+
+
+class TensorInspector:
+    """ref: tensor_inspector.h TensorInspector(tb, ctx)."""
+
+    _visit_count = {}
+
+    def __init__(self, tensor, tag=""):
+        from .ndarray.ndarray import NDArray
+        if isinstance(tensor, NDArray):
+            self._a = tensor.asnumpy()
+        else:
+            self._a = _np.asarray(tensor)
+        self.tag = tag
+
+    def print_string(self):
+        """Formatted dump with shape/dtype header (ref: print_string())."""
+        return "<%s %s %s>\n%s" % (self.tag or "Tensor",
+                                   "x".join(map(str, self._a.shape)),
+                                   self._a.dtype,
+                                   _np.array2string(self._a, threshold=64))
+
+    def check_value(self, checker=None):
+        """Return coordinates of values failing the check; default checker
+        flags NaN/Inf (ref: check_value w/ CheckerType::NegativeChecker
+        etc. — pass any predicate)."""
+        if checker is None:
+            def checker(x):
+                return ~_np.isfinite(x)
+        mask = checker(self._a)
+        coords = [tuple(int(i) for i in idx)
+                  for idx in _np.argwhere(mask)]
+        if coords:
+            logging.warning("TensorInspector%s: %d values failed the check "
+                            "(first at %s)",
+                            " [%s]" % self.tag if self.tag else "",
+                            len(coords), coords[0])
+        return coords
+
+    def has_nan_or_inf(self):
+        return not bool(_np.isfinite(self._a).all())
+
+    def dump_to_file(self, tag, visit=True):
+        """Save to '<tag>_<visit>.npy' with a visit counter so repeated
+        passes don't overwrite (ref: dump_to_file visit-count naming)."""
+        count = TensorInspector._visit_count.get(tag, 0) + 1
+        if visit:
+            TensorInspector._visit_count[tag] = count
+        fname = "%s_%d.npy" % (tag, count)
+        _np.save(fname, self._a)
+        return fname

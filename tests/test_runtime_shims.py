@@ -148,3 +148,146 @@ def test_jit_symbol():
     b = mx.nd.array([10.0, 20.0])
     out = op(a, b)
     np.testing.assert_allclose(out.asnumpy(), [12.0, 24.0])
+
+
+class TestPredictor:
+    def _make(self):
+        import numpy as onp
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.predictor import Predictor
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+        out = sym.softmax(fc, name="out")
+        rng = onp.random.RandomState(0)
+        args = {"fc_weight": mx.nd.array(rng.randn(3, 4).astype("float32")),
+                "fc_bias": mx.nd.zeros((3,))}
+        return Predictor(out.tojson(), input_shapes={"data": (2, 4)},
+                         arg_params=args), args, rng
+
+    def test_workflow_matches_eager(self):
+        import numpy as onp
+        p, args, rng = self._make()
+        x = rng.randn(2, 4).astype("float32")
+        p.set_input("data", x)
+        p.forward()
+        out = p.get_output(0)
+        ref = mx.nd.softmax(mx.nd.FullyConnected(
+            mx.nd.array(x), args["fc_weight"], args["fc_bias"],
+            num_hidden=3)).asnumpy()
+        onp.testing.assert_allclose(out, ref, atol=1e-5)
+        assert p.get_output_shape(0) == (2, 3)
+
+    def test_reshape_and_validation(self):
+        import numpy as onp
+        import pytest
+        p, args, rng = self._make()
+        with pytest.raises(KeyError):
+            p.set_input("nope", onp.zeros((2, 4), "float32"))
+        with pytest.raises(ValueError):
+            p.set_input("data", onp.zeros((9, 4), "float32"))
+        p.reshape({"data": (5, 4)})
+        p.set_input("data", rng.randn(5, 4).astype("float32"))
+        p.forward()
+        assert p.get_output(0).shape == (5, 3)
+
+    def test_from_checkpoint(self, tmp_path):
+        import os
+        import numpy as onp
+        from mxnet_tpu import symbol as sym, io as mio
+        from mxnet_tpu.predictor import Predictor
+        rng = onp.random.RandomState(0)
+        X = rng.randn(32, 4).astype("float32")
+        y = (X.sum(1) > 0).astype("float32")
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        net = sym.SoftmaxOutput(sym.FullyConnected(data, num_hidden=2,
+                                                   name="fc"), label,
+                                name="softmax")
+        it = mio.NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+        prefix = os.path.join(str(tmp_path), "m")
+        mod.save_checkpoint(prefix, 1)
+        pred = Predictor.from_checkpoint(prefix, 1,
+                                         input_shapes={"data": (16, 4)})
+        pred.set_input("data", X[:16])
+        pred.forward()
+        it.reset()
+        ref = mod.predict(it).asnumpy()[:16]
+        onp.testing.assert_allclose(pred.get_output(0), ref, atol=1e-5)
+
+
+class TestTensorInspector:
+    def test_check_and_dump(self, tmp_path, monkeypatch):
+        import numpy as onp
+        from mxnet_tpu.tensor_inspector import TensorInspector
+        monkeypatch.chdir(tmp_path)
+        a = mx.nd.array(onp.array([[1.0, onp.inf], [onp.nan, 4.0]]))
+        ti = TensorInspector(a, tag="grads")
+        assert ti.has_nan_or_inf()
+        bad = ti.check_value()
+        assert set(bad) == {(0, 1), (1, 0)}
+        neg = TensorInspector(mx.nd.array(onp.array([-1.0, 2.0])))
+        assert neg.check_value(lambda x: x < 0) == [(0,)]
+        assert "2x2" in ti.print_string()
+        f = ti.dump_to_file("g")
+        assert f.endswith("_1.npy")
+        loaded = onp.load(f)
+        assert loaded.shape == (2, 2)
+        assert ti.dump_to_file("g").endswith("_2.npy")
+
+
+class TestReviewRegressions:
+    def test_empty_dict_save_roundtrips_as_dict(self, tmp_path):
+        f = str(tmp_path / "e.params")
+        mx.nd.save(f, {})
+        out = mx.nd.load(f)
+        assert out == {}
+
+    def test_get_output_shape_does_not_forward(self):
+        import pytest
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.predictor import Predictor
+        import numpy as onp
+        data = sym.Variable("data")
+        out = sym.softmax(sym.FullyConnected(data, num_hidden=3, name="fc"))
+        rng = onp.random.RandomState(0)
+        p = Predictor(out.tojson(), input_shapes={"data": (2, 4)},
+                      arg_params={"fc_weight": mx.nd.array(
+                          rng.randn(3, 4).astype("float32")),
+                          "fc_bias": mx.nd.zeros((3,))})
+        assert p.get_output_shape(0) == (2, 3)
+        with pytest.raises(RuntimeError):
+            p.get_output(0)  # shape query must not have run forward
+
+    def test_param_bytes_and_scalar_v3_write(self, tmp_path):
+        import struct
+        from mxnet_tpu.predictor import Predictor
+        from mxnet_tpu import symbol as sym
+        import numpy as onp
+        # raw-bytes constructor path (MXPredCreate param_bytes)
+        f = str(tmp_path / "p.params")
+        w = mx.nd.array(onp.ones((3, 4), "float32"))
+        mx.nd.save(f, {"arg:fc_weight": w, "arg:fc_bias": mx.nd.zeros((3,))})
+        raw = open(f, "rb").read()
+        data = sym.Variable("data")
+        out = sym.FullyConnected(data, num_hidden=3, name="fc")
+        p = Predictor(out.tojson(), param_raw_bytes=raw,
+                      input_shapes={"data": (2, 4)})
+        p.set_input("data", onp.ones((2, 4), "float32"))
+        p.forward()
+        assert onp.allclose(p.get_output(0), 4.0)
+        # unnamed bytes rejected with a clear error
+        f2 = str(tmp_path / "l.params")
+        mx.nd.save(f2, [w])
+        import pytest
+        with pytest.raises(ValueError, match="NAMED"):
+            Predictor(out.tojson(), param_raw_bytes=open(f2, "rb").read(),
+                      input_shapes={"data": (2, 4)})
+        # scalar records carry the V3 magic on disk
+        f3 = str(tmp_path / "s.params")
+        mx.nd.save(f3, [mx.nd.array(onp.float32(5.0).reshape(()))])
+        with open(f3, "rb") as fh:
+            fh.read(24)
+            magic, = struct.unpack("<I", fh.read(4))
+        assert magic == 0xF993faca

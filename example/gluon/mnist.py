@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Train MNIST with the Gluon API (ref: example/gluon/mnist/mnist.py —
+same script shape: DataLoader + HybridSequential + Trainer loop).
+
+    python example/gluon/mnist.py --epochs 3 --hybridize
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--hybridize", action="store_true")
+    parser.add_argument("--tpu", action="store_true",
+                        help="place on the TPU (ref --cuda)")
+    args = parser.parse_args()
+
+    from mxnet_tpu.gluon.data.vision import MNIST, transforms
+    trans = transforms.Compose([transforms.ToTensor()])
+    train_data = gluon.data.DataLoader(
+        MNIST(train=True).transform_first(trans),
+        batch_size=args.batch_size, shuffle=True)
+    val_data = gluon.data.DataLoader(
+        MNIST(train=False).transform_first(trans),
+        batch_size=args.batch_size)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    ctx = mx.tpu() if args.tpu else mx.cpu()
+    net.initialize(ctx=ctx)
+    if args.hybridize:
+        net.hybridize()  # whole forward+backward -> one XLA program
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for data, label in train_data:
+            data = data.reshape((data.shape[0], -1))
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update(label, out)
+        name, train_acc = metric.get()
+        metric.reset()
+        for data, label in val_data:
+            metric.update(label, net(data.reshape((data.shape[0], -1))))
+        _, val_acc = metric.get()
+        print("epoch %d: train %s %.4f, val %.4f"
+              % (epoch, name, train_acc, val_acc))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Train MNIST with the symbolic Module API
+(ref: example/image-classification/train_mnist.py — same script shape:
+build a symbol, create the iterators, call fit).
+
+    python example/image-classification/train_mnist.py --network mlp
+    python example/image-classification/train_mnist.py --network lenet --tpus 0
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+
+def get_mlp():
+    """ref: example/image-classification/symbols/mlp.py."""
+    data = sym.Variable("data")
+    data = sym.Flatten(data)
+    fc1 = sym.FullyConnected(data, num_hidden=128, name="fc1")
+    act1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act1, num_hidden=64, name="fc2")
+    act2 = sym.Activation(fc2, act_type="relu", name="relu2")
+    fc3 = sym.FullyConnected(act2, num_hidden=10, name="fc3")
+    return sym.SoftmaxOutput(fc3, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def get_lenet():
+    """ref: example/image-classification/symbols/lenet.py."""
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    a1 = sym.Activation(c1, act_type="tanh")
+    p1 = sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = sym.Convolution(p1, kernel=(5, 5), num_filter=50, name="conv2")
+    a2 = sym.Activation(c2, act_type="tanh")
+    p2 = sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f = sym.Flatten(p2)
+    fc1 = sym.Activation(sym.FullyConnected(f, num_hidden=500, name="fc1"),
+                         act_type="tanh")
+    fc2 = sym.FullyConnected(fc1, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def get_iters(batch_size, flat):
+    """MNIST via gluon datasets (synthetic fallback when offline —
+    MXTPU_SYNTHETIC_DATA=1); returns NDArrayIter pairs like the
+    reference's get_mnist_iter. Reads the dataset's backing numpy arrays
+    in one vectorized conversion — per-sample __getitem__ would round-trip
+    every row through a device array."""
+    from mxnet_tpu.gluon.data.vision import MNIST
+    shape = (-1, 784) if flat else (-1, 1, 28, 28)
+
+    def to_iter(ds, shuffle):
+        X = np.asarray(ds._data).reshape(shape).astype("float32") / 255.0
+        y = np.asarray(ds._label, "float32")
+        return mx.io.NDArrayIter(X, y, batch_size=batch_size,
+                                 shuffle=shuffle)
+
+    return to_iter(MNIST(train=True), True), to_iter(MNIST(train=False),
+                                                     False)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train mnist (ref: train_mnist.py)")
+    parser.add_argument("--network", default="mlp",
+                        choices=["mlp", "lenet"])
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--tpus", default=None,
+                        help="tpu device ids, e.g. '0' (default: cpu; "
+                             "ref --gpus)")
+    parser.add_argument("--kv-store", default="local")
+    args = parser.parse_args()
+
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else mx.cpu()
+    train, val = get_iters(args.batch_size, flat=args.network == "mlp")
+
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            kvstore=args.kv_store, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(magnitude=2.0),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       100))
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    print("final validation accuracy: %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -84,10 +84,21 @@ class MNIST(_DownloadedDataset):
                 self._label = _read_idx_labels(lp)
                 return
         if _synth_ok():
+            # class-specific spatial patterns (a bright row band per
+            # class) so example trainings converge fast on the synthetic
+            # set — pure brightness coding makes features rank-1 and
+            # training artificially slow
             n = 1024 if self._train else 256
             rng = np.random.RandomState(0 if self._train else 1)
-            self._data = (rng.rand(n, *self._shape) * 255).astype(np.uint8)
-            self._label = rng.randint(0, self._classes, n).astype(np.int32)
+            label = rng.randint(0, self._classes, n).astype(np.int32)
+            data = (rng.rand(n, *self._shape) * 40.0)
+            h = self._shape[0]
+            band = max(h // self._classes, 1)
+            for i in range(n):
+                r0 = int(label[i]) * band % h
+                data[i, r0:r0 + band] += 180.0
+            self._data = np.clip(data, 0, 255).astype(np.uint8)
+            self._label = label
             return
         raise IOError(
             "MNIST files not found under %s (offline build: place the "

@@ -320,12 +320,27 @@ class Module(BaseModule):
 
         kv, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
+        batch_size = self._exec_group.batch_size
+        if kv and "dist" in kv.type and "_sync" in kv.type:
+            batch_size *= kv.num_workers
+        rescale_grad = 1.0 / batch_size
         if isinstance(optimizer, str):
             idx2name = dict(enumerate(self._param_names))
             optimizer_params = dict(optimizer_params)
+            # normalize the batch-summed gradient unless the caller chose
+            # their own scale (ref: module.py:498 init_optimizer)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
             optimizer = opt.create(optimizer,
                                    param_idx2name=idx2name,
                                    **optimizer_params)
+        else:
+            if optimizer.rescale_grad != rescale_grad:
+                self.logger.warning(
+                    "Optimizer created manually outside Module but "
+                    "rescale_grad is not normalized to 1.0/batch_size "
+                    "(%s vs. %s). Is this intended?",
+                    optimizer.rescale_grad, rescale_grad)
         self._optimizer = optimizer
         self._kvstore = kv
         self._update_on_kvstore = update_on_kvstore

@@ -220,6 +220,25 @@ def load(fname):
         return _load_stream(f, where=fname)
 
 
+# -- dynamic-shape ops (eager-only; ref: SURVEY.md §7 hard part (b)) --------
+
+def boolean_mask(data, index, axis=0):
+    """Select slices where index is nonzero (ref:
+    src/operator/contrib/boolean_mask.cc). Output shape is data-dependent,
+    so this is an EAGER op — inside jit/hybridize use `where` with a mask
+    (static shape) or pad like BucketingModule."""
+    d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    m = index._data if isinstance(index, NDArray) else jnp.asarray(index)
+    keep = _np.nonzero(_np.asarray(m) != 0)[0]
+    return NDArray(jnp.take(d, jnp.asarray(keep), axis=axis))
+
+
+def unique(data):
+    """Sorted unique values (eager; dynamic output shape)."""
+    d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    return NDArray(jnp.asarray(_np.unique(_np.asarray(d))))
+
+
 # -- generated op wrappers --------------------------------------------------
 _register_mod.populate(globals())
 
@@ -227,3 +246,8 @@ _register_mod.populate(globals())
 from . import random   # noqa: E402,F401
 from . import linalg   # noqa: E402,F401
 from . import sparse   # noqa: E402,F401
+
+# top-level aliases matching the reference namespace (mx.nd.cast_storage
+# in addition to mx.nd.sparse.cast_storage)
+cast_storage = sparse.cast_storage
+sparse_retain = sparse.retain

@@ -172,3 +172,11 @@ def zeros(stype, shape, ctx=None, dtype=None):
     from . import zeros as _zeros
     dense = _zeros(shape, ctx=ctx, dtype=dtype)
     return cast_storage(dense, stype)
+
+
+def retain(data, indices):
+    """Module-level sparse row retain (ref: mx.nd.sparse.retain →
+    src/operator/tensor/sparse_retain.cc)."""
+    if not isinstance(data, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    return data.retain(indices)

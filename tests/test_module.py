@@ -121,10 +121,12 @@ class TestModule:
         train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True)
         val = mx.io.NDArrayIter(x, y, batch_size=32)
         mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        # lr under CORRECT 1/batch_size gradient normalization
+        # (ref: module.py init_optimizer rescale_grad default)
         mod.fit(train, eval_data=val, optimizer="sgd",
-                optimizer_params={"learning_rate": 0.1},
+                optimizer_params={"learning_rate": 1.0, "momentum": 0.9},
                 initializer=mx.init.Xavier(),
-                eval_metric="acc", num_epoch=5)
+                eval_metric="acc", num_epoch=8)
         score = mod.score(val, "acc")
         assert score[0][1] > 0.85, score
 
@@ -336,3 +338,27 @@ def test_bucketing_default_initializer_not_zero():
     mod.init_params()
     args, _ = mod.get_params()
     assert np.abs(args["fc_weight"].asnumpy()).sum() > 0
+
+
+def test_init_optimizer_rescales_by_batch_size():
+    """Regression: Module must default rescale_grad to 1/batch_size like
+    the reference (module.py:498); unnormalized batch-summed gradients
+    made sgd+momentum diverge."""
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert abs(mod._optimizer.rescale_grad - 1.0 / 32) < 1e-12
+    # explicit user value wins
+    mod2 = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label)
+    mod2.init_params()
+    mod2.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "rescale_grad": 1.0})
+    assert mod2._optimizer.rescale_grad == 1.0

@@ -226,11 +226,23 @@ def boolean_mask(data, index, axis=0):
     """Select slices where index is nonzero (ref:
     src/operator/contrib/boolean_mask.cc). Output shape is data-dependent,
     so this is an EAGER op — inside jit/hybridize use `where` with a mask
-    (static shape) or pad like BucketingModule."""
-    d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    (static shape) or pad like BucketingModule. Differentiable in data
+    (scatter-back gradient, like the reference's backward)."""
+    from .. import autograd as _autograd
     m = index._data if isinstance(index, NDArray) else jnp.asarray(index)
-    keep = _np.nonzero(_np.asarray(m) != 0)[0]
-    return NDArray(jnp.take(d, jnp.asarray(keep), axis=axis))
+    keep = jnp.asarray(_np.nonzero(_np.asarray(m) != 0)[0])
+
+    def fwd(x):
+        return jnp.take(x, keep, axis=axis)
+
+    if isinstance(data, NDArray) and _autograd.is_recording():
+        out, vjp_fn = jax.vjp(fwd, data._data)
+        res = NDArray(out)
+        node = _autograd.record_op("boolean_mask", [res], [data], vjp_fn)
+        node.fwd_fn = fwd
+        return res
+    d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    return NDArray(fwd(d))
 
 
 def unique(data):

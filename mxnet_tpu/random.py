@@ -3,9 +3,17 @@
 TPU-native re-design of the reference's random resource
 (ref: src/resource.cc kRandom/kParallelRandom pools,
 python/mxnet/random.py seed()). JAX PRNG is functional; this module owns a
-global key that eager ops split from, and a *trace key* stack so that under a
-jitted CachedOp the key is a traced argument (fold_in by call counter) rather
-than a baked-in constant — keeping dropout/random ops fresh across steps.
+global (seed, counter) stream that eager ops derive keys from, and a
+*trace key* stack so that under a jitted CachedOp the key is a traced
+argument (fold_in by call counter) rather than a baked-in constant —
+keeping dropout/random ops fresh across steps.
+
+The global state is HOST-side integers, never jax arrays: if ``next_key``
+is called inside an active trace with no pushed trace key (an eager-style
+random op traced into someone's jit), the derived key is a tracer — which
+must not be stored back into process state or it leaks out of the trace
+(jax UnexpectedTracerError). Advancing a host counter sidesteps that
+whole class of bug.
 """
 from __future__ import annotations
 
@@ -13,14 +21,16 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "current_key", "push_trace_key", "pop_trace_key"]
+__all__ = ["seed", "next_key", "current_key", "push_trace_key",
+           "pop_trace_key"]
 
 
 class _RngState(threading.local):
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
-        self.trace_keys = []      # stack of (key, counter) used under tracing
+        self.seed = 0
         self.counter = 0
+        self.base_key = None      # concrete PRNGKey(seed), built lazily
+        self.trace_keys = []      # stack of (key, counter) used under tracing
 
 
 _STATE = _RngState()
@@ -29,8 +39,18 @@ _STATE = _RngState()
 def seed(seed_state, ctx="all"):
     """Set the global seed. ref: python/mxnet/random.py:34 (ctx arg kept for
     API parity; there is one logical RNG stream per host)."""
-    _STATE.key = jax.random.PRNGKey(int(seed_state))
+    _STATE.seed = int(seed_state)
     _STATE.counter = 0
+    _STATE.base_key = None
+
+
+def _base_key():
+    # cached: derived only from a host int, so it is always concrete and
+    # safe to keep in process state even when first built inside a trace
+    if _STATE.base_key is None:
+        with jax.ensure_compile_time_eval():
+            _STATE.base_key = jax.random.PRNGKey(_STATE.seed)
+    return _STATE.base_key
 
 
 def next_key():
@@ -40,12 +60,17 @@ def next_key():
         key, counter = _STATE.trace_keys[-1]
         _STATE.trace_keys[-1] = (key, counter + 1)
         return jax.random.fold_in(key, counter)
-    _STATE.key, sub = jax.random.split(_STATE.key)
-    return sub
+    c = _STATE.counter
+    _STATE.counter += 1  # host int: safe to advance inside any trace
+    return jax.random.fold_in(_base_key(), c)
 
 
 def current_key():
-    return _STATE.key
+    """A key representing the current stream position WITHOUT consuming it;
+    disjoint from the next_key stream (distinct fold_in branch), so draws
+    from it never duplicate an eager op's draw."""
+    return jax.random.fold_in(jax.random.fold_in(_base_key(),
+                                                 _STATE.counter), 0x5EED)
 
 
 def push_trace_key(key):

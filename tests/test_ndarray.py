@@ -279,3 +279,17 @@ def test_load_truncated_raises_valueerror(tmp_path):
     import pytest
     with pytest.raises(ValueError, match="truncated|not an NDArray"):
         nd.load(str(f))
+
+
+def test_boolean_mask_differentiable():
+    """Regression: boolean_mask must record on the autograd tape."""
+    from mxnet_tpu import autograd
+    x = nd.array(np.arange(6, dtype="float32").reshape(3, 2))
+    x.attach_grad()
+    m = nd.array(np.array([1, 0, 1], "int32"))
+    with autograd.record():
+        y = nd.boolean_mask(x, m)
+        loss = (y * 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               [[2, 2], [0, 0], [2, 2]])

@@ -62,3 +62,37 @@ def test_poisson_exponential_gamma():
     assert abs(e.mean() - 2.0) < 0.2
     g = nd.random.gamma(alpha=3.0, beta=2.0, shape=(5000,)).asnumpy()
     assert abs(g.mean() - 6.0) < 0.5
+
+
+def test_next_key_inside_foreign_jit_no_tracer_leak():
+    """Regression: an eager-style random op traced into someone else's jit
+    must not store a tracer into the global RNG state — later eager calls
+    would hit jax's UnexpectedTracerError."""
+    import jax
+    from mxnet_tpu import random as mxr
+
+    @jax.jit
+    def traced():
+        return jax.random.uniform(mxr.next_key(), (2,))
+
+    traced()
+    # global state must still yield usable keys outside the trace
+    k = mxr.next_key()
+    val = jax.random.uniform(k, (2,))
+    assert val.shape == (2,)
+
+
+def test_seed_reproducible_counter_stream():
+    import numpy as onp
+    import jax
+    from mxnet_tpu import random as mxr
+    mxr.seed(11)
+    a = [onp.asarray(jax.random.uniform(mxr.next_key(), (3,)))
+         for _ in range(3)]
+    mxr.seed(11)
+    b = [onp.asarray(jax.random.uniform(mxr.next_key(), (3,)))
+         for _ in range(3)]
+    for x, y in zip(a, b):
+        onp.testing.assert_array_equal(x, y)
+    # distinct keys along the stream
+    assert not onp.allclose(a[0], a[1])

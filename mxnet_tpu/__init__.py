@@ -74,6 +74,14 @@ _LAZY = {
     "npx": ".numpy_extension",
     "lib_api": ".lib_api",
     "storage": ".storage",
+    "rtc": ".rtc",
+    "visualization": ".visualization",
+    "viz": ".visualization",
+    "predictor": ".predictor",
+    "name": ".name",
+    "attribute": ".attribute",
+    "kvstore_server": ".kvstore_server",
+    "tensor_inspector": ".tensor_inspector",
 }
 
 

@@ -1,0 +1,58 @@
+"""Attribute scoping for symbols (ref: python/mxnet/attribute.py
+AttrScope): symbols created inside the scope inherit its attributes —
+the mechanism the reference uses for `group2ctx` model-parallel context
+groups (`with mx.AttrScope(ctx_group='dev1'):`) and custom node tags."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_current = threading.local()
+
+
+def _stack():
+    if not hasattr(_current, "stack"):
+        _current.stack = []
+    return _current.stack
+
+
+class AttrScope:
+    """ref: attribute.py:26 AttrScope."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("Attributes need to be strings")
+        self._attr = kwargs
+
+    def get(self, attr=None):
+        """Merge scope attrs over `attr` (ref: attribute.py get)."""
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *args):
+        _stack().pop()
+
+
+def current():
+    """Merged attributes of all active scopes (outermost first)."""
+    merged = {}
+    for scope in _stack():
+        merged.update(scope._attr)
+    return merged
+
+
+def apply(attrs):
+    """Scope attrs with `attrs` layered on top (explicit wins) — the one
+    place node builders merge AttrScope state (ref: attribute.py get)."""
+    merged = current()
+    if attrs:
+        merged.update(attrs)
+    return merged

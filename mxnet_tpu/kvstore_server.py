@@ -1,0 +1,51 @@
+"""KVStore server role shim (ref: python/mxnet/kvstore_server.py).
+
+The reference's `dist_*` modes run dedicated server processes: a worker
+pickles its optimizer, ships it over the ps-lite command channel, and the
+server applies updates (`_controller` dispatching kCommandController).
+On TPU there are no server processes — aggregation is XLA collectives and
+"server-side" optimizer state is sharded optimizer state under pjit
+(SURVEY.md §5) — so `_init_kvstore_server_module` is a no-op that returns
+immediately on every rank instead of trapping server roles in a serve
+loop. `KVStoreServer` keeps the API for launch scripts that construct it.
+"""
+from __future__ import annotations
+
+import pickle
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """ref: kvstore_server.py:28 KVStoreServer."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def _controller(self):
+        """ref: kvstore_server.py _controller — decode a pickled optimizer
+        sent by rank 0 and install it (the command channel collapses to a
+        direct call in-process)."""
+        def server_controller(cmd_id, cmd_body):
+            if cmd_id == 0:
+                optimizer = pickle.loads(cmd_body if isinstance(
+                    cmd_body, bytes) else cmd_body.encode("latin1"))
+                self.kvstore.set_optimizer(optimizer)
+            return None
+        return server_controller
+
+    def run(self):
+        """ref: kvstore_server.py run — the reference blocks in the
+        ps-lite serve loop; with collectives there is nothing to serve."""
+        return None
+
+
+def _init_kvstore_server_module():
+    """ref: kvstore_server.py:85 — the reference traps DMLC_ROLE=server
+    processes into the ps-lite serve loop here. All ranks are workers in
+    this framework (aggregation is collective, "server" state is sharded
+    optimizer state), so there is deliberately nothing to do."""
+
+
+_init_kvstore_server_module()

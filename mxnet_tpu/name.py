@@ -1,0 +1,61 @@
+"""Automatic naming scopes (ref: python/mxnet/name.py NameManager/Prefix).
+
+Symbol nodes auto-name through ``symbol._auto_name``; these context
+managers interpose on that path the way the reference's thread-local
+NameManager stack interposes on C-side name generation."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+_current = threading.local()
+
+
+def _stack():
+    if not hasattr(_current, "stack"):
+        _current.stack = []
+    return _current.stack
+
+
+class NameManager:
+    """ref: name.py:27 NameManager — assigns `hint%d` names."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        """Return `name` if given, else a fresh auto name for `hint`
+        (ref: name.py get)."""
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *args):
+        _stack().pop()
+
+
+class Prefix(NameManager):
+    """ref: name.py:74 Prefix — prepend a prefix to every auto name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def current():
+    """The active NameManager, or None (module-default counters apply)."""
+    stack = _stack()
+    return stack[-1] if stack else None

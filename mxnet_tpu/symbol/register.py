@@ -41,15 +41,28 @@ def op_input_names(opdef):
     return res
 
 
+def _scoped_name(name, hint):
+    """Node naming through the active NameManager/Prefix: explicit names
+    also pass through it, so Prefix('net_') prefixes them like the
+    reference (ref: python/mxnet/name.py NameManager.get)."""
+    from ..name import current as _current_nm
+    nm = _current_nm()
+    if nm is not None:
+        return nm.get(name, hint)
+    return name or _auto_name(hint)
+
+
 def create_symbol_op(op_name, sym_inputs, attrs, name=None):
     """Build a Symbol node for `op_name` with the given input Symbols."""
     opdef = _registry.get_op(op_name)
-    node_name = name or _auto_name(opdef.name.lower())
+    node_name = _scoped_name(name, opdef.name.lower())
     inputs = []
     for s in sym_inputs:
         assert isinstance(s, Symbol), type(s)
         assert len(s._outputs) == 1, "op inputs must be single-output symbols"
         inputs.append(s._outputs[0])
+    from ..attribute import apply as _attr_apply
+    attrs = _attr_apply(attrs)
     node = _Node(opdef.name, node_name, attrs, inputs)
     from .symbol import _num_outputs_of
     node.num_outputs = _num_outputs_of(node)
@@ -62,7 +75,7 @@ def make_symbol_op_func(opdef, public_name):
     def op_func(*args, **kwargs):
         name = kwargs.pop("name", None)
         attr = kwargs.pop("attr", None)
-        node_name = name or _auto_name(opdef.name.lower())
+        node_name = _scoped_name(name, opdef.name.lower())
         sym_inputs = []
         attrs = {}
         if input_names is None:
@@ -117,6 +130,12 @@ def make_symbol_op_func(opdef, public_name):
             assert len(s._outputs) == 1, \
                 "op inputs must be single-output symbols"
             inputs.append(s._outputs[0])
+        from ..attribute import apply as _attr_apply
+        merged = _attr_apply(None)
+        merged.update(attrs)           # op params
+        if attr:
+            merged.update(attr)        # explicit per-call attrs win
+        attrs = merged
         node = _Node(opdef.name, node_name, attrs, inputs)
         from .symbol import _num_outputs_of
         node.num_outputs = _num_outputs_of(node)

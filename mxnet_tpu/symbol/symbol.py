@@ -32,6 +32,12 @@ def _counter():
 
 
 def _auto_name(hint):
+    # an active NameManager/Prefix scope takes over naming
+    # (ref: python/mxnet/name.py NameManager.current)
+    from ..name import current as _current_nm
+    nm = _current_nm()
+    if nm is not None:
+        return nm.get(None, hint)
     counts = _counter()
     idx = counts.get(hint, 0)
     counts[hint] = idx + 1
@@ -290,7 +296,8 @@ def _binop(op_name, scalar_op, lhs, rhs, swap=False):
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs):
     """ref: symbol.py var/Variable."""
-    attrs = dict(attr or {})
+    from ..attribute import apply as _attr_apply
+    attrs = _attr_apply(attr)
     if shape is not None:
         attrs["__shape__"] = list(shape)
     if dtype is not None:

@@ -1,0 +1,106 @@
+"""Network visualization (ref: python/mxnet/visualization.py:
+print_summary, plot_network)."""
+from __future__ import annotations
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _param_count(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-by-layer table with output shapes and parameter counts
+    (ref: visualization.py:38 print_summary). `shape` maps input names
+    to shapes; without it output shapes print as '-'."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    positions = [int(line_length * p) for p in positions]
+    nodes = symbol._topo()
+    out_shapes = {}
+    arg_shapes = {}
+    if shape:
+        arg_sh, _, aux_sh = symbol.infer_shape_partial(**shape)
+        arg_shapes = dict(zip(symbol.list_arguments(), arg_sh))
+        # per-node output shapes via internals
+        internals = symbol.get_internals()
+        _, int_out, _ = internals.infer_shape_partial(**shape)
+        for (node, oi), s in zip(internals._outputs, int_out):
+            out_shapes[(id(node), oi)] = s
+
+    def fmt(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line += str(f)
+            line = line[:pos]
+            line += " " * (pos - len(line))
+        return line
+
+    header = fmt(["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"])
+    lines = ["_" * line_length, header, "=" * line_length]
+    total = 0
+    param_owner = set()
+    for node in nodes:
+        if node.is_variable():
+            continue
+        nparams = 0
+        prevs = []
+        for src, oi in node.inputs:
+            if src.is_variable():
+                s = arg_shapes.get(src.name)
+                if s is not None and src.name not in param_owner \
+                        and src.name not in (shape or {}):
+                    nparams += _param_count(s)
+                    param_owner.add(src.name)
+                if src.name in (shape or {}):
+                    prevs.append(src.name)
+            else:
+                prevs.append(src.name)
+        total += nparams
+        oshape = out_shapes.get((id(node), 0), "-")
+        lines.append(fmt(["%s (%s)" % (node.name, node.op),
+                          oshape, nparams, ",".join(prevs)]))
+        lines.append("_" * line_length)
+    lines.append("Total params: %d" % total)
+    lines.append("_" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz digraph of the symbol (ref: visualization.py:214
+    plot_network). Returns the graphviz.Digraph; .render() writes it."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz package")
+    node_attrs = node_attrs or {}
+    base = {"shape": "box", "fixedsize": "true", "width": "1.3",
+            "height": "0.8034", "style": "filled"}
+    base.update(node_attrs)
+    palette = {"FullyConnected": "#fb8072", "Convolution": "#fb8072",
+               "Activation": "#ffffb3", "BatchNorm": "#bebada",
+               "Pooling": "#80b1d3", "softmax": "#fccde5",
+               "SoftmaxOutput": "#fccde5"}
+    dot = Digraph(name=title, format=save_format)
+    for node in symbol._topo():
+        if node.is_variable():
+            if hide_weights and node.name not in (shape or {}):
+                continue
+            dot.node(node.name, label=node.name, shape="oval",
+                     fillcolor="#8dd3c7", style="filled")
+            continue
+        attrs = dict(base)
+        attrs["fillcolor"] = palette.get(node.op, "#b3de69")
+        dot.node(node.name, label="%s\n%s" % (node.name, node.op), **attrs)
+        for src, _ in node.inputs:
+            if src.is_variable() and hide_weights and \
+                    src.name not in (shape or {}):
+                continue
+            dot.edge(src.name, node.name)
+    return dot

@@ -291,3 +291,81 @@ class TestReviewRegressions:
             fh.read(24)
             magic, = struct.unpack("<I", fh.read(4))
         assert magic == 0xF993faca
+
+
+class TestNamingAndViz:
+    def test_prefix_scope(self):
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.name import Prefix
+        with Prefix("net_"):
+            fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2)
+        assert fc.name.startswith("net_")
+
+    def test_attr_scope_propagates(self):
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.attribute import AttrScope
+        import pytest
+        with AttrScope(ctx_group="dev1"):
+            fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2,
+                                    name="fc")
+            v = sym.Variable("w2")
+        assert fc._outputs[0][0].attrs["ctx_group"] == "dev1"
+        assert v._outputs[0][0].attrs["ctx_group"] == "dev1"
+        with pytest.raises(ValueError):
+            AttrScope(bad=1)
+
+    def test_print_summary_and_plot(self):
+        from mxnet_tpu import symbol as sym, visualization
+        data = sym.Variable("data")
+        net = sym.FullyConnected(
+            sym.Activation(sym.FullyConnected(data, num_hidden=8,
+                                              name="fc1"),
+                           act_type="relu", name="a1"),
+            num_hidden=2, name="fc2")
+        out = visualization.print_summary(net, shape={"data": (4, 6)})
+        assert "fc1" in out and "Total params: 74" in out
+        dot = visualization.plot_network(net, shape={"data": (4, 6)})
+        assert "fc1" in dot.source and "fc2" in dot.source
+
+    def test_kvstore_server_shim(self):
+        import mxnet_tpu as mx
+        import pickle
+        kv = mx.kv.create("local")
+        from mxnet_tpu.kvstore_server import KVStoreServer
+        srv = KVStoreServer(kv)
+        ctrl = srv._controller()
+        import mxnet_tpu.optimizer as opt
+        ctrl(0, pickle.dumps(opt.create("sgd", learning_rate=0.5)))
+        assert kv._optimizer.lr == 0.5
+        assert srv.run() is None
+
+
+class TestAttrScopeInference:
+    def test_infer_shape_under_attr_scope(self):
+        """Regression: scope attrs must never be fed to op kernels."""
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.attribute import AttrScope
+        with AttrScope(ctx_group="dev1"):
+            fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2,
+                                    name="fc")
+        arg_shapes, out_shapes, _ = fc.infer_shape(data=(4, 6))
+        assert tuple(out_shapes[0]) == (4, 2)
+        exe = fc.simple_bind(mx.cpu(), data=(4, 6))
+        import numpy as onp
+        exe.forward(is_train=False, data=onp.zeros((4, 6), "float32"))
+
+    def test_explicit_attr_wins_over_scope(self):
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.attribute import AttrScope
+        with AttrScope(ctx_group="dev1"):
+            fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2,
+                                    attr={"ctx_group": "dev9"}, name="f")
+        assert fc._outputs[0][0].attrs["ctx_group"] == "dev9"
+
+    def test_prefix_applies_to_explicit_names(self):
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.name import Prefix
+        with Prefix("net_"):
+            fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2,
+                                    name="fc1")
+        assert fc.name == "net_fc1"

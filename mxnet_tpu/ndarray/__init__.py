@@ -263,3 +263,4 @@ from . import sparse   # noqa: E402,F401
 # in addition to mx.nd.sparse.cast_storage)
 cast_storage = sparse.cast_storage
 sparse_retain = sparse.retain
+from . import contrib  # noqa: E402,F401

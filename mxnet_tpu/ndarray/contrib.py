@@ -1,0 +1,97 @@
+"""mx.nd.contrib — control flow + misc contrib ops.
+
+ref: python/mxnet/ndarray/contrib.py (foreach :216, while_loop :331,
+cond :460) over src/operator/control_flow.cc:1089/1150/1211. The
+reference's imperative versions run Python loops per step; these do the
+same eagerly (each step's ops XLA-dispatch), which also traces cleanly
+into an enclosing ``hybridize``/jit because the iteration counts are
+static at trace time. For O(1)-size traced loops over long sequences use
+the fused ops (e.g. ``nd.RNN``) or `jax.lax.scan` directly.
+"""
+from __future__ import annotations
+
+from . import NDArray
+from . import stack as _stack
+
+__all__ = ["foreach", "while_loop", "cond", "boolean_mask",
+           "arange_like", "quantize", "dequantize"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Iterate ``body(data_t, states) -> (out, new_states)`` over axis 0 of
+    ``data``; outputs are stacked (ref: ndarray/contrib.py:216 foreach)."""
+    single_data = isinstance(data, NDArray)
+    seqs = [data] if single_data else list(data)
+    length = seqs[0].shape[0]
+    states = init_states
+    outs = []
+    for t in range(length):
+        slices = [s[t] for s in seqs]
+        out, states = body(slices[0] if single_data else slices, states)
+        outs.append(out)
+    if not outs:
+        raise ValueError("foreach over empty data")
+    if isinstance(outs[0], (list, tuple)):
+        stacked = [_stack(*[o[i] for o in outs], axis=0)
+                   for i in range(len(outs[0]))]
+    else:
+        stacked = _stack(*outs, axis=0)
+    return stacked, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """``while cond(*loop_vars): step_out, loop_vars = func(*loop_vars)``
+    with outputs stacked and padded to ``max_iterations``
+    (ref: ndarray/contrib.py:331 while_loop)."""
+    if max_iterations is None:
+        raise ValueError("max_iterations must be provided")
+    loop_vars = _as_list(loop_vars)
+    outs = []
+    steps = 0
+
+    def _pred(v):
+        import numpy as _onp
+        return bool(_onp.asarray(v.asnumpy()).item())
+
+    while steps < max_iterations and _pred(cond(*loop_vars)):
+        step_out, new_vars = func(*loop_vars)
+        outs.append(_as_list(step_out))
+        loop_vars = _as_list(new_vars)
+        steps += 1
+    if not outs:
+        # output shapes are unknowable without one func step; the
+        # reference's imperative while_loop rejects this case too
+        raise ValueError("while_loop ran zero steps (cond was false at "
+                         "entry); outputs would have unknown shape")
+    from . import zeros as _zeros
+    n_out = len(outs[0])
+    stacked = []
+    for i in range(n_out):
+        col = _stack(*[o[i] for o in outs], axis=0)
+        if steps < max_iterations:
+            # pad to max_iterations like the reference's static output
+            pad = _zeros((max_iterations - steps,) + col.shape[1:],
+                         dtype=str(col.dtype))
+            from . import concat as _concat
+            col = _concat(col, pad, dim=0)
+        stacked.append(col)
+    return stacked, loop_vars
+
+
+def cond(pred, then_func, else_func):
+    """Run one branch based on a scalar predicate
+    (ref: ndarray/contrib.py:460 cond)."""
+    import numpy as _onp
+    p = bool(_onp.asarray(pred.asnumpy()).item()) \
+        if isinstance(pred, NDArray) else bool(pred)
+    return then_func() if p else else_func()
+
+
+# convenience re-exports under the reference's contrib namespace
+from . import boolean_mask  # noqa: E402,F401
+from ..numpy_extension import arange_like  # noqa: E402,F401
+from ..contrib.quantization import quantize, dequantize  # noqa: E402,F401

@@ -138,13 +138,11 @@ def infer_shape(sym, *args, partial=False, **kwargs):
 
 def _abstract_eval(node, in_shapes):
     opdef = _registry.get_op(node.op)
-    import inspect as _inspect
-    params = _inspect.signature(opdef.fn).parameters
-    has_var_kw = any(p.kind == _inspect.Parameter.VAR_KEYWORD
-                     for p in params.values())
-    # filter to the op signature (like executor.py does): node.attrs can
-    # carry metadata (AttrScope tags, ctx_group, ...) that must never be
-    # fed to the kernel function
+    from ..executor import _fn_params
+    params, has_var_kw = _fn_params(opdef)
+    # filter to the op signature (shared cache with the executor):
+    # node.attrs can carry metadata (AttrScope tags, ctx_group, ...) that
+    # must never be fed to the kernel function
     attrs = {k: v for k, v in node.attrs.items()
              if not k.startswith("__") and (has_var_kw or k in params)}
     input_names = node.attrs.get("__input_names__")

@@ -17,18 +17,22 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     (ref: visualization.py:38 print_summary). `shape` maps input names
     to shapes; without it output shapes print as '-'."""
     positions = positions or [0.44, 0.64, 0.74, 1.0]
-    positions = [int(line_length * p) for p in positions]
+    if positions[-1] <= 1:
+        # fractional positions (reference semantics); absolute column
+        # stops pass through unchanged (ref: visualization.py:66)
+        positions = [int(line_length * p) for p in positions]
     nodes = symbol._topo()
     out_shapes = {}
     arg_shapes = {}
     if shape:
-        arg_sh, _, aux_sh = symbol.infer_shape_partial(**shape)
-        arg_shapes = dict(zip(symbol.list_arguments(), arg_sh))
-        # per-node output shapes via internals
+        # one internals pass gives every node's output shape, including
+        # the variable nodes that ARE the argument shapes
         internals = symbol.get_internals()
         _, int_out, _ = internals.infer_shape_partial(**shape)
         for (node, oi), s in zip(internals._outputs, int_out):
             out_shapes[(id(node), oi)] = s
+            if node.is_variable() and s is not None:
+                arg_shapes[node.name] = s
 
     def fmt(fields):
         line = ""

@@ -293,3 +293,43 @@ def test_boolean_mask_differentiable():
     loss.backward()
     np.testing.assert_allclose(x.grad.asnumpy(),
                                [[2, 2], [0, 0], [2, 2]])
+
+
+class TestContribControlFlow:
+    """ref: tests/python/unittest/test_contrib_control_flow.py."""
+
+    def test_foreach_cumsum(self):
+        data = nd.array(np.arange(12, dtype="float32").reshape(4, 3))
+        out, final = nd.contrib.foreach(
+            lambda x, s: (x + s, x + s), data, nd.zeros((3,)))
+        expect = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+        np.testing.assert_allclose(out.asnumpy(), expect)
+        np.testing.assert_allclose(final.asnumpy(), expect[-1])
+
+    def test_foreach_multi_state_and_grad(self):
+        from mxnet_tpu import autograd
+        x = nd.array(np.ones((3, 2), "float32"))
+        x.attach_grad()
+        with autograd.record():
+            out, _ = nd.contrib.foreach(lambda t, s: (t * 2.0, s), x, [])
+            out.sum().backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), np.full((3, 2), 2.0))
+
+    def test_while_loop(self):
+        outs, final_vars = nd.contrib.while_loop(
+            cond=lambda i, s: i < 5,
+            func=lambda i, s: ([i], [i + 1, s + i]),
+            loop_vars=[nd.array([1.0]), nd.array([0.0])],
+            max_iterations=10)
+        assert outs[0].shape == (10, 1)  # padded to max_iterations
+        assert float(final_vars[1].asnumpy()[0]) == 10.0  # 1+2+3+4
+        np.testing.assert_allclose(outs[0].asnumpy()[:4, 0],
+                                   [1, 2, 3, 4])
+
+    def test_cond(self):
+        t = nd.contrib.cond(nd.array([2.0]).sum() > 1,
+                            lambda: nd.ones((2,)), lambda: nd.zeros((2,)))
+        assert t.asnumpy().tolist() == [1.0, 1.0]
+        f = nd.contrib.cond(nd.array([0.0]).sum() > 1,
+                            lambda: nd.ones((2,)), lambda: nd.zeros((2,)))
+        assert f.asnumpy().tolist() == [0.0, 0.0]

@@ -1,0 +1,143 @@
+"""Finite-difference gradient checks across the op surface — the
+reference's core operator test strategy (ref: tests/python/unittest/
+test_operator.py's pervasive check_numeric_gradient usage,
+python/mxnet/test_utils.py:883)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _r(*shape, seed=0, scale=1.0, shift=0.0):
+    rng = onp.random.RandomState(seed)
+    return (rng.rand(*shape).astype("float32") * scale + shift)
+
+
+class TestElementwiseGrads:
+    @pytest.mark.parametrize("op,domain", [
+        ("exp", (0.1, 1.0)), ("log", (0.5, 2.0)), ("sqrt", (0.5, 2.0)),
+        ("tanh", (-1.0, 1.0)), ("sigmoid", (-2.0, 2.0)),
+        ("erf", (-1.0, 1.0)), ("rsqrt", (0.5, 2.0)),
+        ("expm1", (-0.5, 0.5)), ("log1p", (0.1, 1.0)),
+        ("arctan", (-1.0, 1.0)), ("sinh", (-1.0, 1.0)),
+    ])
+    def test_unary(self, op, domain):
+        lo, hi = domain
+        x = _r(3, 4, scale=hi - lo, shift=lo)
+        fn = getattr(nd, op)
+        check_numeric_gradient(lambda a: fn(a).sum(), [x])
+
+    def test_binary_broadcast(self):
+        a = _r(3, 4, seed=1, shift=0.5)
+        b = _r(1, 4, seed=2, shift=0.5)
+        check_numeric_gradient(
+            lambda x, y: (x * y + x / y).sum(), [a, b])
+
+    def test_power(self):
+        a = _r(3, 3, shift=0.5)
+        check_numeric_gradient(lambda x: (x ** 2.5).sum(), [a])
+
+    def test_clip_where(self):
+        a = _r(3, 4, scale=2.0, shift=-1.0)
+        check_numeric_gradient(
+            lambda x: nd.clip(x, -0.4, 0.4).sum(), [a])
+
+
+class TestNNGrads:
+    def test_fully_connected(self):
+        x, w, b = _r(4, 5), _r(3, 5, seed=1), _r(3, seed=2)
+        check_numeric_gradient(
+            lambda a, ww, bb: nd.FullyConnected(
+                a, ww, bb, num_hidden=3).sum(), [x, w, b])
+
+    def test_convolution(self):
+        x = _r(2, 3, 6, 6)
+        w = _r(4, 3, 3, 3, seed=1, scale=0.5)
+        check_numeric_gradient(
+            lambda a, ww: (nd.Convolution(
+                a, ww, None, kernel=(3, 3), num_filter=4, no_bias=True,
+                pad=(1, 1)) ** 2).sum(), [x, w], rtol=2e-2)
+
+    def test_pooling(self):
+        x = _r(2, 2, 6, 6)
+        check_numeric_gradient(
+            lambda a: (nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="avg") ** 2).sum(), [x])
+
+    def test_softmax_ce_path(self):
+        x = _r(4, 5, scale=2.0, shift=-1.0)
+        check_numeric_gradient(
+            lambda a: (nd.log_softmax(a)[:, 0]).sum(), [x])
+
+    def test_layer_norm(self):
+        x = _r(4, 6, scale=2.0)
+        g, b = _r(6, seed=1), _r(6, seed=2)
+        check_numeric_gradient(
+            lambda a, gg, bb: (nd.LayerNorm(a, gg, bb) ** 2).sum(),
+            [x, g, b], rtol=2e-2)
+
+    def test_batchnorm_inference_grad(self):
+        x = _r(4, 3, 2, 2)
+        g, b = _r(3, seed=1, shift=0.5), _r(3, seed=2)
+        mean, var = _r(3, seed=3), _r(3, seed=4, shift=0.5)
+        def bn(a):
+            out = nd.BatchNorm(a, nd.array(g), nd.array(b), nd.array(mean),
+                               nd.array(var), use_global_stats=True)
+            out = out[0] if isinstance(out, tuple) else out
+            return (out ** 2).sum()
+        check_numeric_gradient(bn, [x], rtol=2e-2)
+
+    def test_activation_leaky(self):
+        x = _r(3, 4, scale=2.0, shift=-1.0)
+        check_numeric_gradient(
+            lambda a: nd.LeakyReLU(a, slope=0.3).sum(), [x])
+
+
+class TestLinalgGrads:
+    def test_dot(self):
+        a, b = _r(3, 4), _r(4, 2, seed=1)
+        check_numeric_gradient(lambda x, y: (nd.dot(x, y) ** 2).sum(),
+                               [a, b])
+
+    def test_batch_dot(self):
+        a, b = _r(2, 3, 4), _r(2, 4, 2, seed=1)
+        check_numeric_gradient(
+            lambda x, y: (nd.batch_dot(x, y) ** 2).sum(), [a, b])
+
+    def test_norm(self):
+        a = _r(3, 4, shift=0.5)
+        check_numeric_gradient(lambda x: nd.norm(x), [a])
+
+
+class TestShapeGrads:
+    def test_reshape_transpose_concat(self):
+        a = _r(2, 6)
+        b = _r(2, 6, seed=1)
+        check_numeric_gradient(
+            lambda x, y: (nd.concat(nd.transpose(x.reshape((3, 4))),
+                                    nd.transpose(y.reshape((3, 4))),
+                          dim=0) ** 2).sum(), [a, b])
+
+    def test_slice_take(self):
+        a = _r(5, 4)
+        idx = nd.array(onp.array([3, 1], "int32"))
+        check_numeric_gradient(
+            lambda x: (nd.take(x, idx, axis=0) ** 2).sum(), [a])
+
+    def test_sequence_mask(self):
+        a = _r(4, 3, 2)  # (T, N, C)
+        lens = nd.array(onp.array([2, 4, 1], "int32"))
+        check_numeric_gradient(
+            lambda x: (nd.SequenceMask(
+                x, sequence_length=lens, use_sequence_length=True)
+                ** 2).sum(), [a])
+
+
+class TestReduceGrads:
+    @pytest.mark.parametrize("op", ["sum", "mean", "max", "min", "prod"])
+    def test_reduce(self, op):
+        a = _r(3, 4, shift=0.5, seed=7)
+        fn = getattr(nd, op)
+        check_numeric_gradient(lambda x: (fn(x, axis=1) ** 2).sum(), [a])

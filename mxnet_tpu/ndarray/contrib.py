@@ -3,10 +3,12 @@
 ref: python/mxnet/ndarray/contrib.py (foreach :216, while_loop :331,
 cond :460) over src/operator/control_flow.cc:1089/1150/1211. The
 reference's imperative versions run Python loops per step; these do the
-same eagerly (each step's ops XLA-dispatch), which also traces cleanly
-into an enclosing ``hybridize``/jit because the iteration counts are
-static at trace time. For O(1)-size traced loops over long sequences use
-the fused ops (e.g. ``nd.RNN``) or `jax.lax.scan` directly.
+same eagerly (each step's ops XLA-dispatch). ``foreach`` also traces
+cleanly into an enclosing ``hybridize``/jit (its trip count is static);
+``while_loop``/``cond`` inspect predicate VALUES on the host, so they are
+eager-only — inside jit use ``jax.lax.while_loop``/``lax.cond`` (or
+``F.where`` masks) directly. For O(1)-size traced loops over long
+sequences use the fused ops (e.g. ``nd.RNN``) or ``jax.lax.scan``.
 """
 from __future__ import annotations
 
@@ -26,6 +28,8 @@ def foreach(body, data, init_states):
     ``data``; outputs are stacked (ref: ndarray/contrib.py:216 foreach)."""
     single_data = isinstance(data, NDArray)
     seqs = [data] if single_data else list(data)
+    if not seqs:
+        raise ValueError("foreach requires at least one input sequence")
     length = seqs[0].shape[0]
     states = init_states
     outs = []

@@ -51,6 +51,12 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     ref: src/operator/nn/convolution-inl.h ConvolutionParam/ConvolutionCompute.
     """
     nd = len(kernel) if kernel is not None else x.ndim - 2
+    if kernel is not None and tuple(weight.shape[2:]) != tuple(kernel):
+        # the reference CHECKs param-vs-weight consistency at infer time
+        # (ref: convolution-inl.h kernel shape checks)
+        raise ValueError(
+            "Convolution kernel param %s does not match weight spatial "
+            "shape %s" % (tuple(kernel), tuple(weight.shape[2:])))
     stride = _pair(stride, nd)
     dilate = _pair(dilate, nd)
     pad = _pair(pad if pad is not None else 0, nd)
@@ -78,6 +84,10 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     Implemented as conv_general_dilated with lhs_dilation (fractional stride).
     """
     nd = len(kernel)
+    if tuple(weight.shape[2:]) != tuple(kernel):
+        raise ValueError(
+            "Deconvolution kernel param %s does not match weight spatial "
+            "shape %s" % (tuple(kernel), tuple(weight.shape[2:])))
     stride = _pair(stride, nd)
     dilate = _pair(dilate, nd)
     pad = _pair(pad if pad is not None else 0, nd)

@@ -1,0 +1,104 @@
+"""Exception propagation semantics (ref: tests/python/unittest/
+test_exc_handling.py — the reference captures async-op exceptions per
+engine var and rethrows at WaitToRead/WaitForAll; here XLA dispatch is
+the engine, so invalid programs raise at call or at sync points)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+
+nd = mx.nd
+
+
+class TestEagerErrors:
+    def test_shape_mismatch_raises(self):
+        a = nd.ones((2, 3))
+        b = nd.ones((4, 5))
+        with pytest.raises(Exception):
+            nd.dot(a, b).wait_to_read()
+
+    def test_invalid_op_param(self):
+        with pytest.raises(Exception):
+            nd.Convolution(nd.ones((1, 1, 4, 4)), nd.ones((1, 1, 3, 3)),
+                           None, kernel=(9, 9), num_filter=1,
+                           no_bias=True).wait_to_read()
+
+    def test_unknown_kvstore_raises(self):
+        with pytest.raises(ValueError):
+            mx.kv.create("definitely_not_a_kvstore")
+
+    def test_uninitialized_key_raises(self):
+        kv = mx.kv.create("local")
+        with pytest.raises(ValueError):
+            kv.push(99, nd.ones((2,)))
+
+
+class TestTrainingErrors:
+    def test_backward_without_record_raises(self):
+        x = nd.ones((2,))
+        x.attach_grad()
+        y = x * 2  # not recorded
+        with pytest.raises(Exception):
+            y.backward()
+
+    def test_stale_grad_warning(self):
+        net = gluon.nn.Dense(2, in_units=3)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        x = nd.ones((2, 3))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        tr.step(2)
+        # second step without a fresh backward: stale grads must be
+        # detected (ref: trainer.py stale-grad UserWarning)
+        with pytest.raises(UserWarning):
+            tr.step(2)
+
+    def test_deferred_init_error_message(self):
+        net = gluon.nn.Dense(2)  # in_units unknown
+        net.initialize()
+        with pytest.raises(Exception):
+            # accessing data before any forward must raise the deferred
+            # init error, not crash obscurely
+            net.weight.data()
+
+
+class TestHybridizedErrors:
+    def test_error_in_traced_graph_raises_at_call(self):
+        class Bad(gluon.HybridBlock):
+            def hybrid_forward(self, F, x):
+                return F.reshape(x, shape=(7, 13))  # incompatible
+
+        net = Bad()
+        net.hybridize()
+        with pytest.raises(Exception):
+            out = net(nd.ones((2, 3)))
+            out.wait_to_read()
+
+    def test_engine_naive_mode_still_works(self, monkeypatch):
+        """MXNET_ENGINE_TYPE=NaiveEngine: the serial debug mode
+        (ref: src/engine/engine.cc:32) must still compute correctly."""
+        monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+        import importlib
+        from mxnet_tpu import engine
+        importlib.reload(engine)
+        a = nd.ones((4,)) * 3
+        assert float(a.sum().asnumpy()) == 12.0
+        monkeypatch.delenv("MXNET_ENGINE_TYPE")
+        importlib.reload(engine)
+
+
+class TestControlFlowErrors:
+    def test_foreach_empty_sequences(self):
+        with pytest.raises(ValueError, match="at least one"):
+            nd.contrib.foreach(lambda x, s: (x, s), [], [])
+
+    def test_deconv_kernel_mismatch(self):
+        with pytest.raises(ValueError, match="Deconvolution kernel"):
+            nd.Deconvolution(nd.ones((1, 2, 4, 4)), nd.ones((2, 1, 2, 2)),
+                             None, kernel=(3, 3), num_filter=1,
+                             no_bias=True)

@@ -1,2 +1,3 @@
 """Gluon contrib (ref: python/mxnet/gluon/contrib/__init__.py)."""
 from . import estimator  # noqa: F401
+from . import nn  # noqa: F401

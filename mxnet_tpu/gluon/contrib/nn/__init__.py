@@ -1,0 +1,2 @@
+"""Gluon contrib layers (ref: python/mxnet/gluon/contrib/nn/__init__.py)."""
+from .basic_layers import *  # noqa: F401,F403

@@ -1,0 +1,50 @@
+"""Contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py:
+Concurrent, HybridConcurrent, Identity)."""
+from __future__ import annotations
+
+from ... import nn
+from ...block import HybridBlock
+from .... import ndarray as nd
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+
+
+class Concurrent(nn.Sequential):
+    """Run children on the same input and concat outputs
+    (ref: basic_layers.py:34 Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(nn.HybridSequential):
+    """Hybridizable Concurrent (ref: basic_layers.py:73)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        # eager path: HybridSequential.forward would CHAIN children
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block, for use in Concurrent branches
+    (ref: basic_layers.py:112 Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+    def forward(self, x, *args):
+        return x

@@ -31,6 +31,12 @@ def foreach(body, data, init_states):
     if not seqs:
         raise ValueError("foreach requires at least one input sequence")
     length = seqs[0].shape[0]
+    for s in seqs[1:]:
+        if s.shape[0] != length:
+            # jax indexing would silently clamp out-of-bounds steps
+            raise ValueError(
+                "foreach input sequences must share axis-0 length; got "
+                "%d and %d" % (length, s.shape[0]))
     states = init_states
     outs = []
     for t in range(length):

@@ -380,3 +380,23 @@ class TestSVRGCallbacks:
                 epoch_end_callback=epoch_cb,
                 optimizer_params={"learning_rate": 0.1})
         assert seen["epoch_end"] == 2
+
+
+class TestContribNN:
+    def test_concurrent_and_identity(self):
+        from mxnet_tpu.gluon.contrib.nn import (HybridConcurrent, Identity,
+                                                Concurrent)
+        net = HybridConcurrent(axis=-1)
+        net.add(nn.Dense(3, in_units=4), Identity(),
+                nn.Dense(2, in_units=4))
+        net.initialize()
+        x = mx.nd.array(onp.ones((2, 4), "float32"))
+        out = net(x)
+        assert out.shape == (2, 9)
+        net.hybridize()
+        onp.testing.assert_allclose(out.asnumpy(), net(x).asnumpy(),
+                                    rtol=1e-6)
+        c = Concurrent(axis=-1)
+        c.add(nn.Dense(3, in_units=4), Identity())
+        c.initialize()
+        assert c(x).shape == (2, 7)

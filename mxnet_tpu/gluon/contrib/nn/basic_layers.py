@@ -30,21 +30,15 @@ class HybridConcurrent(nn.HybridSequential):
         self.axis = axis
 
     def forward(self, x, *args):
-        # eager path: HybridSequential.forward would CHAIN children
+        # HybridSequential.forward would CHAIN children; used by both the
+        # eager path and the cached-op trace.
         out = [block(x) for block in self._children.values()]
         return nd.concat(*out, dim=self.axis)
-
-    def hybrid_forward(self, F, x):
-        out = [block(x) for block in self._children.values()]
-        return F.concat(*out, dim=self.axis)
 
 
 class Identity(HybridBlock):
     """Pass-through block, for use in Concurrent branches
     (ref: basic_layers.py:112 Identity)."""
-
-    def hybrid_forward(self, F, x):
-        return x
 
     def forward(self, x, *args):
         return x

@@ -81,6 +81,28 @@ def _declare(lib):
         fn = getattr(lib, name)
         fn.argtypes = argtypes
         fn.restype = ctypes.c_int
+    # predict ABI (only present when built with python3-config available)
+    u32 = ctypes.c_uint32
+    u32p = ctypes.POINTER(u32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    for name, argtypes in [
+        ("MXTPredCreate",
+         [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+          ctypes.c_int, u32, ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
+          pp]),
+        ("MXTPredSetInput", [p, ctypes.c_char_p, fp, u32]),
+        ("MXTPredForward", [p]),
+        ("MXTPredGetOutputShape", [p, u32, ctypes.POINTER(u32p), u32p]),
+        ("MXTPredGetOutput", [p, u32, fp, u32]),
+        ("MXTPredReshape", [u32, ctypes.POINTER(ctypes.c_char_p), u32p,
+                            u32p, p, pp]),
+        ("MXTPredFree", [p]),
+    ]:
+        fn = getattr(lib, name, None)
+        if fn is None:
+            continue
+        fn.argtypes = argtypes
+        fn.restype = ctypes.c_int
     return lib
 
 

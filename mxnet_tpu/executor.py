@@ -32,6 +32,8 @@ from .ops import registry as _registry
 
 __all__ = ["Executor"]
 
+from .symbol.control_flow import CONTROL_FLOW_OPS as _CONTROL_FLOW_OPS
+
 _SIG_CACHE = {}
 
 
@@ -72,6 +74,14 @@ class _GraphProgram:
                 if node.name not in values:
                     raise MXNetError("unbound variable %r" % node.name)
                 vals[(id(node), 0)] = values[node.name]
+                continue
+            if node.op in _CONTROL_FLOW_OPS:
+                from .symbol.control_flow import lower as _cf_lower
+                ins = [vals[(id(src), oi)] for src, oi in node.inputs]
+                outs = _cf_lower(node, ins, is_train,
+                                 jax.random.fold_in(key, idx))
+                for i, o in enumerate(outs):
+                    vals[(id(node), i)] = o
                 continue
             opdef = _registry.get_op(node.op)
             pnames, has_var_kw = _fn_params(opdef)

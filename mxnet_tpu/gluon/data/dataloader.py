@@ -99,27 +99,150 @@ class DataLoader:
                     submitted += 1
 
     def _iter_multiprocess(self):
-        ctx = _mp.get_context("fork")
-        with ctx.Pool(self._num_workers) as pool:
-            batches = list(self._batch_sampler)
-            # bounded in-flight window: at most `prefetch` decoded batches
-            # pending, mirroring the threaded path (unbounded apply_async
-            # would buffer the whole epoch in the parent)
-            depth = max(self._prefetch, 1)
-            pending = []
-            submitted = 0
+        pool = self._get_pool()
+        batches = list(self._batch_sampler)
+        # bounded in-flight window: at most `prefetch` decoded batches
+        # pending, mirroring the threaded path (unbounded apply_async
+        # would buffer the whole epoch in the parent)
+        depth = max(self._prefetch, 1)
+        pending = []
+        submitted = 0
+        consumed = 0
+        try:
             for indices in batches[:depth]:
                 pending.append(pool.apply_async(
-                    _mp_fetch, (self._dataset, indices, self._batchify_fn)))
+                    _mp_fetch_shm, (self._pool_key, indices)))
                 submitted += 1
             for i in range(len(batches)):
-                yield pending[i].get()
+                desc = pending[i].get()
+                consumed = i + 1
+                yield _from_shm(desc)
                 if submitted < len(batches):
                     pending.append(pool.apply_async(
-                        _mp_fetch, (self._dataset, batches[submitted],
-                                    self._batchify_fn)))
+                        _mp_fetch_shm, (self._pool_key,
+                                        batches[submitted])))
                     submitted += 1
+        finally:
+            # abandoned/broken iteration: reap in-flight batches and unlink
+            # their shared-memory segments, otherwise they outlive the
+            # process (workers hand tracker ownership to us)
+            for r in pending[consumed:]:
+                try:
+                    _free_shm(r.get(timeout=60))
+                except Exception:
+                    pass
+
+    def _get_pool(self):
+        """Persistent fork-based worker pool — same lifecycle as the
+        reference, which also keeps one pool for the DataLoader's lifetime
+        (ref: gluon/data/dataloader.py DataLoader.__init__ worker_pool), so
+        dataset mutations after the first epoch are likewise invisible to
+        workers. The dataset is inherited by the forked children
+        copy-on-write through a module-level registry — no per-task (or
+        even per-worker) pickling — and batches come back through POSIX
+        shared memory, the reference's CPUSharedStorageManager architecture
+        (ref: src/storage/cpu_shared_storage_manager.h). The registry entry
+        stays until shutdown so that workers respawned by Pool after an
+        abnormal worker death still see every live loader's dataset."""
+        if getattr(self, "_pool", None) is None:
+            ctx = _mp.get_context("fork")
+            self._pool_key = id(self)
+            _WORKER_STATES[self._pool_key] = (self._dataset,
+                                              self._batchify_fn)
+            self._pool = ctx.Pool(self._num_workers)
+            # tear the pool down before interpreter teardown starts —
+            # mp.Pool.__del__ at shutdown races module globals going None
+            import atexit
+            import weakref
+            ref = weakref.ref(self)
+            atexit.register(lambda: ref() is not None
+                            and ref()._shutdown_pool())
+        return self._pool
+
+    def _shutdown_pool(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            self._pool = None
+            _WORKER_STATES.pop(getattr(self, "_pool_key", None), None)
+            try:
+                pool.terminate()
+                pool.join()
+            except Exception:
+                pass
+
+    def __del__(self):
+        self._shutdown_pool()
 
 
-def _mp_fetch(dataset, indices, batchify_fn):
-    return batchify_fn([dataset[i] for i in indices])
+# {loader key: (dataset, batchify_fn)}, populated in the parent before the
+# pool forks so children (and later respawns) inherit it without pickling
+_WORKER_STATES = {}
+
+
+def _to_shm(obj):
+    """Serialize a batch into shared-memory segment descriptors."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, (tuple, list)):
+        return ("tuple", [_to_shm(o) for o in obj])
+    if isinstance(obj, NDArray):
+        a = obj.asnumpy()
+    elif isinstance(obj, np.ndarray):
+        a = obj
+    else:
+        return ("obj", obj)
+    a = np.ascontiguousarray(a)
+    shm = shared_memory.SharedMemory(create=True, size=max(a.nbytes, 1))
+    view = np.ndarray(a.shape, a.dtype, buffer=shm.buf)
+    view[...] = a
+    name = shm.name
+    shm.close()
+    # ownership passes to the parent (which unlinks after rebuild); drop the
+    # worker-side resource_tracker registration so it does not warn about an
+    # already-unlinked segment at worker exit
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister("/" + name, "shared_memory")
+    except Exception:
+        pass
+    return ("shm", name, a.shape, str(a.dtype))
+
+
+def _from_shm(desc):
+    """Rebuild a batch from shared-memory descriptors (parent side)."""
+    from multiprocessing import shared_memory
+    tag = desc[0]
+    if tag == "tuple":
+        return tuple(_from_shm(o) for o in desc[1])
+    if tag == "obj":
+        return desc[1]
+    _, name, shape, dtype = desc
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        # one host memcpy out of the segment before unmapping: the device
+        # transfer downstream is async and must not alias unmapped memory
+        a = np.ndarray(shape, dtype, buffer=shm.buf).copy()
+    finally:
+        shm.close()
+        shm.unlink()
+    return nd_array(a)
+
+
+def _free_shm(desc):
+    """Unlink segments of a batch that will never be rebuilt."""
+    from multiprocessing import shared_memory
+    if desc[0] == "tuple":
+        for o in desc[1]:
+            _free_shm(o)
+    elif desc[0] == "shm":
+        try:
+            shm = shared_memory.SharedMemory(name=desc[1])
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+
+
+def _mp_fetch_shm(key, indices):
+    dataset, batchify_fn = _WORKER_STATES[key]
+    batch = batchify_fn([dataset[i] for i in indices])
+    return _to_shm(batch)

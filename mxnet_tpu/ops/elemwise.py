@@ -37,10 +37,14 @@ for _name, _fn in _BINARY.items():
              aliases=("broadcast_" + _name,
                       *( ("elemwise_" + _name,) if _name in
                          ("add", "subtract", "multiply", "divide") else () ),
-                      *( ("broadcast_sub",) if _name == "subtract" else () ),
-                      *( ("broadcast_mul",) if _name == "multiply" else () ),
-                      *( ("broadcast_div",) if _name == "divide" else () ),
-                      *( ("broadcast_pow",) if _name == "power" else () ),
+                      *( ("broadcast_sub", "elemwise_sub")
+                         if _name == "subtract" else () ),
+                      *( ("broadcast_mul", "elemwise_mul")
+                         if _name == "multiply" else () ),
+                      *( ("broadcast_div", "elemwise_div")
+                         if _name == "divide" else () ),
+                      *( ("broadcast_pow", "_power")
+                         if _name == "power" else () ),
                       ))(_fn)
 
 _COMPARE = {
@@ -188,3 +192,19 @@ register("_rpower_scalar", num_inputs=1)(lambda x, scalar=1.0: scalar ** x)
 register("_mod_scalar", num_inputs=1)(lambda x, scalar=1.0: x % scalar)
 register("_maximum_scalar", num_inputs=1)(lambda x, scalar=0.0: jnp.maximum(x, scalar))
 register("_minimum_scalar", num_inputs=1)(lambda x, scalar=0.0: jnp.minimum(x, scalar))
+
+# scalar comparisons (ref: src/operator/tensor/elemwise_binary_scalar_op_logic.cc)
+# — 1.0/0.0 outputs in the input dtype, like the tensor-tensor comparisons
+for _cname, _cfn in (("_equal_scalar", jnp.equal),
+                     ("_not_equal_scalar", jnp.not_equal),
+                     ("_greater_scalar", jnp.greater),
+                     ("_greater_equal_scalar", jnp.greater_equal),
+                     ("_lesser_scalar", jnp.less),
+                     ("_lesser_equal_scalar", jnp.less_equal)):
+    def _mk_cmp_scalar(f):
+        def _cmp(x, scalar=0.0):
+            dt = x.dtype if jnp.issubdtype(x.dtype, jnp.number) \
+                else jnp.float32
+            return f(x, scalar).astype(dt)
+        return _cmp
+    register(_cname, num_inputs=1, no_grad=True)(_mk_cmp_scalar(_cfn))

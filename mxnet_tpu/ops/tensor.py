@@ -462,3 +462,21 @@ def stop_gradient(x):
 @register("make_loss", num_inputs=1, aliases=("MakeLoss",))
 def make_loss(x, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
     return x
+
+
+@register("_zeros", num_inputs=0, no_grad=True)
+def _zeros(shape=(), dtype="float32"):
+    """ref: src/operator/tensor/init_op.cc _zeros."""
+    return jnp.zeros(tuple(shape), dtype or "float32")
+
+
+@register("_ones", num_inputs=0, no_grad=True)
+def _ones(shape=(), dtype="float32"):
+    """ref: src/operator/tensor/init_op.cc _ones."""
+    return jnp.ones(tuple(shape), dtype or "float32")
+
+
+@register("_full", num_inputs=0, no_grad=True)
+def _full(shape=(), dtype="float32", value=0.0):
+    """ref: src/operator/tensor/init_op.cc _full."""
+    return jnp.full(tuple(shape), value, dtype or "float32")

@@ -60,6 +60,10 @@ class TransformerConfig:
     num_experts: int = 0
     moe_k: int = 2
     causal: bool = True
+    # rematerialize each layer in backward (activation recompute): trades
+    # ~1/3 more FLOPs for O(n_layers) less activation HBM, the standard
+    # TPU trade (SURVEY §7: jax.checkpoint)
+    remat: bool = True
 
     @property
     def head_dim(self):
@@ -230,6 +234,8 @@ def apply(params, tokens, cfg: TransformerConfig, mesh=None,
         x, aux = _layer_body(cfg, mesh, positions, x, lp)
         return x, aux
 
+    if cfg.remat:
+        body = jax.checkpoint(body)
     x, auxs = lax.scan(body, x, params["layers"])
     x = _rms_norm(x, params["ln_f"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["w_out"])

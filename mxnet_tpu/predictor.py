@@ -145,3 +145,40 @@ class Predictor:
         return cls(sym, dev_type=dev_type, input_shapes=input_shapes,
                    arg_params=arg_params, aux_params=aux_params,
                    output_keys=output_keys)
+
+
+# -- entry points for the native C predict ABI (src/c_predict_api.cc) --------
+# Keep the argument types primitive (str/bytes/memoryview/lists) so the C
+# side stays a thin CPython-call shim.
+
+def _c_create(symbol_json, param_bytes, input_names, input_shapes):
+    shapes = {n: tuple(int(d) for d in s)
+              for n, s in zip(input_names, input_shapes)}
+    return Predictor(symbol_json, param_raw_bytes=param_bytes,
+                     input_shapes=shapes)
+
+
+def _c_set_input(pred, key, buf):
+    shape = pred._input_shapes[key]
+    arr = _np.frombuffer(buf, dtype=_np.float32)
+    if arr.size != int(_np.prod(shape)):
+        raise ValueError("input %r: got %d elements, declared shape %s "
+                         "needs %d" % (key, arr.size, shape,
+                                       int(_np.prod(shape))))
+    pred.set_input(key, _np.ascontiguousarray(arr.reshape(shape)))
+
+
+def _c_get_output(pred, index):
+    out = _np.ascontiguousarray(pred.get_output(index), dtype=_np.float32)
+    return out.tobytes()
+
+
+def _c_reshape(pred, input_names, input_shapes):
+    # unspecified inputs keep their prior shape, like MXPredReshape and
+    # Predictor.reshape
+    shapes = dict(pred._input_shapes)
+    shapes.update({n: tuple(int(d) for d in s)
+                   for n, s in zip(input_names, input_shapes)})
+    return Predictor(pred._symbol, dev_type=pred._ctx, input_shapes=shapes,
+                     arg_params=pred._arg_params,
+                     aux_params=pred._aux_params)

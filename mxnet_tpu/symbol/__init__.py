@@ -6,5 +6,7 @@ from .register import populate as _populate
 
 _populate(globals())
 
+from . import contrib  # noqa: E402  (after populate: contrib uses registry)
+
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
-           "zeros", "ones"]
+           "zeros", "ones", "contrib"]

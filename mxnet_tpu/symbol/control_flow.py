@@ -1,0 +1,223 @@
+"""Symbol-level control flow: subgraph capture + XLA-native lowering.
+
+The reference implements `sym.contrib.foreach/while_loop/cond` as stateful
+C++ ops holding nnvm subgraphs (ref: src/operator/control_flow.cc:1089
+_foreach, :1150 _while_loop, :1211 _cond; python capture in
+python/mxnet/symbol/contrib.py:212,375,598). Here a control-flow node
+stores its subgraph(s) as serialized graph JSON in node attrs, and the
+executor lowers the whole node into the enclosing XLA program via
+`lax.scan` / `lax.while_loop` / `lax.cond` — compiler-friendly loops
+instead of the reference's per-step engine pushes, which is exactly the
+control-flow story the TPU design calls for (no data-dependent Python
+control flow inside jit).
+
+Capture works by creation order: every `_Node` carries a monotonically
+increasing `uid`. Anything the body references that was created BEFORE the
+capture started (outer op results) — and every free variable — is "cut"
+into an explicit input of the control-flow node, mirroring the reference's
+closure-capture of outer symbols.
+"""
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .symbol import Symbol, _Node, _node_uid
+
+__all__ = ["CONTROL_FLOW_OPS", "capture_subgraph", "lower"]
+
+CONTROL_FLOW_OPS = ("_foreach", "_while_loop", "_cond")
+
+
+def capture_subgraph(heads, placeholders, marker):
+    """Serialize the graph reachable from `heads` into standalone JSON.
+
+    heads        : list[(node, out_index)] subgraph outputs
+    placeholders : {id(node): varname} — loop placeholders, kept as subgraph
+                   input variables under the given name
+    marker       : uid watermark; nodes with uid < marker are outer values
+
+    Free variables and outer op results become fresh input variables of the
+    subgraph ("cuts"). Returns (json_str, input_varnames, cut_entries) where
+    cut_entries is the ordered list of outer (node, out_index) pairs feeding
+    the cut variables, and input_varnames lists every subgraph input
+    variable name in [placeholder..., cut...] order.
+    """
+    memo = {}       # id(inner node) -> copied node
+    cut_memo = {}   # (id(node), oi) -> copied var node
+    cuts = []       # [(node, oi)] outer values, in first-use order
+    cut_names = []
+
+    def is_boundary(node):
+        return (id(node) not in placeholders
+                and (node.is_variable() or node.uid < marker))
+
+    def cut_var(src, oi):
+        k = (id(src), oi)
+        if k in cut_memo:
+            return cut_memo[k]
+        if src.is_variable():
+            name = src.name               # keep bindable parameter names
+        else:
+            name = "_cut_%s_out%d" % (src.name, oi)
+        nn = _Node(None, name, {})
+        cut_memo[k] = nn
+        cuts.append((src, oi))
+        cut_names.append(name)
+        return nn
+
+    def copy(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if id(node) in placeholders:
+            nn = _Node(None, placeholders[id(node)], {})
+        else:
+            nn = _Node(node.op, node.name, dict(node.attrs), (),
+                       node.num_outputs)
+            for src, oi in node.inputs:
+                if is_boundary(src):
+                    nn.inputs.append((cut_var(src, oi), 0))
+                else:
+                    nn.inputs.append((copy(src), oi))
+        memo[id(node)] = nn
+        return nn
+
+    new_heads = []
+    for node, oi in heads:
+        if is_boundary(node):
+            new_heads.append((cut_var(node, oi), 0))
+        else:
+            new_heads.append((copy(node), oi))
+    sub = Symbol(new_heads)
+    input_names = list(placeholders.values()) + cut_names
+    return sub.tojson(), input_names, cuts
+
+
+def _programs(node):
+    """Parse (and cache) the node's subgraph JSON into graph programs."""
+    if node._cf_cache is None:
+        from .symbol import load_json
+        from ..executor import _GraphProgram
+        node._cf_cache = [_GraphProgram(load_json(js))
+                          for js in node.attrs["__subgraph__"]]
+    return node._cf_cache
+
+
+def _bind(mapping, node_ins, carry, slices):
+    """Resolve a subgraph's {varname: value} dict from its input mapping.
+
+    mapping entries are [varname, kind, idx]:
+      kind "slice" — per-step slice idx of the scanned sequences
+      kind "carry" — loop-carried value idx
+      kind "input" — node input idx (closure / initial value)
+    """
+    values = {}
+    for name, kind, idx in mapping:
+        if kind == "slice":
+            values[name] = slices[idx]
+        elif kind == "carry":
+            values[name] = carry[idx]
+        else:
+            values[name] = node_ins[idx]
+    return values
+
+
+def lower(node, ins, is_train, key):
+    """Lower one control-flow node to jax. ins: node input values in node
+    input order. Returns the node's output values as a list."""
+    if node.op == "_foreach":
+        return _lower_foreach(node, ins, is_train, key)
+    if node.op == "_while_loop":
+        return _lower_while(node, ins, is_train, key)
+    if node.op == "_cond":
+        return _lower_cond(node, ins, is_train, key)
+    raise ValueError(node.op)
+
+
+def _lower_foreach(node, ins, is_train, key):
+    a = node.attrs
+    nd_, ns_ = int(a["__num_data__"]), int(a["__num_states__"])
+    nod = int(a["__num_out_data__"])
+    (mapping,) = a["__subg_inputs__"]
+    (prog,) = _programs(node)
+    data = tuple(ins[:nd_])
+    states0 = tuple(ins[nd_:nd_ + ns_])
+    length = data[0].shape[0]
+
+    def body(carry, xs):
+        slices, t = xs
+        values = _bind(mapping, ins, carry, slices)
+        outs, _ = prog.run(values, is_train, jax.random.fold_in(key, t))
+        return tuple(outs[nod:]), tuple(outs[:nod])
+
+    final, stacked = lax.scan(body, states0,
+                              (data, jnp.arange(length, dtype=jnp.int32)))
+    return list(stacked) + list(final)
+
+
+def _lower_while(node, ins, is_train, key):
+    a = node.attrs
+    nvars = int(a["__num_vars__"])
+    nod = int(a["__num_out_data__"])
+    max_iter = int(a["max_iterations"])
+    map_cond, map_body = a["__subg_inputs__"]
+    prog_cond, prog_body = _programs(node)
+    loop0 = tuple(ins[:nvars])
+
+    def run_body(vars_, t):
+        values = _bind(map_body, ins, vars_, ())
+        outs, _ = prog_body.run(values, is_train, jax.random.fold_in(key, t))
+        return tuple(outs)
+
+    out_shapes = jax.eval_shape(run_body, loop0, jnp.int32(0))[:nod]
+    bufs0 = tuple(jnp.zeros((max_iter,) + s.shape, s.dtype)
+                  for s in out_shapes)
+
+    def cond_fn(st):
+        i, vars_, _ = st
+        values = _bind(map_cond, ins, vars_, ())
+        outs, _ = prog_cond.run(values, is_train, key)
+        p = jnp.reshape(outs[0].astype(bool), ())
+        return jnp.logical_and(i < max_iter, p)
+
+    def body_fn(st):
+        i, vars_, bufs = st
+        outs = run_body(vars_, i)
+        step_outs, new_vars = outs[:nod], outs[nod:]
+        bufs = tuple(lax.dynamic_update_index_in_dim(
+            b, o.astype(b.dtype), i, 0) for b, o in zip(bufs, step_outs))
+        return i + 1, tuple(new_vars), bufs
+
+    _, vars_, bufs = lax.while_loop(
+        cond_fn, body_fn, (jnp.int32(0), loop0, bufs0))
+    return list(bufs) + list(vars_)
+
+
+def _lower_cond(node, ins, is_train, key):
+    a = node.attrs
+    map_pred, map_then, map_else = a["__subg_inputs__"]
+    prog_pred, prog_then, prog_else = _programs(node)
+
+    pred_outs, _ = prog_pred.run(_bind(map_pred, ins, (), ()), is_train, key)
+    pred = jnp.reshape(pred_outs[0].astype(bool), ())
+
+    def mk(prog, mapping, salt):
+        def branch(_):
+            values = _bind(mapping, ins, (), ())
+            outs, _ = prog.run(values, is_train,
+                               jax.random.fold_in(key, salt))
+            return tuple(outs)
+        return branch
+
+    outs = lax.cond(pred, mk(prog_then, map_then, 1),
+                    mk(prog_else, map_else, 2), jnp.int32(0))
+    return list(outs)
+
+
+def next_marker():
+    """uid watermark for capture: nodes created after this call have
+    uid >= the returned value."""
+    return next(_node_uid)

@@ -99,6 +99,12 @@ def infer_shape(sym, *args, partial=False, **kwargs):
     for node in nodes:
         if node.is_variable():
             continue
+        from .control_flow import CONTROL_FLOW_OPS as _CF
+        if node.op in _CF:
+            # recurse into subgraphs so parameters used inside loop bodies
+            # (auto-created weights etc.) get hint-inferred like the
+            # reference's subgraph shape inference
+            _cf_propagate_var_hints(node, shapes)
         input_names = node.attrs.get("__input_names__")
         in_shapes = {}
         if input_names:
@@ -136,7 +142,56 @@ def infer_shape(sym, *args, partial=False, **kwargs):
     return arg_shapes, out_shapes, aux_shapes
 
 
+def _cf_propagate_var_hints(node, shapes):
+    """Run partial shape inference inside a control-flow node's subgraphs
+    and write inferred shapes back onto unknown outer input VARIABLES
+    (loop-body parameters). Mutates `shapes` in place."""
+    from .symbol import load_json
+    a = node.attrs
+    in_shapes = [shapes.get((id(src), oi)) for src, oi in node.inputs]
+    carry_off = int(a.get("__num_data__", 0))
+    for js, mapping in zip(a["__subgraph__"], a["__subg_inputs__"]):
+        sub = load_json(js)
+        kwargs = {}
+        for vn, kind, idx in mapping:
+            if kind == "slice":
+                s = in_shapes[idx]
+                if s is not None and len(s) >= 1:
+                    kwargs[vn] = tuple(s[1:])
+            else:
+                src_idx = carry_off + idx if kind == "carry" else idx
+                s = in_shapes[src_idx]
+                if s is not None:
+                    kwargs[vn] = tuple(s)
+        try:
+            arg_shapes, _, _ = infer_shape(sub, partial=True, **kwargs)
+        except Exception:
+            continue
+        inferred = dict(zip(sub.list_arguments(), arg_shapes))
+        for vn, kind, idx in mapping:
+            s = inferred.get(vn)
+            if s is None:
+                continue
+            src_idx = carry_off + idx if kind == "carry" else idx
+            if kind == "slice" or src_idx >= len(node.inputs):
+                continue
+            src, oi = node.inputs[src_idx]
+            if src.is_variable() and shapes.get((id(src), oi)) is None:
+                shapes[(id(src), oi)] = tuple(s)
+                in_shapes[src_idx] = tuple(s)
+
+
 def _abstract_eval(node, in_shapes):
+    from .control_flow import CONTROL_FLOW_OPS, lower as _cf_lower
+    if node.op in CONTROL_FLOW_OPS:
+        structs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+
+        def cf(*xs):
+            return tuple(_cf_lower(node, list(xs), False,
+                                   jax.random.PRNGKey(0)))
+
+        out = jax.eval_shape(cf, *structs)
+        return [tuple(o.shape) for o in out]
     opdef = _registry.get_op(node.op)
     from ..executor import _fn_params
     params, has_var_kw = _fn_params(opdef)

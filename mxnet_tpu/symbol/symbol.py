@@ -57,10 +57,16 @@ INPUT_PARAM_NAMES = (
 AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean", "running_var")
 
 
+import itertools
+
+_node_uid = itertools.count()
+
+
 class _Node:
     """One graph node: an op application or a variable (op=None)."""
 
-    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_shape")
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_shape",
+                 "uid", "_cf_cache")
 
     def __init__(self, op, name, attrs=None, inputs=(), num_outputs=1,
                  shape=None):
@@ -70,6 +76,8 @@ class _Node:
         self.inputs = list(inputs)  # list[(Symbol's node, out_index)]
         self.num_outputs = num_outputs
         self._shape = shape        # user-annotated shape for variables
+        self.uid = next(_node_uid)  # creation order, for subgraph cutting
+        self._cf_cache = None      # parsed control-flow subgraph programs
 
     def is_variable(self):
         return self.op is None
@@ -216,6 +224,58 @@ class Symbol:
     def __neg__(self):
         return self.__mul__(-1.0)
 
+    def __mod__(self, other):
+        return _binop("mod", "_mod_scalar", self, other)
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return _binop("equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return _binop("not_equal", "_not_equal_scalar", self, other)
+
+    def __gt__(self, other):
+        return _binop("greater", "_greater_scalar", self, other)
+
+    def __ge__(self, other):
+        return _binop("greater_equal", "_greater_equal_scalar", self, other)
+
+    def __lt__(self, other):
+        return _binop("lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _binop("lesser_equal", "_lesser_equal_scalar", self, other)
+
+    __hash__ = object.__hash__
+
+    def __bool__(self):
+        # ref: symbol.py:123 — a Symbol has no runtime value to branch on;
+        # use sym.contrib.cond / lax-lowered control flow instead
+        raise TypeError("Symbol cannot be used in boolean context; it has "
+                        "no value until bound (use sym.contrib.cond)")
+
+    def __getattr__(self, name):
+        # registry ops as methods (`s.sum()`, `s.reshape(...)`), like the
+        # reference's generated Symbol methods (ref: symbol/register.py)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from ..ops import registry as _reg
+        try:
+            _reg.get_op(name)
+        except KeyError:
+            raise AttributeError("Symbol has no attribute %r" % name)
+        from .register import make_symbol_op_func
+        fn = make_symbol_op_func(_reg.get_op(name), name)
+
+        def method(*args, **kwargs):
+            return fn(self, *args, **kwargs)
+
+        method.__name__ = name
+        return method
+
     # -- shape/type inference ----------------------------------------------
     def infer_shape(self, *args, **kwargs):
         from .infer import infer_shape as _infer
@@ -341,6 +401,8 @@ def load_json(json_str):
 
 def _num_outputs_of(node):
     # multi-output ops known to the framework
+    if "__num_outputs__" in node.attrs:
+        return int(node.attrs["__num_outputs__"])
     if node.op in ("BatchNorm", "batch_norm"):
         return 3
     return 1

@@ -10,32 +10,32 @@
 #include <string>
 #include <vector>
 
+#include "c_error.h"
 #include "recordio.h"
 #include "threaded_reader.h"
 
-namespace {
-thread_local std::string last_error;
-
-int Fail(const std::string& msg) {
-  last_error = msg;
-  return -1;
+namespace mxnet_tpu {
+std::string& LastError() {
+  thread_local std::string last_error;
+  return last_error;
 }
 
-#define API_BEGIN() try {
-#define API_END()                               \
-  }                                             \
-  catch (const std::exception& e) {             \
-    return Fail(e.what());                      \
-  }                                             \
-  catch (...) {                                 \
-    return Fail("unknown C++ exception");       \
-  }                                             \
-  return 0;
+int FailWith(const std::string& msg) {
+  LastError() = msg;
+  return -1;
+}
+}  // namespace mxnet_tpu
+
+namespace {
+int Fail(const std::string& msg) { return mxnet_tpu::FailWith(msg); }
+
+#define API_BEGIN MXT_API_BEGIN
+#define API_END MXT_API_END
 }  // namespace
 
 extern "C" {
 
-const char* MXTGetLastError() { return last_error.c_str(); }
+const char* MXTGetLastError() { return mxnet_tpu::LastError().c_str(); }
 
 // -- RecordWriter -----------------------------------------------------------
 int MXTRecordWriterCreate(const char* path, void** out) {

@@ -1,0 +1,29 @@
+// Shared error state for the C ABI (ref: src/c_api/c_api_error.cc —
+// thread-local last-error retrievable via the GetLastError entry point).
+#ifndef MXNET_TPU_C_ERROR_H_
+#define MXNET_TPU_C_ERROR_H_
+
+#include <string>
+
+namespace mxnet_tpu {
+
+// thread-local last error message, read by MXTGetLastError()
+std::string& LastError();
+
+// set the error and return -1 (the C ABI failure code)
+int FailWith(const std::string& msg);
+
+}  // namespace mxnet_tpu
+
+#define MXT_API_BEGIN() try {
+#define MXT_API_END()                                  \
+  }                                                    \
+  catch (const std::exception& e) {                    \
+    return mxnet_tpu::FailWith(e.what());              \
+  }                                                    \
+  catch (...) {                                        \
+    return mxnet_tpu::FailWith("unknown C++ exception"); \
+  }                                                    \
+  return 0;
+
+#endif  // MXNET_TPU_C_ERROR_H_

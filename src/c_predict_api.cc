@@ -1,0 +1,278 @@
+// Native C predict ABI: standalone inference entry points.
+//
+// Mirrors the reference's deployment ABI (ref: include/mxnet/c_predict_api.h
+// — MXPredCreate :84, MXPredSetInput :254, MXPredForward :263,
+// MXPredGetOutputShape :229, MXPredGetOutput :289, MXPredReshape :214,
+// MXPredFree; src/c_api/c_predict_api.cc). The reference binds a
+// GraphExecutor under the ABI; here each handle owns a
+// mxnet_tpu.predictor.Predictor, whose bind compiles the whole graph into
+// ONE XLA program — the compute path stays jax/XLA, the ABI stays C.
+//
+// Works both embedded in a C/C++ application (initializes CPython on first
+// use; set PYTHONPATH so `import mxnet_tpu` resolves) and loaded into an
+// existing Python process (uses the running interpreter via the GIL).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_error.h"
+
+namespace {
+
+using mxnet_tpu::FailWith;
+
+struct PredState {
+  PyObject* predictor = nullptr;          // mxnet_tpu.predictor.Predictor
+  std::vector<uint32_t> shape_buf;        // storage for GetOutputShape
+};
+
+// Ensure an interpreter exists. In an embedded app we initialize it and
+// immediately release the GIL so that every entry point can use the
+// uniform PyGILState_Ensure/Release protocol.
+void EnsurePython() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    PyEval_SaveThread();
+  }
+}
+
+class Gil {
+ public:
+  Gil() { state_ = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+int PyFail(const char* what) {
+  std::string msg = what;
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *val = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &val, &tb);
+    PyErr_NormalizeException(&type, &val, &tb);
+    if (val != nullptr) {
+      PyObject* s = PyObject_Str(val);
+      if (s != nullptr) {
+        const char* u = PyUnicode_AsUTF8(s);
+        if (u != nullptr) msg = std::string(what) + ": " + u;
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(val);
+    Py_XDECREF(tb);
+  }
+  return FailWith(msg);
+}
+
+PyObject* PredictorModule() {
+  return PyImport_ImportModule("mxnet_tpu.predictor");
+}
+
+// (names, shapes) python lists from the reference's packed shape arrays
+bool BuildShapeArgs(uint32_t num_input_nodes, const char** input_keys,
+                    const uint32_t* input_shape_indptr,
+                    const uint32_t* input_shape_data, PyObject** out_names,
+                    PyObject** out_shapes) {
+  PyObject* names = PyList_New(num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  if (names == nullptr || shapes == nullptr) {
+    Py_XDECREF(names);
+    Py_XDECREF(shapes);
+    return false;
+  }
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_keys[i]));
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j) {
+      PyTuple_SetItem(shp, j - lo,
+                      PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shp);
+  }
+  *out_names = names;
+  *out_shapes = shapes;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ref: c_predict_api.h:84 MXPredCreate. dev_type/dev_id are accepted for
+// signature parity; device placement is XLA's (single default device).
+int MXTPredCreate(const char* symbol_json_str, const void* param_bytes,
+                  int param_size, int dev_type, int dev_id,
+                  uint32_t num_input_nodes, const char** input_keys,
+                  const uint32_t* input_shape_indptr,
+                  const uint32_t* input_shape_data, void** out) {
+  (void)dev_type;
+  (void)dev_id;
+  MXT_API_BEGIN()
+  EnsurePython();
+  Gil gil;
+  PyObject* mod = PredictorModule();
+  if (mod == nullptr) return PyFail("import mxnet_tpu.predictor failed");
+  PyObject *names = nullptr, *shapes = nullptr;
+  if (!BuildShapeArgs(num_input_nodes, input_keys, input_shape_indptr,
+                      input_shape_data, &names, &shapes)) {
+    Py_DECREF(mod);
+    return FailWith("out of memory building inputs");
+  }
+  PyObject* pb;
+  if (param_bytes != nullptr && param_size > 0) {
+    pb = PyBytes_FromStringAndSize(static_cast<const char*>(param_bytes),
+                                   param_size);
+  } else {
+    pb = Py_None;
+    Py_INCREF(pb);
+  }
+  PyObject* pred = PyObject_CallMethod(mod, "_c_create", "sOOO",
+                                       symbol_json_str, pb, names, shapes);
+  Py_DECREF(pb);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  Py_DECREF(mod);
+  if (pred == nullptr) return PyFail("MXTPredCreate failed");
+  auto* st = new PredState();
+  st->predictor = pred;
+  *out = st;
+  MXT_API_END()
+}
+
+// ref: c_predict_api.h:254 MXPredSetInput — float32 data, `size` elements.
+int MXTPredSetInput(void* handle, const char* key, const float* data,
+                    uint32_t size) {
+  MXT_API_BEGIN()
+  EnsurePython();
+  Gil gil;
+  auto* st = static_cast<PredState*>(handle);
+  PyObject* mod = PredictorModule();
+  if (mod == nullptr) return PyFail("import mxnet_tpu.predictor failed");
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(data)),
+      static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
+  PyObject* r = PyObject_CallMethod(mod, "_c_set_input", "OsO",
+                                    st->predictor, key, mv);
+  Py_XDECREF(mv);
+  Py_DECREF(mod);
+  if (r == nullptr) return PyFail("MXTPredSetInput failed");
+  Py_DECREF(r);
+  MXT_API_END()
+}
+
+// ref: c_predict_api.h:263 MXPredForward.
+int MXTPredForward(void* handle) {
+  MXT_API_BEGIN()
+  EnsurePython();
+  Gil gil;
+  auto* st = static_cast<PredState*>(handle);
+  PyObject* r = PyObject_CallMethod(st->predictor, "forward", nullptr);
+  if (r == nullptr) return PyFail("MXTPredForward failed");
+  Py_DECREF(r);
+  MXT_API_END()
+}
+
+// ref: c_predict_api.h:229 MXPredGetOutputShape. *shape_data points into
+// handle-owned storage, valid until the next call on this handle.
+int MXTPredGetOutputShape(void* handle, uint32_t index, uint32_t** shape_data,
+                          uint32_t* shape_ndim) {
+  MXT_API_BEGIN()
+  EnsurePython();
+  Gil gil;
+  auto* st = static_cast<PredState*>(handle);
+  PyObject* r = PyObject_CallMethod(st->predictor, "get_output_shape", "I",
+                                    index);
+  if (r == nullptr) return PyFail("MXTPredGetOutputShape failed");
+  Py_ssize_t n = PySequence_Size(r);
+  st->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(r, i);
+    st->shape_buf[i] = static_cast<uint32_t>(PyLong_AsUnsignedLong(it));
+    Py_XDECREF(it);
+  }
+  Py_DECREF(r);
+  *shape_data = st->shape_buf.data();
+  *shape_ndim = static_cast<uint32_t>(n);
+  MXT_API_END()
+}
+
+// ref: c_predict_api.h:289 MXPredGetOutput — copies `size` float32
+// elements into caller memory.
+int MXTPredGetOutput(void* handle, uint32_t index, float* data,
+                     uint32_t size) {
+  MXT_API_BEGIN()
+  EnsurePython();
+  Gil gil;
+  auto* st = static_cast<PredState*>(handle);
+  PyObject* mod = PredictorModule();
+  if (mod == nullptr) return PyFail("import mxnet_tpu.predictor failed");
+  PyObject* r = PyObject_CallMethod(mod, "_c_get_output", "OI",
+                                    st->predictor, index);
+  Py_DECREF(mod);
+  if (r == nullptr) return PyFail("MXTPredGetOutput failed");
+  char* buf = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &nbytes) != 0) {
+    Py_DECREF(r);
+    return PyFail("MXTPredGetOutput: bad buffer");
+  }
+  if (static_cast<uint64_t>(nbytes) != static_cast<uint64_t>(size) * 4) {
+    Py_DECREF(r);
+    return FailWith("MXTPredGetOutput: size mismatch (have " +
+                    std::to_string(nbytes / 4) + " elements, caller asked " +
+                    std::to_string(size) + ")");
+  }
+  std::memcpy(data, buf, nbytes);
+  Py_DECREF(r);
+  MXT_API_END()
+}
+
+// ref: c_predict_api.h:214 MXPredReshape — new handle at new input shapes,
+// sharing the parameters with the original handle.
+int MXTPredReshape(uint32_t num_input_nodes, const char** input_keys,
+                   const uint32_t* input_shape_indptr,
+                   const uint32_t* input_shape_data, void* handle,
+                   void** out) {
+  MXT_API_BEGIN()
+  EnsurePython();
+  Gil gil;
+  auto* st = static_cast<PredState*>(handle);
+  PyObject* mod = PredictorModule();
+  if (mod == nullptr) return PyFail("import mxnet_tpu.predictor failed");
+  PyObject *names = nullptr, *shapes = nullptr;
+  if (!BuildShapeArgs(num_input_nodes, input_keys, input_shape_indptr,
+                      input_shape_data, &names, &shapes)) {
+    Py_DECREF(mod);
+    return FailWith("out of memory building inputs");
+  }
+  PyObject* pred = PyObject_CallMethod(mod, "_c_reshape", "OOO",
+                                       st->predictor, names, shapes);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  Py_DECREF(mod);
+  if (pred == nullptr) return PyFail("MXTPredReshape failed");
+  auto* st2 = new PredState();
+  st2->predictor = pred;
+  *out = st2;
+  MXT_API_END()
+}
+
+// ref: c_predict_api.h MXPredFree.
+int MXTPredFree(void* handle) {
+  MXT_API_BEGIN()
+  auto* st = static_cast<PredState*>(handle);
+  if (st != nullptr && st->predictor != nullptr && Py_IsInitialized()) {
+    Gil gil;
+    Py_DECREF(st->predictor);
+  }
+  delete st;
+  MXT_API_END()
+}
+
+}  // extern "C"

@@ -252,3 +252,39 @@ def test_recordio_split_record_rejoin(tmp_path):
             f.write(b"\x00" * ((4 - len(part) % 4) % 4))
     r = recordio.MXRecordIO(path, "r")
     assert r.read() == payload
+
+
+def test_gluon_dataloader_multiprocess_shm():
+    """Fork-pool workers returning batches via POSIX shared memory
+    (ref: gluon/data/dataloader.py worker pool + cpu_shared storage)."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.arange(96).reshape(24, 4).astype("float32")
+    Y = (np.arange(24) % 3).astype("float32")
+    ds = ArrayDataset(X, Y)
+    loader = DataLoader(ds, batch_size=6, num_workers=2, thread_pool=False)
+    for _ in range(2):  # two epochs: the worker pool is reused
+        xs, ys = [], []
+        for bx, by in loader:
+            xs.append(bx.asnumpy())
+            ys.append(by.asnumpy())
+        np.testing.assert_allclose(np.concatenate(xs), X)
+        np.testing.assert_allclose(np.concatenate(ys), Y)
+
+
+def test_gluon_dataloader_shm_no_leak_on_abandon():
+    """Abandoning iteration mid-epoch must not leak /dev/shm segments."""
+    import glob
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.arange(400).reshape(100, 4).astype("float32")
+    loader = DataLoader(ArrayDataset(X), batch_size=5, num_workers=2,
+                        thread_pool=False, prefetch=8)
+    before = set(glob.glob("/dev/shm/psm_*"))
+    it = iter(loader)
+    next(it)
+    next(it)
+    it.close()
+    loader._shutdown_pool()
+    import time
+    time.sleep(0.5)
+    leaked = set(glob.glob("/dev/shm/psm_*")) - before
+    assert not leaked, leaked

@@ -233,3 +233,106 @@ def test_python_writer_rejects_oversize(tmp_path):
     finally:
         del os.environ["MXNET_TPU_NO_NATIVE"]
         _native._LIB, _native._TRIED = None, False
+
+
+def test_c_predict_abi(tmp_path):
+    """Drive the native MXTPred* ABI end to end through ctypes, the way an
+    embedding C application would (ref: include/mxnet/c_predict_api.h
+    workflow: Create -> SetInput -> Forward -> GetOutputShape/GetOutput ->
+    Reshape -> Free)."""
+    import ctypes
+    import numpy as np
+    import mxnet_tpu as mx
+
+    lib = _native.get_lib()
+    if lib is None or not hasattr(lib, "MXTPredCreate"):
+        pytest.skip("native predict ABI not built")
+
+    # a small trained-ish graph: y = softmax(W2 relu(W1 x))
+    x = mx.sym.var("data")
+    h = mx.sym.FullyConnected(x, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=3,
+                                                     name="fc2"),
+                               name="softmax")
+    rs = np.random.RandomState(0)
+    args = {"fc1_weight": mx.nd.array(rs.rand(8, 4).astype("float32")),
+            "fc1_bias": mx.nd.zeros((8,)),
+            "fc2_weight": mx.nd.array(rs.rand(3, 8).astype("float32")),
+            "fc2_bias": mx.nd.zeros((3,))}
+    pfile = str(tmp_path / "net.params")
+    mx.nd.save(pfile, {"arg:%s" % k: v for k, v in args.items()})
+    with open(pfile, "rb") as f:
+        param_blob = f.read()
+
+    sym_json = out.tojson().encode()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shape_data = (ctypes.c_uint32 * 2)(5, 4)
+    handle = ctypes.c_void_p()
+    rc = lib.MXTPredCreate(sym_json, param_blob, len(param_blob), 1, 0,
+                           1, keys, indptr, shape_data,
+                           ctypes.byref(handle))
+    assert rc == 0, lib.MXTGetLastError().decode()
+
+    xin = rs.rand(5, 4).astype("float32")
+    rc = lib.MXTPredSetInput(handle, b"data",
+                             xin.ctypes.data_as(
+                                 ctypes.POINTER(ctypes.c_float)), xin.size)
+    assert rc == 0, lib.MXTGetLastError().decode()
+    assert lib.MXTPredForward(handle) == 0
+
+    sdata = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    rc = lib.MXTPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                   ctypes.byref(ndim))
+    assert rc == 0, lib.MXTGetLastError().decode()
+    shape = tuple(sdata[i] for i in range(ndim.value))
+    assert shape == (5, 3)
+
+    got = np.zeros(15, "float32")
+    rc = lib.MXTPredGetOutput(handle, 0,
+                              got.ctypes.data_as(
+                                  ctypes.POINTER(ctypes.c_float)), got.size)
+    assert rc == 0, lib.MXTGetLastError().decode()
+    got = got.reshape(5, 3)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-4)
+
+    # reference numerics via the Python predictor
+    from mxnet_tpu.predictor import Predictor
+    pref = Predictor(out.tojson(), input_shapes={"data": (5, 4)},
+                     arg_params=args)
+    pref.set_input("data", xin)
+    pref.forward()
+    np.testing.assert_allclose(got, pref.get_output(0), rtol=1e-4)
+
+    # wrong size errors through the error ring, not a crash
+    bad = np.zeros(7, "float32")
+    rc = lib.MXTPredGetOutput(handle, 0,
+                              bad.ctypes.data_as(
+                                  ctypes.POINTER(ctypes.c_float)), bad.size)
+    assert rc == -1
+    assert b"size mismatch" in lib.MXTGetLastError()
+
+    # reshape: new handle at batch 2, same params
+    indptr2 = (ctypes.c_uint32 * 2)(0, 2)
+    shape2 = (ctypes.c_uint32 * 2)(2, 4)
+    h2 = ctypes.c_void_p()
+    rc = lib.MXTPredReshape(1, keys, indptr2, shape2, handle,
+                            ctypes.byref(h2))
+    assert rc == 0, lib.MXTGetLastError().decode()
+    x2 = xin[:2]
+    assert lib.MXTPredSetInput(h2, b"data",
+                               x2.ctypes.data_as(
+                                   ctypes.POINTER(ctypes.c_float)),
+                               x2.size) == 0
+    assert lib.MXTPredForward(h2) == 0
+    got2 = np.zeros(6, "float32")
+    assert lib.MXTPredGetOutput(h2, 0,
+                                got2.ctypes.data_as(
+                                    ctypes.POINTER(ctypes.c_float)),
+                                got2.size) == 0
+    np.testing.assert_allclose(got2.reshape(2, 3), got[:2], rtol=1e-4)
+
+    assert lib.MXTPredFree(h2) == 0
+    assert lib.MXTPredFree(handle) == 0

@@ -1,0 +1,177 @@
+"""Symbol-level control flow (sym.contrib.foreach/while_loop/cond).
+
+Mirrors the reference's tests/python/unittest/test_contrib_control_flow.py
+coverage for the symbolic API (ref: src/operator/control_flow.cc:1089
+_foreach, :1150 _while_loop, :1211 _cond), lowered here to
+lax.scan/while_loop/cond inside the bound XLA program.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def test_foreach_cumsum():
+    data = sym.var('data')
+    state = sym.var('state')
+    outs, states = sym.contrib.foreach(
+        lambda d, s: (d + s[0], [d + s[0]]), data, [state])
+    exe = outs.bind(args={
+        'data': mx.nd.array(np.arange(6, dtype='float32').reshape(3, 2)),
+        'state': mx.nd.zeros((2,))})
+    r = exe.forward()[0].asnumpy()
+    exp = np.cumsum(np.arange(6).reshape(3, 2), axis=0)
+    np.testing.assert_allclose(r, exp)
+    # final state == last row of the cumsum
+    exe2 = states[0].bind(args={
+        'data': mx.nd.array(np.arange(6, dtype='float32').reshape(3, 2)),
+        'state': mx.nd.zeros((2,))})
+    np.testing.assert_allclose(exe2.forward()[0].asnumpy(), exp[-1])
+
+
+def test_foreach_closure_and_multiseq():
+    d1, d2, w = sym.var('d1'), sym.var('d2'), sym.var('w')
+    outs, _ = sym.contrib.foreach(
+        lambda d, s: (d[0] * w + d[1], []), [d1, d2], [])
+    exe = outs.bind(args={'d1': mx.nd.ones((4, 3)),
+                          'd2': mx.nd.full((4, 3), 2.0),
+                          'w': mx.nd.full((3,), 10.0)})
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), 12.0)
+
+
+def test_foreach_mismatched_lengths_raises():
+    d1, d2 = sym.var('d1'), sym.var('d2')
+    outs, _ = sym.contrib.foreach(lambda d, s: (d[0] + d[1], []),
+                                  [d1, d2], [])
+    exe = outs.bind(args={'d1': mx.nd.ones((4, 2)),
+                          'd2': mx.nd.ones((3, 2))})
+    with pytest.raises(Exception):
+        exe.forward()[0].asnumpy()
+
+
+def test_foreach_grad():
+    data = sym.var('data')
+    state = sym.var('state')
+    outs, _ = sym.contrib.foreach(
+        lambda d, s: (d + s[0], [d + s[0]]), data, [state])
+    exe = outs.bind(
+        args={'data': mx.nd.array(
+            np.arange(6, dtype='float32').reshape(3, 2)),
+            'state': mx.nd.zeros((2,))},
+        args_grad={'data': mx.nd.zeros((3, 2)),
+                   'state': mx.nd.zeros((2,))})
+    exe.forward(is_train=True)
+    exe.backward()
+    # d(sum over stacked cumsum)/d data[t] = T - t
+    np.testing.assert_allclose(exe.grad_dict['data'].asnumpy()[:, 0],
+                               [3., 2., 1.])
+    np.testing.assert_allclose(exe.grad_dict['state'].asnumpy(), [3., 3.])
+
+
+def test_while_loop_sum():
+    i, s = sym.var('i'), sym.var('s')
+    outs, final_vars = sym.contrib.while_loop(
+        cond=lambda i, s: i <= 5.0,
+        func=lambda i, s: ([i], [i + 1.0, s + i]),
+        loop_vars=[i, s], max_iterations=10)
+    args = {'i': mx.nd.array([1.0]), 's': mx.nd.array([0.0])}
+    r = outs[0].bind(args=dict(args)).forward()[0].asnumpy()
+    assert r.shape == (10, 1)  # padded to max_iterations
+    np.testing.assert_allclose(r[:5, 0], [1, 2, 3, 4, 5])
+    np.testing.assert_allclose(r[5:], 0.0)
+    fs = final_vars[1].bind(args=dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(fs, [15.0])
+
+
+def test_while_loop_never_true():
+    i = sym.var('i')
+    outs, final_vars = sym.contrib.while_loop(
+        cond=lambda i: i < 0.0,
+        func=lambda i: ([i * 2.0], [i + 1.0]),
+        loop_vars=[i], max_iterations=4)
+    r = outs[0].bind(args={'i': mx.nd.array([3.0])}).forward()[0].asnumpy()
+    np.testing.assert_allclose(r, 0.0)  # zero-filled, zero steps ran
+    fv = final_vars[0].bind(
+        args={'i': mx.nd.array([3.0])}).forward()[0].asnumpy()
+    np.testing.assert_allclose(fv, [3.0])
+
+
+def test_cond_branches():
+    a, b = sym.var('a'), sym.var('b')
+    pred = (a * b).sum() < 5.0
+    out = sym.contrib.cond(pred,
+                           lambda: (a + 5.0) * (b + 5.0),
+                           lambda: (a - 5.0) * (b - 5.0))
+    v = out.bind(args={'a': mx.nd.array([1.0]),
+                       'b': mx.nd.array([2.0])}).forward()[0].asnumpy()
+    np.testing.assert_allclose(v, [42.0])
+    v2 = out.bind(args={'a': mx.nd.array([3.0]),
+                        'b': mx.nd.array([4.0])}).forward()[0].asnumpy()
+    np.testing.assert_allclose(v2, [(3.0 - 5.0) * (4.0 - 5.0)])
+
+
+def test_control_flow_json_roundtrip():
+    data = sym.var('data')
+    state = sym.var('state')
+    outs, _ = sym.contrib.foreach(
+        lambda d, s: (d + s[0], [d + s[0]]), data, [state])
+    back = sym.load_json(outs.tojson())
+    assert back.list_arguments() == outs.list_arguments()
+    x = np.arange(6, dtype='float32').reshape(3, 2)
+    r = back.bind(args={'data': mx.nd.array(x),
+                        'state': mx.nd.zeros((2,))}).forward()[0].asnumpy()
+    np.testing.assert_allclose(r, np.cumsum(x, axis=0))
+
+
+def test_control_flow_infer_shape():
+    data = sym.var('data')
+    state = sym.var('state')
+    outs, states = sym.contrib.foreach(
+        lambda d, s: (d + s[0], [d + s[0]]), data, [state])
+    _, out_shapes, _ = outs.infer_shape(data=(3, 2), state=(2,))
+    assert out_shapes == [(3, 2)]
+    i = sym.var('i')
+    w_outs, w_vars = sym.contrib.while_loop(
+        cond=lambda i: i < 3.0, func=lambda i: ([i], [i + 1.0]),
+        loop_vars=[i], max_iterations=7)
+    _, osh, _ = w_outs[0].infer_shape(i=(1,))
+    assert osh == [(7, 1)]
+
+
+def test_foreach_in_module_fit():
+    """An RNN-ish scan inside a Module-bound graph trains end to end."""
+    from mxnet_tpu.module import Module
+    import mxnet_tpu.io as mio
+    T, B, H = 4, 8, 5
+    data = sym.var('data')      # (T, B, H) after transpose below
+    w = sym.var('scan_w')
+    h0 = sym.zeros((B, H))
+    outs, states = sym.contrib.foreach(
+        lambda d, s: (d, [s[0] + mx.sym.FullyConnected(
+            d, weight=w, num_hidden=H, no_bias=True, name='fc_scan')]),
+        mx.sym.transpose(data, axes=(1, 0, 2)), [h0])
+    head = mx.sym.FullyConnected(states[0], num_hidden=2, name='out_fc')
+    loss = mx.sym.SoftmaxOutput(head, name='softmax')
+    rs = np.random.RandomState(0)
+    X = rs.rand(32, T, H).astype('float32')
+    Y = (X.sum(axis=(1, 2)) > X.sum() / 32).astype('float32')
+    it = mio.NDArrayIter(X, Y, batch_size=B, label_name='softmax_label')
+    mod = Module(loss, data_names=['data'],
+                 label_names=['softmax_label'])
+    mod.bind(data_shapes=[('data', (B, T, H))],
+             label_shapes=[('softmax_label', (B,))])
+    mod.init_params(mx.initializer.Xavier())
+    # scan_w is a free variable INSIDE the loop body: its shape is
+    # hint-inferred through the subgraph and it binds like any argument
+    assert 'scan_w' in loss.list_arguments()
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1})
+    for _ in range(2):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (B, 2) and np.isfinite(out).all()

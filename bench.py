@@ -8,6 +8,10 @@ as ONE fused XLA program via ShardedTrainStep on whatever chip is attached.
 Prints one JSON line:
   {"metric": "resnet50_train_imgs_per_sec_per_chip", "value": N,
    "unit": "images/sec", "vs_baseline": N / 298.51}
+
+BENCH_MODEL=transformer switches to the decoder-LM training step (267M
+params, seq 2048, bf16, flash attention + per-layer remat) and reports
+tokens/sec — the modern capability headline the 2019 reference lacks.
 """
 import json
 import os
@@ -16,6 +20,55 @@ import time
 import numpy as np
 
 BASELINE_IMGS_PER_SEC = 298.51  # ref V100 fp32 training, batch 32 (perf.md)
+
+
+def main_transformer():
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.parallel import create_mesh
+    from mxnet_tpu.parallel import transformer as T
+
+    platform = jax.devices()[0].platform
+    big = platform != "cpu"
+    B = int(os.environ.get("BENCH_BATCH", 8 if big else 2))
+    S = int(os.environ.get("BENCH_SEQ", 2048 if big else 128))
+    cfg = T.TransformerConfig(
+        vocab_size=32000 if big else 256,
+        dim=1024 if big else 64, n_layers=12 if big else 2,
+        n_heads=16 if big else 4, ffn_hidden=4096 if big else 128,
+        max_seq_len=S, dtype="bfloat16" if big else "float32",
+        attn_mode="local")
+    mesh = create_mesh(devices=jax.devices()[:1], dp=1)
+    init_fn, step_fn = T.make_train_step(cfg, mesh)
+    rs = np.random.RandomState(0)
+    with mesh.mesh:
+        state = init_fn(jr.PRNGKey(0))
+        toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+        tgts = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+        state, loss = step_fn(state, toks, tgts)
+        float(loss)  # compile + warm
+        iters = int(os.environ.get("BENCH_ITERS", 10 if big else 2))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = step_fn(state, toks, tgts)
+        loss = float(loss)
+        dt = (time.perf_counter() - t0) / iters
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(state[0]))
+    tok_per_s = B * S / dt
+    print(json.dumps({
+        "metric": "transformer_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # the 2019 reference has no transformer
+        "platform": platform,
+        "params_m": round(n_params / 1e6, 1),
+        "batch": B, "seq": S,
+        "model_tflops_per_sec": round(6 * n_params * B * S / dt / 1e12, 1),
+        "final_loss": round(loss, 4),
+    }))
 
 
 def main():
@@ -76,4 +129,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+        main_transformer()
+    else:
+        main()

@@ -82,6 +82,7 @@ _LAZY = {
     "attribute": ".attribute",
     "kvstore_server": ".kvstore_server",
     "tensor_inspector": ".tensor_inspector",
+    "operator": ".operator",
 }
 
 

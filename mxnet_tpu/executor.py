@@ -78,10 +78,15 @@ class _GraphProgram:
             if node.op in _CONTROL_FLOW_OPS:
                 from .symbol.control_flow import lower as _cf_lower
                 ins = [vals[(id(src), oi)] for src, oi in node.inputs]
-                outs = _cf_lower(node, ins, is_train,
-                                 jax.random.fold_in(key, idx))
+                outs, cf_aux = _cf_lower(node, ins, is_train,
+                                         jax.random.fold_in(key, idx))
                 for i, o in enumerate(outs):
                     vals[(id(node), i)] = o
+                # subgraph BatchNorm moving-stat writes: cut variables keep
+                # their outer names, so these merge like direct aux writes
+                for name, val in cf_aux.items():
+                    if name in values:
+                        aux_updates[name] = val
                 continue
             opdef = _registry.get_op(node.op)
             pnames, has_var_kw = _fn_params(opdef)

@@ -125,10 +125,11 @@ class DataLoader:
         finally:
             # abandoned/broken iteration: reap in-flight batches and unlink
             # their shared-memory segments, otherwise they outlive the
-            # process (workers hand tracker ownership to us)
+            # process (workers hand tracker ownership to us). Short per-item
+            # timeout: a dead pool must not freeze generator close.
             for r in pending[consumed:]:
                 try:
-                    _free_shm(r.get(timeout=60))
+                    _free_shm(r.get(timeout=5))
                 except Exception:
                     pass
 
@@ -155,8 +156,14 @@ class DataLoader:
             import atexit
             import weakref
             ref = weakref.ref(self)
-            atexit.register(lambda: ref() is not None
-                            and ref()._shutdown_pool())
+
+            def _atexit_cb():
+                self_ = ref()
+                if self_ is not None:
+                    self_._shutdown_pool()
+
+            self._atexit_cb = _atexit_cb
+            atexit.register(_atexit_cb)
         return self._pool
 
     def _shutdown_pool(self):
@@ -164,6 +171,14 @@ class DataLoader:
         if pool is not None:
             self._pool = None
             _WORKER_STATES.pop(getattr(self, "_pool_key", None), None)
+            cb = getattr(self, "_atexit_cb", None)
+            if cb is not None:
+                self._atexit_cb = None
+                import atexit
+                try:
+                    atexit.unregister(cb)
+                except Exception:
+                    pass
             try:
                 pool.terminate()
                 pool.join()

@@ -264,3 +264,15 @@ from . import sparse   # noqa: E402,F401
 cast_storage = sparse.cast_storage
 sparse_retain = sparse.retain
 from . import contrib  # noqa: E402,F401
+
+# fused optimizer update ops with the reference's in-place calling
+# convention (mom/mean/var states mutated, out= delivery) — these override
+# any generated wrappers of the same name
+from .optimizer_ops import *  # noqa: E402,F401,F403
+
+
+def Custom(*args, **kwargs):
+    """Run a registered Python custom op
+    (ref: python/mxnet/operator.py register + nd.Custom)."""
+    from ..operator import invoke as _custom_invoke
+    return _custom_invoke(*args, **kwargs)

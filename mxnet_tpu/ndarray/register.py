@@ -132,10 +132,27 @@ def invoke_getitem(arr, key):
 
 def make_op_func(opdef, name):
     def op_func(*args, **kwargs):
-        # accept and drop common reference-only kwargs
-        kwargs.pop("out", None)
+        out = kwargs.pop("out", None)
         kwargs.pop("name", None)
-        return invoke(opdef, args, kwargs)
+        res = invoke(opdef, args, kwargs)
+        if out is None:
+            return res
+        # in-place result delivery (ref: generated wrappers' `out=` —
+        # _imperative_invoke writes into the provided NDArray)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        ress = res if isinstance(res, (tuple, list)) else (res,)
+        if len(outs) != len(ress):
+            raise ValueError(
+                "%s: out= has %d arrays but the op produces %d outputs"
+                % (name, len(outs), len(ress)))
+        for o, r in zip(outs, ress):
+            if tuple(o.shape) != tuple(r.shape):
+                raise ValueError(
+                    "%s: out= array has shape %s but the result has "
+                    "shape %s" % (name, tuple(o.shape), tuple(r.shape)))
+            o._data = r._data.astype(o._data.dtype) \
+                if r._data.dtype != o._data.dtype else r._data
+        return out
     op_func.__name__ = name
     op_func.__doc__ = opdef.fn.__doc__
     return op_func

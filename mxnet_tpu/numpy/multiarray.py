@@ -514,10 +514,12 @@ def _make_fn(jfn, name):
 
 
 def _populate(ns):
+    # jnp.fix is deprecated (removal in jax 0.10); same semantics as trunc
+    renamed = {"fix": getattr(jnp, "trunc", None)}
     for name in _DELEGATED:
         if name in ns:
             continue
-        jfn = getattr(jnp, name, None)
+        jfn = renamed.get(name) or getattr(jnp, name, None)
         if jfn is None:
             continue
         ns[name] = _make_fn(jfn, name)

@@ -1,0 +1,246 @@
+"""Custom operators in Python — mx.operator.CustomOp / CustomOpProp.
+
+ref: python/mxnet/operator.py (CustomOp :378, CustomOpProp :512,
+register :636) over src/operator/custom/custom-inl.h:52 CustomOperator
+(the reference runs custom-op Python callbacks on a dedicated worker
+thread pool inside the engine).
+
+TPU-native redesign: the eager path runs the Python callbacks inline and
+records a tape node whose vjp calls ``backward()`` — same recording
+contract as every generated op. Inside a COMPILED graph (symbolic
+executor / hybridize), a Custom node lowers to ``jax.pure_callback``: XLA
+calls back onto the host for exactly this node, which is the TPU analog of
+the reference's engine-thread escape hatch (everything around it stays
+fused on device).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .context import current_context
+from .ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_CUSTOM_PROPS = {}
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under ``op_type``
+    (ref: python/mxnet/operator.py:636 register)."""
+    def do_register(prop_cls):
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_prop(op_type):
+    try:
+        return _CUSTOM_PROPS[op_type]
+    except KeyError:
+        raise KeyError("custom op %r is not registered; call "
+                       "mx.operator.register(%r) on a CustomOpProp "
+                       "subclass first" % (op_type, op_type))
+
+
+class CustomOp:
+    """Base class for user-defined operators
+    (ref: python/mxnet/operator.py:378)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """ref: operator.py CustomOp.assign."""
+        if req in ("null",):
+            return
+        if req in ("write", "inplace"):
+            dst._data = src._data.astype(dst._data.dtype) \
+                if isinstance(src, NDArray) else jnp.asarray(
+                    src, dst._data.dtype)
+        elif req == "add":
+            s = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+            dst._data = dst._data + s.astype(dst._data.dtype)
+        else:
+            raise ValueError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Operator properties: arguments/outputs/shapes/types + factory
+    (ref: python/mxnet/operator.py:512)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, in_stype):
+        return (in_stype, ["default"] * len(self.list_outputs()),
+                ["default"] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def _invoke_custom(op_type, inputs, kwargs):
+    """Eager execution of a custom op; returns list of output NDArrays and
+    enough context to register the tape node."""
+    prop_cls = get_prop(op_type)
+    prop = prop_cls(**kwargs)
+    in_data = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+               for a in inputs]
+    in_shapes = [list(a.shape) for a in in_data]
+    shapes = prop.infer_shape(in_shapes)
+    _, out_shapes, aux_shapes = shapes
+    in_types = [a.dtype for a in in_data]
+    _, out_types, aux_types = prop.infer_type(in_types)
+    op = prop.create_operator(current_context(), in_shapes, in_types)
+    out_data = [NDArray(jnp.zeros(tuple(s), dt))
+                for s, dt in zip(out_shapes, out_types)]
+    aux = [NDArray(jnp.zeros(tuple(s), dt))
+           for s, dt in zip(aux_shapes, aux_types)]
+    op.forward(is_train=autograd.is_training() or autograd.is_recording(),
+               req=["write"] * len(out_data), in_data=in_data,
+               out_data=out_data, aux=aux)
+
+    if autograd.is_recording():
+        n_in = len(in_data)
+
+        def vjp_fn(cts):
+            cts = cts if isinstance(cts, tuple) else (cts,)
+            out_grad = [NDArray(jnp.asarray(c)) for c in cts]
+            in_grad = [NDArray(jnp.zeros(a.shape, a.dtype))
+                       for a in in_data]
+            op.backward(req=["write"] * n_in, out_grad=out_grad,
+                        in_data=in_data, out_data=out_data,
+                        in_grad=in_grad, aux=aux)
+            return tuple(g._data for g in in_grad)
+
+        autograd.record_op("Custom:%s" % op_type, out_data, in_data,
+                           vjp_fn)
+    return out_data
+
+
+def invoke(*inputs, op_type, **kwargs):
+    """nd-level entry (``mx.nd.Custom``), ref: operator.py:
+    ndarray custom invoke via MXCustomOp registry."""
+    outs = _invoke_custom(op_type, list(inputs), kwargs)
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# ---------------------------------------------------------------------------
+# compiled-graph lowering: Custom as a host callback island inside XLA
+# ---------------------------------------------------------------------------
+
+def _register_custom_graph_op():
+    from .ops.registry import register as _reg_op
+
+    @_reg_op("Custom")
+    def Custom(*inputs, op_type=None, **kwargs):
+        """Host-callback custom op inside a compiled graph
+        (ref: src/operator/custom/custom-inl.h CustomOperator — the
+        engine-thread version of the same escape hatch)."""
+        if op_type is None:
+            raise ValueError("Custom requires op_type")
+        kwargs.pop("_training", None)
+        prop = get_prop(op_type)(**kwargs)
+        in_shapes = [list(x.shape) for x in inputs]
+        _, out_shapes, _ = prop.infer_shape(in_shapes)
+        in_types = [x.dtype for x in inputs]
+        _, out_types, _ = prop.infer_type(in_types)
+        results = tuple(jax.ShapeDtypeStruct(tuple(s), dt)
+                        for s, dt in zip(out_shapes, out_types))
+
+        def host_fwd(*arrays):
+            prev = autograd.set_recording(False)
+            try:
+                outs = _invoke_custom(
+                    op_type, [NDArray(jnp.asarray(_np.asarray(a)))
+                              for a in arrays], kwargs)
+            finally:
+                autograd.set_recording(prev)
+            return tuple(_np.asarray(o.asnumpy()) for o in outs)
+
+        @jax.custom_vjp
+        def core(*ins):
+            out = jax.pure_callback(host_fwd, results, *ins)
+            return out if len(results) > 1 else (out
+                                                if isinstance(out, tuple)
+                                                else (out,))
+
+        def core_fwd(*ins):
+            out = core(*ins)
+            return out, ins
+
+        def core_bwd(ins, cts):
+            grad_results = tuple(
+                jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                for x in ins)
+
+            def host_bwd(*arrays):
+                n = len(ins)
+                in_arrays = arrays[:n]
+                ct_arrays = arrays[n:]
+                prop2 = get_prop(op_type)(**kwargs)
+                in_nd = [NDArray(jnp.asarray(_np.asarray(a)))
+                         for a in in_arrays]
+                ishapes = [list(a.shape) for a in in_nd]
+                _, oshapes, ashapes = prop2.infer_shape(ishapes)
+                itypes = [a.dtype for a in in_nd]
+                _, otypes, atypes = prop2.infer_type(itypes)
+                op = prop2.create_operator(current_context(), ishapes,
+                                           itypes)
+                out_nd = [NDArray(jnp.zeros(tuple(s), dt))
+                          for s, dt in zip(oshapes, otypes)]
+                aux = [NDArray(jnp.zeros(tuple(s), dt))
+                       for s, dt in zip(ashapes, atypes)]
+                op.forward(is_train=True, req=["write"] * len(out_nd),
+                           in_data=in_nd, out_data=out_nd, aux=aux)
+                in_grad = [NDArray(jnp.zeros(a.shape, a.dtype))
+                           for a in in_nd]
+                op.backward(req=["write"] * len(in_nd),
+                            out_grad=[NDArray(jnp.asarray(_np.asarray(c)))
+                                      for c in ct_arrays],
+                            in_data=in_nd, out_data=out_nd,
+                            in_grad=in_grad, aux=aux)
+                return tuple(_np.asarray(g.asnumpy()) for g in in_grad)
+
+            cts = cts if isinstance(cts, tuple) else (cts,)
+            return jax.pure_callback(host_bwd, grad_results,
+                                     *(tuple(ins) + tuple(cts)))
+
+        core.defvjp(core_fwd, core_bwd)
+        out = core(*inputs)
+        return out if len(results) > 1 else out[0]
+
+
+_register_custom_graph_op()

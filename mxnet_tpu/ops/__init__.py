@@ -12,5 +12,6 @@ from . import linalg         # noqa: F401
 from . import nn             # noqa: F401
 from . import random_ops     # noqa: F401
 from . import ctc            # noqa: F401
+from . import extended       # noqa: F401  (after nn: aliases core ops)
 
 __all__ = ["register", "get_op", "list_ops", "OpDef"]

@@ -31,6 +31,7 @@ from .pipeline import pipeline_stages, PipelineStage
 from .expert import MoELayer, top_k_routing
 from .train import ShardedTrainStep, functional_call, extract_params, \
     attach_params
+from .elastic import CheckpointManager, elastic_train_loop, PreemptionGuard
 from . import transformer
 
 __all__ = [
@@ -46,5 +47,6 @@ __all__ = [
     "pipeline_stages", "PipelineStage",
     "MoELayer", "top_k_routing",
     "ShardedTrainStep", "functional_call", "extract_params", "attach_params",
+    "CheckpointManager", "elastic_train_loop", "PreemptionGuard",
     "transformer",
 ]

@@ -8,5 +8,17 @@ _populate(globals())
 
 from . import contrib  # noqa: E402  (after populate: contrib uses registry)
 
+
+def Custom(*args, **kwargs):
+    """Symbolic custom-op node; lowers to a jax.pure_callback island in
+    the compiled graph (ref: python/mxnet/operator.py sym.Custom)."""
+    from .. import operator as _op_mod  # registers the "Custom" graph op
+    from ..ops import registry as _r
+    from .register import make_symbol_op_func
+    assert _op_mod is not None
+    return make_symbol_op_func(_r.get_op("Custom"), "Custom")(
+        *args, **kwargs)
+
+
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
-           "zeros", "ones", "contrib"]
+           "zeros", "ones", "contrib", "Custom"]

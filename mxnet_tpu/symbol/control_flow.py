@@ -127,7 +127,11 @@ def _bind(mapping, node_ins, carry, slices):
 
 def lower(node, ins, is_train, key):
     """Lower one control-flow node to jax. ins: node input values in node
-    input order. Returns the node's output values as a list."""
+    input order. Returns (outputs list, aux_updates dict) — aux updates
+    (BatchNorm moving stats inside the subgraph) are threaded through the
+    loop carry and keyed by the OUTER variable name (subgraph cutting
+    preserves variable names), so the executor merges them like any other
+    aux write."""
     if node.op == "_foreach":
         return _lower_foreach(node, ins, is_train, key)
     if node.op == "_while_loop":
@@ -135,6 +139,30 @@ def lower(node, ins, is_train, key):
     if node.op == "_cond":
         return _lower_cond(node, ins, is_train, key)
     raise ValueError(node.op)
+
+
+def _probe_aux_keys(prog, values, is_train):
+    """Statically determine which aux vars the subgraph updates."""
+    if not is_train:
+        return []
+
+    def f(vals, k):
+        return prog.run(vals, True, k)[1]
+
+    try:
+        aux_shapes = jax.eval_shape(f, values, jax.random.PRNGKey(0))
+    except Exception:
+        return []
+    return sorted(aux_shapes)
+
+
+def _input_value(mappings, ins, name):
+    """The outer value feeding subgraph variable `name` (input-kind)."""
+    for mapping in mappings:
+        for vn, kind, idx in mapping:
+            if vn == name and kind == "input":
+                return ins[idx]
+    return None
 
 
 def _lower_foreach(node, ins, is_train, key):
@@ -147,15 +175,25 @@ def _lower_foreach(node, ins, is_train, key):
     states0 = tuple(ins[nd_:nd_ + ns_])
     length = data[0].shape[0]
 
-    def body(carry, xs):
-        slices, t = xs
-        values = _bind(mapping, ins, carry, slices)
-        outs, _ = prog.run(values, is_train, jax.random.fold_in(key, t))
-        return tuple(outs[nod:]), tuple(outs[:nod])
+    probe_vals = _bind(mapping, ins, states0, tuple(d[0] for d in data))
+    aux_keys = _probe_aux_keys(prog, probe_vals, is_train)
+    aux0 = tuple(_input_value([mapping], ins, k) for k in aux_keys)
 
-    final, stacked = lax.scan(body, states0,
-                              (data, jnp.arange(length, dtype=jnp.int32)))
-    return list(stacked) + list(final)
+    def body(carry, xs):
+        states, aux = carry
+        slices, t = xs
+        values = _bind(mapping, ins, states, slices)
+        values.update(zip(aux_keys, aux))   # current moving stats
+        outs, aux_up = prog.run(values, is_train,
+                                jax.random.fold_in(key, t))
+        new_aux = tuple(aux_up.get(k, v) for k, v in zip(aux_keys, aux))
+        return (tuple(outs[nod:]), new_aux), tuple(outs[:nod])
+
+    (final, aux_f), stacked = lax.scan(
+        body, (states0, aux0),
+        (data, jnp.arange(length, dtype=jnp.int32)))
+    return (list(stacked) + list(final),
+            dict(zip(aux_keys, aux_f)))
 
 
 def _lower_while(node, ins, is_train, key):
@@ -167,33 +205,40 @@ def _lower_while(node, ins, is_train, key):
     prog_cond, prog_body = _programs(node)
     loop0 = tuple(ins[:nvars])
 
-    def run_body(vars_, t):
-        values = _bind(map_body, ins, vars_, ())
-        outs, _ = prog_body.run(values, is_train, jax.random.fold_in(key, t))
-        return tuple(outs)
+    probe_vals = _bind(map_body, ins, loop0, ())
+    aux_keys = _probe_aux_keys(prog_body, probe_vals, is_train)
+    aux0 = tuple(_input_value([map_body], ins, k) for k in aux_keys)
 
-    out_shapes = jax.eval_shape(run_body, loop0, jnp.int32(0))[:nod]
+    def run_body(vars_, aux, t):
+        values = _bind(map_body, ins, vars_, ())
+        values.update(zip(aux_keys, aux))
+        outs, aux_up = prog_body.run(values, is_train,
+                                     jax.random.fold_in(key, t))
+        new_aux = tuple(aux_up.get(k, v) for k, v in zip(aux_keys, aux))
+        return tuple(outs), new_aux
+
+    out_shapes = jax.eval_shape(run_body, loop0, aux0, jnp.int32(0))[0][:nod]
     bufs0 = tuple(jnp.zeros((max_iter,) + s.shape, s.dtype)
                   for s in out_shapes)
 
     def cond_fn(st):
-        i, vars_, _ = st
+        i, vars_, _, _ = st
         values = _bind(map_cond, ins, vars_, ())
         outs, _ = prog_cond.run(values, is_train, key)
         p = jnp.reshape(outs[0].astype(bool), ())
         return jnp.logical_and(i < max_iter, p)
 
     def body_fn(st):
-        i, vars_, bufs = st
-        outs = run_body(vars_, i)
+        i, vars_, bufs, aux = st
+        outs, new_aux = run_body(vars_, aux, i)
         step_outs, new_vars = outs[:nod], outs[nod:]
         bufs = tuple(lax.dynamic_update_index_in_dim(
             b, o.astype(b.dtype), i, 0) for b, o in zip(bufs, step_outs))
-        return i + 1, tuple(new_vars), bufs
+        return i + 1, tuple(new_vars), bufs, new_aux
 
-    _, vars_, bufs = lax.while_loop(
-        cond_fn, body_fn, (jnp.int32(0), loop0, bufs0))
-    return list(bufs) + list(vars_)
+    _, vars_, bufs, aux_f = lax.while_loop(
+        cond_fn, body_fn, (jnp.int32(0), loop0, bufs0, aux0))
+    return list(bufs) + list(vars_), dict(zip(aux_keys, aux_f))
 
 
 def _lower_cond(node, ins, is_train, key):
@@ -201,20 +246,34 @@ def _lower_cond(node, ins, is_train, key):
     map_pred, map_then, map_else = a["__subg_inputs__"]
     prog_pred, prog_then, prog_else = _programs(node)
 
-    pred_outs, _ = prog_pred.run(_bind(map_pred, ins, (), ()), is_train, key)
+    pred_outs, pred_aux = prog_pred.run(_bind(map_pred, ins, (), ()),
+                                        is_train, key)
     pred = jnp.reshape(pred_outs[0].astype(bool), ())
+
+    aux_keys = sorted(set(
+        _probe_aux_keys(prog_then, _bind(map_then, ins, (), ()), is_train)
+        + _probe_aux_keys(prog_else, _bind(map_else, ins, (), ()),
+                          is_train)))
+    mappings = [map_pred, map_then, map_else]
 
     def mk(prog, mapping, salt):
         def branch(_):
             values = _bind(mapping, ins, (), ())
-            outs, _ = prog.run(values, is_train,
-                               jax.random.fold_in(key, salt))
-            return tuple(outs)
+            outs, aux_up = prog.run(values, is_train,
+                                    jax.random.fold_in(key, salt))
+            # untaken-branch aux stays at the incoming value
+            aux_vals = tuple(
+                aux_up.get(k, _input_value(mappings, ins, k))
+                for k in aux_keys)
+            return tuple(outs) + aux_vals
         return branch
 
-    outs = lax.cond(pred, mk(prog_then, map_then, 1),
-                    mk(prog_else, map_else, 2), jnp.int32(0))
-    return list(outs)
+    res = lax.cond(pred, mk(prog_then, map_then, 1),
+                   mk(prog_else, map_else, 2), jnp.int32(0))
+    n_out = len(res) - len(aux_keys)
+    aux = dict(pred_aux)
+    aux.update(zip(aux_keys, res[n_out:]))
+    return list(res[:n_out]), aux
 
 
 def next_marker():

@@ -188,7 +188,7 @@ def _abstract_eval(node, in_shapes):
 
         def cf(*xs):
             return tuple(_cf_lower(node, list(xs), False,
-                                   jax.random.PRNGKey(0)))
+                                   jax.random.PRNGKey(0))[0])
 
         out = jax.eval_shape(cf, *structs)
         return [tuple(o.shape) for o in out]

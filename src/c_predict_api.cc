@@ -15,6 +15,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -29,14 +30,19 @@ struct PredState {
   std::vector<uint32_t> shape_buf;        // storage for GetOutputShape
 };
 
-// Ensure an interpreter exists. In an embedded app we initialize it and
-// immediately release the GIL so that every entry point can use the
-// uniform PyGILState_Ensure/Release protocol.
+// Ensure an interpreter exists. In an embedded app we initialize it once
+// (std::call_once: concurrent first calls from multiple app threads must
+// not double-initialize) and immediately release the GIL so that every
+// entry point can use the uniform PyGILState_Ensure/Release protocol.
+std::once_flag py_init_flag;
+
 void EnsurePython() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    PyEval_SaveThread();
-  }
+  std::call_once(py_init_flag, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
 }
 
 class Gil {

@@ -1,0 +1,113 @@
+"""Custom Python operators (mx.operator.CustomOp / CustomOpProp).
+
+Modeled on the reference's canonical custom softmax example
+(ref: python/mxnet/operator.py docs + tests/python/unittest/
+test_operator.py test_custom_op, src/operator/custom/custom-inl.h)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+@mx.operator.register("scale2x")
+class Scale2xProp(mx.operator.CustomOpProp):
+    def __init__(self, factor=2.0):
+        super().__init__(need_top_grad=True)
+        self.factor = float(factor)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        factor = self.factor
+
+        class Scale(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * factor)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0] * factor)
+
+        return Scale()
+
+
+@mx.operator.register("mysoftmax")
+class MySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return ([in_shape[0], [in_shape[0][0]]], [in_shape[0]], [])
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class MySoftmax(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                y = np.exp(x - x.max(axis=1, keepdims=True))
+                y /= y.sum(axis=1, keepdims=True)
+                self.assign(out_data[0], req[0], nd.array(y))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                lbl = in_data[1].asnumpy().astype(int)
+                y = out_data[0].asnumpy().copy()
+                y[np.arange(lbl.shape[0]), lbl] -= 1.0
+                self.assign(in_grad[0], req[0], nd.array(y))
+                self.assign(in_grad[1], req[1], nd.zeros(lbl.shape))
+
+        return MySoftmax()
+
+
+def test_custom_eager_forward():
+    x = nd.array(np.array([[1.0, 2.0]], "float32"))
+    y = nd.Custom(x, op_type="scale2x")
+    np.testing.assert_allclose(y.asnumpy(), [[2.0, 4.0]])
+    z = nd.Custom(x, op_type="scale2x", factor=3.0)
+    np.testing.assert_allclose(z.asnumpy(), [[3.0, 6.0]])
+
+
+def test_custom_eager_backward():
+    x = nd.array(np.array([[1.0, 2.0]], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="scale2x") * 4.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[8.0, 8.0]])
+
+
+def test_custom_softmax_trains():
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(4, 3).astype("float32"))
+    lbl = nd.array(np.array([0, 1, 2, 1], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        p = nd.Custom(x, lbl, op_type="mysoftmax")
+    p.backward()
+    pn = p.asnumpy()
+    exp = pn.copy()
+    exp[np.arange(4), [0, 1, 2, 1]] -= 1
+    np.testing.assert_allclose(x.grad.asnumpy(), exp, rtol=1e-5)
+    np.testing.assert_allclose(pn.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_custom_in_compiled_symbol_graph():
+    """A Custom node inside a bound (jitted) graph runs as a
+    jax.pure_callback island with working gradients."""
+    data = mx.sym.var("data")
+    h = mx.sym.Custom(data, op_type="scale2x", name="c1")
+    out = h * h
+    exe = out.bind(args={"data": nd.array(np.array([1.0, 3.0], "float32"))},
+                   args_grad={"data": nd.zeros((2,))})
+    r = exe.forward(is_train=True)
+    np.testing.assert_allclose(r[0].asnumpy(), [4.0, 36.0])
+    exe.backward()
+    # d/dx (2x)^2 = 8x
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               [8.0, 24.0])

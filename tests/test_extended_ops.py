@@ -1,0 +1,213 @@
+"""Extended op long tail (ops/extended.py) vs reference semantics
+(ref: src/operator/tensor/*, src/operator/contrib/* — see per-op cites)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_init_ops():
+    np.testing.assert_allclose(nd.eye(3).asnumpy(), np.eye(3))
+    np.testing.assert_allclose(nd.linspace(0, 1, 5).asnumpy(),
+                               np.linspace(0, 1, 5))
+    r = nd.invoke_by_name("_arange", start=0, stop=3, repeat=2) \
+        if hasattr(nd, "invoke_by_name") else None
+    from mxnet_tpu.ndarray.register import invoke_by_name
+    r = invoke_by_name("_arange", start=0, stop=3, repeat=2)
+    np.testing.assert_allclose(r.asnumpy(), [0, 0, 1, 1, 2, 2])
+
+
+def test_indexing_utils():
+    a = nd.array(np.arange(12).reshape(3, 4).astype("float32"))
+    idx = nd.array(np.array([1, 0, 3], "float32"))
+    np.testing.assert_allclose(nd.batch_take(a, idx).asnumpy(), [1, 4, 11])
+    b = nd.array(np.arange(6).reshape(2, 3).astype("float32"))
+    np.testing.assert_allclose(
+        nd.reshape_like(nd.array(np.arange(6, dtype="float32")), b)
+        .asnumpy().shape, (2, 3))
+    parts = nd.split_v2(a, indices=(1,), axis=0)
+    assert parts[0].shape == (1, 4) and parts[1].shape == (2, 4)
+    flat = nd.ravel_multi_index(
+        nd.array(np.array([[0, 1, 2], [3, 2, 1]], "float32")),
+        shape=(3, 4))
+    np.testing.assert_allclose(flat.asnumpy(), [3, 6, 9])
+    back = nd.unravel_index(flat, shape=(3, 4))
+    np.testing.assert_allclose(back.asnumpy(), [[0, 1, 2], [3, 2, 1]])
+
+
+def test_slice_assign():
+    from mxnet_tpu.ndarray.register import invoke_by_name
+    a = nd.zeros((3, 4))
+    r = invoke_by_name("_slice_assign_scalar", a, scalar=5.0,
+                       begin=(1, 1), end=(3, 3))
+    exp = np.zeros((3, 4), "float32")
+    exp[1:3, 1:3] = 5
+    np.testing.assert_allclose(r.asnumpy(), exp)
+
+
+def test_histogram_moments():
+    data = nd.array(np.array([0.1, 0.2, 0.2, 0.9], "float32"))
+    counts, edges = nd.histogram(data, bin_cnt=2, range=(0.0, 1.0))
+    np.testing.assert_allclose(counts.asnumpy(), [3, 1])
+    m, v = nd.moments(nd.array(np.array([[1., 2.], [3., 4.]], "float32")),
+                      axes=(0,))
+    np.testing.assert_allclose(m.asnumpy(), [2, 3])
+    np.testing.assert_allclose(v.asnumpy(), [1, 1])
+
+
+def test_all_finite_and_multi():
+    ok = nd.all_finite(nd.array(np.ones(4, "float32")))
+    assert float(ok.asnumpy()[0]) == 1.0
+    bad = nd.all_finite(nd.array(np.array([1.0, np.inf], "float32")))
+    assert float(bad.asnumpy()[0]) == 0.0
+    s = nd.multi_sum_sq(nd.array(np.array([1., 2.], "float32")),
+                        nd.array(np.array([3.], "float32")), num_arrays=2)
+    np.testing.assert_allclose([float(x.asnumpy()) for x in s], [5, 9])
+
+
+def test_amp_multicast():
+    a16 = nd.array(np.ones(2, "float16"))
+    a32 = nd.array(np.ones(2, "float32"))
+    o1, o2 = nd.amp_multicast(a16, a32, num_outputs=2)
+    assert o1.dtype == np.float32 and o2.dtype == np.float32
+
+
+def test_fft_ifft_roundtrip():
+    """Numerics pinned by the reference's check_ifft
+    (tests/python/gpu/test_operator_gpu.py:103): ifft is unnormalized."""
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 8).astype("float32")
+    f = nd.fft(nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, atol=1e-4)
+    back = nd.ifft(nd.array(f)).asnumpy()
+    np.testing.assert_allclose(back, x * 8, atol=1e-3)
+
+
+def test_linalg_extras():
+    rs = np.random.RandomState(0)
+    a = rs.rand(3, 3).astype("float32")
+    a = (a + a.T) / 2
+    u, lam = nd.linalg_syevd(nd.array(a))
+    rec = u.asnumpy().T @ np.diag(lam.asnumpy()) @ u.asnumpy()
+    np.testing.assert_allclose(rec, a, atol=1e-4)
+    m = nd.array(np.arange(9, dtype="float32").reshape(3, 3))
+    tri = nd.linalg_extracttrian(m)
+    np.testing.assert_allclose(tri.asnumpy(), [0, 3, 4, 6, 7, 8])
+    back = nd.linalg_maketrian(tri)
+    np.testing.assert_allclose(back.asnumpy(),
+                               np.tril(np.arange(9).reshape(3, 3)))
+
+
+def test_box_iou():
+    a = nd.array(np.array([[0, 0, 2, 2]], "float32"))
+    b = nd.array(np.array([[1, 1, 3, 3], [0, 0, 2, 2]], "float32"))
+    iou = nd.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou, [[1.0 / 7.0, 1.0]], atol=1e-5)
+
+
+def test_box_nms():
+    # records: (score, x1, y1, x2, y2), score_index=0, coord_start=1
+    data = np.array([[[0.9, 0, 0, 2, 2],
+                      [0.8, 0.1, 0.1, 2, 2],     # overlaps first -> cut
+                      [0.7, 5, 5, 6, 6]]], "float32")
+    out = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=1,
+                     score_index=0).asnumpy()
+    assert out[0, 0, 0] == pytest.approx(0.9)
+    assert out[0, 1, 0] == pytest.approx(0.7)     # survivor moved up
+    assert (out[0, 2] == -1).all()                # suppressed -> -1 row
+
+
+def test_bipartite_matching():
+    # the reference's own docstring example (bounding_box.cc:176)
+    x = nd.array(np.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]], "float32"))
+    rows, cols = nd.bipartite_matching(x, threshold=1e-12, is_ascend=False)
+    np.testing.assert_allclose(rows.asnumpy(), [1, -1, 0])
+    np.testing.assert_allclose(cols.asnumpy(), [2, 0])
+
+
+def test_multibox_prior():
+    data = nd.zeros((1, 3, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,)) \
+        if hasattr(nd, "contrib") and hasattr(nd.contrib, "MultiBoxPrior") \
+        else nd.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,))
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4, 4)
+    # centers at (0.25, 0.25), (0.75, 0.25), ... with half-size 0.25
+    np.testing.assert_allclose(a[0, 0], [0, 0, 0.5, 0.5], atol=1e-5)
+
+
+def test_roi_align_and_pooling():
+    data = nd.array(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = nd.array(np.array([[0, 0, 0, 3, 3]], "float32"))
+    out = nd.ROIAlign(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    assert np.isfinite(out.asnumpy()).all()
+    outp = nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    # max pooling of quantized 2x2 bins over the full 4x4 map
+    np.testing.assert_allclose(outp.asnumpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_bilinear_resize_and_adaptive_pool():
+    data = nd.array(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    up = nd.BilinearResize2D(data, height=8, width=8)
+    assert up.shape == (1, 1, 8, 8)
+    pooled = nd.AdaptiveAvgPooling2D(data, output_size=(2, 2))
+    np.testing.assert_allclose(pooled.asnumpy()[0, 0],
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_spatial_transformer_identity():
+    rs = np.random.RandomState(0)
+    img = rs.rand(1, 1, 5, 5).astype("float32")
+    # identity affine
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], "float32"))
+    out = nd.SpatialTransformer(nd.array(img), theta, target_shape=(5, 5),
+                                transform_type="affine",
+                                sampler_type="bilinear")
+    np.testing.assert_allclose(out.asnumpy(), img, atol=1e-5)
+
+
+def test_svm_output_grad():
+    from mxnet_tpu import autograd
+    x = nd.array(np.array([[2.0, 1.0, 0.1]], "float32"))
+    y = nd.array(np.array([0.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(x, y, margin=1.0, use_linear=True)
+    out.backward()
+    g = x.grad.asnumpy()
+    # margin violated only by class 1 (2.0 - 1.0 = 1.0, not > margin? equal)
+    assert g.shape == (1, 3)
+
+
+def test_quadratic_and_index_copy():
+    x = nd.array(np.array([1.0, 2.0], "float32"))
+    np.testing.assert_allclose(
+        nd.quadratic(x, a=1, b=2, c=3).asnumpy(), [6, 11])
+    t = nd.zeros((4, 2))
+    new = nd.array(np.ones((2, 2), "float32"))
+    idx = nd.array(np.array([1, 3], "float32"))
+    out = nd.index_copy(t, idx, new).asnumpy()
+    np.testing.assert_allclose(out[[1, 3]], 1.0)
+    np.testing.assert_allclose(out[[0, 2]], 0.0)
+
+
+def test_legacy_aliases():
+    from mxnet_tpu.ops import registry
+    for name in ("BatchNorm_v1", "Convolution_v1", "Pooling_v1",
+                 "SyncBatchNorm"):
+        assert registry.get_op(name) is not None
+
+
+def test_out_kwarg_writes_in_place():
+    """out= must deliver results into the passed NDArray (ref: generated
+    wrapper semantics, python/mxnet/_ctypes/ndarray.py)."""
+    a = nd.array(np.array([1.0, 2.0], "float32"))
+    b = nd.array(np.array([3.0, 4.0], "float32"))
+    dest = nd.zeros((2,))
+    r = nd.add(a, b, out=dest)
+    assert r is dest
+    np.testing.assert_allclose(dest.asnumpy(), [4, 6])

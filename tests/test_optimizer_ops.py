@@ -1,0 +1,129 @@
+"""Fused optimizer update ops (ndarray/optimizer_ops.py) vs the
+reference's kernel formulas (ref: src/operator/optimizer_op-inl.h)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _w(v):
+    return nd.array(np.asarray(v, "float32"))
+
+
+def test_sgd_update():
+    w, g = _w([1.0, 2.0]), _w([0.5, -0.5])
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.01, rescale_grad=2.0, out=w)
+    # (1 - lr*wd)*w - lr*rescale*g
+    exp = (1 - 0.1 * 0.01) * np.array([1, 2.0]) - 0.1 * 2.0 * np.array(
+        [0.5, -0.5])
+    np.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-6)
+    assert out is w
+
+
+def test_sgd_mom_update_state_mutation():
+    w, g, m = _w([1.0]), _w([1.0]), _w([0.5])
+    nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9, out=w)
+    # mom = 0.9*0.5 - 0.1*1 = 0.35 ; w = 1 + 0.35
+    np.testing.assert_allclose(m.asnumpy(), [0.35], rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), [1.35], rtol=1e-6)
+
+
+def test_clip_gradient():
+    w, g = _w([0.0]), _w([10.0])
+    out = nd.sgd_update(w, g, lr=1.0, clip_gradient=1.0)
+    np.testing.assert_allclose(out.asnumpy(), [-1.0])
+
+
+def test_mp_sgd_update_master_weights():
+    w = nd.array(np.array([1.0], "float16"))
+    g = nd.array(np.array([1.0], "float16"))
+    w32 = _w([1.0])
+    out = nd.mp_sgd_update(w, g, w32, lr=0.25, out=w)
+    np.testing.assert_allclose(w32.asnumpy(), [0.75])
+    assert out.dtype == np.float16
+    np.testing.assert_allclose(out.asnumpy(), [0.75])
+
+
+def test_adam_update():
+    w, g = _w([1.0]), _w([0.5])
+    m, v = _w([0.0]), _w([0.0])
+    nd.adam_update(w, g, m, v, lr=0.1, beta1=0.9, beta2=0.99,
+                   epsilon=1e-8, out=w)
+    np.testing.assert_allclose(m.asnumpy(), [0.05], rtol=1e-6)
+    np.testing.assert_allclose(v.asnumpy(), [0.0025], rtol=1e-5)
+    exp = 1.0 - 0.1 * 0.05 / (np.sqrt(0.0025) + 1e-8)
+    np.testing.assert_allclose(w.asnumpy(), [exp], rtol=1e-5)
+
+
+def test_rmsprop_update():
+    w, g, n = _w([1.0]), _w([2.0]), _w([0.0])
+    nd.rmsprop_update(w, g, n, lr=0.1, gamma1=0.5, epsilon=0.0, out=w)
+    np.testing.assert_allclose(n.asnumpy(), [2.0], rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(),
+                               [1.0 - 0.1 * 2.0 / np.sqrt(2.0)], rtol=1e-5)
+
+
+def test_signsgd_and_signum():
+    w, g = _w([1.0, -1.0]), _w([3.0, -0.2])
+    out = nd.signsgd_update(w, g, lr=0.1)
+    np.testing.assert_allclose(out.asnumpy(), [0.9, -0.9], rtol=1e-6)
+    w2, m2 = _w([0.0]), _w([0.0])
+    nd.signum_update(w2, _w([1.0]), m2, lr=0.1, momentum=0.9, out=w2)
+    np.testing.assert_allclose(m2.asnumpy(), [-0.1], rtol=1e-5)
+    np.testing.assert_allclose(w2.asnumpy(), [-0.1], rtol=1e-5)
+
+
+def test_ftrl_update_zero_within_l1():
+    w, g = _w([0.0]), _w([0.001])
+    z, n = _w([0.0]), _w([0.0])
+    nd.ftrl_update(w, g, z, n, lr=0.1, lamda1=1.0, out=w)
+    np.testing.assert_allclose(w.asnumpy(), [0.0])  # |z| <= lamda1 -> 0
+
+
+def test_nag_mom_update():
+    w, g, m = _w([1.0]), _w([1.0]), _w([0.0])
+    nd.nag_mom_update(w, g, m, lr=0.1, momentum=0.9, out=w)
+    # mom = -lr*g = -0.1; w = 1 - 0 + 1.9*(0 - 0.1) = 0.81
+    np.testing.assert_allclose(m.asnumpy(), [-0.1], rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), [0.81], rtol=1e-6)
+
+
+def test_adamw_update():
+    w, g = _w([1.0]), _w([0.5])
+    m, v = _w([0.0]), _w([0.0])
+    nd.adamw_update(w, g, m, v, rescale_grad=1.0, lr=0.1, eta=1.0,
+                    beta1=0.9, beta2=0.99, epsilon=1e-8, wd=0.1, out=w)
+    exp = 1.0 - (0.1 * 0.05 / (np.sqrt(0.0025) + 1e-8) + 0.1 * 1.0)
+    np.testing.assert_allclose(w.asnumpy(), [exp], rtol=1e-5)
+
+
+def test_multi_sgd_and_preloaded():
+    w1, g1 = _w([1.0]), _w([1.0])
+    w2, g2 = _w([2.0]), _w([1.0])
+    o1, o2 = nd.multi_sgd_update(w1, g1, w2, g2, lrs=(0.1, 0.2),
+                                 wds=(0.0, 0.0), num_weights=2,
+                                 out=(w1, w2))
+    np.testing.assert_allclose(w1.asnumpy(), [0.9], rtol=1e-6)
+    np.testing.assert_allclose(w2.asnumpy(), [1.8], rtol=1e-6)
+    # preloaded: lrs/wds as tensors
+    w3, g3 = _w([1.0]), _w([1.0])
+    nd.preloaded_multi_sgd_update(w3, g3, _w([0.5]), _w([0.0]),
+                                  num_weights=1, out=w3)
+    np.testing.assert_allclose(w3.asnumpy(), [0.5], rtol=1e-6)
+
+
+def test_multi_lars():
+    lrs = _w([1.0, 1.0])
+    w2 = _w([4.0, 0.0])   # |w| = 2, 0
+    g2 = _w([1.0, 1.0])   # |g| = 1
+    wds = _w([0.0, 0.0])
+    out = nd.multi_lars(lrs, w2, g2, wds, eta=1.0, eps=0.0)
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 1.0], rtol=1e-5)
+
+
+def test_sparse_adagrad_update():
+    w, g, h = _w([1.0]), _w([2.0]), _w([0.0])
+    nd.sparse_adagrad_update(w, g, h, lr=0.1, epsilon=0.0, out=w)
+    np.testing.assert_allclose(h.asnumpy(), [4.0], rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), [1.0 - 0.1 * 2.0 / 2.0],
+                               rtol=1e-5)

@@ -175,3 +175,28 @@ def test_foreach_in_module_fit():
             mod.update()
     out = mod.get_outputs()[0].asnumpy()
     assert out.shape == (B, 2) and np.isfinite(out).all()
+
+
+def test_foreach_batchnorm_aux_updates():
+    """BatchNorm moving stats INSIDE a foreach body must update during
+    training forwards (aux threads through the scan carry)."""
+    data = sym.var('data')                      # [T, B, C]
+    outs, _ = sym.contrib.foreach(
+        lambda d, s: (mx.sym.BatchNorm(d, name='bn_scan', momentum=0.5),
+                      []),
+        data, [])
+    rs = np.random.RandomState(0)
+    x = (rs.rand(3, 8, 4) * 10 + 5).astype('float32')
+    args = {'data': mx.nd.array(x),
+            'bn_scan_gamma': mx.nd.ones((4,)),
+            'bn_scan_beta': mx.nd.zeros((4,))}
+    aux = {'bn_scan_moving_mean': mx.nd.zeros((4,)),
+           'bn_scan_moving_var': mx.nd.ones((4,))}
+    exe = outs.bind(args=args, aux_states=aux)
+    exe.forward(is_train=True)
+    _ = exe.outputs[0].asnumpy()  # materialize
+    mm = exe.aux_dict['bn_scan_moving_mean'].asnumpy()
+    assert np.abs(mm).max() > 0.1, mm  # stats moved off init
+    # inference uses the updated global stats without error
+    out_inf = exe.forward(is_train=False)[0].asnumpy()
+    assert np.isfinite(out_inf).all()

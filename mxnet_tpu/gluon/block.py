@@ -35,15 +35,18 @@ __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 class _BlockScope(threading.local):
     def __init__(self):
         self.counters = {}
+        self.prefix = ""     # active name_scope() prefix
+        self.stack = []      # per-scope counters (numbering restarts)
 
 
 _SCOPE = _BlockScope()
 
 
 def _gen_prefix(hint):
-    cnt = _SCOPE.counters.get(hint, 0)
-    _SCOPE.counters[hint] = cnt + 1
-    return "%s%d_" % (hint, cnt)
+    counters = _SCOPE.stack[-1] if _SCOPE.stack else _SCOPE.counters
+    cnt = counters.get(hint, 0)
+    counters[hint] = cnt + 1
+    return _SCOPE.prefix + "%s%d_" % (hint, cnt)
 
 
 class _AuxCollector(threading.local):
@@ -68,8 +71,12 @@ class Block:
 
     def __init__(self, prefix=None, params=None):
         self._empty_prefix = prefix == ""
-        self._prefix = prefix if prefix is not None else _gen_prefix(
-            self._alias())
+        if prefix is not None:
+            # explicit prefixes nest under an active name_scope, like the
+            # reference's _BlockScope.create (ref: gluon/block.py:36)
+            self._prefix = (_SCOPE.prefix + prefix) if prefix else prefix
+        else:
+            self._prefix = _gen_prefix(self._alias())
         self._params = ParameterDict(self._prefix, shared=params)
         self._children = {}
         self._reg_params = {}
@@ -101,10 +108,21 @@ class Block:
         return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
 
     def name_scope(self):
+        """Children (and explicit prefixes) created inside the scope nest
+        under this block's prefix, and name numbering restarts per scope
+        (ref: gluon/block.py Block.name_scope over _BlockScope)."""
+        block = self
+
         class _NS:
             def __enter__(self_ns):
-                return self
+                self_ns._saved_prefix = _SCOPE.prefix
+                _SCOPE.prefix = block._prefix
+                _SCOPE.stack.append({})
+                return block
+
             def __exit__(self_ns, *a):
+                _SCOPE.prefix = self_ns._saved_prefix
+                _SCOPE.stack.pop()
                 return None
         return _NS()
 
@@ -474,13 +492,18 @@ class SymbolBlock(HybridBlock):
                 p = Parameter(argname, allow_deferred_init=True)
                 self._params._params[argname] = p
                 self._reg_params[argname] = p
+        for auxname in self._outputs.list_auxiliary_states():
+            if auxname not in input_names:
+                p = Parameter(auxname, grad_req="null",
+                              allow_deferred_init=True)
+                self._params._params[auxname] = p
+                self._reg_params[auxname] = p
 
     @classmethod
     def imports(cls, symbol_file, input_names, param_file=None, ctx=None):
-        from ..symbol import load as sym_load
+        from ..symbol import load as sym_load, var as sym_var
         sym = sym_load(symbol_file)
-        from ..symbol import Symbol
-        inputs = [Symbol.var(n) for n in (input_names if isinstance(
+        inputs = [sym_var(n) for n in (input_names if isinstance(
             input_names, (list, tuple)) else [input_names])]
         ret = cls(sym, inputs)
         if param_file:
@@ -494,11 +517,82 @@ class SymbolBlock(HybridBlock):
         return ret
 
     def forward(self, *args):
-        feed = {s.name: a for s, a in zip(self._inputs, args)}
-        for name, p in self._reg_params.items():
-            if p._data is not None:
-                feed[name] = p.data()
-        return self._outputs.eval_dict(feed)
+        """Run the wrapped graph as ONE recorded op: forward interprets the
+        graph into jax (tracing into any active jit), and when autograd is
+        recording the whole graph joins the tape via jax.vjp — the same
+        contract as a generated op (ref: block.py:1129 SymbolBlock runs a
+        CachedOp)."""
+        import jax
+        from .. import autograd as _ag
+        from .. import random as _random
+        from ..executor import _GraphProgram
+
+        prog = getattr(self, "_prog", None)
+        if prog is None:
+            prog = self._prog = _GraphProgram(self._outputs)
+        names = [s.name for s in self._inputs]
+        nd_args = [a if isinstance(a, NDArray) else nd.array(a)
+                   for a in args]
+        # finish deferred param init from the graph's shape inference
+        if any(p._data is None for p in self._reg_params.values()):
+            shapes = {s.name: tuple(a.shape)
+                      for s, a in zip(self._inputs, nd_args)}
+            arg_shapes, _, aux_shapes = \
+                self._outputs.infer_shape_partial(**shapes)
+            arg_names = self._outputs.list_arguments()
+            aux_names = self._outputs.list_auxiliary_states()
+            for n, s in list(zip(arg_names, arg_shapes)) + \
+                    list(zip(aux_names, aux_shapes)):
+                p = self._reg_params.get(n)
+                if p is not None and p._data is None and s is not None:
+                    p._finish_deferred_init(tuple(s))
+        param_items = list(self._reg_params.items())
+        all_names = names + [n for n, _ in param_items]
+        nd_inputs = nd_args + [p.data() for _, p in param_items]
+        key = _random.next_key()
+        training = _ag.is_training()
+
+        datas = tuple(a._data for a in nd_inputs)
+        # aux (BatchNorm moving stats) come back as EXTRA outputs so their
+        # values survive jax.vjp tracing; probe the key set abstractly
+        aux_keys = []
+        if training:
+            def probe(*d):
+                return prog.run(dict(zip(all_names, d)), True, key)[1]
+            try:
+                aux_keys = sorted(jax.eval_shape(
+                    probe, *[jax.ShapeDtypeStruct(a.shape, a.dtype)
+                             for a in datas]))
+            except Exception:
+                aux_keys = []
+
+        def fwd(*datas):
+            values = dict(zip(all_names, datas))
+            outs, aux_up = prog.run(values, training, key)
+            return tuple(outs) + tuple(
+                jax.lax.stop_gradient(aux_up[k]) for k in aux_keys)
+
+        if _ag.is_recording():
+            out, vjp_fn = jax.vjp(fwd, *datas)
+            all_outs = [NDArray(o) for o in out]
+
+            def vjp_wrap(cts):
+                # the tape hands a bare cotangent for single-output nodes;
+                # fwd always returns a tuple
+                return vjp_fn(cts if isinstance(cts, tuple) else (cts,))
+
+            _ag.record_op("SymbolBlock", all_outs, nd_inputs, vjp_wrap)
+        else:
+            all_outs = [NDArray(o) for o in fwd(*datas)]
+        n_real = len(all_outs) - len(aux_keys)
+        outs = all_outs[:n_real]
+        # deliver the moving-stat writes to the registered aux params
+        # (ref: the reference's stateful BatchNorm mutating aux NDArrays)
+        for name, val in zip(aux_keys, all_outs[n_real:]):
+            p = self._reg_params.get(name)
+            if p is not None and p._data is not None:
+                p._data._data = val._data.astype(p._data._data.dtype)
+        return outs[0] if len(outs) == 1 else tuple(outs)
 
     def hybrid_forward(self, F, *args, **kwargs):
         raise RuntimeError("SymbolBlock uses forward directly")

@@ -1,12 +1,15 @@
 """Contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py:
-Concurrent, HybridConcurrent, Identity)."""
+Concurrent :31, HybridConcurrent :64, Identity :97, SparseEmbedding :118,
+SyncBatchNorm :165, PixelShuffle1D/2D/3D :244/:292/:354)."""
 from __future__ import annotations
 
 from ... import nn
 from ...block import HybridBlock
 from .... import ndarray as nd
 
-__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
 
 
 class Concurrent(nn.Sequential):
@@ -42,3 +45,115 @@ class Identity(HybridBlock):
 
     def forward(self, x, *args):
         return x
+
+
+class SparseEmbedding(nn.Embedding):
+    """Embedding with row-sparse gradient API (ref: basic_layers.py:118).
+
+    On TPU the gradient is computed dense (XLA has no sparse tensors;
+    docs/PARITY.md) but the layer keeps the reference's name and
+    constructor so model code ports unchanged."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer, **kwargs)
+
+    def __repr__(self):
+        return "SparseEmbedding(%d -> %d)" % (self._input_dim,
+                                              self._output_dim)
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """Cross-device synchronized BatchNorm (ref: basic_layers.py:165).
+
+    The reference synchronizes batch statistics with an explicit key-value
+    AllReduce across GPUs (src/operator/contrib/sync_batch_norm-inl.h).
+    Here the TPU story is structural: inside a pjit'd step over a mesh the
+    batch axis is sharded and XLA turns the batch-stat reductions into
+    cross-replica collectives automatically, so the same layer IS
+    synchronized when compiled over a mesh; `num_devices` is accepted for
+    API parity."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        self._num_devices = num_devices
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=
+                         running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle1D(HybridBlock):
+    """[N, f*C, W] -> [N, C, W*f] (ref: basic_layers.py:244)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = int(factor)
+
+    def forward(self, x, *args):
+        f = self._factor
+        n, fc, w = x.shape
+        c = fc // f
+        y = x.reshape((n, c, f, w))            # (N, C, f, W) — C major,
+        y = y.transpose((0, 1, 3, 2))          # like the reference :283
+        return y.reshape((n, c, w * f))
+
+    def __repr__(self):
+        return "PixelShuffle1D(%d)" % self._factor
+
+
+class PixelShuffle2D(HybridBlock):
+    """[N, f1*f2*C, H, W] -> [N, C, H*f1, W*f2] (ref: basic_layers.py:292)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            self._factors = (int(factor),) * 2
+        except TypeError:
+            self._factors = tuple(int(f) for f in factor)
+            assert len(self._factors) == 2
+
+    def forward(self, x, *args):
+        f1, f2 = self._factors
+        n, fc, h, w = x.shape
+        c = fc // (f1 * f2)
+        y = x.reshape((n, c, f1, f2, h, w))    # C major (ref :344-347)
+        y = y.transpose((0, 1, 4, 2, 5, 3))    # (N, C, H, f1, W, f2)
+        return y.reshape((n, c, h * f1, w * f2))
+
+    def __repr__(self):
+        return "PixelShuffle2D(%s)" % (self._factors,)
+
+
+class PixelShuffle3D(HybridBlock):
+    """[N, f1*f2*f3*C, D, H, W] -> [N, C, D*f1, H*f2, W*f3]
+    (ref: basic_layers.py:354)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            self._factors = (int(factor),) * 3
+        except TypeError:
+            self._factors = tuple(int(f) for f in factor)
+            assert len(self._factors) == 3
+
+    def forward(self, x, *args):
+        f1, f2, f3 = self._factors
+        n, fc, d, h, w = x.shape
+        c = fc // (f1 * f2 * f3)
+        y = x.reshape((n, c, f1, f2, f3, d, h, w))  # C major (ref :407-415)
+        y = y.transpose((0, 1, 5, 2, 6, 3, 7, 4))   # (N,C,D,f1,H,f2,W,f3)
+        return y.reshape((n, c, d * f1, h * f2, w * f3))
+
+    def __repr__(self):
+        return "PixelShuffle3D(%s)" % (self._factors,)

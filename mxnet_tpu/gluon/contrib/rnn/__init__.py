@@ -1,0 +1,3 @@
+"""Contrib recurrent cells (ref: python/mxnet/gluon/contrib/rnn/)."""
+from .rnn_cell import *        # noqa: F401,F403
+from .conv_rnn_cell import *   # noqa: F401,F403

@@ -90,11 +90,21 @@ class Parameter:
 
     def _finish_init(self, init, ctx, default_init):
         from .. import initializer as _initializer
-        initializer = init or self.init or default_init
+        specific = init if init is not None else self.init
+        initializer = specific if specific is not None else default_init
         if isinstance(initializer, str):
             initializer = _initializer.get(initializer)
         data = _np.zeros(self.shape, self.dtype)
-        initializer._init_weight_dispatch(self.name, data)
+        if specific is not None:
+            # a parameter-specific initializer bypasses the name-suffix
+            # dispatch (ref: initializer.py:142 — the __init__ attr path
+            # calls _init_weight directly)
+            if hasattr(initializer, "_init_weight"):
+                initializer._init_weight(self.name, data)
+            else:
+                initializer(self.name, data)   # Mixed / callables
+        else:
+            initializer._init_weight_dispatch(self.name, data)
         ctx = ctx if ctx is not None and not isinstance(ctx, (list, tuple)) \
             else (ctx[0] if ctx else current_context())
         self._data = nd.array(data, ctx=ctx, dtype=self.dtype)
@@ -147,6 +157,15 @@ class Parameter:
 
     def set_data(self, data):
         data = data if isinstance(data, NDArray) else nd.array(data)
+        known = self.shape is not None and all(
+            d not in (0, None, -1) for d in self.shape)
+        if known and tuple(data.shape) != tuple(self.shape):
+            # ref: parameter.py Parameter._load_init shape assert — a
+            # checkpoint/assignment mismatch must not pass silently
+            raise ValueError(
+                "Parameter %r: cannot set data of shape %s on declared "
+                "shape %s" % (self.name, tuple(data.shape),
+                              tuple(self.shape)))
         if self._data is None:
             self.shape = data.shape
             self._data = data

@@ -134,6 +134,9 @@ class RecurrentCell(Block):
                 "sigmoid": F.sigmoid, "softsign": F.softsign}.get(activation)
         if func is not None:
             return func(inputs)
+        if activation == "leaky":
+            # ref: conv GRU cells default; LeakyReLU op, slope 0.01
+            return F.LeakyReLU(inputs, **kwargs)
         if isinstance(activation, str):
             return F.Activation(inputs, act_type=activation, **kwargs)
         return activation(inputs)

@@ -212,11 +212,24 @@ def fill_element_0index(lhs, mhs, rhs):
 
 @register("_histogram", num_inputs=1, no_grad=True, aliases=("histogram",))
 def _histogram(data, bin_cnt=10, range=None):
-    """ref: src/operator/tensor/histogram.cc."""
-    lo, hi = (range if range is not None
-              else (float(jnp.min(data)), float(jnp.max(data))))
-    counts, edges = jnp.histogram(data, bins=int(bin_cnt), range=(lo, hi))
-    return counts.astype(jnp.int32), edges.astype(data.dtype)
+    """ref: src/operator/tensor/histogram.cc. jit-safe: traced min/max
+    drive the bin edges when no explicit range is given."""
+    bins = int(bin_cnt)
+    flat = data.reshape(-1).astype(jnp.float32)
+    if range is not None:
+        lo = jnp.float32(range[0])
+        hi = jnp.float32(range[1])
+    else:
+        lo = jnp.min(flat)
+        hi = jnp.max(flat)
+    width = jnp.maximum(hi - lo, jnp.finfo(jnp.float32).tiny)
+    edges = lo + (hi - lo) * jnp.arange(bins + 1, dtype=jnp.float32) / bins
+    idx = jnp.clip(jnp.floor((flat - lo) / width * bins).astype(jnp.int32),
+                   0, bins - 1)
+    inside = jnp.logical_and(flat >= lo, flat <= hi)
+    counts = jnp.zeros((bins,), jnp.int32).at[idx].add(
+        inside.astype(jnp.int32))
+    return counts, edges.astype(data.dtype)
 
 
 @register("moments", num_inputs=1)
@@ -434,24 +447,28 @@ def bipartite_matching(data, threshold=1e-12, is_ascend=False, topk=-1):
         n, m = scores.shape
         sign = 1.0 if is_ascend else -1.0
         order = jnp.argsort((sign * scores).reshape(-1), stable=True)
-        limit = n * m if int(topk) <= 0 else min(int(topk) * m, n * m)
+        max_matches = n if int(topk) <= 0 else min(int(topk), n)
 
         def step(state, t):
-            row_match, col_used = state
+            row_match, col_used, n_matched = state
             flat_i = order[t]
             i, j = flat_i // m, flat_i % m
             ok = jnp.logical_and(row_match[i] < 0, ~col_used[j])
             val = scores[i, j]
             ok = jnp.logical_and(ok, val >= threshold if is_ascend
                                  else val > threshold)
-            ok = jnp.logical_and(ok, t < limit)
+            # topk caps the NUMBER OF MATCHES (ref: bounding_box.cc
+            # _contrib_bipartite_matching topk semantics)
+            ok = jnp.logical_and(ok, n_matched < max_matches)
             row_match = row_match.at[i].set(
                 jnp.where(ok, j, row_match[i]))
             col_used = col_used.at[j].set(jnp.logical_or(col_used[j], ok))
-            return (row_match, col_used), None
+            return (row_match, col_used,
+                    n_matched + ok.astype(jnp.int32)), None
 
-        init = (jnp.full((n,), -1, jnp.int32), jnp.zeros((m,), jnp.bool_))
-        (row_match, col_used), _ = lax.scan(
+        init = (jnp.full((n,), -1, jnp.int32), jnp.zeros((m,), jnp.bool_),
+                jnp.int32(0))
+        (row_match, col_used, _), _ = lax.scan(
             step, init, jnp.arange(n * m))
         valid = row_match >= 0
         col_match = jnp.full((m,), -1, jnp.int32).at[

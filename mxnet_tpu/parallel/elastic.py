@@ -65,11 +65,14 @@ class CheckpointManager:
     def all_steps(self):
         steps = []
         for n in os.listdir(self.directory):
-            if n.startswith("step_"):
-                try:
-                    steps.append(int(n[5:].split(".")[0]))
-                except ValueError:
-                    pass
+            if not n.startswith("step_") or n.endswith(".tmp"):
+                # .tmp = partial save interrupted mid-write; never a
+                # restore candidate
+                continue
+            try:
+                steps.append(int(n[5:].split(".")[0]))
+            except ValueError:
+                pass
         return sorted(set(steps))
 
     def latest_step(self):

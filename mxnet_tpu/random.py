@@ -36,12 +36,29 @@ class _RngState(threading.local):
 _STATE = _RngState()
 
 
+import numpy as _host_np
+
+# module-private host RNG for initializers' numpy draws: governed by
+# mx.random.seed WITHOUT clobbering the user's global np.random stream
+# (the reference likewise keeps its RNG separate from numpy's)
+_HOST_RNG = _host_np.random.RandomState()
+
+
+def host_rng():
+    """Host-side numpy RandomState seeded by mx.random.seed (used by
+    mxnet_tpu.initializer for parameter fills)."""
+    return _HOST_RNG
+
+
 def seed(seed_state, ctx="all"):
     """Set the global seed. ref: python/mxnet/random.py:34 (ctx arg kept for
-    API parity; there is one logical RNG stream per host)."""
+    API parity; there is one logical RNG stream per host). Also seeds the
+    private host RNG the initializers draw from, so parameter init is
+    reproducible under mx.random.seed."""
     _STATE.seed = int(seed_state)
     _STATE.counter = 0
     _STATE.base_key = None
+    _HOST_RNG.seed(int(seed_state) & 0xFFFFFFFF)
 
 
 def _base_key():

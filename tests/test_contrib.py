@@ -400,3 +400,85 @@ class TestContribNN:
         c.add(nn.Dense(3, in_units=4), Identity())
         c.initialize()
         assert c(x).shape == (2, 7)
+
+
+def test_pixelshuffle_layers():
+    """ref: gluon/contrib/nn/basic_layers.py PixelShuffle1D/2D/3D — the
+    channel-major split (checked against the reference reshape chain)."""
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    x1 = mx.nd.array(onp.arange(12, dtype="float32").reshape(1, 6, 2))
+    y1 = cnn.PixelShuffle1D(2)(x1)
+    assert y1.shape == (1, 3, 4)
+    # C-major: out channel c comes from input channels [c*f, c*f+f)
+    onp.testing.assert_allclose(
+        y1.asnumpy()[0, 0], [0.0, 2.0, 1.0, 3.0])
+    x2 = mx.nd.array(onp.arange(16, dtype="float32").reshape(1, 4, 2, 2))
+    y2 = cnn.PixelShuffle2D((2, 2))(x2)
+    assert y2.shape == (1, 1, 4, 4)
+    x3 = mx.nd.array(onp.arange(2 * 8, dtype="float32")
+                     .reshape(1, 8, 2, 1, 1))
+    y3 = cnn.PixelShuffle3D(2)(x3)
+    assert y3.shape == (1, 1, 4, 2, 2)
+
+
+def test_sync_batchnorm_and_sparse_embedding():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    sbn = cnn.SyncBatchNorm(num_devices=4)
+    sbn.initialize()
+    x = mx.nd.array(onp.random.RandomState(0).rand(4, 3, 2, 2)
+                    .astype("float32"))
+    with mx.autograd.record():
+        y = sbn(x)
+    assert y.shape == x.shape
+    emb = cnn.SparseEmbedding(10, 4)
+    emb.initialize()
+    out = emb(mx.nd.array(onp.array([1, 3], "float32")))
+    assert out.shape == (2, 4)
+    assert "SparseEmbedding" in repr(emb)
+
+
+def test_variational_dropout_cell():
+    from mxnet_tpu.gluon import rnn
+    from mxnet_tpu.gluon.contrib.rnn import VariationalDropoutCell
+    cell = VariationalDropoutCell(rnn.LSTMCell(8), drop_inputs=0.3,
+                                  drop_outputs=0.3)
+    cell.initialize()
+    x = mx.nd.array(onp.random.RandomState(0).rand(2, 5, 4)
+                    .astype("float32"))
+    with mx.autograd.record():  # dropout active in train mode
+        outputs, states = cell.unroll(5, x, merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert len(states) == 2
+
+
+def test_lstmp_cell():
+    from mxnet_tpu.gluon.contrib.rnn import LSTMPCell
+    cell = LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize()
+    x = mx.nd.array(onp.random.RandomState(0).rand(2, 4, 5)
+                    .astype("float32"))
+    outputs, states = cell.unroll(4, x, merge_outputs=True)
+    assert outputs.shape == (2, 4, 3)          # projected size
+    assert states[0].shape == (2, 3)           # h is projected
+    assert states[1].shape == (2, 8)           # c keeps hidden size
+
+
+def test_conv_rnn_cells():
+    from mxnet_tpu.gluon.contrib.rnn import (Conv2DRNNCell, Conv2DLSTMCell,
+                                             Conv2DGRUCell, Conv1DLSTMCell)
+    rs = onp.random.RandomState(0)
+    for cls, n_states in ((Conv2DRNNCell, 1), (Conv2DLSTMCell, 2),
+                          (Conv2DGRUCell, 1)):
+        cell = cls(input_shape=(3, 8, 8), hidden_channels=4,
+                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.nd.array(rs.rand(2, 5, 3, 8, 8).astype("float32"))
+        outputs, states = cell.unroll(5, x, merge_outputs=True)
+        assert outputs.shape == (2, 5, 4, 8, 8), cls.__name__
+        assert len(states) == n_states
+    cell1d = Conv1DLSTMCell(input_shape=(2, 10), hidden_channels=3,
+                            i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell1d.initialize()
+    x = mx.nd.array(rs.rand(2, 4, 2, 10).astype("float32"))
+    outputs, _ = cell1d.unroll(4, x, merge_outputs=True)
+    assert outputs.shape == (2, 4, 3, 10)

@@ -1,0 +1,147 @@
+"""Gluon losses vs hand-computed formulas + convergence smoke.
+
+Ports the strategy of the reference's tests/python/unittest/test_loss.py
+(value checks against numpy formulas, then tiny trainings asserting the
+loss head can drive convergence)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, autograd
+
+L = gluon.loss
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_l2_l1_values():
+    pred = nd.array(np.array([[1.0, 2.0]], "float32"))
+    label = nd.array(np.array([[2.0, 0.0]], "float32"))
+    np.testing.assert_allclose(
+        _np(L.L2Loss()(pred, label)), [(1 + 4) / 2 / 2], rtol=1e-5)
+    np.testing.assert_allclose(
+        _np(L.L1Loss()(pred, label)), [(1 + 2) / 2], rtol=1e-5)
+
+
+def test_sigmoid_bce_matches_formula():
+    x = np.array([[-1.0, 0.5]], "float32")
+    y = np.array([[0.0, 1.0]], "float32")
+    out = _np(L.SigmoidBinaryCrossEntropyLoss()(nd.array(x), nd.array(y)))
+    p = 1 / (1 + np.exp(-x))
+    exp = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean(axis=1)
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+    # from_sigmoid variant takes probabilities directly
+    out2 = _np(L.SigmoidBinaryCrossEntropyLoss(from_sigmoid=True)(
+        nd.array(p.astype("float32")), nd.array(y)))
+    np.testing.assert_allclose(out2, exp, rtol=1e-4)
+
+
+def test_softmax_ce_matches_formula():
+    x = np.array([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]], "float32")
+    y = np.array([2, 1], "float32")
+    out = _np(L.SoftmaxCrossEntropyLoss()(nd.array(x), nd.array(y)))
+    p = np.exp(x - x.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    exp = -np.log(p[np.arange(2), y.astype(int)])
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+    # sparse_label=False with one-hot gives the same numbers
+    onehot = np.eye(3, dtype="float32")[y.astype(int)]
+    out2 = _np(L.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        nd.array(x), nd.array(onehot)))
+    np.testing.assert_allclose(out2, exp, rtol=1e-5)
+
+
+def test_kl_div():
+    p = np.array([[0.2, 0.3, 0.5]], "float32")
+    q = np.array([[0.3, 0.3, 0.4]], "float32")
+    out = _np(L.KLDivLoss(from_logits=False)(
+        nd.array(np.log(q)), nd.array(p)))  # pred=log-space input
+    assert out.shape == (1,) and np.isfinite(out).all()
+
+
+def test_huber():
+    pred = nd.array(np.array([[0.0, 3.0]], "float32"))
+    label = nd.array(np.array([[0.5, 0.0]], "float32"))
+    out = _np(L.HuberLoss(rho=1.0)(pred, label))
+    exp = (0.5 * 0.5 ** 2 + (3.0 - 0.5)) / 2
+    np.testing.assert_allclose(out, [exp], rtol=1e-5)
+
+
+def test_hinge_losses():
+    pred = nd.array(np.array([[0.3]], "float32"))
+    label = nd.array(np.array([[1.0]], "float32"))
+    np.testing.assert_allclose(_np(L.HingeLoss()(pred, label)), [0.7],
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(L.SquaredHingeLoss()(pred, label)),
+                               [0.49], rtol=1e-4)
+    np.testing.assert_allclose(
+        _np(L.LogisticLoss()(pred, label)),
+        [np.log(1 + np.exp(-0.3))], rtol=1e-4)
+
+
+def test_triplet_and_cosine():
+    a = nd.array(np.array([[1.0, 0.0]], "float32"))
+    p = nd.array(np.array([[1.0, 0.1]], "float32"))
+    n = nd.array(np.array([[-1.0, 0.0]], "float32"))
+    t = _np(L.TripletLoss(margin=1.0)(a, p, n))
+    assert t.shape == (1,) and t[0] >= 0
+    y = nd.array(np.array([1.0], "float32"))
+    c = _np(L.CosineEmbeddingLoss()(a, p, y))
+    # 1 - cos(a, p), cos close to 1 -> small loss
+    assert c[0] < 0.1
+
+
+def test_poisson_nll():
+    pred = nd.array(np.array([[1.0, 2.0]], "float32"))
+    target = nd.array(np.array([[1.0, 1.0]], "float32"))
+    out = _np(L.PoissonNLLLoss(from_logits=True)(pred, target))
+    exp = (np.exp([1.0, 2.0]) - np.array([1.0, 1.0]) * np.array(
+        [1.0, 2.0])).mean()
+    np.testing.assert_allclose(out, [exp], rtol=1e-5)
+
+
+def test_ctc_loss_shape():
+    # [B, T, C] activations, labels [B, L]
+    pred = nd.array(np.random.RandomState(0).rand(2, 8, 5)
+                    .astype("float32"))
+    label = nd.array(np.array([[1, 2, 3, -1], [2, 2, -1, -1]], "float32"))
+    out = _np(L.CTCLoss(layout="NTC")(pred, label))
+    assert out.shape == (2,) and (out > 0).all()
+
+
+def test_weight_and_sample_weight():
+    pred = nd.array(np.ones((2, 2), "float32"))
+    label = nd.array(np.zeros((2, 2), "float32"))
+    base = _np(L.L2Loss()(pred, label))
+    np.testing.assert_allclose(_np(L.L2Loss(weight=2.0)(pred, label)),
+                               base * 2, rtol=1e-6)
+    sw = nd.array(np.array([[1.0], [0.0]], "float32"))
+    out = _np(L.L2Loss()(pred, label, sw))
+    np.testing.assert_allclose(out[1], 0.0)
+    np.testing.assert_allclose(out[0], base[0], rtol=1e-6)
+
+
+@pytest.mark.parametrize("loss_cls,out_dim", [
+    (L.L2Loss, 1), (L.L1Loss, 1), (L.HuberLoss, 1),
+])
+def test_regression_losses_converge(loss_cls, out_dim):
+    rs = np.random.RandomState(0)
+    X = rs.rand(64, 4).astype("float32")
+    Y = X.sum(1, keepdims=True)
+    net = gluon.nn.Dense(out_dim)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05})
+    fn = loss_cls()
+    first = last = None
+    for _ in range(60):
+        with autograd.record():
+            l = fn(net(nd.array(X)), nd.array(Y))
+        l.backward()
+        tr.step(64)
+        v = float(l.mean().asscalar())
+        first = v if first is None else first
+        last = v
+    assert last < first * 0.5, (loss_cls.__name__, first, last)

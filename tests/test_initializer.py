@@ -1,0 +1,100 @@
+"""Initializers (ref strategy: tests/python/unittest/test_init.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def _filled(init, shape=(50, 40), name="w_weight"):
+    p = gluon.Parameter(name, shape=shape)
+    p.initialize(init=init)
+    return p.data().asnumpy()
+
+
+def test_zero_one_constant():
+    np.testing.assert_allclose(_filled(mx.initializer.Zero()), 0.0)
+    np.testing.assert_allclose(_filled(mx.initializer.One()), 1.0)
+    np.testing.assert_allclose(
+        _filled(mx.initializer.Constant(2.5)), 2.5)
+
+
+def test_uniform_normal_ranges():
+    u = _filled(mx.initializer.Uniform(0.3))
+    assert u.min() >= -0.3 and u.max() <= 0.3 and u.std() > 0.05
+    n = _filled(mx.initializer.Normal(0.1))
+    assert abs(n.mean()) < 0.02 and 0.05 < n.std() < 0.2
+
+
+def test_xavier_magnitude():
+    x = _filled(mx.initializer.Xavier(factor_type="avg", magnitude=3.0))
+    bound = np.sqrt(3.0 * 2.0 / (50 + 40))
+    assert x.min() >= -bound - 1e-6 and x.max() <= bound + 1e-6
+    assert x.std() > bound / 4
+
+
+def test_orthogonal_is_orthogonal():
+    w = _filled(mx.initializer.Orthogonal(), shape=(20, 20))
+    wtw = w @ w.T
+    scale = wtw[0, 0]
+    np.testing.assert_allclose(wtw, np.eye(20) * scale, atol=1e-3)
+
+
+def test_msra_prelu():
+    w = _filled(mx.initializer.MSRAPrelu(), shape=(30, 20))
+    assert np.isfinite(w).all() and w.std() > 0
+
+
+def test_bilinear_upsampling_kernel():
+    w = _filled(mx.initializer.Bilinear(), shape=(1, 1, 4, 4))
+    # symmetric interpolation kernel
+    np.testing.assert_allclose(w[0, 0], w[0, 0][::-1, ::-1], rtol=1e-5)
+
+
+def test_lstmbias_forget_gate():
+    b = _filled(mx.initializer.LSTMBias(forget_bias=1.0),
+                shape=(20,), name="lstm_bias")
+    # second quarter (forget gate) set to 1, rest 0
+    np.testing.assert_allclose(b[5:10], 1.0)
+    np.testing.assert_allclose(b[:5], 0.0)
+
+
+def test_name_pattern_dispatch():
+    """Initializer dispatches on parameter name suffix: biases zero,
+    gamma one (ref: initializer.py Initializer.__call__ patterns)."""
+    net = nn.Sequential()
+    net.add(nn.Dense(4, in_units=3), nn.BatchNorm())
+    net.initialize(mx.initializer.Xavier())
+    net(nd.ones((1, 3)))
+    np.testing.assert_allclose(net[0].bias.data().asnumpy(), 0.0)
+    np.testing.assert_allclose(net[1].gamma.data().asnumpy(), 1.0)
+    np.testing.assert_allclose(net[1].beta.data().asnumpy(), 0.0)
+
+
+def test_mixed_initializer():
+    init = mx.initializer.Mixed(
+        [".*special.*", ".*"],
+        [mx.initializer.Constant(9.0), mx.initializer.Zero()])
+    p1 = gluon.Parameter("special_weight", shape=(3,))
+    p1.initialize(init=init)
+    np.testing.assert_allclose(p1.data().asnumpy(), 9.0)
+    p2 = gluon.Parameter("fc_weight", shape=(3, 3))
+    p2.initialize(init=init)
+    np.testing.assert_allclose(p2.data().asnumpy(), 0.0)
+
+
+def test_registry_get_by_string():
+    init = mx.initializer.get("xavier")
+    assert isinstance(init, mx.initializer.Xavier)
+    # gluon accepts string initializers too
+    net = nn.Dense(2, in_units=2, weight_initializer="zeros")
+    net.initialize()
+    np.testing.assert_allclose(net.weight.data().asnumpy(), 0.0)
+
+
+def test_init_reproducible_with_seed():
+    mx.random.seed(42)
+    a = _filled(mx.initializer.Uniform(1.0))
+    mx.random.seed(42)
+    b = _filled(mx.initializer.Uniform(1.0))
+    np.testing.assert_allclose(a, b)

@@ -126,7 +126,7 @@ class TestModule:
         mod.fit(train, eval_data=val, optimizer="sgd",
                 optimizer_params={"learning_rate": 1.0, "momentum": 0.9},
                 initializer=mx.init.Xavier(),
-                eval_metric="acc", num_epoch=8)
+                eval_metric="acc", num_epoch=12)
         score = mod.score(val, "acc")
         assert score[0][1] > 0.85, score
 

@@ -1,0 +1,147 @@
+"""Sparse NDArray API, mx.image augmenters, and dlpack interchange.
+
+Ports the strategies of tests/python/unittest/test_sparse_ndarray.py,
+test_image.py and test_dlpack.py against the TPU-native implementations
+(sparse is dense-backed with storage-format API parity — XLA has no
+sparse tensors; docs/PARITY.md)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((5, 3), "float32")
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rs = nd.sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    np.testing.assert_allclose(rs.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(rs.data.asnumpy(),
+                               [[1, 1, 1], [2, 2, 2]])
+    back = rs.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_row_sparse_from_indices_values():
+    vals = np.array([[1.0, 2.0]], "float32")
+    rs = nd.sparse.row_sparse_array((vals, [2]), shape=(4, 2))
+    d = rs.asnumpy()
+    np.testing.assert_allclose(d[2], [1, 2])
+    np.testing.assert_allclose(d[[0, 1, 3]], 0)
+
+
+def test_csr_roundtrip():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], "float32")
+    csr = nd.sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    # scipy-style components
+    np.testing.assert_allclose(csr.indptr.asnumpy(), [0, 1, 3])
+    np.testing.assert_allclose(csr.indices.asnumpy(), [1, 0, 2])
+    np.testing.assert_allclose(csr.data.asnumpy(), [1, 2, 3])
+
+
+def test_sparse_retain():
+    dense = np.arange(12, dtype="float32").reshape(4, 3)
+    rs = nd.sparse.row_sparse_array(dense)
+    kept = rs.retain(nd.array(np.array([0, 2], "float32")))
+    out = kept.asnumpy()
+    np.testing.assert_allclose(out[[0, 2]], dense[[0, 2]])
+    np.testing.assert_allclose(out[[1, 3]], 0)
+
+
+def test_sparse_elemwise_and_dot():
+    dense = np.random.RandomState(0).rand(4, 3).astype("float32")
+    rs = nd.sparse.row_sparse_array(dense)
+    # sparse participates in ordinary ops (dense compute under the hood)
+    s = (rs * 2.0).asnumpy()
+    np.testing.assert_allclose(s, dense * 2, rtol=1e-6)
+    w = nd.array(np.ones((3, 2), "float32"))
+    np.testing.assert_allclose(nd.dot(rs, w).asnumpy(), dense @ np.ones(
+        (3, 2)), rtol=1e-5)
+
+
+def test_sparse_zeros_and_cast_storage():
+    z = nd.sparse.zeros("row_sparse", (3, 2))
+    assert z.stype == "row_sparse" and float(z.asnumpy().sum()) == 0
+    d = nd.array(np.eye(3, dtype="float32"))
+    c = nd.sparse.cast_storage(d, "csr")
+    assert c.stype == "csr"
+    np.testing.assert_allclose(c.asnumpy(), np.eye(3))
+
+
+# ---------------------------------------------------------------------------
+# image
+# ---------------------------------------------------------------------------
+
+def _img(h=8, w=10, c=3):
+    return nd.array(np.random.RandomState(0).randint(
+        0, 255, (h, w, c)).astype("float32"))
+
+
+def test_imresize_and_crops():
+    img = _img()
+    r = mx.image.imresize(img, 5, 4)
+    assert r.shape == (4, 5, 3)
+    fc = mx.image.fixed_crop(img, 2, 1, 4, 4)
+    assert fc.shape == (4, 4, 3)
+    np.testing.assert_allclose(fc.asnumpy(),
+                               img.asnumpy()[1:5, 2:6], rtol=1e-5)
+    cc, rect = mx.image.center_crop(img, (4, 4))
+    assert cc.shape == (4, 4, 3) and len(rect) == 4
+    rc, _ = mx.image.random_crop(img, (4, 4))
+    assert rc.shape == (4, 4, 3)
+
+
+def test_resize_short():
+    img = _img(8, 10)
+    out = mx.image.resize_short(img, 4)
+    assert min(out.shape[:2]) == 4
+
+
+def test_color_normalize():
+    img = nd.array(np.full((2, 2, 3), 10.0, "float32"))
+    out = mx.image.color_normalize(img, mx.nd.array([1.0, 1.0, 1.0]),
+                                   mx.nd.array([3.0, 3.0, 3.0]))
+    np.testing.assert_allclose(out.asnumpy(), 3.0, rtol=1e-5)
+
+
+def test_augmenter_pipeline_and_dumps():
+    aug = mx.image.CenterCropAug((4, 4))
+    out = aug(_img())
+    assert out.shape == (4, 4, 3)
+    s = aug.dumps()
+    assert "CenterCropAug".lower() in s.lower() or "4" in s
+
+
+def test_create_augmenter_list():
+    augs = mx.image.CreateAugmenter(data_shape=(3, 4, 4), resize=6,
+                                    rand_crop=True, mean=True)
+    img = _img()
+    for a in augs:
+        img = a(img)
+    assert img.shape[2] == 3
+
+
+# ---------------------------------------------------------------------------
+# dlpack
+# ---------------------------------------------------------------------------
+
+def test_dlpack_roundtrip():
+    x = nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    back = np.from_dlpack(x)        # NDArray implements __dlpack__
+    np.testing.assert_allclose(np.asarray(back), x.asnumpy())
+
+
+def test_dlpack_to_jax_and_back():
+    import jax.numpy as jnp
+    x = nd.array(np.arange(4, dtype="float32"))
+    j = jnp.from_dlpack(x.dlpack)   # .dlpack is the protocol carrier
+    np.testing.assert_allclose(np.asarray(j), [0, 1, 2, 3])

@@ -1,0 +1,131 @@
+"""Symbol graph API: composition, attributes, internals, inference.
+
+Ports the strategies of tests/python/unittest/test_symbol.py,
+test_attr.py and test_infer_shape.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    return mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+
+
+def test_list_arguments_and_outputs():
+    out = _mlp()
+    args = out.list_arguments()
+    assert args[0] == "data"
+    assert set(args) == {"data", "fc1_weight", "fc1_bias", "fc2_weight",
+                         "fc2_bias"}
+    assert out.list_outputs() == ["fc2_output"]
+
+
+def test_get_internals_and_select():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert any("relu1" in n for n in names)
+    relu = internals["relu1"]
+    assert relu.name == "relu1"
+    # internal head is executable
+    exe = relu.bind(args={
+        "data": nd.ones((2, 4)),
+        "fc1_weight": nd.ones((8, 4)),
+        "fc1_bias": nd.zeros((8,))})
+    assert exe.forward()[0].shape == (2, 8)
+
+
+def test_infer_shape_forward_and_backward():
+    out = _mlp()
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(5, 4))
+    shapes = dict(zip(out.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (8, 4)
+    assert shapes["fc2_weight"] == (3, 8)
+    assert out_shapes == [(5, 3)]
+
+
+def test_infer_shape_partial():
+    out = _mlp()
+    arg_shapes, out_shapes, _ = out.infer_shape_partial()
+    # nothing known -> everything None but no exception
+    assert out_shapes[0] is None
+
+
+def test_attr_propagation_with_attrscope():
+    from mxnet_tpu.attribute import AttrScope
+    with AttrScope(ctx_group="stage1"):
+        a = sym.var("a")
+        b = a * 2.0
+    assert b.attr("ctx_group") == "stage1"
+    assert a.attr("ctx_group") == "stage1"
+    c = sym.var("c")
+    assert c.attr("ctx_group") is None
+
+
+def test_explicit_attr_and_attr_dict():
+    a = sym.var("a", attr={"mood": "angry"})
+    d = a.attr_dict()[a.name] if callable(getattr(a, "attr_dict")) \
+        else a.attr_dict[a.name]
+    assert d["mood"] == "angry"
+
+
+def test_symbol_group():
+    a, b = sym.var("a"), sym.var("b")
+    g = sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    exe = g.bind(args={"a": nd.array([2.0]), "b": nd.array([3.0])})
+    outs = exe.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [5.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [6.0])
+
+
+def test_symbol_copy_and_json():
+    import copy
+    out = _mlp()
+    c = copy.deepcopy(out)
+    assert c.list_arguments() == out.list_arguments()
+    assert c.tojson() == out.tojson()
+
+
+def test_symbol_save_load(tmp_path):
+    out = _mlp()
+    f = str(tmp_path / "net.json")
+    out.save(f)
+    back = sym.load(f)
+    assert back.list_arguments() == out.list_arguments()
+
+
+def test_name_uniqueness():
+    syms = [mx.sym.FullyConnected(sym.var("x"), num_hidden=2)
+            for _ in range(3)]
+    names = [s.name for s in syms]
+    assert len(set(names)) == 3
+
+
+def test_symbol_arithmetic_scalars():
+    a = sym.var("a")
+    out = ((2.0 - a) / (a + 1.0)) ** 2.0
+    exe = out.bind(args={"a": nd.array([1.0])})
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), [0.25])
+
+
+def test_eval_shortcut():
+    a = sym.var("a")
+    res = (a + 1.0).eval(a=nd.array([1.0, 2.0]))
+    np.testing.assert_allclose(res[0].asnumpy(), [2.0, 3.0])
+
+
+def test_grouped_executor_backward():
+    a = sym.var("a")
+    out = sym.Group([a * 2.0, a * 3.0])
+    exe = out.bind(args={"a": nd.array([1.0])},
+                   args_grad={"a": nd.zeros((1,))})
+    exe.forward(is_train=True)
+    exe.backward()
+    # d(2a)/da + d(3a)/da with ones head grads
+    np.testing.assert_allclose(exe.grad_dict["a"].asnumpy(), [5.0])

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""DCGAN on synthetic 32x32 data (ref: example/gan/dcgan.py — same
+generator/discriminator shapes and alternating Trainer updates).
+
+    python example/gan/dcgan.py --epochs 1
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def build_netG(ngf=32, nc=3):
+    netG = nn.Sequential()
+    netG.add(
+        nn.Conv2DTranspose(ngf * 4, 4, 1, 0, use_bias=False),
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(ngf * 2, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(nc, 4, 2, 1, use_bias=False),
+        nn.Activation("tanh"))
+    return netG
+
+
+def build_netD(ndf=32):
+    netD = nn.Sequential()
+    netD.add(
+        nn.Conv2D(ndf, 4, 2, 1, use_bias=False),
+        nn.LeakyReLU(0.2),
+        nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),
+        nn.Conv2D(ndf * 4, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),
+        nn.Conv2D(1, 4, 1, 0, use_bias=False))
+    return netD
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--nz", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.0002)
+    p.add_argument("--batches", type=int, default=20)
+    args = p.parse_args()
+
+    rs = np.random.RandomState(0)
+    netG, netD = build_netG(), build_netD()
+    netG.initialize(mx.initializer.Normal(0.02))
+    netD.initialize(mx.initializer.Normal(0.02))
+    trainerG = gluon.Trainer(netG.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": 0.5})
+    trainerD = gluon.Trainer(netD.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    B = args.batch_size
+    real_label = nd.ones((B,))
+    fake_label = nd.zeros((B,))
+    for epoch in range(args.epochs):
+        for it in range(args.batches):
+            # "real" data: smooth blobs (self-contained stand-in)
+            real = nd.array(np.tanh(
+                rs.rand(B, 3, 32, 32) * 2 - 1).astype("float32"))
+            noise = nd.array(rs.randn(B, args.nz, 1, 1).astype("float32"))
+
+            # --- update D ---
+            with autograd.record():
+                out_real = netD(real).reshape((-1,))
+                errD_real = loss_fn(out_real, real_label)
+                fake = netG(noise)
+                out_fake = netD(fake.detach()).reshape((-1,))
+                errD_fake = loss_fn(out_fake, fake_label)
+                errD = errD_real + errD_fake
+            errD.backward()
+            trainerD.step(B)
+
+            # --- update G ---
+            with autograd.record():
+                out = netD(netG(noise)).reshape((-1,))
+                errG = loss_fn(out, real_label)
+            errG.backward()
+            trainerG.step(B)
+        print("epoch %d: lossD %.4f lossG %.4f"
+              % (epoch, float(errD.mean().asscalar()),
+                 float(errG.mean().asscalar())))
+    print("done; generator output shape:",
+          netG(nd.array(rs.randn(2, args.nz, 1, 1)
+                        .astype("float32"))).shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Model-parallel matrix factorization: giant embedding tables sharded
+over the mesh (ref: example/model-parallel/matrix_factorization/ — there,
+manual group2ctx placement across GPUs; here a tensor-parallel sharding
+spec on one mesh, the TPU-native equivalent of per-layer placement).
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python example/model-parallel/matrix_factorization.py --shards 4
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--users", type=int, default=2000)
+    p.add_argument("--items", type=int, default=4000)
+    p.add_argument("--factor", type=int, default=64)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--shards", type=int, default=1,
+                   help="ways to shard the embedding factor dim (tp)")
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.parallel import create_mesh
+
+    devs = jax.devices()[:max(args.shards, 1)]
+    mesh = create_mesh(devices=devs, tp=len(devs))
+    raw = mesh.mesh
+
+    rs = np.random.RandomState(0)
+    # ground-truth low-rank structure
+    true_u = rs.randn(args.users, 8).astype("float32")
+    true_i = rs.randn(args.items, 8).astype("float32")
+
+    shard = NamedSharding(raw, P(None, "tp"))  # factor dim over the mesh
+    params = {
+        "user": jax.device_put(
+            (rs.randn(args.users, args.factor) * 0.05).astype("float32"),
+            shard),
+        "item": jax.device_put(
+            (rs.randn(args.items, args.factor) * 0.05).astype("float32"),
+            shard),
+    }
+
+    def loss_fn(params, u, i, r):
+        pu = params["user"][u]              # [B, F] — F sharded over tp
+        pi = params["item"][i]
+        pred = jnp.sum(pu * pi, axis=-1)    # psum over tp via GSPMD
+        return jnp.mean((pred - r) ** 2)
+
+    @jax.jit
+    def step(params, u, i, r):
+        loss, g = jax.value_and_grad(loss_fn)(params, u, i, r)
+        return ({k: params[k] - args.lr * g[k] for k in params}, loss)
+
+    for it in range(args.steps):
+        u = rs.randint(0, args.users, args.batch)
+        i = rs.randint(0, args.items, args.batch)
+        r = (true_u[u] * true_i[i]).sum(1).astype("float32")
+        params, loss = step(params, jnp.asarray(u), jnp.asarray(i),
+                            jnp.asarray(r))
+        if it % 10 == 0 or it == args.steps - 1:
+            print("step %3d rmse %.4f" % (it, float(loss) ** 0.5))
+    print("embedding shard spec:", params["user"].sharding)
+
+
+if __name__ == "__main__":
+    main()

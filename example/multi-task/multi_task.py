@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Multi-task learning: one trunk, two heads, joint loss
+(ref: example/multi-task/example_multi_task.py — same two-softmax-heads
+shape over a shared trunk).
+
+    python example/multi-task/multi_task.py --epochs 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+class MultiTaskNet(gluon.Block):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.trunk = nn.Sequential()
+        self.trunk.add(nn.Dense(64, activation="relu"),
+                       nn.Dense(32, activation="relu"))
+        self.head_a = nn.Dense(4)    # task A: 4-way classification
+        self.head_b = nn.Dense(1)    # task B: regression
+
+    def forward(self, x):
+        h = self.trunk(x)
+        return self.head_a(h), self.head_b(h)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    args = p.parse_args()
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(512, 10).astype("float32")
+    Ya = (X[:, :4].argmax(axis=1)).astype("float32")       # class = argmax
+    Yb = X.sum(axis=1, keepdims=True).astype("float32")    # sum regression
+
+    ds = gluon.data.ArrayDataset(X, Ya, Yb)
+    loader = gluon.data.DataLoader(ds, batch_size=args.batch_size,
+                                   shuffle=True)
+    net = MultiTaskNet()
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.005})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    l2 = gluon.loss.L2Loss()
+    acc = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        acc.reset()
+        tot = cnt = 0
+        for xb, ya, yb in loader:
+            with autograd.record():
+                la, lb = net(xb)
+                L = ce(la, ya) + 0.5 * l2(lb, yb)
+            L.backward()
+            trainer.step(xb.shape[0])
+            acc.update([ya], [la])
+            tot += float(L.mean().asscalar())
+            cnt += 1
+        print("epoch %d: joint loss %.4f, task-A acc %.3f"
+              % (epoch, tot / cnt, acc.get()[1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Character-level LSTM language model with Gluon RNN layers
+(ref: example/rnn/ char-rnn examples; example/gluon/word_language_model).
+
+Trains on a small synthetic corpus by default so the script runs
+self-contained; pass --text FILE for real data.
+
+    python example/rnn/char_lstm.py --epochs 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import nn, rnn  # noqa: E402
+
+DEFAULT_TEXT = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+) * 40
+
+
+class CharLSTM(gluon.Block):
+    def __init__(self, vocab, embed=32, hidden=128, layers=2, **kw):
+        super().__init__(**kw)
+        self.embed = nn.Embedding(vocab, embed)
+        self.lstm = rnn.LSTM(hidden, num_layers=layers, layout="NTC")
+        self.head = nn.Dense(vocab, flatten=False)
+
+    def forward(self, x, states):
+        h = self.embed(x)
+        out, states = self.lstm(h, states)
+        return self.head(out), states
+
+    def begin_state(self, batch_size):
+        return self.lstm.begin_state(batch_size=batch_size)
+
+
+def batches(text, vocab, batch_size, seq_len):
+    data = np.array([vocab[c] for c in text], "int32")
+    n = (len(data) - 1) // (batch_size * seq_len)
+    x = data[:n * batch_size * seq_len].reshape(batch_size, n, seq_len)
+    y = data[1:n * batch_size * seq_len + 1].reshape(batch_size, n, seq_len)
+    for i in range(n):
+        yield nd.array(x[:, i].astype("float32")), \
+            nd.array(y[:, i].astype("float32"))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--text", default=None)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.005)
+    args = p.parse_args()
+
+    text = open(args.text).read() if args.text else DEFAULT_TEXT
+    chars = sorted(set(text))
+    vocab = {c: i for i, c in enumerate(chars)}
+    print("corpus %d chars, vocab %d" % (len(text), len(chars)))
+
+    net = CharLSTM(len(chars))
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total, count = 0.0, 0
+        states = net.begin_state(args.batch_size)
+        for x, y in batches(text, vocab, args.batch_size, args.seq_len):
+            # detach state between truncated-BPTT segments
+            states = [s.detach() for s in states]
+            with autograd.record():
+                logits, states = net(x, states)
+                L = loss_fn(logits.reshape((-1, len(chars))),
+                            y.reshape((-1,)))
+            L.backward()
+            trainer.step(x.shape[0] * x.shape[1])
+            total += float(L.mean().asscalar())
+            count += 1
+        print("epoch %d: ce %.4f (ppl %.1f)"
+              % (epoch, total / count, np.exp(total / count)))
+
+    # sample a few characters greedily
+    states = net.begin_state(1)
+    idx = vocab["t"]
+    out = ["t"]
+    for _ in range(60):
+        logits, states = net(nd.array([[float(idx)]]), states)
+        idx = int(np.argmax(logits.asnumpy()[0, -1]))
+        out.append(chars[idx])
+    print("sample:", "".join(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,255 @@
+"""Operator edge-case semantics vs numpy references.
+
+Ports focused cases from tests/python/unittest/test_operator.py where the
+reference pins subtle behavior: pooling pad counting, pad modes, LRN,
+sequence ops with lengths, topk variants, take modes, one_hot,
+depth/space transforms, norm orders, L2Normalization modes, UpSampling."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _a(x):
+    return nd.array(np.asarray(x, "float32"))
+
+
+def test_pooling_avg_count_include_pad():
+    x = _a(np.arange(16).reshape(1, 1, 4, 4))
+    # include pad: denominator is full window
+    inc = nd.Pooling(x, kernel=(3, 3), pool_type="avg", stride=(1, 1),
+                     pad=(1, 1), count_include_pad=True)
+    exc = nd.Pooling(x, kernel=(3, 3), pool_type="avg", stride=(1, 1),
+                     pad=(1, 1), count_include_pad=False)
+    # corner (0,0): window values {0,1,4,5}; include: /9, exclude: /4
+    assert inc.asnumpy()[0, 0, 0, 0] == pytest.approx((0 + 1 + 4 + 5) / 9.0)
+    assert exc.asnumpy()[0, 0, 0, 0] == pytest.approx((0 + 1 + 4 + 5) / 4.0)
+
+
+def test_pooling_global():
+    x = _a(np.arange(16).reshape(1, 1, 4, 4))
+    g = nd.Pooling(x, global_pool=True, pool_type="max", kernel=(2, 2))
+    assert g.asnumpy().reshape(-1)[0] == 15.0
+
+
+def test_pad_modes():
+    x = _a(np.arange(4).reshape(1, 1, 2, 2))
+    c = nd.Pad(x, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+               constant_value=9.0)
+    assert c.shape == (1, 1, 4, 4)
+    assert c.asnumpy()[0, 0, 0, 0] == 9.0
+    e = nd.Pad(x, mode="edge", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    assert e.asnumpy()[0, 0, 0, 0] == 0.0       # replicates corner
+    r = nd.Pad(x, mode="reflect", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    np.testing.assert_allclose(r.asnumpy()[0, 0, 0], [3, 2, 3, 2])
+
+
+def test_lrn_formula():
+    # LRN: x / (knorm + alpha/n * sum(x^2 over window))^beta
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 5, 3, 3).astype("float32")
+    out = nd.LRN(_a(x), nsize=3, alpha=1e-4, beta=0.75, knorm=2.0).asnumpy()
+    n = 3
+    sq = np.zeros_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - n // 2), min(5, c + n // 2 + 1)
+        sq[:, c] = (x[:, lo:hi] ** 2).sum(axis=1)
+    ref = x / (2.0 + (1e-4 / n) * sq) ** 0.75
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_sequence_ops_with_lengths():
+    # data layout: [T, B, ...]
+    x = np.arange(12, dtype="float32").reshape(3, 2, 2)
+    lens = np.array([2, 3], "float32")
+    m = nd.SequenceMask(_a(x), _a(lens), use_sequence_length=True,
+                        value=-1.0).asnumpy()
+    np.testing.assert_allclose(m[2, 0], [-1, -1])   # beyond len 2
+    np.testing.assert_allclose(m[2, 1], x[2, 1])    # within len 3
+    last = nd.SequenceLast(_a(x), _a(lens),
+                           use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], x[1, 0])    # t = len-1
+    np.testing.assert_allclose(last[1], x[2, 1])
+    rev = nd.SequenceReverse(_a(x), _a(lens),
+                             use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(rev[0, 0], x[1, 0])  # first two reversed
+    np.testing.assert_allclose(rev[2, 0], x[2, 0])  # tail untouched
+
+
+def test_topk_variants():
+    x = _a([[3.0, 1.0, 2.0]])
+    v = nd.topk(x, k=2, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(v, [[3, 2]])
+    i = nd.topk(x, k=2, ret_typ="indices").asnumpy()
+    np.testing.assert_allclose(i, [[0, 2]])
+    b = nd.topk(x, k=2, ret_typ="mask").asnumpy()
+    np.testing.assert_allclose(b, [[1, 0, 1]])
+    both = nd.topk(x, k=1, ret_typ="both")
+    np.testing.assert_allclose(both[0].asnumpy(), [[3]])
+    np.testing.assert_allclose(both[1].asnumpy(), [[0]])
+    # smallest
+    s = nd.topk(x, k=1, is_ascend=True).asnumpy()
+    np.testing.assert_allclose(s, [[1]])
+
+
+def test_take_modes():
+    x = _a([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    idx = _a([0, 4])
+    clip = nd.take(x, idx, mode="clip").asnumpy()
+    np.testing.assert_allclose(clip[1], [5, 6])     # 4 -> clipped to 2
+    wrap = nd.take(x, idx, mode="wrap").asnumpy()
+    np.testing.assert_allclose(wrap[1], [3, 4])     # 4 mod 3 = 1
+
+
+def test_one_hot_options():
+    x = _a([0, 2])
+    out = nd.one_hot(x, depth=3, on_value=5.0, off_value=-1.0).asnumpy()
+    np.testing.assert_allclose(out, [[5, -1, -1], [-1, -1, 5]])
+
+
+def test_pick_keepdims():
+    x = _a([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    idx = _a([1, 0])
+    out = nd.pick(x, idx, axis=1).asnumpy()
+    np.testing.assert_allclose(out, [2, 4])
+    out2 = nd.pick(x, idx, axis=1, keepdims=True).asnumpy()
+    assert out2.shape == (2, 1)
+
+
+def test_space_depth_roundtrip():
+    x = _a(np.arange(16).reshape(1, 1, 4, 4))
+    d = nd.space_to_depth(x, block_size=2)
+    assert d.shape == (1, 4, 2, 2)
+    back = nd.depth_to_space(d, block_size=2)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+
+
+def test_norm_orders_and_axes():
+    x = _a([[3.0, 4.0], [6.0, 8.0]])
+    np.testing.assert_allclose(float(nd.norm(x).asnumpy()),
+                               np.sqrt(9 + 16 + 36 + 64), rtol=1e-5)
+    l1 = nd.norm(x, ord=1, axis=1).asnumpy()
+    np.testing.assert_allclose(l1, [7, 14])
+    l2k = nd.norm(x, ord=2, axis=1, keepdims=True).asnumpy()
+    np.testing.assert_allclose(l2k, [[5], [10]])
+
+
+def test_l2_normalization_modes():
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 4).astype("float32")
+    inst = nd.L2Normalization(_a(x), mode="instance").asnumpy()
+    ref = x / np.sqrt((x ** 2).sum(axis=(1, 2), keepdims=True) + 1e-10)
+    np.testing.assert_allclose(inst, ref, rtol=1e-4)
+    chan = nd.L2Normalization(_a(x), mode="channel").asnumpy()
+    refc = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(chan, refc, rtol=1e-4)
+
+
+def test_upsampling_nearest():
+    x = _a(np.arange(4).reshape(1, 1, 2, 2))
+    up = nd.UpSampling(x, scale=2, sample_type="nearest").asnumpy()
+    assert up.shape == (1, 1, 4, 4)
+    # each source pixel becomes a 2x2 block
+    np.testing.assert_allclose(up[0, 0],
+                               [[0, 0, 1, 1], [0, 0, 1, 1],
+                                [2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+def test_slice_like_axes():
+    a = _a(np.zeros((3, 4)))
+    b = _a(np.zeros((2, 3)))
+    out = nd.slice_like(_a(np.arange(12).reshape(3, 4)), b).asnumpy()
+    assert out.shape == (2, 3)
+    out2 = nd.slice_like(_a(np.arange(12).reshape(3, 4)), b,
+                         axes=(1,)).asnumpy()
+    assert out2.shape == (3, 3)
+
+
+def test_repeat_and_tile():
+    x = _a([[1.0, 2.0], [3.0, 4.0]])
+    r = nd.repeat(x, repeats=2, axis=1).asnumpy()
+    np.testing.assert_allclose(r, [[1, 1, 2, 2], [3, 3, 4, 4]])
+    rf = nd.repeat(x, repeats=2).asnumpy()       # flattened when no axis
+    np.testing.assert_allclose(rf, [1, 1, 2, 2, 3, 3, 4, 4])
+    t = nd.tile(x, reps=(2, 1)).asnumpy()
+    assert t.shape == (4, 2)
+
+
+def test_argsort_and_sort_descending():
+    x = _a([3.0, 1.0, 2.0])
+    np.testing.assert_allclose(nd.argsort(x).asnumpy(), [1, 2, 0])
+    np.testing.assert_allclose(nd.argsort(x, is_ascend=False).asnumpy(),
+                               [0, 2, 1])
+    np.testing.assert_allclose(nd.sort(x, is_ascend=False).asnumpy(),
+                               [3, 2, 1])
+
+
+def test_grid_generator_bilinear_sampler_identity():
+    rs = np.random.RandomState(0)
+    img = rs.rand(1, 1, 5, 5).astype("float32")
+    affine = _a([[1.0, 0, 0, 0, 1.0, 0]])
+    grid = nd.GridGenerator(affine, transform_type="affine",
+                            target_shape=(5, 5))
+    out = nd.BilinearSampler(_a(img), grid).asnumpy()
+    np.testing.assert_allclose(out, img, atol=1e-5)
+
+
+def test_dot_transpose_flags():
+    a = np.arange(6, dtype="float32").reshape(2, 3)
+    b = np.arange(12, dtype="float32").reshape(4, 3)
+    out = nd.dot(_a(a), _a(b), transpose_b=True).asnumpy()
+    np.testing.assert_allclose(out, a @ b.T)
+    out2 = nd.dot(_a(a), _a(a), transpose_a=True).asnumpy()
+    np.testing.assert_allclose(out2, a.T @ a)
+
+
+def test_batch_dot():
+    rs = np.random.RandomState(0)
+    a = rs.rand(2, 3, 4).astype("float32")
+    b = rs.rand(2, 4, 5).astype("float32")
+    out = nd.batch_dot(_a(a), _a(b)).asnumpy()
+    np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+
+def test_where_and_clip():
+    cond = _a([1.0, 0.0, 1.0])
+    x, y = _a([1.0, 2.0, 3.0]), _a([10.0, 20.0, 30.0])
+    np.testing.assert_allclose(nd.where(cond, x, y).asnumpy(), [1, 20, 3])
+    np.testing.assert_allclose(
+        nd.clip(_a([-2.0, 0.5, 2.0]), 0.0, 1.0).asnumpy(), [0, 0.5, 1])
+
+
+def test_deconvolution_output_shape():
+    x = nd.zeros((1, 2, 4, 4))
+    w = nd.zeros((2, 3, 3, 3))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=3,
+                           stride=(2, 2), pad=(1, 1), adj=(1, 1),
+                           no_bias=True)
+    # out = (in-1)*stride - 2*pad + kernel + adj = 3*2 - 2 + 3 + 1 = 8
+    assert out.shape == (1, 3, 8, 8)
+
+
+def test_instance_norm_numerics():
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 4).astype("float32")
+    g, b = np.ones(3, "float32") * 2, np.ones(3, "float32")
+    out = nd.InstanceNorm(_a(x), _a(g), _a(b), eps=1e-5).asnumpy()
+    mean = x.mean(axis=2, keepdims=True)
+    var = x.var(axis=2, keepdims=True)
+    ref = 2 * (x - mean) / np.sqrt(var + 1e-5) + 1
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_embedding_gradient_accumulates():
+    from mxnet_tpu import autograd
+    w = nd.array(np.zeros((4, 2), "float32"))
+    w.attach_grad()
+    idx = _a([1, 1, 3])
+    with autograd.record():
+        out = nd.Embedding(idx, w, input_dim=4, output_dim=2).sum()
+    out.backward()
+    g = w.grad.asnumpy()
+    np.testing.assert_allclose(g[1], [2, 2])   # row 1 hit twice
+    np.testing.assert_allclose(g[3], [1, 1])
+    np.testing.assert_allclose(g[0], 0)

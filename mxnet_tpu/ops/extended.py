@@ -763,3 +763,49 @@ for _new, _old in [("BatchNorm", "BatchNorm_v1"),
                    ("Embedding", "_contrib_SparseEmbedding")]:
     if _new in _OPS and _old not in _OPS:
         _OPS[_old] = _OPS[_new]
+
+
+@register("Correlation", num_inputs=2, aliases=("correlation",))
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (ref: src/operator/correlation.cc
+    CorrelationForward :44, shape math correlation-inl.h:99-108).
+    Static python loops over the (small) displacement grid and kernel
+    window unroll into one fused XLA program."""
+    K = int(kernel_size)
+    md = int(max_displacement)
+    s1, s2, p = int(stride1), int(stride2), int(pad_size)
+    kr = K // 2
+    border = md + kr
+    B, C, H, W = data1.shape
+    pH, pW = H + 2 * p, W + 2 * p
+    top_h = -(-(pH - 2 * border) // s1)     # ceil div
+    top_w = -(-(pW - 2 * border) // s1)
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    sumelems = float(K * K * C)
+
+    # NHWC padded copies (ref AddPad)
+    t1 = jnp.pad(jnp.transpose(data1, (0, 2, 3, 1)),
+                 ((0, 0), (p, p), (p, p), (0, 0)))
+    t2 = jnp.pad(jnp.transpose(data2, (0, 2, 3, 1)),
+                 ((0, 0), (p, p), (p, p), (0, 0)))
+
+    def block(src, ys, xs):
+        # kernel anchored TOP-LEFT like the reference (tmp[y1+h][x1+w]);
+        # min t2 start = md - md = 0, so starts never go negative
+        return src[:, ys:ys + (top_h - 1) * s1 + 1:s1,
+                   xs:xs + (top_w - 1) * s1 + 1:s1, :]
+
+    outs = []
+    for tc in range(ngw * ngw):
+        s2o = (tc % ngw - ngr) * s2
+        s2p = (tc // ngw - ngr) * s2
+        acc = 0.0
+        for h in range(K):
+            for w in range(K):
+                a = block(t1, md + h, md + w)
+                b = block(t2, md + h + s2p, md + w + s2o)
+                acc = acc + (a * b if is_multiply else jnp.abs(a - b))
+        outs.append(jnp.sum(acc, axis=-1) / sumelems)
+    return jnp.stack(outs, axis=1)

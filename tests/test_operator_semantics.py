@@ -157,7 +157,6 @@ def test_upsampling_nearest():
 
 
 def test_slice_like_axes():
-    a = _a(np.zeros((3, 4)))
     b = _a(np.zeros((2, 3)))
     out = nd.slice_like(_a(np.arange(12).reshape(3, 4)), b).asnumpy()
     assert out.shape == (2, 3)
@@ -253,3 +252,49 @@ def test_embedding_gradient_accumulates():
     np.testing.assert_allclose(g[1], [2, 2])   # row 1 hit twice
     np.testing.assert_allclose(g[3], [1, 1])
     np.testing.assert_allclose(g[0], 0)
+
+
+@pytest.mark.parametrize("K,md,s1,s2,pad", [
+    (1, 2, 1, 2, 2),       # FlowNet-style 1x1 kernel
+    (3, 2, 1, 1, 2),       # K>1: exercises the kernel-window loop
+    (3, 1, 2, 1, 2),       # stride1 > 1
+])
+def test_correlation_matches_reference_loop(K, md, s1, s2, pad):
+    """Correlation vs a direct transcription of the reference's loop
+    (ref: src/operator/correlation.cc CorrelationForward — kernel anchored
+    top-left: tmp[y1+h][x1+w])."""
+    rs = np.random.RandomState(0)
+    B, C, H, W = 1, 2, 8, 8
+    d1 = rs.rand(B, C, H, W).astype("float32")
+    d2 = rs.rand(B, C, H, W).astype("float32")
+    out = nd.Correlation(_a(d1), _a(d2), kernel_size=K,
+                         max_displacement=md, stride1=s1, stride2=s2,
+                         pad_size=pad, is_multiply=True).asnumpy()
+
+    kr = K // 2
+    border = md + kr
+    pH, pW = H + 2 * pad, W + 2 * pad
+    top_h = int(np.ceil((pH - 2 * border) / s1))
+    top_w = int(np.ceil((pW - 2 * border) / s1))
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    t1 = np.zeros((B, pH, pW, C), "float32")
+    t2 = np.zeros((B, pH, pW, C), "float32")
+    t1[:, pad:pad + H, pad:pad + W] = d1.transpose(0, 2, 3, 1)
+    t2[:, pad:pad + H, pad:pad + W] = d2.transpose(0, 2, 3, 1)
+    ref = np.zeros((B, ngw * ngw, top_h, top_w), "float32")
+    sumelems = K * K * C
+    for i in range(top_h):
+        for j in range(top_w):
+            x1, y1 = j * s1 + md, i * s1 + md
+            for tc in range(ngw * ngw):
+                s2o = (tc % ngw - ngr) * s2
+                s2p = (tc // ngw - ngr) * s2
+                for h in range(K):
+                    for w in range(K):
+                        ref[:, tc, i, j] += (
+                            t1[:, y1 + h, x1 + w]
+                            * t2[:, y1 + s2p + h, x1 + s2o + w]).sum(-1)
+    ref /= sumelems
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-4)

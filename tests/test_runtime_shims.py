@@ -369,3 +369,44 @@ class TestAttrScopeInference:
             fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2,
                                     name="fc1")
         assert fc.name == "net_fc1"
+
+
+def test_concurrent_eager_dispatch_thread_safety():
+    """Concurrent eager op dispatch + autograd from multiple threads
+    (ref strategy: tests/nightly/test_tlocal_racecondition.py,
+    tests/python/unittest/test_thread_local.py — scopes and tapes are
+    thread-local)."""
+    import threading
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+
+    errs = []
+
+    def worker(seed):
+        try:
+            rs = onp.random.RandomState(seed)
+            for _ in range(10):
+                x = nd.array(rs.rand(8, 8).astype("float32"))
+                x.attach_grad()
+                with autograd.record():
+                    y = (nd.dot(x, x) * 2.0).sum()
+                y.backward()
+                g = x.grad.asnumpy()
+                assert onp.isfinite(g).all()
+                # name scopes are thread-local too
+                with mx.name.Prefix("t%d_" % seed):
+                    s = mx.sym.var("v%d" % seed) * 2.0
+                    # explicit VARIABLE names stay unprefixed (reference
+                    # behavior); the OP node gets the thread's prefix
+                    assert s.name.startswith("t%d_" % seed), s.name
+                    assert s.list_arguments()[0] == "v%d" % seed
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs

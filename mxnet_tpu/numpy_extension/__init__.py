@@ -126,3 +126,22 @@ for _name in _NPX_OPS:
         if lower not in globals():
             globals()[lower] = _fn
         __all__.append(_name)
+
+
+def gamma(x, out=None, **kwargs):
+    """Gamma function (ref: npx special functions over
+    src/operator/mshadow_op.h gamma; exp(gammaln) with the reflection
+    formula for the negative axis)."""
+    import jax
+    import jax.numpy as jnp
+    from ..numpy.multiarray import _wrap_out
+    from ..ndarray import NDArray
+    d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    pos = jnp.exp(jax.scipy.special.gammaln(d))
+    # reflection: Gamma(x) = pi / (sin(pi x) * Gamma(1 - x)) for x < 0
+    neg = jnp.pi / (jnp.sin(jnp.pi * d)
+                    * jnp.exp(jax.scipy.special.gammaln(1.0 - d)))
+    return _wrap_out(jnp.where(d > 0, pos, neg))
+
+
+__all__.append("gamma")

@@ -240,3 +240,13 @@ class TestReviewRegressions:
     def test_leaky_relu_alias(self):
         out = npx.leaky_relu(np.array([[-1.0, 1.0]]), slope=0.1)
         onp.testing.assert_allclose(out.asnumpy(), [[-0.1, 1.0]], atol=1e-6)
+
+
+def test_npx_gamma():
+    import numpy as onp
+    x = mx.np.array([0.5, 1.0, 3.5, -0.5])
+    out = onp.asarray(mx.npx.gamma(x))
+    # Gamma(0.5)=sqrt(pi), Gamma(3.5)=15/8*sqrt(pi), Gamma(-0.5)=-2*sqrt(pi)
+    sp = onp.sqrt(onp.pi)
+    onp.testing.assert_allclose(out, [sp, 1.0, 15.0 / 8.0 * sp, -2 * sp],
+                                rtol=1e-5)

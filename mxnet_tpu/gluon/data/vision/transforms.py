@@ -14,9 +14,10 @@ from ...block import Block, HybridBlock
 from ....ndarray import NDArray, array as nd_array
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
-           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
-           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+           "CenterCrop", "RandomResizedCrop", "CropResize",
+           "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomLighting", "RandomColorJitter"]
 
 
 def _to_np(x):
@@ -203,6 +204,52 @@ class RandomSaturation(_RandomJitter):
         return nd_array(img * alpha + gray * (1 - alpha))
 
 
+class CropResize(Block):
+    """Crop a fixed region then optionally resize
+    (ref: transforms.py:238 CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._x0, self._y0 = int(x), int(y)
+        self._w, self._h = int(width), int(height)
+        self._size = (size if isinstance(size, (list, tuple))
+                      else (size, size)) if size is not None else None
+        self._interp = interpolation
+
+    def forward(self, x):
+        import cv2
+        img = _to_np(x)
+        out = img[self._y0:self._y0 + self._h,
+                  self._x0:self._x0 + self._w]
+        if self._size is not None:
+            out = cv2.resize(out, self._size, interpolation=self._interp)
+        if out.ndim == 2:
+            out = out[..., None]
+        return nd_array(out)
+
+
+class RandomHue(_RandomJitter):
+    """Hue jitter via YIQ chroma rotation
+    (ref: transforms.py:502 RandomHue / src/operator/image/image_random.cc
+    RandomHue — same yiq rotation matrices)."""
+
+    def forward(self, x):
+        img = _to_np(x).astype(np.float32)
+        alpha = _pyrandom.uniform(-self._amount, self._amount)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], np.float32)
+        tyiq = np.array([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], np.float32)
+        ityiq = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        t = ityiq @ bt @ tyiq
+        return nd_array(np.dot(img, t.T))
+
+
 class RandomLighting(Block):
     """AlexNet-style PCA noise (ref: transforms.py RandomLighting)."""
 
@@ -232,6 +279,8 @@ class RandomColorJitter(Block):
             self._ts.append(RandomContrast(contrast))
         if saturation:
             self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
 
     def forward(self, x):
         ts = list(self._ts)

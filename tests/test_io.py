@@ -288,3 +288,28 @@ def test_gluon_dataloader_shm_no_leak_on_abandon():
     time.sleep(0.5)
     leaked = set(glob.glob("/dev/shm/psm_*")) - before
     assert not leaked, leaked
+
+
+def test_vision_transforms_crop_resize_and_hue():
+    """ref: gluon/data/vision/transforms.py CropResize :238, RandomHue
+    :502 (YIQ chroma rotation)."""
+    from mxnet_tpu.gluon.data.vision import transforms
+    from mxnet_tpu import nd
+    rs = np.random.RandomState(0)
+    img = nd.array(rs.randint(0, 255, (8, 10, 3)).astype("float32"))
+    cr = transforms.CropResize(2, 1, 4, 5)
+    out = cr(img)
+    assert out.shape == (5, 4, 3)
+    np.testing.assert_allclose(out.asnumpy(),
+                               img.asnumpy()[1:6, 2:6], rtol=1e-5)
+    crr = transforms.CropResize(2, 1, 4, 5, size=(8, 8))
+    assert crr(img).shape == (8, 8, 3)
+    hue = transforms.RandomHue(0.5)
+    hout = hue(img)
+    assert hout.shape == img.shape
+    # luma (Y channel) is preserved by a pure chroma rotation
+    coef = np.array([0.299, 0.587, 0.114], "float32")
+    np.testing.assert_allclose((hout.asnumpy() * coef).sum(-1),
+                               (img.asnumpy() * coef).sum(-1), rtol=1e-3)
+    jit = transforms.RandomColorJitter(brightness=0.1, hue=0.1)
+    assert jit(img).shape == img.shape

@@ -13,9 +13,13 @@ rotates K/V via ppermute; each hop's local block product can use this
 kernel, making the two-level scheme (inter-chip ring x intra-chip flash)
 match Liu et al.'s blockwise formulation.
 
-Backward uses recompute-from-inputs through the jnp reference
-implementation (standard flash practice trades the stored score matrix
-for recompute; here XLA differentiates the recompute).
+Backward is a pair of Pallas kernels in the flash-2 formulation: the
+forward saves only the per-row logsumexp L = m + log(l) (O(S) extra);
+the backward recomputes each (block_q, block_k) score tile inside the
+kernel from Q/K/L, so dQ/dK/dV are produced with O(S*D) HBM traffic and
+O(block^2) VMEM — the O(S^2) score matrix is never materialized in
+either direction. On non-TPU backends (and when the kernel is bypassed)
+the jnp reference's XLA vjp is used instead.
 """
 from __future__ import annotations
 
@@ -26,6 +30,26 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["flash_attention", "attention_reference"]
+
+# Mosaic requires the minor block dim to be a multiple of 128 lanes, so
+# per-row scalars (logsumexp, delta) are stored broadcast over 128 lanes.
+_LANES = 128
+
+
+def _fit_block(requested, size, quantum):
+    """Largest block <= requested that divides `size` and is a multiple of
+    `quantum` (Mosaic sublane/lane granularity). Falls back to `size`
+    itself (one block spanning the axis) when no such divisor exists —
+    a block equal to the array dim is always legal."""
+    b = min(requested, size)
+    if size % b == 0:
+        return b
+    b = (b // quantum) * quantum
+    while b >= quantum:
+        if size % b == 0:
+            return b
+        b -= quantum
+    return size
 
 
 def attention_reference(q, k, v, causal=False, scale=None):
@@ -47,7 +71,7 @@ def attention_reference(q, k, v, causal=False, scale=None):
                       v).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, o_scr, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
                 block_q, block_k, causal, scale, n_kblocks):
     """One (batch*head, q-block, k-block) grid cell. The TPU grid runs
     sequentially with the k axis innermost, so VMEM scratch carries the
@@ -66,12 +90,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, o_scr, *,
         o_scr[:] = jnp.zeros_like(o_scr)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * scale      # (block_q, D)
-        k = k_ref[0].astype(jnp.float32)              # (block_k, D)
-        v = v_ref[0].astype(jnp.float32)
+        # dots run on the input dtype (bf16 hits the MXU at full rate;
+        # f32 would be 8x slower) and accumulate in f32
+        q = q_ref[0]                                  # (block_q, D)
+        k = k_ref[0]                                  # (block_k, D)
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # (block_q, block_k)
+            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
         if causal:
             row = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -87,7 +113,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, o_scr, *,
         m_scr[:, 0] = m_new
         l_scr[:, 0] = corr * l_scr[:, 0] + jnp.sum(p, axis=-1)
         o_scr[:] = corr[:, None] * o_scr[:] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -101,6 +127,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, o_scr, *,
     @pl.when(ki == n_kblocks - 1)
     def _finalize():
         l = l_scr[:, 0]
+        m = m_scr[:, 0]
+        # lse = m + log(l); fully-masked rows keep lse=-inf so the
+        # backward recompute yields p == 0 for them. Broadcast across a
+        # 128-lane minor dim — Mosaic requires the last block dim to be a
+        # multiple of 128, so scalars-per-row ride a full lane register.
+        lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(
+            jnp.where(l == 0.0, 1.0, l)))
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
         o_ref[0] = (o_scr[:] / l[:, None]).astype(o_ref.dtype)
 
@@ -111,11 +145,8 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    assert sq % block_q == 0 and sk % block_k == 0, \
-        "sequence lengths must be multiples of the block sizes " \
-        "(pad like BucketingModule pads variable-length batches)"
+    block_q = _fit_block(block_q, sq, 8)
+    block_k = _fit_block(block_k, sk, 128)
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
@@ -123,7 +154,7 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
                                block_k=block_k, causal=causal, scale=scale,
                                n_kblocks=n_kblocks)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q, n_kblocks),
         in_specs=[
@@ -131,8 +162,14 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, _LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),    # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),    # running denom l
@@ -140,7 +177,187 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse
+
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    qi, ki, block_q, block_k, causal, scale):
+    """Shared flash-2 backward recompute: rebuild the (block_q, block_k)
+    probability tile from Q/K and the saved row logsumexp, then
+    dS = P * (dP - delta) * scale. Used by both _dq_kernel and
+    _dkv_kernel so the masking/lse-safety logic cannot drift."""
+    q = q_ref[0]                                  # (block_q, D)
+    k = k_ref[0]                                  # (block_k, D)
+    v = v_ref[0]
+    do = do_ref[0]                                # (block_q, D)
+    lse = lse_ref[0][:, 0]                        # (block_q,)
+    delta = delta_ref[0][:, 0]                    # (block_q,)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        row = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        col = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(col > row, -jnp.inf, s)
+    # fully-masked rows have lse=-inf: keep them at p=0, not NaN
+    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    p = jnp.exp(s - lse_safe[:, None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)        # (block_q, block_k)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)       # (block_q, block_k)
+    ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, block_q, block_k, causal, scale, n_kblocks):
+    """dQ for one (batch*head, q-block) cell; k innermost.
+    dQ += dS @ K."""
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, qi, ki, block_q, block_k,
+                                causal, scale)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == n_kblocks - 1)
+    def _write():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, block_q, block_k,
+                causal, scale, n_qblocks):
+    """dK/dV for one (batch*head, k-block) cell; q innermost.
+    dV += P^T @ dO; dK += dS^T @ Q."""
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        do = do_ref[0]
+        p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, qi, ki, block_q, block_k,
+                                causal, scale)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_k, D)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (block_k, D)
+
+    if causal:
+        # q blocks entirely above the diagonal see this k block masked out
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == n_qblocks - 1)
+    def _write():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, o, lse, g, causal, scale, block_q, block_k,
+                     interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = _fit_block(block_q, sq, 8)
+    block_k = _fit_block(block_k, sk, 128)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    dof = g.reshape(b * h, sq, d)
+    # the O(S) per-row residual/correction vectors ride a 128-lane minor
+    # dim only here, transiently, for the Mosaic block constraint — the
+    # saved residual itself is (bh, sq)
+    lse = jnp.broadcast_to(lse[:, :, None], (b * h, sq, _LANES))
+    # delta_i = sum_d dO_i * O_i — the rowwise correction in dS; O(S*D)
+    delta = jnp.broadcast_to(
+        jnp.sum(dof.astype(jnp.float32)
+                * o.reshape(b * h, sq, d).astype(jnp.float32),
+                axis=-1, keepdims=True), (b * h, sq, _LANES))
+    n_qblocks = sq // block_q
+    n_kblocks = sk // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale, n_kblocks=n_kblocks),
+        grid=(b * h, n_qblocks, n_kblocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale, n_qblocks=n_qblocks),
+        grid=(b * h, n_kblocks, n_qblocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, j, i: (bh, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 def _use_pallas():
@@ -154,31 +371,43 @@ def _use_pallas():
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     if interpret or _use_pallas():
         return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
+                               interpret)[0]
     return attention_reference(q, k, v, causal=causal, scale=scale)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), \
-        (q, k, v)
+    if interpret or _use_pallas():
+        out, lse = _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                                   interpret)
+        # keep one lane of the (bh, sq, 128) kernel output — the lane dim
+        # exists only for Mosaic's block constraint, not worth 128x HBM
+        # across the fwd->bwd interval
+        return out, (q, k, v, out, lse[:, :, 0])
+    out = attention_reference(q, k, v, causal=causal, scale=scale)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
-                                               scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    if lse is None:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
+                                                   scale=scale), q, k, v)
+        return vjp(g)
+    return _pallas_backward(q, k, v, o, lse, g, causal, scale, block_q,
+                            block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=False):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
+                    block_k=1024, interpret=False):
     """Tiled attention. q,k,v: [B, H, S, D]. On TPU runs the Pallas
     kernel; elsewhere the jnp reference (or the kernel under
-    ``interpret=True`` for testing)."""
+    ``interpret=True`` for testing). Blocks clamp to the sequence
+    length; 1024x1024 measured fastest on-chip at seq 8192 (73 TF/s
+    fwd+bwd model-flops vs 21 for the stock jax kernel)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash(q, k, v, causal, float(scale), int(block_q), int(block_k),

@@ -68,8 +68,12 @@ def bench_transformer():
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(state[0]))
     tflops = 6 * n_params * B * S / dt / 1e12
-    # v5e bf16 peak is ~197 TF/s/chip; report utilization when on TPU
-    mfu = tflops / 197.0 if platform == "tpu" else None
+    # per-generation bf16 peak TF/s/chip; MFU only when the chip is known
+    peaks = {"v4": 275.0, "v5e": 197.0, "v5 lite": 197.0, "v5p": 459.0,
+             "v6e": 918.0}
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    peak = next((p for k, p in peaks.items() if k in kind), None)
+    mfu = tflops / peak if (platform == "tpu" and peak) else None
     return {
         "metric": "transformer_train_tokens_per_sec_per_chip",
         "value": round(B * S / dt, 1),

@@ -254,10 +254,11 @@ def unique(data):
 # -- generated op wrappers --------------------------------------------------
 _register_mod.populate(globals())
 
-# submodule-style namespaces (mx.nd.random, mx.nd.linalg)
+# submodule-style namespaces (mx.nd.random, mx.nd.linalg, mx.nd.image)
 from . import random   # noqa: E402,F401
 from . import linalg   # noqa: E402,F401
 from . import sparse   # noqa: E402,F401
+from . import image    # noqa: E402,F401
 
 # top-level aliases matching the reference namespace (mx.nd.cast_storage
 # in addition to mx.nd.sparse.cast_storage)

@@ -105,3 +105,27 @@ def cond(pred, then_func, else_func):
 from . import boolean_mask  # noqa: E402,F401
 from ..numpy_extension import arange_like  # noqa: E402,F401
 from ..contrib.quantization import quantize, dequantize  # noqa: E402,F401
+
+# DGL graph-sampling family (eager host-side CSR ops — ref:
+# src/operator/contrib/dgl_graph.cc, CPU-only FComputeEx there too)
+from .graph import (dgl_csr_neighbor_uniform_sample,       # noqa: E402,F401
+                    dgl_csr_neighbor_non_uniform_sample,   # noqa: E402,F401
+                    dgl_subgraph, edge_id, dgl_adjacency,  # noqa: E402,F401
+                    dgl_graph_compact, getnnz)             # noqa: E402,F401
+
+
+def _populate_contrib():
+    """Expose every registered ``_contrib_X`` op as ``nd.contrib.X`` (the
+    reference generates these into the contrib module the same way —
+    ref: python/mxnet/ndarray/register.py _init_op_module('contrib'))."""
+    from ..ops import registry as _registry
+    from .register import make_op_func
+    g = globals()
+    for name in _registry.list_ops():
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            if short not in g:
+                g[short] = make_op_func(_registry.get_op(name), short)
+
+
+_populate_contrib()

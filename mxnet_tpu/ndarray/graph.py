@@ -1,0 +1,245 @@
+"""DGL graph-sampling operator family — eager host-side implementations.
+
+ref: src/operator/contrib/dgl_graph.cc — `_contrib_dgl_csr_neighbor_
+{uniform,non_uniform}_sample` (:744/:838), `_contrib_dgl_subgraph`
+(:1115), `_contrib_edge_id` (:1300), `_contrib_dgl_adjacency` (:1376),
+`_contrib_dgl_graph_compact` (:1551), plus `_contrib_getnnz`
+(src/operator/contrib/nnz.cc).
+
+Design note: the reference implements these CPU-only (FComputeEx<cpu>)
+because graph sampling is inherently dynamic-shape, data-dependent
+work — the same reasoning holds on TPU, where XLA requires static
+shapes. These run eagerly on host numpy against CSRNDArray storage
+(the host-callback tier of the op surface), exactly the role the
+reference's CPU kernels play next to its GPU ops. Outputs are padded
+to `max_num_vertices` like the reference so downstream device code
+sees static shapes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray
+from .sparse import CSRNDArray, csr_matrix
+
+__all__ = ["dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+           "edge_id", "dgl_adjacency", "dgl_graph_compact", "getnnz"]
+
+
+def _csr_parts(a):
+    if isinstance(a, CSRNDArray):
+        return (np.asarray(a.indptr.asnumpy(), np.int64),
+                np.asarray(a.indices.asnumpy(), np.int64),
+                np.asarray(a.data.asnumpy()), a.shape)
+    dense = np.asarray(a.asnumpy() if isinstance(a, NDArray) else a)
+    indptr = [0]
+    indices = []
+    data = []
+    for row in dense:
+        nz = np.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return (np.asarray(indptr, np.int64), np.asarray(indices, np.int64),
+            np.asarray(data), dense.shape)
+
+
+def _rng():
+    from .. import random as _random
+    return np.random.RandomState(
+        int(np.asarray(_random.next_key())[-1]) % (2 ** 31))
+
+
+def _neighbor_sample(csr, seeds, num_hops, num_neighbor, max_num_vertices,
+                     prob=None):
+    indptr, indices, data, shape = _csr_parts(csr)
+    max_v = int(max_num_vertices)
+    found = {}          # vertex -> hop layer
+    frontier = []
+    seed_list = [int(v) for v in np.asarray(seeds.asnumpy()).ravel()
+                 if v >= 0]
+    if len(set(seed_list)) > max_v:
+        raise ValueError(
+            "neighbor_sample: %d distinct seeds exceed max_num_vertices=%d"
+            % (len(set(seed_list)), max_v))
+    for s in seed_list:
+        if s not in found:
+            found[s] = 0
+            frontier.append(s)
+    edges = {}          # (u, v) -> value
+    rng = _rng()
+    for hop in range(1, int(num_hops) + 1):
+        nxt = []
+        for u in frontier:
+            row = indices[indptr[u]:indptr[u + 1]]
+            vals = data[indptr[u]:indptr[u + 1]]
+            if len(row) == 0:
+                continue
+            k = min(int(num_neighbor), len(row))
+            if prob is not None:
+                p = np.asarray(prob.asnumpy()).ravel()[row]
+                psum = p.sum()
+                if psum <= 0:
+                    continue
+                # replace=False cannot draw more than the nonzero support
+                k = min(k, int(np.count_nonzero(p)))
+                sel = rng.choice(len(row), size=k, replace=False,
+                                 p=p / psum)
+            else:
+                sel = rng.choice(len(row), size=k, replace=False)
+            for si in sel:
+                v = int(row[si])
+                if len(found) >= max_v and v not in found:
+                    continue
+                edges[(u, v)] = vals[si]
+                if v not in found:
+                    found[v] = hop
+                    nxt.append(v)
+        frontier = nxt
+    verts = sorted(found)
+    n = len(verts)
+    out_v = np.full((max_v + 1,), -1, np.int64)
+    out_v[:n] = verts
+    out_v[-1] = n
+    layer = np.full((max_v,), -1, np.int64)
+    layer[:n] = [found[v] for v in verts]
+    # build the sampled-edge CSR directly (no dense (V, V) intermediate —
+    # these ops exist for graphs where that would be O(V^2))
+    vdt = data.dtype if data.size else np.int64
+    by_row = {}
+    for (u, v), val in edges.items():
+        by_row.setdefault(u, []).append((v, val))
+    s_indptr = np.zeros((shape[0] + 1,), np.int64)
+    s_indices = []
+    s_data = []
+    for r in range(shape[0]):
+        for c, val in sorted(by_row.get(r, ())):
+            s_indices.append(c)
+            s_data.append(val)
+        s_indptr[r + 1] = len(s_indices)
+    sub = csr_matrix((np.asarray(s_data, vdt),
+                      np.asarray(s_indices, np.int64), s_indptr),
+                     shape=shape)
+    return (NDArray(np.asarray(out_v)), sub, NDArray(np.asarray(layer)))
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seeds, num_args=2, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100):
+    """BFS neighbor sampling with uniform probability
+    (ref: dgl_graph.cc:744). Returns, per seed array: (vertices
+    [max_num_vertices+1, last = count], sampled-edge CSR, layer array)."""
+    outs = []
+    for s in seeds:
+        outs.extend(_neighbor_sample(csr, s, num_hops, num_neighbor,
+                                     max_num_vertices))
+    return tuple(outs)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, prob, *seeds, num_args=3,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100):
+    """Weighted neighbor sampling (ref: dgl_graph.cc:838)."""
+    outs = []
+    for s in seeds:
+        outs.extend(_neighbor_sample(csr, s, num_hops, num_neighbor,
+                                     max_num_vertices, prob=prob))
+    return tuple(outs)
+
+
+def dgl_subgraph(graph, *vids, num_args=2, return_mapping=False):
+    """Induced subgraph on vertex set(s) (ref: dgl_graph.cc:1115).
+    With return_mapping, also returns the CSR holding original edge
+    ids."""
+    indptr, indices, data, shape = _csr_parts(graph)
+    outs = []
+    for v in vids:
+        vl = [int(x) for x in np.asarray(v.asnumpy()).ravel()]
+        vset = {x: i for i, x in enumerate(vl)}
+        n = len(vl)
+        new = np.zeros((n, n), np.int64)
+        orig = np.zeros((n, n), data.dtype if data.size else np.int64)
+        eid = 1
+        for i, u in enumerate(vl):
+            row = indices[indptr[u]:indptr[u + 1]]
+            vals = data[indptr[u]:indptr[u + 1]]
+            for c, val in zip(row, vals):
+                j = vset.get(int(c))
+                if j is not None:
+                    new[i, j] = eid
+                    orig[i, j] = val
+                    eid += 1
+        outs.append(csr_matrix(new))
+        if return_mapping:
+            outs.append(csr_matrix(orig))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def edge_id(data, u, v):
+    """out[i] = data[u[i], v[i]] if the edge exists else -1
+    (ref: dgl_graph.cc:1300)."""
+    indptr, indices, vals, shape = _csr_parts(data)
+    uu = np.asarray(u.asnumpy(), np.int64).ravel()
+    vv = np.asarray(v.asnumpy(), np.int64).ravel()
+    out = np.full((len(uu),), -1.0, np.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        row = indices[indptr[a]:indptr[a + 1]]
+        hit = np.nonzero(row == b)[0]
+        if hit.size:
+            out[i] = vals[indptr[a] + hit[0]]
+    return NDArray(out)
+
+
+def dgl_adjacency(data):
+    """CSR edge-id matrix -> float32 adjacency with the same structure
+    (ref: dgl_graph.cc:1376). Reuses indptr/indices; only values change."""
+    indptr, indices, vals, shape = _csr_parts(data)
+    return csr_matrix((np.ones((len(indices),), np.float32), indices,
+                       indptr), shape=shape)
+
+
+def dgl_graph_compact(*graph_data, num_args=2, return_mapping=False,
+                      graph_sizes=()):
+    """Remove the padding rows/cols of sampled sub-CSRs by renumbering
+    through the vertex arrays (ref: dgl_graph.cc:1551). Inputs are the
+    sampled CSR(s) followed by their vertex array(s)."""
+    k = len(graph_data) // 2
+    csrs, vids = graph_data[:k], graph_data[k:]
+    sizes = ([int(graph_sizes)] * k if np.isscalar(graph_sizes)
+             else [int(s) for s in graph_sizes])
+    outs = []
+    for g, v, n in zip(csrs, vids, sizes):
+        indptr, indices, vals, shape = _csr_parts(g)
+        vl = [int(x) for x in np.asarray(v.asnumpy()).ravel()[:n]]
+        vmap = {x: i for i, x in enumerate(vl)}
+        # same convention as dgl_subgraph: first output renumbers edges
+        # 1..E, the mapping output keeps the original edge values
+        new = np.zeros((n, n), np.int64)
+        orig = np.zeros((n, n), vals.dtype if vals.size else np.int64)
+        eid = 1
+        for u in vl:
+            row = indices[indptr[u]:indptr[u + 1]]
+            rv = vals[indptr[u]:indptr[u + 1]]
+            for c, val in zip(row, rv):
+                j = vmap.get(int(c))
+                if j is not None:
+                    new[vmap[u], j] = eid
+                    orig[vmap[u], j] = val
+                    eid += 1
+        # ref example (dgl_graph.cc:1551) shows sequentially renumbered
+        # edge ids in the primary output; the mapping carries originals
+        outs.append(csr_matrix(new))
+        if return_mapping:
+            outs.append(csr_matrix(orig))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def getnnz(data, axis=None):
+    """Stored-value count of a CSR (ref: src/operator/contrib/nnz.cc).
+    axis=None -> scalar; axis=1 -> per-row counts."""
+    indptr, indices, vals, shape = _csr_parts(data)
+    if axis is None:
+        return NDArray(np.asarray(len(indices), np.int64))
+    if int(axis) == 1:
+        return NDArray(np.diff(indptr).astype(np.int64))
+    raise ValueError("getnnz: axis must be None or 1 (ref nnz.cc)")

@@ -1,0 +1,25 @@
+"""mx.nd.image — functional image op namespace.
+
+ref: python/mxnet/ndarray/image.py (generated from the _image_* registry
+names, src/operator/image/image_random.cc). Exposes each registered
+``_image_X`` op as ``nd.image.X``.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .register import make_op_func
+
+__all__ = []
+
+
+def _populate_image():
+    g = globals()
+    for name in _registry.list_ops():
+        if name.startswith("_image_"):
+            short = name[len("_image_"):]
+            if short not in g:
+                g[short] = make_op_func(_registry.get_op(name), short)
+                __all__.append(short)
+
+
+_populate_image()

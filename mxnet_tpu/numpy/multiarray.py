@@ -30,6 +30,7 @@ from ..ndarray.ndarray import NDArray, _is_tracer, _place
 __all__ = ["ndarray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "logspace", "eye", "identity", "empty_like",
            "zeros_like", "ones_like", "full_like", "copy", "asarray",
+           "hanning", "hamming", "blackman",
            "pi", "e", "inf", "nan", "newaxis", "euler_gamma",
            "float16", "float32", "float64", "int8", "int16", "int32",
            "int64", "uint8", "bool_", "bfloat16"]
@@ -436,6 +437,24 @@ def eye(N, M=None, k=0, dtype=float32, ctx=None):
 
 def identity(n, dtype=float32, ctx=None):
     return eye(n, dtype=dtype, ctx=ctx)
+
+
+def hanning(M, dtype=float32, ctx=None):
+    """ref: src/operator/numpy/np_window_op.cc _npi_hanning."""
+    from ..ops.misc_tail import hanning as _h
+    return _dev_wrap(_h(M=M, dtype=canonical_dtype(dtype)), ctx)
+
+
+def hamming(M, dtype=float32, ctx=None):
+    """ref: src/operator/numpy/np_window_op.cc _npi_hamming."""
+    from ..ops.misc_tail import hamming as _h
+    return _dev_wrap(_h(M=M, dtype=canonical_dtype(dtype)), ctx)
+
+
+def blackman(M, dtype=float32, ctx=None):
+    """ref: src/operator/numpy/np_window_op.cc _npi_blackman."""
+    from ..ops.misc_tail import blackman as _b
+    return _dev_wrap(_b(M=M, dtype=canonical_dtype(dtype)), ctx)
 
 
 def copy(a):

@@ -1,0 +1,207 @@
+"""Image operator family — the reference's ``mx.nd.image`` namespace.
+
+ref: src/operator/image/image_random.cc (+ image_random-inl.h semantics:
+to_tensor, normalize, flips, brightness/contrast/saturation/hue jitter,
+random_color_jitter, random_lighting) and src/operator/image/resize.cc,
+crop.cc. TPU-first: all pure jnp (resize via jax.image on device);
+random ops take the wrapper-threaded PRNG ``key`` so they stay jittable
+instead of the reference's per-op Resource PRNG state.
+
+Layout convention matches the reference: HWC (or NHWC batched) uint8/float
+inputs for everything except normalize, which takes the CHW/NCHW float
+output of to_tensor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+# ITU-R BT.601 luma weights — same constants the reference uses
+# (image_random-inl.h RGB2GrayConvert)
+_R, _G, _B = 0.299, 0.587, 0.114
+
+
+@register("_image_to_tensor", aliases=("image_to_tensor",))
+def to_tensor(data):
+    """HWC [0,255] -> CHW float32 [0,1] (ref: image_random.cc ToTensor);
+    batched NHWC -> NCHW."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return x.transpose(2, 0, 1)
+    return x.transpose(0, 3, 1, 2)
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def normalize(data, mean=0.0, std=1.0):
+    """(data - mean) / std per channel on CHW/NCHW float input
+    (ref: image_random.cc Normalize)."""
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    if mean.ndim == 1:
+        shape = (-1,) + (1,) * (2)
+        mean = mean.reshape(shape)
+        std = std.reshape(shape) if std.ndim == 1 else std
+    elif std.ndim == 1:
+        std = std.reshape((-1, 1, 1))
+    return (data - mean) / std
+
+
+def _hwc_axis(data, axis_from_end):
+    return data.ndim - axis_from_end
+
+
+@register("_image_flip_left_right", aliases=("image_flip_left_right",))
+def flip_left_right(data):
+    """ref: image_random.cc FlipLeftRight (HWC width axis)."""
+    return jnp.flip(data, axis=_hwc_axis(data, 2))
+
+
+@register("_image_flip_top_bottom", aliases=("image_flip_top_bottom",))
+def flip_top_bottom(data):
+    return jnp.flip(data, axis=_hwc_axis(data, 3))
+
+
+@register("_image_random_flip_left_right", no_grad=True,
+          aliases=("image_random_flip_left_right",))
+def random_flip_left_right(data, key=None, p=0.5):
+    do = jax.random.bernoulli(key, p)
+    return jnp.where(do, jnp.flip(data, axis=_hwc_axis(data, 2)), data)
+
+
+@register("_image_random_flip_top_bottom", no_grad=True,
+          aliases=("image_random_flip_top_bottom",))
+def random_flip_top_bottom(data, key=None, p=0.5):
+    do = jax.random.bernoulli(key, p)
+    return jnp.where(do, jnp.flip(data, axis=_hwc_axis(data, 3)), data)
+
+
+@register("_image_resize", aliases=("image_resize",))
+def resize(data, size=(0, 0), keep_ratio=False, interp=1):
+    """Resize HWC/NHWC to size (w, h) (ref: src/operator/image/resize.cc;
+    interp 0=nearest, 1=bilinear — the cv2 codes the reference forwards
+    to OpenCV). keep_ratio scales the short side to size[0]."""
+    if isinstance(size, int):
+        size = (size, size)
+    hw_ax = data.ndim - 3
+    H, W = data.shape[hw_ax], data.shape[hw_ax + 1]
+    if keep_ratio:
+        short = min(H, W)
+        s = float(size[0]) / short
+        new_h, new_w = int(round(H * s)), int(round(W * s))
+    else:
+        new_w, new_h = int(size[0]), int(size[1]) or int(size[0])
+    shape = list(data.shape)
+    shape[hw_ax], shape[hw_ax + 1] = new_h, new_w
+    method = "nearest" if int(interp) == 0 else "linear"
+    out = jax.image.resize(data.astype(jnp.float32), tuple(shape), method)
+    return out.astype(data.dtype)
+
+
+@register("_image_crop", aliases=("image_crop",))
+def image_crop(data, x=0, y=0, width=1, height=1):
+    """Static crop of HWC/NHWC (ref: src/operator/image/crop.cc)."""
+    hw_ax = data.ndim - 3
+    sl = [slice(None)] * data.ndim
+    sl[hw_ax] = slice(int(y), int(y) + int(height))
+    sl[hw_ax + 1] = slice(int(x), int(x) + int(width))
+    return data[tuple(sl)]
+
+
+def _blend(a, b, alpha):
+    return a * alpha + b * (1.0 - alpha)
+
+
+def _grayscale(x):
+    # HWC/NHWC channel-last weighted sum, keepdims for broadcasting
+    w = jnp.asarray([_R, _G, _B], jnp.float32)
+    return (x.astype(jnp.float32) * w).sum(-1, keepdims=True)
+
+
+@register("_image_random_brightness", no_grad=True,
+          aliases=("image_random_brightness",))
+def random_brightness(data, key=None, min_factor=0.0, max_factor=1.0):
+    """scale by U(min_factor, max_factor)
+    (ref: image_random-inl.h RandomBrightness)."""
+    a = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return (data.astype(jnp.float32) * a).astype(data.dtype)
+
+
+@register("_image_random_contrast", no_grad=True,
+          aliases=("image_random_contrast",))
+def random_contrast(data, key=None, min_factor=0.0, max_factor=1.0):
+    """blend with the mean gray level (ref: RandomContrast)."""
+    a = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    x = data.astype(jnp.float32)
+    gray = _grayscale(x).mean(axis=(-3, -2, -1), keepdims=True)
+    return _blend(x, gray, a).astype(data.dtype)
+
+
+@register("_image_random_saturation", no_grad=True,
+          aliases=("image_random_saturation",))
+def random_saturation(data, key=None, min_factor=0.0, max_factor=1.0):
+    """blend with the per-pixel gray value (ref: RandomSaturation)."""
+    a = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    x = data.astype(jnp.float32)
+    return _blend(x, _grayscale(x), a).astype(data.dtype)
+
+
+@register("_image_random_hue", no_grad=True, aliases=("image_random_hue",))
+def random_hue(data, key=None, min_factor=0.0, max_factor=1.0):
+    """rotate hue by U(min,max) turns via the YIQ-space matrix trick the
+    reference uses (image_random-inl.h RandomHue)."""
+    import math as _m
+    a = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    alpha = a * (2.0 * _m.pi)
+    x = data.astype(jnp.float32)
+    u, w = jnp.cos(alpha), jnp.sin(alpha)
+    # yiq rotation composite matrix (same constants as the reference)
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], jnp.float32)
+    t_rgb = jnp.asarray([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], jnp.float32)
+    rot = jnp.concatenate([
+        jnp.asarray([[1.0, 0.0, 0.0]], jnp.float32),
+        jnp.stack([jnp.zeros(()), u, -w])[None],
+        jnp.stack([jnp.zeros(()), w, u])[None]], 0)
+    m = t_rgb @ rot @ t_yiq
+    return jnp.einsum("...c,dc->...d", x, m).astype(data.dtype)
+
+
+@register("_image_random_color_jitter", no_grad=True,
+          aliases=("image_random_color_jitter",))
+def random_color_jitter(data, key=None, brightness=0.0, contrast=0.0,
+                        saturation=0.0, hue=0.0):
+    """apply the four jitters in random order-free composition like the
+    reference's RandomColorJitter (which applies sequentially)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    x = data
+    if brightness > 0:
+        x = random_brightness(x, k1, 1 - brightness, 1 + brightness)
+    if contrast > 0:
+        x = random_contrast(x, k2, 1 - contrast, 1 + contrast)
+    if saturation > 0:
+        x = random_saturation(x, k3, 1 - saturation, 1 + saturation)
+    if hue > 0:
+        x = random_hue(x, k4, -hue, hue)
+    return x
+
+
+@register("_image_random_lighting", no_grad=True,
+          aliases=("image_random_lighting",))
+def random_lighting(data, key=None, alpha_std=0.05):
+    """AlexNet-style PCA lighting noise with the reference's fixed
+    eigen-decomposition of ImageNet RGB (image_random-inl.h
+    RandomLighting eig constants)."""
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.814],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    alpha = jax.random.normal(key, (3,)) * alpha_std
+    delta = eigvec @ (alpha * eigval)
+    return (data.astype(jnp.float32) + delta).astype(data.dtype)

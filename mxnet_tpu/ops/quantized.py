@@ -1,0 +1,242 @@
+"""INT8 quantized operator family under the reference's registry names.
+
+ref: src/operator/quantization/ — quantize_v2.cc, requantize.cc,
+calibrate.cc (entropy/KL), quantized_conv.cc, quantized_fully_connected.cc,
+quantized_pooling.cc, quantized_activation.cc, quantized_flatten.cc,
+quantized_concat.cc, quantized_batch_norm.cc.
+
+Scheme: symmetric int8 (scale = max_abs/127, zero-point 0) like the
+reference's default `auto` path for weights. Each quantized op takes
+int8 payloads plus their float min/max ranges and returns
+(payload, out_min, out_max) exactly like the reference's 3-output
+convention; matmul/conv accumulate in int32 (XLA lowers int8 x int8 ->
+int32 dot onto the MXU's int path on TPU).
+
+The graph-surgery driver that swaps float layers for these lives in
+contrib/quantization.py (quantize_net / calib_graph).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _scale(mn, mx):
+    return jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-12) / 127.0
+
+
+@register("_contrib_quantize", no_grad=True, aliases=("quantize_v1",))
+def quantize_v1(data, min_range, max_range, out_type="int8"):
+    """3-in/3-out quantize with explicit range inputs
+    (ref: quantization/quantize.cc)."""
+    s = _scale(min_range, max_range)
+    q = jnp.clip(jnp.round(data / s), -127, 127).astype(jnp.int8)
+    return q, jnp.min(min_range), jnp.max(max_range)
+
+
+@register("_contrib_quantize_v2", no_grad=True, aliases=("quantize_v2",))
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Quantize float->int8; range from calibration params or the data
+    (ref: quantization/quantize_v2.cc)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(float(min_calib_range))
+        mx = jnp.asarray(float(max_calib_range))
+    else:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    s = _scale(mn, mx)
+    q = jnp.clip(jnp.round(data / s), -127, 127).astype(jnp.int8)
+    return q, mn, mx
+
+
+@register("_contrib_requantize", no_grad=True, aliases=("requantize",))
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 -> int8 rescale (ref: quantization/requantize.cc). The int32
+    payload carries scale in_range/2^31; output is int8 at the calibrated
+    (or max-abs) range."""
+    in_s = jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                   jnp.abs(max_range)), 1e-12) / (2.0 ** 31)
+    f = data.astype(jnp.float32) * in_s
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(float(min_calib_range))
+        mx = jnp.asarray(float(max_calib_range))
+    else:
+        mn = jnp.min(f)
+        mx = jnp.max(f)
+    s = _scale(mn, mx)
+    q = jnp.clip(jnp.round(f / s), -127, 127).astype(jnp.int8)
+    return q, mn, mx
+
+
+@register("_contrib_calibrate_entropy", no_grad=True,
+          aliases=("calibrate_entropy",))
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence-optimal calibration threshold from an activation
+    histogram (ref: quantization/calibrate.cc). Runs on host numpy (the
+    reference is CPU-only too) and returns (min, max) scalars."""
+    import numpy as onp
+    from ..contrib.quantization import _get_optimal_threshold
+    h = onp.asarray(hist)
+    e = onp.asarray(hist_edges)
+    thr = _get_optimal_threshold(h, e, int(num_quantized_bins))
+    return (jnp.asarray(-thr, jnp.float32), jnp.asarray(thr, jnp.float32))
+
+
+def _deq(q, mn, mx):
+    return q.astype(jnp.float32) * _scale(mn, mx)
+
+
+def _int32_range(sc):
+    m = sc * (2.0 ** 31)
+    return -m, m
+
+
+@register("_contrib_quantized_act", no_grad=True, aliases=("quantized_act",))
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """int8 activation (ref: quantized_activation.cc); relu keeps the
+    range, matching the reference's passthrough min/max."""
+    if act_type != "relu":
+        raise NotImplementedError("quantized_act supports relu (the "
+                                  "reference's only int8 activation)")
+    return jnp.maximum(data, 0).astype(jnp.int8), min_data, max_data
+
+
+@register("_contrib_quantized_flatten", no_grad=True,
+          aliases=("quantized_flatten",))
+def quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_pooling", no_grad=True,
+          aliases=("quantized_pooling",))
+def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
+                      pool_type="max", stride=(1, 1), pad=(0, 0),
+                      global_pool=False):
+    """int8 max/avg pooling on NCHW (ref: quantized_pooling.cc)."""
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    if global_pool:
+        kh, kw = data.shape[2], data.shape[3]
+        sh = sw = 1
+        ph = pw = 0
+    x = data.astype(jnp.int32)
+    dims = (1, 1, kh, kw)
+    strides = (1, 1, sh, sw)
+    padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    if pool_type == "max":
+        out = lax.reduce_window(x, jnp.iinfo(jnp.int32).min, lax.max,
+                                dims, strides, padding)
+    else:
+        out = lax.reduce_window(x, 0, lax.add, dims, strides, padding)
+        out = out // (kh * kw)
+    return out.astype(jnp.int8), min_data, max_data
+
+
+@register("_contrib_quantized_elemwise_add", no_grad=True,
+          aliases=("quantized_elemwise_add",))
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 + int8 -> int32 with rescaling to a shared scale
+    (ref: quantized_elemwise_add.cc)."""
+    f = _deq(lhs, lhs_min, lhs_max) + _deq(rhs, rhs_min, rhs_max)
+    mx = jnp.maximum(jnp.maximum(jnp.abs(lhs_min), jnp.abs(lhs_max)),
+                     jnp.maximum(jnp.abs(rhs_min), jnp.abs(rhs_max))) * 2
+    s = mx / (2.0 ** 31)
+    out = jnp.clip(jnp.round(f / jnp.maximum(s, 1e-38)),
+                   -(2.0 ** 31 - 1), 2.0 ** 31 - 1).astype(jnp.int32)
+    return out, -mx, mx
+
+
+@register("_contrib_quantized_fully_connected", no_grad=True,
+          aliases=("quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias,
+                              num_hidden=1, no_bias=False, flatten=True):
+    """int8 FC -> int32 (ref: quantized_fully_connected.cc). The int8 x
+    int8 dot accumulates in int32 on the MXU int path."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    acc = lax.dot_general(x.astype(jnp.int32), weight.astype(jnp.int32),
+                          (((x.ndim - 1,), (1,)), ((), ())))
+    sd = _scale(min_data, max_data)
+    sw = _scale(min_weight, max_weight)
+    out_scale = sd * sw
+    if bias is not None and not no_bias:
+        sb = _scale(min_bias, max_bias)
+        acc = acc + jnp.round(bias.astype(jnp.float32) * sb
+                              / out_scale).astype(jnp.int32)
+    mn, mx = _int32_range(out_scale)
+    return acc, mn, mx
+
+
+@register("_contrib_quantized_conv", no_grad=True,
+          aliases=("quantized_conv",))
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, kernel=(1, 1),
+                   stride=(1, 1), pad=(0, 0), dilate=(1, 1), num_filter=1,
+                   num_group=1, no_bias=False, layout="NCHW"):
+    """int8 conv -> int32 (ref: quantized_conv.cc)."""
+    sh, sw = int(stride[0]), int(stride[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int32), weight.astype(jnp.int32),
+        window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dh, dw), feature_group_count=int(num_group),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    sd = _scale(min_data, max_data)
+    sw_ = _scale(min_weight, max_weight)
+    out_scale = sd * sw_
+    if bias is not None and not no_bias:
+        sb = _scale(min_bias, max_bias)
+        acc = acc + jnp.round(bias.astype(jnp.float32) * sb
+                              / out_scale).astype(jnp.int32).reshape(
+                                  1, -1, 1, 1)
+    mn, mx = _int32_range(out_scale)
+    return acc, mn, mx
+
+
+@register("_contrib_quantized_concat", no_grad=True,
+          aliases=("quantized_concat",))
+def quantized_concat(*args, dim=1, num_args=None):
+    """Concat int8 payloads after rescaling to the widest input range
+    (ref: quantized_concat.cc). Inputs: d0..dk, min0, max0, ..."""
+    k = len(args) // 3
+    datas = args[:k]
+    mins = args[k::2][:k]
+    maxs = args[k + 1::2][:k]
+    mx = jnp.stack([jnp.maximum(jnp.abs(a), jnp.abs(b))
+                    for a, b in zip(mins, maxs)]).max()
+    s_out = mx / 127.0
+    parts = []
+    for d, mn_i, mx_i in zip(datas, mins, maxs):
+        f = _deq(d, mn_i, mx_i)
+        parts.append(jnp.clip(jnp.round(f / s_out), -127, 127)
+                     .astype(jnp.int8))
+    return jnp.concatenate(parts, axis=int(dim)), -mx, mx
+
+
+@register("_contrib_quantized_batch_norm", no_grad=True,
+          aliases=("quantized_batch_norm",))
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, eps=1e-3,
+                         min_calib_range=None, max_calib_range=None):
+    """int8 BN using folded scale/shift, re-quantized to the calibrated
+    range (ref: quantized_batch_norm.cc)."""
+    f = _deq(data, min_data, max_data)
+    inv = 1.0 / jnp.sqrt(moving_var + eps)
+    f = (f - moving_mean.reshape(1, -1, 1, 1)) \
+        * (gamma * inv).reshape(1, -1, 1, 1) + beta.reshape(1, -1, 1, 1)
+    if min_calib_range is not None:
+        mn = jnp.asarray(float(min_calib_range))
+        mx = jnp.asarray(float(max_calib_range))
+    else:
+        mn, mx = jnp.min(f), jnp.max(f)
+    s = _scale(mn, mx)
+    return (jnp.clip(jnp.round(f / s), -127, 127).astype(jnp.int8), mn, mx)

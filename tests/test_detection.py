@@ -1,0 +1,469 @@
+"""Detection op family tests — numpy loop oracles ported from the
+reference kernels' specs (ref slots: tests/python/unittest/test_operator.py
+test_psroipooling / test_deformable_* and tests/python/gpu counterparts).
+"""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _nd(a):
+    return mx.nd.array(np.asarray(a, dtype="float32"))
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+class TestDeformableConvolution:
+    def test_zero_offset_matches_dense_conv(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 4, 9, 9).astype("float32")
+        w = rs.randn(6, 4, 3, 3).astype("float32")
+        b = rs.randn(6).astype("float32")
+        off = np.zeros((2, 2 * 9, 7, 7), "float32")
+        out = nd.contrib.DeformableConvolution(
+            _nd(x), _nd(off), _nd(w), _nd(b), kernel=(3, 3),
+            num_filter=6).asnumpy()
+        ref = nd.Convolution(_nd(x), _nd(w), _nd(b), kernel=(3, 3),
+                             num_filter=6).asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 1, 8, 8).astype("float32")
+        w = np.ones((1, 1, 1, 1), "float32")
+        # constant offset (+1, +2): out[y,x] = x[y+1, x+2]
+        off = np.zeros((1, 2, 8, 8), "float32")
+        off[:, 0] = 1.0
+        off[:, 1] = 2.0
+        out = nd.contrib.DeformableConvolution(
+            _nd(x), _nd(off), _nd(w), kernel=(1, 1), num_filter=1,
+            no_bias=True).asnumpy()
+        ref = np.zeros_like(x)
+        ref[0, 0, :7, :6] = x[0, 0, 1:, 2:]
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_fractional_offset_bilinear(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        w = np.ones((1, 1, 1, 1), "float32")
+        off = np.zeros((1, 2, 4, 4), "float32")
+        off[:, 0] = 0.5  # halfway between rows -> average
+        out = nd.contrib.DeformableConvolution(
+            _nd(x), _nd(off), _nd(w), kernel=(1, 1), num_filter=1,
+            no_bias=True).asnumpy()
+        ref = np.zeros((4, 4), "float32")
+        for i in range(3):
+            ref[i] = (x[0, 0, i] + x[0, 0, i + 1]) / 2
+        ref[3] = 0.0  # y=3.5 is outside (>H-1 edge but valid<H) -> clamp
+        # row 3 samples y=3.5: valid (<4) and clamps to row 3
+        ref[3] = x[0, 0, 3]
+        np.testing.assert_allclose(out[0, 0], ref, rtol=1e-5, atol=1e-5)
+
+    def test_gradients_flow(self):
+        rs = np.random.RandomState(2)
+        x = _nd(rs.randn(1, 2, 5, 5))
+        off = _nd(0.1 * rs.randn(1, 2 * 4, 4, 4))
+        w = _nd(rs.randn(3, 2, 2, 2))
+        for a in (x, off, w):
+            a.attach_grad()
+        with mx.autograd.record():
+            y = nd.contrib.DeformableConvolution(
+                x, off, w, kernel=(2, 2), num_filter=3, no_bias=True)
+        y.backward()
+        assert float(np.abs(x.grad.asnumpy()).sum()) > 0
+        assert float(np.abs(off.grad.asnumpy()).sum()) > 0
+        assert float(np.abs(w.grad.asnumpy()).sum()) > 0
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling
+# ---------------------------------------------------------------------------
+
+def psroi_oracle(data, rois, spatial_scale, output_dim, pooled, group):
+    """Direct port of psroi_pooling.cc:56-110."""
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    out = np.zeros((R, output_dim, pooled, pooled), "float32")
+    for n in range(R):
+        bi = int(rois[n, 0])
+        rsw = round(rois[n, 1]) * spatial_scale
+        rsh = round(rois[n, 2]) * spatial_scale
+        rew = (round(rois[n, 3]) + 1.0) * spatial_scale
+        reh = (round(rois[n, 4]) + 1.0) * spatial_scale
+        rw = max(rew - rsw, 0.1)
+        rh = max(reh - rsh, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        for ctop in range(output_dim):
+            for ph in range(pooled):
+                for pw in range(pooled):
+                    hstart = int(np.floor(ph * bh + rsh))
+                    wstart = int(np.floor(pw * bw + rsw))
+                    hend = int(np.ceil((ph + 1) * bh + rsh))
+                    wend = int(np.ceil((pw + 1) * bw + rsw))
+                    hstart, hend = min(max(hstart, 0), H), min(max(hend, 0), H)
+                    wstart, wend = min(max(wstart, 0), W), min(max(wend, 0), W)
+                    gw = min(max(int(np.floor(pw * group / pooled)), 0),
+                             group - 1)
+                    gh = min(max(int(np.floor(ph * group / pooled)), 0),
+                             group - 1)
+                    c = (ctop * group + gh) * group + gw
+                    if hend <= hstart or wend <= wstart:
+                        continue
+                    patch = data[bi, c, hstart:hend, wstart:wend]
+                    out[n, ctop, ph, pw] = patch.mean()
+    return out
+
+
+class TestPSROIPooling:
+    def test_matches_oracle(self):
+        rs = np.random.RandomState(3)
+        G, P, OD = 3, 3, 4
+        data = rs.randn(2, OD * G * G, 12, 12).astype("float32")
+        rois = np.array([[0, 1, 2, 8, 9],
+                         [1, 0, 0, 11, 11],
+                         [0, 4, 4, 6, 6]], "float32")
+        out = nd.contrib.PSROIPooling(_nd(data), _nd(rois),
+                                      spatial_scale=1.0, output_dim=OD,
+                                      pooled_size=P, group_size=G).asnumpy()
+        ref = psroi_oracle(data, rois, 1.0, OD, P, G)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_spatial_scale(self):
+        rs = np.random.RandomState(4)
+        data = rs.randn(1, 4, 8, 8).astype("float32")
+        rois = np.array([[0, 2, 2, 13, 13]], "float32")
+        out = nd.contrib.PSROIPooling(_nd(data), _nd(rois),
+                                      spatial_scale=0.5, output_dim=1,
+                                      pooled_size=2, group_size=2).asnumpy()
+        ref = psroi_oracle(data, rois, 0.5, 1, 2, 2)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling
+# ---------------------------------------------------------------------------
+
+def _bilin(img, h, w):
+    H, W = img.shape
+    h = min(max(h, 0.0), H - 1.0)
+    w = min(max(w, 0.0), W - 1.0)
+    h0, w0 = int(np.floor(h)), int(np.floor(w))
+    h1, w1 = min(h0 + 1, H - 1), min(w0 + 1, W - 1)
+    lh, lw = h - h0, w - w0
+    return (img[h0, w0] * (1 - lh) * (1 - lw) + img[h0, w1] * (1 - lh) * lw
+            + img[h1, w0] * lh * (1 - lw) + img[h1, w1] * lh * lw)
+
+
+def def_psroi_oracle(data, rois, trans, scale, od, group, pooled,
+                     part, spp, tstd, no_trans):
+    """Direct port of deformable_psroi_pooling.cc:60-146."""
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    cec = od // ncls
+    out = np.zeros((R, od, pooled, pooled), "float32")
+    for n in range(R):
+        bi = int(rois[n, 0])
+        rsw = round(rois[n, 1]) * scale - 0.5
+        rsh = round(rois[n, 2]) * scale - 0.5
+        rew = (round(rois[n, 3]) + 1.0) * scale - 0.5
+        reh = (round(rois[n, 4]) + 1.0) * scale - 0.5
+        rw = max(rew - rsw, 0.1)
+        rh = max(reh - rsh, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        sbh, sbw = bh / spp, bw / spp
+        for ctop in range(od):
+            for ph in range(pooled):
+                for pw in range(pooled):
+                    ph_p = int(np.floor(ph / pooled * part))
+                    pw_p = int(np.floor(pw / pooled * part))
+                    cid = ctop // cec
+                    tx = 0.0 if no_trans else \
+                        trans[n, cid * 2, ph_p, pw_p] * tstd
+                    ty = 0.0 if no_trans else \
+                        trans[n, cid * 2 + 1, ph_p, pw_p] * tstd
+                    wst = pw * bw + rsw + tx * rw
+                    hst = ph * bh + rsh + ty * rh
+                    gw = min(max(int(np.floor(pw * group / pooled)), 0),
+                             group - 1)
+                    gh = min(max(int(np.floor(ph * group / pooled)), 0),
+                             group - 1)
+                    c = (ctop * group + gh) * group + gw
+                    s = cnt = 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w_ = wst + iw * sbw
+                            h_ = hst + ih * sbh
+                            if w_ < -0.5 or w_ > W - 0.5 or h_ < -0.5 \
+                                    or h_ > H - 0.5:
+                                continue
+                            s += _bilin(data[bi, c], h_, w_)
+                            cnt += 1
+                    out[n, ctop, ph, pw] = 0.0 if cnt == 0 else s / cnt
+    return out
+
+
+class TestDeformablePSROIPooling:
+    def test_no_trans_matches_oracle(self):
+        rs = np.random.RandomState(5)
+        G = P = 3
+        OD = 2
+        data = rs.randn(1, OD * G * G, 10, 10).astype("float32")
+        rois = np.array([[0, 1, 1, 8, 8]], "float32")
+        out = nd.contrib.DeformablePSROIPooling(
+            _nd(data), _nd(rois), spatial_scale=1.0, output_dim=OD,
+            group_size=G, pooled_size=P, sample_per_part=2,
+            no_trans=True).asnumpy()
+        ref = def_psroi_oracle(data, rois, None, 1.0, OD, G, P, P, 2,
+                               0.0, True)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_with_trans_matches_oracle(self):
+        rs = np.random.RandomState(6)
+        G = P = 2
+        OD = 4  # 2 classes x 2 channels
+        data = rs.randn(2, OD * G * G, 9, 9).astype("float32")
+        rois = np.array([[0, 0, 0, 7, 7], [1, 2, 1, 8, 6]], "float32")
+        trans = 0.3 * rs.randn(2, 4, P, P).astype("float32")
+        out = nd.contrib.DeformablePSROIPooling(
+            _nd(data), _nd(rois), _nd(trans), spatial_scale=1.0,
+            output_dim=OD, group_size=G, pooled_size=P, part_size=P,
+            sample_per_part=2, trans_std=0.1).asnumpy()
+        ref = def_psroi_oracle(data, rois, trans, 1.0, OD, G, P, P, 2,
+                               0.1, False)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal
+# ---------------------------------------------------------------------------
+
+def _anchors_oracle(stride, scales, ratios):
+    base = np.array([0, 0, stride - 1, stride - 1], "float32")
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+    size = w * h
+    out = []
+    for r in ratios:
+        sr = math.floor(size / r)
+        nw = math.floor(math.sqrt(sr) + 0.5)
+        nh = math.floor(nw * r + 0.5)
+        for s in scales:
+            sw, sh = nw * s, nh * s
+            out.append([cx - 0.5 * (sw - 1), cy - 0.5 * (sh - 1),
+                        cx + 0.5 * (sw - 1), cy + 0.5 * (sh - 1)])
+    return np.array(out, "float32")
+
+
+class TestProposal:
+    def _mk(self, rs, H=6, W=8, A=3):
+        cls_prob = rs.rand(1, 2 * A, H, W).astype("float32")
+        bbox_pred = 0.1 * rs.randn(1, 4 * A, H, W).astype("float32")
+        im_info = np.array([[H * 16.0, W * 16.0, 1.0]], "float32")
+        return cls_prob, bbox_pred, im_info
+
+    def test_shapes_and_validity(self):
+        rs = np.random.RandomState(7)
+        cls_prob, bbox_pred, im_info = self._mk(rs)
+        rois = nd.contrib.Proposal(
+            _nd(cls_prob), _nd(bbox_pred), _nd(im_info),
+            rpn_pre_nms_top_n=50, rpn_post_nms_top_n=16,
+            scales=(8,), ratios=(0.5, 1, 2), threshold=0.7,
+            rpn_min_size=4).asnumpy()
+        assert rois.shape == (16, 5)
+        assert (rois[:, 0] == 0).all()
+        # boxes clipped to image
+        assert (rois[:, 1] >= 0).all() and (rois[:, 2] >= 0).all()
+        assert (rois[:, 3] <= im_info[0, 1] - 1).all()
+        assert (rois[:, 4] <= im_info[0, 0] - 1).all()
+
+    def test_top_proposal_is_highest_scoring_box(self):
+        """With deltas=0 and no NMS interference, the first output is the
+        anchor with the highest fg score (after clipping)."""
+        rs = np.random.RandomState(8)
+        H, W, A = 4, 4, 1
+        cls_prob = np.zeros((1, 2, H, W), "float32")
+        cls_prob[0, 1] = rs.rand(H, W)
+        best = np.unravel_index(cls_prob[0, 1].argmax(), (H, W))
+        bbox_pred = np.zeros((1, 4, H, W), "float32")
+        im_info = np.array([[64.0, 64.0, 1.0]], "float32")
+        rois, scores = nd.contrib.Proposal(
+            _nd(cls_prob), _nd(bbox_pred), _nd(im_info),
+            rpn_pre_nms_top_n=16, rpn_post_nms_top_n=4,
+            scales=(4,), ratios=(1,), feature_stride=16,
+            rpn_min_size=4, output_score=True)
+        rois = rois.asnumpy()
+        scores = scores.asnumpy()
+        anc = _anchors_oracle(16, [4], [1])[0]
+        want = anc + np.array([best[1] * 16, best[0] * 16,
+                               best[1] * 16, best[0] * 16], "float32")
+        want = np.clip(want, 0, 63)
+        np.testing.assert_allclose(rois[0, 1:], want, atol=1e-3)
+        assert abs(scores[0, 0] - cls_prob[0, 1][best]) < 1e-5
+
+    def test_nms_suppresses_overlaps(self):
+        """Two anchors at the same location: only one survives NMS."""
+        H, W, A = 2, 2, 2
+        cls_prob = np.zeros((1, 2 * A, H, W), "float32")
+        cls_prob[0, A:] = 0.9
+        cls_prob[0, A, 0, 0] = 0.95
+        bbox_pred = np.zeros((1, 4 * A, H, W), "float32")
+        im_info = np.array([[32.0, 32.0, 1.0]], "float32")
+        rois, sc = nd.contrib.Proposal(
+            _nd(cls_prob), _nd(bbox_pred), _nd(im_info),
+            rpn_pre_nms_top_n=8, rpn_post_nms_top_n=8,
+            scales=(4, 4.01), ratios=(1,), feature_stride=16,
+            rpn_min_size=4, threshold=0.5, output_score=True)
+        sc = sc.asnumpy().ravel()
+        # duplicates cycle — count distinct surviving scores
+        assert len(np.unique(np.round(sc, 5))) <= 4
+
+    def test_multi_proposal_batches(self):
+        rs = np.random.RandomState(9)
+        H, W, A = 4, 4, 2
+        cls_prob = rs.rand(3, 2 * A, H, W).astype("float32")
+        bbox_pred = 0.05 * rs.randn(3, 4 * A, H, W).astype("float32")
+        im_info = np.tile(np.array([[64.0, 64.0, 1.0]], "float32"),
+                          (3, 1))
+        rois = nd.contrib.MultiProposal(
+            _nd(cls_prob), _nd(bbox_pred), _nd(im_info),
+            rpn_pre_nms_top_n=20, rpn_post_nms_top_n=8,
+            scales=(4,), ratios=(0.5, 1), rpn_min_size=2).asnumpy()
+        assert rois.shape == (24, 5)
+        np.testing.assert_array_equal(rois[:, 0],
+                                      np.repeat([0.0, 1.0, 2.0], 8))
+        # per-image result equals single-image Proposal
+        rois0 = nd.contrib.Proposal(
+            _nd(cls_prob[:1]), _nd(bbox_pred[:1]), _nd(im_info[:1]),
+            rpn_pre_nms_top_n=20, rpn_post_nms_top_n=8,
+            scales=(4,), ratios=(0.5, 1), rpn_min_size=2).asnumpy()
+        np.testing.assert_allclose(rois[:8], rois0, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+
+class TestMultiBoxTarget:
+    def test_simple_assignment(self):
+        # one gt box exactly equal to anchor 1 -> anchor 1 positive
+        anchors = np.array([[[0.0, 0.0, 0.2, 0.2],
+                             [0.4, 0.4, 0.8, 0.8],
+                             [0.1, 0.6, 0.3, 0.9]]], "float32")
+        label = np.array([[[2, 0.4, 0.4, 0.8, 0.8],
+                           [-1, -1, -1, -1, -1]]], "float32")
+        cls_pred = np.zeros((1, 4, 3), "float32")
+        lt, lm, ct = nd.contrib.MultiBoxTarget(
+            _nd(anchors), _nd(label), _nd(cls_pred))
+        ct = ct.asnumpy()[0]
+        lm = lm.asnumpy()[0].reshape(3, 4)
+        lt = lt.asnumpy()[0].reshape(3, 4)
+        assert ct[1] == 3.0           # class 2 + 1
+        assert ct[0] == 0.0 and ct[2] == 0.0  # negatives (no mining)
+        assert (lm[1] == 1).all() and (lm[0] == 0).all()
+        # perfect match -> zero offsets
+        np.testing.assert_allclose(lt[1], 0.0, atol=1e-5)
+
+    def test_loc_target_encoding(self):
+        anchors = np.array([[[0.0, 0.0, 0.5, 0.5]]], "float32")
+        label = np.array([[[0, 0.1, 0.1, 0.6, 0.6]]], "float32")
+        lt, lm, ct = nd.contrib.MultiBoxTarget(
+            _nd(anchors), _nd(label), _nd(np.zeros((1, 2, 1), "float32")),
+            variances=(0.1, 0.1, 0.2, 0.2))
+        lt = lt.asnumpy()[0]
+        # same size, center shifted +0.1 => dx = 0.1/0.5/0.1 = 2.0
+        np.testing.assert_allclose(lt, [2.0, 2.0, 0.0, 0.0], atol=1e-4)
+
+    def test_no_gt_all_ignore(self):
+        anchors = np.array([[[0.0, 0.0, 0.2, 0.2],
+                             [0.4, 0.4, 0.8, 0.8]]], "float32")
+        label = -np.ones((1, 2, 5), "float32")
+        lt, lm, ct = nd.contrib.MultiBoxTarget(
+            _nd(anchors), _nd(label), _nd(np.zeros((1, 2, 2), "float32")))
+        assert (ct.asnumpy() == -1.0).all()
+        assert (lm.asnumpy() == 0).all()
+
+    def test_negative_mining(self):
+        rs = np.random.RandomState(10)
+        A = 8
+        anchors = np.zeros((1, A, 4), "float32")
+        anchors[0, :, 0] = np.linspace(0, 0.7, A)
+        anchors[0, :, 1] = 0.0
+        anchors[0, :, 2] = anchors[0, :, 0] + 0.25
+        anchors[0, :, 3] = 0.3
+        label = np.array([[[1, 0.0, 0.0, 0.25, 0.3]]], "float32")
+        cls_pred = rs.randn(1, 3, A).astype("float32")
+        lt, lm, ct = nd.contrib.MultiBoxTarget(
+            _nd(anchors), _nd(label), _nd(cls_pred),
+            negative_mining_ratio=2.0, negative_mining_thresh=0.3)
+        ct = ct.asnumpy()[0]
+        assert ct[0] == 2.0  # the matching anchor, class 1 + 1
+        n_pos = (ct > 0).sum()
+        n_neg = (ct == 0).sum()
+        n_ign = (ct == -1).sum()
+        assert n_pos == 1 and n_neg == 2  # ratio 2 x 1 positive
+        assert n_ign == A - 3
+
+
+# ---------------------------------------------------------------------------
+# RROIAlign
+# ---------------------------------------------------------------------------
+
+class TestRROIAlign:
+    def test_axis_aligned_equals_average(self):
+        """theta=0 over a constant region -> plain average."""
+        data = np.zeros((1, 1, 8, 8), "float32")
+        data[0, 0, 1:7, 1:7] = 5.0
+        rois = np.array([[0, 4.0, 4.0, 4.0, 4.0, 0.0]], "float32")
+        out = nd.contrib.RROIAlign(_nd(data), _nd(rois),
+                                   pooled_size=(2, 2), spatial_scale=1.0,
+                                   sampling_ratio=2).asnumpy()
+        # all bilinear samples (y,x in [2.5, 5.5]) sit strictly inside the
+        # constant 5.0 region [1, 7) so every bin averages to exactly 5
+        np.testing.assert_allclose(out, 5.0, atol=1e-4)
+
+    def test_rotation_90_degrees(self):
+        rs = np.random.RandomState(11)
+        data = rs.rand(1, 1, 12, 12).astype("float32")
+        roi0 = np.array([[0, 6.0, 6.0, 6.0, 2.0, 0.0]], "float32")
+        roi90 = np.array([[0, 6.0, 6.0, 6.0, 2.0, 90.0]], "float32")
+        out0 = nd.contrib.RROIAlign(_nd(data), _nd(roi0),
+                                    pooled_size=(1, 3),
+                                    sampling_ratio=2).asnumpy()
+        out90 = nd.contrib.RROIAlign(_nd(data), _nd(roi90),
+                                     pooled_size=(1, 3),
+                                     sampling_ratio=2).asnumpy()
+        # 90-degree rotation about the center swaps the sampled axis;
+        # outputs must differ for generic data but share the center value
+        assert out0.shape == out90.shape == (1, 1, 1, 3)
+        assert abs(out0[0, 0, 0, 1] - out90[0, 0, 0, 1]) < 0.2
+
+
+# ---------------------------------------------------------------------------
+# Crop
+# ---------------------------------------------------------------------------
+
+class TestCrop:
+    def test_offset_crop(self):
+        x = np.arange(2 * 3 * 6 * 6, dtype="float32").reshape(2, 3, 6, 6)
+        out = nd.Crop(_nd(x), num_args=1, offset=(1, 2),
+                      h_w=(3, 3)).asnumpy()
+        np.testing.assert_array_equal(out, x[:, :, 1:4, 2:5])
+
+    def test_center_crop(self):
+        x = np.arange(1 * 1 * 6 * 6, dtype="float32").reshape(1, 1, 6, 6)
+        out = nd.Crop(_nd(x), num_args=1, h_w=(2, 2),
+                      center_crop=True).asnumpy()
+        np.testing.assert_array_equal(out, x[:, :, 2:4, 2:4])
+
+    def test_crop_like(self):
+        x = _nd(np.arange(64, dtype="float32").reshape(1, 1, 8, 8))
+        like = _nd(np.zeros((1, 1, 3, 5), "float32"))
+        out = nd.Crop(x, like, num_args=2).asnumpy()
+        np.testing.assert_array_equal(out, x.asnumpy()[:, :, :3, :5])

@@ -1,0 +1,316 @@
+"""Long-tail op batch tests: hawkesll, count_sketch, index_array,
+KL sparse reg, window fns, image ops, quantized family, DGL graph ops.
+
+Ref slots: tests/python/unittest/test_contrib_hawkesll.py,
+test_contrib_stes_op.py, test_numpy_op.py window cases,
+tests/python/unittest/test_image.py, test_contrib_quantization.py (in
+tests/python/quantization/), test_dgl_graph.py.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _nd(a, dtype="float32"):
+    return mx.nd.array(onp.asarray(a, dtype=dtype))
+
+
+class TestHawkesLL:
+    def test_single_event_closed_form(self):
+        """One event at t=tau, one mark: ll = log(mu) - mu*tau - remaining
+        compensator to max_time."""
+        mu_v, tau, T_max, alpha_v, beta_v = 0.4, 0.7, 2.0, 0.3, 1.5
+        ll, st = nd.contrib.hawkesll(
+            _nd([[mu_v]]), _nd([alpha_v]), _nd([beta_v]), _nd([[0.0]]),
+            _nd([[tau]]), mx.nd.array(onp.array([[0]], "int32")),
+            _nd([1.0]), _nd([T_max]))
+        # event term: log(mu) - mu*tau (state was 0 before the event)
+        # remaining: mu*(T-tau) + alpha*1*(1-exp(-beta*(T-tau)))
+        d = T_max - tau
+        want = (onp.log(mu_v) - mu_v * tau
+                - (mu_v * d + alpha_v * (1 - onp.exp(-beta_v * d))))
+        onp.testing.assert_allclose(ll.asnumpy()[0], want, rtol=1e-5)
+        # final state: exp(-beta d) * (1 + 0)
+        onp.testing.assert_allclose(st.asnumpy()[0, 0],
+                                    onp.exp(-beta_v * d), rtol=1e-5)
+
+    def test_valid_length_masks_tail(self):
+        args = lambda T: (  # noqa: E731
+            _nd(onp.full((1, 2), 0.5)), _nd([0.2, 0.2]), _nd([1.0, 1.0]),
+            _nd(onp.zeros((1, 2))),
+            _nd(onp.full((1, T), 0.3)),
+            mx.nd.array(onp.zeros((1, T), "int32")),
+            _nd([3.0]), _nd([5.0]))
+        ll_5, _ = nd.contrib.hawkesll(*args(5))
+        ll_3pad, _ = nd.contrib.hawkesll(*args(8))  # 8 slots, 3 valid
+        onp.testing.assert_allclose(ll_5.asnumpy(), ll_3pad.asnumpy(),
+                                    rtol=1e-5)
+
+    def test_differentiable(self):
+        mu = _nd(onp.full((1, 2), 0.5))
+        mu.attach_grad()
+        with mx.autograd.record():
+            ll, st = nd.contrib.hawkesll(
+                mu, _nd([0.2, 0.2]), _nd([1.0, 1.0]),
+                _nd(onp.zeros((1, 2))), _nd(onp.full((1, 4), 0.3)),
+                mx.nd.array(onp.array([[0, 1, 0, 1]], "int32")),
+                _nd([4.0]), _nd([2.0]))
+            loss = ll.sum()
+        loss.backward()
+        assert onp.abs(mu.grad.asnumpy()).min() > 0
+
+
+class TestCountSketch:
+    def test_projection(self):
+        d = _nd([[1.0, 2.0, 3.0, 4.0]])
+        h = _nd([0, 2, 2, 1])
+        s = _nd([1, -1, 1, -1])
+        out = nd.contrib.count_sketch(d, h, s, out_dim=3).asnumpy()
+        onp.testing.assert_allclose(out, [[1.0, -4.0, 1.0]])
+
+    def test_gradient_is_transpose(self):
+        d = _nd(onp.random.RandomState(0).randn(2, 4))
+        h = _nd([0, 1, 1, 2])
+        s = _nd([1, -1, 1, 1])
+        d.attach_grad()
+        with mx.autograd.record():
+            out = nd.contrib.count_sketch(d, h, s, out_dim=3)
+        out.backward()
+        # d(sum out)/d(data[i]) = s[i]
+        onp.testing.assert_allclose(d.grad.asnumpy(),
+                                    onp.tile([1, -1, 1, 1], (2, 1)))
+
+
+class TestIndexArray:
+    def test_full(self):
+        out = nd.contrib.index_array(mx.nd.zeros((2, 3))).asnumpy()
+        for i in range(2):
+            for j in range(3):
+                assert out[i, j].tolist() == [i, j]
+
+    def test_axes_subset(self):
+        out = nd.contrib.index_array(mx.nd.zeros((2, 3, 4)),
+                                     axes=(2, 0)).asnumpy()
+        assert out.shape == (2, 3, 4, 2)
+        assert out[1, 0, 3].tolist() == [3, 1]
+
+
+class TestKLSparseReg:
+    def test_identity_forward_penalized_backward(self):
+        rs = onp.random.RandomState(0)
+        x = _nd(rs.rand(4, 3) * 0.5 + 0.25)
+        x.attach_grad()
+        with mx.autograd.record():
+            y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                             penalty=0.01)
+        onp.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+        y.backward()
+        rho_hat = x.asnumpy().mean(axis=0)
+        want = 1.0 + 0.01 * (-0.1 / rho_hat + 0.9 / (1 - rho_hat))
+        onp.testing.assert_allclose(x.grad.asnumpy(),
+                                    onp.tile(want, (4, 1)), rtol=1e-5)
+
+
+class TestImageOps:
+    def test_to_tensor_normalize(self):
+        rs = onp.random.RandomState(1)
+        img = rs.randint(0, 255, (5, 7, 3)).astype("uint8")
+        t = nd.image.to_tensor(_nd(img, "uint8")).asnumpy()
+        onp.testing.assert_allclose(
+            t, img.transpose(2, 0, 1).astype("float32") / 255, atol=1e-6)
+        n = nd.image.normalize(mx.nd.array(t), mean=(0.4, 0.5, 0.6),
+                               std=(0.2, 0.2, 0.2)).asnumpy()
+        onp.testing.assert_allclose(
+            n[1], (t[1] - 0.5) / 0.2, atol=1e-5)
+
+    def test_flips(self):
+        img = _nd(onp.arange(12).reshape(2, 2, 3))
+        lr = nd.image.flip_left_right(img).asnumpy()
+        onp.testing.assert_array_equal(lr, img.asnumpy()[:, ::-1])
+        tb = nd.image.flip_top_bottom(img).asnumpy()
+        onp.testing.assert_array_equal(tb, img.asnumpy()[::-1])
+
+    def test_resize_and_crop(self):
+        img = _nd(onp.arange(48).reshape(4, 4, 3))
+        r = nd.image.resize(img, size=(2, 2))
+        assert r.shape == (2, 2, 3)
+        c = nd.image.crop(img, x=1, y=0, width=2, height=3)
+        onp.testing.assert_array_equal(c.asnumpy(),
+                                       img.asnumpy()[0:3, 1:3])
+
+    def test_random_ops_shapes(self):
+        img = _nd(onp.random.RandomState(2).rand(4, 4, 3))
+        for fn, kw in [(nd.image.random_flip_left_right, {}),
+                       (nd.image.random_brightness,
+                        dict(min_factor=0.5, max_factor=1.5)),
+                       (nd.image.random_contrast,
+                        dict(min_factor=0.5, max_factor=1.5)),
+                       (nd.image.random_saturation,
+                        dict(min_factor=0.5, max_factor=1.5)),
+                       (nd.image.random_hue,
+                        dict(min_factor=-0.1, max_factor=0.1)),
+                       (nd.image.random_lighting, {})]:
+            out = fn(img, **kw)
+            assert out.shape == img.shape, fn
+
+    def test_hue_identity_at_zero(self):
+        img = _nd(onp.random.RandomState(3).rand(4, 4, 3))
+        out = nd.image.random_hue(img, min_factor=0.0,
+                                  max_factor=0.0).asnumpy()
+        # the NTSC YIQ matrices round-trip to ~1.4e-3 (same constants as
+        # the reference's image_random-inl.h)
+        onp.testing.assert_allclose(out, img.asnumpy(), atol=5e-3)
+
+
+class TestQuantizedOps:
+    def test_quantize_v2_requantize_roundtrip(self):
+        x = _nd(onp.linspace(-2, 2, 64))
+        q, mn, mx_ = nd.contrib.quantize_v2(x)
+        s = max(abs(float(mn.asnumpy())), abs(float(mx_.asnumpy()))) / 127
+        onp.testing.assert_allclose(q.asnumpy() * s, x.asnumpy(),
+                                    atol=s)
+
+    def test_quantized_fc_matches_float(self):
+        rs = onp.random.RandomState(4)
+        x = rs.randn(3, 8).astype("float32")
+        w = rs.randn(5, 8).astype("float32")
+        qx, mnx, mxx = nd.contrib.quantize_v2(_nd(x))
+        qw, mnw, mxw = nd.contrib.quantize_v2(_nd(w))
+        acc, mn, mx_ = nd.contrib.quantized_fully_connected(
+            qx, qw, None, mnx, mxx, mnw, mxw, _nd(0), _nd(0),
+            num_hidden=5, no_bias=True)
+        sd = max(abs(float(mnx.asnumpy())), abs(float(mxx.asnumpy()))) / 127
+        sw = max(abs(float(mnw.asnumpy())), abs(float(mxw.asnumpy()))) / 127
+        got = acc.asnumpy().astype("float64") * sd * sw
+        want = x @ w.T
+        assert onp.abs(got - want).max() < 0.15
+
+    def test_quantized_conv_matches_float(self):
+        rs = onp.random.RandomState(5)
+        x = rs.randn(1, 2, 6, 6).astype("float32")
+        w = rs.randn(3, 2, 3, 3).astype("float32")
+        qx, mnx, mxx = nd.contrib.quantize_v2(_nd(x))
+        qw, mnw, mxw = nd.contrib.quantize_v2(_nd(w))
+        acc, mn, mx_ = nd.contrib.quantized_conv(
+            qx, qw, None, mnx, mxx, mnw, mxw, _nd(0), _nd(0),
+            kernel=(3, 3), num_filter=3, no_bias=True)
+        sd = max(abs(float(mnx.asnumpy())), abs(float(mxx.asnumpy()))) / 127
+        sw = max(abs(float(mnw.asnumpy())), abs(float(mxw.asnumpy()))) / 127
+        got = acc.asnumpy().astype("float64") * sd * sw
+        want = nd.Convolution(_nd(x), _nd(w), kernel=(3, 3), num_filter=3,
+                              no_bias=True).asnumpy()
+        assert onp.abs(got - want).max() < 0.3
+
+    def test_quantized_pooling(self):
+        x = onp.arange(16, dtype="int8").reshape(1, 1, 4, 4)
+        q, mn, mx_ = nd.contrib.quantized_pooling(
+            mx.nd.array(x.astype("float32")).astype("int8"),
+            _nd(-1), _nd(1), kernel=(2, 2), stride=(2, 2),
+            pool_type="max")
+        onp.testing.assert_array_equal(q.asnumpy(),
+                                       [[[[5, 7], [13, 15]]]])
+
+    def test_quantized_elemwise_add(self):
+        a = _nd(onp.array([0.5, -0.25]))
+        b = _nd(onp.array([0.25, 0.25]))
+        qa, mna, mxa = nd.contrib.quantize_v2(a)
+        qb, mnb, mxb = nd.contrib.quantize_v2(b)
+        out, mn, mx_ = nd.contrib.quantized_elemwise_add(
+            qa, qb, mna, mxa, mnb, mxb)
+        s = float(mx_.asnumpy()) / (2.0 ** 31)
+        got = out.asnumpy() * s
+        onp.testing.assert_allclose(got, [0.75, 0.0], atol=0.01)
+
+    def test_calibrate_entropy(self):
+        rs = onp.random.RandomState(6)
+        acts = rs.randn(10000).astype("float32")
+        hist, edges = onp.histogram(acts, bins=1001)
+        mn, mx_ = nd.contrib.calibrate_entropy(_nd(hist), _nd(edges))
+        thr = float(mx_.asnumpy())
+        assert 0.5 < thr < 4.5  # a sane KL threshold for N(0,1)
+        assert float(mn.asnumpy()) == -thr
+
+
+class TestDGLGraph:
+    def _graph(self):
+        data_np = onp.arange(1, 21)
+        indices_np = onp.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                                0, 1, 2, 4, 0, 1, 2, 3])
+        indptr_np = onp.array([0, 4, 8, 12, 16, 20])
+        return mx.nd.sparse.csr_matrix((data_np, indices_np, indptr_np),
+                                       shape=(5, 5))
+
+    def test_uniform_sample_reference_example(self):
+        """ref: dgl_graph.cc:744 docstring example."""
+        a = self._graph()
+        seed = mx.nd.array(onp.arange(5, dtype="int64"))
+        v, subg, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+            a, seed, num_args=2, num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
+        assert v.asnumpy().tolist() == [0, 1, 2, 3, 4, 5]
+        assert layer.asnumpy().tolist() == [0, 0, 0, 0, 0]
+        dense = subg.asnumpy()
+        # sampled edges carry the original edge values
+        orig = a.asnumpy()
+        nz = dense != 0
+        onp.testing.assert_array_equal(dense[nz], orig[nz])
+        # each row sampled at most num_neighbor edges
+        assert (nz.sum(axis=1) <= 2).all()
+
+    def test_non_uniform_sample_respects_zero_prob(self):
+        a = self._graph()
+        prob = mx.nd.array(onp.array([1, 0, 0, 0, 1], "float32"))
+        seed = mx.nd.array(onp.array([1], "int64"))
+        v, subg, layer = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            a, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
+        dense = subg.asnumpy()
+        # only cols 0 and 4 can be sampled from row 1
+        assert dense[1, 1] == 0 and dense[1, 2] == 0 and dense[1, 3] == 0
+
+    def test_subgraph_reference_example(self):
+        """ref: dgl_graph.cc:1115 docstring example."""
+        x = onp.array([[1, 0, 0, 2], [3, 0, 4, 0],
+                       [0, 5, 0, 0], [0, 6, 7, 0]], "int64")
+        g = mx.nd.sparse.csr_matrix(x)
+        new, orig = nd.contrib.dgl_subgraph(
+            g, mx.nd.array([0, 1, 2]), num_args=2, return_mapping=True)
+        onp.testing.assert_array_equal(
+            new.asnumpy(), [[1, 0, 0], [2, 0, 3], [0, 4, 0]])
+        onp.testing.assert_array_equal(
+            orig.asnumpy(), [[1, 0, 0], [3, 0, 4], [0, 5, 0]])
+
+    def test_edge_id_reference_example(self):
+        x = onp.array([[1, 0, 0], [0, 2, 0], [0, 0, 3]], "int64")
+        g = mx.nd.sparse.csr_matrix(x)
+        out = nd.contrib.edge_id(g, mx.nd.array([0, 0, 1, 1, 2, 2]),
+                                 mx.nd.array([0, 1, 1, 2, 0, 2]))
+        assert out.asnumpy().tolist() == [1.0, -1.0, 2.0, -1.0, -1.0, 3.0]
+
+    def test_adjacency_and_getnnz(self):
+        a = self._graph()
+        adj = nd.contrib.dgl_adjacency(a)
+        assert adj.asnumpy().sum() == 20.0
+        assert int(nd.contrib.getnnz(a).asnumpy()) == 20
+        assert nd.contrib.getnnz(a, axis=1).asnumpy().tolist() == [4] * 5
+
+    def test_graph_compact(self):
+        a = self._graph()
+        seed = mx.nd.array(onp.array([0, 1], "int64"))
+        v, subg, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+            a, seed, num_args=2, num_hops=1, num_neighbor=1,
+            max_num_vertices=8)
+        n = int(v.asnumpy()[-1])
+        comp = nd.contrib.dgl_graph_compact(
+            subg, v, num_args=2, graph_sizes=(n,), return_mapping=False)
+        assert comp.shape == (n, n)
+
+
+class TestRNNParamConcat:
+    def test_concat(self):
+        a = _nd(onp.arange(4))
+        b = _nd(onp.arange(6))
+        out = nd._rnn_param_concat(a, b, dim=0)
+        assert out.shape == (10,)

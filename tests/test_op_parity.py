@@ -1,0 +1,129 @@
+"""Operator-surface parity audit against the reference registry.
+
+Scans every public `NNVM_REGISTER_OP` / `MXNET_REGISTER_OP_PROPERTY`
+name in the reference's src/operator/ and asserts each resolves in this
+framework — directly, via an alias, or via a documented semantic
+equivalent. The exemption list below is the complete set of reference
+names that intentionally have no direct counterpart, each with the
+reason (VERDICT r1 "Missing #1" closure criterion).
+
+Skips when /root/reference is not present (e.g. standalone checkouts).
+"""
+import glob
+import os
+import re
+
+import pytest
+
+REFERENCE = "/root/reference/src/operator"
+
+# Names that map to a *different* surface by design. Key -> where/why.
+SEMANTIC_EQUIVALENTS = {
+    # numpy scalar/int-axes variants: the reference splits these because
+    # its C++ dispatch cannot overload on python scalars; the jax-backed
+    # np namespace handles scalars in the same function
+    "_npi_true_divide_scalar": "np.true_divide(arr, scalar)",
+    "_npi_rtrue_divide_scalar": "np.true_divide(scalar, arr)",
+    "_npi_lcm_scalar": "np.lcm(arr, scalar)",
+    "_npi_tensordot_int_axes": "np.tensordot(a, b, int_axes)",
+    "_npi_boolean_mask_assign_scalar": "arr[mask] = scalar (setitem)",
+    "_npi_boolean_mask_assign_tensor": "arr[mask] = tensor (setitem)",
+    "_np__linalg_svd": "np.linalg.svd",
+}
+
+# Names that are not operators a user can reach, or that target other
+# hardware. Each entry documents why no counterpart exists.
+EXEMPT = {
+    # C++ macro-expansion artifacts the .cc regex scan picks up: the
+    # ##distr token-paste stamps the real per-distribution ops, which
+    # ARE registered (sample_normal, random_pdf_gamma, ...)
+    "_sample_##distr", "_random_pdf_##distr", "__name$", "name",
+    # backward halves: gradients come from jax autodiff, not separate
+    # registrations (SURVEY §2.1: FGradient -> jax.vjp by design)
+    "_broadcast_backward", "_split_v2_backward",
+    "_contrib_backward_hawkesll", "_contrib_backward_index_copy",
+    "_contrib_backward_quadratic",
+    # internal executor plumbing: cross-device copies are XLA
+    # device_put/sharding transfers, not graph ops
+    "_CrossDeviceCopy",
+    # plugin/vendor stubs: reference placeholders for external libs
+    # that do not exist on TPU (PARITY.md "known gaps")
+    "_Native", "_NDArray",     # plugin/torch bridge (reference plugin/)
+    "_TensorRT",               # TensorRT subgraph op (GPU inference)
+    "_sg_mkldnn_conv",         # MKLDNN fused subgraph (x86)
+    "_sg_mkldnn_fully_connected",
+    "_contrib_tvm_vadd",       # TVM codegen demo op
+}
+
+
+def _reference_names():
+    names = set()
+    for f in glob.glob(os.path.join(REFERENCE, "**/*.cc"), recursive=True):
+        txt = open(f, errors="ignore").read()
+        for m in re.finditer(r"NNVM_REGISTER_OP\(([^)]+)\)", txt):
+            names.add(m.group(1).strip())
+        for m in re.finditer(r"MXNET_REGISTER_OP_PROPERTY\(([^,]+),", txt):
+            names.add(m.group(1).strip())
+    return names
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE),
+                    reason="reference checkout not available")
+def test_every_reference_op_resolves():
+    import mxnet_tpu as mx
+    import mxnet_tpu.numpy as mnp
+    import mxnet_tpu.numpy_extension as npx
+
+    modules = [mx.nd, mnp, npx, mx.sym,
+               getattr(mx.nd, "contrib", None),
+               getattr(mx.nd, "image", None),
+               getattr(mx.nd, "linalg", None),
+               getattr(mx.nd, "sparse", None),
+               getattr(mnp, "linalg", None),
+               getattr(mnp, "random", None)]
+
+    def resolves(n):
+        cands = {n, n.lstrip("_")}
+        base = n.lstrip("_")
+        for pre in ("npi_", "np_", "np__", "npx_", "contrib_", "image_",
+                    "sparse_", "linalg_", "random_", "sample_"):
+            if base.startswith(pre):
+                cands.add(base[len(pre):])
+                cands.add("_" + base[len(pre):])
+        return any(m is not None and hasattr(m, c)
+                   for c in cands for m in modules)
+
+    unresolved = []
+    for n in sorted(_reference_names()):
+        if n.startswith("_backward_"):
+            continue  # autodiff by design (SURVEY §2.1)
+        if n in EXEMPT or n in SEMANTIC_EQUIVALENTS:
+            continue
+        if not resolves(n):
+            unresolved.append(n)
+    assert not unresolved, (
+        "reference ops with no counterpart and no documented exemption: "
+        f"{unresolved}")
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE),
+                    reason="reference checkout not available")
+def test_semantic_equivalents_actually_work():
+    """The claimed equivalents must really exist and run."""
+    import numpy as onp
+    import mxnet_tpu.numpy as np
+
+    a = np.array([4.0, 6.0])
+    onp.testing.assert_allclose(np.true_divide(a, 2).asnumpy(), [2, 3])
+    onp.testing.assert_allclose(np.true_divide(2, a).asnumpy(),
+                                [0.5, 1 / 3], rtol=1e-6)
+    onp.testing.assert_allclose(
+        np.lcm(np.array([4, 6], dtype="int32"), 3).asnumpy(), [12, 6])
+    assert np.tensordot(np.ones((2, 3)), np.ones((3, 4)), 1).shape == (2, 4)
+    m = np.array([[1.0, 2.0], [3.0, 4.0]])
+    mask = m > 2
+    m[mask] = 0.0
+    onp.testing.assert_allclose(m.asnumpy(), [[1, 2], [0, 0]])
+    u, s, vt = np.linalg.svd(np.array([[2.0, 0.0], [0.0, 1.0]]))
+    onp.testing.assert_allclose(sorted(s.asnumpy().tolist()), [1.0, 2.0],
+                                atol=1e-5)

@@ -320,7 +320,16 @@ class HybridBlock(Block):
 
     def forward(self, x, *args):
         """Eager path: pass NDArrays + param NDArrays to hybrid_forward
-        (ref: block.py:1054 HybridBlock.forward)."""
+        (ref: block.py:1054 HybridBlock.forward). Symbol inputs switch F
+        to the symbol namespace and bind params as named variables — the
+        reference's symbolic tracing path (``net(mx.sym.var('data'))``),
+        which is what ONNX export and Module bind consume."""
+        from ..symbol import Symbol as _Sym
+        if isinstance(x, _Sym):
+            from .. import symbol as _sym_mod
+            params = {name: _sym_mod.var(p.name)
+                      for name, p in self._reg_params.items()}
+            return self.hybrid_forward(_sym_mod, x, *args, **params)
         params = {}
         for name, p in self._reg_params.items():
             try:

@@ -107,6 +107,10 @@ def make_symbol_op_func(opdef, public_name):
             no_bias = bool(attrs.get("no_bias", False))
             for iname in input_names:
                 v = provided.get(iname)
+                if v is None and iname in provided:
+                    # explicit None (e.g. bias=None passed positionally)
+                    # must not survive into the input list
+                    del provided[iname]
                 if v is None:
                     if iname == "bias" and no_bias:
                         continue
@@ -139,7 +143,9 @@ def make_symbol_op_func(opdef, public_name):
         node = _Node(opdef.name, node_name, attrs, inputs)
         from .symbol import _num_outputs_of
         node.num_outputs = _num_outputs_of(node)
-        return Symbol([(node, 0)])
+        # multi-output ops (BatchNorm's out/mean/var, ...) return a group
+        # symbol so tuple-unpacking works like the eager path
+        return Symbol([(node, i) for i in range(node.num_outputs)])
 
     op_func.__name__ = public_name
     op_func.__doc__ = opdef.fn.__doc__

@@ -175,10 +175,13 @@ class BatchNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         from ... import autograd
-        out, mean, var = F.BatchNorm(
+        bn = F.BatchNorm(
             x, gamma, beta, running_mean, running_var, eps=self._eps,
             momentum=self._momentum, fix_gamma=not self._scale,
             use_global_stats=self._use_global_stats, axis=self._axis)
+        if len(bn) == 1:
+            return bn  # symbolic trace: single visible output
+        out, mean, var = bn
         if autograd.is_training() and not self._use_global_stats:
             m = self._momentum
             new_mean = m * running_mean._data + (1 - m) * mean._data \

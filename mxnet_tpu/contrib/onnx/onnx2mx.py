@@ -1,0 +1,289 @@
+"""ONNX ModelProto -> Symbol graph import.
+
+ref: python/mxnet/contrib/onnx/onnx2mx/_op_translations.py +
+import_model.py / import_onnx.py GraphProto.from_onnx. Returns
+(sym, arg_params, aux_params) exactly like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto as P
+
+__all__ = ["import_graph"]
+
+
+def _pads_to_mx(pads):
+    if not pads:
+        return (0, 0)
+    k = len(pads) // 2
+    begin, end = pads[:k], pads[k:]
+    if list(begin) != list(end):
+        raise NotImplementedError("asymmetric ONNX pads %r" % (pads,))
+    return tuple(int(p) for p in begin)
+
+
+def _conv(sym, ins, attrs, name, initializers):
+    kwargs = dict(kernel=tuple(attrs["kernel_shape"]),
+                  stride=tuple(attrs.get("strides", (1, 1))),
+                  dilate=tuple(attrs.get("dilations", (1, 1))),
+                  pad=_pads_to_mx(attrs.get("pads")),
+                  num_group=int(attrs.get("group", 1)))
+    weight = initializers[ins[1].name]
+    kwargs["num_filter"] = int(weight.shape[0])
+    if len(ins) == 2:
+        return sym.Convolution(ins[0].sym, ins[1].sym, no_bias=True,
+                               name=name, **kwargs)
+    return sym.Convolution(ins[0].sym, ins[1].sym, ins[2].sym,
+                           no_bias=False, name=name, **kwargs)
+
+
+def _deconv(sym, ins, attrs, name, initializers):
+    kwargs = dict(kernel=tuple(attrs["kernel_shape"]),
+                  stride=tuple(attrs.get("strides", (1, 1))),
+                  dilate=tuple(attrs.get("dilations", (1, 1))),
+                  pad=_pads_to_mx(attrs.get("pads")),
+                  num_group=int(attrs.get("group", 1)))
+    weight = initializers[ins[1].name]
+    kwargs["num_filter"] = int(weight.shape[1]) * kwargs["num_group"]
+    args = [i.sym for i in ins]
+    return sym.Deconvolution(*args, no_bias=(len(ins) == 2), name=name,
+                             **kwargs)
+
+
+def _bn(sym, ins, attrs, name, initializers):
+    return sym.BatchNorm(*[i.sym for i in ins], name=name,
+                         eps=float(attrs.get("epsilon", 1e-5)),
+                         momentum=float(attrs.get("momentum", 0.9)),
+                         fix_gamma=False, use_global_stats=False)
+
+
+def _gemm(sym, ins, attrs, name, initializers):
+    if attrs.get("transA", 0):
+        raise NotImplementedError("Gemm transA=1")
+    weight = initializers.get(ins[1].name)
+    if not attrs.get("transB", 0):
+        if weight is None:
+            raise NotImplementedError("Gemm transB=0 with dynamic B")
+        initializers[ins[1].name] = np.ascontiguousarray(weight.T)
+        weight = initializers[ins[1].name]
+    num_hidden = int(weight.shape[0])
+    args = [i.sym for i in ins]
+    return sym.FullyConnected(*args, num_hidden=num_hidden,
+                              no_bias=(len(ins) == 2), flatten=False,
+                              name=name)
+
+
+def _pool(ptype, global_pool):
+    def f(sym, ins, attrs, name, initializers):
+        if global_pool:
+            return sym.Pooling(ins[0].sym, pool_type=ptype,
+                               global_pool=True, kernel=(1, 1), name=name)
+        kwargs = dict(kernel=tuple(attrs["kernel_shape"]),
+                      stride=tuple(attrs.get("strides", (1, 1))),
+                      pad=_pads_to_mx(attrs.get("pads")),
+                      pool_type=ptype,
+                      pooling_convention=("full" if attrs.get("ceil_mode")
+                                          else "valid"))
+        if ptype == "avg":
+            kwargs["count_include_pad"] = \
+                bool(attrs.get("count_include_pad", 1))
+        return sym.Pooling(ins[0].sym, name=name, **kwargs)
+    return f
+
+
+def _act(mx_type):
+    def f(sym, ins, attrs, name, initializers):
+        return sym.Activation(ins[0].sym, act_type=mx_type, name=name)
+    return f
+
+
+def _binop(op_name):
+    def f(sym, ins, attrs, name, initializers):
+        return getattr(sym, op_name)(ins[0].sym, ins[1].sym, name=name)
+    return f
+
+
+def _flatten(sym, ins, attrs, name, initializers):
+    return sym.Flatten(ins[0].sym, name=name)
+
+
+def _concat(sym, ins, attrs, name, initializers):
+    return sym.concat(*[i.sym for i in ins],
+                      dim=int(attrs.get("axis", 1)), name=name)
+
+
+def _softmax(sym, ins, attrs, name, initializers):
+    return sym.softmax(ins[0].sym, axis=int(attrs.get("axis", -1)),
+                       name=name)
+
+
+def _dropout(sym, ins, attrs, name, initializers):
+    return sym.Dropout(ins[0].sym, name=name)
+
+
+def _reshape(sym, ins, attrs, name, initializers):
+    shape = initializers.get(ins[1].name) if len(ins) > 1 else \
+        np.asarray(attrs.get("shape", ()))
+    if shape is None:
+        raise NotImplementedError("Reshape with dynamic shape input")
+    return sym.Reshape(ins[0].sym, shape=tuple(int(s) for s in shape),
+                       name=name)
+
+
+def _transpose(sym, ins, attrs, name, initializers):
+    perm = attrs.get("perm")
+    return sym.transpose(ins[0].sym,
+                         axes=tuple(int(p) for p in perm) if perm else (),
+                         name=name)
+
+
+def _clip(sym, ins, attrs, name, initializers):
+    # ONNX: absent bounds mean unbounded (-inf/+inf), not 0
+    lo = float(initializers[ins[1].name]) if len(ins) > 1 else \
+        float(attrs.get("min", -np.inf))
+    hi = float(initializers[ins[2].name]) if len(ins) > 2 else \
+        float(attrs.get("max", np.inf))
+    return sym.clip(ins[0].sym, a_min=lo, a_max=hi, name=name)
+
+
+def _leaky(sym, ins, attrs, name, initializers):
+    return sym.LeakyReLU(ins[0].sym, act_type="leaky",
+                         slope=float(attrs.get("alpha", 0.01)), name=name)
+
+
+def _prelu(sym, ins, attrs, name, initializers):
+    return sym.LeakyReLU(ins[0].sym, ins[1].sym, act_type="prelu",
+                         name=name)
+
+
+def _elu(sym, ins, attrs, name, initializers):
+    return sym.LeakyReLU(ins[0].sym, act_type="elu",
+                         slope=float(attrs.get("alpha", 1.0)), name=name)
+
+
+def _gelu(sym, ins, attrs, name, initializers):
+    return sym.LeakyReLU(ins[0].sym, act_type="gelu", name=name)
+
+
+def _identity(sym, ins, attrs, name, initializers):
+    return sym.identity(ins[0].sym, name=name)
+
+
+def _gather(sym, ins, attrs, name, initializers):
+    # Embedding pattern: Gather(weight, int_indices)
+    w = initializers.get(ins[0].name)
+    if w is None:
+        raise NotImplementedError("Gather with dynamic data")
+    return sym.Embedding(ins[1].sym, ins[0].sym, input_dim=int(w.shape[0]),
+                         output_dim=int(w.shape[1]), name=name)
+
+
+def _cast(sym, ins, attrs, name, initializers):
+    onnx2np = {P.DT_FLOAT: "float32", P.DT_INT32: "int32",
+               P.DT_INT64: "int64", P.DT_FLOAT16: "float16",
+               P.DT_DOUBLE: "float64", P.DT_BOOL: "bool",
+               P.DT_UINT8: "uint8", P.DT_INT8: "int8"}
+    return sym.Cast(ins[0].sym, dtype=onnx2np[int(attrs["to"])], name=name)
+
+
+def _reduce_mean(sym, ins, attrs, name, initializers):
+    axes = attrs.get("axes")
+    return sym.mean(ins[0].sym,
+                    axis=tuple(int(a) for a in axes) if axes else None,
+                    keepdims=bool(attrs.get("keepdims", 1)), name=name)
+
+
+_TABLE = {
+    "Conv": _conv,
+    "ConvTranspose": _deconv,
+    "BatchNormalization": _bn,
+    "Gemm": _gemm,
+    "MatMul": _binop("dot"),
+    "MaxPool": _pool("max", False),
+    "AveragePool": _pool("avg", False),
+    "GlobalMaxPool": _pool("max", True),
+    "GlobalAveragePool": _pool("avg", True),
+    "Relu": _act("relu"),
+    "Sigmoid": _act("sigmoid"),
+    "Tanh": _act("tanh"),
+    "Softplus": _act("softrelu"),
+    "Softsign": _act("softsign"),
+    "LeakyRelu": _leaky,
+    "PRelu": _prelu,
+    "Elu": _elu,
+    "Gelu": _gelu,
+    "Add": _binop("broadcast_add"),
+    "Sub": _binop("broadcast_sub"),
+    "Mul": _binop("broadcast_mul"),
+    "Div": _binop("broadcast_div"),
+    "Flatten": _flatten,
+    "Concat": _concat,
+    "Softmax": _softmax,
+    "Dropout": _dropout,
+    "Reshape": _reshape,
+    "Transpose": _transpose,
+    "Clip": _clip,
+    "Identity": _identity,
+    "Gather": _gather,
+    "Cast": _cast,
+    "ReduceMean": _reduce_mean,
+    "Exp": lambda sym, ins, a, n, i: sym.exp(ins[0].sym, name=n),
+    "Log": lambda sym, ins, a, n, i: sym.log(ins[0].sym, name=n),
+    "Sqrt": lambda sym, ins, a, n, i: sym.sqrt(ins[0].sym, name=n),
+}
+
+
+class _Val:
+    __slots__ = ("name", "sym")
+
+    def __init__(self, name, sym):
+        self.name = name
+        self.sym = sym
+
+
+def import_graph(model):
+    """ModelProto -> (sym, arg_params, aux_params)
+    (ref: onnx2mx/import_onnx.py GraphProto.from_onnx)."""
+    import mxnet_tpu as mx
+
+    g = model.graph
+    initializers = {t.name: P.tensor_to_numpy(t)
+                    for t in g.initializers}
+    vals = {}
+    # graph inputs that are not initializers are data
+    for vi in g.inputs:
+        if vi.name not in initializers:
+            vals[vi.name] = _Val(vi.name, mx.sym.var(vi.name))
+    for name in initializers:
+        vals[name] = _Val(name, mx.sym.var(name))
+
+    for node in g.nodes:
+        fn = _TABLE.get(node.op_type)
+        if fn is None:
+            raise NotImplementedError(
+                "ONNX import: no translation for %r (ref: onnx2mx/"
+                "_op_translations.py)" % node.op_type)
+        ins = [vals[i] for i in node.inputs if i]
+        name = node.name or node.outputs[0]
+        out = fn(mx.sym, ins, node.attrs, name, initializers)
+        for i, oname in enumerate(node.outputs):
+            vals[oname] = _Val(oname, out[i] if len(node.outputs) > 1
+                               else out)
+
+    outs = [vals[vi.name].sym for vi in g.outputs]
+    sym = outs[0] if len(outs) == 1 else mx.sym.Group(outs)
+
+    # split params by the symbol's own arg/aux classification; the
+    # imported graph's variable names are the initializer names
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params = {}
+    aux_params = {}
+    for name, arr in initializers.items():
+        nd_arr = mx.nd.array(arr)
+        if name in aux_names:
+            aux_params[name] = nd_arr
+        elif name in arg_names:
+            arg_params[name] = nd_arr
+    return sym, arg_params, aux_params

@@ -1,0 +1,435 @@
+"""Minimal protobuf wire-format codec for the ONNX message subset.
+
+The reference's ONNX bridge depends on the `onnx` pip package purely for
+(de)serializing ModelProto (ref: python/mxnet/contrib/onnx/onnx2mx/
+import_model.py:30). ONNX files are plain protobuf, and protobuf's wire
+format is simple varint/length-delimited framing — so this module
+implements exactly the fields the bridge needs, with no dependency.
+
+Field numbers follow onnx/onnx.proto3 (ONNX IR v4+, opset-independent):
+ModelProto{1:ir_version, 2:producer_name, 3:producer_version, 7:graph,
+8:opset_import}; GraphProto{1:node, 2:name, 5:initializer, 11:input,
+12:output, 13:value_info}; NodeProto{1:input, 2:output, 3:name,
+4:op_type, 5:attribute}; AttributeProto{1:name, 2:f, 3:i, 4:s, 5:t,
+7:floats, 8:ints, 9:strings, 20:type}; TensorProto{1:dims, 2:data_type,
+4:float_data, 7:int64_data, 8:name, 9:raw_data};
+ValueInfoProto{1:name, 2:type}; TypeProto{1:tensor_type{1:elem_type,
+2:shape{1:dim{1:dim_value, 2:dim_param}}}};
+OperatorSetIdProto{1:domain, 2:version}.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+__all__ = ["TensorProto", "AttributeProto", "NodeProto", "GraphProto",
+           "ModelProto", "ValueInfo", "encode_model", "decode_model",
+           "tensor_from_numpy", "tensor_to_numpy"]
+
+# ONNX TensorProto.DataType
+DT_FLOAT, DT_UINT8, DT_INT8, DT_INT32, DT_INT64 = 1, 2, 3, 6, 7
+DT_BOOL, DT_FLOAT16, DT_DOUBLE = 9, 10, 11
+
+_NP2ONNX = {np.dtype("float32"): DT_FLOAT, np.dtype("uint8"): DT_UINT8,
+            np.dtype("int8"): DT_INT8, np.dtype("int32"): DT_INT32,
+            np.dtype("int64"): DT_INT64, np.dtype("bool"): DT_BOOL,
+            np.dtype("float16"): DT_FLOAT16, np.dtype("float64"): DT_DOUBLE}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR = 1, 2, 3, 4
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+def _w_varint(out, v):
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_tag(out, field, wire):
+    _w_varint(out, (field << 3) | wire)
+
+
+def _w_len(out, field, payload):
+    _w_tag(out, field, 2)
+    _w_varint(out, len(payload))
+    out.extend(payload)
+
+
+def _w_int(out, field, v):
+    _w_tag(out, field, 0)
+    _w_varint(out, int(v))
+
+
+def _w_float(out, field, v):
+    _w_tag(out, field, 5)
+    out.extend(struct.pack("<f", float(v)))
+
+
+def _w_str(out, field, s):
+    _w_len(out, field, s.encode() if isinstance(s, str) else s)
+
+
+def _r_varint(buf, pos):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return val, pos
+
+
+def _signed(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _scan(buf):
+    """Parse one message level into {field: [(wire, value), ...]}."""
+    fields = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _r_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, pos = _r_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _r_varint(buf, pos)
+            v = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        fields.setdefault(field, []).append((wire, v))
+    return fields
+
+
+def _one(fields, num, default=None):
+    vs = fields.get(num)
+    return vs[-1][1] if vs else default
+
+
+def _many(fields, num):
+    return [v for _, v in fields.get(num, ())]
+
+
+def _packed_ints(fields, num):
+    out = []
+    for wire, v in fields.get(num, ()):
+        if wire == 0:
+            out.append(_signed(v))
+        else:  # packed
+            pos = 0
+            while pos < len(v):
+                x, pos = _r_varint(v, pos)
+                out.append(_signed(x))
+    return out
+
+
+def _packed_floats(fields, num):
+    out = []
+    for wire, v in fields.get(num, ()):
+        if wire == 5:
+            out.append(v)
+        else:
+            out.extend(struct.unpack("<%df" % (len(v) // 4), v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# message classes (plain data holders)
+# ---------------------------------------------------------------------------
+
+class TensorProto:
+    def __init__(self, name="", dims=(), data_type=DT_FLOAT, raw=b""):
+        self.name = name
+        self.dims = list(dims)
+        self.data_type = data_type
+        self.raw = raw
+
+    def encode(self):
+        out = bytearray()
+        for d in self.dims:
+            _w_int(out, 1, d)
+        _w_int(out, 2, self.data_type)
+        _w_str(out, 8, self.name)
+        _w_len(out, 9, self.raw)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        f = _scan(buf)
+        t = cls(name=_one(f, 8, b"").decode(),
+                dims=_packed_ints(f, 1),
+                data_type=_one(f, 2, DT_FLOAT))
+        t.raw = _one(f, 9, b"")
+        if not t.raw:
+            # fall back to typed repeated fields
+            fd = _packed_floats(f, 4)
+            if fd:
+                t.raw = np.asarray(fd, np.float32).tobytes()
+            else:
+                i64 = _packed_ints(f, 7)
+                if i64:
+                    t.raw = np.asarray(i64, np.int64).tobytes()
+                else:
+                    i32 = _packed_ints(f, 5)
+                    if i32:
+                        if t.data_type == DT_FLOAT16:
+                            # spec stores fp16 as raw uint16 bit patterns
+                            # inside int32_data, not numeric values
+                            t.raw = np.asarray(i32, np.uint16) \
+                                .view(np.float16).tobytes()
+                        else:
+                            dt = _ONNX2NP.get(t.data_type,
+                                              np.dtype("int32"))
+                            t.raw = np.asarray(i32, dt).tobytes()
+        return t
+
+
+def tensor_from_numpy(name, arr):
+    arr = np.ascontiguousarray(arr)
+    return TensorProto(name=name, dims=arr.shape,
+                       data_type=_NP2ONNX[arr.dtype], raw=arr.tobytes())
+
+
+def tensor_to_numpy(t):
+    dt = _ONNX2NP.get(t.data_type)
+    if dt is None:
+        raise ValueError("unsupported ONNX tensor dtype %d" % t.data_type)
+    return np.frombuffer(t.raw, dt).reshape(t.dims).copy()
+
+
+class AttributeProto:
+    def __init__(self, name, value):
+        self.name = name
+        self.value = value
+
+    def encode(self):
+        out = bytearray()
+        _w_str(out, 1, self.name)
+        v = self.value
+        if isinstance(v, float):
+            _w_float(out, 2, v)
+            _w_int(out, 20, AT_FLOAT)
+        elif isinstance(v, bool) or isinstance(v, int):
+            _w_int(out, 3, int(v))
+            _w_int(out, 20, AT_INT)
+        elif isinstance(v, str):
+            _w_str(out, 4, v)
+            _w_int(out, 20, AT_STRING)
+        elif isinstance(v, TensorProto):
+            _w_len(out, 5, v.encode())
+            _w_int(out, 20, AT_TENSOR)
+        elif isinstance(v, (list, tuple)):
+            if v and isinstance(v[0], float):
+                for x in v:
+                    _w_float(out, 7, x)
+                _w_int(out, 20, AT_FLOATS)
+            elif v and isinstance(v[0], str):
+                for x in v:
+                    _w_str(out, 9, x)
+                _w_int(out, 20, AT_STRINGS)
+            else:
+                for x in v:
+                    _w_int(out, 8, int(x))
+                _w_int(out, 20, AT_INTS)
+        else:
+            raise TypeError("unsupported attribute %r=%r" % (self.name, v))
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        f = _scan(buf)
+        name = _one(f, 1, b"").decode()
+        at = _one(f, 20, 0)
+        if at == AT_FLOAT or (at == 0 and 2 in f):
+            return cls(name, _one(f, 2))
+        if at == AT_INT or (at == 0 and 3 in f):
+            return cls(name, _signed(_one(f, 3)))
+        if at == AT_STRING or (at == 0 and 4 in f):
+            return cls(name, _one(f, 4, b"").decode())
+        if at == AT_TENSOR or (at == 0 and 5 in f):
+            return cls(name, TensorProto.decode(_one(f, 5)))
+        if at == AT_FLOATS or (at == 0 and 7 in f):
+            return cls(name, _packed_floats(f, 7))
+        if at == AT_INTS or (at == 0 and 8 in f):
+            return cls(name, _packed_ints(f, 8))
+        if at == AT_STRINGS or (at == 0 and 9 in f):
+            return cls(name, [s.decode() for s in _many(f, 9)])
+        return cls(name, None)
+
+
+class NodeProto:
+    def __init__(self, op_type, name="", inputs=(), outputs=(), attrs=None):
+        self.op_type = op_type
+        self.name = name
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.attrs = dict(attrs or {})
+
+    def encode(self):
+        out = bytearray()
+        for i in self.inputs:
+            _w_str(out, 1, i)
+        for o in self.outputs:
+            _w_str(out, 2, o)
+        _w_str(out, 3, self.name)
+        _w_str(out, 4, self.op_type)
+        for k, v in self.attrs.items():
+            _w_len(out, 5, AttributeProto(k, v).encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        f = _scan(buf)
+        attrs = {}
+        for a in _many(f, 5):
+            ap = AttributeProto.decode(a)
+            attrs[ap.name] = ap.value
+        return cls(op_type=_one(f, 4, b"").decode(),
+                   name=_one(f, 3, b"").decode(),
+                   inputs=[s.decode() for s in _many(f, 1)],
+                   outputs=[s.decode() for s in _many(f, 2)],
+                   attrs=attrs)
+
+
+class ValueInfo:
+    def __init__(self, name, elem_type=DT_FLOAT, shape=()):
+        self.name = name
+        self.elem_type = elem_type
+        self.shape = list(shape)   # ints or strings (dim_param)
+
+    def encode(self):
+        shp = bytearray()
+        for d in self.shape:
+            dim = bytearray()
+            if isinstance(d, str):
+                _w_str(dim, 2, d)
+            else:
+                _w_int(dim, 1, d)
+            _w_len(shp, 1, dim)
+        tt = bytearray()
+        _w_int(tt, 1, self.elem_type)
+        _w_len(tt, 2, shp)
+        tp = bytearray()
+        _w_len(tp, 1, tt)
+        out = bytearray()
+        _w_str(out, 1, self.name)
+        _w_len(out, 2, tp)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        f = _scan(buf)
+        name = _one(f, 1, b"").decode()
+        elem, shape = DT_FLOAT, []
+        tp = _one(f, 2)
+        if tp:
+            tf = _scan(tp)
+            tt = _one(tf, 1)
+            if tt:
+                ttf = _scan(tt)
+                elem = _one(ttf, 1, DT_FLOAT)
+                shp = _one(ttf, 2)
+                if shp:
+                    for dim in _many(_scan(shp), 1):
+                        df = _scan(dim)
+                        if 1 in df:
+                            shape.append(_signed(_one(df, 1)))
+                        else:
+                            shape.append(_one(df, 2, b"").decode())
+        return cls(name, elem, shape)
+
+
+class GraphProto:
+    def __init__(self, name="graph"):
+        self.name = name
+        self.nodes = []
+        self.initializers = []
+        self.inputs = []     # ValueInfo
+        self.outputs = []    # ValueInfo
+
+    def encode(self):
+        out = bytearray()
+        for n in self.nodes:
+            _w_len(out, 1, n.encode())
+        _w_str(out, 2, self.name)
+        for t in self.initializers:
+            _w_len(out, 5, t.encode())
+        for vi in self.inputs:
+            _w_len(out, 11, vi.encode())
+        for vi in self.outputs:
+            _w_len(out, 12, vi.encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        f = _scan(buf)
+        g = cls(name=_one(f, 2, b"graph").decode())
+        g.nodes = [NodeProto.decode(b) for b in _many(f, 1)]
+        g.initializers = [TensorProto.decode(b) for b in _many(f, 5)]
+        g.inputs = [ValueInfo.decode(b) for b in _many(f, 11)]
+        g.outputs = [ValueInfo.decode(b) for b in _many(f, 12)]
+        return g
+
+
+class ModelProto:
+    def __init__(self, graph=None, ir_version=7, opset=13,
+                 producer="mxnet_tpu"):
+        self.graph = graph
+        self.ir_version = ir_version
+        self.opset = opset
+        self.producer = producer
+
+    def encode(self):
+        out = bytearray()
+        _w_int(out, 1, self.ir_version)
+        _w_str(out, 2, self.producer)
+        _w_len(out, 7, self.graph.encode())
+        ops = bytearray()
+        _w_str(ops, 1, "")
+        _w_int(ops, 2, self.opset)
+        _w_len(out, 8, ops)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf):
+        f = _scan(buf)
+        m = cls(ir_version=_one(f, 1, 7),
+                producer=_one(f, 2, b"").decode())
+        ops = _one(f, 8)
+        if ops:
+            m.opset = _one(_scan(ops), 2, 13)
+        g = _one(f, 7)
+        m.graph = GraphProto.decode(g) if g else None
+        return m
+
+
+def encode_model(model):
+    return model.encode()
+
+
+def decode_model(data):
+    return ModelProto.decode(data)

@@ -1,0 +1,177 @@
+/* Train an MLP classifier through the C ABI — no Python in this file.
+ *
+ * The cpp-package-style demo the reference enables via its C surface
+ * (ref: cpp-package/include/mxnet-cpp/ndarray.h over include/mxnet/
+ * c_api.h): create NDArrays, invoke registered ops by name, record an
+ * autograd tape, backward, and apply SGD updates, all via MXT* entry
+ * points from libmxnet_tpu.so. Data is synthetic MNIST-shaped
+ * (784-dim inputs, 10 classes, linearly separable blobs) so the demo
+ * is self-contained; the assertion is that training loss drops 5x.
+ *
+ * Build (see tests/test_capi_train.py which runs this in CI):
+ *   gcc -O2 train_mnist.c -o train_mnist \
+ *       -L$REPO/mxnet_tpu -lmxnet_tpu -Wl,-rpath,$REPO/mxnet_tpu
+ *   PYTHONPATH=$REPO JAX_PLATFORMS=cpu ./train_mnist
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+/* ---- ABI (mirrors src/c_api_runtime.cc declarations) ---- */
+extern const char* MXTGetLastError(void);
+extern int MXTNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
+                            void** out);
+extern int MXTNDArrayFromData(const int64_t* shape, uint32_t ndim,
+                              int dtype, const void* data, size_t nbytes,
+                              void** out);
+extern int MXTNDArrayFree(void* h);
+extern int MXTNDArraySyncCopyToCPU(void* h, void* data, size_t nbytes);
+extern int MXTImperativeInvoke(const char* op, uint32_t nin, void** in,
+                               uint32_t nparam, const char** keys,
+                               const char** vals, uint32_t* nout,
+                               void** out, uint32_t max_out);
+extern int MXTAutogradMarkVariables(uint32_t n, void** h);
+extern int MXTAutogradSetIsRecording(int rec);
+extern int MXTAutogradBackward(uint32_t n, void** out);
+extern int MXTNDArrayGetGrad(void* h, void** grad);
+
+#define CHECK(rc) do { \
+    if ((rc) != 0) { \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, \
+              MXTGetLastError()); \
+      exit(1); \
+    } } while (0)
+
+#define F32 0
+
+static void* invoke1(const char* op, uint32_t nin, void** in,
+                     uint32_t nparam, const char** keys,
+                     const char** vals) {
+  void* outs[4];
+  uint32_t nout = 0;
+  CHECK(MXTImperativeInvoke(op, nin, in, nparam, keys, vals, &nout, outs,
+                            4));
+  /* ops like BatchNorm return extras; the primary output is outs[0] —
+     free the rest */
+  for (uint32_t i = 1; i < nout; ++i) MXTNDArrayFree(outs[i]);
+  return outs[0];
+}
+
+int main(void) {
+  const int N = 256, D = 784, H = 64, C = 10, EPOCHS = 30;
+  const float LR = 0.5f;
+
+  /* synthetic separable data: class c has mean one-hot spread */
+  float* x = (float*)malloc((size_t)N * D * sizeof(float));
+  float* y = (float*)malloc((size_t)N * sizeof(float));
+  srand(7);
+  for (int i = 0; i < N; ++i) {
+    int c = i % C;
+    y[i] = (float)c;
+    for (int j = 0; j < D; ++j) {
+      float noise = ((float)rand() / RAND_MAX - 0.5f) * 0.5f;
+      x[i * D + j] = noise + ((j % C) == c ? 1.0f : 0.0f);
+    }
+  }
+
+  /* parameters as C buffers; uploaded fresh each step after updates */
+  float* w1 = (float*)calloc((size_t)D * H, sizeof(float));
+  float* b1 = (float*)calloc((size_t)H, sizeof(float));
+  float* w2 = (float*)calloc((size_t)H * C, sizeof(float));
+  float* b2 = (float*)calloc((size_t)C, sizeof(float));
+  for (int i = 0; i < D * H; ++i)
+    w1[i] = ((float)rand() / RAND_MAX - 0.5f) * 0.05f;
+  for (int i = 0; i < H * C; ++i)
+    w2[i] = ((float)rand() / RAND_MAX - 0.5f) * 0.05f;
+
+  int64_t xs[2] = {N, D}, ys1[1] = {N};
+  int64_t w1s[2] = {H, D}, b1s[1] = {H}, w2s[2] = {C, H}, b2s[1] = {C};
+  /* note FullyConnected weight layout is (num_hidden, input_dim) like
+     the reference */
+  float* w1t = (float*)malloc((size_t)D * H * sizeof(float));
+  float* w2t = (float*)malloc((size_t)H * C * sizeof(float));
+
+  void* xa = NULL;
+  void* ya = NULL;
+  CHECK(MXTNDArrayFromData(xs, 2, F32, x, (size_t)N * D * 4, &xa));
+  CHECK(MXTNDArrayFromData(ys1, 1, F32, y, (size_t)N * 4, &ya));
+
+  float first_loss = -1.0f, last_loss = -1.0f;
+  for (int ep = 0; ep < EPOCHS; ++ep) {
+    /* upload parameters (row-major (H,D)/(C,H)) */
+    for (int i = 0; i < H; ++i)
+      for (int j = 0; j < D; ++j) w1t[i * D + j] = w1[j * H + i];
+    for (int i = 0; i < C; ++i)
+      for (int j = 0; j < H; ++j) w2t[i * H + j] = w2[j * C + i];
+    void* W1 = NULL; void* B1 = NULL; void* W2 = NULL; void* B2 = NULL;
+    CHECK(MXTNDArrayFromData(w1s, 2, F32, w1t, (size_t)D * H * 4, &W1));
+    CHECK(MXTNDArrayFromData(b1s, 1, F32, b1, (size_t)H * 4, &B1));
+    CHECK(MXTNDArrayFromData(w2s, 2, F32, w2t, (size_t)H * C * 4, &W2));
+    CHECK(MXTNDArrayFromData(b2s, 1, F32, b2, (size_t)C * 4, &B2));
+    void* params[4] = {W1, B1, W2, B2};
+    CHECK(MXTAutogradMarkVariables(4, params));
+
+    CHECK(MXTAutogradSetIsRecording(1));
+    const char* fck[] = {"num_hidden"};
+    const char* fcv1[] = {"64"};
+    void* in1[3] = {xa, W1, B1};
+    void* h1 = invoke1("FullyConnected", 3, in1, 1, fck, fcv1);
+    const char* ak[] = {"act_type"};
+    const char* av[] = {"relu"};
+    void* h1r = invoke1("Activation", 1, &h1, 1, ak, av);
+    const char* fcv2[] = {"10"};
+    void* in2[3] = {h1r, W2, B2};
+    void* logits = invoke1("FullyConnected", 3, in2, 1, fck, fcv2);
+    /* softmax cross entropy: returns per-batch loss (ref:
+       softmax_cross_entropy op) */
+    void* in3[2] = {logits, ya};
+    void* loss = invoke1("softmax_cross_entropy", 2, in3, 0, NULL, NULL);
+    CHECK(MXTAutogradSetIsRecording(0));
+    CHECK(MXTAutogradBackward(1, &loss));
+
+    float lval = 0.0f;
+    CHECK(MXTNDArraySyncCopyToCPU(loss, &lval, sizeof lval));
+    lval /= (float)N;
+    if (ep == 0) first_loss = lval;
+    last_loss = lval;
+
+    /* SGD: pull grads, update C-side buffers */
+    void* grads[4] = {NULL, NULL, NULL, NULL};
+    for (int p = 0; p < 4; ++p) CHECK(MXTNDArrayGetGrad(params[p], &grads[p]));
+    float* gw1 = (float*)malloc((size_t)D * H * 4);
+    float* gb1 = (float*)malloc((size_t)H * 4);
+    float* gw2 = (float*)malloc((size_t)H * C * 4);
+    float* gb2 = (float*)malloc((size_t)C * 4);
+    CHECK(MXTNDArraySyncCopyToCPU(grads[0], gw1, (size_t)D * H * 4));
+    CHECK(MXTNDArraySyncCopyToCPU(grads[1], gb1, (size_t)H * 4));
+    CHECK(MXTNDArraySyncCopyToCPU(grads[2], gw2, (size_t)H * C * 4));
+    CHECK(MXTNDArraySyncCopyToCPU(grads[3], gb2, (size_t)C * 4));
+    float inv = LR / (float)N;  /* loss was summed over batch */
+    for (int i = 0; i < H; ++i)
+      for (int j = 0; j < D; ++j) w1[j * H + i] -= inv * gw1[i * D + j];
+    for (int i = 0; i < H; ++i) b1[i] -= inv * gb1[i];
+    for (int i = 0; i < C; ++i)
+      for (int j = 0; j < H; ++j) w2[j * C + i] -= inv * gw2[i * H + j];
+    for (int i = 0; i < C; ++i) b2[i] -= inv * gb2[i];
+    free(gw1); free(gb1); free(gw2); free(gb2);
+    for (int p = 0; p < 4; ++p) MXTNDArrayFree(grads[p]);
+    MXTNDArrayFree(h1); MXTNDArrayFree(h1r); MXTNDArrayFree(logits);
+    MXTNDArrayFree(loss);
+    for (int p = 0; p < 4; ++p) MXTNDArrayFree(params[p]);
+
+    if (ep % 10 == 0) printf("epoch %d loss %.4f\n", ep, (double)lval);
+  }
+
+  printf("first %.4f last %.4f\n", (double)first_loss, (double)last_loss);
+  if (!(last_loss < first_loss / 5.0f)) {
+    fprintf(stderr, "FAIL: loss did not drop 5x\n");
+    return 1;
+  }
+  printf("C-ABI MNIST training OK\n");
+  MXTNDArrayFree(xa);
+  MXTNDArrayFree(ya);
+  free(x); free(y); free(w1); free(b1); free(w2); free(b2);
+  free(w1t); free(w2t);
+  return 0;
+}

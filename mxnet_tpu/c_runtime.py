@@ -1,0 +1,121 @@
+"""Python-side dispatcher for the native training C ABI.
+
+The C entry points in src/c_api_runtime.cc (MXTNDArray*,
+MXTImperativeInvoke, MXTAutograd*) marshal handles and strings, then
+call into this module — mirroring how the reference's src/c_api/
+c_api_ndarray.cc:81 dispatches into Imperative::Invoke. Keeping the
+dispatch here means the full op registry, autograd tape, and XLA
+compile cache are shared with the Python frontend; the C ABI is a seam,
+not a second runtime.
+
+Every function takes/returns plain Python objects; the C side holds
+NDArray references as PyObject handles.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+from . import autograd
+from .ndarray import NDArray
+from .ndarray import register as _register
+
+__all__ = ["create", "from_bytes", "to_bytes", "shape_of", "dtype_of",
+           "invoke", "mark_variables", "record_start", "record_stop",
+           "backward", "grad_of", "wait_all", "load_symbol_json"]
+
+_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+           4: "int32", 5: "int8", 6: "int64", 12: "bfloat16"}
+_DTYPE_IDS = {v: k for k, v in _DTYPES.items()}
+
+
+def create(shape, dtype_id):
+    import mxnet_tpu as mx
+    return mx.nd.zeros(tuple(shape), dtype=_DTYPES[int(dtype_id)])
+
+
+def from_bytes(shape, dtype_id, raw):
+    arr = np.frombuffer(raw, _DTYPES[int(dtype_id)]).reshape(tuple(shape))
+    return NDArray(np.ascontiguousarray(arr))
+
+
+def to_bytes(arr):
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def shape_of(arr):
+    return tuple(int(s) for s in arr.shape)
+
+
+def dtype_of(arr):
+    return _DTYPE_IDS.get(str(arr.dtype), 0)
+
+
+def _parse(v):
+    """Parse a C-string op param the way the reference's param structs do
+    (dmlc::Parameter parsing): python literals, else raw string."""
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def invoke(op_name, inputs, keys, vals):
+    """MXTImperativeInvoke core (ref: c_api_ndarray.cc:132
+    MXImperativeInvokeEx -> Imperative::Invoke). Shares the dispatch
+    choke point with the Python frontend (AMP hooks and all)."""
+    kwargs = {k: _parse(v) for k, v in zip(keys, vals)}
+    out = _register.invoke_by_name(op_name, *inputs, **kwargs)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def mark_variables(arrs):
+    """ref: c_api.h MXAutogradMarkVariables."""
+    for a in arrs:
+        a.attach_grad()
+
+
+_RECORD_SCOPES = []
+
+
+def record_start():
+    """ref: MXAutogradSetIsRecording(1) + SetIsTraining(1) — an absolute
+    setter like the reference, not a nesting scope: repeated (1) calls
+    are idempotent."""
+    if not _RECORD_SCOPES:
+        scope = autograd.record()
+        scope.__enter__()
+        _RECORD_SCOPES.append(scope)
+
+
+def record_stop():
+    while _RECORD_SCOPES:
+        _RECORD_SCOPES.pop().__exit__(None, None, None)
+
+
+def backward(outputs):
+    """ref: MXAutogradBackwardEx (c_api.h:1222)."""
+    if len(outputs) == 1:
+        outputs[0].backward()
+    else:
+        autograd.backward(outputs)
+
+
+def grad_of(arr):
+    g = arr.grad
+    if g is None:
+        raise ValueError("array has no gradient (not marked, or no "
+                         "backward has run)")
+    return g
+
+
+def wait_all():
+    """ref: MXNDArrayWaitAll (c_api.h:528) barrier semantics."""
+    import mxnet_tpu as mx
+    mx.nd.waitall()
+
+
+def load_symbol_json(path):
+    import mxnet_tpu as mx
+    return mx.sym.load(path)

@@ -1,0 +1,260 @@
+// Training C ABI: NDArray create/copy, op invoke by name, autograd.
+//
+// Mirrors the core of the reference's 240-function C surface
+// (ref: include/mxnet/c_api.h — MXNDArrayCreateEx :392,
+// MXNDArraySyncCopyFromCPU :456, MXNDArraySyncCopyToCPU :465,
+// MXNDArrayGetShape :575, MXImperativeInvokeEx
+// src/c_api/c_api_ndarray.cc:132, MXAutogradMarkVariables c_api.h:1162,
+// MXAutogradSetIsRecording :1143, MXAutogradBackwardEx :1222,
+// MXNDArrayGetGrad :705, MXNDArrayWaitAll :528) — the seam all six
+// reference language frontends attach to. Entry points marshal handles
+// and strings, then dispatch into mxnet_tpu.c_runtime (Python), which
+// shares the op registry, autograd tape, and XLA compile cache with the
+// Python frontend: one runtime, many frontends, exactly the reference's
+// architecture with jax/XLA standing where the C++ engine stood.
+//
+// Handles are PyObject* references to mxnet_tpu NDArrays; the caller
+// owns them until MXTNDArrayFree. All entry points return 0/-1 with the
+// message in MXTGetLastError() (src/c_api.cc).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_error.h"
+#include "py_embed.h"
+
+namespace {
+
+using mxnet_tpu::FailWith;
+using mxnet_tpu::pyembed::EnsurePython;
+using mxnet_tpu::pyembed::Gil;
+using mxnet_tpu::pyembed::PyFail;
+
+PyObject* Runtime() {
+  static PyObject* mod = nullptr;  // borrowed forever (module is cached)
+  if (mod == nullptr) mod = PyImport_ImportModule("mxnet_tpu.c_runtime");
+  return mod;
+}
+
+// Call c_runtime.<fn>(*args); returns new reference or nullptr.
+PyObject* CallRt(const char* fn, PyObject* args) {
+  PyObject* mod = Runtime();
+  if (mod == nullptr) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) return nullptr;
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return out;
+}
+
+PyObject* HandleList(void** handles, uint32_t n) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* h = static_cast<PyObject*>(handles[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(lst, i, h);
+  }
+  return lst;
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- NDArray ----------------------------------------------------------------
+
+int MXTNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
+                     void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* args = Py_BuildValue("(Ni)", shp, dtype);
+  PyObject* res = CallRt("create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayCreate");
+  *out = res;
+  return 0;
+}
+
+int MXTNDArrayFromData(const int64_t* shape, uint32_t ndim, int dtype,
+                       const void* data, size_t nbytes, void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* raw = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
+  PyObject* args = Py_BuildValue("(NiN)", shp, dtype, raw);
+  PyObject* res = CallRt("from_bytes", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayFromData");
+  *out = res;
+  return 0;
+}
+
+int MXTNDArrayFree(void* handle) {
+  if (handle == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+int MXTNDArrayGetShape(void* handle, uint32_t* out_ndim,
+                       int64_t* out_shape /* >= 8 slots */) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("shape_of", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayGetShape");
+  Py_ssize_t n = PyTuple_Size(res);
+  if (n > 8) {
+    Py_DECREF(res);
+    return FailWith("MXTNDArrayGetShape: array has " + std::to_string(n) +
+                    " dims, the ABI shape buffer holds 8");
+  }
+  *out_ndim = static_cast<uint32_t>(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(res, i));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTNDArraySyncCopyToCPU(void* handle, void* data, size_t nbytes) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("to_bytes", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArraySyncCopyToCPU");
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    Py_DECREF(res);
+    return PyFail("MXTNDArraySyncCopyToCPU: bytes");
+  }
+  if (static_cast<size_t>(len) != nbytes) {
+    Py_DECREF(res);
+    return FailWith("MXTNDArraySyncCopyToCPU: size mismatch (have " +
+                    std::to_string(len) + " bytes, caller asked for " +
+                    std::to_string(nbytes) + ")");
+  }
+  std::memcpy(data, buf, nbytes);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTNDArrayWaitAll() {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("wait_all", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayWaitAll");
+  Py_DECREF(res);
+  return 0;
+}
+
+// -- op invoke --------------------------------------------------------------
+
+// Invoke a registered op by name (ref: MXImperativeInvokeEx,
+// src/c_api/c_api_ndarray.cc:132). Outputs: caller passes
+// out_handles[max_outputs]; *num_outputs is set to the actual count.
+int MXTImperativeInvoke(const char* op_name, uint32_t num_inputs,
+                        void** inputs, uint32_t num_params,
+                        const char** keys, const char** vals,
+                        uint32_t* num_outputs, void** out_handles,
+                        uint32_t max_outputs) {
+  EnsurePython();
+  Gil gil;
+  PyObject* ins = HandleList(inputs, num_inputs);
+  PyObject* pk = PyList_New(num_params);
+  PyObject* pv = PyList_New(num_params);
+  for (uint32_t i = 0; i < num_params; ++i) {
+    // decode as latin-1 so arbitrary C byte strings cannot yield NULL
+    // (PyUnicode_FromString fails on non-UTF-8, and a NULL list slot
+    // would crash the iterator later)
+    PyObject* k = PyUnicode_DecodeLatin1(keys[i], strlen(keys[i]), "replace");
+    PyObject* v = PyUnicode_DecodeLatin1(vals[i], strlen(vals[i]), "replace");
+    if (k == nullptr || v == nullptr) {
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      Py_DECREF(ins);
+      Py_DECREF(pk);
+      Py_DECREF(pv);
+      return PyFail("MXTImperativeInvoke: bad param string");
+    }
+    PyList_SET_ITEM(pk, i, k);
+    PyList_SET_ITEM(pv, i, v);
+  }
+  PyObject* args = Py_BuildValue("(sNNN)", op_name, ins, pk, pv);
+  PyObject* res = CallRt("invoke", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTImperativeInvoke");
+  Py_ssize_t n = PyList_Size(res);
+  if (static_cast<uint32_t>(n) > max_outputs) {
+    Py_DECREF(res);
+    return FailWith("MXTImperativeInvoke: op produced " +
+                    std::to_string(n) + " outputs, caller provided " +
+                    std::to_string(max_outputs) + " slots");
+  }
+  *num_outputs = static_cast<uint32_t>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(res, i);
+    Py_INCREF(o);
+    out_handles[i] = o;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// -- autograd ---------------------------------------------------------------
+
+int MXTAutogradMarkVariables(uint32_t num, void** handles) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", HandleList(handles, num));
+  PyObject* res = CallRt("mark_variables", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTAutogradMarkVariables");
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTAutogradSetIsRecording(int is_recording) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt(is_recording ? "record_start" : "record_stop",
+                         args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTAutogradSetIsRecording");
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTAutogradBackward(uint32_t num_outputs, void** outputs) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", HandleList(outputs, num_outputs));
+  PyObject* res = CallRt("backward", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTAutogradBackward");
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTNDArrayGetGrad(void* handle, void** out_grad) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("grad_of", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayGetGrad");
+  *out_grad = res;
+  return 0;
+}
+
+}  // extern "C"

@@ -20,6 +20,7 @@
 #include <vector>
 
 #include "c_error.h"
+#include "py_embed.h"
 
 namespace {
 
@@ -30,50 +31,13 @@ struct PredState {
   std::vector<uint32_t> shape_buf;        // storage for GetOutputShape
 };
 
-// Ensure an interpreter exists. In an embedded app we initialize it once
-// (std::call_once: concurrent first calls from multiple app threads must
-// not double-initialize) and immediately release the GIL so that every
-// entry point can use the uniform PyGILState_Ensure/Release protocol.
-std::once_flag py_init_flag;
-
-void EnsurePython() {
-  std::call_once(py_init_flag, [] {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      PyEval_SaveThread();
-    }
-  });
-}
-
-class Gil {
- public:
-  Gil() { state_ = PyGILState_Ensure(); }
-  ~Gil() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
-int PyFail(const char* what) {
-  std::string msg = what;
-  if (PyErr_Occurred()) {
-    PyObject *type = nullptr, *val = nullptr, *tb = nullptr;
-    PyErr_Fetch(&type, &val, &tb);
-    PyErr_NormalizeException(&type, &val, &tb);
-    if (val != nullptr) {
-      PyObject* s = PyObject_Str(val);
-      if (s != nullptr) {
-        const char* u = PyUnicode_AsUTF8(s);
-        if (u != nullptr) msg = std::string(what) + ": " + u;
-        Py_DECREF(s);
-      }
-    }
-    Py_XDECREF(type);
-    Py_XDECREF(val);
-    Py_XDECREF(tb);
-  }
-  return FailWith(msg);
-}
+// Interpreter init + GIL + error helpers shared with the training ABI
+// (src/py_embed.h) — ONE once_flag guards Py_InitializeEx across all
+// ABI families, so concurrent first calls from different surfaces
+// cannot double-initialize.
+using mxnet_tpu::pyembed::EnsurePython;
+using mxnet_tpu::pyembed::Gil;
+using mxnet_tpu::pyembed::PyFail;
 
 PyObject* PredictorModule() {
   return PyImport_ImportModule("mxnet_tpu.predictor");

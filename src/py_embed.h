@@ -1,0 +1,66 @@
+// Shared embedded-CPython helpers for the C ABI entry points.
+//
+// Same pattern as src/c_predict_api.cc (which predates this header and
+// keeps its private copies): the ABI works both embedded in a C/C++
+// application (initializes CPython on first use) and loaded into an
+// existing Python process (uses the running interpreter via the GIL).
+#ifndef MXNET_TPU_SRC_PY_EMBED_H_
+#define MXNET_TPU_SRC_PY_EMBED_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+#include "c_error.h"
+
+namespace mxnet_tpu {
+namespace pyembed {
+
+inline void EnsurePython() {
+  static std::once_flag flag;
+  std::call_once(flag, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class Gil {
+ public:
+  Gil() { state_ = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state_); }
+  Gil(const Gil&) = delete;
+  Gil& operator=(const Gil&) = delete;
+
+ private:
+  PyGILState_STATE state_;
+};
+
+inline int PyFail(const char* what) {
+  std::string msg = what;
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *val = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &val, &tb);
+    PyErr_NormalizeException(&type, &val, &tb);
+    if (val != nullptr) {
+      PyObject* s = PyObject_Str(val);
+      if (s != nullptr) {
+        const char* u = PyUnicode_AsUTF8(s);
+        if (u != nullptr) msg = std::string(what) + ": " + u;
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(val);
+    Py_XDECREF(tb);
+    PyErr_Clear();
+  }
+  return FailWith(msg);
+}
+
+}  // namespace pyembed
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_SRC_PY_EMBED_H_

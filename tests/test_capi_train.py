@@ -1,0 +1,183 @@
+"""Training C ABI test: compile example/capi/train_mnist.c with gcc and
+run it against libmxnet_tpu.so — the VERDICT r1 'done' criterion for the
+widened C surface (a cpp-package-style demo training MNIST through the
+ABI in CI). Also unit-drives the MXT* entry points through ctypes.
+
+Ref slot: the reference validates its C surface via cpp-package tests +
+tests/cpp/; six language frontends attach at this seam
+(include/mxnet/c_api.h).
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "libmxnet_tpu.so")
+DEMO = os.path.join(REPO, "example", "capi", "train_mnist.c")
+
+
+def _build_lib():
+    if not os.path.exists(LIB):
+        subprocess.run(["make", "-C", os.path.join(REPO, "src")],
+                       check=True, capture_output=True)
+    return os.path.exists(LIB)
+
+
+def _has_training_abi():
+    if not _build_lib():
+        return False
+    lib = ctypes.CDLL(LIB)
+    return hasattr(lib, "MXTImperativeInvoke")
+
+
+pytestmark = pytest.mark.skipif(
+    not _has_training_abi(), reason="native training ABI not built")
+
+
+class TestCtypesSurface:
+    """Drive the MXT* training surface from Python ctypes, in-process."""
+
+    @classmethod
+    def setup_class(cls):
+        import mxnet_tpu  # noqa: F401 — interpreter already initialized
+        lib = ctypes.CDLL(LIB)
+        lib.MXTGetLastError.restype = ctypes.c_char_p
+        # argtypes matter: a bare python int from an array index would be
+        # truncated to 32 bits without them
+        vp, u32, i64p = ctypes.c_void_p, ctypes.c_uint32, \
+            ctypes.POINTER(ctypes.c_int64)
+        vpp = ctypes.POINTER(vp)
+        lib.MXTNDArrayCreate.argtypes = [i64p, u32, ctypes.c_int, vpp]
+        lib.MXTNDArrayFromData.argtypes = [i64p, u32, ctypes.c_int, vp,
+                                           ctypes.c_size_t, vpp]
+        lib.MXTNDArrayFree.argtypes = [vp]
+        lib.MXTNDArrayGetShape.argtypes = [vp, ctypes.POINTER(u32), i64p]
+        lib.MXTNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+        lib.MXTImperativeInvoke.argtypes = [
+            ctypes.c_char_p, u32, vpp, u32,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(u32), vpp,
+            u32]
+        lib.MXTAutogradMarkVariables.argtypes = [u32, vpp]
+        lib.MXTAutogradSetIsRecording.argtypes = [ctypes.c_int]
+        lib.MXTAutogradBackward.argtypes = [u32, vpp]
+        lib.MXTNDArrayGetGrad.argtypes = [vp, vpp]
+        cls.lib = lib
+
+    def _check(self, rc):
+        assert rc == 0, self.lib.MXTGetLastError().decode()
+
+    def test_ndarray_create_shape_copy(self):
+        h = ctypes.c_void_p()
+        shape = (ctypes.c_int64 * 2)(3, 4)
+        self._check(self.lib.MXTNDArrayCreate(shape, 2, 0,
+                                              ctypes.byref(h)))
+        ndim = ctypes.c_uint32()
+        out_shape = (ctypes.c_int64 * 8)()
+        self._check(self.lib.MXTNDArrayGetShape(h, ctypes.byref(ndim),
+                                                out_shape))
+        assert ndim.value == 2
+        assert list(out_shape[:2]) == [3, 4]
+        buf = (ctypes.c_float * 12)()
+        self._check(self.lib.MXTNDArraySyncCopyToCPU(h, buf, 48))
+        assert list(buf) == [0.0] * 12
+        self._check(self.lib.MXTNDArrayFree(h))
+
+    def test_from_data_and_invoke(self):
+        data = onp.arange(6, dtype="float32").reshape(2, 3)
+        h = ctypes.c_void_p()
+        shape = (ctypes.c_int64 * 2)(2, 3)
+        self._check(self.lib.MXTNDArrayFromData(
+            shape, 2, 0, data.ctypes.data_as(ctypes.c_void_p),
+            data.nbytes, ctypes.byref(h)))
+        outs = (ctypes.c_void_p * 4)()
+        nout = ctypes.c_uint32()
+        ins = (ctypes.c_void_p * 1)(h)
+        self._check(self.lib.MXTImperativeInvoke(
+            b"relu", 1, ins, 0, None, None, ctypes.byref(nout), outs, 4))
+        assert nout.value == 1
+        buf = (ctypes.c_float * 6)()
+        self._check(self.lib.MXTNDArraySyncCopyToCPU(outs[0], buf, 24))
+        onp.testing.assert_allclose(list(buf), data.ravel())
+        self.lib.MXTNDArrayFree(h)
+        self.lib.MXTNDArrayFree(outs[0])
+
+    def test_invoke_with_params(self):
+        data = onp.ones((2, 2), "float32")
+        h = ctypes.c_void_p()
+        shape = (ctypes.c_int64 * 2)(2, 2)
+        self._check(self.lib.MXTNDArrayFromData(
+            shape, 2, 0, data.ctypes.data_as(ctypes.c_void_p),
+            data.nbytes, ctypes.byref(h)))
+        keys = (ctypes.c_char_p * 1)(b"scalar")
+        vals = (ctypes.c_char_p * 1)(b"2.5")
+        outs = (ctypes.c_void_p * 1)()
+        nout = ctypes.c_uint32()
+        ins = (ctypes.c_void_p * 1)(h)
+        self._check(self.lib.MXTImperativeInvoke(
+            b"_mul_scalar", 1, ins, 1, keys, vals, ctypes.byref(nout),
+            outs, 1))
+        buf = (ctypes.c_float * 4)()
+        self._check(self.lib.MXTNDArraySyncCopyToCPU(outs[0], buf, 16))
+        assert list(buf) == [2.5] * 4
+        self.lib.MXTNDArrayFree(h)
+        self.lib.MXTNDArrayFree(outs[0])
+
+    def test_autograd_round_trip(self):
+        data = onp.asarray([[3.0]], "float32")
+        h = ctypes.c_void_p()
+        shape = (ctypes.c_int64 * 2)(1, 1)
+        self._check(self.lib.MXTNDArrayFromData(
+            shape, 2, 0, data.ctypes.data_as(ctypes.c_void_p),
+            data.nbytes, ctypes.byref(h)))
+        arr = (ctypes.c_void_p * 1)(h)
+        self._check(self.lib.MXTAutogradMarkVariables(1, arr))
+        self._check(self.lib.MXTAutogradSetIsRecording(1))
+        outs = (ctypes.c_void_p * 1)()
+        nout = ctypes.c_uint32()
+        ins = (ctypes.c_void_p * 2)(h, h)
+        self._check(self.lib.MXTImperativeInvoke(
+            b"elemwise_mul", 2, ins, 0, None, None, ctypes.byref(nout),
+            outs, 1))
+        self._check(self.lib.MXTAutogradSetIsRecording(0))
+        loss = (ctypes.c_void_p * 1)(outs[0])
+        self._check(self.lib.MXTAutogradBackward(1, loss))
+        g = ctypes.c_void_p()
+        self._check(self.lib.MXTNDArrayGetGrad(h, ctypes.byref(g)))
+        buf = (ctypes.c_float * 1)()
+        self._check(self.lib.MXTNDArraySyncCopyToCPU(g, buf, 4))
+        assert abs(buf[0] - 6.0) < 1e-5  # d(x^2)/dx = 2x = 6
+        for p in (h, outs[0], g):
+            self.lib.MXTNDArrayFree(p)
+
+    def test_error_reporting(self):
+        outs = (ctypes.c_void_p * 1)()
+        nout = ctypes.c_uint32()
+        rc = self.lib.MXTImperativeInvoke(
+            b"not_a_real_op", 0, None, 0, None, None, ctypes.byref(nout),
+            outs, 1)
+        assert rc == -1
+        assert b"not_a_real_op" in self.lib.MXTGetLastError()
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
+def test_c_demo_trains_mnist(tmp_path):
+    """Compile the pure-C demo and run it as a standalone process
+    (embedded CPython): loss must drop 5x."""
+    exe = str(tmp_path / "train_mnist")
+    subprocess.run(
+        ["gcc", "-O2", DEMO, "-o", exe,
+         "-L" + os.path.join(REPO, "mxnet_tpu"), "-lmxnet_tpu",
+         "-Wl,-rpath," + os.path.join(REPO, "mxnet_tpu")],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "C-ABI MNIST training OK" in res.stdout

@@ -40,6 +40,7 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._stype = stype
+        self._grad_stype = grad_stype  # row_sparse -> Trainer ships rows
         self._data = None          # NDArray
         self._grad = None
         self._deferred_init = None  # (init, ctx, default_init)

@@ -179,11 +179,19 @@ class Trainer:
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
                 idx = self._param2idx[param.name]
+                grad = param.grad()
+                if getattr(param, "_grad_stype", "default") == \
+                        "row_sparse":
+                    # ship only touched rows (ref: kvstore_dist.h:522);
+                    # indices come from an on-device nonzero, so the
+                    # conversion never syncs the dense grad to host
+                    from ..ndarray.sparse import RowSparseNDArray
+                    grad = RowSparseNDArray(grad._data, ctx=grad._ctx)
                 if self._update_on_kvstore:
-                    self._kvstore.pushpull(idx, param.grad(),
+                    self._kvstore.pushpull(idx, grad,
                                            out=param.data(), priority=-i)
                 else:
-                    self._kvstore.push(idx, param.grad(), priority=-i)
+                    self._kvstore.push(idx, grad, priority=-i)
                     self._kvstore.pull(idx, param.grad(), priority=-i,
                                        ignore_sparse=False)
 

@@ -65,6 +65,11 @@ class KVStore:
         self._compression_params = None
         self._compression_residuals = {}
         self._barrier_before_exit = True
+        # wire accounting: what push/pull would cost on the network.
+        # Row-sparse payloads count values+indices, not the dense shape
+        # (ref: kvstore_dist.h:522 EncodeRowSparseKey ships only rows).
+        self.bytes_pushed = 0
+        self.bytes_pulled = 0
 
     # -- identity ----------------------------------------------------------
     @property
@@ -96,10 +101,14 @@ class KVStore:
         like Comm::Reduce (ref: src/kvstore/comm.h:451). With an optimizer
         set, the update is applied server-side (update_on_kvstore mode,
         ref: src/kvstore/kvstore_dist_server.h:346 ApplyUpdates)."""
+        from .ndarray.sparse import RowSparseNDArray
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise ValueError("key %r has not been initialized" % (k,))
+            for v in vlist:
+                self.bytes_pushed += v.wire_nbytes \
+                    if isinstance(v, RowSparseNDArray) else int(v.nbytes)
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
             if self._compression_active(merged):
                 merged = self._compress_reduce(k, merged)
@@ -120,6 +129,7 @@ class KVStore:
                 raise ValueError("key %r has not been initialized" % (k,))
             src = self._store[k]
             for o in olist:
+                self.bytes_pulled += int(src.nbytes)
                 o._data = src._data
         return out
 
@@ -143,6 +153,9 @@ class KVStore:
                 row_ids, list) else [row_ids] * len(keys)):
             src = self._store[k]
             rows = src.take(rids, axis=0)
+            # wire cost = requested rows + their ids, NOT the vocab
+            self.bytes_pulled += (int(rows.nbytes) + int(rids.nbytes)) \
+                * len(olist)
             for o in olist:
                 from .ndarray.sparse import RowSparseNDArray, row_sparse_array
                 if isinstance(o, RowSparseNDArray):

@@ -62,6 +62,10 @@ class NDArray:
         return _np.dtype(self._data.dtype)
 
     @property
+    def nbytes(self):
+        return self.size * self.dtype.itemsize
+
+    @property
     def context(self):
         if self._ctx is not None:
             return self._ctx

@@ -4,9 +4,11 @@ TPU-native take on the reference's sparse storage types
 (ref: include/mxnet/ndarray.h:63-82 kRowSparseStorage/kCSRStorage,
 python/mxnet/ndarray/sparse.py). XLA has no native sparse tensors; the
 design keeps the *API and storage format* (indices+values / indptr+indices+
-data) on host-visible arrays, while compute densifies. Row-sparse remains
-valuable as a communication format (kvstore push/pull of embedding grads
-ships only touched rows — ref: src/kvstore/kvstore_dist.h:522).
+data), with index/value extraction running ON DEVICE (eager jnp.nonzero /
+gather — no host round-trip), while heavy compute densifies. Row-sparse
+is the communication format: kvstore push/pull of embedding grads ships
+only touched rows (ref: src/kvstore/kvstore_dist.h:522), with wire-byte
+accounting to prove it (kvstore.bytes_pushed).
 """
 from __future__ import annotations
 
@@ -36,17 +38,28 @@ class RowSparseNDArray(NDArray):
     @property
     def indices(self):
         if self._indices is None:
-            nz = _np.nonzero(_np.abs(self.asnumpy()).reshape(
-                self.shape[0], -1).sum(axis=1))[0]
-            self._indices = array(nz.astype(_np.int64))
+            # on-device nonzero (eager jax supports the dynamic result
+            # shape); replaces the old asnumpy()+np.nonzero host sync
+            row_norm = jnp.abs(self._data).reshape(
+                self.shape[0], -1).sum(axis=1)
+            nz = jnp.nonzero(row_norm)[0]
+            self._indices = NDArray(nz.astype(jnp.int32))
         return self._indices
 
     @property
     def data(self):
         if self._values is None:
-            idx = self.indices.asnumpy().astype(_np.int64)
-            self._values = array(self.asnumpy()[idx])
+            # device gather of the touched rows
+            self._values = NDArray(
+                jnp.take(self._data, self.indices._data, axis=0))
         return self._values
+
+    @property
+    def wire_nbytes(self):
+        """Bytes this array costs on the wire in sparse form
+        (values + indices) — what kvstore push/pull accounts
+        (ref: kvstore_dist.h:522 row-sparse key encoding)."""
+        return int(self.data.nbytes) + int(self.indices.nbytes)
 
     def tostype(self, stype):
         if stype == "row_sparse":
@@ -56,13 +69,15 @@ class RowSparseNDArray(NDArray):
         return cast_storage(self, stype)
 
     def retain(self, indices):
-        """Keep only given rows (ref: sparse retain op)."""
-        idx = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) \
-            else _np.asarray(indices, _np.int64)
-        mask = _np.zeros(self.shape[0], bool)
-        mask[idx] = True
-        dense = self.asnumpy() * mask.reshape((-1,) + (1,) * (self.ndim - 1))
-        return RowSparseNDArray(jnp.asarray(dense), ctx=self._ctx)
+        """Keep only given rows (ref: sparse retain op) — device-side
+        scatter mask, no host round-trip."""
+        idx = indices._data if isinstance(indices, NDArray) \
+            else jnp.asarray(_np.asarray(indices, _np.int64))
+        mask = jnp.zeros((self.shape[0],), bool).at[
+            idx.astype(jnp.int32)].set(True)
+        dense = self._data * mask.reshape(
+            (-1,) + (1,) * (self.ndim - 1)).astype(self._data.dtype)
+        return RowSparseNDArray(dense, ctx=self._ctx)
 
 
 class CSRNDArray(NDArray):
@@ -122,17 +137,23 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     ref: python/mxnet/ndarray/sparse.py row_sparse_array."""
     if isinstance(arg1, tuple) and len(arg1) == 2:
         values, indices = arg1
-        values = values.asnumpy() if isinstance(values, NDArray) \
-            else _np.asarray(values, _np.float32 if dtype is None else dtype)
-        indices = indices.asnumpy() if isinstance(indices, NDArray) \
-            else _np.asarray(indices, _np.int64)
-        n = shape[0] if shape else int(indices.max()) + 1 if len(indices) else 0
-        full_shape = (n,) + tuple(values.shape[1:]) if shape is None else tuple(shape)
-        dense = _np.zeros(full_shape, values.dtype)
-        dense[indices.astype(_np.int64)] = values
-        return RowSparseNDArray(jnp.asarray(dense),
-                                indices=array(indices), values=array(values),
-                                ctx=ctx)
+        values = values._data if isinstance(values, NDArray) \
+            else jnp.asarray(_np.asarray(
+                values, _np.float32 if dtype is None else dtype))
+        indices_dev = indices._data if isinstance(indices, NDArray) \
+            else jnp.asarray(_np.asarray(indices, _np.int64))
+        if shape is None:
+            # dense shape is static metadata; deriving it from the index
+            # values is the one place a host read is unavoidable
+            n = int(indices_dev.max()) + 1 if indices_dev.size else 0
+            full_shape = (n,) + tuple(values.shape[1:])
+        else:
+            full_shape = tuple(shape)
+        # device scatter of the rows into the dense view
+        dense = jnp.zeros(full_shape, values.dtype).at[
+            indices_dev.astype(jnp.int32)].set(values)
+        return RowSparseNDArray(dense, indices=NDArray(indices_dev),
+                                values=NDArray(values), ctx=ctx)
     src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
     return RowSparseNDArray(jnp.asarray(src), ctx=ctx)
 

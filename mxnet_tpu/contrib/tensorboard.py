@@ -1,0 +1,156 @@
+"""TensorBoard bridge (ref: python/mxnet/contrib/tensorboard.py).
+
+The reference's LogMetricsCallback requires the `tensorboard` pip
+package purely to write scalar summaries. TensorBoard's on-disk format
+is just TFRecord-framed Event protobufs, so this module writes them
+directly — same dependency-free stance as the ONNX bridge
+(contrib/onnx/proto.py): Event{1:wall_time(double), 2:step(int64),
+5:summary}, Summary{1: repeated Value{1:tag, 2:simple_value(float)}},
+TFRecord framing = u64 length + masked crc32c(length) + payload +
+masked crc32c(payload).
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+
+# -- crc32c (Castagnoli), table-driven — required by TFRecord framing ------
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data):
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# -- minimal Event/Summary protobuf encoding -------------------------------
+
+def _varint(out, v):
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _tag(out, field, wire):
+    _varint(out, (field << 3) | wire)
+
+
+def _scalar_event(tag, value, step, wall_time):
+    val = bytearray()                      # Summary.Value
+    _tag(val, 1, 2)                        # tag (string)
+    t = tag.encode()
+    _varint(val, len(t))
+    val.extend(t)
+    _tag(val, 2, 5)                        # simple_value (float)
+    val.extend(struct.pack("<f", float(value)))
+
+    summ = bytearray()                     # Summary
+    _tag(summ, 1, 2)
+    _varint(summ, len(val))
+    summ.extend(val)
+
+    ev = bytearray()                       # Event
+    _tag(ev, 1, 1)                         # wall_time (double)
+    ev.extend(struct.pack("<d", wall_time))
+    _tag(ev, 2, 0)                         # step (int64)
+    _varint(ev, int(step))
+    _tag(ev, 5, 2)                         # summary
+    _varint(ev, len(summ))
+    ev.extend(summ)
+    return bytes(ev)
+
+
+def _tfrecord(payload):
+    hdr = struct.pack("<Q", len(payload))
+    return (hdr + struct.pack("<I", _masked_crc(hdr)) + payload
+            + struct.pack("<I", _masked_crc(payload)))
+
+
+class SummaryWriter:
+    """Append-only scalar event writer, tensorboard-loadable.
+    API shape follows tensorboard.SummaryWriter.add_scalar."""
+
+    def __init__(self, logdir):
+        import socket
+        os.makedirs(logdir, exist_ok=True)
+        # hostname+pid+counter keep concurrent writers (multi-process
+        # ranks, back-to-back constructions) in separate files — the
+        # upstream format embeds them for the same reason
+        SummaryWriter._seq = getattr(SummaryWriter, "_seq", 0) + 1
+        fname = "events.out.tfevents.%d.%s.%d.%d.mxnet_tpu" % (
+            int(time.time()), socket.gethostname(), os.getpid(),
+            SummaryWriter._seq)
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "ab")
+        # file-version header event expected by TB readers
+        ver = bytearray()
+        _tag(ver, 1, 1)
+        ver.extend(struct.pack("<d", time.time()))
+        _tag(ver, 3, 2)                    # file_version (string)
+        fv = b"brain.Event:2"
+        _varint(ver, len(fv))
+        ver.extend(fv)
+        self._f.write(_tfrecord(bytes(ver)))
+        self._f.flush()
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._f.write(_tfrecord(_scalar_event(tag, value, global_step,
+                                              time.time())))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback logging EvalMetric values to TensorBoard
+    (ref: contrib/tensorboard.py LogMetricsCallback — same constructor
+    and __call__(param) protocol, driven by Speedometer-style
+    BatchEndParam objects)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)
+        self.summary_writer.flush()

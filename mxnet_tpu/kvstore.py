@@ -17,11 +17,15 @@ step (see mxnet_tpu.parallel). The KVStore API survives for user code:
   summed on device in one fused XLA op), then either stores the result
   (update_on_kvstore=False) or applies the optimizer (set_optimizer was
   called, the server-side-update analog).
-- `dist_sync` / `dist_device_sync` / `dist_async`: multi-host variants. Under
+- `dist_sync` / `dist_device_sync`: multi-host variants. Under
   `jax.distributed` each process holds the same keys; push() additionally
   all-reduces across processes over ICI/DCN via
-  `parallel.host_allreduce` (sync modes). `dist_async` has no ICI analog
-  (ref SURVEY §5) and is emulated as sync with a warning.
+  `parallel.host_allreduce`.
+- `dist_async`: host-driven asynchronous parameter server
+  (kvstore_async.py) — a server thread in rank 0 applies each push
+  immediately over a TCP transport, reproducing the reference's async
+  staleness semantics (ICI collectives are inherently synchronous, so
+  async cannot ride them — SURVEY §5).
 """
 from __future__ import annotations
 
@@ -297,7 +301,9 @@ def create(name="local"):
     """Factory (ref: python/mxnet/kvstore.py:716, src/kvstore/kvstore.cc:40).
 
     Supported: local, device, nccl (alias of device on TPU), tpu,
-    dist_sync, dist_device_sync, dist_async (emulated as sync)."""
+    dist_sync, dist_device_sync, dist_async (host-driven async
+    parameter server with immediate-apply staleness semantics —
+    kvstore_async.py)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     kind = name.lower()
@@ -307,8 +313,12 @@ def create(name="local"):
         raise ValueError("Unknown KVStore type %r (supported: %s)"
                          % (name, ", ".join(valid)))
     if kind == "dist_async":
-        warnings.warn("dist_async has no ICI analog on TPU; running "
-                      "synchronously (see SURVEY.md §5)")
+        # host-driven async parameter server (SURVEY §5: async has no ICI
+        # analog, so it runs over a TCP transport with a server thread in
+        # rank 0 applying each push immediately — the reference's
+        # kvstore_dist_server.h:358 async ApplyUpdates semantics)
+        from .kvstore_async import AsyncKVStore
+        return AsyncKVStore()
     if kind.startswith("dist") and os.environ.get("MXTPU_COORDINATOR"):
         # join the job the launcher (tools/launch.py) wired via env — the
         # analog of ps-lite reading DMLC_* at KVStore::Create time

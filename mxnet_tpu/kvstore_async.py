@@ -1,0 +1,370 @@
+"""Host-driven asynchronous parameter server: real `dist_async`.
+
+ref: src/kvstore/kvstore_dist_server.h:346-359 — in async mode the
+server applies each worker's push IMMEDIATELY (`ApplyUpdates` without
+the NumWorkers aggregation barrier), so workers train on stale weights;
+convergence behavior genuinely differs from dist_sync. The ICI
+collectives that back dist_sync are inherently synchronous, so — as
+SURVEY §5 prescribes — async runs over a host-side transport: a server
+thread in the rank-0 process owns the weights and applies updates as
+pickled (push) messages arrive over TCP; pulls return whatever mix of
+updates has landed. This is the ps-lite worker/server split with the
+scheduler folded into the launcher's coordinator env.
+
+Wire protocol: 4-byte big-endian length + pickled tuple
+  ("init", key, np_array) / ("push", key, np_array)
+  ("pull", key) -> np_array        ("set_optimizer", pickled_bytes)
+  ("barrier",) -> ok               ("stop",)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["AsyncPSServer", "AsyncPSClient", "serve_if_rank0"]
+
+
+def _send(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    n = struct.unpack(">I", hdr)[0]
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class AsyncPSServer:
+    """Weight owner + immediate-apply update loop (the reference's
+    KVStoreDistServer in async mode)."""
+
+    def __init__(self, port=0):
+        self._store = {}
+        self._updater = None
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))  # reachable from other hosts
+        # under the ssh launcher (the coordinator host dials in)
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        self.updates_applied = 0          # observability for tests
+        self.workers_done = 0
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._srv.settimeout(0.2)
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        while not self._stop.is_set():
+            try:
+                msg = _recv(conn)
+            except OSError:
+                return
+            if msg is None:
+                return
+            try:
+                self._handle(conn, msg)
+            except Exception as e:  # noqa: BLE001 — reply, don't die
+                try:
+                    _send(conn, ("err", "%s: %s" % (type(e).__name__, e)))
+                except OSError:
+                    return
+            if msg[0] == "stop":
+                return
+
+    def _handle(self, conn, msg):
+            op = msg[0]
+            if op == "init":
+                _, key, arr = msg
+                with self._lock:
+                    self._store.setdefault(key, np.array(arr, copy=True))
+                _send(conn, ("ok",))
+            elif op == "push":
+                _, key, grad = msg
+                # IMMEDIATE apply — no cross-worker barrier (async
+                # semantics, kvstore_dist_server.h:358)
+                with self._lock:
+                    if self._updater is not None:
+                        self._apply(key, np.asarray(grad))
+                    else:
+                        # same store-replace semantics as the sync
+                        # KVStore without an optimizer (kvstore.py push)
+                        self._store[key] = np.array(grad, copy=True)
+                    self.updates_applied += 1
+                _send(conn, ("ok",))
+            elif op == "pull":
+                _, key = msg
+                with self._lock:
+                    _send(conn, ("val", np.array(self._store[key],
+                                                 copy=True)))
+            elif op == "set_optimizer":
+                # the reference pickles the optimizer worker-side and the
+                # server builds its updater from it (kvstore_server.py)
+                _, blob = msg
+                import mxnet_tpu.optimizer as opt
+                optimizer = pickle.loads(blob)
+                self._opt_states = {}
+                self._optimizer = optimizer
+                self._updater = opt.get_updater(optimizer)
+                _send(conn, ("ok",))
+            elif op == "stats":
+                with self._lock:
+                    _send(conn, ("val", self.updates_applied))
+            elif op == "done":
+                with self._lock:
+                    self.workers_done += 1
+                _send(conn, ("ok",))
+            elif op == "wait_done":
+                _, n = msg
+                import time as _t
+                deadline = _t.monotonic() + 120
+                while _t.monotonic() < deadline:
+                    with self._lock:
+                        if self.workers_done >= n:
+                            break
+                    _t.sleep(0.02)
+                _send(conn, ("ok",))
+            elif op == "stop":
+                _send(conn, ("ok",))
+                self._stop.set()
+            else:
+                _send(conn, ("err", "unknown op %r" % (op,)))
+
+    def _apply(self, key, grad):
+        import mxnet_tpu as mx
+        w = mx.nd.array(self._store[key])
+        g = mx.nd.array(grad)
+        from .kvstore import _str_key_int
+        self._updater(key if isinstance(key, int) else _str_key_int(key),
+                      g, w)
+        self._store[key] = w.asnumpy()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class AsyncPSClient:
+    """Worker-side connection (the reference's ps::KVWorker)."""
+
+    def __init__(self, host, port, retries=50):
+        import time
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        for attempt in range(retries):
+            try:
+                self._sock.connect((host, port))
+                break
+            except ConnectionRefusedError:
+                if attempt == retries - 1:
+                    raise
+                time.sleep(0.1)  # server still coming up on rank 0
+        self._lock = threading.Lock()
+
+    def _call(self, *msg):
+        with self._lock:
+            _send(self._sock, msg)
+            resp = _recv(self._sock)
+        if resp is None:
+            raise ConnectionError("async PS server closed the connection")
+        if resp[0] == "err":
+            raise RuntimeError(resp[1])
+        return resp[1] if len(resp) > 1 else None
+
+    def init(self, key, arr):
+        self._call("init", key, np.asarray(arr))
+
+    def push(self, key, grad):
+        self._call("push", key, np.asarray(grad))
+
+    def pull(self, key):
+        return self._call("pull", key)
+
+    def set_optimizer(self, optimizer):
+        self._call("set_optimizer", pickle.dumps(optimizer))
+
+    def updates_applied(self):
+        return self._call("stats")
+
+    def done(self):
+        self._call("done")
+
+    def wait_done(self, n):
+        self._call("wait_done", n)
+
+    def stop_server(self):
+        try:
+            self._call("stop")
+        except (ConnectionError, OSError):
+            pass
+
+
+class AsyncKVStore:
+    """KVStore-shaped facade over the async PS (the `dist_async` type
+    returned by mx.kv.create). Each push is applied server-side
+    immediately; pull returns the current (possibly stale) weights —
+    the reference's async convergence semantics, not sync's."""
+
+    def __init__(self):
+        rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+        nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+        self._rank = rank
+        self._num_workers = nproc
+        self._server, self._client = serve_if_rank0(rank)
+        self._optimizer = None
+
+    # identity
+    @property
+    def type(self):
+        return "dist_async"
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    # data plane
+    def init(self, key, value):
+        from .kvstore import _ctype_key_value
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            self._client.init(k, vlist[0].asnumpy())
+
+    def push(self, key, value, priority=0):
+        from .kvstore import _ctype_key_value
+        from .ndarray import NDArray
+        import mxnet_tpu.ndarray as nd
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
+            self._client.push(k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .kvstore import _ctype_key_value
+        import jax.numpy as jnp
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            arr = jnp.asarray(self._client.pull(k))
+            for o in olist:
+                o._data = arr
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+        return out
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out=out)
+        return out
+
+    def set_optimizer(self, optimizer):
+        """Pickle the optimizer to the server, which applies it per push
+        (ref: python/mxnet/kvstore_server.py _controller)."""
+        self._optimizer = optimizer
+        self._client.set_optimizer(optimizer)
+
+    # the rest of the KVStore surface callers touch (Module/Trainer) —
+    # same contracts as kvstore.py
+    def set_gradient_compression(self, compression_params):
+        raise NotImplementedError(
+            "gradient compression over the async PS transport is not "
+            "implemented; use dist_sync for compressed pushes "
+            "(ref: gradient_compression.h applies to the sync path)")
+
+    def set_updater(self, updater):
+        raise NotImplementedError(
+            "dist_async applies updates server-side; set_optimizer() "
+            "ships the optimizer to the server (kvstore_server.py UX)")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        import pickle as _p
+        with open(fname, "wb") as f:
+            _p.dump(self._optimizer if dump_optimizer else None, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle as _p
+        with open(fname, "rb") as f:
+            o = _p.load(f)
+        if o is not None:
+            self.set_optimizer(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise NotImplementedError(
+            "row_sparse_pull over the async PS is not implemented; "
+            "use dist_sync (kvstore.py row_sparse_pull)")
+
+    def updates_applied(self):
+        return self._client.updates_applied()
+
+    def done(self):
+        """Signal this worker finished (coordination for clean server
+        shutdown — the reference's Postoffice barrier-before-exit)."""
+        self._client.done()
+
+    def close(self):
+        if self._server is not None:
+            self._client.wait_done(self._num_workers - 1)
+            self._client.stop_server()
+            self._server.stop()
+
+
+def serve_if_rank0(rank, port_env="MXTPU_ASYNC_PS_PORT"):
+    """Launcher hook: rank 0 hosts the server; every rank returns a
+    client. The port is derived deterministically from the launcher's
+    coordinator port (DMLC_PS_ROOT_PORT analog) so non-zero ranks know
+    it before the server even starts — they retry until rank 0 binds."""
+    coord = os.environ.get("MXTPU_COORDINATOR", "")
+    if coord and ":" in coord:
+        host, cport = coord.rsplit(":", 1)
+        host = host or "127.0.0.1"
+        port = int(os.environ.get(port_env, 0)) or (int(cport) + 1001)
+    else:
+        host, port = "127.0.0.1", int(os.environ.get(port_env, 0))
+    if rank == 0:
+        server = AsyncPSServer(port)
+        os.environ[port_env] = str(server.port)
+        return server, AsyncPSClient("127.0.0.1", server.port)
+    return None, AsyncPSClient(host, port)

@@ -1,0 +1,62 @@
+"""Worker script for multi-process dist_async tests
+(ref: tests/nightly/dist_async_kvstore.py). Each rank pushes its own
+updates with NO synchronization barrier; the rank-0 server thread
+applies each push immediately. Checks: every rank's pushes land
+(total update count), and the final pulled weights reflect the summed
+contributions — async eventually sees everything, just not atomically.
+
+Run via: python tools/launch.py -n 3 python tests/dist_async_kvstore_worker.py
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["MXTPU_PROC_ID"])
+    nproc = int(os.environ["MXTPU_NUM_PROCS"])
+    kv = mx.kv.create("dist_async")
+    assert kv.type == "dist_async"
+    shape = (4,)
+    import mxnet_tpu.optimizer as opt
+    if rank == 0:
+        # server-side optimizer: w -= lr * grad, applied per push
+        kv.set_optimizer(opt.create("sgd", learning_rate=1.0, wd=0.0,
+                                    rescale_grad=1.0))
+    kv.init("w", mx.nd.zeros(shape))
+
+    rounds = 5
+    for _ in range(rounds):
+        kv.push("w", mx.nd.ones(shape) * -(rank + 1))  # w += rank+1
+
+    # wait until the server has applied everyone's pushes (async has no
+    # barrier; poll like the reference's nightly test waits on values)
+    import time
+    want = nproc * rounds
+    for _ in range(400):
+        if kv.updates_applied() >= want:
+            break
+        time.sleep(0.05)
+    assert kv.updates_applied() == want, kv.updates_applied()
+
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    total = sum(r + 1 for r in range(nproc)) * rounds
+    got = out.asnumpy()
+    assert (got == total).all(), (got, total)
+    print("rank %d/%d: dist_async checks passed" % (rank, nproc))
+    if rank == 0:
+        kv.close()  # waits for the other ranks' done() signals
+    else:
+        kv.done()
+
+
+if __name__ == "__main__":
+    main()

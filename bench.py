@@ -124,7 +124,7 @@ def bench_resnet():
     float(step.step(xd, yd))  # compile + warm
     float(step.step(xd, yd))
 
-    iters = int(os.environ.get("BENCH_ITERS", 20 if platform != "cpu" else 3))
+    iters = int(os.environ.get("BENCH_ITERS", 30 if platform != "cpu" else 3))
     t0 = time.perf_counter()
     loss = None
     for _ in range(iters):

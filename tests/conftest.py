@@ -40,3 +40,9 @@ def _seed_rngs():
     import mxnet_tpu as mx
     mx.random.seed(0)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: realistic-shape mesh tests (seconds-minutes on "
+        "the virtual CPU mesh; always run, deselect with -m 'not slow')")

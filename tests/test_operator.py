@@ -251,3 +251,24 @@ def test_upsampling_depthspace():
     d2s = nd.depth_to_space(x, block_size=2)
     assert d2s.shape == (1, 1, 4, 4)
     assert_almost_equal(nd.space_to_depth(d2s, block_size=2), x.asnumpy())
+
+
+def test_batchnorm_large_mean_f32_no_cancellation():
+    """f32 inputs with |mean| >> std must not lose the variance to
+    catastrophic cancellation (the two-pass f32 branch in
+    ops/nn.py batch_norm; half-precision inputs take the fused
+    single-pass branch whose cancellation error sits far below the
+    input quantization noise)."""
+    import numpy as onp
+    from mxnet_tpu import autograd
+    rs = onp.random.RandomState(0)
+    x = (1000.0 + 0.1 * rs.randn(64, 8, 4, 4)).astype("float32")
+    ones = mx.nd.array(onp.ones(8, "float32"))
+    zeros = mx.nd.array(onp.zeros(8, "float32"))
+    with autograd.record():
+        out, mean, var = nd.BatchNorm(mx.nd.array(x), ones, zeros,
+                                      zeros, ones, fix_gamma=False,
+                                      eps=1e-5)
+    v = var.asnumpy()
+    onp.testing.assert_allclose(v, 0.01, rtol=0.15)
+    assert 0.85 < out.asnumpy().std() < 1.15

@@ -1,0 +1,201 @@
+// mxnet_tpu-cpp: header-only C++ frontend over the training C ABI.
+//
+// Analog of the reference's cpp-package
+// (ref: cpp-package/include/mxnet-cpp/ndarray.h NDArray value class,
+// op.h generated operator wrappers, autograd scope) — proof that
+// language frontends attach at the C ABI seam
+// (src/c_api_runtime.cc): RAII NDArray handles, operator invocation by
+// registry name, autograd record/backward. Compute stays jax/XLA under
+// the ABI; this header is pure marshalling.
+//
+// Link against libmxnet_tpu.so; run with PYTHONPATH pointing at the
+// repo (the ABI embeds CPython on first use).
+#ifndef MXNET_TPU_CPP_NDARRAY_HPP_
+#define MXNET_TPU_CPP_NDARRAY_HPP_
+
+#include <cstdint>
+#include <initializer_list>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+const char* MXTGetLastError();
+int MXTNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
+                     void** out);
+int MXTNDArrayFromData(const int64_t* shape, uint32_t ndim, int dtype,
+                       const void* data, size_t nbytes, void** out);
+int MXTNDArrayFree(void* h);
+int MXTNDArrayGetShape(void* h, uint32_t* ndim, int64_t* shape);
+int MXTNDArraySyncCopyToCPU(void* h, void* data, size_t nbytes);
+int MXTNDArrayWaitAll();
+int MXTImperativeInvoke(const char* op, uint32_t nin, void** in,
+                        uint32_t nparam, const char** keys,
+                        const char** vals, uint32_t* nout, void** out,
+                        uint32_t max_out);
+int MXTAutogradMarkVariables(uint32_t n, void** h);
+int MXTAutogradSetIsRecording(int rec);
+int MXTAutogradBackward(uint32_t n, void** out);
+int MXTNDArrayGetGrad(void* h, void** grad);
+}
+
+namespace mxnet_tpu {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXTGetLastError());
+}
+
+// Value-semantics NDArray over an opaque ABI handle
+// (ref: mxnet-cpp/ndarray.h NDArray — same shared-handle idiom).
+class NDArray {
+ public:
+  NDArray() : handle_(nullptr) {}
+
+  explicit NDArray(void* handle) : handle_(handle) {}
+
+  NDArray(const std::vector<int64_t>& shape, int dtype = 0) {
+    Check(MXTNDArrayCreate(shape.data(),
+                           static_cast<uint32_t>(shape.size()), dtype,
+                           &handle_));
+  }
+
+  NDArray(const std::vector<float>& data,
+          const std::vector<int64_t>& shape) {
+    Check(MXTNDArrayFromData(shape.data(),
+                             static_cast<uint32_t>(shape.size()), 0,
+                             data.data(), data.size() * sizeof(float),
+                             &handle_));
+  }
+
+  NDArray(const NDArray&) = delete;             // handles are unique
+  NDArray& operator=(const NDArray&) = delete;  // (move-only, like
+                                                // unique_ptr)
+  NDArray(NDArray&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  NDArray& operator=(NDArray&& o) noexcept {
+    if (this != &o) {
+      Reset();
+      handle_ = o.handle_;
+      o.handle_ = nullptr;
+    }
+    return *this;
+  }
+
+  ~NDArray() { Reset(); }
+
+  void Reset() {
+    if (handle_ != nullptr) {
+      MXTNDArrayFree(handle_);
+      handle_ = nullptr;
+    }
+  }
+
+  void* handle() const { return handle_; }
+
+  std::vector<int64_t> Shape() const {
+    uint32_t ndim = 0;
+    int64_t dims[8];
+    Check(MXTNDArrayGetShape(handle_, &ndim, dims));
+    return std::vector<int64_t>(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (int64_t d : Shape()) n *= static_cast<size_t>(d);
+    return n;
+  }
+
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size());
+    Check(MXTNDArraySyncCopyToCPU(handle_, out.data(),
+                                  out.size() * sizeof(float)));
+    return out;
+  }
+
+  void AttachGrad() {
+    void* h = handle_;
+    Check(MXTAutogradMarkVariables(1, &h));
+  }
+
+  NDArray Grad() const {
+    void* g = nullptr;
+    Check(MXTNDArrayGetGrad(handle_, &g));
+    return NDArray(g);
+  }
+
+ private:
+  void* handle_;
+};
+
+// Operator invocation by registry name with string params
+// (ref: mxnet-cpp/op.h generated wrappers over MXImperativeInvoke).
+class Operator {
+ public:
+  explicit Operator(const std::string& name) : name_(name) {}
+
+  Operator& SetParam(const std::string& k, const std::string& v) {
+    keys_.push_back(k);
+    vals_.push_back(v);
+    return *this;
+  }
+
+  Operator& SetInput(const NDArray& arr) {
+    inputs_.push_back(arr.handle());
+    return *this;
+  }
+
+  std::vector<NDArray> InvokeMulti(uint32_t max_out = 4) {
+    std::vector<const char*> k;
+    std::vector<const char*> v;
+    for (const auto& s : keys_) k.push_back(s.c_str());
+    for (const auto& s : vals_) v.push_back(s.c_str());
+    std::vector<void*> outs(max_out, nullptr);
+    uint32_t nout = 0;
+    Check(MXTImperativeInvoke(
+        name_.c_str(), static_cast<uint32_t>(inputs_.size()),
+        inputs_.data(), static_cast<uint32_t>(k.size()),
+        k.empty() ? nullptr : k.data(), v.empty() ? nullptr : v.data(),
+        &nout, outs.data(), max_out));
+    std::vector<NDArray> result;
+    result.reserve(nout);
+    for (uint32_t i = 0; i < nout; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  NDArray Invoke(uint32_t max_out = 4) {
+    auto outs = InvokeMulti(max_out);
+    if (outs.empty()) throw std::runtime_error(name_ + ": no outputs");
+    return std::move(outs[0]);
+  }
+
+ private:
+  std::string name_;
+  std::vector<std::string> keys_, vals_;
+  std::vector<void*> inputs_;
+};
+
+// RAII autograd recording scope (ref: mxnet-cpp has no scope class;
+// python's autograd.record() is the model).
+class AutogradRecord {
+ public:
+  AutogradRecord() { Check(MXTAutogradSetIsRecording(1)); }
+  ~AutogradRecord() { MXTAutogradSetIsRecording(0); }
+  AutogradRecord(const AutogradRecord&) = delete;
+  AutogradRecord& operator=(const AutogradRecord&) = delete;
+};
+
+inline void Backward(const NDArray& loss) {
+  void* h = loss.handle();
+  Check(MXTAutogradBackward(1, &h));
+}
+
+inline void WaitAll() { Check(MXTNDArrayWaitAll()); }
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_NDARRAY_HPP_

@@ -165,6 +165,28 @@ class TestCtypesSurface:
         assert b"not_a_real_op" in self.lib.MXTGetLastError()
 
 
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_package_demo_trains(tmp_path):
+    """Compile the header-only C++ frontend demo (cpp-package analog)
+    and run it standalone — the reference's cpp-package/example/mlp.cpp
+    slot over our C ABI."""
+    exe = str(tmp_path / "train_mlp")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         "-I", os.path.join(REPO, "cpp-package", "include"),
+         os.path.join(REPO, "cpp-package", "example", "train_mlp.cpp"),
+         "-o", exe,
+         "-L" + os.path.join(REPO, "mxnet_tpu"), "-lmxnet_tpu",
+         "-Wl,-rpath," + os.path.join(REPO, "mxnet_tpu")],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "cpp-package MLP training OK" in res.stdout
+
+
 @pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
 def test_c_demo_trains_mnist(tmp_path):
     """Compile the pure-C demo and run it as a standalone process

@@ -3,6 +3,7 @@ reference kernels' specs (ref slots: tests/python/unittest/test_operator.py
 test_psroipooling / test_deformable_* and tests/python/gpu counterparts).
 """
 import math
+import os
 
 import numpy as np
 import pytest
@@ -467,3 +468,22 @@ class TestCrop:
         like = _nd(np.zeros((1, 1, 3, 5), "float32"))
         out = nd.Crop(x, like, num_args=2).asnumpy()
         np.testing.assert_array_equal(out, x.asnumpy()[:, :, :3, :5])
+
+
+class TestSSDExample:
+    def test_ssd_pipeline_trains(self):
+        """End-to-end SSD example (example/ssd/train_ssd.py): prior ->
+        target assignment -> masked joint loss -> SGD must reduce the
+        loss, and MultiBoxDetection must decode."""
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "example", "ssd", "train_ssd.py")
+        spec = importlib.util.spec_from_file_location("train_ssd", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        net, losses = mod.train(epochs=80, log=lambda *a: None)
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        rng = np.random.RandomState(1)
+        x, _ = mod.make_batch(rng, batch=2)
+        dets = mod.detect(net, x)
+        assert dets.shape[0] == 2 and dets.shape[2] == 6

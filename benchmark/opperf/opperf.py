@@ -82,7 +82,37 @@ def default_specs():
         "take": lambda: ([_rand(L),
                           _rand((1024,), "int32")], {}),
         "one_hot": lambda: ([_rand((4096,), "int32")], {"depth": 128}),
+        # detection family (round 2; ref: contrib/deformable_convolution.cc,
+        # psroi_pooling.cc, proposal.cc)
+        "_contrib_DeformableConvolution": lambda: (
+            [_rand((8, 64, 28, 28)), _rand((8, 18, 28, 28), seed=1),
+             _rand((64, 64, 3, 3), seed=2)],
+            {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1),
+             "no_bias": True}),
+        "_contrib_PSROIPooling": lambda: (
+            [_rand((2, 4 * 49, 28, 28)),
+             _rand_rois(16, 28)],
+            {"spatial_scale": 1.0, "output_dim": 4, "pooled_size": 7,
+             "group_size": 7}),
+        # image family
+        "_image_to_tensor": lambda: ([_rand((64, 224, 224, 3))], {}),
+        "_image_resize": lambda: ([_rand((64, 224, 224, 3))],
+                                  {"size": (112, 112)}),
+        # quantized int8 (forward-only by nature)
+        "_contrib_quantize_v2": lambda: ([_rand(L)], {}),
     }
+
+
+def _rand_rois(n, size):
+    import numpy as np
+    rs = np.random.RandomState(7)
+    x1 = rs.randint(0, size // 2, n)
+    y1 = rs.randint(0, size // 2, n)
+    rois = np.stack([np.zeros(n), x1, y1,
+                     x1 + rs.randint(4, size // 2, n),
+                     y1 + rs.randint(4, size // 2, n)], 1)
+    import mxnet_tpu as mx
+    return mx.nd.array(rois.astype("float32"))
 
 
 def bench_op(name, make_inputs, warmup=3, runs=20, run_backward=True):

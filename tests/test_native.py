@@ -336,3 +336,27 @@ def test_c_predict_abi(tmp_path):
 
     assert lib.MXTPredFree(h2) == 0
     assert lib.MXTPredFree(handle) == 0
+
+
+def test_rec2idx_tool(tmp_path):
+    """tools/rec2idx.py builds an .idx enabling random access
+    (ref: /root/reference/tools/rec2idx.py IndexCreator)."""
+    import subprocess
+    import sys
+    from mxnet_tpu.recordio import MXRecordIO, MXIndexedRecordIO
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = MXRecordIO(rec, "w")
+    for i in range(25):
+        w.write(("record-%03d" % i).encode() * (i + 1))
+    w.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "rec2idx.py"),
+         rec, idx],
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=repo), timeout=120)
+    assert res.returncode == 0, res.stderr
+    r = MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(17) == b"record-017" * 18
+    assert len(r.keys) == 25

@@ -1,0 +1,77 @@
+"""Create an .idx index file for an existing RecordIO .rec file,
+enabling random access via MXIndexedRecordIO.
+
+ref: /root/reference/tools/rec2idx.py IndexCreator — reads through the
+record stream, recording the byte offset of each record as
+"<key>\\t<offset>\\n" lines.
+
+Usage: python tools/rec2idx.py data.rec data.idx [--key-type int]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from mxnet_tpu.recordio import MXRecordIO  # noqa: E402
+
+
+class IndexCreator(MXRecordIO):
+    """Sequential pass over a .rec writing the byte offset of every
+    record into an .idx sidecar (ref: tools/rec2idx.py IndexCreator)."""
+
+    def __init__(self, uri, idx_path, key_type=int):
+        self.key_type = key_type
+        self.idx_path = idx_path
+        self.fidx = None
+        super().__init__(uri, "r")
+
+    def open(self):
+        super().open()
+        self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def create_index(self):
+        """ref: rec2idx.py IndexCreator.create_index."""
+        counter = 0
+        pre_time = __import__("time").time()
+        while True:
+            pos = self.tell()
+            cont = self.read()
+            if cont is None:
+                break
+            key = self.key_type(counter)
+            self.fidx.write("%s\t%d\n" % (str(key), pos))
+            counter += 1
+            if counter % 1000 == 0:
+                cur_time = __import__("time").time()
+                if cur_time - pre_time > 2:
+                    print("time: %s  count: %d" % (cur_time, counter))
+                    pre_time = cur_time
+        return counter
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Make an index file for a RecordIO file "
+        "(ref: tools/rec2idx.py)")
+    p.add_argument("record", help="path to the .rec file")
+    p.add_argument("index", help="path for the .idx output")
+    p.add_argument("--key-type", choices=["int", "str"], default="int")
+    args = p.parse_args(argv)
+    creator = IndexCreator(args.record, args.index,
+                           int if args.key_type == "int" else str)
+    n = creator.create_index()
+    creator.close()
+    print("wrote %d index entries to %s" % (n, args.index))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,15 @@ def _local_attention(q, k, v, causal, scale, q_offset=0):
     B, H, S, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    if q_offset == 0:
+        # after the all-to-all each device holds FULL sequences for its
+        # head group — plain self-attention, so the Pallas flash kernel
+        # (fwd + flash-2 bwd, O(S*D) HBM) applies directly; it falls
+        # back to the dense reference off-TPU. This is the two-level
+        # composition (inter-chip all-to-all x intra-chip flash) that
+        # makes Ulysses the preferred long-context mode on TPU.
+        from ..pallas_kernels import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         qp = q_offset + jnp.arange(S)

@@ -178,7 +178,13 @@ def _attention(cfg, mesh, q, k, v, positions):
     qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, Dh]
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    if cfg.attn_mode == "ring" and mesh is not None:
+    if cfg.attn_mode == "ring_flash" and mesh is not None:
+        # inter-chip ppermute ring x intra-chip Pallas flash blocks,
+        # differentiable both directions (parallel/ring_flash.py)
+        from .ring_flash import ring_flash_self_attention
+        ot = ring_flash_self_attention(qt, kt, vt, mesh, axis_name="sp",
+                                       causal=cfg.causal)
+    elif cfg.attn_mode == "ring" and mesh is not None:
         from .ring_attention import ring_self_attention
         ot = ring_self_attention(qt, kt, vt, mesh, axis_name="sp",
                                  causal=cfg.causal)

@@ -7,62 +7,159 @@ convergence behavior genuinely differs from dist_sync. The ICI
 collectives that back dist_sync are inherently synchronous, so — as
 SURVEY §5 prescribes — async runs over a host-side transport: a server
 thread in the rank-0 process owns the weights and applies updates as
-pickled (push) messages arrive over TCP; pulls return whatever mix of
-updates has landed. This is the ps-lite worker/server split with the
-scheduler folded into the launcher's coordinator env.
+messages arrive over TCP; pulls return whatever mix of updates has
+landed. This is the ps-lite worker/server split with the scheduler
+folded into the launcher's coordinator env.
 
-Wire protocol: 4-byte big-endian length + pickled tuple
-  ("init", key, np_array) / ("push", key, np_array)
-  ("pull", key) -> np_array        ("set_optimizer", pickled_bytes)
-  ("barrier",) -> ok               ("stop",)
+Wire protocol (no pickle on the data plane — a remote peer can never
+make the server deserialize executable objects from a push/pull):
+
+  frame   := u32_be length | payload
+  payload := opcode:u8 | fields
+  key     := 0x00 i64_be        (int key)
+           | 0x01 u16_be utf8   (str key)
+  array   := u8 dtype-name-len | dtype-name | u8 ndim | u32_be dims...
+           | raw C-order bytes
+
+The ONE message that must carry a Python object — `set_optimizer`, the
+reference's pickled-optimizer-to-server UX (python/mxnet/kvstore_server.py
+``_controller``) — is authenticated: payload is HMAC-SHA256(secret,
+blob) || blob, and the server refuses to unpickle unless the MAC
+verifies. The secret comes from ``MXTPU_PS_SECRET`` (distributed to all
+ranks by the launcher env pass-through, tools/launch.py); rank 0
+generates one when unset so single-host runs are safe by default.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 import pickle
+import secrets as _secrets
 import socket
 import struct
 import threading
+import warnings
 
 import numpy as np
 
 __all__ = ["AsyncPSServer", "AsyncPSClient", "serve_if_rank0"]
 
+# request opcodes
+_OP_INIT = 1
+_OP_PUSH = 2
+_OP_PULL = 3
+_OP_SET_OPT = 4
+_OP_STATS = 5
+_OP_DONE = 6
+_OP_WAIT_DONE = 7
+_OP_STOP = 8
+# reserved for the sparse/compressed wire (row-sparse push/pull and
+# 2-bit compressed push ride the same framing)
+_OP_PUSH_RSP = 9
+_OP_PULL_RSP = 10
+_OP_PUSH_2BIT = 11
+_OP_PROFILER = 12
 
-def _send(sock, obj):
-    data = pickle.dumps(obj)
-    sock.sendall(struct.pack(">I", len(data)) + data)
+# response opcodes
+_RE_OK = 0x10
+_RE_ARR = 0x11
+_RE_INT = 0x12
+_RE_ERR = 0x1F
 
 
-def _recv(sock):
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            return None
-        hdr += chunk
-    n = struct.unpack(">I", hdr)[0]
+def _ps_secret():
+    s = os.environ.get("MXTPU_PS_SECRET", "")
+    return s.encode() if s else None
+
+
+def _pack_key(key):
+    if isinstance(key, (int, np.integer)):
+        return b"\x00" + struct.pack(">q", int(key))
+    kb = str(key).encode()
+    return b"\x01" + struct.pack(">H", len(kb)) + kb
+
+
+def _unpack_key(buf, off):
+    tag = buf[off]
+    off += 1
+    if tag == 0:
+        return struct.unpack_from(">q", buf, off)[0], off + 8
+    (n,) = struct.unpack_from(">H", buf, off)
+    off += 2
+    return buf[off:off + n].decode(), off + n
+
+
+def _pack_arr(a):
+    a = np.ascontiguousarray(a)
+    dt = a.dtype.name.encode()
+    out = [struct.pack(">B", len(dt)), dt, struct.pack(">B", a.ndim)]
+    out.append(struct.pack(">%dI" % a.ndim, *a.shape))
+    out.append(a.tobytes())
+    return b"".join(out)
+
+
+def _unpack_arr(buf, off):
+    n = buf[off]
+    off += 1
+    dt = np.dtype(buf[off:off + n].decode())
+    off += n
+    ndim = buf[off]
+    off += 1
+    shape = struct.unpack_from(">%dI" % ndim, buf, off)
+    off += 4 * ndim
+    count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
+    nbytes = count * dt.itemsize
+    arr = np.frombuffer(buf, dtype=dt, count=count, offset=off
+                        ).reshape(shape).copy()
+    return arr, off + nbytes
+
+
+def _send_frame(sock, payload):
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             return None
         buf += chunk
-    return pickle.loads(buf)
+    return buf
+
+
+def _recv_frame(sock):
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    n = struct.unpack(">I", hdr)[0]
+    return _recv_exact(sock, n)
 
 
 class AsyncPSServer:
     """Weight owner + immediate-apply update loop (the reference's
-    KVStoreDistServer in async mode)."""
+    KVStoreDistServer in async mode).
 
-    def __init__(self, port=0):
+    Binds to ``bind_host`` only (loopback by default) — never to
+    0.0.0.0 unless the launcher explicitly passes the coordinator
+    interface, so the update endpoint is not exposed beyond the
+    training fabric."""
+
+    def __init__(self, port=0, bind_host="127.0.0.1"):
         self._store = {}
         self._updater = None
         self._lock = threading.Lock()
+        if _ps_secret() is None:
+            # same-host workers inherit this via the environment; the
+            # launcher passes MXTPU_* through for remote ranks
+            os.environ["MXTPU_PS_SECRET"] = _secrets.token_hex(32)
+        # pinned at construction: later env mutation must not change
+        # what the server trusts
+        self._secret = _ps_secret()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("0.0.0.0", port))  # reachable from other hosts
-        # under the ssh launcher (the coordinator host dials in)
+        self._srv.bind((bind_host, port))
         self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
@@ -90,78 +187,96 @@ class AsyncPSServer:
     def _serve_conn(self, conn):
         while not self._stop.is_set():
             try:
-                msg = _recv(conn)
+                buf = _recv_frame(conn)
             except OSError:
                 return
-            if msg is None:
+            if buf is None or not len(buf):
                 return
             try:
-                self._handle(conn, msg)
+                self._handle(conn, buf)
             except Exception as e:  # noqa: BLE001 — reply, don't die
+                msg = ("%s: %s" % (type(e).__name__, e)).encode()[:4096]
                 try:
-                    _send(conn, ("err", "%s: %s" % (type(e).__name__, e)))
+                    _send_frame(conn, struct.pack(">BH", _RE_ERR, len(msg))
+                                + msg)
                 except OSError:
                     return
-            if msg[0] == "stop":
+            if buf[0] == _OP_STOP:
                 return
 
-    def _handle(self, conn, msg):
-            op = msg[0]
-            if op == "init":
-                _, key, arr = msg
+    def _handle(self, conn, buf):
+        op, off = buf[0], 1
+        if op == _OP_INIT:
+            key, off = _unpack_key(buf, off)
+            arr, off = _unpack_arr(buf, off)
+            with self._lock:
+                self._store.setdefault(key, arr)
+            _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_PUSH:
+            key, off = _unpack_key(buf, off)
+            grad, off = _unpack_arr(buf, off)
+            # IMMEDIATE apply — no cross-worker barrier (async
+            # semantics, kvstore_dist_server.h:358)
+            with self._lock:
+                if self._updater is not None:
+                    self._apply(key, grad)
+                else:
+                    # same store-replace semantics as the sync
+                    # KVStore without an optimizer (kvstore.py push)
+                    self._store[key] = grad.copy()
+                self.updates_applied += 1
+            _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_PULL:
+            key, off = _unpack_key(buf, off)
+            with self._lock:
+                val = np.array(self._store[key], copy=True)
+            _send_frame(conn, bytes([_RE_ARR]) + _pack_arr(val))
+        elif op == _OP_SET_OPT:
+            # the reference pickles the optimizer worker-side and the
+            # server builds its updater from it (kvstore_server.py).
+            # The blob is executable on unpickle, so it MUST carry a
+            # valid HMAC — an unauthenticated peer cannot reach
+            # pickle.loads.
+            mac, blob = buf[off:off + 32], buf[off + 32:]
+            if self._secret is None:
+                raise RuntimeError(
+                    "server has no MXTPU_PS_SECRET; refusing pickled "
+                    "optimizer (launcher must distribute the secret)")
+            want = hmac.new(self._secret, blob, hashlib.sha256).digest()
+            if not hmac.compare_digest(mac, want):
+                raise PermissionError("set_optimizer HMAC mismatch")
+            import mxnet_tpu.optimizer as opt
+            optimizer = pickle.loads(blob)
+            self._optimizer = optimizer
+            self._updater = opt.get_updater(optimizer)
+            _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_STATS:
+            with self._lock:
+                n = self.updates_applied
+            _send_frame(conn, struct.pack(">Bq", _RE_INT, n))
+        elif op == _OP_DONE:
+            with self._lock:
+                self.workers_done += 1
+            _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_WAIT_DONE:
+            n, timeout = struct.unpack_from(">qd", buf, off)
+            import time as _t
+            deadline = _t.monotonic() + timeout
+            reached = 0
+            while True:  # condition first: timeout=0 is a valid poll
                 with self._lock:
-                    self._store.setdefault(key, np.array(arr, copy=True))
-                _send(conn, ("ok",))
-            elif op == "push":
-                _, key, grad = msg
-                # IMMEDIATE apply — no cross-worker barrier (async
-                # semantics, kvstore_dist_server.h:358)
-                with self._lock:
-                    if self._updater is not None:
-                        self._apply(key, np.asarray(grad))
-                    else:
-                        # same store-replace semantics as the sync
-                        # KVStore without an optimizer (kvstore.py push)
-                        self._store[key] = np.array(grad, copy=True)
-                    self.updates_applied += 1
-                _send(conn, ("ok",))
-            elif op == "pull":
-                _, key = msg
-                with self._lock:
-                    _send(conn, ("val", np.array(self._store[key],
-                                                 copy=True)))
-            elif op == "set_optimizer":
-                # the reference pickles the optimizer worker-side and the
-                # server builds its updater from it (kvstore_server.py)
-                _, blob = msg
-                import mxnet_tpu.optimizer as opt
-                optimizer = pickle.loads(blob)
-                self._opt_states = {}
-                self._optimizer = optimizer
-                self._updater = opt.get_updater(optimizer)
-                _send(conn, ("ok",))
-            elif op == "stats":
-                with self._lock:
-                    _send(conn, ("val", self.updates_applied))
-            elif op == "done":
-                with self._lock:
-                    self.workers_done += 1
-                _send(conn, ("ok",))
-            elif op == "wait_done":
-                _, n = msg
-                import time as _t
-                deadline = _t.monotonic() + 120
-                while _t.monotonic() < deadline:
-                    with self._lock:
-                        if self.workers_done >= n:
-                            break
-                    _t.sleep(0.02)
-                _send(conn, ("ok",))
-            elif op == "stop":
-                _send(conn, ("ok",))
-                self._stop.set()
-            else:
-                _send(conn, ("err", "unknown op %r" % (op,)))
+                    if self.workers_done >= n:
+                        reached = 1
+                        break
+                if _t.monotonic() >= deadline:
+                    break
+                _t.sleep(0.02)
+            _send_frame(conn, struct.pack(">Bq", _RE_INT, reached))
+        elif op == _OP_STOP:
+            _send_frame(conn, bytes([_RE_OK]))
+            self._stop.set()
+        else:
+            raise ValueError("unknown opcode %d" % op)
 
     def _apply(self, key, grad):
         import mxnet_tpu as mx
@@ -196,40 +311,71 @@ class AsyncPSClient:
                 time.sleep(0.1)  # server still coming up on rank 0
         self._lock = threading.Lock()
 
-    def _call(self, *msg):
+    def _call(self, payload):
         with self._lock:
-            _send(self._sock, msg)
-            resp = _recv(self._sock)
+            _send_frame(self._sock, payload)
+            resp = _recv_frame(self._sock)
         if resp is None:
             raise ConnectionError("async PS server closed the connection")
-        if resp[0] == "err":
-            raise RuntimeError(resp[1])
-        return resp[1] if len(resp) > 1 else None
+        code = resp[0]
+        if code == _RE_OK:
+            return None
+        if code == _RE_INT:
+            return struct.unpack_from(">q", resp, 1)[0]
+        if code == _RE_ARR:
+            arr, _ = _unpack_arr(resp, 1)
+            return arr
+        if code == _RE_ERR:
+            (n,) = struct.unpack_from(">H", resp, 1)
+            raise RuntimeError(resp[3:3 + n].decode())
+        raise ConnectionError("bad response opcode %d" % code)
 
     def init(self, key, arr):
-        self._call("init", key, np.asarray(arr))
+        self._call(bytes([_OP_INIT]) + _pack_key(key)
+                   + _pack_arr(np.asarray(arr)))
 
     def push(self, key, grad):
-        self._call("push", key, np.asarray(grad))
+        self._call(bytes([_OP_PUSH]) + _pack_key(key)
+                   + _pack_arr(np.asarray(grad)))
 
     def pull(self, key):
-        return self._call("pull", key)
+        return self._call(bytes([_OP_PULL]) + _pack_key(key))
 
     def set_optimizer(self, optimizer):
-        self._call("set_optimizer", pickle.dumps(optimizer))
+        secret = _ps_secret()
+        if secret is None:
+            raise RuntimeError(
+                "MXTPU_PS_SECRET is not set; cannot authenticate the "
+                "pickled optimizer (serve_if_rank0 generates one — set "
+                "it in the launcher env for multi-host runs)")
+        blob = pickle.dumps(optimizer)
+        mac = hmac.new(secret, blob, hashlib.sha256).digest()
+        self._call(bytes([_OP_SET_OPT]) + mac + blob)
 
     def updates_applied(self):
-        return self._call("stats")
+        return self._call(bytes([_OP_STATS]))
 
     def done(self):
-        self._call("done")
+        self._call(bytes([_OP_DONE]))
 
-    def wait_done(self, n):
-        self._call("wait_done", n)
+    def wait_done(self, n, timeout=None):
+        """Wait until `n` workers called done(); returns True if they
+        did before the deadline (default MXTPU_PS_DONE_TIMEOUT, 120s —
+        matching the reference's barrier-before-exit patience)."""
+        if timeout is None:
+            timeout = float(os.environ.get("MXTPU_PS_DONE_TIMEOUT", "120"))
+        reached = self._call(struct.pack(">Bqd", _OP_WAIT_DONE, n,
+                                         float(timeout)))
+        if not reached:
+            warnings.warn(
+                "async PS shutdown: %d worker done() signals did not "
+                "arrive within %.0fs; stopping anyway" % (n, timeout),
+                RuntimeWarning, stacklevel=2)
+        return bool(reached)
 
     def stop_server(self):
         try:
-            self._call("stop")
+            self._call(bytes([_OP_STOP]))
         except (ConnectionError, OSError):
             pass
 
@@ -247,6 +393,7 @@ class AsyncKVStore:
         self._num_workers = nproc
         self._server, self._client = serve_if_rank0(rank)
         self._optimizer = None
+        self._done_sent = False
 
     # identity
     @property
@@ -270,7 +417,6 @@ class AsyncKVStore:
 
     def push(self, key, value, priority=0):
         from .kvstore import _ctype_key_value
-        from .ndarray import NDArray
         import mxnet_tpu.ndarray as nd
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
@@ -302,7 +448,8 @@ class AsyncKVStore:
 
     def set_optimizer(self, optimizer):
         """Pickle the optimizer to the server, which applies it per push
-        (ref: python/mxnet/kvstore_server.py _controller)."""
+        (ref: python/mxnet/kvstore_server.py _controller). The blob is
+        HMAC-authenticated on the wire — see module docstring."""
         self._optimizer = optimizer
         self._client.set_optimizer(optimizer)
 
@@ -342,11 +489,16 @@ class AsyncKVStore:
     def done(self):
         """Signal this worker finished (coordination for clean server
         shutdown — the reference's Postoffice barrier-before-exit)."""
-        self._client.done()
+        if not self._done_sent:
+            self._done_sent = True
+            self._client.done()
 
     def close(self):
+        # Count our own rank as done so a Trainer/Module exit that never
+        # called done() explicitly doesn't stall waiting for itself.
+        self.done()
         if self._server is not None:
-            self._client.wait_done(self._num_workers - 1)
+            self._client.wait_done(self._num_workers)
             self._client.stop_server()
             self._server.stop()
 
@@ -355,7 +507,10 @@ def serve_if_rank0(rank, port_env="MXTPU_ASYNC_PS_PORT"):
     """Launcher hook: rank 0 hosts the server; every rank returns a
     client. The port is derived deterministically from the launcher's
     coordinator port (DMLC_PS_ROOT_PORT analog) so non-zero ranks know
-    it before the server even starts — they retry until rank 0 binds."""
+    it before the server even starts — they retry until rank 0 binds.
+
+    The server binds to the coordinator interface when one is
+    configured (multi-host), else loopback — never 0.0.0.0."""
     coord = os.environ.get("MXTPU_COORDINATOR", "")
     if coord and ":" in coord:
         host, cport = coord.rsplit(":", 1)
@@ -363,8 +518,13 @@ def serve_if_rank0(rank, port_env="MXTPU_ASYNC_PS_PORT"):
         port = int(os.environ.get(port_env, 0)) or (int(cport) + 1001)
     else:
         host, port = "127.0.0.1", int(os.environ.get(port_env, 0))
+    if rank == 0 and "MXTPU_PS_SECRET" not in os.environ:
+        # generated before fork/spawn of local workers; multi-host
+        # launchers pass MXTPU_* env through (tools/launch.py)
+        os.environ["MXTPU_PS_SECRET"] = _secrets.token_hex(32)
     if rank == 0:
-        server = AsyncPSServer(port)
+        bind = host if host not in ("127.0.0.1", "localhost") else "127.0.0.1"
+        server = AsyncPSServer(port, bind_host=bind)
         os.environ[port_env] = str(server.port)
-        return server, AsyncPSClient("127.0.0.1", server.port)
+        return server, AsyncPSClient(bind, server.port)
     return None, AsyncPSClient(host, port)

@@ -40,7 +40,10 @@ def _fit_block(requested, size, quantum):
     """Largest block <= requested that divides `size` and is a multiple of
     `quantum` (Mosaic sublane/lane granularity). Falls back to `size`
     itself (one block spanning the axis) when no such divisor exists —
-    a block equal to the array dim is always legal."""
+    but only while that still fits VMEM: for e.g. a prime seq length the
+    whole-axis block would allocate a size^2 fp32 score tile and die in
+    an opaque Mosaic compile error, so raise actionable padding guidance
+    instead."""
     b = min(requested, size)
     if size % b == 0:
         return b
@@ -49,6 +52,12 @@ def _fit_block(requested, size, quantum):
         if size % b == 0:
             return b
         b -= quantum
+    if size > 4 * max(requested, quantum):
+        raise ValueError(
+            "flash_attention: sequence length %d has no block divisor that "
+            "is a multiple of %d; pad the sequence to a multiple of %d "
+            "(e.g. with jnp.pad + masking) or pass a block size that "
+            "divides it" % (size, quantum, quantum))
     return size
 
 

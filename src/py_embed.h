@@ -1,9 +1,9 @@
 // Shared embedded-CPython helpers for the C ABI entry points.
 //
-// Same pattern as src/c_predict_api.cc (which predates this header and
-// keeps its private copies): the ABI works both embedded in a C/C++
-// application (initializes CPython on first use) and loaded into an
-// existing Python process (uses the running interpreter via the GIL).
+// Used by c_api*.cc and c_predict_api.cc alike: the ABI works both
+// embedded in a C/C++ application (initializes CPython on first use)
+// and loaded into an existing Python process (uses the running
+// interpreter via the GIL).
 #ifndef MXNET_TPU_SRC_PY_EMBED_H_
 #define MXNET_TPU_SRC_PY_EMBED_H_
 

@@ -1,0 +1,40 @@
+"""Example Python external operator library.
+
+Python plugins register jax-traceable ops — first-class citizens that
+compile, fuse, and differentiate like built-ins (unlike C plugins,
+which are host-callback islands). Load with:
+
+    >>> import mxnet_tpu as mx
+    >>> mx.lib_api.load("/abs/path/gelu_plugin.py")
+    >>> y = mx.nd.my_gelu(x)          # nd, sym, and gluon all see it
+"""
+import jax.numpy as jnp
+
+from mxnet_tpu import lib_api
+
+
+def _gelu_fwd(x):
+    # tanh-approximation GELU, pure jnp: traces into XLA
+    c = jnp.sqrt(jnp.asarray(2.0 / jnp.pi, x.dtype))
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x ** 3)))
+
+
+def _gelu_bwd(residuals, g):
+    (x,) = residuals
+    c = jnp.sqrt(jnp.asarray(2.0 / jnp.pi, x.dtype))
+    inner = c * (x + 0.044715 * x ** 3)
+    t = jnp.tanh(inner)
+    dinner = c * (1.0 + 3 * 0.044715 * x ** 2)
+    dgelu = 0.5 * (1.0 + t) + 0.5 * x * (1.0 - t ** 2) * dinner
+    return (g * dgelu,)
+
+
+def initialize(version):
+    """lib_api.h contract: non-zero iff compatible with `version`."""
+    if version < 10600:
+        return 0
+    lib_api.register_op("my_gelu", _gelu_fwd, backward=_gelu_bwd)
+    # an op relying on jax autodiff (no explicit backward)
+    lib_api.register_op("my_softplus2",
+                        lambda x: 2.0 * jnp.logaddexp(x, 0.0))
+    return 1

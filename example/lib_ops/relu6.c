@@ -1,0 +1,60 @@
+/* Example external operator library: relu6 + scale2, host f32 kernels.
+ *
+ * Analog of the reference's external-library examples built against
+ * include/mxnet/lib_api.h. Build and load:
+ *
+ *   gcc -shared -fPIC -O2 -I../../src relu6.c -o librelu6.so
+ *   >>> import mxnet_tpu as mx
+ *   >>> mx.lib_api.load("/abs/path/librelu6.so")
+ *   >>> mx.nd.relu6(mx.nd.array([-1., 3., 9.]))   # [0, 3, 6]
+ */
+#include "../../src/lib_api.h"
+
+int initialize(int version) {
+  return version >= 10600; /* non-zero = compatible (lib_api.h contract) */
+}
+
+static const char* kNames[] = {"relu6", "scale2"};
+
+int _opRegSize(void) { return 2; }
+
+const char* _opRegName(int idx) { return kNames[idx]; }
+
+static int64_t numel(const int64_t* shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+/* both ops are elementwise: output shape == first input shape */
+int _opInferShape(int idx, int nin,
+                  const int64_t* const* in_shapes, const int* in_ndims,
+                  int64_t* out_shape, int* out_ndim) {
+  (void)idx;
+  if (nin < 1 || in_ndims[0] > 8) return 1;
+  *out_ndim = in_ndims[0];
+  for (int i = 0; i < in_ndims[0]; ++i) out_shape[i] = in_shapes[0][i];
+  return 0;
+}
+
+int _opCompute(int idx, int nin,
+               const float* const* inputs,
+               const int64_t* const* in_shapes, const int* in_ndims,
+               float* output, const int64_t* out_shape, int out_ndim) {
+  (void)nin;
+  int64_t n = numel(out_shape, out_ndim);
+  (void)in_shapes; (void)in_ndims;
+  const float* x = inputs[0];
+  if (idx == 0) { /* relu6 */
+    for (int64_t i = 0; i < n; ++i) {
+      float v = x[i] < 0.f ? 0.f : x[i];
+      output[i] = v > 6.f ? 6.f : v;
+    }
+    return 0;
+  }
+  if (idx == 1) { /* scale2 */
+    for (int64_t i = 0; i < n; ++i) output[i] = 2.f * x[i];
+    return 0;
+  }
+  return 1;
+}

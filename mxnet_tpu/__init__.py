@@ -73,6 +73,7 @@ _LAZY = {
     "numpy_extension": ".numpy_extension",
     "npx": ".numpy_extension",
     "lib_api": ".lib_api",
+    "library": ".library",
     "storage": ".storage",
     "rtc": ".rtc",
     "visualization": ".visualization",

@@ -1,0 +1,291 @@
+"""External operator-library ABI: load out-of-tree ops at runtime.
+
+TPU-native analog of the reference's library-loading surface:
+
+- ``MXLoadLib`` (ref: src/c_api/c_api.cc:96) dlopens a user library and
+  calls its exported ``initialize(int version)`` — the one function the
+  1.6 plugin contract requires (ref: include/mxnet/lib_api.h
+  ``MXLIB_INITIALIZE_STR``). A truthy return means "compatible,
+  registered" (the reference's c_api.cc treats a zero return as
+  failure).
+- ``python/mxnet/library.py load()`` is the user entry point.
+
+Here a plugin is either:
+
+1. **A Python module** (``.py``) — imported in its own namespace; it
+   registers jax-traceable ops via :func:`register_op` (optionally with
+   a custom VJP), then the loader calls its ``initialize(version)``.
+   These ops are first-class: they trace into XLA, differentiate, and
+   fuse like built-ins.
+2. **A C shared library** (``.so``) — dlopened via ctypes; after
+   ``initialize`` succeeds the loader queries an optional registration
+   surface (``_opRegSize`` / ``_opRegName`` / ``_opInferShape`` /
+   ``_opCompute``, declared in ``src/lib_api.h``) and wraps each kernel
+   in ``jax.pure_callback``: on TPU a foreign C kernel is host compute
+   by construction, so the callback island is the honest mapping —
+   inputs stream back to the host, the kernel runs, the result is fed
+   to the device, and XLA treats it as an opaque node. C-plugin ops are
+   forward-only (no VJP) unless the library also exports
+   ``_opBackward``.
+
+Loaded ops appear in ``mx.nd``, ``mx.sym`` and the operator registry
+immediately, so Gluon/Module graphs can use them like any other op.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+__all__ = ["load", "register_op", "loaded_libraries", "VERSION"]
+
+# MXNET_VERSION analog: major*10000 + minor*100 + patch (ref:
+# include/mxnet/lib_api.h version passing convention)
+VERSION = 10600
+
+_LOADED = {}
+
+
+def loaded_libraries():
+    """Paths of libraries loaded this process (ref: MXLibInfo* family)."""
+    return sorted(_LOADED)
+
+
+def _install_wrappers(names):
+    """(Re)install nd/sym wrappers for `names`, overwriting any existing
+    entry — unlike additive populate(), a plugin that overrides a
+    built-in must actually take effect through mx.nd/mx.sym."""
+    import mxnet_tpu.ndarray as _nd
+    import mxnet_tpu.symbol as _sym
+    from .ndarray.register import make_op_func
+    from .symbol.register import make_symbol_op_func
+    from .ops import registry as _registry
+    for n in names:
+        opdef = _registry.get_op(n)
+        vars(_nd)[n] = make_op_func(opdef, n)
+        vars(_sym)[n] = make_symbol_op_func(opdef, n)
+
+
+def _registry_snapshot():
+    from .ops import registry as _registry
+    return dict(_registry._OPS)
+
+
+def _registry_rollback(snapshot):
+    """Restore the registry (and nd/sym wrappers) to `snapshot` — a
+    failed initialize must leave nothing behind (MXLoadLib contract:
+    zero return means nothing was registered)."""
+    import mxnet_tpu.ndarray as _nd
+    import mxnet_tpu.symbol as _sym
+    from .ops import registry as _registry
+    added = set(_registry._OPS) - set(snapshot)
+    changed = [n for n in snapshot
+               if _registry._OPS.get(n) is not snapshot[n]]
+    _registry._OPS.clear()
+    _registry._OPS.update(snapshot)
+    for n in added:
+        vars(_nd).pop(n, None)
+        vars(_sym).pop(n, None)
+    _install_wrappers(changed)
+
+
+def register_op(name, forward, backward=None, aliases=(), no_grad=False):
+    """Register an out-of-tree operator into the live registry.
+
+    Parameters
+    ----------
+    name : str
+        Op name; becomes ``mx.nd.<name>`` / ``mx.sym.<name>``.
+    forward : callable
+        Pure function ``fn(*jax_arrays, **static_params) -> array`` —
+        jax-traceable (jnp/lax), so it compiles and fuses like any
+        built-in op.
+    backward : callable, optional
+        Custom VJP ``fn(residual_inputs, cotangent) -> tuple(grads)``.
+        When given, ``forward`` is wrapped in ``jax.custom_vjp``;
+        otherwise jax autodiff of ``forward`` applies (or the op is
+        marked non-differentiable with ``no_grad=True``).
+    """
+    import functools
+    import inspect
+    import warnings
+
+    import jax
+    from .ops import registry as _registry
+
+    fn = forward
+    if backward is not None:
+        # custom_vjp can't bind keyword args, so build one wrapped fn
+        # per distinct static-kwarg binding (cached; kwargs of an op
+        # call are hashable static params by the registry contract)
+        bwd_params = inspect.signature(backward).parameters
+        bwd_takes_kw = (len(bwd_params) > 2 or any(
+            p.kind == inspect.Parameter.VAR_KEYWORD
+            for p in bwd_params.values()))
+
+        @functools.lru_cache(maxsize=None)
+        def _vjp_for(kw_items):
+            kw = dict(kw_items)
+
+            @jax.custom_vjp
+            def f(*args):
+                return forward(*args, **kw)
+
+            def _fwd(*args):
+                return forward(*args, **kw), args
+
+            def _bwd(residuals, g):
+                if bwd_takes_kw:
+                    return tuple(backward(residuals, g, **kw))
+                return tuple(backward(residuals, g))
+
+            f.defvjp(_fwd, _bwd)
+            return f
+
+        def fn(*args, **kwargs):
+            return _vjp_for(tuple(sorted(kwargs.items())))(*args)
+
+        fn.__name__ = name
+        fn.__signature__ = inspect.signature(forward)
+    existing = _registry._OPS.get(name)
+    if existing is not None:
+        warnings.warn("external library overrides operator %r" % name,
+                      RuntimeWarning, stacklevel=2)
+    _registry.register(name, no_grad=no_grad, aliases=aliases)(fn)
+    _install_wrappers((name,) + tuple(aliases))
+    return fn
+
+
+def _load_python_plugin(path):
+    import importlib.util
+    modname = "mxnet_tpu_lib_%s" % os.path.basename(path)[:-3]
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    init = getattr(mod, "initialize", None)
+    if init is None:
+        raise RuntimeError(
+            "plugin %s does not export initialize(version) "
+            "(ref: lib_api.h MXLIB_INITIALIZE_STR contract)" % path)
+    if not init(VERSION):
+        raise RuntimeError("library %s failed to initialize "
+                           "(incompatible with version %d)" % (path, VERSION))
+    return mod
+
+
+_MAX_NDIM = 8
+
+
+def _wrap_c_op(lib, idx, name):
+    """Build a jax-callable from a C plugin kernel via pure_callback."""
+    import jax
+    import jax.numpy as jnp
+
+    infer = lib._opInferShape
+    infer.restype = ctypes.c_int
+    compute = lib._opCompute
+    compute.restype = ctypes.c_int
+
+    def _infer_shape(in_shapes):
+        nin = len(in_shapes)
+        shape_arrs = [np.asarray(s, dtype=np.int64) for s in in_shapes]
+        ptrs = (ctypes.POINTER(ctypes.c_int64) * nin)(
+            *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+              for s in shape_arrs])
+        ndims = (ctypes.c_int * nin)(*[len(s) for s in in_shapes])
+        out_shape = (ctypes.c_int64 * _MAX_NDIM)()
+        out_ndim = ctypes.c_int(0)
+        rc = infer(idx, nin, ptrs, ndims, out_shape,
+                   ctypes.byref(out_ndim))
+        if rc != 0:
+            raise RuntimeError("%s: _opInferShape failed (%d)" % (name, rc))
+        return tuple(out_shape[i] for i in range(out_ndim.value))
+
+    def _host_kernel(out_shape, *arrays):
+        # out_shape was inferred once at trace time (op_fn) — no extra
+        # ctypes round-trip per callback execution
+        arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+        nin = len(arrays)
+        out = np.empty(out_shape, dtype=np.float32)
+        data_ptrs = (ctypes.POINTER(ctypes.c_float) * nin)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        shape_arrs = [np.asarray(a.shape, dtype=np.int64) for a in arrays]
+        shape_ptrs = (ctypes.POINTER(ctypes.c_int64) * nin)(
+            *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+              for s in shape_arrs])
+        ndims = (ctypes.c_int * nin)(*[a.ndim for a in arrays])
+        oshape = np.asarray(out_shape, dtype=np.int64)
+        rc = compute(idx, nin, data_ptrs, shape_ptrs, ndims,
+                     out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                     oshape.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                     len(out_shape))
+        if rc != 0:
+            raise RuntimeError("%s: _opCompute failed (%d)" % (name, rc))
+        return out
+
+    def op_fn(*arrays):
+        import functools
+        arrays = [jnp.asarray(a, dtype=jnp.float32) for a in arrays]
+        out_shape = _infer_shape([a.shape for a in arrays])
+        result_sd = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+        kernel = functools.partial(_host_kernel, out_shape)
+        return jax.pure_callback(kernel, result_sd, *arrays,
+                                 vmap_method="sequential")
+
+    op_fn.__name__ = name
+    op_fn.__doc__ = ("External C-plugin op %r (host-callback kernel; "
+                     "forward-only)" % name)
+    return op_fn
+
+
+def _load_c_plugin(path):
+    lib = ctypes.CDLL(path)
+    init = lib.initialize
+    init.restype = ctypes.c_int
+    init.argtypes = [ctypes.c_int]
+    if not init(VERSION):
+        raise RuntimeError("library %s failed to initialize "
+                           "(incompatible with version %d)" % (path, VERSION))
+    # optional op-registration surface
+    if not hasattr(lib, "_opRegSize"):
+        return lib
+    lib._opRegSize.restype = ctypes.c_int
+    lib._opRegName.restype = ctypes.c_char_p
+    n = lib._opRegSize()
+    for i in range(n):
+        name = lib._opRegName(i).decode()
+        register_op(name, _wrap_c_op(lib, i, name), no_grad=True)
+    return lib
+
+
+def load(path, verbose=True):
+    """Load an external operator library (ref: python/mxnet/library.py
+    load(), src/c_api/c_api.cc:96 MXLoadLib).
+
+    ``path`` must be an absolute path to a ``.so`` (C plugin) or ``.py``
+    (Python plugin) file. Idempotent per path.
+    """
+    from .base import MXNetError
+    if not os.path.exists(path):
+        raise MXNetError("load path %s does NOT exist" % path)
+    if not os.path.isabs(path):
+        raise MXNetError("load path %s is not an absolute path" % path)
+    ext = os.path.splitext(path)[1]
+    if ext not in (".so", ".dll", ".py"):
+        raise MXNetError("load path %s is NOT a library file" % path)
+    if path in _LOADED:
+        return _LOADED[path]
+    snapshot = _registry_snapshot()
+    try:
+        handle = (_load_python_plugin(path) if ext == ".py"
+                  else _load_c_plugin(path))
+    except Exception:
+        _registry_rollback(snapshot)
+        raise
+    _LOADED[path] = handle
+    if verbose:
+        import logging
+        logging.getLogger("mxnet_tpu").info("loaded library %s", path)
+    return handle

@@ -1,0 +1,8 @@
+"""Library management API (ref: python/mxnet/library.py).
+
+Thin alias of :mod:`mxnet_tpu.lib_api` so reference code using
+``mx.library.load(path)`` works unchanged.
+"""
+from .lib_api import load, loaded_libraries  # noqa: F401
+
+__all__ = ["load", "loaded_libraries"]

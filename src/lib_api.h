@@ -1,0 +1,47 @@
+// Plugin-author header for external operator libraries.
+//
+// Analog of the reference's include/mxnet/lib_api.h: a plugin MUST
+// export initialize(int version) and return non-zero when compatible
+// (ref: lib_api.h MXLIB_INITIALIZE_STR; src/c_api/c_api.cc:96 MXLoadLib
+// treats zero as failure). Beyond that 1.6 contract, this framework
+// defines an optional registration surface so a C plugin can publish
+// host-side f32 kernels; the loader (mxnet_tpu/lib_api.py) wraps each
+// one in jax.pure_callback so it composes with jit'ed graphs as an
+// opaque host node.
+//
+// Build: gcc -shared -fPIC -O2 myops.c -o libmyops.so
+#ifndef MXNET_TPU_SRC_LIB_API_H_
+#define MXNET_TPU_SRC_LIB_API_H_
+
+#include <stdint.h>
+
+#define MXTPU_LIB_VERSION 10600  /* major*10000 + minor*100 + patch */
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Required. Return non-zero iff the library supports `version`. */
+int initialize(int version);
+
+/* Optional op-registration surface (all-or-nothing):             */
+/* number of ops this library provides                            */
+int _opRegSize(void);
+/* name of op `idx` (static storage)                              */
+const char* _opRegName(int idx);
+/* infer the (single) output shape from the input shapes; write   */
+/* into out_shape (capacity 8) / out_ndim; return 0 on success    */
+int _opInferShape(int idx, int nin,
+                  const int64_t* const* in_shapes, const int* in_ndims,
+                  int64_t* out_shape, int* out_ndim);
+/* compute the op on contiguous f32 host buffers; return 0 on     */
+/* success                                                        */
+int _opCompute(int idx, int nin,
+               const float* const* inputs,
+               const int64_t* const* in_shapes, const int* in_ndims,
+               float* output, const int64_t* out_shape, int out_ndim);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  // MXNET_TPU_SRC_LIB_API_H_

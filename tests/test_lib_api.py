@@ -1,0 +1,188 @@
+"""External op-library ABI (lib_api) tests.
+
+ref: src/c_api/c_api.cc:96 MXLoadLib + include/mxnet/lib_api.h
+initialize(version) contract + python/mxnet/library.py load().
+"""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def c_plugin(tmp_path_factory):
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    d = tmp_path_factory.mktemp("libops")
+    so = str(d / "librelu6.so")
+    src = os.path.join(REPO, "example", "lib_ops", "relu6.c")
+    subprocess.check_call(["gcc", "-shared", "-fPIC", "-O2",
+                           "-I", os.path.join(REPO, "src"), src, "-o", so])
+    mx.lib_api.load(so)
+    return so
+
+
+@pytest.fixture(scope="module")
+def py_plugin():
+    path = os.path.join(REPO, "example", "lib_ops", "gelu_plugin.py")
+    mx.lib_api.load(path)
+    return path
+
+
+class TestCPlugin:
+    def test_nd(self, c_plugin):
+        x = mx.nd.array(np.array([-3.0, 2.0, 7.5], np.float32))
+        y = mx.nd.relu6(x)
+        np.testing.assert_allclose(y.asnumpy(), [0.0, 2.0, 6.0])
+        z = mx.nd.scale2(x)
+        np.testing.assert_allclose(z.asnumpy(), [-6.0, 4.0, 15.0])
+
+    def test_inside_jit(self, c_plugin):
+        # pure_callback islands must survive jit tracing
+        import jax
+        import jax.numpy as jnp
+        fn = jax.jit(lambda a: mx.ops.registry.get_op("relu6").fn(a) + 1.0)
+        out = fn(jnp.array([-1.0, 8.0]))
+        np.testing.assert_allclose(np.asarray(out), [1.0, 7.0])
+
+    def test_sym(self, c_plugin):
+        data = mx.sym.var("data")
+        net = mx.sym.relu6(data)
+        ex = net.bind(mx.cpu(), {"data": mx.nd.array(
+            np.array([[-1.0, 6.5]], np.float32))})
+        (out,) = ex.forward()
+        np.testing.assert_allclose(out.asnumpy(), [[0.0, 6.0]])
+
+    def test_idempotent_load(self, c_plugin):
+        h1 = mx.lib_api.load(c_plugin)
+        h2 = mx.lib_api.load(c_plugin)
+        assert h1 is h2
+        assert c_plugin in mx.lib_api.loaded_libraries()
+
+
+class TestPyPlugin:
+    def test_nd_and_grad(self, py_plugin):
+        x = mx.nd.array(np.linspace(-2, 2, 7).astype(np.float32))
+        x.attach_grad()
+        with mx.autograd.record():
+            y = mx.nd.my_gelu(x)
+        y.backward(mx.nd.ones_like(y))
+        # custom VJP must match finite differences
+        eps = 1e-3
+        xn = x.asnumpy()
+        import jax.numpy as jnp
+        f = mx.ops.registry.get_op("my_gelu").fn
+        num = (np.asarray(f(jnp.asarray(xn + eps)))
+               - np.asarray(f(jnp.asarray(xn - eps)))) / (2 * eps)
+        np.testing.assert_allclose(x.grad.asnumpy(), num, atol=1e-2)
+
+    def test_autodiff_without_backward(self, py_plugin):
+        x = mx.nd.array(np.array([0.5, -0.5], np.float32))
+        x.attach_grad()
+        with mx.autograd.record():
+            y = mx.nd.my_softplus2(x)
+        y.backward(mx.nd.ones_like(y))
+        sig = 1 / (1 + np.exp(-x.asnumpy()))
+        np.testing.assert_allclose(x.grad.asnumpy(), 2 * sig, rtol=1e-5)
+
+    def test_gluon(self, py_plugin):
+        class Net(mx.gluon.HybridBlock):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.dense = mx.gluon.nn.Dense(4)
+
+            def hybrid_forward(self, F, x):
+                return F.my_gelu(self.dense(x))
+
+        net = Net()
+        net.initialize()
+        net.hybridize()
+        out = net(mx.nd.array(np.ones((2, 3), np.float32)))
+        assert out.shape == (2, 4)
+
+    def test_library_alias(self, py_plugin):
+        assert py_plugin in mx.library.loaded_libraries()
+
+
+class TestContract:
+    def test_missing_file(self):
+        with pytest.raises(mx.base.MXNetError):
+            mx.lib_api.load("/nonexistent/lib.so")
+
+    def test_relative_path(self):
+        with pytest.raises(mx.base.MXNetError):
+            mx.lib_api.load("relative.so")
+
+    def test_bad_extension(self, tmp_path):
+        p = tmp_path / "notalib.txt"
+        p.write_text("x")
+        with pytest.raises(mx.base.MXNetError):
+            mx.lib_api.load(str(p))
+
+    def test_initialize_version_gate(self, tmp_path):
+        # a plugin rejecting the framework version must fail the load
+        p = tmp_path / "oldlib.py"
+        p.write_text("def initialize(version):\n    return 0\n")
+        with pytest.raises(RuntimeError, match="failed to initialize"):
+            mx.lib_api.load(str(p))
+
+    def test_missing_initialize(self, tmp_path):
+        p = tmp_path / "noinit.py"
+        p.write_text("x = 1\n")
+        with pytest.raises(RuntimeError, match="initialize"):
+            mx.lib_api.load(str(p))
+
+    def test_failed_initialize_rolls_back_registrations(self, tmp_path):
+        # a plugin that registers THEN fails the version gate must leave
+        # nothing behind (MXLoadLib: zero return = nothing registered)
+        p = tmp_path / "haflib.py"
+        p.write_text(
+            "import jax.numpy as jnp\n"
+            "from mxnet_tpu import lib_api\n"
+            "def initialize(version):\n"
+            "    lib_api.register_op('halfbaked_op', lambda x: x + 1)\n"
+            "    return 0\n")
+        with pytest.raises(RuntimeError, match="failed to initialize"):
+            mx.lib_api.load(str(p))
+        assert not hasattr(mx.nd, "halfbaked_op")
+        with pytest.raises(KeyError):
+            mx.ops.registry.get_op("halfbaked_op")
+
+
+class TestRegisterOp:
+    def test_custom_vjp_with_static_kwargs(self):
+        import jax.numpy as jnp
+
+        def fwd(x, scale=2.0):
+            return scale * x * x
+
+        def bwd(residuals, g, scale=2.0):
+            (x,) = residuals
+            return (g * 2.0 * scale * x,)
+
+        mx.lib_api.register_op("sqscale_t", fwd, backward=bwd)
+        x = mx.nd.array(np.array([1.0, -2.0], np.float32))
+        x.attach_grad()
+        with mx.autograd.record():
+            y = mx.nd.sqscale_t(x, scale=3.0)
+        np.testing.assert_allclose(y.asnumpy(), [3.0, 12.0])
+        y.backward(mx.nd.ones_like(y))
+        np.testing.assert_allclose(x.grad.asnumpy(), [6.0, -12.0])
+
+    def test_override_takes_effect_in_namespaces(self):
+        import jax.numpy as jnp
+        # register, then override: mx.nd must see the NEW semantics
+        mx.lib_api.register_op("ovr_t", lambda x: x + 1.0)
+        assert mx.nd.ovr_t(mx.nd.array([1.0])).asnumpy()[0] == 2.0
+        with pytest.warns(RuntimeWarning, match="overrides operator"):
+            mx.lib_api.register_op("ovr_t", lambda x: x + 10.0)
+        assert mx.nd.ovr_t(mx.nd.array([1.0])).asnumpy()[0] == 11.0
+        s = mx.sym.ovr_t(mx.sym.var("data"))
+        ex = s.bind(mx.cpu(), {"data": mx.nd.array([1.0])})
+        assert ex.forward()[0].asnumpy()[0] == 11.0

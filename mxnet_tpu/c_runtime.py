@@ -65,8 +65,22 @@ def invoke(op_name, inputs, keys, vals):
     """MXTImperativeInvoke core (ref: c_api_ndarray.cc:132
     MXImperativeInvokeEx -> Imperative::Invoke). Shares the dispatch
     choke point with the Python frontend (AMP hooks and all)."""
+    from .ops import registry as _registry
     kwargs = {k: _parse(v) for k, v in zip(keys, vals)}
-    out = _register.invoke_by_name(op_name, *inputs, **kwargs)
+    try:
+        opdef = _registry.get_op(op_name)
+    except KeyError:
+        # the fused optimizer update ops live in the nd namespace, not
+        # the registry (ndarray/optimizer_ops.py) — the reference
+        # registers those as ops too, so resolve exactly that family
+        # here (an allowlist: arbitrary nd attributes like save/load
+        # must NOT be invocable through the C op surface)
+        from .ndarray import optimizer_ops as _opt_ops
+        if op_name not in _opt_ops.__all__:
+            raise KeyError("no such operator: %r" % op_name)
+        out = getattr(_opt_ops, op_name)(*inputs, **kwargs)
+    else:
+        out = _register.invoke(opdef, inputs, kwargs)
     return list(out) if isinstance(out, (tuple, list)) else [out]
 
 
@@ -119,3 +133,272 @@ def wait_all():
 def load_symbol_json(path):
     import mxnet_tpu as mx
     return mx.sym.load(path)
+
+
+# -- Symbol family (ref: MXSymbol* section of include/mxnet/c_api.h) --------
+
+def symbol_from_json(json_str):
+    import mxnet_tpu as mx
+    return mx.sym.load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_save(sym, path):
+    sym.save(path)
+
+
+def symbol_var(name):
+    import mxnet_tpu as mx
+    return mx.sym.var(name)
+
+
+class _AtomicOp:
+    """An op-with-params awaiting composition (the two-step
+    MXSymbolCreateAtomicSymbol -> MXSymbolCompose flow of the reference
+    C ABI; ref: c_api_symbolic.cc)."""
+
+    def __init__(self, op_name, attrs):
+        from .ops import registry as _registry
+        _registry.get_op(op_name)  # fail fast on unknown ops
+        self.op_name = op_name
+        self.attrs = attrs
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    return _AtomicOp(op_name, {k: _parse(v) for k, v in zip(keys, vals)})
+
+
+def symbol_compose(atomic, name, keys, args):
+    """Compose an atomic op with input symbols. `keys` empty => positional
+    (the reference accepts both; ref: MXSymbolCompose c_api.h)."""
+    from .symbol.register import make_symbol_op_func
+    from .ops import registry as _registry
+    opdef = _registry.get_op(atomic.op_name)
+    fn = make_symbol_op_func(opdef, atomic.op_name)
+    kwargs = dict(atomic.attrs)
+    if name:
+        kwargs["name"] = name
+    if keys:
+        kwargs.update(dict(zip(keys, args)))
+        return fn(**kwargs)
+    return fn(*args, **kwargs)
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_name(sym):
+    n = getattr(sym, "name", None)
+    return n if n is not None else ""
+
+
+def symbol_infer_shape(sym, names, shapes):
+    """Returns (arg_shapes, out_shapes, aux_shapes) given provided input
+    shapes (ref: MXSymbolInferShape)."""
+    provided = {n: tuple(s) for n, s in zip(names, shapes)}
+    arg, out, aux = sym.infer_shape(**provided)
+    def _clean(lst):
+        return [tuple(int(d) for d in s) if s is not None else () for s in lst]
+    return _clean(arg), _clean(out), _clean(aux)
+
+
+# -- Executor family (ref: MXExecutor* / graph_executor.cc) -----------------
+
+def executor_simple_bind(sym, names, shapes, grad_req):
+    from .executor import Executor
+    provided = {n: tuple(s) for n, s in zip(names, shapes)}
+    return Executor.simple_bind(sym, grad_req=grad_req, **provided)
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def executor_backward(ex, out_grads):
+    ex.backward(out_grads if out_grads else None)
+
+
+def executor_arg(ex, name):
+    return ex.arg_dict[name]
+
+
+def executor_grad(ex, name):
+    g = ex.grad_dict.get(name)
+    if g is None:
+        raise KeyError("argument %r has no gradient buffer" % name)
+    return g
+
+
+def executor_aux(ex, name):
+    return ex.aux_dict[name]
+
+
+# -- KVStore family (ref: MXKVStore* c_api.h; src/kvstore/kvstore.cc:40) ----
+
+def kv_create(kind):
+    import mxnet_tpu as mx
+    return mx.kv.create(kind)
+
+
+def kv_init(kv, key, arr):
+    kv.init(key, arr)
+
+
+def kv_push(kv, key, arr, priority):
+    kv.push(key, arr, priority=priority)
+
+
+def kv_pull(kv, key, out, priority):
+    kv.pull(key, out=out, priority=priority)
+
+
+def kv_pushpull(kv, key, arr, out, priority):
+    kv.pushpull(key, arr, out=out, priority=priority)
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_size(kv):
+    return int(kv.num_workers)
+
+
+def kv_type(kv):
+    return str(kv.type)
+
+
+def kv_set_optimizer(kv, name, keys, vals):
+    import mxnet_tpu.optimizer as opt
+    params = {k: _parse(v) for k, v in zip(keys, vals)}
+    kv.set_optimizer(opt.create(name, **params))
+
+
+# -- DataIter family (ref: MXDataIter* c_api.h; src/io/io.cc registry) ------
+
+_ITER_NAMES = ("MNISTIter", "CSVIter", "LibSVMIter", "ImageRecordIter")
+
+
+def list_data_iters():
+    return list(_ITER_NAMES)
+
+
+class _IterCursor:
+    """Holds the current batch so GetData/GetLabel have stable handles
+    (the reference iterator's current DataBatch)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def data_iter_create(name, keys, vals):
+    import mxnet_tpu.io as io
+    import mxnet_tpu.image as image
+    params = {k: _parse(v) for k, v in zip(keys, vals)}
+    if name == "ImageRecordIter":
+        from .io.image_iter import ImageRecordIter
+        return _IterCursor(ImageRecordIter(**params))
+    cls = getattr(io, name, None)
+    if cls is None:
+        cls = getattr(image, name, None)
+    if cls is None:
+        raise ValueError("unknown data iterator %r (have: %s)"
+                         % (name, ", ".join(_ITER_NAMES)))
+    return _IterCursor(cls(**params))
+
+
+def data_iter_next(cur):
+    try:
+        cur.batch = cur.it.next()
+        return 1
+    except StopIteration:
+        cur.batch = None
+        return 0
+
+
+def data_iter_data(cur):
+    if cur.batch is None:
+        raise RuntimeError("no current batch (call MXTDataIterNext first)")
+    return cur.batch.data[0]
+
+
+def data_iter_label(cur):
+    if cur.batch is None:
+        raise RuntimeError("no current batch (call MXTDataIterNext first)")
+    return cur.batch.label[0]
+
+
+def data_iter_reset(cur):
+    cur.it.reset()
+    cur.batch = None
+
+
+# -- NDArray save/load (ref: MXNDArraySave/Load c_api.h:638-672) ------------
+
+def nd_save(fname, arrays, names):
+    import mxnet_tpu as mx
+    if names:
+        mx.nd.save(fname, dict(zip(names, arrays)))
+    else:
+        mx.nd.save(fname, list(arrays))
+
+
+def nd_load(fname):
+    import mxnet_tpu as mx
+    data = mx.nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return names, [data[n] for n in names]
+    return [], list(data)
+
+
+def set_data(dst, src):
+    """Device-side value copy dst <- src, no host round trip
+    (ref: MXNDArraySyncCopyFromNDArray c_api.h)."""
+    import jax.numpy as jnp
+    if tuple(dst.shape) != tuple(src.shape):
+        raise ValueError("MXTNDArrayCopyFrom: shape mismatch (dst %s, "
+                         "src %s)" % (tuple(dst.shape), tuple(src.shape)))
+    dst._data = jnp.asarray(src._data, dst._data.dtype)
+
+
+def copy_from_bytes(arr, raw):
+    """In-place value update (ref: MXNDArraySyncCopyFromCPU c_api.h:456)."""
+    import jax.numpy as jnp
+    new = np.frombuffer(raw, str(arr.dtype)).reshape(arr.shape)
+    arr._data = jnp.asarray(np.ascontiguousarray(new))
+
+
+# -- misc (seed/op list/lib loading) ----------------------------------------
+# (the version constant lives C-side in MXTGetVersion, c_api_symbol.cc)
+
+def random_seed(seed):
+    import mxnet_tpu as mx
+    mx.random.seed(int(seed))
+
+
+def list_all_ops():
+    from .ops import registry as _registry
+    return sorted(set(_registry.list_ops()))
+
+
+def load_lib(path):
+    from . import lib_api
+    lib_api.load(path)

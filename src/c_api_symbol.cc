@@ -1,0 +1,731 @@
+// Frontier C ABI: Symbol, Executor, KVStore, DataIter, NDArray save/load.
+//
+// Widens the training surface of c_api_runtime.cc to the full set of
+// families every reference language frontend is built on
+// (ref: include/mxnet/c_api.h — MXSymbolCreateFromJSON/Compose family,
+// MXExecutorSimpleBindEx, MXKVStoreInit/Push/Pull/PushPullEx,
+// MXDataIterCreateIter/Next/GetData/GetLabel, MXNDArraySave/Load
+// :638-672). Same architecture as c_api_runtime.cc: entry points
+// marshal C types, dispatch to mxnet_tpu.c_runtime (embedded CPython),
+// which shares the registry/tape/XLA cache with the Python frontend.
+//
+// Handle model: every handle is a PyObject* (NDArray, Symbol, Executor,
+// KVStore, or iterator cursor). The per-family *Free functions all
+// Py_DECREF — they exist because the reference ABI names them per
+// family and frontends call them by those names.
+//
+// String/list lifetime: one thread_local return store backs ALL
+// string/array-returning entry points, so a returned const char* /
+// array stays valid only until the NEXT such ABI call on the same
+// thread — copy out before making another call (the reference's
+// MXAPIThreadLocalEntry has the same contract,
+// ref: src/c_api/c_api_common.h).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_error.h"
+#include "py_embed.h"
+
+namespace {
+
+using mxnet_tpu::FailWith;
+using mxnet_tpu::pyembed::EnsurePython;
+using mxnet_tpu::pyembed::Gil;
+using mxnet_tpu::pyembed::PyFail;
+
+PyObject* Runtime() {
+  static PyObject* mod = nullptr;  // borrowed forever (module is cached)
+  if (mod == nullptr) mod = PyImport_ImportModule("mxnet_tpu.c_runtime");
+  return mod;
+}
+
+PyObject* CallRt(const char* fn, PyObject* args) {
+  PyObject* mod = Runtime();
+  if (mod == nullptr) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) return nullptr;
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return out;
+}
+
+PyObject* StrList(const char** strs, uint32_t n) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SET_ITEM(lst, i, PyUnicode_DecodeLatin1(
+        strs[i], strlen(strs[i]), "replace"));
+  return lst;
+}
+
+PyObject* HandleList(void** handles, uint32_t n) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* h = static_cast<PyObject*>(handles[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(lst, i, h);
+  }
+  return lst;
+}
+
+// Thread-local string-list return store (MXAPIThreadLocalEntry analog).
+struct RetStore {
+  std::vector<std::string> strings;
+  std::vector<const char*> charp;
+  std::vector<void*> handles;
+  std::string str;
+  std::vector<int64_t> shape_data;
+  std::vector<uint32_t> shape_ndim;
+  std::vector<const int64_t*> shape_ptr;
+};
+thread_local RetStore ret_store;
+
+// Copy a Python list of str into the thread-local store; set *n/*out.
+int ReturnStrList(PyObject* res, uint32_t* n, const char*** out,
+                  const char* who) {
+  if (!PyList_Check(res)) {
+    Py_DECREF(res);
+    return FailWith(std::string(who) + ": runtime returned non-list");
+  }
+  Py_ssize_t cnt = PyList_Size(res);
+  ret_store.strings.clear();
+  ret_store.charp.clear();
+  for (Py_ssize_t i = 0; i < cnt; ++i) {
+    PyObject* s = PyList_GET_ITEM(res, i);
+    Py_ssize_t len = 0;
+    const char* c = PyUnicode_AsUTF8AndSize(s, &len);
+    if (c == nullptr) {
+      Py_DECREF(res);
+      return PyFail(who);
+    }
+    ret_store.strings.emplace_back(c, static_cast<size_t>(len));
+  }
+  for (auto& s : ret_store.strings) ret_store.charp.push_back(s.c_str());
+  *n = static_cast<uint32_t>(cnt);
+  *out = ret_store.charp.data();
+  Py_DECREF(res);
+  return 0;
+}
+
+// Build [[d0,d1,...], ...] from flat shape data.
+PyObject* ShapeList(uint32_t num, const uint32_t* ndims,
+                    const int64_t* flat) {
+  PyObject* lst = PyList_New(num);
+  size_t off = 0;
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject* shp = PyTuple_New(ndims[i]);
+    for (uint32_t d = 0; d < ndims[i]; ++d)
+      PyTuple_SET_ITEM(shp, d, PyLong_FromLongLong(flat[off + d]));
+    off += ndims[i];
+    PyList_SET_ITEM(lst, i, shp);
+  }
+  return lst;
+}
+
+// Common tail: return a single new-reference handle.
+int ReturnHandle(PyObject* res, void** out, const char* who) {
+  if (res == nullptr) return PyFail(who);
+  *out = res;
+  return 0;
+}
+
+// Common tail: ok/None result.
+int ReturnOk(PyObject* res, const char* who) {
+  if (res == nullptr) return PyFail(who);
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- generic + misc ---------------------------------------------------------
+
+int MXTGetVersion(int* out) {
+  *out = 10600;
+  return 0;
+}
+
+int MXTRandomSeed(int seed) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", seed);
+  PyObject* res = CallRt("random_seed", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTRandomSeed");
+}
+
+int MXTListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("list_all_ops", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTListAllOpNames");
+  return ReturnStrList(res, out_size, out_array, "MXTListAllOpNames");
+}
+
+// Load an external operator library (ref: MXLoadLib c_api.cc:96).
+int MXTLoadLib(const char* path) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", path);
+  PyObject* res = CallRt("load_lib", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTLoadLib");
+}
+
+// -- Symbol -----------------------------------------------------------------
+
+int MXTSymbolCreateFromJSON(const char* json, void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", json);
+  PyObject* res = CallRt("symbol_from_json", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolCreateFromJSON");
+}
+
+int MXTSymbolCreateFromFile(const char* path, void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", path);
+  PyObject* res = CallRt("load_symbol_json", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolCreateFromFile");
+}
+
+int MXTSymbolSaveToJSON(void* sym, const char** out_json) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_to_json", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolSaveToJSON");
+  const char* c = PyUnicode_AsUTF8(res);
+  if (c == nullptr) {
+    Py_DECREF(res);
+    return PyFail("MXTSymbolSaveToJSON");
+  }
+  ret_store.str = c;
+  *out_json = ret_store.str.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTSymbolSaveToFile(void* sym, const char* path) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym), path);
+  PyObject* res = CallRt("symbol_save", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTSymbolSaveToFile");
+}
+
+int MXTSymbolCreateVariable(const char* name, void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", name);
+  PyObject* res = CallRt("symbol_var", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolCreateVariable");
+}
+
+int MXTSymbolCreateAtomicSymbol(const char* op_name, uint32_t num_params,
+                                const char** keys, const char** vals,
+                                void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(sNN)", op_name,
+                                 StrList(keys, num_params),
+                                 StrList(vals, num_params));
+  PyObject* res = CallRt("symbol_create_atomic", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolCreateAtomicSymbol");
+}
+
+// keys may be NULL => positional composition (reference semantics).
+int MXTSymbolCompose(void* atomic, const char* name, uint32_t num_args,
+                     const char** keys, void** args_handles, void** out) {
+  Gil gil;
+  PyObject* keylist = keys ? StrList(keys, num_args) : PyList_New(0);
+  PyObject* args = Py_BuildValue("(OsNN)", static_cast<PyObject*>(atomic),
+                                 name ? name : "", keylist,
+                                 HandleList(args_handles, num_args));
+  PyObject* res = CallRt("symbol_compose", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolCompose");
+}
+
+int MXTSymbolListArguments(void* sym, uint32_t* out_size,
+                           const char*** out_array) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_list_arguments", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolListArguments");
+  return ReturnStrList(res, out_size, out_array, "MXTSymbolListArguments");
+}
+
+int MXTSymbolListOutputs(void* sym, uint32_t* out_size,
+                         const char*** out_array) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_list_outputs", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolListOutputs");
+  return ReturnStrList(res, out_size, out_array, "MXTSymbolListOutputs");
+}
+
+int MXTSymbolListAuxiliaryStates(void* sym, uint32_t* out_size,
+                                 const char*** out_array) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_list_aux", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolListAuxiliaryStates");
+  return ReturnStrList(res, out_size, out_array,
+                       "MXTSymbolListAuxiliaryStates");
+}
+
+int MXTSymbolGetName(void* sym, const char** out_name) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_name", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolGetName");
+  const char* c = PyUnicode_AsUTF8(res);
+  ret_store.str = c ? c : "";
+  *out_name = ret_store.str.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+// Infer shapes from provided named input shapes.
+// Outputs (valid until next call on this thread): three parallel arrays
+// flattened — counts, per-entry ndim, and flat dims — for args, outputs
+// and aux in sequence (ref: MXSymbolInferShape's triple return).
+int MXTSymbolInferShape(void* sym, uint32_t num_provided,
+                        const char** names, const uint32_t* ndims,
+                        const int64_t* shapes_flat,
+                        uint32_t* arg_count, uint32_t* out_count,
+                        uint32_t* aux_count,
+                        const uint32_t** all_ndims,
+                        const int64_t** all_dims) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(sym),
+                                 StrList(names, num_provided),
+                                 ShapeList(num_provided, ndims, shapes_flat));
+  PyObject* res = CallRt("symbol_infer_shape", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolInferShape");
+  // res = ([argshapes], [outshapes], [auxshapes])
+  ret_store.shape_ndim.clear();
+  ret_store.shape_data.clear();
+  uint32_t counts[3] = {0, 0, 0};
+  for (int part = 0; part < 3; ++part) {
+    PyObject* lst = PyTuple_GET_ITEM(res, part);
+    Py_ssize_t cnt = PyList_Size(lst);
+    counts[part] = static_cast<uint32_t>(cnt);
+    for (Py_ssize_t i = 0; i < cnt; ++i) {
+      PyObject* shp = PyList_GET_ITEM(lst, i);
+      Py_ssize_t nd = PyTuple_Size(shp);
+      ret_store.shape_ndim.push_back(static_cast<uint32_t>(nd));
+      for (Py_ssize_t d = 0; d < nd; ++d)
+        ret_store.shape_data.push_back(
+            PyLong_AsLongLong(PyTuple_GET_ITEM(shp, d)));
+    }
+  }
+  Py_DECREF(res);
+  *arg_count = counts[0];
+  *out_count = counts[1];
+  *aux_count = counts[2];
+  *all_ndims = ret_store.shape_ndim.data();
+  *all_dims = ret_store.shape_data.data();
+  return 0;
+}
+
+int MXTSymbolFree(void* sym) {
+  if (sym == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(sym));
+  return 0;
+}
+
+// -- Executor ---------------------------------------------------------------
+
+int MXTExecutorSimpleBind(void* sym, uint32_t num_provided,
+                          const char** names, const uint32_t* ndims,
+                          const int64_t* shapes_flat,
+                          const char* grad_req, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONNs)", static_cast<PyObject*>(sym),
+                                 StrList(names, num_provided),
+                                 ShapeList(num_provided, ndims, shapes_flat),
+                                 grad_req);
+  PyObject* res = CallRt("executor_simple_bind", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTExecutorSimpleBind");
+}
+
+int MXTExecutorForward(void* exec, int is_train) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(exec),
+                                 is_train);
+  PyObject* res = CallRt("executor_forward", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTExecutorForward");
+}
+
+int MXTExecutorOutputs(void* exec, uint32_t* num_outputs,
+                       void** out_handles, uint32_t max_outputs) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(exec));
+  PyObject* res = CallRt("executor_outputs", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTExecutorOutputs");
+  Py_ssize_t n = PyList_Size(res);
+  if (static_cast<uint32_t>(n) > max_outputs) {
+    Py_DECREF(res);
+    return FailWith("MXTExecutorOutputs: " + std::to_string(n) +
+                    " outputs, caller provided " +
+                    std::to_string(max_outputs) + " slots");
+  }
+  *num_outputs = static_cast<uint32_t>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(res, i);
+    Py_INCREF(o);
+    out_handles[i] = o;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// num_head_grads == 0 => implicit ones (reference backward() semantics).
+int MXTExecutorBackward(void* exec, uint32_t num_head_grads,
+                        void** head_grads) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(exec),
+                                 HandleList(head_grads, num_head_grads));
+  PyObject* res = CallRt("executor_backward", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTExecutorBackward");
+}
+
+int MXTExecutorArgArray(void* exec, const char* name, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(exec), name);
+  PyObject* res = CallRt("executor_arg", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTExecutorArgArray");
+}
+
+int MXTExecutorGradArray(void* exec, const char* name, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(exec), name);
+  PyObject* res = CallRt("executor_grad", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTExecutorGradArray");
+}
+
+int MXTExecutorAuxArray(void* exec, const char* name, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(exec), name);
+  PyObject* res = CallRt("executor_aux", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTExecutorAuxArray");
+}
+
+int MXTExecutorFree(void* exec) {
+  if (exec == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(exec));
+  return 0;
+}
+
+// -- KVStore ----------------------------------------------------------------
+
+int MXTKVStoreCreate(const char* type, void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", type);
+  PyObject* res = CallRt("kv_create", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTKVStoreCreate");
+}
+
+int MXTKVStoreInit(void* kv, int key, void* nd) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OiO)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(nd));
+  PyObject* res = CallRt("kv_init", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStoreInit");
+}
+
+int MXTKVStoreInitEx(void* kv, const char* key, void* nd) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsO)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(nd));
+  PyObject* res = CallRt("kv_init", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStoreInitEx");
+}
+
+int MXTKVStorePush(void* kv, int key, void* nd, int priority) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OiOi)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(nd), priority);
+  PyObject* res = CallRt("kv_push", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStorePush");
+}
+
+int MXTKVStorePushEx(void* kv, const char* key, void* nd, int priority) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsOi)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(nd), priority);
+  PyObject* res = CallRt("kv_push", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStorePushEx");
+}
+
+int MXTKVStorePull(void* kv, int key, void* out_nd, int priority) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OiOi)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(out_nd), priority);
+  PyObject* res = CallRt("kv_pull", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStorePull");
+}
+
+int MXTKVStorePullEx(void* kv, const char* key, void* out_nd, int priority) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsOi)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(out_nd), priority);
+  PyObject* res = CallRt("kv_pull", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStorePullEx");
+}
+
+// Fused push+pull (ref: MXKVStorePushPullEx) — in/out may alias.
+int MXTKVStorePushPull(void* kv, int key, void* in_nd, void* out_nd,
+                       int priority) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OiOOi)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(in_nd),
+                                 static_cast<PyObject*>(out_nd), priority);
+  PyObject* res = CallRt("kv_pushpull", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStorePushPull");
+}
+
+int MXTKVStoreGetRank(void* kv, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* res = CallRt("kv_rank", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTKVStoreGetRank");
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTKVStoreGetGroupSize(void* kv, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* res = CallRt("kv_size", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTKVStoreGetGroupSize");
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTKVStoreGetType(void* kv, const char** out_type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* res = CallRt("kv_type", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTKVStoreGetType");
+  const char* c = PyUnicode_AsUTF8(res);
+  ret_store.str = c ? c : "";
+  *out_type = ret_store.str.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+// Build the optimizer server-side from name+params — the C-frontend
+// analog of the pickled-optimizer UX (ref: MXKVStoreSetOptimizer /
+// kvstore_server.py _controller).
+int MXTKVStoreSetOptimizer(void* kv, const char* opt_name,
+                           uint32_t num_params, const char** keys,
+                           const char** vals) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsNN)", static_cast<PyObject*>(kv),
+                                 opt_name, StrList(keys, num_params),
+                                 StrList(vals, num_params));
+  PyObject* res = CallRt("kv_set_optimizer", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStoreSetOptimizer");
+}
+
+int MXTKVStoreFree(void* kv) {
+  if (kv == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(kv));
+  return 0;
+}
+
+// -- DataIter ---------------------------------------------------------------
+
+int MXTListDataIters(uint32_t* out_size, const char*** out_array) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("list_data_iters", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTListDataIters");
+  return ReturnStrList(res, out_size, out_array, "MXTListDataIters");
+}
+
+int MXTDataIterCreate(const char* name, uint32_t num_params,
+                      const char** keys, const char** vals, void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(sNN)", name, StrList(keys, num_params),
+                                 StrList(vals, num_params));
+  PyObject* res = CallRt("data_iter_create", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTDataIterCreate");
+}
+
+int MXTDataIterNext(void* iter, int* out_more) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
+  PyObject* res = CallRt("data_iter_next", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTDataIterNext");
+  *out_more = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTDataIterGetData(void* iter, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
+  PyObject* res = CallRt("data_iter_data", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTDataIterGetData");
+}
+
+int MXTDataIterGetLabel(void* iter, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
+  PyObject* res = CallRt("data_iter_label", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTDataIterGetLabel");
+}
+
+int MXTDataIterBeforeFirst(void* iter) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
+  PyObject* res = CallRt("data_iter_reset", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTDataIterBeforeFirst");
+}
+
+int MXTDataIterFree(void* iter) {
+  if (iter == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(iter));
+  return 0;
+}
+
+// -- NDArray save/load + in-place copy --------------------------------------
+
+// names may be NULL => unnamed records (ref: MXNDArraySave c_api.h:659).
+int MXTNDArraySave(const char* fname, uint32_t num, void** handles,
+                   const char** names) {
+  Gil gil;
+  PyObject* namelist = names ? StrList(names, num) : PyList_New(0);
+  PyObject* args = Py_BuildValue("(sNN)", fname, HandleList(handles, num),
+                                 namelist);
+  PyObject* res = CallRt("nd_save", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTNDArraySave");
+}
+
+// Returned handle/name arrays stay valid until the next Load on this
+// thread; handles are owned by the caller (free each with
+// MXTNDArrayFree). (ref: MXNDArrayLoad c_api.h:672)
+int MXTNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
+                   uint32_t* out_name_size, const char*** out_names) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* res = CallRt("nd_load", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayLoad");
+  PyObject* names = PyTuple_GET_ITEM(res, 0);
+  PyObject* arrays = PyTuple_GET_ITEM(res, 1);
+  Py_ssize_t nn = PyList_Size(names);
+  Py_ssize_t na = PyList_Size(arrays);
+  ret_store.strings.clear();
+  ret_store.charp.clear();
+  ret_store.handles.clear();
+  for (Py_ssize_t i = 0; i < nn; ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GET_ITEM(names, i));
+    ret_store.strings.emplace_back(c ? c : "");
+  }
+  for (auto& s : ret_store.strings) ret_store.charp.push_back(s.c_str());
+  for (Py_ssize_t i = 0; i < na; ++i) {
+    PyObject* a = PyList_GET_ITEM(arrays, i);
+    Py_INCREF(a);
+    ret_store.handles.push_back(a);
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<uint32_t>(na);
+  *out_arr = ret_store.handles.data();
+  *out_name_size = static_cast<uint32_t>(nn);
+  *out_names = ret_store.charp.data();
+  return 0;
+}
+
+int MXTNDArraySyncCopyFromCPU(void* handle, const void* data,
+                              size_t nbytes) {
+  Gil gil;
+  PyObject* raw = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(handle),
+                                 raw);
+  PyObject* res = CallRt("copy_from_bytes", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTNDArraySyncCopyFromCPU");
+}
+
+// Device-side value copy dst <- src (no host round trip; ref:
+// MXNDArraySyncCopyFromNDArray).
+int MXTNDArrayCopyFrom(void* dst, void* src) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(dst),
+                                 static_cast<PyObject*>(src));
+  PyObject* res = CallRt("set_data", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTNDArrayCopyFrom");
+}
+
+int MXTNDArrayGetDType(void* handle, int* out_dtype) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("dtype_of", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayGetDType");
+  *out_dtype = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,365 @@
+"""Frontier C ABI tests: Symbol / Executor / KVStore / DataIter /
+NDArray save-load surfaces (src/c_api_symbol.cc).
+
+The end-to-end test is the VERDICT done-criterion: a pure-C program
+(example/capi/train_symbol.c) binds a Symbol loaded from JSON, trains
+it through a KVStore-held optimizer fed by a DataIter, and writes a
+checkpoint that Python loads back.
+
+ref: include/mxnet/c_api.h — MXSymbolCreateFromJSON family,
+MXExecutorSimpleBindEx, MXKVStore*, MXDataIter*, MXNDArraySave/Load
+:638-672.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "libmxnet_tpu.so")
+DEMO = os.path.join(REPO, "example", "capi", "train_symbol.c")
+
+
+def _build_lib():
+    if not os.path.exists(LIB):
+        subprocess.run(["make", "-C", os.path.join(REPO, "src")],
+                       check=True, capture_output=True)
+    lib = ctypes.CDLL(LIB)
+    return lib if hasattr(lib, "MXTSymbolCreateFromJSON") else None
+
+
+def _mlp_symbol():
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, name="fc1")
+    return mx.sym.LinearRegressionOutput(fc, label, name="lro")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = _build_lib()
+    if lib is None:
+        pytest.skip("frontier C ABI not built")
+    lib.MXTGetLastError.restype = ctypes.c_char_p
+    vp, u32 = ctypes.c_void_p, ctypes.c_uint32
+    vpp = ctypes.POINTER(vp)
+    ccp = ctypes.POINTER(ctypes.c_char_p)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u32p = ctypes.POINTER(u32)
+    lib.MXTSymbolCreateFromJSON.argtypes = [ctypes.c_char_p, vpp]
+    lib.MXTSymbolSaveToJSON.argtypes = [vp, ccp]
+    lib.MXTSymbolCreateVariable.argtypes = [ctypes.c_char_p, vpp]
+    lib.MXTSymbolCreateAtomicSymbol.argtypes = [ctypes.c_char_p, u32,
+                                                ccp, ccp, vpp]
+    lib.MXTSymbolCompose.argtypes = [vp, ctypes.c_char_p, u32, ccp, vpp,
+                                     vpp]
+    lib.MXTSymbolListArguments.argtypes = [vp, u32p,
+                                           ctypes.POINTER(ccp)]
+    lib.MXTSymbolListOutputs.argtypes = [vp, u32p, ctypes.POINTER(ccp)]
+    lib.MXTSymbolInferShape.argtypes = [vp, u32, ccp, u32p, i64p, u32p,
+                                        u32p, u32p,
+                                        ctypes.POINTER(u32p),
+                                        ctypes.POINTER(i64p)]
+    lib.MXTSymbolFree.argtypes = [vp]
+    lib.MXTExecutorSimpleBind.argtypes = [vp, u32, ccp, u32p, i64p,
+                                          ctypes.c_char_p, vpp]
+    lib.MXTExecutorForward.argtypes = [vp, ctypes.c_int]
+    lib.MXTExecutorBackward.argtypes = [vp, u32, vpp]
+    lib.MXTExecutorOutputs.argtypes = [vp, u32p, vpp, u32]
+    lib.MXTExecutorArgArray.argtypes = [vp, ctypes.c_char_p, vpp]
+    lib.MXTExecutorGradArray.argtypes = [vp, ctypes.c_char_p, vpp]
+    lib.MXTExecutorFree.argtypes = [vp]
+    lib.MXTKVStoreCreate.argtypes = [ctypes.c_char_p, vpp]
+    lib.MXTKVStoreInit.argtypes = [vp, ctypes.c_int, vp]
+    lib.MXTKVStorePush.argtypes = [vp, ctypes.c_int, vp, ctypes.c_int]
+    lib.MXTKVStorePull.argtypes = [vp, ctypes.c_int, vp, ctypes.c_int]
+    lib.MXTKVStoreGetRank.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+    lib.MXTKVStoreGetType.argtypes = [vp, ccp]
+    lib.MXTKVStoreFree.argtypes = [vp]
+    lib.MXTDataIterCreate.argtypes = [ctypes.c_char_p, u32, ccp, ccp, vpp]
+    lib.MXTDataIterNext.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+    lib.MXTDataIterGetData.argtypes = [vp, vpp]
+    lib.MXTDataIterFree.argtypes = [vp]
+    lib.MXTNDArraySave.argtypes = [ctypes.c_char_p, u32, vpp, ccp]
+    lib.MXTNDArrayLoad.argtypes = [ctypes.c_char_p, u32p,
+                                   ctypes.POINTER(vpp), u32p,
+                                   ctypes.POINTER(ccp)]
+    lib.MXTNDArrayFromData.argtypes = [i64p, u32, ctypes.c_int, vp,
+                                       ctypes.c_size_t, vpp]
+    lib.MXTNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    lib.MXTNDArraySyncCopyFromCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    lib.MXTNDArrayFree.argtypes = [vp]
+    lib.MXTListAllOpNames.argtypes = [u32p, ctypes.POINTER(ccp)]
+    lib.MXTGetVersion.argtypes = [ctypes.POINTER(ctypes.c_int)]
+    return lib
+
+
+def _ck(lib, rc):
+    assert rc == 0, lib.MXTGetLastError().decode()
+
+
+def _nd_from(lib, arr):
+    arr = onp.ascontiguousarray(arr, "float32")
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    _ck(lib, lib.MXTNDArrayFromData(
+        shape, arr.ndim, 0, arr.ctypes.data_as(ctypes.c_void_p),
+        arr.nbytes, ctypes.byref(h)))
+    return h
+
+
+def _to_np(lib, h, shape):
+    out = onp.empty(shape, "float32")
+    _ck(lib, lib.MXTNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes))
+    return out
+
+
+class TestSymbolABI:
+    def test_json_round_trip(self, lib):
+        json_str = _mlp_symbol().tojson().encode()
+        h = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolCreateFromJSON(json_str, ctypes.byref(h)))
+        n = ctypes.c_uint32()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        _ck(lib, lib.MXTSymbolListArguments(h, ctypes.byref(n),
+                                            ctypes.byref(names)))
+        args = [names[i].decode() for i in range(n.value)]
+        assert "data" in args and "fc1_weight" in args
+        out = ctypes.c_char_p()
+        _ck(lib, lib.MXTSymbolSaveToJSON(h, ctypes.byref(out)))
+        sym2 = mx.sym.load_json(out.value.decode())
+        assert sym2.list_arguments() == _mlp_symbol().list_arguments()
+        lib.MXTSymbolFree(h)
+
+    def test_atomic_compose(self, lib):
+        # variable -> atomic relu -> compose, positionally
+        v = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolCreateVariable(b"x", ctypes.byref(v)))
+        atom = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolCreateAtomicSymbol(
+            b"relu", 0, None, None, ctypes.byref(atom)))
+        args = (ctypes.c_void_p * 1)(v)
+        composed = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolCompose(atom, b"act0", 1, None, args,
+                                      ctypes.byref(composed)))
+        n = ctypes.c_uint32()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        _ck(lib, lib.MXTSymbolListOutputs(composed, ctypes.byref(n),
+                                          ctypes.byref(names)))
+        assert n.value == 1
+        for h in (v, atom, composed):
+            lib.MXTSymbolFree(h)
+
+    def test_infer_shape(self, lib):
+        json_str = _mlp_symbol().tojson().encode()
+        h = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolCreateFromJSON(json_str, ctypes.byref(h)))
+        names = (ctypes.c_char_p * 2)(b"data", b"label")
+        ndims = (ctypes.c_uint32 * 2)(2, 2)
+        flat = (ctypes.c_int64 * 4)(8, 4, 8, 1)
+        argc = ctypes.c_uint32()
+        outc = ctypes.c_uint32()
+        auxc = ctypes.c_uint32()
+        all_nd = ctypes.POINTER(ctypes.c_uint32)()
+        all_d = ctypes.POINTER(ctypes.c_int64)()
+        _ck(lib, lib.MXTSymbolInferShape(
+            h, 2, names, ndims, flat, ctypes.byref(argc),
+            ctypes.byref(outc), ctypes.byref(auxc), ctypes.byref(all_nd),
+            ctypes.byref(all_d)))
+        assert outc.value == 1
+        # first arg is data: (8, 4)
+        assert all_nd[0] == 2 and all_d[0] == 8 and all_d[1] == 4
+        lib.MXTSymbolFree(h)
+
+
+class TestExecutorABI:
+    def test_forward_backward(self, lib):
+        json_str = _mlp_symbol().tojson().encode()
+        sym = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolCreateFromJSON(json_str, ctypes.byref(sym)))
+        names = (ctypes.c_char_p * 2)(b"data", b"label")
+        ndims = (ctypes.c_uint32 * 2)(2, 2)
+        flat = (ctypes.c_int64 * 4)(4, 3, 4, 1)
+        ex = ctypes.c_void_p()
+        _ck(lib, lib.MXTExecutorSimpleBind(sym, 2, names, ndims, flat,
+                                           b"write", ctypes.byref(ex)))
+        data = ctypes.c_void_p()
+        _ck(lib, lib.MXTExecutorArgArray(ex, b"data", ctypes.byref(data)))
+        x = onp.ones((4, 3), "float32")
+        _ck(lib, lib.MXTNDArraySyncCopyFromCPU(
+            data, x.ctypes.data_as(ctypes.c_void_p), x.nbytes))
+        _ck(lib, lib.MXTExecutorForward(ex, 1))
+        nout = ctypes.c_uint32()
+        outs = (ctypes.c_void_p * 2)()
+        _ck(lib, lib.MXTExecutorOutputs(ex, ctypes.byref(nout), outs, 2))
+        assert nout.value == 1
+        _ck(lib, lib.MXTExecutorBackward(ex, 0, None))
+        g = ctypes.c_void_p()
+        _ck(lib, lib.MXTExecutorGradArray(ex, b"fc1_weight",
+                                          ctypes.byref(g)))
+        gv = _to_np(lib, g, (1, 3))
+        assert onp.all(onp.isfinite(gv))
+        for h in (data, outs[0], g):
+            lib.MXTNDArrayFree(h)
+        lib.MXTExecutorFree(ex)
+        lib.MXTSymbolFree(sym)
+
+
+class TestKVStoreABI:
+    def test_int_key_push_pull(self, lib):
+        kv = ctypes.c_void_p()
+        _ck(lib, lib.MXTKVStoreCreate(b"local", ctypes.byref(kv)))
+        t = ctypes.c_char_p()
+        _ck(lib, lib.MXTKVStoreGetType(kv, ctypes.byref(t)))
+        assert t.value == b"local"
+        r = ctypes.c_int()
+        _ck(lib, lib.MXTKVStoreGetRank(kv, ctypes.byref(r)))
+        assert r.value == 0
+        a = _nd_from(lib, onp.full((2, 2), 3.0))
+        _ck(lib, lib.MXTKVStoreInit(kv, 7, a))
+        b = _nd_from(lib, onp.full((2, 2), 2.0))
+        _ck(lib, lib.MXTKVStorePush(kv, 7, b, 0))
+        out = _nd_from(lib, onp.zeros((2, 2)))
+        _ck(lib, lib.MXTKVStorePull(kv, 7, out, 0))
+        onp.testing.assert_allclose(_to_np(lib, out, (2, 2)), 2.0)
+        for h in (a, b, out):
+            lib.MXTNDArrayFree(h)
+        lib.MXTKVStoreFree(kv)
+
+
+class TestDataIterABI:
+    def test_csv_iter(self, lib, tmp_path):
+        csv = tmp_path / "d.csv"
+        onp.savetxt(csv, onp.arange(12, dtype="float32").reshape(6, 2),
+                    delimiter=",")
+        keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape",
+                                     b"batch_size")
+        vals = (ctypes.c_char_p * 3)(str(csv).encode(), b"(2,)", b"3")
+        it = ctypes.c_void_p()
+        _ck(lib, lib.MXTDataIterCreate(b"CSVIter", 3, keys, vals,
+                                       ctypes.byref(it)))
+        more = ctypes.c_int()
+        _ck(lib, lib.MXTDataIterNext(it, ctypes.byref(more)))
+        assert more.value == 1
+        d = ctypes.c_void_p()
+        _ck(lib, lib.MXTDataIterGetData(it, ctypes.byref(d)))
+        onp.testing.assert_allclose(
+            _to_np(lib, d, (3, 2)),
+            onp.arange(6, dtype="float32").reshape(3, 2))
+        lib.MXTNDArrayFree(d)
+        lib.MXTDataIterFree(it)
+
+
+class TestSaveLoadABI:
+    def test_named_round_trip(self, lib, tmp_path):
+        f = str(tmp_path / "w.params").encode()
+        a = _nd_from(lib, onp.arange(4, dtype="float32").reshape(2, 2))
+        handles = (ctypes.c_void_p * 1)(a)
+        names = (ctypes.c_char_p * 1)(b"arg:w0")
+        _ck(lib, lib.MXTNDArraySave(f, 1, handles, names))
+        # load through the ABI
+        n = ctypes.c_uint32()
+        arrs = ctypes.POINTER(ctypes.c_void_p)()
+        nn = ctypes.c_uint32()
+        onames = ctypes.POINTER(ctypes.c_char_p)()
+        _ck(lib, lib.MXTNDArrayLoad(f, ctypes.byref(n),
+                                    ctypes.byref(arrs), ctypes.byref(nn),
+                                    ctypes.byref(onames)))
+        assert n.value == 1 and nn.value == 1
+        assert onames[0] == b"arg:w0"
+        onp.testing.assert_allclose(
+            _to_np(lib, arrs[0], (2, 2)),
+            onp.arange(4, dtype="float32").reshape(2, 2))
+        lib.MXTNDArrayFree(arrs[0])
+        # and through Python (byte-format compat)
+        loaded = mx.nd.load(f.decode())
+        assert list(loaded) == ["arg:w0"]
+        lib.MXTNDArrayFree(a)
+
+
+class TestMisc:
+    def test_version_and_ops(self, lib):
+        v = ctypes.c_int()
+        _ck(lib, lib.MXTGetVersion(ctypes.byref(v)))
+        assert v.value == 10600
+        n = ctypes.c_uint32()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        _ck(lib, lib.MXTListAllOpNames(ctypes.byref(n),
+                                       ctypes.byref(names)))
+        ops = {names[i] for i in range(n.value)}
+        assert n.value > 400 and b"FullyConnected" in ops
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_lenet_trains(tmp_path):
+    """cpp-package parity criterion: the C++ LeNet example (Symbol::
+    CreateOp graph, Xavier init, SGD+momentum optimizer, FactorScheduler,
+    Accuracy metric, checkpoint save/load) compiles and trains to >=0.9
+    accuracy (ref: cpp-package/example/lenet.cpp)."""
+    if _build_lib() is None:
+        pytest.skip("frontier C ABI not built")
+    exe = str(tmp_path / "train_lenet")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         "-I", os.path.join(REPO, "cpp-package", "include"),
+         os.path.join(REPO, "cpp-package", "example", "train_lenet.cpp"),
+         "-o", exe,
+         "-L" + os.path.join(REPO, "mxnet_tpu"), "-lmxnet_tpu",
+         "-Wl,-rpath," + os.path.join(REPO, "mxnet_tpu")],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600, cwd=str(tmp_path))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "cpp-package LeNet training OK" in res.stdout
+    # the checkpoint the C++ program wrote loads in Python
+    params = mx.nd.load(str(tmp_path / "lenet.params"))
+    assert "conv1_weight" in params and "fc2_bias" in params
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
+def test_c_demo_trains_symbol_from_json(tmp_path):
+    """The done-criterion: pure-C program loads symbol JSON, trains via
+    DataIter+KVStore, saves a checkpoint Python verifies."""
+    if _build_lib() is None:
+        pytest.skip("frontier C ABI not built")
+    rng = onp.random.RandomState(0)
+    w_true = onp.array([[1.5], [-2.0], [0.5], [3.0]], "float32")
+    X = rng.randn(64, 4).astype("float32")
+    y = X @ w_true + 0.7
+    onp.savetxt(tmp_path / "data.csv", X, delimiter=",")
+    onp.savetxt(tmp_path / "label.csv", y, delimiter=",")
+    _mlp_symbol().save(str(tmp_path / "sym.json"))
+
+    exe = str(tmp_path / "train_symbol")
+    subprocess.run(
+        ["gcc", "-O2", DEMO, "-o", exe,
+         "-L" + os.path.join(REPO, "mxnet_tpu"), "-lmxnet_tpu",
+         "-Wl,-rpath," + os.path.join(REPO, "mxnet_tpu")],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    ckpt = str(tmp_path / "trained.params")
+    res = subprocess.run(
+        [exe, str(tmp_path / "sym.json"), str(tmp_path / "data.csv"),
+         str(tmp_path / "label.csv"), ckpt],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    losses = [float(ln.rsplit(" ", 1)[1])
+              for ln in res.stdout.splitlines() if ln.startswith("epoch")]
+    assert losses[-1] < losses[0] * 0.05, res.stdout
+
+    # Python loads the C-written checkpoint and reproduces the fit
+    params = mx.nd.load(ckpt)
+    assert set(params) == {"fc1_weight", "fc1_bias"}
+    w = params["fc1_weight"].asnumpy()
+    b = params["fc1_bias"].asnumpy()
+    pred = X @ w.T + b
+    assert onp.mean((pred - y) ** 2) < 0.1

@@ -1,0 +1,16 @@
+// Umbrella header (ref: cpp-package/include/mxnet-cpp/MxNetCpp.h).
+#ifndef MXNET_TPU_CPP_MXNETCPP_H_
+#define MXNET_TPU_CPP_MXNETCPP_H_
+
+#include "base.h"
+#include "ndarray.hpp"
+#include "symbol.hpp"
+#include "executor.hpp"
+#include "optimizer.hpp"
+#include "kvstore.hpp"
+#include "io.hpp"
+#include "metric.hpp"
+#include "initializer.hpp"
+#include "lr_scheduler.hpp"
+
+#endif  // MXNET_TPU_CPP_MXNETCPP_H_

@@ -1,0 +1,136 @@
+// mxnet_tpu-cpp base: ABI declarations + error handling shared by all
+// frontend headers (ref: cpp-package/include/mxnet-cpp/base.h).
+//
+// The frontend is header-only marshalling over the C ABI
+// (src/c_api_runtime.cc + src/c_api_symbol.cc) — exactly the
+// reference's architecture, where mxnet-cpp wraps include/mxnet/c_api.h.
+#ifndef MXNET_TPU_CPP_BASE_H_
+#define MXNET_TPU_CPP_BASE_H_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+extern "C" {
+const char* MXTGetLastError();
+int MXTGetVersion(int* out);
+int MXTRandomSeed(int seed);
+int MXTListAllOpNames(uint32_t* n, const char*** names);
+int MXTLoadLib(const char* path);
+
+int MXTNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
+                     void** out);
+int MXTNDArrayFromData(const int64_t* shape, uint32_t ndim, int dtype,
+                       const void* data, size_t nbytes, void** out);
+int MXTNDArrayFree(void* h);
+int MXTNDArrayGetShape(void* h, uint32_t* ndim, int64_t* shape);
+int MXTNDArrayGetDType(void* h, int* dtype);
+int MXTNDArraySyncCopyToCPU(void* h, void* data, size_t nbytes);
+int MXTNDArraySyncCopyFromCPU(void* h, const void* data, size_t nbytes);
+int MXTNDArrayCopyFrom(void* dst, void* src);
+int MXTNDArrayWaitAll();
+int MXTNDArraySave(const char* fname, uint32_t n, void** handles,
+                   const char** names);
+int MXTNDArrayLoad(const char* fname, uint32_t* n, void*** handles,
+                   uint32_t* nn, const char*** names);
+
+int MXTImperativeInvoke(const char* op, uint32_t nin, void** in,
+                        uint32_t nparam, const char** keys,
+                        const char** vals, uint32_t* nout, void** out,
+                        uint32_t max_out);
+int MXTAutogradMarkVariables(uint32_t n, void** h);
+int MXTAutogradSetIsRecording(int rec);
+int MXTAutogradBackward(uint32_t n, void** out);
+int MXTNDArrayGetGrad(void* h, void** grad);
+
+int MXTSymbolCreateFromJSON(const char* json, void** out);
+int MXTSymbolCreateFromFile(const char* path, void** out);
+int MXTSymbolSaveToJSON(void* sym, const char** out_json);
+int MXTSymbolSaveToFile(void* sym, const char* path);
+int MXTSymbolCreateVariable(const char* name, void** out);
+int MXTSymbolCreateAtomicSymbol(const char* op, uint32_t nparam,
+                                const char** keys, const char** vals,
+                                void** out);
+int MXTSymbolCompose(void* atomic, const char* name, uint32_t nargs,
+                     const char** keys, void** args, void** out);
+int MXTSymbolListArguments(void* sym, uint32_t* n, const char*** names);
+int MXTSymbolListOutputs(void* sym, uint32_t* n, const char*** names);
+int MXTSymbolListAuxiliaryStates(void* sym, uint32_t* n,
+                                 const char*** names);
+int MXTSymbolGetName(void* sym, const char** name);
+int MXTSymbolInferShape(void* sym, uint32_t nprov, const char** names,
+                        const uint32_t* ndims, const int64_t* flat,
+                        uint32_t* argc, uint32_t* outc, uint32_t* auxc,
+                        const uint32_t** all_ndims,
+                        const int64_t** all_dims);
+int MXTSymbolFree(void* sym);
+
+int MXTExecutorSimpleBind(void* sym, uint32_t nprov, const char** names,
+                          const uint32_t* ndims, const int64_t* flat,
+                          const char* grad_req, void** out);
+int MXTExecutorForward(void* ex, int is_train);
+int MXTExecutorBackward(void* ex, uint32_t nhead, void** heads);
+int MXTExecutorOutputs(void* ex, uint32_t* nout, void** outs,
+                       uint32_t max_out);
+int MXTExecutorArgArray(void* ex, const char* name, void** out);
+int MXTExecutorGradArray(void* ex, const char* name, void** out);
+int MXTExecutorAuxArray(void* ex, const char* name, void** out);
+int MXTExecutorFree(void* ex);
+
+int MXTKVStoreCreate(const char* type, void** out);
+int MXTKVStoreInit(void* kv, int key, void* nd);
+int MXTKVStoreInitEx(void* kv, const char* key, void* nd);
+int MXTKVStorePush(void* kv, int key, void* nd, int priority);
+int MXTKVStorePushEx(void* kv, const char* key, void* nd, int priority);
+int MXTKVStorePull(void* kv, int key, void* out, int priority);
+int MXTKVStorePullEx(void* kv, const char* key, void* out, int priority);
+int MXTKVStorePushPull(void* kv, int key, void* in, void* out,
+                       int priority);
+int MXTKVStoreGetRank(void* kv, int* out);
+int MXTKVStoreGetGroupSize(void* kv, int* out);
+int MXTKVStoreGetType(void* kv, const char** out);
+int MXTKVStoreSetOptimizer(void* kv, const char* name, uint32_t nparam,
+                           const char** keys, const char** vals);
+int MXTKVStoreFree(void* kv);
+
+int MXTListDataIters(uint32_t* n, const char*** names);
+int MXTDataIterCreate(const char* name, uint32_t nparam,
+                      const char** keys, const char** vals, void** out);
+int MXTDataIterNext(void* it, int* more);
+int MXTDataIterGetData(void* it, void** out);
+int MXTDataIterGetLabel(void* it, void** out);
+int MXTDataIterBeforeFirst(void* it);
+int MXTDataIterFree(void* it);
+}
+
+namespace mxnet_tpu {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXTGetLastError());
+}
+
+inline int GetVersion() {
+  int v = 0;
+  Check(MXTGetVersion(&v));
+  return v;
+}
+
+inline void RandomSeed(int seed) { Check(MXTRandomSeed(seed)); }
+
+// dtype ids shared with the Python frontend (c_runtime._DTYPES)
+enum DType {
+  kFloat32 = 0,
+  kFloat64 = 1,
+  kFloat16 = 2,
+  kUint8 = 3,
+  kInt32 = 4,
+  kInt8 = 5,
+  kInt64 = 6,
+  kBfloat16 = 12,
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_BASE_H_

@@ -1,0 +1,96 @@
+// Executor: bound computation over the C ABI
+// (ref: cpp-package/include/mxnet-cpp/executor.h — Forward/Backward/
+// outputs/arg_dict over MXExecutor*).
+#ifndef MXNET_TPU_CPP_EXECUTOR_HPP_
+#define MXNET_TPU_CPP_EXECUTOR_HPP_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "base.h"
+#include "ndarray.hpp"
+#include "symbol.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Executor {
+ public:
+  Executor() = default;
+
+  explicit Executor(void* handle)
+      : handle_(handle, [](void* h) { MXTExecutorFree(h); }) {}
+
+  void Forward(bool is_train) {
+    Check(MXTExecutorForward(handle(), is_train ? 1 : 0));
+  }
+
+  // empty heads => implicit ones (reference backward() semantics)
+  void Backward(const std::vector<NDArray>& head_grads = {}) {
+    std::vector<void*> h;
+    for (const auto& g : head_grads) h.push_back(g.handle());
+    Check(MXTExecutorBackward(handle(),
+                              static_cast<uint32_t>(h.size()),
+                              h.empty() ? nullptr : h.data()));
+  }
+
+  std::vector<NDArray> Outputs(uint32_t max_out = 8) const {
+    std::vector<void*> outs(max_out, nullptr);
+    uint32_t n = 0;
+    Check(MXTExecutorOutputs(handle(), &n, outs.data(), max_out));
+    std::vector<NDArray> result;
+    result.reserve(n);
+    for (uint32_t i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  NDArray ArgArray(const std::string& name) const {
+    void* h = nullptr;
+    Check(MXTExecutorArgArray(handle(), name.c_str(), &h));
+    return NDArray(h);
+  }
+
+  NDArray GradArray(const std::string& name) const {
+    void* h = nullptr;
+    Check(MXTExecutorGradArray(handle(), name.c_str(), &h));
+    return NDArray(h);
+  }
+
+  NDArray AuxArray(const std::string& name) const {
+    void* h = nullptr;
+    Check(MXTExecutorAuxArray(handle(), name.c_str(), &h));
+    return NDArray(h);
+  }
+
+  void* handle() const { return handle_.get(); }
+
+ private:
+  std::shared_ptr<void> handle_;
+};
+
+inline Executor Symbol::SimpleBind(
+    const std::map<std::string, std::vector<int64_t>>& provided,
+    const std::string& grad_req) const {
+  std::vector<const char*> names;
+  std::vector<uint32_t> ndims;
+  std::vector<int64_t> flat;
+  for (const auto& kv : provided) {
+    names.push_back(kv.first.c_str());
+    ndims.push_back(static_cast<uint32_t>(kv.second.size()));
+    for (int64_t d : kv.second) flat.push_back(d);
+  }
+  void* ex = nullptr;
+  Check(MXTExecutorSimpleBind(handle(),
+                              static_cast<uint32_t>(names.size()),
+                              names.data(), ndims.data(), flat.data(),
+                              grad_req.c_str(), &ex));
+  return Executor(ex);
+}
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_EXECUTOR_HPP_

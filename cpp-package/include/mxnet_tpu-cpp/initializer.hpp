@@ -1,0 +1,140 @@
+// Weight initializers (ref: cpp-package/include/mxnet-cpp/initializer.h
+// — Initializer base dispatching on argument-name suffix, Xavier /
+// Uniform / Normal / Zero / One).
+#ifndef MXNET_TPU_CPP_INITIALIZER_HPP_
+#define MXNET_TPU_CPP_INITIALIZER_HPP_
+
+#include <cmath>
+#include <cstdint>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "ndarray.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Initializer {
+ public:
+  virtual ~Initializer() = default;
+
+  // dispatch on name suffix like the reference (initializer.h
+  // operator()): *_bias/_gamma/_beta/_moving_* get fixed values
+  void operator()(const std::string& name, NDArray* arr) {
+    if (EndsWith(name, "_bias") || EndsWith(name, "_beta") ||
+        EndsWith(name, "_moving_mean") || EndsWith(name, "_moving_var")) {
+      Fill(arr, 0.0f);
+    } else if (EndsWith(name, "_gamma")) {
+      Fill(arr, 1.0f);
+    } else {
+      InitWeight(arr);
+    }
+  }
+
+ protected:
+  virtual void InitWeight(NDArray* arr) = 0;
+
+  static void Fill(NDArray* arr, float v) {
+    std::vector<float> buf(arr->Size(), v);
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+
+  static bool EndsWith(const std::string& s, const std::string& suf) {
+    return s.size() >= suf.size() &&
+           s.compare(s.size() - suf.size(), suf.size(), suf) == 0;
+  }
+
+  std::mt19937 rng_{5489u};
+};
+
+class Zero : public Initializer {
+ protected:
+  void InitWeight(NDArray* arr) override { Fill(arr, 0.0f); }
+};
+
+class One : public Initializer {
+ protected:
+  void InitWeight(NDArray* arr) override { Fill(arr, 1.0f); }
+};
+
+class Uniform : public Initializer {
+ public:
+  explicit Uniform(float scale = 0.07f) : scale_(scale) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override {
+    std::uniform_real_distribution<float> d(-scale_, scale_);
+    std::vector<float> buf(arr->Size());
+    for (auto& x : buf) x = d(rng_);
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+
+ private:
+  float scale_;
+};
+
+class Normal : public Initializer {
+ public:
+  explicit Normal(float mu = 0.0f, float sigma = 0.01f)
+      : mu_(mu), sigma_(sigma) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override {
+    std::normal_distribution<float> d(mu_, sigma_);
+    std::vector<float> buf(arr->Size());
+    for (auto& x : buf) x = d(rng_);
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+
+ private:
+  float mu_, sigma_;
+};
+
+// Xavier/Glorot (ref: initializer.h Xavier — gaussian|uniform,
+// avg|in|out fan, magnitude 3 default).
+class Xavier : public Initializer {
+ public:
+  enum RandType { gaussian, uniform };
+  enum FactorType { avg, in, out };
+
+  explicit Xavier(RandType rand_type = gaussian,
+                  FactorType factor_type = avg, float magnitude = 3.0f)
+      : rand_type_(rand_type), factor_type_(factor_type),
+        magnitude_(magnitude) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override {
+    std::vector<int64_t> shape = arr->Shape();
+    float hw = 1.0f;
+    for (size_t i = 2; i < shape.size(); ++i)
+      hw *= static_cast<float>(shape[i]);
+    float fan_out = shape.empty() ? 1.0f
+                                  : static_cast<float>(shape[0]) * hw;
+    float fan_in = shape.size() < 2 ? 1.0f
+                                    : static_cast<float>(shape[1]) * hw;
+    float factor = fan_in;
+    if (factor_type_ == avg) factor = (fan_in + fan_out) / 2.0f;
+    if (factor_type_ == out) factor = fan_out;
+    float scale = std::sqrt(magnitude_ / factor);
+    std::vector<float> buf(arr->Size());
+    if (rand_type_ == uniform) {
+      std::uniform_real_distribution<float> d(-scale, scale);
+      for (auto& x : buf) x = d(rng_);
+    } else {
+      std::normal_distribution<float> d(0.0f, scale);
+      for (auto& x : buf) x = d(rng_);
+    }
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+
+ private:
+  RandType rand_type_;
+  FactorType factor_type_;
+  float magnitude_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_INITIALIZER_HPP_

@@ -1,0 +1,81 @@
+// MXDataIter: data iterators by registry name over the C ABI
+// (ref: cpp-package/include/mxnet-cpp/io.h MXDataIter with
+// SetParam/CreateDataIter over MXDataIter*).
+#ifndef MXNET_TPU_CPP_IO_HPP_
+#define MXNET_TPU_CPP_IO_HPP_
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.h"
+#include "ndarray.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class MXDataIter {
+ public:
+  explicit MXDataIter(const std::string& name) : name_(name) {}
+
+  MXDataIter& SetParam(const std::string& k, const std::string& v) {
+    keys_.push_back(k);
+    vals_.push_back(v);
+    return *this;
+  }
+
+  // instantiate on first use (reference's CreateDataIter_ lazy flow)
+  void CreateDataIter() {
+    if (handle_) return;
+    std::vector<const char*> k, v;
+    for (const auto& s : keys_) k.push_back(s.c_str());
+    for (const auto& s : vals_) v.push_back(s.c_str());
+    void* h = nullptr;
+    Check(MXTDataIterCreate(name_.c_str(),
+                            static_cast<uint32_t>(k.size()),
+                            k.empty() ? nullptr : k.data(),
+                            v.empty() ? nullptr : v.data(), &h));
+    handle_.reset(h, [](void* p) { MXTDataIterFree(p); });
+  }
+
+  bool Next() {
+    CreateDataIter();
+    int more = 0;
+    Check(MXTDataIterNext(handle_.get(), &more));
+    return more != 0;
+  }
+
+  NDArray GetData() {
+    void* h = nullptr;
+    Check(MXTDataIterGetData(handle_.get(), &h));
+    return NDArray(h);
+  }
+
+  NDArray GetLabel() {
+    void* h = nullptr;
+    Check(MXTDataIterGetLabel(handle_.get(), &h));
+    return NDArray(h);
+  }
+
+  void Reset() {
+    CreateDataIter();
+    Check(MXTDataIterBeforeFirst(handle_.get()));
+  }
+
+  static std::vector<std::string> ListIters() {
+    uint32_t n = 0;
+    const char** names = nullptr;
+    Check(MXTListDataIters(&n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+ private:
+  std::string name_;
+  std::vector<std::string> keys_, vals_;
+  std::shared_ptr<void> handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_IO_HPP_

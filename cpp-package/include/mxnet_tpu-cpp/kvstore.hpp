@@ -1,0 +1,100 @@
+// KVStore: parameter synchronization over the C ABI
+// (ref: cpp-package/include/mxnet-cpp/kvstore.h over MXKVStore*).
+#ifndef MXNET_TPU_CPP_KVSTORE_HPP_
+#define MXNET_TPU_CPP_KVSTORE_HPP_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.h"
+#include "ndarray.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    void* h = nullptr;
+    Check(MXTKVStoreCreate(type.c_str(), &h));
+    handle_.reset(h, [](void* p) { MXTKVStoreFree(p); });
+  }
+
+  void Init(int key, const NDArray& value) {
+    Check(MXTKVStoreInit(handle(), key, value.handle()));
+  }
+
+  void Init(const std::string& key, const NDArray& value) {
+    Check(MXTKVStoreInitEx(handle(), key.c_str(), value.handle()));
+  }
+
+  void Push(int key, const NDArray& value, int priority = 0) {
+    Check(MXTKVStorePush(handle(), key, value.handle(), priority));
+  }
+
+  void Push(const std::string& key, const NDArray& value,
+            int priority = 0) {
+    Check(MXTKVStorePushEx(handle(), key.c_str(), value.handle(),
+                           priority));
+  }
+
+  void Pull(int key, NDArray* out, int priority = 0) {
+    Check(MXTKVStorePull(handle(), key, out->handle(), priority));
+  }
+
+  void Pull(const std::string& key, NDArray* out, int priority = 0) {
+    Check(MXTKVStorePullEx(handle(), key.c_str(), out->handle(),
+                           priority));
+  }
+
+  void PushPull(int key, const NDArray& in, NDArray* out,
+                int priority = 0) {
+    Check(MXTKVStorePushPull(handle(), key, in.handle(), out->handle(),
+                             priority));
+  }
+
+  // Server-side optimizer from name+params (ref: MXKVStoreSetOptimizer
+  // / the pickled-optimizer UX of kvstore_server.py).
+  void SetOptimizer(const std::string& name,
+                    const std::map<std::string, std::string>& params) {
+    std::vector<const char*> k, v;
+    for (const auto& kv : params) {
+      k.push_back(kv.first.c_str());
+      v.push_back(kv.second.c_str());
+    }
+    Check(MXTKVStoreSetOptimizer(handle(), name.c_str(),
+                                 static_cast<uint32_t>(k.size()),
+                                 k.empty() ? nullptr : k.data(),
+                                 v.empty() ? nullptr : v.data()));
+  }
+
+  int GetRank() const {
+    int r = 0;
+    Check(MXTKVStoreGetRank(handle(), &r));
+    return r;
+  }
+
+  int GetNumWorkers() const {
+    int n = 0;
+    Check(MXTKVStoreGetGroupSize(handle(), &n));
+    return n;
+  }
+
+  std::string GetType() const {
+    const char* t = nullptr;
+    Check(MXTKVStoreGetType(handle(), &t));
+    return t;
+  }
+
+  void* handle() const { return handle_.get(); }
+
+ private:
+  std::shared_ptr<void> handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_KVSTORE_HPP_

@@ -1,0 +1,95 @@
+// Evaluation metrics (ref: cpp-package/include/mxnet-cpp/metric.h —
+// EvalMetric base with Accuracy / MSE, host-side accumulation).
+#ifndef MXNET_TPU_CPP_METRIC_HPP_
+#define MXNET_TPU_CPP_METRIC_HPP_
+
+#include <cmath>
+#include <cstddef>
+#include <string>
+#include <vector>
+
+#include "ndarray.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class EvalMetric {
+ public:
+  explicit EvalMetric(const std::string& name) : name_(name) {}
+  virtual ~EvalMetric() = default;
+
+  virtual void Update(const NDArray& labels, const NDArray& preds) = 0;
+
+  float Get() const {
+    return num_inst_ == 0 ? 0.0f
+                          : static_cast<float>(sum_metric_ / num_inst_);
+  }
+
+  void Reset() {
+    sum_metric_ = 0.0;
+    num_inst_ = 0;
+  }
+
+  const std::string& GetName() const { return name_; }
+
+ protected:
+  std::string name_;
+  double sum_metric_ = 0.0;
+  size_t num_inst_ = 0;
+};
+
+// argmax-vs-label accuracy (ref: metric.h Accuracy)
+class Accuracy : public EvalMetric {
+ public:
+  Accuracy() : EvalMetric("accuracy") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> l = labels.ToVector();
+    std::vector<float> p = preds.ToVector();
+    size_t batch = l.size();
+    size_t nclass = p.size() / batch;
+    for (size_t i = 0; i < batch; ++i) {
+      size_t best = 0;
+      for (size_t c = 1; c < nclass; ++c)
+        if (p[i * nclass + c] > p[i * nclass + best]) best = c;
+      sum_metric_ += (static_cast<float>(best) == l[i]) ? 1.0 : 0.0;
+      ++num_inst_;
+    }
+  }
+};
+
+// mean squared error (ref: metric.h MSE)
+class MSE : public EvalMetric {
+ public:
+  MSE() : EvalMetric("mse") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> l = labels.ToVector();
+    std::vector<float> p = preds.ToVector();
+    for (size_t i = 0; i < l.size() && i < p.size(); ++i) {
+      double d = p[i] - l[i];
+      sum_metric_ += d * d;
+      ++num_inst_;
+    }
+  }
+};
+
+// mean absolute error (ref: metric.h MAE)
+class MAE : public EvalMetric {
+ public:
+  MAE() : EvalMetric("mae") {}
+
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> l = labels.ToVector();
+    std::vector<float> p = preds.ToVector();
+    for (size_t i = 0; i < l.size() && i < p.size(); ++i) {
+      sum_metric_ += std::fabs(p[i] - l[i]);
+      ++num_inst_;
+    }
+  }
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_METRIC_HPP_

@@ -21,32 +21,10 @@
 #include <utility>
 #include <vector>
 
-extern "C" {
-const char* MXTGetLastError();
-int MXTNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
-                     void** out);
-int MXTNDArrayFromData(const int64_t* shape, uint32_t ndim, int dtype,
-                       const void* data, size_t nbytes, void** out);
-int MXTNDArrayFree(void* h);
-int MXTNDArrayGetShape(void* h, uint32_t* ndim, int64_t* shape);
-int MXTNDArraySyncCopyToCPU(void* h, void* data, size_t nbytes);
-int MXTNDArrayWaitAll();
-int MXTImperativeInvoke(const char* op, uint32_t nin, void** in,
-                        uint32_t nparam, const char** keys,
-                        const char** vals, uint32_t* nout, void** out,
-                        uint32_t max_out);
-int MXTAutogradMarkVariables(uint32_t n, void** h);
-int MXTAutogradSetIsRecording(int rec);
-int MXTAutogradBackward(uint32_t n, void** out);
-int MXTNDArrayGetGrad(void* h, void** grad);
-}
+#include "base.h"
 
 namespace mxnet_tpu {
 namespace cpp {
-
-inline void Check(int rc) {
-  if (rc != 0) throw std::runtime_error(MXTGetLastError());
-}
 
 // Value-semantics NDArray over an opaque ABI handle
 // (ref: mxnet-cpp/ndarray.h NDArray — same shared-handle idiom).
@@ -113,6 +91,46 @@ class NDArray {
     std::vector<float> out(Size());
     Check(MXTNDArraySyncCopyToCPU(handle_, out.data(),
                                   out.size() * sizeof(float)));
+    return out;
+  }
+
+  void SyncCopyFromCPU(const float* data, size_t count) {
+    Check(MXTNDArraySyncCopyFromCPU(handle_, data,
+                                    count * sizeof(float)));
+  }
+
+  // device-side value copy, this <- other (no host round trip)
+  void CopyFrom(const NDArray& other) {
+    Check(MXTNDArrayCopyFrom(handle_, other.handle()));
+  }
+
+  // Save/Load in the reference .params byte format
+  // (ref: mxnet-cpp/ndarray.h Save/LoadToMap over MXNDArraySave/Load).
+  static void Save(const std::string& fname,
+                   const std::vector<std::pair<std::string,
+                                               const NDArray*>>& arrays) {
+    std::vector<void*> handles;
+    std::vector<const char*> names;
+    for (const auto& kv : arrays) {
+      names.push_back(kv.first.c_str());
+      handles.push_back(kv.second->handle());
+    }
+    Check(MXTNDArraySave(fname.c_str(),
+                         static_cast<uint32_t>(handles.size()),
+                         handles.data(), names.data()));
+  }
+
+  static std::map<std::string, NDArray> LoadToMap(
+      const std::string& fname) {
+    uint32_t n = 0;
+    void** handles = nullptr;
+    uint32_t nn = 0;
+    const char** names = nullptr;
+    Check(MXTNDArrayLoad(fname.c_str(), &n, &handles, &nn, &names));
+    std::map<std::string, NDArray> out;
+    for (uint32_t i = 0; i < n; ++i)
+      out.emplace(i < nn ? names[i] : std::to_string(i),
+                  NDArray(handles[i]));
     return out;
   }
 

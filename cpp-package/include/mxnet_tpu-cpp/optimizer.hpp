@@ -1,0 +1,113 @@
+// Optimizer: client-side updates via the fused update ops
+// (ref: cpp-package/include/mxnet-cpp/optimizer.hpp — SGDOptimizer /
+// AdamOptimizer call sgd_update / adam_update through the imperative
+// invoke path, mirroring src/operator/optimizer_op.cc).
+#ifndef MXNET_TPU_CPP_OPTIMIZER_HPP_
+#define MXNET_TPU_CPP_OPTIMIZER_HPP_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.h"
+#include "ndarray.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Optimizer {
+ public:
+  virtual ~Optimizer() = default;
+
+  Optimizer& SetParam(const std::string& k, const std::string& v) {
+    params_[k] = v;
+    return *this;
+  }
+
+  // In-place update of weight from grad, with per-index state
+  // (ref: mxnet-cpp optimizer.h Update(index, weight, grad)).
+  virtual void Update(int index, NDArray* weight,
+                      const NDArray& grad) = 0;
+
+  static std::unique_ptr<Optimizer> Create(const std::string& name);
+
+ protected:
+  // invoke `op` on inputs + params_; copy result into weight in place
+  void ApplyUpdate(const std::string& op, NDArray* weight,
+                   const std::vector<void*>& input_handles) {
+    std::vector<const char*> k, v;
+    for (const auto& kv : params_) {
+      k.push_back(kv.first.c_str());
+      v.push_back(kv.second.c_str());
+    }
+    std::vector<void*> ins = input_handles;
+    void* out = nullptr;
+    uint32_t nout = 0;
+    Check(MXTImperativeInvoke(op.c_str(),
+                              static_cast<uint32_t>(ins.size()),
+                              ins.data(),
+                              static_cast<uint32_t>(k.size()),
+                              k.empty() ? nullptr : k.data(),
+                              v.empty() ? nullptr : v.data(), &nout,
+                              &out, 1));
+    Check(MXTNDArrayCopyFrom(weight->handle(), out));
+    MXTNDArrayFree(out);
+  }
+
+  // lazily created zero state shaped like `like`
+  NDArray& State(std::map<int, NDArray>* store, int index,
+                 const NDArray& like) {
+    auto it = store->find(index);
+    if (it == store->end()) {
+      it = store->emplace(index, NDArray(like.Shape())).first;
+    }
+    return it->second;
+  }
+
+  std::map<std::string, std::string> params_;
+};
+
+class SGDOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray* weight, const NDArray& grad) override {
+    if (params_.count("momentum") != 0u) {
+      NDArray& mom = State(&mom_, index, *weight);
+      ApplyUpdate("sgd_mom_update", weight,
+                  {weight->handle(), grad.handle(), mom.handle()});
+    } else {
+      ApplyUpdate("sgd_update", weight,
+                  {weight->handle(), grad.handle()});
+    }
+  }
+
+ private:
+  std::map<int, NDArray> mom_;
+};
+
+class AdamOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray* weight, const NDArray& grad) override {
+    NDArray& mean = State(&mean_, index, *weight);
+    NDArray& var = State(&var_, index, *weight);
+    ApplyUpdate("adam_update", weight,
+                {weight->handle(), grad.handle(), mean.handle(),
+                 var.handle()});
+  }
+
+ private:
+  std::map<int, NDArray> mean_, var_;
+};
+
+inline std::unique_ptr<Optimizer> Optimizer::Create(
+    const std::string& name) {
+  if (name == "sgd") return std::unique_ptr<Optimizer>(new SGDOptimizer());
+  if (name == "adam")
+    return std::unique_ptr<Optimizer>(new AdamOptimizer());
+  throw std::runtime_error("unknown optimizer: " + name);
+}
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_OPTIMIZER_HPP_

@@ -1,0 +1,165 @@
+// Symbol: declarative graph construction over the C ABI
+// (ref: cpp-package/include/mxnet-cpp/symbol.h Symbol + op_suppl.h
+// conveniences; the atomic+compose flow mirrors MXSymbolCreateAtomicSymbol
+// -> MXSymbolCompose in c_api_symbolic.cc).
+#ifndef MXNET_TPU_CPP_SYMBOL_HPP_
+#define MXNET_TPU_CPP_SYMBOL_HPP_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "base.h"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Executor;  // fwd (executor.hpp)
+
+// Shared-handle Symbol (reference Symbols are also cheaply copyable).
+class Symbol {
+ public:
+  Symbol() = default;
+
+  explicit Symbol(void* handle)
+      : handle_(handle, [](void* h) { MXTSymbolFree(h); }) {}
+
+  static Symbol Variable(const std::string& name) {
+    void* h = nullptr;
+    Check(MXTSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  static Symbol FromJSON(const std::string& json) {
+    void* h = nullptr;
+    Check(MXTSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+
+  static Symbol FromFile(const std::string& path) {
+    void* h = nullptr;
+    Check(MXTSymbolCreateFromFile(path.c_str(), &h));
+    return Symbol(h);
+  }
+
+  // Create an op node: atomic symbol + compose, positional or named
+  // inputs (ref: mxnet-cpp Operator::CreateSymbol).
+  static Symbol CreateOp(
+      const std::string& op_name, const std::string& node_name,
+      const std::vector<Symbol>& inputs,
+      const std::map<std::string, std::string>& params = {},
+      const std::vector<std::string>& input_keys = {}) {
+    std::vector<const char*> pk, pv;
+    for (const auto& kv : params) {
+      pk.push_back(kv.first.c_str());
+      pv.push_back(kv.second.c_str());
+    }
+    void* atomic = nullptr;
+    Check(MXTSymbolCreateAtomicSymbol(
+        op_name.c_str(), static_cast<uint32_t>(pk.size()),
+        pk.empty() ? nullptr : pk.data(),
+        pv.empty() ? nullptr : pv.data(), &atomic));
+    std::vector<void*> args;
+    for (const auto& s : inputs) args.push_back(s.handle());
+    std::vector<const char*> ik;
+    for (const auto& k : input_keys) ik.push_back(k.c_str());
+    void* out = nullptr;
+    int rc = MXTSymbolCompose(
+        atomic, node_name.c_str(), static_cast<uint32_t>(args.size()),
+        ik.empty() ? nullptr : ik.data(), args.data(), &out);
+    MXTSymbolFree(atomic);
+    Check(rc);
+    return Symbol(out);
+  }
+
+  std::string ToJSON() const {
+    const char* json = nullptr;
+    Check(MXTSymbolSaveToJSON(handle(), &json));
+    return json;
+  }
+
+  void Save(const std::string& path) const {
+    Check(MXTSymbolSaveToFile(handle(), path.c_str()));
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return StrListOf(MXTSymbolListArguments);
+  }
+
+  std::vector<std::string> ListOutputs() const {
+    return StrListOf(MXTSymbolListOutputs);
+  }
+
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrListOf(MXTSymbolListAuxiliaryStates);
+  }
+
+  std::string GetName() const {
+    const char* n = nullptr;
+    Check(MXTSymbolGetName(handle(), &n));
+    return n;
+  }
+
+  // Infer shapes given named input shapes; fills arg/out/aux shape
+  // lists (ref: mxnet-cpp symbol.h InferShape).
+  void InferShape(
+      const std::map<std::string, std::vector<int64_t>>& provided,
+      std::vector<std::vector<int64_t>>* arg_shapes,
+      std::vector<std::vector<int64_t>>* out_shapes,
+      std::vector<std::vector<int64_t>>* aux_shapes) const {
+    std::vector<const char*> names;
+    std::vector<uint32_t> ndims;
+    std::vector<int64_t> flat;
+    for (const auto& kv : provided) {
+      names.push_back(kv.first.c_str());
+      ndims.push_back(static_cast<uint32_t>(kv.second.size()));
+      for (int64_t d : kv.second) flat.push_back(d);
+    }
+    uint32_t argc = 0, outc = 0, auxc = 0;
+    const uint32_t* all_nd = nullptr;
+    const int64_t* all_d = nullptr;
+    Check(MXTSymbolInferShape(handle(),
+                              static_cast<uint32_t>(names.size()),
+                              names.data(), ndims.data(), flat.data(),
+                              &argc, &outc, &auxc, &all_nd, &all_d));
+    size_t entry = 0, off = 0;
+    auto take = [&](uint32_t count,
+                    std::vector<std::vector<int64_t>>* dst) {
+      if (dst != nullptr) dst->clear();
+      for (uint32_t i = 0; i < count; ++i, ++entry) {
+        std::vector<int64_t> s(all_d + off, all_d + off + all_nd[entry]);
+        off += all_nd[entry];
+        if (dst != nullptr) dst->push_back(std::move(s));
+      }
+    };
+    take(argc, arg_shapes);
+    take(outc, out_shapes);
+    take(auxc, aux_shapes);
+  }
+
+  // Bind with data shapes; allocates everything else (executor.hpp
+  // defines the Executor; declared here, implemented below the class).
+  Executor SimpleBind(
+      const std::map<std::string, std::vector<int64_t>>& provided,
+      const std::string& grad_req = "write") const;
+
+  void* handle() const { return handle_.get(); }
+
+ private:
+  using ListFn = int (*)(void*, uint32_t*, const char***);
+  std::vector<std::string> StrListOf(ListFn fn) const {
+    uint32_t n = 0;
+    const char** names = nullptr;
+    Check(fn(handle(), &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+  std::shared_ptr<void> handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_SYMBOL_HPP_

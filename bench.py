@@ -102,8 +102,19 @@ def bench_resnet():
                                128 if platform != "cpu" else 8))
     dtype = os.environ.get("BENCH_DTYPE",
                            "bfloat16" if platform != "cpu" else "float32")
+    # BENCH_LAYOUT=NHWC runs the channels-last variant (API stays NCHW;
+    # one boundary transpose inside the model). Measured r3 on one v5e
+    # chip: NCHW 2548/2577 img/s vs NHWC 2480/2564 (b128/b256) — parity
+    # within noise, because the step is HBM-bandwidth-bound (XLA cost
+    # analysis: 43.95 GB moved per b128 step at ~880 GB/s ≈ the chip's
+    # peak), and XLA already picks its own internal conv layouts either
+    # way. See docs/ROADMAP.md "ResNet perf ceiling".
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError("BENCH_LAYOUT must be NCHW or NHWC, got %r"
+                         % layout)
 
-    net = resnet50_v1()
+    net = resnet50_v1(layout=layout)
     net.initialize()
     net(mx.nd.array(np.zeros((1, 3, 224, 224), "float32")))  # deferred init
     if dtype != "float32":
@@ -141,6 +152,7 @@ def bench_resnet():
         "platform": platform,
         "batch": batch,
         "dtype": dtype,
+        "layout": layout,
         "final_loss": round(float(loss), 4),
     }
 

@@ -49,7 +49,9 @@ class _Conv(HybridBlock):
             + tuple(kernel_size)
 
     def _shape_hint(self, x, *args):
-        cin = x.shape[1]
+        layout = self._kwargs.get("layout")
+        cin = x.shape[-1] if (layout and layout.endswith("C")) \
+            else x.shape[1]
         hints = {self.weight: self._weight_shape(
             self._channels, cin, self._groups, self._kwargs["kernel"])}
         if self.bias is not None:
@@ -110,7 +112,9 @@ class _ConvTranspose(_Conv):
         return (in_channels, channels // groups) + tuple(kernel_size)
 
     def _shape_hint(self, x, *args):
-        cin = x.shape[1]
+        layout = self._kwargs.get("layout")
+        cin = x.shape[-1] if (layout and layout.endswith("C")) \
+            else x.shape[1]
         hints = {self.weight: (cin, self._channels // self._groups)
                  + tuple(self._kwargs["kernel"])}
         if self.bias is not None:
@@ -166,6 +170,7 @@ class _Pooling(HybridBlock):
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "pool_type": pool_type, "global_pool": global_pool,
+            "layout": layout,
             "pooling_convention": "full" if ceil_mode else "valid"}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
@@ -179,7 +184,8 @@ class MaxPool1D(_Pooling):
                  ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 1),
                          _tup(strides, 1) if strides is not None else None,
-                         _tup(padding, 1), ceil_mode, False, "max", **kwargs)
+                         _tup(padding, 1), ceil_mode, False, "max",
+                         layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
@@ -187,7 +193,8 @@ class MaxPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 2),
                          _tup(strides, 2) if strides is not None else None,
-                         _tup(padding, 2), ceil_mode, False, "max", **kwargs)
+                         _tup(padding, 2), ceil_mode, False, "max",
+                         layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
@@ -195,7 +202,8 @@ class MaxPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 3),
                          _tup(strides, 3) if strides is not None else None,
-                         _tup(padding, 3), ceil_mode, False, "max", **kwargs)
+                         _tup(padding, 3), ceil_mode, False, "max",
+                         layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -204,6 +212,7 @@ class AvgPool1D(_Pooling):
         super().__init__(_tup(pool_size, 1),
                          _tup(strides, 1) if strides is not None else None,
                          _tup(padding, 1), ceil_mode, False, "avg",
+                         layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -214,6 +223,7 @@ class AvgPool2D(_Pooling):
         super().__init__(_tup(pool_size, 2),
                          _tup(strides, 2) if strides is not None else None,
                          _tup(padding, 2), ceil_mode, False, "avg",
+                         layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -224,6 +234,7 @@ class AvgPool3D(_Pooling):
         super().__init__(_tup(pool_size, 3),
                          _tup(strides, 3) if strides is not None else None,
                          _tup(padding, 3), ceil_mode, False, "avg",
+                         layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -235,32 +246,32 @@ class _GlobalPool(_Pooling):
 
 class GlobalMaxPool1D(_GlobalPool):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__("max", 1, **kwargs)
+        super().__init__("max", 1, layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_GlobalPool):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__("max", 2, **kwargs)
+        super().__init__("max", 2, layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_GlobalPool):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__("max", 3, **kwargs)
+        super().__init__("max", 3, layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_GlobalPool):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__("avg", 1, **kwargs)
+        super().__init__("avg", 1, layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_GlobalPool):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__("avg", 2, **kwargs)
+        super().__init__("avg", 2, layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_GlobalPool):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__("avg", 3, **kwargs)
+        super().__init__("avg", 3, layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

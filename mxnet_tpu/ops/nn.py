@@ -8,8 +8,11 @@ activation.cc, src/operator/leaky_relu-inl.h). The cuDNN wrapper layer
 conv_general_dilated / reduce_window straight onto the MXU/VPU, and algorithm
 selection (ref: cudnn_algoreg-inl.h) is the compiler's autotuner's job.
 
-Layout: the reference default is NCHW. XLA:TPU handles NCHW natively (it
-relayouts internally), so the public API keeps NCHW for parity.
+Layout: the reference default is NCHW and stays the public default for
+parity (XLA:TPU relayouts internally either way). Conv/Deconv/Pooling
+also honor the channels-last layouts (NWC/NHWC/NDHWC) for channels-last
+model variants (model_zoo resnet `layout="NHWC"`); weights stay OIHW in
+every layout so `.params` checkpoints are layout-independent.
 """
 from __future__ import annotations
 
@@ -42,6 +45,11 @@ def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
     return out
 
 
+def _channels_last(layout):
+    """True for the channels-last layouts (NWC/NHWC/NDHWC)."""
+    return layout is not None and layout.endswith("C")
+
+
 @register("Convolution", aliases=("convolution",))
 def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=None, num_group=1, no_bias=False,
@@ -61,17 +69,25 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _pair(dilate, nd)
     pad = _pair(pad if pad is not None else 0, nd)
     padding = [(p, p) for p in pad]
+    # channels-last layouts (NWC/NHWC/NDHWC) keep activations in the
+    # TPU-native resident layout — XLA then tiles convs onto the MXU
+    # without the relayout copies an NCHW graph needs. Weights stay
+    # OIHW in both layouts for .params checkpoint compat; XLA folds the
+    # transposition into the conv.
+    channels_last = _channels_last(layout)
+    spatial = "DHW"[3 - nd:]
+    act = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
     dn = jax.lax.conv_dimension_numbers(
-        x.shape, weight.shape,
-        ("NCHW"[:2] + "DHW"[3 - nd:], "OIDHW"[:2] + "DHW"[3 - nd:],
-         "NCHW"[:2] + "DHW"[3 - nd:]) if nd != 2 else ("NCHW", "OIHW", "NCHW"))
+        x.shape, weight.shape, (act, "OI" + spatial, act))
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=padding,
         lhs_dilation=(1,) * nd, rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group,
         preferred_element_type=None)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) + (1,) * nd + (-1,)) if channels_last \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -105,15 +121,19 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
                                           *w.shape[3:])
     else:
         w = jnp.swapaxes(w, 0, 1)
-    dn_spec = ("NCHW", "OIHW", "NCHW") if nd == 2 else (
-        "NC" + "DHW"[3 - nd:], "OI" + "DHW"[3 - nd:], "NC" + "DHW"[3 - nd:])
-    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_spec)
+    channels_last = _channels_last(layout)
+    spatial = "DHW"[3 - nd:]
+    act = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (act, "OI" + spatial, act))
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=(1,) * nd, padding=padding,
         lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=num_group)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) + (1,) * nd + (-1,)) if channels_last \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -121,10 +141,14 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
 def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
             global_pool=False, pooling_convention="valid", cudnn_off=False,
             p_value=2, count_include_pad=True, layout=None):
-    """ref: src/operator/nn/pooling-inl.h PoolingParam."""
+    """ref: src/operator/nn/pooling-inl.h PoolingParam. Supports both
+    channels-first (NCW/NCHW/NCDHW) and TPU-native channels-last
+    (NWC/NHWC/NDHWC) layouts."""
     nd = x.ndim - 2
+    channels_last = _channels_last(layout)
+    spatial0 = 1 if channels_last else 2  # first spatial dim index
     if global_pool:
-        axes = tuple(range(2, 2 + nd))
+        axes = tuple(range(spatial0, spatial0 + nd))
         if pool_type == "max":
             return jnp.max(x, axis=axes, keepdims=True)
         if pool_type in ("avg", "sum"):
@@ -136,18 +160,24 @@ def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
     kernel = _pair(kernel, nd)
     stride = _pair(stride, nd)
     pad = _pair(pad if pad is not None else 0, nd)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
     if pooling_convention == "full":
         # ceil division output size (ref: pooling-inl.h kFull)
-        padding = [(0, 0), (0, 0)]
+        spad = []
         for i in range(nd):
-            in_sz = x.shape[2 + i] + 2 * pad[i]
+            in_sz = x.shape[spatial0 + i] + 2 * pad[i]
             out_sz = -(-(in_sz - kernel[i]) // stride[i]) + 1
             needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz
-            padding.append((pad[i], pad[i] + max(needed, 0)))
+            spad.append((pad[i], pad[i] + max(needed, 0)))
     else:
-        padding = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+        spad = [(p, p) for p in pad]
+    padding = ([(0, 0)] + spad + [(0, 0)]) if channels_last \
+        else [(0, 0), (0, 0)] + spad
 
     if pool_type == "max":
         # NB: init must stay a weak-typed Python scalar — an array init value

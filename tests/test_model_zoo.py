@@ -81,3 +81,84 @@ def test_model_save_load_roundtrip(tmp_path):
     net2 = get_model("mobilenet0.25", classes=3)
     net2.load_parameters(f)
     np.testing.assert_allclose(y, net2(x).asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_nhwc_layout_matches_nchw():
+    """layout="NHWC" (TPU-native channels-last) must be numerically
+    identical to NCHW given the same OIHW weights — the API contract
+    that makes checkpoints layout-independent (docs/ROADMAP.md
+    round-3 perf analysis)."""
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    a = resnet18_v1(classes=10)
+    a.initialize()
+    x = mx.nd.array(np.random.RandomState(3).rand(2, 3, 32, 32)
+                    .astype("float32"))
+    ya = a(x)
+    b = resnet18_v1(classes=10, layout="NHWC")
+    b.initialize()
+    b(x)  # deferred init
+    pa, pb = a.collect_params(), b.collect_params()
+    for k1, k2 in zip(sorted(pa), sorted(pb)):
+        assert pb[k2].shape == pa[k1].shape, (k1, k2)
+        pb[k2].set_data(pa[k1].data())
+    np.testing.assert_allclose(ya.asnumpy(), b(x).asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_nhwc_checkpoint_interchange(tmp_path):
+    """An NCHW-trained checkpoint loads into an NHWC model unchanged."""
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    a = resnet18_v1(classes=5)
+    a.initialize()
+    x = mx.nd.array(np.random.RandomState(4).rand(1, 3, 32, 32)
+                    .astype("float32"))
+    y = a(x).asnumpy()
+    f = str(tmp_path / "w.params")
+    a.save_parameters(f)
+    b = resnet18_v1(classes=5, layout="NHWC")
+    b.load_parameters(f)
+    np.testing.assert_allclose(y, b(x).asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_nhwc_trains():
+    """One SGD step on the NHWC variant produces finite decreasing loss."""
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    net = resnet18_v1(classes=4, layout="NHWC", thumbnail=True)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05})
+    loss_fn = SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(np.random.RandomState(5).rand(8, 3, 32, 32)
+                    .astype("float32"))
+    y = mx.nd.array(np.arange(8) % 4)
+    losses = []
+    for _ in range(5):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(8)
+        losses.append(float(l.mean().asnumpy()))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_conv_transpose_nhwc_matches_nchw():
+    """Deconvolution honors channels-last too (same OIHW-style weights)."""
+    from mxnet_tpu.gluon import nn as gnn
+    a = gnn.Conv2DTranspose(6, kernel_size=3, strides=2, padding=1,
+                            in_channels=4)
+    a.initialize()
+    x = mx.nd.array(np.random.RandomState(6).rand(2, 4, 8, 8)
+                    .astype("float32"))
+    ya = a(x).asnumpy()
+    b = gnn.Conv2DTranspose(6, kernel_size=3, strides=2, padding=1,
+                            in_channels=4, layout="NHWC")
+    b.initialize()
+    xn = mx.nd.array(np.transpose(x.asnumpy(), (0, 2, 3, 1)))
+    b(xn)
+    pa, pb = a.collect_params(), b.collect_params()
+    for k1, k2 in zip(sorted(pa), sorted(pb)):
+        pb[k2].set_data(pa[k1].data())
+    yb = np.transpose(b(xn).asnumpy(), (0, 3, 1, 2))
+    np.testing.assert_allclose(ya, yb, rtol=1e-4, atol=1e-5)

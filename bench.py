@@ -43,13 +43,28 @@ def bench_transformer():
     # measured vs 70 at dim 2048, 34 at 1024); the 2.4B params + Adam-free
     # SGD state fit in 16G HBM at batch 4
     dim = int(os.environ.get("BENCH_DIM", 4096 if big else 64))
-    layers = int(os.environ.get("BENCH_LAYERS", 8 if big else 2))
+    # 6 layers (1.87B params): trades 2 layers of param/momentum/grad
+    # state for the ffn_prod selective-remat buffer — measured r3 best
+    # (118.3 TF/s, 60.0% MFU vs 111.1/56.4% for 8 layers + full remat)
+    layers = int(os.environ.get("BENCH_LAYERS", 6 if big else 2))
     cfg = T.TransformerConfig(
         vocab_size=32000 if big else 256,
         dim=dim, n_layers=layers,
         n_heads=max(4, dim // 128), ffn_hidden=dim * 4,
         max_seq_len=S, dtype="bfloat16" if big else "float32",
-        attn_mode="local")
+        attn_mode="local",
+        # chunked CE keeps the [B,S,32k] f32 logits off HBM (see
+        # TransformerConfig.loss_chunks) — required for batch >= 8
+        loss_chunks=int(os.environ.get("BENCH_LOSS_CHUNKS",
+                                       8 if big else 1)),
+        # selective remat: keep these intermediates in HBM instead of
+        # recomputing them in backward (TransformerConfig.remat_save).
+        # ffn_prod skips recomputing the two FFN up-projections; fits
+        # at 6 layers (attn_o is not worth saving: flash bwd recomputes
+        # its fwd for the lse residual regardless)
+        remat_save=tuple(n for n in os.environ.get(
+            "BENCH_REMAT_SAVE", "ffn_prod" if big else "").split(",")
+            if n))
     mesh = create_mesh(devices=jax.devices()[:1], dp=1)
     init_fn, step_fn = T.make_train_step(cfg, mesh)
     rs = np.random.RandomState(0)

@@ -35,6 +35,8 @@ import jax.random as jr
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
 from .ring_attention import ring_attention, blockwise_attention
 from .ulysses import ulysses_attention_local
 from .expert import moe_ffn
@@ -64,6 +66,18 @@ class TransformerConfig:
     # ~1/3 more FLOPs for O(n_layers) less activation HBM, the standard
     # TPU trade (SURVEY §7: jax.checkpoint)
     remat: bool = True
+    # selective remat: names of intermediates the backward may KEEP
+    # instead of recomputing (jax save_only_these_names policy).
+    # "ffn_prod" saves the gated-FFN product [B,S,ffn_hidden] — skips
+    # recomputing the two up-projections (the biggest matmuls);
+    # "attn_o" saves the attention output [B,S,D] — skips re-running
+    # the flash forward kernel inside the backward. Empty = full remat.
+    remat_save: tuple = ()
+    # >1: compute the final projection + cross-entropy in this many
+    # sequence chunks (sequential lax.map + per-chunk remat), so the
+    # [B, S, vocab] f32 logits tensor never materializes — at 32k vocab
+    # that saves GBs of HBM and is what lets batch 8 fit on one chip
+    loss_chunks: int = 1
 
     @property
     def head_dim(self):
@@ -217,7 +231,7 @@ def _layer_body(cfg, mesh, positions, x, lp):
                       (0, 2, 1, 3))
     k = jnp.transpose(_rope(jnp.transpose(k, (0, 2, 1, 3)), positions),
                       (0, 2, 1, 3))
-    o = _attention(cfg, mesh, q, k, v, positions)
+    o = _ckpt_name(_attention(cfg, mesh, q, k, v, positions), "attn_o")
     x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
     h = _rms_norm(x, lp["ln2"])
     if cfg.num_experts > 0:
@@ -226,13 +240,33 @@ def _layer_body(cfg, mesh, positions, x, lp):
         return x + y, aux
     g = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w_gate"]))
     u = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
-    return x + jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"]), 0.0
+    prod = _ckpt_name(g * u, "ffn_prod")
+    return x + jnp.einsum("bsf,fd->bsd", prod, lp["w_down"]), 0.0
 
 
 def apply(params, tokens, cfg: TransformerConfig, mesh=None,
           return_aux=False):
     """Forward: tokens [B, S] int32 -> logits [B, S, V]. GSPMD mode.
     With return_aux, also returns the summed MoE load-balance loss."""
+    x, aux = _hidden(params, tokens, cfg, mesh)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["w_out"])
+    if return_aux:
+        return logits, aux
+    return logits
+
+
+def _remat_policy(cfg):
+    """None = recompute everything; with cfg.remat_save, keep the named
+    intermediates (save_only_these_names) so the backward skips their
+    producers — selective remat, the memory/recompute dial."""
+    if not cfg.remat_save:
+        return None
+    return jax.checkpoint_policies.save_only_these_names(*cfg.remat_save)
+
+
+def _hidden(params, tokens, cfg, mesh):
+    """Trunk forward up to (but excluding) the output projection;
+    returns (x [B,S,D], summed aux)."""
     x = jnp.take(params["embed"], tokens, axis=0)
     positions = jnp.arange(tokens.shape[1])
 
@@ -241,20 +275,51 @@ def apply(params, tokens, cfg: TransformerConfig, mesh=None,
         return x, aux
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(cfg))
     x, auxs = lax.scan(body, x, params["layers"])
-    x = _rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["w_out"])
-    if return_aux:
-        return logits, jnp.sum(auxs)
-    return logits
+    return _rms_norm(x, params["ln_f"]), jnp.sum(auxs)
+
+
+def _chunked_ce(x, w_out, targets, n_chunks):
+    """Mean token NLL with the vocab projection done per sequence chunk.
+
+    lax.map runs chunks sequentially, and jax.checkpoint makes the
+    backward recompute each chunk's logits instead of saving them, so
+    peak HBM holds ONE [B, S/n, V] f32 tile instead of the full
+    [B, S, V] logits (2+ GB at 32k vocab, batch 8, seq 2048)."""
+    B, S, D = x.shape
+    C = S // n_chunks
+    xc = jnp.swapaxes(x.reshape(B, n_chunks, C, D), 0, 1)
+    tc = jnp.swapaxes(targets.reshape(B, n_chunks, C), 0, 1)
+
+    @jax.checkpoint
+    def chunk_nll(args):
+        xi, ti = args
+        logits = jnp.einsum("bcd,dv->bcv", xi, w_out,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, ti[..., None], axis=-1)[..., 0]
+        return jnp.sum(lse - tgt)
+
+    return jnp.sum(lax.map(chunk_nll, (xc, tc))) / (B * S)
 
 
 def loss_fn(params, tokens, targets, cfg, mesh=None, aux_weight=0.01):
-    logits, aux = apply(params, tokens, cfg, mesh, return_aux=True)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    if cfg.loss_chunks > 1:
+        if tokens.shape[1] % cfg.loss_chunks != 0:
+            # a silent full-logits fallback would re-materialize the
+            # [B,S,V] tensor loss_chunks exists to avoid (and OOM)
+            raise ValueError(
+                "loss_chunks=%d does not divide seq_len=%d; pick a "
+                "divisor or set loss_chunks=1"
+                % (cfg.loss_chunks, tokens.shape[1]))
+        x, aux = _hidden(params, tokens, cfg, mesh)
+        loss = _chunked_ce(x, params["w_out"], targets, cfg.loss_chunks)
+    else:
+        logits, aux = apply(params, tokens, cfg, mesh, return_aux=True)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -jnp.mean(ll)
     if cfg.num_experts > 0:
         loss = loss + aux_weight * aux  # GShard load-balance pressure
     return loss

@@ -144,8 +144,7 @@ def bench_resnet():
     rng = np.random.RandomState(0)
     x = rng.rand(batch, 3, 224, 224).astype(dtype)
     y = rng.randint(0, 1000, (batch,)).astype("float32")
-    xd, yd = step.place_batch(x, y)  # on-device once; input pipeline is
-    # benchmarked separately (the reference prefetches via iter_prefetcher.h)
+    xd, yd = step.place_batch(x, y)  # compute-only: batch on device once
 
     float(step.step(xd, yd))  # compile + warm
     float(step.step(xd, yd))
@@ -159,7 +158,7 @@ def bench_resnet():
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * iters / dt
-    return {
+    result = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec",
@@ -170,6 +169,131 @@ def bench_resnet():
         "layout": layout,
         "final_loss": round(float(loss), 4),
     }
+    if os.environ.get("BENCH_INPUT_PIPELINE", "1") == "1":
+        try:
+            result["input_pipeline"] = bench_input_pipeline(
+                step=step, batch=batch, dtype=dtype,
+                compute_imgs_per_sec=imgs_per_sec)
+        except Exception as e:  # noqa: BLE001 — a missing cv2 etc. must
+            # not discard the compute result measured above
+            result["input_pipeline"] = {"error": "%s: %s"
+                                        % (type(e).__name__, e)}
+    return result
+
+
+def _synth_rec(n=2048, side=256, path="/tmp/mxtpu_bench_synth.rec"):
+    """Synthetic JPEG .rec + .idx (written once, reused across runs)."""
+    import cv2
+    from mxnet_tpu.recordio import MXIndexedRecordIO, pack, IRHeader
+    idx = path.replace(".rec", ".idx")
+    if os.path.exists(path) and os.path.exists(idx):
+        return path, idx
+    # write to temp names + atomic rename so an interrupted run can
+    # never leave a truncated file that later runs silently reuse
+    tmp_rec, tmp_idx = path + ".tmp", idx + ".tmp"
+    w = MXIndexedRecordIO(tmp_idx, tmp_rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (side, side, 3), np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 90])
+        assert ok
+        w.write_idx(i, pack(IRHeader(0, float(i % 1000), i, 0),
+                            enc.tobytes()))
+    w.close()
+    os.rename(tmp_rec, path)
+    os.rename(tmp_idx, idx)
+    return path, idx
+
+
+def bench_input_pipeline(step=None, batch=128, dtype="bfloat16",
+                         compute_imgs_per_sec=None):
+    """End-to-end input pipeline: synthetic .rec -> ImageRecordIter
+    (uint8 feed, on-device normalize) -> sustained img/s, and the same
+    pipeline actually feeding the training step (VERDICT r2 item 5).
+
+    The pipeline is host-CPU-bound: single-core cv2 JPEG decode of
+    256px records measures ~1300 img/s, so a host needs
+    ceil(compute_rate / per-core rate) cores to keep a chip fed — the
+    reference's published numbers assume a 36-core C5 host
+    (ref: perf.md), while CI/axon hosts may have 1."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+
+    rec, idx = _synth_rec()
+
+    n_threads = min(8, os.cpu_count() or 1)
+
+    def make_iter():
+        return mx.io.ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx, data_shape=(3, 224, 224),
+            batch_size=batch, shuffle=True, rand_crop=True,
+            rand_mirror=True, dtype="uint8",
+            preprocess_threads=n_threads)
+
+    # 1) pipeline-only sustained rate (decode + augment + batch)
+    it = make_iter()
+    n = 0
+    t0 = time.perf_counter()
+    for _ in range(2):
+        it.reset()
+        for b in it:
+            n += b.data[0].shape[0]
+    pipeline_rate = n / (time.perf_counter() - t0)
+
+    # host->device bandwidth for one uint8 batch (on a real TPU host
+    # this is PCIe/DMA at GB/s; over a remote-tunnel dev attach it can
+    # be the train-through bottleneck, so report it for context)
+    probe = np.zeros((batch, 3, 224, 224), np.uint8)
+    jax.block_until_ready(jnp.asarray(probe))  # warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(jnp.asarray(probe))
+    h2d_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
+
+    out = {
+        "sustained_imgs_per_sec": round(pipeline_rate, 1),
+        "host_cpus": os.cpu_count(),
+        "record_px": 256,
+        "host_to_device_MBps": round(h2d_mbps, 1),
+    }
+    if compute_imgs_per_sec:
+        # per-core rate uses the thread count the pipeline actually ran
+        # with, not the host's core count
+        out["cores_to_feed_compute"] = int(
+            np.ceil(compute_imgs_per_sec / (pipeline_rate / n_threads)))
+
+    # 2) the same pipeline feeding the real train step (uint8 to the
+    #    device, normalize on-chip — the TPU-idiomatic feed)
+    if step is not None:
+        mean = jnp.asarray([123.68, 116.78, 103.94], dtype
+                           ).reshape(1, 3, 1, 1)
+        scale = jnp.asarray(1.0 / 58.0, dtype)
+
+        @jax.jit
+        def normalize(u8):
+            return (u8.astype(dtype) - mean) * scale
+
+        it = make_iter()
+        it.reset()
+        first = next(iter(it))
+        xd, yd = step.place_batch(
+            normalize(jnp.asarray(first.data[0].asnumpy())),
+            first.label[0].asnumpy())
+        float(step.step(xd, yd))  # warm the (possibly new) shapes
+        n = 0
+        t0 = time.perf_counter()
+        loss = None
+        it.reset()
+        for b in it:
+            xd, yd = step.place_batch(
+                normalize(jnp.asarray(b.data[0].asnumpy())),
+                b.label[0].asnumpy())
+            loss = step.step(xd, yd)
+            n += b.data[0].shape[0]
+        float(loss)
+        out["train_through_imgs_per_sec"] = round(
+            n / (time.perf_counter() - t0), 1)
+    return out
 
 
 if __name__ == "__main__":

@@ -1,0 +1,26 @@
+"""Standalone input-pipeline benchmark (VERDICT r2 item 5).
+
+Synthetic JPEG .rec -> ImageRecordIter (uint8 feed, threaded decode,
+prefetch) -> sustained img/s, plus host->device bandwidth. One command:
+
+    python benchmark/input_pipeline.py
+
+Prints one JSON line. The same measurement runs inside bench.py's
+resnet entry (key "input_pipeline") so BENCH_r* records it next to the
+compute-only number.
+
+ref slot: the reference benchmarks its pipeline via
+tools/bandwidth + the OMP decode path of iter_image_recordio_2.cc;
+here decode is cv2 (GIL-releasing) with batch-level vectorized
+normalize — see mxnet_tpu/io/image_iter.py for the design rules.
+"""
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench import bench_input_pipeline  # noqa: E402
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_input_pipeline()))

@@ -239,6 +239,49 @@ class LightingAug(Augmenter):
         return nd_array(_np_img(src).astype(np.float32) + rgb)
 
 
+class RandomGrayAug(Augmenter):
+    """Random grayscale conversion (ref: image.py RandomGrayAug)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.array([[0.21, 0.21, 0.21],
+                             [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]], np.float32)
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            img = _np_img(src).astype(np.float32)
+            return nd_array(img @ self.mat)
+        return src
+
+
+class HueJitterAug(Augmenter):
+    """Random hue rotation in YIQ space (ref: image.py HueJitterAug,
+    approximate linear transform)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], np.float32)
+        t = (self.ityiq @ bt @ self.tyiq).T
+        img = _np_img(src).astype(np.float32)
+        return nd_array(img @ t)
+
+
 class ColorJitterAug(Augmenter):
     def __init__(self, brightness=0, contrast=0, saturation=0):
         super().__init__(brightness=brightness, contrast=contrast,
@@ -380,9 +423,30 @@ class ImageIter:
         idxs = [self._order[i % n] for i in range(self._cursor, end)]
         pad = max(0, end - n)
         self._cursor = end
+        # _load returns (image, label); labels may be scalars
+        # (classification) or [N, obj_width] arrays (ImageDetIter)
         imgs, labels = zip(*[self._load(self._items[i]) for i in idxs])
         return DataBatch(data=[nd_array(np.stack(imgs))],
-                         label=[nd_array(np.asarray(labels))], pad=pad)
+                         label=[nd_array(np.stack(labels))], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
     def __next__(self):
         return self.next()
+
+
+# detection pipeline members live in image_det.py; resolved lazily so
+# the two modules can import in either order (ref: the reference
+# re-exports via python/mxnet/image/__init__.py)
+_DET_NAMES = ("DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+              "DetHorizontalFlipAug", "DetRandomCropAug",
+              "DetRandomPadAug", "CreateMultiRandCropAugmenter",
+              "CreateDetAugmenter", "ImageDetIter")
+
+
+def __getattr__(name):
+    if name in _DET_NAMES:
+        from . import image_det
+        return getattr(image_det, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

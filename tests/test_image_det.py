@@ -1,0 +1,139 @@
+"""Detection data pipeline tests (ref: python/mxnet/image/detection.py;
+tests/python/unittest/test_image.py TestImageDetIter is the model)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image import (CreateDetAugmenter, DetBorrowAug,
+                             DetHorizontalFlipAug, DetRandomCropAug,
+                             DetRandomPadAug, DetRandomSelectAug,
+                             ImageDetIter)
+
+
+def _det_label(boxes, header_width=2, obj_width=5):
+    """Reference raw label layout: [hdr_w, obj_w, (cls,x1,y1,x2,y2)*N]."""
+    flat = [float(header_width), float(obj_width)]
+    for b in boxes:
+        flat.extend(float(v) for v in b)
+    return flat
+
+
+def _write_det_rec(tmp_path, n=6, size=64):
+    import cv2
+    path = str(tmp_path / "det.rec")
+    idx = str(tmp_path / "det.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        nobj = 1 + i % 3
+        boxes = []
+        for j in range(nobj):
+            x1, y1 = rng.uniform(0, 0.5, 2)
+            boxes.append([j % 4, x1, y1, x1 + 0.3, y1 + 0.3])
+        header = recordio.IRHeader(0, _det_label(boxes), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img, quality=90))
+    w.close()
+    return path, idx
+
+
+class TestDetAugmenters:
+    def _img_label(self):
+        rng = np.random.RandomState(1)
+        img = mx.nd.array((rng.rand(60, 80, 3) * 255).astype(np.float32))
+        label = np.array([[0, 0.1, 0.2, 0.5, 0.6],
+                          [2, 0.4, 0.1, 0.9, 0.8]], np.float32)
+        return img, label
+
+    def test_flip_label_math(self):
+        img, label = self._img_label()
+        aug = DetHorizontalFlipAug(p=1.0)
+        out, lab = aug(img, label)
+        # x coords mirror: new_x1 = 1-x2, new_x2 = 1-x1; y unchanged
+        np.testing.assert_allclose(lab[:, 1], 1.0 - label[:, 3])
+        np.testing.assert_allclose(lab[:, 3], 1.0 - label[:, 1])
+        np.testing.assert_allclose(lab[:, (2, 4)], label[:, (2, 4)])
+        np.testing.assert_allclose(out.asnumpy(),
+                                   img.asnumpy()[:, ::-1])
+
+    def test_random_crop_boxes_stay_normalized(self):
+        img, label = self._img_label()
+        aug = DetRandomCropAug(min_object_covered=0.1, max_attempts=30)
+        for _ in range(10):
+            out, lab = aug(img, label)
+            assert lab.shape[1] == 5
+            assert lab.shape[0] >= 1           # never ejects everything
+            assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+            assert (lab[:, 3] > lab[:, 1]).all()
+            assert (lab[:, 4] > lab[:, 2]).all()
+
+    def test_random_pad_shrinks_boxes(self):
+        img, label = self._img_label()
+        aug = DetRandomPadAug(area_range=(1.5, 2.5))
+        out, lab = aug(img, label)
+        oh, ow = out.shape[0], out.shape[1]
+        assert oh * ow > 60 * 80              # canvas grew
+        # padded boxes cover a smaller normalized area
+        area = lambda b: ((b[:, 3] - b[:, 1]) * (b[:, 4] - b[:, 2])).sum()
+        assert area(lab) < area(label)
+
+    def test_select_and_borrow(self):
+        from mxnet_tpu.image import CastAug
+        img, label = self._img_label()
+        aug = DetRandomSelectAug([DetBorrowAug(CastAug())], skip_prob=0)
+        out, lab = aug(img, label)
+        np.testing.assert_allclose(lab, label)
+
+    def test_create_det_augmenter_list(self):
+        augs = CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                  rand_mirror=True, mean=True, std=True,
+                                  brightness=0.1)
+        img, label = self._img_label()
+        for aug in augs:
+            img, label = aug(img, label)
+        assert img.shape[:2] == (32, 32)
+        assert (label[:, 1:5] >= -0.01).all()
+
+
+class TestImageDetIter:
+    def test_batches_and_label_padding(self, tmp_path):
+        path, idx = _write_det_rec(tmp_path)
+        it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                          path_imgrec=path, path_imgidx=idx, shuffle=True,
+                          rand_crop=0.5, rand_pad=0.5, rand_mirror=True)
+        assert it.label_shape == (3, 5)       # max 3 objects per image
+        assert it.provide_label[0].shape == (4, 3, 5)
+        b = next(iter(it))
+        assert b.data[0].shape == (4, 3, 32, 32)
+        lab = b.label[0].asnumpy()
+        assert lab.shape == (4, 3, 5)
+        # padding rows are -1; real rows have valid classes
+        for row in lab.reshape(-1, 5):
+            assert row[0] >= 0 or (row == -1).all()
+
+    def test_full_epoch_and_reset(self, tmp_path):
+        path, idx = _write_det_rec(tmp_path, n=8)
+        it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                          path_imgrec=path)
+        n = sum(b.data[0].shape[0] for b in it)
+        assert n == 8
+        it.reset()
+        assert next(iter(it)).data[0].shape[0] == 4
+
+    def test_parse_label_rejects_bad(self):
+        with pytest.raises(RuntimeError):
+            ImageDetIter._parse_label(np.zeros(3))
+        with pytest.raises(RuntimeError):  # inconsistent widths
+            ImageDetIter._parse_label(
+                np.array([2.0, 5.0, 0, 0.1, 0.1, 0.5]))
+
+    def test_sync_label_shape(self, tmp_path):
+        p1, i1 = _write_det_rec(tmp_path, n=4)
+        train = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                             path_imgrec=p1)
+        val = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                           path_imgrec=p1)
+        val.reshape(label_shape=(7, 5))
+        val = train.sync_label_shape(val)
+        assert train.label_shape == val.label_shape == (7, 5)

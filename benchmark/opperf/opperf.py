@@ -186,6 +186,364 @@ def run_performance_test(ops=None, warmup=3, runs=20, run_backward=True):
     return results
 
 
+# ---------------------------------------------------------------------------
+# Full-registry mode: auto-generated inputs for EVERY registered op
+# (ref: opperf.py runs all registered ops with inputs synthesized from
+# rules/default_params.py; here inputs come from the op fn signatures)
+# ---------------------------------------------------------------------------
+
+# tensor-input shape heuristics by parameter name (small shapes: the
+# full sweep must finish in CI minutes). Profiles cover the common
+# rank expectations; auto_spec tries them in order until the op runs.
+_B, _D = 8, 32
+_SHARED_SHAPES = {
+    "weight": (_D, _D), "bias": (_D,),
+    "gamma": (_D,), "beta": (_D,),
+    "moving_mean": (_D,), "moving_var": (_D,),
+    "label": (_B,),
+    "indices": (_B,), "index": (_B,),
+    "grid": (2, 2, 4, 4),
+    "rois": (4, 5), "anchors": (1, 16, 4), "anchor": (1, 16, 4),
+    "cls_pred": (2, 2, 16), "loc_pred": (2, 64),
+    "cls_prob": (2, 2, 16), "bbox_pred": (2, 64),
+    "im_info": (2, 3),
+    "parameters": (4096,), "state": (1, _B, _D), "state_cell": (1, _B, _D),
+    "A": (2, 8, 8), "B": (2, 8, 8), "C": (2, 8, 8),
+    "pred": (10, 4, 8),                      # CTC: (seq, batch, alphabet)
+    "sequence_length": (_B,), "lengths": (_B,), "len_arr": (_B,),
+    "min_data": (1,), "max_data": (1,),
+    "min_range": (1,), "max_range": (1,),
+    "min_calib": (1,), "max_calib": (1,),
+    "offset": (2, 18, 8, 8),                 # deformable conv offsets
+    "mask": (2, 9, 8, 8),
+}
+_PROFILES = (
+    # rank-2 activations (the default)
+    {"data": (_B, _D), "x": (_B, _D), "a": (_B, _D), "b": (_B, _D),
+     "lhs": (_B, _D), "rhs": (_B, _D), "data1": (_B, _D),
+     "data2": (_B, _D), "shape_like": (_B, _D), "like": (_B, _D),
+     "condition": (_B, _D), "mu": (_B, _D), "sigma": (_B, _D),
+     "low": (_B, _D), "high": (_B, _D), "lam": (_B, _D),
+     "alpha": (_B, _D), "loc": (_B, _D), "scale": (_B, _D)},
+    # rank-4 NCHW (conv/pool/spatial families)
+    {"data": (2, 4, 8, 8), "x": (2, 4, 8, 8), "a": (2, 4, 8, 8),
+     "b": (2, 4, 8, 8), "lhs": (2, 4, 8, 8), "rhs": (2, 4, 8, 8),
+     "data1": (2, 4, 8, 8), "data2": (2, 4, 8, 8),
+     "shape_like": (2, 4, 8, 8), "like": (2, 4, 8, 8),
+     "condition": (2, 4, 8, 8), "weight": (8, 4, 3, 3)},
+    # rank-3 (sequence/batched-matmul families)
+    {"data": (2, _B, _D), "x": (2, _B, _D), "a": (2, 8, 8),
+     "b": (2, 8, 8), "lhs": (2, 8, 8), "rhs": (2, 8, 8),
+     "data1": (2, _B, _D), "data2": (2, _B, _D),
+     "shape_like": (2, _B, _D), "like": (2, _B, _D)},
+    # square rank-2 (dot/linalg/contract families)
+    {"data": (_D, _D), "x": (_D, _D), "a": (_D, _D), "b": (_D, _D),
+     "lhs": (_D, _D), "rhs": (_D, _D), "data1": (_D, _D),
+     "data2": (_D, _D)},
+    # rank-3 HWC (host image ops)
+    {"data": (16, 16, 3), "x": (16, 16, 3)},
+)
+_INT_TENSORS = {"indices", "index", "label"}
+
+
+def _mk(name, shape, dtype="float32", lo=0.5, hi=1.5, seed=0):
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+    if dtype.startswith("int"):
+        return mx.nd.array(rng.randint(int(lo), int(hi), shape)
+                           .astype(dtype))
+    return mx.nd.array(rng.uniform(lo, hi, shape).astype(dtype))
+
+
+# hand specs for ops whose input contracts the generic rules can't
+# infer (shape coupling between inputs, packed encodings, special
+# dtypes). Everything else is auto-generated.
+_OP_OVERRIDES = {
+    # layout NTC: pred (batch, seq, alphabet); label (batch, max_len)
+    "CTCLoss": lambda: ([_mk("p", (4, 10, 8)),
+                         _mk("l", (4, 2), "int32", 1, 7)], {}),
+    "MultiBoxTarget": lambda: ([_mk("a", (1, 16, 4), lo=0.0, hi=1.0),
+                                _mk("l", (2, 2, 5), lo=0.1, hi=0.5),
+                                _mk("c", (2, 2, 16))], {}),
+    # default scales x ratios = 12 anchors: cls 2*12 ch, bbox 4*12 ch
+    "Proposal": lambda: ([_mk("c", (1, 24, 8, 8)),
+                          _mk("b", (1, 48, 8, 8), lo=-0.1, hi=0.1),
+                          _mk("i", (1, 3), lo=8, hi=9)], {}),
+    "MultiProposal": lambda: ([_mk("c", (1, 24, 8, 8)),
+                               _mk("b", (1, 48, 8, 8), lo=-0.1, hi=0.1),
+                               _mk("i", (1, 3), lo=8, hi=9)], {}),
+    "GridGenerator": lambda: ([_mk("d", (2, 6))],
+                              {"transform_type": "affine",
+                               "target_shape": (4, 4)}),
+    "SpatialTransformer": lambda: ([_mk("d", (2, 4, 8, 8)),
+                                    _mk("l", (2, 6))],
+                                   {"transform_type": "affine",
+                                    "target_shape": (4, 4)}),
+    "DeformableConvolution": lambda: (
+        [_mk("d", (2, 4, 8, 8)), _mk("o", (2, 18, 8, 8), lo=-1, hi=1),
+         _mk("w", (8, 4, 3, 3))],
+        {"kernel": (3, 3), "num_filter": 8, "pad": (1, 1),
+         "no_bias": True}),
+    "Deconvolution": lambda: ([_mk("d", (2, 4, 8, 8)),
+                               _mk("w", (4, 8, 3, 3))],
+                              {"kernel": (3, 3), "num_filter": 8,
+                               "no_bias": True}),
+    "Pad": lambda: ([_mk("d", (2, 4, 8, 8))],
+                    {"mode": "constant",
+                     "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+    "Reshape": lambda: ([_mk("d", (_B, _D))], {"shape": (_D, _B)}),
+    "broadcast_to": lambda: ([_mk("d", (1, _D))], {"shape": (_B, _D)}),
+    "cast_storage": lambda: ([_mk("d", (_B, _D))], {"stype": "default"}),
+    "RNN": lambda: ([_mk("d", (5, 2, 8)), _mk("p", (4096,), lo=-0.1,
+                                              hi=0.1),
+                     _mk("s", (1, 2, 8))],
+                    {"state_size": 8, "num_layers": 1,
+                     "mode": "rnn_tanh"}),
+    "gather_nd": lambda: ([_mk("d", (_B, _D)),
+                           _mk("i", (2, 4), "int32", 0, 7)], {}),
+    "scatter_nd": lambda: ([_mk("d", (4,)),
+                            _mk("i", (1, 4), "int32", 0, 7)],
+                           {"shape": (_B,)}),
+    "_scatter_set_nd": lambda: ([_mk("d", (_B,)), _mk("v", (4,)),
+                                 _mk("i", (1, 4), "int32", 0, 7)],
+                                {"shape": (_B,)}),
+    "choose_element_0index": lambda: ([_mk("d", (_B, _D)),
+                                       _mk("i", (_B,), "int32", 0,
+                                           _D - 1)], {}),
+    "fill_element_0index": lambda: ([_mk("d", (_B, _D)),
+                                     _mk("v", (_B,)),
+                                     _mk("i", (_B,), "int32", 0,
+                                         _D - 1)], {}),
+    "_unravel_index": lambda: ([_mk("i", (_B,), "int32", 0, 63)],
+                               {"shape": (8, 8)}),
+    "_linalg_maketrian": lambda: ([_mk("d", (2, 36))], {}),
+    "_contrib_quantized_conv": lambda: (
+        [_mk("d", (2, 4, 8, 8), "int8", -127, 127),
+         _mk("w", (8, 4, 3, 3), "int8", -127, 127),
+         _mk("bz", (8,), "int8", -127, 127),
+         _mk("mn", (1,), lo=-1, hi=-0.9), _mk("mx", (1,), lo=0.9, hi=1),
+         _mk("wmn", (1,), lo=-1, hi=-0.9),
+         _mk("wmx", (1,), lo=0.9, hi=1),
+         _mk("bmn", (1,), lo=-1, hi=-0.9),
+         _mk("bmx", (1,), lo=0.9, hi=1)],
+        {"kernel": (3, 3), "num_filter": 8, "no_bias": True}),
+    "_contrib_quantized_concat": lambda: (
+        [_mk("a", (_B, _D), "int8", -127, 127),
+         _mk("b", (_B, _D), "int8", -127, 127),
+         _mk("amn", (1,), lo=-1, hi=-0.9), _mk("amx", (1,), lo=0.9, hi=1),
+         _mk("bmn", (1,), lo=-1, hi=-0.9),
+         _mk("bmx", (1,), lo=0.9, hi=1)],
+        {"num_args": 2, "dim": 1}),
+    "_contrib_calibrate_entropy": lambda: (
+        [_mk("h", (64,), lo=0, hi=100),
+         _mk("e", (65,), lo=-1, hi=1)], {"num_quantized_bins": 16}),
+    "bernoulli": lambda: ([_mk("p", (_B, _D), lo=0.1, hi=0.9)], {}),
+    "negative": lambda: ([_mk("x", (_B, _D))], {}),
+    "_contrib_hawkesll": lambda: (
+        [_mk("mu", (2, 3), lo=0.1, hi=0.5),
+         _mk("al", (3,), lo=0.1, hi=0.4),
+         _mk("be", (3,), lo=0.5, hi=1.0),
+         _mk("st", (2, 3), lo=0.5, hi=1.0),
+         _mk("lags", (2, 5), lo=0.01, hi=0.2),
+         _mk("marks", (2, 5), "int32", 0, 2),
+         _mk("vl", (2,), "int32", 4, 5),
+         _mk("maxt", (2,), lo=2.0, hi=3.0)], {}),
+}
+
+# values for REQUIRED static params, by name (optional params keep their
+# defaults)
+_STATIC_DEFAULTS = {
+    "kernel": (3, 3), "num_filter": 8, "num_hidden": _D,
+    "shape": (_B * _D,), "axis": 0, "axes": None, "dim": 0,
+    "depth": 16, "reps": (2, 2), "size": 2, "k": 1, "begin": 0, "end": 4,
+    "scalar": 2.0, "p": 0.5, "num_outputs": 2, "num_args": 2,
+    "pooled_size": 2, "output_dim": 4, "spatial_scale": 1.0,
+    "group_size": 2, "rhs_begin": 0, "rhs_end": 1, "lhs_begin": 0,
+    "lhs_end": 1, "num_group": 1, "eps": 1e-5, "dtype": "float32",
+    "src_dtype": "float32", "target_dtype": "float32",
+    "sample_ratio": 1, "state_size": _D, "num_layers": 1, "mode": "rnn_tanh",
+    "act_type": "relu", "transform_type": "affine", "target_shape": (4, 4),
+    "min_calib_range": -1.0, "max_calib_range": 1.0, "nms_threshold": 0.5,
+    "overlap_threshold": 0.5, "n": 2, "num_sampled": 4, "range_max": 16,
+    "slice_mode": "center",
+}
+
+
+def _make_tensor(name, seed, profile):
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+    shape = profile.get(name) or _SHARED_SHAPES.get(name) or (_B, _D)
+    if name in _INT_TENSORS:
+        return mx.nd.array(rng.randint(0, 4, shape).astype("int32"))
+    return mx.nd.array(rng.uniform(0.5, 1.5, shape).astype("float32"))
+
+
+def auto_spec(opdef, profile):
+    """Synthesize (args, kwargs) for an op from its fn signature using
+    one shape profile, or raise ValueError naming what could not be
+    synthesized. Rule: every leading required parameter that is not a
+    known static is a tensor input (the registry convention the symbol
+    wrappers also rely on)."""
+    import inspect
+    sig = inspect.signature(opdef.fn)
+    args = []
+    kwargs = {}
+    in_input_prefix = True
+    seed = 0
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            # variadic ops get two tensors
+            args.extend([_make_tensor("data", 0, profile),
+                         _make_tensor("data", 1, profile)])
+            in_input_prefix = False
+            continue
+        if p.kind == inspect.Parameter.VAR_KEYWORD:
+            continue
+        if p.name in ("key", "_training", "out", "name"):
+            continue
+        required = p.default is inspect.Parameter.empty
+        if in_input_prefix and required and \
+                p.name not in _STATIC_DEFAULTS:
+            args.append(_make_tensor(p.name, seed, profile))
+            seed += 1
+            continue
+        in_input_prefix = False
+        if not required:
+            continue  # optional static: keep the default
+        if p.name in _STATIC_DEFAULTS:
+            v = _STATIC_DEFAULTS[p.name]
+            if v is not None:
+                kwargs[p.name] = v
+            continue
+        raise ValueError("no synthesis rule for required param %r"
+                         % p.name)
+    if not args and "shape" not in kwargs:
+        # creation ops (zeros/arange/samplers) run tensor-free if they
+        # accept a shape
+        if "shape" in sig.parameters:
+            kwargs["shape"] = (_B, _D)
+        else:
+            raise ValueError("op takes no tensor inputs")
+    return args, kwargs
+
+
+def _bench_callable(fn, runs, warmup):
+    """Per-call synchronous timing: every iteration blocks until ready,
+    so no async pipelining can hide (or fabricate) dispatch cost. This
+    is a HOST-side microbench harness — on a remote-tunnel TPU attach,
+    per-call sync includes tunnel RTT and inflates small ops; run the
+    full sweep on CPU (CI) or a locally attached device."""
+    import jax
+
+    def _ready(out):
+        leaves = out if isinstance(out, (tuple, list)) else [out]
+        jax.block_until_ready([getattr(o, "_data", o) for o in leaves
+                               if o is not None])
+
+    for _ in range(max(warmup, 1)):
+        _ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        _ready(fn())
+    return (time.perf_counter() - t0) / runs * 1e3
+
+
+def bench_registry_op(name, opdef, runs=5, warmup=1):
+    """Benchmark one registry op with auto inputs: the mx.nd dispatch
+    path AND the jnp-native baseline (calling the registered pure fn on
+    raw jax arrays — the lower bound the dispatch layer adds overhead
+    to). Input shapes come from the first profile the op accepts."""
+    import inspect
+    import jax
+    import mxnet_tpu as mx
+
+    fn = getattr(mx.nd, name, None)
+    if fn is None:
+        raise ValueError("not exposed on mx.nd")
+    args = kwargs = None
+    last_err = None
+    if name in _OP_OVERRIDES:
+        args, kwargs = _OP_OVERRIDES[name]()
+    else:
+        for profile in _PROFILES:
+            try:
+                cand_args, cand_kwargs = auto_spec(opdef, profile)
+                fn(*cand_args, **cand_kwargs)  # dry run, this profile
+                args, kwargs = cand_args, cand_kwargs
+                break
+            except Exception as e:  # noqa: BLE001 — next rank profile
+                last_err = e
+        if args is None:
+            # creation ops whose params all default (arange/eye/window
+            # fns/samplers): run argument-free
+            try:
+                fn()
+                args, kwargs = [], {}
+            except Exception:  # noqa: BLE001
+                raise last_err
+    nd_ms = _bench_callable(lambda: fn(*args, **kwargs), runs, warmup)
+
+    # jnp-native baseline: the raw registered function
+    raw = [getattr(a, "_data", a) for a in args]
+    sig = inspect.signature(opdef.fn)
+    extra = {}
+    if "key" in sig.parameters:
+        extra["key"] = jax.random.PRNGKey(0)
+    if "_training" in sig.parameters:
+        extra["_training"] = False
+    base_ms = _bench_callable(
+        lambda: opdef.fn(*raw, **kwargs, **extra), runs, warmup)
+    return {"op": name, "fwd_ms": round(nd_ms, 4),
+            "jnp_native_ms": round(base_ms, 4),
+            "dispatch_overhead_ms": round(nd_ms - base_ms, 4)}
+
+
+def run_full_registry(runs=5, warmup=1, verbose=False, ops=None):
+    """One command over EVERY registered op name (aliases share their
+    canonical OpDef's measurement; `ops` filters to a subset by any
+    registered name). Forward-path timing only. Returns the summary
+    dict that --full emits as JSON."""
+    from mxnet_tpu.ops import registry as _registry
+
+    names = _registry.list_ops()
+    canonical = {}
+    for n in names:
+        opdef = _registry.get_op(n)
+        # canonical = any registered name with a hand spec, else the
+        # first seen — so _OP_OVERRIDES keys match regardless of how
+        # alias names sort
+        if n in _OP_OVERRIDES or id(opdef) not in canonical:
+            canonical[id(opdef)] = n
+
+    if ops:
+        wanted = {id(_registry.get_op(n)) for n in ops}
+        canonical = {k: v for k, v in canonical.items() if k in wanted}
+
+    results, errors = {}, {}
+    for _oid, cname in sorted(canonical.items(), key=lambda kv: kv[1]):
+        opdef = _registry.get_op(cname)
+        try:
+            results[cname] = bench_registry_op(cname, opdef, runs, warmup)
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            errors[cname] = "%s: %s" % (type(e).__name__, str(e)[:100])
+        if verbose:
+            status = "ok" if cname in results else "ERR"
+            print("%-40s %s" % (cname, status), file=sys.stderr)
+
+    ok = sorted(results.values(), key=lambda r: -r["fwd_ms"])
+    return {
+        "registry_names": len(names),
+        "unique_ops": len(canonical),
+        "measured": len(results),
+        "errors": len(errors),
+        "coverage_pct": round(100.0 * len(results)
+                              / max(len(canonical), 1), 1),
+        "top10_slowest": ok[:10],
+        "results": results,
+        "error_detail": errors,
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="op micro-benchmarks (ref: benchmark/opperf)")
@@ -194,8 +552,31 @@ def main(argv=None):
     parser.add_argument("--runs", type=int, default=20)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--no-backward", action="store_true")
+    parser.add_argument("--full", action="store_true",
+                        help="sweep EVERY registered op with "
+                             "auto-generated inputs (small shapes)")
+    parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--json", default=None, help="write results here")
     args = parser.parse_args(argv)
+    if args.full:
+        ops = args.ops.split(",") if args.ops else None
+        summary = run_full_registry(runs=max(1, args.runs // 4),
+                                    warmup=args.warmup,
+                                    verbose=args.verbose, ops=ops)
+        print("registry names: %d (unique ops %d), measured %d, "
+              "errors %d -> %.1f%% coverage (forward-path timing)"
+              % (summary["registry_names"], summary["unique_ops"],
+                 summary["measured"], summary["errors"],
+                 summary["coverage_pct"]))
+        print("%-36s %10s %14s" % ("10 slowest", "fwd (ms)",
+                                   "jnp-native (ms)"))
+        for r in summary["top10_slowest"]:
+            print("%-36s %10.4f %14.4f" % (r["op"], r["fwd_ms"],
+                                           r["jnp_native_ms"]))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(summary, f, indent=2)
+        return 0
     ops = args.ops.split(",") if args.ops else None
     results = run_performance_test(ops, args.warmup, args.runs,
                                    not args.no_backward)

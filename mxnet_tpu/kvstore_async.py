@@ -60,6 +60,9 @@ _OP_PUSH_RSP = 9
 _OP_PULL_RSP = 10
 _OP_PUSH_2BIT = 11
 _OP_PROFILER = 12
+_OP_HEARTBEAT = 13
+_OP_DEADNODES = 14
+_OP_SHAPE = 15
 
 # response opcodes
 _RE_OK = 0x10
@@ -150,6 +153,7 @@ class AsyncPSServer:
         self._store = {}
         self._updater = None
         self._lock = threading.Lock()
+        self._heartbeats = {}  # rank -> monotonic time of last beat
         if _ps_secret() is None:
             # same-host workers inherit this via the environment; the
             # launcher passes MXTPU_* through for remote ranks
@@ -255,8 +259,14 @@ class AsyncPSServer:
                 n = self.updates_applied
             _send_frame(conn, struct.pack(">Bq", _RE_INT, n))
         elif op == _OP_DONE:
+            # done may carry the finishing rank: a clean finalize
+            # DEREGISTERS the node (ps-lite Finalize), so it never shows
+            # up as dead — only crashed workers go stale
             with self._lock:
                 self.workers_done += 1
+                if len(buf) >= off + 8:
+                    (rank,) = struct.unpack_from(">q", buf, off)
+                    self._heartbeats.pop(int(rank), None)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_WAIT_DONE:
             n, timeout = struct.unpack_from(">qd", buf, off)
@@ -272,11 +282,125 @@ class AsyncPSServer:
                     break
                 _t.sleep(0.02)
             _send_frame(conn, struct.pack(">Bq", _RE_INT, reached))
+        elif op == _OP_PUSH_RSP:
+            # row-sparse push: only touched rows cross the wire
+            # (ref: kvstore_dist.h:522 EncodeRowSparseKey)
+            key, off = _unpack_key(buf, off)
+            rows_idx, off = _unpack_arr(buf, off)
+            rows_val, off = _unpack_arr(buf, off)
+            with self._lock:
+                dense = self._store[key]
+                ids = rows_idx.astype(np.int64)
+                if self._updater is not None:
+                    # reference row-sparse semantics: the update runs on
+                    # the TOUCHED ROWS only — wd/momentum must not leak
+                    # onto untouched rows (kvstore_dist_server.h sparse
+                    # DataHandleEx)
+                    self._apply_rows(key, ids, rows_val)
+                else:
+                    dense[ids] = rows_val
+                self.updates_applied += 1
+            _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_PULL_RSP:
+            # pull only the requested rows (row_sparse_pull semantics)
+            key, off = _unpack_key(buf, off)
+            rows_idx, off = _unpack_arr(buf, off)
+            with self._lock:
+                rows = np.array(
+                    self._store[key][rows_idx.astype(np.int64)],
+                    copy=True)
+            _send_frame(conn, bytes([_RE_ARR]) + _pack_arr(rows))
+        elif op == _OP_PUSH_2BIT:
+            # 2-bit quantized push: int32 words + (n, threshold) header;
+            # the server dequantizes and applies (ref:
+            # gradient_compression.h:38 — async now matches the sync
+            # path's wire optimization)
+            key, off = _unpack_key(buf, off)
+            n, thr = struct.unpack_from(">qd", buf, off)
+            off += 16
+            words, off = _unpack_arr(buf, off)
+            from .pallas_kernels.compression import dequantize_2bit_jnp
+            import jax.numpy as jnp
+            grad = np.asarray(dequantize_2bit_jnp(
+                jnp.asarray(words), int(n), float(thr)))
+            with self._lock:
+                grad = grad.reshape(self._store[key].shape)
+                if self._updater is not None:
+                    self._apply(key, grad)
+                else:
+                    self._store[key] = grad.copy()
+                self.updates_applied += 1
+            _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_SHAPE:
+            key, off = _unpack_key(buf, off)
+            with self._lock:
+                shp = np.asarray(self._store[key].shape, np.int64)
+            _send_frame(conn, bytes([_RE_ARR]) + _pack_arr(shp))
+        elif op == _OP_HEARTBEAT:
+            (rank,) = struct.unpack_from(">q", buf, off)
+            import time as _t
+            with self._lock:
+                self._heartbeats[int(rank)] = _t.monotonic()
+            _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_DEADNODES:
+            # ranks whose heartbeat is older than `timeout` seconds
+            # (ref: ps-lite GetDeadNodes, kvstore_dist.h:121)
+            (timeout,) = struct.unpack_from(">d", buf, off)
+            import time as _t
+            now = _t.monotonic()
+            with self._lock:
+                dead = sorted(r for r, t in self._heartbeats.items()
+                              if now - t > timeout)
+            arr = np.asarray(dead, np.int64)
+            _send_frame(conn, bytes([_RE_ARR]) + _pack_arr(arr))
+        elif op == _OP_PROFILER:
+            # profiler command channel (ref: KVStoreServerProfilerCommand
+            # include/mxnet/kvstore.h:49; exercised by the reference's
+            # tests/nightly/test_server_profiling.py)
+            (n,) = struct.unpack_from(">H", buf, off)
+            off += 2
+            cmd = buf[off:off + n].decode()
+            off += n
+            (m,) = struct.unpack_from(">H", buf, off)
+            off += 2
+            body = buf[off:off + m].decode()
+            self._profiler_command(cmd, body)
+            _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_STOP:
             _send_frame(conn, bytes([_RE_OK]))
             self._stop.set()
         else:
             raise ValueError("unknown opcode %d" % op)
+
+    @staticmethod
+    def _profiler_command(cmd, body):
+        """Run a profiler command on the SERVER process (the reference
+        forwards SetConfig/State/Pause/Dump enums to each server)."""
+        from . import profiler
+        if cmd == "set_config":
+            kwargs = {}
+            for part in body.split(","):
+                if "=" in part:
+                    k, v = part.split("=", 1)
+                    kwargs[k.strip()] = (v.strip() == "True"
+                                         if v.strip() in ("True", "False")
+                                         else v.strip())
+            profiler.set_config(**kwargs)
+        elif cmd == "state":
+            profiler.set_state(body or "run")
+        elif cmd == "dump":
+            profiler.dump()
+        else:
+            raise ValueError("unknown profiler command %r" % cmd)
+
+    def _apply_rows(self, key, ids, grad_rows):
+        import mxnet_tpu as mx
+        from .kvstore import _str_key_int
+        w = mx.nd.array(self._store[key][ids])
+        g = mx.nd.array(grad_rows)
+        self._updater(key if isinstance(key, int) else _str_key_int(key),
+                      g, w)
+        self._store[key][ids] = w.asnumpy()
 
     def _apply(self, key, grad):
         import mxnet_tpu as mx
@@ -310,6 +434,33 @@ class AsyncPSClient:
                     raise
                 time.sleep(0.1)  # server still coming up on rank 0
         self._lock = threading.Lock()
+        self.bytes_pushed = 0  # wire accounting (sparse/compressed tests)
+        self._hb_stop = None
+
+    def start_heartbeat(self, rank, interval=0.5):
+        """Background liveness beats (ref: ps-lite heartbeats feeding
+        GetDeadNodes). Returns immediately; stop with stop_heartbeat."""
+        if self._hb_stop is not None:
+            return
+        import time
+        self._hb_stop = threading.Event()
+
+        def run():
+            while not self._hb_stop.is_set():
+                try:
+                    self.heartbeat(rank)
+                except (ConnectionError, OSError, RuntimeError):
+                    return
+                self._hb_stop.wait(interval)
+
+        self._hb_thread = threading.Thread(target=run, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(timeout=5)
+            self._hb_stop = None
 
     def _call(self, payload):
         with self._lock:
@@ -335,11 +486,53 @@ class AsyncPSClient:
                    + _pack_arr(np.asarray(arr)))
 
     def push(self, key, grad):
-        self._call(bytes([_OP_PUSH]) + _pack_key(key)
-                   + _pack_arr(np.asarray(grad)))
+        payload = bytes([_OP_PUSH]) + _pack_key(key) \
+            + _pack_arr(np.asarray(grad))
+        self.bytes_pushed += len(payload)
+        self._call(payload)
+
+    def push_row_sparse(self, key, row_ids, rows):
+        """Sparse wire: only (row_ids, rows) cross — bytes scale with
+        touched rows, not the dense shape."""
+        payload = bytes([_OP_PUSH_RSP]) + _pack_key(key) \
+            + _pack_arr(np.asarray(row_ids, np.int64)) \
+            + _pack_arr(np.asarray(rows))
+        self.bytes_pushed += len(payload)
+        self._call(payload)
+
+    def push_compressed(self, key, words, n, threshold):
+        payload = bytes([_OP_PUSH_2BIT]) + _pack_key(key) \
+            + struct.pack(">qd", int(n), float(threshold)) \
+            + _pack_arr(np.asarray(words, np.int32))
+        self.bytes_pushed += len(payload)
+        self._call(payload)
 
     def pull(self, key):
         return self._call(bytes([_OP_PULL]) + _pack_key(key))
+
+    def pull_row_sparse(self, key, row_ids):
+        return self._call(bytes([_OP_PULL_RSP]) + _pack_key(key)
+                          + _pack_arr(np.asarray(row_ids, np.int64)))
+
+    def shape_of(self, key):
+        """Dense shape of a stored key WITHOUT transferring the value
+        (row_sparse_pull needs it; a full pull would defeat the sparse
+        wire)."""
+        arr = self._call(bytes([_OP_SHAPE]) + _pack_key(key))
+        return tuple(int(d) for d in arr)
+
+    def heartbeat(self, rank):
+        self._call(struct.pack(">Bq", _OP_HEARTBEAT, int(rank)))
+
+    def dead_nodes(self, timeout=3.0):
+        arr = self._call(struct.pack(">Bd", _OP_DEADNODES,
+                                     float(timeout)))
+        return [int(r) for r in arr]
+
+    def profiler_command(self, cmd, body=""):
+        c, b = cmd.encode(), body.encode()
+        self._call(bytes([_OP_PROFILER]) + struct.pack(">H", len(c)) + c
+                   + struct.pack(">H", len(b)) + b)
 
     def set_optimizer(self, optimizer):
         secret = _ps_secret()
@@ -355,8 +548,11 @@ class AsyncPSClient:
     def updates_applied(self):
         return self._call(bytes([_OP_STATS]))
 
-    def done(self):
-        self._call(bytes([_OP_DONE]))
+    def done(self, rank=None):
+        payload = bytes([_OP_DONE])
+        if rank is not None:
+            payload += struct.pack(">q", int(rank))
+        self._call(payload)
 
     def wait_done(self, n, timeout=None):
         """Wait until `n` workers called done(); returns True if they
@@ -394,6 +590,13 @@ class AsyncKVStore:
         self._server, self._client = serve_if_rank0(rank)
         self._optimizer = None
         self._done_sent = False
+        self._compression = None
+        self._compression_bound = int(os.environ.get(
+            "MXNET_KVSTORE_SIZE_LOWER_BOUND", "4096"))
+        self._residuals = {}
+        # liveness beats feed the server's dead-node tracking
+        self._client.start_heartbeat(rank, interval=float(
+            os.environ.get("MXTPU_PS_HEARTBEAT_INTERVAL", "0.5")))
 
     # identity
     @property
@@ -417,11 +620,38 @@ class AsyncKVStore:
 
     def push(self, key, value, priority=0):
         from .kvstore import _ctype_key_value
+        from .ndarray.sparse import RowSparseNDArray
         import mxnet_tpu.ndarray as nd
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
-            self._client.push(k, merged.asnumpy())
+            if isinstance(merged, RowSparseNDArray):
+                # lazy .indices/.values (private slots are None for a
+                # RowSparseNDArray built from dense)
+                self._client.push_row_sparse(
+                    k, merged.indices.asnumpy(),
+                    merged.data.asnumpy())
+            elif self._compression is not None \
+                    and merged.size >= self._compression_bound:
+                self._push_compressed(k, merged)
+            else:
+                self._client.push(k, merged.asnumpy())
+
+    def _push_compressed(self, key, grad):
+        """2-bit quantize with per-key error-feedback residual; only
+        the int32 words cross the TCP wire (16x smaller than fp32) —
+        the async path now has the sync path's wire optimization."""
+        import jax.numpy as jnp
+        from .pallas_kernels.compression import quantize_2bit_jnp
+        thr = self._compression["threshold"]
+        flat = jnp.asarray(grad.asnumpy().ravel(), jnp.float32)
+        res = self._residuals.get(key)
+        if res is None or res.shape != flat.shape:
+            res = jnp.zeros_like(flat)
+        words, new_res = quantize_2bit_jnp(flat, res, thr)
+        self._residuals[key] = new_res
+        self._client.push_compressed(key, np.asarray(words), flat.shape[0],
+                                     thr)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         from .kvstore import _ctype_key_value
@@ -456,10 +686,25 @@ class AsyncKVStore:
     # the rest of the KVStore surface callers touch (Module/Trainer) —
     # same contracts as kvstore.py
     def set_gradient_compression(self, compression_params):
-        raise NotImplementedError(
-            "gradient compression over the async PS transport is not "
-            "implemented; use dist_sync for compressed pushes "
-            "(ref: gradient_compression.h applies to the sync path)")
+        """2-bit gradient compression on the async TCP wire
+        (ref: src/kvstore/gradient_compression.h:38 — the reference
+        applies it on the dist wire; async now matches the sync path).
+        Pushes of arrays >= MXNET_KVSTORE_BIGARRAY_BOUND elements send
+        int32 words with a client-side error-feedback residual."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype not in ("none", "2bit"):
+            raise ValueError("Unsupported compression type %r" % ctype)
+        if ctype == "none":
+            self._compression = None
+            return
+        self._compression = {
+            "type": "2bit",
+            "threshold": float(compression_params.get("threshold", 0.5)),
+        }
+        # same gating source as the sync path (kvstore.py)
+        self._compression_bound = int(compression_params.get(
+            "size_lower_bound",
+            os.environ.get("MXNET_KVSTORE_SIZE_LOWER_BOUND", 4096)))
 
     def set_updater(self, updater):
         raise NotImplementedError(
@@ -479,9 +724,45 @@ class AsyncKVStore:
             self.set_optimizer(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        raise NotImplementedError(
-            "row_sparse_pull over the async PS is not implemented; "
-            "use dist_sync (kvstore.py row_sparse_pull)")
+        """Pull only the requested rows over the wire
+        (ref: kvstore.py row_sparse_pull / kvstore_dist.h:522)."""
+        from .kvstore import _ctype_key_value
+        from .ndarray import NDArray
+        from .ndarray.sparse import RowSparseNDArray, row_sparse_array
+        import jax.numpy as jnp
+        assert out is not None and row_ids is not None
+        keys, outs = _ctype_key_value(key, out)
+        if not isinstance(row_ids, list):
+            row_ids = [row_ids] * len(keys)
+        for k, olist, rids in zip(keys, outs, row_ids):
+            ids = np.asarray(rids.asnumpy()
+                             if isinstance(rids, NDArray) else rids,
+                             np.int64)
+            rows = self._client.pull_row_sparse(k, ids)
+            full_shape = self._client.shape_of(k)  # cheap shape query
+            for o in olist:
+                if isinstance(o, RowSparseNDArray):
+                    new = row_sparse_array((rows, ids), shape=full_shape)
+                    o._indices = new._indices
+                    o._values = new._values
+                    o._data = new._data
+                else:
+                    dense = np.zeros(full_shape, rows.dtype)
+                    dense[ids] = rows
+                    o._data = jnp.asarray(dense)
+        return out
+
+    def get_dead_nodes(self, timeout=3.0):
+        """Ranks whose heartbeat went stale (ref: ps-lite GetDeadNodes,
+        kvstore_dist.h:121). A restarted worker resumes beating and
+        drops off this list (is_recovery semantics)."""
+        return self._client.dead_nodes(timeout)
+
+    def set_server_profiler_command(self, cmd, body=""):
+        """Forward a profiler command to the PS server process
+        (ref: KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49):
+        cmd in {'set_config', 'state', 'dump'}."""
+        self._client.profiler_command(cmd, body)
 
     def updates_applied(self):
         return self._client.updates_applied()
@@ -491,11 +772,13 @@ class AsyncKVStore:
         shutdown — the reference's Postoffice barrier-before-exit)."""
         if not self._done_sent:
             self._done_sent = True
-            self._client.done()
+            self._client.stop_heartbeat()
+            self._client.done(self._rank)
 
     def close(self):
         # Count our own rank as done so a Trainer/Module exit that never
         # called done() explicitly doesn't stall waiting for itself.
+        self._client.stop_heartbeat()
         self.done()
         if self._server is not None:
             self._client.wait_done(self._num_workers)

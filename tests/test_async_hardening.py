@@ -1,0 +1,209 @@
+"""Async PS hardening (VERDICT r2 item 9): row-sparse + 2-bit
+compressed pushes on the async wire, heartbeats/dead-node query,
+profiler command channel, and a multiprocess dead-worker restart.
+
+ref: src/kvstore/kvstore_dist.h:522 (EncodeRowSparseKey), :121
+(GetDeadNodes), gradient_compression.h:38,
+include/mxnet/kvstore.h:49 (KVStoreServerProfilerCommand).
+"""
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore_async import AsyncPSServer, AsyncPSClient
+
+
+@pytest.fixture()
+def server():
+    srv = AsyncPSServer()
+    yield srv
+    srv.stop()
+
+
+class TestSparseWire:
+    def test_row_sparse_push_touches_only_rows(self, server):
+        c = AsyncPSClient("127.0.0.1", server.port)
+        w = np.ones((16, 4), np.float32)
+        c.init(1, w)
+        before = c.bytes_pushed
+        c.push_row_sparse(1, [2, 5], np.full((2, 4), 9.0, np.float32))
+        sparse_bytes = c.bytes_pushed - before
+        out = c.pull(1)
+        np.testing.assert_allclose(out[2], 9.0)
+        np.testing.assert_allclose(out[5], 9.0)
+        np.testing.assert_allclose(out[0], 1.0)  # untouched rows intact
+        # wire cost scales with touched rows, not the dense shape
+        c.push(1, w)
+        dense_bytes = c.bytes_pushed - before - sparse_bytes
+        assert sparse_bytes < dense_bytes / 2
+
+    def test_row_sparse_push_through_optimizer(self, server):
+        import mxnet_tpu.optimizer as opt
+        c = AsyncPSClient("127.0.0.1", server.port)
+        c.init(3, np.ones((8, 2), np.float32))
+        c.set_optimizer(opt.create("sgd", learning_rate=0.5, wd=0.0))
+        c.push_row_sparse(3, [1], np.ones((1, 2), np.float32))
+        out = c.pull(3)
+        np.testing.assert_allclose(out[1], 0.5)   # 1 - 0.5*1
+        np.testing.assert_allclose(out[0], 1.0)   # zero grad elsewhere
+
+    def test_pull_row_sparse(self, server):
+        c = AsyncPSClient("127.0.0.1", server.port)
+        c.init(4, np.arange(12, dtype=np.float32).reshape(6, 2))
+        rows = c.pull_row_sparse(4, [0, 5])
+        np.testing.assert_allclose(rows, [[0, 1], [10, 11]])
+
+
+class TestCompressedWire:
+    def test_2bit_push_dequantizes_server_side(self, server):
+        c = AsyncPSClient("127.0.0.1", server.port)
+        from mxnet_tpu.pallas_kernels.compression import quantize_2bit_jnp
+        import jax.numpy as jnp
+        n = 64
+        c.init(7, np.zeros((n,), np.float32))
+        grad = np.full((n,), 1.0, np.float32)
+        words, _res = quantize_2bit_jnp(jnp.asarray(grad),
+                                        jnp.zeros(n), 0.5)
+        before = c.bytes_pushed
+        c.push_compressed(7, np.asarray(words), n, 0.5)
+        wire = c.bytes_pushed - before
+        assert wire < n * 4 / 2   # int32 words: 16x fewer than values
+        out = c.pull(7)
+        np.testing.assert_allclose(out, 0.5)  # store-replace semantics
+
+    def test_kvstore_facade_compression_with_residual(self, tmp_path):
+        os.environ["MXTPU_PROC_ID"] = "0"
+        os.environ["MXTPU_NUM_PROCS"] = "1"
+        os.environ["MXTPU_ASYNC_PS_PORT"] = "0"
+        os.environ.pop("MXTPU_COORDINATOR", None)
+        import mxnet_tpu.optimizer as opt
+        kv = mx.kv.create("dist_async")
+        try:
+            kv.set_gradient_compression({"type": "2bit",
+                                         "threshold": 0.5,
+                                         "size_lower_bound": 1024})
+            kv.set_optimizer(opt.create("sgd", learning_rate=1.0,
+                                        wd=0.0))
+            n = 2048  # >= size_lower_bound -> compressed path
+            w = mx.nd.array(np.zeros((n,), np.float32))
+            kv.init(9, w)
+            g = mx.nd.array(np.full((n,), 0.3, np.float32))
+            before = kv._client.bytes_pushed
+            kv.push(9, g)      # 0.3 < thr: residual only, no step
+            kv.push(9, g)      # residual 0.6 >= thr: quantized step
+            wire = kv._client.bytes_pushed - before
+            assert wire < 2 * n * 4 / 4   # both pushes compressed
+            out = mx.nd.array(np.zeros((n,), np.float32))
+            kv.pull(9, out=out)
+            np.testing.assert_allclose(out.asnumpy(), -0.5, atol=1e-6)
+        finally:
+            kv.close()
+
+
+class TestLiveness:
+    def test_heartbeat_dead_node_and_recovery(self, server):
+        a = AsyncPSClient("127.0.0.1", server.port)
+        b = AsyncPSClient("127.0.0.1", server.port)
+        a.start_heartbeat(0, interval=0.1)
+        b.start_heartbeat(1, interval=0.1)
+        time.sleep(0.4)
+        assert a.dead_nodes(timeout=1.0) == []
+        b.stop_heartbeat()           # rank 1 "dies"
+        time.sleep(1.2)
+        assert a.dead_nodes(timeout=1.0) == [1]
+        # restarted worker resumes beating under the same rank
+        b2 = AsyncPSClient("127.0.0.1", server.port)
+        b2.start_heartbeat(1, interval=0.1)
+        time.sleep(0.4)
+        assert a.dead_nodes(timeout=1.0) == []
+        a.stop_heartbeat()
+        b2.stop_heartbeat()
+
+
+class TestProfilerChannel:
+    def test_server_profiler_command_dump(self, server, tmp_path):
+        c = AsyncPSClient("127.0.0.1", server.port)
+        out = str(tmp_path / "server_profile.json")
+        c.profiler_command("set_config", "filename=%s" % out)
+        c.profiler_command("state", "run")
+        c.push(11, np.ones((4,), np.float32)) \
+            if c.init(11, np.ones((4,), np.float32)) is None else None
+        c.profiler_command("state", "stop")
+        c.profiler_command("dump", "")
+        assert os.path.exists(out)
+
+    def test_unknown_command_errors(self, server):
+        c = AsyncPSClient("127.0.0.1", server.port)
+        with pytest.raises(RuntimeError, match="profiler command"):
+            c.profiler_command("explode", "")
+
+
+def _hardening_worker(rank, nproc, port_env_val, die_before_done):
+    os.environ["MXTPU_PROC_ID"] = str(rank)
+    os.environ["MXTPU_NUM_PROCS"] = str(nproc)
+    os.environ["MXTPU_ASYNC_PS_PORT"] = port_env_val
+    os.environ["MXTPU_PS_HEARTBEAT_INTERVAL"] = "0.1"
+    import mxnet_tpu as mx2
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+    kv = mx2.kv.create("dist_async")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5,
+                                 "size_lower_bound": 1024})
+    kv.init(1, mx2.nd.array(np.zeros((16, 4), np.float32)))
+    kv.init(2, mx2.nd.array(np.zeros((2048,), np.float32)))
+    # sparse push
+    rs = row_sparse_array((np.full((1, 4), 1.0, np.float32),
+                           np.array([rank])), shape=(16, 4))
+    kv.push(1, rs)
+    # compressed push (over the bigarray bound)
+    kv.push(2, mx2.nd.array(np.full((2048,), 0.6, np.float32)))
+    if die_before_done:
+        kv._client.stop_heartbeat()
+        os._exit(0)  # crash without done() — the dead worker
+    kv.close()
+
+
+class TestMultiprocessRestart:
+    def test_sparse_compressed_and_dead_worker_restart(self):
+        os.environ.pop("MXTPU_COORDINATOR", None)
+        os.environ["MXTPU_PROC_ID"] = "0"
+        os.environ["MXTPU_NUM_PROCS"] = "3"
+        os.environ["MXTPU_ASYNC_PS_PORT"] = "0"
+        os.environ["MXTPU_PS_HEARTBEAT_INTERVAL"] = "0.1"
+        os.environ["MXTPU_PS_DONE_TIMEOUT"] = "30"
+        kv = mx.kv.create("dist_async")
+        try:
+            port = os.environ["MXTPU_ASYNC_PS_PORT"]
+            # spawn (not fork): the parent already runs jax + server
+            # threads, and forking that deadlocks in the child
+            ctx = mp.get_context("spawn")
+            # worker 1 completes; worker 2 dies before done()
+            w1 = ctx.Process(target=_hardening_worker,
+                             args=(1, 3, port, False))
+            w2 = ctx.Process(target=_hardening_worker,
+                             args=(2, 3, port, True))
+            w1.start()
+            w2.start()
+            w1.join(90)
+            w2.join(90)
+            assert w1.exitcode == 0 and w2.exitcode == 0
+            time.sleep(1.5)
+            dead = kv.get_dead_nodes(timeout=1.0)
+            assert 2 in dead and 1 not in dead, dead
+            # restart the dead rank; it finishes the protocol
+            w2b = ctx.Process(target=_hardening_worker,
+                              args=(2, 3, port, False))
+            w2b.start()
+            w2b.join(90)
+            assert w2b.exitcode == 0
+            time.sleep(0.5)
+            # both sparse rows landed (ranks 1 and 2 each touched row)
+            out = mx.nd.array(np.zeros((16, 4), np.float32))
+            kv.pull(1, out=out)
+            v = out.asnumpy()
+            assert v[1].sum() > 0 and v[2].sum() > 0
+        finally:
+            kv.close()

@@ -115,6 +115,36 @@ def infer_shape(sym, *args, partial=False, **kwargs):
             for iname, (src, oi) in zip(input_names, node.inputs):
                 if shapes.get((id(src), oi)) is None and iname in hints:
                     shapes[(id(src), oi)] = tuple(hints[iname])
+        if node.attrs.get("__fused_json__") and any(
+                shapes.get((id(src), oi)) is None
+                for src, oi in node.inputs):
+            # fused subgraph node with unknown inputs: deduce them by
+            # running inference on the INNER region graph
+            # (ref: subgraph FInferShape runs the inner graph's pass).
+            # __fused_json__ is specific to fusion nodes, so this can
+            # never collide with control-flow's __subgraph__/_cf_cache.
+            if isinstance(node._cf_cache, tuple):
+                sub_sym, sub_inputs = node._cf_cache
+            else:
+                from .symbol import load_json as _load_json
+                sub_sym = _load_json(node.attrs["__fused_json__"])
+                sub_inputs = list(node.attrs["__fused_inputs__"])
+                node._cf_cache = (sub_sym, sub_inputs)
+            known_inner = {}
+            for iname, (src, oi) in zip(sub_inputs, node.inputs):
+                si = shapes.get((id(src), oi))
+                if si is not None:
+                    known_inner[iname] = si
+            try:
+                arg_sh, _o, _a = infer_shape(sub_sym, partial=True,
+                                             **known_inner)
+                by_name = dict(zip(sub_sym.list_arguments(), arg_sh))
+            except Exception:  # noqa: BLE001 — fall through to eval
+                by_name = {}
+            for iname, (src, oi) in zip(sub_inputs, node.inputs):
+                if shapes.get((id(src), oi)) is None \
+                        and by_name.get(iname) is not None:
+                    shapes[(id(src), oi)] = tuple(by_name[iname])
         # now try abstract eval
         ins = [shapes.get((id(src), oi)) for src, oi in node.inputs]
         if any(s is None for s in ins):

@@ -264,6 +264,26 @@ from . import image    # noqa: E402,F401
 # in addition to mx.nd.sparse.cast_storage)
 cast_storage = sparse.cast_storage
 sparse_retain = sparse.retain
+
+# sparse-aware dot: CSR operands take the device-native kernel
+# (ref: dot-inl.h DotCsrDnsDns); dense operands keep the registry path
+_dense_dot = globals()["dot"]
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None, **kw):
+    from .sparse import CSRNDArray
+    if isinstance(lhs, CSRNDArray) or isinstance(rhs, CSRNDArray):
+        if kw:
+            raise TypeError("unsupported kwargs for sparse dot: %s"
+                            % sorted(kw))
+        res = sparse.dot(lhs, rhs, transpose_a=transpose_a,
+                         transpose_b=transpose_b)
+        if out is not None:
+            out._data = res._data
+            return out
+        return res
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b, out=out, **kw)
 from . import contrib  # noqa: E402,F401
 
 # fused optimizer update ops with the reference's in-place calling

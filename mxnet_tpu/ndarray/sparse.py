@@ -113,16 +113,14 @@ class CSRNDArray(NDArray):
     def _materialize(self):
         if self._indptr is None:
             dense = self.asnumpy()
-            indptr = [0]
-            indices, values = [], []
-            for row in dense:
-                nz = _np.nonzero(row)[0]
-                indices.extend(nz.tolist())
-                values.extend(row[nz].tolist())
-                indptr.append(len(indices))
-            self._indptr = array(_np.asarray(indptr, _np.int64))
-            self._indices = array(_np.asarray(indices, _np.int64))
-            self._values = array(_np.asarray(values, dense.dtype))
+            # vectorized extraction (a per-row Python loop would cost
+            # minutes on realistically sized matrices)
+            rows, cols = _np.nonzero(dense)
+            counts = _np.bincount(rows, minlength=dense.shape[0])
+            indptr = _np.concatenate([[0], _np.cumsum(counts)])
+            self._indptr = array(indptr.astype(_np.int64))
+            self._indices = array(cols.astype(_np.int64))
+            self._values = array(dense[rows, cols])
 
     def tostype(self, stype):
         if stype == "csr":
@@ -201,3 +199,87 @@ def retain(data, indices):
     if not isinstance(data, RowSparseNDArray):
         raise TypeError("retain expects a RowSparseNDArray")
     return data.retain(indices)
+
+
+def _csr_rowids(indptr, nnz):
+    """Row id of each stored element, from the CSR indptr — device-side
+    (searchsorted over the monotonically increasing indptr)."""
+    return jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+
+
+def dot_csr_dense(values, col_indices, indptr, dense, num_rows,
+                  transpose_lhs=False):
+    """Device-native sparse-dense matmul on the CSR components — the
+    O(nnz * n) kernel, no densification
+    (ref: src/operator/tensor/dot-inl.h DotCsrDnsDns / DotCsrTransDnsDns).
+
+    values [nnz], col_indices [nnz], indptr [m+1], dense [k, n].
+    Returns [m, n] (or [k_cols, n] for transpose_lhs, where the CSR is
+    contracted along its rows). Pure jnp: differentiable w.r.t. values
+    and dense, jit/TPU-compatible (gather + segment_sum lower to XLA
+    scatter-add)."""
+    import jax
+    nnz = values.shape[0]
+    row_ids = _csr_rowids(indptr, nnz)
+    cols = col_indices.astype(jnp.int32)
+    if transpose_lhs:
+        # out[c, :] += v_j * dense[row_j, :]  — contract over csr rows
+        contrib = values[:, None] * dense[row_ids]
+        return jax.ops.segment_sum(contrib, cols, num_segments=num_rows)
+    # out[r, :] += v_j * dense[col_j, :]
+    contrib = values[:, None] * dense[cols]
+    return jax.ops.segment_sum(contrib, row_ids,
+                               num_segments=num_rows)
+
+
+from ..ops.registry import register as _register_op
+
+
+@_register_op("_sparse_dot_csr_dense", num_inputs=2)
+def _sparse_dot_csr_op(values, dense, col_indices=None, indptr=None,
+                       num_rows=None, transpose_lhs=False,
+                       swap_dense=False):
+    """Registry seam for the CSR kernel: `values` and `dense` are the
+    differentiable NDArray inputs (so autograd RECORDS the op and
+    gradients flow to sparse values and dense weights); the integer
+    CSR structure rides as static kwargs."""
+    d = jnp.swapaxes(dense, -1, -2) if swap_dense else dense
+    out = dot_csr_dense(values, col_indices, indptr, d, num_rows,
+                        transpose_lhs=transpose_lhs)
+    return jnp.swapaxes(out, -1, -2) if swap_dense else out
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """mx.nd.sparse.dot (ref: python/mxnet/ndarray/sparse.py dot,
+    src/operator/tensor/dot.cc): CSR x dense (and transposes) run the
+    device-native kernel above — autograd-recorded, so sparse feature
+    matrices train; anything else falls back to the dense registry op."""
+    from .register import invoke_by_name as _invoke
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, CSRNDArray):
+        if transpose_b:
+            raise NotImplementedError(
+                "dot(csr, dense, transpose_b=True) is unsupported "
+                "(matches the reference's dot.cc storage dispatch)")
+        m, k = lhs.shape
+        out_rows = k if transpose_a else m
+        return _invoke("_sparse_dot_csr_dense", lhs.data, rhs,
+                       col_indices=lhs.indices._data,
+                       indptr=lhs.indptr._data, num_rows=out_rows,
+                       transpose_lhs=transpose_a)
+    if isinstance(rhs, CSRNDArray) and not isinstance(lhs, CSRNDArray):
+        # dot(dense, csr) = dot(csr^T, dense^T)^T (2-D)
+        if transpose_a:
+            raise NotImplementedError(
+                "dot(dense, csr, transpose_a=True) is unsupported")
+        m, k = rhs.shape
+        out_rows = m if transpose_b else k
+        return _invoke("_sparse_dot_csr_dense", rhs.data, lhs,
+                       col_indices=rhs.indices._data,
+                       indptr=rhs.indptr._data, num_rows=out_rows,
+                       transpose_lhs=not transpose_b, swap_dense=True)
+    # dense x dense (or csr x csr, which densifies like the reference's
+    # fallback storage path): the dense registry op, recorded as usual
+    a = lhs.tostype("default") if isinstance(lhs, CSRNDArray) else lhs
+    b = rhs.tostype("default") if isinstance(rhs, CSRNDArray) else rhs
+    return _invoke("dot", a, b, transpose_a=transpose_a,
+                   transpose_b=transpose_b)

@@ -381,22 +381,71 @@ def load(fname):
         return load_json(f.read())
 
 
+def _parse_attr_value(v):
+    """Attr values come in three dialects: this framework's tojson
+    (JSON-encoded), the reference 1.x dmlc strings ("(3, 3)", "False",
+    "64"), and plain strings ("relu"). Try them in that order
+    (ref: src/nnvm/legacy_json_util.cc does the same normalization)."""
+    if not isinstance(v, str):
+        return v
+    try:
+        return json.loads(v)
+    except (ValueError, TypeError):
+        pass
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
 def load_json(json_str):
+    """Parse a symbol JSON — this framework's own output, the
+    reference's 1.x format (`attrs`, 3-tuple inputs, mxnet_version
+    attr), or the pre-1.0 legacy format (`param` + `attr` per node,
+    2-tuple inputs; ref: src/nnvm/legacy_json_util.cc UpgradeJSON_*).
+    Compat is proven against fixture files emitted by real MXNet
+    (tests/fixtures/ref_mxnet_*_symbol.json)."""
     g = json.loads(json_str)
     nodes = []
     for jn in g["nodes"]:
-        attrs = {k: json.loads(v) if isinstance(v, str) else v
-                 for k, v in jn.get("attrs", {}).items()}
+        raw = dict(jn.get("attrs") or jn.get("param") or {})
+        attrs = {k: _parse_attr_value(v) for k, v in raw.items()}
+        # legacy per-node metadata (ctx_group/lr_mult/wd_mult...) rides
+        # in "attr"; keep it out of kernel kwargs via the __-prefix
+        for k, v in (jn.get("attr") or {}).items():
+            attrs.setdefault("__%s__" % k, v)
         if jn["op"] == "null":
             node = _Node(None, jn["name"], attrs)
         else:
             node = _Node(jn["op"], jn["name"], attrs)
         nodes.append(node)
     for jn, node in zip(g["nodes"], nodes):
-        node.inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
+        node.inputs = [(nodes[e[0]], e[1]) for e in jn["inputs"]]
         if not node.is_variable():
             node.num_outputs = _num_outputs_of(node)
-    return Symbol([(nodes[i], oi) for i, oi, _ in g["heads"]])
+            if node.op in ("BatchNorm", "batch_norm") \
+                    and len(node.inputs) == 3:
+                # pre-1.0 BatchNorm had implicit moving stats; the
+                # reference's JSON upgrade adds the aux inputs
+                # (ref: src/nnvm/legacy_json_util.cc UpgradeJSON_000800)
+                for suffix in ("moving_mean", "moving_var"):
+                    aux = _Node(None, "%s_%s" % (node.name, suffix))
+                    node.inputs.append((aux, 0))
+            if "__input_names__" not in node.attrs:
+                # reference JSON carries no input-name metadata; recover
+                # it from the op signature so parameter-shape hinting
+                # works on loaded graphs (ref: nnvm op FListInputNames)
+                from .register import op_input_names
+                from ..ops import registry as _registry
+                try:
+                    names = op_input_names(_registry.get_op(node.op))
+                except KeyError:
+                    names = None
+                if names and len(names) >= len(node.inputs):
+                    node.attrs["__input_names__"] = \
+                        list(names[:len(node.inputs)])
+    return Symbol([(nodes[e[0]], e[1]) for e in g["heads"]])
 
 
 def _num_outputs_of(node):

@@ -1,0 +1,18 @@
+#include <cstdio>
+#include <vector>
+#include "mxnet_tpu-cpp/MxNetCpp.h"
+using namespace mxnet_tpu::cpp;
+int main() {
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("label");
+  Symbol fc1 = op::FullyConnected("fc1", {data}, {{"num_hidden", "16"}});
+  Symbol a1 = op::Activation("a1", {fc1}, {{"act_type", "relu"}});
+  Symbol fc2 = op::FullyConnected("fc2", {a1}, {{"num_hidden", "4"}});
+  Symbol net = op::SoftmaxOutput("sm", {fc2, label});
+  Executor ex = net.SimpleBind({{"data", {2, 8}}, {"label", {2}}});
+  ex.Forward(false);
+  auto out = ex.Outputs()[0].ToVector();
+  double s = 0; for (float v : out) s += v;
+  printf("op.h wrappers OK, prob sum %.3f\n", s);
+  return (s > 1.9 && s < 2.1) ? 0 : 1;
+}

@@ -9,6 +9,7 @@
 #include "optimizer.hpp"
 #include "kvstore.hpp"
 #include "io.hpp"
+#include "op.h"
 #include "metric.hpp"
 #include "initializer.hpp"
 #include "lr_scheduler.hpp"

@@ -28,6 +28,10 @@ int MXTNDArrayGetDType(void* h, int* dtype);
 int MXTNDArraySyncCopyToCPU(void* h, void* data, size_t nbytes);
 int MXTNDArraySyncCopyFromCPU(void* h, const void* data, size_t nbytes);
 int MXTNDArrayCopyFrom(void* dst, void* src);
+int MXTNDArrayReshape(void* h, uint32_t ndim, const int64_t* dims,
+                      void** out);
+int MXTNDArraySlice(void* h, int64_t begin, int64_t end, void** out);
+int MXTNDArrayAt(void* h, int64_t idx, void** out);
 int MXTNDArrayWaitAll();
 int MXTNDArraySave(const char* fname, uint32_t n, void** handles,
                    const char** names);
@@ -42,6 +46,12 @@ int MXTAutogradMarkVariables(uint32_t n, void** h);
 int MXTAutogradSetIsRecording(int rec);
 int MXTAutogradBackward(uint32_t n, void** out);
 int MXTNDArrayGetGrad(void* h, void** grad);
+int MXTAutogradIsRecording(int* out);
+int MXTAutogradIsTraining(int* out);
+int MXTAutogradSetIsTraining(int train_mode);
+int MXTProfileSetConfig(uint32_t n, const char** keys, const char** vals);
+int MXTProfileSetState(int state);
+int MXTProfileDump();
 
 int MXTSymbolCreateFromJSON(const char* json, void** out);
 int MXTSymbolCreateFromFile(const char* path, void** out);
@@ -63,6 +73,13 @@ int MXTSymbolInferShape(void* sym, uint32_t nprov, const char** names,
                         uint32_t* argc, uint32_t* outc, uint32_t* auxc,
                         const uint32_t** all_ndims,
                         const int64_t** all_dims);
+int MXTSymbolGetAttr(void* sym, const char* key, const char** out,
+                     int* success);
+int MXTSymbolSetAttr(void* sym, const char* key, const char* value);
+int MXTSymbolListAttr(void* sym, const char** out_json);
+int MXTSymbolGetInternals(void* sym, void** out);
+int MXTSymbolGetOutput(void* sym, uint32_t index, void** out);
+int MXTSymbolCopy(void* sym, void** out);
 int MXTSymbolFree(void* sym);
 
 int MXTExecutorSimpleBind(void* sym, uint32_t nprov, const char** names,

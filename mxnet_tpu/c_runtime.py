@@ -402,3 +402,86 @@ def list_all_ops():
 def load_lib(path):
     from . import lib_api
     lib_api.load(path)
+
+
+# -- NDArray views (ref: MXNDArrayReshape/Slice/At c_api.h) -----------------
+
+def nd_reshape(arr, shape):
+    return arr.reshape(tuple(int(d) for d in shape))
+
+
+def nd_slice(arr, begin, end):
+    # the slice op takes per-axis tuples (ref: slice-inl.h SliceParam)
+    return arr.slice((int(begin),), (int(end),))
+
+
+def nd_at(arr, idx):
+    return arr[int(idx)]
+
+
+# -- autograd flags (ref: MXAutogradIsRecording/IsTraining/SetIsTraining) ---
+
+def autograd_is_recording():
+    return 1 if autograd.is_recording() else 0
+
+
+def autograd_is_training():
+    return 1 if autograd.is_training() else 0
+
+
+def autograd_set_training(flag):
+    autograd.set_training(bool(flag))
+
+
+# -- profiler controls (ref: MXSetProcessProfilerConfig/State, MXDumpProfile)
+
+def profiler_set_config(keys, vals):
+    from . import profiler
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        kwargs[k] = _parse(v)
+    profiler.set_config(**kwargs)
+
+
+def profiler_set_state(state):
+    from . import profiler
+    profiler.set_state("run" if int(state) else "stop")
+
+
+def profiler_dump():
+    from . import profiler
+    profiler.dump()
+
+
+# -- Symbol attributes / views (ref: MXSymbolGetAttr/SetAttr/ListAttr,
+#    MXSymbolGetInternals/GetOutput c_api.h) --------------------------------
+
+def symbol_attr(sym, key):
+    v = sym.attr(key)
+    # None = missing; any string (even "") = present — the C side maps
+    # this onto the (out, success) pair like the reference
+    return None if v is None else str(v)
+
+
+def symbol_set_attr(sym, key, val):
+    # store the RAW string (ref: MXSymbolSetAttr keeps values verbatim;
+    # a parse/re-stringify round trip would mutate "1.50" -> "1.5")
+    sym._set_attr(**{key: val})
+
+
+def symbol_attr_json(sym):
+    import json as _json
+    return _json.dumps(sym.attr_dict)
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_output(sym, index):
+    return sym[int(index)]
+
+
+def symbol_copy(sym):
+    import copy as _copy
+    return _copy.deepcopy(sym)

@@ -706,6 +706,188 @@ int MXTNDArraySyncCopyFromCPU(void* handle, const void* data,
   return ReturnOk(res, "MXTNDArraySyncCopyFromCPU");
 }
 
+// -- NDArray views (ref: MXNDArrayReshape/Slice/At c_api.h) -----------------
+
+int MXTNDArrayReshape(void* handle, uint32_t ndim, const int64_t* dims,
+                      void** out) {
+  Gil gil;
+  PyObject* shp = PyList_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyList_SET_ITEM(shp, i, PyLong_FromLongLong(dims[i]));
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(handle),
+                                 shp);
+  PyObject* res = CallRt("nd_reshape", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTNDArrayReshape");
+}
+
+int MXTNDArraySlice(void* handle, int64_t begin, int64_t end, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OLL)", static_cast<PyObject*>(handle),
+                                 static_cast<long long>(begin),
+                                 static_cast<long long>(end));
+  PyObject* res = CallRt("nd_slice", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTNDArraySlice");
+}
+
+int MXTNDArrayAt(void* handle, int64_t idx, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OL)", static_cast<PyObject*>(handle),
+                                 static_cast<long long>(idx));
+  PyObject* res = CallRt("nd_at", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTNDArrayAt");
+}
+
+// -- autograd flags (ref: MXAutogradIsRecording/IsTraining/SetIsTraining) ---
+
+int MXTAutogradIsRecording(int* out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("autograd_is_recording", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTAutogradIsRecording");
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTAutogradIsTraining(int* out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("autograd_is_training", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTAutogradIsTraining");
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTAutogradSetIsTraining(int train_mode) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", train_mode);
+  PyObject* res = CallRt("autograd_set_training", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTAutogradSetIsTraining");
+}
+
+// -- profiler (ref: MXSetProcessProfilerConfig/State, MXDumpProfile) --------
+
+int MXTProfileSetConfig(uint32_t num_params, const char** keys,
+                        const char** vals) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(NN)", StrList(keys, num_params),
+                                 StrList(vals, num_params));
+  PyObject* res = CallRt("profiler_set_config", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfileSetConfig");
+}
+
+int MXTProfileSetState(int state) {  // 0 = stop, 1 = run
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", state);
+  PyObject* res = CallRt("profiler_set_state", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfileSetState");
+}
+
+int MXTProfileDump() {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("profiler_dump", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfileDump");
+}
+
+// -- Symbol attrs / views (ref: MXSymbolGetAttr/SetAttr/ListAttr,
+//    MXSymbolGetInternals/GetOutput, MXSymbolCopy) --------------------------
+
+int MXTSymbolGetAttr(void* sym, const char* key, const char** out,
+                     int* success) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym), key);
+  PyObject* res = CallRt("symbol_attr", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolGetAttr");
+  if (res == Py_None) {  // attr missing (an empty string is PRESENT)
+    Py_DECREF(res);
+    ret_store.str.clear();
+    *out = ret_store.str.c_str();
+    *success = 0;
+    return 0;
+  }
+  const char* c = PyUnicode_AsUTF8(res);
+  if (c == nullptr) {
+    Py_DECREF(res);
+    return PyFail("MXTSymbolGetAttr");
+  }
+  ret_store.str = c;
+  Py_DECREF(res);
+  *success = 1;
+  *out = ret_store.str.c_str();
+  return 0;
+}
+
+int MXTSymbolSetAttr(void* sym, const char* key, const char* value) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oss)", static_cast<PyObject*>(sym),
+                                 key, value);
+  PyObject* res = CallRt("symbol_set_attr", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTSymbolSetAttr");
+}
+
+// JSON object {node: {key: value}} — one call instead of the
+// reference's paired size/array outputs.
+int MXTSymbolListAttr(void* sym, const char** out_json) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_attr_json", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolListAttr");
+  const char* c = PyUnicode_AsUTF8(res);
+  if (c == nullptr) {
+    Py_DECREF(res);
+    return PyFail("MXTSymbolListAttr");
+  }
+  ret_store.str = c;
+  *out_json = ret_store.str.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTSymbolGetInternals(void* sym, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_get_internals", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolGetInternals");
+}
+
+int MXTSymbolGetOutput(void* sym, uint32_t index, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(sym),
+                                 index);
+  PyObject* res = CallRt("symbol_get_output", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolGetOutput");
+}
+
+int MXTSymbolCopy(void* sym, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_copy", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolCopy");
+}
+
 // Device-side value copy dst <- src (no host round trip; ref:
 // MXNDArraySyncCopyFromNDArray).
 int MXTNDArrayCopyFrom(void* dst, void* src) {

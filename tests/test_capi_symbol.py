@@ -413,3 +413,105 @@ def test_op_h_is_current():
     assert not missing, "op.h is stale; regenerate. Missing: %s" \
         % missing[:10]
     assert not stale, "op.h has wrappers for removed ops: %s" % stale[:10]
+
+
+class TestRound3Additions:
+    """Views, autograd flags, profiler controls, symbol attrs
+    (ref: MXNDArrayReshape/Slice/At, MXAutogradIsRecording/IsTraining,
+    MXSetProcessProfilerConfig/State + MXDumpProfile,
+    MXSymbolGetAttr/SetAttr/ListAttr/GetInternals/GetOutput/Copy)."""
+
+    def test_ndarray_views(self, lib):
+        vp = ctypes.c_void_p
+        lib.MXTNDArrayReshape.argtypes = [vp, ctypes.c_uint32,
+                                          ctypes.POINTER(ctypes.c_int64),
+                                          ctypes.POINTER(vp)]
+        lib.MXTNDArraySlice.argtypes = [vp, ctypes.c_int64,
+                                        ctypes.c_int64,
+                                        ctypes.POINTER(vp)]
+        lib.MXTNDArrayAt.argtypes = [vp, ctypes.c_int64,
+                                     ctypes.POINTER(vp)]
+        a = _nd_from(lib, onp.arange(12, dtype="float32").reshape(3, 4))
+        r = ctypes.c_void_p()
+        dims = (ctypes.c_int64 * 2)(4, 3)
+        _ck(lib, lib.MXTNDArrayReshape(a, 2, dims, ctypes.byref(r)))
+        onp.testing.assert_allclose(
+            _to_np(lib, r, (4, 3)).ravel(), onp.arange(12))
+        s = ctypes.c_void_p()
+        _ck(lib, lib.MXTNDArraySlice(a, 1, 3, ctypes.byref(s)))
+        onp.testing.assert_allclose(_to_np(lib, s, (2, 4))[0, 0], 4.0)
+        at = ctypes.c_void_p()
+        _ck(lib, lib.MXTNDArrayAt(a, 2, ctypes.byref(at)))
+        onp.testing.assert_allclose(_to_np(lib, at, (4,))[0], 8.0)
+        for h in (a, r, s, at):
+            lib.MXTNDArrayFree(h)
+
+    def test_autograd_flags(self, lib):
+        rec = ctypes.c_int(-1)
+        _ck(lib, lib.MXTAutogradIsRecording(ctypes.byref(rec)))
+        assert rec.value == 0
+        _ck(lib, lib.MXTAutogradSetIsTraining(1))
+        tr = ctypes.c_int(-1)
+        _ck(lib, lib.MXTAutogradIsTraining(ctypes.byref(tr)))
+        assert tr.value == 1
+        _ck(lib, lib.MXTAutogradSetIsTraining(0))
+
+    def test_profiler_controls(self, lib, tmp_path):
+        ccp = ctypes.POINTER(ctypes.c_char_p)
+        out = str(tmp_path / "c_profile.json")
+        keys = (ctypes.c_char_p * 1)(b"filename")
+        vals = (ctypes.c_char_p * 1)(out.encode())
+        _ck(lib, lib.MXTProfileSetConfig(1, keys, vals))
+        _ck(lib, lib.MXTProfileSetState(1))
+        h = _nd_from(lib, onp.ones((2, 2), "float32"))
+        lib.MXTNDArrayFree(h)
+        _ck(lib, lib.MXTProfileSetState(0))
+        _ck(lib, lib.MXTProfileDump())
+        assert os.path.exists(out)
+
+    def test_symbol_attrs_and_views(self, lib):
+        vp = ctypes.c_void_p
+        ccp = ctypes.POINTER(ctypes.c_char_p)
+        lib.MXTSymbolGetAttr.argtypes = [vp, ctypes.c_char_p, ccp,
+                                         ctypes.POINTER(ctypes.c_int)]
+        lib.MXTSymbolSetAttr.argtypes = [vp, ctypes.c_char_p,
+                                         ctypes.c_char_p]
+        lib.MXTSymbolListAttr.argtypes = [vp, ccp]
+        lib.MXTSymbolGetInternals.argtypes = [vp, ctypes.POINTER(vp)]
+        lib.MXTSymbolGetOutput.argtypes = [vp, ctypes.c_uint32,
+                                           ctypes.POINTER(vp)]
+        lib.MXTSymbolCopy.argtypes = [vp, ctypes.POINTER(vp)]
+        h = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolCreateFromJSON(
+            _mlp_symbol().tojson().encode(), ctypes.byref(h)))
+        _ck(lib, lib.MXTSymbolSetAttr(h, b"lr_mult", b"2.0"))
+        out = ctypes.c_char_p()
+        ok = ctypes.c_int()
+        _ck(lib, lib.MXTSymbolGetAttr(h, b"lr_mult", ctypes.byref(out),
+                                      ctypes.byref(ok)))
+        assert ok.value == 1 and out.value == b"2.0"
+        # empty string is PRESENT; a missing key is success=0
+        _ck(lib, lib.MXTSymbolSetAttr(h, b"note", b""))
+        _ck(lib, lib.MXTSymbolGetAttr(h, b"note", ctypes.byref(out),
+                                      ctypes.byref(ok)))
+        assert ok.value == 1 and out.value == b""
+        _ck(lib, lib.MXTSymbolGetAttr(h, b"nope", ctypes.byref(out),
+                                      ctypes.byref(ok)))
+        assert ok.value == 0
+        attrs_json = ctypes.c_char_p()
+        _ck(lib, lib.MXTSymbolListAttr(h, ctypes.byref(attrs_json)))
+        import json as _json
+        assert isinstance(_json.loads(attrs_json.value.decode()), dict)
+        internals = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolGetInternals(h, ctypes.byref(internals)))
+        n = ctypes.c_uint32()
+        names = ccp()
+        _ck(lib, lib.MXTSymbolListOutputs(internals, ctypes.byref(n),
+                                          ctypes.byref(names)))
+        assert n.value > 1  # every internal node is an output
+        out0 = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolGetOutput(h, 0, ctypes.byref(out0)))
+        cp = ctypes.c_void_p()
+        _ck(lib, lib.MXTSymbolCopy(h, ctypes.byref(cp)))
+        for x in (h, internals, out0, cp):
+            lib.MXTSymbolFree(x)

@@ -25,7 +25,10 @@ def test_full_registry_coverage(summary):
     """Every registry name is swept; every unique op measures (errors
     would mean the auto-input synthesis regressed)."""
     from mxnet_tpu.ops import registry as r
-    assert summary["registry_names"] == len(r.list_ops())
+    # other test modules may have registered graph-local pseudo-ops
+    # (fused subgraph regions, plugin test ops) before this runs
+    assert summary["registry_names"] \
+        == len(r.list_ops()) - summary["skipped_pseudo_ops"]
     assert summary["registry_names"] >= 460
     assert summary["errors"] == 0, summary["error_detail"]
     assert summary["coverage_pct"] == 100.0

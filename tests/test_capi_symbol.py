@@ -14,6 +14,7 @@ import ctypes
 import os
 import shutil
 import subprocess
+import sys
 
 import numpy as onp
 import pytest
@@ -391,8 +392,12 @@ def test_generated_op_h_compiles_and_runs(tmp_path):
 
 
 def test_op_h_is_current():
-    """The checked-in generated header matches the live registry BOTH
-    ways (run cpp-package/scripts/gen_op_h.py after op changes)."""
+    """The checked-in generated header matches the registry BOTH ways
+    (run cpp-package/scripts/gen_op_h.py after op changes). The
+    expected set is computed in a FRESH interpreter — the in-process
+    registry may carry ops other test modules registered dynamically
+    (plugins, fused subgraph regions), which the generator never
+    sees."""
     import importlib.util
     import re
     spec = importlib.util.spec_from_file_location(
@@ -403,11 +408,16 @@ def test_op_h_is_current():
     hdr = open(os.path.join(REPO, "cpp-package", "include",
                             "mxnet_tpu-cpp", "op.h")).read()
     declared = set(re.findall(r'Symbol::CreateOp\("([^"]+)"', hdr))
-    from mxnet_tpu.ops import registry as r
-    # the test uses the generator's own emit criterion, so the two can
-    # never disagree about which names belong in the header
-    expected = {n for n in r.list_ops()
-                if gen._cpp_name(n) is not None}
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-c",
+         "from mxnet_tpu.ops import registry as r;"
+         "print('\\n'.join(r.list_ops()))"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    clean_names = res.stdout.split()
+    expected = {n for n in clean_names if gen._cpp_name(n) is not None}
     missing = sorted(expected - declared)
     stale = sorted(declared - expected)
     assert not missing, "op.h is stale; regenerate. Missing: %s" \

@@ -12,9 +12,10 @@ Prints one JSON line:
    "transformer": {"tokens_per_sec": N, "model_tflops_per_sec": N, ...}}
 
 The transformer sub-benchmark is the modern capability headline the 2019
-reference lacks: a 2.4B-param decoder LM (dim 4096, seq 2048, bf16, Pallas
-flash attention fwd+bwd, per-layer remat). Dim sweep measured on one
-v5e chip (docs/PARITY.md): dim 1024 -> 34 TF/s, 2048 -> 70, 4096 -> 111.
+reference lacks: a 1.6B-param decoder LM (dim 4096, 5 layers, seq 2048,
+batch 6, bf16, Pallas flash attention fwd+bwd, selective remat + chunked
+CE). Dim sweep measured on one v5e chip: dim 1024 -> 34 TF/s model-flops,
+2048 -> 70, 4096 -> 111 (full remat) -> 122.6 with round-3 tuning.
 
 BENCH_MODEL=resnet50|transformer runs just one of the two.
 """
@@ -37,16 +38,17 @@ def bench_transformer():
 
     platform = jax.devices()[0].platform
     big = platform != "cpu"
-    B = int(os.environ.get("BENCH_BATCH", 4 if big else 2))
+    B = int(os.environ.get("BENCH_BATCH", 6 if big else 2))
     S = int(os.environ.get("BENCH_SEQ", 2048 if big else 128))
     # dim 4096 is the MFU sweet spot on one chip (111 TF/s model-flops
-    # measured vs 70 at dim 2048, 34 at 1024); the 2.4B params + Adam-free
-    # SGD state fit in 16G HBM at batch 4
+    # at full remat vs 70 at dim 2048, 34 at 1024; dim 5120 measured
+    # WORSE at 58.8%); params+momentum+grads are the HBM floor
     dim = int(os.environ.get("BENCH_DIM", 4096 if big else 64))
-    # 6 layers (1.87B params): trades 2 layers of param/momentum/grad
-    # state for the ffn_prod selective-remat buffer — measured r3 best
-    # (118.3 TF/s, 60.0% MFU vs 111.1/56.4% for 8 layers + full remat)
-    layers = int(os.environ.get("BENCH_LAYERS", 6 if big else 2))
+    # 5 layers (1.6B params) at batch 6: trades layer state for the
+    # ffn_prod selective-remat buffer + a fuller chip — measured r3
+    # best (122.4 TF/s, 62.1% MFU; vs 118.6/60.2% at L6/B4 and
+    # 111.1/56.4% for 8 layers + full remat; B8 overflows HBM by 104MB)
+    layers = int(os.environ.get("BENCH_LAYERS", 5 if big else 2))
     cfg = T.TransformerConfig(
         vocab_size=32000 if big else 256,
         dim=dim, n_layers=layers,
@@ -59,9 +61,10 @@ def bench_transformer():
                                        8 if big else 1)),
         # selective remat: keep these intermediates in HBM instead of
         # recomputing them in backward (TransformerConfig.remat_save).
-        # ffn_prod skips recomputing the two FFN up-projections; fits
-        # at 6 layers (attn_o is not worth saving: flash bwd recomputes
-        # its fwd for the lse residual regardless)
+        # ffn_prod skips recomputing the two FFN up-projections and
+        # fits at the 5-layer/batch-6 default (attn_o is not worth
+        # saving: flash bwd recomputes its fwd for the lse residual
+        # regardless)
         remat_save=tuple(n for n in os.environ.get(
             "BENCH_REMAT_SAVE", "ffn_prod" if big else "").split(",")
             if n))

@@ -13,9 +13,11 @@ Prints one JSON line:
 
 The transformer sub-benchmark is the modern capability headline the 2019
 reference lacks: a 1.6B-param decoder LM (dim 4096, 5 layers, seq 2048,
-batch 6, bf16, Pallas flash attention fwd+bwd, selective remat + chunked
-CE). Dim sweep measured on one v5e chip: dim 1024 -> 34 TF/s model-flops,
-2048 -> 70, 4096 -> 111 (full remat) -> 122.6 with round-3 tuning.
+batch 12, bf16, Pallas flash attention fwd+bwd, chunked CE, full
+per-layer remat). Measured on one v5e chip: dim sweep 34/70/111 TF/s
+model-flops at dim 1024/2048/4096 (r2 config) -> 123.3 with round-3
+tuning (layer/batch sweep + chunked CE; selective remat via
+BENCH_REMAT_SAVE=ffn_prod measures ~equal at batch 6).
 
 BENCH_MODEL=resnet50|transformer runs just one of the two.
 """
@@ -38,16 +40,17 @@ def bench_transformer():
 
     platform = jax.devices()[0].platform
     big = platform != "cpu"
-    B = int(os.environ.get("BENCH_BATCH", 6 if big else 2))
+    B = int(os.environ.get("BENCH_BATCH", 12 if big else 2))
     S = int(os.environ.get("BENCH_SEQ", 2048 if big else 128))
     # dim 4096 is the MFU sweet spot on one chip (111 TF/s model-flops
     # at full remat vs 70 at dim 2048, 34 at 1024; dim 5120 measured
     # WORSE at 58.8%); params+momentum+grads are the HBM floor
     dim = int(os.environ.get("BENCH_DIM", 4096 if big else 64))
-    # 5 layers (1.6B params) at batch 6: trades layer state for the
-    # ffn_prod selective-remat buffer + a fuller chip — measured r3
-    # best (122.4 TF/s, 62.1% MFU; vs 118.6/60.2% at L6/B4 and
-    # 111.1/56.4% for 8 layers + full remat; B8 overflows HBM by 104MB)
+    # 5 layers (1.6B params) at batch 12 with FULL remat: measured r3
+    # best (123.3 TF/s, 62.6% MFU). The sweep: L5/B6+ffn_prod-save
+    # 122.4, L5/B8 full-remat 123.0, L6/B4+save 118.6, L6/B10 116.2,
+    # 8 layers full remat (r2 baseline) 111.1/56.4%. Bigger batches
+    # beat selective remat once the saved buffers stop fitting.
     layers = int(os.environ.get("BENCH_LAYERS", 5 if big else 2))
     cfg = T.TransformerConfig(
         vocab_size=32000 if big else 256,
@@ -59,15 +62,13 @@ def bench_transformer():
         # TransformerConfig.loss_chunks) — required for batch >= 8
         loss_chunks=int(os.environ.get("BENCH_LOSS_CHUNKS",
                                        8 if big else 1)),
-        # selective remat: keep these intermediates in HBM instead of
-        # recomputing them in backward (TransformerConfig.remat_save).
-        # ffn_prod skips recomputing the two FFN up-projections and
-        # fits at the 5-layer/batch-6 default (attn_o is not worth
-        # saving: flash bwd recomputes its fwd for the lse residual
-        # regardless)
+        # selective remat (TransformerConfig.remat_save): saving
+        # ffn_prod wins at batch <= 6 but its buffers push batch 12
+        # out of HBM — at the default batch the fuller chip beats the
+        # saved recompute, so the headline runs full remat
+        # (BENCH_REMAT_SAVE=ffn_prod reproduces the selective config)
         remat_save=tuple(n for n in os.environ.get(
-            "BENCH_REMAT_SAVE", "ffn_prod" if big else "").split(",")
-            if n))
+            "BENCH_REMAT_SAVE", "").split(",") if n))
     mesh = create_mesh(devices=jax.devices()[:1], dp=1)
     init_fn, step_fn = T.make_train_step(cfg, mesh)
     rs = np.random.RandomState(0)

@@ -108,6 +108,7 @@ int MXTKVStoreGetGroupSize(void* kv, int* out);
 int MXTKVStoreGetType(void* kv, const char** out);
 int MXTKVStoreSetOptimizer(void* kv, const char* name, uint32_t nparam,
                            const char** keys, const char** vals);
+int MXTKVStoreBarrier(void* kv);
 int MXTKVStoreFree(void* kv);
 
 int MXTListDataIters(uint32_t* n, const char*** names);

@@ -284,6 +284,11 @@ def kv_type(kv):
     return str(kv.type)
 
 
+def kv_barrier(kv):
+    """Global barrier across workers (ref: MXKVStoreBarrier)."""
+    kv._barrier()
+
+
 def kv_set_optimizer(kv, name, keys, vals):
     import mxnet_tpu.optimizer as opt
     params = {k: _parse(v) for k, v in zip(keys, vals)}

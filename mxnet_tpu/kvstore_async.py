@@ -63,6 +63,7 @@ _OP_PROFILER = 12
 _OP_HEARTBEAT = 13
 _OP_DEADNODES = 14
 _OP_SHAPE = 15
+_OP_BARRIER = 16
 
 # response opcodes
 _RE_OK = 0x10
@@ -154,6 +155,9 @@ class AsyncPSServer:
         self._updater = None
         self._lock = threading.Lock()
         self._heartbeats = {}  # rank -> monotonic time of last beat
+        self._barrier_cv = threading.Condition(self._lock)
+        self._barrier_count = 0
+        self._barrier_gen = 0
         if _ps_secret() is None:
             # same-host workers inherit this via the environment; the
             # launcher passes MXTPU_* through for remote ranks
@@ -336,6 +340,45 @@ class AsyncPSServer:
             with self._lock:
                 shp = np.asarray(self._store[key].shape, np.int64)
             _send_frame(conn, bytes([_RE_ARR]) + _pack_arr(shp))
+        elif op == _OP_BARRIER:
+            # rendezvous of n workers (ref: ps::Postoffice::Barrier,
+            # kvstore_dist.h:106) — each conn thread blocks until the
+            # generation releases. An aborted wait (server stop or
+            # timeout) WITHDRAWS its arrival and errors, so a crashed
+            # participant cannot poison the next generation and a
+            # client never sees a rendezvous that did not happen.
+            (n,) = struct.unpack_from(">q", buf, off)
+            import time as _t
+            timeout = float(os.environ.get("MXTPU_PS_BARRIER_TIMEOUT",
+                                           "600"))
+            deadline = _t.monotonic() + timeout
+            with self._barrier_cv:
+                if self._barrier_count == 0:
+                    self._barrier_n = int(n)
+                elif int(n) != self._barrier_n:
+                    raise ValueError(
+                        "barrier size mismatch: %d vs in-progress %d"
+                        % (n, self._barrier_n))
+                self._barrier_count += 1
+                gen = self._barrier_gen
+                if self._barrier_count >= self._barrier_n:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                    released = True
+                else:
+                    while self._barrier_gen == gen \
+                            and not self._stop.is_set() \
+                            and _t.monotonic() < deadline:
+                        self._barrier_cv.wait(0.2)
+                    released = self._barrier_gen != gen
+                    if not released:
+                        self._barrier_count -= 1  # withdraw arrival
+            if not released:
+                raise RuntimeError(
+                    "barrier aborted (server stopping or %.0fs timeout "
+                    "waiting for %d workers)" % (timeout, n))
+            _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_HEARTBEAT:
             (rank,) = struct.unpack_from(">q", buf, off)
             import time as _t
@@ -434,6 +477,7 @@ class AsyncPSClient:
                     raise
                 time.sleep(0.1)  # server still coming up on rank 0
         self._lock = threading.Lock()
+        self._addr = (host, port)
         self.bytes_pushed = 0  # wire accounting (sparse/compressed tests)
         self._hb_stop = None
 
@@ -520,6 +564,20 @@ class AsyncPSClient:
         wire)."""
         arr = self._call(bytes([_OP_SHAPE]) + _pack_key(key))
         return tuple(int(d) for d in arr)
+
+    def barrier(self, num_workers):
+        """Block until `num_workers` clients reach this barrier. Runs
+        on a DEDICATED connection so the shared one (and the heartbeat
+        thread behind its lock) keeps flowing while we wait — a
+        barrier-parked worker must not look dead."""
+        tmp = AsyncPSClient(*self._addr)
+        try:
+            tmp._call(struct.pack(">Bq", _OP_BARRIER, int(num_workers)))
+        finally:
+            try:
+                tmp._sock.close()
+            except OSError:
+                pass
 
     def heartbeat(self, rank):
         self._call(struct.pack(">Bq", _OP_HEARTBEAT, int(rank)))
@@ -751,6 +809,11 @@ class AsyncKVStore:
                     dense[ids] = rows
                     o._data = jnp.asarray(dense)
         return out
+
+    def _barrier(self):
+        """Global rendezvous of all workers (ref: MXKVStoreBarrier /
+        ps::Postoffice::Barrier)."""
+        self._client.barrier(self._num_workers)
 
     def get_dead_nodes(self, timeout=3.0):
         """Ranks whose heartbeat went stale (ref: ps-lite GetDeadNodes,

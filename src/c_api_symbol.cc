@@ -572,6 +572,16 @@ int MXTKVStoreSetOptimizer(void* kv, const char* opt_name,
   return ReturnOk(res, "MXTKVStoreSetOptimizer");
 }
 
+// Global barrier across workers (ref: MXKVStoreBarrier /
+// ps::Postoffice::Barrier).
+int MXTKVStoreBarrier(void* kv) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* res = CallRt("kv_barrier", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStoreBarrier");
+}
+
 int MXTKVStoreFree(void* kv) {
   if (kv == nullptr) return 0;
   Gil gil;

@@ -207,3 +207,75 @@ class TestMultiprocessRestart:
             assert v[1].sum() > 0 and v[2].sum() > 0
         finally:
             kv.close()
+
+
+class TestBarrier:
+    def test_rendezvous_releases_all(self, server):
+        import threading
+        clients = [AsyncPSClient("127.0.0.1", server.port)
+                   for _ in range(3)]
+        released = []
+
+        def arrive(i):
+            clients[i].barrier(3)
+            released.append(i)
+
+        t1 = threading.Thread(target=arrive, args=(0,))
+        t2 = threading.Thread(target=arrive, args=(1,))
+        t1.start()
+        t2.start()
+        time.sleep(0.5)
+        assert released == []      # two of three: still blocked
+        arrive(2)                  # third releases everyone
+        t1.join(10)
+        t2.join(10)
+        assert sorted(released) == [0, 1, 2]
+
+    def test_barrier_reusable_across_generations(self, server):
+        c = AsyncPSClient("127.0.0.1", server.port)
+        for _ in range(3):
+            c.barrier(1)           # n=1 releases immediately, each time
+
+    def test_barrier_size_mismatch_errors(self, server):
+        import threading
+        a = AsyncPSClient("127.0.0.1", server.port)
+        b = AsyncPSClient("127.0.0.1", server.port)
+        t = threading.Thread(target=lambda: a.barrier(2))
+        t.start()
+        time.sleep(0.3)
+        with pytest.raises(RuntimeError, match="size mismatch"):
+            b.barrier(5)
+        b.barrier(2)  # correct size releases the pending rendezvous
+        t.join(10)
+
+    def test_barrier_timeout_aborts_and_withdraws(self, server,
+                                                  monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_BARRIER_TIMEOUT", "1")
+        a = AsyncPSClient("127.0.0.1", server.port)
+        with pytest.raises(RuntimeError, match="barrier aborted"):
+            a.barrier(2)           # partner never arrives
+        # the withdrawn arrival must not poison the next generation
+        monkeypatch.setenv("MXTPU_PS_BARRIER_TIMEOUT", "600")
+        import threading
+        released = []
+        t = threading.Thread(
+            target=lambda: (a.barrier(2), released.append(1)))
+        t.start()
+        time.sleep(0.5)
+        assert released == []      # needs a REAL second arrival
+        AsyncPSClient("127.0.0.1", server.port).barrier(2)
+        t.join(10)
+        assert released == [1]
+
+    def test_heartbeat_flows_while_barrier_parked(self, server):
+        import threading
+        a = AsyncPSClient("127.0.0.1", server.port)
+        a.start_heartbeat(0, interval=0.1)
+        t = threading.Thread(target=lambda: a.barrier(2))
+        t.start()
+        time.sleep(1.2)            # parked well past the dead window
+        watcher = AsyncPSClient("127.0.0.1", server.port)
+        assert watcher.dead_nodes(timeout=1.0) == []  # NOT starved
+        watcher.barrier(2)         # release
+        t.join(10)
+        a.stop_heartbeat()

@@ -1,0 +1,176 @@
+"""Attribute the ResNet-50 train step's HBM traffic per HLO instruction.
+
+Round-3 established the step is HBM-bound (44 GB moved per b128 step,
+XLA cost analysis) but never said WHERE the bytes go.  This script
+compiles the exact bench.py step, parses the optimized HLO, and charges
+each entry-computation instruction its operand+result bytes — the
+static analog of a per-kernel HBM profile.  Output drives the round-4
+fusion work (VERDICT r3 item 1).
+
+Usage:  python benchmark/resnet_hbm_profile.py [--layout NHWC] [--batch 256]
+"""
+import argparse
+import collections
+import re
+import sys
+
+import numpy as np
+
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "token": 0, "opaque": 0,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def shape_bytes(type_str):
+    """Bytes of an HLO type string, incl. tuples: '(bf16[2,3]{...}, f32[4])'."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        if dt not in DTYPE_BYTES:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * DTYPE_BYTES[dt]
+    return total
+
+
+# '  %name = TYPE op(...)' — TYPE is everything up to the opcode token
+_INSTR_RE = re.compile(
+    r"^\s+(?:ROOT\s+)?%?([\w\.\-]+)\s*=\s*(\(?.*?\)?)\s+([\w\-]+)\(")
+_OPERAND_RE = re.compile(r"%([\w\.\-]+)")
+
+SKIP_OPS = {"parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+            "after-all", "partition-id", "replica-id", "iota"}
+
+
+def parse_entry(hlo_text):
+    """Yield (name, opcode, result_bytes, operand_names, line) for ENTRY."""
+    lines = hlo_text.splitlines()
+    # find ENTRY computation block
+    depth = 0
+    in_entry = False
+    sizes = {}
+    instrs = []
+    for ln in lines:
+        if ln.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry:
+            if ln.startswith("}"):
+                break
+            m = _INSTR_RE.match(ln)
+            if not m:
+                continue
+            name, type_str, opcode = m.groups()
+            rb = shape_bytes(type_str)
+            sizes[name] = rb
+            # operands: everything inside the first (...) after opcode
+            paren = ln[m.end() - 1:]
+            # cut at '), ' metadata boundary — good enough for accounting
+            ops = _OPERAND_RE.findall(paren)
+            instrs.append((name, opcode, rb, ops, ln.strip()))
+    return sizes, instrs
+
+
+def categorize(opcode, line):
+    if opcode == "fusion":
+        m = re.search(r"kind=(\w+)", line)
+        kind = m.group(1) if m else "?"
+        for hint, cat in (("reduce", "fusion:reduce"),
+                          ("conv", "fusion:conv"),
+                          ("scatter", "fusion:scatter")):
+            if hint in line:
+                return "fusion:" + kind
+        return "fusion:" + kind
+    if opcode in ("convolution", "custom-call") and "conv" in line:
+        return "convolution"
+    return opcode
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layout", default="NCHW")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--hlo-out", default=None,
+                    help="also dump the optimized HLO text here")
+    args = ap.parse_args()
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    import mxnet_tpu.optimizer as opt
+    from mxnet_tpu.parallel import create_mesh, data_parallel, \
+        ShardedTrainStep
+
+    net = resnet50_v1(layout=args.layout)
+    net.initialize()
+    net(mx.nd.array(np.zeros((1, 3, 224, 224), "float32")))
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    mesh = create_mesh(devices=jax.devices()[:1], dp=1)
+    step = ShardedTrainStep(net, SoftmaxCrossEntropyLoss(),
+                            opt.create("sgd", learning_rate=0.01,
+                                       momentum=0.9),
+                            strategy=data_parallel(mesh))
+    rng = np.random.RandomState(0)
+    x = rng.rand(args.batch, 3, 224, 224).astype(args.dtype)
+    y = rng.randint(0, 1000, (args.batch,)).astype("float32")
+    xd, yd = step.place_batch(x, y)
+    lowered = step.lower(xd, yd)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    print("== aggregate cost analysis ==")
+    for k in ("bytes accessed", "flops", "optimal_seconds"):
+        if k in ca:
+            print("  %s: %.4g" % (k, ca[k]))
+    hlo = compiled.as_text()
+    if args.hlo_out:
+        with open(args.hlo_out, "w") as f:
+            f.write(hlo)
+    sizes, instrs = parse_entry(hlo)
+
+    rows = []
+    for name, opcode, rb, ops, line in instrs:
+        if opcode in SKIP_OPS:
+            continue
+        read = sum(sizes.get(o, 0) for o in ops if o in sizes)
+        rows.append((rb + read, rb, read, name, opcode, line))
+    rows.sort(reverse=True)
+
+    total = sum(r[0] for r in rows)
+    print("\n== static entry-computation traffic: %.2f GB ==" % (total / 1e9))
+
+    by_cat = collections.Counter()
+    cat_n = collections.Counter()
+    for tot, rb, read, name, opcode, line in rows:
+        cat = categorize(opcode, line)
+        by_cat[cat] += tot
+        cat_n[cat] += 1
+    print("\n== by category ==")
+    for cat, b in by_cat.most_common():
+        print("  %-24s %8.2f GB  (%d instrs)" % (cat, b / 1e9, cat_n[cat]))
+
+    print("\n== top %d instructions ==" % args.top)
+    for tot, rb, read, name, opcode, line in rows[:args.top]:
+        print("  %7.1f MB (w %6.1f r %7.1f)  %-12s %s"
+              % (tot / 1e6, rb / 1e6, read / 1e6, opcode, line[:140]))
+
+    # opcode histogram for transpose/copy hunting
+    n_transpose = sum(1 for _, _, _, _, op, ln in rows
+                      if op in ("transpose", "copy")
+                      or (op == "fusion" and "transpose(" in ln))
+    print("\ntranspose/copy-flavored entry instrs: %d" % n_transpose)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""PTB-style LSTM language model on the legacy symbolic RNN API
+(ref: example/rnn/bucketing/lstm_bucketing.py): BucketSentenceIter +
+mx.rnn.SequentialRNNCell/LSTMCell + BucketingModule.fit, with
+save/load via the rnn checkpoint helpers.
+
+Runs self-contained on a synthetic corpus by default (zero-egress CI);
+pass --train FILE with one sentence per line for real data.
+
+    python example/rnn/lstm_bucketing.py --epochs 2
+
+TPU note: each bucket length compiles once (one XLA program per bucket
+via the BucketingModule's shared-module bind), so keep the bucket list
+short — the reference's [10, 20, 30, 40, 50, 60] default works.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def synthetic_corpus(n=400, seed=0):
+    """Markov-ish token stream so the LM has learnable structure."""
+    rs = np.random.RandomState(seed)
+    words = ["the", "a", "cat", "dog", "sat", "ran", "on", "mat", "log",
+             "fast", "slow", "big", "small", "and", "then"]
+    sents = []
+    for _ in range(n):
+        ln = rs.randint(4, 12)
+        sents.append([words[rs.randint(len(words))] for _ in range(ln)])
+    return [" ".join(s) for s in sents]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train", default=None, help="one sentence per line")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-hidden", type=int, default=200)
+    ap.add_argument("--num-embed", type=int, default=200)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--buckets", default="10,20,30,40,50,60")
+    ap.add_argument("--save-prefix", default=None)
+    args = ap.parse_args()
+
+    if args.train:
+        with open(args.train) as f:
+            lines = [ln.split() for ln in f if ln.strip()]
+    else:
+        lines = [ln.split() for ln in synthetic_corpus()]
+
+    sentences, vocab = mx.rnn.encode_sentences(lines, invalid_label=0,
+                                               start_label=1)
+    vocab_size = max(vocab.values()) + 1
+    buckets = [int(b) for b in args.buckets.split(",")]
+    buckets = [b for b in buckets
+               if any(len(s) <= b for s in sentences)]
+    data_train = mx.rnn.BucketSentenceIter(
+        sentences, args.batch_size, buckets=buckets, invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label,
+                                    name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=data_train.default_bucket_key)
+
+    metric = mx.metric.Perplexity(ignore_label=None)
+    model.bind(data_shapes=data_train.provide_data,
+               label_shapes=data_train.provide_label)
+    model.init_params(initializer=mx.init.Xavier(factor_type="in",
+                                                 magnitude=2.34))
+    model.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": args.lr,
+                                           "momentum": 0.9})
+    for epoch in range(args.epochs):
+        data_train.reset()
+        metric.reset()
+        for i, batch in enumerate(data_train):
+            model.forward(batch, is_train=True)
+            model.update_metric(metric, batch.label)
+            model.backward()
+            model.update()
+        print("epoch %d: train %s=%.3f" % (epoch, *metric.get()))
+        if args.save_prefix:
+            arg, aux = model.get_params()
+            sym = sym_gen(data_train.default_bucket_key)[0]
+            mx.rnn.save_rnn_checkpoint(stack, args.save_prefix, epoch + 1,
+                                       sym, arg, aux)
+            print("saved %s-%04d.params" % (args.save_prefix, epoch + 1))
+
+
+if __name__ == "__main__":
+    main()

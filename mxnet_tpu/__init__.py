@@ -54,6 +54,7 @@ _LAZY = {
     "module": ".module",
     "mod": ".module",
     "model": ".model",
+    "rnn": ".rnn",
     "callback": ".callback",
     "monitor": ".monitor",
     "profiler": ".profiler",

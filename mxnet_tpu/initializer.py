@@ -14,7 +14,7 @@ from .base import _Registry
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "Mixed", "get", "register"]
+           "FusedRNN", "Mixed", "get", "register", "create"]
 
 _REG = _Registry("initializer")
 
@@ -33,6 +33,19 @@ def get(name):
     if isinstance(name, Initializer):
         return name
     return _REG.get(_ALIASES.get(name.lower(), name))()
+
+
+def create(spec):
+    """Initializer from a ``dumps()`` JSON spec, a plain registry name, or
+    an Initializer instance (ref: initializer.py registry.create path used
+    by the ``__init__`` variable attr)."""
+    import json
+    if isinstance(spec, Initializer):
+        return spec
+    if isinstance(spec, str) and spec.startswith("["):
+        klass, kwargs = json.loads(spec)
+        return _REG.get(_ALIASES.get(klass.lower(), klass.lower()))(**kwargs)
+    return get(spec)
 
 
 class InitDesc(str):
@@ -54,11 +67,21 @@ class Initializer:
     def __call__(self, desc, arr):
         from .ndarray import NDArray
         import jax.numpy as jnp
+        # a variable-level init attr overrides the global initializer and
+        # always runs its _init_weight (no suffix dispatch) — ref:
+        # initializer.py Initializer.__call__ '__init__' attr branch
+        spec = getattr(desc, "attrs", None) or {}
+        override = spec.get("__init__")
         if isinstance(arr, NDArray):
             # asnumpy() of a jax buffer is a read-only view; copy for in-place
             host = _np.array(arr.asnumpy())
-            self._init_weight_dispatch(str(desc), host)
+            if override:
+                create(override)._init_weight(str(desc), host)
+            else:
+                self._init_weight_dispatch(str(desc), host)
             arr._data = jnp.asarray(host)
+        elif override:
+            create(override)._init_weight(str(desc), arr)
         else:
             self._init_weight_dispatch(str(desc), arr)
 
@@ -96,6 +119,12 @@ class Initializer:
 
     def _init_weight(self, name, arr):
         raise NotImplementedError
+
+    def dumps(self):
+        """JSON spec round-trippable through ``create()`` (ref:
+        initializer.py Initializer.dumps)."""
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
 
     def __repr__(self):
         return "%s(%s)" % (type(self).__name__, self._kwargs)
@@ -222,6 +251,60 @@ class LSTMBias(Initializer):
         arr[...] = 0.0
         num_hidden = arr.shape[0] // 4
         arr[num_hidden:2 * num_hidden] = self.forget_bias
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize a packed fused-RNN parameter vector
+    (ref: initializer.py:715 FusedRNN). Walks the packed layout the `RNN`
+    op consumes (ops/nn.py _rnn_unpack_params: weights layer-major with
+    direction inner, then biases) and applies the inner initializer to each
+    per-gate weight block; LSTM forget-gate bias rows get ``forget_bias``.
+    """
+
+    def __init__(self, init=None, num_hidden=None, num_layers=None,
+                 mode="lstm", bidirectional=False, forget_bias=1.0):
+        if isinstance(init, Initializer):
+            init_spec = init.dumps()
+        else:
+            init_spec = init
+        super().__init__(init=init_spec, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional, forget_bias=forget_bias)
+        self._init = create(init_spec) if init_spec else None
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._ndir = 2 if bidirectional else 1
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        from .ops.nn import _RNN_GATES, rnn_packed_input_size
+        g = _RNN_GATES[self._mode]
+        h = self._num_hidden
+        nd = self._ndir
+        inner = self._init or Uniform(0.07)
+        li = rnn_packed_input_size(arr.size, self._mode, h,
+                                   self._num_layers, nd)
+        off = 0
+        for layer in range(self._num_layers):
+            isz = li if layer == 0 else h * nd
+            for _ in range(nd):
+                for cols in (isz, h):  # i2h weight, then h2h weight
+                    for j in range(g):
+                        blk = arr[off:off + h * cols].reshape(h, cols)
+                        inner._init_weight(name, blk)
+                        arr[off:off + h * cols] = blk.ravel()
+                        off += h * cols
+        for layer in range(self._num_layers):
+            for _ in range(nd):
+                for _src in range(2):  # i2h bias, then h2h bias
+                    for j in range(g):
+                        val = self._forget_bias \
+                            if (self._mode == "lstm" and j == 1) else 0.0
+                        arr[off:off + h] = val
+                        off += h
+        assert off == arr.size, "packed fused-RNN parameter size mismatch"
 
 
 class Mixed:

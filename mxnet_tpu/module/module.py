@@ -160,6 +160,8 @@ class Module(BaseModule):
         if self._aux_params is None:
             self._aux_params = dict(self._exec_group.executor.aux_dict)
 
+        var_attrs = self._symbol.attr_dict
+
         def _impl(name, arr, cache):
             # mirrors the reference's _impl (module.py:267): cached value
             # wins; a missing name raises unless allow_missing, in which
@@ -174,7 +176,8 @@ class Module(BaseModule):
                 if not allow_missing:
                     raise RuntimeError("%s is not presented" % name)
             if initializer is not None:
-                initializer(InitDesc(name), arr)
+                # variable attrs carry per-param init overrides (__init__)
+                initializer(InitDesc(name, attrs=var_attrs.get(name)), arr)
 
         for name, arr in sorted(self._arg_params.items()):
             _impl(name, arr, arg_params)
@@ -334,6 +337,18 @@ class Module(BaseModule):
             optimizer = opt.create(optimizer,
                                    param_idx2name=idx2name,
                                    **optimizer_params)
+            # per-variable __lr_mult__/__wd_mult__ attrs (sym.Variable
+            # lr_mult=...) flow into the optimizer like the reference's
+            # attr_dict wiring (ref: module.py:502 init_optimizer)
+            attrs = self._symbol.attr_dict
+            lr_mult = {n: float(a["__lr_mult__"])
+                       for n, a in attrs.items() if "__lr_mult__" in a}
+            wd_mult = {n: float(a["__wd_mult__"])
+                       for n, a in attrs.items() if "__wd_mult__" in a}
+            if lr_mult:
+                optimizer.set_lr_mult(lr_mult)
+            if wd_mult:
+                optimizer.set_wd_mult(wd_mult)
         else:
             if optimizer.rescale_grad != rescale_grad:
                 self.logger.warning(

@@ -586,6 +586,25 @@ def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
 _RNN_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
 
 
+def rnn_packed_param_size(mode, input_size, state_size, num_layers, ndir):
+    """Length of the packed `RNN` parameter vector (ref: rnn-inl.h
+    GetRnnParamSize). Single source of truth for the packing arithmetic —
+    symbol/infer.py and initializer.FusedRNN derive from this."""
+    g = _RNN_GATES[mode]
+    h = state_size
+    return ndir * g * h * (input_size + h + 2) \
+        + (num_layers - 1) * ndir * g * h * (h * ndir + h + 2)
+
+
+def rnn_packed_input_size(total, mode, state_size, num_layers, ndir):
+    """Recover the layer-0 input size from a packed vector's length
+    (inverse of rnn_packed_param_size; ref: rnn_cell.py unpack_weights)."""
+    g = _RNN_GATES[mode]
+    h = state_size
+    return total // ndir // g // h - (num_layers - 1) * (h + ndir * h + 2) \
+        - h - 2
+
+
 def _rnn_unpack_params(parameters, mode, input_size, state_size, num_layers,
                        ndir):
     """Split the packed 1-D parameter vector into per-(layer, direction)

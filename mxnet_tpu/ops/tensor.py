@@ -103,8 +103,23 @@ def broadcast_to(x, shape=None):
 
 
 @register("broadcast_like", num_inputs=2)
-def broadcast_like(x, like):
-    return jnp.broadcast_to(x, like.shape)
+def broadcast_like(x, like, lhs_axes=None, rhs_axes=None):
+    """ref: src/operator/tensor/broadcast_reduce_op.h BroadcastLikeParam —
+    with axes given, only those lhs dims take the matching rhs sizes."""
+    if lhs_axes is None and rhs_axes is None:
+        return jnp.broadcast_to(x, like.shape)
+    if lhs_axes is None or rhs_axes is None:
+        raise ValueError("broadcast_like needs both lhs_axes and rhs_axes "
+                         "or neither")
+    lhs_axes = (lhs_axes,) if isinstance(lhs_axes, int) else tuple(lhs_axes)
+    rhs_axes = (rhs_axes,) if isinstance(rhs_axes, int) else tuple(rhs_axes)
+    if len(lhs_axes) != len(rhs_axes) or not lhs_axes:
+        raise ValueError("lhs_axes and rhs_axes must be equal-length and "
+                         "non-empty, got %s vs %s" % (lhs_axes, rhs_axes))
+    tgt = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la % x.ndim] = like.shape[ra % like.ndim]
+    return jnp.broadcast_to(x, tuple(tgt))
 
 
 @register("broadcast_axis", num_inputs=1, aliases=("broadcast_axes",))

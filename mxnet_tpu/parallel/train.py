@@ -204,6 +204,15 @@ class ShardedTrainStep:
             self.params, self.opt_states, xd, yd, rng)
         return loss
 
+    def lower(self, x, y):
+        """AOT-lower the step for inspection (cost analysis, optimized
+        HLO) without running it — profiling seam for benchmark/."""
+        if self._jitted is None:
+            self._build()
+        xd, yd = self.place_batch(x, y)
+        return self._jitted.lower(self.params, self.opt_states, xd, yd,
+                                  _random.next_key())
+
     def place_batch(self, x, y):
         """Pre-shard a host batch onto the mesh (double-buffer helper)."""
         xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)

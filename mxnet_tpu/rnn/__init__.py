@@ -1,0 +1,8 @@
+"""Legacy symbolic RNN API — `mx.rnn` (ref: python/mxnet/rnn/__init__.py).
+
+Cells build Symbol graphs (compiled to one XLA program at bind);
+FusedRNNCell rides the lax.scan-backed `RNN` op. See rnn_cell.py for the
+TPU design notes."""
+from .rnn_cell import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403

@@ -67,6 +67,16 @@ def _hint_param_shapes(node, in_shapes):
         out["beta"] = (c,)
     elif op == "Embedding":
         out["weight"] = (int(a.get("input_dim")), int(a.get("output_dim")))
+    elif op in ("RNN", "rnn"):
+        # packed parameter length + state shapes
+        from ..ops.nn import rnn_packed_param_size
+        h = int(a.get("state_size"))
+        layers = int(a.get("num_layers", 1))
+        nd = 2 if a.get("bidirectional") else 1
+        out["parameters"] = (rnn_packed_param_size(
+            a.get("mode", "lstm"), data[-1], h, layers, nd),)
+        out["state"] = (layers * nd, data[1], h)
+        out["state_cell"] = (layers * nd, data[1], h)
     return out
 
 

@@ -91,6 +91,14 @@ def make_symbol_op_func(opdef, public_name):
                 else:
                     attrs[k] = v
         else:
+            # the reference's docs/wrappers spell the first input `data`
+            # while many registry fns name it `x` (and vice versa) —
+            # accept either spelling (ref: generated op wrappers accept
+            # the documented name)
+            for given, actual in (("data", "x"), ("x", "data")):
+                if given in kwargs and given not in input_names \
+                        and actual in input_names and actual not in kwargs:
+                    kwargs[actual] = kwargs.pop(given)
             provided = {}
             pos = list(args)
             for iname in input_names:
@@ -98,9 +106,15 @@ def make_symbol_op_func(opdef, public_name):
                     provided[iname] = kwargs.pop(iname)
                 elif pos:
                     provided[iname] = pos.pop(0)
-            # remaining kwargs are static attrs
+            # remaining kwargs are static attrs; a Symbol under a name the
+            # op doesn't declare as an input would be silently dropped
+            # from the graph — make that an error instead
             for k, v in kwargs.items():
                 if isinstance(v, Symbol):
+                    if k not in input_names:
+                        raise TypeError(
+                            "%s got Symbol for unknown input %r "
+                            "(inputs: %s)" % (public_name, k, input_names))
                     provided[k] = v
                 else:
                     attrs[k] = v
@@ -126,6 +140,12 @@ def make_symbol_op_func(opdef, public_name):
                     raise TypeError("input %s must be a Symbol, got %s"
                                     % (iname, type(v)))
                 provided[iname] = v
+            if any(isinstance(p, Symbol) for p in pos):
+                raise TypeError(
+                    "%s got %d unexpected positional Symbol input(s) "
+                    "beyond its declared inputs %s"
+                    % (public_name, sum(isinstance(p, Symbol) for p in pos),
+                       input_names))
             sym_inputs = [provided[i] for i in input_names if i in provided]
             attrs["__input_names__"] = [i for i in input_names
                                         if i in provided]

@@ -48,9 +48,11 @@ def _auto_name(hint):
 INPUT_PARAM_NAMES = (
     "x", "data", "lhs", "rhs", "weight", "bias", "gamma", "beta",
     "moving_mean", "moving_var", "label", "grid", "indices", "index",
-    "condition", "a", "b", "mu", "sigma", "low", "high", "lam", "alpha",
+    "condition", "cond", "a", "b", "y", "mu", "sigma", "low", "high",
+    "lam", "alpha",
     "loc", "scale", "shape_like", "data1", "data2", "rois", "anchors",
-    "cls_pred", "loc_pred", "parameters", "state", "state_cell",
+    "cls_pred", "loc_pred", "parameters", "state", "state_cell", "like",
+    "sequence_length",
 )
 
 # aux-state naming convention (BatchNorm moving stats et al.)
@@ -362,6 +364,14 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         attrs["__shape__"] = list(shape)
     if dtype is not None:
         attrs["__dtype__"] = str(_np.dtype(dtype))
+    if init is not None:
+        # serialized so it survives tojson round-trips; honored by
+        # Initializer.__call__ (ref: symbol.py var() __init__ attr)
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = float(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = float(wd_mult)
     node = _Node(None, name, attrs, shape=tuple(shape) if shape else None)
     return Symbol([(node, 0)])
 
@@ -449,19 +459,32 @@ def load_json(json_str):
 
 
 def _num_outputs_of(node):
-    # multi-output ops known to the framework
+    # multi-output ops known to the framework; attr-dependent counts
+    # mirror the reference's per-op FNumOutputs (ref: nnvm op registry)
     if "__num_outputs__" in node.attrs:
         return int(node.attrs["__num_outputs__"])
     if node.op in ("BatchNorm", "batch_norm"):
         return 3
+    if node.op in ("split", "SliceChannel"):
+        return int(node.attrs.get("num_outputs", 1))
+    if node.op in ("RNN", "rnn"):
+        if node.attrs.get("state_outputs"):
+            return 3 if node.attrs.get("mode", "lstm") == "lstm" else 2
+        return 1
+    if node.op == "moments":
+        return 2
+    if node.op == "topk":
+        return 2 if node.attrs.get("ret_typ") == "both" else 1
     return 1
 
 
-def zeros(shape, dtype="float32", **kwargs):
+def zeros(shape, dtype="float32", name=None, **kwargs):
     from .register import create_symbol_op
-    return create_symbol_op("_zeros", [], {"shape": shape, "dtype": dtype})
+    return create_symbol_op("_zeros", [], {"shape": shape, "dtype": dtype},
+                            name=name)
 
 
-def ones(shape, dtype="float32", **kwargs):
+def ones(shape, dtype="float32", name=None, **kwargs):
     from .register import create_symbol_op
-    return create_symbol_op("_ones", [], {"shape": shape, "dtype": dtype})
+    return create_symbol_op("_ones", [], {"shape": shape, "dtype": dtype},
+                            name=name)

@@ -1,0 +1,326 @@
+"""Legacy `mx.rnn` package (ref: python/mxnet/rnn/): cells, fused cell,
+modifiers, BucketSentenceIter, checkpoint helpers, and an end-to-end
+BucketingModule LM (the reference example/rnn workflow shape)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+
+
+def _bind_and_run(sym, data, seed=7, dtype="float32"):
+    exe = sym.simple_bind(data=data.shape)
+    rs = np.random.RandomState(seed)
+    for name, arr in sorted(exe.arg_dict.items()):
+        if name != "data":
+            arr[:] = (rs.rand(*arr.shape) * 0.2 - 0.1).astype(dtype)
+    exe.arg_dict["data"][:] = data
+    return exe.forward()[0].asnumpy(), exe
+
+
+def test_cell_unroll_shapes():
+    for cell, h in ((rnn.RNNCell(10, prefix="r_"), 10),
+                    (rnn.LSTMCell(12, prefix="l_"), 12),
+                    (rnn.GRUCell(9, prefix="g_"), 9)):
+        out, states = cell.unroll(4, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+        y, _ = _bind_and_run(out, np.random.rand(3, 4, 6).astype("f"))
+        assert y.shape == (3, 4, h)
+        assert len(states) == len(cell.state_info)
+
+
+def test_unroll_list_outputs():
+    cell = rnn.LSTMCell(8, prefix="l_")
+    outs, _ = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                          merge_outputs=False)
+    assert isinstance(outs, list) and len(outs) == 3
+
+
+def test_lstm_param_names_and_forget_bias():
+    """i2h_bias carries LSTMBias init via the __init__ var attr."""
+    cell = rnn.LSTMCell(5, prefix="lstm_")
+    out, _ = cell.unroll(2, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    args = set(out.list_arguments())
+    assert {"lstm_i2h_weight", "lstm_i2h_bias", "lstm_h2h_weight",
+            "lstm_h2h_bias", "data"} <= args
+    attrs = out.attr_dict
+    assert "lstmbias" in attrs["lstm_i2h_bias"]["__init__"]
+    # Module init honors it: forget rows = 1, others 0
+    mod = mx.mod.Module(out, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (2, 2, 3))])
+    mod.init_params(initializer=mx.init.Zero())
+    bias = mod.get_params()[0]["lstm_i2h_bias"].asnumpy()
+    assert np.allclose(bias[5:10], 1.0) and np.allclose(bias[:5], 0.0)
+
+
+def test_unpack_pack_roundtrip():
+    cell = rnn.LSTMCell(6, prefix="x_")
+    cell.unroll(2, inputs=mx.sym.Variable("data"), merge_outputs=True)
+    rs = np.random.RandomState(0)
+    args = {"x_i2h_weight": mx.nd.array(rs.rand(24, 4)),
+            "x_i2h_bias": mx.nd.array(rs.rand(24)),
+            "x_h2h_weight": mx.nd.array(rs.rand(24, 6)),
+            "x_h2h_bias": mx.nd.array(rs.rand(24))}
+    unpacked = cell.unpack_weights({k: v.copy() for k, v in args.items()})
+    assert "x_i2h_i_weight" in unpacked and "x_h2h_o_bias" in unpacked
+    packed = cell.pack_weights(unpacked)
+    for k in args:
+        np.testing.assert_allclose(args[k].asnumpy(),
+                                   packed[k].asnumpy(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh"])
+def test_fused_matches_unfused(mode):
+    """FusedRNNCell (lax.scan RNN op) == its unfuse() stack given the
+    same weights routed through unpack_weights — validates the packed
+    layout end to end."""
+    T, N, I, H, L = 3, 2, 4, 5, 2
+    fused = rnn.FusedRNNCell(H, num_layers=L, mode=mode, prefix="f_")
+    fo, _ = fused.unroll(T, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    rs = np.random.RandomState(3)
+    nparam = fo.infer_shape(data=(N, T, I))[0]
+    names = fo.list_arguments()
+    pvec = None
+    for nm, shp in zip(names, nparam):
+        if nm == "f_parameters":
+            pvec = mx.nd.array((rs.rand(*shp) * 0.4 - 0.2).astype("f"))
+    assert pvec is not None
+    exe = fo.bind(args={"data": mx.nd.zeros((N, T, I)),
+                        "f_parameters": pvec})
+    x = np.random.RandomState(5).rand(N, T, I).astype("f")
+    exe.arg_dict["data"][:] = x
+    y_fused = exe.forward()[0].asnumpy()
+
+    stack = fused.unfuse()
+    so, _ = stack.unroll(T, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    per_gate = fused.unpack_weights({"f_parameters": pvec})
+    per_layer = stack.pack_weights(per_gate)
+    args = {"data": mx.nd.zeros((N, T, I))}
+    args.update({k: v for k, v in per_layer.items()})
+    sexe = so.bind(args=args)
+    sexe.arg_dict["data"][:] = x
+    y_stack = sexe.forward()[0].asnumpy()
+    np.testing.assert_allclose(y_fused, y_stack, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_rnn_initializer():
+    """init.FusedRNN fills the packed vector; lstm forget biases = 1."""
+    fused = rnn.FusedRNNCell(4, num_layers=2, mode="lstm", prefix="f_")
+    fo, _ = fused.unroll(2, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    mod = mx.mod.Module(fo, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (2, 2, 3))])
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    vec = mod.get_params()[0]["f_parameters"].asnumpy()
+    unpacked = fused.unpack_weights(
+        {"f_parameters": mx.nd.array(vec)})
+    np.testing.assert_allclose(
+        unpacked["f_l0_i2h_f_bias"].asnumpy(), 1.0)
+    np.testing.assert_allclose(
+        unpacked["f_l1_h2h_f_bias"].asnumpy(), 1.0)
+    np.testing.assert_allclose(unpacked["f_l0_i2h_i_bias"].asnumpy(), 0.0)
+    w = unpacked["f_l0_i2h_i_weight"].asnumpy()
+    assert w.std() > 0  # inner init actually ran
+
+
+def test_modifier_cells():
+    base = rnn.LSTMCell(8, prefix="z_")
+    zone = rnn.ZoneoutCell(base, zoneout_outputs=0.2, zoneout_states=0.1)
+    out, _ = zone.unroll(3, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    y, _ = _bind_and_run(out, np.random.rand(2, 3, 4).astype("f"))
+    assert y.shape == (2, 3, 8)
+
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(6, prefix="s0_"))
+    stack.add(rnn.DropoutCell(0.3, prefix="d_"))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(6, prefix="s1_")))
+    out, states = stack.unroll(3, inputs=mx.sym.Variable("data"),
+                               merge_outputs=True)
+    y, _ = _bind_and_run(out, np.random.rand(2, 3, 6).astype("f"))
+    assert y.shape == (2, 3, 6)
+    assert len(states) == 4  # two LSTM cells x (h, c)
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.GRUCell(5, prefix="l_"),
+                               rnn.GRUCell(5, prefix="r_"))
+    out, states = bi.unroll(4, inputs=mx.sym.Variable("data"),
+                            merge_outputs=True)
+    y, _ = _bind_and_run(out, np.random.rand(2, 4, 3).astype("f"))
+    assert y.shape == (2, 4, 10)
+    assert len(states) == 2
+
+
+def test_conv_cells():
+    for klass in (rnn.ConvRNNCell, rnn.ConvLSTMCell, rnn.ConvGRUCell):
+        cell = klass(input_shape=(1, 3, 8, 8), num_hidden=4)
+        out, _ = cell.unroll(2, inputs=mx.sym.Variable("data"),
+                             merge_outputs=False)
+        y, _ = _bind_and_run(out[-1],
+                             np.random.rand(2, 2, 3, 8, 8).astype("f"))
+        assert y.shape == (2, 4, 8, 8)
+
+
+def test_begin_state_variable():
+    """func=Variable feeds states as graph inputs."""
+    cell = rnn.LSTMCell(7, prefix="v_")
+    states = cell.begin_state(func=mx.sym.Variable)
+    out, _ = cell.unroll(2, inputs=mx.sym.Variable("data"),
+                         begin_state=states, merge_outputs=True)
+    args = out.list_arguments()
+    assert "v_begin_state_0" in args and "v_begin_state_1" in args
+    exe = out.simple_bind(data=(3, 2, 4), v_begin_state_0=(3, 7),
+                          v_begin_state_1=(3, 7))
+    assert exe.forward()[0].shape == (3, 2, 7)
+
+
+def test_encode_sentences():
+    sents = [["a", "b", "c"], ["b", "c"]]
+    coded, vocab = rnn.encode_sentences(sents, start_label=1)
+    assert coded[0] == [vocab["a"], vocab["b"], vocab["c"]]
+    assert coded[1] == [vocab["b"], vocab["c"]]
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sents = [list(rs.randint(1, 20, size=n))
+             for n in rs.randint(3, 9, size=64)]
+    it = rnn.BucketSentenceIter(sents, batch_size=4, buckets=[4, 8],
+                                invalid_label=0)
+    assert it.default_bucket_key == 8
+    n = 0
+    for batch in it:
+        assert batch.bucket_key in (4, 8)
+        data = batch.data[0].asnumpy()
+        label = batch.label[0].asnumpy()
+        assert data.shape == (4, batch.bucket_key)
+        # label is data shifted left by one
+        np.testing.assert_array_equal(label[:, :-1], data[:, 1:])
+        n += 1
+    assert n > 0
+    it.reset()
+    assert sum(1 for _ in it) == n
+
+
+def test_rnn_checkpoint(tmp_path):
+    prefix = str(tmp_path / "lm")
+    fused = rnn.FusedRNNCell(4, num_layers=1, mode="lstm", prefix="c_")
+    out, _ = fused.unroll(2, inputs=mx.sym.Variable("data"),
+                          merge_outputs=True)
+    rs = np.random.RandomState(1)
+    shp = out.infer_shape(data=(2, 2, 3))[0]
+    args = {n: mx.nd.array(rs.rand(*s).astype("f"))
+            for n, s in zip(out.list_arguments(), shp) if n != "data"}
+    rnn.save_rnn_checkpoint(fused, prefix, 3, out, args, {})
+    # on disk the params are per-gate (readable / portable)
+    import mxnet_tpu.model as model
+    _, raw, _ = model.load_checkpoint(prefix, 3)
+    assert "c_l0_i2h_i_weight" in raw
+    sym2, arg2, _ = rnn.load_rnn_checkpoint(fused, prefix, 3)
+    np.testing.assert_allclose(args["c_parameters"].asnumpy(),
+                               arg2["c_parameters"].asnumpy(), rtol=1e-6)
+
+
+def test_lm_bucketing_train():
+    """The reference example/rnn workflow: BucketSentenceIter +
+    sym_gen(seq_len) closing over shared cells -> BucketingModule.fit
+    (ref: example/rnn/lstm_bucketing.py structure)."""
+    vocab_size, emb, hid = 30, 8, 16
+    rs = np.random.RandomState(0)
+    sents = [list(rs.randint(2, vocab_size, size=n))
+             for n in rs.randint(3, 9, size=96)]
+    it = rnn.BucketSentenceIter(sents, batch_size=8, buckets=[4, 8],
+                                invalid_label=0)
+
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(hid, prefix="lstm_l0_"))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=emb, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, hid))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    first = None
+    for epoch in range(4):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        ppl = metric.get()[1]
+        if first is None:
+            first = ppl
+    assert ppl < first, "perplexity did not improve: %s -> %s" % (first,
+                                                                  ppl)
+
+
+def test_symbol_sequence_length_input():
+    """sequence_length binds as a real symbol input (review r4 finding)."""
+    data = mx.sym.Variable("data")
+    seqlen = mx.sym.Variable("len")
+    s = mx.sym.SequenceMask(data=data, sequence_length=seqlen,
+                            use_sequence_length=True, value=0.0)
+    assert "len" in s.list_arguments()
+    exe = s.simple_bind(data=(4, 2, 3), len=(2,))
+    exe.arg_dict["data"][:] = np.ones((4, 2, 3), "f")
+    exe.arg_dict["len"][:] = np.array([2, 4], "f")
+    out = exe.forward()[0].asnumpy()
+    assert out[2:, 0].sum() == 0 and out[:, 1].sum() > 0
+
+
+def test_symbol_positional_overflow_raises():
+    with pytest.raises(TypeError):
+        mx.sym.relu(mx.sym.Variable("a"), mx.sym.Variable("b"))
+
+
+def test_lr_mult_flows_to_optimizer():
+    """sym.Variable(lr_mult=0) freezes a param through Module."""
+    w = mx.sym.Variable("fcw", lr_mult=0.0)
+    out = mx.sym.FullyConnected(data=mx.sym.Variable("data"), weight=w,
+                                num_hidden=3, name="fc")
+    out = mx.sym.LinearRegressionOutput(
+        data=out, label=mx.sym.Variable("lab"))
+    mod = mx.mod.Module(out, data_names=("data",), label_names=("lab",))
+    from mxnet_tpu.io import DataBatch
+    mod.bind(data_shapes=[("data", (4, 5))],
+             label_shapes=[("lab", (4, 3))])
+    mod.init_params(initializer=mx.init.Uniform(0.5))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    before = mod.get_params()[0]["fcw"].asnumpy().copy()
+    batch = DataBatch([mx.nd.array(np.random.rand(4, 5))],
+                      [mx.nd.array(np.random.rand(4, 3))])
+    for _ in range(3):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    after = mod.get_params()[0]["fcw"].asnumpy()
+    np.testing.assert_allclose(before, after)
+    # bias (no lr_mult) did move
+    assert not np.allclose(
+        mod.get_params()[0]["fc_bias"].asnumpy(), 0.0)

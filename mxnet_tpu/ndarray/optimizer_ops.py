@@ -1,19 +1,16 @@
 """Fused optimizer update ops with the reference's in-place semantics.
 
-ref: src/operator/optimizer_op.cc registrations + kernels in
-optimizer_op-inl.h (SGDKernel :382, SGDMomKernel :600, NAGMomKernel
-:1060, AdamUpdateKernel :1302, RMSPropUpdateKernel :1717,
-RMSPropAlexUpdateKernel :1619, FTRLKernel :1797, FTMLKernel :1214,
-SignSGDKernel :1998, SignumKernel :2066) and
-src/operator/contrib/adamw.cc, multi_lars.cc,
-src/operator/optimizer_op.cc multi_sgd/preloaded variants.
+ref: src/operator/optimizer_op.cc registrations (kernel line refs live
+with the math in ops/optimizer_ops.py, the pure functional registry
+layer these wrappers share with the symbolic executor).
 
 These are the nd-level entry points (`mx.nd.sgd_update(w, g, out=w, ...)`)
 that the reference's Python optimizers call into. State inputs (momentum,
 mean/var, n/z/...) are updated IN PLACE on the passed NDArrays, and the
 new weight is returned (written into ``out`` when given) — exactly the
-reference's calling convention. The Python `mxnet_tpu.optimizer` classes
-keep their own fused-jit path; these ops exist for direct-API parity.
+reference's calling convention. The pure ops return every updated tensor
+explicitly (XLA has no aliasing); this layer maps those outputs back onto
+the state NDArrays.
 
 On TPU each call XLA-dispatches a small fused program; for whole-step
 fusion use ShardedTrainStep (parallel/train.py), which compiles the
@@ -23,6 +20,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..ops import optimizer_ops as _pure
 from .ndarray import NDArray
 
 __all__ = [
@@ -43,8 +41,8 @@ def _d(x):
     return x._data if isinstance(x, NDArray) else jnp.asarray(x)
 
 
-def _clip(g, c):
-    return jnp.clip(g, -c, c) if c is not None and c >= 0 else g
+def _scalar(v):
+    return float(v) if not isinstance(v, NDArray) else _d(v)
 
 
 def _deliver(out, new_w):
@@ -54,107 +52,92 @@ def _deliver(out, new_w):
     return NDArray(new_w)
 
 
-def _scalar(v):
-    return float(v) if not isinstance(v, NDArray) else _d(v)
+def _writeback(states, new_vals):
+    """Map the pure op's extra outputs onto the state NDArrays in place,
+    preserving each state's dtype (the reference mutates them)."""
+    for st, new in zip(states, new_vals):
+        st._data = new.astype(st._data.dtype)
 
 
 def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=True, out=None, **kw):
-    """ref: optimizer_op-inl.h:382 SGDKernel."""
-    w, g = _d(weight), _d(grad)
-    g = _clip(rescale_grad * g, clip_gradient)
-    new_w = (1.0 - lr * wd) * w - lr * g
+    new_w = _pure.sgd_update(_d(weight), _d(grad), lr=lr, wd=wd,
+                             rescale_grad=rescale_grad,
+                             clip_gradient=clip_gradient)
     return _deliver(out, new_w)
 
 
 def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
                    out=None, **kw):
-    """ref: optimizer_op-inl.h:600 SGDMomKernel (mom updated in place)."""
-    w, g, m = _d(weight), _d(grad), _d(mom)
-    g = _clip(rescale_grad * g, clip_gradient)
-    new_m = momentum * m - lr * wd * w - lr * g
-    mom._data = new_m.astype(mom._data.dtype)
-    return _deliver(out, w + new_m)
+    new_w, new_m = _pure.sgd_mom_update(
+        _d(weight), _d(grad), _d(mom), lr=lr, momentum=momentum, wd=wd,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    _writeback([mom], [new_m])
+    return _deliver(out, new_w)
 
 
 def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, lazy_update=True, out=None, **kw):
-    """Multi-precision SGD: update fp32 master, cast down
-    (ref: optimizer_op-inl.h MP_SGDKernel)."""
-    w32, g = _d(weight32), _d(grad).astype(jnp.float32)
-    g = _clip(rescale_grad * g, clip_gradient)
-    new_w32 = (1.0 - lr * wd) * w32 - lr * g
+    new_w, new_w32 = _pure.mp_sgd_update(
+        _d(weight), _d(grad), _d(weight32), lr=lr, wd=wd,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
     weight32._data = new_w32
-    return _deliver(out if out is not None else weight,
-                    new_w32.astype(_d(weight).dtype))
+    return _deliver(out if out is not None else weight, new_w)
 
 
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       lazy_update=True, out=None, **kw):
-    """ref: optimizer_op-inl.h MP_SGDMomKernel."""
-    w32, g, m = _d(weight32), _d(grad).astype(jnp.float32), _d(mom)
-    g = _clip(rescale_grad * g, clip_gradient)
-    new_m = momentum * m - lr * wd * w32 - lr * g
+    new_w, new_m, new_w32 = _pure.mp_sgd_mom_update(
+        _d(weight), _d(grad), _d(mom), _d(weight32), lr=lr,
+        momentum=momentum, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient)
     mom._data = new_m
-    new_w32 = w32 + new_m
     weight32._data = new_w32
-    return _deliver(out if out is not None else weight,
-                    new_w32.astype(_d(weight).dtype))
+    return _deliver(out if out is not None else weight, new_w)
 
 
 def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, out=None, **kw):
-    """Nesterov momentum (ref: optimizer_op-inl.h:1060 NAGMomKernel)."""
-    w, g, m = _d(weight), _d(grad), _d(mom)
-    g = _clip(rescale_grad * g, clip_gradient) + wd * w
-    m_scaled = momentum * m
-    new_w = w - m_scaled + (momentum + 1.0) * (m_scaled - lr * g)
-    mom._data = (m_scaled - lr * g).astype(mom._data.dtype)
+    new_w, new_m = _pure.nag_mom_update(
+        _d(weight), _d(grad), _d(mom), lr=lr, momentum=momentum, wd=wd,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    _writeback([mom], [new_m])
     return _deliver(out, new_w)
 
 
 def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       out=None, **kw):
-    """ref: optimizer_op-inl.h MP_NAGMomKernel."""
-    w32, g, m = _d(weight32), _d(grad).astype(jnp.float32), _d(mom)
-    g = _clip(rescale_grad * g, clip_gradient) + wd * w32
-    m_scaled = momentum * m
-    new_w32 = w32 - m_scaled + (momentum + 1.0) * (m_scaled - lr * g)
-    mom._data = m_scaled - lr * g
+    new_w, new_m, new_w32 = _pure.mp_nag_mom_update(
+        _d(weight), _d(grad), _d(mom), _d(weight32), lr=lr,
+        momentum=momentum, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient)
+    mom._data = new_m
     weight32._data = new_w32
-    return _deliver(out if out is not None else weight,
-                    new_w32.astype(_d(weight).dtype))
+    return _deliver(out if out is not None else weight, new_w)
 
 
 def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True, out=None, **kw):
-    """ref: optimizer_op-inl.h:1302 AdamUpdateKernel (no bias correction —
-    the Python optimizer folds it into lr, like the reference)."""
-    w, g = _d(weight), _d(grad)
-    m, v = _d(mean), _d(var)
-    g = _clip(g * rescale_grad + wd * w, clip_gradient)
-    new_m = beta1 * m + (1.0 - beta1) * g
-    new_v = beta2 * v + (1.0 - beta2) * g * g
-    mean._data = new_m.astype(m.dtype)
-    var._data = new_v.astype(v.dtype)
-    return _deliver(out, w - lr * new_m / (jnp.sqrt(new_v) + epsilon))
+    new_w, new_m, new_v = _pure.adam_update(
+        _d(weight), _d(grad), _d(mean), _d(var), lr=lr, beta1=beta1,
+        beta2=beta2, epsilon=epsilon, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient)
+    _writeback([mean, var], [new_m, new_v])
+    return _deliver(out, new_w)
 
 
 def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
                    out=None, **kw):
-    """ref: optimizer_op-inl.h:1717 RMSPropUpdateKernel."""
-    w, g, sn = _d(weight), _d(grad), _d(n)
-    g = _clip(rescale_grad * g + wd * w, clip_gradient)
-    new_n = (1.0 - gamma1) * g * g + gamma1 * sn
-    n._data = new_n.astype(sn.dtype)
-    new_w = w - lr * g / jnp.sqrt(new_n + epsilon)
-    if clip_weights is not None and clip_weights >= 0:
-        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    new_w, new_n = _pure.rmsprop_update(
+        _d(weight), _d(grad), _d(n), lr=lr, gamma1=gamma1, epsilon=epsilon,
+        wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient,
+        clip_weights=clip_weights)
+    _writeback([n], [new_n])
     return _deliver(out, new_w)
 
 
@@ -162,157 +145,111 @@ def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0, out=None,
                        **kw):
-    """Graves' RMSProp (ref: optimizer_op-inl.h:1619
-    RMSPropAlexUpdateKernel)."""
-    w, gr = _d(weight), _d(grad)
-    sn, sg, sd = _d(n), _d(g), _d(delta)
-    gr = _clip(rescale_grad * gr + wd * w, clip_gradient)
-    new_n = (1.0 - gamma1) * gr * gr + gamma1 * sn
-    new_g = (1.0 - gamma1) * gr + gamma1 * sg
-    new_d = gamma2 * sd - lr * gr / jnp.sqrt(new_n - new_g * new_g
-                                             + epsilon)
-    n._data = new_n.astype(sn.dtype)
-    g._data = new_g.astype(sg.dtype)
-    delta._data = new_d.astype(sd.dtype)
-    new_w = w + new_d
-    if clip_weights is not None and clip_weights >= 0:
-        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    new_w, new_n, new_g, new_d = _pure.rmspropalex_update(
+        _d(weight), _d(grad), _d(n), _d(g), _d(delta), lr=lr,
+        gamma1=gamma1, gamma2=gamma2, epsilon=epsilon, wd=wd,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient,
+        clip_weights=clip_weights)
+    _writeback([n, g, delta], [new_n, new_g, new_d])
     return _deliver(out, new_w)
 
 
 def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0, out=None, **kw):
-    """ref: optimizer_op-inl.h:1797 FTRLKernel."""
-    w, g = _d(weight), _d(grad)
-    sz, sn = _d(z), _d(n)
-    g = _clip(rescale_grad * g, clip_gradient)
-    new_z = sz + g - (jnp.sqrt(sn + g * g) - jnp.sqrt(sn)) / lr * w
-    new_n = sn + g * g
-    z._data = new_z.astype(sz.dtype)
-    n._data = new_n.astype(sn.dtype)
-    new_w = jnp.where(
-        jnp.abs(new_z) <= lamda1, jnp.zeros_like(w),
-        (jnp.sign(new_z) * lamda1 - new_z)
-        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    new_w, new_z, new_n = _pure.ftrl_update(
+        _d(weight), _d(grad), _d(z), _d(n), lr=lr, lamda1=lamda1,
+        beta=beta, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient)
+    _writeback([z, n], [new_z, new_n])
     return _deliver(out, new_w)
 
 
 def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
                 out=None, **kw):
-    """ref: optimizer_op-inl.h:1214 FTMLKernel."""
-    w, g = _d(weight), _d(grad)
-    sd, sv, sz = _d(d), _d(v), _d(z)
-    g = _clip(rescale_grad * g + wd * w, clip_grad)
-    t = float(t)
-    new_v = beta2 * sv + (1.0 - beta2) * g * g
-    d_t = (1.0 - beta1 ** t) / lr * (
-        jnp.sqrt(new_v / (1.0 - beta2 ** t)) + epsilon)
-    sigma = d_t - beta1 * sd
-    new_z = beta1 * sz + (1.0 - beta1) * g - sigma * w
-    d._data = d_t.astype(sd.dtype)
-    v._data = new_v.astype(sv.dtype)
-    z._data = new_z.astype(sz.dtype)
-    return _deliver(out, -new_z / d_t)
+    new_w, new_d, new_v, new_z = _pure.ftml_update(
+        _d(weight), _d(grad), _d(d), _d(v), _d(z), lr=lr, t=t,
+        beta1=beta1, beta2=beta2, epsilon=epsilon, wd=wd,
+        rescale_grad=rescale_grad, clip_grad=clip_grad)
+    _writeback([d, v, z], [new_d, new_v, new_z])
+    return _deliver(out, new_w)
 
 
 def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, out=None, **kw):
-    """ref: optimizer_op-inl.h:1998 SignSGDKernel."""
-    w, g = _d(weight), _d(grad)
-    return _deliver(out, (1.0 - lr * wd) * w - lr * jnp.sign(g))
+    new_w = _pure.signsgd_update(_d(weight), _d(grad), lr=lr, wd=wd,
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+    return _deliver(out, new_w)
 
 
 def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0,
                   out=None, **kw):
-    """ref: optimizer_op-inl.h:2066 SignumKernel."""
-    w, g, m = _d(weight), _d(grad), _d(mom)
-    g = _clip(rescale_grad * g, clip_gradient)
-    new_m = momentum * m - (1.0 - momentum) * wd * w - (1.0 - momentum) * g
-    mom._data = new_m.astype(m.dtype)
-    return _deliver(out, (1.0 - lr * wd_lh) * w + lr * jnp.sign(new_m))
+    new_w, new_m = _pure.signum_update(
+        _d(weight), _d(grad), _d(mom), lr=lr, momentum=momentum, wd=wd,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient,
+        wd_lh=wd_lh)
+    _writeback([mom], [new_m])
+    return _deliver(out, new_w)
 
 
 def adamw_update(weight, grad, mean, var, rescale_grad, lr, eta,
                  beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                  clip_gradient=-1.0, out=None, **kw):
-    """Decoupled weight decay Adam (ref: src/operator/contrib/adamw.cc
-    _adamw_update; rescale_grad is a TENSOR input there)."""
-    w, g = _d(weight), _d(grad)
-    m, v = _d(mean), _d(var)
-    g = _clip(g * _scalar(rescale_grad), clip_gradient)
-    new_m = beta1 * m + (1.0 - beta1) * g
-    new_v = beta2 * v + (1.0 - beta2) * g * g
-    mean._data = new_m.astype(m.dtype)
-    var._data = new_v.astype(v.dtype)
-    new_w = w - eta * (lr * new_m / (jnp.sqrt(new_v) + epsilon) + wd * w)
+    """rescale_grad is a TENSOR input in the reference (adamw.cc); both
+    scalar and NDArray are accepted here."""
+    new_w, new_m, new_v = _pure.adamw_update(
+        _d(weight), _d(grad), _d(mean), _d(var),
+        rescale_grad=_scalar(rescale_grad), lr=lr, eta=eta, beta1=beta1,
+        beta2=beta2, epsilon=epsilon, wd=wd, clip_gradient=clip_gradient)
+    _writeback([mean, var], [new_m, new_v])
     return _deliver(out, new_w)
 
 
 def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, lr,
                     eta, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                     clip_gradient=-1.0, out=None, **kw):
-    """ref: src/operator/contrib/adamw.cc _mp_adamw_update."""
-    w32 = _d(weight32)
-    g = _d(grad).astype(jnp.float32)
-    m, v = _d(mean), _d(var)
-    g = _clip(g * _scalar(rescale_grad), clip_gradient)
-    new_m = beta1 * m + (1.0 - beta1) * g
-    new_v = beta2 * v + (1.0 - beta2) * g * g
+    new_w, new_m, new_v, new_w32 = _pure.mp_adamw_update(
+        _d(weight), _d(grad), _d(mean), _d(var), _d(weight32),
+        rescale_grad=_scalar(rescale_grad), lr=lr, eta=eta, beta1=beta1,
+        beta2=beta2, epsilon=epsilon, wd=wd, clip_gradient=clip_gradient)
     mean._data = new_m
     var._data = new_v
-    new_w32 = w32 - eta * (lr * new_m / (jnp.sqrt(new_v) + epsilon)
-                           + wd * w32)
     weight32._data = new_w32
-    return _deliver(out if out is not None else weight,
-                    new_w32.astype(_d(weight).dtype))
+    return _deliver(out if out is not None else weight, new_w)
 
 
 def lamb_update_phase1(weight, grad, mean, var, lr=None, beta1=0.9,
                        beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
                        wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                        out=None, **kw):
-    """ref: src/operator/optimizer_op.cc lamb_update_phase1."""
-    w, g = _d(weight), _d(grad)
-    m, v = _d(mean), _d(var)
-    g = _clip(rescale_grad * g, clip_gradient)
-    new_m = beta1 * m + (1.0 - beta1) * g
-    new_v = beta2 * v + (1.0 - beta2) * g * g
-    mean._data = new_m.astype(m.dtype)
-    var._data = new_v.astype(v.dtype)
-    mh, vh = new_m, new_v
-    if bias_correction:
-        t = float(t)
-        mh = new_m / (1.0 - beta1 ** t)
-        vh = new_v / (1.0 - beta2 ** t)
-    return _deliver(out, mh / (jnp.sqrt(vh) + epsilon) + wd * w)
+    g_out, new_m, new_v = _pure.lamb_update_phase1(
+        _d(weight), _d(grad), _d(mean), _d(var), lr=lr, beta1=beta1,
+        beta2=beta2, epsilon=epsilon, t=t, bias_correction=bias_correction,
+        wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    _writeback([mean, var], [new_m, new_v])
+    return _deliver(out, g_out)
 
 
 def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
                        upper_bound=-1.0, out=None, **kw):
-    """ref: src/operator/optimizer_op.cc lamb_update_phase2."""
-    w, gd = _d(weight), _d(g)
-    r1v, r2v = _d(r1), _d(r2)
-    if lower_bound is not None and lower_bound >= 0:
-        r1v = jnp.maximum(r1v, lower_bound)
-    if upper_bound is not None and upper_bound >= 0:
-        r1v = jnp.minimum(r1v, upper_bound)
-    ratio = jnp.where(jnp.logical_and(r1v > 0, r2v > 0), r1v / r2v, 1.0)
-    return _deliver(out, w - lr * ratio * gd)
+    new_w = _pure.lamb_update_phase2(
+        _d(weight), _d(g), _d(r1), _d(r2), lr=lr,
+        lower_bound=lower_bound, upper_bound=upper_bound)
+    return _deliver(out, new_w)
 
 
 def sparse_adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
                           rescale_grad=1.0, clip_gradient=-1.0, out=None,
                           **kw):
-    """AdaGrad with history state (ref: src/operator/optimizer_op.cc
-    _sparse_adagrad_update; dense emulation of the row-sparse path)."""
-    w, g, h = _d(weight), _d(grad), _d(history)
-    g = _clip(rescale_grad * g, clip_gradient)
-    new_h = h + g * g
-    history._data = new_h.astype(h.dtype)
-    return _deliver(out, w - lr * (g / (jnp.sqrt(new_h) + epsilon)
-                                   + wd * w))
+    """Dense emulation of the row-sparse path (ref: optimizer_op.cc
+    _sparse_adagrad_update)."""
+    new_w, new_h = _pure.sparse_adagrad_update(
+        _d(weight), _d(grad), _d(history), lr=lr, epsilon=epsilon, wd=wd,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    _writeback([history], [new_h])
+    return _deliver(out, new_w)
 
 
 group_adagrad_update = sparse_adagrad_update  # ref: contrib/optimizer_op.cc
@@ -320,16 +257,10 @@ group_adagrad_update = sparse_adagrad_update  # ref: contrib/optimizer_op.cc
 
 def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
                eps=1e-8, rescale_grad=1.0, out=None, **kw):
-    """LARS trust-ratio learning rates (ref: src/operator/contrib/
-    multi_lars.cc)."""
-    lr_v = _d(lrs)
-    w2, g2, wd_v = _d(weights_sum_sq), _d(grads_sum_sq), _d(wds)
-    wn = jnp.sqrt(w2)
-    gn = jnp.sqrt(g2) * rescale_grad
-    ratio = jnp.where(
-        jnp.logical_and(wn > 0, gn > 0),
-        eta * wn / (gn + wd_v * wn + eps), jnp.ones_like(wn))
-    return _deliver(out, lr_v * ratio)
+    new_lrs = _pure.multi_lars(_d(lrs), _d(weights_sum_sq),
+                               _d(grads_sum_sq), _d(wds), eta=eta, eps=eps,
+                               rescale_grad=rescale_grad)
+    return _deliver(out, new_lrs)
 
 
 # -- multi-tensor variants ---------------------------------------------------

@@ -15,6 +15,7 @@ from . import ctc            # noqa: F401
 from . import extended       # noqa: F401  (after nn: aliases core ops)
 from . import detection      # noqa: F401  (Faster-RCNN/R-FCN/SSD family)
 from . import image          # noqa: F401  (mx.nd.image namespace ops)
+from . import optimizer_ops  # noqa: F401  (pure fused update ops)
 from . import misc_tail      # noqa: F401  (hawkesll/count_sketch/...)
 from . import quantized      # noqa: F401  (INT8 op family)
 

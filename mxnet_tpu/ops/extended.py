@@ -183,7 +183,8 @@ def cast_storage(data, stype="default"):
     return data
 
 
-@register("_sparse_retain", num_inputs=2)
+@register("_sparse_retain", num_inputs=2, aliases=("sparse_retain",),
+          input_names=("data", "indices"))
 def _sparse_retain(data, indices):
     """Dense emulation of row_sparse retain: rows not in `indices` zeroed
     (ref: src/operator/tensor/sparse_retain.cc)."""

@@ -1,0 +1,416 @@
+"""Fused optimizer update ops — pure functional registry forms.
+
+ref: src/operator/optimizer_op.cc registrations + kernels in
+optimizer_op-inl.h (SGDKernel :382, SGDMomKernel :600, NAGMomKernel
+:1060, AdamUpdateKernel :1302, RMSPropUpdateKernel :1717,
+RMSPropAlexUpdateKernel :1619, FTRLKernel :1797, FTMLKernel :1214,
+SignSGDKernel :1998, SignumKernel :2066), src/operator/contrib/adamw.cc,
+multi_lars.cc, and the multi_sgd/preloaded variants.
+
+The reference's ops mutate their state inputs in place. XLA programs
+have no aliasing, so the registry forms here are PURE: every updated
+tensor is an explicit output — ``sgd_mom_update`` returns
+``(new_weight, new_mom)``. This is the TPU-idiomatic dataflow contract
+and what the symbolic executor compiles. The `mx.nd.*_update` wrappers
+(ndarray/optimizer_ops.py) restore the reference's imperative in-place
+calling convention on top of these.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _clip(g, c):
+    return jnp.clip(g, -c, c) if c is not None and c >= 0 else g
+
+
+def _wclip(w, c):
+    if c is not None and c >= 0:
+        return jnp.clip(w, -c, c)
+    return w
+
+
+@register("sgd_update", num_inputs=2, no_grad=True,
+          input_names=("weight", "grad"))
+def sgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    """ref: optimizer_op-inl.h:382 SGDKernel."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    return (1.0 - lr * wd) * weight - lr * g
+
+
+@register("sgd_mom_update", num_inputs=3, no_grad=True, num_outputs=2,
+          input_names=("weight", "grad", "mom"))
+def sgd_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """ref: optimizer_op-inl.h:600 SGDMomKernel -> (new_w, new_mom)."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    new_m = momentum * mom - lr * wd * weight - lr * g
+    return weight + new_m, new_m
+
+
+@register("mp_sgd_update", num_inputs=3, no_grad=True, num_outputs=2,
+          input_names=("weight", "grad", "weight32"))
+def mp_sgd_update(weight, grad, weight32, lr=None, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """ref: optimizer_op-inl.h MP_SGDKernel -> (new_w, new_w32)."""
+    g = _clip(rescale_grad * grad.astype(jnp.float32), clip_gradient)
+    new_w32 = (1.0 - lr * wd) * weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", num_inputs=4, no_grad=True, num_outputs=3,
+          input_names=("weight", "grad", "mom", "weight32"))
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    """ref: optimizer_op-inl.h MP_SGDMomKernel -> (new_w, new_mom,
+    new_w32)."""
+    g = _clip(rescale_grad * grad.astype(jnp.float32), clip_gradient)
+    new_m = momentum * mom - lr * wd * weight32 - lr * g
+    new_w32 = weight32 + new_m
+    return new_w32.astype(weight.dtype), new_m, new_w32
+
+
+@register("nag_mom_update", num_inputs=3, no_grad=True, num_outputs=2,
+          input_names=("weight", "grad", "mom"))
+def nag_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov momentum (ref: optimizer_op-inl.h:1060 NAGMomKernel)
+    -> (new_w, new_mom)."""
+    g = _clip(rescale_grad * grad, clip_gradient) + wd * weight
+    m_scaled = momentum * mom
+    new_m = m_scaled - lr * g
+    new_w = weight - m_scaled + (momentum + 1.0) * new_m
+    return new_w, new_m
+
+
+@register("mp_nag_mom_update", num_inputs=4, no_grad=True, num_outputs=3,
+          input_names=("weight", "grad", "mom", "weight32"))
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: optimizer_op-inl.h MP_NAGMomKernel -> (new_w, new_mom,
+    new_w32)."""
+    g = _clip(rescale_grad * grad.astype(jnp.float32), clip_gradient) \
+        + wd * weight32
+    m_scaled = momentum * mom
+    new_m = m_scaled - lr * g
+    new_w32 = weight32 - m_scaled + (momentum + 1.0) * new_m
+    return new_w32.astype(weight.dtype), new_m, new_w32
+
+
+@register("adam_update", num_inputs=4, no_grad=True, num_outputs=3,
+          input_names=("weight", "grad", "mean", "var"))
+def adam_update(weight, grad, mean, var, lr=None, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    """ref: optimizer_op-inl.h:1302 AdamUpdateKernel (no bias correction —
+    the Python optimizer folds it into lr) -> (new_w, new_mean, new_var)."""
+    g = _clip(grad * rescale_grad + wd * weight, clip_gradient)
+    new_m = beta1 * mean + (1.0 - beta1) * g
+    new_v = beta2 * var + (1.0 - beta2) * g * g
+    new_w = weight - lr * new_m / (jnp.sqrt(new_v) + epsilon)
+    return new_w, new_m, new_v
+
+
+@register("rmsprop_update", num_inputs=3, no_grad=True, num_outputs=2,
+          input_names=("weight", "grad", "n"))
+def rmsprop_update(weight, grad, n, lr=None, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    """ref: optimizer_op-inl.h:1717 RMSPropUpdateKernel -> (new_w, new_n)."""
+    g = _clip(rescale_grad * grad + wd * weight, clip_gradient)
+    new_n = (1.0 - gamma1) * g * g + gamma1 * n
+    new_w = _wclip(weight - lr * g / jnp.sqrt(new_n + epsilon),
+                   clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", num_inputs=5, no_grad=True, num_outputs=4,
+          input_names=("weight", "grad", "n", "g", "delta"))
+def rmspropalex_update(weight, grad, n, g, delta, lr=None, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Graves' RMSProp (ref: optimizer_op-inl.h:1619) -> (new_w, new_n,
+    new_g, new_delta)."""
+    gr = _clip(rescale_grad * grad + wd * weight, clip_gradient)
+    new_n = (1.0 - gamma1) * gr * gr + gamma1 * n
+    new_g = (1.0 - gamma1) * gr + gamma1 * g
+    new_d = gamma2 * delta \
+        - lr * gr / jnp.sqrt(new_n - new_g * new_g + epsilon)
+    new_w = _wclip(weight + new_d, clip_weights)
+    return new_w, new_n, new_g, new_d
+
+
+@register("ftrl_update", num_inputs=4, no_grad=True, num_outputs=3,
+          input_names=("weight", "grad", "z", "n"))
+def ftrl_update(weight, grad, z, n, lr=None, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: optimizer_op-inl.h:1797 FTRLKernel -> (new_w, new_z, new_n)."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    new_z = z + g - (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr * weight
+    new_n = n + g * g
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        (jnp.sign(new_z) * lamda1 - new_z)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+@register("ftml_update", num_inputs=5, no_grad=True, num_outputs=4,
+          input_names=("weight", "grad", "d", "v", "z"))
+def ftml_update(weight, grad, d, v, z, lr=None, t=1, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    """ref: optimizer_op-inl.h:1214 FTMLKernel -> (new_w, new_d, new_v,
+    new_z)."""
+    g = _clip(rescale_grad * grad + wd * weight, clip_grad)
+    t = float(t)
+    new_v = beta2 * v + (1.0 - beta2) * g * g
+    d_t = (1.0 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1.0 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1.0 - beta1) * g - sigma * weight
+    return -new_z / d_t, d_t, new_v, new_z
+
+
+@register("signsgd_update", num_inputs=2, no_grad=True,
+          input_names=("weight", "grad"))
+def signsgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    """ref: optimizer_op-inl.h:1998 SignSGDKernel."""
+    return (1.0 - lr * wd) * weight - lr * jnp.sign(grad)
+
+
+@register("signum_update", num_inputs=3, no_grad=True, num_outputs=2,
+          input_names=("weight", "grad", "mom"))
+def signum_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """ref: optimizer_op-inl.h:2066 SignumKernel -> (new_w, new_mom)."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    new_m = momentum * mom - (1.0 - momentum) * wd * weight \
+        - (1.0 - momentum) * g
+    return (1.0 - lr * wd_lh) * weight + lr * jnp.sign(new_m), new_m
+
+
+@register("adamw_update", num_inputs=4, no_grad=True, num_outputs=3,
+          input_names=("weight", "grad", "mean", "var"))
+def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=None,
+                 eta=None, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                 clip_gradient=-1.0):
+    """Decoupled weight decay Adam (ref: contrib/adamw.cc _adamw_update;
+    rescale_grad is a scalar attr here, a tensor there)
+    -> (new_w, new_mean, new_var)."""
+    g = _clip(grad * rescale_grad, clip_gradient)
+    new_m = beta1 * mean + (1.0 - beta1) * g
+    new_v = beta2 * var + (1.0 - beta2) * g * g
+    new_w = weight - eta * (lr * new_m / (jnp.sqrt(new_v) + epsilon)
+                            + wd * weight)
+    return new_w, new_m, new_v
+
+
+@register("mp_adamw_update", num_inputs=5, no_grad=True, num_outputs=4,
+          input_names=("weight", "grad", "mean", "var", "weight32"))
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=1.0,
+                    lr=None, eta=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                    wd=0.0, clip_gradient=-1.0):
+    """ref: contrib/adamw.cc _mp_adamw_update -> (new_w, new_mean,
+    new_var, new_w32)."""
+    g = _clip(grad.astype(jnp.float32) * rescale_grad, clip_gradient)
+    new_m = beta1 * mean + (1.0 - beta1) * g
+    new_v = beta2 * var + (1.0 - beta2) * g * g
+    new_w32 = weight32 - eta * (lr * new_m / (jnp.sqrt(new_v) + epsilon)
+                                + wd * weight32)
+    return new_w32.astype(weight.dtype), new_m, new_v, new_w32
+
+
+@register("lamb_update_phase1", num_inputs=4, no_grad=True, num_outputs=3,
+          input_names=("weight", "grad", "mean", "var"))
+def lamb_update_phase1(weight, grad, mean, var, lr=None, beta1=0.9,
+                       beta2=0.999, epsilon=1e-6, t=1,
+                       bias_correction=True, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    """ref: optimizer_op.cc lamb_update_phase1 -> (g_out, new_mean,
+    new_var)."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    new_m = beta1 * mean + (1.0 - beta1) * g
+    new_v = beta2 * var + (1.0 - beta2) * g * g
+    mh, vh = new_m, new_v
+    if bias_correction:
+        t = float(t)
+        mh = new_m / (1.0 - beta1 ** t)
+        vh = new_v / (1.0 - beta2 ** t)
+    return mh / (jnp.sqrt(vh) + epsilon) + wd * weight, new_m, new_v
+
+
+@register("lamb_update_phase2", num_inputs=4, no_grad=True,
+          input_names=("weight", "g", "r1", "r2"))
+def lamb_update_phase2(weight, g, r1, r2, lr=None, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    """ref: optimizer_op.cc lamb_update_phase2."""
+    r1v, r2v = r1, r2
+    if lower_bound is not None and lower_bound >= 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1v > 0, r2v > 0), r1v / r2v, 1.0)
+    return weight - lr * ratio * g
+
+
+@register("sparse_adagrad_update", num_inputs=3, no_grad=True,
+          num_outputs=2, aliases=("group_adagrad_update",),
+          input_names=("weight", "grad", "history"))
+def sparse_adagrad_update(weight, grad, history, lr=None, epsilon=1e-7,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad with accumulated history (ref: optimizer_op.cc
+    _sparse_adagrad_update; contrib group_adagrad shares the kernel)
+    -> (new_w, new_history)."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    new_h = history + g * g
+    new_w = weight - lr * (g / (jnp.sqrt(new_h) + epsilon) + wd * weight)
+    return new_w, new_h
+
+
+@register("multi_lars", num_inputs=4, no_grad=True,
+          input_names=("lrs", "weights_sum_sq", "grads_sum_sq", "wds"))
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """LARS trust-ratio learning rates (ref: contrib/multi_lars.cc)."""
+    wn = jnp.sqrt(weights_sum_sq)
+    gn = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = jnp.where(jnp.logical_and(wn > 0, gn > 0),
+                      eta * wn / (gn + wds * wn + eps), jnp.ones_like(wn))
+    return lrs * ratio
+
+
+def _norm_list(v, n):
+    # entries may be python floats (attrs) or traced jax scalars (the
+    # preloaded variants index their lrs/wds tensor inputs) — no float()
+    if isinstance(v, (tuple, list)):
+        return list(v)
+    return [v] * n
+
+
+def _multi_pure(single, n_per, n_states, data, num_weights, lrs, wds,
+                kwargs):
+    """Apply a pure single update over interleaved groups; returns all
+    new weights, then all new state tensors group-major (the reference
+    mutates states in place; the pure form makes them outputs)."""
+    num_weights = int(num_weights)
+    lrs = _norm_list(lrs, num_weights)
+    wds = _norm_list(wds, num_weights)
+    new_ws, new_states = [], []
+    for i in range(num_weights):
+        group = data[i * n_per:(i + 1) * n_per]
+        res = single(*group, lr=lrs[i], wd=wds[i], **kwargs)
+        if n_states:
+            new_ws.append(res[0])
+            new_states.extend(res[1:])
+        else:
+            new_ws.append(res)
+    return tuple(new_ws) + tuple(new_states)
+
+
+def _multi_nout(states_per_weight):
+    def count(attrs):
+        return int(attrs.get("num_weights", 1)) * (1 + states_per_weight)
+    return count
+
+
+@register("multi_sgd_update", no_grad=True, num_outputs=_multi_nout(0))
+def multi_sgd_update(*data, lrs=None, wds=None, num_weights=1,
+                     rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: optimizer_op.cc multi_sgd_update — (w, g) x N -> new weights."""
+    return _multi_pure(sgd_update, 2, 0, data, num_weights, lrs, wds,
+                       dict(rescale_grad=rescale_grad,
+                            clip_gradient=clip_gradient))
+
+
+@register("multi_sgd_mom_update", no_grad=True, num_outputs=_multi_nout(1))
+def multi_sgd_mom_update(*data, lrs=None, wds=None, num_weights=1,
+                         momentum=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0):
+    """ref: optimizer_op.cc multi_sgd_mom_update — (w, g, mom) x N
+    -> (new_w x N, new_mom x N)."""
+    return _multi_pure(sgd_mom_update, 3, 1, data, num_weights, lrs, wds,
+                       dict(momentum=momentum, rescale_grad=rescale_grad,
+                            clip_gradient=clip_gradient))
+
+
+@register("multi_mp_sgd_update", no_grad=True, num_outputs=_multi_nout(1))
+def multi_mp_sgd_update(*data, lrs=None, wds=None, num_weights=1,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: optimizer_op.cc multi_mp_sgd_update — (w, g, w32) x N
+    -> (new_w x N, new_w32 x N)."""
+    return _multi_pure(mp_sgd_update, 3, 1, data, num_weights, lrs, wds,
+                       dict(rescale_grad=rescale_grad,
+                            clip_gradient=clip_gradient))
+
+
+@register("multi_mp_sgd_mom_update", no_grad=True,
+          num_outputs=_multi_nout(2))
+def multi_mp_sgd_mom_update(*data, lrs=None, wds=None, num_weights=1,
+                            momentum=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0):
+    """ref: optimizer_op.cc multi_mp_sgd_mom_update — (w, g, mom, w32) x N
+    -> (new_w x N, (new_mom, new_w32) x N)."""
+    return _multi_pure(mp_sgd_mom_update, 4, 2, data, num_weights, lrs,
+                       wds, dict(momentum=momentum,
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient))
+
+
+def _preloaded_pure(multi, n_per, data, num_weights, kwargs):
+    # trailing two tensors are the preloaded lrs/wds vectors
+    # (ref: optimizer_op.cc preloaded_multi_sgd_update)
+    lrs, wds = data[-2], data[-1]
+    num_weights = int(num_weights)
+    return multi(*data[:-2], lrs=[lrs[i] for i in range(num_weights)],
+                 wds=[wds[i] for i in range(num_weights)],
+                 num_weights=num_weights, **kwargs)
+
+
+@register("preloaded_multi_sgd_update", no_grad=True,
+          num_outputs=_multi_nout(0))
+def preloaded_multi_sgd_update(*data, num_weights=1, rescale_grad=1.0,
+                               clip_gradient=-1.0):
+    """ref: optimizer_op.cc preloaded_multi_sgd_update."""
+    return _preloaded_pure(multi_sgd_update, 2, data, num_weights,
+                           dict(rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient))
+
+
+@register("preloaded_multi_sgd_mom_update", no_grad=True,
+          num_outputs=_multi_nout(1))
+def preloaded_multi_sgd_mom_update(*data, num_weights=1, momentum=0.0,
+                                   rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: optimizer_op.cc preloaded_multi_sgd_mom_update."""
+    return _preloaded_pure(multi_sgd_mom_update, 3, data, num_weights,
+                           dict(momentum=momentum,
+                                rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient))
+
+
+@register("preloaded_multi_mp_sgd_update", no_grad=True,
+          num_outputs=_multi_nout(1))
+def preloaded_multi_mp_sgd_update(*data, num_weights=1, rescale_grad=1.0,
+                                  clip_gradient=-1.0):
+    """ref: optimizer_op.cc preloaded_multi_mp_sgd_update."""
+    return _preloaded_pure(multi_mp_sgd_update, 3, data, num_weights,
+                           dict(rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient))
+
+
+@register("preloaded_multi_mp_sgd_mom_update", no_grad=True,
+          num_outputs=_multi_nout(2))
+def preloaded_multi_mp_sgd_mom_update(*data, num_weights=1, momentum=0.0,
+                                      rescale_grad=1.0,
+                                      clip_gradient=-1.0):
+    """ref: optimizer_op.cc preloaded_multi_mp_sgd_mom_update."""
+    return _preloaded_pure(multi_mp_sgd_mom_update, 4, data, num_weights,
+                           dict(momentum=momentum,
+                                rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient))

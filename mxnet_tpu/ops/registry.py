@@ -19,23 +19,32 @@ _OPS = {}
 
 
 class OpDef:
-    __slots__ = ("name", "fn", "no_grad", "num_inputs", "aliases", "wrap_kwargs")
+    __slots__ = ("name", "fn", "no_grad", "num_inputs", "aliases",
+                 "wrap_kwargs", "num_outputs", "input_names")
 
     def __init__(self, name, fn, no_grad=False, num_inputs=None, aliases=(),
-                 wrap_kwargs=None):
+                 wrap_kwargs=None, num_outputs=None, input_names=None):
         self.name = name
         self.fn = fn
         self.no_grad = no_grad          # outputs not differentiable (int/bool)
         self.num_inputs = num_inputs    # None = variadic / inspect at call
         self.aliases = aliases
         self.wrap_kwargs = wrap_kwargs or {}
+        # symbol-graph output count: int, or callable(attrs) -> int for
+        # attr-dependent counts (the reference's FNumOutputs); None = 1
+        self.num_outputs = num_outputs
+        # explicit ordered tensor-input names; None = derive from the fn
+        # signature via the INPUT_PARAM_NAMES heuristic (symbol frontend)
+        self.input_names = input_names
 
 
-def register(name, no_grad=False, num_inputs=None, aliases=()):
+def register(name, no_grad=False, num_inputs=None, aliases=(),
+             num_outputs=None, input_names=None):
     """Decorator: register a functional op under ``name`` (+ aliases)."""
     def _reg(fn):
         opdef = OpDef(name, fn, no_grad=no_grad, num_inputs=num_inputs,
-                      aliases=aliases)
+                      aliases=aliases, num_outputs=num_outputs,
+                      input_names=input_names)
         _OPS[name] = opdef
         for a in aliases:
             _OPS[a] = opdef

@@ -491,6 +491,22 @@ def _ones(shape=(), dtype="float32"):
     return jnp.ones(tuple(shape), dtype or "float32")
 
 
+@register("arange", num_inputs=0, no_grad=True, aliases=("_arange",))
+def arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+           dtype="float32"):
+    """ref: src/operator/tensor/init_op.cc _arange (RangeParam)."""
+    out = jnp.arange(start, stop, step, dtype or "float32")
+    if repeat and int(repeat) > 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+@register("full", num_inputs=0, no_grad=True, aliases=("_full",))
+def full(shape=(), value=0.0, dtype="float32"):
+    """ref: src/operator/tensor/init_op.cc _full (InitOpWithScalarParam)."""
+    return jnp.full(tuple(shape), value, dtype or "float32")
+
+
 @register("_full", num_inputs=0, no_grad=True)
 def _full(shape=(), dtype="float32", value=0.0):
     """ref: src/operator/tensor/init_op.cc _full."""

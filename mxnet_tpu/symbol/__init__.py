@@ -7,6 +7,10 @@ from .register import populate as _populate
 _populate(globals())
 
 from . import contrib  # noqa: E402  (after populate: contrib uses registry)
+from . import random  # noqa: E402  (sub-namespaces mirror nd.<ns>)
+from . import linalg  # noqa: E402
+from . import image  # noqa: E402
+from . import sparse  # noqa: E402
 
 
 def Custom(*args, **kwargs):

@@ -20,6 +20,8 @@ _INPUT_CACHE = {}
 
 def op_input_names(opdef):
     """Ordered tensor-input parameter names of an op fn; None if variadic."""
+    if opdef.input_names is not None:
+        return list(opdef.input_names)
     if opdef.name in _INPUT_CACHE:
         return _INPUT_CACHE[opdef.name]
     sig = inspect.signature(opdef.fn)

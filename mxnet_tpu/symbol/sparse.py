@@ -1,0 +1,20 @@
+"""``mx.sym.sparse`` namespace (ref: python/mxnet/symbol/sparse.py).
+
+Sparse STORAGE is an NDArray-level concept here (XLA tensors are dense;
+see ndarray/sparse.py) — the symbolic namespace exposes the graph ops:
+``cast_storage`` is identity, ``retain`` is the dense row-masking
+emulation, ``dot`` is the shared dot op. Imperative-only constructors
+(csr_matrix/row_sparse_array) stay on the nd side."""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .register import make_symbol_op_func
+from .symbol import zeros  # noqa: F401  (sym.sparse.zeros == dense zeros)
+
+__all__ = ["cast_storage", "retain", "dot", "zeros", "add_n"]
+
+cast_storage = make_symbol_op_func(_registry.get_op("cast_storage"),
+                                   "cast_storage")
+retain = make_symbol_op_func(_registry.get_op("_sparse_retain"), "retain")
+dot = make_symbol_op_func(_registry.get_op("dot"), "dot")
+add_n = make_symbol_op_func(_registry.get_op("add_n"), "add_n")

@@ -52,7 +52,7 @@ INPUT_PARAM_NAMES = (
     "lam", "alpha",
     "loc", "scale", "shape_like", "data1", "data2", "rois", "anchors",
     "cls_pred", "loc_pred", "parameters", "state", "state_cell", "like",
-    "sequence_length",
+    "sequence_length", "A", "B", "C",
 )
 
 # aux-state naming convention (BatchNorm moving stats et al.)
@@ -475,6 +475,13 @@ def _num_outputs_of(node):
         return 2
     if node.op == "topk":
         return 2 if node.attrs.get("ret_typ") == "both" else 1
+    from ..ops import registry as _reg
+    try:
+        declared = _reg.get_op(node.op).num_outputs
+    except KeyError:
+        declared = None
+    if declared is not None:
+        return declared(node.attrs) if callable(declared) else int(declared)
     return 1
 
 

@@ -1,6 +1,32 @@
 """Experimental / contributed subsystems
 (ref: python/mxnet/contrib/__init__.py): AMP, INT8 quantization, ONNX."""
 from . import amp  # noqa: F401
+
+
+_LAZY_SUBMODULES = ("autograd", "io", "ndarray", "symbol", "tensorboard")
+
+
+def __getattr__(name):
+    # autograd/io/ndarray/symbol shims re-export frontend namespaces that
+    # themselves import contrib ops — lazy to break the import cycle
+    # (ref: python/mxnet/contrib/__init__.py imports these eagerly; its
+    # C-registry has no such cycle). `quant` aliases quantization
+    # (ref: contrib/__init__.py `from . import quantization as quant`).
+    if name == "quant":
+        from . import quantization
+        globals()["quant"] = quantization
+        return quantization
+    if name in _LAZY_SUBMODULES:
+        import importlib
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_SUBMODULES) | {"quant"})
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import svrg_optimization  # noqa: F401

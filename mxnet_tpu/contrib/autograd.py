@@ -1,0 +1,80 @@
+"""Legacy experimental autograd API (ref: python/mxnet/contrib/autograd.py)
+— thin aliases over the first-class `mxnet_tpu.autograd` tape."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..ndarray import NDArray, zeros_like
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """ref: contrib/autograd.py:32 — returns the previous state."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    _ag.set_recording(is_train)
+    return prev
+
+
+def train_section():
+    """`with train_section():` records in train mode
+    (ref: contrib/autograd.py:74)."""
+    return _ag.record(train_mode=True)
+
+
+def test_section():
+    """ref: contrib/autograd.py:88."""
+    return _ag.pause(train_mode=False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """ref: contrib/autograd.py:102."""
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """ref: contrib/autograd.py:123."""
+    return _ag.backward(outputs, head_grads=out_grads,
+                        retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """ref: contrib/autograd.py:158."""
+    return backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Wrap ``func`` to return (arg gradients, loss)
+    (ref: contrib/autograd.py:163)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            idx = argnum if isinstance(argnum, list) else [argnum]
+            variables = [args[i] for i in idx]
+        for x in variables:
+            assert isinstance(x, NDArray), \
+                "autograd input should be NDArray"
+        grads = [zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        compute_gradient([outputs] if isinstance(outputs, NDArray)
+                         else outputs)
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Wrap ``func`` to return arg gradients only
+    (ref: contrib/autograd.py:195)."""
+    wrapped = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def only_grads(*args):
+        return wrapped(*args)[0]
+    return only_grads

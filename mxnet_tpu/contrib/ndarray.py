@@ -1,0 +1,14 @@
+"""``mx.contrib.ndarray`` namespace re-export
+(ref: python/mxnet/contrib/ndarray.py — there it is generated from the
+contrib op registry; here it delegates to nd.contrib, whose surface is
+partly dynamic)."""
+from ..ndarray import contrib as _nd_contrib
+from ..ndarray.contrib import *  # noqa: F401,F403
+
+
+def __getattr__(name):
+    return getattr(_nd_contrib, name)
+
+
+def __dir__():
+    return dir(_nd_contrib)

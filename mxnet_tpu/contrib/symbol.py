@@ -1,0 +1,13 @@
+"""``mx.contrib.symbol`` namespace re-export
+(ref: python/mxnet/contrib/symbol.py — generated from the contrib op
+registry there; delegates to sym.contrib here)."""
+from ..symbol import contrib as _sym_contrib
+from ..symbol.contrib import *  # noqa: F401,F403
+
+
+def __getattr__(name):
+    return getattr(_sym_contrib, name)
+
+
+def __dir__():
+    return dir(_sym_contrib)

@@ -1,4 +1,6 @@
 """Gluon contrib (ref: python/mxnet/gluon/contrib/__init__.py)."""
+from . import cnn  # noqa: F401
+from . import data  # noqa: F401
 from . import estimator  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401

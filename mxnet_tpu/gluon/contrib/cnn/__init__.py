@@ -1,0 +1,4 @@
+"""Gluon contrib CNN layers (ref: python/mxnet/gluon/contrib/cnn/)."""
+from .conv_layers import DeformableConvolution  # noqa: F401
+
+__all__ = ["DeformableConvolution"]

@@ -1,0 +1,108 @@
+"""Contrib convolution layers
+(ref: python/mxnet/gluon/contrib/cnn/conv_layers.py:29).
+
+The DeformableConvolution block owns BOTH convolutions of the v1 design:
+the plain conv that predicts the sampling offsets and the deformable
+conv that consumes them (op: ops/detection.py deformable_convolution —
+bilinear taps gathered per static kernel position, one grouped MXU
+matmul)."""
+from __future__ import annotations
+
+from ....base import numeric_types
+from ...block import HybridBlock
+from ...nn.basic_layers import Activation
+
+__all__ = ["DeformableConvolution"]
+
+
+def _tup2(v):
+    return (v,) * 2 if isinstance(v, numeric_types) else tuple(v)
+
+
+class DeformableConvolution(HybridBlock):
+    """2-D Deformable Convolution v1 (Dai et al. 2017): a regular conv
+    learns per-position sampling offsets for the main conv
+    (ref: gluon/contrib/cnn/conv_layers.py:29)."""
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 op_name="DeformableConvolution", adj=None, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("NCHW",), \
+            "deformable convolution supports NCHW layout"
+        kernel_size = _tup2(kernel_size)
+        strides = _tup2(strides)
+        padding = _tup2(padding)
+        dilation = _tup2(dilation)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._op_name = op_name
+
+        offset_channels = 2 * kernel_size[0] * kernel_size[1] \
+            * num_deformable_group
+        self._kwargs_offset = {
+            "kernel": kernel_size, "stride": strides, "dilate": dilation,
+            "pad": padding, "num_filter": offset_channels,
+            "num_group": groups, "layout": layout}
+        self._kwargs_deform = {
+            "kernel": kernel_size, "stride": strides, "dilate": dilation,
+            "pad": padding, "num_filter": channels, "num_group": groups,
+            "num_deformable_group": num_deformable_group}
+
+        self.offset_weight = self.params.get(
+            "offset_weight",
+            shape=(offset_channels, in_channels // groups if in_channels
+                   else 0) + kernel_size,
+            init=offset_weight_initializer, allow_deferred_init=True)
+        self.offset_bias = self.params.get(
+            "offset_bias", shape=(offset_channels,),
+            init=offset_bias_initializer,
+            allow_deferred_init=True) if offset_use_bias else None
+        self.deformable_conv_weight = self.params.get(
+            "deformable_conv_weight",
+            shape=(channels, in_channels // groups if in_channels else 0)
+            + kernel_size,
+            init=weight_initializer, allow_deferred_init=True)
+        self.deformable_conv_bias = self.params.get(
+            "deformable_conv_bias", shape=(channels,),
+            init=bias_initializer,
+            allow_deferred_init=True) if use_bias else None
+        self.act = Activation(activation) if activation else None
+        self._groups = groups
+        self._kernel = kernel_size
+
+    def _shape_hint(self, x, *args):
+        cin = x.shape[1]
+        hints = {
+            self.offset_weight:
+                (self._kwargs_offset["num_filter"],
+                 cin // self._groups) + self._kernel,
+            self.deformable_conv_weight:
+                (self._channels, cin // self._groups) + self._kernel,
+        }
+        if self.offset_bias is not None:
+            hints[self.offset_bias] = (self._kwargs_offset["num_filter"],)
+        if self.deformable_conv_bias is not None:
+            hints[self.deformable_conv_bias] = (self._channels,)
+        return hints
+
+    def hybrid_forward(self, F, x, offset_weight, deformable_conv_weight,
+                       offset_bias=None, deformable_conv_bias=None):
+        offset = F.Convolution(x, offset_weight, offset_bias,
+                               no_bias=offset_bias is None,
+                               **self._kwargs_offset)
+        out = F.DeformableConvolution(
+            x, offset, deformable_conv_weight, deformable_conv_bias,
+            no_bias=deformable_conv_bias is None, **self._kwargs_deform)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def _alias(self):
+        return "deformable_conv"

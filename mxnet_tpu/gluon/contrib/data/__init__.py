@@ -1,0 +1,6 @@
+"""Gluon contrib data (ref: python/mxnet/gluon/contrib/data/)."""
+from . import text  # noqa: F401
+from .sampler import IntervalSampler  # noqa: F401
+from .text import WikiText2, WikiText103  # noqa: F401
+
+__all__ = ["text", "IntervalSampler", "WikiText2", "WikiText103"]

@@ -1,0 +1,2 @@
+"""Text dataset constants (ref: gluon/contrib/data/_constants.py)."""
+EOS_TOKEN = "<eos>"

@@ -1,0 +1,27 @@
+"""Contrib samplers (ref: python/mxnet/gluon/contrib/data/sampler.py)."""
+from __future__ import annotations
+
+from ...data import sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(sampler.Sampler):
+    """Samples [0, length) at fixed ``interval`` strides; with
+    ``rollover`` the walk restarts at each skipped offset so every index
+    is visited (ref: gluon/contrib/data/sampler.py:25)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length, \
+            "Interval %s must be <= length %s" % (interval, length)
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        starts = range(self._interval if self._rollover else 1)
+        for start in starts:
+            yield from range(start, self._length, self._interval)
+
+    def __len__(self):
+        return self._length

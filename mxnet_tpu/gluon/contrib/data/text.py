@@ -1,0 +1,134 @@
+"""Contrib text datasets (ref: python/mxnet/gluon/contrib/data/text.py).
+
+WikiText2/WikiText103 keep the reference API (root/segment/vocab/
+seq_len, `<eos>` per line, contiguous next-token labels reshaped to
+fixed-length rows). This build is zero-egress: the loader reads the
+standard ``wiki.<segment>.tokens`` file if present under ``root`` and
+otherwise falls back to a deterministic synthetic corpus when
+``MXTPU_SYNTHETIC_DATA=1`` (same convention as the vision datasets,
+gluon/data/vision/datasets.py)."""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ....contrib import text
+from ...data import dataset
+from . import _constants as C
+
+__all__ = ["WikiText2", "WikiText103"]
+
+
+def _synth_ok():
+    return os.environ.get("MXTPU_SYNTHETIC_DATA", "1") == "1"
+
+
+class _LanguageModelDataset(dataset.Dataset):
+    """ref: gluon/contrib/data/text.py:35 _LanguageModelDataset."""
+
+    def __init__(self, root, namespace, vocabulary):
+        self._root = os.path.expanduser(root)
+        self._vocab = vocabulary
+        self._counter = None
+        self._namespace = namespace
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _build_vocab(self, content):
+        if not self._counter:
+            self._counter = text.utils.count_tokens_from_str(content)
+        if not self._vocab:
+            self._vocab = text.vocab.Vocabulary(
+                counter=self.frequencies, reserved_tokens=[C.EOS_TOKEN])
+
+
+class _WikiText(_LanguageModelDataset):
+
+    def _synth_corpus(self):
+        """Deterministic Markov-ish corpus standing in for the download
+        (zero-egress CI)."""
+        rng = np.random.RandomState(
+            {"train": 0, "validation": 1, "test": 2}[self._segment])
+        words = ["the", "of", "and", "in", "to", "a", "was", "is", "for",
+                 "on", "as", "by", "with", "at", "from", "wiki", "text",
+                 "language", "model", "data"]
+        n_lines = {"train": 400, "validation": 80, "test": 80}[self._segment]
+        lines = []
+        for _ in range(n_lines):
+            ln = rng.randint(5, 25)
+            lines.append(" ".join(words[rng.randint(len(words))]
+                                  for _ in range(ln)))
+        return "\n".join(lines)
+
+    def _read_content(self):
+        fname = "wiki.%s.tokens" % (
+            "valid" if self._segment == "validation" else self._segment)
+        path = os.path.join(self._root, fname)
+        if os.path.exists(path):
+            with io.open(path, "r", encoding="utf8") as fin:
+                return fin.read()
+        if _synth_ok():
+            return self._synth_corpus()
+        raise IOError(
+            "%s not found under %s (offline build: place the WikiText "
+            "tokens files there, or set MXTPU_SYNTHETIC_DATA=1)"
+            % (fname, self._root))
+
+    def _get_data(self):
+        content = self._read_content()
+        self._build_vocab(content)
+        raw = [ln.strip().split() for ln in content.splitlines()]
+        raw = [ln for ln in raw if ln]
+        for ln in raw:
+            ln.append(C.EOS_TOKEN)
+        flat = self.vocabulary.to_indices(
+            [tok for ln in raw for tok in ln if tok])
+        data = np.array(flat[:-1], dtype=np.int32)
+        label = np.array(flat[1:], dtype=np.int32)
+        n = (len(data) // self._seq_len) * self._seq_len
+        from .... import ndarray as nd
+        self._data = nd.array(data[:n].reshape((-1, self._seq_len)),
+                              dtype="int32")
+        self._label = nd.array(label[:n].reshape((-1, self._seq_len)),
+                               dtype="int32")
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 word-level LM dataset
+    (ref: gluon/contrib/data/text.py:105)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-2"),
+                 segment="train", vocab=None, seq_len=35):
+        self._segment = segment
+        self._seq_len = seq_len
+        super().__init__(root, "wikitext-2", vocab)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 word-level LM dataset
+    (ref: gluon/contrib/data/text.py:143)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-103"),
+                 segment="train", vocab=None, seq_len=35):
+        self._segment = segment
+        self._seq_len = seq_len
+        super().__init__(root, "wikitext-103", vocab)

@@ -132,6 +132,12 @@ def bench_resnet():
     if layout not in ("NCHW", "NHWC"):
         raise ValueError("BENCH_LAYOUT must be NCHW or NHWC, got %r"
                          % layout)
+    # BENCH_FUSED=1: NHWC + 1x1-convs-as-dots + save-only-conv-outs remat
+    # so normalize/ReLU chains never persist in HBM (round-4 HBM work;
+    # see ShardedTrainStep remat_policy + ops/nn.py _ckpt_name)
+    fused = os.environ.get("BENCH_FUSED", "0") == "1"
+    if fused:
+        layout = "NHWC"
 
     net = resnet50_v1(layout=layout)
     net.initialize()
@@ -143,7 +149,8 @@ def bench_resnet():
     step = ShardedTrainStep(net, SoftmaxCrossEntropyLoss(),
                             opt.create("sgd", learning_rate=0.01,
                                        momentum=0.9),
-                            strategy=data_parallel(mesh))
+                            strategy=data_parallel(mesh),
+                            remat_policy="conv_outs" if fused else None)
 
     rng = np.random.RandomState(0)
     x = rng.rand(batch, 3, 224, 224).astype(dtype)
@@ -154,11 +161,16 @@ def bench_resnet():
     float(step.step(xd, yd))
 
     iters = int(os.environ.get("BENCH_ITERS", 30 if platform != "cpu" else 3))
+    import contextlib
+    xprof_dir = os.environ.get("BENCH_XPROF")
+    trace_cm = jax.profiler.trace(xprof_dir) if xprof_dir \
+        else contextlib.nullcontext()
     t0 = time.perf_counter()
     loss = None
-    for _ in range(iters):
-        loss = step.step(xd, yd)
-    loss = float(loss)  # sync once at the end
+    with trace_cm:
+        for _ in range(iters):
+            loss = step.step(xd, yd)
+        loss = float(loss)  # sync once at the end
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * iters / dt
@@ -266,9 +278,13 @@ def bench_input_pipeline(step=None, batch=128, dtype="bfloat16",
         out["cores_to_feed_compute"] = int(
             np.ceil(compute_imgs_per_sec / (pipeline_rate / n_threads)))
 
-    # 2) the same pipeline feeding the real train step (uint8 to the
-    #    device, normalize on-chip — the TPU-idiomatic feed)
+    # 2) the same pipeline feeding the real train step: uint8 batches are
+    #    DOUBLE-BUFFERED to the device (DevicePrefetchIter issues the
+    #    device_put of batch N+1 while N computes — SURVEY §7.5), then
+    #    normalized on-chip (the TPU-idiomatic feed)
     if step is not None:
+        from mxnet_tpu.io import DevicePrefetchIter
+
         mean = jnp.asarray([123.68, 116.78, 103.94], dtype
                            ).reshape(1, 3, 1, 1)
         scale = jnp.asarray(1.0 / 58.0, dtype)
@@ -277,26 +293,44 @@ def bench_input_pipeline(step=None, batch=128, dtype="bfloat16",
         def normalize(u8):
             return (u8.astype(dtype) - mean) * scale
 
+        def to_host(b):
+            return (b.data[0].asnumpy(), b.label[0].asnumpy())
+
+        class _HostBatches:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def __iter__(self):
+                return (to_host(b) for b in self.inner)
+
+            def reset(self):
+                self.inner.reset()
+
         it = make_iter()
         it.reset()
-        first = next(iter(it))
-        xd, yd = step.place_batch(
-            normalize(jnp.asarray(first.data[0].asnumpy())),
-            first.label[0].asnumpy())
+        # place straight onto the step's batch sharding so step() never
+        # re-device_puts inside the timed loop
+        pf = DevicePrefetchIter(_HostBatches(it), depth=2,
+                                sharding=step._batch_sharding)
+        xu8, yh = next(pf)
+        xd, yd = step.place_batch(normalize(xu8), yh)
         float(step.step(xd, yd))  # warm the (possibly new) shapes
         n = 0
         t0 = time.perf_counter()
         loss = None
-        it.reset()
-        for b in it:
-            xd, yd = step.place_batch(
-                normalize(jnp.asarray(b.data[0].asnumpy())),
-                b.label[0].asnumpy())
-            loss = step.step(xd, yd)
-            n += b.data[0].shape[0]
+        pf.reset()
+        for xu8, yh in pf:
+            loss = step.step(normalize(xu8), yh)
+            n += int(xu8.shape[0])
         float(loss)
-        out["train_through_imgs_per_sec"] = round(
-            n / (time.perf_counter() - t0), 1)
+        dt_through = time.perf_counter() - t0
+        out["train_through_imgs_per_sec"] = round(n / dt_through, 1)
+        if compute_imgs_per_sec:
+            # overlap quality: 1.0 = perfectly hidden feed
+            # (train-through == min(sustained pipeline, compute))
+            bound = min(pipeline_rate, compute_imgs_per_sec)
+            out["feed_overlap_efficiency"] = round(
+                (n / dt_through) / bound, 3)
     return out
 
 

@@ -95,6 +95,8 @@ def categorize(opcode, line):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layout", default="NCHW")
+    ap.add_argument("--fused", action="store_true",
+                    help="NHWC + save-only-conv-outs remat (BENCH_FUSED)")
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--top", type=int, default=40)
@@ -110,7 +112,8 @@ def main():
     from mxnet_tpu.parallel import create_mesh, data_parallel, \
         ShardedTrainStep
 
-    net = resnet50_v1(layout=args.layout)
+    layout = "NHWC" if args.fused else args.layout
+    net = resnet50_v1(layout=layout)
     net.initialize()
     net(mx.nd.array(np.zeros((1, 3, 224, 224), "float32")))
     if args.dtype != "float32":
@@ -119,7 +122,9 @@ def main():
     step = ShardedTrainStep(net, SoftmaxCrossEntropyLoss(),
                             opt.create("sgd", learning_rate=0.01,
                                        momentum=0.9),
-                            strategy=data_parallel(mesh))
+                            strategy=data_parallel(mesh),
+                            remat_policy="conv_outs" if args.fused
+                            else None)
     rng = np.random.RandomState(0)
     x = rng.rand(args.batch, 3, 224, 224).astype(args.dtype)
     y = rng.randint(0, 1000, (args.batch,)).astype("float32")

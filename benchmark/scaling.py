@@ -1,0 +1,90 @@
+"""Multi-process data-parallel scaling benchmark driver
+(VERDICT r3 item 2).
+
+Measures global img/s of sync data-parallel thumbnail-ResNet training at
+n = 1, 2, 4, 8 local processes (tools/launch.py + dist_device_sync) and
+writes a SCALING_r*.json with per-n throughput and efficiency vs n=1 —
+the CI-shaped analog of the reference's 1..256-GPU scaling table
+(ref: example/image-classification/README.md:309-319, 90.1% at 256).
+
+On a real multi-host TPU slice the same harness measures ICI/DCN
+scaling; on a CI host the curve measures launcher + Gloo-collective +
+oversubscription overhead (a 1-core host runs all ranks on one core, so
+compute does NOT scale — efficiency there reflects harness sanity, not
+hardware).
+
+    python benchmark/scaling.py --ns 1,2,4,8 --out SCALING_r04.json
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.launch import launch_local  # noqa: E402
+
+
+def run_one(n, batch, steps, out_path):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "MXTPU_SCALING_OUT": out_path,
+        "MXTPU_SCALING_BATCH": str(batch),
+        "MXTPU_SCALING_STEPS": str(steps),
+    }
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scaling_worker.py")
+    codes = launch_local(n, [sys.executable, worker], env_extra=env)
+    if any(codes):
+        raise RuntimeError("n=%d run failed: %s" % (n, codes))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ns", default="1,2,4,8")
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--out", default=None,
+                    help="write the summary JSON here")
+    args = ap.parse_args(argv)
+
+    results = []
+    with tempfile.TemporaryDirectory() as td:
+        rec_path = os.path.join(td, "recs.jsonl")
+        for n in [int(x) for x in args.ns.split(",")]:
+            run_one(n, args.batch, args.steps, rec_path)
+        with open(rec_path) as f:
+            results = [json.loads(ln) for ln in f if ln.strip()]
+
+    base = next((r for r in results if r["n"] == 1), results[0])
+    for r in results:
+        ideal = base["imgs_per_sec"] * r["n"] / base["n"]
+        r["efficiency"] = round(r["imgs_per_sec"] / ideal, 3)
+
+    summary = {
+        "metric": "dist_device_sync_scaling",
+        "model": "resnet18_thumbnail_32x32",
+        "host_cpus": os.cpu_count(),
+        "platform": "cpu-mesh",
+        "note": ("sync dp over jax.distributed collectives; on a "
+                 "1-core host all ranks share one core so efficiency "
+                 "measures harness overhead, not hardware scaling"),
+        "points": results,
+    }
+    line = json.dumps(summary)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print("\n  n  imgs/s   step_ms  efficiency", file=sys.stderr)
+    for r in results:
+        print("%3d  %7.1f  %7.1f  %9.3f"
+              % (r["n"], r["imgs_per_sec"], r["step_ms"],
+                 r["efficiency"]), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""One rank of the multi-process data-parallel scaling benchmark
+(VERDICT r3 item 2 — the analog of the reference's 1..256-GPU scaling
+table, example/image-classification/README.md:309).
+
+Launched by benchmark/scaling.py via tools/launch.py:
+
+    python tools/launch.py -n 4 python benchmark/scaling_worker.py
+
+Each rank trains thumbnail ResNet-18 through the Gluon Trainer with
+kvstore=dist_device_sync (gradients allreduced over the jax.distributed
+Gloo/ICI backend — sync semantics, every step sees all ranks). Rank 0
+appends one JSON line with the measured global img/s to the path in
+MXTPU_SCALING_OUT.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+_COORD = os.environ.get("MXTPU_COORDINATOR")
+if _COORD and int(os.environ.get("MXTPU_NUM_PROCS", "1")) > 1:
+    jax.distributed.initialize(_COORD,
+                               int(os.environ["MXTPU_NUM_PROCS"]),
+                               int(os.environ["MXTPU_PROC_ID"]))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1  # noqa: E402
+
+
+def main():
+    batch = int(os.environ.get("MXTPU_SCALING_BATCH", "16"))
+    steps = int(os.environ.get("MXTPU_SCALING_STEPS", "8"))
+    warmup = int(os.environ.get("MXTPU_SCALING_WARMUP", "2"))
+    nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+    rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+
+    net = resnet18_v1(classes=10, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(np.zeros((1, 3, 32, 32), "f")))  # deferred init
+    net.hybridize()
+
+    kv = "dist_device_sync" if nproc > 1 else "device"
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rs = np.random.RandomState(1000 + rank)
+    x = mx.nd.array(rs.rand(batch, 3, 32, 32).astype("f"))
+    y = mx.nd.array(rs.randint(0, 10, (batch,)).astype("f"))
+
+    def step():
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    for _ in range(warmup):
+        float(step().asnumpy().sum())
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = step()
+    float(loss.asnumpy().sum())  # sync
+    dt = time.perf_counter() - t0
+
+    global_imgs_per_sec = batch * nproc * steps / dt
+    if rank == 0:
+        out_path = os.environ.get("MXTPU_SCALING_OUT")
+        rec = {"n": nproc, "batch_per_rank": batch, "steps": steps,
+               "imgs_per_sec": round(global_imgs_per_sec, 2),
+               "step_ms": round(dt / steps * 1e3, 2)}
+        if out_path:
+            with open(out_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -3,10 +3,10 @@
 WikiText2/WikiText103 keep the reference API (root/segment/vocab/
 seq_len, `<eos>` per line, contiguous next-token labels reshaped to
 fixed-length rows). This build is zero-egress: the loader reads the
-standard ``wiki.<segment>.tokens`` file if present under ``root`` and
-otherwise falls back to a deterministic synthetic corpus when
-``MXTPU_SYNTHETIC_DATA=1`` (same convention as the vision datasets,
-gluon/data/vision/datasets.py)."""
+standard ``wiki.<segment>.tokens`` file if present under ``root``;
+setting ``MXTPU_SYNTHETIC_DATA=1`` (opt-in, same convention as the
+vision datasets, gluon/data/vision/datasets.py) substitutes a
+deterministic synthetic corpus; otherwise a missing file raises."""
 from __future__ import annotations
 
 import io
@@ -22,7 +22,9 @@ __all__ = ["WikiText2", "WikiText103"]
 
 
 def _synth_ok():
-    return os.environ.get("MXTPU_SYNTHETIC_DATA", "1") == "1"
+    # opt-in, matching the vision datasets: a mistyped root must raise,
+    # not silently train on the fake corpus
+    return os.environ.get("MXTPU_SYNTHETIC_DATA", "0") == "1"
 
 
 class _LanguageModelDataset(dataset.Dataset):

@@ -11,7 +11,8 @@ buffer in PrefetchingIter.
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
                  LibSVMIter, ResizeIter, PrefetchingIter, MNISTIter)
 from .image_iter import ImageRecordIter
+from .prefetch import DevicePrefetchIter, DevicePrefetcher
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "ResizeIter", "PrefetchingIter", "MNISTIter",
-           "ImageRecordIter"]
+           "ImageRecordIter", "DevicePrefetchIter", "DevicePrefetcher"]

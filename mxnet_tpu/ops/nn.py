@@ -23,6 +23,17 @@ import numpy as _np
 from .registry import register
 
 
+def _ckpt_name(x, name):
+    """Tag a value for remat policies (jax.ad_checkpoint.checkpoint_name).
+    ShardedTrainStep(remat_policy="conv_outs") saves ONLY tagged values
+    between forward and backward — normalized/activated intermediates are
+    then recomputed in backward, fused into the consuming matmuls, so
+    they never persist in HBM (round-4 ResNet HBM work; a no-op unless a
+    surrounding jax.checkpoint policy references the name)."""
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(x, name)
+
+
 def _pair(v, n=2):
     if v is None:
         return (1,) * n
@@ -75,6 +86,22 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     # OIHW in both layouts for .params checkpoint compat; XLA folds the
     # transposition into the conv.
     channels_last = _channels_last(layout)
+    if channels_last and num_group == 1 and nd == 2 \
+            and tuple(weight.shape[2:]) == (1, 1) and pad == (0, 0):
+        # 1x1 NHWC conv == one MXU matmul over [N*H*W, Cin]. Expressed
+        # as a dot (not conv_general) because XLA:TPU fuses elementwise
+        # PRODUCERS into dot operand loads but not into convolutions
+        # (measured: benchmark/fusion_probe.py) — so a preceding
+        # BN-affine+ReLU rides the operand load instead of
+        # materializing. Strides become a free slice of the input.
+        xs = x[:, ::stride[0], ::stride[1], :] if stride != (1, 1) else x
+        n, h, w_, cin = xs.shape
+        out = (xs.reshape(n * h * w_, cin)
+               @ weight.reshape(weight.shape[0], cin).T)
+        out = out.reshape(n, h, w_, weight.shape[0])
+        if bias is not None and not no_bias:
+            out = out + bias.reshape((1, 1, 1, -1))
+        return _ckpt_name(out, "conv_out")
     spatial = "DHW"[3 - nd:]
     act = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
     dn = jax.lax.conv_dimension_numbers(
@@ -88,7 +115,7 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
         bshape = ((1,) + (1,) * nd + (-1,)) if channels_last \
             else ((1, -1) + (1,) * nd)
         out = out + bias.reshape(bshape)
-    return out
+    return _ckpt_name(out, "conv_out")
 
 
 @register("Deconvolution", aliases=("deconvolution",))
@@ -184,8 +211,9 @@ def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
         # breaks reverse-mode linearization of reduce_window under jit
         init = -float("inf") if jnp.issubdtype(x.dtype, jnp.floating) else \
             int(jnp.iinfo(x.dtype).min)
-        return jax.lax.reduce_window(x, init, jax.lax.max,
-                                     window, strides, padding)
+        return _ckpt_name(jax.lax.reduce_window(x, init, jax.lax.max,
+                                                window, strides, padding),
+                          "pool_out")
     if pool_type in ("avg", "sum"):
         s = jax.lax.reduce_window(x, 0.0 if jnp.issubdtype(
             x.dtype, jnp.floating) else 0, jax.lax.add,
@@ -422,8 +450,10 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
             shape0[axis % x.ndim] = x.shape[axis % x.ndim]
             var32 = jnp.mean(jnp.square(xf - mean32.reshape(shape0)),
                              axis=axes)
-        mean = mean32.astype(x.dtype)
-        var = var32.astype(x.dtype)
+        # tagged so conv-outs remat policies keep the (tiny) stat
+        # vectors instead of re-reducing the activation in backward
+        mean = _ckpt_name(mean32.astype(x.dtype), "bn_stat")
+        var = _ckpt_name(var32.astype(x.dtype), "bn_stat")
     else:
         mean, var = moving_mean, moving_var
     shape = [1] * x.ndim

@@ -106,11 +106,20 @@ class ShardedTrainStep:
     """
 
     def __init__(self, block, loss_fn, optimizer, strategy=None, mesh=None,
-                 donate=True):
+                 donate=True, remat_policy=None):
+        """remat_policy="conv_outs" wraps the forward in jax.checkpoint
+        saving ONLY checkpoint_name-tagged values (conv_out/pool_out/
+        bn_stat — see ops/nn.py _ckpt_name): backward recomputes the
+        elementwise normalize/activation chains from raw conv outputs,
+        fused into the consuming matmuls, instead of persisting them in
+        HBM (round-4 ResNet HBM-traffic work). Any other string is
+        passed to jax.checkpoint_policies.save_only_these_names as a
+        comma-separated name list."""
         if strategy is None:
             if mesh is None:
                 raise ValueError("need strategy or mesh")
             strategy = data_parallel(mesh)
+        self._remat_policy = remat_policy
         self.block = block
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -147,6 +156,8 @@ class ShardedTrainStep:
         block, loss_fn, optimizer = self.block, self.loss_fn, self.optimizer
         paths = self._param_paths
 
+        remat_policy = self._remat_policy
+
         def train_step(params, opt_states, x, y, rng):
             def loss_of(ps):
                 out, aux = functional_call(block, ps, [x], training=True,
@@ -155,6 +166,14 @@ class ShardedTrainStep:
                 loss = loss_fn(NDArray(out0), NDArray(y))._data
                 return jnp.mean(loss), aux
 
+            if remat_policy:
+                names = ("conv_out", "pool_out", "bn_stat") \
+                    if remat_policy == "conv_outs" \
+                    else tuple(remat_policy.split(","))
+                loss_of = jax.checkpoint(
+                    loss_of,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        *names))
             (loss, aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
             new_params, new_states = {}, {}

@@ -1,0 +1,107 @@
+"""Device-feed double buffering (VERDICT r3 item 4)."""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DevicePrefetcher, DevicePrefetchIter
+
+
+class _SlowIter:
+    """Restartable iterator with a per-batch production delay."""
+
+    def __init__(self, n, delay):
+        self.n = n
+        self.delay = delay
+
+    def __iter__(self):
+        for i in range(self.n):
+            time.sleep(self.delay)
+            yield np.full((4,), i, dtype=np.float32)
+
+    def reset(self):
+        pass
+
+
+def test_order_and_values():
+    pf = DevicePrefetchIter(_SlowIter(6, 0.0))
+    got = [int(np.asarray(b)[0]) for b in pf]
+    assert got == list(range(6))
+
+
+def test_reset_restarts():
+    pf = DevicePrefetchIter(_SlowIter(4, 0.0))
+    assert len(list(pf)) == 4
+    pf.reset()
+    assert len(list(pf)) == 4
+
+
+def test_overlap_hides_producer_latency():
+    """Consumer work overlaps producer delay: wall ~ max, not sum."""
+    n, delay = 8, 0.03
+    pf = DevicePrefetchIter(_SlowIter(n, delay))
+    next(pf)  # thread warm, first batch out
+    t0 = time.perf_counter()
+    for _ in pf:
+        time.sleep(delay)  # consumer busy exactly as long as producer
+    wall = time.perf_counter() - t0
+    serial = 2 * delay * (n - 1)
+    # perfectly overlapped would be ~delay*(n-1); allow generous slack
+    # for the 1-core CI host, but require clearly better than serial
+    assert wall < serial * 0.8, (wall, serial)
+
+
+def test_exception_propagates():
+    def boom():
+        yield np.zeros(2)
+        raise RuntimeError("producer failed")
+
+    pf = DevicePrefetchIter(boom())
+    next(pf)
+    with pytest.raises(RuntimeError, match="producer failed"):
+        next(pf)
+
+
+def test_gluon_dataloader_prefetcher():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    n = 12
+    ds = ArrayDataset(np.arange(n * 3, dtype="f").reshape(n, 3),
+                      np.arange(n, dtype="f"))
+    loader = DataLoader(ds, batch_size=4)
+    pf = DevicePrefetcher(loader)
+    assert len(pf) == 3
+    seen = 0
+    for x, y in pf:
+        assert isinstance(x, mx.nd.NDArray) and x.shape == (4, 3)
+        seen += 1
+    assert seen == 3
+    # second epoch works (reset-on-iter)
+    assert sum(1 for _ in pf) == 3
+
+
+def test_ndarray_batches_stay_ndarray():
+    batches = [(mx.nd.array(np.ones((2, 2), "f")),
+                mx.nd.array(np.zeros((2,), "f")))]
+    pf = DevicePrefetchIter(iter(batches))
+    x, y = next(pf)
+    assert isinstance(x, mx.nd.NDArray)
+    np.testing.assert_allclose(x.asnumpy(), 1.0)
+
+
+def test_reset_cancels_infinite_producer():
+    """reset() must not require the producer to finish (review r4)."""
+    def forever():
+        i = 0
+        while True:
+            yield np.full((2,), i, dtype=np.float32)
+            i += 1
+
+    pf = DevicePrefetchIter(forever())
+    next(pf)
+    t0 = time.perf_counter()
+    pf.reset()  # would hang without cancellation
+    assert time.perf_counter() - t0 < 5.0
+    # the replacement worker is live (generator resumes, not rewound)
+    assert np.asarray(next(pf)).shape == (2,)

@@ -67,19 +67,18 @@ def invoke(op_name, inputs, keys, vals):
     choke point with the Python frontend (AMP hooks and all)."""
     from .ops import registry as _registry
     kwargs = {k: _parse(v) for k, v in zip(keys, vals)}
-    try:
-        opdef = _registry.get_op(op_name)
-    except KeyError:
-        # the fused optimizer update ops live in the nd namespace, not
-        # the registry (ndarray/optimizer_ops.py) — the reference
-        # registers those as ops too, so resolve exactly that family
-        # here (an allowlist: arbitrary nd attributes like save/load
-        # must NOT be invocable through the C op surface)
-        from .ndarray import optimizer_ops as _opt_ops
-        if op_name not in _opt_ops.__all__:
-            raise KeyError("no such operator: %r" % op_name)
+    # the fused optimizer update ops keep the reference's IN-PLACE
+    # calling convention on this surface (state mutated, one output) —
+    # the nd wrappers (ndarray/optimizer_ops.py) shadow the pure
+    # registry forms here exactly as they do in the nd namespace
+    from .ndarray import optimizer_ops as _opt_ops
+    if op_name in _opt_ops.__all__:
         out = getattr(_opt_ops, op_name)(*inputs, **kwargs)
     else:
+        try:
+            opdef = _registry.get_op(op_name)
+        except KeyError:
+            raise KeyError("no such operator: %r" % op_name)
         out = _register.invoke(opdef, inputs, kwargs)
     return list(out) if isinstance(out, (tuple, list)) else [out]
 
@@ -247,6 +246,36 @@ def executor_grad(ex, name):
 
 def executor_aux(ex, name):
     return ex.aux_dict[name]
+
+
+# -- CachedOp family (ref: MXCreateCachedOp c_api.h:1241; the jit seam) -----
+
+def cachedop_create(sym, keys, vals):
+    """MXTCachedOpCreate core: flags mirror CachedOpConfig
+    (ref: cached_op.h:35 — static_alloc/static_shape/inline_limit)."""
+    from .jit import CachedOp
+    known = ("static_alloc", "static_shape", "inline_limit")
+    kwargs = {}
+    flags = []
+    for k, v in zip(keys, vals):
+        pv = _parse(v)
+        if k in known:
+            kwargs[k] = pv
+        else:
+            flags.append((k, pv))
+    return CachedOp(sym, flags=flags, **kwargs)
+
+
+def cachedop_invoke(op, inputs):
+    """MXTCachedOpInvoke core: always returns a list of NDArrays."""
+    out = op(*inputs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def cachedop_stats(op):
+    """(total calls, traces+compiles) — the second same-signature call
+    must show compiles == 1 (the cache-hit proof the C demo asserts)."""
+    return int(op.calls), int(op.compiles)
 
 
 # -- KVStore family (ref: MXKVStore* c_api.h; src/kvstore/kvstore.cc:40) ----
@@ -490,3 +519,288 @@ def symbol_get_output(sym, index):
 def symbol_copy(sym):
     import copy as _copy
     return _copy.deepcopy(sym)
+
+
+# -- round-4 ABI long tail (VERDICT r3 item 3: parity audit closures) -------
+
+def nd_wait(arr):
+    """MXTNDArrayWaitToRead/WaitToWrite core — per-array sync
+    (ref: c_api.h MXNDArrayWaitToRead; XLA analog is
+    block_until_ready)."""
+    arr.wait_to_read()
+
+
+def nd_detach(arr):
+    return arr.detach()
+
+
+_DEV_TYPE_IDS = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 2}
+
+
+def nd_context(arr):
+    """(dev_type_id, dev_id); accelerators report the reference's GPU id
+    (2) — the ABI has no TPU enum and callers only branch cpu/非cpu."""
+    ctx = arr.context
+    return _DEV_TYPE_IDS.get(ctx.device_type, 2), int(ctx.device_id)
+
+
+_STYPE_IDS = {"undefined": -1, "default": 0, "row_sparse": 1, "csr": 2}
+
+
+def nd_storage_type(arr):
+    return _STYPE_IDS.get(getattr(arr, "stype", "default"), 0)
+
+
+def nd_none():
+    """MXTNDArrayCreateNone: a placeholder handle
+    (ref: c_api.cc MXNDArrayCreateNone)."""
+    return NDArray(np.zeros((), "float32"))
+
+
+def nd_shallow_copy(arr):
+    return NDArray(arr._data)
+
+
+def nd_load_from_buffer(raw):
+    """Returns (names list, arrays list) like ndarray_load."""
+    import os
+    import tempfile
+    fd, path = tempfile.mkstemp(suffix=".params")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(raw)
+        import mxnet_tpu as mx
+        loaded = mx.nd.load(path)
+    finally:
+        os.unlink(path)
+    if isinstance(loaded, dict):
+        return list(loaded.keys()), list(loaded.values())
+    return [], list(loaded)
+
+
+def symbol_group(syms):
+    from .symbol import Group
+    return Group(list(syms))
+
+
+def symbol_num_outputs(sym):
+    return len(sym.list_outputs())
+
+
+def symbol_print(sym):
+    """Debug string (ref: MXSymbolPrint): name, args, outputs."""
+    return ("Symbol(name=%s)\nArguments: %s\nOutputs: %s"
+            % (sym.name, ", ".join(sym.list_arguments()),
+               ", ".join(sym.list_outputs())))
+
+
+def symbol_get_children(sym):
+    kids = sym.get_children()
+    return kids  # may be None; C side maps to null handle
+
+
+def symbol_get_inputs(sym):
+    from .symbol import Symbol
+    nodes = [n for n in sym._topo() if n.is_variable()]
+    return [Symbol([(n, 0)]) for n in nodes]
+
+
+def symbol_atomic_name(sym):
+    node = sym._outputs[0][0]
+    return node.op or "null"
+
+
+def symbol_attrs_shallow(sym):
+    """Flat [k0, v0, k1, v1, ...] of the head node's own attrs."""
+    out = []
+    for k, v in sym._outputs[0][0].attrs.items():
+        if not k.startswith("__"):
+            out.extend([str(k), str(v)])
+    return out
+
+
+def symbol_infer_shape_partial(sym, names, shapes):
+    provided = {n: tuple(s) for n, s in zip(names, shapes)}
+    arg, out, aux = sym.infer_shape_partial(**provided)
+
+    def _clean(lst):
+        return [tuple(int(d) for d in s) if s is not None else ()
+                for s in lst]
+    return _clean(arg), _clean(out), _clean(aux)
+
+
+def symbol_infer_type(sym, names, dtype_ids, partial):
+    typed = {n: _DTYPES[int(d)] for n, d in zip(names, dtype_ids)}
+    arg_t, out_t, aux_t = sym.infer_type(**typed)
+
+    def ids(lst):
+        return [(-1 if t is None else _DTYPE_IDS.get(str(np.dtype(t)), 0))
+                for t in lst]
+    return ids(arg_t), ids(out_t), ids(aux_t)
+
+
+def executor_print(ex):
+    args = {n: tuple(a.shape) for n, a in ex.arg_dict.items()}
+    return "Executor(outputs=%d)\n%s" % (
+        len(ex.outputs), "\n".join("  %s: %s" % kv for kv in args.items()))
+
+
+def executor_reshape(ex, names, shapes):
+    return ex.reshape(partial_shaping=True,
+                      **{n: tuple(s) for n, s in zip(names, shapes)})
+
+
+def executor_bind(sym, names, arrs, grad_req):
+    from .executor import Executor
+    args = dict(zip(names, arrs))
+    grads = {n: NDArray(np.zeros(a.shape, str(a.dtype)))
+             for n, a in args.items()}
+    return Executor(sym, args=args, args_grad=grads, grad_req=grad_req)
+
+
+def kv_role(which):
+    """worker/server/scheduler booleans from the DMLC-compatible env
+    (ref: MXKVStoreIsWorkerNode; every process is a worker here unless a
+    reference-era launcher says otherwise)."""
+    import os
+    role = os.environ.get("DMLC_ROLE", "worker")
+    return 1 if role == which else 0
+
+
+def kv_num_dead(kv, node_id):
+    get = getattr(kv, "get_dead_nodes", None)
+    return len(get()) if get else 0
+
+
+def kv_set_gradient_compression(kv, keys, vals):
+    kv.set_gradient_compression(
+        {k: _parse(v) for k, v in zip(keys, vals)})
+
+
+def kv_pull_row_sparse(kv, key, row_ids, out):
+    kv.row_sparse_pull(_parse_key(key), out=out, row_ids=row_ids)
+
+
+def _parse_key(key):
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return key
+
+
+def notify_shutdown():
+    import mxnet_tpu as mx
+    mx.nd.waitall()
+
+
+def init_ps_env(keys, vals):
+    import os
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+
+
+# profiler object family (ref: MXProfileCreateDomain..SetMarker)
+
+def profile_create(kind, domain, name):
+    from . import profiler as prof
+    if kind == "domain":
+        return prof.Domain(name)
+    klass = {"task": prof.Task, "frame": prof.Frame,
+             "event": prof.Event, "counter": prof.Counter}[kind]
+    if kind == "event":
+        return prof.Event(name)
+    return klass(domain, name)
+
+
+def profile_duration(handle, start):
+    handle.start() if start else handle.stop()
+
+
+def profile_counter_set(handle, value):
+    handle.set_value(value) if hasattr(handle, "set_value") else \
+        setattr(handle, "value", value)
+
+
+def profile_counter_adjust(handle, delta):
+    if hasattr(handle, "increment"):
+        handle.increment(delta)
+    else:
+        handle.value = getattr(handle, "value", 0) + delta
+
+
+def profile_set_marker(domain, name, scope):
+    from . import profiler as prof
+    prof.Marker(domain, name).mark(scope or "process")
+
+
+def profile_pause(paused):
+    from . import profiler as prof
+    prof.pause() if paused else prof.resume()
+
+
+def profile_aggregate_stats(reset, format_, sort_by, ascending):
+    from . import profiler as prof
+    return prof.dumps(reset=bool(reset), format=format_ or "table",
+                      sort_by=sort_by or "total",
+                      ascending=bool(ascending))
+
+
+def engine_set_bulk_size(size):
+    from . import engine
+    prev = engine.bulk_size()
+    engine.set_bulk_size(int(size))
+    return int(prev)
+
+
+def lib_info_features():
+    """Flat [name, '1'/'0', ...] pairs (ref: MXLibInfoFeatures)."""
+    from .runtime import Features
+    out = []
+    for name, enabled in Features().items():
+        out.extend([str(name), "1" if enabled else "0"])
+    return out
+
+
+def np_shape_is():
+    from . import util
+    return 1 if util.is_np_shape() else 0
+
+
+def np_shape_set(active):
+    from . import util
+    return 1 if util.set_np_shape(bool(active)) else 0
+
+
+def device_count():
+    import jax
+    return len(jax.devices())
+
+
+def device_memory_info(dev_id):
+    """(free, total) bytes; accelerator stats via PJRT when exposed."""
+    import jax
+    d = jax.devices()[int(dev_id)]
+    stats = getattr(d, "memory_stats", lambda: None)() or {}
+    total = int(stats.get("bytes_limit", 0))
+    used = int(stats.get("bytes_in_use", 0))
+    return total - used, total
+
+
+def dataiter_index(it):
+    batch = getattr(it, "_c_current", None)
+    idx = getattr(batch, "index", None) if batch is not None else None
+    return [int(i) for i in idx] if idx is not None else []
+
+
+def dataiter_pad(it):
+    batch = getattr(it, "_c_current", None)
+    return int(getattr(batch, "pad", 0) or 0) if batch is not None else 0
+
+
+def autograd_get_symbol(arr):
+    return autograd.get_symbol(arr)
+
+
+def storage_empty_cache():
+    from . import storage
+    storage.empty_cache()

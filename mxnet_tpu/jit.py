@@ -37,6 +37,10 @@ class CachedOp:
         self._static_shape = bool(static_shape)
         self._signature = None
         self._flags = dict(flags)
+        # cache observability (MXTCachedOpGetStats): every new input
+        # signature is one trace+compile, anything else is a cache hit
+        self.calls = 0
+        self._seen_signatures = set()
         if callable(sym_or_fn) and not hasattr(sym_or_fn, "list_inputs"):
             self._input_names = None
             raw = sym_or_fn
@@ -56,9 +60,15 @@ class CachedOp:
             return outs
         return raw
 
+    @property
+    def compiles(self):
+        return len(self._seen_signatures)
+
     def __call__(self, *args):
         jargs = tuple(_to_jax(a) for a in args)
         sig = tuple((a.shape, str(a.dtype)) for a in jargs)
+        self.calls += 1
+        self._seen_signatures.add(sig)
         if self._static_shape:
             if self._signature is None:
                 self._signature = sig

@@ -445,6 +445,74 @@ int MXTExecutorFree(void* exec) {
   return 0;
 }
 
+// -- CachedOp ---------------------------------------------------------------
+// The jit seam as a C surface (ref: include/mxnet/c_api.h:1241
+// MXCreateCachedOp / :1257 MXInvokeCachedOp / :1252 MXFreeCachedOp):
+// a Symbol compiles once per input signature; repeat invocations with
+// the same shapes/dtypes reuse the XLA executable. GetStats exposes the
+// (calls, compiles) counters so callers can assert cache behavior.
+
+int MXTCachedOpCreate(void* sym, uint32_t num_flags, const char** keys,
+                      const char** vals, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(sym),
+                                 StrList(keys, num_flags),
+                                 StrList(vals, num_flags));
+  PyObject* res = CallRt("cachedop_create", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTCachedOpCreate");
+}
+
+int MXTCachedOpInvoke(void* op, uint32_t num_inputs, void** inputs,
+                      uint32_t* num_outputs, void** out_handles,
+                      uint32_t max_outputs) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(op),
+                                 HandleList(inputs, num_inputs));
+  PyObject* res = CallRt("cachedop_invoke", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTCachedOpInvoke");
+  Py_ssize_t n = PyList_Size(res);
+  if (static_cast<uint32_t>(n) > max_outputs) {
+    Py_DECREF(res);
+    return FailWith("MXTCachedOpInvoke: " + std::to_string(n) +
+                    " outputs, caller provided " +
+                    std::to_string(max_outputs) + " slots");
+  }
+  *num_outputs = static_cast<uint32_t>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(res, i);
+    Py_INCREF(o);
+    out_handles[i] = o;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTCachedOpGetStats(void* op, uint64_t* calls, uint64_t* compiles) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(op));
+  PyObject* res = CallRt("cachedop_stats", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTCachedOpGetStats");
+  unsigned long long c = 0, m = 0;
+  if (!PyArg_ParseTuple(res, "KK", &c, &m)) {
+    Py_DECREF(res);
+    return PyFail("MXTCachedOpGetStats");
+  }
+  Py_DECREF(res);
+  *calls = c;
+  *compiles = m;
+  return 0;
+}
+
+int MXTCachedOpFree(void* op) {
+  if (op == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(op));
+  return 0;
+}
+
 // -- KVStore ----------------------------------------------------------------
 
 int MXTKVStoreCreate(const char* type, void** out) {
@@ -918,6 +986,657 @@ int MXTNDArrayGetDType(void* handle, int* out_dtype) {
   *out_dtype = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
+}
+
+}  // extern "C"
+
+// ===== round-4 ABI long tail (VERDICT r3 item 3) ===========================
+// Mechanical completions of reference families whose functionality already
+// exists in the runtime: per-array waits, context/storage queries, symbol
+// introspection, executor bind/reshape/print, KVStore role/row-sparse/
+// compression, the MXProfile* object family, engine/bulk, libinfo,
+// numpy-shape toggles, device queries, PS env, and autograd symbol
+// extraction. Ref lines: include/mxnet/c_api.h for each MX name minus the
+// leading T.
+
+namespace {
+
+int ReturnInt(PyObject* res, int* out, const char* who) {
+  if (res == nullptr) return PyFail(who);
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  if (PyErr_Occurred()) return PyFail(who);
+  return 0;
+}
+
+int ReturnStr(PyObject* res, const char** out, const char* who) {
+  if (res == nullptr) return PyFail(who);
+  const char* c = PyUnicode_AsUTF8(res);
+  if (c == nullptr) {
+    Py_DECREF(res);
+    return PyFail(who);
+  }
+  ret_store.str = c;
+  *out = ret_store.str.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- NDArray ----------------------------------------------------------------
+
+int MXTNDArrayWaitToRead(void* handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("nd_wait", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTNDArrayWaitToRead");
+}
+
+int MXTNDArrayWaitToWrite(void* handle) {
+  return MXTNDArrayWaitToRead(handle);
+}
+
+int MXTNDArrayDetach(void* handle, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("nd_detach", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTNDArrayDetach");
+}
+
+int MXTNDArrayGetContext(void* handle, int* out_dev_type, int* out_dev_id) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("nd_context", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayGetContext");
+  if (!PyArg_ParseTuple(res, "ii", out_dev_type, out_dev_id)) {
+    Py_DECREF(res);
+    return PyFail("MXTNDArrayGetContext");
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTNDArrayGetStorageType(void* handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("nd_storage_type", args);
+  Py_DECREF(args);
+  return ReturnInt(res, out, "MXTNDArrayGetStorageType");
+}
+
+int MXTNDArrayCreateNone(void** out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("nd_none", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTNDArrayCreateNone");
+}
+
+int MXTShallowCopyNDArray(void* handle, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("nd_shallow_copy", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTShallowCopyNDArray");
+}
+
+int MXTNDArrayLoadFromBuffer(const void* buf, size_t size,
+                             uint32_t* out_size, void*** out_arr,
+                             uint32_t* out_name_size,
+                             const char*** out_names) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(y#)", static_cast<const char*>(buf),
+      static_cast<Py_ssize_t>(size));
+  PyObject* res = CallRt("nd_load_from_buffer", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTNDArrayLoadFromBuffer");
+  PyObject* names = PyTuple_GetItem(res, 0);
+  PyObject* arrs = PyTuple_GetItem(res, 1);
+  if (names == nullptr || arrs == nullptr) {
+    Py_DECREF(res);
+    return PyFail("MXTNDArrayLoadFromBuffer");
+  }
+  ret_store.strings.clear();
+  ret_store.charp.clear();
+  ret_store.handles.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i)
+    ret_store.strings.emplace_back(
+        PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  for (auto& s : ret_store.strings) ret_store.charp.push_back(s.c_str());
+  for (Py_ssize_t i = 0; i < PyList_Size(arrs); ++i) {
+    PyObject* a = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(a);
+    ret_store.handles.push_back(a);
+  }
+  *out_name_size = static_cast<uint32_t>(ret_store.charp.size());
+  *out_names = ret_store.charp.data();
+  *out_size = static_cast<uint32_t>(ret_store.handles.size());
+  *out_arr = ret_store.handles.data();
+  Py_DECREF(res);
+  return 0;
+}
+
+// -- Symbol -----------------------------------------------------------------
+
+int MXTSymbolCreateGroup(uint32_t num_symbols, void** symbols, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", HandleList(symbols, num_symbols));
+  PyObject* res = CallRt("symbol_group", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTSymbolCreateGroup");
+}
+
+int MXTSymbolGetNumOutputs(void* sym, uint32_t* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_num_outputs", args);
+  Py_DECREF(args);
+  int v = 0;
+  int rc = ReturnInt(res, &v, "MXTSymbolGetNumOutputs");
+  *out = static_cast<uint32_t>(v);
+  return rc;
+}
+
+int MXTSymbolPrint(void* sym, const char** out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_print", args);
+  Py_DECREF(args);
+  return ReturnStr(res, out_str, "MXTSymbolPrint");
+}
+
+int MXTSymbolGetChildren(void* sym, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_get_children", args);
+  Py_DECREF(args);
+  if (res == Py_None) {
+    Py_DECREF(res);
+    *out = nullptr;
+    return 0;
+  }
+  return ReturnHandle(res, out, "MXTSymbolGetChildren");
+}
+
+int MXTSymbolGetInputSymbols(void* sym, void** out_handles,
+                             uint32_t max_inputs, int* out_size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_get_inputs", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolGetInputSymbols");
+  Py_ssize_t n = PyList_Size(res);
+  if (static_cast<uint32_t>(n) > max_inputs) {
+    Py_DECREF(res);
+    return FailWith("MXTSymbolGetInputSymbols: too many inputs");
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(res, i);
+    Py_INCREF(o);
+    out_handles[i] = o;
+  }
+  *out_size = static_cast<int>(n);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTSymbolGetAtomicSymbolName(void* sym, const char** out_name) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_atomic_name", args);
+  Py_DECREF(args);
+  return ReturnStr(res, out_name, "MXTSymbolGetAtomicSymbolName");
+}
+
+int MXTSymbolListAttrShallow(void* sym, uint32_t* out_size,
+                             const char*** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallRt("symbol_attrs_shallow", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolListAttrShallow");
+  return ReturnStrList(res, out_size, out, "MXTSymbolListAttrShallow");
+}
+
+int MXTShallowCopySymbol(void* sym, void** out) {
+  if (sym == nullptr) return FailWith("null symbol");
+  Gil gil;
+  Py_INCREF(static_cast<PyObject*>(sym));
+  *out = sym;
+  return 0;
+}
+
+int MXTSymbolInferShapePartial(void* sym, uint32_t num_provided,
+                               const char** names, const uint32_t* ndims,
+                               const int64_t* shapes_flat,
+                               uint32_t* arg_count, uint32_t* out_count,
+                               uint32_t* aux_count,
+                               const uint32_t** all_ndims,
+                               const int64_t** all_dims) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(sym),
+                                 StrList(names, num_provided),
+                                 ShapeList(num_provided, ndims, shapes_flat));
+  PyObject* res = CallRt("symbol_infer_shape_partial", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolInferShapePartial");
+  ret_store.shape_ndim.clear();
+  ret_store.shape_data.clear();
+  uint32_t counts[3] = {0, 0, 0};
+  for (int part = 0; part < 3; ++part) {
+    PyObject* lst = PyTuple_GET_ITEM(res, part);
+    Py_ssize_t cnt = PyList_Size(lst);
+    counts[part] = static_cast<uint32_t>(cnt);
+    for (Py_ssize_t i = 0; i < cnt; ++i) {
+      PyObject* shp = PyList_GET_ITEM(lst, i);
+      Py_ssize_t nd = PyTuple_Size(shp);
+      ret_store.shape_ndim.push_back(static_cast<uint32_t>(nd));
+      for (Py_ssize_t d = 0; d < nd; ++d)
+        ret_store.shape_data.push_back(
+            PyLong_AsLongLong(PyTuple_GET_ITEM(shp, d)));
+    }
+  }
+  Py_DECREF(res);
+  *arg_count = counts[0];
+  *out_count = counts[1];
+  *aux_count = counts[2];
+  *all_ndims = ret_store.shape_ndim.data();
+  *all_dims = ret_store.shape_data.data();
+  return 0;
+}
+
+int MXTSymbolInferType(void* sym, uint32_t num_provided, const char** names,
+                       const int* dtypes, uint32_t* arg_count,
+                       const int** arg_types, uint32_t* out_count,
+                       const int** out_types, uint32_t* aux_count,
+                       const int** aux_types) {
+  Gil gil;
+  PyObject* dt = PyList_New(num_provided);
+  for (uint32_t i = 0; i < num_provided; ++i)
+    PyList_SET_ITEM(dt, i, PyLong_FromLong(dtypes[i]));
+  PyObject* args = Py_BuildValue("(ONNi)", static_cast<PyObject*>(sym),
+                                 StrList(names, num_provided), dt, 0);
+  PyObject* res = CallRt("symbol_infer_type", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTSymbolInferType");
+  static thread_local std::vector<int> arg_v, out_v, aux_v;
+  arg_v.clear(); out_v.clear(); aux_v.clear();
+  std::vector<int>* dsts[3] = {&arg_v, &out_v, &aux_v};
+  for (int part = 0; part < 3; ++part) {
+    PyObject* lst = PyTuple_GetItem(res, part);
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i)
+      dsts[part]->push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GET_ITEM(lst, i))));
+  }
+  Py_DECREF(res);
+  *arg_count = static_cast<uint32_t>(arg_v.size());
+  *arg_types = arg_v.data();
+  *out_count = static_cast<uint32_t>(out_v.size());
+  *out_types = out_v.data();
+  *aux_count = static_cast<uint32_t>(aux_v.size());
+  *aux_types = aux_v.data();
+  return 0;
+}
+
+// -- Executor ---------------------------------------------------------------
+
+int MXTExecutorPrint(void* exec, const char** out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(exec));
+  PyObject* res = CallRt("executor_print", args);
+  Py_DECREF(args);
+  return ReturnStr(res, out_str, "MXTExecutorPrint");
+}
+
+int MXTExecutorReshape(void* exec, uint32_t num_provided,
+                       const char** names, const uint32_t* ndims,
+                       const int64_t* shapes_flat, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(exec),
+                                 StrList(names, num_provided),
+                                 ShapeList(num_provided, ndims, shapes_flat));
+  PyObject* res = CallRt("executor_reshape", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTExecutorReshape");
+}
+
+int MXTExecutorBind(void* sym, uint32_t num_args, const char** names,
+                    void** arg_handles, const char* grad_req, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONNs)", static_cast<PyObject*>(sym),
+                                 StrList(names, num_args),
+                                 HandleList(arg_handles, num_args),
+                                 grad_req);
+  PyObject* res = CallRt("executor_bind", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTExecutorBind");
+}
+
+// -- KVStore ----------------------------------------------------------------
+
+int MXTKVStoreIsWorkerNode(int* out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", "worker");
+  PyObject* res = CallRt("kv_role", args);
+  Py_DECREF(args);
+  return ReturnInt(res, out, "MXTKVStoreIsWorkerNode");
+}
+
+int MXTKVStoreIsServerNode(int* out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", "server");
+  PyObject* res = CallRt("kv_role", args);
+  Py_DECREF(args);
+  return ReturnInt(res, out, "MXTKVStoreIsServerNode");
+}
+
+int MXTKVStoreIsSchedulerNode(int* out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", "scheduler");
+  PyObject* res = CallRt("kv_role", args);
+  Py_DECREF(args);
+  return ReturnInt(res, out, "MXTKVStoreIsSchedulerNode");
+}
+
+int MXTKVStoreGetNumDeadNode(void* kv, int node_id, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(kv),
+                                 node_id);
+  PyObject* res = CallRt("kv_num_dead", args);
+  Py_DECREF(args);
+  return ReturnInt(res, out, "MXTKVStoreGetNumDeadNode");
+}
+
+int MXTKVStoreSetGradientCompression(void* kv, uint32_t num_params,
+                                     const char** keys,
+                                     const char** vals) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(kv),
+                                 StrList(keys, num_params),
+                                 StrList(vals, num_params));
+  PyObject* res = CallRt("kv_set_gradient_compression", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStoreSetGradientCompression");
+}
+
+int MXTKVStorePullRowSparse(void* kv, const char* key, void* row_ids,
+                            void* out_arr) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OsOO)", static_cast<PyObject*>(kv), key,
+                                 static_cast<PyObject*>(row_ids),
+                                 static_cast<PyObject*>(out_arr));
+  PyObject* res = CallRt("kv_pull_row_sparse", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTKVStorePullRowSparse");
+}
+
+int MXTNotifyShutdown(void) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("notify_shutdown", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTNotifyShutdown");
+}
+
+int MXTInitPSEnv(uint32_t num_vars, const char** keys, const char** vals) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(NN)", StrList(keys, num_vars),
+                                 StrList(vals, num_vars));
+  PyObject* res = CallRt("init_ps_env", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTInitPSEnv");
+}
+
+// -- Profiler object family -------------------------------------------------
+
+static int ProfileCreate(const char* kind, void* domain, const char* name,
+                         void** out, const char* who) {
+  EnsurePython();
+  Gil gil;
+  PyObject* dom = domain ? static_cast<PyObject*>(domain) : Py_None;
+  PyObject* args = Py_BuildValue("(sOs)", kind, dom, name);
+  PyObject* res = CallRt("profile_create", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, who);
+}
+
+int MXTProfileCreateDomain(const char* name, void** out) {
+  return ProfileCreate("domain", nullptr, name, out,
+                       "MXTProfileCreateDomain");
+}
+
+int MXTProfileCreateTask(void* domain, const char* name, void** out) {
+  return ProfileCreate("task", domain, name, out, "MXTProfileCreateTask");
+}
+
+int MXTProfileCreateFrame(void* domain, const char* name, void** out) {
+  return ProfileCreate("frame", domain, name, out,
+                       "MXTProfileCreateFrame");
+}
+
+int MXTProfileCreateEvent(const char* name, void** out) {
+  return ProfileCreate("event", nullptr, name, out,
+                       "MXTProfileCreateEvent");
+}
+
+int MXTProfileCreateCounter(void* domain, const char* name, void** out) {
+  return ProfileCreate("counter", domain, name, out,
+                       "MXTProfileCreateCounter");
+}
+
+int MXTProfileDestroyHandle(void* handle) {
+  if (handle == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+int MXTProfileDurationStart(void* handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle), 1);
+  PyObject* res = CallRt("profile_duration", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfileDurationStart");
+}
+
+int MXTProfileDurationStop(void* handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle), 0);
+  PyObject* res = CallRt("profile_duration", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfileDurationStop");
+}
+
+int MXTProfileSetCounter(void* handle, uint64_t value) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OK)", static_cast<PyObject*>(handle),
+                                 static_cast<unsigned long long>(value));
+  PyObject* res = CallRt("profile_counter_set", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfileSetCounter");
+}
+
+int MXTProfileAdjustCounter(void* handle, int64_t delta) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OL)", static_cast<PyObject*>(handle),
+                                 static_cast<long long>(delta));
+  PyObject* res = CallRt("profile_counter_adjust", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfileAdjustCounter");
+}
+
+int MXTProfileSetMarker(void* domain, const char* name,
+                        const char* scope) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oss)", static_cast<PyObject*>(domain),
+                                 name, scope ? scope : "process");
+  PyObject* res = CallRt("profile_set_marker", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfileSetMarker");
+}
+
+int MXTProfilePause(int paused) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", paused);
+  PyObject* res = CallRt("profile_pause", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTProfilePause");
+}
+
+int MXTAggregateProfileStatsPrint(const char** out_str, int reset,
+                                  const char* format, const char* sort_by,
+                                  int ascending) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(issi)", reset, format ? format : "table",
+                                 sort_by ? sort_by : "total", ascending);
+  PyObject* res = CallRt("profile_aggregate_stats", args);
+  Py_DECREF(args);
+  return ReturnStr(res, out_str, "MXTAggregateProfileStatsPrint");
+}
+
+// -- misc -------------------------------------------------------------------
+
+int MXTEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", bulk_size);
+  PyObject* res = CallRt("engine_set_bulk_size", args);
+  Py_DECREF(args);
+  return ReturnInt(res, prev_bulk_size, "MXTEngineSetBulkSize");
+}
+
+int MXTLibInfoFeatures(uint32_t* out_size, const char*** out_pairs) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("lib_info_features", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTLibInfoFeatures");
+  return ReturnStrList(res, out_size, out_pairs, "MXTLibInfoFeatures");
+}
+
+int MXTRandomSeedContext(int seed, int dev_type, int dev_id) {
+  (void)dev_type;
+  (void)dev_id;
+  return MXTRandomSeed(seed);
+}
+
+int MXTIsNumpyShape(int* out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("np_shape_is", args);
+  Py_DECREF(args);
+  return ReturnInt(res, out, "MXTIsNumpyShape");
+}
+
+int MXTSetIsNumpyShape(int is_np_shape, int* prev) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", is_np_shape);
+  PyObject* res = CallRt("np_shape_set", args);
+  Py_DECREF(args);
+  return ReturnInt(res, prev, "MXTSetIsNumpyShape");
+}
+
+// "GPU" in the reference ABI = the accelerator; here that is the TPU
+// fleet PJRT exposes (ref: MXGetGPUCount / MXGetGPUMemoryInformation64).
+int MXTGetGPUCount(int* out) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("device_count", args);
+  Py_DECREF(args);
+  return ReturnInt(res, out, "MXTGetGPUCount");
+}
+
+int MXTGetGPUMemoryInformation(int dev_id, uint64_t* free_mem,
+                               uint64_t* total_mem) {
+  EnsurePython();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", dev_id);
+  PyObject* res = CallRt("device_memory_info", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTGetGPUMemoryInformation");
+  unsigned long long f = 0, t = 0;
+  if (!PyArg_ParseTuple(res, "KK", &f, &t)) {
+    Py_DECREF(res);
+    return PyFail("MXTGetGPUMemoryInformation");
+  }
+  Py_DECREF(res);
+  *free_mem = f;
+  *total_mem = t;
+  return 0;
+}
+
+int MXTDataIterGetPadNum(void* iter, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
+  PyObject* res = CallRt("dataiter_pad", args);
+  Py_DECREF(args);
+  return ReturnInt(res, out, "MXTDataIterGetPadNum");
+}
+
+int MXTDataIterGetIndex(void* iter, uint64_t** out_index,
+                        uint64_t* out_size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
+  PyObject* res = CallRt("dataiter_index", args);
+  Py_DECREF(args);
+  if (res == nullptr) return PyFail("MXTDataIterGetIndex");
+  static thread_local std::vector<uint64_t> idx;
+  idx.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i)
+    idx.push_back(static_cast<uint64_t>(
+        PyLong_AsUnsignedLongLong(PyList_GET_ITEM(res, i))));
+  Py_DECREF(res);
+  *out_size = idx.size();
+  *out_index = idx.data();
+  return 0;
+}
+
+int MXTAutogradComputeGradient(uint32_t num_output, void** output_handles) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)",
+                                 HandleList(output_handles, num_output));
+  PyObject* res = CallRt("backward", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTAutogradComputeGradient");
+}
+
+int MXTAutogradGetSymbol(void* handle, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallRt("autograd_get_symbol", args);
+  Py_DECREF(args);
+  return ReturnHandle(res, out, "MXTAutogradGetSymbol");
+}
+
+int MXTStorageEmptyCache(int dev_type, int dev_id) {
+  (void)dev_type;
+  (void)dev_id;
+  EnsurePython();
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallRt("storage_empty_cache", args);
+  Py_DECREF(args);
+  return ReturnOk(res, "MXTStorageEmptyCache");
 }
 
 }  // extern "C"

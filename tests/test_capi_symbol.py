@@ -525,3 +525,32 @@ class TestRound3Additions:
         _ck(lib, lib.MXTSymbolCopy(h, ctypes.byref(cp)))
         for x in (h, internals, out0, cp):
             lib.MXTSymbolFree(x)
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
+def test_c_demo_cachedop_cache_hits(tmp_path):
+    """VERDICT r4 done-criterion: a C caller drives the jit seam —
+    second same-signature invoke hits the compile cache, a resized
+    input recompiles (example/capi/cachedop_demo.c)."""
+    if _build_lib() is None:
+        pytest.skip("frontier C ABI not built")
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    net = mx.sym.FullyConnected(data, weight=w, num_hidden=2,
+                                no_bias=True, name="fc")
+    net.save(str(tmp_path / "sym.json"))
+    demo = os.path.join(REPO, "example", "capi", "cachedop_demo.c")
+    exe = str(tmp_path / "cachedop_demo")
+    subprocess.run(
+        ["gcc", "-O2", demo, "-o", exe,
+         "-L" + os.path.join(REPO, "mxnet_tpu"), "-lmxnet_tpu",
+         "-Wl,-rpath," + os.path.join(REPO, "mxnet_tpu")],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run([exe, str(tmp_path / "sym.json")], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "calls=2 compiles=1" in res.stdout
+    assert "calls=3 compiles=2" in res.stdout
+    assert "CachedOp C ABI OK" in res.stdout

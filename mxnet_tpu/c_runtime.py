@@ -71,8 +71,8 @@ def invoke(op_name, inputs, keys, vals):
     # calling convention on this surface (state mutated, one output) —
     # the nd wrappers (ndarray/optimizer_ops.py) shadow the pure
     # registry forms here exactly as they do in the nd namespace
-    from .ndarray import optimizer_ops as _opt_ops
-    if op_name in _opt_ops.__all__:
+    if op_name in _inplace_update_ops():
+        from .ndarray import optimizer_ops as _opt_ops
         out = getattr(_opt_ops, op_name)(*inputs, **kwargs)
     else:
         try:
@@ -81,6 +81,17 @@ def invoke(op_name, inputs, keys, vals):
             raise KeyError("no such operator: %r" % op_name)
         out = _register.invoke(opdef, inputs, kwargs)
     return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+_INPLACE_UPDATE_OPS = None
+
+
+def _inplace_update_ops():
+    global _INPLACE_UPDATE_OPS
+    if _INPLACE_UPDATE_OPS is None:
+        from .ndarray import optimizer_ops as _opt_ops
+        _INPLACE_UPDATE_OPS = frozenset(_opt_ops.__all__)
+    return _INPLACE_UPDATE_OPS
 
 
 def mark_variables(arrs):
@@ -756,8 +767,8 @@ def lib_info_features():
     """Flat [name, '1'/'0', ...] pairs (ref: MXLibInfoFeatures)."""
     from .runtime import Features
     out = []
-    for name, enabled in Features().items():
-        out.extend([str(name), "1" if enabled else "0"])
+    for name, feat in Features().items():
+        out.extend([str(name), "1" if feat.enabled else "0"])
     return out
 
 
@@ -772,8 +783,10 @@ def np_shape_set(active):
 
 
 def device_count():
+    """Accelerator count (ref: MXGetGPUCount) — CPU devices excluded so
+    a CPU-only host reports 0, like the reference without GPUs."""
     import jax
-    return len(jax.devices())
+    return sum(1 for d in jax.devices() if d.platform != "cpu")
 
 
 def device_memory_info(dev_id):
@@ -787,18 +800,14 @@ def device_memory_info(dev_id):
 
 
 def dataiter_index(it):
-    batch = getattr(it, "_c_current", None)
+    batch = getattr(it, "batch", None)  # _IterCursor.batch
     idx = getattr(batch, "index", None) if batch is not None else None
     return [int(i) for i in idx] if idx is not None else []
 
 
 def dataiter_pad(it):
-    batch = getattr(it, "_c_current", None)
+    batch = getattr(it, "batch", None)  # _IterCursor.batch
     return int(getattr(batch, "pad", 0) or 0) if batch is not None else 0
-
-
-def autograd_get_symbol(arr):
-    return autograd.get_symbol(arr)
 
 
 def storage_empty_cache():

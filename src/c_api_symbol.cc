@@ -1620,14 +1620,6 @@ int MXTAutogradComputeGradient(uint32_t num_output, void** output_handles) {
   return ReturnOk(res, "MXTAutogradComputeGradient");
 }
 
-int MXTAutogradGetSymbol(void* handle, void** out) {
-  Gil gil;
-  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
-  PyObject* res = CallRt("autograd_get_symbol", args);
-  Py_DECREF(args);
-  return ReturnHandle(res, out, "MXTAutogradGetSymbol");
-}
-
 int MXTStorageEmptyCache(int dev_type, int dev_id) {
   (void)dev_type;
   (void)dev_id;

@@ -165,6 +165,7 @@ class AsyncPSServer:
         # pinned at construction: later env mutation must not change
         # what the server trusts
         self._secret = _ps_secret()
+        self.bind_host = bind_host
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((bind_host, port))
@@ -465,21 +466,32 @@ class AsyncPSServer:
 class AsyncPSClient:
     """Worker-side connection (the reference's ps::KVWorker)."""
 
-    def __init__(self, host, port, retries=50):
-        import time
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        for attempt in range(retries):
-            try:
-                self._sock.connect((host, port))
-                break
-            except ConnectionRefusedError:
-                if attempt == retries - 1:
-                    raise
-                time.sleep(0.1)  # server still coming up on rank 0
+    def __init__(self, host, port, retries=100):
+        # connection is LAZY: in a sharded group, the server hosted by a
+        # higher rank may not exist yet when lower ranks build their
+        # client sets — first use retries until it binds (the ps-lite
+        # worker's connect-to-server rendezvous)
+        self._sock = None
+        self._retries = retries
         self._lock = threading.Lock()
         self._addr = (host, port)
         self.bytes_pushed = 0  # wire accounting (sparse/compressed tests)
         self._hb_stop = None
+
+    def _ensure_connected(self):
+        if self._sock is not None:
+            return
+        import time
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        for attempt in range(self._retries):
+            try:
+                sock.connect(self._addr)
+                break
+            except (ConnectionRefusedError, OSError):
+                if attempt == self._retries - 1:
+                    raise
+                time.sleep(0.1)  # server still coming up on its rank
+        self._sock = sock
 
     def start_heartbeat(self, rank, interval=0.5):
         """Background liveness beats (ref: ps-lite heartbeats feeding
@@ -490,11 +502,22 @@ class AsyncPSClient:
         self._hb_stop = threading.Event()
 
         def run():
+            failures = 0
             while not self._hb_stop.is_set():
                 try:
                     self.heartbeat(rank)
+                    failures = 0
                 except (ConnectionError, OSError, RuntimeError):
-                    return
+                    # a straggler server may not be up yet (lazy
+                    # connect): keep beating; give up only after a
+                    # sustained outage, loudly
+                    failures += 1
+                    if failures > 600:
+                        warnings.warn(
+                            "heartbeat to %s:%d failed %d times; "
+                            "liveness tracking stops for this pair"
+                            % (*self._addr, failures), RuntimeWarning)
+                        return
                 self._hb_stop.wait(interval)
 
         self._hb_thread = threading.Thread(target=run, daemon=True)
@@ -508,6 +531,7 @@ class AsyncPSClient:
 
     def _call(self, payload):
         with self._lock:
+            self._ensure_connected()
             _send_frame(self._sock, payload)
             resp = _recv_frame(self._sock)
         if resp is None:
@@ -645,16 +669,55 @@ class AsyncKVStore:
         nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
         self._rank = rank
         self._num_workers = nproc
-        self._server, self._client = serve_if_rank0(rank)
+        self._servers, self._clients = serve_group(rank)
+        self._server = self._servers[0] if self._servers else None
+        self._client = self._clients[0]  # control plane (barrier etc.)
         self._optimizer = None
         self._done_sent = False
         self._compression = None
         self._compression_bound = int(os.environ.get(
             "MXNET_KVSTORE_SIZE_LOWER_BOUND", "4096"))
+        # dense arrays >= this many elements are SPLIT across the server
+        # group (ref: kvstore_dist.h:58 MXNET_KVSTORE_BIGARRAY_BOUND)
+        self._bigarray_bound = int(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", str(1000 * 1000)))
+        self._split = {}  # key -> (shape, dtype, [shard lengths])
         self._residuals = {}
-        # liveness beats feed the server's dead-node tracking
-        self._client.start_heartbeat(rank, interval=float(
-            os.environ.get("MXTPU_PS_HEARTBEAT_INTERVAL", "0.5")))
+        # liveness beats feed each server's dead-node tracking
+        hb = float(os.environ.get("MXTPU_PS_HEARTBEAT_INTERVAL", "0.5"))
+        for c in self._clients:
+            c.start_heartbeat(rank, interval=hb)
+        # Trainer/Module never call done() themselves; signal at process
+        # exit so server shutdown never stalls on missing done()s
+        # (the reference's Postoffice barrier-before-exit is implicit).
+        # weakref: atexit must not pin closed stores for process life
+        import atexit
+        import weakref
+        ref = weakref.ref(self)
+        atexit.register(lambda: getattr(ref(), "done", lambda: None)())
+
+    # -- key placement (EncodeDefaultKey semantics) -------------------------
+    def _owner(self, key):
+        """Stable key -> server index (ref: kvstore_dist.h:263
+        EncodeDefaultKey; int-looking keys use modulo like the
+        reference, others a stable string hash)."""
+        n = len(self._clients)
+        if n == 1:
+            return 0
+        try:
+            return int(key) % n
+        except (TypeError, ValueError):
+            import zlib
+            return zlib.crc32(str(key).encode()) % n
+
+    def _shard_lens(self, size):
+        n = len(self._clients)
+        base, extra = divmod(int(size), n)
+        return [base + (1 if i < extra else 0) for i in range(n)]
+
+    @staticmethod
+    def _shard_key(key, i):
+        return "%s#s%d" % (key, i)
 
     # identity
     @property
@@ -672,9 +735,29 @@ class AsyncKVStore:
     # data plane
     def init(self, key, value):
         from .kvstore import _ctype_key_value
+        from .ndarray.sparse import RowSparseNDArray
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
-            self._client.init(k, vlist[0].asnumpy())
+            host = vlist[0].asnumpy()
+            if isinstance(vlist[0], RowSparseNDArray):
+                # row-sparse params route whole-key (push does too) —
+                # splitting would strand the key the RSP push targets
+                self._clients[self._owner(k)].init(k, host)
+                continue
+            if len(self._clients) > 1 \
+                    and host.size >= self._bigarray_bound:
+                # big-array split: contiguous flat slices, one per
+                # server (ref: kvstore_dist.h EncodeDefaultKey big path)
+                lens = self._shard_lens(host.size)
+                self._split[k] = (host.shape, host.dtype, lens)
+                flat = host.ravel()
+                off = 0
+                for i, ln in enumerate(lens):
+                    self._clients[i].init(self._shard_key(k, i),
+                                          flat[off:off + ln])
+                    off += ln
+            else:
+                self._clients[self._owner(k)].init(k, host)
 
     def push(self, key, value, priority=0):
         from .kvstore import _ctype_key_value
@@ -684,32 +767,82 @@ class AsyncKVStore:
         for k, vlist in zip(keys, vals):
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
             if isinstance(merged, RowSparseNDArray):
-                # lazy .indices/.values (private slots are None for a
-                # RowSparseNDArray built from dense)
-                self._client.push_row_sparse(
+                # row-sparse keys are whole-key routed (the reference
+                # splits rows too; documented simplification — lazy
+                # .indices/.values are None for dense-built arrays)
+                self._clients[self._owner(k)].push_row_sparse(
                     k, merged.indices.asnumpy(),
                     merged.data.asnumpy())
-            elif self._compression is not None \
-                    and merged.size >= self._compression_bound:
-                self._push_compressed(k, merged)
+            elif k in self._split:
+                flat = merged.asnumpy().ravel()
+                jobs = []
+                off = 0
+                for i, ln in enumerate(self._split[k][2]):
+                    jobs.append((i, self._shard_key(k, i),
+                                 flat[off:off + ln]))
+                    off += ln
+                self._fanout(lambda j: self._push_dense(*j), jobs)
             else:
-                self._client.push(k, merged.asnumpy())
+                self._push_dense(self._owner(k), k, merged.asnumpy())
 
-    def _push_compressed(self, key, grad):
-        """2-bit quantize with per-key error-feedback residual; only
-        the int32 words cross the TCP wire (16x smaller than fp32) —
-        the async path now has the sync path's wire optimization."""
+    def _push_dense(self, cidx, key, host):
+        if self._compression is not None \
+                and host.size >= self._compression_bound:
+            self._push_compressed(cidx, key, host)
+        else:
+            self._clients[cidx].push(key, host)
+
+    def _push_compressed(self, cidx, key, host):
+        """2-bit quantize with per-(shard)key error-feedback residual;
+        only the int32 words cross the TCP wire (16x smaller than fp32)
+        — the async path has the sync path's wire optimization."""
         import jax.numpy as jnp
         from .pallas_kernels.compression import quantize_2bit_jnp
         thr = self._compression["threshold"]
-        flat = jnp.asarray(grad.asnumpy().ravel(), jnp.float32)
+        flat = jnp.asarray(np.ravel(host), jnp.float32)
         res = self._residuals.get(key)
         if res is None or res.shape != flat.shape:
             res = jnp.zeros_like(flat)
         words, new_res = quantize_2bit_jnp(flat, res, thr)
         self._residuals[key] = new_res
-        self._client.push_compressed(key, np.asarray(words), flat.shape[0],
-                                     thr)
+        self._clients[cidx].push_compressed(key, np.asarray(words),
+                                            flat.shape[0], thr)
+
+    @staticmethod
+    def _fanout(fn, jobs):
+        """Run one job per server shard concurrently — each client has
+        its own socket/lock, so shard transfers overlap instead of
+        paying N serialized round trips."""
+        if len(jobs) == 1:
+            return [fn(jobs[0])]
+        results = [None] * len(jobs)
+        errors = []
+
+        def run(i, j):
+            try:
+                results[i] = fn(j)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=(i, j), daemon=True)
+              for i, j in enumerate(jobs)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errors:
+            raise errors[0]
+        return results
+
+    def _pull_host(self, k):
+        if k in self._split:
+            shape, dtype, lens = self._split[k]
+            parts = self._fanout(
+                lambda i: self._clients[i].pull(self._shard_key(k, i)),
+                list(range(len(lens))))
+            return np.concatenate(
+                [np.ravel(p) for p in parts]).astype(dtype).reshape(shape)
+        return self._clients[self._owner(k)].pull(k)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         from .kvstore import _ctype_key_value
@@ -717,7 +850,7 @@ class AsyncKVStore:
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
-            arr = jnp.asarray(self._client.pull(k))
+            arr = jnp.asarray(self._pull_host(k))
             for o in olist:
                 o._data = arr
         return out
@@ -735,11 +868,13 @@ class AsyncKVStore:
         return out
 
     def set_optimizer(self, optimizer):
-        """Pickle the optimizer to the server, which applies it per push
-        (ref: python/mxnet/kvstore_server.py _controller). The blob is
-        HMAC-authenticated on the wire — see module docstring."""
+        """Pickle the optimizer to every server in the group, which
+        applies it per push (ref: python/mxnet/kvstore_server.py
+        _controller). The blob is HMAC-authenticated on the wire — see
+        module docstring."""
         self._optimizer = optimizer
-        self._client.set_optimizer(optimizer)
+        for c in self._clients:
+            c.set_optimizer(optimizer)
 
     # the rest of the KVStore surface callers touch (Module/Trainer) —
     # same contracts as kvstore.py
@@ -793,11 +928,17 @@ class AsyncKVStore:
         if not isinstance(row_ids, list):
             row_ids = [row_ids] * len(keys)
         for k, olist, rids in zip(keys, outs, row_ids):
+            if k in self._split:
+                raise NotImplementedError(
+                    "row_sparse_pull of a big-array-split key; raise "
+                    "MXNET_KVSTORE_BIGARRAY_BOUND or keep row-sparse "
+                    "params below it")
+            owner = self._clients[self._owner(k)]
             ids = np.asarray(rids.asnumpy()
                              if isinstance(rids, NDArray) else rids,
                              np.int64)
-            rows = self._client.pull_row_sparse(k, ids)
-            full_shape = self._client.shape_of(k)  # cheap shape query
+            rows = owner.pull_row_sparse(k, ids)
+            full_shape = owner.shape_of(k)  # cheap shape query
             for o in olist:
                 if isinstance(o, RowSparseNDArray):
                     new = row_sparse_array((rows, ids), shape=full_shape)
@@ -822,55 +963,118 @@ class AsyncKVStore:
         return self._client.dead_nodes(timeout)
 
     def set_server_profiler_command(self, cmd, body=""):
-        """Forward a profiler command to the PS server process
+        """Forward a profiler command to every PS server process
         (ref: KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49):
         cmd in {'set_config', 'state', 'dump'}."""
-        self._client.profiler_command(cmd, body)
+        for c in self._clients:
+            c.profiler_command(cmd, body)
 
     def updates_applied(self):
-        return self._client.updates_applied()
+        return sum(c.updates_applied() for c in self._clients)
 
     def done(self):
-        """Signal this worker finished (coordination for clean server
-        shutdown — the reference's Postoffice barrier-before-exit)."""
+        """Signal this worker finished to every server (coordination for
+        clean group shutdown — the reference's Postoffice
+        barrier-before-exit). Registered atexit, so Trainer/Module exits
+        that never call it explicitly still signal."""
         if not self._done_sent:
             self._done_sent = True
-            self._client.stop_heartbeat()
-            self._client.done(self._rank)
+            for c in self._clients:
+                c.stop_heartbeat()
+            for c in self._clients:
+                try:
+                    c.done(self._rank)
+                except (ConnectionError, OSError):
+                    pass  # server already gone at interpreter exit
 
     def close(self):
         # Count our own rank as done so a Trainer/Module exit that never
         # called done() explicitly doesn't stall waiting for itself.
-        self._client.stop_heartbeat()
         self.done()
-        if self._server is not None:
-            self._client.wait_done(self._num_workers)
-            self._client.stop_server()
-            self._server.stop()
+        # server-hosting ranks wait for all workers on THEIR servers
+        # (each worker done()s every server), then stop them
+        for srv in self._servers:
+            cli = AsyncPSClient(srv.bind_host, srv.port)
+            cli.wait_done(self._num_workers)
+            cli.stop_server()
+            srv.stop()
 
 
 def serve_if_rank0(rank, port_env="MXTPU_ASYNC_PS_PORT"):
-    """Launcher hook: rank 0 hosts the server; every rank returns a
-    client. The port is derived deterministically from the launcher's
-    coordinator port (DMLC_PS_ROOT_PORT analog) so non-zero ranks know
-    it before the server even starts — they retry until rank 0 binds.
+    """Back-compat single-server hook: (server-or-None, one client)."""
+    servers, clients = serve_group(rank, port_env=port_env)
+    return (servers[0] if servers else None), clients[0]
 
-    The server binds to the coordinator interface when one is
-    configured (multi-host), else loopback — never 0.0.0.0."""
+
+def serve_group(rank, port_env="MXTPU_ASYNC_PS_PORT"):
+    """Launcher hook for the SHARDED server group (VERDICT r3 item 6;
+    ref: the reference's DMLC_NUM_SERVER server processes +
+    EncodeDefaultKey placement, src/kvstore/kvstore_dist.h:263).
+
+    ``MXTPU_NUM_SERVERS`` (default 1) server endpoints exist; in a
+    multi-process job rank s < num_servers hosts server s (one server
+    thread per designated rank), and in a single process rank 0 hosts
+    all of them. Ports are deterministic — coordinator port + 1001 + s
+    (DMLC_PS_ROOT_PORT analog) — so every rank can build its client
+    set before the servers even bind (clients retry).
+
+    Returns (servers_hosted_here, clients[num_servers]). Servers bind
+    the coordinator interface when one is configured (multi-host), else
+    loopback — never 0.0.0.0."""
+    num_servers = max(1, int(os.environ.get("MXTPU_NUM_SERVERS", "1")))
+    nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
     coord = os.environ.get("MXTPU_COORDINATOR", "")
     if coord and ":" in coord:
         host, cport = coord.rsplit(":", 1)
         host = host or "127.0.0.1"
-        port = int(os.environ.get(port_env, 0)) or (int(cport) + 1001)
+        base = int(os.environ.get(port_env, 0)) or (int(cport) + 1001)
     else:
-        host, port = "127.0.0.1", int(os.environ.get(port_env, 0))
+        host, base = "127.0.0.1", int(os.environ.get(port_env, 0))
     if rank == 0 and "MXTPU_PS_SECRET" not in os.environ:
         # generated before fork/spawn of local workers; multi-host
         # launchers pass MXTPU_* env through (tools/launch.py)
         os.environ["MXTPU_PS_SECRET"] = _secrets.token_hex(32)
-    if rank == 0:
-        bind = host if host not in ("127.0.0.1", "localhost") else "127.0.0.1"
-        server = AsyncPSServer(port, bind_host=bind)
-        os.environ[port_env] = str(server.port)
-        return server, AsyncPSClient(bind, server.port)
-    return None, AsyncPSClient(host, port)
+    bind = host if host not in ("127.0.0.1", "localhost") else "127.0.0.1"
+    if nproc == 1:
+        my_ids = list(range(num_servers)) if rank == 0 else []
+    else:
+        my_ids = [rank] if rank < num_servers else []
+        if num_servers > nproc:
+            raise ValueError(
+                "MXTPU_NUM_SERVERS=%d > number of processes %d"
+                % (num_servers, nproc))
+    def _env_key(s):
+        return port_env if s == 0 else "%s_%d" % (port_env, s)
+
+    def _derived_port(s):
+        """env override first, else deterministic base+s (0 = ephemeral,
+        valid only for servers hosted in this process)."""
+        return int(os.environ.get(_env_key(s), 0)) \
+            or (base + s if base else 0)
+
+    servers = []
+    ports = {}
+    for s in my_ids:
+        srv = AsyncPSServer(_derived_port(s), bind_host=bind)
+        servers.append(srv)
+        ports[s] = srv.port
+
+    # publish the ports we actually bound (ephemeral-port flow: workers
+    # spawned AFTER the server host inherit these through the env, the
+    # pre-sharding serve_if_rank0 contract); hosting overwrites stale
+    # values from any earlier in-process group
+    for s, p in ports.items():
+        os.environ[_env_key(s)] = str(p)
+    clients = []
+    for s in range(num_servers):
+        if s in ports:          # hosted in this process: exact port
+            clients.append(AsyncPSClient(bind, ports[s]))
+            continue
+        p = _derived_port(s)
+        if not p:
+            raise RuntimeError(
+                "cannot discover server %d's port: set %s or run under "
+                "tools/launch.py (coordinator port + 1001 + s)"
+                % (s, _env_key(s)))
+        clients.append(AsyncPSClient(host, p))
+    return servers, clients

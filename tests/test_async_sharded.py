@@ -1,0 +1,210 @@
+"""Sharded async PS server group (VERDICT r3 item 6): key placement
+across servers (EncodeDefaultKey semantics), big-array splitting, and
+clean multi-server shutdown with zero done() warnings.
+
+ref: src/kvstore/kvstore_dist.h:58 MXNET_KVSTORE_BIGARRAY_BOUND,
+:263 EncodeDefaultKey (small keys -> key %% num_servers; big arrays
+sliced across the whole group).
+"""
+import multiprocessing as mp
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.fixture()
+def sharded_env(monkeypatch):
+    monkeypatch.delenv("MXTPU_COORDINATOR", raising=False)
+    monkeypatch.setenv("MXTPU_PROC_ID", "0")
+    monkeypatch.setenv("MXTPU_NUM_PROCS", "1")
+    monkeypatch.setenv("MXTPU_NUM_SERVERS", "2")
+    monkeypatch.setenv("MXTPU_ASYNC_PS_PORT", "0")
+    # serve_group publishes bound ports into these; ensure they are
+    # both absent at entry and restored at teardown
+    monkeypatch.delenv("MXTPU_ASYNC_PS_PORT_1", raising=False)
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1000")
+    yield
+
+
+def test_key_placement_and_split(sharded_env):
+    kv = mx.kv.create("dist_async")
+    try:
+        assert len(kv._servers) == 2 and len(kv._clients) == 2
+        # small int keys place at key % num_servers (EncodeDefaultKey)
+        kv.init(0, mx.nd.array(np.ones((4,), np.float32)))
+        kv.init(1, mx.nd.array(np.full((4,), 2.0, np.float32)))
+        assert tuple(kv._clients[0].shape_of(0)) == (4,)
+        assert tuple(kv._clients[1].shape_of(1)) == (4,)
+        with pytest.raises(Exception):
+            kv._clients[1].shape_of(0)  # not on the other server
+        # big array splits into contiguous flat shards, one per server
+        big = np.arange(2500, dtype=np.float32).reshape(50, 50)
+        kv.init("w_big", mx.nd.array(big))
+        assert "w_big" in kv._split
+        lens = kv._split["w_big"][2]
+        assert sum(lens) == 2500 and len(lens) == 2
+        s0 = kv._clients[0].pull("w_big#s0")
+        s1 = kv._clients[1].pull("w_big#s1")
+        np.testing.assert_allclose(
+            np.concatenate([s0.ravel(), s1.ravel()]), big.ravel())
+        # pull reassembles
+        out = mx.nd.array(np.zeros_like(big))
+        kv.pull("w_big", out=out)
+        np.testing.assert_allclose(out.asnumpy(), big)
+    finally:
+        kv.close()
+
+
+def test_split_push_through_optimizer(sharded_env):
+    import mxnet_tpu.optimizer as opt
+    kv = mx.kv.create("dist_async")
+    try:
+        w0 = np.ones((60, 30), np.float32)  # 1800 > bound -> split
+        kv.init("w", mx.nd.array(w0))
+        kv.set_optimizer(opt.create("sgd", learning_rate=0.5, wd=0.0))
+        kv.push("w", mx.nd.array(np.full_like(w0, 2.0)))
+        out = mx.nd.array(np.zeros_like(w0))
+        kv.pull("w", out=out)
+        # w - lr * g = 1 - 0.5*2 = 0, uniformly across BOTH shards
+        np.testing.assert_allclose(out.asnumpy(), 0.0, atol=1e-6)
+        assert kv.updates_applied() == 2  # one per server shard
+    finally:
+        kv.close()
+
+
+def test_split_push_compressed(sharded_env):
+    import mxnet_tpu.optimizer as opt
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5,
+                                     "size_lower_bound": 128})
+        w0 = np.ones((2048,), np.float32)
+        kv.init("wc", mx.nd.array(w0))
+        kv.set_optimizer(opt.create("sgd", learning_rate=1.0, wd=0.0))
+        kv.push("wc", mx.nd.array(np.full_like(w0, 0.9)))
+        out = mx.nd.array(np.zeros_like(w0))
+        kv.pull("wc", out=out)
+        # 2-bit quantizes grad 0.9 -> threshold 0.5; w = 1 - 0.5
+        np.testing.assert_allclose(out.asnumpy(), 0.5, atol=1e-6)
+    finally:
+        kv.close()
+
+
+def test_clean_shutdown_no_warnings(sharded_env):
+    """Done-criterion: shutdown with ZERO stall warnings."""
+    kv = mx.kv.create("dist_async")
+    kv.init(7, mx.nd.array(np.zeros((4,), np.float32)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        kv.close()  # would raise if the done() stall warning fired
+
+
+def _sharded_worker(rank, nproc, port0, port1):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXTPU_PROC_ID"] = str(rank)
+    os.environ["MXTPU_NUM_PROCS"] = str(nproc)
+    os.environ["MXTPU_NUM_SERVERS"] = "2"
+    os.environ["MXTPU_ASYNC_PS_PORT"] = port0
+    os.environ["MXTPU_ASYNC_PS_PORT_1"] = port1
+    os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "1000"
+    import warnings as _w
+    import mxnet_tpu as mx2
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)
+        kv = mx2.kv.create("dist_async")
+        kv.init(0, mx2.nd.array(np.zeros((4,), np.float32)))
+        kv.init(1, mx2.nd.array(np.zeros((4,), np.float32)))
+        kv.init("big", mx2.nd.array(np.zeros((1600,), np.float32)))
+        kv.push(0, mx2.nd.array(np.ones((4,), np.float32)))
+        kv.push(1, mx2.nd.array(np.ones((4,), np.float32)))
+        kv.push("big", mx2.nd.array(np.ones((1600,), np.float32)))
+        kv._barrier()
+        out = mx2.nd.array(np.zeros((1600,), np.float32))
+        kv.pull("big", out=out)
+        # sum semantics without optimizer: every worker's push landed
+        assert out.asnumpy().sum() >= 1600, out.asnumpy().sum()
+        kv.close()  # clean: zero RuntimeWarnings or we exit nonzero
+
+
+def test_multiprocess_two_servers():
+    """3 workers, 2 servers hosted by ranks 0 and 1; keys split across
+    both; every worker shuts down with zero stall warnings."""
+    os.environ.pop("MXTPU_COORDINATOR", None)
+    os.environ["MXTPU_PROC_ID"] = "0"
+    os.environ["MXTPU_NUM_PROCS"] = "3"
+    os.environ["MXTPU_NUM_SERVERS"] = "2"
+    os.environ["MXTPU_ASYNC_PS_PORT"] = "0"
+    os.environ.pop("MXTPU_ASYNC_PS_PORT_1", None)
+    os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "1000"
+    os.environ["MXTPU_PS_DONE_TIMEOUT"] = "60"
+    try:
+        # pre-agree server 1's port BEFORE rank 0 builds its client set
+        # (rank 1 will host it; rank 0 needs the address at construction)
+        import socket
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port1 = str(s.getsockname()[1])
+        os.environ["MXTPU_ASYNC_PS_PORT_1"] = port1
+        # rank 0 (this process) hosts server 0; rank 1 hosts server 1
+        kv = mx.kv.create("dist_async")
+        try:
+            assert len(kv._servers) == 1  # rank 0 hosts exactly server 0
+            port0 = os.environ["MXTPU_ASYNC_PS_PORT"]
+            ctx = mp.get_context("spawn")
+            procs = [ctx.Process(target=_sharded_worker,
+                                 args=(r, 3, port0, port1))
+                     for r in (1, 2)]
+            for p in procs:
+                p.start()
+            # this process is ALSO worker rank 0; signal done BEFORE
+            # joining (rank 1's close waits for our done on server 1)
+            _rank0_worker_body(kv)
+            kv.done()
+            for p in procs:
+                p.join(120)
+            assert all(p.exitcode == 0 for p in procs), \
+                [p.exitcode for p in procs]
+        finally:
+            with warnings.catch_warnings():
+                warnings.simplefilter("error", RuntimeWarning)
+                kv.close()
+    finally:
+        for k in ("MXTPU_NUM_SERVERS", "MXTPU_ASYNC_PS_PORT_1",
+                  "MXNET_KVSTORE_BIGARRAY_BOUND"):
+            os.environ.pop(k, None)
+
+
+def _rank0_worker_body(kv):
+    kv.init(0, mx.nd.array(np.zeros((4,), np.float32)))
+    kv.init(1, mx.nd.array(np.zeros((4,), np.float32)))
+    kv.init("big", mx.nd.array(np.zeros((1600,), np.float32)))
+    kv.push("big", mx.nd.array(np.ones((1600,), np.float32)))
+    kv._barrier()
+
+
+def test_row_sparse_init_routes_whole_key(sharded_env):
+    """A big row-sparse param must NOT be flat-split (its RSP pushes
+    are whole-key routed) — review r4 finding."""
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+    kv = mx.kv.create("dist_async")
+    try:
+        dense = np.ones((64, 32), np.float32)  # 2048 > bound
+        rsp = row_sparse_array((dense, np.arange(64)), shape=(64, 32))
+        kv.init("emb", rsp)
+        assert "emb" not in kv._split
+        owner = kv._owner("emb")
+        assert tuple(kv._clients[owner].shape_of("emb")) == (64, 32)
+        # RSP push lands on the same server
+        kv.push("emb", row_sparse_array(
+            (np.full((2, 32), 3.0, np.float32), np.array([1, 5])),
+            shape=(64, 32)))
+        out = kv._clients[owner].pull("emb")
+        # no optimizer installed: pushed rows are assigned (async apply)
+        np.testing.assert_allclose(out[1], 3.0)
+        np.testing.assert_allclose(out[0], 1.0)  # untouched row intact
+    finally:
+        kv.close()

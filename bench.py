@@ -334,6 +334,35 @@ def bench_input_pipeline(step=None, batch=128, dtype="bfloat16",
     return out
 
 
+def bench_numerics():
+    """BENCH_NUMERICS=1: device-vs-CPU-golden op sweep + flash kernel
+    check (benchmark/tpu_numerics.py; VERDICT r3 item 8). Summarized
+    into the bench JSON — per-op detail stays in the harness."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "benchmark"))
+    import tpu_numerics
+    full = tpu_numerics.run_with_cpu_golden()
+    matmul = {k: v["max_ulp"] for k, v in full["per_op"].items()
+              if k in ("dot", "Convolution", "FullyConnected",
+                       "linalg_gemm2", "dot_precision_highest")}
+    worst_nonmatmul = max(
+        ((k, v["max_ulp"]) for k, v in full["per_op"].items()
+         if k not in matmul), key=lambda kv: kv[1])
+    return {
+        "n_ops": full["n_ops"],
+        "worst_op": full["worst_op"],
+        "worst_ulp": full["worst_ulp"],
+        "worst_nonmatmul_op": worst_nonmatmul[0],
+        "worst_nonmatmul_ulp": worst_nonmatmul[1],
+        "matmul_family_ulp": matmul,
+        "flash_fwd_rel_err": full["flash_fwd_rel_err"],
+        "flash_bwd_max_abs_err": full["flash_bwd_max_abs_err"],
+        "pallas_active": full["pallas_active"],
+        "per_op": full["per_op"],
+    }
+
+
 if __name__ == "__main__":
     which = os.environ.get("BENCH_MODEL", "both")
     if which == "transformer":
@@ -346,4 +375,9 @@ if __name__ == "__main__":
             result["transformer"] = bench_transformer()
         except Exception as e:  # HBM/platform variance must not kill the
             result["transformer"] = {"error": str(e)[:200]}  # headline
+        if os.environ.get("BENCH_NUMERICS", "0") == "1":
+            try:
+                result["numerics"] = bench_numerics()
+            except Exception as e:  # noqa: BLE001
+                result["numerics"] = {"error": str(e)[:200]}
         print(json.dumps(result))

@@ -1,0 +1,230 @@
+"""On-device numerics sweep (VERDICT r3 item 8).
+
+The CPU test suite never touches the real chip; this harness runs N
+representative ops per family on the attached device and compares
+against goldens from a CPU run of the SAME op set (deterministic
+inputs), the analog of the reference's `check_consistency` GPU suite
+(ref: tests/python/gpu/test_operator_gpu.py). Mosaic/XLA:TPU numeric
+drift shows up here as per-op max-ulp / max-abs error.
+
+Two modes (same file, different backends):
+    JAX_PLATFORMS=cpu python benchmark/tpu_numerics.py --golden g.npz
+    python benchmark/tpu_numerics.py --check g.npz   # on the device
+
+bench.py runs both automatically under BENCH_NUMERICS=1 (golden in a
+CPU subprocess) and embeds the result in the bench JSON. The flash
+attention kernels (fwd + bwd, NON-interpret) are additionally checked
+in-process against the f32 jnp reference attention.
+"""
+import argparse
+import json
+import subprocess
+import sys
+import os
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _inputs(op, rs):
+    """Deterministic representative inputs per op (identical across the
+    golden and check processes)."""
+    f = lambda *s: rs.rand(*s).astype("float32")  # noqa: E731
+    return {
+        # elemwise / transcendental
+        "exp": [f(64, 64) * 4 - 2], "log": [f(64, 64) + 0.1],
+        "tanh": [f(64, 64) * 6 - 3], "sigmoid": [f(64, 64) * 8 - 4],
+        "erf": [f(64, 64) * 4 - 2], "rsqrt": [f(64, 64) + 0.05],
+        # reductions
+        "sum": [f(32, 128)], "mean": [f(32, 128)], "max": [f(32, 128)],
+        "norm": [f(32, 128)],
+        # linalg / matmul
+        "dot": [f(96, 64), f(64, 80)],
+        "linalg_gemm2": [f(32, 48), f(48, 24)],
+        "linalg_potrf": [None],  # built below (SPD)
+        "FullyConnected": [f(32, 64), f(16, 64), f(16)],
+        # nn
+        "Convolution": [f(4, 8, 16, 16), f(12, 8, 3, 3), f(12)],
+        "BatchNorm": [f(8, 16, 8, 8), f(16), f(16), f(16), f(16)],
+        "Pooling": [f(4, 8, 16, 16)],
+        "softmax": [f(32, 100) * 10 - 5],
+        "LayerNorm": [f(16, 128), f(128), f(128)],
+        "log_softmax": [f(32, 100) * 10 - 5],
+        # tensor manipulation
+        "topk": [f(16, 200)], "sort": [f(16, 200)],
+        "cumsum": [f(16, 128)],
+        "take": [f(50, 8), rs.randint(0, 50, (20,)).astype("float32")],
+    }[op]
+
+
+def _call(op, ins):
+    from mxnet_tpu.ops import registry
+    import jax
+
+    kwargs = {
+        "sum": {"axis": 1}, "mean": {"axis": 1}, "max": {"axis": 1},
+        "norm": {"ord": 2, "axis": 1},
+        "FullyConnected": {"num_hidden": 16},
+        "Convolution": {"kernel": (3, 3), "num_filter": 12,
+                        "pad": (1, 1)},
+        "BatchNorm": {"eps": 1e-3, "fix_gamma": False,
+                      "_training": True},
+        "Pooling": {"kernel": (2, 2), "stride": (2, 2),
+                    "pool_type": "max"},
+        "topk": {"k": 5, "ret_typ": "value"},
+        "cumsum": {"axis": 1},
+        "take": {"axis": 0},
+    }.get(op, {})
+    fn = registry.get_op(op).fn
+    out = jax.jit(lambda *a: fn(*a, **kwargs))(*ins)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    return np.asarray(jax.block_until_ready(out))
+
+
+OPS = ["exp", "log", "tanh", "sigmoid", "erf", "rsqrt",
+       "sum", "mean", "max", "norm",
+       "dot", "linalg_gemm2", "linalg_potrf", "FullyConnected",
+       "Convolution", "BatchNorm", "Pooling", "softmax", "LayerNorm",
+       "log_softmax",
+       "topk", "sort", "cumsum", "take"]
+
+
+def run_ops():
+    results = {}
+    import zlib
+    # control: the matmul-family ULP gap is the TPU's default
+    # bf16-multiply matmul policy, not a kernel bug — HIGHEST-precision
+    # dot must collapse it by orders of magnitude
+    import jax
+    import jax.numpy as jnp
+    rs = np.random.RandomState(42)
+    a = rs.rand(96, 64).astype("float32")
+    b = rs.rand(64, 80).astype("float32")
+    hi = jax.jit(lambda x, y: jnp.dot(x, y, precision="highest"))
+    results["dot_precision_highest"] = np.asarray(
+        jax.block_until_ready(hi(a, b)))
+    for op in OPS:
+        # crc32, NOT hash(): str hashing is salted per process and the
+        # golden/check runs live in different processes
+        rs = np.random.RandomState(zlib.crc32(op.encode()) % (2 ** 31))
+        if op == "linalg_potrf":
+            a = rs.rand(24, 24).astype("float32")
+            ins = [a @ a.T + 24 * np.eye(24, dtype="float32")]
+        else:
+            ins = _inputs(op, rs)
+        results[op] = _call(op, ins)
+    return results
+
+
+def _max_ulp(a, b):
+    """Max ULP distance between two same-shape f32 arrays (bit distance
+    of the IEEE totally-ordered representation)."""
+    ai = a.astype(np.float32).view(np.int32).astype(np.int64)
+    bi = b.astype(np.float32).view(np.int32).astype(np.int64)
+    # map negative floats onto the descending side of the number line
+    ai = np.where(ai < 0, np.int64(-2147483648) - ai, ai)
+    bi = np.where(bi < 0, np.int64(-2147483648) - bi, bi)
+    return int(np.max(np.abs(ai - bi))) if a.size else 0
+
+
+def check_flash():
+    """Flash fwd+bwd (non-interpret when on TPU) vs jnp reference
+    attention, both evaluated on THIS device in f32."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    # the package __init__ re-exports the flash_attention FUNCTION under
+    # the module's name; load the module itself
+    FA = importlib.import_module(
+        "mxnet_tpu.pallas_kernels.flash_attention")
+
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.rand(2, 4, 256, 64).astype("float32") - 0.5)
+    k = jnp.asarray(rs.rand(2, 4, 256, 64).astype("float32") - 0.5)
+    v = jnp.asarray(rs.rand(2, 4, 256, 64).astype("float32") - 0.5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(FA.flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(FA.attention_reference(q, k, v, causal=True) ** 2)
+
+    of, gf = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    orf, gr = jax.value_and_grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    fwd_err = float(abs(np.asarray(of) - np.asarray(orf))
+                    / max(abs(float(orf)), 1e-9))
+    bwd_err = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(gf, gr))
+    return {"flash_fwd_rel_err": round(fwd_err, 9),
+            "flash_bwd_max_abs_err": round(bwd_err, 9),
+            "pallas_active": bool(FA._use_pallas())}
+
+
+def sweep(golden_path):
+    import jax
+    golden = np.load(golden_path)
+    mine = run_ops()
+    per_op = {}
+    worst = ("", 0)
+    for op in OPS + ["dot_precision_highest"]:
+        g = golden[op]
+        m = mine[op]
+        ulp = _max_ulp(m, g)
+        per_op[op] = {"max_ulp": ulp,
+                      "max_abs": float(np.max(np.abs(m - g)))
+                      if g.size else 0.0}
+        if ulp > worst[1]:
+            worst = (op, ulp)
+    out = {
+        "platform": jax.devices()[0].platform,
+        "n_ops": len(OPS),
+        "worst_op": worst[0],
+        "worst_ulp": worst[1],
+        "per_op": per_op,
+    }
+    out.update(check_flash())
+    return out
+
+
+def run_with_cpu_golden():
+    """bench.py hook: golden in a CPU subprocess, check on this device."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        gpath = os.path.join(td, "golden.npz")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        # the axon accelerator plugin loads via a PYTHONPATH
+        # sitecustomize and overrides JAX_PLATFORMS — the golden MUST
+        # run on the real CPU backend, so scrub it down to the repo
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--golden",
+             gpath],
+            env=env, check=True, capture_output=True, timeout=900)
+        return sweep(gpath)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--golden", default=None)
+    ap.add_argument("--check", default=None)
+    args = ap.parse_args()
+    if args.golden:
+        np.savez(args.golden, **run_ops())
+        print("wrote %s (%d ops)" % (args.golden, len(OPS)))
+        return
+    if args.check:
+        print(json.dumps(sweep(args.check), indent=1))
+        return
+    print(json.dumps(run_with_cpu_golden(), indent=1))
+
+
+if __name__ == "__main__":
+    main()

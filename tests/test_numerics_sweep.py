@@ -1,0 +1,37 @@
+"""The on-device numerics harness itself (benchmark/tpu_numerics.py;
+VERDICT r3 item 8). CI runs CPU-vs-CPU (same backend -> 0 ULP expected)
+to prove the machinery: deterministic inputs across processes, ULP
+accounting, flash cross-check. The real TPU-vs-CPU run happens in
+bench.py under BENCH_NUMERICS=1 (recorded in BENCH_r*.json)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HARNESS = os.path.join(REPO, "benchmark", "tpu_numerics.py")
+
+
+def _clean_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def test_same_backend_sweep_is_exact(tmp_path):
+    golden = str(tmp_path / "g.npz")
+    r1 = subprocess.run([sys.executable, HARNESS, "--golden", golden],
+                        env=_clean_env(), capture_output=True, text=True,
+                        timeout=600)
+    assert r1.returncode == 0, r1.stderr
+    r2 = subprocess.run([sys.executable, HARNESS, "--check", golden],
+                        env=_clean_env(), capture_output=True, text=True,
+                        timeout=600)
+    assert r2.returncode == 0, r2.stderr
+    out = json.loads(r2.stdout[r2.stdout.index("{"):])
+    # same backend, same deterministic inputs -> bit-exact
+    assert out["worst_ulp"] == 0, out
+    assert out["n_ops"] >= 20
+    # flash check ran (reference path on CPU) and is numerically tight
+    assert out["flash_fwd_rel_err"] < 1e-3
+    assert out["flash_bwd_max_abs_err"] < 1e-2

@@ -8,8 +8,12 @@ inputs), the analog of the reference's `check_consistency` GPU suite
 drift shows up here as per-op max-ulp / max-abs error.
 
 Two modes (same file, different backends):
-    JAX_PLATFORMS=cpu python benchmark/tpu_numerics.py --golden g.npz
+    env -u PYTHONPATH PYTHONPATH=/root/repo JAX_PLATFORMS=cpu \
+        python benchmark/tpu_numerics.py --golden g.npz
     python benchmark/tpu_numerics.py --check g.npz   # on the device
+(--golden stamps the producing platform into the npz and --check
+refuses a non-cpu golden: the axon sitecustomize on PYTHONPATH can
+override JAX_PLATFORMS=cpu, and a device-made golden would diff to 0.)
 
 bench.py runs both automatically under BENCH_NUMERICS=1 (golden in a
 CPU subprocess) and embeds the result in the bench JSON. The flash
@@ -169,9 +173,19 @@ def check_flash():
 def sweep(golden_path):
     import jax
     golden = np.load(golden_path)
+    # a golden accidentally produced on an accelerator (the axon
+    # sitecustomize can override JAX_PLATFORMS=cpu) would make every
+    # device-vs-golden diff read 0 — refuse it
+    gplat = (str(golden["__platform__"]) if "__platform__" in golden
+             else "<unstamped>")
+    if gplat != "cpu":
+        raise RuntimeError(
+            "golden %s was produced on %r, not cpu — rerun --golden "
+            "with the axon sitecustomize scrubbed from PYTHONPATH"
+            % (golden_path, gplat))
     mine = run_ops()
     per_op = {}
-    worst = ("", 0)
+    worst = None
     for op in OPS + ["dot_precision_highest"]:
         g = golden[op]
         m = mine[op]
@@ -179,7 +193,7 @@ def sweep(golden_path):
         per_op[op] = {"max_ulp": ulp,
                       "max_abs": float(np.max(np.abs(m - g)))
                       if g.size else 0.0}
-        if ulp > worst[1]:
+        if worst is None or ulp > worst[1]:
             worst = (op, ulp)
     out = {
         "platform": jax.devices()[0].platform,
@@ -204,10 +218,18 @@ def run_with_cpu_golden():
         # run on the real CPU backend, so scrub it down to the repo
         env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))
-        subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--golden",
-             gpath],
-            env=env, check=True, capture_output=True, timeout=900)
+        try:
+            subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--golden",
+                 gpath],
+                env=env, check=True, capture_output=True, timeout=900)
+        except subprocess.CalledProcessError as e:
+            # surface the child's traceback — CalledProcessError's own
+            # message drops the captured stderr
+            tail = (e.stderr or b"").decode("utf-8", "replace")[-800:]
+            raise RuntimeError(
+                "golden subprocess failed (exit %d): %s"
+                % (e.returncode, tail)) from e
         return sweep(gpath)
 
 
@@ -217,8 +239,12 @@ def main():
     ap.add_argument("--check", default=None)
     args = ap.parse_args()
     if args.golden:
-        np.savez(args.golden, **run_ops())
-        print("wrote %s (%d ops)" % (args.golden, len(OPS)))
+        import jax
+        platform = jax.devices()[0].platform
+        np.savez(args.golden, __platform__=np.array(platform),
+                 **run_ops())
+        print("wrote %s (%d ops, %s)" % (args.golden, len(OPS),
+                                         platform))
         return
     if args.check:
         print(json.dumps(sweep(args.check), indent=1))

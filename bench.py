@@ -37,12 +37,13 @@ try:
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BASELINE.json")) as _f:
         _published = json.load(_f).get("published", {})
-except (OSError, ValueError):
-    _published = {}
-BASELINE_IMGS_PER_SEC = _published.get(
-    "resnet50_train_imgs_per_sec_v100", 298.51)
-BASELINE_TRANSFORMER_MFU = _published.get(
-    "transformer_mfu", {}).get("beat_target_mfu", 0.462)
+    BASELINE_IMGS_PER_SEC = _published.get(
+        "resnet50_train_imgs_per_sec_v100", 298.51)
+    BASELINE_TRANSFORMER_MFU = _published.get(
+        "transformer_mfu", {}).get("beat_target_mfu", 0.462)
+except (OSError, ValueError, AttributeError, TypeError):
+    BASELINE_IMGS_PER_SEC = 298.51
+    BASELINE_TRANSFORMER_MFU = 0.462
 
 
 def bench_transformer():

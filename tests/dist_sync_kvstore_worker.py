@@ -91,7 +91,88 @@ def main():
         assert (all_w[r] == all_w[0]).all(), \
             "rank %d: weights diverged across ranks" % rank
 
-    # 6. barrier then done
+    # 6. row_sparse push + row_sparse_pull: rank-dependent row sets must
+    #    sum exactly and selective pulls ship only the asked rows
+    #    (ref: dist_sync_kvstore.py test_sync_push_pull row_sparse cases,
+    #    kvstore_dist.h:522 EncodeRowSparseKey)
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+    vocab, dim = 12, 3
+    kv.init(11, mx.nd.zeros((vocab, dim)))
+    my_rows = onp.array([rank % vocab, (rank + 2) % vocab], "int64")
+    vals = onp.ones((2, dim), "float32") * (rank + 1)
+    kv.push(11, row_sparse_array((mx.nd.array(vals),
+                                  mx.nd.array(my_rows)),
+                                 shape=(vocab, dim)))
+    expected_dense = onp.zeros((vocab, dim), "float32")
+    for r in range(nworker):
+        for row in (r % vocab, (r + 2) % vocab):
+            expected_dense[row] += r + 1
+    dense_out = mx.nd.zeros((vocab, dim))
+    kv.pull(11, out=dense_out)
+    assert (dense_out.asnumpy() == expected_dense).all(), \
+        "rank %d: row_sparse aggregation wrong" % rank
+    want = mx.nd.array(onp.array([1, 5, 7], "int64"))
+    sparse_out = row_sparse_array(
+        (mx.nd.zeros((3, dim)), want), shape=(vocab, dim))
+    kv.row_sparse_pull(11, out=sparse_out, row_ids=want)
+    got = sparse_out.asnumpy()[[1, 5, 7]]
+    assert (got == expected_dense[[1, 5, 7]]).all(), \
+        "rank %d: row_sparse_pull rows wrong" % rank
+
+    # 7. fp16 path: aggregation must be exact in half precision
+    #    (ref: dist_sync_kvstore.py test_sync_init fp16 / 'init_test'
+    #    dtype cases)
+    kv.init(13, mx.nd.zeros(shape, dtype="float16"))
+    kv.push(13, mx.nd.ones(shape, dtype="float16") * (rank + 1))
+    out16 = mx.nd.zeros(shape, dtype="float16")
+    kv.pull(13, out=out16)
+    a16 = out16.asnumpy()
+    assert a16.dtype == onp.float16, a16.dtype
+    assert (a16 == expected).all(), \
+        "rank %d: fp16 expected %s got %s" % (rank, expected, a16)
+
+    # 8. server-side optimizer (update_on_kvstore): every rank must see
+    #    the identical post-update weight w - lr*sum(grads)
+    #    (ref: kvstore_dist_server.h:346 ApplyUpdates + set_optimizer)
+    kvo = mx.kv.create("dist_sync")
+    kvo.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kvo.init(17, mx.nd.ones(shape))
+    kvo.push(17, mx.nd.ones(shape) * (rank + 1))
+    kvo.pull(17, out=out)
+    check_diff_to_scalar(out, 1.0 - 0.5 * expected, rank)
+
+    # 9. large-tensor exactness (sync mode allreduces whole tensors —
+    #    the BIGARRAY-bound *splitting* path is async-only and covered
+    #    with a lowered bound in tests/test_async_sharded.py)
+    big_shape = (70000,)
+    kv.init(19, mx.nd.zeros(big_shape))
+    kv.push(19, mx.nd.ones(big_shape) * (rank + 1))
+    big_out = mx.nd.zeros(big_shape)
+    kv.pull(19, out=big_out)
+    check_diff_to_scalar(big_out, expected, rank)
+
+    # 10. compression error-feedback across rounds: 0.3 quantizes to 0
+    #     (residual 0.3), next 0.3 makes 0.6 -> +0.5 per rank
+    #     (ref: gradient_compression.h error-feedback residual)
+    kvc.init(23, mx.nd.zeros(shape))
+    kvc.push(23, mx.nd.ones(shape) * 0.3)
+    kvc.pull(23, out=out)
+    check_diff_to_scalar(out, 0.0, rank)
+    kvc.push(23, mx.nd.ones(shape) * 0.3)
+    kvc.pull(23, out=out)
+    check_diff_to_scalar(out, 0.5 * nworker, rank)
+
+    # 11. list-form init/push/pull (the reference's multi-key calls)
+    lkeys = [31, 32, 33]
+    kv.init(lkeys, [mx.nd.zeros(shape)] * 3)
+    kv.push(lkeys, [mx.nd.ones(shape) * (rank + 1 + i)
+                    for i in range(3)])
+    louts = [mx.nd.zeros(shape) for _ in range(3)]
+    kv.pull(lkeys, out=louts)
+    for i, o in enumerate(louts):
+        check_diff_to_scalar(o, expected + i * nworker, rank)
+
+    # 12. barrier then done
     mx.parallel.host_barrier("dist-test")
     print("rank %d/%d: all dist_sync kvstore checks passed" % (rank, nworker))
 

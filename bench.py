@@ -33,17 +33,25 @@ import numpy as np
 # training MFU figure (same published table: GPT-3 21.3%, Gopher 32.5%,
 # MT-NLG 30.2%) — the 2019 reference has no transformer benchmark.
 # Fallbacks keep bench.py runnable standalone.
-try:
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BASELINE.json")) as _f:
-        _published = json.load(_f).get("published", {})
-    BASELINE_IMGS_PER_SEC = _published.get(
-        "resnet50_train_imgs_per_sec_v100", 298.51)
-    BASELINE_TRANSFORMER_MFU = _published.get(
-        "transformer_mfu", {}).get("beat_target_mfu", 0.462)
-except (OSError, ValueError, AttributeError, TypeError):
-    BASELINE_IMGS_PER_SEC = 298.51
-    BASELINE_TRANSFORMER_MFU = 0.462
+def _published_baseline(*path, default):
+    """One key from BASELINE.json's `published` block, falling back to
+    the hardcoded default independently per key (a malformed entry must
+    not discard the other valid ones)."""
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE.json")) as f:
+            node = json.load(f).get("published", {})
+        for p in path:
+            node = node[p]
+        return float(node)
+    except (OSError, ValueError, TypeError, KeyError):
+        return default
+
+
+BASELINE_IMGS_PER_SEC = _published_baseline(
+    "resnet50_train_imgs_per_sec_v100", default=298.51)
+BASELINE_TRANSFORMER_MFU = _published_baseline(
+    "transformer_mfu", "beat_target_mfu", default=0.462)
 
 
 def bench_transformer():

@@ -36,6 +36,8 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _seed_rngs():
+    import random as _pyrandom
+    _pyrandom.seed(0)  # image augmenters draw skip/shuffle/crop from it
     _np.random.seed(0)
     import mxnet_tpu as mx
     mx.random.seed(0)

@@ -497,6 +497,9 @@ class TestSSDExample:
         spec = importlib.util.spec_from_file_location("train_ssd2", path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
+        # determinism comes from conftest's _seed_rngs (incl. python
+        # `random`, which the Det augmenters draw from — an unseeded
+        # augmenter stream made the 0.7 threshold ~1/50 flaky)
         net, losses = mod.train_from_rec(str(tmp_path), epochs=8,
                                          log=lambda *a: None)
         assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])

@@ -44,7 +44,7 @@ def _published_baseline(*path, default):
         for p in path:
             node = node[p]
         return float(node)
-    except (OSError, ValueError, TypeError, KeyError):
+    except (OSError, ValueError, TypeError, KeyError, AttributeError):
         return default
 
 
@@ -162,12 +162,21 @@ def bench_resnet():
                          % layout)
     # BENCH_FUSED=1: NHWC + 1x1-convs-as-dots + save-only-conv-outs remat
     # so normalize/ReLU chains never persist in HBM (round-4 HBM work;
-    # see ShardedTrainStep remat_policy + ops/nn.py _ckpt_name)
-    fused = os.environ.get("BENCH_FUSED", "0") == "1"
-    if fused:
+    # see ShardedTrainStep remat_policy + ops/nn.py _ckpt_name).
+    # BENCH_FUSED=pallas: NHWC + the Pallas fused BN->ReLU->conv3x3
+    # kernel (pallas_kernels/conv_fused.py) on the stages where it beats
+    # XLA's native conv (fuse="auto"); pallas_all forces it everywhere;
+    # pallas_remat combines auto with the conv-outs remat policy.
+    fused = os.environ.get("BENCH_FUSED", "0")
+    if fused not in ("0", "1", "pallas", "pallas_remat", "pallas_all"):
+        raise ValueError("BENCH_FUSED must be one of 0|1|pallas|"
+                         "pallas_remat|pallas_all, got %r" % fused)
+    pallas_fuse = {"pallas": "auto", "pallas_remat": "auto",
+                   "pallas_all": True}.get(fused, False)
+    if fused != "0":
         layout = "NHWC"
 
-    net = resnet50_v1(layout=layout)
+    net = resnet50_v1(layout=layout, fuse=pallas_fuse)
     net.initialize()
     net(mx.nd.array(np.zeros((1, 3, 224, 224), "float32")))  # deferred init
     if dtype != "float32":
@@ -178,7 +187,8 @@ def bench_resnet():
                             opt.create("sgd", learning_rate=0.01,
                                        momentum=0.9),
                             strategy=data_parallel(mesh),
-                            remat_policy="conv_outs" if fused else None)
+                            remat_policy="conv_outs"
+                            if fused in ("1", "pallas_remat") else None)
 
     rng = np.random.RandomState(0)
     x = rng.rand(batch, 3, 224, 224).astype(dtype)
@@ -211,6 +221,7 @@ def bench_resnet():
         "batch": batch,
         "dtype": dtype,
         "layout": layout,
+        "fused": fused,
         "final_loss": round(float(loss), 4),
     }
     if os.environ.get("BENCH_INPUT_PIPELINE", "1") == "1":

@@ -29,12 +29,91 @@ def _conv3x3(channels, stride, in_channels, layout="NCHW"):
                      use_bias=False, in_channels=in_channels, layout=layout)
 
 
+# -- fused BN->ReLU->conv3x3 path (fuse=True, NHWC only) --------------------
+# XLA:TPU does not fuse elementwise producers into convolutions
+# (benchmark/fusion_probe.py: 2.6x operand bytes), so the normalized
+# activation between a BatchNorm and the following 3x3 conv is a full HBM
+# round-trip on the XLA path. These private OpDefs route that link through
+# the Pallas kernel in pallas_kernels/conv_fused.py instead: the BN fold
+# (s = gamma*rsqrt(var+eps), b = beta - mean*s) is one tape op whose stat
+# math matches ops.nn.batch_norm exactly, and the conv consumes the RAW
+# previous conv output with scale/bias/ReLU applied in VMEM. Kept out of
+# the global op registry: opperf/op-parity sweeps synthesize inputs by
+# shape heuristics these composite signatures don't fit.
+_BN_FOLD_OP = None
+_FUSED_CONV_OP = None
+
+
+def _fused_opdefs():
+    global _BN_FOLD_OP, _FUSED_CONV_OP
+    if _BN_FOLD_OP is None:
+        import jax
+        import jax.numpy as jnp
+        from ....ops.registry import OpDef
+        from ....ops.nn import batch_moments
+
+        def _bn_fold(y, gamma, beta, eps=1e-5):
+            # the SAME stat computation as ops.nn.batch_norm — shared
+            # helper so the exact-running-stats contract can't drift
+            mean, var = batch_moments(y, (0, 1, 2), axis=3)
+            s = gamma.astype(jnp.float32) * jax.lax.rsqrt(
+                var.astype(jnp.float32) + eps)
+            b = beta.astype(jnp.float32) - mean.astype(jnp.float32) * s
+            return s, b, mean, var
+
+        def _fused_conv(x, s, b, w, relu=True):
+            from ....pallas_kernels.conv_fused import \
+                fused_scale_relu_conv3x3
+            w_hwio = jnp.transpose(w, (2, 3, 1, 0))   # OIHW -> HWIO
+            return fused_scale_relu_conv3x3(x, s, b, w_hwio, relu=relu)
+
+        _BN_FOLD_OP = OpDef("_fused_bn_fold", _bn_fold)
+        _FUSED_CONV_OP = OpDef("_fused_scale_relu_conv3x3", _fused_conv)
+    return _BN_FOLD_OP, _FUSED_CONV_OP
+
+
+def _fused_producer_conv(bn, conv, y, F):
+    """y -> conv3x3(relu(bn(y))) with the normalize/ReLU chain fused into
+    the conv's VMEM operand load; replicates the BatchNorm block's
+    running-stat updates (gluon/nn/basic_layers.py BatchNorm)."""
+    from .... import autograd
+    from ...block import report_aux_update
+    from ....ndarray.register import invoke
+
+    fold_op, conv_op = _fused_opdefs()
+    if bn.gamma._data is None:
+        bn._infer_param_shapes(y)
+    gamma, beta = bn.gamma.data(), bn.beta.data()
+    if autograd.is_training() and not bn._use_global_stats:
+        s, b, mean, var = invoke(fold_op, (y, gamma, beta),
+                                 {"eps": bn._eps})
+        m = bn._momentum
+        report_aux_update(
+            bn.running_mean,
+            m * bn.running_mean.data()._data + (1 - m) * mean._data)
+        report_aux_update(
+            bn.running_var,
+            m * bn.running_var.data()._data + (1 - m) * var._data)
+    else:
+        rm = F.cast(bn.running_mean.data(), "float32")
+        rv = F.cast(bn.running_var.data(), "float32")
+        s = F.cast(gamma, "float32") * F.rsqrt(rv + bn._eps)
+        b = F.cast(beta, "float32") - rm * s
+    return invoke(conv_op, (y, s, b, conv.weight.data()), {"relu": True})
+
+
+def _is_nd(F):
+    return getattr(F, "__name__", "").endswith("ndarray")
+
+
 class BasicBlockV1(HybridBlock):
-    """Two 3x3 convs, post-activation residual unit."""
+    """Two 3x3 convs, post-activation residual unit. ``fuse=True`` routes
+    the BN->ReLU->second-conv link through the Pallas fused kernel."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", fuse=False, **kwargs):
         super().__init__(**kwargs)
+        self._fuse = fuse
         ax = _bn_axis(layout)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(_conv3x3(channels, stride, in_channels, layout))
@@ -54,18 +133,30 @@ class BasicBlockV1(HybridBlock):
 
     def hybrid_forward(self, F, x):
         residual = x
-        x = self.body(x)
+        if self._fuse and _is_nd(F):
+            # body: conv3x3(stride), bn, relu, conv3x3(1), bn — fuse the
+            # bn+relu producer into the second conv's operand load
+            y = self.body[0](x)
+            y = _fused_producer_conv(self.body[1], self.body[3], y, F)
+            x = self.body[4](y)
+        else:
+            x = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
         return F.Activation(x + residual, act_type="relu")
 
 
 class BottleneckV1(HybridBlock):
-    """1x1 -> 3x3 -> 1x1 bottleneck, post-activation."""
+    """1x1 -> 3x3 -> 1x1 bottleneck, post-activation. ``fuse=True``
+    routes the BN->ReLU->3x3 link through the Pallas fused kernel
+    (pallas_kernels/conv_fused.py) so the normalized activation never
+    round-trips HBM; all 3x3 convs in this block are stride 1, which is
+    exactly the kernel's domain."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", fuse=False, **kwargs):
         super().__init__(**kwargs)
+        self._fuse = fuse
         ax = _bn_axis(layout)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
@@ -90,7 +181,14 @@ class BottleneckV1(HybridBlock):
 
     def hybrid_forward(self, F, x):
         residual = x
-        x = self.body(x)
+        if self._fuse and _is_nd(F):
+            y = self.body[0](x)                       # 1x1 (stride)
+            y = _fused_producer_conv(self.body[1], self.body[3], y, F)
+            for i in (4, 5, 6, 7):                     # bn, relu, 1x1, bn
+                y = self.body[i](y)
+            x = y
+        else:
+            x = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
         return F.Activation(x + residual, act_type="relu")
@@ -173,9 +271,12 @@ class ResNetV1(HybridBlock):
     MXU without relayout copies."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", fuse=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        if fuse and layout != "NHWC":
+            raise ValueError("fuse=True requires layout='NHWC' (the Pallas "
+                             "fused conv kernel is channels-last)")
         self._layout = layout
         ax = _bn_axis(layout)
         with self.name_scope():
@@ -192,20 +293,27 @@ class ResNetV1(HybridBlock):
                 stride = 1 if i == 0 else 2
                 self.features.add(self._make_layer(
                     block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i], layout=layout))
+                    in_channels=channels[i], layout=layout, fuse=fuse))
             self.features.add(nn.GlobalAvgPool2D(layout=layout))
             self.output = nn.Dense(classes, in_units=channels[-1])
 
     def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0, layout="NCHW"):
+                    in_channels=0, layout="NCHW", fuse=False):
+        # fuse="auto": apply the Pallas fused kernel only where it beats
+        # XLA's native conv — small feature maps / deep channels (the
+        # im2col VMEM tax loses on large maps; see conv_fused.py). The
+        # 3x3 width is channels//4 in bottlenecks, channels in basics.
+        width3x3 = channels // 4 if block in (BottleneckV1, BottleneckV2) \
+            else channels
+        block_fuse = bool(fuse) if fuse != "auto" else width3x3 >= 512
         layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
         with layer.name_scope():
             layer.add(block(channels, stride, channels != in_channels,
                             in_channels=in_channels, layout=layout,
-                            prefix=""))
+                            fuse=block_fuse, prefix=""))
             for _ in range(layers - 1):
                 layer.add(block(channels, 1, False, in_channels=channels,
-                                layout=layout, prefix=""))
+                                layout=layout, fuse=block_fuse, prefix=""))
         return layer
 
     def hybrid_forward(self, F, x):

@@ -417,6 +417,39 @@ def logistic_regression_output(data, label, grad_scale=1.0):
         data, label, grad_scale)
 
 
+def batch_moments(x, axes, axis=None):
+    """Batch mean/var for normalization, cast to x.dtype — the ONE
+    definition of this framework's BN stat semantics (the fused-conv
+    BN fold in gluon/model_zoo/vision/resnet.py must stay bit-identical
+    to the BatchNorm op, so both call here).
+
+    Half-precision inputs: single-pass E[x^2]-E[x]^2 in f32. The
+    cancellation error is ~mean^2 * 2^-24, ~256x SMALLER than the
+    variance noise the bf16 input quantization itself injects
+    (~mean^2 * 2^-16) — so this loses nothing, and fusing both moments
+    into ONE reduction pass removes most of the train-mode BN overhead
+    (measured +13% ResNet step throughput vs jnp.var's re-read of x).
+    Full-precision inputs: two-pass E[(x-mean)^2], where single-pass
+    cancellation WOULD dominate for |mean| >> std.
+    """
+    xf = x.astype(jnp.float32)
+    mean32 = jnp.mean(xf, axis=axes)
+    if jnp.dtype(x.dtype).itemsize <= 2:
+        var32 = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean32)
+        var32 = jnp.maximum(var32, 0.0)
+    else:
+        shape0 = [1] * x.ndim
+        keep = (axis % x.ndim) if axis is not None else [
+            i for i in range(x.ndim) if i not in axes][0]
+        shape0[keep] = x.shape[keep]
+        var32 = jnp.mean(jnp.square(xf - mean32.reshape(shape0)),
+                         axis=axes)
+    # tagged so conv-outs remat policies keep the (tiny) stat vectors
+    # instead of re-reducing the activation in backward
+    return (_ckpt_name(mean32.astype(x.dtype), "bn_stat"),
+            _ckpt_name(var32.astype(x.dtype), "bn_stat"))
+
+
 @register("BatchNorm", aliases=("batch_norm",))
 def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                fix_gamma=True, use_global_stats=False, output_mean_var=False,
@@ -430,30 +463,7 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     use_batch = _training and not use_global_stats
     if use_batch:
-        xf = x.astype(jnp.float32)
-        mean32 = jnp.mean(xf, axis=axes)
-        if jnp.dtype(x.dtype).itemsize <= 2:
-            # half-precision inputs: single-pass E[x^2]-E[x]^2 in f32.
-            # The cancellation error is ~mean^2 * 2^-24, which is ~256x
-            # SMALLER than the variance noise the bf16 input quantization
-            # itself injects (~mean^2 * 2^-16) — so this loses nothing,
-            # and fusing both moments into ONE reduction pass removes
-            # most of the train-mode BN overhead (measured +13% ResNet
-            # step throughput vs jnp.var's re-read of x)
-            var32 = jnp.mean(jnp.square(xf), axis=axes) \
-                - jnp.square(mean32)
-            var32 = jnp.maximum(var32, 0.0)
-        else:
-            # full-precision inputs: two-pass E[(x-mean)^2], where
-            # single-pass cancellation WOULD dominate for |mean| >> std
-            shape0 = [1] * x.ndim
-            shape0[axis % x.ndim] = x.shape[axis % x.ndim]
-            var32 = jnp.mean(jnp.square(xf - mean32.reshape(shape0)),
-                             axis=axes)
-        # tagged so conv-outs remat policies keep the (tiny) stat
-        # vectors instead of re-reducing the activation in backward
-        mean = _ckpt_name(mean32.astype(x.dtype), "bn_stat")
-        var = _ckpt_name(var32.astype(x.dtype), "bn_stat")
+        mean, var = batch_moments(x, axes, axis)
     else:
         mean, var = moving_mean, moving_var
     shape = [1] * x.ndim

@@ -1,0 +1,156 @@
+"""Pallas fused BN->ReLU->conv3x3 kernel (pallas_kernels/conv_fused.py)
+and its model-zoo integration (resnet fuse=...).
+
+Kernels run in interpreter mode on the CPU suite; the real-TPU path is
+exercised by bench.py BENCH_FUSED=pallas (see docs/ROADMAP.md round-4
+fused-conv study for the measured results).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mxnet_tpu.pallas_kernels import conv_fused as CF
+
+
+def _mats(N, H, W, Ci, Co, seed=0):
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(N, H, W, Ci).astype("float32"))
+    s = jnp.asarray(rs.rand(Ci).astype("float32") + 0.5)
+    b = jnp.asarray(rs.randn(Ci).astype("float32") * 0.1)
+    w = jnp.asarray(rs.randn(3, 3, Ci, Co).astype("float32") * 0.1)
+    return x, s, b, w
+
+
+class TestKernels:
+    @pytest.mark.parametrize("shape", [(3, 8, 8, 16, 24),   # NB=1
+                                       (4, 4, 4, 8, 8)])    # NB>1 path
+    def test_forward_matches_reference(self, shape):
+        x, s, b, w = _mats(*shape)
+        out = CF.fused_scale_relu_conv3x3(x, s, b, w, interpret=True)
+        ref = CF.fused_conv_reference(x, s, b, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_no_relu(self):
+        x, s, b, w = _mats(2, 6, 6, 8, 8)
+        out = CF.fused_scale_relu_conv3x3(x, s, b, w, relu=False,
+                                          interpret=True)
+        ref = CF.fused_conv_reference(x, s, b, w, relu=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize("shape", [(3, 8, 8, 16, 24), (4, 4, 4, 8, 8)])
+    def test_gradients_match_reference(self, shape):
+        x, s, b, w = _mats(*shape)
+
+        def lk(*a):
+            return jnp.sum(
+                CF.fused_scale_relu_conv3x3(*a, interpret=True) ** 2)
+
+        def lr(*a):
+            return jnp.sum(CF.fused_conv_reference(*a) ** 2)
+
+        gk = jax.grad(lk, argnums=(0, 1, 2, 3))(x, s, b, w)
+        gr = jax.grad(lr, argnums=(0, 1, 2, 3))(x, s, b, w)
+        for a, c in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       atol=2e-3, rtol=1e-3)
+
+    def test_tiled_backward_paths(self, monkeypatch):
+        """Force the Ci-tiled dx grid AND a 2-Co-tile dW grid — the
+        deep-stage VMEM configurations — and check grads still match."""
+        monkeypatch.setattr(CF, "_bwd_dx_tiles",
+                            lambda N, H, W, Ci, Co, cb: (1, Ci // 2))
+        monkeypatch.setattr(CF, "_bwd_dw_tiles",
+                            lambda N, H, W, Ci, Co, cb: (1, Co // 2))
+        x, s, b, w = _mats(2, 6, 6, 16, 16)
+
+        def lk(*a):
+            return jnp.sum(
+                CF.fused_scale_relu_conv3x3(*a, interpret=True) ** 2)
+
+        def lr(*a):
+            return jnp.sum(CF.fused_conv_reference(*a) ** 2)
+
+        gk = jax.grad(lk, argnums=(0, 1, 2, 3))(x, s, b, w)
+        gr = jax.grad(lr, argnums=(0, 1, 2, 3))(x, s, b, w)
+        for a, c in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       atol=2e-3, rtol=1e-3)
+
+    def test_shape_validation(self):
+        x, s, b, w = _mats(2, 6, 6, 8, 8)
+        with pytest.raises(ValueError):
+            CF.fused_scale_relu_conv3x3(x, s, b, jnp.zeros((5, 5, 8, 8)))
+
+
+class TestModelIntegration:
+    def _run(self, fuse, seed=0):
+        import random
+        import mxnet_tpu as mx
+        from mxnet_tpu import autograd
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+        random.seed(seed)
+        np.random.seed(seed)
+        mx.random.seed(seed)
+        net = resnet50_v1(layout="NHWC", fuse=fuse)
+        net.initialize()
+        x = mx.nd.array(np.random.RandomState(1).randn(
+            2, 3, 64, 64).astype("float32"))
+        y = mx.nd.array(np.array([3.0, 7.0]))
+        with autograd.record():
+            loss = SoftmaxCrossEntropyLoss()(net(x), y).mean()
+        loss.backward()
+        params = net.collect_params()
+        g3 = next(p.grad().asnumpy() for n, p in sorted(params.items())
+                  if "stage2" in n and p.shape[-2:] == (3, 3))
+        rm = next(p.data().asnumpy() for n, p in sorted(params.items())
+                  if "running_mean" in n and "stage1" in n)
+        return float(loss.asnumpy()), g3, rm
+
+    def test_fused_resnet_matches_unfused(self):
+        l0, g0, rm0 = self._run(False)
+        l1, g1, rm1 = self._run(True)
+        assert abs(l0 - l1) < 1e-3, (l0, l1)
+        # running stats must be EXACT: same stat math, same aux updates
+        np.testing.assert_array_equal(rm0, rm1)
+        # grads agree within deep-net accumulation-order noise
+        assert np.max(np.abs(g0 - g1)) / (np.max(np.abs(g0)) + 1e-9) < 0.05
+
+    def test_fuse_requires_nhwc(self):
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+        with pytest.raises(ValueError):
+            resnet50_v1(layout="NCHW", fuse=True)
+
+    def test_fuse_auto_policy(self):
+        """auto fuses only the >=512-wide 3x3 stages (where the kernel
+        beats XLA's conv; see conv_fused.py docstring)."""
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+        net = resnet50_v1(layout="NHWC", fuse="auto")
+        stages = net.features
+        fused_flags = []
+        for child in stages:
+            name = getattr(child, "prefix", "") or ""
+            if "stage" in name:
+                fused_flags.append(child[0]._fuse)
+        assert fused_flags == [False, False, False, True]
+
+    def test_fused_hybridize_consistent(self):
+        import random
+        import mxnet_tpu as mx
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+        random.seed(0)
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = resnet50_v1(layout="NHWC", fuse=True)
+        net.initialize()
+        x = mx.nd.array(np.random.RandomState(2).randn(
+            2, 3, 32, 32).astype("float32"))
+        eager = net(x).asnumpy()
+        net.hybridize()
+        hybrid = net(x).asnumpy()
+        np.testing.assert_allclose(eager, hybrid, atol=2e-3)

@@ -61,11 +61,17 @@ def _fused_opdefs():
             b = beta.astype(jnp.float32) - mean.astype(jnp.float32) * s
             return s, b, mean, var
 
+        from ....ops.nn import _ckpt_name
+
         def _fused_conv(x, s, b, w, relu=True):
             from ....pallas_kernels.conv_fused import \
                 fused_scale_relu_conv3x3
             w_hwio = jnp.transpose(w, (2, 3, 1, 0))   # OIHW -> HWIO
-            return fused_scale_relu_conv3x3(x, s, b, w_hwio, relu=relu)
+            # tagged like every XLA-path conv so conv_outs remat
+            # policies keep it instead of re-running the Pallas kernel
+            return _ckpt_name(
+                fused_scale_relu_conv3x3(x, s, b, w_hwio, relu=relu),
+                "conv_out")
 
         _BN_FOLD_OP = OpDef("_fused_bn_fold", _bn_fold)
         _FUSED_CONV_OP = OpDef("_fused_scale_relu_conv3x3", _fused_conv)

@@ -170,7 +170,7 @@ def _bwd_dx_tiles(N, H, W_, Ci, Co, cbytes):
     (flipped weights + patch scratch dominate; streamed blocks and the
     weight block are double-buffered by Mosaic)."""
     nb = _pick_nb(N, H, W_, Co, cbytes)
-    tci = Ci
+
     def est(nb_, tci_):
         wt2 = 2 * 9 * Co * tci_ * cbytes
         pat = nb_ * H * W_ * 9 * Co * cbytes
@@ -178,12 +178,7 @@ def _bwd_dx_tiles(N, H, W_, Ci, Co, cbytes):
         blocks = 2 * nb_ * H * W_ * (2 * tci_ + Co) * cbytes
         dz32 = nb_ * H * W_ * tci_ * 4
         return wt2 + pat + gp + blocks + dz32
-    while (tci > 128 and tci % 2 == 0
-           and est(nb, tci) > _VMEM_BUDGET):
-        tci //= 2
-    while nb > 1 and est(nb, tci) > _VMEM_BUDGET:
-        nb //= 2
-    return nb, tci
+    return _shrink(nb, Ci, est, _VMEM_BUDGET)
 
 
 def _bwd_dw_kernel(x_ref, s_ref, b_ref, g_ref, dw_ref, zp_scr, pat_scr, *,
@@ -237,6 +232,17 @@ def _pick_nb(N, H, W_, C, cbytes):
     return nb
 
 
+def _shrink(nb, tile, est, budget):
+    """Shared tile-shrink policy: halve the channel tile down to the
+    128-lane floor first (keeps MXU-efficient rows), then halve the
+    images-per-cell, until est(nb, tile) fits the budget."""
+    while tile > 128 and tile % 2 == 0 and est(nb, tile) > budget:
+        tile //= 2
+    while nb > 1 and est(nb, tile) > budget:
+        nb //= 2
+    return nb, tile
+
+
 def _fwd_tiles(N, H, W_, Ci, Co, cbytes):
     """(NB, TCo) for the forward kernel. The forward weight block is
     observed NOT to be double-buffered (stage-4 untiled compiles at
@@ -253,13 +259,7 @@ def _fwd_tiles(N, H, W_, Ci, Co, cbytes):
         acc32 = nb_ * H * W_ * tco_ * 4
         return w2 + pat + zp + blocks + acc32
 
-    budget = 11 * 1024 * 1024
-    tco = Co
-    while tco > 128 and tco % 2 == 0 and est(nb, tco) > budget:
-        tco //= 2
-    while nb > 1 and est(nb, tco) > budget:
-        nb //= 2
-    return nb, tco
+    return _shrink(nb, Co, est, 11 * 1024 * 1024)
 
 
 def _pallas_forward(x, s, b, w, relu, interpret):
@@ -303,13 +303,7 @@ def _bwd_dw_tiles(N, H, W_, Ci, Co, cbytes):
                 + 2 * nb_ * H * W_ * (Ci + Co) * cbytes
                 + 2 * 9 * Ci * tco_ * 4)
 
-    tco = Co
-    while (tco > 128 and tco % 2 == 0
-           and est(nb, tco) > _VMEM_BUDGET):
-        tco //= 2
-    while nb > 1 and est(nb, tco) > _VMEM_BUDGET:
-        nb //= 2
-    return nb, tco
+    return _shrink(nb, Co, est, _VMEM_BUDGET)
 
 
 def _pallas_backward(x, s, b, w, relu, interpret, g):

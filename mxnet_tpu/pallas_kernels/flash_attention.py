@@ -98,16 +98,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         o_scr[:] = jnp.zeros_like(o_scr)
 
-    def compute():
+    def compute(masked):
         # dots run on the input dtype (bf16 hits the MXU at full rate;
-        # f32 would be 8x slower) and accumulate in f32
+        # f32 would be 8x slower) and accumulate in f32.
+        # No isneginf guards: every q row's FIRST processed block (ki=0)
+        # contains its valid col 0, so m stays finite from the first
+        # step on, exp(-inf - finite) underflows to exactly 0 for both
+        # masked scores and the m_prev=-inf init, and no exp(-inf+inf)
+        # NaN can form. (Fully-masked rows cannot occur: causal row r
+        # always sees cols 0..r.)
         q = q_ref[0]                                  # (block_q, D)
         k = k_ref[0]                                  # (block_k, D)
         v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
-        if causal:
+        if masked:
             row = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             col = ki * block_k + lax.broadcasted_iota(
@@ -116,9 +122,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
         m_prev = m_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(jnp.isneginf(s), 0.0, p)
         corr = jnp.exp(m_prev - m_new)
-        corr = jnp.where(jnp.isneginf(m_prev), 0.0, corr)
         m_scr[:, 0] = m_new
         l_scr[:, 0] = corr * l_scr[:, 0] + jnp.sum(p, axis=-1)
         o_scr[:] = corr[:, None] * o_scr[:] + jax.lax.dot_general(
@@ -126,25 +130,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
             preferred_element_type=jnp.float32)
 
     if causal:
-        # blocks strictly above the causal triangle contribute nothing
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        # three block classes: strictly above the diagonal contribute
+        # nothing (skipped); straddling the diagonal need the iota mask;
+        # strictly below run UNMASKED — most active blocks at long seq,
+        # saving the per-element iota/compare/select VPU work
+        below = ki * block_k + block_k - 1 <= qi * block_q
+
+        @pl.when(jnp.logical_and(
+            ki * block_k <= qi * block_q + block_q - 1,
+            jnp.logical_not(below)))
         def _():
-            compute()
+            compute(True)
+
+        @pl.when(below)
+        def _():
+            compute(False)
     else:
-        compute()
+        compute(False)
 
     @pl.when(ki == n_kblocks - 1)
     def _finalize():
+        # INVARIANT: no row is ever fully masked (causal row r sees cols
+        # 0..r; non-causal sees everything; ring x flash skips
+        # fully-masked hops before calling the kernel), so l > 0 and
+        # lse is finite — the backward recompute relies on this.
+        # Broadcast across a 128-lane minor dim — Mosaic requires the
+        # last block dim to be a multiple of 128, so scalars-per-row
+        # ride a full lane register.
         l = l_scr[:, 0]
-        m = m_scr[:, 0]
-        # lse = m + log(l); fully-masked rows keep lse=-inf so the
-        # backward recompute yields p == 0 for them. Broadcast across a
-        # 128-lane minor dim — Mosaic requires the last block dim to be a
-        # multiple of 128, so scalars-per-row ride a full lane register.
-        lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(
-            jnp.where(l == 0.0, 1.0, l)))
+        lse = m_scr[:, 0] + jnp.log(l)
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
-        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
         o_ref[0] = (o_scr[:] / l[:, None]).astype(o_ref.dtype)
 
 
@@ -190,11 +205,14 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    qi, ki, block_q, block_k, causal, scale):
+                    qi, ki, block_q, block_k, masked, scale):
     """Shared flash-2 backward recompute: rebuild the (block_q, block_k)
     probability tile from Q/K and the saved row logsumexp, then
     dS = P * (dP - delta) * scale. Used by both _dq_kernel and
-    _dkv_kernel so the masking/lse-safety logic cannot drift."""
+    _dkv_kernel so the masking/lse-safety logic cannot drift.
+    ``masked`` is static: only diagonal-straddling blocks pay the iota
+    mask; masked scores give p = exp(-inf - lse) = 0 exactly (causal
+    rows always have a finite lse — see _fwd_kernel)."""
     q = q_ref[0]                                  # (block_q, D)
     k = k_ref[0]                                  # (block_k, D)
     v = v_ref[0]
@@ -204,16 +222,13 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    if causal:
+    if masked:
         row = qi * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         col = ki * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(col > row, -jnp.inf, s)
-    # fully-masked rows have lse=-inf: keep them at p=0, not NaN
-    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
-    p = jnp.exp(s - lse_safe[:, None])
-    p = jnp.where(jnp.isneginf(s), 0.0, p)        # (block_q, block_k)
+    p = jnp.exp(s - lse[:, None])                 # (block_q, block_k)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)       # (block_q, block_k)
@@ -234,20 +249,28 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    def compute():
+    def compute(masked):
         _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
                                 delta_ref, qi, ki, block_q, block_k,
-                                causal, scale)
+                                masked, scale)
         dq_scr[:] += jax.lax.dot_general(
             ds, k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        below = ki * block_k + block_k - 1 <= qi * block_q
+
+        @pl.when(jnp.logical_and(
+            ki * block_k <= qi * block_q + block_q - 1,
+            jnp.logical_not(below)))
         def _():
-            compute()
+            compute(True)
+
+        @pl.when(below)
+        def _():
+            compute(False)
     else:
-        compute()
+        compute(False)
 
     @pl.when(ki == n_kblocks - 1)
     def _write():
@@ -269,11 +292,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def compute():
+    def compute(masked):
         do = do_ref[0]
         p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
                                 delta_ref, qi, ki, block_q, block_k,
-                                causal, scale)
+                                masked, scale)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # (block_k, D)
@@ -282,12 +305,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)       # (block_k, D)
 
     if causal:
-        # q blocks entirely above the diagonal see this k block masked out
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        # q blocks entirely above the diagonal see this k block masked
+        # out; strictly-below blocks run unmasked (see _fwd_kernel)
+        below = ki * block_k + block_k - 1 <= qi * block_q
+
+        @pl.when(jnp.logical_and(
+            qi * block_q + block_q - 1 >= ki * block_k,
+            jnp.logical_not(below)))
         def _():
-            compute()
+            compute(True)
+
+        @pl.when(below)
+        def _():
+            compute(False)
     else:
-        compute()
+        compute(False)
 
     @pl.when(qi == n_qblocks - 1)
     def _write():

@@ -357,6 +357,73 @@ _OP_OVERRIDES = {
          _mk("maxt", (2,), lo=2.0, hi=3.0)], {}),
 }
 
+
+def _upd(n_tensors, **hyper):
+    """Fused-optimizer update-op spec: n same-shape tensors (weight +
+    grad + states) plus runtime hyperparameters. lr etc. default to
+    None in the registry fns but are REQUIRED by the generated nd
+    wrappers (the reference marks them required attrs), so auto_spec's
+    optional-param skip can't synthesize them."""
+    def make():
+        return ([_mk("t%d" % i, (_B, _D), seed=i)
+                 for i in range(n_tensors)], dict(hyper))
+    return make
+
+
+def _multi_upd(n_per, groups=2, preloaded=False):
+    """multi_* update ops: `groups` interleaved (weight, grad, states)
+    tuples; preloaded variants carry the lr/wd vectors as the two
+    trailing DATA tensors instead of attrs."""
+    def make():
+        args = [_mk("m%d" % i, (_B, _D), seed=i)
+                for i in range(n_per * groups)]
+        if preloaded:
+            args += [_mk("lrs", (groups,), lo=0.01, hi=0.1),
+                     _mk("wds", (groups,), lo=0.0, hi=0.01)]
+            return args, {"num_weights": groups}
+        return args, {"num_weights": groups,
+                      "lrs": [0.05] * groups, "wds": [0.0] * groups}
+    return make
+
+
+_OP_OVERRIDES.update({
+    "sgd_update": _upd(2, lr=0.05),
+    "sgd_mom_update": _upd(3, lr=0.05),
+    "mp_sgd_update": _upd(3, lr=0.05),
+    "mp_sgd_mom_update": _upd(4, lr=0.05),
+    "signsgd_update": _upd(2, lr=0.05),
+    "signum_update": _upd(3, lr=0.05),
+    "nag_mom_update": _upd(3, lr=0.05),
+    "mp_nag_mom_update": _upd(4, lr=0.05),
+    "adam_update": _upd(4, lr=0.05),
+    "ftml_update": _upd(5, lr=0.05, t=1),
+    "ftrl_update": _upd(4, lr=0.05),
+    "rmsprop_update": _upd(3, lr=0.05),
+    "rmspropalex_update": _upd(5, lr=0.05),
+    "adamw_update": _upd(4, rescale_grad=1.0, lr=0.05, eta=1.0),
+    "mp_adamw_update": _upd(5, rescale_grad=1.0, lr=0.05, eta=1.0),
+    "lamb_update_phase1": _upd(4, lr=0.05),
+    # phase2's r1/r2 are the per-tensor scalar norms, shape (1,) — a
+    # full-tensor ratio would time a different computation
+    "lamb_update_phase2": lambda: (
+        [_mk("w", (_B, _D)), _mk("g", (_B, _D), seed=1),
+         _mk("r1", (1,), lo=1.0, hi=2.0), _mk("r2", (1,), lo=1.0, hi=2.0)],
+        {"lr": 0.05}),
+    "group_adagrad_update": _upd(3, lr=0.05),
+    "multi_sgd_update": _multi_upd(2),
+    "multi_sgd_mom_update": _multi_upd(3),
+    "multi_mp_sgd_update": _multi_upd(3),
+    "multi_mp_sgd_mom_update": _multi_upd(4),
+    "preloaded_multi_sgd_update": _multi_upd(2, preloaded=True),
+    "preloaded_multi_sgd_mom_update": _multi_upd(3, preloaded=True),
+    "preloaded_multi_mp_sgd_update": _multi_upd(3, preloaded=True),
+    "preloaded_multi_mp_sgd_mom_update": _multi_upd(4, preloaded=True),
+    # creation ops whose nd wrapper exposes required positionals
+    # (val / stop) under different names than the registry fn
+    "full": lambda: ([(_B, _D), 2.0], {}),
+    "arange": lambda: ([0.0, float(_B * _D)], {}),
+})
+
 # values for REQUIRED static params, by name (optional params keep their
 # defaults)
 _STATIC_DEFAULTS = {

@@ -246,9 +246,9 @@ def _shrink(nb, tile, est, budget):
 def _fwd_tiles(N, H, W_, Ci, Co, cbytes):
     """(NB, TCo) for the forward kernel. The forward weight block is
     observed NOT to be double-buffered (stage-4 untiled compiles at
-    ~10 MB), so it counts once and the budget is looser than backward's
-    — tiling Co rebuilds the im2col patches per tile, which costs more
-    VPU time than it saves."""
+    ~10 MB), so it counts once. Unlike backward, NB shrinks FIRST:
+    halving images-per-cell keeps the weight block whole and avoids
+    rebuilding the im2col patches per Co tile."""
     nb = _pick_nb(N, H, W_, Ci, cbytes)
 
     def est(nb_, tco_):
@@ -259,7 +259,13 @@ def _fwd_tiles(N, H, W_, Ci, Co, cbytes):
         acc32 = nb_ * H * W_ * tco_ * 4
         return w2 + pat + zp + blocks + acc32
 
-    return _shrink(nb, Co, est, 11 * 1024 * 1024)
+    budget = 10 * 1024 * 1024
+    tco = Co
+    while nb > 1 and est(nb, tco) > budget:
+        nb //= 2
+    while tco > 128 and tco % 2 == 0 and est(nb, tco) > budget:
+        tco //= 2
+    return nb, tco
 
 
 def _pallas_forward(x, s, b, w, relu, interpret):

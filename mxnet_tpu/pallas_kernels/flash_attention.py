@@ -80,6 +80,27 @@ def attention_reference(q, k, v, causal=False, scale=None):
                       v).astype(q.dtype)
 
 
+def _causal_dispatch(qi, ki, block_q, block_k, compute):
+    """Run ``compute(masked)`` for one (q-block, k-block) causal cell:
+    blocks strictly above the diagonal are skipped, diagonal-straddling
+    blocks run masked, strictly-below blocks run unmasked. Shared by the
+    forward and both backward kernels so the classification cannot
+    drift."""
+    import jax.experimental.pallas as pl
+
+    below = ki * block_k + block_k - 1 <= qi * block_q
+
+    @pl.when(jnp.logical_and(
+        ki * block_k <= qi * block_q + block_q - 1,
+        jnp.logical_not(below)))
+    def _():
+        compute(True)
+
+    @pl.when(below)
+    def _():
+        compute(False)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
                 block_q, block_k, causal, scale, n_kblocks):
     """One (batch*head, q-block, k-block) grid cell. The TPU grid runs
@@ -130,21 +151,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
             preferred_element_type=jnp.float32)
 
     if causal:
-        # three block classes: strictly above the diagonal contribute
-        # nothing (skipped); straddling the diagonal need the iota mask;
-        # strictly below run UNMASKED — most active blocks at long seq,
-        # saving the per-element iota/compare/select VPU work
-        below = ki * block_k + block_k - 1 <= qi * block_q
-
-        @pl.when(jnp.logical_and(
-            ki * block_k <= qi * block_q + block_q - 1,
-            jnp.logical_not(below)))
-        def _():
-            compute(True)
-
-        @pl.when(below)
-        def _():
-            compute(False)
+        # most active blocks at long seq are strictly below the diagonal
+        # and skip the per-element iota/compare/select VPU work
+        _causal_dispatch(qi, ki, block_q, block_k, compute)
     else:
         compute(False)
 
@@ -258,17 +267,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        below = ki * block_k + block_k - 1 <= qi * block_q
-
-        @pl.when(jnp.logical_and(
-            ki * block_k <= qi * block_q + block_q - 1,
-            jnp.logical_not(below)))
-        def _():
-            compute(True)
-
-        @pl.when(below)
-        def _():
-            compute(False)
+        _causal_dispatch(qi, ki, block_q, block_k, compute)
     else:
         compute(False)
 
@@ -305,19 +304,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)       # (block_k, D)
 
     if causal:
-        # q blocks entirely above the diagonal see this k block masked
-        # out; strictly-below blocks run unmasked (see _fwd_kernel)
-        below = ki * block_k + block_k - 1 <= qi * block_q
-
-        @pl.when(jnp.logical_and(
-            qi * block_q + block_q - 1 >= ki * block_k,
-            jnp.logical_not(below)))
-        def _():
-            compute(True)
-
-        @pl.when(below)
-        def _():
-            compute(False)
+        _causal_dispatch(qi, ki, block_q, block_k, compute)
     else:
         compute(False)
 

@@ -60,13 +60,15 @@ BASELINE_TRANSFORMER_MFU = _published_baseline(
 
 
 def _fused_mode():
-    """Validated BENCH_FUSED value — ONE parser so the train and
-    inference sub-benches can't attribute results to different configs."""
+    """Validated BENCH_FUSED value AND its model fuse= mapping — ONE
+    parser so the train and inference sub-benches can't attribute
+    results to different configs. Returns (raw_value, fuse_kwarg)."""
     fused = os.environ.get("BENCH_FUSED", "0")
     if fused not in ("0", "1", "pallas", "pallas_remat", "pallas_all"):
         raise ValueError("BENCH_FUSED must be one of 0|1|pallas|"
                          "pallas_remat|pallas_all, got %r" % fused)
-    return fused
+    return fused, {"pallas": "auto", "pallas_remat": "auto",
+                   "pallas_all": True}.get(fused, False)
 
 
 def bench_transformer():
@@ -182,9 +184,7 @@ def bench_resnet():
     # kernel (pallas_kernels/conv_fused.py) on the stages where it beats
     # XLA's native conv (fuse="auto"); pallas_all forces it everywhere;
     # pallas_remat combines auto with the conv-outs remat policy.
-    fused = _fused_mode()
-    pallas_fuse = {"pallas": "auto", "pallas_remat": "auto",
-                   "pallas_all": True}.get(fused, False)
+    fused, pallas_fuse = _fused_mode()
     if fused != "0":
         layout = "NHWC"
 
@@ -402,15 +402,14 @@ def bench_resnet_inference(net=None, batch=None, dtype=None):
                                         256 if big else 8))
     dtype = dtype or os.environ.get("BENCH_DTYPE",
                                     "bfloat16" if big else "float32")
-    fused = _fused_mode()   # validate BENCH_FUSED on every platform
+    # same BENCH_FUSED parsing+mapping as the training bench — inference
+    # is forward-only, the regime where the kernel wins per-stage (it
+    # still loses whole-model; docs/ROADMAP.md fused-conv study)
+    fused, pallas_fuse = _fused_mode()
     layout = "NHWC" if big else "NCHW"
     if net is None:
-        # same BENCH_FUSED mapping as the training bench — inference is
-        # forward-only, the regime where the kernel wins per-stage (it
-        # still loses whole-model; docs/ROADMAP.md fused-conv study)
-        fuse = {"pallas": "auto", "pallas_remat": "auto",
-                "pallas_all": True}.get(fused, False) if big else False
-        net = resnet50_v1(layout=layout, fuse=fuse)
+        net = resnet50_v1(layout=layout,
+                          fuse=pallas_fuse if big else False)
         net.initialize()
         net(mx.nd.array(np.zeros((1, 3, 224, 224), "float32")))
         if dtype != "float32":

@@ -232,14 +232,28 @@ def _pick_nb(N, H, W_, C, cbytes):
     return nb
 
 
-def _shrink(nb, tile, est, budget):
-    """Shared tile-shrink policy: halve the channel tile down to the
-    128-lane floor first (keeps MXU-efficient rows), then halve the
-    images-per-cell, until est(nb, tile) fits the budget."""
-    while tile > 128 and tile % 2 == 0 and est(nb, tile) > budget:
-        tile //= 2
-    while nb > 1 and est(nb, tile) > budget:
-        nb //= 2
+def _shrink(nb, tile, est, budget, nb_first=False):
+    """Shared tile-shrink policy: halve until est(nb, tile) fits the
+    budget. Backward kernels halve the channel tile first (their
+    weight/accumulator blocks dominate); the forward halves
+    images-per-cell first (keeps the weight block whole and avoids
+    rebuilding the im2col patches per Co tile)."""
+    def shrink_tile():
+        nonlocal tile
+        while tile > 128 and tile % 2 == 0 and est(nb, tile) > budget:
+            tile //= 2
+
+    def shrink_nb():
+        nonlocal nb
+        while nb > 1 and est(nb, tile) > budget:
+            nb //= 2
+
+    if nb_first:
+        shrink_nb()
+        shrink_tile()
+    else:
+        shrink_tile()
+        shrink_nb()
     return nb, tile
 
 
@@ -259,13 +273,9 @@ def _fwd_tiles(N, H, W_, Ci, Co, cbytes):
         acc32 = nb_ * H * W_ * tco_ * 4
         return w2 + pat + zp + blocks + acc32
 
-    budget = 10 * 1024 * 1024
-    tco = Co
-    while nb > 1 and est(nb, tco) > budget:
-        nb //= 2
-    while tco > 128 and tco % 2 == 0 and est(nb, tco) > budget:
-        tco //= 2
-    return nb, tco
+    # forward budget is tighter than _VMEM_BUDGET would suggest at big
+    # batch (b256 measured 408 KB over at 11 MB)
+    return _shrink(nb, Co, est, 10 * 1024 * 1024, nb_first=True)
 
 
 def _pallas_forward(x, s, b, w, relu, interpret):

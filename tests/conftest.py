@@ -46,5 +46,7 @@ def _seed_rngs():
 
 def pytest_configure(config):
     config.addinivalue_line(
-        "markers", "slow: realistic-shape mesh tests (seconds-minutes on "
-        "the virtual CPU mesh; always run, deselect with -m 'not slow')")
+        "markers", "slow: minutes-scale tests (realistic-shape mesh "
+        "steps, subprocess clusters, full registry sweeps, JPEG "
+        "pipelines); always run by default — `-m 'not slow'` is the "
+        "quick lane")

@@ -16,6 +16,11 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu.kvstore_async import AsyncPSServer, AsyncPSClient
 
+# minutes-scale on the 1-core CI host (subprocess clusters / full
+# registry sweep / JPEG decode) — deselect with -m 'not slow' for
+# the quick lane; the full lane always runs them
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def server():

@@ -15,6 +15,11 @@ import pytest
 
 import mxnet_tpu as mx
 
+# minutes-scale on the 1-core CI host (subprocess clusters / full
+# registry sweep / JPEG decode) — deselect with -m 'not slow' for
+# the quick lane; the full lane always runs them
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def sharded_env(monkeypatch):

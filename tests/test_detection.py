@@ -11,6 +11,11 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 
+# minutes-scale on the 1-core CI host (subprocess clusters / full
+# registry sweep / JPEG decode) — deselect with -m 'not slow' for
+# the quick lane; the full lane always runs them
+pytestmark = pytest.mark.slow
+
 
 def _nd(a):
     return mx.nd.array(np.asarray(a, dtype="float32"))

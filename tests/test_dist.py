@@ -9,6 +9,11 @@ import sys
 
 import pytest
 
+# minutes-scale on the 1-core CI host (subprocess clusters / full
+# registry sweep / JPEG decode) — deselect with -m 'not slow' for
+# the quick lane; the full lane always runs them
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

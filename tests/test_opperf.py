@@ -15,6 +15,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 from opperf import (auto_spec, bench_registry_op,  # noqa: E402
                     run_full_registry, _PROFILES)
 
+# minutes-scale on the 1-core CI host (full registry sweep) — deselect
+# with -m 'not slow' for the quick lane; the full lane always runs them
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def summary():

@@ -11,10 +11,8 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 
-# minutes-scale on the 1-core CI host (subprocess clusters / full
-# registry sweep / JPEG decode) — deselect with -m 'not slow' for
-# the quick lane; the full lane always runs them
-pytestmark = pytest.mark.slow
+# the numpy-oracle op tests are seconds-scale and stay in the quick
+# lane; only the SSD end-to-end training class below is marked slow
 
 
 def _nd(a):
@@ -475,6 +473,7 @@ class TestCrop:
         np.testing.assert_array_equal(out, x.asnumpy()[:, :, :3, :5])
 
 
+@pytest.mark.slow  # minutes-scale: full training loops + JPEG .rec
 class TestSSDExample:
     def test_ssd_pipeline_trains(self):
         """End-to-end SSD example (example/ssd/train_ssd.py): prior ->

@@ -46,7 +46,8 @@ def run(dim, layers, seq, batch=1, iters=3):
     from mxnet_tpu.parallel import transformer as T
 
     cfg = T.TransformerConfig(
-        vocab_size=32000, dim=dim, n_layers=layers, n_heads=dim // 128,
+        vocab_size=32000, dim=dim, n_layers=layers,
+        n_heads=max(1, dim // 128),
         ffn_hidden=dim * 4, max_seq_len=seq, dtype="bfloat16",
         attn_mode="local",
         # chunked CE: [B,S,32k] logits never materialize — mandatory at
@@ -59,11 +60,16 @@ def run(dim, layers, seq, batch=1, iters=3):
         state = init_fn(jr.PRNGKey(0))
         toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq)),
                            jnp.int32)
-        state, loss = step_fn(state, toks, toks)
+        # independent targets — same convention as bench.py's
+        # transformer bench (targets == inputs would let causal
+        # attention copy-predict and collapse the loss)
+        tgts = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq)),
+                           jnp.int32)
+        state, loss = step_fn(state, toks, tgts)
         float(loss)  # compile + warm
         t0 = time.perf_counter()
         for _ in range(iters):
-            state, loss = step_fn(state, toks, toks)
+            state, loss = step_fn(state, toks, tgts)
         loss = float(loss)
         dt = (time.perf_counter() - t0) / iters
     n_params = sum(int(np.prod(p.shape))
@@ -84,15 +90,16 @@ def main():
                     help="dim,layers,seq triples (default: the sweep)")
     ap.add_argument("--iters", type=int, default=3)
     args = ap.parse_args()
-    configs = ([tuple(int(x) for x in c.split(",")) for c in args.configs]
-               if args.configs else DEFAULT_CONFIGS)
-    for dim, layers, seq in configs:
+    for raw in (args.configs or
+                ["%d,%d,%d" % c for c in DEFAULT_CONFIGS]):
         try:
+            dim, layers, seq = (int(x) for x in raw.split(","))
             print(json.dumps(run(dim, layers, seq, iters=args.iters)),
                   flush=True)
-        except Exception as e:  # noqa: BLE001 — an OOM config must not
-            print(json.dumps({"dim": dim, "layers": layers, "seq": seq,
-                              "error": str(e)[:200]}), flush=True)
+        except Exception as e:  # noqa: BLE001 — an OOM or malformed
+            # config must not kill the remaining sweep
+            print(json.dumps({"config": raw, "error": str(e)[:200]}),
+                  flush=True)
 
 
 if __name__ == "__main__":

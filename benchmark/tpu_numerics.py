@@ -170,6 +170,43 @@ def check_flash():
             "pallas_active": bool(FA._use_pallas())}
 
 
+def run_model():
+    """Deterministic whole-model forward — the model-level analog of the
+    op sweep (ref pattern: tests/python/gpu/test_operator_gpu.py runs
+    full models on the device too). A thumbnail ResNet-18 eval forward
+    exercises layout choices, conv/BN/pool fusion decisions, and the
+    Gluon->jit tracing path that per-op checks cannot see."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import random as mxrandom
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    import random as _pyrandom
+    # deterministic init WITHOUT leaking reseeded global streams into
+    # whatever runs after (bench.py calls this mid-process)
+    py_state = _pyrandom.getstate()
+    np_state = np.random.get_state()
+    mx_state = (mxrandom._STATE.seed, mxrandom._STATE.counter,
+                mxrandom._STATE.base_key, mxrandom._HOST_RNG.get_state())
+    try:
+        _pyrandom.seed(0)
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = resnet18_v1(thumbnail=True)
+        net.initialize()
+        rs = np.random.RandomState(11)
+        x = mx.nd.array(rs.rand(4, 3, 32, 32).astype("float32"))
+        from mxnet_tpu import autograd
+        with autograd.pause():
+            out = net(x)
+        return np.asarray(out.asnumpy())
+    finally:
+        _pyrandom.setstate(py_state)
+        np.random.set_state(np_state)
+        (mxrandom._STATE.seed, mxrandom._STATE.counter,
+         mxrandom._STATE.base_key) = mx_state[:3]
+        mxrandom._HOST_RNG.set_state(mx_state[3])
+
+
 def sweep(golden_path):
     import jax
     golden = np.load(golden_path)
@@ -202,6 +239,11 @@ def sweep(golden_path):
         "worst_ulp": worst[1],
         "per_op": per_op,
     }
+    if "__model__" in golden:
+        m = run_model()
+        g = golden["__model__"]
+        out["model_resnet18_max_ulp"] = _max_ulp(m, g)
+        out["model_resnet18_max_abs"] = float(np.max(np.abs(m - g)))
     out.update(check_flash())
     return out
 
@@ -242,6 +284,7 @@ def main():
         import jax
         platform = jax.devices()[0].platform
         np.savez(args.golden, __platform__=np.array(platform),
+                 __model__=run_model(),
                  **run_ops())
         print("wrote %s (%d ops, %s)" % (args.golden, len(OPS),
                                          platform))

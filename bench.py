@@ -495,8 +495,8 @@ def bench_numerics():
         "worst_nonmatmul_op": worst_nonmatmul[0],
         "worst_nonmatmul_ulp": worst_nonmatmul[1],
         "matmul_family_ulp": matmul,
-        "model_resnet18_max_ulp": full.get("model_resnet18_max_ulp"),
         "model_resnet18_max_abs": full.get("model_resnet18_max_abs"),
+        "model_resnet18_rel_err": full.get("model_resnet18_rel_err"),
         "flash_fwd_rel_err": full["flash_fwd_rel_err"],
         "flash_bwd_max_abs_err": full["flash_bwd_max_abs_err"],
         "pallas_active": full["pallas_active"],

@@ -191,11 +191,24 @@ def run_model():
         _pyrandom.seed(0)
         np.random.seed(0)
         mx.random.seed(0)
+        from mxnet_tpu import autograd
+
         net = resnet18_v1(thumbnail=True)
         net.initialize()
         rs = np.random.RandomState(11)
         x = mx.nd.array(rs.rand(4, 3, 32, 32).astype("float32"))
-        from mxnet_tpu import autograd
+        with autograd.pause():
+            net(x)  # finish deferred init (host)
+        # eager NDArrays are host-committed (default ctx cpu) and ops
+        # follow operand placement — without explicit placement the
+        # "device" check would silently run on the host CPU and match
+        # the golden bit-exactly, checking nothing. reset_ctx /
+        # as_in_context keep each array's .context consistent with the
+        # buffer (Context('tpu') falls back to host on cpu-only runs,
+        # preserving the golden process's behavior).
+        tpu = mx.context.Context("tpu")
+        net.collect_params().reset_ctx(tpu)
+        x = x.as_in_context(tpu)
         with autograd.pause():
             out = net(x)
         return np.asarray(out.asnumpy())
@@ -242,8 +255,14 @@ def sweep(golden_path):
     if "__model__" in golden:
         m = run_model()
         g = golden["__model__"]
-        out["model_resnet18_max_ulp"] = _max_ulp(m, g)
-        out["model_resnet18_max_abs"] = float(np.max(np.abs(m - g)))
+        # ULP distance is meaningless for near-zero logits (a sign flip
+        # at 1e-8 is ~2^31 ULP), so the headline is max_abs relative to
+        # the output scale; TPU f32 convs legitimately differ from CPU
+        # (bf16-passes decomposition) and this is where that shows up
+        max_abs = float(np.max(np.abs(m - g)))
+        out["model_resnet18_max_abs"] = max_abs
+        out["model_resnet18_rel_err"] = float(
+            max_abs / (np.max(np.abs(g)) + 1e-12))
     out.update(check_flash())
     return out
 

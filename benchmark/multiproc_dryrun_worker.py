@@ -12,15 +12,18 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_FLAG = "--xla_force_host_platform_device_count=4"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " " + _FLAG).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import jax  # noqa: E402
+
+from tools.launch import force_virtual_cpu_devices  # noqa: E402
+
+# Survive a preloaded accelerator plugin that already grabbed a backend
+# at interpreter startup (the r4 MULTICHIP regression); see the helper's
+# docstring. Must precede jax.distributed.initialize.
+force_virtual_cpu_devices(4)
 
 jax.distributed.initialize(os.environ["MXTPU_COORDINATOR"],
                            int(os.environ["MXTPU_NUM_PROCS"]),

@@ -17,12 +17,13 @@ pytestmark = pytest.mark.slow
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_launcher(n, script, timeout=240):
+def _run_launcher(n, script, timeout=240, env_extra=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # replace (not extend) PYTHONPATH: the axon sitecustomize on it would
     # grab the real TPU in every worker
     env["PYTHONPATH"] = REPO
+    env.update(env_extra or {})
     cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
            "-n", str(n), sys.executable, os.path.join(REPO, script)]
     return subprocess.run(cmd, env=env, capture_output=True, text=True,
@@ -78,6 +79,44 @@ def test_bandwidth_tool_dist():
     recs = [json.loads(line) for line in res.stdout.splitlines()
             if line.startswith("{")]
     assert recs and recs[0]["num_workers"] == 2
+
+
+_PHASE6_WORKER = "benchmark/multiproc_dryrun_worker.py"
+
+
+def _assert_phase6_ok(res):
+    assert res.returncode == 0, res.stdout + res.stderr
+    out = res.stdout + res.stderr
+    for rank in range(2):
+        assert ("multiproc dryrun rank %d: dp=4 sp=2 over 2 procs ok"
+                % rank) in out, out
+
+
+def test_multiproc_dryrun_phase6():
+    """Run the exact dryrun phase-6 command (2 procs x 4 virtual devices
+    stitched by jax.distributed) so the driver's MULTICHIP check is
+    exercised in CI — it regressed silently in r4 (VERDICT r4 item 1)."""
+    res = _run_launcher(2, _PHASE6_WORKER, timeout=480, env_extra={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    _assert_phase6_ok(res)
+
+
+def test_multiproc_dryrun_phase6_hostile_preload(tmp_path):
+    """Phase 6 with a simulated preloaded accelerator plugin: a
+    sitecustomize that clobbers XLA_FLAGS and initializes the XLA backend
+    at interpreter startup, before the worker's env mutations run — the
+    exact r4 failure mode ("expected 8 global devices, got 1"). The
+    worker's force_virtual_cpu_devices re-init must recover."""
+    site = tmp_path / "sitecustomize.py"
+    site.write_text(
+        "import os\n"
+        "os.environ.pop('XLA_FLAGS', None)\n"
+        "import jax\n"
+        "jax.devices()  # pins a 1-device backend before worker code runs\n")
+    res = _run_launcher(2, _PHASE6_WORKER, timeout=480, env_extra={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PYTHONPATH": str(tmp_path) + os.pathsep + REPO})
+    _assert_phase6_ok(res)
 
 
 def test_launcher_propagates_failure(tmp_path):

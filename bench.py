@@ -28,6 +28,7 @@ BENCH_MODEL=resnet50|transformer|resnet50_infer runs one section alone.
 """
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -484,7 +485,8 @@ def bench_numerics():
     full = tpu_numerics.run_with_cpu_golden()
     matmul = {k: v["max_ulp"] for k, v in full["per_op"].items()
               if k in ("dot", "Convolution", "FullyConnected",
-                       "linalg_gemm2", "dot_precision_highest")}
+                       "linalg_gemm2", "dot_precision_highest",
+                       "dot_policy_float32")}
     worst_nonmatmul = max(
         ((k, v["max_ulp"]) for k, v in full["per_op"].items()
          if k not in matmul), key=lambda kv: kv[1])
@@ -500,6 +502,7 @@ def bench_numerics():
         "flash_fwd_rel_err": full["flash_fwd_rel_err"],
         "flash_bwd_max_abs_err": full["flash_bwd_max_abs_err"],
         "pallas_active": full["pallas_active"],
+        "gate": full["gate"],
         "per_op": full["per_op"],
     }
 
@@ -550,3 +553,9 @@ if __name__ == "__main__":
         except Exception as e:  # noqa: BLE001
             result["numerics"] = {"error": str(e)[:400]}
     print(json.dumps(result))
+    gate = result.get("numerics", {}).get("gate")
+    if gate is not None and not gate["ok"]:
+        # per-op ULP budget breached (benchmark/tpu_numerics.py
+        # ULP_BUDGETS) — fail loudly AFTER printing the JSON record
+        sys.exit("numerics ULP gate breached: %s"
+                 % "; ".join(gate["breaches"]))

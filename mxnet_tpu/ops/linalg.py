@@ -15,24 +15,26 @@ from .registry import register
 
 
 @register("dot", num_inputs=2)
-def dot(a, b, transpose_a=False, transpose_b=False):
-    # MXNet dot: contract last axis of a with first axis of b
+def dot(a, b, transpose_a=False, transpose_b=False, precision=None):
+    # MXNet dot: contract last axis of a with first axis of b.
+    # precision=None defers to the global policy (mxnet_tpu/precision.py);
+    # "float32"/"highest" buy reference-parity fp32 at extra MXU passes.
     if transpose_a:
         a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
     if transpose_b:
         b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
     if a.ndim == 1 and b.ndim == 1:
-        return jnp.dot(a, b)
-    return jnp.tensordot(a, b, axes=1)
+        return jnp.dot(a, b, precision=precision)
+    return jnp.tensordot(a, b, axes=1, precision=precision)
 
 
 @register("batch_dot", num_inputs=2)
-def batch_dot(a, b, transpose_a=False, transpose_b=False):
+def batch_dot(a, b, transpose_a=False, transpose_b=False, precision=None):
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
         b = jnp.swapaxes(b, -1, -2)
-    return jnp.matmul(a, b)
+    return jnp.matmul(a, b, precision=precision)
 
 
 @register("khatri_rao")
@@ -48,17 +50,18 @@ def khatri_rao(*mats):
 
 @register("linalg_gemm", num_inputs=3)
 def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
-                beta=1.0, axis=-2):
+                beta=1.0, axis=-2, precision=None):
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
-    return alpha * jnp.matmul(a, b) + beta * C
+    return alpha * jnp.matmul(a, b, precision=precision) + beta * C
 
 
 @register("linalg_gemm2", num_inputs=2)
-def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2, precision=None):
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
-    return alpha * jnp.matmul(a, b)
+    return alpha * jnp.matmul(a, b, precision=precision)
 
 
 @register("linalg_potrf", num_inputs=1)
@@ -77,9 +80,11 @@ def linalg_potri(A, lower=True):
 
 
 @register("linalg_trmm", num_inputs=2)
-def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0,
+                precision=None):
     a = jnp.swapaxes(A, -1, -2) if transpose else A
-    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+    return alpha * (jnp.matmul(B, a, precision=precision) if rightside
+                    else jnp.matmul(a, B, precision=precision))
 
 
 @register("linalg_trsm", num_inputs=2)
@@ -113,9 +118,10 @@ def linalg_makediag(d, offset=0):
 
 
 @register("linalg_syrk", num_inputs=1)
-def linalg_syrk(A, transpose=False, alpha=1.0):
+def linalg_syrk(A, transpose=False, alpha=1.0, precision=None):
     a = jnp.swapaxes(A, -1, -2) if transpose else A
-    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2),
+                              precision=precision)
 
 
 @register("linalg_gelqf", num_inputs=1)

@@ -45,12 +45,14 @@ def _pair(v, n=2):
 
 @register("FullyConnected", num_inputs=None, aliases=("fully_connected",))
 def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
-                    flatten=True):
+                    flatten=True, precision=None):
+    # precision=None defers to the global matmul policy
+    # (mxnet_tpu/precision.py, MXTPU_MATMUL_PRECISION)
     if flatten:
         x2 = x.reshape(x.shape[0], -1)
     else:
         x2 = x
-    out = jnp.matmul(x2, weight.T)
+    out = jnp.matmul(x2, weight.T, precision=precision)
     if bias is not None and not no_bias:
         out = out + bias
     return out
@@ -65,7 +67,7 @@ def _channels_last(layout):
 def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=None, num_group=1, no_bias=False,
                 layout="NCHW", cudnn_tune=None, cudnn_off=False,
-                workspace=1024):
+                workspace=1024, precision=None):
     """N-D convolution (1D/2D/3D by kernel length), NCHW/NCW/NCDHW layouts.
     ref: src/operator/nn/convolution-inl.h ConvolutionParam/ConvolutionCompute.
     """
@@ -96,8 +98,9 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
         # materializing. Strides become a free slice of the input.
         xs = x[:, ::stride[0], ::stride[1], :] if stride != (1, 1) else x
         n, h, w_, cin = xs.shape
-        out = (xs.reshape(n * h * w_, cin)
-               @ weight.reshape(weight.shape[0], cin).T)
+        out = jnp.matmul(xs.reshape(n * h * w_, cin),
+                         weight.reshape(weight.shape[0], cin).T,
+                         precision=precision)
         out = out.reshape(n, h, w_, weight.shape[0])
         if bias is not None and not no_bias:
             out = out + bias.reshape((1, 1, 1, -1))
@@ -110,7 +113,7 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
         x, weight, window_strides=stride, padding=padding,
         lhs_dilation=(1,) * nd, rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=None)
+        preferred_element_type=None, precision=precision)
     if bias is not None and not no_bias:
         bshape = ((1,) + (1,) * nd + (-1,)) if channels_last \
             else ((1, -1) + (1,) * nd)
@@ -122,7 +125,7 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
 def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
                   pad=None, adj=None, target_shape=None, num_filter=None,
                   num_group=1, no_bias=True, layout="NCHW", cudnn_tune=None,
-                  cudnn_off=False, workspace=512):
+                  cudnn_off=False, workspace=512, precision=None):
     """Transposed convolution. ref: src/operator/nn/deconvolution-inl.h.
     Implemented as conv_general_dilated with lhs_dilation (fractional stride).
     """
@@ -156,7 +159,7 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=(1,) * nd, padding=padding,
         lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group)
+        feature_group_count=num_group, precision=precision)
     if bias is not None and not no_bias:
         bshape = ((1,) + (1,) * nd + (-1,)) if channels_last \
             else ((1, -1) + (1,) * nd)

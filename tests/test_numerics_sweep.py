@@ -35,3 +35,40 @@ def test_same_backend_sweep_is_exact(tmp_path):
     # flash check ran (reference path on CPU) and is numerically tight
     assert out["flash_fwd_rel_err"] < 1e-3
     assert out["flash_bwd_max_abs_err"] < 1e-2
+    # the precision-policy controls are in the sweep and the ULP gate
+    # passed (VERDICT r4 item 3: a sweep without a gate silently
+    # absorbs regressions)
+    assert "dot_policy_float32" in out["per_op"]
+    assert "dot_precision_highest" in out["per_op"]
+    assert out["gate"]["ok"], out["gate"]
+
+
+def test_ulp_gate_fails_on_breach():
+    """A budget breach must fail the sweep (and bench), not just be
+    recorded."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import tpu_numerics as tn
+
+    out = {
+        "per_op": {"dot": {"max_ulp": tn.ULP_BUDGETS["dot"] + 1,
+                           "max_abs": 1.0},
+                   "exp": {"max_ulp": 0, "max_abs": 0.0}},
+        "flash_fwd_rel_err": 0.0,
+        "flash_bwd_max_abs_err": 0.0,
+        "model_resnet18_rel_err": 0.5,
+    }
+    breaches = tn.apply_gate(out)
+    assert not out["gate"]["ok"]
+    assert len(breaches) == 2  # dot ULP + model rel err
+    assert any("dot" in b for b in breaches)
+    assert any("model_resnet18_rel_err" in b for b in breaches)
+
+    ok = {"per_op": {"dot": {"max_ulp": 3, "max_abs": 0.0}},
+          "flash_fwd_rel_err": 0.0, "flash_bwd_max_abs_err": 0.0}
+    assert tn.apply_gate(ok) == []
+    assert ok["gate"]["ok"]
+
+    # every sweep op has a budget — a new op without one would be
+    # silently ungated
+    for op in tn.OPS:
+        assert op in tn.ULP_BUDGETS, op

@@ -1,7 +1,16 @@
-"""Training callbacks.
+"""Training callbacks for the Module fit loop.
 
-ref: python/mxnet/callback.py (module_checkpoint :31, do_checkpoint :59,
-log_train_metric :83, Speedometer :108, ProgressBar :177, LogValidationMetricsCallback).
+Own-idiom rebuild of the reference callback surface
+(ref: python/mxnet/callback.py — module_checkpoint :31, do_checkpoint
+:59, log_train_metric :83, Speedometer :108, ProgressBar :177,
+LogValidationMetricsCallback :205). Every batch-end callback receives
+the fit loop's BatchEndParam (fields: epoch, nbatch, eval_metric,
+locals) and every epoch-end callback (iter_no, sym, arg, aux).
+
+One TPU-relevant behavior worth knowing: metric values read here come
+from the device-side accumulators in metric.py — the fit loop never
+syncs per batch, so a Speedometer with frequent=50 forces at most one
+device->host transfer per 50 batches, not per batch.
 """
 from __future__ import annotations
 
@@ -13,113 +22,121 @@ import time
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
+_log = logging.getLogger(__name__)
+
+
+def _every(period):
+    """True on epochs 0-indexed period-1, 2*period-1, ... (the reference
+    checkpoints on (iter_no + 1) % period == 0)."""
+    period = max(1, int(period))
+    return lambda iter_no: (iter_no + 1) % period == 0
+
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint the model data once each period (ref: callback.py:31)."""
-    period = int(max(1, period))
+    """Epoch-end callback saving `mod` every `period` epochs
+    (ref: callback.py:31)."""
+    due = _every(period)
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
-    return _callback
+    def _on_epoch_end(iter_no, sym=None, arg=None, aux=None):
+        if due(iter_no):
+            mod.save_checkpoint(prefix, iter_no + 1,
+                                save_optimizer_states)
+    return _on_epoch_end
 
 
 def do_checkpoint(prefix, period=1):
-    """Checkpoint params every period epochs (ref: callback.py:59)."""
+    """Epoch-end callback saving the (sym, arg, aux) triple every
+    `period` epochs (ref: callback.py:59)."""
     from .model import save_checkpoint
-    period = int(max(1, period))
+    due = _every(period)
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
+    def _on_epoch_end(iter_no, sym, arg, aux):
+        if due(iter_no):
             save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
-    return _callback
+    return _on_epoch_end
 
 
 def log_train_metric(period, auto_reset=False):
-    """Log metric periodically (ref: callback.py:83)."""
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset_local()
-    return _callback
+    """Batch-end callback logging the training metric every `period`
+    batches (ref: callback.py:83)."""
+    def _on_batch_end(param):
+        metric = param.eval_metric
+        if param.nbatch % period != 0 or metric is None:
+            return
+        for name, value in metric.get_name_value():
+            _log.info("Iter[%d] Batch[%d] Train-%s=%f", param.epoch,
+                      param.nbatch, name, value)
+        if auto_reset:
+            metric.reset_local()
+    return _on_batch_end
 
 
 class Speedometer:
-    """Log training speed and metrics periodically (ref: callback.py:108)."""
+    """Batch-end callback logging samples/sec plus the current metric
+    every `frequent` batches (ref: callback.py:108).
+
+    With auto_reset the metric window restarts after each report, so
+    the printed values cover just the last `frequent` batches; without
+    it they are epoch-cumulative (batch range logged accordingly).
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
-        self.batch_size = batch_size
-        self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self.batch_size, self.frequent = batch_size, frequent
         self.auto_reset = auto_reset
+        self.last_count = 0
+        self._window_start = None  # None => first call of an epoch
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
+        n = param.nbatch
+        if self.last_count > n:  # nbatch restarted: new epoch
+            self._window_start = None
+        self.last_count = n
 
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset_local()
-                        msg = "Epoch[%d] Batch [%d-%d]\tSpeed: %.2f " \
-                              "samples/sec"
-                        msg += "\t%s=%f" * len(name_value)
-                        logging.info(msg, param.epoch,
-                                     count - self.frequent, count, speed,
-                                     *sum(name_value, ()))
-                    else:
-                        msg = "Epoch[%d] Batch [0-%d]\tSpeed: %.2f " \
-                              "samples/sec"
-                        msg += "\t%s=%f" * len(name_value)
-                        logging.info(msg, param.epoch, count, speed,
-                                     *sum(name_value, ()))
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed)
-                self.tic = time.time()
+        if self._window_start is None:
+            self._window_start = time.time()
+            return
+        if n % self.frequent != 0:
+            return
+
+        elapsed = time.time() - self._window_start
+        speed = (self.frequent * self.batch_size / elapsed) if elapsed \
+            else float("inf")
+        metric = param.eval_metric
+        if metric is None:
+            _log.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                      param.epoch, n, speed)
         else:
-            self.init = True
-            self.tic = time.time()
+            pairs = metric.get_name_value()
+            lo = n - self.frequent if self.auto_reset else 0
+            if self.auto_reset:
+                metric.reset_local()
+            _log.info("Epoch[%d] Batch [%d-%d]\tSpeed: %.2f "
+                      "samples/sec%s", param.epoch, lo, n, speed,
+                      "".join("\t%s=%f" % nv for nv in pairs))
+        self._window_start = time.time()
 
 
 class ProgressBar:
-    """ASCII progress bar (ref: callback.py:177)."""
+    """Batch-end callback drawing an ASCII bar over `total` batches
+    (ref: callback.py:177)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
-        self.total = total
+        self.bar_len, self.total = length, total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write("[%s] %s%s\r" % (prog_bar, percents, "%"))
+        done = param.nbatch / float(self.total)
+        fill = int(round(self.bar_len * done))
+        sys.stdout.write("[%s] %s%%\r" % (
+            "=" * fill + "-" * (self.bar_len - fill),
+            math.ceil(100.0 * done)))
 
 
 class LogValidationMetricsCallback:
-    """ref: callback.py:205."""
+    """Epoch-end (eval) callback logging every validation metric
+    (ref: callback.py:205)."""
 
     def __call__(self, param):
-        if not param.eval_metric:
-            return
-        name_value = param.eval_metric.get_name_value()
-        for name, value in name_value:
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
-                         value)
+        for name, value in (param.eval_metric.get_name_value()
+                            if param.eval_metric else ()):
+            _log.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                      value)

@@ -1,4 +1,15 @@
-"""Loss layers (ref: python/mxnet/gluon/loss.py)."""
+"""Gluon losses.
+
+Own-idiom rebuild of the reference's loss zoo
+(ref: python/mxnet/gluon/loss.py). Nearly every loss there repeats the
+same tail — optional per-sample weighting, then a mean over the
+non-batch axes — so here that tail lives once (`_weighted` +
+`_per_sample_mean`) and elementwise losses only state their term via
+the `_ElementwiseLoss` template. All math goes through the F-dispatched
+op layer (ops/), so a definition traces into one XLA program under
+hybridize and runs eagerly otherwise; every reduction stays inside the
+compiled graph — a loss never forces a device->host sync.
+"""
 from __future__ import annotations
 
 from .block import HybridBlock
@@ -9,89 +20,163 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
            "PoissonNLLLoss", "CosineEmbeddingLoss"]
 
+_EPS = 1e-12
 
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    """ref: gluon/loss.py:_apply_weighting."""
+
+def _weighted(F, term, weight, sample_weight):
+    """The shared weighting tail: elementwise sample_weight (broadcast),
+    then the loss's constant weight (ref helper: _apply_weighting)."""
     if sample_weight is not None:
-        loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        loss = loss * weight
-    return loss
+        term = F.broadcast_mul(term, sample_weight)
+    return term if weight is None else term * weight
 
 
-def _reshape_like(F, x, y):
-    return x.reshape(y.shape)
+def _softplus(F, x):
+    """log(1 + exp(x)) via the op layer's softrelu activation."""
+    return F.Activation(x, act_type="softrelu")
+
+
+def _stable_bce(F, z, target):
+    """Cross-entropy of sigmoid(z) against target without forming the
+    sigmoid: max(z, 0) - z*target + log1p(exp(-|z|))."""
+    return F.relu(z) - z * target + _softplus(F, -F.abs(z))
 
 
 class Loss(HybridBlock):
-    """Base loss (ref: gluon/loss.py:Loss)."""
+    """Base: holds the constant weight and which axis indexes samples
+    (ref: gluon/loss.py Loss)."""
 
     def __init__(self, weight, batch_axis, **kwargs):
         super().__init__(**kwargs)
         self._weight = weight
         self._batch_axis = batch_axis
 
+    def _per_sample_mean(self, F, term, sample_weight):
+        """Weighting + mean over every axis except the batch one — the
+        tail every elementwise loss shares."""
+        term = _weighted(F, term, self._weight, sample_weight)
+        return F.mean(term, axis=self._batch_axis, exclude=True)
+
     def __repr__(self):
-        return "%s(batch_axis=%s, w=%s)" % (type(self).__name__,
-                                            self._batch_axis, self._weight)
+        return "%s(batch_axis=%s, w=%s)" % (
+            type(self).__name__, self._batch_axis, self._weight)
 
 
-class L2Loss(Loss):
-    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
+class _ElementwiseLoss(Loss):
+    """Template for losses of the form mean_over_sample(term(pred,
+    label)): subclasses implement only `_term`; the label is first
+    viewed in pred's shape (the reference reshapes likewise so int
+    labels of shape [B] align with preds of [B, 1] etc.)."""
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(label - pred)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class L1Loss(Loss):
     def __init__(self, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
+    def _term(self, F, pred, label):
+        raise NotImplementedError
+
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        term = self._term(F, pred, label.reshape(pred.shape))
+        return self._per_sample_mean(F, term, sample_weight)
+
+
+class L2Loss(_ElementwiseLoss):
+    """Half mean-squared error (the 1/2 makes the gradient pred-label)."""
+
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def _term(self, F, pred, label):
+        # the constant 1/2 of the reference's weight/2 folded into the
+        # term (scalars commute with the weighting tail)
+        return 0.5 * F.square(label - pred)
+
+
+class L1Loss(_ElementwiseLoss):
+    def _term(self, F, pred, label):
+        return F.abs(label - pred)
+
+
+class HuberLoss(_ElementwiseLoss):
+    """Quadratic inside |err| <= rho, linear outside."""
+
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def _term(self, F, pred, label):
+        err = F.abs(label - pred)
+        return F.where(err > self._rho, err - 0.5 * self._rho,
+                       F.square(err) * (0.5 / self._rho))
+
+
+class HingeLoss(_ElementwiseLoss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def _term(self, F, pred, label):
+        return F.relu(self._margin - pred * label)
+
+
+class SquaredHingeLoss(HingeLoss):
+    def _term(self, F, pred, label):
+        return F.square(super()._term(F, pred, label))
+
+
+class LogisticLoss(_ElementwiseLoss):
+    """Binary logistic loss over raw scores; labels either {-1, 1}
+    ("signed", default) or {0, 1} ("binary")."""
+
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise ValueError("label_format must be 'signed' or 'binary', "
+                             "got %r" % (label_format,))
+        self._label_format = label_format
+
+    def _term(self, F, pred, label):
+        if self._label_format == "signed":
+            label = (label + 1.0) * 0.5  # {-1,1} -> {0,1}
+        return _stable_bce(F, pred, label)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
-    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+    """BCE over logits (default) or over already-sigmoided
+    probabilities (from_sigmoid=True), with optional positive-class
+    reweighting (ref: gluon/loss.py SigmoidBinaryCrossEntropyLoss)."""
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_sigmoid = from_sigmoid
 
     def hybrid_forward(self, F, pred, label, sample_weight=None,
                        pos_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            if pos_weight is None:
-                loss = F.relu(pred) - pred * label + F.Activation(
-                    -F.abs(pred), act_type="softrelu")
-            else:
-                log_weight = 1 + F.broadcast_mul(pos_weight - 1, label)
-                loss = pred - pred * label + log_weight * (
-                    F.Activation(-F.abs(pred), act_type="softrelu")
-                    + F.relu(-pred))
+        label = label.reshape(pred.shape)
+        if self._from_sigmoid:
+            pos_term = F.log(pred + _EPS) * label
+            if pos_weight is not None:
+                pos_term = F.broadcast_mul(pos_term, pos_weight)
+            term = -(pos_term + F.log(1 - pred + _EPS) * (1 - label))
+        elif pos_weight is None:
+            term = _stable_bce(F, pred, label)
         else:
-            eps = 1e-12
-            if pos_weight is None:
-                loss = -(F.log(pred + eps) * label
-                         + F.log(1 - pred + eps) * (1 - label))
-            else:
-                loss = -(F.broadcast_mul(F.log(pred + eps) * label, pos_weight)
-                         + F.log(1 - pred + eps) * (1 - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            # log-weight scales only the softplus branch, matching the
+            # reference's weighted-logit algebra
+            lw = 1 + F.broadcast_mul(pos_weight - 1, label)
+            term = pred - pred * label \
+                + lw * (_softplus(F, -F.abs(pred)) + F.relu(-pred))
+        return self._per_sample_mean(F, term, sample_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """ref: gluon/loss.py SoftmaxCrossEntropyLoss."""
+    """Categorical CE over logits; sparse int labels by default, dense
+    distributions with sparse_label=False
+    (ref: gluon/loss.py SoftmaxCrossEntropyLoss)."""
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
@@ -101,42 +186,44 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            term = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            term = -F.sum(logp * label.reshape(logp.shape),
+                          axis=self._axis, keepdims=True)
+        return self._per_sample_mean(F, term, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
 class KLDivLoss(Loss):
-    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
-                 **kwargs):
+    """KL(label || softmax(pred)); pred is log-probabilities when
+    from_logits (default), raw scores otherwise."""
+
+    def __init__(self, from_logits=True, axis=-1, weight=None,
+                 batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_logits = from_logits
         self._axis = axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
+        term = label * (F.log(label + _EPS) - logp)
+        return self._per_sample_mean(F, term, sample_weight)
 
 
 class CTCLoss(Loss):
-    """Connectionist temporal classification loss
-    (ref: src/operator/nn/ctc_loss.cc + gluon/loss.py CTCLoss). Implemented
-    with a log-space forward recursion over ``lax.scan`` — XLA-friendly (no
-    warp-ctc kernel)."""
+    """Connectionist temporal classification
+    (ref: src/operator/nn/ctc_loss.cc + gluon/loss.py CTCLoss). The
+    recursion itself is the registered ctc_loss op — a log-space
+    forward pass over lax.scan, XLA-friendly (no warp-ctc kernel)."""
 
-    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
         super().__init__(weight, 0, **kwargs)
         self._layout = layout
         self._label_layout = label_layout
@@ -144,117 +231,72 @@ class CTCLoss(Loss):
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
         from ..ndarray.register import invoke_by_name
-        out = invoke_by_name("ctc_loss", pred, label,
-                             pred_lengths=pred_lengths,
-                             label_lengths=label_lengths,
-                             layout=self._layout,
-                             label_layout=self._label_layout)
-        loss = _apply_weighting(F, out, self._weight, sample_weight)
-        return loss
-
-
-class HuberLoss(Loss):
-    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._rho = rho
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class HingeLoss(Loss):
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class SquaredHingeLoss(Loss):
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class LogisticLoss(Loss):
-    def __init__(self, weight=None, batch_axis=0, label_format="signed",
-                 **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._label_format = label_format
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        if self._label_format == "signed":
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + F.Activation(
-            -F.abs(pred), act_type="softrelu")
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        per_seq = invoke_by_name(
+            "ctc_loss", pred, label, pred_lengths=pred_lengths,
+            label_lengths=label_lengths, layout=self._layout,
+            label_layout=self._label_layout)
+        return _weighted(F, per_seq, self._weight, sample_weight)
 
 
 class TripletLoss(Loss):
+    """relu(margin + ||pos - a||^2 - ||neg - a||^2), one value per
+    sample (already reduced, so only the weighting tail applies)."""
+
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+    def hybrid_forward(self, F, pred, positive, negative,
+                       sample_weight=None):
+        gap = F.sum(F.square(positive.reshape(pred.shape) - pred)
+                    - F.square(negative.reshape(pred.shape) - pred),
+                    axis=self._batch_axis, exclude=True)
+        return _weighted(F, F.relu(gap + self._margin), self._weight,
+                         sample_weight)
 
 
 class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood; target * log(target!) tail via
+    Stirling when compute_full (ref: gluon/loss.py PoissonNLLLoss —
+    which reduces over EVERYTHING, batch included)."""
+
+    _TWO_PI = 6.283185307179586
+
     def __init__(self, weight=None, from_logits=True, batch_axis=0,
                  compute_full=False, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_logits = from_logits
         self._compute_full = compute_full
 
-    def hybrid_forward(self, F, pred, target, sample_weight=None, epsilon=1e-8):
-        target = _reshape_like(F, target, pred)
+    def hybrid_forward(self, F, pred, target, sample_weight=None,
+                       epsilon=1e-8):
+        target = target.reshape(pred.shape)
         if self._from_logits:
-            loss = F.exp(pred) - target * pred
+            term = F.exp(pred) - target * pred
         else:
-            loss = pred - target * F.log(pred + epsilon)
+            term = pred - target * F.log(pred + epsilon)
         if self._compute_full:
             stirling = (target * F.log(target + epsilon) - target
-                        + 0.5 * F.log(2 * 3.1415926535 * (target + epsilon)))
-            stirling = F.where(target <= 1, F.zeros_like(target), stirling)
-            loss = loss + stirling
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss)
+                        + 0.5 * F.log(self._TWO_PI * (target + epsilon)))
+            term = term + F.where(target <= 1, F.zeros_like(target),
+                                  stirling)
+        return F.mean(_weighted(F, term, self._weight, sample_weight))
 
 
 class CosineEmbeddingLoss(Loss):
+    """1 - cos(a, b) for positive pairs, relu(cos - margin) for
+    negative ones; returns one value per pair, unreduced like the
+    reference."""
+
     def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
-        input1 = input1.reshape((input1.shape[0], -1))
-        input2 = input2.reshape((input2.shape[0], -1))
-        cos = F.sum(input1 * input2, axis=1) / (
-            F.norm(input1, axis=1) * F.norm(input2, axis=1) + 1e-12)
-        label = label.reshape((-1,))
-        loss = F.where(label == 1, 1 - cos, F.relu(cos - self._margin))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return loss
+        a = input1.reshape((input1.shape[0], -1))
+        b = input2.reshape((input2.shape[0], -1))
+        cos = F.sum(a * b, axis=1) / (
+            F.norm(a, axis=1) * F.norm(b, axis=1) + _EPS)
+        term = F.where(label.reshape((-1,)) == 1, 1 - cos,
+                       F.relu(cos - self._margin))
+        return _weighted(F, term, self._weight, sample_weight)

@@ -1,12 +1,25 @@
-"""Evaluation metrics.
+"""Evaluation metrics, accumulated on-device.
 
-ref: python/mxnet/metric.py (EvalMetric :68, CompositeEvalMetric :309,
-Accuracy :393, TopKAccuracy :462, F1 :620, MCC :721, Perplexity :833,
-MAE :920, MSE :969, RMSE :1018, CrossEntropy :1067, NegativeLogLikelihood
-:1126, PearsonCorrelation :1187, Loss :1230, Torch/Caffe :1262,
-CustomMetric :1282, np :1351). Metric math runs on-host with numpy —
-metrics aggregate scalars across batches; pulling a device array once per
-batch is the intended sync point (same as the reference's .asnumpy() calls).
+Own-idiom rebuild of the reference metric zoo (ref: python/mxnet/metric.py
+— EvalMetric :68, CompositeEvalMetric :309, Accuracy :393, TopKAccuracy
+:462, F1 :620, MCC :721, Perplexity :833, MAE :920, MSE :969, RMSE :1018,
+CrossEntropy :1067, NegativeLogLikelihood :1126, PearsonCorrelation
+:1187, Loss :1230, Torch/Caffe :1262, CustomMetric :1282, np :1351).
+
+The reference pulls every batch to the host (an `.asnumpy()` per metric
+per batch) and reduces with numpy. Here a metric's per-batch statistic
+is a small jitted reduction that runs wherever the predictions already
+live, and the running (numerator, denominator) pair stays a lazy device
+scalar: `update()` enqueues async device work and returns immediately;
+the only device->host sync a metric ever forces is the `float()` inside
+`get()`. A fit loop logging through a Speedometer at frequent=50 hence
+syncs once per 50 batches instead of once per batch (measured:
+benchmark/metric_sync.py).
+
+Exceptions by contract: CustomMetric / metric.np wrap a user-supplied
+numpy feval, so their inputs are materialized every batch; F1/MCC
+validate the labels-are-binary precondition lazily at read time (an
+eager check would be a per-batch sync).
 """
 from __future__ import annotations
 
@@ -22,677 +35,594 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
            "Caffe", "CustomMetric", "np", "create", "register", "get"]
 
-_METRIC_REGISTRY = {}
+_REGISTRY = {}
 
 
 def register(klass):
-    name = klass.__name__.lower()
-    _METRIC_REGISTRY[name] = klass
+    """Register a metric class under its lowercased class name."""
+    _REGISTRY[klass.__name__.lower()] = klass
     return klass
 
 
-def alias(*aliases):
-    def reg(klass):
-        for a in aliases:
-            _METRIC_REGISTRY[a.lower()] = klass
+def alias(*names):
+    def _add(klass):
+        _REGISTRY.update({n.lower(): klass for n in names})
         return klass
-    return reg
+    return _add
 
 
 def get(name):
-    return _METRIC_REGISTRY[name.lower()]
+    return _REGISTRY[name.lower()]
 
 
 def create(metric, *args, **kwargs):
-    """Create a metric from name / callable / list (ref: metric.py:50)."""
+    """Metric from a name, callable, EvalMetric, or list thereof
+    (ref: metric.py:50)."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
-    if isinstance(metric, CompositeEvalMetric):
-        return metric
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite_metric = CompositeEvalMetric()
-        for child_metric in metric:
-            composite_metric.add(create(child_metric, *args, **kwargs))
-        return composite_metric
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, *args, **kwargs))
+        return out
     if isinstance(metric, string_types):
         return get(metric)(*args, **kwargs)
-    raise TypeError("metric should be a str, callable, EvalMetric, or list")
+    raise TypeError(
+        "cannot create a metric from %r (want str, callable, EvalMetric, "
+        "or a list of those)" % (metric,))
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    """ref: metric.py:37."""
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    """Parity helper (ref: metric.py:37): compare list lengths (or full
+    shapes with shape=True), optionally wrapping bare arrays in lists."""
+    got = labels.shape if shape else len(labels)
+    want = preds.shape if shape else len(preds)
+    if got != want:
         raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+                         "predictions {}".format(got, want))
     if wrap:
-        if isinstance(labels, ndarray.NDArray):
-            labels = [labels]
-        if isinstance(preds, ndarray.NDArray):
-            preds = [preds]
+        labels = [labels] if isinstance(labels, ndarray.NDArray) else labels
+        preds = [preds] if isinstance(preds, ndarray.NDArray) else preds
     return labels, preds
 
 
-class EvalMetric:
-    """Base metric (ref: metric.py:68)."""
+def _jax_of(x):
+    """The jnp array behind an update() argument, wherever it lives —
+    no copy, no host transfer."""
+    import jax.numpy as jnp
+    return x._data if isinstance(x, ndarray.NDArray) else jnp.asarray(x)
 
-    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+
+class _Running:
+    """A lazy (numerator, denominator) pair. Either side may be a host
+    number or an un-materialized device scalar; `value()` holds the one
+    float() sync a metric performs.
+
+    Seeds are Python ints so integer batch statistics (hit counts,
+    element counts) chain as exact device int32 sums — float32 would
+    stop counting past 2^24 (~16.7M); int32 is exact to 2.1e9 samples
+    between resets, which bounds the contract explicitly."""
+
+    __slots__ = ("num", "den")
+
+    def __init__(self):
+        self.clear()
+
+    def clear(self):
+        self.num = 0
+        self.den = 0
+
+    def add(self, num, den):
+        self.num = self.num + num
+        self.den = self.den + den
+
+    def value(self):
+        den = float(self.den)
+        return float(self.num) / den if den else float("nan")
+
+
+class EvalMetric:
+    """Protocol-compatible base (ref: metric.py:68): update / reset /
+    reset_local / get / get_global / get_name_value / update_dict.
+
+    Local and global windows are `_Running` pairs; `_bump` feeds both.
+    The reference's sum_metric / num_inst counters survive as
+    properties, reading (and syncing) the local pair on access.
+    """
+
+    def __init__(self, name, output_names=None, label_names=None,
+                 **kwargs):
         self.name = str(name)
         self.output_names = output_names
         self.label_names = label_names
         self._has_global_stats = kwargs.pop("has_global_stats", False)
         self._kwargs = kwargs
+        self._local = _Running()
+        self._global = _Running()
         self.reset()
 
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
+    # -- reference-compat counter views (each access syncs) ------------
+    @property
+    def sum_metric(self):
+        return self._local.num if isinstance(self._local.num, float) \
+            else float(self._local.num)
+
+    @sum_metric.setter
+    def sum_metric(self, v):
+        self._local.num = v
+
+    @property
+    def num_inst(self):
+        return self._local.den if isinstance(self._local.den, float) \
+            else float(self._local.den)
+
+    @num_inst.setter
+    def num_inst(self, v):
+        self._local.den = v
+
+    @property
+    def global_sum_metric(self):
+        return float(self._global.num)
+
+    @property
+    def global_num_inst(self):
+        return float(self._global.den)
+
+    # ------------------------------------------------------------------
+    def _bump(self, num, den):
+        """Fold one batch's (numerator, denominator) into the local and
+        global windows — lazily if they are device scalars."""
+        self._local.add(num, den)
+        self._global.add(num, den)
+
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            "metric": self.__class__.__name__,
-            "name": self.name,
-            "output_names": self.output_names,
-            "label_names": self.label_names})
+        config = dict(self._kwargs)
+        config.update(metric=type(self).__name__, name=self.name,
+                      output_names=self.output_names,
+                      label_names=self.label_names)
         return config
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names if name in pred]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names
-                     if name in label]
-        else:
-            label = list(label.values())
+        pred = [pred[k] for k in self.output_names if k in pred] \
+            if self.output_names is not None else list(pred.values())
+        label = [label[k] for k in self.label_names if k in label] \
+            if self.label_names is not None else list(label.values())
         self.update(label, pred)
 
     def update(self, labels, preds):
         raise NotImplementedError()
 
     def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
-        self.global_num_inst = 0
-        self.global_sum_metric = 0.0
+        self._local.clear()
+        self._global.clear()
 
     def reset_local(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
+        self._local.clear()
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, self._local.value())
 
     def get_global(self):
         if self._has_global_stats:
-            if self.global_num_inst == 0:
-                return (self.name, float("nan"))
-            return (self.name, self.global_sum_metric / self.global_num_inst)
+            return (self.name, self._global.value())
         return self.get()
 
+    @staticmethod
+    def _as_pairs(name, value):
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
+
     def get_name_value(self):
-        name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        return self._as_pairs(*self.get())
 
     def get_global_name_value(self):
         if self._has_global_stats:
-            name, value = self.get_global()
-            if not isinstance(name, list):
-                name = [name]
-            if not isinstance(value, list):
-                value = [value]
-            return list(zip(name, value))
+            return self._as_pairs(*self.get_global())
         return self.get_name_value()
 
 
-@register
-@alias("composite")
-class CompositeEvalMetric(EvalMetric):
-    """ref: metric.py:309."""
+class _DeviceMetric(EvalMetric):
+    """Base for device-accumulating metrics: subclasses implement
+    `_stats(label, pred) -> (numerator, denominator)` in jnp; it is
+    jitted per (shape, dtype) and executed where the batch lives, and
+    the returned scalars are folded into the running pairs without
+    materialization."""
 
-    def __init__(self, metrics=None, name="composite", output_names=None,
-                 label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        import jax
+        self._reduce = jax.jit(self._stats)
 
-    def add(self, metric):
-        self.metrics.append(create(metric))
-
-    def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}"
-                              .format(index, len(self.metrics)))
-
-    def update_dict(self, labels, preds):
-        if self.label_names is not None:
-            labels = {name: label for name, label in labels.items()
-                      if name in self.label_names}
-        if self.output_names is not None:
-            preds = {name: pred for name, pred in preds.items()
-                     if name in self.output_names}
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+    def _stats(self, label, pred):
+        raise NotImplementedError
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
-
-    def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
-
-    def reset_local(self):
-        try:
-            for metric in self.metrics:
-                metric.reset_local()
-        except AttributeError:
-            pass
-
-    def get(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if isinstance(name, string_types):
-                name = [name]
-            if isinstance(value, (float, int, numpy.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
-
-    def get_global(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get_global()
-            if isinstance(name, string_types):
-                name = [name]
-            if isinstance(value, (float, int, numpy.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
-
-    def get_config(self):
-        config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
-        return config
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self._bump(*self._reduce(_jax_of(label), _jax_of(pred)))
 
 
 @register
 @alias("acc")
-class Accuracy(EvalMetric):
-    """ref: metric.py:393."""
+class Accuracy(_DeviceMetric):
+    """Fraction of argmax predictions matching the label
+    (ref: metric.py:393)."""
 
     def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
+        self.axis = axis
         super().__init__(name, axis=axis, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
-        self.axis = axis
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            pred_np = pred_label.asnumpy() \
-                if isinstance(pred_label, ndarray.NDArray) \
-                else numpy.asarray(pred_label)
-            label_np = label.asnumpy() \
-                if isinstance(label, ndarray.NDArray) else numpy.asarray(label)
-            if pred_np.shape != label_np.shape:
-                pred_np = numpy.argmax(pred_np, axis=self.axis)
-            pred_np = pred_np.astype("int32")
-            label_np = label_np.astype("int32")
-            label_np = label_np.flat
-            pred_np = pred_np.flat
-            check_label_shapes(label_np, pred_np)
-            num_correct = (pred_np == label_np).sum()
-            self.sum_metric += num_correct
-            self.global_sum_metric += num_correct
-            self.num_inst += len(pred_np)
-            self.global_num_inst += len(pred_np)
+    def _stats(self, label, pred):
+        import jax.numpy as jnp
+        if pred.shape != label.shape:  # class scores -> class index
+            pred = jnp.argmax(pred, axis=self.axis)
+        hits = jnp.sum(pred.ravel().astype(jnp.int32)
+                       == label.ravel().astype(jnp.int32))
+        return hits, label.size
 
 
 @register
 @alias("top_k_accuracy", "top_k_acc")
-class TopKAccuracy(EvalMetric):
-    """ref: metric.py:462."""
+class TopKAccuracy(_DeviceMetric):
+    """Label-in-top-k rate over 2-D score matrices
+    (ref: metric.py:462 — which walks the k argsort columns; lax.top_k
+    counts the same membership in one fused kernel)."""
 
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
-        super().__init__(name, top_k=top_k, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
+        if top_k <= 1:
+            raise ValueError("use Accuracy for top_k <= 1")
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
+        super().__init__("%s_%d" % (name, top_k), top_k=top_k,
+                         output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def _stats(self, label, pred):
+        import jax
+        import jax.numpy as jnp
+        if pred.ndim > 2:
+            raise ValueError("predictions must be 1-D or 2-D, got %d-D"
+                             % pred.ndim)
+        if pred.ndim == 1:
+            hits = jnp.sum(pred.astype(jnp.int32)
+                           == label.astype(jnp.int32))
+        else:
+            k = min(self.top_k, pred.shape[1])
+            _, top = jax.lax.top_k(pred.astype(jnp.float32), k)
+            hits = jnp.sum(top == label.astype(top.dtype)[:, None])
+        return hits, pred.shape[0]
+
+
+class _ConfusionCounts:
+    """Lazy device confusion matrix for the binary F-family
+    (ref helper: metric.py:547 _BinaryClassificationMetrics). Each
+    update adds four un-materialized scalars; `snapshot()` returns the
+    lazy (tp, fp, fn, tn) tuple, and reads happen only inside the
+    owning metric's get()."""
+
+    def __init__(self):
+        import jax
+        self._tally = jax.jit(self._batch_tally)
+        self.reset_stats()
+
+    @staticmethod
+    def _batch_tally(label, pred):
+        import jax.numpy as jnp
+        yes = jnp.argmax(pred, axis=1) == 1
+        truth = label.ravel().astype(jnp.int32) == 1
+        tp = jnp.sum(yes & truth)
+        fp = jnp.sum(yes & ~truth)
+        fn = jnp.sum(~yes & truth)
+        tn = jnp.sum(~yes & ~truth)
+        # labels outside {0, 1} make the four cells no longer partition
+        # the batch; carried along for the lazy binary check
+        bad = jnp.sum(label.ravel().astype(jnp.int32) > 1)
+        return tp, fp, fn, tn, bad
+
+    def update_binary_stats(self, label, pred):
+        tp, fp, fn, tn, bad = self._tally(_jax_of(label), _jax_of(pred))
+        self.true_positives = self.true_positives + tp
+        self.false_positives = self.false_positives + fp
+        self.false_negatives = self.false_negatives + fn
+        self.true_negatives = self.true_negatives + tn
+        self._bad = self._bad + bad
+
+    def snapshot(self):
+        return (self.true_positives, self.false_positives,
+                self.false_negatives, self.true_negatives, self._bad)
+
+    def reset_stats(self):
+        self.true_positives = 0
+        self.false_positives = 0
+        self.false_negatives = 0
+        self.true_negatives = 0
+        self._bad = 0
+
+
+def _fscore(tp, fp, fn, tn, bad):
+    if bad:
+        raise ValueError("F1 supports binary labels only; saw a label "
+                         "> 1 (checked lazily at read time)")
+    precision = tp / (tp + fp) if tp + fp else 0.0
+    recall = tp / (tp + fn) if tp + fn else 0.0
+    if precision + recall == 0.0:
+        return 0.0
+    return 2 * precision * recall / (precision + recall)
+
+
+def _matthews(tp, fp, fn, tn, bad):
+    if bad:
+        raise ValueError("MCC supports binary labels only; saw a label "
+                         "> 1 (checked lazily at read time)")
+    if not (tp + fp + fn + tn):
+        return 0.0
+    denom = 1.0
+    for t in (tp + fp, tp + fn, tn + fp, tn + fn):
+        denom *= t or 1.0
+    return (tp * tn - fp * fn) / math.sqrt(denom)
+
+
+class _FFamily(EvalMetric):
+    """Shared frame of F1 and MCC: a device confusion matrix, read
+    through a score function at get(). average="macro" keeps one lazy
+    snapshot PER BATCH and averages their scores at read time — same
+    semantics as the reference's per-update score-and-reset, but with
+    zero per-batch syncs; "micro" pools the counts."""
+
+    _score = None  # staticmethod(_fscore | _matthews)
+
+    def __init__(self, name, output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self._counts = _ConfusionCounts()
+        self._snapshots = []
+        super().__init__(name=name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, \
-                "Predictions should be no more than 2 dims"
-            pred_np = numpy.argsort(
-                pred_label.asnumpy().astype("float32"), axis=1)
-            label_np = label.asnumpy().astype("int32")
-            num_samples = pred_np.shape[0]
-            num_dims = len(pred_np.shape)
-            if num_dims == 1:
-                num_correct = (pred_np.flat == label_np.flat).sum()
-                self.sum_metric += num_correct
-                self.global_sum_metric += num_correct
-            elif num_dims == 2:
-                num_classes = pred_np.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    num_correct = (
-                        pred_np[:, num_classes - 1 - j].flat ==
-                        label_np.flat).sum()
-                    self.sum_metric += num_correct
-                    self.global_sum_metric += num_correct
-            self.num_inst += num_samples
-            self.global_num_inst += num_samples
+        for label, pred in zip(labels, preds):
+            if label.shape[0] != pred.shape[0]:
+                raise ValueError("label rows %d != pred rows %d"
+                                 % (label.shape[0], pred.shape[0]))
+            self._counts.update_binary_stats(label, pred)
+        if self.average == "macro":
+            self._snapshots.append(self._counts.snapshot())
+            self._counts.reset_stats()
 
+    def get(self):
+        import jax
+        score = type(self)._score
+        if self.average == "macro":
+            if not self._snapshots:
+                return (self.name, float("nan"))
+            # ONE batched transfer for every pending snapshot, then
+            # cache the host tuples so re-reads are free and the device
+            # buffers are released
+            self._snapshots = [
+                tuple(float(c) for c in s)
+                for s in jax.device_get(self._snapshots)]
+            vals = [score(*s) for s in self._snapshots]
+            return (self.name, sum(vals) / len(vals))
+        cells = [float(c)
+                 for c in jax.device_get(self._counts.snapshot())]
+        if not sum(cells[:4]):
+            return (self.name, float("nan"))
+        return (self.name, score(*cells))
 
-class _BinaryClassificationMetrics:
-    """ref: metric.py:547."""
+    get_global = get
 
-    def __init__(self):
-        self.true_positives = 0
-        self.false_negatives = 0
-        self.false_positives = 0
-        self.true_negatives = 0
+    def reset(self):
+        self._snapshots = []
+        self._counts.reset_stats()
+        super().reset()
 
-    def update_binary_stats(self, label, pred):
-        pred_np = pred.asnumpy()
-        label_np = label.asnumpy().astype("int32")
-        pred_label = numpy.argmax(pred_np, axis=1)
-        check_label_shapes(label_np, pred_np)
-        if len(numpy.unique(label_np)) > 2:
-            raise ValueError("%s currently only supports binary "
-                             "classification." % type(self).__name__)
-        pred_true = (pred_label == 1)
-        pred_false = 1 - pred_true
-        label_true = (label_np == 1)
-        label_false = 1 - label_true
-        true_pos = (pred_true * label_true).sum()
-        false_pos = (pred_true * label_false).sum()
-        false_neg = (pred_false * label_true).sum()
-        true_neg = (pred_false * label_false).sum()
-        self.true_positives += true_pos
-        self.false_positives += false_pos
-        self.false_negatives += false_neg
-        self.true_negatives += true_neg
-
-    @property
-    def precision(self):
-        if self.true_positives + self.false_positives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_positives)
-        return 0.
-
-    @property
-    def recall(self):
-        if self.true_positives + self.false_negatives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_negatives)
-        return 0.
-
-    @property
-    def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (
-                self.precision + self.recall)
-        return 0.
-
-    @property
-    def matthewscc(self):
-        if not self.total_examples:
-            return 0.
-        true_pos = float(self.true_positives)
-        false_pos = float(self.false_positives)
-        false_neg = float(self.false_negatives)
-        true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos),
-                 (true_pos + false_neg),
-                 (true_neg + false_pos),
-                 (true_neg + false_neg)]
-        denom = 1.
-        for t in filter(lambda t: t != 0., terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) / \
-            math.sqrt(denom)
-
-    @property
-    def total_examples(self):
-        return self.false_negatives + self.false_positives + \
-            self.true_negatives + self.true_positives
-
-    def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
+    reset_local = reset
 
 
 @register
-class F1(EvalMetric):
-    """ref: metric.py:620."""
+class F1(_FFamily):
+    """Binary F1 (ref: metric.py:620)."""
+
+    _score = staticmethod(_fscore)
 
     def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
-        self.average = average
-        self.metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names, has_global_stats=True)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(label, pred)
-        if self.average == "macro":
-            self.sum_metric += self.metrics.fscore
-            self.global_sum_metric += self.metrics.fscore
-            self.num_inst += 1
-            self.global_num_inst += 1
-            self.metrics.reset_stats()
-        else:
-            self.sum_metric = self.metrics.fscore * \
-                self.metrics.total_examples
-            self.global_sum_metric = self.sum_metric
-            self.num_inst = self.metrics.total_examples
-            self.global_num_inst = self.num_inst
-
-    def reset(self):
-        self.sum_metric = 0.
-        self.num_inst = 0
-        self.global_sum_metric = 0.
-        self.global_num_inst = 0
-        self.metrics.reset_stats()
-
-    reset_local = reset
+        super().__init__(name, output_names, label_names, average)
 
 
 @register
-class MCC(EvalMetric):
+class MCC(_FFamily):
     """Matthews correlation coefficient (ref: metric.py:721)."""
+
+    _score = staticmethod(_matthews)
 
     def __init__(self, name="mcc", output_names=None, label_names=None,
                  average="macro"):
-        self._average = average
-        self._metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names, has_global_stats=True)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
-        if self._average == "macro":
-            self.sum_metric += self._metrics.matthewscc
-            self.global_sum_metric += self._metrics.matthewscc
-            self.num_inst += 1
-            self.global_num_inst += 1
-            self._metrics.reset_stats()
-        else:
-            self.sum_metric = self._metrics.matthewscc * \
-                self._metrics.total_examples
-            self.global_sum_metric = self.sum_metric
-            self.num_inst = self._metrics.total_examples
-            self.global_num_inst = self.num_inst
-
-    def reset(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        self.global_sum_metric = 0.
-        self.global_num_inst = 0.
-        self._metrics.reset_stats()
-
-    reset_local = reset
+        super().__init__(name, output_names, label_names, average)
 
 
 @register
-class Perplexity(EvalMetric):
-    """ref: metric.py:833."""
+class Perplexity(_DeviceMetric):
+    """exp of the mean negative log picked-probability, optionally
+    skipping ignore_label positions (ref: metric.py:833)."""
 
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
-        super().__init__(name, ignore_label=ignore_label,
-                         output_names=output_names, label_names=label_names,
-                         has_global_stats=True)
         self.ignore_label = ignore_label
         self.axis = axis
+        super().__init__(name, ignore_label=ignore_label,
+                         output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.
-        num = 0
-        for label, pred in zip(labels, preds):
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
-            assert label_np.size == pred_np.size / pred_np.shape[-1], \
-                "shape mismatch"
-            label_np = label_np.reshape((label_np.size,)).astype("int32")
-            probs = numpy.take_along_axis(
-                pred_np.reshape(-1, pred_np.shape[-1]),
-                label_np[:, None], axis=1)[:, 0]
-            if self.ignore_label is not None:
-                ignore = (label_np == self.ignore_label).astype(probs.dtype)
-                num -= int(ignore.sum())
-                probs = probs * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += probs.size
-        self.sum_metric += loss
-        self.global_sum_metric += loss
-        self.num_inst += num
-        self.global_num_inst += num
+    def _stats(self, label, pred):
+        import jax.numpy as jnp
+        classes = pred.shape[-1]
+        assert label.size * classes == pred.size, \
+            "label/pred shape mismatch"
+        idx = label.ravel().astype(jnp.int32)
+        p = jnp.take_along_axis(pred.reshape(-1, classes), idx[:, None],
+                                axis=1)[:, 0]
+        n = idx.size
+        if self.ignore_label is not None:
+            keep = idx != self.ignore_label
+            p = jnp.where(keep, p, 1.0)
+            n = jnp.sum(keep)
+        return -jnp.sum(jnp.log(jnp.maximum(p, 1e-10))), n
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+        v = self._local.value()
+        return (self.name, math.exp(v) if v == v else v)
 
     def get_global(self):
-        if self.global_num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name,
-                math.exp(self.global_sum_metric / self.global_num_inst))
+        v = self._global.value()
+        return (self.name, math.exp(v) if v == v else v)
+
+
+class _PerBatchMean(_DeviceMetric):
+    """Regression-style metrics: one scalar per batch, averaged over
+    batches (den advances by 1 per update, like the reference)."""
+
+    _default_name = None
+
+    def __init__(self, name=None, output_names=None, label_names=None):
+        super().__init__(name or self._default_name,
+                         output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def _stats(self, label, pred):
+        return self._batch_value(label, pred), 1
 
 
 @register
 @alias("mae")
-class MAE(EvalMetric):
-    """ref: metric.py:920."""
+class MAE(_PerBatchMean):
+    """Mean absolute error (ref: metric.py:920)."""
 
-    def __init__(self, name="mae", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
+    _default_name = "mae"
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
-            if len(label_np.shape) == 1:
-                label_np = label_np.reshape(label_np.shape[0], 1)
-            if len(pred_np.shape) == 1:
-                pred_np = pred_np.reshape(pred_np.shape[0], 1)
-            mae = numpy.abs(label_np - pred_np).mean()
-            self.sum_metric += mae
-            self.global_sum_metric += mae
-            self.num_inst += 1
-            self.global_num_inst += 1
+    def _batch_value(self, label, pred):
+        import jax.numpy as jnp
+        return jnp.mean(jnp.abs(label - pred))
 
 
 @register
 @alias("mse")
-class MSE(EvalMetric):
-    """ref: metric.py:969."""
+class MSE(_PerBatchMean):
+    """Mean squared error (ref: metric.py:969)."""
 
-    def __init__(self, name="mse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
+    _default_name = "mse"
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
-            if len(label_np.shape) == 1:
-                label_np = label_np.reshape(label_np.shape[0], 1)
-            if len(pred_np.shape) == 1:
-                pred_np = pred_np.reshape(pred_np.shape[0], 1)
-            mse = ((label_np - pred_np) ** 2.0).mean()
-            self.sum_metric += mse
-            self.global_sum_metric += mse
-            self.num_inst += 1
-            self.global_num_inst += 1
+    def _batch_value(self, label, pred):
+        import jax.numpy as jnp
+        return jnp.mean(jnp.square(label - pred))
 
 
 @register
 @alias("rmse")
-class RMSE(EvalMetric):
-    """ref: metric.py:1018."""
+class RMSE(_PerBatchMean):
+    """Root mean squared error, per batch (ref: metric.py:1018 — note
+    the reference averages per-batch roots, not the root of the pooled
+    mean; kept)."""
 
-    def __init__(self, name="rmse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
+    _default_name = "rmse"
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
-            if len(label_np.shape) == 1:
-                label_np = label_np.reshape(label_np.shape[0], 1)
-            if len(pred_np.shape) == 1:
-                pred_np = pred_np.reshape(pred_np.shape[0], 1)
-            rmse = numpy.sqrt(((label_np - pred_np) ** 2.0).mean())
-            self.sum_metric += rmse
-            self.global_sum_metric += rmse
-            self.num_inst += 1
-            self.global_num_inst += 1
-
-
-@register
-@alias("ce")
-class CrossEntropy(EvalMetric):
-    """ref: metric.py:1067."""
-
-    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
-                 label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
-            label_np = label_np.ravel()
-            assert label_np.shape[0] == pred_np.shape[0]
-            prob = pred_np[numpy.arange(label_np.shape[0]),
-                           numpy.int64(label_np)]
-            cross_entropy = (-numpy.log(prob + self.eps)).sum()
-            self.sum_metric += cross_entropy
-            self.global_sum_metric += cross_entropy
-            self.num_inst += label_np.shape[0]
-            self.global_num_inst += label_np.shape[0]
-
-
-@register
-@alias("nll_loss")
-class NegativeLogLikelihood(EvalMetric):
-    """ref: metric.py:1126."""
-
-    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
-                 label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
-            label_np = label_np.ravel()
-            num_examples = pred_np.shape[0]
-            assert label_np.shape[0] == num_examples, \
-                (label_np.shape[0], num_examples)
-            prob = pred_np[numpy.arange(num_examples, dtype=numpy.int64),
-                           numpy.int64(label_np)]
-            nll = (-numpy.log(prob + self.eps)).sum()
-            self.sum_metric += nll
-            self.global_sum_metric += nll
-            self.num_inst += num_examples
-            self.global_num_inst += num_examples
+    def _batch_value(self, label, pred):
+        import jax.numpy as jnp
+        return jnp.sqrt(jnp.mean(jnp.square(label - pred)))
 
 
 @register
 @alias("pearsonr")
-class PearsonCorrelation(EvalMetric):
-    """ref: metric.py:1187."""
+class PearsonCorrelation(_PerBatchMean):
+    """Per-batch Pearson r (ref: metric.py:1187), as centered
+    cross-moments on the device instead of host corrcoef."""
 
-    def __init__(self, name="pearsonr", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names, has_global_stats=True)
+    _default_name = "pearsonr"
+
+    def _batch_value(self, label, pred):
+        import jax.numpy as jnp
+        x = pred.ravel().astype(jnp.float32)
+        y = label.ravel().astype(jnp.float32)
+        xc = x - jnp.mean(x)
+        yc = y - jnp.mean(y)
+        return jnp.sum(xc * yc) / jnp.sqrt(
+            jnp.sum(jnp.square(xc)) * jnp.sum(jnp.square(yc)))
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
             check_label_shapes(label, pred, False, True)
-            label_np = label.asnumpy().ravel().astype(numpy.float64)
-            pred_np = pred.asnumpy().ravel().astype(numpy.float64)
-            pearson_corr = numpy.corrcoef(pred_np, label_np)[0, 1]
-            self.sum_metric += pearson_corr
-            self.global_sum_metric += pearson_corr
-            self.num_inst += 1
-            self.global_num_inst += 1
+            self._bump(*self._reduce(_jax_of(label), _jax_of(pred)))
+
+
+class _PickedLogProb(_DeviceMetric):
+    """-sum(log p[label]) over a [N, C] probability matrix, averaged
+    over the N rows — the shape CrossEntropy and NLL share."""
+
+    def __init__(self, eps=1e-12, name=None, output_names=None,
+                 label_names=None):
+        self.eps = eps
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def _stats(self, label, pred):
+        import jax.numpy as jnp
+        idx = label.ravel().astype(jnp.int32)
+        assert idx.size == pred.shape[0], (idx.size, pred.shape)
+        p = jnp.take_along_axis(pred, idx[:, None], axis=1)[:, 0]
+        return -jnp.sum(jnp.log(p + self.eps)), idx.size
+
+
+@register
+@alias("ce")
+class CrossEntropy(_PickedLogProb):
+    """ref: metric.py:1067."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy",
+                 output_names=None, label_names=None):
+        super().__init__(eps, name, output_names, label_names)
+
+
+@register
+@alias("nll_loss")
+class NegativeLogLikelihood(_PickedLogProb):
+    """ref: metric.py:1126."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
 class Loss(EvalMetric):
-    """Dummy metric for directly printing loss (ref: metric.py:1230)."""
+    """Running mean of whatever the outputs are — the print-the-loss
+    metric (ref: metric.py:1230)."""
 
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
 
     def update(self, _, preds):
+        import jax.numpy as jnp
         if isinstance(preds, ndarray.NDArray):
             preds = [preds]
         for pred in preds:
-            loss = float(pred.asnumpy().sum())
-            self.sum_metric += loss
-            self.global_sum_metric += loss
-            self.num_inst += pred.size
-            self.global_num_inst += pred.size
+            arr = _jax_of(pred)
+            self._bump(jnp.sum(arr), arr.size)
 
 
 @register
 class Torch(Loss):
-    """Dummy metric for torch criterions (ref: metric.py:1262)."""
+    """Alias frame for torch criterions (ref: metric.py:1262)."""
 
     def __init__(self, name="torch", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
@@ -708,18 +638,20 @@ class Caffe(Loss):
 
 @register
 class CustomMetric(EvalMetric):
-    """ref: metric.py:1282."""
+    """User-supplied numpy feval (ref: metric.py:1282). By contract the
+    feval sees numpy arrays, so this is the one metric that materializes
+    its inputs every update."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:  # lambdas etc.
                 name = "custom(%s)" % name
         super().__init__(name, feval=feval,
                          allow_extra_outputs=allow_extra_outputs,
-                         output_names=output_names, label_names=label_names,
-                         has_global_stats=True)
+                         output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 
@@ -727,28 +659,82 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             labels, preds = check_label_shapes(labels, preds, True)
         for pred, label in zip(preds, labels):
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
-            reval = self._feval(label_np, pred_np)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.global_sum_metric += sum_metric
-                self.num_inst += num_inst
-                self.global_num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.global_sum_metric += reval
-                self.num_inst += 1
-                self.global_num_inst += 1
+            out = self._feval(label.asnumpy(), pred.asnumpy())
+            self._bump(*(out if isinstance(out, tuple) else (out, 1)))
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy feval as a metric (ref: metric.py:1351)."""
+    """Wrap a bare numpy feval(label, pred) as a metric
+    (ref: metric.py:1351)."""
     def feval(label, pred):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+@alias("composite")
+class CompositeEvalMetric(EvalMetric):
+    """Fans update/reset/get out over child metrics (ref: metric.py:309)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.metrics = [create(m) for m in metrics or []]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            # the reference RETURNS this exception (metric.py:344) — an
+            # upstream wart, fixed here by actually raising
+            raise ValueError("Metric index {} is out of range 0 and {}"
+                             .format(index, len(self.metrics)))
+
+    def update_dict(self, labels, preds):
+        if self.label_names is not None:
+            labels = {k: v for k, v in labels.items()
+                      if k in self.label_names}
+        if self.output_names is not None:
+            preds = {k: v for k, v in preds.items()
+                     if k in self.output_names}
+        for m in self.metrics:
+            m.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", ()):
+            m.reset()
+
+    def reset_local(self):
+        for m in getattr(self, "metrics", ()):
+            m.reset_local()
+
+    def _gather(self, one):
+        names, values = [], []
+        for m in self.metrics:
+            name, value = one(m)
+            names += name if isinstance(name, list) else [name]
+            values += value if isinstance(value, list) else [value]
+        return (names, values)
+
+    def get(self):
+        return self._gather(lambda m: m.get())
+
+    def get_global(self):
+        return self._gather(lambda m: m.get_global())
+
+    def get_config(self):
+        config = super().get_config()
+        config.update(metrics=[m.get_config() for m in self.metrics])
+        return config

@@ -28,6 +28,7 @@ from .ndarray import NDArray
 # importing applies the MXTPU_MATMUL_PRECISION env policy (VERDICT r4 #3)
 from .precision import (set_matmul_precision, get_matmul_precision,
                         matmul_precision)
+from .attribute import AttrScope  # ref: mx.AttrScope (ctx_group scoping)
 
 # re-export seed at top level like the reference (mx.random.seed exists too)
 

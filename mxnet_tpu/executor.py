@@ -56,14 +56,30 @@ def _tuplify(v):
 
 
 class _GraphProgram:
-    """Evaluates a Symbol graph on jax values (the trace body)."""
+    """Evaluates a Symbol graph on jax values (the trace body).
 
-    def __init__(self, symbol):
+    `placement` — a ({ctx_group_name: jax.Device}, default_device) pair —
+    turns on group2ctx model parallelism (ref: ctx_map in
+    src/executor/graph_executor.cc:388): every node's inputs are
+    device_put onto its group's device, so the op executes there and
+    cross-group edges become explicit transfers (the reference inserts
+    the same copies via src/operator/cross_device_copy.cc). Placement
+    implies eager per-node execution — per-node device pinning cannot
+    live inside one fused XLA program; the real TP/PP story is
+    mxnet_tpu/parallel (docs/MIGRATION.md).
+    """
+
+    def __init__(self, symbol, placement=None):
         self.symbol = symbol
         self.nodes = symbol._topo()
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.heads = list(symbol._outputs)
+        self.placement = placement
+
+    def _device_of(self, node):
+        devmap, default = self.placement
+        return devmap.get(node.attrs.get("ctx_group"), default)
 
     def run(self, values, is_train, key):
         """values: {var_name: jax array}. Returns (outputs, aux_updates)."""
@@ -73,11 +89,17 @@ class _GraphProgram:
             if node.is_variable():
                 if node.name not in values:
                     raise MXNetError("unbound variable %r" % node.name)
-                vals[(id(node), 0)] = values[node.name]
+                val = values[node.name]
+                if self.placement:
+                    val = jax.device_put(val, self._device_of(node))
+                vals[(id(node), 0)] = val
                 continue
             if node.op in _CONTROL_FLOW_OPS:
                 from .symbol.control_flow import lower as _cf_lower
                 ins = [vals[(id(src), oi)] for src, oi in node.inputs]
+                if self.placement:
+                    dev = self._device_of(node)
+                    ins = [jax.device_put(v, dev) for v in ins]
                 outs, cf_aux = _cf_lower(node, ins, is_train,
                                          jax.random.fold_in(key, idx))
                 for i, o in enumerate(outs):
@@ -101,6 +123,12 @@ class _GraphProgram:
             if "_training" in pnames:
                 attrs["_training"] = is_train
             ins = [vals[(id(src), oi)] for src, oi in node.inputs]
+            if self.placement:
+                # computation follows data: moving the inputs IS the
+                # cross-device copy; ops whose inputs are already local
+                # get a no-op
+                dev = self._device_of(node)
+                ins = [jax.device_put(v, dev) for v in ins]
             input_names = node.attrs.get("__input_names__")
             if input_names:
                 kw = dict(zip(input_names, ins))
@@ -152,10 +180,16 @@ class Executor:
     """Bound graph with allocated arguments/gradients/aux states."""
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None):
+                 grad_req="write", aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
-        self._prog = _GraphProgram(symbol)
+        self._group2ctx = dict(group2ctx) if group2ctx else None
+        placement = None
+        if self._group2ctx:
+            placement = ({g: c.jax_device()
+                          for g, c in self._group2ctx.items()},
+                         self._ctx.jax_device())
+        self._prog = _GraphProgram(symbol, placement=placement)
         arg_names = self._prog.arg_names
         aux_names = self._prog.aux_names
 
@@ -176,8 +210,16 @@ class Executor:
         self._monitor = None
         self._seed = 0
 
-        self._fwd = jax.jit(self._raw_forward, static_argnums=(0,))
-        self._fwd_bwd = jax.jit(self._raw_forward_backward)
+        if placement is None:
+            self._fwd = jax.jit(self._raw_forward, static_argnums=(0,))
+            self._fwd_bwd = jax.jit(self._raw_forward_backward)
+        else:
+            # group2ctx pins individual nodes to devices — incompatible
+            # with one fused XLA program, so the graph interpreter runs
+            # eagerly with computation-follows-data placement (see
+            # _GraphProgram docstring)
+            self._fwd = self._raw_forward
+            self._fwd_bwd = self._raw_forward_backward
 
     # -- binding helpers ----------------------------------------------------
     @staticmethod
@@ -218,7 +260,7 @@ class Executor:
 
     @classmethod
     def simple_bind(cls, symbol, ctx=None, grad_req="write", type_dict=None,
-                    **kwargs):
+                    group2ctx=None, **kwargs):
         """Allocate all arguments/grads/aux from inferred shapes
         (ref: graph_executor.cc:780 SimpleBind)."""
         arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
@@ -239,7 +281,7 @@ class Executor:
                  if req.get(n, "null") != "null"
                  and _np.issubdtype(args[n].dtype, _np.inexact)}
         return cls(symbol, ctx, args=args, args_grad=grads, grad_req=req,
-                   aux_states=aux)
+                   aux_states=aux, group2ctx=group2ctx)
 
     # -- compiled bodies ----------------------------------------------------
     def _values(self, arg_vals, aux_vals):
@@ -423,7 +465,8 @@ class Executor:
         grads = {n: NDArray(jnp.zeros_like(args[n]._data))
                  for n in self.grad_dict}
         return Executor(self._symbol, self._ctx, args=args, args_grad=grads,
-                        grad_req=self._grad_req, aux_states=aux)
+                        grad_req=self._grad_req, aux_states=aux,
+                        group2ctx=self._group2ctx)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor = callback

@@ -326,14 +326,19 @@ class Symbol:
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
+        """group2ctx maps AttrScope ctx_group names to Contexts for
+        model parallelism (ref: graph_executor.cc:388 ctx_map); see
+        executor._GraphProgram for the placement semantics."""
         from ..executor import Executor
         return Executor(self, ctx, args=args, args_grad=args_grad,
-                        grad_req=grad_req, aux_states=aux_states)
+                        grad_req=grad_req, aux_states=aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    **kwargs):
+                    group2ctx=None, **kwargs):
         from ..executor import Executor
-        return Executor.simple_bind(self, ctx, grad_req=grad_req, **kwargs)
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    group2ctx=group2ctx, **kwargs)
 
     # convenience used by module/model code
     def debug_str(self):

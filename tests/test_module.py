@@ -362,3 +362,76 @@ def test_init_optimizer_rescales_by_batch_size():
                         optimizer_params={"learning_rate": 0.1,
                                           "rescale_grad": 1.0})
     assert mod2._optimizer.rescale_grad == 1.0
+
+
+def test_group2ctx_model_parallel():
+    """group2ctx model parallelism (VERDICT r4 item 7; ref shape:
+    example/model-parallel/matrix_factorization/model.py): embedding
+    lookups pinned to ctx group dev1, the MLP + inner-product + loss to
+    dev2, bound over two devices of the virtual CPU mesh. Cross-group
+    edges become device transfers (executor._GraphProgram placement);
+    numerics must match a plain single-device bind."""
+    import jax
+
+    B, F_, H, MAXU, MAXI = 8, 4, 3, 20, 30
+    with mx.AttrScope(ctx_group="dev1"):
+        user = mx.sym.Embedding(data=mx.sym.Variable("user"),
+                                weight=mx.sym.Variable("user_weight"),
+                                input_dim=MAXU, output_dim=F_)
+        item = mx.sym.Embedding(data=mx.sym.Variable("item"),
+                                weight=mx.sym.Variable("item_weight"),
+                                input_dim=MAXI, output_dim=F_)
+    with mx.AttrScope(ctx_group="dev2"):
+        user = mx.sym.Activation(data=user, act_type="relu")
+        user = mx.sym.FullyConnected(
+            data=user, weight=mx.sym.Variable("fc_user_weight"),
+            bias=mx.sym.Variable("fc_user_bias"), num_hidden=H)
+        item = mx.sym.Activation(data=item, act_type="relu")
+        item = mx.sym.FullyConnected(
+            data=item, weight=mx.sym.Variable("fc_item_weight"),
+            bias=mx.sym.Variable("fc_item_bias"), num_hidden=H)
+        pred = mx.sym.Flatten(data=mx.sym.sum(user * item, axis=1))
+        pred = mx.sym.LinearRegressionOutput(
+            data=pred, label=mx.sym.Variable("score"))
+
+    rs = np.random.RandomState(0)
+    args = {
+        "user": mx.nd.array(rs.randint(0, MAXU, (B,)).astype("float32")),
+        "item": mx.nd.array(rs.randint(0, MAXI, (B,)).astype("float32")),
+        "user_weight": mx.nd.array(rs.rand(MAXU, F_).astype("float32")),
+        "item_weight": mx.nd.array(rs.rand(MAXI, F_).astype("float32")),
+        "fc_user_weight": mx.nd.array(rs.rand(H, F_).astype("float32")),
+        "fc_user_bias": mx.nd.zeros((H,)),
+        "fc_item_weight": mx.nd.array(rs.rand(H, F_).astype("float32")),
+        "fc_item_bias": mx.nd.zeros((H,)),
+        "score": mx.nd.array(rs.rand(B, 1).astype("float32")),
+    }
+    grad_names = ["user_weight", "item_weight", "fc_user_weight",
+                  "fc_item_weight"]
+
+    def make_grads():
+        return {n: mx.nd.zeros(args[n].shape) for n in grad_names}
+
+    g2c = {"dev1": mx.Context("cpu", 1), "dev2": mx.Context("cpu", 2)}
+    req = {n: ("write" if n in grad_names else "null") for n in args}
+    mp_grads = make_grads()
+    exe = pred.bind(mx.cpu(0), args=args, args_grad=mp_grads,
+                    grad_req=req, group2ctx=g2c)
+    out = exe.forward(is_train=True)
+    exe.backward()
+
+    # the head lives in group dev2 -> output committed to cpu device 2
+    cpus = jax.local_devices(backend="cpu")
+    assert list(out[0]._data.devices()) == [cpus[2]]
+
+    # single-device reference bind: same numbers, forward and backward
+    ref_grads = make_grads()
+    ref = pred.bind(mx.cpu(0), args=args, args_grad=ref_grads,
+                    grad_req=req)
+    ref_out = ref.forward(is_train=True)
+    ref.backward()
+    np.testing.assert_allclose(out[0].asnumpy(), ref_out[0].asnumpy(),
+                               rtol=1e-5)
+    for n in grad_names:
+        np.testing.assert_allclose(mp_grads[n].asnumpy(),
+                                   ref_grads[n].asnumpy(), rtol=1e-5)

@@ -1,0 +1,215 @@
+/* XS bindings: Perl <-> the MXT* C ABI (src/c_api_runtime.cc).
+ *
+ * The second generated non-C++ frontend over the C ABI (the first is
+ * cpp-package/), proving the attach seam generalizes — analog of the
+ * reference's perl-package/ (ref: perl-package/AI-MXNetCAPI/mxnet.i,
+ * which SWIG-wraps include/mxnet/c_api.h the same way).
+ *
+ * Handles cross the boundary as IVs (pointer-sized integers); the
+ * Perl-side AI::MXTpu::NDArray class owns lifetime (DESTROY -> free).
+ * Only f32 crosses in this frontend, matching example/capi/.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern const char* MXTGetLastError(void);
+extern int MXTNDArrayFromData(const int64_t* shape, uint32_t ndim,
+                              int dtype, const void* data, size_t nbytes,
+                              void** out);
+extern int MXTNDArrayFree(void* h);
+extern int MXTNDArrayGetShape(void* h, uint32_t* out_ndim,
+                              int64_t* out_shape);
+extern int MXTNDArraySyncCopyToCPU(void* h, void* data, size_t nbytes);
+extern int MXTNDArrayWaitAll(void);
+extern int MXTImperativeInvoke(const char* op, uint32_t nin, void** in,
+                               uint32_t nparam, const char** keys,
+                               const char** vals, uint32_t* nout,
+                               void** out, uint32_t max_out);
+extern int MXTAutogradMarkVariables(uint32_t n, void** h);
+extern int MXTAutogradSetIsRecording(int rec);
+extern int MXTAutogradBackward(uint32_t n, void** out);
+extern int MXTNDArrayGetGrad(void* h, void** grad);
+
+#define MAX_OUTS 8
+#define MAX_DIMS 8
+
+static void croak_abi(pTHX_ const char* where) {
+    croak("AI::MXTpu: %s failed: %s", where, MXTGetLastError());
+}
+
+MODULE = AI::MXTpu    PACKAGE = AI::MXTpu
+
+PROTOTYPES: DISABLE
+
+const char*
+_last_error()
+CODE:
+    RETVAL = MXTGetLastError();
+OUTPUT:
+    RETVAL
+
+IV
+_from_data(shape_ref, data)
+    SV* shape_ref
+    SV* data
+CODE:
+{
+    AV* av = (AV*)SvRV(shape_ref);
+    uint32_t ndim = (uint32_t)(av_len(av) + 1);
+    int64_t shape[MAX_DIMS];
+    uint32_t i;
+    STRLEN nbytes;
+    const char* buf;
+    void* out = NULL;
+    if (ndim > MAX_DIMS)
+        croak("AI::MXTpu: ndim %u exceeds %d", ndim, MAX_DIMS);
+    for (i = 0; i < ndim; ++i)
+        shape[i] = (int64_t)SvIV(*av_fetch(av, i, 0));
+    buf = SvPVbyte(data, nbytes);
+    if (MXTNDArrayFromData(shape, ndim, /*f32*/0, buf, (size_t)nbytes,
+                           &out) != 0)
+        croak_abi(aTHX_ "NDArrayFromData");
+    RETVAL = PTR2IV(out);
+}
+OUTPUT:
+    RETVAL
+
+void
+_free(h)
+    IV h
+CODE:
+    MXTNDArrayFree(INT2PTR(void*, h));
+
+void
+_shape(h)
+    IV h
+PPCODE:
+{
+    uint32_t ndim = 0, i;
+    int64_t shape[MAX_DIMS];
+    if (MXTNDArrayGetShape(INT2PTR(void*, h), &ndim, shape) != 0)
+        croak_abi(aTHX_ "NDArrayGetShape");
+    EXTEND(SP, ndim);
+    for (i = 0; i < ndim; ++i)
+        mPUSHi((IV)shape[i]);
+}
+
+SV*
+_to_bytes(h, nbytes)
+    IV h
+    IV nbytes
+CODE:
+{
+    SV* out = newSV((STRLEN)nbytes + 1);
+    SvPOK_on(out);
+    if (MXTNDArraySyncCopyToCPU(INT2PTR(void*, h), SvPVX(out),
+                                (size_t)nbytes) != 0) {
+        SvREFCNT_dec(out);
+        croak_abi(aTHX_ "NDArraySyncCopyToCPU");
+    }
+    SvCUR_set(out, (STRLEN)nbytes);
+    RETVAL = out;
+}
+OUTPUT:
+    RETVAL
+
+void
+_waitall()
+CODE:
+    if (MXTNDArrayWaitAll() != 0)
+        croak_abi(aTHX_ "NDArrayWaitAll");
+
+void
+_invoke(op, in_ref, keys_ref, vals_ref)
+    const char* op
+    SV* in_ref
+    SV* keys_ref
+    SV* vals_ref
+PPCODE:
+{
+    AV* in_av = (AV*)SvRV(in_ref);
+    AV* k_av = (AV*)SvRV(keys_ref);
+    AV* v_av = (AV*)SvRV(vals_ref);
+    uint32_t nin = (uint32_t)(av_len(in_av) + 1);
+    uint32_t nparam = (uint32_t)(av_len(k_av) + 1);
+    void** ins;
+    const char** keys;
+    const char** vals;
+    void* outs[MAX_OUTS];
+    uint32_t nout = 0, i;
+    int rc;
+    Newx(ins, nin ? nin : 1, void*);
+    Newx(keys, nparam ? nparam : 1, const char*);
+    Newx(vals, nparam ? nparam : 1, const char*);
+    for (i = 0; i < nin; ++i)
+        ins[i] = INT2PTR(void*, SvIV(*av_fetch(in_av, i, 0)));
+    for (i = 0; i < nparam; ++i) {
+        keys[i] = SvPV_nolen(*av_fetch(k_av, i, 0));
+        vals[i] = SvPV_nolen(*av_fetch(v_av, i, 0));
+    }
+    rc = MXTImperativeInvoke(op, nin, ins, nparam, keys, vals, &nout,
+                             outs, MAX_OUTS);
+    Safefree(ins);
+    Safefree(keys);
+    Safefree(vals);
+    if (rc != 0)
+        croak_abi(aTHX_ op);
+    EXTEND(SP, nout);
+    for (i = 0; i < nout; ++i)
+        mPUSHi(PTR2IV(outs[i]));
+}
+
+void
+_mark_variables(in_ref)
+    SV* in_ref
+CODE:
+{
+    AV* av = (AV*)SvRV(in_ref);
+    uint32_t n = (uint32_t)(av_len(av) + 1);
+    void** hs;
+    uint32_t i;
+    int rc;
+    Newx(hs, n ? n : 1, void*);
+    for (i = 0; i < n; ++i)
+        hs[i] = INT2PTR(void*, SvIV(*av_fetch(av, i, 0)));
+    rc = MXTAutogradMarkVariables(n, hs);
+    Safefree(hs);
+    if (rc != 0)
+        croak_abi(aTHX_ "AutogradMarkVariables");
+}
+
+void
+_set_recording(rec)
+    IV rec
+CODE:
+    if (MXTAutogradSetIsRecording((int)rec) != 0)
+        croak_abi(aTHX_ "AutogradSetIsRecording");
+
+void
+_backward(h)
+    IV h
+CODE:
+{
+    void* out = INT2PTR(void*, h);
+    if (MXTAutogradBackward(1, &out) != 0)
+        croak_abi(aTHX_ "AutogradBackward");
+}
+
+IV
+_get_grad(h)
+    IV h
+CODE:
+{
+    void* grad = NULL;
+    if (MXTNDArrayGetGrad(INT2PTR(void*, h), &grad) != 0)
+        croak_abi(aTHX_ "NDArrayGetGrad");
+    RETVAL = PTR2IV(grad);
+}
+OUTPUT:
+    RETVAL

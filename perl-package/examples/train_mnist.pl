@@ -1,0 +1,77 @@
+#!/usr/bin/env perl
+# Train an MLP classifier from Perl — no Python in this file.
+#
+# The Perl twin of example/capi/train_mnist.c (same synthetic
+# MNIST-shaped data, same 784-64-10 MLP, same loss-drops-5x pass
+# criterion), built on the generated AI::MXTpu::Ops wrappers instead of
+# hand-rolled MXTImperativeInvoke calls — the point being that the
+# registry-generated surface carries a full training loop. Analog of
+# the reference's perl-package/AI-MXNet/examples/mnist.pl.
+#
+# Run (tests/test_perl_frontend.py does this in CI):
+#   cd perl-package && perl Makefile.PL && make
+#   PYTHONPATH=$REPO JAX_PLATFORMS=cpu perl -Mblib examples/train_mnist.pl
+use strict;
+use warnings;
+
+use AI::MXTpu;
+use AI::MXTpu::Ops;
+
+my ($N, $D, $H, $C, $EPOCHS, $LR) = (256, 784, 64, 10, 30, 0.5);
+
+# synthetic separable blobs: class c means a one-hot-ish spread
+srand(7);
+my (@x, @y);
+for my $i (0 .. $N - 1) {
+    my $c = $i % $C;
+    push @y, $c;
+    for my $j (0 .. $D - 1) {
+        push @x, (rand() - 0.5) * 0.5 + (($j % $C) == $c ? 1.0 : 0.0);
+    }
+}
+my $xa = AI::MXTpu::NDArray->new([$N, $D], \@x);
+my $ya = AI::MXTpu::NDArray->new([$N], \@y);
+
+# parameters live as flat perl arrays between steps; FullyConnected
+# weights are (num_hidden, input_dim) like the reference
+my @w1 = map { (rand() - 0.5) * 0.05 } 1 .. $H * $D;
+my @b1 = (0) x $H;
+my @w2 = map { (rand() - 0.5) * 0.05 } 1 .. $C * $H;
+my @b2 = (0) x $C;
+
+my ($first, $last);
+for my $ep (0 .. $EPOCHS - 1) {
+    my $W1 = AI::MXTpu::NDArray->new([$H, $D], \@w1);
+    my $B1 = AI::MXTpu::NDArray->new([$H], \@b1);
+    my $W2 = AI::MXTpu::NDArray->new([$C, $H], \@w2);
+    my $B2 = AI::MXTpu::NDArray->new([$C], \@b2);
+    AI::MXTpu::mark_variables($W1, $B1, $W2, $B2);
+
+    my $loss = AI::MXTpu::record(sub {
+        my $h = AI::MXTpu::Ops::FullyConnected(
+            $xa, $W1, $B1, num_hidden => $H);
+        $h = AI::MXTpu::Ops::Activation($h, act_type => 'relu');
+        my $logits = AI::MXTpu::Ops::FullyConnected(
+            $h, $W2, $B2, num_hidden => $C);
+        return AI::MXTpu::Ops::softmax_cross_entropy($logits, $ya);
+    });
+    AI::MXTpu::backward($loss);
+
+    my $lval = $loss->asscalar / $N;
+    $first = $lval if $ep == 0;
+    $last = $lval;
+    printf("epoch %d loss %.4f\n", $ep, $lval) if $ep % 10 == 0;
+
+    # SGD on the host-side buffers (loss was summed over the batch)
+    my $inv = $LR / $N;
+    my @updates = ([\@w1, $W1], [\@b1, $B1], [\@w2, $W2], [\@b2, $B2]);
+    for my $u (@updates) {
+        my ($buf, $param) = @$u;
+        my $g = $param->grad->aslist;
+        $buf->[$_] -= $inv * $g->[$_] for 0 .. $#$buf;
+    }
+}
+
+printf("first %.4f last %.4f\n", $first, $last);
+die "FAIL: loss did not drop 5x\n" unless $last < $first / 5.0;
+print "Perl-frontend MNIST training OK\n";

@@ -9,6 +9,9 @@
 
 #include <Python.h>
 
+#include <dlfcn.h>
+#include <stdio.h>
+
 #include <mutex>
 #include <string>
 
@@ -21,6 +24,19 @@ inline void EnsurePython() {
   static std::once_flag flag;
   std::call_once(flag, [] {
     if (!Py_IsInitialized()) {
+      // Plugin hosts (perl XS, JNI, dlopen-based loaders) load this
+      // library RTLD_LOCAL, so libpython arrives as a LOCAL-visibility
+      // dependency — and numpy/jax C extensions, which expect python
+      // symbols to be global, then fail to import with misleading
+      // errors. Re-promote (or load) libpython RTLD_GLOBAL first; a
+      // no-op when the host is python itself or links us directly.
+      char soname[64];
+      snprintf(soname, sizeof soname, "libpython%d.%d.so.1.0",
+               PY_MAJOR_VERSION, PY_MINOR_VERSION);
+      if (dlopen(soname, RTLD_NOLOAD | RTLD_GLOBAL | RTLD_LAZY) ==
+          nullptr) {
+        dlopen(soname, RTLD_GLOBAL | RTLD_LAZY);
+      }
       Py_InitializeEx(0);
       PyEval_SaveThread();
     }

@@ -169,7 +169,7 @@ def bench_transformer():
     # point should not silently pay an extra 1.74B training run.
     swept = any(os.environ.get(k) for k in
                 ("BENCH_BATCH", "BENCH_DIM", "BENCH_LAYERS",
-                 "BENCH_SEQ"))
+                 "BENCH_SEQ", "BENCH_LOSS_CHUNKS", "BENCH_REMAT_SAVE"))
     if big and os.environ.get("BENCH_DEEP",
                               "0" if swept else "1") == "1":
         try:

@@ -1,0 +1,79 @@
+"""Communication-decomposition harness (benchmark/comm_model.py;
+VERDICT r4 item 2 replaced the content-free one-core timeshare scaling
+number with HLO-measured collective bytes + a validated projection)."""
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmark"))
+
+import comm_model  # noqa: E402
+
+
+def test_shape_bytes_handles_tuples_and_layouts():
+    assert comm_model._shape_bytes("f32[512,128]{1,0}") == 512 * 128 * 4
+    assert comm_model._shape_bytes("bf16[8]") == 16
+    assert comm_model._shape_bytes(
+        "(f32[128]{0}, s32[4,2]{1,0}, pred[])") == 512 + 32 + 1
+    assert comm_model._shape_bytes("f32[]") == 4
+
+
+def test_loop_aware_collective_accounting():
+    """A collective inside a while body counts trip-count times — the
+    exact bug the static count had (under-reported (L-1) layers)."""
+    hlo = """\
+HloModule m, is_scheduled=true
+
+%cond (p: (s32[], f32[4])) -> pred[] {
+  %p = (s32[], f32[4]{0}) parameter(0)
+  %c = s32[] constant(3)
+  %i = s32[] get-tuple-element(%p), index=0
+  ROOT %lt = pred[] compare(%i, %c), direction=LT
+}
+
+%body (p: (s32[], f32[4])) -> (s32[], f32[4]) {
+  %p = (s32[], f32[4]{0}) parameter(0)
+  %g = f32[4]{0} get-tuple-element(%p), index=1
+  %ar = f32[4]{0} all-reduce(%g), channel_id=1, to_apply=%add
+  %i = s32[] get-tuple-element(%p), index=0
+  ROOT %t = (s32[], f32[4]{0}) tuple(%i, %ar)
+}
+
+ENTRY %main (a: f32[8]) -> f32[8] {
+  %a = f32[8]{0} parameter(0)
+  %ar0 = f32[8]{0} all-reduce(%a), channel_id=2, to_apply=%add
+  %t0 = (s32[], f32[4]{0}) tuple(%c0, %s)
+  %w = (s32[], f32[4]{0}) while(%t0), condition=%cond, body=%body
+  ROOT %r = f32[8]{0} copy(%ar0)
+}
+"""
+    by_kind, counts, unresolved = comm_model.hlo_collective_bytes(hlo)
+    # 8*4 at top level + 3 trips * 4*4 in the loop
+    assert by_kind["all-reduce"] == 32 + 3 * 16
+    assert counts["all-reduce"] == 1 + 3
+    assert unresolved == 0
+
+
+def test_pure_dp_measurement_matches_analytic_model():
+    """End-to-end on the virtual mesh: the HLO-measured all-reduce
+    payload of the pure-dp train step must match the analytic model
+    (params + (chunks-1)*vocab*dim + scalar) — the trust gate the
+    SCALING_r05 projection rests on."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    V, D = 512, 128
+    m = comm_model.measure_config(
+        "pure_dp", {"dp": 8},
+        dict(vocab_size=V, dim=D, n_layers=2, n_heads=4,
+             ffn_hidden=4 * D, attn_mode="local", loss_chunks=4),
+        B=16, S=64)
+    assert m["unresolved_loops"] == 0
+    analytic = 4 * (m["params"] + 3 * V * D + 1)
+    got = m["collective_payload_bytes"]["all-reduce"]
+    assert abs(got - analytic) / analytic < 0.05, (got, analytic)
+    # pure dp must not need any other collective kind
+    assert m["collective_payload_bytes"]["collective-permute"] == 0
+    assert m["collective_payload_bytes"]["all-to-all"] == 0

@@ -283,10 +283,16 @@ def bench_resnet():
     return result
 
 
-def _synth_rec(n=2048, side=256, path="/tmp/mxtpu_bench_synth.rec"):
-    """Synthetic JPEG .rec + .idx (written once, reused across runs)."""
+def _synth_rec(n=2048, side=256, path="/tmp/mxtpu_bench_synth.rec",
+               raw=False):
+    """Synthetic .rec + .idx (written once, reused across runs). raw=True
+    stores pre-decoded pixels (recordio.pack_raw_img) — the decode-free
+    fast path; JPEG otherwise."""
     import cv2
-    from mxnet_tpu.recordio import MXIndexedRecordIO, pack, IRHeader
+    from mxnet_tpu.recordio import (MXIndexedRecordIO, pack, pack_raw_img,
+                                    IRHeader)
+    if raw:
+        path = path.replace(".rec", "_raw.rec")
     idx = path.replace(".rec", ".idx")
     if os.path.exists(path) and os.path.exists(idx):
         return path, idx
@@ -297,10 +303,14 @@ def _synth_rec(n=2048, side=256, path="/tmp/mxtpu_bench_synth.rec"):
     rng = np.random.RandomState(0)
     for i in range(n):
         img = rng.randint(0, 255, (side, side, 3), np.uint8)
-        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 90])
-        assert ok
-        w.write_idx(i, pack(IRHeader(0, float(i % 1000), i, 0),
-                            enc.tobytes()))
+        header = IRHeader(0, float(i % 1000), i, 0)
+        if raw:
+            w.write_idx(i, pack_raw_img(header, img))
+        else:
+            ok, enc = cv2.imencode(".jpg", img,
+                                   [cv2.IMWRITE_JPEG_QUALITY, 90])
+            assert ok
+            w.write_idx(i, pack(header, enc.tobytes()))
     w.close()
     os.rename(tmp_rec, path)
     os.rename(tmp_idx, idx)
@@ -323,46 +333,71 @@ def bench_input_pipeline(step=None, batch=128, dtype="bfloat16",
     import mxnet_tpu as mx
 
     rec, idx = _synth_rec()
+    raw_rec, raw_idx = _synth_rec(raw=True)
 
     n_threads = min(8, os.cpu_count() or 1)
 
-    def make_iter():
+    def make_iter(path_rec=rec, path_idx=idx):
         return mx.io.ImageRecordIter(
-            path_imgrec=rec, path_imgidx=idx, data_shape=(3, 224, 224),
+            path_imgrec=path_rec, path_imgidx=path_idx,
+            data_shape=(3, 224, 224),
             batch_size=batch, shuffle=True, rand_crop=True,
             rand_mirror=True, dtype="uint8",
             preprocess_threads=n_threads)
 
-    # 1) pipeline-only sustained rate (decode + augment + batch)
-    it = make_iter()
-    n = 0
-    t0 = time.perf_counter()
-    for _ in range(2):
-        it.reset()
-        for b in it:
-            n += b.data[0].shape[0]
-    pipeline_rate = n / (time.perf_counter() - t0)
+    # 1) pipeline-only sustained rate (decode + augment + batch), for
+    #    BOTH record formats: JPEG (decode-bound on small hosts) and
+    #    the pre-decoded raw-pixel fast path (recordio.pack_raw_img —
+    #    frombuffer+crop only, VERDICT r4 item 8)
+    def sustained(path_rec, path_idx):
+        it = make_iter(path_rec, path_idx)
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(2):
+            it.reset()
+            for b in it:
+                n += b.data[0].shape[0]
+        return n / (time.perf_counter() - t0)
+
+    pipeline_rate = sustained(rec, idx)
+    raw_rate = sustained(raw_rec, raw_idx)
 
     # host->device bandwidth for one uint8 batch (on a real TPU host
     # this is PCIe/DMA at GB/s; over a remote-tunnel dev attach it can
     # be the train-through bottleneck, so report it for context)
     probe = np.zeros((batch, 3, 224, 224), np.uint8)
     jax.block_until_ready(jnp.asarray(probe))  # warm
-    t0 = time.perf_counter()
-    jax.block_until_ready(jnp.asarray(probe))
-    h2d_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
+    # best of 3: this figure becomes the feed_overlap_efficiency bound,
+    # so one tunnel hiccup must not define it
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jnp.asarray(probe))
+        times.append(time.perf_counter() - t0)
+    h2d_mbps = probe.nbytes / min(times) / 1e6
 
     out = {
         "sustained_imgs_per_sec": round(pipeline_rate, 1),
+        "sustained_raw_imgs_per_sec": round(raw_rate, 1),
         "host_cpus": os.cpu_count(),
         "record_px": 256,
         "host_to_device_MBps": round(h2d_mbps, 1),
+        # hard ceiling the transfer link imposes on ANY feed: a uint8
+        # 3x224x224 image is 150,528 B, so train-through can never beat
+        # h2d_bw / img_bytes. On a real TPU host (PCIe/DMA, GB/s) this
+        # is tens of thousands img/s and irrelevant; over the remote
+        # dev tunnel it can be the binding constraint — judge
+        # train_through against it, not against the pipeline rate.
+        "h2d_bound_imgs_per_sec": round(
+            h2d_mbps * 1e6 / (3 * 224 * 224), 1),
     }
     if compute_imgs_per_sec:
         # per-core rate uses the thread count the pipeline actually ran
         # with, not the host's core count
         out["cores_to_feed_compute"] = int(
             np.ceil(compute_imgs_per_sec / (pipeline_rate / n_threads)))
+        out["cores_to_feed_compute_raw"] = int(
+            np.ceil(compute_imgs_per_sec / (raw_rate / n_threads)))
 
     # 2) the same pipeline feeding the real train step: uint8 batches are
     #    DOUBLE-BUFFERED to the device (DevicePrefetchIter issues the
@@ -392,7 +427,10 @@ def bench_input_pipeline(step=None, batch=128, dtype="bfloat16",
             def reset(self):
                 self.inner.reset()
 
-        it = make_iter()
+        # the train-through feed uses the raw-pixel fast path — on a
+        # decode-starved host that is the difference between feeding
+        # ~1/3 of compute and feeding it fully
+        it = make_iter(raw_rec, raw_idx)
         it.reset()
         # place straight onto the step's batch sharding so step() never
         # re-device_puts inside the timed loop
@@ -411,10 +449,13 @@ def bench_input_pipeline(step=None, batch=128, dtype="bfloat16",
         float(loss)
         dt_through = time.perf_counter() - t0
         out["train_through_imgs_per_sec"] = round(n / dt_through, 1)
+        out["train_through_feed"] = "raw"
         if compute_imgs_per_sec:
             # overlap quality: 1.0 = perfectly hidden feed
-            # (train-through == min(sustained pipeline, compute))
-            bound = min(pipeline_rate, compute_imgs_per_sec)
+            # (train-through == min(raw pipeline, compute, transfer
+            # link) — the raw rate because that is the feed used)
+            bound = min(raw_rate, compute_imgs_per_sec,
+                        out["h2d_bound_imgs_per_sec"])
             out["feed_overlap_efficiency"] = round(
                 (n / dt_through) / bound, 3)
     return out

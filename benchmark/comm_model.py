@@ -244,7 +244,8 @@ def main(argv=None):
     # bytes exactly and is itself the r5 finding: chunked CE trades
     # HBM for (loss_chunks-1) extra unembedding-grad reductions.
     dp = measured[0]
-    chunks, vocab, dim = 4, V, D
+    chunks, vocab, dim = small["loss_chunks"], small["vocab_size"], \
+        small["dim"]
     analytic = 4 * (dp["params"] + (chunks - 1) * vocab * dim + 1)
     got = dp["collective_payload_bytes"]["all-reduce"]
     delta = abs(got - analytic) / analytic

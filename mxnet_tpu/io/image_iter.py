@@ -37,11 +37,17 @@ __all__ = ["ImageRecordIter"]
 def _decode_and_augment(raw, data_shape, rand_crop, rand_mirror, resize,
                         rng_seed):
     """Record bytes -> (uint8 HWC RGB image, label). cv2 ops release the
-    GIL; everything else here is O(1) Python."""
+    GIL; everything else here is O(1) Python. Pre-decoded raw-pixel
+    records (recordio.pack_raw_img) skip cv2.imdecode entirely — the
+    fast path for hosts whose cores cannot keep up with JPEG decode."""
     import cv2
+    from ..recordio import decode_raw_img
     header, img_bytes = unpack(raw)
     label = header.label
-    img = cv2.imdecode(np.frombuffer(img_bytes, np.uint8), cv2.IMREAD_COLOR)
+    img = decode_raw_img(img_bytes)
+    if img is None:
+        img = cv2.imdecode(np.frombuffer(img_bytes, np.uint8),
+                           cv2.IMREAD_COLOR)
     if img is None:
         raise IOError("failed to decode image record")
     rng = _pyrandom.Random(rng_seed)

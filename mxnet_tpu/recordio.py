@@ -361,9 +361,49 @@ def unpack(s):
     return header, s
 
 
+# Raw-pixel payload magic: pre-decoded records skip JPEG entirely
+# (frombuffer + reshape instead of cv2.imdecode), trading ~13x file
+# size for decode-free reads — the .rec fast path for hosts whose CPUs
+# cannot keep a chip fed (VERDICT r4 item 8). Layout after the magic:
+# u16 height, u16 width, u8 channels, then H*W*C uint8 pixels in HWC
+# BGR order (same channel order cv2.imdecode yields, so every consumer
+# path is byte-identical from here on). JPEG streams begin FF D8 and
+# PNG \x89PNG, so the magic cannot collide.
+RAW_MAGIC = b"RAWP"
+_RAW_DIMS = struct.Struct("<HHB")
+
+
+def pack_raw_img(header, img):
+    """Pack a pre-decoded uint8 HWC image (BGR, as cv2 reads) with no
+    compression — the write side of the raw fast path."""
+    img = np.ascontiguousarray(img, dtype=np.uint8)
+    if img.ndim != 3:
+        raise ValueError("pack_raw_img wants HWC uint8, got shape %s"
+                         % (img.shape,))
+    h, w, c = img.shape
+    return pack(header, RAW_MAGIC + _RAW_DIMS.pack(h, w, c)
+                + img.tobytes())
+
+
+def decode_raw_img(img_bytes):
+    """The BGR uint8 HWC view behind a raw payload (zero-copy and
+    therefore READ-ONLY — copy before mutating), or None if the
+    payload is not raw."""
+    if not img_bytes.startswith(RAW_MAGIC):
+        return None
+    off = len(RAW_MAGIC)
+    h, w, c = _RAW_DIMS.unpack_from(img_bytes, off)
+    return np.frombuffer(img_bytes, np.uint8,
+                         count=h * w * c,
+                         offset=off + _RAW_DIMS.size).reshape(h, w, c)
+
+
 def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """Encode an image array and pack (ref: recordio.py pack_img)."""
+    """Encode an image array and pack (ref: recordio.py pack_img).
+    img_fmt=".raw" stores pre-decoded pixels (see pack_raw_img)."""
     import cv2
+    if img_fmt == ".raw":
+        return pack_raw_img(header, img)
     if img_fmt in (".jpg", ".jpeg"):
         encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
     elif img_fmt == ".png":
@@ -376,8 +416,17 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
 
 
 def unpack_img(s, iscolor=1):
-    """(header, BGR image array) from a record (ref: recordio.py unpack_img)."""
-    import cv2
+    """(header, BGR image array) from a record (ref: recordio.py unpack_img).
+    Raw-pixel payloads (pack_raw_img) decode without cv2; they honor
+    iscolor like the JPEG path (0 -> 2-D grayscale) and return a
+    WRITABLE array (decode_raw_img's zero-copy view is read-only)."""
     header, s = unpack(s)
+    raw = decode_raw_img(s)
+    if raw is not None:
+        if iscolor == 0:
+            import cv2
+            return header, cv2.cvtColor(raw, cv2.COLOR_BGR2GRAY)
+        return header, raw.copy()
+    import cv2
     img = cv2.imdecode(np.frombuffer(s, np.uint8), iscolor)
     return header, img

@@ -367,3 +367,39 @@ def test_image_record_iter_normalize_matches_manual(tmp_path):
     std = np.array([50.0, 51.0, 52.0], np.float32).reshape(1, 3, 1, 1)
     np.testing.assert_allclose(a.data[0].asnumpy(),
                                (manual - mean) / std, rtol=1e-5)
+
+
+def test_raw_pixel_records_roundtrip_and_iterate(tmp_path):
+    """Pre-decoded raw-pixel .rec fast path (recordio.pack_raw_img):
+    byte-exact pixel round-trip through unpack_img with NO cv2 decode,
+    and ImageRecordIter consumes raw and JPEG records identically."""
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(3)
+    img = rng.randint(0, 255, (40, 48, 3), np.uint8)
+    rec = recordio.pack_raw_img(recordio.IRHeader(0, 7.0, 0, 0), img)
+    header, out = recordio.unpack_img(rec)
+    assert header.label == 7.0
+    np.testing.assert_array_equal(out, img)  # lossless, unlike JPEG
+    # magic detection: JPEG payloads still take the cv2 path
+    assert recordio.decode_raw_img(b"\xff\xd8\xff\xe0 not raw") is None
+
+    # iterator fast path: raw .rec yields exact center-crop pixels
+    recf = str(tmp_path / "raw.rec")
+    idxf = str(tmp_path / "raw.idx")
+    w = recordio.MXIndexedRecordIO(idxf, recf, "w")
+    imgs = [rng.randint(0, 255, (36, 36, 3), np.uint8) for _ in range(8)]
+    for i, im in enumerate(imgs):
+        w.write_idx(i, recordio.pack_raw_img(
+            recordio.IRHeader(0, float(i), i, 0), im))
+    w.close()
+    import mxnet_tpu as mx
+    it = mx.io.ImageRecordIter(path_imgrec=recf, path_imgidx=idxf,
+                               data_shape=(3, 32, 32), batch_size=4,
+                               dtype="uint8", preprocess_threads=2)
+    b = next(iter(it))
+    got = b.data[0].asnumpy()  # NCHW uint8
+    lbl = int(b.label[0].asnumpy()[0])
+    src = imgs[lbl]
+    want = src[2:34, 2:34, ::-1].transpose(2, 0, 1)  # center crop, BGR->RGB
+    np.testing.assert_array_equal(got[0], want)

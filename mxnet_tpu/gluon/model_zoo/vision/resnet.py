@@ -90,6 +90,13 @@ def _fused_producer_conv(bn, conv, y, F):
     if bn.gamma._data is None:
         bn._infer_param_shapes(y)
     gamma, beta = bn.gamma.data(), bn.beta.data()
+    if not bn._scale:
+        # batch_norm's fix_gamma (=not scale) replaces gamma with ones
+        # at dispatch; the fused fold below uses gamma VERBATIM, so a
+        # scale=False BN would silently train gamma. All model-zoo
+        # blocks use scale=True; substitute ones to keep the semantics
+        # identical if the helper is ever reused with scale=False.
+        gamma = F.ones_like(gamma)
     if autograd.is_training() and not bn._use_global_stats:
         s, b, mean, var = invoke(fold_op, (y, gamma, beta),
                                  {"eps": bn._eps})

@@ -166,7 +166,7 @@ def _bwd_dx_kernel(x_ref, s_ref, b_ref, wt_ref, g_ref, dx_ref, ds_ref,
 
 
 def _bwd_dx_tiles(N, H, W_, Ci, Co, cbytes):
-    """(NB, TCi) for the dx kernel under the ~11 MB VMEM working budget
+    """(NB, TCi, fits) for the dx kernel under the ~11 MB VMEM working budget
     (flipped weights + patch scratch dominate; streamed blocks and the
     weight block are double-buffered by Mosaic)."""
     nb = _pick_nb(N, H, W_, Co, cbytes)
@@ -254,11 +254,14 @@ def _shrink(nb, tile, est, budget, nb_first=False):
     else:
         shrink_tile()
         shrink_nb()
-    return nb, tile
+    # the floor is (nb=1, tile=128): past it the estimate can still
+    # exceed the budget (huge feature maps with fuse forced on) — the
+    # caller must fall back instead of dying at Mosaic compile time
+    return nb, tile, est(nb, tile) <= budget
 
 
 def _fwd_tiles(N, H, W_, Ci, Co, cbytes):
-    """(NB, TCo) for the forward kernel. The forward weight block is
+    """(NB, TCo, fits) for the forward kernel. The forward weight block is
     observed NOT to be double-buffered (stage-4 untiled compiles at
     ~10 MB), so it counts once. Unlike backward, NB shrinks FIRST:
     halving images-per-cell keeps the weight block whole and avoids
@@ -283,7 +286,7 @@ def _pallas_forward(x, s, b, w, relu, interpret):
     Co = w.shape[-1]
     cdt = _compute_dtype(x.dtype)
     cbytes = jnp.dtype(cdt).itemsize
-    NB, tco = _fwd_tiles(N, H, W_, Ci, Co, cbytes)
+    NB, tco, _ = _fwd_tiles(N, H, W_, Ci, Co, cbytes)
     w2 = w.reshape(9 * Ci, Co).astype(cdt)
     s2 = s.astype(jnp.float32).reshape(1, Ci)
     b2 = b.astype(jnp.float32).reshape(1, Ci)
@@ -307,7 +310,7 @@ def _pallas_forward(x, s, b, w, relu, interpret):
 
 
 def _bwd_dw_tiles(N, H, W_, Ci, Co, cbytes):
-    """(NB, TCo) for the d-weight kernel under _VMEM_BUDGET. The f32
+    """(NB, TCo, fits) for the d-weight kernel under _VMEM_BUDGET. The f32
     accumulator output block is double-buffered by Mosaic even though
     it is revisited (observed: 2x the block size on the VMEM stack), so
     it counts twice."""
@@ -330,7 +333,7 @@ def _pallas_backward(x, s, b, w, relu, interpret, g):
     s2 = s.astype(jnp.float32).reshape(1, Ci)
     b2 = b.astype(jnp.float32).reshape(1, Ci)
     # d-input: contract shifted dy patches with flipped-transposed taps
-    NBx, tci = _bwd_dx_tiles(N, H, W_, Ci, Co, cbytes)
+    NBx, tci, _ = _bwd_dx_tiles(N, H, W_, Ci, Co, cbytes)
     wt = w[::-1, ::-1].transpose(0, 1, 3, 2).reshape(9 * Co, Ci).astype(cdt)
     dx, ds, db = pl.pallas_call(
         functools.partial(_bwd_dx_kernel, NB=NBx, H=H, W=W_, relu=relu,
@@ -359,7 +362,7 @@ def _pallas_backward(x, s, b, w, relu, interpret, g):
     )(x, s2, b2, wt, g)
     # d-weight: accumulate (9Ci, TCo) across the sequential batch grid,
     # Co-tiled so the f32 accumulator + im2col scratch stay under VMEM.
-    NBw, tco = _bwd_dw_tiles(N, H, W_, Ci, Co, cbytes)
+    NBw, tco, _ = _bwd_dw_tiles(N, H, W_, Ci, Co, cbytes)
     w2 = pl.pallas_call(
         functools.partial(_bwd_dw_kernel, NB=NBw, H=H, W=W_, relu=relu,
                           cdt=cdt),
@@ -398,9 +401,34 @@ def _use_pallas(x=None):
         return False
 
 
+def _fwd_fits(x, w):
+    """True when the forward kernel's shrunk (nb, tile) fits its VMEM
+    budget. Reachable to FAIL with fuse=True/pallas_all forced on large
+    feature maps; launching anyway would die at Mosaic compile time, so
+    the dispatcher falls back to fused_conv_reference instead."""
+    N, H, W_, Ci = x.shape
+    Co = w.shape[-1]
+    cbytes = jnp.dtype(_compute_dtype(x.dtype)).itemsize
+    return _fwd_tiles(N, H, W_, Ci, Co, cbytes)[2]
+
+
+def _bwd_fits(x, w):
+    """Same gate for the two backward kernels (their budgets are
+    tighter than the forward's, so they are checked separately — a
+    forward-only workload keeps the fast kernel either way)."""
+    N, H, W_, Ci = x.shape
+    Co = w.shape[-1]
+    cbytes = jnp.dtype(_compute_dtype(x.dtype)).itemsize
+    return (_bwd_dx_tiles(N, H, W_, Ci, Co, cbytes)[2]
+            and _bwd_dw_tiles(N, H, W_, Ci, Co, cbytes)[2])
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _fused(x, s, b, w, relu, interpret):
-    if interpret or _use_pallas(x):
+    # forward gates on the FORWARD plan only: an inference-only call
+    # must not lose the fast kernel because a backward plan (checked in
+    # _fused_bwd) would not fit
+    if interpret or (_use_pallas(x) and _fwd_fits(x, w)):
         return _pallas_forward(x, s, b, w, relu, interpret)
     return fused_conv_reference(x, s, b, w, relu)
 
@@ -411,7 +439,7 @@ def _fused_fwd(x, s, b, w, relu, interpret):
 
 def _fused_bwd(relu, interpret, res, g):
     x, s, b, w = res
-    if interpret or _use_pallas(x):
+    if interpret or (_use_pallas(x) and _bwd_fits(x, w)):
         return _pallas_backward(x, s, b, w, relu, interpret, g)
     _, vjp = jax.vjp(
         lambda x_, s_, b_, w_: fused_conv_reference(x_, s_, b_, w_, relu),

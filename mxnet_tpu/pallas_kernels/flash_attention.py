@@ -513,6 +513,22 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     MXTPU_FLASH_AUTOTUNE=1); explicit values override. Blocks clamp to
     the sequence length."""
     import os
+    if causal and q.shape[2] != k.shape[2]:
+        # This kernel's causal mask is LEFT-aligned (col > row masked),
+        # which is only the right semantics when q and kv index the
+        # same positions. Decode-style calls (q_len=1 against an
+        # N-entry KV cache) need RIGHT-aligned masking and would get
+        # silently wrong attention here — reject loudly instead.
+        # (A fully-masked row, the other classic hazard, cannot occur
+        # under left alignment: row r always sees col 0.) Ring /
+        # sequence-parallel callers handle per-hop offsets themselves
+        # before calling in (parallel/ring_flash).
+        raise ValueError(
+            "flash_attention(causal=True) requires equal q/kv lengths "
+            "(got %d vs %d): the causal mask is left-aligned, so "
+            "decode-style q-against-longer-kv calls would be silently "
+            "mis-masked; use attention_reference or slice the cache"
+            % (q.shape[2], k.shape[2]))
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if block_q is None or block_k is None:

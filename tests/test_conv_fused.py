@@ -61,9 +61,9 @@ class TestKernels:
         """Force the Ci-tiled dx grid AND a 2-Co-tile dW grid — the
         deep-stage VMEM configurations — and check grads still match."""
         monkeypatch.setattr(CF, "_bwd_dx_tiles",
-                            lambda N, H, W, Ci, Co, cb: (1, Ci // 2))
+                            lambda N, H, W, Ci, Co, cb: (1, Ci // 2, True))
         monkeypatch.setattr(CF, "_bwd_dw_tiles",
-                            lambda N, H, W, Ci, Co, cb: (1, Co // 2))
+                            lambda N, H, W, Ci, Co, cb: (1, Co // 2, True))
         x, s, b, w = _mats(2, 6, 6, 16, 16)
 
         def lk(*a):
@@ -154,3 +154,34 @@ class TestModelIntegration:
         net.hybridize()
         hybrid = net(x).asnumpy()
         np.testing.assert_allclose(eager, hybrid, atol=2e-3)
+
+
+def test_over_budget_plan_falls_back_to_reference(monkeypatch):
+    """When the shrunk (nb, tile) still exceeds the VMEM budget
+    (ADVICE r4: reachable with fuse forced on large feature maps), the
+    dispatcher must take fused_conv_reference instead of launching a
+    pallas_call that dies at Mosaic compile time."""
+    import numpy as np
+
+    x, s, b, w = _mats(2, 6, 6, 16, 16)
+    # simulate an unfittable plan
+    monkeypatch.setattr(CF, "_fwd_tiles",
+                        lambda *a: (1, 16, False))
+    called = []
+    real_ref = CF.fused_conv_reference
+    monkeypatch.setattr(CF, "fused_conv_reference",
+                        lambda *a, **k: called.append(1) or real_ref(*a, **k))
+    monkeypatch.setattr(CF, "_use_pallas", lambda *a, **k: True)
+    out = CF.fused_scale_relu_conv3x3(x, s, b, w)
+    assert called, "over-budget plan did not fall back to the reference"
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(real_ref(x, s, b, w)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_shrink_reports_fit():
+    nb, tile, fits = CF._shrink(4, 512, lambda n, t: n * t, budget=256)
+    assert fits and nb * tile <= 256
+    # even the floor (nb=1, tile=128) exceeds this budget
+    nb, tile, fits = CF._shrink(4, 512, lambda n, t: n * t, budget=16)
+    assert (nb, tile) == (1, 128) and not fits

@@ -176,3 +176,18 @@ class TestKVStoreCompression:
         kv.pull(4, out=out)
         # below size_lower_bound: passes through uncompressed
         assert onp.allclose(out.asnumpy(), 0.01)
+
+
+def test_flash_causal_rejects_unequal_lengths():
+    """The fully-masked-row invariant is enforced at the public boundary
+    (ADVICE r4): causal with kv shorter than q would leave leading rows
+    with no visible keys and NaN silently in the kernel."""
+    import jax.numpy as jnp
+    from mxnet_tpu.pallas_kernels.flash_attention import flash_attention
+    q = jnp.zeros((1, 2, 256, 64), jnp.float32)
+    kv = jnp.zeros((1, 2, 128, 64), jnp.float32)
+    with pytest.raises(ValueError, match="equal q/kv lengths"):
+        flash_attention(q, kv, kv, causal=True)
+    # non-causal cross-attention with unequal lengths stays legal
+    out = flash_attention(q, kv, kv, causal=False, interpret=True)
+    assert out.shape == q.shape

@@ -11,17 +11,44 @@ from .context import current_context, cpu
 from . import ndarray as nd
 from . import autograd
 
-__all__ = ["default_context", "assert_almost_equal", "rand_ndarray",
-           "rand_shape_nd", "check_numeric_gradient", "check_consistency",
-           "almost_equal"]
+__all__ = ["default_context", "set_default_context",
+           "assert_almost_equal", "rand_ndarray",
+           "rand_shape_nd", "rand_shape_2d", "rand_shape_3d",
+           "check_numeric_gradient", "check_consistency",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "almost_equal", "same"]
 
 
 def default_context():
     return current_context()
 
 
+def set_default_context(ctx):
+    """Make ``ctx`` this thread's default (ref: test_utils.py:68)."""
+    from .context import Context
+    Context._default_ctx.value = ctx
+
+
 def rand_shape_nd(ndim, dim=10):
     return tuple(_np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1),
+            _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1),
+            _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
+
+
+def same(a, b):
+    """Exact array equality (ref: test_utils.py same)."""
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else _np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else _np.asarray(b)
+    return _np.array_equal(a, b)
 
 
 def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
@@ -80,8 +107,16 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
 
 def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-6):
     """Run ``fn`` under each context and compare outputs — the reference's
-    cross-backend validator (ref: test_utils.py:1314)."""
-    ctx_list = ctx_list or [cpu()]
+    cross-backend validator (ref: test_utils.py:1314). The default
+    ctx_list compares the host CPU against the CURRENT context (on an
+    accelerator-attached process that is a real cpu-vs-device check;
+    cpu-only processes collapse to one context and the comparison is
+    vacuous, as in the reference when no GPU is present). The deep
+    device sweep with ULP accounting is benchmark/tpu_numerics.py."""
+    if ctx_list is None:
+        ctx_list = [cpu()]
+        if current_context() != cpu():
+            ctx_list.append(current_context())
     outs = []
     for ctx in ctx_list:
         with ctx:
@@ -91,3 +126,51 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-6):
     for o in outs[1:]:
         assert_almost_equal(outs[0], o, rtol=rtol, atol=atol)
     return outs[0]
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-4, atol=1e-6,
+                           ctx=None, aux_states=None):
+    """Bind ``sym`` with ``inputs`` and compare each output against
+    ``expected`` (ref: test_utils.py:1061 check_symbolic_forward)."""
+    args = {n: nd.array(v) if not isinstance(v, nd.NDArray) else v
+            for n, v in zip(sym.list_arguments(), inputs)} \
+        if not isinstance(inputs, dict) else inputs
+    exe = sym.bind(ctx or current_context(), args=args,
+                   aux_states=aux_states)
+    outs = exe.forward()
+    if len(outs) != len(expected):
+        raise ValueError("check_symbolic_forward: %d outputs but %d "
+                         "expected values — a truncated zip would pass "
+                         "vacuously" % (len(outs), len(expected)))
+    for got, want in zip(outs, expected):
+        assert_almost_equal(got, want, rtol=rtol, atol=atol,
+                            names=("forward", "expected"))
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected, rtol=1e-4,
+                            atol=1e-6, ctx=None, aux_states=None):
+    """Bind, run forward+backward with ``out_grads``, and compare each
+    argument gradient (ref: test_utils.py:1129 check_symbolic_backward)."""
+    ctx = ctx or current_context()
+    names = sym.list_arguments()
+    args = {n: nd.array(v) if not isinstance(v, nd.NDArray) else v
+            for n, v in zip(names, inputs)} \
+        if not isinstance(inputs, dict) else inputs
+    grads = {n: nd.zeros(a.shape, ctx=ctx) for n, a in args.items()}
+    exe = sym.bind(ctx, args=args, args_grad=grads,
+                   aux_states=aux_states)
+    exe.forward(is_train=True)
+    exe.backward([g if isinstance(g, nd.NDArray) else nd.array(g)
+                  for g in out_grads])
+    if not isinstance(expected, dict):
+        if len(expected) > len(names):
+            raise ValueError(
+                "check_symbolic_backward: %d expected gradients for %d "
+                "arguments (shorter lists are partial checks; longer is "
+                "always a miscount)" % (len(expected), len(names)))
+        expected = dict(zip(names, expected))
+    for n, want in expected.items():
+        assert_almost_equal(grads[n], want, rtol=rtol, atol=atol,
+                            names=("grad(%s)" % n, "expected"))
+    return {n: g.asnumpy() for n, g in grads.items()}

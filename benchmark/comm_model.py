@@ -257,9 +257,19 @@ def main(argv=None):
         "rel_delta": round(delta, 6),
         "model_trusted": bool(delta < 0.05),
         "naive_4B_per_param_bytes": 4 * dp["params"],
-        "finding": "chunked-CE re-all-reduces the unembedding grad "
-                   "per chunk; local accumulation before AR would "
-                   "save (chunks-1)*vocab*dim*4 bytes/step",
+        "finding": (
+            "chunked-CE re-all-reduces the unembedding grad per chunk "
+            "(+(chunks-1)*vocab*dim*4 bytes/step). Root cause isolated "
+            "(r5): GSPMD keeps the AR inside ANY scan that accumulates "
+            "a batch-sharded contraction — scan carries must hold a "
+            "concrete sharding, so each iteration's partial sum is "
+            "reduced before the add; reproduced with a 10-line minimal "
+            "scan, and a hand-written custom-vjp accumulation compiles "
+            "to the same HLO. Fixing it needs Explicit-mode "
+            "PartitionSpec(unreduced=...) shardings (rejected: "
+            "framework-wide mesh-mode migration) or a shard_map'd loss "
+            "mirroring every dp x tp x sp layout by hand. Documented "
+            "cost, not a bug: single-chip perf is unaffected."),
     }
 
     # projections for the two REAL single-chip workloads (step times

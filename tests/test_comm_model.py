@@ -77,3 +77,39 @@ def test_pure_dp_measurement_matches_analytic_model():
     # pure dp must not need any other collective kind
     assert m["collective_payload_bytes"]["collective-permute"] == 0
     assert m["collective_payload_bytes"]["all-to-all"] == 0
+
+
+def test_gspmd_keeps_scan_accumulated_reduction_in_loop():
+    """Minimal reproduction of the chunked-CE finding: a scan that
+    accumulates a batch-sharded contraction gets its all-reduce INSIDE
+    the loop (once per iteration), because scan carries must hold a
+    concrete sharding. This pins the structural behavior the
+    SCALING_r05 'observed' projection models; if a jax upgrade starts
+    hoisting it, this test fails and the projection should be updated
+    to the ideal pattern."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(onp.array(jax.devices()[:8]).reshape(8), ("dp",))
+    xs = jnp.zeros((4, 16, 8))
+    ys = jnp.zeros((4, 16, 32))
+
+    def f(xs, ys):
+        def body(acc, args):
+            x, y = args
+            return acc + jnp.einsum("bd,bv->dv", x, y), 0.0
+        return lax.scan(body, jnp.zeros((8, 32)), (xs, ys))[0]
+
+    sh = NamedSharding(mesh, P(None, "dp"))
+    txt = jax.jit(f, in_shardings=(sh, sh)).lower(xs, ys) \
+        .compile().as_text()
+    by, counts, unresolved = comm_model.hlo_collective_bytes(txt)
+    assert unresolved == 0
+    # in-loop: 4 dynamic executions of the [8, 32] f32 reduction
+    assert counts["all-reduce"] == 4, counts
+    assert by["all-reduce"] == 4 * 8 * 32 * 4, by

@@ -4,6 +4,13 @@ over the mesh (ref: example/model-parallel/matrix_factorization/ — there,
 manual group2ctx placement across GPUs; here a tensor-parallel sharding
 spec on one mesh, the TPU-native equivalent of per-layer placement).
 
+The reference's group2ctx API itself is ALSO supported (r5):
+``Symbol.bind(..., group2ctx={'dev1': ctx, ...})`` places ctx-group
+annotated nodes per device with automatic cross-group transfers —
+tests/test_module.py::test_group2ctx_model_parallel runs this exact
+model shape through it. Prefer the mesh sharding below for performance
+(one compiled program); group2ctx is the API-parity path.
+
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python example/model-parallel/matrix_factorization.py --shards 4
 """

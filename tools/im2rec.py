@@ -108,7 +108,10 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--resize", type=int, default=0)
     parser.add_argument("--quality", type=int, default=95)
-    parser.add_argument("--encoding", default=".jpg")
+    parser.add_argument("--encoding", default=".jpg",
+                        help=".jpg/.png re-encode, or .raw for "
+                             "pre-decoded pixels (decode-free reads, "
+                             "~13x file size; recordio.pack_raw_img)")
     parser.add_argument("--pass-through", action="store_true",
                         help="store raw file bytes without re-encoding")
     args = parser.parse_args(argv)

@@ -342,10 +342,10 @@ sub broadcast_logical_or { AI::MXTpu::op('broadcast_logical_or', @_) }
 # broadcast_logical_xor(a, b)
 sub broadcast_logical_xor { AI::MXTpu::op('broadcast_logical_xor', @_) }
 
-# broadcast_maximum(*args: 'ArrayLike', out: 'None' = None, where: 'None' = None) -> 'Any'
+# broadcast_maximum(x: 'ArrayLike', y: 'ArrayLike', /) -> 'Array'
 sub broadcast_maximum { AI::MXTpu::op('broadcast_maximum', @_) }
 
-# broadcast_minimum(*args: 'ArrayLike', out: 'None' = None, where: 'None' = None) -> 'Any'
+# broadcast_minimum(x: 'ArrayLike', y: 'ArrayLike', /) -> 'Array'
 sub broadcast_minimum { AI::MXTpu::op('broadcast_minimum', @_) }
 
 # broadcast_mod(x1: 'ArrayLike', x2: 'ArrayLike', /) -> 'Array'
@@ -765,7 +765,7 @@ sub max_ { AI::MXTpu::op('max', @_) }
 # max_axis(x, axis=None, keepdims=False, exclude=False)
 sub max_axis { AI::MXTpu::op('max_axis', @_) }
 
-# maximum(*args: 'ArrayLike', out: 'None' = None, where: 'None' = None) -> 'Any'
+# maximum(x: 'ArrayLike', y: 'ArrayLike', /) -> 'Array'
 sub maximum { AI::MXTpu::op('maximum', @_) }
 
 # mean(x, axis=None, keepdims=False, exclude=False)
@@ -777,7 +777,7 @@ sub min_ { AI::MXTpu::op('min', @_) }
 # min_axis(x, axis=None, keepdims=False, exclude=False)
 sub min_axis { AI::MXTpu::op('min_axis', @_) }
 
-# minimum(*args: 'ArrayLike', out: 'None' = None, where: 'None' = None) -> 'Any'
+# minimum(x: 'ArrayLike', y: 'ArrayLike', /) -> 'Array'
 sub minimum { AI::MXTpu::op('minimum', @_) }
 
 # mod(x1: 'ArrayLike', x2: 'ArrayLike', /) -> 'Array'

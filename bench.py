@@ -547,6 +547,87 @@ def bench_resnet_inference(net=None, batch=None, dtype=None):
     }
 
 
+def bench_eager_ops():
+    """BENCH_MODEL=eager_ops: imperative dispatch overhead — a chain of
+    small NDArray ops in ops/sec, fast path (MXNET_IMPERATIVE_JIT jitted
+    dispatch cache) vs untraced eager, plus the engine.bulk() segment mode
+    (whole chain fused into one XLA program per flush). Tracks the per-op
+    Python+dispatch cost the reference's engine/CachedOp machinery exists
+    to hide (SURVEY §3; include/mxnet/engine.h:117)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine
+    from mxnet_tpu.ndarray import register as R
+
+    n = int(os.environ.get("BENCH_EAGER_SIZE", 64))
+    iters = int(os.environ.get("BENCH_EAGER_ITERS", 200))
+    chain = int(os.environ.get("BENCH_EAGER_CHAIN", 16))
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(n, n).astype("float32"))
+    y = mx.nd.array((rs.rand(n, n) + 0.5).astype("float32"))
+
+    reps = max(1, chain // 4)
+    ops_per_iter = reps * 4
+
+    def run_chain():
+        # representative imperative mix: scalar arithmetic (the reference's
+        # _plus_scalar/_mul_scalar traffic), an activation, a tensor op
+        c = x
+        for _ in range(reps):
+            c = c * 0.5
+            c = c + 1.0
+            c = mx.nd.softmax(c)
+            c = c + y
+        return c
+
+    def one_round(mode, n):
+        prev = R.set_imperative_jit(mode != "off")
+        try:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                if mode == "bulk":
+                    with engine.bulk(ops_per_iter):
+                        c = run_chain()
+                else:
+                    c = run_chain()
+            c.wait_to_read()
+            dt = time.perf_counter() - t0
+        finally:
+            R.set_imperative_jit(prev)
+        return n * ops_per_iter / dt, c.asnumpy()
+
+    # warm every mode first (dispatch cache compiles on repeat), then
+    # measure in ALTERNATING rounds and keep the per-mode median — the
+    # modes see the same machine-load drift instead of each other's noise
+    outs = {}
+    for mode in ("jit", "bulk", "off"):
+        _r, outs[mode] = one_round(mode, 4)
+    R.reset_dispatch_stats()
+    _r, outs["jit"] = one_round("jit", 2)  # stats over a clean jit round
+    stats = R.dispatch_stats()
+    rates = {"jit": [], "bulk": [], "off": []}
+    for _round in range(3):
+        for mode in rates:
+            rates[mode].append(one_round(mode, max(1, iters // 3))[0])
+    med = {m: sorted(v)[len(v) // 2] for m, v in rates.items()}
+    fast, bulk, slow = med["jit"], med["bulk"], med["off"]
+    out_fast, out_bulk, out_slow = outs["jit"], outs["bulk"], outs["off"]
+    return {
+        "metric": "eager_ops_per_sec",
+        "value": round(fast, 1),
+        "unit": "ops/sec",
+        "jit_ops_per_sec": round(fast, 1),
+        "eager_ops_per_sec": round(slow, 1),
+        "bulk_ops_per_sec": round(bulk, 1),
+        "speedup_jit": round(fast / slow, 2),
+        "speedup_bulk": round(bulk / slow, 2),
+        "bitwise_parity": bool(np.array_equal(out_fast, out_slow)
+                               and np.array_equal(out_bulk, out_slow)),
+        "chain_len": ops_per_iter,
+        "tensor_side": n,
+        "dispatch": stats,
+    }
+
+
 def bench_numerics():
     """BENCH_NUMERICS=1: device-vs-CPU-golden op sweep + flash kernel
     check (benchmark/tpu_numerics.py; VERDICT r3 item 8). The full
@@ -590,6 +671,8 @@ if __name__ == "__main__":
         result = bench_resnet()
     elif which == "resnet50_infer":
         result = bench_resnet_inference()
+    elif which == "eager_ops":
+        result = bench_eager_ops()
     else:
         def _section(fn):
             # retry ONLY transient remote-attach channel drops — a
@@ -611,6 +694,7 @@ if __name__ == "__main__":
         result = bench_resnet()
         result["inference"] = _section(bench_resnet_inference)
         result["transformer"] = _section(bench_transformer)
+        result["eager_ops"] = _section(bench_eager_ops)
     # honored for every BENCH_MODEL, not just the default combined run.
     # Defaults ON for real-device runs: the recorded BENCH_r*.json is
     # the artifact the on-TPU numerics sweep exists to produce

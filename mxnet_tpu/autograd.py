@@ -306,6 +306,11 @@ def _grad_create_graph(heads, variables, head_grads, single):
 def _run_backward(heads, head_grads, retain_graph, targets=None):
     """Shared reverse sweep. Returns {leaf NDArray: cotangent jax array}."""
     from .ndarray import NDArray
+    from .ndarray import register as _register
+
+    # tape grad is a bulk sync point (ISSUE: CachedOp seam): pending
+    # segment ops may feed marked leaves or heads — run them first
+    _register.flush_bulk_segment()
 
     order = _toposort(heads)
     if not order:

@@ -757,10 +757,11 @@ def profile_aggregate_stats(reset, format_, sort_by, ascending):
 
 
 def engine_set_bulk_size(size):
+    """MXEngineSetBulkSize parity: sets the bulk segment cap and returns
+    the previous value as an int. Setting the size is a segment boundary —
+    any bulk segment pending on this thread is flushed first."""
     from . import engine
-    prev = engine.bulk_size()
-    engine.set_bulk_size(int(size))
-    return int(prev)
+    return int(engine.set_bulk_size(int(size)))
 
 
 def lib_info_features():

@@ -60,10 +60,15 @@ _bulk_size = [int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))]
 def set_bulk_size(size):
     """Set the op-bulking segment limit (ref: Engine::set_bulk_size,
     MXNET_EXEC_BULK_EXEC_* env vars, graph_executor.cc:1288 InitOpSegs).
-    Here it bounds how many traced ops a CachedOp compiles into one XLA
-    program segment. Returns the previous value."""
+    Bounds how many queued imperative ops a bulk segment compiles into one
+    XLA program; the default comes from MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN.
+    Resizing is a segment boundary (any pending segment flushes first, as
+    the reference flushes the current opr bulk). Returns the previous
+    value."""
     prev = _bulk_size[0]
+    _flush_pending_segment()
     _bulk_size[0] = int(size)
+    _register().set_active_bulk_limit(int(size))
     return prev
 
 
@@ -71,27 +76,73 @@ def bulk_size():
     return _bulk_size[0]
 
 
+_register_mod = None
+
+
+def _register():
+    """The op-dispatch module (lazy: ndarray imports engine, not vice
+    versa at module load)."""
+    global _register_mod
+    if _register_mod is None:
+        from .ndarray import register
+        _register_mod = register
+    return _register_mod
+
+
+def _flush_pending_segment():
+    """Drain this thread's imperative bulk segment, if any."""
+    _register().flush_bulk_segment()
+
+
 @contextlib.contextmanager
-def bulk(size):
-    """Scope form of set_bulk_size (ref: python/mxnet/engine.py bulk)."""
-    prev = set_bulk_size(size)
+def bulk(size=None):
+    """Scope form of set_bulk_size (ref: python/mxnet/engine.py bulk).
+
+    Inside the scope, eligible imperative ops are ACCUMULATED into a lazy
+    segment and executed as one jitted XLA program at a sync point (buffer
+    read, wait_for_var/wait_for_all, autograd, or segment-full at
+    ``bulk_size()`` ops) — the imperative analog of CachedOp bulking
+    (ref: graph_executor.cc:1288 InitOpSegs). ``size=None`` keeps the
+    current ``bulk_size()`` (the MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN
+    default). With MXNET_IMPERATIVE_JIT=0 this degrades to the historical
+    knob-only behavior (ops run eagerly)."""
+    reg = _register()
+    prev = set_bulk_size(size if size is not None else bulk_size())
+    seg = None
+    if reg.imperative_jit_enabled() and not is_naive():
+        # size <= 1 still installs a segment (shadowing any outer one):
+        # each op flushes as it queues, i.e. per-op synchronous execution
+        # — the reference semantics of bulk size 1 inside a bulk scope
+        seg = reg.begin_bulk_segment(max(1, bulk_size()))
     try:
         yield
     finally:
-        set_bulk_size(prev)
+        try:
+            if seg is not None:
+                reg.end_bulk_segment(seg)
+        finally:
+            set_bulk_size(prev)
 
 
 def wait_for_var(arr):
     """ref: Engine::WaitForVar (include/mxnet/engine.h). Blocks until the
-    array's producing computation is done; raises its deferred error here."""
+    array's producing computation is done; raises its deferred error here.
+    Reading ``_data`` drains any bulk segment the array is pending in."""
     import jax
     data = getattr(arr, "_data", arr)
     jax.block_until_ready(data)
 
 
 def wait_for_all():
-    """ref: Engine::WaitForAll. Barrier over all live device work."""
+    """ref: Engine::WaitForAll. Barrier over all live device work. The
+    CALLING thread's pending bulk segment is flushed first — queued work
+    this barrier must cover even though no jax.Array exists for it yet.
+    Bulk segments are thread-local (like the reference's per-thread opr
+    bulk): another thread's queued-but-unflushed ops are drained by that
+    thread's own sync points / engine.bulk scope exit, not by this
+    barrier."""
     import jax
+    _flush_pending_segment()
     try:
         for d in jax.live_arrays():
             d.block_until_ready()

@@ -69,9 +69,12 @@ def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
 
 
 def waitall():
-    """ref: mx.nd.waitall → Engine::WaitForAll. XLA async dispatch drains when
-    we block on effects; jax exposes no global barrier, so this is a no-op
-    fence plus a tiny device sync."""
+    """ref: mx.nd.waitall → Engine::WaitForAll. Drains any pending bulk
+    segment (queued imperative ops run now; their errors surface here, the
+    sync point), then a tiny device fence — XLA async dispatch drains when
+    we block on effects."""
+    from .. import engine as _engine
+    _engine._flush_pending_segment()
     try:
         jax.block_until_ready(jnp.zeros(()))
     except Exception:

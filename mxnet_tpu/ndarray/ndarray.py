@@ -31,27 +31,64 @@ def _is_tracer(x):
     return isinstance(x, jax.core.Tracer)
 
 
+class _PendingSlot:
+    """Placeholder buffer for an NDArray produced by a queued bulk-segment
+    op (engine.bulk — the imperative CachedOp seam). Shape/dtype are known
+    from abstract evaluation; the concrete ``jax.Array`` materialises when
+    the owning segment flushes. Reading ``NDArray._data`` is a sync point:
+    the property getter flushes the segment transparently."""
+
+    __slots__ = ("segment", "shape", "dtype", "ndim", "ref")
+
+    def __init__(self, segment, shape, dtype, ref):
+        self.segment = segment
+        self.shape = tuple(shape)
+        self.dtype = _np.dtype(dtype)
+        self.ndim = len(self.shape)
+        self.ref = ref  # ("o", op_idx, out_idx) within the segment
+
+
 class NDArray:
     """N-dimensional array on a device context."""
 
-    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_autograd_entry",
+    __slots__ = ("_buf", "_ctx", "_grad", "_grad_req", "_autograd_entry",
                  "_deferred_init", "_fresh_grad", "__weakref__")
 
     def __init__(self, data, ctx=None):
-        self._data = data
+        self._buf = data
         self._ctx = ctx
         self._grad = None
         self._grad_req = "null"
         self._autograd_entry = None
 
+    # -- buffer access (the engine sync point) ----------------------------
+    @property
+    def _data(self):
+        """The concrete jax.Array. If the buffer is still pending inside a
+        bulk segment, reading it flushes the segment first — the analog of
+        the reference engine's WaitToRead dependency resolution."""
+        buf = self._buf
+        if type(buf) is _PendingSlot:
+            buf.segment.flush()
+            buf = self._buf
+            if type(buf) is _PendingSlot:
+                raise RuntimeError(
+                    "NDArray depends on a bulk-segment op that failed; "
+                    "the original error was raised at the flush point")
+        return buf
+
+    @_data.setter
+    def _data(self, value):
+        self._buf = value
+
     # -- basic properties -------------------------------------------------
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        return tuple(self._buf.shape)
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return self._buf.ndim
 
     @property
     def size(self):
@@ -59,7 +96,7 @@ class NDArray:
 
     @property
     def dtype(self):
-        return _np.dtype(self._data.dtype)
+        return _np.dtype(self._buf.dtype)
 
     @property
     def nbytes(self):
@@ -373,9 +410,8 @@ class NDArray:
 
     # -- arithmetic operators --------------------------------------------
     def _binop(self, name, other, reverse=False):
-        from . import register as _r
         a, b = (other, self) if reverse else (self, other)
-        return _r.invoke_by_name(name, a, b)
+        return _register_mod().invoke_by_name(name, a, b)
 
     def __add__(self, other):
         return self._binop("add", other)
@@ -492,8 +528,7 @@ class NDArray:
         return key
 
     def __getitem__(self, key):
-        from . import register as _r
-        return _r.invoke_getitem(self, self._clean_index(key))
+        return _register_mod().invoke_getitem(self, self._clean_index(key))
 
     def __setitem__(self, key, value):
         self._check_inplace()
@@ -530,6 +565,20 @@ class NDArray:
 
     def __dlpack_device__(self):
         return self._data.__dlpack_device__()
+
+
+_REGISTER_MOD = None
+
+
+def _register_mod():
+    """Lazy handle on .register (it imports this module; a top-level
+    import here would cycle). Memoized: the per-op import-machinery cost
+    (~2us) matters on the dispatch hot path."""
+    global _REGISTER_MOD
+    if _REGISTER_MOD is None:
+        from . import register
+        _REGISTER_MOD = register
+    return _REGISTER_MOD
 
 
 def _place(data, ctx):

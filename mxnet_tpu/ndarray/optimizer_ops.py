@@ -20,8 +20,29 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..ops import optimizer_ops as _pure
+from ..ops import optimizer_ops as _pure  # noqa: F401 — registration side effect
+from ..ops import registry as _registry
+from . import register as _register
 from .ndarray import NDArray
+
+
+def _invoke(name, *tensors, **statics):
+    """Run a registry optimizer op through the imperative dispatch choke
+    point: the jitted cache (MXNET_IMPERATIVE_JIT) applies, and the op's
+    OpDef.inplace marks donate the STATE buffers on accelerator backends
+    (states are unconditionally rebound by _assign below — the relinquish
+    donation requires; the weight is never donated because pure-form
+    callers keep it readable). Returns NDArray(s), possibly still pending
+    inside an engine.bulk segment."""
+    return _register.invoke(
+        _registry.get_op(name),
+        tuple(t if isinstance(t, NDArray) else NDArray(jnp.asarray(t))
+              for t in tensors), statics)
+
+
+# dst <- src delivery preserving dst dtype; adopts still-pending bulk
+# results (one shared implementation with the out= delivery path)
+_assign = _register.deliver_result
 
 __all__ = [
     "sgd_update", "sgd_mom_update", "mp_sgd_update", "mp_sgd_mom_update",
@@ -42,26 +63,28 @@ def _d(x):
 
 
 def _scalar(v):
-    return float(v) if not isinstance(v, NDArray) else _d(v)
+    """Scalar attrs pass through as floats; NDArray-valued ones (adamw's
+    tensor rescale_grad) stay NDArrays so _invoke treats them as tensor
+    inputs."""
+    return float(v) if not isinstance(v, NDArray) else v
 
 
 def _deliver(out, new_w):
     if out is not None:
-        out._data = new_w.astype(out._data.dtype)
-        return out
-    return NDArray(new_w)
+        return _assign(out, new_w)
+    return new_w
 
 
 def _writeback(states, new_vals):
     """Map the pure op's extra outputs onto the state NDArrays in place,
     preserving each state's dtype (the reference mutates them)."""
     for st, new in zip(states, new_vals):
-        st._data = new.astype(st._data.dtype)
+        _assign(st, new)
 
 
 def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=True, out=None, **kw):
-    new_w = _pure.sgd_update(_d(weight), _d(grad), lr=lr, wd=wd,
+    new_w = _invoke("sgd_update", weight, grad, lr=lr, wd=wd,
                              rescale_grad=rescale_grad,
                              clip_gradient=clip_gradient)
     return _deliver(out, new_w)
@@ -70,8 +93,8 @@ def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
 def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
                    out=None, **kw):
-    new_w, new_m = _pure.sgd_mom_update(
-        _d(weight), _d(grad), _d(mom), lr=lr, momentum=momentum, wd=wd,
+    new_w, new_m = _invoke("sgd_mom_update", 
+        weight, grad, mom, lr=lr, momentum=momentum, wd=wd,
         rescale_grad=rescale_grad, clip_gradient=clip_gradient)
     _writeback([mom], [new_m])
     return _deliver(out, new_w)
@@ -79,29 +102,29 @@ def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
 
 def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, lazy_update=True, out=None, **kw):
-    new_w, new_w32 = _pure.mp_sgd_update(
-        _d(weight), _d(grad), _d(weight32), lr=lr, wd=wd,
+    new_w, new_w32 = _invoke("mp_sgd_update", 
+        weight, grad, weight32, lr=lr, wd=wd,
         rescale_grad=rescale_grad, clip_gradient=clip_gradient)
-    weight32._data = new_w32
+    _assign(weight32, new_w32)
     return _deliver(out if out is not None else weight, new_w)
 
 
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       lazy_update=True, out=None, **kw):
-    new_w, new_m, new_w32 = _pure.mp_sgd_mom_update(
-        _d(weight), _d(grad), _d(mom), _d(weight32), lr=lr,
+    new_w, new_m, new_w32 = _invoke("mp_sgd_mom_update", 
+        weight, grad, mom, weight32, lr=lr,
         momentum=momentum, wd=wd, rescale_grad=rescale_grad,
         clip_gradient=clip_gradient)
-    mom._data = new_m
-    weight32._data = new_w32
+    _assign(mom, new_m)
+    _assign(weight32, new_w32)
     return _deliver(out if out is not None else weight, new_w)
 
 
 def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, out=None, **kw):
-    new_w, new_m = _pure.nag_mom_update(
-        _d(weight), _d(grad), _d(mom), lr=lr, momentum=momentum, wd=wd,
+    new_w, new_m = _invoke("nag_mom_update", 
+        weight, grad, mom, lr=lr, momentum=momentum, wd=wd,
         rescale_grad=rescale_grad, clip_gradient=clip_gradient)
     _writeback([mom], [new_m])
     return _deliver(out, new_w)
@@ -110,20 +133,20 @@ def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
 def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       out=None, **kw):
-    new_w, new_m, new_w32 = _pure.mp_nag_mom_update(
-        _d(weight), _d(grad), _d(mom), _d(weight32), lr=lr,
+    new_w, new_m, new_w32 = _invoke("mp_nag_mom_update", 
+        weight, grad, mom, weight32, lr=lr,
         momentum=momentum, wd=wd, rescale_grad=rescale_grad,
         clip_gradient=clip_gradient)
-    mom._data = new_m
-    weight32._data = new_w32
+    _assign(mom, new_m)
+    _assign(weight32, new_w32)
     return _deliver(out if out is not None else weight, new_w)
 
 
 def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True, out=None, **kw):
-    new_w, new_m, new_v = _pure.adam_update(
-        _d(weight), _d(grad), _d(mean), _d(var), lr=lr, beta1=beta1,
+    new_w, new_m, new_v = _invoke("adam_update", 
+        weight, grad, mean, var, lr=lr, beta1=beta1,
         beta2=beta2, epsilon=epsilon, wd=wd, rescale_grad=rescale_grad,
         clip_gradient=clip_gradient)
     _writeback([mean, var], [new_m, new_v])
@@ -133,8 +156,8 @@ def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
 def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
                    out=None, **kw):
-    new_w, new_n = _pure.rmsprop_update(
-        _d(weight), _d(grad), _d(n), lr=lr, gamma1=gamma1, epsilon=epsilon,
+    new_w, new_n = _invoke("rmsprop_update", 
+        weight, grad, n, lr=lr, gamma1=gamma1, epsilon=epsilon,
         wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient,
         clip_weights=clip_weights)
     _writeback([n], [new_n])
@@ -145,8 +168,8 @@ def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0, out=None,
                        **kw):
-    new_w, new_n, new_g, new_d = _pure.rmspropalex_update(
-        _d(weight), _d(grad), _d(n), _d(g), _d(delta), lr=lr,
+    new_w, new_n, new_g, new_d = _invoke("rmspropalex_update", 
+        weight, grad, n, g, delta, lr=lr,
         gamma1=gamma1, gamma2=gamma2, epsilon=epsilon, wd=wd,
         rescale_grad=rescale_grad, clip_gradient=clip_gradient,
         clip_weights=clip_weights)
@@ -156,8 +179,8 @@ def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
 
 def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0, out=None, **kw):
-    new_w, new_z, new_n = _pure.ftrl_update(
-        _d(weight), _d(grad), _d(z), _d(n), lr=lr, lamda1=lamda1,
+    new_w, new_z, new_n = _invoke("ftrl_update", 
+        weight, grad, z, n, lr=lr, lamda1=lamda1,
         beta=beta, wd=wd, rescale_grad=rescale_grad,
         clip_gradient=clip_gradient)
     _writeback([z, n], [new_z, new_n])
@@ -167,8 +190,8 @@ def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
 def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
                 out=None, **kw):
-    new_w, new_d, new_v, new_z = _pure.ftml_update(
-        _d(weight), _d(grad), _d(d), _d(v), _d(z), lr=lr, t=t,
+    new_w, new_d, new_v, new_z = _invoke("ftml_update", 
+        weight, grad, d, v, z, lr=lr, t=t,
         beta1=beta1, beta2=beta2, epsilon=epsilon, wd=wd,
         rescale_grad=rescale_grad, clip_grad=clip_grad)
     _writeback([d, v, z], [new_d, new_v, new_z])
@@ -177,7 +200,7 @@ def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
 
 def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, out=None, **kw):
-    new_w = _pure.signsgd_update(_d(weight), _d(grad), lr=lr, wd=wd,
+    new_w = _invoke("signsgd_update", weight, grad, lr=lr, wd=wd,
                                  rescale_grad=rescale_grad,
                                  clip_gradient=clip_gradient)
     return _deliver(out, new_w)
@@ -186,8 +209,8 @@ def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
 def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0,
                   out=None, **kw):
-    new_w, new_m = _pure.signum_update(
-        _d(weight), _d(grad), _d(mom), lr=lr, momentum=momentum, wd=wd,
+    new_w, new_m = _invoke("signum_update", 
+        weight, grad, mom, lr=lr, momentum=momentum, wd=wd,
         rescale_grad=rescale_grad, clip_gradient=clip_gradient,
         wd_lh=wd_lh)
     _writeback([mom], [new_m])
@@ -199,8 +222,8 @@ def adamw_update(weight, grad, mean, var, rescale_grad, lr, eta,
                  clip_gradient=-1.0, out=None, **kw):
     """rescale_grad is a TENSOR input in the reference (adamw.cc); both
     scalar and NDArray are accepted here."""
-    new_w, new_m, new_v = _pure.adamw_update(
-        _d(weight), _d(grad), _d(mean), _d(var),
+    new_w, new_m, new_v = _invoke("adamw_update", 
+        weight, grad, mean, var,
         rescale_grad=_scalar(rescale_grad), lr=lr, eta=eta, beta1=beta1,
         beta2=beta2, epsilon=epsilon, wd=wd, clip_gradient=clip_gradient)
     _writeback([mean, var], [new_m, new_v])
@@ -210,13 +233,13 @@ def adamw_update(weight, grad, mean, var, rescale_grad, lr, eta,
 def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, lr,
                     eta, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                     clip_gradient=-1.0, out=None, **kw):
-    new_w, new_m, new_v, new_w32 = _pure.mp_adamw_update(
-        _d(weight), _d(grad), _d(mean), _d(var), _d(weight32),
+    new_w, new_m, new_v, new_w32 = _invoke("mp_adamw_update", 
+        weight, grad, mean, var, weight32,
         rescale_grad=_scalar(rescale_grad), lr=lr, eta=eta, beta1=beta1,
         beta2=beta2, epsilon=epsilon, wd=wd, clip_gradient=clip_gradient)
-    mean._data = new_m
-    var._data = new_v
-    weight32._data = new_w32
+    _assign(mean, new_m)
+    _assign(var, new_v)
+    _assign(weight32, new_w32)
     return _deliver(out if out is not None else weight, new_w)
 
 
@@ -224,8 +247,8 @@ def lamb_update_phase1(weight, grad, mean, var, lr=None, beta1=0.9,
                        beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
                        wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                        out=None, **kw):
-    g_out, new_m, new_v = _pure.lamb_update_phase1(
-        _d(weight), _d(grad), _d(mean), _d(var), lr=lr, beta1=beta1,
+    g_out, new_m, new_v = _invoke("lamb_update_phase1", 
+        weight, grad, mean, var, lr=lr, beta1=beta1,
         beta2=beta2, epsilon=epsilon, t=t, bias_correction=bias_correction,
         wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient)
     _writeback([mean, var], [new_m, new_v])
@@ -234,8 +257,8 @@ def lamb_update_phase1(weight, grad, mean, var, lr=None, beta1=0.9,
 
 def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
                        upper_bound=-1.0, out=None, **kw):
-    new_w = _pure.lamb_update_phase2(
-        _d(weight), _d(g), _d(r1), _d(r2), lr=lr,
+    new_w = _invoke("lamb_update_phase2", 
+        weight, g, r1, r2, lr=lr,
         lower_bound=lower_bound, upper_bound=upper_bound)
     return _deliver(out, new_w)
 
@@ -245,8 +268,8 @@ def sparse_adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
                           **kw):
     """Dense emulation of the row-sparse path (ref: optimizer_op.cc
     _sparse_adagrad_update)."""
-    new_w, new_h = _pure.sparse_adagrad_update(
-        _d(weight), _d(grad), _d(history), lr=lr, epsilon=epsilon, wd=wd,
+    new_w, new_h = _invoke("sparse_adagrad_update", 
+        weight, grad, history, lr=lr, epsilon=epsilon, wd=wd,
         rescale_grad=rescale_grad, clip_gradient=clip_gradient)
     _writeback([history], [new_h])
     return _deliver(out, new_w)
@@ -257,8 +280,8 @@ group_adagrad_update = sparse_adagrad_update  # ref: contrib/optimizer_op.cc
 
 def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
                eps=1e-8, rescale_grad=1.0, out=None, **kw):
-    new_lrs = _pure.multi_lars(_d(lrs), _d(weights_sum_sq),
-                               _d(grads_sum_sq), _d(wds), eta=eta, eps=eps,
+    new_lrs = _invoke("multi_lars", lrs, weights_sum_sq,
+                               grads_sum_sq, wds, eta=eta, eps=eps,
                                rescale_grad=rescale_grad)
     return _deliver(out, new_lrs)
 

@@ -44,7 +44,8 @@ def sgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
 
 
 @register("sgd_mom_update", num_inputs=3, no_grad=True, num_outputs=2,
-          input_names=("weight", "grad", "mom"))
+          input_names=("weight", "grad", "mom"),
+          inplace=(2,))
 def sgd_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     """ref: optimizer_op-inl.h:600 SGDMomKernel -> (new_w, new_mom)."""
@@ -54,7 +55,8 @@ def sgd_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
 
 
 @register("mp_sgd_update", num_inputs=3, no_grad=True, num_outputs=2,
-          input_names=("weight", "grad", "weight32"))
+          input_names=("weight", "grad", "weight32"),
+          inplace=(2,))
 def mp_sgd_update(weight, grad, weight32, lr=None, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     """ref: optimizer_op-inl.h MP_SGDKernel -> (new_w, new_w32)."""
@@ -64,7 +66,8 @@ def mp_sgd_update(weight, grad, weight32, lr=None, wd=0.0,
 
 
 @register("mp_sgd_mom_update", num_inputs=4, no_grad=True, num_outputs=3,
-          input_names=("weight", "grad", "mom", "weight32"))
+          input_names=("weight", "grad", "mom", "weight32"),
+          inplace=(2, 3))
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       lazy_update=True):
@@ -77,7 +80,8 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
 
 
 @register("nag_mom_update", num_inputs=3, no_grad=True, num_outputs=2,
-          input_names=("weight", "grad", "mom"))
+          input_names=("weight", "grad", "mom"),
+          inplace=(2,))
 def nag_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
     """Nesterov momentum (ref: optimizer_op-inl.h:1060 NAGMomKernel)
@@ -90,7 +94,8 @@ def nag_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
 
 
 @register("mp_nag_mom_update", num_inputs=4, no_grad=True, num_outputs=3,
-          input_names=("weight", "grad", "mom", "weight32"))
+          input_names=("weight", "grad", "mom", "weight32"),
+          inplace=(2, 3))
 def mp_nag_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     """ref: optimizer_op-inl.h MP_NAGMomKernel -> (new_w, new_mom,
@@ -104,7 +109,8 @@ def mp_nag_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
 
 
 @register("adam_update", num_inputs=4, no_grad=True, num_outputs=3,
-          input_names=("weight", "grad", "mean", "var"))
+          input_names=("weight", "grad", "mean", "var"),
+          inplace=(2, 3))
 def adam_update(weight, grad, mean, var, lr=None, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
@@ -118,7 +124,8 @@ def adam_update(weight, grad, mean, var, lr=None, beta1=0.9, beta2=0.999,
 
 
 @register("rmsprop_update", num_inputs=3, no_grad=True, num_outputs=2,
-          input_names=("weight", "grad", "n"))
+          input_names=("weight", "grad", "n"),
+          inplace=(2,))
 def rmsprop_update(weight, grad, n, lr=None, gamma1=0.95, epsilon=1e-8,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    clip_weights=-1.0):
@@ -131,7 +138,8 @@ def rmsprop_update(weight, grad, n, lr=None, gamma1=0.95, epsilon=1e-8,
 
 
 @register("rmspropalex_update", num_inputs=5, no_grad=True, num_outputs=4,
-          input_names=("weight", "grad", "n", "g", "delta"))
+          input_names=("weight", "grad", "n", "g", "delta"),
+          inplace=(2, 3, 4))
 def rmspropalex_update(weight, grad, n, g, delta, lr=None, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
@@ -147,7 +155,8 @@ def rmspropalex_update(weight, grad, n, g, delta, lr=None, gamma1=0.95,
 
 
 @register("ftrl_update", num_inputs=4, no_grad=True, num_outputs=3,
-          input_names=("weight", "grad", "z", "n"))
+          input_names=("weight", "grad", "z", "n"),
+          inplace=(2, 3))
 def ftrl_update(weight, grad, z, n, lr=None, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0):
     """ref: optimizer_op-inl.h:1797 FTRLKernel -> (new_w, new_z, new_n)."""
@@ -162,7 +171,8 @@ def ftrl_update(weight, grad, z, n, lr=None, lamda1=0.01, beta=1.0, wd=0.0,
 
 
 @register("ftml_update", num_inputs=5, no_grad=True, num_outputs=4,
-          input_names=("weight", "grad", "d", "v", "z"))
+          input_names=("weight", "grad", "d", "v", "z"),
+          inplace=(2, 3, 4))
 def ftml_update(weight, grad, d, v, z, lr=None, t=1, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
     """ref: optimizer_op-inl.h:1214 FTMLKernel -> (new_w, new_d, new_v,
@@ -186,7 +196,8 @@ def signsgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
 
 
 @register("signum_update", num_inputs=3, no_grad=True, num_outputs=2,
-          input_names=("weight", "grad", "mom"))
+          input_names=("weight", "grad", "mom"),
+          inplace=(2,))
 def signum_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
     """ref: optimizer_op-inl.h:2066 SignumKernel -> (new_w, new_mom)."""
@@ -197,7 +208,8 @@ def signum_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
 
 
 @register("adamw_update", num_inputs=4, no_grad=True, num_outputs=3,
-          input_names=("weight", "grad", "mean", "var"))
+          input_names=("weight", "grad", "mean", "var"),
+          inplace=(2, 3))
 def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=None,
                  eta=None, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                  clip_gradient=-1.0):
@@ -213,7 +225,8 @@ def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=None,
 
 
 @register("mp_adamw_update", num_inputs=5, no_grad=True, num_outputs=4,
-          input_names=("weight", "grad", "mean", "var", "weight32"))
+          input_names=("weight", "grad", "mean", "var", "weight32"),
+          inplace=(2, 3, 4))
 def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=1.0,
                     lr=None, eta=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
                     wd=0.0, clip_gradient=-1.0):
@@ -228,7 +241,8 @@ def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=1.0,
 
 
 @register("lamb_update_phase1", num_inputs=4, no_grad=True, num_outputs=3,
-          input_names=("weight", "grad", "mean", "var"))
+          input_names=("weight", "grad", "mean", "var"),
+          inplace=(2, 3))
 def lamb_update_phase1(weight, grad, mean, var, lr=None, beta1=0.9,
                        beta2=0.999, epsilon=1e-6, t=1,
                        bias_correction=True, wd=0.0, rescale_grad=1.0,
@@ -262,7 +276,8 @@ def lamb_update_phase2(weight, g, r1, r2, lr=None, lower_bound=-1.0,
 
 @register("sparse_adagrad_update", num_inputs=3, no_grad=True,
           num_outputs=2, aliases=("group_adagrad_update",),
-          input_names=("weight", "grad", "history"))
+          input_names=("weight", "grad", "history"),
+          inplace=(2,))
 def sparse_adagrad_update(weight, grad, history, lr=None, epsilon=1e-7,
                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     """AdaGrad with accumulated history (ref: optimizer_op.cc

@@ -76,7 +76,7 @@ def requantize(data, min_range, max_range, min_calib_range=None,
 
 
 @register("_contrib_calibrate_entropy", no_grad=True,
-          aliases=("calibrate_entropy",))
+          aliases=("calibrate_entropy",), nojit=True)
 def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
     """KL-divergence-optimal calibration threshold from an activation
     histogram (ref: quantization/calibrate.cc). Runs on host numpy (the

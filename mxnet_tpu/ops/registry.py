@@ -20,10 +20,12 @@ _OPS = {}
 
 class OpDef:
     __slots__ = ("name", "fn", "no_grad", "num_inputs", "aliases",
-                 "wrap_kwargs", "num_outputs", "input_names")
+                 "wrap_kwargs", "num_outputs", "input_names", "nojit",
+                 "inplace")
 
     def __init__(self, name, fn, no_grad=False, num_inputs=None, aliases=(),
-                 wrap_kwargs=None, num_outputs=None, input_names=None):
+                 wrap_kwargs=None, num_outputs=None, input_names=None,
+                 nojit=False, inplace=()):
         self.name = name
         self.fn = fn
         self.no_grad = no_grad          # outputs not differentiable (int/bool)
@@ -36,15 +38,24 @@ class OpDef:
         # explicit ordered tensor-input names; None = derive from the fn
         # signature via the INPUT_PARAM_NAMES heuristic (symbol frontend)
         self.input_names = input_names
+        # opt-out of the imperative jitted dispatch cache + bulking
+        # (host callbacks, data-dependent output shapes); the eager
+        # untraced path is always used for these
+        self.nojit = nojit
+        # positional tensor-input indices the op conceptually overwrites —
+        # the reference's ``req='write'`` analog (kWriteInplace,
+        # op_attr_types.h). The jitted dispatch path donates these input
+        # buffers to XLA so the update can reuse them in place.
+        self.inplace = tuple(inplace)
 
 
 def register(name, no_grad=False, num_inputs=None, aliases=(),
-             num_outputs=None, input_names=None):
+             num_outputs=None, input_names=None, nojit=False, inplace=()):
     """Decorator: register a functional op under ``name`` (+ aliases)."""
     def _reg(fn):
         opdef = OpDef(name, fn, no_grad=no_grad, num_inputs=num_inputs,
                       aliases=aliases, num_outputs=num_outputs,
-                      input_names=input_names)
+                      input_names=input_names, nojit=nojit, inplace=inplace)
         _OPS[name] = opdef
         for a in aliases:
             _OPS[a] = opdef

@@ -26,7 +26,7 @@ import time
 __all__ = [
     "set_config", "set_state", "dump", "dumps", "pause", "resume",
     "Domain", "Task", "Frame", "Event", "Counter", "Marker",
-    "record_op", "is_running",
+    "record_op", "is_running", "imperative_stats", "reset_imperative_stats",
 ]
 
 _lock = threading.Lock()
@@ -159,9 +159,24 @@ def dump(finished=True, profile_process="worker"):
         json.dump(data, f)
 
 
+def imperative_stats():
+    """Imperative dispatch-cache counters (cache hits/misses/retraces/
+    fallbacks and bulk-segment flushes/ops) — the observability surface of
+    the MXNET_IMPERATIVE_JIT fast path. Always counted; zero when the fast
+    path is disabled or unused."""
+    from .ndarray import register as _register
+    return _register.dispatch_stats()
+
+
+def reset_imperative_stats():
+    from .ndarray import register as _register
+    _register.reset_dispatch_stats()
+
+
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
     """Return aggregate stats as a text table (ref: profiler.py:151,
-    src/profiler/aggregate_stats.cc)."""
+    src/profiler/aggregate_stats.cc), followed by the imperative
+    dispatch-cache counters."""
     key_idx = {"count": 0, "total": 1, "min": 2, "max": 3,
                "avg": None}.get(sort_by, 1)
     with _lock:
@@ -179,6 +194,14 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     for n, c, tot, mn, mx, avg in rows:
         lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f"
                      % (n[:40], c, tot, mn, mx, avg))
+    st = imperative_stats()
+    lines.append("")
+    lines.append("imperative dispatch: hits=%d misses=%d retraces=%d "
+                 "fallbacks=%d bulk_flushes=%d bulk_ops=%d"
+                 % (st["hits"], st["misses"], st["retraces"],
+                    st["fallbacks"], st["bulk_flushes"], st["bulk_ops"]))
+    if reset:
+        reset_imperative_stats()
     return "\n".join(lines)
 
 

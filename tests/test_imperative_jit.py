@@ -1,0 +1,423 @@
+"""Imperative fast path: jitted op-dispatch cache + engine bulking.
+
+Covers the MXNET_IMPERATIVE_JIT dispatch cache (numerics parity fast vs
+untraced, retrace behavior on shape/dtype/attr change, AMP-version cache
+invalidation, gradients through jitted forwards, NaiveEngine error
+surfacing) and the engine.bulk() lazy segment (accumulate/flush semantics,
+sync points, parity). The repeated-op cache-hit test is the tier-1 smoke
+guard: it fails if the fast path silently rots into always-falling-back.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, profiler
+from mxnet_tpu import c_runtime
+from mxnet_tpu.ndarray import register as R
+from mxnet_tpu.ops import registry as _registry
+
+
+@pytest.fixture(autouse=True)
+def _fast_path_on():
+    prev = R.set_imperative_jit(True)
+    R.reset_dispatch_stats()
+    yield
+    R.set_imperative_jit(prev)
+
+
+def _warm(f, n=3):
+    """Call f enough times that the dispatch cache compiles (the cache
+    only jits a key once it repeats)."""
+    out = None
+    for _ in range(n):
+        out = f()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dispatch cache
+# ---------------------------------------------------------------------------
+
+def test_cache_registers_hits_on_repeated_op():
+    # tier-1 smoke guard (CI): a repeated op MUST produce cache hits
+    x = mx.nd.ones((4, 4))
+    y = mx.nd.ones((4, 4))
+    R.reset_dispatch_stats()
+    for _ in range(5):
+        (x * y).wait_to_read()
+    st = R.dispatch_stats()
+    assert st["hits"] > 0, st
+    assert st["misses"] >= 1, st
+    # the profiler exposes the same counters and includes them in dumps()
+    assert profiler.imperative_stats()["hits"] == st["hits"]
+    assert "imperative dispatch:" in profiler.dumps()
+
+
+def test_numerics_parity_fast_vs_slow_bitwise():
+    rs = np.random.RandomState(0)
+    x = mx.nd.array((rs.rand(8, 8) + 0.5).astype("float32"))
+    y = mx.nd.array((rs.rand(8, 8) + 0.5).astype("float32"))
+    cases = {
+        "add": lambda: x + y,
+        "subtract": lambda: x - y,
+        "multiply": lambda: x * y,
+        "divide": lambda: x / y,
+        "mul_scalar": lambda: x * 2.5,
+        "add_scalar": lambda: x + 1.25,
+        "relu": lambda: mx.nd.relu(x - 0.7),
+        "sigmoid": lambda: mx.nd.sigmoid(x),
+        "exp": lambda: mx.nd.exp(x),
+        "softmax": lambda: mx.nd.softmax(x),
+        "dot": lambda: mx.nd.dot(x, y),
+        "sum_axis": lambda: mx.nd.sum(x, axis=1),
+        "reshape": lambda: x.reshape((4, 16)),
+    }
+    for name, f in cases.items():
+        R.set_imperative_jit(False)
+        slow = f().asnumpy()
+        R.set_imperative_jit(True)
+        fast = _warm(f).asnumpy()
+        assert np.array_equal(slow, fast), \
+            "bitwise mismatch for %s" % name
+
+
+def test_retrace_on_shape_change_new_key_on_attr_change():
+    def run(arr, **kw):
+        out = None
+        for _ in range(3):
+            out = mx.nd.sum(arr, **kw)
+        return out
+
+    R._clear_dispatch_cache()  # key-space isolation from other tests
+    R.reset_dispatch_stats()
+    run(mx.nd.ones((4, 5)))
+    assert R.dispatch_stats()["retraces"] == 0
+    # same op+attrs, new shape -> retrace
+    run(mx.nd.ones((6, 7)))
+    assert R.dispatch_stats()["retraces"] == 1
+    # same op+attrs, new dtype -> retrace
+    run(mx.nd.ones((4, 5), dtype="int32"))
+    assert R.dispatch_stats()["retraces"] == 2
+    # attr change -> different signature entirely (miss, not a retrace)
+    before = R.dispatch_stats()
+    run(mx.nd.ones((4, 5)), axis=1)
+    after = R.dispatch_stats()
+    assert after["retraces"] == before["retraces"]
+    assert after["misses"] > before["misses"]
+
+
+def test_amp_version_bump_invalidates_cache():
+    x = mx.nd.ones((3, 3))
+    _warm(lambda: x + x)
+    R.reset_dispatch_stats()
+    (x + x).wait_to_read()
+    assert R.dispatch_stats()["hits"] == 1
+    # any hook change bumps _amp_version: previously cached entries must
+    # not be reused (the hook may rewrite inputs)
+    R.set_amp_cast_hook(None)
+    R.reset_dispatch_stats()
+    (x + x).wait_to_read()
+    st = R.dispatch_stats()
+    assert st["hits"] == 0 and st["misses"] == 1, st
+
+
+def test_gradients_through_jitted_ops():
+    rs = np.random.RandomState(0)
+    av = rs.rand(5, 4).astype("float32")
+    bv = (rs.rand(5, 4) + 0.5).astype("float32")
+
+    def grads():
+        a = mx.nd.array(av)
+        b = mx.nd.array(bv)
+        a.attach_grad()
+        b.attach_grad()
+        with autograd.record():
+            out = mx.nd.sum(mx.nd.sigmoid(a * b + 1.0) * a)
+        out.backward()
+        return a.grad.asnumpy(), b.grad.asnumpy()
+
+    R.set_imperative_jit(False)
+    ga_slow, gb_slow = grads()
+    R.set_imperative_jit(True)
+    ga_fast, gb_fast = _warm(grads)
+    np.testing.assert_allclose(ga_fast, ga_slow, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(gb_fast, gb_slow, rtol=1e-6, atol=1e-6)
+    # second-order entry points still work through the jitted forwards
+    a = mx.nd.array(av)
+    a.attach_grad()
+    with autograd.record():
+        out = (a * a).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * av, rtol=1e-6)
+
+
+def test_nojit_op_falls_back_and_matches_eager():
+    if "_test_nojit_double" not in _registry._OPS:
+        @_registry.register("_test_nojit_double", no_grad=True, nojit=True)
+        def _test_nojit_double(x):
+            # genuine host callback: concretizes the input
+            return jnp.asarray(np.asarray(x) * 2.0)
+    R.reset_dispatch_stats()
+    out = R.invoke_by_name("_test_nojit_double", mx.nd.ones((2, 2)))
+    np.testing.assert_array_equal(out.asnumpy(), np.full((2, 2), 2.0))
+    assert R.dispatch_stats()["fallbacks"] == 1
+
+
+def test_trace_incompatible_op_auto_falls_back():
+    if "_test_datadep" not in _registry._OPS:
+        @_registry.register("_test_datadep", no_grad=True)
+        def _test_datadep(x):
+            # data-dependent host branch: fails under trace, fine eagerly
+            return x + float(np.asarray(x).sum())
+    R.reset_dispatch_stats()
+    xs = mx.nd.ones((3,))
+    expect = np.ones(3) + 3.0
+    for _ in range(4):
+        out = R.invoke_by_name("_test_datadep", xs)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    st = R.dispatch_stats()
+    assert st["fallbacks"] >= 1, st
+    assert st["hits"] == 0, st
+
+
+def test_naive_engine_errors_at_faulting_op(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    assert engine.is_naive()
+    x = mx.nd.ones((2, 3))
+    bad = mx.nd.ones((4, 5))
+    # the error must surface at the faulting call, not a later sync point
+    with pytest.raises(Exception):
+        mx.nd.dot(x, bad)
+    # and a valid op still runs (forced-sync path)
+    out = _warm(lambda: x * 2.0)
+    np.testing.assert_array_equal(out.asnumpy(), np.full((2, 3), 2.0))
+
+
+# ---------------------------------------------------------------------------
+# bulking
+# ---------------------------------------------------------------------------
+
+def test_bulk_accumulates_and_flushes_at_read():
+    x = mx.nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    with engine.bulk(8):
+        c = x
+        for _ in range(5):
+            c = c + 1.0
+        assert R.bulk_segment_depth() == 5
+        got = c.asnumpy()  # read = sync point
+        assert R.bulk_segment_depth() == 0
+    np.testing.assert_array_equal(got, x.asnumpy() + 5)
+    assert R.dispatch_stats()["bulk_ops"] == 5
+    assert R.dispatch_stats()["bulk_flushes"] >= 1
+
+
+def test_bulk_flushes_when_segment_full():
+    x = mx.nd.ones((2, 2))
+    with engine.bulk(3):
+        c = x + 1.0
+        c = c + 1.0
+        assert R.bulk_segment_depth() == 2
+        c = c + 1.0  # hits bulk_size() -> auto flush
+        assert R.bulk_segment_depth() == 0
+        np.testing.assert_array_equal(c.asnumpy(), np.full((2, 2), 4.0))
+
+
+def test_bulk_parity_bitwise_with_eager():
+    rs = np.random.RandomState(1)
+    x = mx.nd.array(rs.rand(6, 6).astype("float32"))
+    y = mx.nd.array((rs.rand(6, 6) + 0.5).astype("float32"))
+
+    def chain():
+        c = x
+        for _ in range(3):
+            c = c * 0.5
+            c = mx.nd.softmax(c)
+            c = c + y
+        return c
+
+    R.set_imperative_jit(False)
+    eager = chain().asnumpy()
+    R.set_imperative_jit(True)
+    for _ in range(2):
+        with engine.bulk(16):
+            bulked = chain().asnumpy()
+    assert np.array_equal(eager, bulked)
+
+
+def test_wait_for_all_drains_bulk_segment():
+    x = mx.nd.ones((3,))
+    with engine.bulk(16):
+        c = x + 41.0
+        assert R.bulk_segment_depth() == 1
+        engine.wait_for_all()
+        assert R.bulk_segment_depth() == 0
+    np.testing.assert_array_equal(c.asnumpy(), np.full((3,), 42.0))
+
+
+def test_waitall_drains_bulk_segment():
+    x = mx.nd.ones((3,))
+    with engine.bulk(16):
+        c = x * 3.0
+        assert R.bulk_segment_depth() == 1
+        mx.nd.waitall()
+        assert R.bulk_segment_depth() == 0
+    np.testing.assert_array_equal(c.asnumpy(), np.full((3,), 3.0))
+
+
+def test_autograd_is_a_bulk_sync_point():
+    x = mx.nd.ones((3,))
+    x.attach_grad()
+    with engine.bulk(16):
+        base = mx.nd.ones((3,)) * 2.0  # queued (not recording)
+        with autograd.record():
+            out = x * base  # consumes the pending array -> flush
+        out.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), np.full((3,), 2.0))
+
+
+def test_bulk_scope_exit_flushes():
+    x = mx.nd.ones((2,))
+    with engine.bulk(16):
+        c = x + 1.0
+        assert R.bulk_segment_depth() == 1
+    # scope exit flushed; the array must be concrete without further sync
+    assert R.bulk_segment_depth() == 0
+    np.testing.assert_array_equal(c.asnumpy(), np.full((2,), 2.0))
+
+
+def test_bulk_with_fast_path_disabled_is_knob_only():
+    R.set_imperative_jit(False)
+    x = mx.nd.ones((2,))
+    with engine.bulk(8):
+        c = x + 1.0
+        assert R.bulk_segment_depth() == 0  # executed eagerly
+    np.testing.assert_array_equal(c.asnumpy(), np.full((2,), 2.0))
+
+
+def test_nested_bulk_scopes_compose():
+    x = mx.nd.ones((2,))
+    with engine.bulk(8):
+        a = x + 1.0
+        with engine.bulk(4):
+            b = a + 1.0
+            assert engine.bulk_size() == 4
+        # inner exit restored the outer segment; ops still bulk
+        c = b + 1.0
+        assert R.bulk_segment_depth() >= 1
+        assert engine.bulk_size() == 8
+    np.testing.assert_array_equal(c.asnumpy(), np.full((2,), 4.0))
+
+
+def test_scalar_attr_type_is_part_of_cache_key():
+    # 2 == 2.0 == True hash-collide; replaying an int-2 closure for a
+    # float-2.0 call would change dtype promotion vs the untraced path
+    x = mx.nd.array(np.ones((3,), "int32"))
+    _warm(lambda: x * 2)          # caches the int-attr closure
+    d_int = (x * 2).dtype
+    d_float = _warm(lambda: x * 2.0).dtype
+    R.set_imperative_jit(False)
+    assert (x * 2).dtype == d_int
+    assert (x * 2.0).dtype == d_float
+    assert d_int != d_float  # int stays int32; float promotes
+
+
+def test_out_delivery_does_not_flush_bulk_segment():
+    x = mx.nd.ones((2, 2))
+    y = mx.nd.ones((2, 2))
+    o = mx.nd.zeros((2, 2))
+    R.reset_dispatch_stats()
+    with engine.bulk(16):
+        for _ in range(4):
+            mx.nd.broadcast_add(x, y, out=o)
+        assert R.dispatch_stats()["bulk_flushes"] == 0
+        np.testing.assert_array_equal(o.asnumpy(), np.full((2, 2), 2.0))
+    assert R.dispatch_stats()["bulk_flushes"] == 1
+
+
+def test_bulk_attr_mutation_between_queue_and_flush():
+    x = mx.nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    axes = [1, 0]
+    with engine.bulk(8):
+        y = mx.nd.transpose(x, axes=axes)
+        axes[0], axes[1] = 0, 1  # caller mutates the attr before flush
+        got = y.asnumpy()
+    np.testing.assert_array_equal(got, x.asnumpy().T)
+
+
+def test_optimizer_updates_fuse_inside_bulk():
+    w = mx.nd.ones((8,))
+    g = mx.nd.ones((8,)) * 0.1
+    m = mx.nd.zeros((8,))
+    R.reset_dispatch_stats()
+    with engine.bulk(16):
+        mx.nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9, out=w)
+        mx.nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9, out=w)
+        assert R.dispatch_stats()["bulk_flushes"] == 0  # still queued
+    assert R.dispatch_stats()["bulk_flushes"] == 1
+    assert R.dispatch_stats()["bulk_ops"] == 2
+    # parity with the untraced path
+    we, ge, me = mx.nd.ones((8,)), mx.nd.ones((8,)) * 0.1, mx.nd.zeros((8,))
+    R.set_imperative_jit(False)
+    mx.nd.sgd_mom_update(we, ge, me, lr=0.1, momentum=0.9, out=we)
+    mx.nd.sgd_mom_update(we, ge, me, lr=0.1, momentum=0.9, out=we)
+    assert np.array_equal(w.asnumpy(), we.asnumpy())
+    assert np.array_equal(m.asnumpy(), me.asnumpy())
+
+
+def test_one_shot_segment_signature_replays_eagerly():
+    # a per-step attr change (lr schedule) makes every segment signature
+    # unique; those must NOT pay a whole-segment trace+compile per flush
+    w = mx.nd.ones((8,))
+    g = mx.nd.ones((8,)) * 0.1
+    m = mx.nd.zeros((8,))
+    n0 = len(R._SEGMENT_CACHE)
+    for i in range(5):
+        with engine.bulk(8):
+            mx.nd.sgd_mom_update(w, g, m, lr=0.1 / (113.7 + i),
+                                 momentum=0.9, out=w)
+    assert len(R._SEGMENT_CACHE) == n0  # replayed eagerly, not compiled
+    # and a REPEATED signature still compiles (second sight)
+    for _ in range(3):
+        with engine.bulk(8):
+            mx.nd.sgd_mom_update(w, g, m, lr=0.0625, momentum=0.9, out=w)
+    assert len(R._SEGMENT_CACHE) == n0 + 1
+
+
+def test_failed_flush_does_not_leave_zombie_segment():
+    if "_test_exit_boom" not in _registry._OPS:
+        import jax
+
+        @_registry.register("_test_exit_boom", no_grad=True)
+        def _test_exit_boom(q):
+            def cb(v):
+                raise ValueError("exit boom")
+            return jax.pure_callback(
+                cb, jax.ShapeDtypeStruct(q.shape, q.dtype), q)
+    x = mx.nd.ones((2,))
+    with pytest.raises(Exception):
+        with engine.bulk(16):
+            R.invoke_by_name("_test_exit_boom", x)
+            # no sync point before scope exit: the flush at exit raises
+    # the segment must be gone and the bulk size restored
+    assert R.bulk_segment_depth() == 0
+    y = x + 1.0  # must execute eagerly, not queue into a zombie segment
+    np.testing.assert_array_equal(y.asnumpy(), np.full((2,), 2.0))
+
+
+def test_engine_set_bulk_size_returns_prev_int():
+    prev = c_runtime.engine_set_bulk_size(7)
+    assert isinstance(prev, int)
+    assert c_runtime.engine_set_bulk_size(prev) == 7
+    assert engine.bulk_size() == prev
+
+
+def test_set_bulk_size_is_a_segment_boundary():
+    x = mx.nd.ones((2,))
+    with engine.bulk(16):
+        c = x + 1.0
+        assert R.bulk_segment_depth() == 1
+        engine.set_bulk_size(engine.bulk_size())  # resize -> flush
+        assert R.bulk_segment_depth() == 0
+    np.testing.assert_array_equal(c.asnumpy(), np.full((2,), 2.0))

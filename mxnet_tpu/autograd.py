@@ -12,10 +12,13 @@ single tape node, which is the ``CachedOp`` analog
 from __future__ import annotations
 
 import threading
+import time as _time
 
 import jax
 import jax.numpy as jnp
 import numpy as _np
+
+from . import profiler as _profiler
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "set_recording", "set_training", "mark_variables",
@@ -192,7 +195,13 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     if len(heads) != len(head_grads):
         raise ValueError("heads and head_grads must have the same length")
 
+    t0 = _time.perf_counter() if _profiler._ACTIVE else None
     grads = _run_backward(heads, head_grads, retain_graph)
+    if t0 is not None:
+        _profiler.record_op("autograd.backward",
+                            (_time.perf_counter() - t0) * 1e6,
+                            category="autograd", lane="autograd",
+                            args={"heads": len(heads)})
 
     # accumulate into .grad of marked leaves
     for var, g in grads.items():
@@ -230,7 +239,15 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     if create_graph:
         return _grad_create_graph(heads, variables, head_grads, single)
 
-    grads = _run_backward(heads, head_grads, retain_graph, targets=variables)
+    t0 = _time.perf_counter() if _profiler._ACTIVE else None
+    grads = _run_backward(heads, head_grads, retain_graph,
+                          targets=variables)
+    if t0 is not None:
+        _profiler.record_op("autograd.grad",
+                            (_time.perf_counter() - t0) * 1e6,
+                            category="autograd", lane="autograd",
+                            args={"heads": len(heads),
+                                  "variables": len(variables)})
     out = []
     for v in variables:
         g = grads.get(v)

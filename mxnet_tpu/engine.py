@@ -140,14 +140,22 @@ def wait_for_all():
     Bulk segments are thread-local (like the reference's per-thread opr
     bulk): another thread's queued-but-unflushed ops are drained by that
     thread's own sync points / engine.bulk scope exit, not by this
-    barrier."""
+    barrier. When profiling is on the barrier is a span in the ``bulk``
+    lane — long bars here mean the device is behind the host."""
     import jax
+    import time as _time
+    from . import profiler as _profiler
+    t0 = _time.perf_counter() if _profiler._ACTIVE else None
     _flush_pending_segment()
     try:
         for d in jax.live_arrays():
             d.block_until_ready()
     except AttributeError:
         (jax.device_put(0.0) + 0).block_until_ready()
+    if t0 is not None:
+        _profiler.record_op("engine.wait_for_all",
+                            (_time.perf_counter() - t0) * 1e6,
+                            category="engine", lane="bulk")
 
 
 def push_sync(fn, *args):

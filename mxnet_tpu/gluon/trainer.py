@@ -14,7 +14,10 @@ reduction happens inside the jitted step as an XLA collective. So
 """
 from __future__ import annotations
 
+import time as _time
+
 from .. import optimizer as opt
+from .. import profiler as _profiler
 from ..ndarray import NDArray
 from .parameter import Parameter
 
@@ -150,7 +153,10 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update: rescale by 1/batch_size, reduce, apply
-        (ref: trainer.py:305)."""
+        (ref: trainer.py:305). A span in the profiler's ``gluon`` lane when
+        profiling is on — the per-step anchor the other lanes (imperative,
+        bulk, kvstore, autograd, memory) line up under."""
+        t0 = _time.perf_counter() if _profiler._ACTIVE else None
         rescale_grad = self._scale / batch_size
         self._check_and_rescale_grad(rescale_grad)
         if not self._kv_initialized:
@@ -159,6 +165,12 @@ class Trainer:
             self._init_params()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        if t0 is not None:
+            _profiler.record_op(
+                "gluon.Trainer.step", (_time.perf_counter() - t0) * 1e6,
+                category="gluon", lane="gluon",
+                args={"batch_size": batch_size,
+                      "params": len(self._params)})
 
     def allreduce_grads(self):
         """Explicit reduce step for when update() is called separately

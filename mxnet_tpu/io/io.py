@@ -3,12 +3,14 @@ from __future__ import annotations
 
 import collections
 import threading
+import time as _time
 import queue as _queue
 
 import numpy as np
 
 from ..ndarray import NDArray, array as nd_array
 from .. import ndarray as nd
+from .. import profiler as _profiler
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "ResizeIter", "PrefetchingIter", "MNISTIter"]
@@ -373,6 +375,18 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
+        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        batch = self._next_impl()
+        if t0 is not None:
+            _profiler.record_op(
+                "io.prefetch_next", (_time.perf_counter() - t0) * 1e6,
+                category="io", lane="io",
+                args={"queue_depth": self._queue.qsize()})
+            _profiler.record_counter("io.prefetch_queue_depth",
+                                     self._queue.qsize(), lane="io")
+        return batch
+
+    def _next_impl(self):
         while True:
             epoch, batch = self._queue.get()
             if epoch != self._epoch:

@@ -14,6 +14,9 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
+
+from .. import profiler as _profiler
 
 __all__ = ["DevicePrefetchIter", "DevicePrefetcher"]
 
@@ -79,8 +82,21 @@ class DevicePrefetchIter:
         def worker():
             try:
                 for batch in self._it:
-                    if stop.is_set() or not put(self._place(batch)):
+                    t0 = _time.perf_counter() if _profiler._ACTIVE \
+                        else None
+                    placed = self._place(batch)
+                    if t0 is not None:
+                        _profiler.record_op(
+                            "io.batch_place",
+                            (_time.perf_counter() - t0) * 1e6,
+                            category="io", lane="io",
+                            args={"queue_depth": q.qsize()})
+                    if stop.is_set() or not put(placed):
                         return
+                    if t0 is not None:
+                        _profiler.record_counter(
+                            "io.prefetch_queue_depth", q.qsize(),
+                            lane="io")
             except BaseException as e:  # noqa: BLE001 — propagate to consumer
                 put(e)
                 return
@@ -110,7 +126,17 @@ class DevicePrefetchIter:
         return self
 
     def __next__(self):
+        # batch-fetch span: how long the consumer stalled waiting on the
+        # producer (queue-empty time = the pipeline is io-bound)
+        t0 = _time.perf_counter() if _profiler._ACTIVE else None
         item = self._q.get()
+        if t0 is not None:
+            _profiler.record_op(
+                "io.batch_fetch", (_time.perf_counter() - t0) * 1e6,
+                category="io", lane="io",
+                args={"queue_depth": self._q.qsize()})
+            _profiler.record_counter("io.prefetch_queue_depth",
+                                     self._q.qsize(), lane="io")
         if item is _SENTINEL:
             raise StopIteration
         if isinstance(item, BaseException):

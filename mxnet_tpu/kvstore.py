@@ -31,11 +31,13 @@ from __future__ import annotations
 
 import os
 import pickle
+import time as _time
 import warnings
 
 from .ndarray import NDArray
 from . import ndarray as nd
 from . import optimizer as opt
+from . import profiler as _profiler
 
 __all__ = ["KVStore", "create"]
 
@@ -94,11 +96,20 @@ class KVStore:
     # -- init/push/pull ----------------------------------------------------
     def init(self, key, value):
         """Initialize a key with a value (ref: kvstore.py init)."""
+        t0 = _time.perf_counter() if _profiler._ACTIVE else None
         keys, vals = _ctype_key_value(key, value)
+        nbytes = 0
         for k, vlist in zip(keys, vals):
             if k in self._store:
                 continue
+            nbytes += int(vlist[0].nbytes)
             self._store[k] = NDArray(vlist[0]._data)
+        if t0 is not None:
+            _profiler.record_op(
+                "kvstore.init", (_time.perf_counter() - t0) * 1e6,
+                category="kvstore", lane="kvstore",
+                args={"keys": len(keys), "bytes": nbytes,
+                      "type": self._kind})
 
     def push(self, key, value, priority=0):
         """Push values; multiple values per key are reduced (summed) exactly
@@ -106,6 +117,8 @@ class KVStore:
         set, the update is applied server-side (update_on_kvstore mode,
         ref: src/kvstore/kvstore_dist_server.h:346 ApplyUpdates)."""
         from .ndarray.sparse import RowSparseNDArray
+        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        b0 = self.bytes_pushed
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
@@ -123,10 +136,20 @@ class KVStore:
                 self._updater(idx, merged, self._store[k])
             else:
                 self._store[k] = NDArray(merged._data)
+        if t0 is not None:
+            moved = self.bytes_pushed - b0
+            _profiler.record_op(
+                "kvstore.push", (_time.perf_counter() - t0) * 1e6,
+                category="kvstore", lane="kvstore",
+                args={"keys": len(keys), "bytes": moved,
+                      "type": self._kind})
+            _profiler.account("kvstore.bytes_pushed", moved)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Pull values into `out` (ref: kvstore.py pull)."""
         assert out is not None
+        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        b0 = self.bytes_pulled
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -135,6 +158,14 @@ class KVStore:
             for o in olist:
                 self.bytes_pulled += int(src.nbytes)
                 o._data = src._data
+        if t0 is not None:
+            moved = self.bytes_pulled - b0
+            _profiler.record_op(
+                "kvstore.pull", (_time.perf_counter() - t0) * 1e6,
+                category="kvstore", lane="kvstore",
+                args={"keys": len(keys), "bytes": moved,
+                      "type": self._kind})
+            _profiler.account("kvstore.bytes_pulled", moved)
         return out
 
     def pushpull(self, key, value, out=None, priority=0):
@@ -150,6 +181,8 @@ class KVStore:
         src/kvstore/kvstore_dist.h:522 EncodeRowSparseKey). Dense storage
         with row gather on TPU."""
         assert out is not None and row_ids is not None
+        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        b0 = self.bytes_pulled
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
@@ -169,6 +202,15 @@ class KVStore:
                     o._data = new._data
                 else:
                     o._data = src._data
+        if t0 is not None:
+            moved = self.bytes_pulled - b0
+            _profiler.record_op(
+                "kvstore.row_sparse_pull",
+                (_time.perf_counter() - t0) * 1e6,
+                category="kvstore", lane="kvstore",
+                args={"keys": len(keys), "bytes": moved,
+                      "type": self._kind})
+            _profiler.account("kvstore.bytes_pulled", moved)
         return out
 
     def broadcast(self, key, value, out=None, priority=0):

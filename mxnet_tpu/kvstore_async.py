@@ -39,9 +39,12 @@ import secrets as _secrets
 import socket
 import struct
 import threading
+import time as _ptime
 import warnings
 
 import numpy as np
+
+from . import profiler as _profiler
 
 __all__ = ["AsyncPSServer", "AsyncPSClient", "serve_if_rank0"]
 
@@ -490,6 +493,8 @@ class AsyncPSClient:
             except (ConnectionRefusedError, OSError):
                 if attempt == self._retries - 1:
                     raise
+                if _profiler._ACTIVE:
+                    _profiler.account("kvstore.connect_retries", 1)
                 time.sleep(0.1)  # server still coming up on its rank
         self._sock = sock
 
@@ -507,7 +512,13 @@ class AsyncPSClient:
                 try:
                     self.heartbeat(rank)
                     failures = 0
+                    if _profiler._ACTIVE:
+                        _profiler.account("kvstore.heartbeats", 1,
+                                          emit=False)
                 except (ConnectionError, OSError, RuntimeError):
+                    if _profiler._ACTIVE:
+                        _profiler.account("kvstore.heartbeat_failures", 1,
+                                          emit=False)
                     # a straggler server may not be up yet (lazy
                     # connect): keep beating; give up only after a
                     # sustained outage, loudly
@@ -736,9 +747,12 @@ class AsyncKVStore:
     def init(self, key, value):
         from .kvstore import _ctype_key_value
         from .ndarray.sparse import RowSparseNDArray
+        t0 = _ptime.perf_counter() if _profiler._ACTIVE else None
+        nbytes = 0
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             host = vlist[0].asnumpy()
+            nbytes += int(host.nbytes)
             if isinstance(vlist[0], RowSparseNDArray):
                 # row-sparse params route whole-key (push does too) —
                 # splitting would strand the key the RSP push targets
@@ -758,14 +772,24 @@ class AsyncKVStore:
                     off += ln
             else:
                 self._clients[self._owner(k)].init(k, host)
+        if t0 is not None:
+            _profiler.record_op(
+                "kvstore_async.init", (_ptime.perf_counter() - t0) * 1e6,
+                category="kvstore", lane="kvstore",
+                args={"keys": len(keys), "bytes": nbytes})
 
     def push(self, key, value, priority=0):
         from .kvstore import _ctype_key_value
         from .ndarray.sparse import RowSparseNDArray
         import mxnet_tpu.ndarray as nd
+        t0 = _ptime.perf_counter() if _profiler._ACTIVE else None
+        nbytes = 0
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
+            if t0 is not None:
+                nbytes += int(merged.wire_nbytes if isinstance(
+                    merged, RowSparseNDArray) else merged.nbytes)
             if isinstance(merged, RowSparseNDArray):
                 # row-sparse keys are whole-key routed (the reference
                 # splits rows too; documented simplification — lazy
@@ -784,6 +808,12 @@ class AsyncKVStore:
                 self._fanout(lambda j: self._push_dense(*j), jobs)
             else:
                 self._push_dense(self._owner(k), k, merged.asnumpy())
+        if t0 is not None:
+            _profiler.record_op(
+                "kvstore_async.push", (_ptime.perf_counter() - t0) * 1e6,
+                category="kvstore", lane="kvstore",
+                args={"keys": len(keys), "bytes": nbytes})
+            _profiler.account("kvstore.bytes_pushed", nbytes)
 
     def _push_dense(self, cidx, key, host):
         if self._compression is not None \
@@ -848,11 +878,22 @@ class AsyncKVStore:
         from .kvstore import _ctype_key_value
         import jax.numpy as jnp
         assert out is not None
+        t0 = _ptime.perf_counter() if _profiler._ACTIVE else None
+        nbytes = 0
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
-            arr = jnp.asarray(self._pull_host(k))
+            host = self._pull_host(k)
+            if t0 is not None:
+                nbytes += int(host.nbytes) * len(olist)
+            arr = jnp.asarray(host)
             for o in olist:
                 o._data = arr
+        if t0 is not None:
+            _profiler.record_op(
+                "kvstore_async.pull", (_ptime.perf_counter() - t0) * 1e6,
+                category="kvstore", lane="kvstore",
+                args={"keys": len(keys), "bytes": nbytes})
+            _profiler.account("kvstore.bytes_pulled", nbytes)
         return out
 
     def pushpull(self, key, value, out=None, priority=0):

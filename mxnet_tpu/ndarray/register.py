@@ -37,12 +37,14 @@ from __future__ import annotations
 import inspect
 import os
 import threading
+import time as _time
 
 import jax
 import numpy as _np
 
 from .. import autograd
 from .. import engine as _engine
+from .. import profiler as _profiler
 from .. import random as _random
 from ..ops import registry as _registry
 from .ndarray import NDArray, _PendingSlot
@@ -50,7 +52,25 @@ from .ndarray import NDArray, _PendingSlot
 __all__ = ["invoke", "invoke_by_name", "make_op_func", "populate",
            "invoke_getitem", "imperative_jit_enabled", "set_imperative_jit",
            "dispatch_stats", "reset_dispatch_stats", "flush_bulk_segment",
-           "bulk_segment_depth"]
+           "bulk_segment_depth", "set_profiler_hooks"]
+
+# Telemetry hooks at the dispatch choke points (the engine OprBlock hook
+# analog, src/profiler/profiler.h:251). When profiling is off the entire
+# cost is `_HOOKS and _profiler._ACTIVE` — two truth tests — per op;
+# BENCH_MODEL=profiler_overhead gates that at <2% of eager dispatch.
+# MXNET_PROFILER_HOOKS=0 removes even that (bench baseline / paranoia).
+_HOOKS = os.environ.get("MXNET_PROFILER_HOOKS", "1") \
+    not in ("0", "false", "off")
+
+
+def set_profiler_hooks(enabled):
+    """Toggle the profiler instrumentation guards at runtime (the env var
+    ``MXNET_PROFILER_HOOKS`` sets the process default). Returns the
+    previous value."""
+    global _HOOKS
+    prev = _HOOKS
+    _HOOKS = bool(enabled)
+    return prev
 
 _SPEC_CACHE = {}
 
@@ -284,7 +304,17 @@ def _cached_callable(opdef, key, partial_key, args, kwargs, arg_slots,
     return fn
 
 
+def _record_invoke(opdef, t0):
+    _profiler.record_op(opdef.name, (_time.perf_counter() - t0) * 1e6,
+                        category="operator", lane="imperative")
+
+
 def invoke(opdef, args, kwargs):
+    # telemetry guard is inlined (no wrapper call): with profiling off the
+    # whole cost is this one conditional plus two `is not None` tests at
+    # the return sites (BENCH_MODEL=profiler_overhead gates it at <2%)
+    _prof_t0 = _time.perf_counter() if (_HOOKS and _profiler._ACTIVE) \
+        else None
     spec = _spec(opdef)
     if _amp_cast_hook is not None or spec["has_key"] or spec["has_training"]:
         kwargs = dict(kwargs)
@@ -318,6 +348,8 @@ def invoke(opdef, args, kwargs):
             out = seg.try_queue(opdef, spec, args, kwargs, arg_slots,
                                 kw_slots, nd_inputs)
             if out is not _NOT_BULKED:
+                if _prof_t0 is not None:
+                    _record_invoke(opdef, _prof_t0)
                 return out
 
     datas = tuple(a._data for a in nd_inputs)
@@ -403,6 +435,8 @@ def invoke(opdef, args, kwargs):
             node = autograd.record_op(opdef.name, outs, nd_inputs, vjp_fn)
             node.fwd_fn = fwd
         # else: non-differentiable output — gradient stops here
+    if _prof_t0 is not None:
+        _record_invoke(opdef, _prof_t0)
     return tuple(outs) if multi else outs[0]
 
 
@@ -661,9 +695,30 @@ class _BulkSegment:
 
     def flush(self):
         """Execute all queued ops as one jitted program and deliver the
-        results onto their NDArrays."""
+        results onto their NDArrays. When profiling is on, the flush is a
+        span in the ``bulk`` lane carrying the op count and whether this
+        segment compiled, replayed a cached program, or ran eagerly — and
+        a memory sample lands at the boundary (allocation churn point)."""
         if not self.ops:
             return
+        if _HOOKS and _profiler._ACTIVE:
+            n_ops = len(self.ops)
+            t0 = _time.perf_counter()
+            mode = self._flush_impl()
+            _profiler.record_op(
+                "bulk_segment", (_time.perf_counter() - t0) * 1e6,
+                category="bulk", lane="bulk",
+                args={"ops": n_ops, "mode": mode})
+            _profiler.sample_memory("bulk_flush")
+        else:
+            self._flush_impl()
+
+    def _flush_impl(self):
+        """Returns how the segment executed: ``cached`` (jitted runner
+        hit), ``compile`` (runner traced+compiled this flush),
+        ``eager-warming`` (signature below the compile-on-repeat
+        threshold), or ``eager-fallback`` (runner raised; replayed
+        untraced)."""
         ops, leaves, outs = self.ops, self.leaves, self.outs
         self.ops, self.leaves, self.outs = [], [], []
         self.leaf_ids = {}
@@ -671,6 +726,7 @@ class _BulkSegment:
         sig = (tuple((name, statics, in_refs, multi)
                      for name, statics, in_refs, _call, multi in ops),
                tuple(_aval(l) for l in leaves))
+        mode = "cached"
         runner = _SEGMENT_CACHE.get(sig)
         if runner is None:
             # compile-on-repeat, like the dispatch cache: a signature seen
@@ -684,7 +740,7 @@ class _BulkSegment:
             if seen < _JIT_THRESHOLD:
                 self._replay_eager(ops, leaves, outs)
                 _STATS["bulk_flushes"] += 1
-                return
+                return "eager-warming"
             if len(_SEGMENT_CACHE) >= _CACHE_CAP:
                 _SEGMENT_CACHE.clear()
             spec = [(_build_traced(*call), in_refs, multi)
@@ -701,6 +757,7 @@ class _BulkSegment:
 
             runner = jax.jit(run)
             _SEGMENT_CACHE[sig] = runner
+            mode = "compile"
 
         try:
             results = runner(leaves)
@@ -710,11 +767,12 @@ class _BulkSegment:
             # untraced path, and stop bulking the offending ops
             self._replay_eager(ops, leaves, outs, blacklist=True)
             _STATS["bulk_flushes"] += 1
-            return
+            return "eager-fallback"
         _STATS["bulk_flushes"] += 1
         for arr, slot, i, k in outs:
             if arr._buf is slot:  # not overwritten since queueing
                 arr._buf = results[i][k]
+        return mode
 
     @staticmethod
     def _replay_eager(ops, leaves, outs, blacklist=False):

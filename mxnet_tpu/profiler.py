@@ -13,8 +13,22 @@ hooks every engine OprBlock; here the analog is twofold:
   Domain/Task/Frame/Event/Counter/Marker objects
   (ref: python/mxnet/profiler.py:226-491).
 
-Scoped op timing is recorded by the NDArray/op layer via ``record_op`` when
-profiling is on (zero cost when off).
+The host trace is organized into stable **lanes** (chrome-trace tid rows
+named via ``thread_name`` metadata, ≙ the reference's per-device/per-thread
+profiling domains, profiler.h:120 DeviceStats): ``imperative`` (op dispatch),
+``bulk`` (segment flushes), ``kvstore`` (push/pull/init + wire counters),
+``io`` (prefetch spans + queue depth), ``autograd`` (backward sweeps),
+``memory`` (per-device HBM counters), ``gluon`` (Trainer.step), and ``user``
+(Domain/Task/... objects). Subsystems emit through ``record_op`` /
+``record_counter`` / ``account`` and guard on ``profiler._ACTIVE`` first, so
+everything is zero-cost when profiling is off.
+
+``profile_memory`` samples ``storage.stats()`` (PJRT per-device
+bytes_in_use/peak) on a background thread plus at bulk-flush boundaries —
+the analog of the reference pool counters feeding MemoryProfiler.
+``continuous_dump``/``dump_period`` rewrite the trace file atomically every
+period (ref: MXSetContinuousProfileDump) so long runs are inspectable
+mid-flight. ``metrics()`` returns the whole surface as one JSON-safe dict.
 """
 from __future__ import annotations
 
@@ -26,8 +40,21 @@ import time
 __all__ = [
     "set_config", "set_state", "dump", "dumps", "pause", "resume",
     "Domain", "Task", "Frame", "Event", "Counter", "Marker",
-    "record_op", "is_running", "imperative_stats", "reset_imperative_stats",
+    "record_op", "record_counter", "account", "sample_memory", "metrics",
+    "is_running", "imperative_stats", "reset_imperative_stats", "LANES",
 ]
+
+# Stable pid/tid lanes of the host trace. tid doubles as the sort index.
+LANES = {
+    "imperative": 0,
+    "bulk": 1,
+    "kvstore": 2,
+    "io": 3,
+    "autograd": 4,
+    "memory": 5,
+    "gluon": 6,
+    "user": 7,
+}
 
 _lock = threading.Lock()
 _state = {
@@ -36,12 +63,55 @@ _state = {
     "filename": "profile.json",
     "aggregate_stats": False,
     "profile_memory": False,
+    "continuous_dump": False,
+    "dump_period": 1.0,
+    "xprof": True,
     "xprof_dir": None,
     "xprof_active": False,
 }
+# Fast-path guard mirrored from (running and not paused). Subsystem hooks
+# read this module attribute before building any event dict — the
+# profiling-off cost of the whole telemetry layer is this one truth test
+# (BENCH_MODEL=profiler_overhead keeps it honest).
+_ACTIVE = False
+
 _events = []          # chrome-trace event dicts
 _agg = {}             # name -> [count, total_us, min_us, max_us]
+_counters = {}        # cumulative subsystem counters (kvstore/io bytes, ...)
+_mem_last = {}        # str(device) -> last sampled memory dict
 _t0 = time.perf_counter()
+
+# Trace-event cap: a multi-hour run with the 10Hz memory sampler + per-op
+# spans must not grow _events (and the continuous-dump serialization of
+# it) without bound. Aggregate/counter totals keep counting past the cap;
+# only raw timeline events are dropped, tallied in
+# counters['profiler.dropped_events'].
+_MAX_EVENTS = int(os.environ.get("MXNET_PROFILER_MAX_EVENTS", "1000000"))
+# serializes trace-file writers (continuous-dump daemon vs explicit
+# dump()): both write the same temp path, and interleaved writers would
+# break the atomic-rewrite guarantee
+_dump_lock = threading.Lock()
+
+
+def _append_locked(ev):
+    """Append one trace event; caller holds _lock. Drops (and tallies)
+    events past _MAX_EVENTS so unbounded runs stay bounded."""
+    if len(_events) >= _MAX_EVENTS:
+        _counters["profiler.dropped_events"] = \
+            _counters.get("profiler.dropped_events", 0) + 1
+        return
+    _events.append(ev)
+
+
+_mem_thread = None
+_dump_thread = None
+_threads_stop = None
+
+_VALID_CONFIG_KEYS = frozenset((
+    "filename", "aggregate_stats", "profile_memory", "continuous_dump",
+    "dump_period", "xprof", "xprof_dir", "profile_all", "profile_symbolic",
+    "profile_imperative", "profile_api", "profile_process",
+))
 
 
 def _now_us():
@@ -51,60 +121,152 @@ def _now_us():
 def set_config(**kwargs):
     """Configure the profiler (ref: python/mxnet/profiler.py:33
     MXSetProcessProfilerConfig). Accepted keys: ``filename``,
-    ``profile_all/profile_symbolic/profile_imperative/profile_memory/
-    profile_api`` (accepted for parity; host+device tracing is unified here),
-    ``aggregate_stats``, ``continuous_dump``, ``dump_period``,
-    ``profile_process``, and TPU-specific ``xprof_dir`` (directory for an
-    xprof/XLA device trace; defaults next to ``filename``)."""
+    ``profile_all/profile_symbolic/profile_imperative/profile_api``
+    (accepted for parity; host+device tracing is unified here),
+    ``profile_memory`` (background HBM sampling into the ``memory`` lane),
+    ``aggregate_stats``, ``continuous_dump``/``dump_period`` (atomic
+    periodic trace rewrite), ``profile_process``, and TPU-specific
+    ``xprof`` (bool: start a device trace, default True) / ``xprof_dir``
+    (directory for it; defaults next to ``filename``).
+
+    The whole kwargs dict is validated before ANY of it is applied, so a
+    bad call can never leave the config half-mutated."""
+    if not set(kwargs) <= _VALID_CONFIG_KEYS:
+        bad = sorted(set(kwargs) - _VALID_CONFIG_KEYS)
+        raise ValueError("unknown profiler config key%s %s"
+                         % ("s" if len(bad) > 1 else "", ", ".join(
+                             repr(k) for k in bad)))
+    if "dump_period" in kwargs:
+        period = float(kwargs["dump_period"])
+        if period <= 0:
+            raise ValueError("dump_period must be > 0, got %r"
+                             % (kwargs["dump_period"],))
+        kwargs["dump_period"] = period
+    if "filename" in kwargs and not isinstance(kwargs["filename"], str):
+        raise ValueError("filename must be a string")
     with _lock:
         if "filename" in kwargs:
             _state["filename"] = kwargs["filename"]
-        if "aggregate_stats" in kwargs:
-            _state["aggregate_stats"] = bool(kwargs["aggregate_stats"])
-        if "profile_memory" in kwargs:
-            _state["profile_memory"] = bool(kwargs["profile_memory"])
+        for key in ("aggregate_stats", "profile_memory", "continuous_dump",
+                    "xprof"):
+            if key in kwargs:
+                _state[key] = bool(kwargs[key])
+        if "dump_period" in kwargs:
+            _state["dump_period"] = kwargs["dump_period"]
         if "xprof_dir" in kwargs:
             _state["xprof_dir"] = kwargs["xprof_dir"]
-        for k in kwargs:
-            if k not in ("filename", "aggregate_stats", "profile_memory",
-                         "xprof_dir", "profile_all", "profile_symbolic",
-                         "profile_imperative", "profile_api",
-                         "continuous_dump", "dump_period", "profile_process"):
-                raise ValueError("unknown profiler config key %r" % (k,))
 
 
 def set_state(state="stop", profile_process="worker"):
     """Start/stop profiling (ref: python/mxnet/profiler.py:89). Starting also
-    begins an xprof device trace when a trace dir is configured or derivable;
-    xprof start failures fall back to host-only tracing (e.g. when another
-    trace is already active)."""
+    begins an xprof device trace when enabled (``xprof=True``) and a trace
+    dir is configured or derivable — xprof start failures fall back to
+    host-only tracing (e.g. when another trace is already active) — plus
+    the memory-sampler / continuous-dump daemon threads when configured."""
+    global _ACTIVE
     if state not in ("run", "stop"):
         raise ValueError("state must be 'run' or 'stop'")
-    with _lock:
-        if state == "run" and not _state["running"]:
+    if state == "run":
+        with _lock:
+            if _state["running"]:
+                return
             _state["running"] = True
             _state["paused"] = False
-            xdir = _state["xprof_dir"]
-            if xdir is None:
-                xdir = os.path.join(
-                    os.path.dirname(os.path.abspath(_state["filename"])),
-                    "xprof_trace")
-            try:
-                import jax
-                jax.profiler.start_trace(xdir)
-                _state["xprof_active"] = True
-                _state["xprof_dir"] = xdir
-            except Exception:
-                _state["xprof_active"] = False
-        elif state == "stop" and _state["running"]:
+            _ACTIVE = True
+            # xprof start/stop stays under _lock so a racing stop can
+            # never observe a half-started device trace
+            if _state["xprof"]:
+                xdir = _state["xprof_dir"]
+                if xdir is None:
+                    xdir = os.path.join(
+                        os.path.dirname(
+                            os.path.abspath(_state["filename"])),
+                        "xprof_trace")
+                try:
+                    import jax
+                    jax.profiler.start_trace(xdir)
+                    _state["xprof_active"] = True
+                    _state["xprof_dir"] = xdir
+                except Exception:
+                    _state["xprof_active"] = False
+            profile_memory = _state["profile_memory"]
+            continuous = _state["continuous_dump"]
+            period = _state["dump_period"]
+        _start_daemons(profile_memory, continuous, period)
+    else:
+        with _lock:
+            if not _state["running"]:
+                return
             _state["running"] = False
+            _ACTIVE = False
+            continuous = _state["continuous_dump"]
             if _state["xprof_active"]:
+                _state["xprof_active"] = False
                 try:
                     import jax
                     jax.profiler.stop_trace()
                 except Exception:
                     pass
-                _state["xprof_active"] = False
+        _stop_daemons()
+        if continuous:
+            _write_trace()  # final rewrite covers events since last period
+
+
+def _start_daemons(profile_memory, continuous, period):
+    """Background samplers for an active run. The trace file is written
+    IMMEDIATELY when continuous dump is on (then every ``dump_period``), so
+    it exists and parses from the first moment of the run.
+
+    Runs outside set_state's lock hold (thread starts must not happen
+    under _lock), so a racing set_state('stop') is handled two ways: a
+    re-check of ``running`` under _lock before starting anything, and the
+    loops themselves exiting once the run is over — a daemon that lost
+    the race self-terminates within one period instead of leaking."""
+    global _mem_thread, _dump_thread, _threads_stop
+    with _lock:
+        if not _state["running"]:
+            return
+        _threads_stop = threading.Event()
+    stop = _threads_stop
+    if profile_memory:
+        sample_memory("start")
+        sample_period = float(os.environ.get(
+            "MXNET_PROFILER_MEMORY_SAMPLE_PERIOD", "0.1"))
+
+        def _mem_loop():
+            while not stop.wait(sample_period):
+                if not _state["running"]:
+                    return
+                sample_memory("sampler")
+
+        _mem_thread = threading.Thread(
+            target=_mem_loop, daemon=True, name="profiler-mem-sampler")
+        _mem_thread.start()
+    if continuous:
+        _write_trace()
+
+        def _dump_loop():
+            while not stop.wait(period):
+                if not _state["running"]:
+                    return
+                try:
+                    _write_trace()
+                except Exception:
+                    pass  # a failed rewrite must not kill the daemon
+
+        _dump_thread = threading.Thread(
+            target=_dump_loop, daemon=True, name="profiler-continuous-dump")
+        _dump_thread.start()
+
+
+def _stop_daemons():
+    global _mem_thread, _dump_thread, _threads_stop
+    if _threads_stop is not None:
+        _threads_stop.set()
+    for t in (_mem_thread, _dump_thread):
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+    _mem_thread = _dump_thread = _threads_stop = None
 
 
 def is_running():
@@ -112,27 +274,48 @@ def is_running():
 
 
 def pause(profile_process="worker"):
-    """ref: python/mxnet/profiler.py:193."""
-    _state["paused"] = True
+    """ref: python/mxnet/profiler.py:193. Emits a ``profiler.pause``
+    instant marker (while still active, so the trace explains its own
+    gap) and then suspends recording."""
+    global _ACTIVE
+    with _lock:
+        if _state["running"] and not _state["paused"]:
+            _append_locked({"name": "profiler.pause", "cat": "profiler",
+                            "ph": "i", "s": "g", "ts": _now_us(), "pid": 0,
+                            "tid": LANES["user"]})
+        _state["paused"] = True
+        _ACTIVE = False
 
 
 def resume(profile_process="worker"):
-    """ref: python/mxnet/profiler.py:209."""
-    _state["paused"] = False
+    """ref: python/mxnet/profiler.py:209. Re-enables recording and emits a
+    ``profiler.resume`` instant marker bounding the gap."""
+    global _ACTIVE
+    with _lock:
+        was_paused = _state["paused"]
+        _state["paused"] = False
+        _ACTIVE = _state["running"]
+        if _state["running"] and was_paused:
+            _append_locked({"name": "profiler.resume", "cat": "profiler",
+                            "ph": "i", "s": "g", "ts": _now_us(), "pid": 0,
+                            "tid": LANES["user"]})
 
 
-def record_op(name, dur_us, category="operator", args=None):
-    """Record one completed op (called by the runtime when profiling is on).
-    Mirrors the engine's ProfileOperator (src/engine/threaded_engine.h:83)."""
-    if not is_running():
+def record_op(name, dur_us, category="operator", args=None,
+              lane="imperative"):
+    """Record one completed span into ``lane`` (called by the runtime when
+    profiling is on). Mirrors the engine's ProfileOperator
+    (src/engine/threaded_engine.h:83)."""
+    if not _ACTIVE:
         return
     end = _now_us()
     ev = {"name": name, "cat": category, "ph": "X",
-          "ts": end - dur_us, "dur": dur_us, "pid": 0, "tid": 0}
+          "ts": end - dur_us, "dur": dur_us, "pid": 0,
+          "tid": LANES.get(lane, LANES["user"])}
     if args:
         ev["args"] = args
     with _lock:
-        _events.append(ev)
+        _append_locked(ev)
         st = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
         st[0] += 1
         st[1] += dur_us
@@ -140,23 +323,138 @@ def record_op(name, dur_us, category="operator", args=None):
         st[3] = max(st[3], dur_us)
 
 
-def _emit(name, ph, cat, ts=None, args=None, tid=0):
-    ev = {"name": name, "cat": cat, "ph": ph,
-          "ts": _now_us() if ts is None else ts, "pid": 0, "tid": tid}
-    if args is not None:
-        ev["args"] = args
+def record_counter(name, value, lane="user", series=None):
+    """Emit a gauge sample (chrome Counter event) into ``lane`` — e.g. the
+    io prefetch queue depth. ``series`` optionally names multiple stacked
+    series (a dict of series -> value)."""
+    if not _ACTIVE:
+        return
+    args = dict(series) if series is not None else {"value": value}
+    ev = {"name": name, "cat": "counter", "ph": "C", "ts": _now_us(),
+          "pid": 0, "tid": LANES.get(lane, LANES["user"]), "args": args}
     with _lock:
-        _events.append(ev)
+        _append_locked(ev)
 
 
-def dump(finished=True, profile_process="worker"):
-    """Write accumulated events as chrome://tracing JSON to ``filename``
-    (ref: python/mxnet/profiler.py:122, DumpProfile profiler.h:299)."""
+def account(name, delta, lane="kvstore", emit=True):
+    """Accumulate a cumulative subsystem counter (kvstore bytes pushed,
+    connect retries, heartbeats, io batches, ...) and, by default, emit the
+    running total as a Counter event so the trace shows it over time. The
+    totals surface in ``dumps()`` and ``metrics()['counters']``."""
+    if not _ACTIVE:
+        return
     with _lock:
-        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        total = _counters.get(name, 0) + delta
+        _counters[name] = total
+        if emit:
+            _append_locked({"name": name, "cat": "counter", "ph": "C",
+                            "ts": _now_us(), "pid": 0,
+                            "tid": LANES.get(lane, LANES["user"]),
+                            "args": {"value": total}})
+
+
+def sample_memory(trigger=None):
+    """Sample per-device memory (``storage.stats()``) into Counter events
+    on the ``memory`` lane and remember the snapshot for the ``dumps()``
+    table / ``metrics()``. No-op unless profiling is active with
+    ``profile_memory=True``. Called by the background sampler and at
+    bulk-flush boundaries (the allocation-churn points)."""
+    if not (_ACTIVE and _state["profile_memory"]):
+        return
+    try:
+        from . import storage
+        device_stats = storage.stats()
+    except Exception:
+        return
+    ts = _now_us()
+    events, snap = [], {}
+    for s in device_stats:
+        dev = str(s.device)
+        events.append({
+            "name": "memory:%s" % dev, "cat": "memory", "ph": "C",
+            "ts": ts, "pid": 0, "tid": LANES["memory"],
+            "args": {"bytes_in_use": s.bytes_in_use,
+                     "peak_bytes_in_use": s.peak_bytes_in_use}})
+        snap[dev] = {
+            "bytes_in_use": s.bytes_in_use,
+            "peak_bytes_in_use": s.peak_bytes_in_use,
+            "peak_since_reset": getattr(s, "peak_since_reset", 0),
+            "num_allocs": s.num_allocs,
+        }
+    with _lock:
+        if not (_state["running"] and _state["profile_memory"]):
+            return  # stopped while sampling: don't write into a dead run
+        for ev in events:
+            _append_locked(ev)
+        _mem_last.update(snap)
+
+
+def _lane_metadata():
+    """chrome-trace metadata naming the process and every lane row."""
+    events = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "mxnet_tpu"}},
+        {"name": "process_sort_index", "ph": "M", "pid": 0,
+         "args": {"sort_index": 0}},
+    ]
+    for lane, tid in sorted(LANES.items(), key=lambda kv: kv[1]):
+        events.append({"name": "thread_name", "ph": "M", "pid": 0,
+                       "tid": tid, "args": {"name": lane}})
+        events.append({"name": "thread_sort_index", "ph": "M", "pid": 0,
+                       "tid": tid, "args": {"sort_index": tid}})
+    return events
+
+
+def _write_trace():
+    """Atomically (write-temp + rename) dump the chrome trace, so a reader
+    — or a crash — mid-rewrite never sees a truncated JSON file. Writers
+    (continuous-dump daemon vs explicit dump()) are serialized under
+    _dump_lock: they share the temp path, and an interleaved pair would
+    publish corrupt JSON or race os.replace."""
+    with _lock:
+        data = {"traceEvents": _lane_metadata() + list(_events),
+                "displayTimeUnit": "ms"}
         fn = _state["filename"]
-    with open(fn, "w") as f:
-        json.dump(data, f)
+    with _dump_lock:
+        _atomic_json_write(fn, data)
+
+
+def _atomic_json_write(fn, data):
+    """write-temp + rename under _dump_lock (caller holds it). Events may
+    carry arbitrary user args (record_op/record_counter are public), so
+    unserializable values degrade to str() instead of failing the dump;
+    the temp file never outlives a failed write."""
+    tmp = "%s.tmp.%d" % (fn, os.getpid())
+    try:
+        with open(tmp, "w") as f:
+            json.dump(data, f, default=str)
+        os.replace(tmp, fn)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def dump(finished=True, profile_process="worker", format="chrome"):
+    """Write accumulated telemetry to ``filename``
+    (ref: python/mxnet/profiler.py:122, DumpProfile profiler.h:299).
+
+    ``format='chrome'`` (or ``'json'``): the chrome://tracing event file.
+    ``format='metrics'``: the ``metrics()`` snapshot as JSON — the
+    machine-readable aggregate surface for scrapers/bench harnesses."""
+    if format in ("chrome", "json"):
+        _write_trace()
+    elif format == "metrics":
+        data = metrics()
+        with _lock:
+            fn = _state["filename"]
+        with _dump_lock:
+            _atomic_json_write(fn, data)
+    else:
+        raise ValueError("format must be 'chrome', 'json' or 'metrics', "
+                         "got %r" % (format,))
 
 
 def imperative_stats():
@@ -173,18 +471,59 @@ def reset_imperative_stats():
     _register.reset_dispatch_stats()
 
 
-def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Return aggregate stats as a text table (ref: profiler.py:151,
-    src/profiler/aggregate_stats.cc), followed by the imperative
-    dispatch-cache counters."""
-    key_idx = {"count": 0, "total": 1, "min": 2, "max": 3,
-               "avg": None}.get(sort_by, 1)
+def _agg_rows():
+    """[(name, count, total, min, max, avg)] snapshot — callers hold _lock."""
+    return [(n, s[0], s[1], s[2] if s[0] else 0.0, s[3],
+             s[1] / s[0] if s[0] else 0.0) for n, s in _agg.items()]
+
+
+def metrics(reset=False):
+    """One JSON-safe snapshot of everything the profiler knows: the
+    aggregate span table, imperative dispatch-cache counters, cumulative
+    subsystem counters (kvstore/io), and the last per-device memory sample.
+    ``json.dumps(profiler.metrics())`` always works — bench.py and external
+    scrapers consume this instead of parsing the ``dumps()`` text table."""
     with _lock:
-        rows = [(n, s[0], s[1], s[2] if s[0] else 0.0, s[3],
-                 s[1] / s[0] if s[0] else 0.0) for n, s in _agg.items()]
+        rows = _agg_rows()
+        counters = dict(_counters)
+        memory = {dev: dict(vals) for dev, vals in _mem_last.items()}
+        num_events = len(_events)
         if reset:
             _agg.clear()
             _events.clear()
+            _counters.clear()
+            _mem_last.clear()
+    out = {
+        "aggregate": {
+            n: {"count": c, "total_us": tot, "min_us": mn, "max_us": mx,
+                "avg_us": avg}
+            for n, c, tot, mn, mx, avg in rows},
+        "imperative": imperative_stats(),
+        "counters": counters,
+        "memory": memory,
+        "num_events": num_events,
+    }
+    if reset:
+        reset_imperative_stats()
+    return out
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Return aggregate stats as a text table (ref: profiler.py:151,
+    src/profiler/aggregate_stats.cc), followed by the imperative
+    dispatch-cache counters, cumulative subsystem counters, and — when
+    memory profiling sampled anything — a per-device memory table."""
+    key_idx = {"count": 0, "total": 1, "min": 2, "max": 3,
+               "avg": None}.get(sort_by, 1)
+    with _lock:
+        rows = _agg_rows()
+        counters = dict(_counters)
+        memory = {dev: dict(vals) for dev, vals in _mem_last.items()}
+        if reset:
+            _agg.clear()
+            _events.clear()
+            _counters.clear()
+            _mem_last.clear()
     if key_idx is None:
         rows.sort(key=lambda r: r[5], reverse=not ascending)
     else:
@@ -200,9 +539,42 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
                  "fallbacks=%d bulk_flushes=%d bulk_ops=%d"
                  % (st["hits"], st["misses"], st["retraces"],
                     st["fallbacks"], st["bulk_flushes"], st["bulk_ops"]))
+    if counters:
+        lines.append("counters: " + " ".join(
+            "%s=%s" % (k, counters[k]) for k in sorted(counters)))
+    if memory:
+        lines.append("")
+        lines.append("%-24s %16s %16s %16s" % (
+            "Device memory", "In use(B)", "Peak(B)", "PeakSinceReset(B)"))
+        for dev in sorted(memory):
+            m = memory[dev]
+            lines.append("%-24s %16d %16d %16d" % (
+                dev[:24], m["bytes_in_use"], m["peak_bytes_in_use"],
+                m["peak_since_reset"]))
     if reset:
         reset_imperative_stats()
     return "\n".join(lines)
+
+
+def _reset():
+    """Stop profiling and clear every recorded artifact (test helper)."""
+    set_state("stop")
+    with _lock:
+        _events.clear()
+        _agg.clear()
+        _counters.clear()
+        _mem_last.clear()
+    reset_imperative_stats()
+
+
+def _emit(name, ph, cat, ts=None, args=None, tid=None):
+    ev = {"name": name, "cat": cat, "ph": ph,
+          "ts": _now_us() if ts is None else ts, "pid": 0,
+          "tid": LANES["user"] if tid is None else tid}
+    if args is not None:
+        ev["args"] = args
+    with _lock:
+        _append_locked(ev)
 
 
 # -- user-defined profiling objects (ref: profiler.py:226-491) ---------------
@@ -246,7 +618,7 @@ class _Span:
         if is_running():
             dur = _now_us() - self._start
             record_op("%s::%s" % (self.domain, self.name), dur,
-                      category=self._ph_cat)
+                      category=self._ph_cat, lane="user")
         self._start = None
 
     def __enter__(self):

@@ -16,9 +16,18 @@ control*, not reimplementation:
 from __future__ import annotations
 
 import gc
+import threading
 
 __all__ = ["DeviceStats", "stats", "total_bytes_in_use", "release_all",
-           "empty_cache"]
+           "empty_cache", "reset_peak"]
+
+# Framework-side high-water mark per device, updated on every stats() call.
+# PJRT's own peak_bytes_in_use is cumulative for the process and cannot be
+# reset, so per-step peak deltas (profiler memory samples between steps)
+# come from this re-derivable mark instead: reset_peak() rebases it to the
+# current usage and the next samples grow it from there.
+_hwm_lock = threading.Lock()
+_hwm = {}  # str(device) -> high-water bytes_in_use since last reset_peak()
 
 
 class DeviceStats:
@@ -32,6 +41,7 @@ class DeviceStats:
         self.bytes_limit = int(raw.get("bytes_limit", 0))
         self.num_allocs = int(raw.get("num_allocs", 0))
         self.largest_alloc_size = int(raw.get("largest_alloc_size", 0))
+        self.peak_since_reset = 0  # filled in by stats()
         self.raw = dict(raw)
 
     def __repr__(self):
@@ -42,15 +52,44 @@ class DeviceStats:
 
 def stats():
     """Per-device memory stats from PJRT. CPU devices may not report stats;
-    they yield zeroed entries."""
+    they yield zeroed entries. Each call advances the framework-side
+    high-water mark backing ``peak_since_reset`` (see ``reset_peak``)."""
     import jax
     out = []
-    for d in jax.devices():
-        try:
-            raw = d.memory_stats() or {}
-        except Exception:
-            raw = {}
-        out.append(DeviceStats(d, raw))
+    with _hwm_lock:
+        for d in jax.devices():
+            try:
+                raw = d.memory_stats() or {}
+            except Exception:
+                raw = {}
+            ds = DeviceStats(d, raw)
+            key = str(d)
+            mark = _hwm.get(key)
+            if mark is None or ds.bytes_in_use > mark:
+                mark = ds.bytes_in_use
+                _hwm[key] = mark
+            ds.peak_since_reset = mark
+            out.append(ds)
+    return out
+
+
+def reset_peak():
+    """Rebase the framework-side peak mark to current usage (per device),
+    so ``DeviceStats.peak_since_reset`` measures the high-water mark of the
+    window since this call — e.g. one training step between two profiler
+    memory samples. PJRT's own ``peak_bytes_in_use`` is process-cumulative
+    and stays untouched. Returns {str(device): rebased bytes_in_use}."""
+    import jax
+    out = {}
+    with _hwm_lock:
+        for d in jax.devices():
+            try:
+                raw = d.memory_stats() or {}
+            except Exception:
+                raw = {}
+            key = str(d)
+            _hwm[key] = int(raw.get("bytes_in_use", 0))
+            out[key] = _hwm[key]
     return out
 
 

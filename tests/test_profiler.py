@@ -1,0 +1,447 @@
+"""Unified runtime telemetry tests (ref: tests/python/unittest/
+test_profiler.py): set_config validation, record_op aggregation, trace
+lanes, memory sampling, continuous dump, pause/resume markers, metrics()
+round-trip, subsystem instrumentation, and storage.reset_peak."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon, profiler, storage
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler(tmp_path):
+    profiler._reset()
+    profiler.set_config(filename=str(tmp_path / "profile.json"),
+                        xprof=False, profile_memory=False,
+                        continuous_dump=False, dump_period=1.0)
+    yield
+    profiler._reset()
+    profiler.set_config(filename="profile.json", profile_memory=False,
+                        continuous_dump=False, xprof=True)
+
+
+def _trace(fn=None):
+    fn = fn or profiler._state["filename"]
+    with open(fn) as f:
+        return json.load(f)
+
+
+def _lane_events(data, lane):
+    tid = profiler.LANES[lane]
+    return [e for e in data["traceEvents"]
+            if e.get("tid") == tid and e.get("ph") in ("X", "C", "i")]
+
+
+# -- set_config (satellite: atomic validation) ------------------------------
+
+def test_set_config_unknown_key_rejected_before_any_mutation(tmp_path):
+    fn_before = profiler._state["filename"]
+    with pytest.raises(ValueError, match="bogus"):
+        profiler.set_config(filename=str(tmp_path / "other.json"),
+                            aggregate_stats=True, bogus=1)
+    # the KNOWN keys in the same call must not have been applied
+    assert profiler._state["filename"] == fn_before
+    assert profiler._state["aggregate_stats"] is False
+
+
+def test_set_config_dump_period_validated_before_apply():
+    with pytest.raises(ValueError, match="dump_period"):
+        profiler.set_config(continuous_dump=True, dump_period=0)
+    assert profiler._state["continuous_dump"] is False
+
+
+def test_set_config_accepts_reference_parity_keys():
+    profiler.set_config(profile_all=True, profile_symbolic=True,
+                        profile_imperative=True, profile_api=True,
+                        profile_process="worker")
+
+
+# -- record_op aggregation ---------------------------------------------------
+
+def test_record_op_aggregates_and_dumps_table():
+    profiler.set_state("run")
+    profiler.record_op("opA", 10.0)
+    profiler.record_op("opA", 30.0)
+    profiler.record_op("opB", 5.0)
+    profiler.set_state("stop")
+    table = profiler.dumps()
+    m = profiler.metrics()
+    assert m["aggregate"]["opA"]["count"] == 2
+    assert m["aggregate"]["opA"]["total_us"] == pytest.approx(40.0)
+    assert m["aggregate"]["opA"]["min_us"] == pytest.approx(10.0)
+    assert m["aggregate"]["opA"]["max_us"] == pytest.approx(30.0)
+    assert "opA" in table and "opB" in table
+    assert "imperative dispatch:" in table
+
+
+def test_record_op_is_noop_when_stopped_or_paused():
+    profiler.record_op("ghost", 10.0)
+    assert "ghost" not in profiler.metrics()["aggregate"]
+    profiler.set_state("run")
+    profiler.pause()
+    profiler.record_op("ghost", 10.0)
+    profiler.resume()
+    profiler.set_state("stop")
+    assert "ghost" not in profiler.metrics()["aggregate"]
+
+
+# -- pause/resume markers (satellite) ---------------------------------------
+
+def test_pause_resume_emit_instant_markers():
+    profiler.set_state("run")
+    profiler.pause()
+    assert not profiler.is_running()
+    profiler.resume()
+    assert profiler.is_running()
+    profiler.set_state("stop")
+    profiler.dump()
+    names = [e["name"] for e in _trace()["traceEvents"]
+             if e.get("ph") == "i"]
+    assert "profiler.pause" in names
+    assert "profiler.resume" in names
+
+
+# -- lane metadata -----------------------------------------------------------
+
+def test_dump_contains_lane_metadata_events():
+    profiler.set_state("run")
+    profiler.record_op("x", 1.0)
+    profiler.set_state("stop")
+    profiler.dump()
+    data = _trace()
+    meta = [e for e in data["traceEvents"] if e.get("ph") == "M"]
+    proc = [e for e in meta if e["name"] == "process_name"]
+    assert proc and proc[0]["args"]["name"] == "mxnet_tpu"
+    thread_names = {e["tid"]: e["args"]["name"] for e in meta
+                    if e["name"] == "thread_name"}
+    for lane, tid in profiler.LANES.items():
+        assert thread_names[tid] == lane
+
+
+# -- imperative + bulk lanes -------------------------------------------------
+
+def test_imperative_ops_and_bulk_flush_land_in_their_lanes():
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    profiler.set_state("run")
+    b = a * 2.0
+    b = b + 1.0
+    with engine.bulk(8):
+        c = a + b
+        c = c * 3.0
+        c.asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    data = _trace()
+    imp = [e for e in _lane_events(data, "imperative")
+           if e.get("ph") == "X"]
+    assert len(imp) >= 2
+    bulk = [e for e in _lane_events(data, "bulk")
+            if e["name"] == "bulk_segment"]
+    assert bulk, "bulk flush span missing"
+    assert bulk[0]["args"]["ops"] >= 2
+    assert bulk[0]["args"]["mode"] in (
+        "cached", "compile", "eager-warming", "eager-fallback")
+
+
+def test_profiling_off_records_nothing_from_subsystems():
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    _ = (a * 2.0 + 1.0).asnumpy()
+    a.attach_grad()
+    with autograd.record():
+        y = (a * a).sum()
+    y.backward()
+    m = profiler.metrics()
+    assert m["aggregate"] == {}
+    assert m["counters"] == {}
+    assert m["num_events"] == 0
+
+
+# -- autograd lane -----------------------------------------------------------
+
+def test_autograd_backward_span():
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    x.attach_grad()
+    profiler.set_state("run")
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    profiler.set_state("stop")
+    m = profiler.metrics()
+    assert m["aggregate"]["autograd.backward"]["count"] == 1
+    profiler.dump()
+    assert any(e["name"] == "autograd.backward"
+               for e in _lane_events(_trace(), "autograd"))
+
+
+# -- kvstore lane ------------------------------------------------------------
+
+def test_kvstore_spans_and_byte_counters():
+    kv = mx.kv.create("local")
+    profiler.set_state("run")
+    kv.init(7, mx.nd.ones((8, 8)))
+    kv.push(7, mx.nd.ones((8, 8)))
+    out = mx.nd.zeros((8, 8))
+    kv.pull(7, out=out)
+    profiler.set_state("stop")
+    m = profiler.metrics()
+    for name in ("kvstore.init", "kvstore.push", "kvstore.pull"):
+        assert m["aggregate"][name]["count"] == 1, name
+    assert m["counters"]["kvstore.bytes_pushed"] == 8 * 8 * 4
+    assert m["counters"]["kvstore.bytes_pulled"] == 8 * 8 * 4
+    profiler.dump()
+    kv_events = _lane_events(_trace(), "kvstore")
+    spans = [e for e in kv_events if e.get("ph") == "X"]
+    assert any(e["args"]["bytes"] == 8 * 8 * 4 for e in spans)
+
+
+# -- io lane -----------------------------------------------------------------
+
+def test_io_prefetch_spans_and_queue_depth():
+    from mxnet_tpu.io.prefetch import DevicePrefetchIter
+    batches = [np.full((2, 2), i, np.float32) for i in range(4)]
+    profiler.set_state("run")
+    got = list(DevicePrefetchIter(iter(batches), depth=2))
+    profiler.set_state("stop")
+    assert len(got) == 4
+    m = profiler.metrics()
+    # one span per batch plus one for the end-of-stream sentinel read
+    assert m["aggregate"]["io.batch_fetch"]["count"] >= 4
+    assert m["aggregate"]["io.batch_place"]["count"] >= 1
+    profiler.dump()
+    io_events = _lane_events(_trace(), "io")
+    assert any(e["name"] == "io.prefetch_queue_depth"
+               and e.get("ph") == "C" for e in io_events)
+
+
+# -- memory profiling (tentpole 1) ------------------------------------------
+
+def test_memory_sampling_counters_and_table():
+    profiler.set_config(profile_memory=True)
+    profiler.set_state("run")
+    _ = (mx.nd.ones((16, 16)) * 2.0).asnumpy()
+    profiler.sample_memory("test")
+    time.sleep(0.15)  # let the background sampler tick at least once
+    profiler.set_state("stop")
+    profiler.dump()
+    mem = [e for e in _lane_events(_trace(), "memory")
+           if e.get("ph") == "C"]
+    assert mem, "no memory counter events"
+    assert mem[0]["name"].startswith("memory:")
+    assert set(mem[0]["args"]) == {"bytes_in_use", "peak_bytes_in_use"}
+    assert "Device memory" in profiler.dumps()
+    m = profiler.metrics()
+    assert m["memory"], "metrics() lost the memory snapshot"
+    for vals in m["memory"].values():
+        assert {"bytes_in_use", "peak_bytes_in_use",
+                "peak_since_reset"} <= set(vals)
+
+
+def test_memory_sampling_off_by_default():
+    profiler.set_state("run")
+    profiler.sample_memory("test")
+    profiler.set_state("stop")
+    profiler.dump()
+    assert not _lane_events(_trace(), "memory")
+
+
+def test_bulk_flush_triggers_memory_sample():
+    profiler.set_config(profile_memory=True)
+    # sampler period pushed way out: only start + flush-boundary samples
+    os.environ["MXNET_PROFILER_MEMORY_SAMPLE_PERIOD"] = "60"
+    try:
+        profiler.set_state("run")
+        a = mx.nd.ones((4, 4))
+        with engine.bulk(8):
+            b = a + 1.0
+            b = b * 2.0
+            b.asnumpy()
+        profiler.set_state("stop")
+    finally:
+        del os.environ["MXNET_PROFILER_MEMORY_SAMPLE_PERIOD"]
+    profiler.dump()
+    mem = [e for e in _lane_events(_trace(), "memory")
+           if e.get("ph") == "C"]
+    assert len(mem) >= 2  # the start sample + the bulk-flush sample
+
+
+# -- continuous dump (tentpole 2) -------------------------------------------
+
+def test_continuous_dump_writes_valid_json_mid_run(tmp_path):
+    fn = str(tmp_path / "cont.json")
+    profiler.set_config(filename=fn, continuous_dump=True,
+                        dump_period=0.05)
+    profiler.set_state("run")
+    try:
+        # the file exists (and parses) from the first moment of the run
+        assert os.path.exists(fn)
+        data0 = _trace(fn)
+        assert "traceEvents" in data0
+        profiler.record_op("mid_run_op", 12.0)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            time.sleep(0.06)
+            names = [e["name"] for e in _trace(fn)["traceEvents"]]
+            if "mid_run_op" in names:
+                break
+        else:
+            pytest.fail("periodic rewrite never picked up the event")
+    finally:
+        profiler.set_state("stop")
+    # final rewrite on stop also contains everything
+    assert any(e["name"] == "mid_run_op"
+               for e in _trace(fn)["traceEvents"])
+
+
+def test_dump_is_atomic_no_temp_left_behind(tmp_path):
+    fn = str(tmp_path / "atomic.json")
+    profiler.set_config(filename=fn)
+    profiler.set_state("run")
+    profiler.record_op("x", 1.0)
+    profiler.set_state("stop")
+    profiler.dump()
+    assert os.path.exists(fn)
+    leftovers = [p for p in os.listdir(str(tmp_path)) if ".tmp." in p]
+    assert not leftovers
+
+
+# -- metrics() (tentpole 4) --------------------------------------------------
+
+def test_metrics_json_roundtrip_and_matches_dumps_totals():
+    profiler.set_state("run")
+    profiler.record_op("opX", 25.0)
+    profiler.record_op("opX", 75.0)
+    profiler.account("io.batches", 3)
+    profiler.set_state("stop")
+    m = profiler.metrics()
+    # JSON-safe by construction
+    m2 = json.loads(json.dumps(m))
+    assert m2["aggregate"]["opX"]["count"] == 2
+    assert m2["aggregate"]["opX"]["total_us"] == pytest.approx(100.0)
+    assert m2["counters"]["io.batches"] == 3
+    assert m2["imperative"] == profiler.imperative_stats()
+    # same totals as the text table
+    line = [ln for ln in profiler.dumps().splitlines()
+            if ln.startswith("opX")][0]
+    cols = line.split()
+    assert int(cols[1]) == m["aggregate"]["opX"]["count"]
+    assert float(cols[2]) == pytest.approx(
+        m["aggregate"]["opX"]["total_us"], abs=0.1)
+
+
+def test_dump_format_metrics_writes_snapshot(tmp_path):
+    fn = str(tmp_path / "metrics.json")
+    profiler.set_config(filename=fn)
+    profiler.set_state("run")
+    profiler.record_op("opY", 10.0)
+    profiler.set_state("stop")
+    profiler.dump(format="metrics")
+    data = json.load(open(fn))
+    assert data["aggregate"]["opY"]["count"] == 1
+    assert set(data) >= {"aggregate", "imperative", "counters", "memory"}
+    with pytest.raises(ValueError):
+        profiler.dump(format="pdf")
+
+
+def test_event_cap_drops_and_tallies(monkeypatch):
+    monkeypatch.setattr(profiler, "_MAX_EVENTS", 3)
+    profiler.set_state("run")
+    for i in range(6):
+        profiler.record_op("capped", 1.0)
+    profiler.set_state("stop")
+    m = profiler.metrics()
+    assert m["num_events"] == 3
+    assert m["counters"]["profiler.dropped_events"] == 3
+    # aggregation keeps counting past the cap
+    assert m["aggregate"]["capped"]["count"] == 6
+
+
+def test_metrics_reset_clears_everything():
+    profiler.set_state("run")
+    profiler.record_op("opZ", 10.0)
+    profiler.account("kvstore.bytes_pushed", 5)
+    profiler.set_state("stop")
+    profiler.metrics(reset=True)
+    m = profiler.metrics()
+    assert m["aggregate"] == {} and m["counters"] == {}
+    assert m["num_events"] == 0
+
+
+# -- storage.reset_peak (satellite) -----------------------------------------
+
+def test_storage_reset_peak_rebases_high_water_mark():
+    marks = storage.reset_peak()
+    assert marks  # one entry per device
+    s0 = storage.stats()[0]
+    dev = str(s0.device)
+    assert s0.peak_since_reset == s0.bytes_in_use
+    # simulate an allocation spike the framework observed
+    with storage._hwm_lock:
+        storage._hwm[dev] = s0.bytes_in_use + 12345
+    s1 = [s for s in storage.stats() if str(s.device) == dev][0]
+    assert s1.peak_since_reset >= s0.bytes_in_use + 12345
+    storage.reset_peak()
+    s2 = [s for s in storage.stats() if str(s.device) == dev][0]
+    assert s2.peak_since_reset == s2.bytes_in_use
+
+
+# -- acceptance: gluon loop with everything on ------------------------------
+
+def test_end_to_end_gluon_loop_four_lanes(tmp_path):
+    from mxnet_tpu.io.prefetch import DevicePrefetcher
+    fn = str(tmp_path / "e2e.json")
+    profiler.set_config(filename=fn, profile_all=True, profile_memory=True,
+                        continuous_dump=True, dump_period=0.05,
+                        xprof=False)
+
+    rng = np.random.RandomState(0)
+    xs = [mx.nd.array(rng.uniform(-1, 1, (8, 4)).astype("float32"))
+          for _ in range(3)]
+    ys = [mx.nd.array(rng.uniform(-1, 1, (8, 1)).astype("float32"))
+          for _ in range(3)]
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize(mx.init.Uniform(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.L2Loss()
+
+    profiler.set_state("run")
+    try:
+        for x, y in DevicePrefetcher(list(zip(xs, ys))):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(batch_size=8)
+        with engine.bulk(8):
+            pred = net(xs[0])
+            pred = pred * 2.0
+            pred.asnumpy()
+        # continuous dump: trace exists and parses BEFORE stop
+        assert os.path.exists(fn)
+        mid = _trace(fn)
+        assert isinstance(mid["traceEvents"], list)
+        m_before = profiler.metrics()
+    finally:
+        profiler.set_state("stop")
+
+    data = _trace(fn)
+    inv = {tid: lane for lane, tid in profiler.LANES.items()}
+    lanes_hit = {inv[e["tid"]] for e in data["traceEvents"]
+                 if e.get("ph") in ("X", "C") and e.get("tid") in inv}
+    assert {"imperative", "bulk", "autograd", "memory",
+            "gluon"} <= lanes_hit, lanes_hit
+    assert "io" in lanes_hit
+    assert len(lanes_hit) >= 4
+    # metrics totals agree with the dumps() aggregate for every span name
+    m = profiler.metrics()
+    assert set(m["aggregate"]) == set(m_before["aggregate"]) \
+        or set(m_before["aggregate"]) <= set(m["aggregate"])
+    table = profiler.dumps()
+    for name, agg in m["aggregate"].items():
+        assert name[:40] in table
+    assert m["aggregate"]["gluon.Trainer.step"]["count"] == 3
+    assert m["aggregate"]["autograd.backward"]["count"] == 3

@@ -54,6 +54,28 @@ def _shape_bytes(type_str):
     return total
 
 
+def _tuple_elements(type_str):
+    """Top-level elements of a tuple type ``(a, b, ...)``; [] when the
+    type is not a tuple. Layout braces (``{1,0}``) nest commas, so the
+    split tracks depth across (), [] and {}."""
+    s = type_str.strip()
+    if not s.startswith("("):
+        return []
+    depth, start, elems = 0, 1, []
+    for i, ch in enumerate(s):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+            if depth == 0:
+                elems.append(s[start:i])
+                break
+        elif ch == "," and depth == 1:
+            elems.append(s[start:i])
+            start = i + 1
+    return elems
+
+
 def _split_computations(hlo_text):
     """{computation_name: [lines]} for every computation block."""
     comps = {}
@@ -114,7 +136,19 @@ def hlo_collective_bytes(hlo_text):
         for line in comps[comp_name]:
             m = coll_re.search(line)
             if m and "-done" not in line.split("=", 1)[1][:60]:
-                out[m.group(2)] += _shape_bytes(m.group(1))
+                ty = m.group(1)
+                if m.group(3):
+                    # async form: the -start result type is a tuple of
+                    # (operand, result[, context...]) — e.g. a
+                    # collective-permute-start carries two trailing
+                    # u32[] context elements. Summing the whole tuple
+                    # double-counts the payload, so keep only the
+                    # result element, always the second (the -done
+                    # side is already skipped)
+                    elems = _tuple_elements(ty)
+                    if len(elems) >= 2:
+                        ty = elems[1]
+                out[m.group(2)] += _shape_bytes(ty)
                 counts[m.group(2)] += 1
             w = while_re.search(line)
             if w:

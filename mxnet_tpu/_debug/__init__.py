@@ -1,0 +1,9 @@
+"""Runtime debugging aids (lock-order tracing, race detection).
+
+Everything here is dormant unless its env gate is set — the framework
+routes through these modules unconditionally, and the modules keep
+their own disabled fast paths, so production runs pay (almost) nothing.
+"""
+from . import locktrace
+
+__all__ = ["locktrace"]

@@ -16,10 +16,11 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
+
+from ._debug import locktrace as _locktrace
 
 _LIB = None
-_LIB_LOCK = threading.Lock()
+_LIB_LOCK = _locktrace.named_lock("native.lib")
 _TRIED = False
 
 

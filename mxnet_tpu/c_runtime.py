@@ -14,6 +14,7 @@ NDArray references as PyObject handles.
 from __future__ import annotations
 
 import ast
+import threading as _threading
 
 import numpy as np
 
@@ -100,22 +101,34 @@ def mark_variables(arrs):
         a.attach_grad()
 
 
-_RECORD_SCOPES = []
+# per-thread open record scope: autograd recording state is thread-local
+# (both here and in the reference), so a second C-ABI thread toggling
+# recording must not pop a scope the first thread opened
+_RECORD_SCOPES = _threading.local()
+
+
+def _record_stack():
+    stack = getattr(_RECORD_SCOPES, "stack", None)
+    if stack is None:
+        stack = _RECORD_SCOPES.stack = []
+    return stack
 
 
 def record_start():
     """ref: MXAutogradSetIsRecording(1) + SetIsTraining(1) — an absolute
     setter like the reference, not a nesting scope: repeated (1) calls
     are idempotent."""
-    if not _RECORD_SCOPES:
+    stack = _record_stack()
+    if not stack:
         scope = autograd.record()
         scope.__enter__()
-        _RECORD_SCOPES.append(scope)
+        stack.append(scope)
 
 
 def record_stop():
-    while _RECORD_SCOPES:
-        _RECORD_SCOPES.pop().__exit__(None, None, None)
+    stack = _record_stack()
+    while stack:
+        stack.pop().__exit__(None, None, None)
 
 
 def backward(outputs):

@@ -20,7 +20,7 @@ __all__ = ["SummaryWriter", "LogMetricsCallback"]
 
 # -- crc32c (Castagnoli), table-driven — required by TFRecord framing ------
 
-_CRC_TABLE = []
+_CRC_TABLE = []  # mxlint: disable=MX003 (idempotent lazy init of a deterministic table; a racing double build appends identical values — reads go through the final 256 entries only)
 
 
 def _crc_table():

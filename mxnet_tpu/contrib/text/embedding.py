@@ -18,7 +18,7 @@ from .vocab import Vocabulary
 __all__ = ["register", "create", "get_pretrained_file_names",
            "TokenEmbedding", "CustomEmbedding", "CompositeEmbedding"]
 
-_REGISTRY = {}
+_REGISTRY = {}  # mxlint: disable=MX003 (populated by @register decorators at import time, single-threaded; read-only afterwards)
 
 
 def register(klass):

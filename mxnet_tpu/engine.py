@@ -26,6 +26,8 @@ import contextlib
 import os
 import threading
 
+from ._debug import locktrace as _locktrace
+
 __all__ = [
     "engine_type", "is_naive", "set_bulk_size", "bulk_size", "bulk",
     "wait_for_var", "wait_for_all", "push_sync",
@@ -54,7 +56,7 @@ def maybe_sync(data):
     return data
 
 
-_bulk_size = [int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))]
+_bulk_size = [int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))]  # mxlint: disable=MX003 (process-wide knob, GIL-atomic int store; per-thread segments snapshot it at scope entry)
 
 
 def set_bulk_size(size):
@@ -129,6 +131,8 @@ def wait_for_var(arr):
     array's producing computation is done; raises its deferred error here.
     Reading ``_data`` drains any bulk segment the array is pending in."""
     import jax
+    if _locktrace.ENABLED:
+        _locktrace.boundary("engine.wait_for_var")
     data = getattr(arr, "_data", arr)
     jax.block_until_ready(data)
 
@@ -146,6 +150,8 @@ def wait_for_all():
     import time as _time
     from . import profiler as _profiler
     t0 = _time.perf_counter() if _profiler._ACTIVE else None
+    if _locktrace.ENABLED:
+        _locktrace.boundary("engine.wait_for_all")
     _flush_pending_segment()
     try:
         for d in jax.live_arrays():

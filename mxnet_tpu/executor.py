@@ -34,7 +34,7 @@ __all__ = ["Executor"]
 
 from .symbol.control_flow import CONTROL_FLOW_OPS as _CONTROL_FLOW_OPS
 
-_SIG_CACHE = {}
+_SIG_CACHE = {}  # mxlint: disable=MX003 (GIL-atomic memo of deterministic signature parses; a racing duplicate insert is identical)
 
 
 def _fn_params(opdef):
@@ -211,7 +211,9 @@ class Executor:
         self._seed = 0
 
         if placement is None:
+            # mxlint: disable=MX005 (per-Executor jit over a FIXED bound graph and arg shapes: one key family per bind, released with the executor)
             self._fwd = jax.jit(self._raw_forward, static_argnums=(0,))
+            # mxlint: disable=MX005 (same per-Executor single-key contract as _fwd above)
             self._fwd_bwd = jax.jit(self._raw_forward_backward)
         else:
             # group2ctx pins individual nodes to devices — incompatible

@@ -191,7 +191,7 @@ class DataLoader:
 
 # {loader key: (dataset, batchify_fn)}, populated in the parent before the
 # pool forks so children (and later respawns) inherit it without pickling
-_WORKER_STATES = {}
+_WORKER_STATES = {}  # mxlint: disable=MX003 (parent-process registry keyed by id(loader): GIL-atomic writes to distinct keys, snapshotted into children at fork)
 
 
 def _to_shm(obj):

@@ -27,6 +27,7 @@ import threading
 import numpy as np
 
 from .io import DataIter, DataBatch, DataDesc
+from .._debug import locktrace as _locktrace
 from ..context import cpu as _cpu
 from ..ndarray import NDArray
 from ..recordio import MXRecordIO, MXIndexedRecordIO, unpack
@@ -121,7 +122,7 @@ class ImageRecordIter(DataIter):
                     break
                 self._offsets.append(pos)
         self._prefetcher = None
-        self._read_lock = threading.Lock()
+        self._read_lock = _locktrace.named_lock("io.image_read")
         self.reset()
 
     @property

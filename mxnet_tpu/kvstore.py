@@ -328,7 +328,7 @@ class KVStore:
         pass
 
 
-_STR_KEY_CACHE = {}
+_STR_KEY_CACHE = {}  # mxlint: disable=MX003 (GIL-atomic memo of the str->int key mapping; values are deterministic per key)
 
 
 def _str_key_int(k):

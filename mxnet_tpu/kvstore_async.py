@@ -45,6 +45,7 @@ import warnings
 import numpy as np
 
 from . import profiler as _profiler
+from ._debug import locktrace as _locktrace
 
 __all__ = ["AsyncPSServer", "AsyncPSClient", "serve_if_rank0"]
 
@@ -156,9 +157,10 @@ class AsyncPSServer:
     def __init__(self, port=0, bind_host="127.0.0.1"):
         self._store = {}
         self._updater = None
-        self._lock = threading.Lock()
+        self._lock = _locktrace.named_lock("kvstore_async.server")
         self._heartbeats = {}  # rank -> monotonic time of last beat
-        self._barrier_cv = threading.Condition(self._lock)
+        self._barrier_cv = _locktrace.named_condition(
+            "kvstore_async.server", self._lock)
         self._barrier_count = 0
         self._barrier_gen = 0
         if _ps_secret() is None:
@@ -476,7 +478,7 @@ class AsyncPSClient:
         # worker's connect-to-server rendezvous)
         self._sock = None
         self._retries = retries
-        self._lock = threading.Lock()
+        self._lock = _locktrace.named_lock("kvstore_async.client")
         self._addr = (host, port)
         self.bytes_pushed = 0  # wire accounting (sparse/compressed tests)
         self._hb_stop = None

@@ -38,6 +38,8 @@ import os
 
 import numpy as np
 
+from ._debug import locktrace as _locktrace
+
 __all__ = ["load", "register_op", "loaded_libraries", "VERSION"]
 
 # MXNET_VERSION analog: major*10000 + minor*100 + patch (ref:
@@ -45,6 +47,12 @@ __all__ = ["load", "register_op", "loaded_libraries", "VERSION"]
 VERSION = 10600
 
 _LOADED = {}
+# serializes plugin loads: load() is check-then-act on _LOADED and the
+# op registry snapshot/rollback is a critical section — two threads
+# loading the same plugin concurrently would register its ops twice.
+# Reentrant: a plugin's module body may itself load() a dependency
+# plugin on the same thread
+_LOAD_LOCK = _locktrace.named_lock("lib_api.load", reentrant=True)
 
 
 def loaded_libraries():
@@ -275,16 +283,17 @@ def load(path, verbose=True):
     ext = os.path.splitext(path)[1]
     if ext not in (".so", ".dll", ".py"):
         raise MXNetError("load path %s is NOT a library file" % path)
-    if path in _LOADED:
-        return _LOADED[path]
-    snapshot = _registry_snapshot()
-    try:
-        handle = (_load_python_plugin(path) if ext == ".py"
-                  else _load_c_plugin(path))
-    except Exception:
-        _registry_rollback(snapshot)
-        raise
-    _LOADED[path] = handle
+    with _LOAD_LOCK:
+        if path in _LOADED:
+            return _LOADED[path]
+        snapshot = _registry_snapshot()
+        try:
+            handle = (_load_python_plugin(path) if ext == ".py"
+                      else _load_c_plugin(path))
+        except Exception:
+            _registry_rollback(snapshot)
+            raise
+        _LOADED[path] = handle
     if verbose:
         import logging
         logging.getLogger("mxnet_tpu").info("loaded library %s", path)

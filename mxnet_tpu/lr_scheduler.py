@@ -121,6 +121,12 @@ class MultiFactorScheduler(LRScheduler):
                  warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr,
                          warmup_mode)
+        if not isinstance(step, (list, tuple)):
+            # a scalar step otherwise dies with a TypeError mid-iteration
+            # below; the reference's isinstance check names the contract
+            raise ValueError("step must be a list or tuple of ints, got %r "
+                             "(use FactorScheduler for a fixed interval)"
+                             % (step,))
         if not step or any(s < 1 for s in step):
             raise ValueError("step must be a non-empty list of ints >= 1, "
                              "got %r" % (step,))

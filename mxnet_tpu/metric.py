@@ -35,7 +35,7 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
            "Caffe", "CustomMetric", "np", "create", "register", "get"]
 
-_REGISTRY = {}
+_REGISTRY = {}  # mxlint: disable=MX003 (populated by @register decorators at import time, single-threaded; read-only afterwards)
 
 
 def register(klass):
@@ -237,6 +237,7 @@ class _DeviceMetric(EvalMetric):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         import jax
+        # mxlint: disable=MX005 (per-metric-instance jit of one fixed reduction: a single key per label/pred shape, bounded by the eval loop's shapes)
         self._reduce = jax.jit(self._stats)
 
     def _stats(self, label, pred):
@@ -291,6 +292,11 @@ class TopKAccuracy(_DeviceMetric):
         if pred.ndim > 2:
             raise ValueError("predictions must be 1-D or 2-D, got %d-D"
                              % pred.ndim)
+        # (N, 1) column labels must flatten before broadcasting against
+        # the k argsort columns (ref uses label_np.flat): without the
+        # ravel, label[:, None] is (N, 1, 1) and the == broadcasts to
+        # (N, N, k), counting cross-row matches — accuracy above 1.0
+        label = label.ravel()
         if pred.ndim == 1:
             hits = jnp.sum(pred.astype(jnp.int32)
                            == label.astype(jnp.int32))
@@ -310,6 +316,7 @@ class _ConfusionCounts:
 
     def __init__(self):
         import jax
+        # mxlint: disable=MX005 (per-instance jit of the fixed 4-cell confusion tally; one key per batch shape)
         self._tally = jax.jit(self._batch_tally)
         self.reset_stats()
 

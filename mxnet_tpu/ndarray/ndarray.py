@@ -219,6 +219,7 @@ class NDArray:
     # -- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         """ref: python/mxnet/ndarray/ndarray.py attach_grad."""
+        # mxlint: disable=MX001 (grad-buffer alloc, not an op — must not hit the tape/cache)
         self._grad = NDArray(jnp.zeros(self.shape, self.dtype)) \
             if grad_req != "null" else None
         self._grad_req = grad_req
@@ -403,10 +404,14 @@ class NDArray:
         return _f(self, other, **kw)
 
     def zeros_like(self):
-        return NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
+        # through the dispatch choke point: jit-cached, bulkable, and
+        # visible to the profiler lane (mxlint MX001)
+        from .register import invoke_by_name
+        return invoke_by_name("zeros_like", self)
 
     def ones_like(self):
-        return NDArray(jnp.ones(self.shape, self.dtype), ctx=self._ctx)
+        from .register import invoke_by_name
+        return invoke_by_name("ones_like", self)
 
     # -- arithmetic operators --------------------------------------------
     def _binop(self, name, other, reverse=False):

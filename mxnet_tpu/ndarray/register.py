@@ -46,6 +46,7 @@ from .. import autograd
 from .. import engine as _engine
 from .. import profiler as _profiler
 from .. import random as _random
+from .._debug import locktrace as _locktrace
 from ..ops import registry as _registry
 from .ndarray import NDArray, _PendingSlot
 
@@ -72,7 +73,7 @@ def set_profiler_hooks(enabled):
     _HOOKS = bool(enabled)
     return prev
 
-_SPEC_CACHE = {}
+_SPEC_CACHE = {}  # mxlint: disable=MX003 (GIL-atomic op on the dispatch hot path: a lock would cost more than the benign lost-update race; counters are best-effort, caches memoize deterministic values)
 
 
 def _spec(opdef):
@@ -120,12 +121,14 @@ _JIT_THRESHOLD = 2
 # (the reference bounds CachedOp caches the same blunt way)
 _CACHE_CAP = 8192
 
+# mxlint: disable=MX003 (GIL-atomic memo of deterministic jitted callables; worst case a duplicate trace, never a wrong result)
 _DISPATCH_CACHE = {}     # full key -> jitted callable
-_KEY_COUNTS = {}         # full key -> times seen (for the hot threshold)
-_PARTIAL_KEYS = set()    # (name, statics, amp) seen — retrace detection
-_FAILED_KEYS = set()     # keys that raised under trace — permanent fallback
+_KEY_COUNTS = {}         # full key -> times seen (for the hot threshold)  # mxlint: disable=MX003 (GIL-atomic heuristic counter: a lost update only delays compile-on-repeat by one call)
+_PARTIAL_KEYS = set()    # (name, statics, amp) seen — retrace detection  # mxlint: disable=MX003 (GIL-atomic membership adds; retrace stat is best-effort)
+_FAILED_KEYS = set()     # keys that raised under trace — permanent fallback  # mxlint: disable=MX003 (GIL-atomic adds; a racing miss just retries the trace once)
 
 # observability (satellite: profiler counters; included in profiler.dumps)
+# mxlint: disable=MX003 (GIL-atomic best-effort counters on the per-op hot path; the <2% overhead gate forbids a lock here)
 _STATS = {
     "hits": 0,          # dispatch served by a cached jitted callable
     "misses": 0,        # key not yet compiled (eager while warming, or
@@ -298,6 +301,10 @@ def _cached_callable(opdef, key, partial_key, args, kwargs, arg_slots,
     traced = _build_traced(opdef, args, kwargs, arg_slots, kw_slots,
                            take_key)
     donate = _donate_argnums(opdef, arg_slots, recording)
+    if _locktrace.ENABLED:
+        # the first call of this jitted fn traces + compiles (seconds):
+        # a framework lock held here starves every other thread
+        _locktrace.boundary("imperative.jit_compile")
     fn = jax.jit(traced, donate_argnums=donate) if donate \
         else jax.jit(traced)
     _DISPATCH_CACHE[key] = fn
@@ -305,6 +312,7 @@ def _cached_callable(opdef, key, partial_key, args, kwargs, arg_slots,
 
 
 def _record_invoke(opdef, t0):
+    # mxlint: disable=MX002 (called only when _prof_t0 is not None, i.e. under the inlined `_HOOKS and _ACTIVE` guard at both call sites — keeping the guard expression inline there is the whole point)
     _profiler.record_op(opdef.name, (_time.perf_counter() - t0) * 1e6,
                         category="operator", lane="imperative")
 
@@ -503,7 +511,7 @@ _NOT_BULKED = object()
 _BULK_LOCAL = threading.local()
 
 # out-aval cache: (name, statics, in avals) -> tuple of (shape, dtype)
-_AVAL_CACHE = {}
+_AVAL_CACHE = {}  # mxlint: disable=MX003 (GIL-atomic memo of eval_shape results: deterministic, duplicate compute is the worst case)
 
 
 def bulk_segment_depth():
@@ -553,8 +561,8 @@ def set_active_bulk_limit(limit):
 
 
 # runner cache: segment signature -> jitted program over the leaf arrays
-_SEGMENT_CACHE = {}
-_SEGMENT_COUNTS = {}  # signature -> times flushed (compile-on-repeat)
+_SEGMENT_CACHE = {}  # mxlint: disable=MX003 (GIL-atomic memo of jitted segment runners, same contract as _DISPATCH_CACHE)
+_SEGMENT_COUNTS = {}  # signature -> times flushed (compile-on-repeat)  # mxlint: disable=MX003 (GIL-atomic heuristic counter, see _KEY_COUNTS)
 
 
 def deliver_result(dst, src):
@@ -755,6 +763,8 @@ class _BulkSegment:
                     results.append(tuple(o) if multi else (o,))
                 return results
 
+            if _locktrace.ENABLED:
+                _locktrace.boundary("imperative.bulk_compile")
             runner = jax.jit(run)
             _SEGMENT_CACHE[sig] = runner
             mode = "compile"
@@ -802,7 +812,7 @@ class _BulkSegment:
                     slot.segment = _FAILED_SEGMENT
 
 
-_BULK_FAILED_OPS = set()
+_BULK_FAILED_OPS = set()  # mxlint: disable=MX003 (GIL-atomic adds; a racing miss re-queues one doomed op which then fails over identically)
 
 
 class _DeadSegment:

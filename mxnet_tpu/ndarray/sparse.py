@@ -12,6 +12,11 @@ accounting to prove it (kvstore.bytes_pushed).
 """
 from __future__ import annotations
 
+# mxlint: disable-file=MX001 (whole-file design exemption, see docstring:
+# sparse storage-format extraction runs as eager device compute on the
+# RAW buffers — indices/indptr manipulation is not an op-registry path,
+# and routing it through invoke would put storage bookkeeping on the
+# autograd tape and in the dispatch cache)
 import jax.numpy as jnp
 import numpy as _np
 

@@ -26,7 +26,7 @@ from .ndarray import NDArray
 
 __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
 
-_CUSTOM_PROPS = {}
+_CUSTOM_PROPS = {}  # mxlint: disable=MX003 (custom-op registration happens at model-setup time before threads dispatch ops)
 
 
 def register(reg_name):

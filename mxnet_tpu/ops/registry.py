@@ -15,7 +15,7 @@ from __future__ import annotations
 
 __all__ = ["register", "get_op", "list_ops", "OpDef"]
 
-_OPS = {}
+_OPS = {}  # mxlint: disable=MX003 (populated by register() at import/plugin-load time; plugin loads serialize under lib_api's load lock)
 
 
 class OpDef:

@@ -213,6 +213,7 @@ class Optimizer:
     def _jitted(self, key, fn):
         f = self._jit_cache.get(key)
         if f is None:
+            # mxlint: disable=MX005 (per-optimizer keyed cache right here: _jitted IS this subsystem's bounded cache, keyed by update-rule signature)
             f = jax.jit(fn)
             self._jit_cache[key] = f
         return f

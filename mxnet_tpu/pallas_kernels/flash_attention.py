@@ -443,7 +443,7 @@ _BLOCK_TABLE = {
 }
 _TUNE_CANDIDATES = [(512, 512), (512, 1024), (1024, 512), (1024, 1024),
                     (2048, 512), (256, 512)]
-_TUNE_CACHE = {}
+_TUNE_CACHE = {}  # mxlint: disable=MX003 (GIL-atomic memo of measured block sizes; a racing duplicate tune costs time, never correctness)
 
 
 def _default_blocks(seq):
@@ -477,7 +477,7 @@ def _autotune_blocks(q, k, v, causal, scale):
             # of what gets timed (grad on q alone would let XLA DCE it)
             grad = jax.grad(loss, argnums=(0, 1, 2))
 
-            @jax.jit
+            @jax.jit  # mxlint: disable=MX005 (tuning micro-bench: compiled once per candidate block size inside the memoized autotune pass)
             def many(q_, k_, v_):
                 # chained fori so the device actually serializes the
                 # iterations (async dispatch would lie to the timer)

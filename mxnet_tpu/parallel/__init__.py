@@ -15,6 +15,7 @@ strategy as a sharding spec, and XLA inserts the ICI/DCN collectives:
 
 See SURVEY.md §2.4 and §5 "distributed communication backend".
 """
+from .compat import shard_map
 from .mesh import (DeviceMesh, create_mesh, current_mesh, default_mesh_axes,
                    mesh_scope)
 from .collectives import (all_reduce, all_gather, reduce_scatter, all_to_all,
@@ -35,6 +36,7 @@ from .elastic import CheckpointManager, elastic_train_loop, PreemptionGuard
 from . import transformer
 
 __all__ = [
+    "shard_map",
     "DeviceMesh", "create_mesh", "current_mesh", "default_mesh_axes",
     "mesh_scope",
     "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute",

@@ -1,0 +1,28 @@
+"""JAX version-compat shims for the parallel stack.
+
+``shard_map`` moved twice across jax releases: it lives at
+``jax.experimental.shard_map.shard_map`` through 0.4.x/0.5.x (with a
+``check_rep`` kwarg) and at top-level ``jax.shard_map`` from 0.6 on
+(where the kwarg was renamed ``check_vma``). Every shard_map call in
+this package goes through this one shim so the rest of the code can
+use the modern spelling (``check_vma=``) on either jax.
+"""
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.6: top-level export taking check_vma
+    from jax import shard_map as _shard_map
+    _KWARG = "check_vma"
+except ImportError:  # jax <= 0.5: experimental export taking check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _KWARG = "check_rep"
+
+__all__ = ["shard_map"]
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    kwargs[_KWARG] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -89,7 +89,7 @@ def pipeline_stages(stage_fn, stacked_params, x, mesh, n_microbatch,
               sharded P('pp', ...)
     x: [B, ...] batch (sharded on dp); B % n_microbatch == 0
     """
-    from jax import shard_map
+    from .compat import shard_map
     raw_mesh = getattr(mesh, "mesh", mesh)
     B = x.shape[0]
     assert B % n_microbatch == 0, "batch %d not divisible into %d mb" % (

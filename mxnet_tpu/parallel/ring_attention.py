@@ -98,7 +98,7 @@ def ring_self_attention(q, k, v, mesh, axis_name="sp", causal=False,
     """shard_map wrapper: q/k/v are [B, H, S, D] arrays (sharded or not);
     sequence axis is sharded over `axis_name`, batch over `batch_axis`,
     heads over `head_axis`."""
-    from jax import shard_map
+    from .compat import shard_map
     spec = P(batch_axis, head_axis, axis_name, None)
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal, scale=scale)

@@ -232,7 +232,7 @@ def ring_flash_self_attention(q, k, v, mesh, axis_name="sp", causal=False,
     """shard_map wrapper over full [B, H, S, D] arrays (mirrors
     ring_attention.ring_self_attention) — the single place that owns the
     spec/mesh wiring for the ring x flash path."""
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
     spec = P(batch_axis, head_axis, axis_name, None)
 

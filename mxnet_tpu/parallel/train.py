@@ -197,6 +197,7 @@ class ShardedTrainStep:
             lambda a: self._shardings_for_state(a), self.opt_states,
             is_leaf=lambda l: hasattr(l, "shape"))
         with raw_mesh:
+            # mxlint: disable=MX005 (one sharded train step per ShardedTrainStep instance; shapes fixed by the strategy, single key)
             self._jitted = jax.jit(
                 train_step,
                 in_shardings=(param_sh, state_sh, self._batch_sharding,

@@ -443,7 +443,7 @@ def make_train_step(cfg: TransformerConfig, mesh, learning_rate=1e-3):
                 lambda p, m: p - learning_rate * m, params, new_mom)
             return (new_params, new_mom), loss
     else:
-        from jax import shard_map
+        from .compat import shard_map
         data_spec = P("dp", "sp")
 
         def spmd_step(params, mom, tokens, targets):
@@ -479,7 +479,7 @@ def make_train_step(cfg: TransformerConfig, mesh, learning_rate=1e-3):
             in_specs=(specs, specs, data_spec, data_spec),
             out_specs=(specs, specs, P()), check_vma=False)
 
-        @jax.jit
+        @jax.jit  # mxlint: disable=MX005 (one pp-mode train step per make_train_step call; config and mesh are frozen into the closure, single key)
         def step_fn(state, tokens, targets):
             params, mom = state
             new_params, new_mom, loss = smapped(params, mom, tokens, targets)

@@ -63,7 +63,7 @@ def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None):
 def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
                       scale=None, batch_axis="dp", head_axis="tp"):
     """shard_map wrapper over full [B, H, S, D] arrays."""
-    from jax import shard_map
+    from .compat import shard_map
     spec = P(batch_axis, head_axis, axis_name, None)
     fn = functools.partial(ulysses_attention_local, axis_name=axis_name,
                            causal=causal, scale=scale)

@@ -37,6 +37,8 @@ import os
 import threading
 import time
 
+from ._debug import locktrace as _locktrace
+
 __all__ = [
     "set_config", "set_state", "dump", "dumps", "pause", "resume",
     "Domain", "Task", "Frame", "Event", "Counter", "Marker",
@@ -56,7 +58,7 @@ LANES = {
     "user": 7,
 }
 
-_lock = threading.Lock()
+_lock = _locktrace.named_lock("profiler.events")
 _state = {
     "running": False,
     "paused": False,
@@ -90,16 +92,18 @@ _MAX_EVENTS = int(os.environ.get("MXNET_PROFILER_MAX_EVENTS", "1000000"))
 # serializes trace-file writers (continuous-dump daemon vs explicit
 # dump()): both write the same temp path, and interleaved writers would
 # break the atomic-rewrite guarantee
-_dump_lock = threading.Lock()
+_dump_lock = _locktrace.named_lock("profiler.dump")
 
 
 def _append_locked(ev):
     """Append one trace event; caller holds _lock. Drops (and tallies)
     events past _MAX_EVENTS so unbounded runs stay bounded."""
     if len(_events) >= _MAX_EVENTS:
+        # mxlint: disable=MX003 (caller holds _lock — the function's contract, see docstring)
         _counters["profiler.dropped_events"] = \
             _counters.get("profiler.dropped_events", 0) + 1
         return
+    # mxlint: disable=MX003 (caller holds _lock — the function's contract, see docstring)
     _events.append(ev)
 
 
@@ -503,6 +507,11 @@ def metrics(reset=False):
         "memory": memory,
         "num_events": num_events,
     }
+    if _locktrace.ENABLED:
+        # runtime lock-order detector findings (MXNET_DEBUG_LOCKS=1):
+        # acquisition-order inversions + locks held across jit/sync
+        # boundaries, from mxnet_tpu._debug.locktrace
+        out["locks"] = _locktrace.report()
     if reset:
         reset_imperative_stats()
     return out

@@ -49,6 +49,7 @@ class _Kernel:
     """ref: rtc.py CudaKernel.launch."""
 
     def __init__(self, fn, name):
+        # mxlint: disable=MX005 (one jit per user-built CudaModule kernel, compiled at construction; key count == kernel count)
         self._fn = jax.jit(fn)
         self.name = name
 

@@ -16,7 +16,8 @@ control*, not reimplementation:
 from __future__ import annotations
 
 import gc
-import threading
+
+from ._debug import locktrace as _locktrace
 
 __all__ = ["DeviceStats", "stats", "total_bytes_in_use", "release_all",
            "empty_cache", "reset_peak"]
@@ -26,7 +27,7 @@ __all__ = ["DeviceStats", "stats", "total_bytes_in_use", "release_all",
 # reset, so per-step peak deltas (profiler memory samples between steps)
 # come from this re-derivable mark instead: reset_peak() rebases it to the
 # current usage and the next samples grow it from there.
-_hwm_lock = threading.Lock()
+_hwm_lock = _locktrace.named_lock("storage.hwm")
 _hwm = {}  # str(device) -> high-water bytes_in_use since last reset_peak()
 
 

@@ -15,7 +15,7 @@ from .symbol import Symbol, _Node, _auto_name, Variable, INPUT_PARAM_NAMES
 
 __all__ = ["populate", "create_symbol_op", "op_input_names"]
 
-_INPUT_CACHE = {}
+_INPUT_CACHE = {}  # mxlint: disable=MX003 (GIL-atomic memo of per-op input-name lists; deterministic, duplicate insert benign)
 
 
 def op_input_names(opdef):

@@ -69,7 +69,7 @@ def perl_name(name):
     return name + "_" if name.lower() in PERL_RESERVED else name
 
 
-def main():
+def main(out_path=None):
     from mxnet_tpu.ops import registry
 
     body = []
@@ -88,8 +88,9 @@ def main():
         body.append("sub %s { AI::MXTpu::op('%s', @_) }\n\n"
                     % (pname, name))
 
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "..", "lib", "AI", "MXTpu", "Ops.pm")
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "lib", "AI", "MXTpu", "Ops.pm")
     with open(out_path, "w") as f:
         f.write(HEADER % {"count": len(emitted)})
         f.writelines(body)
@@ -98,4 +99,6 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # optional explicit output path (CI generates to a temp file and
+    # diffs against the checked-in copy without touching the tree)
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

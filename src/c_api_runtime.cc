@@ -26,6 +26,12 @@
 #include "c_error.h"
 #include "py_embed.h"
 
+// Exception->errno translation on every entry point (mxlint MX006):
+// a C++ exception crossing the C ABI is UB; the macros turn it
+// into the -1/MXTGetLastError() contract (see c_error.h).
+#define API_BEGIN MXT_API_BEGIN
+#define API_END MXT_API_END
+
 namespace {
 
 using mxnet_tpu::FailWith;
@@ -68,6 +74,7 @@ extern "C" {
 
 int MXTNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
                      void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* shp = PyTuple_New(ndim);
@@ -79,10 +86,12 @@ int MXTNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
   if (res == nullptr) return PyFail("MXTNDArrayCreate");
   *out = res;
   return 0;
+  API_END()
 }
 
 int MXTNDArrayFromData(const int64_t* shape, uint32_t ndim, int dtype,
                        const void* data, size_t nbytes, void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* shp = PyTuple_New(ndim);
@@ -96,17 +105,21 @@ int MXTNDArrayFromData(const int64_t* shape, uint32_t ndim, int dtype,
   if (res == nullptr) return PyFail("MXTNDArrayFromData");
   *out = res;
   return 0;
+  API_END()
 }
 
 int MXTNDArrayFree(void* handle) {
+  API_BEGIN()
   if (handle == nullptr) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(handle));
   return 0;
+  API_END()
 }
 
 int MXTNDArrayGetShape(void* handle, uint32_t* out_ndim,
                        int64_t* out_shape /* >= 8 slots */) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("shape_of", args);
@@ -123,9 +136,11 @@ int MXTNDArrayGetShape(void* handle, uint32_t* out_ndim,
     out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(res, i));
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTNDArraySyncCopyToCPU(void* handle, void* data, size_t nbytes) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("to_bytes", args);
@@ -146,9 +161,11 @@ int MXTNDArraySyncCopyToCPU(void* handle, void* data, size_t nbytes) {
   std::memcpy(data, buf, nbytes);
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTNDArrayWaitAll() {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
@@ -157,6 +174,7 @@ int MXTNDArrayWaitAll() {
   if (res == nullptr) return PyFail("MXTNDArrayWaitAll");
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 // -- op invoke --------------------------------------------------------------
@@ -169,6 +187,7 @@ int MXTImperativeInvoke(const char* op_name, uint32_t num_inputs,
                         const char** keys, const char** vals,
                         uint32_t* num_outputs, void** out_handles,
                         uint32_t max_outputs) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* ins = HandleList(inputs, num_inputs);
@@ -210,11 +229,13 @@ int MXTImperativeInvoke(const char* op_name, uint32_t num_inputs,
   }
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 // -- autograd ---------------------------------------------------------------
 
 int MXTAutogradMarkVariables(uint32_t num, void** handles) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(N)", HandleList(handles, num));
@@ -223,9 +244,11 @@ int MXTAutogradMarkVariables(uint32_t num, void** handles) {
   if (res == nullptr) return PyFail("MXTAutogradMarkVariables");
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTAutogradSetIsRecording(int is_recording) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
@@ -235,9 +258,11 @@ int MXTAutogradSetIsRecording(int is_recording) {
   if (res == nullptr) return PyFail("MXTAutogradSetIsRecording");
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTAutogradBackward(uint32_t num_outputs, void** outputs) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(N)", HandleList(outputs, num_outputs));
   PyObject* res = CallRt("backward", args);
@@ -245,9 +270,11 @@ int MXTAutogradBackward(uint32_t num_outputs, void** outputs) {
   if (res == nullptr) return PyFail("MXTAutogradBackward");
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTNDArrayGetGrad(void* handle, void** out_grad) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("grad_of", args);
@@ -255,6 +282,7 @@ int MXTNDArrayGetGrad(void* handle, void** out_grad) {
   if (res == nullptr) return PyFail("MXTNDArrayGetGrad");
   *out_grad = res;
   return 0;
+  API_END()
 }
 
 }  // extern "C"

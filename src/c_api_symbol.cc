@@ -30,6 +30,12 @@
 #include "c_error.h"
 #include "py_embed.h"
 
+// Exception->errno translation on every entry point (mxlint MX006):
+// a C++ exception crossing the C ABI is UB; the macros turn it
+// into the -1/MXTGetLastError() contract (see c_error.h).
+#define API_BEGIN MXT_API_BEGIN
+#define API_END MXT_API_END
+
 namespace {
 
 using mxnet_tpu::FailWith;
@@ -146,20 +152,25 @@ extern "C" {
 // -- generic + misc ---------------------------------------------------------
 
 int MXTGetVersion(int* out) {
+  API_BEGIN()
   *out = 10600;
   return 0;
+  API_END()
 }
 
 int MXTRandomSeed(int seed) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(i)", seed);
   PyObject* res = CallRt("random_seed", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTRandomSeed");
+  API_END()
 }
 
 int MXTListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
@@ -167,39 +178,47 @@ int MXTListAllOpNames(uint32_t* out_size, const char*** out_array) {
   Py_DECREF(args);
   if (res == nullptr) return PyFail("MXTListAllOpNames");
   return ReturnStrList(res, out_size, out_array, "MXTListAllOpNames");
+  API_END()
 }
 
 // Load an external operator library (ref: MXLoadLib c_api.cc:96).
 int MXTLoadLib(const char* path) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", path);
   PyObject* res = CallRt("load_lib", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTLoadLib");
+  API_END()
 }
 
 // -- Symbol -----------------------------------------------------------------
 
 int MXTSymbolCreateFromJSON(const char* json, void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", json);
   PyObject* res = CallRt("symbol_from_json", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolCreateFromJSON");
+  API_END()
 }
 
 int MXTSymbolCreateFromFile(const char* path, void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", path);
   PyObject* res = CallRt("load_symbol_json", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolCreateFromFile");
+  API_END()
 }
 
 int MXTSymbolSaveToJSON(void* sym, const char** out_json) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_to_json", args);
@@ -214,28 +233,34 @@ int MXTSymbolSaveToJSON(void* sym, const char** out_json) {
   *out_json = ret_store.str.c_str();
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTSymbolSaveToFile(void* sym, const char* path) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym), path);
   PyObject* res = CallRt("symbol_save", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTSymbolSaveToFile");
+  API_END()
 }
 
 int MXTSymbolCreateVariable(const char* name, void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", name);
   PyObject* res = CallRt("symbol_var", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolCreateVariable");
+  API_END()
 }
 
 int MXTSymbolCreateAtomicSymbol(const char* op_name, uint32_t num_params,
                                 const char** keys, const char** vals,
                                 void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(sNN)", op_name,
@@ -244,11 +269,13 @@ int MXTSymbolCreateAtomicSymbol(const char* op_name, uint32_t num_params,
   PyObject* res = CallRt("symbol_create_atomic", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolCreateAtomicSymbol");
+  API_END()
 }
 
 // keys may be NULL => positional composition (reference semantics).
 int MXTSymbolCompose(void* atomic, const char* name, uint32_t num_args,
                      const char** keys, void** args_handles, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* keylist = keys ? StrList(keys, num_args) : PyList_New(0);
   PyObject* args = Py_BuildValue("(OsNN)", static_cast<PyObject*>(atomic),
@@ -257,30 +284,36 @@ int MXTSymbolCompose(void* atomic, const char* name, uint32_t num_args,
   PyObject* res = CallRt("symbol_compose", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolCompose");
+  API_END()
 }
 
 int MXTSymbolListArguments(void* sym, uint32_t* out_size,
                            const char*** out_array) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_list_arguments", args);
   Py_DECREF(args);
   if (res == nullptr) return PyFail("MXTSymbolListArguments");
   return ReturnStrList(res, out_size, out_array, "MXTSymbolListArguments");
+  API_END()
 }
 
 int MXTSymbolListOutputs(void* sym, uint32_t* out_size,
                          const char*** out_array) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_list_outputs", args);
   Py_DECREF(args);
   if (res == nullptr) return PyFail("MXTSymbolListOutputs");
   return ReturnStrList(res, out_size, out_array, "MXTSymbolListOutputs");
+  API_END()
 }
 
 int MXTSymbolListAuxiliaryStates(void* sym, uint32_t* out_size,
                                  const char*** out_array) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_list_aux", args);
@@ -288,9 +321,11 @@ int MXTSymbolListAuxiliaryStates(void* sym, uint32_t* out_size,
   if (res == nullptr) return PyFail("MXTSymbolListAuxiliaryStates");
   return ReturnStrList(res, out_size, out_array,
                        "MXTSymbolListAuxiliaryStates");
+  API_END()
 }
 
 int MXTSymbolGetName(void* sym, const char** out_name) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_name", args);
@@ -301,6 +336,7 @@ int MXTSymbolGetName(void* sym, const char** out_name) {
   *out_name = ret_store.str.c_str();
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 // Infer shapes from provided named input shapes.
@@ -314,6 +350,7 @@ int MXTSymbolInferShape(void* sym, uint32_t num_provided,
                         uint32_t* aux_count,
                         const uint32_t** all_ndims,
                         const int64_t** all_dims) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(sym),
                                  StrList(names, num_provided),
@@ -345,13 +382,16 @@ int MXTSymbolInferShape(void* sym, uint32_t num_provided,
   *all_ndims = ret_store.shape_ndim.data();
   *all_dims = ret_store.shape_data.data();
   return 0;
+  API_END()
 }
 
 int MXTSymbolFree(void* sym) {
+  API_BEGIN()
   if (sym == nullptr) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(sym));
   return 0;
+  API_END()
 }
 
 // -- Executor ---------------------------------------------------------------
@@ -360,6 +400,7 @@ int MXTExecutorSimpleBind(void* sym, uint32_t num_provided,
                           const char** names, const uint32_t* ndims,
                           const int64_t* shapes_flat,
                           const char* grad_req, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ONNs)", static_cast<PyObject*>(sym),
                                  StrList(names, num_provided),
@@ -368,19 +409,23 @@ int MXTExecutorSimpleBind(void* sym, uint32_t num_provided,
   PyObject* res = CallRt("executor_simple_bind", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTExecutorSimpleBind");
+  API_END()
 }
 
 int MXTExecutorForward(void* exec, int is_train) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(exec),
                                  is_train);
   PyObject* res = CallRt("executor_forward", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTExecutorForward");
+  API_END()
 }
 
 int MXTExecutorOutputs(void* exec, uint32_t* num_outputs,
                        void** out_handles, uint32_t max_outputs) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(exec));
   PyObject* res = CallRt("executor_outputs", args);
@@ -401,48 +446,59 @@ int MXTExecutorOutputs(void* exec, uint32_t* num_outputs,
   }
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 // num_head_grads == 0 => implicit ones (reference backward() semantics).
 int MXTExecutorBackward(void* exec, uint32_t num_head_grads,
                         void** head_grads) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(exec),
                                  HandleList(head_grads, num_head_grads));
   PyObject* res = CallRt("executor_backward", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTExecutorBackward");
+  API_END()
 }
 
 int MXTExecutorArgArray(void* exec, const char* name, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(exec), name);
   PyObject* res = CallRt("executor_arg", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTExecutorArgArray");
+  API_END()
 }
 
 int MXTExecutorGradArray(void* exec, const char* name, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(exec), name);
   PyObject* res = CallRt("executor_grad", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTExecutorGradArray");
+  API_END()
 }
 
 int MXTExecutorAuxArray(void* exec, const char* name, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(exec), name);
   PyObject* res = CallRt("executor_aux", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTExecutorAuxArray");
+  API_END()
 }
 
 int MXTExecutorFree(void* exec) {
+  API_BEGIN()
   if (exec == nullptr) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(exec));
   return 0;
+  API_END()
 }
 
 // -- CachedOp ---------------------------------------------------------------
@@ -454,6 +510,7 @@ int MXTExecutorFree(void* exec) {
 
 int MXTCachedOpCreate(void* sym, uint32_t num_flags, const char** keys,
                       const char** vals, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(sym),
                                  StrList(keys, num_flags),
@@ -461,11 +518,13 @@ int MXTCachedOpCreate(void* sym, uint32_t num_flags, const char** keys,
   PyObject* res = CallRt("cachedop_create", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTCachedOpCreate");
+  API_END()
 }
 
 int MXTCachedOpInvoke(void* op, uint32_t num_inputs, void** inputs,
                       uint32_t* num_outputs, void** out_handles,
                       uint32_t max_outputs) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(op),
                                  HandleList(inputs, num_inputs));
@@ -487,9 +546,11 @@ int MXTCachedOpInvoke(void* op, uint32_t num_inputs, void** inputs,
   }
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTCachedOpGetStats(void* op, uint64_t* calls, uint64_t* compiles) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(op));
   PyObject* res = CallRt("cachedop_stats", args);
@@ -504,83 +565,101 @@ int MXTCachedOpGetStats(void* op, uint64_t* calls, uint64_t* compiles) {
   *calls = c;
   *compiles = m;
   return 0;
+  API_END()
 }
 
 int MXTCachedOpFree(void* op) {
+  API_BEGIN()
   if (op == nullptr) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(op));
   return 0;
+  API_END()
 }
 
 // -- KVStore ----------------------------------------------------------------
 
 int MXTKVStoreCreate(const char* type, void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", type);
   PyObject* res = CallRt("kv_create", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTKVStoreCreate");
+  API_END()
 }
 
 int MXTKVStoreInit(void* kv, int key, void* nd) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OiO)", static_cast<PyObject*>(kv), key,
                                  static_cast<PyObject*>(nd));
   PyObject* res = CallRt("kv_init", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStoreInit");
+  API_END()
 }
 
 int MXTKVStoreInitEx(void* kv, const char* key, void* nd) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OsO)", static_cast<PyObject*>(kv), key,
                                  static_cast<PyObject*>(nd));
   PyObject* res = CallRt("kv_init", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStoreInitEx");
+  API_END()
 }
 
 int MXTKVStorePush(void* kv, int key, void* nd, int priority) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OiOi)", static_cast<PyObject*>(kv), key,
                                  static_cast<PyObject*>(nd), priority);
   PyObject* res = CallRt("kv_push", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStorePush");
+  API_END()
 }
 
 int MXTKVStorePushEx(void* kv, const char* key, void* nd, int priority) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OsOi)", static_cast<PyObject*>(kv), key,
                                  static_cast<PyObject*>(nd), priority);
   PyObject* res = CallRt("kv_push", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStorePushEx");
+  API_END()
 }
 
 int MXTKVStorePull(void* kv, int key, void* out_nd, int priority) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OiOi)", static_cast<PyObject*>(kv), key,
                                  static_cast<PyObject*>(out_nd), priority);
   PyObject* res = CallRt("kv_pull", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStorePull");
+  API_END()
 }
 
 int MXTKVStorePullEx(void* kv, const char* key, void* out_nd, int priority) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OsOi)", static_cast<PyObject*>(kv), key,
                                  static_cast<PyObject*>(out_nd), priority);
   PyObject* res = CallRt("kv_pull", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStorePullEx");
+  API_END()
 }
 
 // Fused push+pull (ref: MXKVStorePushPullEx) — in/out may alias.
 int MXTKVStorePushPull(void* kv, int key, void* in_nd, void* out_nd,
                        int priority) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OiOOi)", static_cast<PyObject*>(kv), key,
                                  static_cast<PyObject*>(in_nd),
@@ -588,9 +667,11 @@ int MXTKVStorePushPull(void* kv, int key, void* in_nd, void* out_nd,
   PyObject* res = CallRt("kv_pushpull", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStorePushPull");
+  API_END()
 }
 
 int MXTKVStoreGetRank(void* kv, int* out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
   PyObject* res = CallRt("kv_rank", args);
@@ -599,9 +680,11 @@ int MXTKVStoreGetRank(void* kv, int* out) {
   *out = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTKVStoreGetGroupSize(void* kv, int* out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
   PyObject* res = CallRt("kv_size", args);
@@ -610,9 +693,11 @@ int MXTKVStoreGetGroupSize(void* kv, int* out) {
   *out = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTKVStoreGetType(void* kv, const char** out_type) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
   PyObject* res = CallRt("kv_type", args);
@@ -623,6 +708,7 @@ int MXTKVStoreGetType(void* kv, const char** out_type) {
   *out_type = ret_store.str.c_str();
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 // Build the optimizer server-side from name+params — the C-frontend
@@ -631,6 +717,7 @@ int MXTKVStoreGetType(void* kv, const char** out_type) {
 int MXTKVStoreSetOptimizer(void* kv, const char* opt_name,
                            uint32_t num_params, const char** keys,
                            const char** vals) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OsNN)", static_cast<PyObject*>(kv),
                                  opt_name, StrList(keys, num_params),
@@ -638,28 +725,34 @@ int MXTKVStoreSetOptimizer(void* kv, const char* opt_name,
   PyObject* res = CallRt("kv_set_optimizer", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStoreSetOptimizer");
+  API_END()
 }
 
 // Global barrier across workers (ref: MXKVStoreBarrier /
 // ps::Postoffice::Barrier).
 int MXTKVStoreBarrier(void* kv) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
   PyObject* res = CallRt("kv_barrier", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStoreBarrier");
+  API_END()
 }
 
 int MXTKVStoreFree(void* kv) {
+  API_BEGIN()
   if (kv == nullptr) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(kv));
   return 0;
+  API_END()
 }
 
 // -- DataIter ---------------------------------------------------------------
 
 int MXTListDataIters(uint32_t* out_size, const char*** out_array) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
@@ -667,10 +760,12 @@ int MXTListDataIters(uint32_t* out_size, const char*** out_array) {
   Py_DECREF(args);
   if (res == nullptr) return PyFail("MXTListDataIters");
   return ReturnStrList(res, out_size, out_array, "MXTListDataIters");
+  API_END()
 }
 
 int MXTDataIterCreate(const char* name, uint32_t num_params,
                       const char** keys, const char** vals, void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(sNN)", name, StrList(keys, num_params),
@@ -678,9 +773,11 @@ int MXTDataIterCreate(const char* name, uint32_t num_params,
   PyObject* res = CallRt("data_iter_create", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTDataIterCreate");
+  API_END()
 }
 
 int MXTDataIterNext(void* iter, int* out_more) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
   PyObject* res = CallRt("data_iter_next", args);
@@ -689,37 +786,46 @@ int MXTDataIterNext(void* iter, int* out_more) {
   *out_more = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTDataIterGetData(void* iter, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
   PyObject* res = CallRt("data_iter_data", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTDataIterGetData");
+  API_END()
 }
 
 int MXTDataIterGetLabel(void* iter, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
   PyObject* res = CallRt("data_iter_label", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTDataIterGetLabel");
+  API_END()
 }
 
 int MXTDataIterBeforeFirst(void* iter) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
   PyObject* res = CallRt("data_iter_reset", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTDataIterBeforeFirst");
+  API_END()
 }
 
 int MXTDataIterFree(void* iter) {
+  API_BEGIN()
   if (iter == nullptr) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(iter));
   return 0;
+  API_END()
 }
 
 // -- NDArray save/load + in-place copy --------------------------------------
@@ -727,6 +833,7 @@ int MXTDataIterFree(void* iter) {
 // names may be NULL => unnamed records (ref: MXNDArraySave c_api.h:659).
 int MXTNDArraySave(const char* fname, uint32_t num, void** handles,
                    const char** names) {
+  API_BEGIN()
   Gil gil;
   PyObject* namelist = names ? StrList(names, num) : PyList_New(0);
   PyObject* args = Py_BuildValue("(sNN)", fname, HandleList(handles, num),
@@ -734,6 +841,7 @@ int MXTNDArraySave(const char* fname, uint32_t num, void** handles,
   PyObject* res = CallRt("nd_save", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTNDArraySave");
+  API_END()
 }
 
 // Returned handle/name arrays stay valid until the next Load on this
@@ -741,6 +849,7 @@ int MXTNDArraySave(const char* fname, uint32_t num, void** handles,
 // MXTNDArrayFree). (ref: MXNDArrayLoad c_api.h:672)
 int MXTNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
                    uint32_t* out_name_size, const char*** out_names) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", fname);
@@ -770,10 +879,12 @@ int MXTNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
   *out_name_size = static_cast<uint32_t>(nn);
   *out_names = ret_store.charp.data();
   return 0;
+  API_END()
 }
 
 int MXTNDArraySyncCopyFromCPU(void* handle, const void* data,
                               size_t nbytes) {
+  API_BEGIN()
   Gil gil;
   PyObject* raw = PyBytes_FromStringAndSize(
       static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
@@ -782,12 +893,14 @@ int MXTNDArraySyncCopyFromCPU(void* handle, const void* data,
   PyObject* res = CallRt("copy_from_bytes", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTNDArraySyncCopyFromCPU");
+  API_END()
 }
 
 // -- NDArray views (ref: MXNDArrayReshape/Slice/At c_api.h) -----------------
 
 int MXTNDArrayReshape(void* handle, uint32_t ndim, const int64_t* dims,
                       void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* shp = PyList_New(ndim);
   for (uint32_t i = 0; i < ndim; ++i)
@@ -797,9 +910,11 @@ int MXTNDArrayReshape(void* handle, uint32_t ndim, const int64_t* dims,
   PyObject* res = CallRt("nd_reshape", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTNDArrayReshape");
+  API_END()
 }
 
 int MXTNDArraySlice(void* handle, int64_t begin, int64_t end, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OLL)", static_cast<PyObject*>(handle),
                                  static_cast<long long>(begin),
@@ -807,20 +922,24 @@ int MXTNDArraySlice(void* handle, int64_t begin, int64_t end, void** out) {
   PyObject* res = CallRt("nd_slice", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTNDArraySlice");
+  API_END()
 }
 
 int MXTNDArrayAt(void* handle, int64_t idx, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OL)", static_cast<PyObject*>(handle),
                                  static_cast<long long>(idx));
   PyObject* res = CallRt("nd_at", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTNDArrayAt");
+  API_END()
 }
 
 // -- autograd flags (ref: MXAutogradIsRecording/IsTraining/SetIsTraining) ---
 
 int MXTAutogradIsRecording(int* out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
@@ -830,9 +949,11 @@ int MXTAutogradIsRecording(int* out) {
   *out = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTAutogradIsTraining(int* out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
@@ -842,21 +963,25 @@ int MXTAutogradIsTraining(int* out) {
   *out = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTAutogradSetIsTraining(int train_mode) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(i)", train_mode);
   PyObject* res = CallRt("autograd_set_training", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTAutogradSetIsTraining");
+  API_END()
 }
 
 // -- profiler (ref: MXSetProcessProfilerConfig/State, MXDumpProfile) --------
 
 int MXTProfileSetConfig(uint32_t num_params, const char** keys,
                         const char** vals) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(NN)", StrList(keys, num_params),
@@ -864,24 +989,29 @@ int MXTProfileSetConfig(uint32_t num_params, const char** keys,
   PyObject* res = CallRt("profiler_set_config", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfileSetConfig");
+  API_END()
 }
 
 int MXTProfileSetState(int state) {  // 0 = stop, 1 = run
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(i)", state);
   PyObject* res = CallRt("profiler_set_state", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfileSetState");
+  API_END()
 }
 
 int MXTProfileDump() {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
   PyObject* res = CallRt("profiler_dump", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfileDump");
+  API_END()
 }
 
 // -- Symbol attrs / views (ref: MXSymbolGetAttr/SetAttr/ListAttr,
@@ -889,6 +1019,7 @@ int MXTProfileDump() {
 
 int MXTSymbolGetAttr(void* sym, const char* key, const char** out,
                      int* success) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym), key);
   PyObject* res = CallRt("symbol_attr", args);
@@ -911,20 +1042,24 @@ int MXTSymbolGetAttr(void* sym, const char* key, const char** out,
   *success = 1;
   *out = ret_store.str.c_str();
   return 0;
+  API_END()
 }
 
 int MXTSymbolSetAttr(void* sym, const char* key, const char* value) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Oss)", static_cast<PyObject*>(sym),
                                  key, value);
   PyObject* res = CallRt("symbol_set_attr", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTSymbolSetAttr");
+  API_END()
 }
 
 // JSON object {node: {key: value}} — one call instead of the
 // reference's paired size/array outputs.
 int MXTSymbolListAttr(void* sym, const char** out_json) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_attr_json", args);
@@ -939,45 +1074,55 @@ int MXTSymbolListAttr(void* sym, const char** out_json) {
   *out_json = ret_store.str.c_str();
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTSymbolGetInternals(void* sym, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_get_internals", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolGetInternals");
+  API_END()
 }
 
 int MXTSymbolGetOutput(void* sym, uint32_t index, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(sym),
                                  index);
   PyObject* res = CallRt("symbol_get_output", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolGetOutput");
+  API_END()
 }
 
 int MXTSymbolCopy(void* sym, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_copy", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolCopy");
+  API_END()
 }
 
 // Device-side value copy dst <- src (no host round trip; ref:
 // MXNDArraySyncCopyFromNDArray).
 int MXTNDArrayCopyFrom(void* dst, void* src) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(dst),
                                  static_cast<PyObject*>(src));
   PyObject* res = CallRt("set_data", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTNDArrayCopyFrom");
+  API_END()
 }
 
 int MXTNDArrayGetDType(void* handle, int* out_dtype) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("dtype_of", args);
@@ -986,6 +1131,7 @@ int MXTNDArrayGetDType(void* handle, int* out_dtype) {
   *out_dtype = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 }  // extern "C"
@@ -1029,26 +1175,33 @@ extern "C" {
 // -- NDArray ----------------------------------------------------------------
 
 int MXTNDArrayWaitToRead(void* handle) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("nd_wait", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTNDArrayWaitToRead");
+  API_END()
 }
 
 int MXTNDArrayWaitToWrite(void* handle) {
+  API_BEGIN()
   return MXTNDArrayWaitToRead(handle);
+  API_END()
 }
 
 int MXTNDArrayDetach(void* handle, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("nd_detach", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTNDArrayDetach");
+  API_END()
 }
 
 int MXTNDArrayGetContext(void* handle, int* out_dev_type, int* out_dev_id) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("nd_context", args);
@@ -1060,37 +1213,45 @@ int MXTNDArrayGetContext(void* handle, int* out_dev_type, int* out_dev_id) {
   }
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTNDArrayGetStorageType(void* handle, int* out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("nd_storage_type", args);
   Py_DECREF(args);
   return ReturnInt(res, out, "MXTNDArrayGetStorageType");
+  API_END()
 }
 
 int MXTNDArrayCreateNone(void** out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
   PyObject* res = CallRt("nd_none", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTNDArrayCreateNone");
+  API_END()
 }
 
 int MXTShallowCopyNDArray(void* handle, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = CallRt("nd_shallow_copy", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTShallowCopyNDArray");
+  API_END()
 }
 
 int MXTNDArrayLoadFromBuffer(const void* buf, size_t size,
                              uint32_t* out_size, void*** out_arr,
                              uint32_t* out_name_size,
                              const char*** out_names) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue(
@@ -1123,19 +1284,23 @@ int MXTNDArrayLoadFromBuffer(const void* buf, size_t size,
   *out_arr = ret_store.handles.data();
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 // -- Symbol -----------------------------------------------------------------
 
 int MXTSymbolCreateGroup(uint32_t num_symbols, void** symbols, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(N)", HandleList(symbols, num_symbols));
   PyObject* res = CallRt("symbol_group", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTSymbolCreateGroup");
+  API_END()
 }
 
 int MXTSymbolGetNumOutputs(void* sym, uint32_t* out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_num_outputs", args);
@@ -1144,17 +1309,21 @@ int MXTSymbolGetNumOutputs(void* sym, uint32_t* out) {
   int rc = ReturnInt(res, &v, "MXTSymbolGetNumOutputs");
   *out = static_cast<uint32_t>(v);
   return rc;
+  API_END()
 }
 
 int MXTSymbolPrint(void* sym, const char** out_str) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_print", args);
   Py_DECREF(args);
   return ReturnStr(res, out_str, "MXTSymbolPrint");
+  API_END()
 }
 
 int MXTSymbolGetChildren(void* sym, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_get_children", args);
@@ -1165,10 +1334,12 @@ int MXTSymbolGetChildren(void* sym, void** out) {
     return 0;
   }
   return ReturnHandle(res, out, "MXTSymbolGetChildren");
+  API_END()
 }
 
 int MXTSymbolGetInputSymbols(void* sym, void** out_handles,
                              uint32_t max_inputs, int* out_size) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_get_inputs", args);
@@ -1187,32 +1358,39 @@ int MXTSymbolGetInputSymbols(void* sym, void** out_handles,
   *out_size = static_cast<int>(n);
   Py_DECREF(res);
   return 0;
+  API_END()
 }
 
 int MXTSymbolGetAtomicSymbolName(void* sym, const char** out_name) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_atomic_name", args);
   Py_DECREF(args);
   return ReturnStr(res, out_name, "MXTSymbolGetAtomicSymbolName");
+  API_END()
 }
 
 int MXTSymbolListAttrShallow(void* sym, uint32_t* out_size,
                              const char*** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
   PyObject* res = CallRt("symbol_attrs_shallow", args);
   Py_DECREF(args);
   if (res == nullptr) return PyFail("MXTSymbolListAttrShallow");
   return ReturnStrList(res, out_size, out, "MXTSymbolListAttrShallow");
+  API_END()
 }
 
 int MXTShallowCopySymbol(void* sym, void** out) {
+  API_BEGIN()
   if (sym == nullptr) return FailWith("null symbol");
   Gil gil;
   Py_INCREF(static_cast<PyObject*>(sym));
   *out = sym;
   return 0;
+  API_END()
 }
 
 int MXTSymbolInferShapePartial(void* sym, uint32_t num_provided,
@@ -1222,6 +1400,7 @@ int MXTSymbolInferShapePartial(void* sym, uint32_t num_provided,
                                uint32_t* aux_count,
                                const uint32_t** all_ndims,
                                const int64_t** all_dims) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(sym),
                                  StrList(names, num_provided),
@@ -1252,6 +1431,7 @@ int MXTSymbolInferShapePartial(void* sym, uint32_t num_provided,
   *all_ndims = ret_store.shape_ndim.data();
   *all_dims = ret_store.shape_data.data();
   return 0;
+  API_END()
 }
 
 int MXTSymbolInferType(void* sym, uint32_t num_provided, const char** names,
@@ -1259,6 +1439,7 @@ int MXTSymbolInferType(void* sym, uint32_t num_provided, const char** names,
                        const int** arg_types, uint32_t* out_count,
                        const int** out_types, uint32_t* aux_count,
                        const int** aux_types) {
+  API_BEGIN()
   Gil gil;
   PyObject* dt = PyList_New(num_provided);
   for (uint32_t i = 0; i < num_provided; ++i)
@@ -1285,21 +1466,25 @@ int MXTSymbolInferType(void* sym, uint32_t num_provided, const char** names,
   *aux_count = static_cast<uint32_t>(aux_v.size());
   *aux_types = aux_v.data();
   return 0;
+  API_END()
 }
 
 // -- Executor ---------------------------------------------------------------
 
 int MXTExecutorPrint(void* exec, const char** out_str) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(exec));
   PyObject* res = CallRt("executor_print", args);
   Py_DECREF(args);
   return ReturnStr(res, out_str, "MXTExecutorPrint");
+  API_END()
 }
 
 int MXTExecutorReshape(void* exec, uint32_t num_provided,
                        const char** names, const uint32_t* ndims,
                        const int64_t* shapes_flat, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(exec),
                                  StrList(names, num_provided),
@@ -1307,10 +1492,12 @@ int MXTExecutorReshape(void* exec, uint32_t num_provided,
   PyObject* res = CallRt("executor_reshape", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTExecutorReshape");
+  API_END()
 }
 
 int MXTExecutorBind(void* sym, uint32_t num_args, const char** names,
                     void** arg_handles, const char* grad_req, void** out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ONNs)", static_cast<PyObject*>(sym),
                                  StrList(names, num_args),
@@ -1319,49 +1506,59 @@ int MXTExecutorBind(void* sym, uint32_t num_args, const char** names,
   PyObject* res = CallRt("executor_bind", args);
   Py_DECREF(args);
   return ReturnHandle(res, out, "MXTExecutorBind");
+  API_END()
 }
 
 // -- KVStore ----------------------------------------------------------------
 
 int MXTKVStoreIsWorkerNode(int* out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", "worker");
   PyObject* res = CallRt("kv_role", args);
   Py_DECREF(args);
   return ReturnInt(res, out, "MXTKVStoreIsWorkerNode");
+  API_END()
 }
 
 int MXTKVStoreIsServerNode(int* out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", "server");
   PyObject* res = CallRt("kv_role", args);
   Py_DECREF(args);
   return ReturnInt(res, out, "MXTKVStoreIsServerNode");
+  API_END()
 }
 
 int MXTKVStoreIsSchedulerNode(int* out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", "scheduler");
   PyObject* res = CallRt("kv_role", args);
   Py_DECREF(args);
   return ReturnInt(res, out, "MXTKVStoreIsSchedulerNode");
+  API_END()
 }
 
 int MXTKVStoreGetNumDeadNode(void* kv, int node_id, int* out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(kv),
                                  node_id);
   PyObject* res = CallRt("kv_num_dead", args);
   Py_DECREF(args);
   return ReturnInt(res, out, "MXTKVStoreGetNumDeadNode");
+  API_END()
 }
 
 int MXTKVStoreSetGradientCompression(void* kv, uint32_t num_params,
                                      const char** keys,
                                      const char** vals) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(kv),
                                  StrList(keys, num_params),
@@ -1369,10 +1566,12 @@ int MXTKVStoreSetGradientCompression(void* kv, uint32_t num_params,
   PyObject* res = CallRt("kv_set_gradient_compression", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStoreSetGradientCompression");
+  API_END()
 }
 
 int MXTKVStorePullRowSparse(void* kv, const char* key, void* row_ids,
                             void* out_arr) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OsOO)", static_cast<PyObject*>(kv), key,
                                  static_cast<PyObject*>(row_ids),
@@ -1380,18 +1579,22 @@ int MXTKVStorePullRowSparse(void* kv, const char* key, void* row_ids,
   PyObject* res = CallRt("kv_pull_row_sparse", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTKVStorePullRowSparse");
+  API_END()
 }
 
 int MXTNotifyShutdown(void) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
   PyObject* res = CallRt("notify_shutdown", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTNotifyShutdown");
+  API_END()
 }
 
 int MXTInitPSEnv(uint32_t num_vars, const char** keys, const char** vals) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(NN)", StrList(keys, num_vars),
@@ -1399,6 +1602,7 @@ int MXTInitPSEnv(uint32_t num_vars, const char** keys, const char** vals) {
   PyObject* res = CallRt("init_ps_env", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTInitPSEnv");
+  API_END()
 }
 
 // -- Profiler object family -------------------------------------------------
@@ -1415,92 +1619,117 @@ static int ProfileCreate(const char* kind, void* domain, const char* name,
 }
 
 int MXTProfileCreateDomain(const char* name, void** out) {
+  API_BEGIN()
   return ProfileCreate("domain", nullptr, name, out,
                        "MXTProfileCreateDomain");
+  API_END()
 }
 
 int MXTProfileCreateTask(void* domain, const char* name, void** out) {
+  API_BEGIN()
   return ProfileCreate("task", domain, name, out, "MXTProfileCreateTask");
+  API_END()
 }
 
 int MXTProfileCreateFrame(void* domain, const char* name, void** out) {
+  API_BEGIN()
   return ProfileCreate("frame", domain, name, out,
                        "MXTProfileCreateFrame");
+  API_END()
 }
 
 int MXTProfileCreateEvent(const char* name, void** out) {
+  API_BEGIN()
   return ProfileCreate("event", nullptr, name, out,
                        "MXTProfileCreateEvent");
+  API_END()
 }
 
 int MXTProfileCreateCounter(void* domain, const char* name, void** out) {
+  API_BEGIN()
   return ProfileCreate("counter", domain, name, out,
                        "MXTProfileCreateCounter");
+  API_END()
 }
 
 int MXTProfileDestroyHandle(void* handle) {
+  API_BEGIN()
   if (handle == nullptr) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(handle));
   return 0;
+  API_END()
 }
 
 int MXTProfileDurationStart(void* handle) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle), 1);
   PyObject* res = CallRt("profile_duration", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfileDurationStart");
+  API_END()
 }
 
 int MXTProfileDurationStop(void* handle) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle), 0);
   PyObject* res = CallRt("profile_duration", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfileDurationStop");
+  API_END()
 }
 
 int MXTProfileSetCounter(void* handle, uint64_t value) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OK)", static_cast<PyObject*>(handle),
                                  static_cast<unsigned long long>(value));
   PyObject* res = CallRt("profile_counter_set", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfileSetCounter");
+  API_END()
 }
 
 int MXTProfileAdjustCounter(void* handle, int64_t delta) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(OL)", static_cast<PyObject*>(handle),
                                  static_cast<long long>(delta));
   PyObject* res = CallRt("profile_counter_adjust", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfileAdjustCounter");
+  API_END()
 }
 
 int MXTProfileSetMarker(void* domain, const char* name,
                         const char* scope) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(Oss)", static_cast<PyObject*>(domain),
                                  name, scope ? scope : "process");
   PyObject* res = CallRt("profile_set_marker", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfileSetMarker");
+  API_END()
 }
 
 int MXTProfilePause(int paused) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(i)", paused);
   PyObject* res = CallRt("profile_pause", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTProfilePause");
+  API_END()
 }
 
 int MXTAggregateProfileStatsPrint(const char** out_str, int reset,
                                   const char* format, const char* sort_by,
                                   int ascending) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(issi)", reset, format ? format : "table",
@@ -1508,20 +1737,24 @@ int MXTAggregateProfileStatsPrint(const char** out_str, int reset,
   PyObject* res = CallRt("profile_aggregate_stats", args);
   Py_DECREF(args);
   return ReturnStr(res, out_str, "MXTAggregateProfileStatsPrint");
+  API_END()
 }
 
 // -- misc -------------------------------------------------------------------
 
 int MXTEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(i)", bulk_size);
   PyObject* res = CallRt("engine_set_bulk_size", args);
   Py_DECREF(args);
   return ReturnInt(res, prev_bulk_size, "MXTEngineSetBulkSize");
+  API_END()
 }
 
 int MXTLibInfoFeatures(uint32_t* out_size, const char*** out_pairs) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
@@ -1529,45 +1762,55 @@ int MXTLibInfoFeatures(uint32_t* out_size, const char*** out_pairs) {
   Py_DECREF(args);
   if (res == nullptr) return PyFail("MXTLibInfoFeatures");
   return ReturnStrList(res, out_size, out_pairs, "MXTLibInfoFeatures");
+  API_END()
 }
 
 int MXTRandomSeedContext(int seed, int dev_type, int dev_id) {
+  API_BEGIN()
   (void)dev_type;
   (void)dev_id;
   return MXTRandomSeed(seed);
+  API_END()
 }
 
 int MXTIsNumpyShape(int* out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
   PyObject* res = CallRt("np_shape_is", args);
   Py_DECREF(args);
   return ReturnInt(res, out, "MXTIsNumpyShape");
+  API_END()
 }
 
 int MXTSetIsNumpyShape(int is_np_shape, int* prev) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(i)", is_np_shape);
   PyObject* res = CallRt("np_shape_set", args);
   Py_DECREF(args);
   return ReturnInt(res, prev, "MXTSetIsNumpyShape");
+  API_END()
 }
 
 // "GPU" in the reference ABI = the accelerator; here that is the TPU
 // fleet PJRT exposes (ref: MXGetGPUCount / MXGetGPUMemoryInformation64).
 int MXTGetGPUCount(int* out) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = PyTuple_New(0);
   PyObject* res = CallRt("device_count", args);
   Py_DECREF(args);
   return ReturnInt(res, out, "MXTGetGPUCount");
+  API_END()
 }
 
 int MXTGetGPUMemoryInformation(int dev_id, uint64_t* free_mem,
                                uint64_t* total_mem) {
+  API_BEGIN()
   EnsurePython();
   Gil gil;
   PyObject* args = Py_BuildValue("(i)", dev_id);
@@ -1583,18 +1826,22 @@ int MXTGetGPUMemoryInformation(int dev_id, uint64_t* free_mem,
   *free_mem = f;
   *total_mem = t;
   return 0;
+  API_END()
 }
 
 int MXTDataIterGetPadNum(void* iter, int* out) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
   PyObject* res = CallRt("dataiter_pad", args);
   Py_DECREF(args);
   return ReturnInt(res, out, "MXTDataIterGetPadNum");
+  API_END()
 }
 
 int MXTDataIterGetIndex(void* iter, uint64_t** out_index,
                         uint64_t* out_size) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(iter));
   PyObject* res = CallRt("dataiter_index", args);
@@ -1609,18 +1856,22 @@ int MXTDataIterGetIndex(void* iter, uint64_t** out_index,
   *out_size = idx.size();
   *out_index = idx.data();
   return 0;
+  API_END()
 }
 
 int MXTAutogradComputeGradient(uint32_t num_output, void** output_handles) {
+  API_BEGIN()
   Gil gil;
   PyObject* args = Py_BuildValue("(N)",
                                  HandleList(output_handles, num_output));
   PyObject* res = CallRt("backward", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTAutogradComputeGradient");
+  API_END()
 }
 
 int MXTStorageEmptyCache(int dev_type, int dev_id) {
+  API_BEGIN()
   (void)dev_type;
   (void)dev_id;
   EnsurePython();
@@ -1629,6 +1880,7 @@ int MXTStorageEmptyCache(int dev_type, int dev_id) {
   PyObject* res = CallRt("storage_empty_cache", args);
   Py_DECREF(args);
   return ReturnOk(res, "MXTStorageEmptyCache");
+  API_END()
 }
 
 }  // extern "C"

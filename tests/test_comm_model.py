@@ -56,6 +56,51 @@ ENTRY %main (a: f32[8]) -> f32[8] {
     assert unresolved == 0
 
 
+def test_async_start_collective_counts_result_only():
+    """Regression: an async ``-start`` collective's HLO result is an
+    (operand, result) tuple — the payload must be counted once, not
+    doubled, and the matching ``-done`` line adds nothing."""
+    hlo = """\
+HloModule m, is_scheduled=true
+
+ENTRY %main (a: f32[512,128]) -> f32[512,128] {
+  %a = f32[512,128]{1,0} parameter(0)
+  %ar-start = (f32[512,128]{1,0}, f32[512,128]{1,0}) all-reduce-start(%a), channel_id=1, to_apply=%add
+  ROOT %ar-done = f32[512,128]{1,0} all-reduce-done(%ar-start)
+}
+"""
+    by_kind, counts, unresolved = comm_model.hlo_collective_bytes(hlo)
+    assert by_kind["all-reduce"] == 512 * 128 * 4  # once, not twice
+    assert counts["all-reduce"] == 1
+    assert unresolved == 0
+
+
+def test_async_start_with_context_elements_counts_result_only():
+    """collective-permute-start's result tuple carries two trailing
+    u32[] context elements — the payload is still only the second
+    element."""
+    hlo = """\
+HloModule m, is_scheduled=true
+
+ENTRY %main (x: f32[1024]) -> f32[1024] {
+  %x = f32[1024]{0} parameter(0)
+  %cp-start = (f32[1024]{0}, f32[1024]{0}, u32[], u32[]) collective-permute-start(%x), channel_id=1
+  ROOT %cp-done = f32[1024]{0} collective-permute-done(%cp-start)
+}
+"""
+    by_kind, counts, unresolved = comm_model.hlo_collective_bytes(hlo)
+    assert by_kind["collective-permute"] == 1024 * 4, by_kind
+    assert counts["collective-permute"] == 1
+    assert unresolved == 0
+
+
+def test_tuple_elements_tracks_layout_braces():
+    elems = comm_model._tuple_elements(
+        "(f32[512,128]{1,0}, f32[512,128]{1,0})")
+    assert elems == ["f32[512,128]{1,0}", " f32[512,128]{1,0}"]
+    assert comm_model._tuple_elements("f32[8]{0}") == []
+
+
 def test_pure_dp_measurement_matches_analytic_model():
     """End-to-end on the virtual mesh: the HLO-measured all-reduce
     payload of the pure-dp train step must match the analytic model

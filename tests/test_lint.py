@@ -1,0 +1,270 @@
+"""mxlint self-enforcement (tools/mxlint; docs/LINTING.md).
+
+Two halves:
+
+* the tier-1 gate: mxlint over the whole tree must report ZERO
+  unwaived findings — the PR 1-2 invariants (single dispatch choke
+  point, guarded telemetry, locked shared state, API_BEGIN/API_END on
+  the C ABI, monotonic trace clocks) stay true by construction, and
+* unit coverage of each rule and of the waiver/baseline machinery on
+  synthetic inputs, so a rule regression can't silently turn the gate
+  into a no-op.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools import mxlint
+from tools.mxlint import core, rules
+
+REPO = core.REPO_ROOT
+
+
+# -- the gate ----------------------------------------------------------------
+
+def test_tree_is_lint_clean():
+    """`python -m tools.mxlint mxnet_tpu src tests` — zero unwaived
+    violations. If this fails: fix the finding, or waive it with an
+    inline justification (docs/LINTING.md)."""
+    findings, n_waived, n_baselined, bad = mxlint.run(
+        ["mxnet_tpu", "src", "tests"])
+    assert bad == [], "waivers without justification:\n%s" % "\n".join(
+        map(repr, bad))
+    assert findings == [], "unwaived mxlint findings:\n%s" % "\n".join(
+        map(repr, findings))
+    # the gate must actually be exercising the rules, not skipping files
+    assert n_waived > 0
+
+
+def test_cli_exits_zero_on_tree():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "mxnet_tpu", "src",
+         "tests"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_baseline_is_empty():
+    """The checked-in baseline must stay empty: new findings are fixed
+    or waived with a reason, never silently baselined."""
+    assert core.load_baseline() == []
+
+
+# -- rule units on synthetic files -------------------------------------------
+
+def _lint_snippet(tmp_path, relpath, src, rule_codes=None):
+    """Run mxlint on one synthetic file planted at a scoped repo-relative
+    path under tmp_path."""
+    target = tmp_path / relpath
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(src))
+    prev = core.REPO_ROOT
+    core.REPO_ROOT = str(tmp_path)
+    try:
+        sel = None
+        if rule_codes:
+            sel = [r for r in rules.ALL_RULES if r.code in rule_codes]
+        return mxlint.run([str(target)], rules=sel, baseline=[])
+    finally:
+        core.REPO_ROOT = prev
+
+
+def test_mx001_flags_jnp_and_exempts_asarray(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/ndarray/contrib.py", """\
+        import jax.numpy as jnp
+
+        def f(x):
+            y = jnp.asarray(x)      # conversion: exempt
+            return jnp.tanh(y)      # compute: flagged
+        """, {"MX001"})
+    assert [f.code for f in findings] == ["MX001"]
+    assert "tanh" in findings[0].message
+
+
+def test_mx002_unguarded_vs_guarded(tmp_path):
+    findings, n_waived, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/io/thing.py", """\
+        from .. import profiler as _profiler
+
+        def bad():
+            _profiler.record_op("x", 1.0)
+
+        def good_inline():
+            if _profiler._ACTIVE:
+                _profiler.record_op("x", 1.0)
+
+        def good_derived(t0):
+            if t0 is not None:
+                _profiler.account("bytes", 4)
+        """, {"MX002"})
+    assert len(findings) == 1
+    assert findings[0].line == 4
+
+
+def test_mx003_mutation_lock_and_definition_waiver(tmp_path):
+    findings, n_waived, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/sub/mod.py", """\
+        import threading
+
+        _LOCK = threading.Lock()
+        _GUARDED = {}
+        _NAKED = {}
+        _DECLARED = {}  # mxlint: disable=MX003 (import-time only)
+        _TLS = threading.local()
+
+        def f(k, v):
+            with _LOCK:
+                _GUARDED[k] = v
+            _NAKED[k] = v
+            _DECLARED[k] = v
+        """, {"MX003"})
+    assert len(findings) == 1
+    assert "_NAKED" in findings[0].message
+    assert n_waived == 1  # _DECLARED via its definition-line waiver
+
+
+def test_mx004_buf_outside_ndarray(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/helper.py", """\
+        def peek(arr):
+            return arr._buf
+        """, {"MX004"})
+    assert [f.code for f in findings] == ["MX004"]
+
+
+def test_mx005_jit_call_and_decorator(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/newmod.py", """\
+        import jax
+
+        fast = jax.jit(lambda x: x)
+
+        @jax.jit
+        def g(x):
+            return x
+        """, {"MX005"})
+    assert [f.code for f in findings] == ["MX005", "MX005"]
+
+
+def test_mx005_call_form_decorator_reported_once(tmp_path):
+    """@jax.jit(...) is both a decorator and a Call node — one site,
+    one finding."""
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/decmod.py", """\
+        import jax
+
+        @jax.jit(static_argnums=(0,))
+        def g(n, x):
+            return x
+        """, {"MX005"})
+    assert len(findings) == 1
+
+
+def test_mx005_sanctioned_module_is_exempt(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/jit.py", """\
+        import jax
+        fast = jax.jit(lambda x: x)
+        """, {"MX005"})
+    assert findings == []
+
+
+def test_mx006_missing_and_present_macros(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "src/c_api_extra.cc", """\
+        int MXTGood(void** out) {
+          API_BEGIN()
+          *out = nullptr;
+          API_END()
+        }
+
+        int MXTBad(void** out) {
+          *out = nullptr;
+          return 0;
+        }
+        """, {"MX006"})
+    assert len(findings) == 1
+    assert "MXTBad" in findings[0].message
+
+
+def test_mx007_wall_clock(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/io/meter.py", """\
+        import time
+
+        def stamp():
+            return time.time()
+        """, {"MX007"})
+    assert [f.code for f in findings] == ["MX007"]
+
+
+def test_mx008_bare_except(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/engine.py", """\
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+        """, {"MX008"})
+    assert [f.code for f in findings] == ["MX008"]
+
+
+# -- waiver machinery --------------------------------------------------------
+
+def test_waiver_without_reason_is_flagged(tmp_path):
+    findings, _, _, bad = _lint_snippet(
+        tmp_path, "mxnet_tpu/w.py", """\
+        import jax
+        fast = jax.jit(lambda x: x)  # mxlint: disable=MX005
+        """, {"MX005"})
+    assert findings == []  # the waiver still suppresses
+    assert len(bad) == 1
+    assert bad[0].code == "MX000"
+
+
+def test_waiver_on_line_above(tmp_path):
+    findings, n_waived, _, bad = _lint_snippet(
+        tmp_path, "mxnet_tpu/w2.py", """\
+        import jax
+        # mxlint: disable=MX005 (bounded: single key)
+        fast = jax.jit(lambda x: x)
+        """, {"MX005"})
+    assert findings == [] and bad == [] and n_waived == 1
+
+
+def test_file_level_waiver(tmp_path):
+    findings, n_waived, _, bad = _lint_snippet(
+        tmp_path, "mxnet_tpu/ndarray/extra.py", """\
+        # mxlint: disable-file=MX001 (whole-file design exemption for test)
+        import jax.numpy as jnp
+
+        def a(x):
+            return jnp.tanh(x)
+
+        def b(x):
+            return jnp.exp(x)
+        """, {"MX001"})
+    assert findings == [] and bad == [] and n_waived == 2
+
+
+def test_baseline_suppresses_and_reports(tmp_path):
+    target = tmp_path / "mxnet_tpu" / "b.py"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text("import jax\nfast = jax.jit(lambda x: x)\n")
+    prev = core.REPO_ROOT
+    core.REPO_ROOT = str(tmp_path)
+    try:
+        sel = [r for r in rules.ALL_RULES if r.code == "MX005"]
+        baseline = [{"code": "MX005", "path": "mxnet_tpu/b.py",
+                     "line": 2}]
+        findings, _, n_baselined, _ = mxlint.run(
+            [str(target)], rules=sel, baseline=baseline)
+        assert findings == [] and n_baselined == 1
+    finally:
+        core.REPO_ROOT = prev

@@ -1,0 +1,334 @@
+"""Runtime lock-order / race detector (mxnet_tpu/_debug/locktrace.py,
+``MXNET_DEBUG_LOCKS=1``).
+
+Two halves:
+
+* unit coverage of the detector itself (inversion detection, boundary
+  violations, Condition support, disabled fast path), and
+* the acceptance gate: the concurrency-heavy subsystems — profiler
+  daemons (continuous dump + memory sampler), the imperative jit/bulk
+  fast path from multiple threads, io prefetch, and the async
+  parameter server — run UNDER the detector and must report zero
+  lock-order inversions, with the findings surfaced in
+  ``profiler.metrics()['locks']``.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, profiler
+from mxnet_tpu._debug import locktrace
+
+
+@pytest.fixture
+def tracing():
+    """Detector on + clean slate, restored afterwards."""
+    prev = locktrace.enable()
+    locktrace.reset()
+    yield
+    locktrace.reset()
+    if not prev:
+        locktrace.disable()
+
+
+# -- detector unit behavior --------------------------------------------------
+
+def test_inversion_detected(tracing):
+    a = locktrace.named_lock("t.a")
+    b = locktrace.named_lock("t.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    r = locktrace.report()
+    assert r["inversion_total"] == 1
+    assert sorted(r["inversions"][0]["pair"]) == ["t.a", "t.b"]
+    assert "t.a->t.b" in r["order_edges"]
+    assert "t.b->t.a" in r["order_edges"]
+
+
+def test_consistent_order_is_clean(tracing):
+    a = locktrace.named_lock("t.first")
+    b = locktrace.named_lock("t.second")
+    for _ in range(5):
+        with a:
+            with b:
+                pass
+    r = locktrace.report()
+    assert r["inversion_total"] == 0
+    assert r["order_edges"] == ["t.first->t.second"]
+
+
+def test_inversion_reported_once_not_per_repeat(tracing):
+    a = locktrace.named_lock("t.x")
+    b = locktrace.named_lock("t.y")
+    for _ in range(4):
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert locktrace.report()["inversion_total"] == 1
+
+
+def test_inversion_detected_through_outer_held_lock(tracing):
+    """The edge must come from EVERY held lock: A held (with B taken in
+    between) while acquiring C, vs C-then-A elsewhere, is a deadlock
+    cycle even though A and C are never adjacent."""
+    a = locktrace.named_lock("t.outer")
+    b = locktrace.named_lock("t.middle")
+    c = locktrace.named_lock("t.inner")
+    with a:
+        with b:
+            with c:
+                pass
+    with c:
+        with a:
+            pass
+    r = locktrace.report()
+    assert r["inversion_total"] == 1, r
+    assert sorted(r["inversions"][0]["pair"]) == ["t.inner", "t.outer"]
+
+
+def test_reentrant_named_lock_nests_on_same_thread(tracing):
+    """reentrant=True (lib_api.load's contract: a plugin loading a
+    dependency plugin) must not self-deadlock and must keep balanced
+    bookkeeping."""
+    lk = locktrace.named_lock("t.re", reentrant=True)
+    with lk:
+        with lk:  # would deadlock on a plain Lock
+            pass
+    assert locktrace.report()["inversion_total"] == 0
+    # held stack fully unwound: a later boundary sees nothing held
+    engine.wait_for_all()
+    assert locktrace.report()["boundary_violation_total"] == 0
+
+
+def test_condition_wait_after_runtime_enable():
+    """A lock acquired BEFORE enable() has no bookkeeping record;
+    Condition.wait on it must still work (acquire-probe fallback), not
+    raise 'cannot wait on un-acquired lock'."""
+    locktrace.disable()
+    locktrace.reset()
+    cv = locktrace.named_condition("t.late")
+    try:
+        with cv:
+            locktrace.enable()  # detector turned on mid-critical-section
+            assert cv.wait(timeout=0.05) is False  # times out, no raise
+    finally:
+        locktrace.disable()
+        locktrace.reset()
+
+
+def test_boundary_violation_lock_held_across_sync(tracing):
+    lk = locktrace.named_lock("t.held")
+    with lk:
+        engine.wait_for_all()
+    r = locktrace.report()
+    assert r["boundary_violation_total"] == 1
+    v = r["boundary_violations"][0]
+    assert v["boundary"] == "engine.wait_for_all"
+    assert v["held"] == ["t.held"]
+
+
+def test_boundary_clean_when_nothing_held(tracing):
+    engine.wait_for_all()
+    x = mx.nd.array([1.0])
+    engine.wait_for_var(x)
+    assert locktrace.report()["boundary_violation_total"] == 0
+
+
+def test_named_condition_wait_notify(tracing):
+    cv = locktrace.named_condition("t.cv")
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5)
+    assert hits == [1]
+    assert locktrace.report()["inversion_total"] == 0
+
+
+def test_disabled_is_plain_lock():
+    prev = locktrace.ENABLED
+    locktrace.disable()
+    try:
+        locktrace.reset()
+        lk = locktrace.named_lock("t.off")
+        with lk:
+            assert lk.locked()
+        assert not lk.locked()
+        assert locktrace.report()["acquisitions"] == 0
+    finally:
+        if prev:
+            locktrace.enable()
+
+
+def test_metrics_has_no_locks_section_when_disabled():
+    prev = locktrace.ENABLED
+    locktrace.disable()
+    try:
+        assert "locks" not in profiler.metrics()
+    finally:
+        if prev:
+            locktrace.enable()
+
+
+# -- acceptance: concurrency-heavy subsystems under the detector -------------
+
+def _assert_clean(context):
+    r = locktrace.report()
+    assert r["inversions"] == [], (context, r["inversions"])
+    assert r["boundary_violations"] == [], (context,
+                                            r["boundary_violations"])
+
+
+def test_profiler_daemons_under_detector(tracing, tmp_path):
+    """Continuous-dump daemon + memory sampler + concurrent emitters +
+    pause/resume + explicit dump: the profiler's two locks must keep a
+    consistent order everywhere."""
+    profiler._reset()
+    profiler.set_config(filename=str(tmp_path / "t.json"),
+                        aggregate_stats=True, profile_memory=True,
+                        continuous_dump=True, dump_period=0.05,
+                        xprof=False)
+    try:
+        _drive_profiler_daemons(tmp_path)
+    finally:
+        # set_config state is process-global: put the defaults back so
+        # later suites see a pristine profiler
+        profiler.set_config(filename="profile.json",
+                            aggregate_stats=False, profile_memory=False,
+                            continuous_dump=False, dump_period=1.0,
+                            xprof=True)
+
+
+def _drive_profiler_daemons(tmp_path):
+    profiler.set_state("run")
+    stop = threading.Event()
+
+    def emitter(i):
+        while not stop.is_set():
+            profiler.record_op("op%d" % i, 1.0)
+            profiler.account("c%d" % i, 1, emit=False)
+
+    threads = [threading.Thread(target=emitter, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.3)
+    profiler.pause()
+    profiler.resume()
+    profiler.dump()
+    m = profiler.metrics()
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    profiler.set_state("stop")
+    assert "locks" in m
+    assert m["locks"]["enabled"]
+    assert "profiler.events" in m["locks"]["locks"]
+    _assert_clean("profiler daemons")
+    profiler._reset()
+
+
+def test_imperative_jit_and_bulk_under_detector(tracing):
+    """Multi-threaded eager dispatch through the jit cache plus bulk
+    segments: compile boundaries must never see a held framework
+    lock."""
+    def worker(seed):
+        x = mx.nd.array(np.random.RandomState(seed).rand(4, 4)
+                        .astype("float32"))
+        for _ in range(6):
+            y = mx.nd.relu(x + x) * 2
+        with engine.bulk(8):
+            z = x + x
+            z = z * z
+            z = mx.nd.relu(z)
+        engine.wait_for_var(z)
+        return y
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    engine.wait_for_all()
+    _assert_clean("imperative jit/bulk")
+
+
+def test_prefetch_under_detector(tracing):
+    from mxnet_tpu.io.prefetch import DevicePrefetchIter
+
+    class Source:
+        def __init__(self):
+            self.n = 0
+
+        def reset(self):
+            self.n = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self.n >= 8:
+                raise StopIteration
+            self.n += 1
+            return np.full((2, 2), self.n, "float32")
+
+    it = DevicePrefetchIter(Source(), depth=2)
+    got = [b for b in it]
+    assert len(got) == 8
+    it.reset()
+    assert len(list(it)) == 8
+    _assert_clean("device prefetch")
+
+
+def test_kvstore_async_under_detector(tracing):
+    """Server accept/serve threads + concurrent worker pushes + the
+    barrier condition variable, all on traced locks."""
+    from mxnet_tpu.kvstore_async import AsyncPSServer, AsyncPSClient
+
+    srv = AsyncPSServer()
+    try:
+        c0 = AsyncPSClient("127.0.0.1", srv.port)
+        c0.init("w", np.zeros((4,), np.float32))
+
+        def worker(rank):
+            c = AsyncPSClient("127.0.0.1", srv.port)
+            for _ in range(5):
+                c.push("w", np.ones((4,), np.float32))
+                c.pull("w")
+            c.barrier(3)
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+        out = c0.pull("w")
+        # default apply (no optimizer) overwrites: last push wins
+        np.testing.assert_allclose(np.asarray(out), np.ones((4,)))
+    finally:
+        srv.stop()
+    _assert_clean("kvstore_async")
+    r = locktrace.report()
+    assert "kvstore_async.server" in r["locks"]

@@ -30,6 +30,22 @@ def test_top_k_accuracy():
     assert m.get()[1] == pytest.approx(0.5)
 
 
+def test_top_k_accuracy_column_labels():
+    """Regression: (N, 1) labels must not broadcast to (N, N, k) —
+    the mis-broadcast counted cross-row matches and pushed the metric
+    past 1.0."""
+    m = metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1], [0.2, 0.2, 0.6]])
+    label = mx.nd.array([[2], [2], [2]])  # column vector, not flat
+    m.update([label], [pred])
+    acc = m.get()[1]
+    assert acc <= 1.0
+    # same data flat: identical answer
+    m2 = metric.TopKAccuracy(top_k=2)
+    m2.update([mx.nd.array([2, 2, 2])], [pred])
+    assert acc == pytest.approx(m2.get()[1])
+
+
 def test_f1():
     m = metric.F1()
     pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7], [0.6, 0.4]])

@@ -126,6 +126,18 @@ def test_lr_scheduler_multifactor():
     assert abs(s(11) - 0.01) < 1e-12
 
 
+def test_lr_scheduler_multifactor_rejects_scalar_step():
+    """Regression: a scalar step used to die with a TypeError deep in
+    the milestone iteration; it must raise a clear ValueError at
+    construction instead."""
+    from mxnet_tpu.lr_scheduler import MultiFactorScheduler
+    with pytest.raises(ValueError, match="list or tuple"):
+        MultiFactorScheduler(step=5, factor=0.1)
+    # tuples are as good as lists
+    s = MultiFactorScheduler(step=(5, 10), factor=0.1, base_lr=1.0)
+    assert s(1) == 1.0
+
+
 def test_lr_scheduler_poly_cosine_warmup():
     from mxnet_tpu.lr_scheduler import PolyScheduler, CosineScheduler
     p = PolyScheduler(max_update=100, base_lr=1.0, pwr=2,

@@ -110,7 +110,7 @@ def test_pipeline_matches_gspmd():
     params2 = T.init_params(key, cfg2)  # same weights, stacked [pp, L/pp]
     init_fn, step_fn = T.make_train_step(cfg2, mesh)
     with mesh.mesh:
-        from jax import shard_map
+        from mxnet_tpu.parallel import shard_map
         from jax.sharding import PartitionSpec as P
         specs = T.param_specs(cfg2)
         loss = shard_map(
@@ -204,7 +204,7 @@ def test_functional_call_matches_eager():
 
 
 def test_collectives_shard_map():
-    from jax import shard_map
+    from mxnet_tpu.parallel import shard_map
     from jax.sharding import PartitionSpec as P
     from mxnet_tpu.parallel import all_reduce, reduce_scatter, ring_exchange
     mesh = create_mesh(dp=8)

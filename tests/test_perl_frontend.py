@@ -42,18 +42,24 @@ def built_pkg():
     return PKG
 
 
-def test_ops_pm_is_current(built_pkg):
+def test_ops_pm_is_current(built_pkg, tmp_path):
     """The checked-in generated Ops.pm must match the live registry
-    (same regeneration contract as cpp-package op.h)."""
+    (same regeneration contract as cpp-package op.h). Generates to a
+    temp path and compares contents — the working tree is never
+    mutated, and the check doesn't depend on `git diff` (which would
+    pass vacuously on a dirty or non-git checkout)."""
+    fresh = tmp_path / "Ops.pm"
     gen = subprocess.run(
-        ["python", os.path.join(PKG, "scripts", "gen_op_pm.py")],
+        ["python", os.path.join(PKG, "scripts", "gen_op_pm.py"),
+         str(fresh)],
         env=_env(), capture_output=True, text=True, timeout=300)
     assert gen.returncode == 0, gen.stderr
-    out = subprocess.run(["git", "diff", "--stat", "--",
-                          "perl-package/lib/AI/MXTpu/Ops.pm"],
-                         cwd=REPO, capture_output=True, text=True)
-    assert out.stdout.strip() == "", \
-        "generated Ops.pm is stale — rerun gen_op_pm.py:\n" + out.stdout
+    checked_in = os.path.join(PKG, "lib", "AI", "MXTpu", "Ops.pm")
+    with open(checked_in) as f:
+        want = f.read()
+    got = fresh.read_text()
+    assert got == want, \
+        "generated Ops.pm is stale — rerun gen_op_pm.py"
 
 
 def test_ndarray_roundtrip_and_ops(built_pkg):

@@ -1,0 +1,21 @@
+"""mxlint: framework-invariant static analysis for mxnet_tpu.
+
+The AST/text half of the enforcement pair (the runtime half is
+``mxnet_tpu/_debug/locktrace.py``): ~8 framework-specific rules that
+keep the PR 1-2 invariants — single dispatch choke point, guarded
+telemetry, locked shared state, API_BEGIN/API_END on the C ABI — true
+across future PRs the way the reference wires cpplint/pylint into ci/.
+
+    python -m tools.mxlint                 # lint mxnet_tpu src tests
+    python -m tools.mxlint mxnet_tpu/io    # lint a subtree
+    python -m tools.mxlint --rule MX003 .  # one rule
+
+See docs/LINTING.md for the rule catalog, the waiver idiom, and the
+baseline workflow. tests/test_lint.py runs this over the tree in
+tier-1 and fails on any unwaived finding.
+"""
+from .core import Finding, load_baseline, main, parse_waivers, run
+from .rules import ALL_RULES
+
+__all__ = ["Finding", "ALL_RULES", "run", "main", "parse_waivers",
+           "load_baseline"]

@@ -1,0 +1,228 @@
+"""mxlint driver: file walking, waivers, baseline, CLI.
+
+Waiver idiom (Python and C++):
+
+    # mxlint: disable=MX003 (GIL-atomic counter bumps; lock would cost
+    #         more than the race on the dispatch hot path)
+    // mxlint: disable=MX006 (no-throw body: plain pointer bookkeeping)
+
+A waiver suppresses the listed codes on its own line and the line
+directly below it (so it can sit above the offending statement). MX003
+additionally honors a waiver on the flagged container's *definition*
+line — declare once at the definition that unlocked mutation is
+intentional instead of waiving every mutation site. A waiver without a
+parenthesized justification is itself reported (MX000): the point is a
+reviewed reason next to every exemption.
+
+Baseline: ``tools/mxlint/baseline.json`` records known findings as
+``{code, path, line}`` triples that don't fail the run (the cpplint
+NOLINT-file escape hatch for bulk-adopting a rule). The checked-in
+baseline is empty — every pre-existing violation was fixed or waived —
+and should stay that way; regenerate with ``--write-baseline`` only
+when bulk-introducing a new rule.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+_WAIVER_RE = re.compile(
+    r"(?:#|//)\s*mxlint:\s*disable=((?:MX\d{3})(?:\s*,\s*MX\d{3})*)"
+    r"\s*(\(.+)?")
+_FILE_WAIVER_RE = re.compile(
+    r"(?:#|//)\s*mxlint:\s*disable-file=((?:MX\d{3})(?:\s*,\s*MX\d{3})*)"
+    r"\s*(\(.+)?")
+
+# directories never worth walking
+_SKIP_DIRS = {".git", "__pycache__", "build", "blib", ".pytest_cache",
+              "node_modules"}
+
+
+class Finding:
+    __slots__ = ("code", "path", "line", "message", "extra_waiver_lines")
+
+    def __init__(self, code, path, line, message,
+                 extra_waiver_lines=()):
+        self.code = code
+        self.path = path
+        self.line = line
+        self.message = message
+        # additional lines whose waivers also suppress this finding
+        # (MX003: the container's definition line)
+        self.extra_waiver_lines = tuple(extra_waiver_lines)
+
+    def __repr__(self):
+        return "%s:%d: %s %s" % (self.path, self.line, self.code,
+                                 self.message)
+
+
+def parse_waivers(src):
+    """(line waivers, file waivers, bad waivers). Line waivers are
+    {line -> set(codes)}; a waiver covers its own line and the next
+    one. ``disable-file=`` waives a code for the whole file — for
+    files whose entire design is the exemption (document the design in
+    the justification). Waivers lacking a justification are returned
+    as bad."""
+    waivers = {}
+    file_waivers = set()
+    bad = []
+    for i, line in enumerate(src.splitlines(), start=1):
+        fm = _FILE_WAIVER_RE.search(line)
+        m = _WAIVER_RE.search(line) if fm is None else None
+        if fm is not None:
+            codes = {c.strip() for c in fm.group(1).split(",")}
+            file_waivers.update(codes)
+            reason = (fm.group(2) or "").strip("() \t")
+        elif m is not None:
+            codes = {c.strip() for c in m.group(1).split(",")}
+            reason = (m.group(2) or "").strip("() \t")
+            waivers.setdefault(i, set()).update(codes)
+            waivers.setdefault(i + 1, set()).update(codes)
+        else:
+            continue
+        if not reason:
+            bad.append((i, sorted(codes)))
+    return waivers, file_waivers, bad
+
+
+def _iter_files(paths):
+    for top in paths:
+        ab = top if os.path.isabs(top) else os.path.join(REPO_ROOT, top)
+        if os.path.isfile(ab):
+            yield ab
+            continue
+        for root, dirs, files in os.walk(ab):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                if f.endswith((".py", ".cc", ".h")):
+                    yield os.path.join(root, f)
+
+
+def _rel(path):
+    return os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+
+
+def run(paths, rules=None, baseline=None):
+    """Lint ``paths`` (repo-relative or absolute files/dirs).
+
+    Returns (unwaived findings, waived count, baselined count,
+    bad-waiver findings)."""
+    from .rules import ALL_RULES
+    from .rules import _parents
+    rules = list(ALL_RULES if rules is None else rules)
+    if baseline is None:
+        baseline = load_baseline()
+    base_keys = {(b["code"], b["path"], b.get("line")) for b in baseline}
+
+    findings, bad_waivers = [], []
+    n_waived = n_baselined = 0
+    for abspath in _iter_files(paths):
+        rel = _rel(abspath)
+        active = [r for r in rules if r.scope(rel)]
+        if not active:
+            continue
+        try:
+            with open(abspath, encoding="utf-8", errors="replace") as f:
+                src = f.read()
+        except OSError:
+            continue
+        waivers, file_waivers, bad = parse_waivers(src)
+        for line, codes in bad:
+            bad_waivers.append(Finding(
+                "MX000", rel, line,
+                "waiver for %s has no justification — write "
+                "`# mxlint: disable=CODE (reason)`" % ",".join(codes)))
+        tree = parents = None
+        if rel.endswith(".py"):
+            try:
+                tree = ast.parse(src)
+            except SyntaxError as e:
+                bad_waivers.append(Finding(
+                    "MX000", rel, e.lineno or 0,
+                    "file does not parse: %s" % e.msg))
+                continue
+            parents = _parents(tree)
+        for rule in active:
+            if rule.kind == "python" and tree is None:
+                continue
+            if rule.kind == "cc" and rel.endswith(".py"):
+                continue
+            for fi in rule.check(rel, src, tree, parents):
+                lines = (fi.line,) + fi.extra_waiver_lines
+                if fi.code in file_waivers or \
+                        any(fi.code in waivers.get(l, ()) for l in lines):
+                    n_waived += 1
+                elif (fi.code, fi.path, fi.line) in base_keys or \
+                        (fi.code, fi.path, None) in base_keys:
+                    n_baselined += 1
+                else:
+                    findings.append(fi)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings, n_waived, n_baselined, bad_waivers
+
+
+def load_baseline(path=BASELINE_PATH):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f).get("findings", [])
+    except (OSError, ValueError):
+        return []
+
+
+def write_baseline(findings, path=BASELINE_PATH):
+    data = {
+        "comment": "Known findings exempt from failing mxlint. Keep "
+                   "empty; see docs/LINTING.md.",
+        "findings": [{"code": f.code, "path": f.path, "line": f.line}
+                     for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def main(argv=None):
+    import argparse
+    from .rules import ALL_RULES
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="Framework-invariant static analysis "
+                    "(docs/LINTING.md has the rule catalog).")
+    ap.add_argument("paths", nargs="*",
+                    default=["mxnet_tpu", "src", "tests"],
+                    help="files/dirs to lint (default: mxnet_tpu src "
+                         "tests)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict to specific rule codes (repeatable)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current findings as the new baseline")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rule:
+        rules = [r for r in ALL_RULES if r.code in set(args.rule)]
+    findings, n_waived, n_baselined, bad = run(args.paths, rules=rules)
+
+    if args.write_baseline:
+        write_baseline(findings)
+        print("baseline: recorded %d findings" % len(findings))
+        return 0
+
+    for f in findings + bad:
+        print("%s:%d: %s %s" % (f.path, f.line, f.code, f.message))
+    summary = "mxlint: %d finding%s (%d waived, %d baselined)" % (
+        len(findings), "" if len(findings) == 1 else "s", n_waived,
+        n_baselined)
+    if bad:
+        summary += ", %d bad waiver%s" % (len(bad),
+                                          "" if len(bad) == 1 else "s")
+    print(summary, file=sys.stderr)
+    return 1 if findings or bad else 0

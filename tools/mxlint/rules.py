@@ -1,0 +1,545 @@
+"""mxlint rule set: framework-specific invariants as checked analyses.
+
+Each rule is a class with a ``code``, a one-line ``summary``, a path
+``scope`` (repo-relative, forward slashes), and a ``check`` returning
+findings. Python rules get the parsed AST plus a parent map; the C++
+rule (MX006) is a text pass. The invariants come from PRs 1-2 (the
+imperative fast path and the telemetry layer) — see docs/LINTING.md
+for the catalog with rationale and example waivers.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def _parents(tree):
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _ancestors(node, parents):
+    n = parents.get(node)
+    while n is not None:
+        yield n
+        n = parents.get(n)
+
+
+def _import_aliases(tree, module):
+    """Local names bound to ``module`` (e.g. 'jnp' for jax.numpy)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == module:
+                    names.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            parent, _, leaf = module.rpartition(".")
+            if node.module == parent or (
+                    node.module or "").endswith(parent.lstrip(".")):
+                for a in node.names:
+                    if a.name == leaf:
+                        names.add(a.asname or a.name)
+    return names
+
+
+def _profiler_aliases(tree):
+    """Names the file binds to the profiler module (``from .. import
+    profiler as _profiler`` and friends)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "profiler":
+                    names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("profiler"):
+                    names.add(a.asname or a.name.split(".")[0])
+    return names
+
+
+def _in_function(node, parents):
+    return any(isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+               for a in _ancestors(node, parents))
+
+
+_HOT_MODULES = (
+    "mxnet_tpu/ndarray/",
+    "mxnet_tpu/engine.py",
+    "mxnet_tpu/kvstore.py",
+    "mxnet_tpu/kvstore_async.py",
+    "mxnet_tpu/kvstore_server.py",
+    "mxnet_tpu/io/",
+)
+
+
+def _is_hot(path):
+    return any(path.startswith(p) for p in _HOT_MODULES)
+
+
+# -- MX001 -------------------------------------------------------------------
+
+class MX001JnpBypassesInvoke:
+    """Direct jnp compute in ndarray/ op paths bypasses the
+    ``register.invoke`` choke point — such ops are invisible to the jit
+    dispatch cache, bulk segments, and the per-op profiler lane.
+    Host<->device conversion (``asarray``/``array``) is exempt: it
+    moves bytes, it doesn't dispatch an op."""
+
+    code = "MX001"
+    summary = "direct jnp call in ndarray/ bypasses register.invoke"
+    kind = "python"
+    _CONVERSIONS = frozenset(("asarray", "array"))
+
+    def scope(self, path):
+        return (path.startswith("mxnet_tpu/ndarray/")
+                and not path.endswith("/register.py"))
+
+    def check(self, path, src, tree, parents):
+        aliases = _import_aliases(tree, "jax.numpy")
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # jnp.take(...) / alias.X(...); also jnp.x.y(...) chains
+            base = func
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in aliases \
+                    and isinstance(func, ast.Attribute) \
+                    and func.attr not in self._CONVERSIONS:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "jnp.%s() dispatches outside register.invoke — "
+                    "route through the op registry or waive with the "
+                    "reason it cannot be an op" % func.attr))
+        return out
+
+
+# -- MX002 -------------------------------------------------------------------
+
+_GUARD_TOKENS = ("_ACTIVE", "_HOOKS", "is_running")
+_HOOK_FNS = ("record_op", "record_counter", "account", "sample_memory")
+
+
+def _test_is_guard(test):
+    """Does a conditional's test gate on the profiler being active?
+    Accepts the inlined guard (``_HOOKS and _profiler._ACTIVE``), the
+    derived form (``t0 is not None`` where t0 was set under the
+    guard), and ``is_running()``."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr in _GUARD_TOKENS:
+            return True
+        if isinstance(n, ast.Name) and (
+                n.id in _GUARD_TOKENS or n.id.endswith("t0")):
+            return True
+        if isinstance(n, ast.Call):
+            f = n.func
+            if (isinstance(f, ast.Attribute) and f.attr == "is_running") \
+                    or (isinstance(f, ast.Name)
+                        and f.id == "is_running"):
+                return True
+    return False
+
+
+class MX002UnguardedProfilerHook:
+    """Profiler hook calls in hot modules must sit behind the inlined
+    active-guard — otherwise the '<2% overhead when profiling is off'
+    gate (BENCH_MODEL=profiler_overhead) is a lie."""
+
+    code = "MX002"
+    summary = "profiler hook in hot module not behind the active guard"
+    kind = "python"
+
+    def scope(self, path):
+        return _is_hot(path)
+
+    def check(self, path, src, tree, parents):
+        aliases = _profiler_aliases(tree)
+        if not aliases:
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _HOOK_FNS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in aliases):
+                continue
+            guarded = False
+            for anc in _ancestors(node, parents):
+                if isinstance(anc, (ast.If, ast.IfExp)) \
+                        and _test_is_guard(anc.test):
+                    guarded = True
+                    break
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    break
+            if not guarded:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "%s.%s() in a hot module must be inside an "
+                    "`if _HOOKS and _profiler._ACTIVE` (or derived "
+                    "`t0 is not None`) guard" % (f.value.id, f.attr)))
+        return out
+
+
+# -- MX003 -------------------------------------------------------------------
+
+_MUTATORS = frozenset((
+    "append", "add", "update", "pop", "clear", "extend", "insert",
+    "remove", "setdefault", "popitem", "discard",
+))
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "named_lock",
+                   "named_condition")
+
+
+class MX003UnlockedModuleState:
+    """Module-level mutable containers mutated from function bodies
+    need a named lock (``with <lock>:`` around the mutation), a
+    ``threading.local`` home, or a waiver on the container's
+    definition line stating why unlocked access is sound (e.g.
+    GIL-atomic counter bumps on the dispatch hot path)."""
+
+    code = "MX003"
+    summary = "module-level mutable state mutated without a lock"
+    kind = "python"
+
+    def scope(self, path):
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    def _module_containers(self, tree):
+        """name -> def lineno for module-level dict/list/set bindings."""
+        out = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name, v = node.targets[0].id, node.value
+                if isinstance(v, (ast.Dict, ast.List, ast.Set)):
+                    out[name] = node.lineno
+                elif isinstance(v, ast.Call):
+                    f = v.func
+                    callee = f.id if isinstance(f, ast.Name) else (
+                        f.attr if isinstance(f, ast.Attribute) else "")
+                    if callee in ("dict", "list", "set", "defaultdict",
+                                  "OrderedDict", "deque"):
+                        out[name] = node.lineno
+        return out
+
+    def _module_locks(self, tree):
+        locks = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                f = node.value.func
+                callee = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else "")
+                if callee in _LOCK_FACTORIES:
+                    locks.add(node.targets[0].id)
+        return locks
+
+    def _locals_names(self, tree):
+        """Module-level names bound to threading.local()."""
+        out = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                f = node.value.func
+                if isinstance(f, ast.Attribute) and f.attr == "local":
+                    out.add(node.targets[0].id)
+        return out
+
+    def _under_lock(self, node, parents, locks):
+        for anc in _ancestors(node, parents):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Name) and (
+                            e.id in locks
+                            or e.id.lower().endswith("lock")):
+                        return True
+                    if isinstance(e, ast.Attribute) and \
+                            e.attr.lower().endswith(("lock", "cv")):
+                        return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # keep climbing: a nested helper may still be inside
+                # an outer function's with-lock block
+                continue
+        return False
+
+    def check(self, path, src, tree, parents):
+        containers = self._module_containers(tree)
+        if not containers:
+            return []
+        locks = self._module_locks(tree)
+        local_names = self._locals_names(tree)
+        out = []
+
+        def flag(node, name, how):
+            out.append(Finding(
+                self.code, path, node.lineno,
+                "module-level %r mutated (%s) outside any lock — hold "
+                "a named lock, make it threading.local, or waive at "
+                "the definition (line %d) with why unlocked access is "
+                "sound" % (name, how, containers[name]),
+                extra_waiver_lines=(containers[name],)))
+
+        for node in ast.walk(tree):
+            if not _in_function(node, parents):
+                continue
+            name = None
+            how = ""
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in containers:
+                        name, how = t.value.id, "item assignment"
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in containers:
+                        name, how = t.value.id, "del"
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _MUTATORS and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in containers:
+                    name, how = f.value.id, ".%s()" % f.attr
+            if name is None or name in local_names or name == "__all__":
+                # __all__ population (populate()-style op injection) is
+                # import-time namespace bookkeeping, not shared state
+                continue
+            if not self._under_lock(node, parents, locks):
+                flag(node, name, how)
+        return out
+
+
+# -- MX004 -------------------------------------------------------------------
+
+class MX004RawBufOutsideNdarray:
+    """``._buf`` may hold a _PendingSlot (a queued-but-unflushed bulk
+    op). Only ndarray/ internals may touch it; everything else must
+    read ``._data``, which drains the owning segment first."""
+
+    code = "MX004"
+    summary = "._buf read outside ndarray/ internals (use ._data)"
+    kind = "python"
+
+    def scope(self, path):
+        return (path.startswith("mxnet_tpu/")
+                and not path.startswith("mxnet_tpu/ndarray/")
+                and path.endswith(".py"))
+
+    def check(self, path, src, tree, parents):
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr == "_buf":
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "._buf access outside ndarray/ — a pending bulk "
+                    "segment would resolve stale data; use ._data"))
+        return out
+
+
+# -- MX005 -------------------------------------------------------------------
+
+_SANCTIONED_JIT = (
+    "mxnet_tpu/ndarray/register.py",   # imperative dispatch + bulk caches
+    "mxnet_tpu/jit.py",                # the explicit user-facing jit cache
+    "mxnet_tpu/gluon/block.py",        # HybridBlock compile cache
+)
+
+
+class MX005UnsanctionedJaxJit:
+    """Every ``jax.jit`` call site is a retrace-storm risk unless its
+    key management lives in a sanctioned cache module. New sites must
+    either move behind those caches or waive with the reason the local
+    cache is bounded."""
+
+    code = "MX005"
+    summary = "bare jax.jit outside the sanctioned cache modules"
+    kind = "python"
+
+    def scope(self, path):
+        return (path.startswith("mxnet_tpu/") and path.endswith(".py")
+                and path not in _SANCTIONED_JIT)
+
+    def check(self, path, src, tree, parents):
+        jax_names = _import_aliases(tree, "jax")
+        jit_names = _import_aliases(tree, "jax.jit")
+        out = []
+        # call-form decorators (@jax.jit(static_argnums=...)) are Call
+        # nodes too — record them so the Call branch below doesn't
+        # report the same site twice
+        dec_calls = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    if self._is_jit(d, jax_names, jit_names):
+                        out.append(self._finding(path, dec.lineno))
+                        if isinstance(dec, ast.Call):
+                            dec_calls.add(id(dec))
+            elif isinstance(node, ast.Call) and id(node) not in dec_calls \
+                    and self._is_jit(node.func, jax_names, jit_names):
+                out.append(self._finding(path, node.lineno))
+        return out
+
+    @staticmethod
+    def _is_jit(f, jax_names, jit_names):
+        if isinstance(f, ast.Attribute) and f.attr == "jit" and \
+                isinstance(f.value, ast.Name) and f.value.id in jax_names:
+            return True
+        return isinstance(f, ast.Name) and f.id in jit_names
+
+    def _finding(self, path, lineno):
+        return Finding(
+            self.code, path, lineno,
+            "bare jax.jit outside the sanctioned cache modules "
+            "(%s) — retrace-storm risk; cache through them or waive "
+            "with how this site bounds its keys"
+            % ", ".join(_SANCTIONED_JIT))
+
+
+# -- MX006 (C++ text pass) ---------------------------------------------------
+
+_CC_FN_RE = re.compile(r"^int (MXT\w+)\s*\(")
+
+
+class MX006CApiErrorMacros:
+    """Every int-returning MXT* entry point must wrap its body in
+    API_BEGIN/API_END (or the MXT_ spellings): a C++ exception crossing
+    the C ABI is undefined behavior, and the macros are what turn it
+    into the -1/MXTGetLastError() contract."""
+
+    code = "MX006"
+    summary = "MXT* entry point without API_BEGIN/API_END"
+    kind = "cc"
+
+    def scope(self, path):
+        return path.startswith("src/c_") and path.endswith(".cc")
+
+    def check(self, path, src, tree=None, parents=None):
+        lines = src.splitlines()
+        out = []
+        i = 0
+        while i < len(lines):
+            m = _CC_FN_RE.match(lines[i])
+            if not m:
+                i += 1
+                continue
+            fn_name, fn_line = m.group(1), i + 1
+            # swallow the (possibly multi-line) signature up to '{'
+            j = i
+            while j < len(lines) and "{" not in lines[j]:
+                j += 1
+            # body runs to the first line that CLOSES the depth
+            depth = 0
+            body = []
+            k = j
+            while k < len(lines):
+                depth += lines[k].count("{") - lines[k].count("}")
+                body.append(lines[k])
+                if depth <= 0 and k > j or (depth == 0 and "{" in
+                                            lines[k] and "}" in lines[k]):
+                    break
+                k += 1
+            text = "\n".join(body)
+            if not ("API_BEGIN" in text and "API_END" in text):
+                out.append(Finding(
+                    self.code, path, fn_line,
+                    "%s() is not wrapped in API_BEGIN()/API_END() — a "
+                    "C++ exception here crosses the C ABI" % fn_name))
+            i = k + 1
+        return out
+
+
+# -- MX007 -------------------------------------------------------------------
+
+class MX007WallClockInTrace:
+    """Trace-event timestamps must be monotonic: ``time.time()`` goes
+    backwards under NTP steps and breaks span math. Use
+    ``time.perf_counter()`` / ``time.monotonic()``."""
+
+    code = "MX007"
+    summary = "time.time() in trace-emission / hot modules"
+    kind = "python"
+
+    def scope(self, path):
+        return (path == "mxnet_tpu/profiler.py"
+                or path.startswith("mxnet_tpu/_debug/")
+                or _is_hot(path))
+
+    def check(self, path, src, tree, parents):
+        time_names = _import_aliases(tree, "time")
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "time" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in time_names:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "time.time() in a trace-emitting module — wall "
+                    "clock steps under NTP; use perf_counter()/"
+                    "monotonic()"))
+        return out
+
+
+# -- MX008 -------------------------------------------------------------------
+
+class MX008BareExcept:
+    """A bare ``except:`` in engine/dispatch paths swallows
+    KeyboardInterrupt and SystemExit mid-dispatch, wedging sync points.
+    Catch ``Exception`` (or narrower) instead."""
+
+    code = "MX008"
+    summary = "bare except: in engine/dispatch paths"
+    kind = "python"
+
+    def scope(self, path):
+        return path in ("mxnet_tpu/engine.py", "mxnet_tpu/autograd.py",
+                        "mxnet_tpu/executor.py") \
+            or path.startswith("mxnet_tpu/ndarray/")
+
+    def check(self, path, src, tree, parents):
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "bare `except:` catches KeyboardInterrupt/"
+                    "SystemExit mid-dispatch — catch Exception or "
+                    "narrower"))
+        return out
+
+
+ALL_RULES = (
+    MX001JnpBypassesInvoke(),
+    MX002UnguardedProfilerHook(),
+    MX003UnlockedModuleState(),
+    MX004RawBufOutsideNdarray(),
+    MX005UnsanctionedJaxJit(),
+    MX006CApiErrorMacros(),
+    MX007WallClockInTrace(),
+    MX008BareExcept(),
+)

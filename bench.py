@@ -628,6 +628,136 @@ def bench_eager_ops():
     }
 
 
+def bench_train_step():
+    """BENCH_MODEL=train_step: full Gluon training-step throughput — the
+    fused donated program (gluon.train_step: forward + backward +
+    optimizer for all params as ONE jitted call, ISSUE 4) vs the eager
+    record/backward/Trainer.step loop on the same hybridized MLP.
+
+    Median-of-3 ALTERNATING rounds of steps/sec per mode (both modes see
+    the same machine-load drift), parity-checked bitwise after 3 steps,
+    and replay-checked: after compiling once, an lr change and a new
+    batch_size divisor must replay the same executable
+    (fused_step.retraces == 0 — lr/wd/rescale are operands, not baked
+    constants). Gate: fused >= 1.5x eager steps/sec, like the
+    profiler_overhead gate this exits non-zero on breach."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import fused_step as FS
+
+    hidden = int(os.environ.get("BENCH_STEP_HIDDEN", 64))
+    batch = int(os.environ.get("BENCH_STEP_BATCH", 32))
+    iters = int(os.environ.get("BENCH_STEP_ITERS", 60))
+    loss_fn = gluon.loss.L2Loss()
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(batch, hidden).astype("float32"))
+    y = mx.nd.array(rs.rand(batch, 1).astype("float32"))
+
+    def make_net(seed_from=None):
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(hidden, in_units=hidden,
+                                   activation="relu"))
+            net.add(gluon.nn.Dense(hidden, in_units=hidden,
+                                   activation="relu"))
+            net.add(gluon.nn.Dense(1, in_units=hidden))
+        net.initialize(mx.init.Uniform(0.1))
+        net.hybridize()
+        if seed_from is not None:
+            for (_, p1), (_, p2) in zip(
+                    sorted(seed_from.collect_params().items()),
+                    sorted(net.collect_params().items())):
+                p2.set_data(p1.data())
+        return net
+
+    def make_trainer(net):
+        return gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 0.05, "momentum": 0.9})
+
+    def eager_step(net, trainer, bs=batch):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(bs)
+        return loss
+
+    # -- parity: 3 steps on identical nets, bitwise ----------------------
+    net_a = make_net()
+    net_b = make_net(net_a)
+    tr_a, tr_b = make_trainer(net_a), make_trainer(net_b)
+    step_b = gluon.train_step(net_b, loss_fn, tr_b)
+    for _ in range(3):
+        eager_step(net_a, tr_a)
+        step_b(x, y, batch_size=batch)
+    parity = all(
+        np.array_equal(pa.data().asnumpy(), pb.data().asnumpy())
+        for (_, pa), (_, pb) in zip(
+            sorted(net_a.collect_params().items()),
+            sorted(net_b.collect_params().items())))
+
+    # -- replay: lr + batch_size changes must not retrace ----------------
+    FS.reset_stats()
+    tr_b.set_learning_rate(0.01)
+    step_b(x, y, batch_size=batch)
+    step_b(x, y, batch_size=2 * batch)
+    replay_stats = FS.stats()
+    replays_clean = replay_stats["retraces"] == 0 \
+        and replay_stats["hits"] == 2
+
+    # -- throughput: alternating rounds, median-of-3 per mode ------------
+    net_e = make_net(net_a)
+    net_f = make_net(net_a)
+    tr_e, tr_f = make_trainer(net_e), make_trainer(net_f)
+    step_f = gluon.train_step(net_f, loss_fn, tr_f)
+    for _ in range(3):  # warm both paths (fused compiles on repeat)
+        eager_step(net_e, tr_e)
+        step_f(x, y, batch_size=batch)
+
+    def eager_round(n):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            loss = eager_step(net_e, tr_e)
+        loss.wait_to_read()
+        return n / (time.perf_counter() - t0)
+
+    def fused_round(n):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            loss = step_f(x, y, batch_size=batch)
+        loss.wait_to_read()
+        return n / (time.perf_counter() - t0)
+
+    rates = {"eager": [], "fused": []}
+    n = max(1, iters // 3)
+    for _ in range(3):
+        rates["eager"].append(eager_round(n))
+        rates["fused"].append(fused_round(n))
+    med = {m: sorted(v)[len(v) // 2] for m, v in rates.items()}
+    speedup = med["fused"] / med["eager"]
+    assert step_f.last_mode == "fused", step_f.last_mode
+
+    return {
+        "metric": "train_step_steps_per_sec",
+        "value": round(med["fused"], 1),
+        "unit": "steps/sec",
+        "fused_steps_per_sec": round(med["fused"], 1),
+        "eager_steps_per_sec": round(med["eager"], 1),
+        "speedup": round(speedup, 2),
+        "bitwise_parity": bool(parity),
+        "replay": {"retraces": replay_stats["retraces"],
+                   "hits": replay_stats["hits"],
+                   "clean": bool(replays_clean)},
+        "hidden": hidden,
+        "batch": batch,
+        "params": len(tr_f._params),
+        "dispatch": FS.stats(),
+        "gate": {"ok": bool(speedup >= 1.5 and parity and replays_clean),
+                 "min_speedup": 1.5},
+    }
+
+
 def bench_profiler_overhead():
     """BENCH_MODEL=profiler_overhead: cost of the telemetry layer at the
     imperative dispatch choke point (ISSUE 2 hard constraint: zero-cost
@@ -789,6 +919,8 @@ if __name__ == "__main__":
         result = bench_resnet_inference()
     elif which == "eager_ops":
         result = bench_eager_ops()
+    elif which == "train_step":
+        result = bench_train_step()
     elif which == "profiler_overhead":
         result = bench_profiler_overhead()
     else:
@@ -836,6 +968,13 @@ if __name__ == "__main__":
         # dispatch guard blew its <2% budget — fail AFTER the JSON record
         sys.exit("profiler off-path overhead gate breached: %.3f%% >= "
                  "%.1f%%" % (result["value"], result["gate"]["budget_pct"]))
+    if result.get("metric") == "train_step_steps_per_sec" \
+            and not result["gate"]["ok"]:
+        # the fused step must actually pay for itself AND replay cleanly
+        sys.exit("train_step gate breached: speedup %.2fx (need >= %.1fx), "
+                 "parity=%s, replay=%s"
+                 % (result["speedup"], result["gate"]["min_speedup"],
+                    result["bitwise_parity"], result["replay"]))
     gate = result.get("numerics", {}).get("gate")
     if gate is not None and not gate["ok"]:
         # per-op ULP budget breached (benchmark/tpu_numerics.py

@@ -22,7 +22,7 @@ from . import profiler as _profiler
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "set_recording", "set_training", "mark_variables",
-           "backward", "grad", "get_symbol", "Function"]
+           "backward", "grad", "deliver_grad", "get_symbol", "Function"]
 
 
 class _State(threading.local):
@@ -205,16 +205,24 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
     # accumulate into .grad of marked leaves
     for var, g in grads.items():
-        if var._grad is None:
-            continue
-        if getattr(var, "_grad_req", "write") == "add":
-            var._grad._data = var._grad._data + g
-        else:
-            var._grad._data = g.astype(var._grad._data.dtype)
-        # stale-grad tracking: Trainer clears this after each update
-        # (ref: NDArray fresh_grad flag, src/ndarray/ndarray.cc)
-        var._fresh_grad = True
+        deliver_grad(var, g)
     return None
+
+
+def deliver_grad(var, g):
+    """Write one computed cotangent into ``var``'s grad buffer honoring
+    its grad_req (write/add) and mark the grad fresh — the accumulation
+    step of the tape sweep, shared with the gluon fused train step so
+    both paths materialize gradients identically (stale-grad tracking:
+    Trainer clears the flag after each update; ref: NDArray fresh_grad,
+    src/ndarray/ndarray.cc)."""
+    if var._grad is None:
+        return
+    if getattr(var, "_grad_req", "write") == "add":
+        var._grad._data = var._grad._data + g
+    else:
+        var._grad._data = g.astype(var._grad._data.dtype)
+    var._fresh_grad = True
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

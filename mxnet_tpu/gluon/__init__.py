@@ -6,6 +6,7 @@ from .parameter import Parameter, Constant, ParameterDict, \
     DeferredInitializationError  # noqa: F401
 from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
 from .trainer import Trainer  # noqa: F401
+from .fused_step import FusedTrainStep, train_step  # noqa: F401
 from . import nn  # noqa: F401
 from . import loss  # noqa: F401
 from . import utils  # noqa: F401

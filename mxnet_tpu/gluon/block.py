@@ -29,7 +29,7 @@ from .. import random as _random
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "make_pure_forward"]
 
 
 class _BlockScope(threading.local):
@@ -423,36 +423,10 @@ class HybridBlock(Block):
         """Trace the block's forward into one jitted pure function.
         Analog of CachedOp::SetForwardGraph + StaticInitExec
         (ref: src/imperative/cached_op.cc:307,584)."""
-        aux_params = []
+        def call(*input_nds):
+            return Block.__call__(self, *input_nds)
 
-        def pure_fn(param_datas, input_datas, rng_key):
-            # swap traced data into the parameters, run eager forward
-            originals = [p.data()._data for p in params]
-            for p, d in zip(params, param_datas):
-                p.data()._data = d
-            _random.push_trace_key(rng_key)
-            collected = []
-            _AUX.stack.append(collected)
-            prev_rec = autograd.set_recording(False)
-            prev_train = autograd.set_training(training)
-            try:
-                out = Block.__call__(
-                    self, *[NDArray(d) for d in input_datas])
-            finally:
-                autograd.set_training(prev_train)
-                autograd.set_recording(prev_rec)
-                _AUX.stack.pop()
-                _random.pop_trace_key()
-                for p, d in zip(params, originals):
-                    p.data()._data = d
-            outs = out if isinstance(out, (tuple, list)) else (out,)
-            aux_params.clear()
-            aux_datas = []
-            for p, new_data in collected:
-                aux_params.append(p)
-                aux_datas.append(new_data)
-            return tuple(o._data for o in outs), tuple(aux_datas)
-
+        pure_fn, aux_params = make_pure_forward(params, call, training)
         jitted = jax.jit(pure_fn)
         # trigger nothing yet; n_outs resolved on first call via structure
         return jitted, None, aux_params
@@ -474,6 +448,58 @@ class HybridBlock(Block):
     def optimize_for(self, x, backend=None, **kwargs):
         self.hybridize(True)
         return self(x)
+
+
+def make_pure_forward(params, call, training):
+    """Build the pure-functional form of an eager forward: returns
+    ``(pure_fn, aux_params)`` where ``pure_fn(param_datas, input_datas,
+    rng_key) -> (out_datas, aux_datas)`` runs ``call`` with the traced
+    param buffers swapped into ``params``, recording off, train mode set,
+    and the PRNG stream keyed off ``rng_key``. The CachedOp purification
+    seam shared by HybridBlock._build_cached_graph and the gluon fused
+    train step (gluon/fused_step.py).
+
+    Aux-state updates (BatchNorm moving stats) are threaded out of the
+    pure function two ways: ``report_aux_update`` collection (eager
+    stateful layers) and direct ``p.data()._data`` rebinds (a hybridized
+    child applying its own cached-op aux inside this trace — previously
+    those were silently dropped by the originals restore). ``aux_params``
+    is repopulated on every trace, ordered like ``aux_datas``."""
+    aux_params = []
+
+    def pure_fn(param_datas, input_datas, rng_key):
+        # swap traced data into the parameters, run eager forward
+        originals = [p.data()._data for p in params]
+        for p, d in zip(params, param_datas):
+            p.data()._data = d
+        _random.push_trace_key(rng_key)
+        collected = []
+        _AUX.stack.append(collected)
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(training)
+        mutated = []
+        try:
+            out = call(*[NDArray(d) for d in input_datas])
+        finally:
+            autograd.set_training(prev_train)
+            autograd.set_recording(prev_rec)
+            _AUX.stack.pop()
+            _random.pop_trace_key()
+            for p, d, orig in zip(params, param_datas, originals):
+                cur = p.data()._data
+                if cur is not d and cur is not orig:
+                    mutated.append((p, cur))
+            for p, d in zip(params, originals):
+                p.data()._data = d
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        aux_params.clear()
+        aux_datas = []
+        for p, new_data in collected + mutated:
+            aux_params.append(p)
+            aux_datas.append(new_data)
+        return tuple(o._data for o in outs), tuple(aux_datas)
+
+    return pure_fn, aux_params
 
 
 def report_aux_update(param, new_data):

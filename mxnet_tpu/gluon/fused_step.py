@@ -1,0 +1,471 @@
+"""Fused train step: loss-forward + backward + optimizer update as ONE
+donated jitted program.
+
+The reference's biggest training-throughput lever is CachedOp with
+``static_alloc``/``static_shape`` (ref: src/imperative/cached_op.cc —
+plan memory once, reuse buffers, run the whole graph as one segment).
+Our hybridize analog only jits the *forward*: backward replays the tape
+as a separate vjp program and ``Trainer._update`` dispatches one
+optimizer call per parameter per step, double-buffering weights and
+optimizer state. For a ResNet/transformer step that host-side loop is
+the dominant overhead — it spans autograd and the optimizer, so neither
+the PR 1 eager fast path nor the HybridBlock cache can reach it.
+
+``FusedTrainStep`` closes the loop: one ``jax.jit`` program traces
+
+    loss = loss_fn(...)                  # forward
+    grads = d loss / d params            # whole-graph backward (jax.vjp)
+    w', s' = step_fn(w, g, s, lr, wd, r) # optimizer, all params at once
+
+with parameter and optimizer-state buffers DONATED to XLA (off-CPU), so
+weights update in place instead of being double-buffered — the
+``static_alloc`` analog for the whole step. Per-step hyperparameters
+(lr, wd, rescale_grad) enter as TRACED OPERANDS, never baked constants:
+an lr schedule tick or a new ``batch_size`` divisor replays the same
+executable (``fused_step.retraces == 0``). Programs are cached with the
+same signature-keyed compile-on-repeat pattern as the imperative
+dispatch cache (ndarray/register.py): a signature runs the genuine
+eager path until it repeats, so one-shot shapes never pay a trace.
+
+Anything the trace can't honor falls back to the eager
+record/backward/``Trainer.step`` path for THAT step — never a crash —
+and is tallied in ``fused_step.fallbacks``: the env kill switch
+(``MXNET_GLUON_FUSED_STEP=0``), an active ``autograd.record`` scope, an
+attached kvstore (multi-host reduce happens outside the program),
+sparse grads, ``grad_req='add'``, a non-hybridized block handed to
+``train_step``, optimizers without the pure ``step_fn`` form, and
+deferred-init parameters (the eager step initializes them; later steps
+fuse). Counters surface as ``profiler.metrics()['fused_step']`` and
+each call is a ``gluon.train_step`` span in the profiler's ``gluon``
+lane.
+
+API::
+
+    step = trainer.fuse_step(lambda x, y: loss(net(x), y))
+    step = mxnet_tpu.gluon.train_step(net, loss, trainer)   # block form
+    for x, y in batches:
+        l = step(x, y, batch_size=x.shape[0])
+"""
+from __future__ import annotations
+
+import os
+import time as _time
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import profiler as _profiler
+from .. import random as _random
+from ..ndarray import NDArray
+from ..ndarray import register as _register
+from ..optimizer.optimizer import _is_low_precision
+from .block import make_pure_forward
+
+__all__ = ["FusedTrainStep", "train_step", "fused_step_enabled",
+           "set_fused_step", "stats", "reset_stats"]
+
+_ENABLED = os.environ.get("MXNET_GLUON_FUSED_STEP", "1") \
+    not in ("0", "false", "off")
+# compile a signature only once it repeats (one-shot shapes stay on the
+# genuine eager path) — same contract as register._JIT_THRESHOLD
+_COMPILE_THRESHOLD = 2
+_CACHE_CAP = 64  # per-step-object; shape churn clears rather than grows
+
+# mxlint: disable=MX003 (GIL-atomic best-effort counters, same contract as ndarray/register._STATS)
+_STATS = {
+    "hits": 0,       # step served by a cached compiled program
+    "misses": 0,     # signature not yet compiled (eager warming, or
+                     # compiled this call)
+    "retraces": 0,   # compile for a config seen before with different
+                     # input/param avals — shape churn indicator
+    "fallbacks": 0,  # step took the eager path for an eligibility or
+                     # trace-failure reason (see the span's mode arg)
+}
+
+
+def fused_step_enabled():
+    return _ENABLED
+
+
+def set_fused_step(enabled):
+    """Toggle the fused train step at runtime (the env var
+    ``MXNET_GLUON_FUSED_STEP`` sets the process default). Returns the
+    previous value."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(enabled)
+    return prev
+
+
+def stats():
+    """Snapshot of the fused-step counters
+    (hits/misses/retraces/fallbacks)."""
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+# surfaces as metrics()['fused_step'] and a dumps() line
+_profiler.register_stats_provider("fused_step", stats, reset_stats)
+
+
+def _state_to_data(state):
+    """NDArray state tree -> jax-array pytree (None passes through)."""
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state._data
+    if isinstance(state, (tuple, list)):
+        return tuple(_state_to_data(s) for s in state)
+    return state
+
+
+def _adopt_state(state, new):
+    """Write a returned jax-array pytree back into the NDArray state
+    tree in place (the pending-result adoption of optimizer state)."""
+    if state is None:
+        return
+    if isinstance(state, NDArray):
+        state._data = new
+        return
+    for s, n in zip(state, new):
+        _adopt_state(s, n)
+
+
+def train_step(block, loss_fn, trainer):
+    """Fused training step for a (block, loss, trainer) triple:
+    ``step(data, label, batch_size=...)`` computes
+    ``loss_fn(block(data), label)``, backpropagates, and applies the
+    trainer's optimizer — all inside one donated jitted program when the
+    block is hybridized (eager fallback otherwise, tallied, never a
+    crash). With more than two positional args, all but the last feed
+    the block and the last is the label. Returns the loss NDArray, like
+    the eager ``loss_fn`` call would."""
+    return FusedTrainStep(trainer, loss_fn, block=block)
+
+
+class FusedTrainStep:
+    """One training step as one XLA program (see the module docstring).
+
+    Built via ``Trainer.fuse_step(loss_fn)`` (``loss_fn(*batch)`` is any
+    callable over NDArrays returning the per-sample loss, usually a
+    closure over the net) or ``gluon.train_step(block, loss_fn,
+    trainer)``. In the closure form, parameters NOT owned by the trainer
+    are baked into the program as constants — keep everything the loss
+    reads inside the trainer (or use the block form, which threads every
+    block parameter through the trace)."""
+
+    def __init__(self, trainer, loss_fn, block=None):
+        if not callable(loss_fn):
+            raise TypeError("loss_fn must be callable, got %r"
+                            % type(loss_fn))
+        self._trainer = trainer
+        self._loss_fn = loss_fn
+        self._block = block
+        self._cache = {}        # full signature -> (jfn, aux_params, fixed)
+        self._key_counts = {}   # signature -> times seen (warming)
+        self._partial_keys = set()  # configs compiled (retrace detection)
+        self._failed_keys = set()   # signatures that failed to trace
+        self.last_mode = None   # how the previous call executed
+
+    # -- public ------------------------------------------------------------
+    def __call__(self, *args, batch_size=None, ignore_stale_grad=False):
+        from ..ndarray import array as _nd_array
+        nd_args = [a if isinstance(a, NDArray) else _nd_array(a)
+                   for a in args]
+        if batch_size is None:
+            batch_size = int(nd_args[0].shape[0]) \
+                if nd_args and nd_args[0].shape else 1
+        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        mode = "error"
+        try:
+            loss, mode = self._dispatch(nd_args, batch_size,
+                                        ignore_stale_grad)
+        finally:
+            self.last_mode = mode
+            if t0 is not None:
+                _profiler.record_op(
+                    "gluon.train_step",
+                    (_time.perf_counter() - t0) * 1e6,
+                    category="gluon", lane="gluon",
+                    args={"mode": mode, "batch_size": batch_size,
+                          "params": len(self._trainer._params)})
+        return loss
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, nd_args, batch_size, ignore_stale_grad):
+        reason = self._fallback_reason()
+        if reason is None:
+            all_params, train_pos, indices = self._param_split()
+            if not train_pos:
+                reason = "no-trainable-params"
+            elif any(p._data is None for p in all_params):
+                # covers block params the trainer does NOT own (frozen
+                # layers): the eager step's forward finishes their
+                # deferred init, later steps fuse
+                reason = "deferred-init"
+        if reason is not None:
+            _STATS["fallbacks"] += 1
+            return self._eager_step(nd_args, batch_size,
+                                    ignore_stale_grad), \
+                "fallback:" + reason
+
+        # optimizer states are created HERE (not at update time) through
+        # the trainer's own updater, so save_states/load_states round-trip
+        # across eager and fused steps against one shared store
+        updater = self._trainer._updater
+        states = [updater.ensure_state(i, self._trainer._params[i].data())
+                  for i in indices]
+        key, partial = self._signature(nd_args, all_params, train_pos,
+                                       states)
+        if key in self._failed_keys:
+            _STATS["fallbacks"] += 1
+            return self._eager_step(nd_args, batch_size,
+                                    ignore_stale_grad), \
+                "fallback:trace-failed"
+
+        entry = self._cache.get(key)
+        if entry is not None:
+            _STATS["hits"] += 1
+            return self._run(entry, all_params, train_pos, indices, states,
+                            nd_args, batch_size), "fused"
+
+        _STATS["misses"] += 1
+        if len(self._key_counts) >= 4 * _CACHE_CAP:
+            self._key_counts.clear()  # one-shot signatures must not leak
+        seen = self._key_counts.get(key, 0) + 1
+        self._key_counts[key] = seen
+        if seen < _COMPILE_THRESHOLD:
+            return self._eager_step(nd_args, batch_size,
+                                    ignore_stale_grad), "eager-warming"
+        if len(self._cache) >= _CACHE_CAP:
+            self._cache.clear()
+            self._partial_keys.clear()
+        if partial in self._partial_keys:
+            _STATS["retraces"] += 1
+        self._partial_keys.add(partial)
+        try:
+            entry = self._build(all_params, train_pos)
+            loss = self._run(entry, all_params, train_pos, indices, states,
+                             nd_args, batch_size)
+        except Exception:
+            # trace-incompatible step (data-dependent control flow, host
+            # callback, ...): remember the signature and run the genuine
+            # eager path — never a crash
+            if len(self._failed_keys) >= 4 * _CACHE_CAP:
+                self._failed_keys.clear()  # shape churn must not leak keys
+            self._failed_keys.add(key)
+            _STATS["fallbacks"] += 1
+            return self._eager_step(nd_args, batch_size,
+                                    ignore_stale_grad), \
+                "fallback:trace-failed"
+        self._cache[key] = entry
+        return loss, "compile"
+
+    def _fallback_reason(self):
+        if not _ENABLED:
+            return "disabled"
+        if autograd.is_recording():
+            return "recording-scope"
+        tr = self._trainer
+        # mirror the eager step() prologue so eligibility sees the real
+        # kvstore/params state (both calls are idempotent)
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._params_to_init:
+            tr._init_params()
+        if tr._kvstore is not None:
+            return "kvstore"
+        if not tr._optimizer.fused_step_supported():
+            return "optimizer:" + type(tr._optimizer).__name__
+        if hasattr(tr, "_amp_loss_scaler"):
+            # amp.init_trainer wraps Trainer._update with the dynamic
+            # loss-scaler overflow skip — logic the fused program would
+            # silently bypass
+            return "amp-loss-scaler"
+        if self._block is not None and \
+                not getattr(self._block, "_active", False):
+            return "non-hybridized"
+        for p in tr._params:
+            if p.grad_req == "add":
+                return "grad-req-add"
+            if getattr(p, "_grad_stype", "default") != "default" or \
+                    getattr(p, "_stype", "default") != "default":
+                return "sparse-grad"
+        return None
+
+    def _param_split(self):
+        """(all_params, trainable positions, trainer indices). The block
+        form threads EVERY block parameter through the trace (frozen ones
+        as runtime inputs, not baked constants); the closure form can only
+        see the trainer's."""
+        tr = self._trainer
+        if self._block is not None:
+            all_params = self._block._all_params_list()
+            known = {id(p) for p in all_params}
+            all_params = all_params + [p for p in tr._params
+                                       if id(p) not in known]
+        else:
+            all_params = list(tr._params)
+        train_pos, indices = [], []
+        for pos, p in enumerate(all_params):
+            idx = tr._param2idx.get(p.name)
+            if idx is not None and tr._params[idx] is p \
+                    and p.grad_req != "null":
+                train_pos.append(pos)
+                indices.append(idx)
+        return all_params, train_pos, indices
+
+    def _signature(self, nd_args, all_params, train_pos, states):
+        """(full cache key, partial key). lr/wd/rescale are operands and
+        deliberately absent; the partial key (config without avals) is the
+        retrace detector, same contract as register._dispatch_key."""
+        state_datas = [_state_to_data(s) for s in states]
+        partial = (self._trainer._optimizer._fused_static_key(),
+                   len(all_params), tuple(train_pos),
+                   _register._amp_version,
+                   jax.tree_util.tree_structure(state_datas))
+        full = partial + (
+            tuple(_register.aval(a._data) for a in nd_args),
+            tuple(_register.aval(p.data()._data) for p in all_params),
+            tuple(_register.aval(l)
+                  for l in jax.tree_util.tree_leaves(state_datas)))
+        return full, partial
+
+    # -- the program -------------------------------------------------------
+    def _build(self, all_params, train_pos):
+        """Trace loss-forward + backward + the optimizer update for ALL
+        parameters into one pure function and jit it with weight and
+        optimizer-state buffers donated (off-CPU; donation is a no-op on
+        the host backend)."""
+        opt = self._trainer._optimizer
+        pure_fwd, aux_params = make_pure_forward(all_params, self._call,
+                                                 training=True)
+        n_all = len(all_params)
+        train_set = set(train_pos)
+        fixed_pos = tuple(i for i in range(n_all) if i not in train_set)
+        mp = opt.multi_precision
+
+        def pure_step(train_datas, state_datas, fixed_datas, in_datas,
+                      lrs, wds, rescale, rng):
+            def loss_of(tds):
+                merged = [None] * n_all
+                for pos, d in zip(train_pos, tds):
+                    merged[pos] = d
+                for pos, d in zip(fixed_pos, fixed_datas):
+                    merged[pos] = d
+                outs, aux = pure_fwd(tuple(merged), in_datas, rng)
+                # grad of sum(loss) ≙ backward's all-ones head seed
+                return jnp.sum(outs[0]), (outs[0], aux)
+
+            (_, (loss, aux)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_datas)
+            # parity note: against the HYBRIDIZED eager path (backward =
+            # vjp of the same jitted forward) this program is bitwise
+            # identical; the non-hybridized per-op tape can differ by
+            # ~1 ULP because XLA fuses tiny dots differently per context
+            new_ws, new_sts = [], []
+            for i in range(len(train_datas)):
+                w, g, st = train_datas[i], grads[i], state_datas[i]
+                lr_i, wd_i, rs_i = lrs[i], wds[i], rescale
+                if not (mp and _is_low_precision(w.dtype)) \
+                        and w.dtype != jnp.float32:
+                    # the eager per-param jit receives WEAK host scalars
+                    # that demote to the weight dtype; traced operands
+                    # are strong f32 — demote explicitly so fp16/bf16
+                    # steps do the same low-precision arithmetic
+                    lr_i = lr_i.astype(w.dtype)
+                    wd_i = wd_i.astype(w.dtype)
+                    rs_i = rs_i.astype(w.dtype)
+                nw, ns = opt.step_fn_multi_precision(w, g, st, lr_i, wd_i,
+                                                     rs_i)
+                new_ws.append(nw)
+                new_sts.append(ns)
+            return loss, tuple(new_ws), tuple(new_sts), grads, aux
+
+        donate = ()
+        try:
+            if jax.default_backend() != "cpu":
+                donate = (0, 1)  # weights + optimizer state
+        except Exception:
+            donate = ()
+        jfn = jax.jit(pure_step, donate_argnums=donate) if donate \
+            else jax.jit(pure_step)
+        return jfn, aux_params, fixed_pos
+
+    def _run(self, entry, all_params, train_pos, indices, states, nd_args,
+             batch_size):
+        """Execute one fused step: host hyperparameter math (identical to
+        the eager update()'s), the compiled program, then pending-result
+        adoption back into Parameter.data()/grad() and the state store."""
+        jfn, aux_params, fixed_pos = entry
+        tr = self._trainer
+        opt = tr._optimizer
+        rescale = tr._scale / batch_size
+        tr._check_and_rescale_grad(rescale)
+        # count bookkeeping first, exactly like update(); snapshot so a
+        # failing run (which then falls back to eager) can't double-count
+        prev_num = opt.num_update
+        prev_counts = {i: opt._index_update_count.get(i) for i in indices}
+        opt._update_count(list(indices))
+        try:
+            lrs = [opt.step_lr(i) for i in indices]
+            wds = opt._get_wds(list(indices))
+            train_params = [all_params[pos] for pos in train_pos]
+            train_datas = tuple(p.data()._data for p in train_params)
+            state_datas = tuple(_state_to_data(s) for s in states)
+            fixed_datas = tuple(all_params[pos].data()._data
+                                for pos in fixed_pos)
+            in_datas = tuple(a._data for a in nd_args)
+            # f32 operands: the framework canonicalizes float64 away at
+            # the NDArray boundary (jax x64 stays off), so f32 is full
+            # precision for every reachable weight dtype
+            loss_data, new_ws, new_sts, grads, aux_datas = jfn(
+                train_datas, state_datas, fixed_datas, in_datas,
+                jnp.asarray(lrs, jnp.float32),
+                jnp.asarray(wds, jnp.float32),
+                jnp.float32(rescale), _random.next_key())
+        except BaseException:
+            opt.num_update = prev_num
+            for i, c in prev_counts.items():
+                if c is None:
+                    opt._index_update_count.pop(i, None)
+                else:
+                    opt._index_update_count[i] = c
+            raise
+        # pending-result adoption: weights + raw grads into the params,
+        # state leaves into the updater's store, aux (moving stats) last
+        for p, nw, g in zip(train_params, new_ws, grads):
+            p._adopt_fused(nw, g)
+        for st, ns in zip(states, new_sts):
+            _adopt_state(st, ns)
+        for p, a in zip(aux_params, aux_datas):
+            tgt = p.data()
+            tgt._data = a if a.dtype == tgt.dtype else a.astype(tgt.dtype)
+        return NDArray(loss_data)
+
+    # -- eager fallback ----------------------------------------------------
+    def _call(self, *nd_args):
+        if self._block is not None:
+            if len(nd_args) >= 2:
+                out = self._block(*nd_args[:-1])
+                return self._loss_fn(out, nd_args[-1])
+            return self._loss_fn(self._block(*nd_args))
+        return self._loss_fn(*nd_args)
+
+    def _eager_step(self, nd_args, batch_size, ignore_stale_grad):
+        """The untraced truth: record, backward, Trainer.step — used for
+        warming runs and every fallback, so a fused-ineligible step is
+        never a crash, just the eager cost."""
+        with autograd.record():
+            loss = self._call(*nd_args)
+        if not isinstance(loss, NDArray):
+            raise TypeError("loss_fn must return one NDArray loss, got %r"
+                            % type(loss))
+        autograd.backward([loss])
+        self._trainer.step(batch_size, ignore_stale_grad=ignore_stale_grad)
+        return loss

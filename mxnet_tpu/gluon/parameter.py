@@ -176,6 +176,20 @@ class Parameter:
         else:
             self._data._data = data._data.astype(self._data.dtype)
 
+    def _adopt_fused(self, weight_data, grad_data=None):
+        """Adopt one fused-train-step result into this parameter's live
+        buffers: the updated weight into ``data()`` (dtype preserved)
+        and, when given, the raw gradient the program computed into
+        ``grad()`` — then age the grad flag, because the same program
+        already consumed it (mirrors Trainer._update's bookkeeping, so
+        eager and fused steps leave identical state behind)."""
+        data = self.data()
+        data._data = weight_data if weight_data.dtype == data.dtype \
+            else weight_data.astype(data.dtype)
+        if grad_data is not None:
+            autograd.deliver_grad(data, grad_data)
+        data._fresh_grad = False
+
     def reset_ctx(self, ctx):
         if self._data is not None:
             self._data = self._data.as_in_context(ctx)

@@ -71,7 +71,11 @@ class Trainer:
         else:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
-        self._updaters = [opt.get_updater(self._optimizer)]
+        # ONE updater owns all parameter state: a Parameter is one logical
+        # (mesh-placed) array here, so the reference's updater-per-device
+        # list collapses to a single update path — which is also the one
+        # well-defined update list the fused step traces
+        self._updater = opt.get_updater(self._optimizer)
 
     def _reset_kvstore(self):
         self._kv_initialized = False
@@ -172,6 +176,22 @@ class Trainer:
                 args={"batch_size": batch_size,
                       "params": len(self._params)})
 
+    def fuse_step(self, loss_fn, block=None):
+        """Return a :class:`~mxnet_tpu.gluon.fused_step.FusedTrainStep`
+        tracing ``loss_fn`` forward + backward + this trainer's optimizer
+        update (all parameters at once) into ONE donated jitted program —
+        the CachedOp ``static_alloc``/``static_shape`` analog for the
+        whole training step. ``loss_fn(*batch)`` is any callable over
+        NDArrays returning the per-sample loss, usually a closure over
+        the net; parameters it reads that this trainer does not own are
+        baked as constants (use ``gluon.train_step(block, loss, trainer)``
+        to thread every block parameter through instead). Each call
+        replaces the eager record/backward/``step`` triple and falls back
+        to it per step whenever the trace can't honor the step (counted
+        in ``profiler.metrics()['fused_step']``, never a crash)."""
+        from .fused_step import FusedTrainStep
+        return FusedTrainStep(self, loss_fn, block=block)
+
     def allreduce_grads(self):
         """Explicit reduce step for when update() is called separately
         (ref: trainer.py:334)."""
@@ -222,13 +242,13 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        updates = [[] for _ in self._updaters]
+        updates = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
-            if not ignore_stale_grad:
-                data = param.data()
-                if not getattr(data, "_fresh_grad", True):
+            fresh = getattr(param.data(), "_fresh_grad", True)
+            if not fresh:
+                if not ignore_stale_grad:
                     raise UserWarning(
                         "Gradient of Parameter `%s` on context %s has not "
                         "been updated by backward since last `step`. This "
@@ -237,15 +257,26 @@ class Trainer:
                         "iteration. If you are intentionally only using a "
                         "subset, call step with ignore_stale_grad=True to "
                         "suppress this warning" % (
-                            param.name, str(data.context)))
-            param.data()._fresh_grad = False
-            if self._kvstore and self._update_on_kvstore:
+                            param.name, str(param.data().context)))
+                # ref: trainer.py:365 skips non-fresh grads under
+                # ignore_stale_grad instead of re-applying the previous
+                # iteration's gradient (momentum would keep charging)
                 continue
-            updates[0].append((i, param.grad(), param.data()))
-        for updater, upd in zip(self._updaters, updates):
-            if upd:
-                i, g, w = zip(*upd)
-                updater(list(i), list(g), list(w))
+            if self._kvstore and self._update_on_kvstore:
+                # the kvstore's pushpull already applied this update in
+                # _allreduce_grads (and a failed pushpull raised before
+                # reaching here) — only now is the grad consumed
+                param.data()._fresh_grad = False
+                continue
+            updates.append((i, param.grad(), param.data()))
+        if updates:
+            i, g, w = zip(*updates)
+            self._updater(list(i), list(g), list(w))
+            # age grads only after the update path actually ran: a
+            # raising updater must leave them fresh so a retried step
+            # doesn't trip the stale-grad check (or silently skip params)
+            for data in w:
+                data._fresh_grad = False
 
     def save_states(self, fname):
         """Save optimizer/updater states (ref: trainer.py:436)."""
@@ -261,8 +292,7 @@ class Trainer:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
             with open(fname, "wb") as fout:
-                fout.write(self._updaters[0].get_states(
-                    dump_optimizer=True))
+                fout.write(self._updater.get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         """ref: trainer.py:465."""
@@ -275,10 +305,7 @@ class Trainer:
             self._optimizer = self._kvstore._updater.optimizer
         else:
             with open(fname, "rb") as f:
-                states = f.read()
-            for updater in self._updaters:
-                updater.set_states(states)
-                updater.optimizer = self._updaters[0].optimizer
-            self._optimizer = self._updaters[0].optimizer
+                self._updater.set_states(f.read())
+            self._optimizer = self._updater.optimizer
         param_dict = {i: param for i, param in enumerate(self._params)}
         self._optimizer.param_dict = param_dict

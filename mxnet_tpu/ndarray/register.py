@@ -53,7 +53,7 @@ from .ndarray import NDArray, _PendingSlot
 __all__ = ["invoke", "invoke_by_name", "make_op_func", "populate",
            "invoke_getitem", "imperative_jit_enabled", "set_imperative_jit",
            "dispatch_stats", "reset_dispatch_stats", "flush_bulk_segment",
-           "bulk_segment_depth", "set_profiler_hooks"]
+           "bulk_segment_depth", "set_profiler_hooks", "aval"]
 
 # Telemetry hooks at the dispatch choke points (the engine OprBlock hook
 # analog, src/profiler/profiler.h:251). When profiling is off the entire
@@ -214,6 +214,16 @@ def _aval(d):
     # np.dtype objects hash/compare by identity semantics and are cheap
     # key components; str(dtype) costs ~10us and is avoided on purpose
     return (d.shape, d.dtype, getattr(d, "weak_type", False))
+
+
+def aval(d):
+    """Hashable signature component for one jax array: (shape, dtype,
+    weak_type). The shared key ingredient of every signature-keyed
+    compile-on-repeat cache in the tree — the dispatch cache and bulk
+    segments here, and the gluon fused train step
+    (gluon/fused_step.py) — so they all discriminate inputs the same
+    way."""
+    return _aval(d)
 
 
 def _snapshot(v):

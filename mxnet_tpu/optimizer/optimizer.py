@@ -203,6 +203,62 @@ class Optimizer:
     def _get_wd(self, index):
         return self._get_wds([index])[0]
 
+    # -- pure step form (gluon fused train step) ---------------------------
+    #
+    # Next to the in-place ``update()`` every fused-capable optimizer
+    # defines ``step_fn(weight, grad, state, lr, wd, rescale)``: a pure
+    # function over jax arrays returning ``(new_weight, new_state)``,
+    # mirroring update()'s jitted closure op-for-op so the fused train
+    # step (gluon/fused_step.py) is bitwise-identical to the eager path.
+    # lr/wd/rescale arrive as TRACED scalar operands (never baked
+    # constants), so lr schedules and batch_size changes replay the same
+    # compiled program; per-step host math that update() does in float64
+    # (Adam's bias-corrected rate) lives in ``step_lr`` so both paths
+    # round identically.
+
+    def step_fn(self, weight, grad, state, lr, wd, rescale):
+        """Pure update: (new_weight, new_state) from jax-array operands.
+        Optimizers that don't override this are not fused-step capable
+        (the fused train step falls back to the eager path for them)."""
+        raise NotImplementedError(
+            "%s does not define the pure step_fn form; the gluon fused "
+            "train step falls back to eager update()"
+            % type(self).__name__)
+
+    def step_fn_multi_precision(self, weight, grad, state, lr, wd, rescale):
+        """Pure counterpart of ``update_multi_precision``: when this
+        weight carries an fp32 master copy, step on the master and cast
+        back, with the state shaped ``(master, base_state)`` exactly as
+        ``create_state_multi_precision`` built it."""
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            master, base = state
+            new_master, new_base = self.step_fn(
+                master, grad.astype(jnp.float32), base, lr, wd, rescale)
+            return new_master.astype(weight.dtype), (new_master, new_base)
+        return self.step_fn(weight, grad, state, lr, wd, rescale)
+
+    def fused_step_supported(self):
+        """Whether this optimizer defines the pure step_fn form."""
+        return type(self).step_fn is not Optimizer.step_fn
+
+    def step_lr(self, index):
+        """Effective learning rate ``step_fn`` should receive for one
+        weight this step — computed with the SAME host float64 math
+        ``update()`` uses (call after ``_update_count``). Optimizers whose
+        update bakes the step count into the rate (Adam) override this;
+        the count itself never enters the trace, so stepping never
+        retraces."""
+        return self._get_lr(index)
+
+    def _fused_static_key(self):
+        """Hashable snapshot of the hyperparameters step_fn bakes as
+        trace constants. Part of the fused-step cache key: mutating them
+        (or load_states swapping in a differently-configured optimizer)
+        must invalidate the compiled program instead of silently
+        replaying stale constants."""
+        return (type(self).__name__, self.clip_gradient,
+                bool(self.multi_precision))
+
     # -- jit plumbing ------------------------------------------------------
     def _preprocess_grad(self, grad, rescale, clip):
         g = grad * rescale
@@ -263,6 +319,16 @@ class SGD(Optimizer):
         if self.momentum == 0.0:
             return None
         return NDArray(jnp.zeros_like(weight._data))
+
+    def step_fn(self, weight, grad, state, lr, wd, rescale):
+        g = self._preprocess_grad(grad, rescale, self.clip_gradient)
+        if self.momentum == 0.0:
+            return weight - lr * (g + wd * weight), state
+        m2 = self.momentum * state - lr * (g + wd * weight)
+        return weight + m2, m2
+
+    def _fused_static_key(self):
+        return super()._fused_static_key() + (self.momentum,)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -586,6 +652,27 @@ class Adam(Optimizer):
         return (NDArray(jnp.zeros_like(weight._data)),
                 NDArray(jnp.zeros_like(weight._data)))
 
+    def step_fn(self, weight, grad, state, lr, wd, rescale):
+        # lr is the bias-corrected rate from step_lr (the lr_t update()
+        # computes host-side) so the step count never enters the trace
+        m, v = state
+        g = self._preprocess_grad(grad, rescale, self.clip_gradient) \
+            + wd * weight
+        m2 = self.beta1 * m + (1 - self.beta1) * g
+        v2 = self.beta2 * v + (1 - self.beta2) * g * g
+        w2 = weight - lr * m2 / (jnp.sqrt(v2) + self.epsilon)
+        return w2, (m2, v2)
+
+    def step_lr(self, index):
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        return self._get_lr(index) * math.sqrt(coef2) / coef1
+
+    def _fused_static_key(self):
+        return super()._fused_static_key() + (self.beta1, self.beta2,
+                                              self.epsilon)
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -659,6 +746,15 @@ class AdaGrad(Optimizer):
     def create_state(self, index, weight):
         return NDArray(jnp.zeros_like(weight._data))
 
+    def step_fn(self, weight, grad, state, lr, wd, rescale):
+        g = self._preprocess_grad(grad, rescale, self.clip_gradient) \
+            + wd * weight
+        h2 = state + g * g
+        return weight - lr * g / (jnp.sqrt(h2) + self.float_stable_eps), h2
+
+    def _fused_static_key(self):
+        return super()._fused_static_key() + (self.float_stable_eps,)
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -724,6 +820,31 @@ class RMSProp(Optimizer):
                     NDArray(jnp.zeros_like(weight._data)),
                     NDArray(jnp.zeros_like(weight._data)))  # n, g, delta
         return NDArray(jnp.zeros_like(weight._data))
+
+    def step_fn(self, weight, grad, state, lr, wd, rescale):
+        g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
+        clip_w = self.clip_weights
+        g = self._preprocess_grad(grad, rescale, self.clip_gradient) \
+            + wd * weight
+        if not self.centered:
+            n2 = (1 - g1) * g * g + g1 * state
+            w2 = weight - lr * g / jnp.sqrt(n2 + eps)
+            if clip_w is not None:
+                w2 = jnp.clip(w2, -clip_w, clip_w)
+            return w2, n2
+        n, gbar, delta = state
+        n2 = (1 - g1) * g * g + g1 * n
+        gb2 = (1 - g1) * g + g1 * gbar
+        d2 = g2 * delta - lr * g / jnp.sqrt(n2 - gb2 * gb2 + eps)
+        w2 = weight + d2
+        if clip_w is not None:
+            w2 = jnp.clip(w2, -clip_w, clip_w)
+        return w2, (n2, gb2, d2)
+
+    def _fused_static_key(self):
+        return super()._fused_static_key() + (
+            self.gamma1, self.gamma2, self.epsilon, self.centered,
+            self.clip_weights)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -891,6 +1012,19 @@ class NAG(Optimizer):
             return None
         return NDArray(jnp.zeros_like(weight._data))
 
+    def step_fn(self, weight, grad, state, lr, wd, rescale):
+        mom = self.momentum
+        if state is None:
+            g = self._preprocess_grad(grad, rescale, self.clip_gradient)
+            return weight - lr * (g + wd * weight), None
+        g = self._preprocess_grad(grad, rescale, self.clip_gradient) \
+            + wd * weight
+        m2 = mom * state + g
+        return weight - lr * (g + mom * m2), m2
+
+    def _fused_static_key(self):
+        return super()._fused_static_key() + (self.momentum,)
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -984,6 +1118,21 @@ class Updater:
         self.states_synced = {}
         self.aggregate_updates = optimizer.aggregate_num > 0
 
+    def ensure_state(self, index, weight):
+        """Create-or-resync the optimizer state for one index (the lazy
+        init block of ``__call__``, shared with the gluon fused train
+        step so both paths own the SAME state store — save_states /
+        load_states round-trip across them)."""
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced[index]:
+            self.states[index] = self.sync_state_context(self.states[index],
+                                                         weight.context)
+            self.states_synced[index] = True
+        return self.states[index]
+
     def __call__(self, index, grad, weight):
         if not isinstance(index, (list, tuple)):
             indices = [index]
@@ -992,14 +1141,7 @@ class Updater:
         else:
             indices, grads, weights = index, grad, weight
         for i, g, w in zip(indices, grads, weights):
-            if i not in self.states:
-                self.states[i] = \
-                    self.optimizer.create_state_multi_precision(i, w)
-                self.states_synced[i] = True
-            elif not self.states_synced[i]:
-                self.states[i] = self.sync_state_context(self.states[i],
-                                                         w.context)
-                self.states_synced[i] = True
+            self.ensure_state(i, w)
             self.optimizer.update_multi_precision(i, w, g, self.states[i])
 
     def sync_state_context(self, state, context):

@@ -44,6 +44,7 @@ __all__ = [
     "Domain", "Task", "Frame", "Event", "Counter", "Marker",
     "record_op", "record_counter", "account", "sample_memory", "metrics",
     "is_running", "imperative_stats", "reset_imperative_stats", "LANES",
+    "register_stats_provider",
 ]
 
 # Stable pid/tid lanes of the host trace. tid doubles as the sort index.
@@ -461,6 +462,39 @@ def dump(finished=True, profile_process="worker", format="chrome"):
                          "got %r" % (format,))
 
 
+# Subsystem counter snapshots surfaced as named sections of metrics()
+# and trailing lines of dumps() — the gluon fused train step registers
+# "fused_step" here; other layers can follow the same pattern instead of
+# growing bespoke metrics() fields.
+_STATS_PROVIDERS = {}  # name -> (snapshot_fn, reset_fn or None)
+
+
+def register_stats_provider(name, snapshot, reset=None):
+    """Expose a subsystem's counter snapshot (a flat JSON-safe dict) as
+    ``metrics()[name]`` and a line of ``dumps()``. ``snapshot()`` must be
+    cheap and callable with profiling off; ``reset()`` (optional) is
+    invoked by ``metrics(reset=True)`` / ``dumps(reset=True)``."""
+    with _lock:
+        _STATS_PROVIDERS[name] = (snapshot, reset)
+
+
+def _provider_sections(reset):
+    """[(name, stats dict)] from the registered providers; a raising
+    provider reports its error instead of killing the snapshot."""
+    with _lock:
+        providers = sorted(_STATS_PROVIDERS.items())
+    out = []
+    for name, (snapshot, reset_fn) in providers:
+        try:
+            stats = dict(snapshot())
+            if reset and reset_fn is not None:
+                reset_fn()
+        except Exception as e:
+            stats = {"error": "%s: %s" % (type(e).__name__, e)}
+        out.append((name, stats))
+    return out
+
+
 def imperative_stats():
     """Imperative dispatch-cache counters (cache hits/misses/retraces/
     fallbacks and bulk-segment flushes/ops) — the observability surface of
@@ -507,6 +541,8 @@ def metrics(reset=False):
         "memory": memory,
         "num_events": num_events,
     }
+    for name, stats in _provider_sections(reset):
+        out.setdefault(name, stats)
     if _locktrace.ENABLED:
         # runtime lock-order detector findings (MXNET_DEBUG_LOCKS=1):
         # acquisition-order inversions + locks held across jit/sync
@@ -548,6 +584,9 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
                  "fallbacks=%d bulk_flushes=%d bulk_ops=%d"
                  % (st["hits"], st["misses"], st["retraces"],
                     st["fallbacks"], st["bulk_flushes"], st["bulk_ops"]))
+    for name, stats in _provider_sections(reset):
+        lines.append("%s: %s" % (name, " ".join(
+            "%s=%s" % (k, stats[k]) for k in sorted(stats))))
     if counters:
         lines.append("counters: " + " ".join(
             "%s=%s" % (k, counters[k]) for k in sorted(counters)))

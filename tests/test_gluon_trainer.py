@@ -87,6 +87,98 @@ def test_trainer_allreduce_then_update():
     trainer.update(4)
 
 
+def test_trainer_single_updater_reality():
+    """The dead multi-updater list is gone: ONE updater owns all state
+    (a Parameter is one logical mesh-placed array here), which is also
+    the single well-defined update list the fused step traces."""
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    assert not hasattr(trainer, "_updaters")
+    assert trainer._updater.optimizer is trainer._optimizer
+
+
+def test_failed_update_leaves_grads_fresh():
+    """Stale-grad regression: _update must age grads only AFTER the
+    update path actually ran — a raising updater leaves them fresh so a
+    retried step works instead of tripping the stale-grad check."""
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.ones((4, 4))
+    y = mx.nd.ones((4, 1))
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+
+    real_updater = trainer._updater
+
+    class _Flaky:
+        def __init__(self):
+            self.fail = True
+
+        def __call__(self, i, g, w):
+            if self.fail:
+                raise RuntimeError("simulated optimizer failure")
+            return real_updater(i, g, w)
+
+    flaky = _Flaky()
+    trainer._updater = flaky
+    with pytest.raises(RuntimeError, match="simulated"):
+        trainer.step(4)
+    # grads still look fresh: the update never happened
+    for p in net.collect_params().values():
+        assert p.data()._fresh_grad is True
+    # retry WITHOUT a new backward must neither warn stale nor skip
+    flaky.fail = False
+    before = {n: p.data().asnumpy().copy()
+              for n, p in net.collect_params().items()}
+    trainer.step(4)
+    for n, p in net.collect_params().items():
+        assert not np.array_equal(before[n], p.data().asnumpy())
+        assert p.data()._fresh_grad is False
+
+
+def test_update_on_kvstore_failed_pushpull_keeps_grads_fresh():
+    """Under update_on_kvstore the pushpull IS the update: when it
+    raises, step() aborts before any bookkeeping, so params still look
+    fresh; once it succeeds the flag clears."""
+    class _FlakyKV:
+        fail = True
+
+        def set_optimizer(self, o):
+            pass
+
+        def init(self, k, v):
+            pass
+
+        def pushpull(self, k, grad, out=None, priority=0):
+            if self.fail:
+                raise RuntimeError("wire down")
+            out -= grad * 0.0  # applied-update stand-in
+
+    net = _make_net()
+    kv = _FlakyKV()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv,
+                            update_on_kvstore=True)
+    x = mx.nd.ones((4, 4))
+    y = mx.nd.ones((4, 1))
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    with pytest.raises(RuntimeError, match="wire down"):
+        trainer.step(4)
+    for p in net.collect_params().values():
+        assert p.data()._fresh_grad is True
+    kv.fail = False
+    trainer.step(4)
+    for p in net.collect_params().values():
+        assert p.data()._fresh_grad is False
+
+
 # -- kvstore ----------------------------------------------------------------
 
 def test_kvstore_push_pull_single():

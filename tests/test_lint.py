@@ -174,6 +174,19 @@ def test_mx005_sanctioned_module_is_exempt(tmp_path):
     assert findings == []
 
 
+def test_mx005_fused_step_module_is_sanctioned(tmp_path):
+    """The fused-train-step program cache (ISSUE 4) is a sanctioned jit
+    site: its keys are the signature-keyed compile-on-repeat cache on
+    each FusedTrainStep, bounded like the dispatch cache."""
+    assert "mxnet_tpu/gluon/fused_step.py" in rules._SANCTIONED_JIT
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/gluon/fused_step.py", """\
+        import jax
+        prog = jax.jit(lambda x: x)
+        """, {"MX005"})
+    assert findings == []
+
+
 def test_mx006_missing_and_present_macros(tmp_path):
     findings, _, _, _ = _lint_snippet(
         tmp_path, "src/c_api_extra.cc", """\

@@ -365,6 +365,7 @@ _SANCTIONED_JIT = (
     "mxnet_tpu/ndarray/register.py",   # imperative dispatch + bulk caches
     "mxnet_tpu/jit.py",                # the explicit user-facing jit cache
     "mxnet_tpu/gluon/block.py",        # HybridBlock compile cache
+    "mxnet_tpu/gluon/fused_step.py",   # fused train-step program cache
 )
 
 

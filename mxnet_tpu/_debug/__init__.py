@@ -5,5 +5,6 @@ routes through these modules unconditionally, and the modules keep
 their own disabled fast paths, so production runs pay (almost) nothing.
 """
 from . import locktrace
+from . import faultpoint
 
-__all__ = ["locktrace"]
+__all__ = ["locktrace", "faultpoint"]

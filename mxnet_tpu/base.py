@@ -7,11 +7,14 @@ plumbing (dtype canonicalisation, registries, errors).
 """
 from __future__ import annotations
 
+import contextlib
+import os as _os
+
 import numpy as _np
 
 __all__ = [
     "MXNetError", "string_types", "numeric_types",
-    "canonical_dtype", "DTYPE_NAMES",
+    "canonical_dtype", "DTYPE_NAMES", "atomic_write",
 ]
 
 
@@ -38,6 +41,37 @@ DTYPE_NAMES = {
     "int64": _jnp.int64,
     "bool": _jnp.bool_,
 }
+
+
+@contextlib.contextmanager
+def atomic_write(fname, mode="wb"):
+    """Crash-consistent file publication: yields an open file over a
+    sibling temp path, and ``os.replace``-renames it onto ``fname`` only
+    after the body completed. The profiler's continuous-dump idiom
+    (profiler._atomic_json_write) generalized for every checkpoint
+    writer (nd.save, symbol.save, Trainer.save_states,
+    parallel.CheckpointManager): a crash — or an injected
+    ``checkpoint.save`` fault — mid-save can never leave a corrupt or
+    half-written file at the published path; the previous checkpoint
+    stays intact and the temp file is removed.
+
+    The ``checkpoint.save`` fault point fires BETWEEN the temp write and
+    the rename — the worst possible crash instant, which is exactly what
+    the atomicity contract must survive (tests/test_faultpoints.py)."""
+    from ._debug import faultpoint as _faultpoint
+    tmp = "%s.tmp.%d" % (fname, _os.getpid())
+    try:
+        with open(tmp, mode) as f:
+            yield f
+        if _faultpoint.ACTIVE:
+            _faultpoint.check("checkpoint.save")
+        _os.replace(tmp, fname)
+    except BaseException:
+        try:
+            _os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def is_inexact_dtype(dt):

@@ -59,6 +59,7 @@ from .. import profiler as _profiler
 from .. import random as _random
 from ..ndarray import NDArray
 from ..ndarray import register as _register
+from .._debug import faultpoint as _faultpoint
 from ..optimizer.optimizer import _is_low_precision
 from .block import make_pure_forward
 
@@ -342,6 +343,11 @@ class FusedTrainStep:
         parameters into one pure function and jit it with weight and
         optimizer-state buffers donated (off-CPU; donation is a no-op on
         the host backend)."""
+        if _faultpoint.ACTIVE:
+            # trace-site fault seam: _dispatch wraps _build in the
+            # fallback:trace-failed try, so a raise here exercises the
+            # per-step eager degradation a real trace failure takes
+            _faultpoint.check("fused_step.trace")
         opt = self._trainer._optimizer
         pure_fwd, aux_params = make_pure_forward(all_params, self._call,
                                                  training=True)

@@ -279,7 +279,10 @@ class Trainer:
                 data._fresh_grad = False
 
     def save_states(self, fname):
-        """Save optimizer/updater states (ref: trainer.py:436)."""
+        """Save optimizer/updater states (ref: trainer.py:436).
+        Crash-consistent: temp-file + atomic rename (base.atomic_write),
+        so an interrupted save never truncates the previous states file
+        a resume depends on."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
@@ -291,7 +294,8 @@ class Trainer:
                 "yet initialized in kvstore."
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as fout:
+            from ..base import atomic_write
+            with atomic_write(fname) as fout:
                 fout.write(self._updater.get_states(dump_optimizer=True))
 
     def load_states(self, fname):

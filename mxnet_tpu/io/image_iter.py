@@ -227,6 +227,10 @@ class _Prefetcher:
                 except StopIteration:
                     item = None
                 except Exception as e:  # noqa: BLE001 — forward to consumer
+                    from .. import profiler as _profiler
+                    if _profiler._ACTIVE:
+                        _profiler.account("io.prefetch_worker_deaths", 1,
+                                          lane="io", emit=False)
                     item = e
                 # bounded put that keeps observing the stop flag, so
                 # stop() never deadlocks against a full queue

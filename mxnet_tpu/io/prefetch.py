@@ -17,6 +17,7 @@ import threading
 import time as _time
 
 from .. import profiler as _profiler
+from .._debug import faultpoint as _faultpoint
 
 __all__ = ["DevicePrefetchIter", "DevicePrefetcher"]
 
@@ -66,6 +67,11 @@ class DevicePrefetchIter:
     def _start(self):
         self._q = queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
+        # restart-or-die bookkeeping: once the worker dies on an
+        # exception the consumer sees it EXACTLY ONCE; afterwards the
+        # iterator is exhausted (StopIteration, like any finished
+        # iterator) until reset() launches a fresh worker
+        self._worker_failed = False
         q, stop = self._q, self._stop
 
         def put(item):
@@ -84,6 +90,8 @@ class DevicePrefetchIter:
                 for batch in self._it:
                     t0 = _time.perf_counter() if _profiler._ACTIVE \
                         else None
+                    if _faultpoint.ACTIVE:
+                        _faultpoint.check("io.prefetch.place")
                     placed = self._place(batch)
                     if t0 is not None:
                         _profiler.record_op(
@@ -98,6 +106,12 @@ class DevicePrefetchIter:
                             "io.prefetch_queue_depth", q.qsize(),
                             lane="io")
             except BaseException as e:  # noqa: BLE001 — propagate to consumer
+                # a worker death is a counted event, not just a raised
+                # exception: io.prefetch_worker_deaths is the restart
+                # diagnostic (how often did reset() have to recover?)
+                if _profiler._ACTIVE:
+                    _profiler.account("io.prefetch_worker_deaths", 1,
+                                      lane="io", emit=False)
                 put(e)
                 return
             put(_SENTINEL)
@@ -108,9 +122,12 @@ class DevicePrefetchIter:
 
     def reset(self):
         """Cancel the in-flight producer and restart the underlying
-        iterator. Requires a restartable source (one with ``reset()``,
-        or a re-iterable like a DataLoader); a plain generator cannot be
-        rewound — batches consumed before reset are lost."""
+        iterator — including after a worker death: the exception was
+        raised once, the iterator then reads exhausted, and reset()
+        starts a FRESH worker (restart-or-die recovery). Requires a
+        restartable source (one with ``reset()``, or a re-iterable like
+        a DataLoader); a plain generator cannot be rewound — batches
+        consumed before reset are lost."""
         self._stop.set()
         while self._thread.is_alive():
             try:  # unblock a worker stuck on a full queue
@@ -126,6 +143,12 @@ class DevicePrefetchIter:
         return self
 
     def __next__(self):
+        # a dead worker queued its exception ONCE (already raised): the
+        # iterator is exhausted now — StopIteration, not a block-forever
+        # q.get() and not the same exception replayed, so `for` loops
+        # terminate and reset() is the documented way back
+        if self._worker_failed:
+            raise StopIteration
         # batch-fetch span: how long the consumer stalled waiting on the
         # producer (queue-empty time = the pipeline is io-bound)
         t0 = _time.perf_counter() if _profiler._ACTIVE else None
@@ -140,6 +163,7 @@ class DevicePrefetchIter:
         if item is _SENTINEL:
             raise StopIteration
         if isinstance(item, BaseException):
+            self._worker_failed = True
             raise item
         return item
 

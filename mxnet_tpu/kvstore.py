@@ -288,7 +288,8 @@ class KVStore:
     # -- optimizer-state checkpointing ------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "updater is not initialized"
-        with open(fname, "wb") as f:
+        from .base import atomic_write
+        with atomic_write(fname) as f:
             f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):

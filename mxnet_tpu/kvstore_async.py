@@ -44,7 +44,9 @@ import warnings
 
 import numpy as np
 
+from . import _retry
 from . import profiler as _profiler
+from ._debug import faultpoint as _faultpoint
 from ._debug import locktrace as _locktrace
 
 __all__ = ["AsyncPSServer", "AsyncPSClient", "serve_if_rank0"]
@@ -209,6 +211,9 @@ class AsyncPSServer:
             try:
                 self._handle(conn, buf)
             except Exception as e:  # noqa: BLE001 — reply, don't die
+                if _profiler._ACTIVE:
+                    _profiler.account("kvstore.server_errors", 1,
+                                      emit=False)
                 msg = ("%s: %s" % (type(e).__name__, e)).encode()[:4096]
                 try:
                     _send_frame(conn, struct.pack(">BH", _RE_ERR, len(msg))
@@ -380,10 +385,23 @@ class AsyncPSServer:
                     released = self._barrier_gen != gen
                     if not released:
                         self._barrier_count -= 1  # withdraw arrival
+                        # name the missing: the heartbeat table (same
+                        # lock as the cv) knows who stopped beating, so
+                        # the abort tells operators WHO is dead, not
+                        # just how many arrivals were short
+                        stale = float(os.environ.get(
+                            "MXTPU_PS_DEAD_TIMEOUT", "3.0"))
+                        now = _t.monotonic()
+                        dead = sorted(
+                            r for r, t in self._heartbeats.items()
+                            if now - t > stale)
             if not released:
                 raise RuntimeError(
                     "barrier aborted (server stopping or %.0fs timeout "
-                    "waiting for %d workers)" % (timeout, n))
+                    "waiting for %d workers); dead ranks (heartbeat "
+                    "stale > %.0fs): %s" % (
+                        timeout, n, stale,
+                        dead if dead else "none known"))
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_HEARTBEAT:
             (rank,) = struct.unpack_from(">q", buf, off)
@@ -483,22 +501,43 @@ class AsyncPSClient:
         self.bytes_pushed = 0  # wire accounting (sparse/compressed tests)
         self._hb_stop = None
 
+    def _connect_once(self):
+        """One connect attempt (the kvstore.connect fault seam); no
+        retry of its own — the caller owns the backoff budget, so retry
+        loops never nest (a nested budget would multiply the documented
+        MXTPU_PS_RETRY_DEADLINE)."""
+        if _faultpoint.ACTIVE:
+            _faultpoint.check("kvstore.connect")
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.connect(self._addr)
+        except BaseException:
+            sock.close()  # no half-open socket per failed attempt
+            raise
+        self._sock = sock
+
     def _ensure_connected(self):
+        """First-connect rendezvous with the unified backoff policy. The
+        attempt budget stays the constructor's ``retries`` (the
+        rendezvous with a server that has not bound yet must outlast the
+        exponential ramp); base/cap/deadline come from the
+        MXTPU_PS_RETRY_* knobs. Reconnects after a broken socket do NOT
+        come through here — _call's own retry loop calls _connect_once,
+        so the transport deadline is one budget, not a product of two."""
         if self._sock is not None:
             return
-        import time
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        for attempt in range(self._retries):
-            try:
-                sock.connect(self._addr)
-                break
-            except (ConnectionRefusedError, OSError):
-                if attempt == self._retries - 1:
-                    raise
-                if _profiler._ACTIVE:
-                    _profiler.account("kvstore.connect_retries", 1)
-                time.sleep(0.1)  # server still coming up on its rank
-        self._sock = sock
+
+        def on_retry(n, exc, delay):
+            # connect retries counted apart from mid-stream transport
+            # retries and heartbeat failures: three different diagnoses
+            if _profiler._ACTIVE:
+                _profiler.account("kvstore.connect_retries", 1)
+
+        _retry.call(
+            self._connect_once, retryable=(ConnectionError, OSError),
+            policy=_retry.RetryPolicy(max_retries=self._retries),
+            on_retry=on_retry)
+
 
     def start_heartbeat(self, rank, interval=0.5):
         """Background liveness beats (ref: ps-lite heartbeats feeding
@@ -542,13 +581,66 @@ class AsyncPSClient:
             self._hb_thread.join(timeout=5)
             self._hb_stop = None
 
-    def _call(self, payload):
+    def _call(self, payload, idempotent=True, point="kvstore.send"):
+        """One request/response round trip, hardened: a broken socket
+        (server restart, dropped connection, injected ``kvstore.send``/
+        ``kvstore.pull`` fault) is retried with reconnect + exponential
+        backoff under the MXTPU_PS_RETRY_* policy — but only for
+        ``idempotent`` requests. init/pull/stats/shape are pure reads or
+        idempotent writes; a resent push can at worst double-apply one
+        gradient, which async-PS staleness semantics already tolerate
+        (kvstore_dist_server.h:358 applies pushes immediately with no
+        ordering contract). barrier/done/heartbeat/stop pass
+        ``idempotent=False``: re-sending those changes protocol state
+        (a double done() inflates the shutdown count; a re-sent barrier
+        arrival could release a rendezvous that never happened).
+
+        Budget shape: the patient first-connect rendezvous happens ONCE
+        up front; each retry attempt then reconnects with a single
+        _connect_once, so the whole operation is bounded by one
+        MXTPU_PS_RETRY_DEADLINE, and every backoff sleep runs OUTSIDE
+        self._lock (a reconnecting client must not starve its own
+        heartbeat thread off the shared lock)."""
         with self._lock:
             self._ensure_connected()
-            _send_frame(self._sock, payload)
-            resp = _recv_frame(self._sock)
-        if resp is None:
-            raise ConnectionError("async PS server closed the connection")
+
+        def attempt():
+            with self._lock:
+                if self._sock is None:
+                    self._connect_once()  # reconnect: caller's budget
+                if _faultpoint.ACTIVE:
+                    _faultpoint.check(point)
+                try:
+                    _send_frame(self._sock, payload)
+                    resp = _recv_frame(self._sock)
+                except (ConnectionError, OSError):
+                    # mid-stream break: this socket is done either way
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    raise
+                if resp is None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    raise ConnectionError(
+                        "async PS server closed the connection")
+                return resp
+
+        if idempotent:
+            def on_retry(n, exc, delay):
+                if _profiler._ACTIVE:
+                    _profiler.account("kvstore.transport_retries", 1,
+                                      emit=False)
+            resp = _retry.call(attempt,
+                               retryable=(ConnectionError, OSError),
+                               on_retry=on_retry)
+        else:
+            resp = attempt()
         code = resp[0]
         if code == _RE_OK:
             return None
@@ -589,11 +681,13 @@ class AsyncPSClient:
         self._call(payload)
 
     def pull(self, key):
-        return self._call(bytes([_OP_PULL]) + _pack_key(key))
+        return self._call(bytes([_OP_PULL]) + _pack_key(key),
+                          point="kvstore.pull")
 
     def pull_row_sparse(self, key, row_ids):
         return self._call(bytes([_OP_PULL_RSP]) + _pack_key(key)
-                          + _pack_arr(np.asarray(row_ids, np.int64)))
+                          + _pack_arr(np.asarray(row_ids, np.int64)),
+                          point="kvstore.pull")
 
     def shape_of(self, key):
         """Dense shape of a stored key WITHOUT transferring the value
@@ -609,7 +703,10 @@ class AsyncPSClient:
         barrier-parked worker must not look dead."""
         tmp = AsyncPSClient(*self._addr)
         try:
-            tmp._call(struct.pack(">Bq", _OP_BARRIER, int(num_workers)))
+            # non-idempotent: a resent arrival after a lost response
+            # could release a rendezvous that never fully assembled
+            tmp._call(struct.pack(">Bq", _OP_BARRIER, int(num_workers)),
+                      idempotent=False)
         finally:
             try:
                 tmp._sock.close()
@@ -617,7 +714,12 @@ class AsyncPSClient:
                 pass
 
     def heartbeat(self, rank):
-        self._call(struct.pack(">Bq", _OP_HEARTBEAT, int(rank)))
+        # fail-fast (no transport retry): the beat loop re-beats every
+        # interval anyway, and its failures are counted DISTINCTLY
+        # (kvstore.heartbeat_failures) so a flaky link shows up as such
+        # instead of inflating the transport-retry counter
+        self._call(struct.pack(">Bq", _OP_HEARTBEAT, int(rank)),
+                   idempotent=False)
 
     def dead_nodes(self, timeout=3.0):
         arr = self._call(struct.pack(">Bd", _OP_DEADNODES,
@@ -647,7 +749,9 @@ class AsyncPSClient:
         payload = bytes([_OP_DONE])
         if rank is not None:
             payload += struct.pack(">q", int(rank))
-        self._call(payload)
+        # non-idempotent: the server COUNTS done() signals, so a resend
+        # after a lost response would double-count this worker
+        self._call(payload, idempotent=False)
 
     def wait_done(self, n, timeout=None):
         """Wait until `n` workers called done(); returns True if they
@@ -666,7 +770,7 @@ class AsyncPSClient:
 
     def stop_server(self):
         try:
-            self._call(bytes([_OP_STOP]))
+            self._call(bytes([_OP_STOP]), idempotent=False)
         except (ConnectionError, OSError):
             pass
 
@@ -853,6 +957,7 @@ class AsyncKVStore:
         def run(i, j):
             try:
                 results[i] = fn(j)
+            # mxlint: disable=MX009 (collected across shard threads; the first error re-raises from the caller after join)
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
 
@@ -949,7 +1054,8 @@ class AsyncKVStore:
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         import pickle as _p
-        with open(fname, "wb") as f:
+        from .base import atomic_write
+        with atomic_write(fname) as f:
             _p.dump(self._optimizer if dump_optimizer else None, f)
 
     def load_optimizer_states(self, fname):
@@ -1070,7 +1176,16 @@ def serve_group(rank, port_env="MXTPU_ASYNC_PS_PORT"):
     if coord and ":" in coord:
         host, cport = coord.rsplit(":", 1)
         host = host or "127.0.0.1"
-        base = int(os.environ.get(port_env, 0)) or (int(cport) + 1001)
+        derived = int(cport) + 1001
+        if derived + num_servers > 65536:
+            # the launcher's coordinator port is ephemeral and this
+            # host's range can run to 65535, so +1001+s can overflow the
+            # port space (OverflowError at bind/connect). Wrap the whole
+            # derived window back into valid space — deterministically,
+            # from the same coordinator port every rank sees, so the
+            # group still agrees on the endpoints without talking.
+            derived -= 50000
+        base = int(os.environ.get(port_env, 0)) or derived
     else:
         host, base = "127.0.0.1", int(os.environ.get(port_env, 0))
     if rank == 0 and "MXTPU_PS_SECRET" not in os.environ:

@@ -13,8 +13,11 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
+from ..base import atomic_write as _atomic_write
 from ..base import canonical_dtype
 from ..context import current_context, Context
+from .._debug import faultpoint as _faultpoint
+from .. import profiler as _profiler
 from .ndarray import NDArray, array, concatenate
 from . import register as _register_mod
 
@@ -26,10 +29,20 @@ __all__ = ["NDArray", "array", "concatenate", "zeros", "ones", "full",
 # -- creation ---------------------------------------------------------------
 
 def _ctx_place(data, ctx):
+    """Creation-factory device placement with a host-backed degradation
+    path: a failed device_put (unknown ctx, backend OOM, or an injected
+    ``storage.alloc`` fault) yields a host-resident NDArray with the
+    same values instead of crashing — counted so the degradation is
+    visible (``storage.alloc_fallbacks``)."""
     ctx = ctx or current_context()
     try:
+        if _faultpoint.ACTIVE:
+            _faultpoint.check("storage.alloc")
         return NDArray(jax.device_put(data, ctx.jax_device()), ctx=ctx)
     except Exception:
+        if _profiler._ACTIVE:
+            _profiler.account("storage.alloc_fallbacks", 1, lane="memory",
+                              emit=False)
         return NDArray(data, ctx=ctx)
 
 
@@ -161,7 +174,12 @@ def _read_one(f):
 
 def save(fname, data):
     """Save NDArrays in the reference's .params binary format
-    (ref: python/mxnet/ndarray/utils.py save → MXNDArraySave)."""
+    (ref: python/mxnet/ndarray/utils.py save → MXNDArraySave).
+
+    Crash-consistent: written to a temp sibling and atomically renamed
+    (base.atomic_write), so an interrupted save — process kill, full
+    disk, injected ``checkpoint.save`` fault — never corrupts an
+    existing checkpoint at ``fname``."""
     if isinstance(data, NDArray):
         arrays, names = [data], []
     elif isinstance(data, (list, tuple)):
@@ -173,7 +191,7 @@ def save(fname, data):
         arrays = [data[k] for k in names]
     else:
         raise TypeError("unsupported save payload %r" % type(data))
-    with open(fname, "wb") as f:
+    with _atomic_write(fname) as f:
         f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
         f.write(struct.pack("<Q", len(arrays)))
         for a in arrays:

@@ -46,6 +46,7 @@ from .. import autograd
 from .. import engine as _engine
 from .. import profiler as _profiler
 from .. import random as _random
+from .._debug import faultpoint as _faultpoint
 from .._debug import locktrace as _locktrace
 from ..ops import registry as _registry
 from .ndarray import NDArray, _PendingSlot
@@ -138,6 +139,8 @@ _STATS = {
     "fallbacks": 0,     # fast path enabled but call took the untraced path
     "bulk_flushes": 0,  # bulk segments executed as one program
     "bulk_ops": 0,      # ops that executed inside a bulk segment
+    "bulk_fallbacks": 0,  # segment runners that raised and replayed
+                          # eagerly (the 'eager-fallback' flush mode)
 }
 
 
@@ -315,6 +318,11 @@ def _cached_callable(opdef, key, partial_key, args, kwargs, arg_slots,
         # the first call of this jitted fn traces + compiles (seconds):
         # a framework lock held here starves every other thread
         _locktrace.boundary("imperative.jit_compile")
+    if _faultpoint.ACTIVE:
+        # compile-site fault seam: a raise here is caught by invoke(),
+        # which marks the key permanently failed and dispatches eagerly
+        # — the same degradation a real jax.jit construction error takes
+        _faultpoint.check("imperative.jit.compile")
     fn = jax.jit(traced, donate_argnums=donate) if donate \
         else jax.jit(traced)
     _DISPATCH_CACHE[key] = fn
@@ -395,8 +403,22 @@ def invoke(opdef, args, kwargs):
                                          kw_slots, datas, key_val, take_key,
                                          recording)
         if key is not None and key not in _FAILED_KEYS:
-            jfn = _cached_callable(opdef, key, partial_key, args, kwargs,
-                                   arg_slots, kw_slots, take_key, recording)
+            try:
+                jfn = _cached_callable(opdef, key, partial_key, args,
+                                       kwargs, arg_slots, kw_slots,
+                                       take_key, recording)
+            except Exception:
+                # jax.jit construction failed (bad donation spec, or an
+                # injected imperative.jit.compile fault): permanent
+                # eager fallback for this key — never a crash. Before
+                # this guard a constructor error propagated to the user
+                # even though the eager path was perfectly able to run.
+                if len(_FAILED_KEYS) >= _CACHE_CAP:
+                    _FAILED_KEYS.clear()
+                _FAILED_KEYS.add(key)
+                _DISPATCH_CACHE.pop(key, None)
+                _STATS["fallbacks"] += 1
+                jfn = None
         else:
             _STATS["fallbacks"] += 1
     elif _JIT_ENABLED and opdef.nojit:
@@ -780,6 +802,12 @@ class _BulkSegment:
             mode = "compile"
 
         try:
+            if _faultpoint.ACTIVE and mode == "compile":
+                # compile-site fault seam: drives the eager-fallback
+                # replay below, exactly like a real trace failure (the
+                # runner stays cached — a later flush of the same
+                # signature replays it, mirroring a transient failure)
+                _faultpoint.check("engine.bulk.compile")
             results = runner(leaves)
         except Exception:
             # a queued op turned out to be unjittable: replay the segment
@@ -787,6 +815,7 @@ class _BulkSegment:
             # untraced path, and stop bulking the offending ops
             self._replay_eager(ops, leaves, outs, blacklist=True)
             _STATS["bulk_flushes"] += 1
+            _STATS["bulk_fallbacks"] += 1
             return "eager-fallback"
         _STATS["bulk_flushes"] += 1
         for arr, slot, i, k in outs:

@@ -81,23 +81,47 @@ class CheckpointManager:
 
     # -- save/restore -------------------------------------------------------
     def save(self, step, state):
-        """Write `state` (pytree of arrays) for `step`; prunes old ones."""
+        """Write `state` (pytree of arrays) for `step`; prunes old ones.
+
+        Crash-consistent both ways: the full state lands on a `.tmp`
+        sibling first and is atomically renamed into place, with the
+        `checkpoint.save` fault point firing between write and rename —
+        an injected (or real) crash mid-save leaves every previously
+        published step restorable and at worst a `.tmp` leftover, which
+        `all_steps()` never considers a restore candidate."""
+        from .._debug import faultpoint as _faultpoint
         path = self._step_path(step)
         tmp = path + ".tmp"
-        if self._orbax:
-            # orbax refuses to overwrite; write then atomic-rename
-            import shutil
-            if os.path.exists(tmp):
-                shutil.rmtree(tmp)
-            self._ckptr.save(tmp, jax.tree_util.tree_map(np.asarray,
-                                                         state))
-            if os.path.exists(path):
-                shutil.rmtree(path)
-            os.replace(tmp, path)
-        else:
-            with open(tmp, "wb") as f:
-                pickle.dump(jax.tree_util.tree_map(np.asarray, state), f)
-            os.replace(tmp, path)
+        try:
+            if self._orbax:
+                # orbax refuses to overwrite; write then atomic-rename
+                import shutil
+                if os.path.exists(tmp):
+                    shutil.rmtree(tmp)
+                self._ckptr.save(tmp, jax.tree_util.tree_map(np.asarray,
+                                                             state))
+                if _faultpoint.ACTIVE:
+                    _faultpoint.check("checkpoint.save")
+                if os.path.exists(path):
+                    shutil.rmtree(path)
+                os.replace(tmp, path)
+            else:
+                with open(tmp, "wb") as f:
+                    pickle.dump(jax.tree_util.tree_map(np.asarray, state),
+                                f)
+                if _faultpoint.ACTIVE:
+                    _faultpoint.check("checkpoint.save")
+                os.replace(tmp, path)
+        except BaseException:
+            try:
+                if os.path.isdir(tmp):
+                    import shutil
+                    shutil.rmtree(tmp)
+                else:
+                    os.remove(tmp)
+            except OSError:
+                pass
+            raise
         self._prune()
         return path
 

@@ -744,6 +744,17 @@ class Marker:
             _emit(self.name, "i", "marker", args={"scope": scope})
 
 
+# Fault-injection trigger counters (mxnet_tpu._debug.faultpoint): the
+# chaos-testing accounting surface — every injected fault must be
+# visible in metrics()['faults'] (tests/test_faultpoints.py asserts it).
+# Registered here (not in faultpoint) because faultpoint loads as part
+# of the _debug package import above, before this module finishes.
+from ._debug import faultpoint as _faultpoint  # noqa: E402
+
+register_stats_provider("faults", _faultpoint.metrics,
+                        _faultpoint.reset_counters)
+
+
 # deprecated aliases kept for parity (ref: profiler.py:70,109,143)
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     set_config(filename=filename)

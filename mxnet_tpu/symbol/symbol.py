@@ -315,7 +315,10 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        # atomic publication: a crash mid-write must not leave a
+        # truncated -symbol.json next to a valid .params file
+        from ..base import atomic_write
+        with atomic_write(fname, "w") as f:
             f.write(self.tojson())
 
     # -- evaluation / binding ----------------------------------------------

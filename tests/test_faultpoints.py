@@ -1,0 +1,611 @@
+"""Faultpoint chaos suite (ISSUE 5): the framework's "never a crash"
+degradation paths exercised under real, injected failure.
+
+Three invariants, asserted throughout:
+
+* **no hang** — every faulted operation either succeeds (retry/fallback)
+  or raises within its bounded retry budget; nothing blocks forever,
+* **no silent corruption** — wherever a retry or fallback succeeds, the
+  results are BITWISE equal to the fault-free reference that runs the
+  same code path (eager vs eager, transport-retried vs clean wire),
+* **full accounting** — every injected fault is visible in
+  ``profiler.metrics()['faults']`` and the matching retry/fallback
+  counter ticks (``kvstore.transport_retries``, ``kvstore.connect_retries``,
+  ``io.prefetch_worker_deaths``, imperative ``fallbacks``/``bulk_fallbacks``,
+  ``fused_step.fallbacks``).
+
+Schedules are seeded (``MXNET_FAULTPOINTS_SEED``): every chaos run here
+is deterministic and replayable.
+"""
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon, profiler
+from mxnet_tpu._debug import faultpoint as fp
+from mxnet_tpu.io import DevicePrefetchIter
+from mxnet_tpu.kvstore_async import AsyncPSClient, AsyncPSServer
+from mxnet_tpu.ndarray import register as R
+
+
+@pytest.fixture(autouse=True)
+def _clean_faultpoints(monkeypatch):
+    # fast retries for every test: chaos must not make the suite slow
+    monkeypatch.setenv("MXTPU_PS_RETRY_BASE", "0.01")
+    monkeypatch.setenv("MXTPU_PS_RETRY_CAP", "0.05")
+    fp.reset()
+    yield
+    fp.reset()
+    profiler._reset()
+
+
+@pytest.fixture()
+def server():
+    srv = AsyncPSServer()
+    yield srv
+    srv.stop()
+
+
+# -- spec grammar / determinism ----------------------------------------------
+
+class TestSpec:
+    def test_env_grammar_roundtrip(self):
+        pts = fp.configure(
+            "kvstore.send=raise:ConnectionError@p=0.3;"
+            "io.prefetch.place=delay:50ms@n=3", seed=1)
+        assert pts == ["io.prefetch.place", "kvstore.send"]
+        rep = fp.report()
+        assert rep["active"]
+        assert rep["points"]["kvstore.send"] == "raise:ConnectionError@p=0.3"
+
+    def test_dict_form_and_reset(self):
+        fp.configure({"fused_step.trace": "raise:RuntimeError@n=1"})
+        assert fp.is_active()
+        fp.reset()
+        assert not fp.is_active()
+        assert fp.metrics() == {}
+
+    @pytest.mark.parametrize("bad", [
+        "nosuchpoint=raise:ValueError",       # unknown point
+        "kvstore.send=explode",               # unknown action
+        "kvstore.send=raise:open",            # not an Exception subclass
+        "kvstore.send=raise:ValueError@p=7",  # p out of range
+        "kvstore.send=raise:ValueError@z=1",  # unknown modifier
+        "kvstore.send",                       # missing '='
+    ])
+    def test_bad_specs_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            fp.configure(bad)
+
+    def test_delay_units(self):
+        fp.configure({"io.prefetch.place": "delay:1ms"})
+        t0 = time.perf_counter()
+        fp.check("io.prefetch.place")  # sleeps, does not raise
+        assert time.perf_counter() - t0 < 1.0
+        assert fp.triggers("io.prefetch.place") == 1
+
+    def _pattern(self, seed, hits=40):
+        fp.configure({"kvstore.send": "raise:ConnectionError@p=0.5"},
+                     seed=seed)
+        out = []
+        for _ in range(hits):
+            try:
+                fp.check("kvstore.send")
+                out.append(0)
+            except ConnectionError:
+                out.append(1)
+        fp.reset()
+        return out
+
+    def test_seeded_schedule_is_replayable(self):
+        a = self._pattern(seed=42)
+        b = self._pattern(seed=42)
+        c = self._pattern(seed=43)
+        assert a == b                 # same seed -> identical schedule
+        assert 0 < sum(a) < len(a)    # p=0.5 actually mixes
+        assert a != c                 # and the seed actually matters
+
+    def test_skip_and_n_modifiers(self):
+        fp.configure({"kvstore.send": "raise:OSError@skip=2@n=1"})
+        fp.check("kvstore.send")      # skipped
+        fp.check("kvstore.send")      # skipped
+        with pytest.raises(OSError):
+            fp.check("kvstore.send")  # armed, fires
+        fp.check("kvstore.send")      # n exhausted: quiet again
+        assert fp.triggers("kvstore.send") == 1
+
+    def test_faults_surface_in_profiler_metrics(self):
+        fp.configure({"checkpoint.save": "raise:RuntimeError@n=1"})
+        with pytest.raises(RuntimeError):
+            fp.check("checkpoint.save")
+        # counted with NO active profile run: accounting must not
+        # depend on tracing being on
+        assert profiler.metrics()["faults"] == {"checkpoint.save": 1}
+
+
+# -- kvstore transport chaos --------------------------------------------------
+
+class TestKVStoreChaos:
+    def test_push_pull_survive_send_faults_bitwise(self, server):
+        """Flaky transport, hardened client: every push/pull lands, the
+        final value is bitwise what a clean wire produces, and both the
+        faults and the retries are accounted."""
+        profiler.set_config(filename="/tmp/fp_kv_profile.json",
+                            xprof=False)
+        profiler.set_state("run")
+        try:
+            fp.configure({"kvstore.send": "raise:ConnectionError@p=0.4",
+                          "kvstore.pull": "raise:ConnectionError@p=0.4"},
+                         seed=3)
+            c = AsyncPSClient("127.0.0.1", server.port)
+            c.init(1, np.zeros((8,), np.float32))
+            for i in range(12):
+                c.push(1, np.full((8,), float(i), np.float32))
+            out = c.pull(1)
+            # store-replace semantics: last push wins, bit-for-bit
+            np.testing.assert_array_equal(
+                out, np.full((8,), 11.0, np.float32))
+            m = profiler.metrics()
+            assert m["faults"].get("kvstore.send", 0) > 0
+            total_faults = (m["faults"].get("kvstore.send", 0)
+                            + m["faults"].get("kvstore.pull", 0))
+            # full accounting: one transport retry per injected fault
+            assert m["counters"]["kvstore.transport_retries"] \
+                == total_faults
+        finally:
+            profiler.set_state("stop")
+
+    def test_connect_faults_retry_then_succeed(self, server):
+        profiler.set_config(filename="/tmp/fp_kv_profile.json",
+                            xprof=False)
+        profiler.set_state("run")
+        try:
+            fp.configure({"kvstore.connect": "raise:ConnectionError@n=2"})
+            c = AsyncPSClient("127.0.0.1", server.port)
+            c.init(2, np.ones((4,), np.float32))  # first use connects
+            np.testing.assert_array_equal(
+                c.pull(2), np.ones((4,), np.float32))
+            m = profiler.metrics()
+            assert fp.triggers("kvstore.connect") == 2
+            assert m["counters"]["kvstore.connect_retries"] == 2
+        finally:
+            profiler.set_state("stop")
+
+    def test_retry_budget_bounds_wall_time(self, server, monkeypatch):
+        """A permanently broken transport raises within the bounded
+        retry budget instead of hanging (the no-hang invariant)."""
+        monkeypatch.setenv("MXTPU_PS_RETRY_MAX", "3")
+        fp.configure({"kvstore.send": "raise:ConnectionError"})  # p=1
+        c = AsyncPSClient("127.0.0.1", server.port)
+        t0 = time.perf_counter()
+        with pytest.raises(ConnectionError):
+            c.push(3, np.zeros((2,), np.float32))
+        assert time.perf_counter() - t0 < 5.0
+        assert fp.triggers("kvstore.send") == 4  # 1 try + 3 retries
+
+    def test_non_idempotent_ops_do_not_resend(self, server):
+        """done() mutates server state (the shutdown count): a transport
+        fault there must fail fast, never auto-resend."""
+        fp.configure({"kvstore.send": "raise:ConnectionError"})
+        c = AsyncPSClient("127.0.0.1", server.port)
+        with pytest.raises(ConnectionError):
+            c.done(0)
+        assert fp.triggers("kvstore.send") == 1  # exactly one attempt
+
+    def test_barrier_timeout_names_dead_ranks(self, server, monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_BARRIER_TIMEOUT", "1")
+        monkeypatch.setenv("MXTPU_PS_DEAD_TIMEOUT", "0.3")
+        beater = AsyncPSClient("127.0.0.1", server.port)
+        beater.start_heartbeat(7, interval=0.1)
+        time.sleep(0.3)
+        beater.stop_heartbeat()       # rank 7 "dies"
+        time.sleep(0.6)               # let the beat go stale
+        a = AsyncPSClient("127.0.0.1", server.port)
+        with pytest.raises(RuntimeError) as ei:
+            a.barrier(2)              # partner never arrives
+        msg = str(ei.value)
+        assert "barrier aborted" in msg
+        assert "dead ranks" in msg and "7" in msg, msg
+
+
+# -- prefetch chaos -----------------------------------------------------------
+
+class _Range:
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.full((2,), i, dtype=np.float32)
+
+    def reset(self):
+        pass
+
+
+class TestPrefetchChaos:
+    def test_worker_death_raises_once_then_stops_then_resets(self):
+        profiler.set_config(filename="/tmp/fp_io_profile.json",
+                            xprof=False)
+        profiler.set_state("run")
+        try:
+            fp.configure({"io.prefetch.place": "raise:OSError@n=1"})
+            pf = DevicePrefetchIter(_Range(4))
+            with pytest.raises(OSError):      # surfaced exactly once...
+                next(pf)
+            with pytest.raises(StopIteration):  # ...then exhausted, not
+                next(pf)                        # replayed forever
+            with pytest.raises(StopIteration):
+                next(pf)
+            pf.reset()                        # restart-or-die: restart
+            got = [int(np.asarray(b)[0]) for b in pf]
+            assert got == [0, 1, 2, 3]        # fault-free parity
+            m = profiler.metrics()
+            assert m["faults"] == {"io.prefetch.place": 1}
+            assert m["counters"]["io.prefetch_worker_deaths"] == 1
+        finally:
+            profiler.set_state("stop")
+
+    def test_delay_faults_do_not_corrupt_order(self):
+        fp.configure({"io.prefetch.place": "delay:5ms@p=0.5"}, seed=11)
+        pf = DevicePrefetchIter(_Range(8))
+        got = [int(np.asarray(b)[0]) for b in pf]
+        assert got == list(range(8))          # slowdown is not reorder
+        assert fp.triggers("io.prefetch.place") > 0
+
+
+# -- compile/trace fallback chaos ---------------------------------------------
+
+class TestCompileFallbackChaos:
+    def _chain(self, x):
+        y = x * 2.0
+        z = y + 1.0
+        return (z * z).asnumpy()
+
+    def test_jit_compile_faults_fall_back_bitwise(self):
+        """Every dispatch-cache compile fails (p=1): ops run untraced,
+        results bitwise-match the jit-disabled eager truth, fallbacks
+        tick, never a crash."""
+        x = mx.nd.array(np.arange(6, dtype=np.float32))
+        prev = R.set_imperative_jit(False)
+        try:
+            want = self._chain(x)             # the untraced truth
+        finally:
+            R.set_imperative_jit(prev)
+        fp.configure({"imperative.jit.compile": "raise:RuntimeError"})
+        R.reset_dispatch_stats()
+        for _ in range(4):                    # past the compile threshold
+            got = self._chain(x)
+        np.testing.assert_array_equal(got, want)
+        st = R.dispatch_stats()
+        assert st["fallbacks"] > 0
+        assert fp.triggers("imperative.jit.compile") > 0
+        assert profiler.metrics()["faults"]["imperative.jit.compile"] \
+            == fp.triggers("imperative.jit.compile")
+
+    def test_bulk_compile_faults_replay_eagerly_bitwise(self):
+        x = mx.nd.array(np.arange(5, dtype=np.float32))
+        prev = R.set_imperative_jit(False)
+        try:
+            with engine.bulk(8):
+                want = ((x + 3.0) * (x - 1.0)).asnumpy()
+        finally:
+            R.set_imperative_jit(prev)
+        fp.configure({"engine.bulk.compile": "raise:RuntimeError"})
+        R.reset_dispatch_stats()
+        for _ in range(3):
+            with engine.bulk(8):
+                got = ((x + 3.0) * (x - 1.0)).asnumpy()
+        np.testing.assert_array_equal(got, want)
+        st = R.dispatch_stats()
+        assert st["bulk_fallbacks"] >= 1
+        assert fp.triggers("engine.bulk.compile") >= 1
+
+    def test_fused_step_trace_faults_fall_back_bitwise(self):
+        """fused_step.trace faults: every step takes the eager fallback
+        and the whole run is bitwise identical to a pure-eager run of
+        the same net (the fallback IS the eager path)."""
+        def make(seed_from=None):
+            net = gluon.nn.HybridSequential()
+            with net.name_scope():
+                net.add(gluon.nn.Dense(8, in_units=4, activation="relu"))
+                net.add(gluon.nn.Dense(1, in_units=8))
+            net.initialize(mx.init.Uniform(0.1))
+            net.hybridize()
+            if seed_from is not None:
+                for (_, p1), (_, p2) in zip(
+                        sorted(seed_from.collect_params().items()),
+                        sorted(net.collect_params().items())):
+                    p2.set_data(p1.data())
+            return net
+
+        rs = np.random.RandomState(0)
+        x = mx.nd.array(rs.rand(4, 4).astype("float32"))
+        y = mx.nd.array(rs.rand(4, 1).astype("float32"))
+        loss_fn = gluon.loss.L2Loss()
+
+        net_a = make()
+        net_b = make(seed_from=net_a)
+
+        # reference: the plain eager record/backward/step loop
+        tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                             {"learning_rate": 0.1})
+        for _ in range(4):
+            with autograd.record():
+                loss_b = loss_fn(net_b(x), y)
+            loss_b.backward()
+            tr_b.step(4)
+
+        # faulted: every trace attempt raises -> per-step eager fallback
+        from mxnet_tpu.gluon import fused_step as FS
+        fp.configure({"fused_step.trace": "raise:RuntimeError"})
+        FS.reset_stats()
+        tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                             {"learning_rate": 0.1})
+        step = tr_a.fuse_step(lambda xx, yy: loss_fn(net_a(xx), yy))
+        for _ in range(4):
+            loss_a = step(x, y, batch_size=4)
+        assert step.last_mode == "fallback:trace-failed"
+        assert FS.stats()["fallbacks"] > 0
+        assert fp.triggers("fused_step.trace") > 0
+        np.testing.assert_array_equal(loss_a.asnumpy(), loss_b.asnumpy())
+        for (_, pa), (_, pb) in zip(
+                sorted(net_a.collect_params().items()),
+                sorted(net_b.collect_params().items())):
+            np.testing.assert_array_equal(pa.data().asnumpy(),
+                                          pb.data().asnumpy())
+
+    def test_storage_alloc_faults_degrade_to_host(self):
+        fp.configure({"storage.alloc": "raise:MemoryError@n=3"})
+        a = mx.nd.zeros((4,))
+        b = mx.nd.ones((4,))
+        np.testing.assert_array_equal(a.asnumpy(), np.zeros((4,), "f"))
+        np.testing.assert_array_equal(b.asnumpy(), np.ones((4,), "f"))
+        assert fp.triggers("storage.alloc") >= 2
+
+
+# -- crash-consistent checkpoints ---------------------------------------------
+
+class TestCheckpointChaos:
+    def test_nd_save_crash_never_corrupts_latest(self, tmp_path):
+        fname = str(tmp_path / "weights.params")
+        good = {"w": mx.nd.array(np.arange(4, dtype=np.float32))}
+        mx.nd.save(fname, good)
+        fp.configure({"checkpoint.save": "raise:RuntimeError@n=1"})
+        with pytest.raises(RuntimeError):
+            mx.nd.save(fname, {"w": mx.nd.zeros((4,))})  # crash mid-save
+        # the published file is the intact PREVIOUS checkpoint...
+        loaded = mx.nd.load(fname)
+        np.testing.assert_array_equal(loaded["w"].asnumpy(),
+                                      np.arange(4, dtype=np.float32))
+        # ...and the aborted temp never leaks
+        assert os.listdir(str(tmp_path)) == ["weights.params"]
+        # a post-crash save works again
+        mx.nd.save(fname, {"w": mx.nd.zeros((4,))})
+        np.testing.assert_array_equal(mx.nd.load(fname)["w"].asnumpy(),
+                                      np.zeros((4,), "f"))
+
+    def test_trainer_save_states_crash_consistent(self, tmp_path):
+        net = gluon.nn.Dense(2, in_units=3)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        x = mx.nd.array(np.ones((2, 3), np.float32))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        tr.step(2)
+        fname = str(tmp_path / "trainer.states")
+        tr.save_states(fname)
+        before = open(fname, "rb").read()
+        fp.configure({"checkpoint.save": "raise:OSError@n=1"})
+        with pytest.raises(OSError):
+            tr.save_states(fname)
+        assert open(fname, "rb").read() == before  # bitwise intact
+        tr.load_states(fname)                      # and loadable
+
+    def test_checkpoint_manager_crash_keeps_previous_step(self, tmp_path):
+        from mxnet_tpu.parallel import CheckpointManager
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+        state0 = {"w": np.arange(3, dtype=np.float32)}
+        ckpt.save(0, state0)
+        fp.configure({"checkpoint.save": "raise:RuntimeError@n=1"})
+        with pytest.raises(RuntimeError):
+            ckpt.save(1, {"w": np.zeros(3, np.float32)})
+        # step 1 never published; step 0 restores bitwise
+        assert ckpt.latest_step() == 0
+        restored, step = ckpt.restore()
+        assert step == 0
+        np.testing.assert_array_equal(restored["w"], state0["w"])
+        # recovery: the next save publishes normally
+        ckpt.save(1, {"w": np.zeros(3, np.float32)})
+        assert ckpt.latest_step() == 1
+
+
+# -- the chaos training loop (tier-1 acceptance) ------------------------------
+
+class TestChaosTrainingLoop:
+    def _run_loop(self, faulted):
+        """Small training loop: prefetched batches + fused step. Returns
+        (losses, final params). Faulted runs add seeded raises/delays on
+        the compile/trace/io seams — all of which must degrade, never
+        crash, and must not change the math."""
+        # fresh dispatch cache: the compile seams must actually be
+        # crossed inside the measured loop (and identically on every
+        # run, so faulted/clean and run/re-run comparisons line up)
+        R._clear_dispatch_cache()
+        R.reset_dispatch_stats()
+        if faulted:
+            fp.configure({
+                "imperative.jit.compile": "raise:RuntimeError@p=0.5",
+                "fused_step.trace": "raise:RuntimeError",
+                "io.prefetch.place": "delay:1ms@p=0.3",
+                "storage.alloc": "raise:MemoryError@p=0.2",
+            }, seed=5)
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(8, in_units=4, activation="relu"))
+            net.add(gluon.nn.Dense(1, in_units=8))
+        net.initialize(mx.init.Xavier(rnd_type="uniform"))
+        net.hybridize()
+        loss_fn = gluon.loss.L2Loss()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05})
+        step = tr.fuse_step(lambda xx, yy: loss_fn(net(xx), yy))
+        rs = np.random.RandomState(0)
+        batches = [(rs.rand(4, 4).astype("float32"),
+                    rs.rand(4, 1).astype("float32")) for _ in range(6)]
+
+        def to_nd(b):
+            return mx.nd.array(b[0]), mx.nd.array(b[1])
+
+        losses = []
+        pf = DevicePrefetchIter(iter(batches), place_fn=to_nd)
+        for x, y in pf:
+            losses.append(float(step(x, y, batch_size=4)
+                                .asnumpy().mean()))
+        # name-independent: block naming counters advance per instance,
+        # so compare params positionally in sorted-name order
+        params = [p.data().asnumpy()
+                  for _, p in sorted(net.collect_params().items())]
+        triggered = dict(fp.metrics())
+        fp.reset()
+        return losses, params, triggered
+
+    def test_faulted_loop_matches_fault_free_bitwise(self):
+        t0 = time.perf_counter()
+        clean_losses, clean_params, _ = self._run_loop(faulted=False)
+        mx.random.seed(0)
+        faulted_losses, faulted_params, triggered = \
+            self._run_loop(faulted=True)
+        # no hang: the whole faulted loop finishes promptly
+        assert time.perf_counter() - t0 < 120.0
+        # faults actually fired on the seams this loop crosses
+        assert triggered.get("fused_step.trace", 0) > 0
+        assert triggered.get("imperative.jit.compile", 0) > 0
+        # no silent corruption: losses and final params are bitwise
+        # equal — raises hit fallback paths that compute the same math,
+        # delays only reorder time (fallbacks are eager; the clean run's
+        # warming steps are eager too, and both paths' updates agree
+        # bitwise on this net — the fused-step parity contract)
+        assert faulted_losses == clean_losses
+        assert len(faulted_params) == len(clean_params)
+        for fa, cl in zip(faulted_params, clean_params):
+            np.testing.assert_array_equal(fa, cl)
+
+    def test_chaos_run_is_deterministic(self):
+        """Same seed, same schedule: two faulted runs trigger the same
+        fault counts and produce identical losses (replayability)."""
+        mx.random.seed(0)
+        l1, p1, t1 = self._run_loop(faulted=True)
+        mx.random.seed(0)
+        l2, p2, t2 = self._run_loop(faulted=True)
+        assert t1 == t2
+        assert l1 == l2
+
+
+class TestServeGroupPortCeiling:
+    def test_coordinator_near_port_ceiling_wraps_deterministically(
+            self, monkeypatch):
+        """A launcher coordinator port near 65535 must not overflow the
+        derived server ports (cport + 1001 + s): the window wraps back
+        into valid space, every rank computing the same base."""
+        from mxnet_tpu.kvstore_async import serve_group
+        monkeypatch.setenv("MXTPU_COORDINATOR", "127.0.0.1:65300")
+        monkeypatch.setenv("MXTPU_NUM_PROCS", "1")
+        monkeypatch.setenv("MXTPU_ASYNC_PS_PORT", "0")
+        monkeypatch.delenv("MXTPU_NUM_SERVERS", raising=False)
+        servers, clients = serve_group(0)
+        try:
+            assert servers and 0 < servers[0].port <= 65535
+            clients[0].init(1, np.ones((2,), np.float32))
+            np.testing.assert_array_equal(
+                clients[0].pull(1), np.ones((2,), np.float32))
+        finally:
+            for s in servers:
+                s.stop()
+
+
+# -- slow: multiprocess PS chaos with a killed+restarted worker ---------------
+
+def _ps_chaos_worker(rank, nproc, port_env_val, steps, die_at):
+    os.environ["MXTPU_PROC_ID"] = str(rank)
+    os.environ["MXTPU_NUM_PROCS"] = str(nproc)
+    os.environ["MXTPU_ASYNC_PS_PORT"] = port_env_val
+    os.environ["MXTPU_PS_HEARTBEAT_INTERVAL"] = "0.1"
+    os.environ["MXTPU_PS_RETRY_BASE"] = "0.01"
+    os.environ["MXTPU_PS_RETRY_CAP"] = "0.1"
+    # flaky wire for every push/pull this worker makes — seeded per rank
+    os.environ["MXNET_FAULTPOINTS"] = \
+        "kvstore.send=raise:ConnectionError@p=0.15;" \
+        "kvstore.pull=raise:ConnectionError@p=0.15"
+    os.environ["MXNET_FAULTPOINTS_SEED"] = str(100 + rank)
+    import numpy as np2
+    import mxnet_tpu as mx2
+    kv = mx2.kv.create("dist_async")
+    target = np2.full((8,), 3.0, np2.float32)
+    out = mx2.nd.zeros((8,))
+    for step in range(steps):
+        if step == die_at:
+            kv._client.stop_heartbeat()
+            os._exit(0)  # crash mid-training, no done()
+        kv.pull(1, out=out)
+        w = out.asnumpy()
+        grad = w - target  # d/dw 0.5*(w-target)^2 — sgd pulls w to 3.0
+        kv.push(1, mx2.nd.array(grad))
+    kv.close()
+
+
+class TestMultiprocessChaos:
+    @pytest.mark.slow
+    def test_worker_killed_and_restarted_under_send_faults(self):
+        """Async PS under chaos: both workers train on a flaky wire
+        (15% injected send/pull failure), one worker is killed
+        mid-training and restarted. The run must neither deadlock nor
+        diverge: the server survives, the dead rank is detected, and the
+        weights converge to the optimum's ballpark."""
+        os.environ.pop("MXTPU_COORDINATOR", None)
+        os.environ["MXTPU_PROC_ID"] = "0"
+        os.environ["MXTPU_NUM_PROCS"] = "3"
+        os.environ["MXTPU_ASYNC_PS_PORT"] = "0"
+        os.environ["MXTPU_PS_HEARTBEAT_INTERVAL"] = "0.1"
+        os.environ["MXTPU_PS_DONE_TIMEOUT"] = "30"
+        import mxnet_tpu.optimizer as opt
+        kv = mx.kv.create("dist_async")
+        try:
+            kv.init(1, mx.nd.zeros((8,)))
+            kv.set_optimizer(opt.create("sgd", learning_rate=0.2,
+                                        wd=0.0))
+            port = os.environ["MXTPU_ASYNC_PS_PORT"]
+            ctx = mp.get_context("spawn")
+            w1 = ctx.Process(target=_ps_chaos_worker,
+                             args=(1, 3, port, 30, -1))
+            w2 = ctx.Process(target=_ps_chaos_worker,
+                             args=(2, 3, port, 30, 8))
+            w1.start()
+            w2.start()
+            w2.join(120)
+            assert w2.exitcode == 0      # died on schedule, no deadlock
+            time.sleep(1.0)
+            assert 2 in kv.get_dead_nodes(timeout=0.8)
+            # restart the dead rank; it finishes its training share
+            w2b = ctx.Process(target=_ps_chaos_worker,
+                              args=(2, 3, port, 30, -1))
+            w2b.start()
+            w1.join(120)
+            w2b.join(120)
+            assert w1.exitcode == 0 and w2b.exitcode == 0
+            out = mx.nd.zeros((8,))
+            kv.pull(1, out=out)
+            w = out.asnumpy()
+            assert np.all(np.isfinite(w))
+            # same final-loss ballpark as a fault-free run: sgd on this
+            # quadratic converges to the target; chaos (duplicated or
+            # dropped-then-retried pushes, a mid-flight restart) may
+            # wiggle the tail but not the destination
+            np.testing.assert_allclose(w, 3.0, atol=0.5)
+        finally:
+            kv.close()

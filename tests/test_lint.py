@@ -228,6 +228,44 @@ def test_mx008_bare_except(tmp_path):
     assert [f.code for f in findings] == ["MX008"]
 
 
+def test_mx009_flags_swallowed_broad_except(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/io/pipe.py", """\
+        def f():
+            try:
+                return 1
+            except Exception:
+                return 2
+        """, {"MX009"})
+    assert [f.code for f in findings] == ["MX009"]
+
+
+def test_mx009_accepts_reraise_and_accounting(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/kvstore_async.py", """\
+        from . import profiler as _profiler
+
+        def f():
+            try:
+                return 1
+            except Exception:
+                raise
+        def g():
+            try:
+                return 1
+            except BaseException:
+                if _profiler._ACTIVE:
+                    _profiler.account("kvstore.server_errors", 1)
+                return 2
+        def narrow():
+            try:
+                return 1
+            except (ConnectionError, OSError):
+                return 2  # narrow catches are out of scope
+        """, {"MX009"})
+    assert findings == []
+
+
 # -- waiver machinery --------------------------------------------------------
 
 def test_waiver_without_reason_is_flagged(tmp_path):

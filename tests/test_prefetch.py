@@ -63,6 +63,50 @@ def test_exception_propagates():
         next(pf)
 
 
+def test_worker_death_raises_once_then_exhausts():
+    """Restart-or-die contract: the worker exception surfaces exactly
+    once; afterwards the iterator reads exhausted (StopIteration) so a
+    `for` loop over a died prefetcher terminates instead of hanging on
+    an empty queue or replaying the same exception forever."""
+    def boom():
+        raise RuntimeError("producer failed")
+        yield  # pragma: no cover — makes it a generator
+
+    pf = DevicePrefetchIter(boom())
+    with pytest.raises(RuntimeError, match="producer failed"):
+        next(pf)
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(pf)
+    assert list(pf) == []  # for-loop form terminates too
+
+
+def test_reset_recovers_after_worker_death():
+    """reset() after a death starts a FRESH worker over the restarted
+    source — full recovery, not permanent poisoning."""
+    class FlakyOnce:
+        def __init__(self):
+            self.runs = 0
+
+        def __iter__(self):
+            self.runs += 1
+            if self.runs == 1:
+                raise OSError("transient source failure")
+            for i in range(3):
+                yield np.full((2,), i, dtype=np.float32)
+
+        def reset(self):
+            pass
+
+    pf = DevicePrefetchIter(FlakyOnce())
+    with pytest.raises(OSError):
+        next(pf)
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.reset()
+    assert [int(np.asarray(b)[0]) for b in pf] == [0, 1, 2]
+
+
 def test_gluon_dataloader_prefetcher():
     from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
 

@@ -534,6 +534,71 @@ class MX008BareExcept:
         return out
 
 
+# -- MX009 -------------------------------------------------------------------
+
+_BROAD_EXC_NAMES = frozenset(("Exception", "BaseException"))
+
+
+class MX009SwallowedBroadExcept:
+    """Retry/except sites in the transport and data-pipeline layers
+    (``kvstore_async.py``, ``io/``, ``_retry.py``) must not swallow
+    ``Exception``/``BaseException`` silently: a failure a retry loop
+    quietly eats is exactly the unaccounted degradation the faultpoint
+    chaos suite exists to expose. Every broad handler must re-raise,
+    count the event via ``profiler.account``, or carry an inline waiver
+    stating why swallowing is sound."""
+
+    code = "MX009"
+    summary = "broad except swallowed without re-raise or accounting"
+    kind = "python"
+
+    def scope(self, path):
+        return path in ("mxnet_tpu/kvstore_async.py",
+                        "mxnet_tpu/_retry.py") \
+            or path.startswith("mxnet_tpu/io/")
+
+    @staticmethod
+    def _is_broad(handler):
+        t = handler.type
+        if t is None:
+            return True  # bare except
+        names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+        for n in names:
+            if isinstance(n, ast.Name) and n.id in _BROAD_EXC_NAMES:
+                return True
+            if isinstance(n, ast.Attribute) and \
+                    n.attr in _BROAD_EXC_NAMES:
+                return True
+        return False
+
+    @staticmethod
+    def _handled(handler):
+        """True if the handler body re-raises or accounts the event."""
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "account":
+                return True
+        return False
+
+    def check(self, path, src, tree, parents):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node) or self._handled(node):
+                continue
+            out.append(Finding(
+                self.code, path, node.lineno,
+                "broad except handler neither re-raises nor counts via "
+                "profiler.account — a swallowed transport/pipeline "
+                "failure is an unaccounted degradation; handle, count, "
+                "or waive with why silence is sound"))
+        return out
+
+
 ALL_RULES = (
     MX001JnpBypassesInvoke(),
     MX002UnguardedProfilerHook(),
@@ -543,4 +608,5 @@ ALL_RULES = (
     MX006CApiErrorMacros(),
     MX007WallClockInTrace(),
     MX008BareExcept(),
+    MX009SwallowedBroadExcept(),
 )

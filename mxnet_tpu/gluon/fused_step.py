@@ -189,12 +189,15 @@ class FusedTrainStep:
         finally:
             self.last_mode = mode
             if t0 is not None:
+                dur_us = (_time.perf_counter() - t0) * 1e6
                 _profiler.record_op(
-                    "gluon.train_step",
-                    (_time.perf_counter() - t0) * 1e6,
+                    "gluon.train_step", dur_us,
                     category="gluon", lane="gluon",
                     args={"mode": mode, "batch_size": batch_size,
                           "params": len(self._trainer._params)})
+                # the latency histogram ROADMAP item 1's serve gate
+                # reports p50/p99 from (metrics()['latency'])
+                _profiler.record_latency("fused_step.step", dur_us)
         return loss
 
     # -- dispatch ----------------------------------------------------------

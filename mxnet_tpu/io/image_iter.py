@@ -228,9 +228,10 @@ class _Prefetcher:
                     item = None
                 except Exception as e:  # noqa: BLE001 — forward to consumer
                     from .. import profiler as _profiler
-                    if _profiler._ACTIVE:
-                        _profiler.account("io.prefetch_worker_deaths", 1,
-                                          lane="io", emit=False)
+                    # counted with profiling off too: account gates only
+                    # the trace event, never the production counter
+                    _profiler.account("io.prefetch_worker_deaths", 1,
+                                      lane="io", emit=False)
                     item = e
                 # bounded put that keeps observing the stop flag, so
                 # stop() never deadlocks against a full queue

@@ -378,10 +378,14 @@ class PrefetchingIter(DataIter):
         t0 = _time.perf_counter() if _profiler._ACTIVE else None
         batch = self._next_impl()
         if t0 is not None:
+            wait_us = (_time.perf_counter() - t0) * 1e6
             _profiler.record_op(
-                "io.prefetch_next", (_time.perf_counter() - t0) * 1e6,
+                "io.prefetch_next", wait_us,
                 category="io", lane="io",
                 args={"queue_depth": self._queue.qsize()})
+            # same consumer-stall histogram DevicePrefetchIter feeds:
+            # one series for "how long did the step wait on input"
+            _profiler.record_latency("io.prefetch_wait", wait_us)
             _profiler.record_counter("io.prefetch_queue_depth",
                                      self._queue.qsize(), lane="io")
         return batch

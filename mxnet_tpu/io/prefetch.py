@@ -108,10 +108,11 @@ class DevicePrefetchIter:
             except BaseException as e:  # noqa: BLE001 — propagate to consumer
                 # a worker death is a counted event, not just a raised
                 # exception: io.prefetch_worker_deaths is the restart
-                # diagnostic (how often did reset() have to recover?)
-                if _profiler._ACTIVE:
-                    _profiler.account("io.prefetch_worker_deaths", 1,
-                                      lane="io", emit=False)
+                # diagnostic (how often did reset() have to recover?) —
+                # counted even with profiling off (account accumulates
+                # unconditionally; only trace emission gates on _ACTIVE)
+                _profiler.account("io.prefetch_worker_deaths", 1,
+                                  lane="io", emit=False)
                 put(e)
                 return
             put(_SENTINEL)
@@ -154,10 +155,14 @@ class DevicePrefetchIter:
         t0 = _time.perf_counter() if _profiler._ACTIVE else None
         item = self._q.get()
         if t0 is not None:
+            wait_us = (_time.perf_counter() - t0) * 1e6
             _profiler.record_op(
-                "io.batch_fetch", (_time.perf_counter() - t0) * 1e6,
+                "io.batch_fetch", wait_us,
                 category="io", lane="io",
                 args={"queue_depth": self._q.qsize()})
+            # consumer-stall histogram: p95/p99 here >> 0 means the
+            # input pipeline, not the step, is the ceiling
+            _profiler.record_latency("io.prefetch_wait", wait_us)
             _profiler.record_counter("io.prefetch_queue_depth",
                                      self._q.qsize(), lane="io")
         if item is _SENTINEL:

@@ -136,14 +136,15 @@ class KVStore:
                 self._updater(idx, merged, self._store[k])
             else:
                 self._store[k] = NDArray(merged._data)
+        # accounted with profiling off too — metrics()['counters'] must
+        # be trustworthy in production (account gates only trace output)
+        _profiler.account("kvstore.bytes_pushed", self.bytes_pushed - b0)
         if t0 is not None:
-            moved = self.bytes_pushed - b0
             _profiler.record_op(
                 "kvstore.push", (_time.perf_counter() - t0) * 1e6,
                 category="kvstore", lane="kvstore",
-                args={"keys": len(keys), "bytes": moved,
+                args={"keys": len(keys), "bytes": self.bytes_pushed - b0,
                       "type": self._kind})
-            _profiler.account("kvstore.bytes_pushed", moved)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Pull values into `out` (ref: kvstore.py pull)."""
@@ -158,14 +159,13 @@ class KVStore:
             for o in olist:
                 self.bytes_pulled += int(src.nbytes)
                 o._data = src._data
+        _profiler.account("kvstore.bytes_pulled", self.bytes_pulled - b0)
         if t0 is not None:
-            moved = self.bytes_pulled - b0
             _profiler.record_op(
                 "kvstore.pull", (_time.perf_counter() - t0) * 1e6,
                 category="kvstore", lane="kvstore",
-                args={"keys": len(keys), "bytes": moved,
+                args={"keys": len(keys), "bytes": self.bytes_pulled - b0,
                       "type": self._kind})
-            _profiler.account("kvstore.bytes_pulled", moved)
         return out
 
     def pushpull(self, key, value, out=None, priority=0):
@@ -202,15 +202,14 @@ class KVStore:
                     o._data = new._data
                 else:
                     o._data = src._data
+        _profiler.account("kvstore.bytes_pulled", self.bytes_pulled - b0)
         if t0 is not None:
-            moved = self.bytes_pulled - b0
             _profiler.record_op(
                 "kvstore.row_sparse_pull",
                 (_time.perf_counter() - t0) * 1e6,
                 category="kvstore", lane="kvstore",
-                args={"keys": len(keys), "bytes": moved,
+                args={"keys": len(keys), "bytes": self.bytes_pulled - b0,
                       "type": self._kind})
-            _profiler.account("kvstore.bytes_pulled", moved)
         return out
 
     def broadcast(self, key, value, out=None, priority=0):

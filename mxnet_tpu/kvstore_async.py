@@ -28,11 +28,26 @@ blob) || blob, and the server refuses to unpickle unless the MAC
 verifies. The secret comes from ``MXTPU_PS_SECRET`` (distributed to all
 ranks by the launcher env pass-through, tools/launch.py); rank 0
 generates one when unset so single-host runs are safe by default.
+
+Wire trace-context (ISSUE 6): a client that negotiated protocol
+version >= 1 (the ``_OP_HELLO`` rendezvous at connect; an old server
+answers unknown-opcode ``_RE_ERR`` and the client falls back to the
+unstamped wire, so mixed fleets interop) sets the high bit of the
+opcode byte while a profile run is active and prefixes the payload
+with a 20-byte context ``rank:i32 | req_id:u64 | send_ts_us:f64``.
+The server strips it, records a ``ps.server.<op>`` span keyed by the
+id, and both sides emit chrome-trace flow events (``ph:"s"``
+client-side, ``ph:"f"`` server-side) so the merged multi-rank trace
+(``tools/trace_merge.py``) draws client→server causality arrows per
+push/pull/barrier. Profiling off = opcode byte and payload are
+byte-identical to the v0 wire (the zero-overhead contract,
+benched by ``BENCH_MODEL=profiler_overhead``).
 """
 from __future__ import annotations
 
 import hashlib
 import hmac
+import itertools
 import os
 import pickle
 import secrets as _secrets
@@ -41,6 +56,7 @@ import struct
 import threading
 import time as _ptime
 import warnings
+import weakref
 
 import numpy as np
 
@@ -70,12 +86,53 @@ _OP_HEARTBEAT = 13
 _OP_DEADNODES = 14
 _OP_SHAPE = 15
 _OP_BARRIER = 16
+_OP_HELLO = 17
 
 # response opcodes
 _RE_OK = 0x10
 _RE_ARR = 0x11
 _RE_INT = 0x12
+_RE_BYTES = 0x13
 _RE_ERR = 0x1F
+
+# protocol version this build speaks; negotiated per connection by the
+# _OP_HELLO rendezvous. v1 adds the wire trace-context (opcode high bit
+# + 20-byte header), the timestamped heartbeat (clock sync), and the
+# _OP_PROFILER 'metrics' pull. v0 peers simply never see any of it.
+_PROTO_VERSION = 1
+# opcode high bit: "a trace-context header follows the opcode byte"
+_TRACE_FLAG = 0x80
+_CTX_FMT = ">iQd"   # rank:i32 | req_id:u64 | client send-ts (trace us)
+_CTX_SIZE = struct.calcsize(_CTX_FMT)
+
+_OP_NAMES = {
+    _OP_INIT: "init", _OP_PUSH: "push", _OP_PULL: "pull",
+    _OP_SET_OPT: "set_optimizer", _OP_STATS: "stats", _OP_DONE: "done",
+    _OP_WAIT_DONE: "wait_done", _OP_STOP: "stop",
+    _OP_PUSH_RSP: "push_rsp", _OP_PULL_RSP: "pull_rsp",
+    _OP_PUSH_2BIT: "push_2bit", _OP_PROFILER: "profiler",
+    _OP_HEARTBEAT: "heartbeat", _OP_DEADNODES: "dead_nodes",
+    _OP_SHAPE: "shape", _OP_BARRIER: "barrier", _OP_HELLO: "hello",
+}
+
+
+# Ops whose handler blocks waiting on OTHER workers (cross-worker
+# rendezvous): their duration measures straggler skew, not server apply
+# cost, so they stay out of the kvstore.server_handle histogram.
+_RENDEZVOUS_OPS = frozenset((_OP_BARRIER, _OP_WAIT_DONE))
+
+# One process-wide request-id sequence shared by every AsyncPSClient in
+# the rank (per-server shard clients, the fresh tmp client each barrier()
+# creates, ...): per-client counters would all start at 0 and collide in
+# _flow_id, cross-wiring client->server causality arrows in the merged
+# trace. next() on itertools.count is atomic under the GIL.
+_REQ_SEQ = itertools.count(1)
+
+
+def _flow_id(rank, req_id):
+    """Job-unique chrome-trace flow id for one request: the stamping
+    rank in the top bits so concurrent ranks never collide."""
+    return ((rank & 0xFFFF) << 48) | (req_id & 0xFFFFFFFFFFFF)
 
 
 def _ps_secret():
@@ -147,6 +204,35 @@ def _recv_frame(sock):
     return _recv_exact(sock, n)
 
 
+# live servers hosted in this process, for the kvstore_server stats
+# provider below (weak: a stopped/collected server drops out on its own)
+_SERVERS = weakref.WeakSet()
+
+
+def _server_stats():
+    """``metrics()['kvstore_server']``: per-rank heartbeat staleness as
+    the ``rank_heartbeat_age.<rank>`` gauge (seconds since that rank's
+    last beat — operators see a rank going stale BEFORE the
+    barrier-timeout autopsy names it dead) plus apply/done totals,
+    aggregated over every live server hosted in this process."""
+    out = {}
+    now = _ptime.monotonic()
+    for srv in list(_SERVERS):
+        with srv._lock:
+            beats = dict(srv._heartbeats)
+            out["updates_applied"] = out.get("updates_applied", 0) \
+                + srv.updates_applied
+            out["workers_done"] = out.get("workers_done", 0) \
+                + srv.workers_done
+        for rank, t in beats.items():
+            key = "rank_heartbeat_age.%d" % rank
+            out[key] = max(out.get(key, 0.0), round(now - t, 3))
+    return out
+
+
+_profiler.register_stats_provider("kvstore_server", _server_stats)
+
+
 class AsyncPSServer:
     """Weight owner + immediate-apply update loop (the reference's
     KVStoreDistServer in async mode).
@@ -185,6 +271,7 @@ class AsyncPSServer:
         self._accept_thread.start()
         self.updates_applied = 0          # observability for tests
         self.workers_done = 0
+        _SERVERS.add(self)  # feeds the kvstore_server stats provider
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -208,18 +295,49 @@ class AsyncPSServer:
                 return
             if buf is None or not len(buf):
                 return
+            ctx = None
+            if buf[0] & _TRACE_FLAG and len(buf) > _CTX_SIZE:
+                # v1 wire trace-context: strip (rank, req_id, send_ts)
+                # so _handle sees the plain v0 payload
+                ctx = struct.unpack_from(_CTX_FMT, buf, 1)
+                buf = bytes([buf[0] & ~_TRACE_FLAG]) + buf[1 + _CTX_SIZE:]
+            t0 = _ptime.perf_counter() if _profiler._ACTIVE else None
             try:
                 self._handle(conn, buf)
             except Exception as e:  # noqa: BLE001 — reply, don't die
-                if _profiler._ACTIVE:
-                    _profiler.account("kvstore.server_errors", 1,
-                                      emit=False)
+                _profiler.account("kvstore.server_errors", 1,
+                                  emit=False)
                 msg = ("%s: %s" % (type(e).__name__, e)).encode()[:4096]
                 try:
                     _send_frame(conn, struct.pack(">BH", _RE_ERR, len(msg))
                                 + msg)
                 except OSError:
                     return
+            if t0 is not None:
+                # server-side span per request; when the request carried
+                # trace-context, key it by (rank, req_id) and close the
+                # flow the client opened — the merged trace then shows
+                # client→server causality per push/pull/barrier
+                dur = (_ptime.perf_counter() - t0) * 1e6
+                opname = _OP_NAMES.get(buf[0], "op%d" % buf[0])
+                args = None
+                if ctx is not None:
+                    args = {"rank": ctx[0], "req_id": ctx[1],
+                            "client_send_ts_us": ctx[2]}
+                _profiler.record_op("ps.server.%s" % opname, dur,
+                                    category="kvstore", lane="kvstore",
+                                    args=args)
+                if ctx is not None:
+                    _profiler.record_flow(
+                        "ps.%s" % opname, _flow_id(ctx[0], ctx[1]), "f",
+                        ts_us=_profiler._now_us() - dur)
+                if buf[0] not in _RENDEZVOUS_OPS:
+                    # barrier/wait_done block for cross-worker
+                    # rendezvous (seconds, straggler-bound) — folding
+                    # those waits in would swamp the apply-cost tail
+                    # this histogram isolates
+                    _profiler.record_latency("kvstore.server_handle",
+                                             dur)
             if buf[0] == _OP_STOP:
                 return
 
@@ -408,7 +526,23 @@ class AsyncPSServer:
             import time as _t
             with self._lock:
                 self._heartbeats[int(rank)] = _t.monotonic()
-            _send_frame(conn, bytes([_RE_OK]))
+            if len(buf) >= off + 16:
+                # v1 beat carries the client's trace-clock timestamp:
+                # answer with OUR trace clock so the client can estimate
+                # the offset tools/trace_merge.py aligns shards with
+                # (the NTP-style exchange of ISSUE 6 tentpole b)
+                _send_frame(conn, bytes([_RE_ARR]) + _pack_arr(
+                    np.asarray([_profiler._now_us()], np.float64)))
+            else:
+                _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_HELLO:
+            # protocol-version rendezvous: a v1 client asks before ever
+            # stamping trace-context. (An OLD server lands in the
+            # unknown-opcode ValueError below instead and replies
+            # _RE_ERR, which the client reads as version 0 — that
+            # asymmetry IS the interop contract.)
+            _send_frame(conn, struct.pack(">Bq", _RE_INT,
+                                          _PROTO_VERSION))
         elif op == _OP_DEADNODES:
             # ranks whose heartbeat is older than `timeout` seconds
             # (ref: ps-lite GetDeadNodes, kvstore_dist.h:121)
@@ -431,8 +565,12 @@ class AsyncPSServer:
             (m,) = struct.unpack_from(">H", buf, off)
             off += 2
             body = buf[off:off + m].decode()
-            self._profiler_command(cmd, body)
-            _send_frame(conn, bytes([_RE_OK]))
+            reply = self._profiler_command(cmd, body)
+            if reply is None:
+                _send_frame(conn, bytes([_RE_OK]))
+            else:
+                _send_frame(conn, struct.pack(">BI", _RE_BYTES,
+                                              len(reply)) + reply)
         elif op == _OP_STOP:
             _send_frame(conn, bytes([_RE_OK]))
             self._stop.set()
@@ -442,7 +580,11 @@ class AsyncPSServer:
     @staticmethod
     def _profiler_command(cmd, body):
         """Run a profiler command on the SERVER process (the reference
-        forwards SetConfig/State/Pause/Dump enums to each server)."""
+        forwards SetConfig/State/Pause/Dump enums to each server).
+        ``metrics`` returns the server's own ``profiler.metrics()``
+        snapshot as JSON bytes — any worker can pull the PS server's
+        telemetry (latency histograms included) into the merged view."""
+        import json as _json
         from . import profiler
         if cmd == "set_config":
             kwargs = {}
@@ -457,8 +599,11 @@ class AsyncPSServer:
             profiler.set_state(body or "run")
         elif cmd == "dump":
             profiler.dump()
+        elif cmd == "metrics":
+            return _json.dumps(profiler.metrics()).encode()
         else:
             raise ValueError("unknown profiler command %r" % cmd)
+        return None
 
     def _apply_rows(self, key, ids, grad_rows):
         import mxnet_tpu as mx
@@ -500,20 +645,40 @@ class AsyncPSClient:
         self._addr = (host, port)
         self.bytes_pushed = 0  # wire accounting (sparse/compressed tests)
         self._hb_stop = None
+        # wire trace-context state: what protocol the peer speaks
+        # (negotiated per connection) and this client's request counter
+        self._peer_version = 0
+        self._rank = int(os.environ.get("MXTPU_PROC_ID", "0") or 0)
+        self._req_id = 0
 
     def _connect_once(self):
         """One connect attempt (the kvstore.connect fault seam); no
         retry of its own — the caller owns the backoff budget, so retry
         loops never nest (a nested budget would multiply the documented
-        MXTPU_PS_RETRY_DEADLINE)."""
+        MXTPU_PS_RETRY_DEADLINE). A fresh connection re-negotiates the
+        protocol version with one _OP_HELLO round trip: a v1 server
+        answers its version, an old server answers unknown-opcode
+        _RE_ERR and the client stays on the v0 (unstamped) wire."""
         if _faultpoint.ACTIVE:
             _faultpoint.check("kvstore.connect")
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
             sock.connect(self._addr)
+            _send_frame(sock, struct.pack(">Bq", _OP_HELLO,
+                                          _PROTO_VERSION))
+            resp = _recv_frame(sock)
         except BaseException:
             sock.close()  # no half-open socket per failed attempt
             raise
+        if resp is None:
+            sock.close()
+            raise ConnectionError(
+                "async PS server closed during version negotiation")
+        if resp[0] == _RE_INT:
+            peer = int(struct.unpack_from(">q", resp, 1)[0])
+        else:
+            peer = 0  # pre-v1 server: never stamp trace-context
+        self._peer_version = min(peer, _PROTO_VERSION)
         self._sock = sock
 
     def _ensure_connected(self):
@@ -530,8 +695,7 @@ class AsyncPSClient:
         def on_retry(n, exc, delay):
             # connect retries counted apart from mid-stream transport
             # retries and heartbeat failures: three different diagnoses
-            if _profiler._ACTIVE:
-                _profiler.account("kvstore.connect_retries", 1)
+            _profiler.account("kvstore.connect_retries", 1)
 
         _retry.call(
             self._connect_once, retryable=(ConnectionError, OSError),
@@ -539,9 +703,14 @@ class AsyncPSClient:
             on_retry=on_retry)
 
 
-    def start_heartbeat(self, rank, interval=0.5):
+    def start_heartbeat(self, rank, interval=0.5, sync_clock=False,
+                        clock_primary=False):
         """Background liveness beats (ref: ps-lite heartbeats feeding
-        GetDeadNodes). Returns immediately; stop with stop_heartbeat."""
+        GetDeadNodes). Returns immediately; stop with stop_heartbeat.
+        ``sync_clock=True`` rides a trace-clock timestamp on each beat
+        (v1 peers) so the client keeps a live offset estimate against
+        this server; ``clock_primary`` marks it the canonical alignment
+        target trace merging shifts this rank's shard by."""
         if self._hb_stop is not None:
             return
         import time
@@ -551,15 +720,14 @@ class AsyncPSClient:
             failures = 0
             while not self._hb_stop.is_set():
                 try:
-                    self.heartbeat(rank)
+                    self.heartbeat(rank, sync_clock=sync_clock,
+                                   clock_primary=clock_primary)
                     failures = 0
-                    if _profiler._ACTIVE:
-                        _profiler.account("kvstore.heartbeats", 1,
-                                          emit=False)
+                    _profiler.account("kvstore.heartbeats", 1,
+                                      emit=False)
                 except (ConnectionError, OSError, RuntimeError):
-                    if _profiler._ACTIVE:
-                        _profiler.account("kvstore.heartbeat_failures", 1,
-                                          emit=False)
+                    _profiler.account("kvstore.heartbeat_failures", 1,
+                                      emit=False)
                     # a straggler server may not be up yet (lazy
                     # connect): keep beating; give up only after a
                     # sustained outage, loudly
@@ -581,7 +749,8 @@ class AsyncPSClient:
             self._hb_thread.join(timeout=5)
             self._hb_stop = None
 
-    def _call(self, payload, idempotent=True, point="kvstore.send"):
+    def _call(self, payload, idempotent=True, point="kvstore.send",
+              latency=None):
         """One request/response round trip, hardened: a broken socket
         (server restart, dropped connection, injected ``kvstore.send``/
         ``kvstore.pull`` fault) is retried with reconnect + exponential
@@ -594,6 +763,14 @@ class AsyncPSClient:
         ``idempotent=False``: re-sending those changes protocol state
         (a double done() inflates the shutdown count; a re-sent barrier
         arrival could release a rendezvous that never happened).
+
+        While a profile run is active and the peer negotiated v1, each
+        attempt is stamped with the wire trace-context and the round
+        trip becomes a ``ps.client.<op>`` span + flow-start event;
+        ``latency`` optionally names the RTT histogram to feed
+        (``kvstore.push_rtt`` / ``kvstore.pull_rtt`` /
+        ``kvstore.barrier_wait``). Profiling off costs one extra bool
+        test and the wire bytes are untouched.
 
         Budget shape: the patient first-connect rendezvous happens ONCE
         up front; each retry attempt then reconnects with a single
@@ -610,8 +787,19 @@ class AsyncPSClient:
                     self._connect_once()  # reconnect: caller's budget
                 if _faultpoint.ACTIVE:
                     _faultpoint.check(point)
+                wire = payload
+                t0 = None
+                if _profiler._ACTIVE and self._peer_version >= 1:
+                    # stamp the negotiated trace-context: fresh req_id
+                    # per attempt so a retried send shows up as its own
+                    # server span instead of aliasing the lost one
+                    self._req_id = next(_REQ_SEQ)
+                    t0 = _profiler._now_us()
+                    wire = bytes([payload[0] | _TRACE_FLAG]) \
+                        + struct.pack(_CTX_FMT, self._rank,
+                                      self._req_id, t0) + payload[1:]
                 try:
-                    _send_frame(self._sock, payload)
+                    _send_frame(self._sock, wire)
                     resp = _recv_frame(self._sock)
                 except (ConnectionError, OSError):
                     # mid-stream break: this socket is done either way
@@ -629,13 +817,27 @@ class AsyncPSClient:
                     self._sock = None
                     raise ConnectionError(
                         "async PS server closed the connection")
+                if t0 is not None:
+                    opname = _OP_NAMES.get(payload[0],
+                                           "op%d" % payload[0])
+                    rtt = _profiler._now_us() - t0
+                    _profiler.record_op(
+                        "ps.client.%s" % opname, rtt,
+                        category="kvstore", lane="kvstore",
+                        args={"req_id": self._req_id,
+                              "bytes": len(payload)})
+                    _profiler.record_flow(
+                        "ps.%s" % opname,
+                        _flow_id(self._rank, self._req_id), "s",
+                        ts_us=t0)
+                    if latency is not None:
+                        _profiler.record_latency(latency, rtt)
                 return resp
 
         if idempotent:
             def on_retry(n, exc, delay):
-                if _profiler._ACTIVE:
-                    _profiler.account("kvstore.transport_retries", 1,
-                                      emit=False)
+                _profiler.account("kvstore.transport_retries", 1,
+                                  emit=False)
             resp = _retry.call(attempt,
                                retryable=(ConnectionError, OSError),
                                on_retry=on_retry)
@@ -649,6 +851,9 @@ class AsyncPSClient:
         if code == _RE_ARR:
             arr, _ = _unpack_arr(resp, 1)
             return arr
+        if code == _RE_BYTES:
+            (n,) = struct.unpack_from(">I", resp, 1)
+            return resp[5:5 + n]
         if code == _RE_ERR:
             (n,) = struct.unpack_from(">H", resp, 1)
             raise RuntimeError(resp[3:3 + n].decode())
@@ -662,7 +867,7 @@ class AsyncPSClient:
         payload = bytes([_OP_PUSH]) + _pack_key(key) \
             + _pack_arr(np.asarray(grad))
         self.bytes_pushed += len(payload)
-        self._call(payload)
+        self._call(payload, latency="kvstore.push_rtt")
 
     def push_row_sparse(self, key, row_ids, rows):
         """Sparse wire: only (row_ids, rows) cross — bytes scale with
@@ -671,23 +876,25 @@ class AsyncPSClient:
             + _pack_arr(np.asarray(row_ids, np.int64)) \
             + _pack_arr(np.asarray(rows))
         self.bytes_pushed += len(payload)
-        self._call(payload)
+        self._call(payload, latency="kvstore.push_rtt")
 
     def push_compressed(self, key, words, n, threshold):
         payload = bytes([_OP_PUSH_2BIT]) + _pack_key(key) \
             + struct.pack(">qd", int(n), float(threshold)) \
             + _pack_arr(np.asarray(words, np.int32))
         self.bytes_pushed += len(payload)
-        self._call(payload)
+        self._call(payload, latency="kvstore.push_rtt")
 
     def pull(self, key):
         return self._call(bytes([_OP_PULL]) + _pack_key(key),
-                          point="kvstore.pull")
+                          point="kvstore.pull",
+                          latency="kvstore.pull_rtt")
 
     def pull_row_sparse(self, key, row_ids):
         return self._call(bytes([_OP_PULL_RSP]) + _pack_key(key)
                           + _pack_arr(np.asarray(row_ids, np.int64)),
-                          point="kvstore.pull")
+                          point="kvstore.pull",
+                          latency="kvstore.pull_rtt")
 
     def shape_of(self, key):
         """Dense shape of a stored key WITHOUT transferring the value
@@ -706,18 +913,34 @@ class AsyncPSClient:
             # non-idempotent: a resent arrival after a lost response
             # could release a rendezvous that never fully assembled
             tmp._call(struct.pack(">Bq", _OP_BARRIER, int(num_workers)),
-                      idempotent=False)
+                      idempotent=False, latency="kvstore.barrier_wait")
         finally:
             try:
                 tmp._sock.close()
             except OSError:
                 pass
 
-    def heartbeat(self, rank):
+    def heartbeat(self, rank, sync_clock=False, clock_primary=False):
         # fail-fast (no transport retry): the beat loop re-beats every
         # interval anyway, and its failures are counted DISTINCTLY
         # (kvstore.heartbeat_failures) so a flaky link shows up as such
         # instead of inflating the transport-retry counter
+        if sync_clock and self._peer_version >= 1:
+            # timestamped beat: client brackets the exchange on its
+            # trace clock, the server answers with its own — the
+            # NTP-style pair behind merge_traces clock alignment.
+            # offset ≈ server_ts - midpoint(t0, t1); error <= rtt/2.
+            t0 = _profiler._now_us()
+            arr = self._call(struct.pack(">Bqd", _OP_HEARTBEAT,
+                                         int(rank), float(t0)),
+                             idempotent=False)
+            t1 = _profiler._now_us()
+            if arr is not None and len(arr):
+                _profiler.record_clock_sync(
+                    "%s:%d" % self._addr,
+                    float(arr[0]) - 0.5 * (t0 + t1), t1 - t0,
+                    primary=clock_primary)
+            return
         self._call(struct.pack(">Bq", _OP_HEARTBEAT, int(rank)),
                    idempotent=False)
 
@@ -728,8 +951,17 @@ class AsyncPSClient:
 
     def profiler_command(self, cmd, body=""):
         c, b = cmd.encode(), body.encode()
-        self._call(bytes([_OP_PROFILER]) + struct.pack(">H", len(c)) + c
-                   + struct.pack(">H", len(b)) + b)
+        return self._call(bytes([_OP_PROFILER]) + struct.pack(">H", len(c))
+                          + c + struct.pack(">H", len(b)) + b)
+
+    def server_metrics(self):
+        """The server process's own ``profiler.metrics()`` snapshot
+        (the _OP_PROFILER ``metrics`` command): any worker can pull the
+        PS server's telemetry — latency histograms, heartbeat-age
+        gauges, counters — into its own merged view."""
+        import json as _json
+        raw = self.profiler_command("metrics")
+        return _json.loads(bytes(raw).decode()) if raw else None
 
     def set_optimizer(self, optimizer):
         secret = _ps_secret()
@@ -800,10 +1032,13 @@ class AsyncKVStore:
             "MXNET_KVSTORE_BIGARRAY_BOUND", str(1000 * 1000)))
         self._split = {}  # key -> (shape, dtype, [shard lengths])
         self._residuals = {}
-        # liveness beats feed each server's dead-node tracking
+        # liveness beats feed each server's dead-node tracking; they
+        # also carry the clock-sync timestamps (server 0 = the primary
+        # clock every rank's trace shard aligns to in merge_traces)
         hb = float(os.environ.get("MXTPU_PS_HEARTBEAT_INTERVAL", "0.5"))
-        for c in self._clients:
-            c.start_heartbeat(rank, interval=hb)
+        for i, c in enumerate(self._clients):
+            c.start_heartbeat(rank, interval=hb, sync_clock=True,
+                              clock_primary=(i == 0))
         # Trainer/Module never call done() themselves; signal at process
         # exit so server shutdown never stalls on missing done()s
         # (the reference's Postoffice barrier-before-exit is implicit).
@@ -893,9 +1128,11 @@ class AsyncKVStore:
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             merged = vlist[0] if len(vlist) == 1 else nd.add_n(*vlist)
-            if t0 is not None:
-                nbytes += int(merged.wire_nbytes if isinstance(
-                    merged, RowSparseNDArray) else merged.nbytes)
+            # wire-byte accounting is unconditional: the cumulative
+            # kvstore.bytes_pushed counter must be trustworthy in
+            # production, not only while a profile run is active
+            nbytes += int(merged.wire_nbytes if isinstance(
+                merged, RowSparseNDArray) else merged.nbytes)
             if isinstance(merged, RowSparseNDArray):
                 # row-sparse keys are whole-key routed (the reference
                 # splits rows too; documented simplification — lazy
@@ -914,12 +1151,12 @@ class AsyncKVStore:
                 self._fanout(lambda j: self._push_dense(*j), jobs)
             else:
                 self._push_dense(self._owner(k), k, merged.asnumpy())
+        _profiler.account("kvstore.bytes_pushed", nbytes)
         if t0 is not None:
             _profiler.record_op(
                 "kvstore_async.push", (_ptime.perf_counter() - t0) * 1e6,
                 category="kvstore", lane="kvstore",
                 args={"keys": len(keys), "bytes": nbytes})
-            _profiler.account("kvstore.bytes_pushed", nbytes)
 
     def _push_dense(self, cidx, key, host):
         if self._compression is not None \
@@ -990,17 +1227,16 @@ class AsyncKVStore:
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
             host = self._pull_host(k)
-            if t0 is not None:
-                nbytes += int(host.nbytes) * len(olist)
+            nbytes += int(host.nbytes) * len(olist)
             arr = jnp.asarray(host)
             for o in olist:
                 o._data = arr
+        _profiler.account("kvstore.bytes_pulled", nbytes)
         if t0 is not None:
             _profiler.record_op(
                 "kvstore_async.pull", (_ptime.perf_counter() - t0) * 1e6,
                 category="kvstore", lane="kvstore",
                 args={"keys": len(keys), "bytes": nbytes})
-            _profiler.account("kvstore.bytes_pulled", nbytes)
         return out
 
     def pushpull(self, key, value, out=None, priority=0):
@@ -1114,9 +1350,15 @@ class AsyncKVStore:
     def set_server_profiler_command(self, cmd, body=""):
         """Forward a profiler command to every PS server process
         (ref: KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49):
-        cmd in {'set_config', 'state', 'dump'}."""
-        for c in self._clients:
-            c.profiler_command(cmd, body)
+        cmd in {'set_config', 'state', 'dump', 'metrics'}."""
+        return [c.profiler_command(cmd, body) for c in self._clients]
+
+    def server_metrics(self):
+        """Each PS server's own ``profiler.metrics()`` snapshot, in
+        server order — the worker-side pull that folds server telemetry
+        (its latency histograms, heartbeat ages, error counters) into
+        this rank's view of the job."""
+        return [c.server_metrics() for c in self._clients]
 
     def updates_applied(self):
         return sum(c.updates_applied() for c in self._clients)

@@ -40,9 +40,10 @@ def _ctx_place(data, ctx):
             _faultpoint.check("storage.alloc")
         return NDArray(jax.device_put(data, ctx.jax_device()), ctx=ctx)
     except Exception:
-        if _profiler._ACTIVE:
-            _profiler.account("storage.alloc_fallbacks", 1, lane="memory",
-                              emit=False)
+        # counted with profiling off too: account gates only the trace
+        # event, never the production counter
+        _profiler.account("storage.alloc_fallbacks", 1, lane="memory",
+                          emit=False)
         return NDArray(data, ctx=ctx)
 
 

@@ -29,10 +29,22 @@ the analog of the reference pool counters feeding MemoryProfiler.
 ``continuous_dump``/``dump_period`` rewrite the trace file atomically every
 period (ref: MXSetContinuousProfileDump) so long runs are inspectable
 mid-flight. ``metrics()`` returns the whole surface as one JSON-safe dict.
+
+Distributed observability plane (ISSUE 6): every event carries
+``pid=rank`` so per-rank trace shards merge into one chrome trace
+(``merge_traces`` / ``tools/trace_merge.py``), aligned via the clock
+offsets the kvstore heartbeat path measures (``record_clock_sync``);
+``record_latency`` feeds log-bucketed histograms with
+p50/p95/p99 in ``metrics()['latency']``; ``record_flow`` emits the
+chrome flow events (``ph:"s"/"f"``) that pair a client request span with
+the server-side handling span across processes; and ``serve_metrics``
+exposes the whole snapshot as a zero-dependency Prometheus ``/metrics``
+HTTP endpoint (``MXNET_PROFILER_HTTP_PORT``).
 """
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -44,8 +56,16 @@ __all__ = [
     "Domain", "Task", "Frame", "Event", "Counter", "Marker",
     "record_op", "record_counter", "account", "sample_memory", "metrics",
     "is_running", "imperative_stats", "reset_imperative_stats", "LANES",
-    "register_stats_provider",
+    "register_stats_provider", "record_latency", "record_flow",
+    "record_clock_sync", "clock_sync", "latency_metrics",
+    "serve_metrics", "stop_metrics_server", "prometheus_text",
+    "merge_traces", "PID",
 ]
+
+# chrome-trace pid of every event this process emits: the worker rank.
+# Per-rank trace shards then merge into ONE job-wide trace with each
+# rank as its own process row (merge_traces / tools/trace_merge.py).
+PID = int(os.environ.get("MXTPU_PROC_ID", "0") or 0)
 
 # Stable pid/tid lanes of the host trace. tid doubles as the sort index.
 LANES = {
@@ -82,6 +102,13 @@ _events = []          # chrome-trace event dicts
 _agg = {}             # name -> [count, total_us, min_us, max_us]
 _counters = {}        # cumulative subsystem counters (kvstore/io bytes, ...)
 _mem_last = {}        # str(device) -> last sampled memory dict
+# name -> [count, sum_us, min_us, max_us, {bucket_idx: count}] — the
+# log-bucketed latency histograms behind record_latency()
+_latency = {}
+# peer -> {"offset_us", "rtt_us", "samples", "primary"}: clock-offset
+# estimates from the kvstore heartbeat path (min-RTT sample wins); the
+# trace-merge CLI reads these out of each shard's metadata block
+_clock_sync = {}
 _t0 = time.perf_counter()
 
 # Trace-event cap: a multi-hour run with the 10Hz memory sampler + per-op
@@ -198,6 +225,16 @@ def set_state(state="stop", profile_process="worker"):
             continuous = _state["continuous_dump"]
             period = _state["dump_period"]
         _start_daemons(profile_memory, continuous, period)
+        # live export: MXNET_PROFILER_HTTP_PORT opts a run into the
+        # /metrics endpoint without any code change; the server stays
+        # up across stop so the final snapshot remains scrapable
+        if os.environ.get("MXNET_PROFILER_HTTP_PORT"):
+            try:
+                serve_metrics()
+            except (OSError, ValueError, OverflowError):
+                pass  # port taken / malformed or out-of-range env value
+                #      (bind raises OverflowError past 65535): host
+                #      tracing must not die for a telemetry config typo
     else:
         with _lock:
             if not _state["running"]:
@@ -286,7 +323,7 @@ def pause(profile_process="worker"):
     with _lock:
         if _state["running"] and not _state["paused"]:
             _append_locked({"name": "profiler.pause", "cat": "profiler",
-                            "ph": "i", "s": "g", "ts": _now_us(), "pid": 0,
+                            "ph": "i", "s": "g", "ts": _now_us(), "pid": PID,
                             "tid": LANES["user"]})
         _state["paused"] = True
         _ACTIVE = False
@@ -302,7 +339,7 @@ def resume(profile_process="worker"):
         _ACTIVE = _state["running"]
         if _state["running"] and was_paused:
             _append_locked({"name": "profiler.resume", "cat": "profiler",
-                            "ph": "i", "s": "g", "ts": _now_us(), "pid": 0,
+                            "ph": "i", "s": "g", "ts": _now_us(), "pid": PID,
                             "tid": LANES["user"]})
 
 
@@ -315,7 +352,7 @@ def record_op(name, dur_us, category="operator", args=None,
         return
     end = _now_us()
     ev = {"name": name, "cat": category, "ph": "X",
-          "ts": end - dur_us, "dur": dur_us, "pid": 0,
+          "ts": end - dur_us, "dur": dur_us, "pid": PID,
           "tid": LANES.get(lane, LANES["user"])}
     if args:
         ev["args"] = args
@@ -336,26 +373,170 @@ def record_counter(name, value, lane="user", series=None):
         return
     args = dict(series) if series is not None else {"value": value}
     ev = {"name": name, "cat": "counter", "ph": "C", "ts": _now_us(),
-          "pid": 0, "tid": LANES.get(lane, LANES["user"]), "args": args}
+          "pid": PID, "tid": LANES.get(lane, LANES["user"]), "args": args}
     with _lock:
         _append_locked(ev)
 
 
 def account(name, delta, lane="kvstore", emit=True):
     """Accumulate a cumulative subsystem counter (kvstore bytes pushed,
-    connect retries, heartbeats, io batches, ...) and, by default, emit the
-    running total as a Counter event so the trace shows it over time. The
-    totals surface in ``dumps()`` and ``metrics()['counters']``."""
-    if not _ACTIVE:
-        return
+    connect retries, heartbeats, io batches, ...) and, when a profile run
+    is active, emit the running total as a Counter event so the trace
+    shows it over time. The totals surface in ``dumps()`` and
+    ``metrics()['counters']``.
+
+    The total accumulates UNCONDITIONALLY — only the trace-event emission
+    gates on ``_ACTIVE`` — so production counters (bytes moved, retries,
+    worker deaths) never silently drop deltas while profiling is off.
+    Accounting sites sit on network/IO/exception paths, not the per-op
+    dispatch hot path, so the always-on cost is one lock + dict update
+    per already-expensive event."""
     with _lock:
         total = _counters.get(name, 0) + delta
         _counters[name] = total
-        if emit:
+        if emit and _ACTIVE:
             _append_locked({"name": name, "cat": "counter", "ph": "C",
-                            "ts": _now_us(), "pid": 0,
+                            "ts": _now_us(), "pid": PID,
                             "tid": LANES.get(lane, LANES["user"]),
                             "args": {"value": total}})
+
+
+# -- latency histograms (ISSUE 6 tentpole c) ---------------------------------
+# Log-spaced buckets: 8 sub-buckets per octave (power of 2), so every
+# bucket spans <= 12.5% of its lower edge — percentile estimates carry a
+# bounded ~6% relative error without storing raw samples. Bucket index
+# packs (exponent, sub-bucket) from math.frexp; -1 is the [0, 0.5us)
+# underflow bucket (sub-0.5us durations would otherwise pack to other
+# negative indices that alias the sentinel's (0, 0) bounds — and emit
+# duplicate le="0" series in one Prometheus exposition).
+_LAT_SUBBITS = 3
+_LAT_SUB = 1 << _LAT_SUBBITS
+
+
+def _bucket_index(dur_us):
+    if dur_us < 0.5:
+        return -1
+    m, e = math.frexp(dur_us)       # dur = m * 2**e, m in [0.5, 1)
+    return (e << _LAT_SUBBITS) | int((m - 0.5) * 2 * _LAT_SUB)
+
+
+def _bucket_bounds(idx):
+    """(lo, hi) of bucket ``idx`` in microseconds."""
+    if idx < 0:
+        return 0.0, 0.5
+    e, s = idx >> _LAT_SUBBITS, idx & (_LAT_SUB - 1)
+    base = math.ldexp(1.0, e - 1)   # 2**(e-1)
+    return base * (1.0 + s / _LAT_SUB), base * (1.0 + (s + 1) / _LAT_SUB)
+
+
+def record_latency(name, dur_us):
+    """Record one duration sample into the log-bucketed histogram
+    ``name`` (the primitive behind ``metrics()['latency']`` and the
+    Prometheus ``/metrics`` histograms). Hot-path callers guard with the
+    inlined ``_HOOKS and _ACTIVE`` idiom (mxlint MX010); samples are only
+    collected while a profile run is active."""
+    if not _ACTIVE:
+        return
+    idx = _bucket_index(dur_us)
+    with _lock:
+        st = _latency.get(name)
+        if st is None:
+            st = _latency[name] = [0, 0.0, float("inf"), 0.0, {}]
+        st[0] += 1
+        st[1] += dur_us
+        st[2] = min(st[2], dur_us)
+        st[3] = max(st[3], dur_us)
+        st[4][idx] = st[4].get(idx, 0) + 1
+
+
+def _hist_percentile(buckets, count, q):
+    """Quantile estimate by linear interpolation inside the bucket the
+    cumulative count crosses ``q * count`` in."""
+    target = q * count
+    cum = 0.0
+    for idx in sorted(buckets):
+        n = buckets[idx]
+        if cum + n >= target:
+            lo, hi = _bucket_bounds(idx)
+            return lo + (hi - lo) * ((target - cum) / n)
+        cum += n
+    return _bucket_bounds(max(buckets))[1]
+
+
+def latency_metrics(reset=False):
+    """{name: {count, sum_us, mean_us, min_us, max_us, p50_us, p95_us,
+    p99_us}} — the ``metrics()['latency']`` section. ``reset`` clears
+    the histograms under the SAME lock acquisition as the snapshot, so
+    a sample recorded concurrently lands in either this snapshot or the
+    next one — never in neither."""
+    with _lock:
+        snap = {n: (st[0], st[1], st[2], st[3], dict(st[4]))
+                for n, st in _latency.items()}
+        if reset:
+            _latency.clear()
+    out = {}
+    for name, (count, total, mn, mx, buckets) in snap.items():
+        if not count:
+            continue
+        out[name] = {
+            "count": count,
+            "sum_us": total,
+            "mean_us": total / count,
+            "min_us": mn,
+            "max_us": mx,
+            "p50_us": min(mx, _hist_percentile(buckets, count, 0.50)),
+            "p95_us": min(mx, _hist_percentile(buckets, count, 0.95)),
+            "p99_us": min(mx, _hist_percentile(buckets, count, 0.99)),
+        }
+    return out
+
+
+def record_flow(name, flow_id, phase, ts_us=None, lane="kvstore",
+                category="kvstore", args=None):
+    """Emit one chrome-trace flow event (``ph:'s'`` start / ``'t'`` step /
+    ``'f'`` finish) with the job-unique ``flow_id``. A flow binds to the
+    enclosing duration span on its pid/tid at ``ts_us``, so a client RTT
+    span and the server-side handling span render as one connected arrow
+    in the merged trace (the cross-rank causality of ISSUE 6)."""
+    if not _ACTIVE:
+        return
+    if phase not in ("s", "t", "f"):
+        raise ValueError("flow phase must be 's', 't' or 'f', got %r"
+                         % (phase,))
+    ev = {"name": name, "cat": category, "ph": phase, "id": flow_id,
+          "ts": _now_us() if ts_us is None else ts_us, "pid": PID,
+          "tid": LANES.get(lane, LANES["user"])}
+    if phase == "f":
+        ev["bp"] = "e"  # bind to the enclosing slice, not the next one
+    if args:
+        ev["args"] = args
+    with _lock:
+        _append_locked(ev)
+
+
+def record_clock_sync(peer, offset_us, rtt_us, primary=False):
+    """Record one clock-offset estimate against ``peer`` (an NTP-style
+    sample from the kvstore heartbeat path: ``offset_us`` added to THIS
+    process's trace clock gives the peer's). The minimum-RTT sample wins
+    (tightest bound on the true offset). ``primary=True`` marks the
+    canonical alignment target (PS server 0) that ``merge_traces``
+    shifts this rank's shard by. Always recorded — calibration must not
+    depend on when profiling was switched on."""
+    with _lock:
+        st = _clock_sync.get(peer)
+        if st is None or rtt_us <= st["rtt_us"]:
+            _clock_sync[peer] = st = {
+                "offset_us": float(offset_us), "rtt_us": float(rtt_us),
+                "samples": (st["samples"] if st else 0),
+                "primary": bool(primary) or bool(st and st["primary"]),
+            }
+        st["samples"] += 1
+
+
+def clock_sync():
+    """Snapshot of the per-peer clock-offset estimates."""
+    with _lock:
+        return {p: dict(v) for p, v in _clock_sync.items()}
 
 
 def sample_memory(trigger=None):
@@ -377,7 +558,7 @@ def sample_memory(trigger=None):
         dev = str(s.device)
         events.append({
             "name": "memory:%s" % dev, "cat": "memory", "ph": "C",
-            "ts": ts, "pid": 0, "tid": LANES["memory"],
+            "ts": ts, "pid": PID, "tid": LANES["memory"],
             "args": {"bytes_in_use": s.bytes_in_use,
                      "peak_bytes_in_use": s.peak_bytes_in_use}})
         snap[dev] = {
@@ -395,17 +576,20 @@ def sample_memory(trigger=None):
 
 
 def _lane_metadata():
-    """chrome-trace metadata naming the process and every lane row."""
+    """chrome-trace metadata naming the process and every lane row.
+    Rank 0 keeps the bare process name; other ranks qualify it so a
+    merged multi-rank trace labels each process row."""
+    pname = "mxnet_tpu" if PID == 0 else "mxnet_tpu rank %d" % PID
     events = [
-        {"name": "process_name", "ph": "M", "pid": 0,
-         "args": {"name": "mxnet_tpu"}},
-        {"name": "process_sort_index", "ph": "M", "pid": 0,
-         "args": {"sort_index": 0}},
+        {"name": "process_name", "ph": "M", "pid": PID,
+         "args": {"name": pname}},
+        {"name": "process_sort_index", "ph": "M", "pid": PID,
+         "args": {"sort_index": PID}},
     ]
     for lane, tid in sorted(LANES.items(), key=lambda kv: kv[1]):
-        events.append({"name": "thread_name", "ph": "M", "pid": 0,
+        events.append({"name": "thread_name", "ph": "M", "pid": PID,
                        "tid": tid, "args": {"name": lane}})
-        events.append({"name": "thread_sort_index", "ph": "M", "pid": 0,
+        events.append({"name": "thread_sort_index", "ph": "M", "pid": PID,
                        "tid": tid, "args": {"sort_index": tid}})
     return events
 
@@ -418,7 +602,14 @@ def _write_trace():
     publish corrupt JSON or race os.replace."""
     with _lock:
         data = {"traceEvents": _lane_metadata() + list(_events),
-                "displayTimeUnit": "ms"}
+                "displayTimeUnit": "ms",
+                # shard self-description for tools/trace_merge.py: which
+                # rank this is and how its clock maps onto the peers'
+                "metadata": {
+                    "rank": PID,
+                    "clock_sync": {p: dict(v)
+                                   for p, v in _clock_sync.items()},
+                }}
         fn = _state["filename"]
     with _dump_lock:
         _atomic_json_write(fn, data)
@@ -531,6 +722,9 @@ def metrics(reset=False):
             _events.clear()
             _counters.clear()
             _mem_last.clear()
+    latency = latency_metrics(reset)
+    # _clock_sync survives reset on purpose: it is calibration
+    # state (clock offsets), not accumulated telemetry
     out = {
         "aggregate": {
             n: {"count": c, "total_us": tot, "min_us": mn, "max_us": mx,
@@ -538,7 +732,9 @@ def metrics(reset=False):
             for n, c, tot, mn, mx, avg in rows},
         "imperative": imperative_stats(),
         "counters": counters,
+        "latency": latency,
         "memory": memory,
+        "clock_sync": clock_sync(),
         "num_events": num_events,
     }
     for name, stats in _provider_sections(reset):
@@ -569,6 +765,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             _events.clear()
             _counters.clear()
             _mem_last.clear()
+    latency = latency_metrics(reset)
     if key_idx is None:
         rows.sort(key=lambda r: r[5], reverse=not ascending)
     else:
@@ -587,6 +784,16 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     for name, stats in _provider_sections(reset):
         lines.append("%s: %s" % (name, " ".join(
             "%s=%s" % (k, stats[k]) for k in sorted(stats))))
+    if latency:
+        lines.append("")
+        lines.append("%-40s %8s %10s %10s %10s %10s" % (
+            "Latency", "Count", "p50(us)", "p95(us)", "p99(us)",
+            "Max(us)"))
+        for name in sorted(latency):
+            h = latency[name]
+            lines.append("%-40s %8d %10.1f %10.1f %10.1f %10.1f" % (
+                name[:40], h["count"], h["p50_us"], h["p95_us"],
+                h["p99_us"], h["max_us"]))
     if counters:
         lines.append("counters: " + " ".join(
             "%s=%s" % (k, counters[k]) for k in sorted(counters)))
@@ -604,20 +811,273 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     return "\n".join(lines)
 
 
+# -- live export: Prometheus text + /metrics HTTP endpoint (ISSUE 6 d) ------
+
+def _prom_num(v):
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if math.isnan(v):
+            return "NaN"
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def prometheus_text():
+    """Render ``metrics()`` in the Prometheus text exposition format
+    (version 0.0.4) — what the ``/metrics`` endpoint serves. Latency
+    histograms become real Prometheus histograms (cumulative ``le``
+    buckets in seconds plus ``_sum``/``_count``); cumulative subsystem
+    counters become counters; memory, heartbeat ages and provider
+    sections become gauges. Every sample carries a ``rank`` label so a
+    job-wide scrape config can aggregate across workers."""
+    m = metrics()
+    rank = 'rank="%d"' % PID
+    lines = []
+
+    def emit(name, kind, help_text, samples):
+        lines.append("# HELP %s %s" % (name, help_text))
+        lines.append("# TYPE %s %s" % (name, kind))
+        for labels, value in samples:
+            lab = ",".join([rank] + labels)
+            lines.append("%s{%s} %s" % (name, lab, _prom_num(value)))
+
+    counter_samples = [
+        (['name="%s"' % k], v) for k, v in sorted(m["counters"].items())]
+    if counter_samples:
+        emit("mxtpu_counter_total", "counter",
+             "Cumulative subsystem counters (profiler.account).",
+             counter_samples)
+    # latency histograms: one family, name label distinguishes series
+    with _lock:
+        hists = {n: (st[0], st[1], dict(st[4]))
+                 for n, st in _latency.items()}
+    if hists:
+        lines.append("# HELP mxtpu_latency_seconds Latency histograms "
+                     "(profiler.record_latency), log-spaced buckets.")
+        lines.append("# TYPE mxtpu_latency_seconds histogram")
+        for name in sorted(hists):
+            count, total, buckets = hists[name]
+            series = '%s,name="%s"' % (rank, name)
+            cum = 0
+            for idx in sorted(buckets):
+                cum += buckets[idx]
+                le = _bucket_bounds(idx)[1] / 1e6  # us -> seconds
+                lines.append(
+                    'mxtpu_latency_seconds_bucket{%s,le="%.9g"} %d'
+                    % (series, le, cum))
+            lines.append('mxtpu_latency_seconds_bucket{%s,le="+Inf"} %d'
+                         % (series, count))
+            lines.append("mxtpu_latency_seconds_sum{%s} %s"
+                         % (series, _prom_num(total / 1e6)))
+            lines.append("mxtpu_latency_seconds_count{%s} %d"
+                         % (series, count))
+    mem_samples = []
+    for dev, vals in sorted(m["memory"].items()):
+        for k, v in sorted(vals.items()):
+            mem_samples.append(
+                (['device="%s"' % dev, 'stat="%s"' % k], v))
+    if mem_samples:
+        emit("mxtpu_memory_bytes", "gauge",
+             "Per-device memory stats (storage.stats).", mem_samples)
+    # span aggregates: count + total time per named span
+    agg_counts, agg_totals = [], []
+    for name, st in sorted(m["aggregate"].items()):
+        agg_counts.append((['name="%s"' % name], st["count"]))
+        agg_totals.append((['name="%s"' % name], st["total_us"] / 1e6))
+    if agg_counts:
+        emit("mxtpu_span_count", "counter",
+             "Completed span count per name (record_op).", agg_counts)
+        emit("mxtpu_span_seconds_total", "counter",
+             "Total span time per name (record_op).", agg_totals)
+    # registered stats providers (fused_step, faults, kvstore_server,
+    # imperative): flat numeric gauges
+    sections = [("imperative", m.get("imperative", {}))]
+    sections += [(k, v) for k, v in sorted(m.items())
+                 if k not in ("aggregate", "imperative", "counters",
+                              "latency", "memory", "clock_sync",
+                              "num_events", "locks")
+                 and isinstance(v, dict)]
+    gauge_samples = []
+    for section, stats in sections:
+        for k, v in sorted(stats.items()):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                gauge_samples.append(
+                    (['section="%s"' % section, 'name="%s"' % k], v))
+    if gauge_samples:
+        emit("mxtpu_stat", "gauge",
+             "Subsystem stats providers (register_stats_provider).",
+             gauge_samples)
+    emit("mxtpu_profiler_events", "gauge",
+         "Raw trace events currently buffered.",
+         [([], m["num_events"])])
+    return "\n".join(lines) + "\n"
+
+
+_http_server = None
+_http_thread = None
+
+
+def serve_metrics(port=None, host="127.0.0.1"):
+    """Start (idempotently) the zero-dependency ``/metrics`` HTTP
+    endpoint rendering ``prometheus_text()`` — plus ``/metrics.json``
+    with the raw ``metrics()`` dict — on ``host:port``. ``port=None``
+    reads ``MXNET_PROFILER_HTTP_PORT``; ``0`` binds an ephemeral port.
+    Returns the bound port. Binds loopback by default — expose it
+    beyond the host via your scrape proxy, not by changing ``host``,
+    unless the fabric is trusted."""
+    global _http_server, _http_thread
+    with _lock:
+        if _http_server is not None:
+            return _http_server.server_address[1]
+    if port is None:
+        port = int(os.environ.get("MXNET_PROFILER_HTTP_PORT", "0"))
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path in ("/metrics", "/"):
+                body = prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/metrics.json":
+                body = json.dumps(metrics()).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass  # a scrape every 15s must not spam stderr
+
+    import socketserver
+
+    class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    srv = _Server((host, int(port)), _Handler)
+    with _lock:
+        if _http_server is not None:  # lost the race to another starter
+            srv.server_close()
+            return _http_server.server_address[1]
+        _http_server = srv
+    _http_thread = threading.Thread(target=srv.serve_forever,
+                                    kwargs={"poll_interval": 0.2},
+                                    daemon=True, name="profiler-metrics")
+    _http_thread.start()
+    return srv.server_address[1]
+
+
+def stop_metrics_server():
+    """Shut the ``/metrics`` endpoint down (no-op when not serving)."""
+    global _http_server, _http_thread
+    with _lock:
+        srv, _http_server = _http_server, None
+        thread, _http_thread = _http_thread, None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if thread is not None:
+        thread.join(timeout=5)
+
+
+# -- multi-rank trace merge (ISSUE 6 tentpole b) -----------------------------
+
+def merge_traces(shards, output=None, align=True):
+    """Merge per-rank chrome-trace shards into one job-wide trace.
+
+    ``shards``: paths to (or already-loaded dicts of) trace files dumped
+    by each rank (each carries ``metadata.rank`` and the
+    ``metadata.clock_sync`` offsets measured on the kvstore heartbeat
+    path). Every event's ``pid`` is forced to its shard's rank and, when
+    ``align`` (default), its timestamp is shifted by the shard's primary
+    clock offset so all ranks share PS server 0's clock — the flow
+    events stamped on the wire then pair up client→server in one
+    timeline. Writes atomically to ``output`` when given.
+
+    Returns ``(merged_dict, summary)`` where ``summary`` carries per-
+    rank offsets and the flow-pairing tally (``flows_started``,
+    ``flows_finished``, ``flows_paired``)."""
+    loaded = []
+    for i, sh in enumerate(shards):
+        if isinstance(sh, str):
+            with open(sh) as f:
+                sh = json.load(f)
+        loaded.append(sh)
+    events = []
+    summary = {"ranks": [], "offsets_us": {}, "events": 0}
+    seen_meta = set()
+    for i, sh in enumerate(loaded):
+        meta = sh.get("metadata", {}) or {}
+        rank = meta.get("rank")
+        if rank is None:  # pre-ISSUE-6 shard: fall back to position
+            rank = i
+        offset = 0.0
+        sync = meta.get("clock_sync", {}) or {}
+        if align and sync:
+            primaries = [v for v in sync.values() if v.get("primary")] \
+                or list(sync.values())
+            best = min(primaries, key=lambda v: v.get("rtt_us", 0.0))
+            offset = float(best.get("offset_us", 0.0))
+        summary["ranks"].append(rank)
+        summary["offsets_us"][str(rank)] = offset
+        for ev in sh.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = rank
+            if ev.get("ph") == "M":
+                # one metadata event per (pid, name, tid): shards
+                # re-emit lane metadata on every dump
+                key = (rank, ev.get("name"), ev.get("tid"))
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+                if ev.get("name") == "process_name" and rank != 0:
+                    ev["args"] = {"name": "mxnet_tpu rank %d" % rank}
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + offset
+            events.append(ev)
+    events.sort(key=lambda e: e.get("ts", -1.0))
+    starts = {e["id"] for e in events
+              if e.get("ph") == "s" and "id" in e}
+    finishes = {e["id"] for e in events
+                if e.get("ph") == "f" and "id" in e}
+    summary["flows_started"] = len(starts)
+    summary["flows_finished"] = len(finishes)
+    summary["flows_paired"] = len(starts & finishes)
+    summary["events"] = len(events)
+    merged = {"traceEvents": events, "displayTimeUnit": "ms",
+              "metadata": {"merged_from": summary["ranks"],
+                           "offsets_us": summary["offsets_us"]}}
+    if output is not None:
+        with _dump_lock:
+            _atomic_json_write(output, merged)
+    return merged, summary
+
+
 def _reset():
     """Stop profiling and clear every recorded artifact (test helper)."""
     set_state("stop")
+    stop_metrics_server()
     with _lock:
         _events.clear()
         _agg.clear()
         _counters.clear()
         _mem_last.clear()
+        _latency.clear()
+        _clock_sync.clear()
     reset_imperative_stats()
 
 
 def _emit(name, ph, cat, ts=None, args=None, tid=None):
     ev = {"name": name, "cat": cat, "ph": ph,
-          "ts": _now_us() if ts is None else ts, "pid": 0,
+          "ts": _now_us() if ts is None else ts, "pid": PID,
           "tid": LANES["user"] if tid is None else tid}
     if args is not None:
         ev["args"] = args

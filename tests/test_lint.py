@@ -266,6 +266,52 @@ def test_mx009_accepts_reraise_and_accounting(tmp_path):
     assert findings == []
 
 
+def test_mx010_flags_unguarded_latency_telemetry(tmp_path):
+    """record_latency/record_flow in kvstore_async and the fused step
+    must sit behind the inlined active guard (ISSUE 6 satellite)."""
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/gluon/fused_step.py", """\
+        from .. import profiler as _profiler
+
+        def bad(dur):
+            _profiler.record_latency("fused_step.step", dur)
+
+        def bad_flow(fid):
+            _profiler.record_flow("ps.push", fid, "s")
+        """, {"MX010"})
+    assert [f.code for f in findings] == ["MX010", "MX010"]
+    assert "record_latency" in findings[0].message
+
+
+def test_mx010_accepts_inlined_and_derived_guards(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/kvstore_async.py", """\
+        from . import profiler as _profiler
+
+        def good_inline(dur):
+            if _profiler._ACTIVE:
+                _profiler.record_latency("kvstore.pull_rtt", dur)
+
+        def good_derived(t0):
+            if t0 is not None:
+                _profiler.record_flow("ps.pull", 7, "f")
+        """, {"MX010"})
+    assert findings == []
+
+
+def test_mx010_out_of_scope_module_is_exempt(tmp_path):
+    """The rule targets the hot request/step paths; cold modules (e.g.
+    a tool) may call the primitives unguarded."""
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/callback.py", """\
+        from . import profiler as _profiler
+
+        def f(dur):
+            _profiler.record_latency("cb", dur)
+        """, {"MX010"})
+    assert findings == []
+
+
 # -- waiver machinery --------------------------------------------------------
 
 def test_waiver_without_reason_is_flagged(tmp_path):

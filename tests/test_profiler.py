@@ -371,6 +371,267 @@ def test_metrics_reset_clears_everything():
     assert m["num_events"] == 0
 
 
+# -- account() accumulates with profiling off (ISSUE 6 satellite) -----------
+
+def test_account_counts_with_profiler_stopped():
+    """Regression: cumulative counters must not silently drop deltas
+    while profiling is off — only the trace-event emission gates on
+    _ACTIVE."""
+    assert not profiler.is_running()
+    profiler.account("kvstore.bytes_pushed", 100)
+    profiler.account("transport_retries", 2, emit=False)
+    m = profiler.metrics()
+    assert m["counters"]["kvstore.bytes_pushed"] == 100
+    assert m["counters"]["transport_retries"] == 2
+    # but NO trace events were born from it
+    assert m["num_events"] == 0
+    # and the totals keep growing across an on/off boundary
+    profiler.set_state("run")
+    profiler.account("kvstore.bytes_pushed", 1)
+    profiler.set_state("stop")
+    profiler.account("kvstore.bytes_pushed", 1)
+    assert profiler.metrics()["counters"]["kvstore.bytes_pushed"] == 102
+
+
+def test_kvstore_byte_counters_accumulate_while_profiling_off():
+    """The production wire-byte ledger survives profiling being off
+    (the exact bug the ISSUE 6 satellite names)."""
+    kv = mx.kv.create("local")
+    kv.init(11, mx.nd.ones((4, 4)))
+    kv.push(11, mx.nd.ones((4, 4)))
+    out = mx.nd.zeros((4, 4))
+    kv.pull(11, out=out)
+    m = profiler.metrics()
+    assert m["counters"]["kvstore.bytes_pushed"] == 4 * 4 * 4
+    assert m["counters"]["kvstore.bytes_pulled"] == 4 * 4 * 4
+    assert m["num_events"] == 0
+
+
+# -- latency histograms (ISSUE 6 tentpole c) ---------------------------------
+
+def _np_pct(data, q):
+    # 'lower' = an actual sample value, the right reference for a
+    # histogram quantile (default linear interpolation invents points
+    # in the empty gap of a bimodal distribution)
+    return float(np.percentile(data, q, method="lower"))
+
+
+@pytest.mark.parametrize("dist", ["uniform", "bimodal", "heavy_tail"])
+def test_latency_percentiles_match_numpy_reference(dist):
+    rs = np.random.RandomState(42)
+    if dist == "uniform":
+        data = rs.uniform(10.0, 1000.0, 4000)
+    elif dist == "bimodal":
+        data = np.concatenate([rs.normal(100.0, 5.0, 2000),
+                               rs.normal(50000.0, 1500.0, 2000)])
+    else:  # heavy tail
+        data = rs.lognormal(mean=5.0, sigma=2.0, size=4000)
+    data = np.abs(data) + 1e-3
+    profiler.set_state("run")
+    for d in data:
+        profiler.record_latency("t.%s" % dist, float(d))
+    profiler.set_state("stop")
+    h = profiler.metrics()["latency"]["t.%s" % dist]
+    assert h["count"] == len(data)
+    assert h["max_us"] == pytest.approx(float(data.max()))
+    assert h["sum_us"] == pytest.approx(float(data.sum()), rel=1e-6)
+    # log buckets are 12.5% wide: estimates must land within one bucket
+    for q, key in ((50, "p50_us"), (95, "p95_us"), (99, "p99_us")):
+        ref = _np_pct(data, q)
+        assert h[key] == pytest.approx(ref, rel=0.13), (dist, q)
+    assert h["p50_us"] <= h["p95_us"] <= h["p99_us"] <= h["max_us"]
+
+
+def test_latency_single_sample_and_zero():
+    profiler.set_state("run")
+    profiler.record_latency("one", 123.4)
+    profiler.record_latency("zeros", 0.0)
+    profiler.set_state("stop")
+    lat = profiler.metrics()["latency"]
+    one = lat["one"]
+    assert one["count"] == 1
+    for key in ("p50_us", "p95_us", "p99_us"):
+        # within the sample's own bucket, clamped to the true max
+        assert 123.4 * (1 - 0.13) <= one[key] <= 123.4
+    z = lat["zeros"]
+    assert z["p50_us"] == 0.0 and z["max_us"] == 0.0
+
+
+def test_latency_submicrosecond_samples_share_underflow_bucket():
+    """All sub-0.5us samples land in the single [0, 0.5us) underflow
+    bucket — frexp packing would otherwise hand each a distinct negative
+    index aliasing (0, 0) bounds, zeroing the percentiles and emitting
+    duplicate ``le`` series in one Prometheus exposition."""
+    profiler.set_state("run")
+    for v in (0.4, 0.3, 0.2, 0.05):
+        profiler.record_latency("tiny", v)
+    profiler.record_latency("tiny", 2.0)
+    profiler.set_state("stop")
+    h = profiler.metrics()["latency"]["tiny"]
+    assert h["count"] == 5
+    assert h["min_us"] == 0.05 and h["max_us"] == 2.0
+    assert 0.0 < h["p50_us"] <= 0.5  # inside the underflow bucket
+    body = profiler.prometheus_text()
+    labels = [line.split(" ")[0] for line in body.splitlines()
+              if 'name="tiny"' in line and "_bucket" in line]
+    assert labels and len(labels) == len(set(labels)), labels
+
+
+def test_latency_noop_when_stopped_and_reset_clears():
+    profiler.record_latency("ghost", 10.0)
+    assert "ghost" not in profiler.metrics()["latency"]
+    profiler.set_state("run")
+    profiler.record_latency("real", 10.0)
+    profiler.set_state("stop")
+    assert "real" in profiler.metrics()["latency"]
+    profiler.metrics(reset=True)
+    assert profiler.metrics()["latency"] == {}
+    assert "Latency" not in profiler.dumps()
+
+
+def test_latency_appears_in_dumps_table():
+    profiler.set_state("run")
+    for d in (10.0, 20.0, 30.0):
+        profiler.record_latency("kvstore.pull_rtt", d)
+    profiler.set_state("stop")
+    table = profiler.dumps()
+    assert "Latency" in table and "kvstore.pull_rtt" in table
+
+
+# -- flow events + pid=rank (ISSUE 6 tentpole a/b) ---------------------------
+
+def test_record_flow_emits_paired_s_f_events():
+    profiler.set_state("run")
+    profiler.record_op("client.req", 100.0, lane="kvstore")
+    profiler.record_flow("req", 42, "s", lane="kvstore")
+    profiler.record_flow("req", 42, "f", lane="kvstore")
+    with pytest.raises(ValueError):
+        profiler.record_flow("req", 42, "x")
+    profiler.set_state("stop")
+    profiler.dump()
+    evs = _trace()["traceEvents"]
+    s = [e for e in evs if e.get("ph") == "s"]
+    f = [e for e in evs if e.get("ph") == "f"]
+    assert s and f and s[0]["id"] == f[0]["id"] == 42
+    assert f[0]["bp"] == "e"
+
+
+def test_events_carry_rank_pid():
+    profiler.set_state("run")
+    profiler.record_op("op", 1.0)
+    profiler.set_state("stop")
+    profiler.dump()
+    data = _trace()
+    assert all(e.get("pid") == profiler.PID
+               for e in data["traceEvents"])
+    # the shard self-describes for trace_merge
+    assert data["metadata"]["rank"] == profiler.PID
+
+
+def test_record_clock_sync_keeps_min_rtt_sample():
+    profiler.record_clock_sync("peer:1", 500.0, 80.0)
+    profiler.record_clock_sync("peer:1", 900.0, 300.0)  # worse rtt
+    profiler.record_clock_sync("peer:1", 510.0, 40.0, primary=True)
+    cs = profiler.clock_sync()["peer:1"]
+    assert cs["offset_us"] == 510.0 and cs["rtt_us"] == 40.0
+    assert cs["samples"] == 3 and cs["primary"] is True
+
+
+# -- /metrics endpoint (ISSUE 6 tentpole d) ----------------------------------
+
+def _parse_prometheus(text):
+    """Minimal exposition-format validator: returns {family: n_samples}
+    and raises on malformed lines."""
+    import re
+    fams = {}
+    typed = set()
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? "
+        r"(-?[0-9.eE+]+|\+Inf|-Inf|NaN)$")
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[3] in ("counter", "gauge", "histogram",
+                                "summary", "untyped"), line
+            typed.add(parts[2])
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        assert m, "malformed sample line: %r" % line
+        fams[m.group(1)] = fams.get(m.group(1), 0) + 1
+    assert typed, "no TYPE lines"
+    return fams
+
+
+def test_serve_metrics_prometheus_scrape():
+    from urllib.request import urlopen
+    profiler.set_state("run")
+    profiler.record_latency("kvstore.pull_rtt", 120.0)
+    profiler.record_latency("fused_step.step", 800.0)
+    profiler.account("kvstore.bytes_pushed", 64)
+    port = profiler.serve_metrics(port=0)
+    try:
+        # idempotent: second call returns the same port
+        assert profiler.serve_metrics(port=0) == port
+        body = urlopen("http://127.0.0.1:%d/metrics" % port,
+                       timeout=5).read().decode()
+        fams = _parse_prometheus(body)
+        assert fams.get("mxtpu_latency_seconds_bucket", 0) >= 2
+        assert "mxtpu_latency_seconds_count" in fams
+        assert 'name="kvstore.pull_rtt"' in body
+        assert 'name="fused_step.step"' in body
+        assert "mxtpu_counter_total" in fams
+        # JSON twin of the same snapshot
+        import json as _json
+        raw = urlopen("http://127.0.0.1:%d/metrics.json" % port,
+                      timeout=5).read()
+        snap = _json.loads(raw)
+        assert snap["counters"]["kvstore.bytes_pushed"] == 64
+        # unknown path 404s without killing the server
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            urlopen("http://127.0.0.1:%d/nope" % port, timeout=5)
+        body2 = urlopen("http://127.0.0.1:%d/metrics" % port,
+                        timeout=5).read()
+        assert body2
+    finally:
+        profiler.set_state("stop")
+        profiler.stop_metrics_server()
+    # endpoint really is down now
+    import urllib.error
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urlopen("http://127.0.0.1:%d/metrics" % port, timeout=1)
+
+
+def test_http_port_env_autostarts_endpoint(monkeypatch):
+    from urllib.request import urlopen
+    monkeypatch.setenv("MXNET_PROFILER_HTTP_PORT", "0")
+    profiler.set_state("run")
+    try:
+        port = profiler.serve_metrics()  # idempotent: already started
+        body = urlopen("http://127.0.0.1:%d/metrics" % port,
+                       timeout=5).read().decode()
+        assert "mxtpu_profiler_events" in body
+    finally:
+        profiler.set_state("stop")
+        profiler.stop_metrics_server()
+
+
+@pytest.mark.parametrize("bad", ["auto", "70000", "-1"])
+def test_http_port_env_malformed_does_not_kill_profiling(monkeypatch, bad):
+    """A telemetry config typo in MXNET_PROFILER_HTTP_PORT (non-numeric,
+    or out of bind range — HTTPServer raises OverflowError past 65535)
+    must not abort set_state('run') — host tracing survives it."""
+    monkeypatch.setenv("MXNET_PROFILER_HTTP_PORT", bad)
+    profiler.set_state("run")
+    try:
+        assert profiler.metrics() is not None
+    finally:
+        profiler.set_state("stop")
+        profiler.stop_metrics_server()
+
+
 # -- storage.reset_peak (satellite) -----------------------------------------
 
 def test_storage_reset_peak_rebases_high_water_mark():

@@ -1,0 +1,290 @@
+"""Distributed observability plane tests (ISSUE 6): wire trace-context
+round trips (client flow 's' paired with server flow 'f'), protocol
+version negotiation against an old server, multi-rank trace merge with
+heartbeat-based clock alignment, and the 2-process end-to-end run."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401 — conftest platform setup
+from mxnet_tpu import kvstore_async as KA
+from mxnet_tpu import profiler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler(tmp_path):
+    profiler._reset()
+    profiler.set_config(filename=str(tmp_path / "shard.json"),
+                        xprof=False)
+    yield
+    profiler._reset()
+    profiler.set_config(filename="profile.json", xprof=True)
+
+
+def _trace(fn=None):
+    with open(fn or profiler._state["filename"]) as f:
+        return json.load(f)
+
+
+# -- wire trace-context: in-process client/server round trip ----------------
+
+def test_wire_context_pairs_client_server_flows():
+    srv = KA.AsyncPSServer()
+    cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+    profiler.set_state("run")
+    try:
+        cli.init("w", np.zeros(4, np.float32))
+        for _ in range(3):
+            cli.push("w", np.ones(4, np.float32))
+            cli.pull("w")
+    finally:
+        profiler.set_state("stop")
+        cli.stop_server()
+        srv.stop()
+    assert cli._peer_version == KA._PROTO_VERSION
+    profiler.dump()
+    evs = _trace()["traceEvents"]
+    s_ids = {e["id"] for e in evs if e.get("ph") == "s"}
+    f_ids = {e["id"] for e in evs if e.get("ph") == "f"}
+    assert len(s_ids) >= 7  # init + 3 pushes + 3 pulls
+    assert s_ids == f_ids, "every client flow must close server-side"
+    m = profiler.metrics()
+    # RTT histograms fed by the same round trips
+    assert m["latency"]["kvstore.push_rtt"]["count"] == 3
+    assert m["latency"]["kvstore.pull_rtt"]["count"] == 3
+    assert m["aggregate"]["ps.server.push"]["count"] == 3
+    assert m["aggregate"]["ps.client.pull"]["count"] == 3
+
+
+def test_flow_ids_unique_across_clients_same_rank():
+    """Two clients on one rank (per-server shard clients, the tmp client
+    every barrier() creates) must never stamp the same flow id: req ids
+    are drawn from one process-wide sequence, not per-client counters
+    that would all start at 0 and cross-wire causality arrows."""
+    srv = KA.AsyncPSServer()
+    a = KA.AsyncPSClient("127.0.0.1", srv.port)
+    b = KA.AsyncPSClient("127.0.0.1", srv.port)
+    profiler.set_state("run")
+    try:
+        a.init("w", np.zeros(4, np.float32))
+        for _ in range(3):
+            a.push("w", np.ones(4, np.float32))
+            b.pull("w")
+    finally:
+        profiler.set_state("stop")
+        a.stop_server()
+        srv.stop()
+    profiler.dump()
+    evs = _trace()["traceEvents"]
+    s_ids = [e["id"] for e in evs if e.get("ph") == "s"]
+    assert len(s_ids) >= 7
+    assert len(s_ids) == len(set(s_ids)), "duplicate client flow ids"
+    assert set(s_ids) == {e["id"] for e in evs if e.get("ph") == "f"}
+
+
+def test_profiling_off_wire_is_byte_identical_v0():
+    """Off-path unchanged: with no profile run active a v1 client sends
+    exactly the v0 frames (no flag bit, no context header)."""
+    srv = KA.AsyncPSServer()
+    cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+    sent = []
+    real_send = KA._send_frame
+
+    def spy(sock, payload):
+        sent.append(bytes(payload[:1]))
+        real_send(sock, payload)
+
+    KA._send_frame = spy
+    try:
+        cli.init("w", np.zeros(4, np.float32))
+        cli.push("w", np.ones(4, np.float32))
+        cli.pull("w")
+    finally:
+        KA._send_frame = real_send
+        cli.stop_server()
+        srv.stop()
+    assert sent and all(not (b[0] & KA._TRACE_FLAG) for b in sent)
+    assert profiler.metrics()["num_events"] == 0
+
+
+def test_old_server_negotiates_to_v0_and_still_works():
+    """Interop: a server that predates _OP_HELLO answers unknown-opcode
+    _RE_ERR; the client reads version 0 and never stamps trace-context,
+    even while profiling is on."""
+
+    class OldServer(KA.AsyncPSServer):
+        def _handle(self, conn, buf):
+            if buf[0] == KA._OP_HELLO:
+                raise ValueError("unknown opcode %d" % buf[0])
+            return super()._handle(conn, buf)
+
+    srv = OldServer()
+    cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+    sent = []
+    real_send = KA._send_frame
+
+    def spy(sock, payload):
+        sent.append(bytes(payload[:1]))
+        real_send(sock, payload)
+
+    profiler.set_state("run")
+    KA._send_frame = spy
+    try:
+        cli.init("w", np.zeros(4, np.float32))
+        cli.push("w", np.ones(4, np.float32))
+        out = cli.pull("w")
+    finally:
+        KA._send_frame = real_send
+        profiler.set_state("stop")
+        cli.stop_server()
+        srv.stop()
+    assert cli._peer_version == 0
+    assert np.array_equal(out, np.ones(4, np.float32))
+    assert all(not (b[0] & KA._TRACE_FLAG) for b in sent)
+
+
+def test_heartbeat_clock_sync_and_age_gauge():
+    srv = KA.AsyncPSServer()
+    cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+    try:
+        cli.heartbeat(5, sync_clock=True, clock_primary=True)  # negotiates
+        cli.heartbeat(5, sync_clock=True, clock_primary=True)
+        cs = profiler.clock_sync()
+        peer = "127.0.0.1:%d" % srv.port
+        assert peer in cs and cs[peer]["primary"]
+        # same process, same perf_counter epoch offset differs only by
+        # profiler import-time delta + rtt noise: bounded by ~1s here
+        assert abs(cs[peer]["offset_us"]) < 1e6
+        stats = profiler.metrics()["kvstore_server"]
+        assert "rank_heartbeat_age.5" in stats
+        assert 0.0 <= stats["rank_heartbeat_age.5"] < 10.0
+    finally:
+        cli.stop_server()
+        srv.stop()
+
+
+# -- merge_traces unit (synthetic shards) ------------------------------------
+
+def _shard(rank, events, clock_sync=None):
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"rank": rank, "clock_sync": clock_sync or {}}}
+
+
+def test_merge_aligns_clocks_and_remaps_pids(tmp_path):
+    # rank 1's clock runs 10_000us behind server 0's: its shard carries
+    # offset +10_000 and its raw timestamps sit BEFORE the causally
+    # later server events until alignment shifts them
+    fid = KA._flow_id(1, 7)
+    shard0 = _shard(0, [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "mxnet_tpu"}},
+        {"name": "ps.server.push", "ph": "X", "ts": 5200.0, "dur": 50.0,
+         "pid": 0, "tid": 2},
+        {"name": "ps.push", "ph": "f", "bp": "e", "id": fid,
+         "ts": 5200.0, "pid": 0, "tid": 2},
+    ])
+    shard1 = _shard(1, [
+        {"name": "ps.client.push", "ph": "X", "ts": -4900.0,
+         "dur": 400.0, "pid": 1, "tid": 2},
+        {"name": "ps.push", "ph": "s", "id": fid, "ts": -4900.0,
+         "pid": 1, "tid": 2},
+    ], clock_sync={"127.0.0.1:9999": {
+        "offset_us": 10000.0, "rtt_us": 120.0, "samples": 3,
+        "primary": True}})
+    p0, p1 = tmp_path / "s0.json", tmp_path / "s1.json"
+    p0.write_text(json.dumps(shard0))
+    p1.write_text(json.dumps(shard1))
+    out = tmp_path / "merged.json"
+    merged, summary = profiler.merge_traces(
+        [str(p0), str(p1)], output=str(out))
+    assert summary["flows_paired"] == 1
+    assert summary["offsets_us"] == {"0": 0.0, "1": 10000.0}
+    evs = merged["traceEvents"]
+    s = [e for e in evs if e.get("ph") == "s"][0]
+    f = [e for e in evs if e.get("ph") == "f"][0]
+    # monotone after alignment: flow start precedes its finish
+    assert s["ts"] == pytest.approx(5100.0)
+    assert s["ts"] <= f["ts"]
+    assert s["pid"] == 1 and f["pid"] == 0
+    # written file round-trips
+    disk = json.loads(out.read_text())
+    assert disk["metadata"]["offsets_us"]["1"] == 10000.0
+    # --no-align path keeps raw timestamps
+    raw, _ = profiler.merge_traces([str(p0), str(p1)], align=False)
+    raw_s = [e for e in raw["traceEvents"] if e.get("ph") == "s"][0]
+    assert raw_s["ts"] == pytest.approx(-4900.0)
+
+
+def test_merge_cli_reports_pairs(tmp_path):
+    fid = KA._flow_id(1, 9)
+    p0 = tmp_path / "r0.json"
+    p1 = tmp_path / "r1.json"
+    p0.write_text(json.dumps(_shard(0, [
+        {"name": "ps.pull", "ph": "f", "bp": "e", "id": fid,
+         "ts": 10.0, "pid": 0, "tid": 2}])))
+    p1.write_text(json.dumps(_shard(1, [
+        {"name": "ps.pull", "ph": "s", "id": fid, "ts": 5.0,
+         "pid": 1, "tid": 2}])))
+    out = tmp_path / "m.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_merge.py"),
+         str(p0), str(p1), "-o", str(out)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 paired" in r.stdout
+    assert json.loads(out.read_text())["traceEvents"]
+
+
+# -- 2-process end-to-end (acceptance) ---------------------------------------
+
+@pytest.mark.slow
+def test_two_process_run_merges_into_one_trace(tmp_path):
+    """A 2-process kvstore training run produces per-rank shards that
+    merge into one chrome trace with paired client→server flows and
+    monotone flow timestamps after clock alignment; each rank's
+    /metrics scrape and latency percentiles are validated in-worker."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["MXTPU_TRACE_DIR"] = str(tmp_path)
+    env["MXTPU_PS_HEARTBEAT_INTERVAL"] = "0.1"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(REPO, "tests", "trace_merge_worker.py")],
+        env=env, capture_output=True, text=True, timeout=480)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout + r.stderr
+    for rank in range(2):
+        for marker in ("LATENCY_OK", "SCRAPE_OK", "SERVER_METRICS_OK"):
+            assert "rank %d: %s" % (rank, marker) in out, out
+        assert "rank %d/2: OBS_WORKER_OK" % rank in out, out
+
+    shards = [str(tmp_path / ("trace_rank%d.json" % i)) for i in (0, 1)]
+    merged, summary = profiler.merge_traces(
+        shards, output=str(tmp_path / "merged.json"))
+    assert sorted(summary["ranks"]) == [0, 1]
+    assert summary["flows_started"] > 0
+    assert summary["flows_paired"] > 0, summary
+    # causality: every paired flow is monotone after alignment, within
+    # the alignment error bound (half the sync RTT, generously padded)
+    evs = merged["traceEvents"]
+    starts = {e["id"]: e for e in evs if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in evs if e.get("ph") == "f"}
+    paired = set(starts) & set(finishes)
+    rank1_sync = json.load(open(shards[1]))["metadata"]["clock_sync"]
+    slack = max(v["rtt_us"] for v in rank1_sync.values()) / 2 + 100.0
+    violations = [fid for fid in paired
+                  if finishes[fid]["ts"] < starts[fid]["ts"] - slack]
+    assert not violations, (len(violations), len(paired))
+    # both ranks contribute events under their own pid
+    pids = {e.get("pid") for e in evs}
+    assert {0, 1} <= pids

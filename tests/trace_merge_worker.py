@@ -1,0 +1,107 @@
+"""Worker script for the 2-process distributed-observability test
+(ISSUE 6 acceptance): each rank runs a short dist_async kvstore training
+loop plus a fused gluon step with profiling on, scrapes its own
+``/metrics`` endpoint mid-run, and dumps a per-rank trace shard
+(``pid=rank``) into ``MXTPU_TRACE_DIR`` for the launcher-side merge.
+
+Run via: python tools/launch.py -n 2 python tests/trace_merge_worker.py
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, profiler  # noqa: E402,F401
+
+
+def main():
+    rank = int(os.environ["MXTPU_PROC_ID"])
+    nproc = int(os.environ["MXTPU_NUM_PROCS"])
+    outdir = os.environ["MXTPU_TRACE_DIR"]
+    shard = os.path.join(outdir, "trace_rank%d.json" % rank)
+    assert profiler.PID == rank, (profiler.PID, rank)
+
+    profiler.set_config(filename=shard, xprof=False)
+    profiler.set_state("run")
+    port = profiler.serve_metrics(port=0)
+
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.zeros((8,)))
+    for _ in range(6):
+        kv.push("w", mx.nd.ones((8,)) * (rank + 1))
+        out = mx.nd.zeros((8,))
+        kv.pull("w", out=out)
+
+    # a few fused train steps so fused_step.step has a histogram
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize(mx.init.Uniform(0.1))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    step = gluon.train_step(net, gluon.loss.L2Loss(), trainer)
+    x = mx.nd.array(onp.ones((4, 4), onp.float32))
+    y = mx.nd.array(onp.zeros((4, 1), onp.float32))
+    for _ in range(4):
+        step(x, y, batch_size=4)
+
+    # let at least one timestamped heartbeat per server land so the
+    # shard carries a primary clock-sync sample
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if any(v.get("primary") for v in profiler.clock_sync().values()):
+            break
+        time.sleep(0.05)
+    assert any(v.get("primary") for v in profiler.clock_sync().values()), \
+        "no primary clock-sync sample arrived"
+
+    # acceptance: p50/p95/p99 for the wired-in histograms
+    lat = profiler.metrics()["latency"]
+    for name in ("kvstore.pull_rtt", "kvstore.push_rtt",
+                 "fused_step.step"):
+        h = lat[name]
+        assert h["count"] > 0 and h["p50_us"] <= h["p95_us"] \
+            <= h["p99_us"] <= h["max_us"], (name, h)
+    print("rank %d: LATENCY_OK" % rank)
+
+    # acceptance: live scrape of our own /metrics mid-run is valid
+    # Prometheus text exposition including those histograms
+    from urllib.request import urlopen
+    body = urlopen("http://127.0.0.1:%d/metrics" % port,
+                   timeout=5).read().decode()
+    assert "# TYPE mxtpu_latency_seconds histogram" in body
+    assert 'name="kvstore.pull_rtt"' in body
+    assert "mxtpu_counter_total" in body
+    for line in body.splitlines():
+        assert line.startswith("#") or " " in line, line
+    print("rank %d: SCRAPE_OK" % rank)
+
+    # the worker can also pull the PS server's own metrics
+    srv_metrics = kv.server_metrics()
+    assert srv_metrics and "latency" in srv_metrics[0]
+    assert any(k.startswith("rank_heartbeat_age.")
+               for k in srv_metrics[0]["kvstore_server"]), \
+        srv_metrics[0].get("kvstore_server")
+    print("rank %d: SERVER_METRICS_OK" % rank)
+
+    kv._barrier()
+    profiler.set_state("stop")
+    profiler.dump()
+    data = json.load(open(shard))
+    assert data["metadata"]["rank"] == rank
+    assert all(e.get("pid") == rank for e in data["traceEvents"])
+    print("rank %d/%d: OBS_WORKER_OK" % (rank, nproc))
+    if rank == 0:
+        kv.close()
+    else:
+        kv.done()
+
+
+if __name__ == "__main__":
+    main()

@@ -128,7 +128,11 @@ class MX001JnpBypassesInvoke:
 # -- MX002 -------------------------------------------------------------------
 
 _GUARD_TOKENS = ("_ACTIVE", "_HOOKS", "is_running")
-_HOOK_FNS = ("record_op", "record_counter", "account", "sample_memory")
+# `account` is deliberately NOT here: since ISSUE 6 it accumulates its
+# cumulative counter unconditionally (only the trace-event emission
+# gates on _ACTIVE internally), so production counters stay trustworthy
+# with profiling off — call sites must NOT wrap it in the guard.
+_HOOK_FNS = ("record_op", "record_counter", "sample_memory")
 
 
 def _test_is_guard(test):
@@ -599,6 +603,63 @@ class MX009SwallowedBroadExcept:
         return out
 
 
+# -- MX010 -------------------------------------------------------------------
+
+_LATENCY_HOOK_FNS = ("record_latency", "record_flow")
+
+
+class MX010UnguardedLatencyTelemetry:
+    """The ISSUE-6 telemetry primitives — ``record_latency`` histograms
+    and ``record_flow`` wire-causality events — sit on the hottest
+    paths of all (the kvstore request loop, the fused train step). Call
+    sites there must stay behind the inlined ``_HOOKS and _ACTIVE``
+    guard (or the derived ``t0 is not None`` form), exactly like MX002
+    for spans: the <0.5% wire-RTT and <2% dispatch overhead budgets of
+    ``BENCH_MODEL=profiler_overhead`` are only true because the off
+    path never builds an event or touches the histogram lock."""
+
+    code = "MX010"
+    summary = "record_latency/record_flow not behind the active guard"
+    kind = "python"
+
+    def scope(self, path):
+        return _is_hot(path) \
+            or path == "mxnet_tpu/gluon/fused_step.py"
+
+    def check(self, path, src, tree, parents):
+        aliases = _profiler_aliases(tree)
+        if not aliases:
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _LATENCY_HOOK_FNS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in aliases):
+                continue
+            guarded = False
+            for anc in _ancestors(node, parents):
+                if isinstance(anc, (ast.If, ast.IfExp)) \
+                        and _test_is_guard(anc.test):
+                    guarded = True
+                    break
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    break
+            if not guarded:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "%s.%s() on a hot path must be inside an "
+                    "`if _HOOKS and _profiler._ACTIVE` (or derived "
+                    "`t0 is not None`) guard — the profiler-overhead "
+                    "bench budget assumes the off path is one bool "
+                    "test" % (f.value.id, f.attr)))
+        return out
+
+
 ALL_RULES = (
     MX001JnpBypassesInvoke(),
     MX002UnguardedProfilerHook(),
@@ -609,4 +670,5 @@ ALL_RULES = (
     MX007WallClockInTrace(),
     MX008BareExcept(),
     MX009SwallowedBroadExcept(),
+    MX010UnguardedLatencyTelemetry(),
 )

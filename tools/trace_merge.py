@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Merge per-rank chrome-trace shards into one job-wide trace.
+
+Each rank of a distributed run dumps its own trace shard
+(``profiler.dump()``) with ``pid=rank`` and a ``metadata`` block
+carrying the clock offsets measured on the kvstore heartbeat path.
+This CLI (a thin wrapper over ``profiler.merge_traces``) aligns every
+shard onto PS server 0's clock and writes one chrome://tracing /
+Perfetto file in which the wire flow events (``ph:"s"/"f"``) draw
+client→server causality arrows per push/pull/barrier.
+
+    python tools/trace_merge.py trace_rank0.json trace_rank1.json \
+        -o merged.json
+
+``--no-align`` keeps raw per-rank timestamps (debugging the alignment
+itself). Exit status is non-zero when no flow pairs match while both
+sides emitted flows — the signature of mismatched shards.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank chrome-trace shards into one trace")
+    ap.add_argument("shards", nargs="+",
+                    help="per-rank trace JSON files (profiler.dump())")
+    ap.add_argument("-o", "--output", default="merged_trace.json",
+                    help="merged trace path (default: %(default)s)")
+    ap.add_argument("--no-align", action="store_true",
+                    help="skip heartbeat-based clock alignment")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu import profiler
+
+    _, summary = profiler.merge_traces(
+        args.shards, output=args.output, align=not args.no_align)
+    print("merged %d shard(s) (ranks %s) -> %s: %d events"
+          % (len(args.shards), summary["ranks"], args.output,
+             summary["events"]))
+    for rank, off in sorted(summary["offsets_us"].items()):
+        print("  rank %s: clock offset %+.1f us" % (rank, off))
+    print("  flow events: %d started, %d finished, %d paired"
+          % (summary["flows_started"], summary["flows_finished"],
+             summary["flows_paired"]))
+    if summary["flows_started"] and summary["flows_finished"] \
+            and not summary["flows_paired"]:
+        print("error: no client/server flow pair matched — are these "
+              "shards from the same run?", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

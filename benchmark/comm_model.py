@@ -113,12 +113,29 @@ def _trip_count(cond_lines):
     return consts[0] if len(consts) == 1 else None
 
 
+def _is_degenerate_groups(line):
+    """True when the collective's replica_groups are singletons — a
+    one-member group exchanges nothing, so the op is sharding
+    bookkeeping, not wire traffic (r07 fix: the shard_map'd loss emits
+    one such no-op AR per layer-stack leaf, which inflated the measured
+    payload by a full parameter's worth of phantom bytes)."""
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[", line)
+    if m:
+        return int(m.group(2)) == 1   # iota form: [groups, per_group]
+    m = re.search(r"replica_groups=\{(\{[^}]*\})", line)
+    if m:
+        return "," not in m.group(1)  # literal form: first group {n}
+    return False
+
+
 def hlo_collective_bytes(hlo_text):
     """Per-kind collective payload bytes for ONE step, loop-aware: a
     collective inside a `while` body (jax.lax.scan over layers / loss
     chunks) executes trip-count times, so body bytes are multiplied by
     the trip count parsed from the loop condition (r5 fix: the static
     count under-reported by exactly (L-1) layers' gradients).
+    Degenerate collectives (singleton replica groups) are skipped —
+    they move zero bytes.
 
     Returns (bytes_by_kind, counts_by_kind, n_unresolved_loops)."""
     comps = _split_computations(hlo_text)
@@ -135,7 +152,8 @@ def hlo_collective_bytes(hlo_text):
             return out, counts
         for line in comps[comp_name]:
             m = coll_re.search(line)
-            if m and "-done" not in line.split("=", 1)[1][:60]:
+            if m and "-done" not in line.split("=", 1)[1][:60] \
+                    and not _is_degenerate_groups(line):
                 ty = m.group(1)
                 if m.group(3):
                     # async form: the -start result type is a tuple of
@@ -226,20 +244,30 @@ ASSUMPTIONS = {
 }
 
 
+def allreduce_seconds(payload_bytes, n):
+    """(t_ici, t_dcn) seconds to ring-all-reduce one payload at n
+    chips under ASSUMPTIONS: 2(n-1)/n x payload over per-chip ICI, plus
+    the hierarchical DCN term for multi-host (payload re-reduced across
+    hosts at host DCN bandwidth). The single place the wire-time
+    formula lives — `project` and bench.py's comm_overlap gate both
+    price collectives through it."""
+    ici = ASSUMPTIONS["ici_bw_per_chip_GBps"] * 1e9
+    dcn = ASSUMPTIONS["dcn_bw_per_host_GBps"] * 1e9
+    per_host = ASSUMPTIONS["chips_per_host"]
+    t_ici = 2.0 * (n - 1) / n * payload_bytes / ici
+    hosts = max(1, n // per_host)
+    t_dcn = (2.0 * (hosts - 1) / hosts * payload_bytes / dcn
+             if hosts > 1 else 0.0)
+    return t_ici, t_dcn
+
+
 def project(step_time_s, grad_payload_bytes, ns):
     """Ring-all-reduce efficiency at n chips over ICI, plus the
     hierarchical DCN term for multi-host (payload re-reduced across
     hosts at host DCN bandwidth)."""
-    ici = ASSUMPTIONS["ici_bw_per_chip_GBps"] * 1e9
-    dcn = ASSUMPTIONS["dcn_bw_per_host_GBps"] * 1e9
-    per_host = ASSUMPTIONS["chips_per_host"]
     rows = []
     for n in ns:
-        wire = 2.0 * (n - 1) / n * grad_payload_bytes
-        t_ici = wire / ici
-        hosts = max(1, n // per_host)
-        t_dcn = (2.0 * (hosts - 1) / hosts * grad_payload_bytes / dcn
-                 if hosts > 1 else 0.0)
+        t_ici, t_dcn = allreduce_seconds(grad_payload_bytes, n)
         t_comm = t_ici + t_dcn
         rows.append({
             "n": n,

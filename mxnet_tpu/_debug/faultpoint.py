@@ -29,6 +29,15 @@ failure → behavior → counter table):
                             before the atomic rename (mid-save crash)
 ``storage.alloc``           creation-factory device placement
                             (``nd._ctx_place``)
+``collective.allreduce``    gradient-reduction launch seams: the host
+                            kvstore reducer (``parallel/elastic.py``
+                            ``HostGradReducer``) per call, and
+                            ``parallel/collectives.py`` helpers at
+                            trace/launch time
+``elastic.restore``         ``CheckpointManager.restore`` entry, before
+                            any checkpoint bytes are read
+``elastic.reshard``         ``ElasticController.reshard`` entry, before
+                            the surviving world is committed
 ==========================  ================================================
 
 Configuration — env var (parsed at import) or programmatic::
@@ -94,6 +103,9 @@ POINTS = frozenset((
     "fused_step.trace",
     "checkpoint.save",
     "storage.alloc",
+    "collective.allreduce",
+    "elastic.restore",
+    "elastic.reshard",
 ))
 
 _lock = _locktrace.named_lock("faultpoint.config")

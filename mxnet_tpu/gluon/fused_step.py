@@ -137,7 +137,7 @@ def _adopt_state(state, new):
         _adopt_state(s, n)
 
 
-def train_step(block, loss_fn, trainer):
+def train_step(block, loss_fn, trainer, mesh=None, bucket_bytes=None):
     """Fused training step for a (block, loss, trainer) triple:
     ``step(data, label, batch_size=...)`` computes
     ``loss_fn(block(data), label)``, backpropagates, and applies the
@@ -145,8 +145,17 @@ def train_step(block, loss_fn, trainer):
     block is hybridized (eager fallback otherwise, tallied, never a
     crash). With more than two positional args, all but the last feed
     the block and the last is the label. Returns the loss NDArray, like
-    the eager ``loss_fn`` call would."""
-    return FusedTrainStep(trainer, loss_fn, block=block)
+    the eager ``loss_fn`` call would.
+
+    With ``mesh`` (a ``parallel.create_mesh`` DeviceMesh), the program
+    runs data-parallel over the mesh's 'dp' axis inside ``shard_map``:
+    the batch is sharded, parameters stay replicated, and the gradient
+    all-reduce is issued as size-capped buckets placed MID-BACKWARD
+    (``parallel/overlap.py``) so the reduction hides under the backward
+    instead of serializing after it — the SCALING_r05 overlap story,
+    folded into the fused step."""
+    return FusedTrainStep(trainer, loss_fn, block=block, mesh=mesh,
+                          bucket_bytes=bucket_bytes)
 
 
 class FusedTrainStep:
@@ -160,13 +169,20 @@ class FusedTrainStep:
     reads inside the trainer (or use the block form, which threads every
     block parameter through the trace)."""
 
-    def __init__(self, trainer, loss_fn, block=None):
+    def __init__(self, trainer, loss_fn, block=None, mesh=None,
+                 bucket_bytes=None):
         if not callable(loss_fn):
             raise TypeError("loss_fn must be callable, got %r"
                             % type(loss_fn))
         self._trainer = trainer
         self._loss_fn = loss_fn
         self._block = block
+        self._mesh = mesh
+        self._bucket_bytes = bucket_bytes
+        self._dp = 1
+        if mesh is not None:
+            raw = getattr(mesh, "mesh", mesh)
+            self._dp = int(dict(raw.shape).get("dp", 1))
         self._cache = {}        # full signature -> (jfn, aux_params, fixed)
         self._key_counts = {}   # signature -> times seen (warming)
         self._partial_keys = set()  # configs compiled (retrace detection)
@@ -203,6 +219,12 @@ class FusedTrainStep:
     # -- dispatch ----------------------------------------------------------
     def _dispatch(self, nd_args, batch_size, ignore_stale_grad):
         reason = self._fallback_reason()
+        if reason is None and self._mesh is not None and nd_args \
+                and nd_args[0].shape \
+                and nd_args[0].shape[0] % max(self._dp, 1) != 0:
+            # shard_map shards dim 0 over 'dp'; an indivisible batch
+            # runs this step eagerly instead of crashing the trace
+            reason = "mesh-batch-indivisible"
         if reason is None:
             all_params, train_pos, indices = self._param_split()
             if not train_pos:
@@ -359,16 +381,42 @@ class FusedTrainStep:
         fixed_pos = tuple(i for i in range(n_all) if i not in train_set)
         mp = opt.multi_precision
 
+        tag = None
+        if self._mesh is not None:
+            # mesh mode: bucket markers between the grad variables and
+            # their use — each bucket's psum over 'dp' fires in the
+            # backward the moment its segment completes, hiding the
+            # reduction under the rest of the backward (overlap.py)
+            from ..parallel import overlap as _overlap
+            plan = _overlap.bucket_plan(
+                [all_params[pos].data()._data for pos in train_pos],
+                self._bucket_bytes)
+
+            def tag(tds):
+                return tuple(_overlap.tag_gradient_buckets(
+                    list(tds), "dp", plan=plan, op="sum"))
+
         def pure_step(train_datas, state_datas, fixed_datas, in_datas,
                       lrs, wds, rescale, rng):
+            if tag is not None:
+                # per-shard rng: a replicated key would hand every 'dp'
+                # shard identical dropout masks (sample j of shard 0 and
+                # shard 1 sharing a mask), shrinking the effective
+                # randomness by the dp factor
+                rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+
             def loss_of(tds):
+                if tag is not None:
+                    tds = tag(tds)
                 merged = [None] * n_all
                 for pos, d in zip(train_pos, tds):
                     merged[pos] = d
                 for pos, d in zip(fixed_pos, fixed_datas):
                     merged[pos] = d
                 outs, aux = pure_fwd(tuple(merged), in_datas, rng)
-                # grad of sum(loss) ≙ backward's all-ones head seed
+                # grad of sum(loss) ≙ backward's all-ones head seed;
+                # in mesh mode the local-shard sums psum (via the
+                # markers) into the identical full-batch gradient
                 return jnp.sum(outs[0]), (outs[0], aux)
 
             (_, (loss, aux)), grads = jax.value_and_grad(
@@ -394,17 +442,63 @@ class FusedTrainStep:
                                                      rs_i)
                 new_ws.append(nw)
                 new_sts.append(ns)
+            if self._mesh is not None:
+                # aux (BN moving stats) are per-shard estimates —
+                # average them so every replica adopts the same value
+                from jax import lax
+                aux = tuple(lax.pmean(a, "dp") for a in aux)
             return loss, tuple(new_ws), tuple(new_sts), grads, aux
 
+        body = pure_step
+        if self._mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.compat import shard_map as _shard_map
+            raw_mesh = getattr(self._mesh, "mesh", self._mesh)
+            # params/states/hypers replicated, batch sharded on 'dp';
+            # grads leave the body already psum'd (the markers), the
+            # per-sample loss re-assembles across shards
+            body = _shard_map(
+                pure_step, raw_mesh,
+                in_specs=(P(), P(), P(), P("dp"), P(), P(), P(), P()),
+                out_specs=(P("dp"), P(), P(), P(), P()),
+                check_vma=False)
         donate = ()
         try:
             if jax.default_backend() != "cpu":
                 donate = (0, 1)  # weights + optimizer state
         except Exception:
             donate = ()
-        jfn = jax.jit(pure_step, donate_argnums=donate) if donate \
-            else jax.jit(pure_step)
+        jfn = jax.jit(body, donate_argnums=donate) if donate \
+            else jax.jit(body)
+        if self._mesh is not None:
+            jfn = self._mesh_placed(jfn)
         return jfn, aux_params, fixed_pos
+
+    def _mesh_placed(self, inner):
+        """Mesh-mode placement shim: the first fused call receives
+        params/state committed to one device (their eager birthplace);
+        a shard_map program spans the whole mesh, so every operand is
+        re-placed onto it first — replicated for params/state/hypers,
+        'dp'-sharded for the batch. After step one the adopted outputs
+        already carry the mesh sharding and the put is a no-op."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        raw_mesh = getattr(self._mesh, "mesh", self._mesh)
+        rep = NamedSharding(raw_mesh, P())
+        batch = NamedSharding(raw_mesh, P("dp"))
+
+        def place(tree, sh):
+            return jax.tree_util.tree_map(
+                lambda a: a if getattr(a, "sharding", None) == sh
+                else jax.device_put(a, sh), tree)
+
+        def call(train_datas, state_datas, fixed_datas, in_datas,
+                 lrs, wds, rescale, rng):
+            return inner(place(train_datas, rep), place(state_datas, rep),
+                         place(fixed_datas, rep), place(in_datas, batch),
+                         place(lrs, rep), place(wds, rep),
+                         place(rescale, rep), place(rng, rep))
+
+        return call
 
     def _run(self, entry, all_params, train_pos, indices, states, nd_args,
              batch_size):
@@ -466,10 +560,44 @@ class FusedTrainStep:
             return self._loss_fn(self._block(*nd_args))
         return self._loss_fn(*nd_args)
 
+    def _unplace_mesh(self):
+        """A mesh-fused step leaves params/grads/optimizer state
+        replicated across the mesh; the eager path runs single-device
+        programs, and mixing both commitments is a jit device error.
+        Gather everything back to the default device before an eager
+        step (rare: warming, indivisible batch, trace failure)."""
+        dev = jax.devices()[0]
+
+        def pull(a):
+            if a is None:
+                return None
+            sh = getattr(a, "sharding", None)
+            if sh is not None and len(getattr(sh, "device_set", ())) > 1:
+                return jax.device_put(a, dev)
+            return a
+
+        def pull_nd(nd_):
+            if nd_ is not None and getattr(nd_, "_data", None) is not None:
+                nd_._data = pull(nd_._data)
+
+        params = self._param_split()[0] if self._block is not None \
+            else list(self._trainer._params)
+        for p in params:
+            pull_nd(p._data)
+            pull_nd(getattr(p, "_grad", None))
+        upd = getattr(self._trainer, "_updater", None)
+        if upd is not None:
+            for st in upd.states.values():
+                for leaf in jax.tree_util.tree_leaves(
+                        st, is_leaf=lambda x: hasattr(x, "_data")):
+                    pull_nd(leaf if hasattr(leaf, "_data") else None)
+
     def _eager_step(self, nd_args, batch_size, ignore_stale_grad):
         """The untraced truth: record, backward, Trainer.step — used for
         warming runs and every fallback, so a fused-ineligible step is
         never a crash, just the eager cost."""
+        if self._mesh is not None:
+            self._unplace_mesh()
         with autograd.record():
             loss = self._call(*nd_args)
         if not isinstance(loss, NDArray):

@@ -176,7 +176,7 @@ class Trainer:
                 args={"batch_size": batch_size,
                       "params": len(self._params)})
 
-    def fuse_step(self, loss_fn, block=None):
+    def fuse_step(self, loss_fn, block=None, mesh=None, bucket_bytes=None):
         """Return a :class:`~mxnet_tpu.gluon.fused_step.FusedTrainStep`
         tracing ``loss_fn`` forward + backward + this trainer's optimizer
         update (all parameters at once) into ONE donated jitted program —
@@ -188,9 +188,21 @@ class Trainer:
         to thread every block parameter through instead). Each call
         replaces the eager record/backward/``step`` triple and falls back
         to it per step whenever the trace can't honor the step (counted
-        in ``profiler.metrics()['fused_step']``, never a crash)."""
+        in ``profiler.metrics()['fused_step']``, never a crash).
+
+        ``mesh`` runs the program data-parallel over the mesh's 'dp'
+        axis with the gradient reduction bucketed and overlapped under
+        the backward (``bucket_bytes`` caps each bucket; default
+        ``MXTPU_ELASTIC_BUCKET_MB``) — see ``gluon.train_step``.
+        Mesh-mode caveat: inside ``shard_map`` BatchNorm normalizes
+        with per-shard (local-batch) statistics and pmean's the moving
+        stats — standard DDP semantics, but NOT what the eager warmup
+        steps (global batch) compute; BN-dependent models should make
+        the per-device batch large enough or use a cross-replica
+        norm."""
         from .fused_step import FusedTrainStep
-        return FusedTrainStep(self, loss_fn, block=block)
+        return FusedTrainStep(self, loss_fn, block=block, mesh=mesh,
+                              bucket_bytes=bucket_bytes)
 
     def allreduce_grads(self):
         """Explicit reduce step for when update() is called separately

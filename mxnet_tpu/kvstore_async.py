@@ -1026,6 +1026,10 @@ class AsyncKVStore:
         self._compression = None
         self._compression_bound = int(os.environ.get(
             "MXNET_KVSTORE_SIZE_LOWER_BOUND", "4096"))
+        # dead ranks already reported by dead_nodes(): growth of this
+        # set is THE elastic signal (counter + trace marker), so the
+        # controller and operators see a rank die exactly once
+        self._known_dead = set()
         # dense arrays >= this many elements are SPLIT across the server
         # group (ref: kvstore_dist.h:58 MXNET_KVSTORE_BIGARRAY_BOUND)
         self._bigarray_bound = int(os.environ.get(
@@ -1345,7 +1349,41 @@ class AsyncKVStore:
         """Ranks whose heartbeat went stale (ref: ps-lite GetDeadNodes,
         kvstore_dist.h:121). A restarted worker resumes beating and
         drops off this list (is_recovery semantics)."""
-        return self._client.dead_nodes(timeout)
+        return self.dead_nodes(timeout)
+
+    def dead_nodes(self, timeout=3.0):
+        """Client-side dead-node poll (the ``_OP_DEADNODES`` wire op):
+        ranks whose heartbeat is staler than ``timeout`` seconds. When
+        the set GROWS, each newly-dead rank counts once into
+        ``profiler.metrics()['elastic']['dead_rank_detected']`` and
+        drops an ``elastic:dead_rank_detected`` instant trace marker —
+        the same signal the :class:`~mxnet_tpu.parallel.elastic.
+        ElasticController` reshards on, so the controller and operators
+        watching the trace/metrics see the failure simultaneously."""
+        dead = self._client.dead_nodes(timeout)
+        cur = set(dead)
+        # a recovered rank (resumed beating: is_recovery semantics)
+        # leaves the known set, so a SECOND death re-counts and
+        # re-marks instead of being swallowed by the first
+        self._known_dead &= cur
+        new = sorted(cur - self._known_dead)
+        if new:
+            self._known_dead.update(new)
+            _profiler.bump_elastic("dead_rank_detected", len(new),
+                                   args={"ranks": new}, lane="kvstore")
+        return dead
+
+    def resize(self, num_workers):
+        """Commit an elastic world change: barriers and the shutdown
+        rendezvous now wait for ``num_workers`` participants. Called by
+        the elastic controller after a reshard so the surviving group
+        can still rendezvous (a barrier sized for the old world would
+        wait forever on the dead)."""
+        num_workers = int(num_workers)
+        if num_workers < 1:
+            raise ValueError("resize needs >= 1 worker, got %d"
+                             % num_workers)
+        self._num_workers = num_workers
 
     def set_server_profiler_command(self, cmd, body=""):
         """Forward a profiler command to every PS server process

@@ -17,14 +17,16 @@ See SURVEY.md §2.4 and §5 "distributed communication backend".
 """
 from .compat import shard_map
 from .mesh import (DeviceMesh, create_mesh, current_mesh, default_mesh_axes,
-                   mesh_scope)
+                   mesh_scope, surviving_devices, shrink_mesh)
 from .collectives import (all_reduce, all_gather, reduce_scatter, all_to_all,
                           ppermute, ring_exchange, host_allreduce,
                           host_barrier, num_hosts, host_rank,
                           initialize_distributed)
 from .sharding import (ShardingStrategy, PartitionRules, data_parallel,
                        fsdp, tensor_parallel, make_param_sharding,
-                       infer_rules_for_block)
+                       infer_rules_for_block, host_array, relayout_params)
+from .overlap import (bucket_plan, tag_gradient_buckets, bucketed_reduce,
+                      default_bucket_bytes)
 from .ring_attention import ring_attention, ring_self_attention, \
     blockwise_attention
 from .ulysses import ulysses_attention
@@ -32,23 +34,30 @@ from .pipeline import pipeline_stages, PipelineStage
 from .expert import MoELayer, top_k_routing
 from .train import ShardedTrainStep, functional_call, extract_params, \
     attach_params
-from .elastic import CheckpointManager, elastic_train_loop, PreemptionGuard
+from .elastic import (CheckpointManager, elastic_train_loop,
+                      PreemptionGuard, ElasticController, HostGradReducer,
+                      ReshardRequired, shard_for_rank)
 from . import transformer
 
 __all__ = [
     "shard_map",
     "DeviceMesh", "create_mesh", "current_mesh", "default_mesh_axes",
-    "mesh_scope",
+    "mesh_scope", "surviving_devices", "shrink_mesh",
     "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute",
     "ring_exchange", "host_allreduce", "host_barrier", "num_hosts",
     "host_rank", "initialize_distributed",
     "ShardingStrategy", "PartitionRules", "data_parallel", "fsdp",
     "tensor_parallel", "make_param_sharding", "infer_rules_for_block",
+    "host_array", "relayout_params",
+    "bucket_plan", "tag_gradient_buckets", "bucketed_reduce",
+    "default_bucket_bytes",
     "ring_attention", "ring_self_attention", "blockwise_attention",
     "ulysses_attention",
     "pipeline_stages", "PipelineStage",
     "MoELayer", "top_k_routing",
     "ShardedTrainStep", "functional_call", "extract_params", "attach_params",
     "CheckpointManager", "elastic_train_loop", "PreemptionGuard",
+    "ElasticController", "HostGradReducer", "ReshardRequired",
+    "shard_for_rank",
     "transformer",
 ]

@@ -30,6 +30,14 @@ def all_reduce(x, axis_name, op="sum"):
     ≙ the whole push+pull of kvstore sync (ref: kvstore_dist.h PushPull):
     one fused ICI allreduce instead of reduce-to-root + broadcast.
     """
+    from .._debug import faultpoint as _faultpoint
+    if _faultpoint.ACTIVE:
+        # fires at trace/launch time (inside shard_map bodies this is
+        # the trace of the program that will carry the collective) —
+        # the injection seam for "a failed collective surfaces as an
+        # exception" (ISSUE 7); the per-call runtime seam is
+        # elastic.HostGradReducer's check of the same point
+        _faultpoint.check("collective.allreduce")
     if op == "sum":
         return lax.psum(x, axis_name)
     if op == "mean":
@@ -91,6 +99,9 @@ def host_allreduce(arrays):
     (ref: kvstore_dist_server.h:346 ApplyUpdates waits for NumWorkers).
     Implemented as a tiny jitted psum over the global device set.
     """
+    from .._debug import faultpoint as _faultpoint
+    if _faultpoint.ACTIVE:
+        _faultpoint.check("collective.allreduce")
     if jax.process_count() == 1:
         return arrays
     import numpy as _np

@@ -24,5 +24,8 @@ __all__ = ["shard_map"]
 @functools.wraps(_shard_map)
 def shard_map(f, mesh, in_specs, out_specs, check_vma=True, **kwargs):
     kwargs[_KWARG] = check_vma
+    # accept the package's DeviceMesh wrapper transparently (every
+    # caller otherwise repeats the getattr unwrap by hand)
+    mesh = getattr(mesh, "mesh", mesh)
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **kwargs)

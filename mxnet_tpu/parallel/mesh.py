@@ -17,7 +17,7 @@ import numpy as _np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["DeviceMesh", "create_mesh", "current_mesh", "default_mesh_axes",
-           "mesh_scope"]
+           "mesh_scope", "surviving_devices", "shrink_mesh"]
 
 # canonical axis order, outermost (slowest/DCN-friendly) to innermost (ICI)
 default_mesh_axes = ("dp", "fsdp", "pp", "ep", "sp", "tp")
@@ -108,6 +108,37 @@ def current_mesh():
     """Innermost active mesh, or None."""
     stack = _stack()
     return stack[-1] if stack else None
+
+
+def surviving_devices(dead_processes, devices=None):
+    """Devices NOT owned by a dead process — the raw material of a
+    post-failure mesh. ``dead_processes`` are jax process indices (the
+    launcher's ``MXTPU_PROC_ID`` ranks in a multi-host job)."""
+    dead = set(int(p) for p in dead_processes)
+    if devices is None:
+        devices = jax.devices()
+    return [d for d in devices if int(d.process_index) not in dead]
+
+
+def shrink_mesh(mesh, dead_processes=(), devices=None):
+    """Rebuild a mesh over the survivors of a host failure (the live
+    resharding primitive, ISSUE 7): every device owned by a dead
+    process is dropped, non-``dp`` axis sizes are preserved, and
+    ``dp`` absorbs the shrink — dp is the outermost/DCN axis, the one a
+    lost host subtracts from. Raises ``ValueError`` when the surviving
+    device count cannot carry the model axes (tp/sp/... no longer
+    divide), in which case the job must wait for a replacement instead
+    of limping (reshard policy 'fail')."""
+    raw = getattr(mesh, "mesh", mesh)
+    if devices is None:
+        devices = surviving_devices(dead_processes,
+                                    list(raw.devices.ravel()))
+    if not devices:
+        raise ValueError("no surviving devices to rebuild the mesh on")
+    sizes = {a: int(s) for a, s in dict(raw.shape).items()
+             if a != "dp" and int(s) > 1}
+    return create_mesh(axes=tuple(raw.axis_names), devices=list(devices),
+                       **sizes)
 
 
 @contextlib.contextmanager

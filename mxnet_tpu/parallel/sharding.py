@@ -14,7 +14,8 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["PartitionRules", "ShardingStrategy", "data_parallel", "fsdp",
-           "tensor_parallel", "make_param_sharding", "infer_rules_for_block"]
+           "tensor_parallel", "make_param_sharding", "infer_rules_for_block",
+           "host_array", "relayout_params"]
 
 
 class PartitionRules:
@@ -91,6 +92,38 @@ def make_param_sharding(mesh, params, rules):
         shape = tuple(v.shape) if hasattr(v, "shape") else tuple(v)
         out[path] = NamedSharding(raw_mesh, rules.spec_for(path, shape))
     return out
+
+
+def host_array(a):
+    """Stage one (possibly sharded) array to host numpy — the transfer
+    half of checkpointing and live resharding. Fully-addressable arrays
+    gather directly; a non-fully-addressable array (multi-host global
+    mesh) is recoverable here only when replicated (each host holds the
+    whole value); genuinely host-sharded state needs the orbax
+    checkpoint path instead."""
+    import numpy as _np
+    if hasattr(a, "is_fully_addressable") and not a.is_fully_addressable:
+        shard = a.addressable_shards[0]
+        if tuple(shard.data.shape) == tuple(a.shape):
+            return _np.asarray(shard.data)
+        raise ValueError(
+            "cannot host-stage a host-sharded global array of shape %s "
+            "(local shard %s); use the orbax checkpoint path for "
+            "non-replicated multi-host state" % (a.shape,
+                                                 shard.data.shape))
+    return _np.asarray(a)
+
+
+def relayout_params(params, strategy):
+    """Re-place a ``{path: array}`` pytree per ``strategy`` — the
+    re-layout half of live resharding (ISSUE 7): after the mesh is
+    rebuilt over the survivors (``mesh.shrink_mesh``), every leaf is
+    staged to host (its old sharding may reference devices that no
+    longer exist) and ``device_put`` under the NamedSharding the
+    strategy's partition rules assign it on the NEW mesh."""
+    shardings = strategy.param_sharding(params)
+    return {k: jax.device_put(host_array(v), shardings[k])
+            for k, v in params.items()}
 
 
 def data_parallel(mesh):

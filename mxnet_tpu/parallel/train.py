@@ -106,8 +106,30 @@ class ShardedTrainStep:
     """
 
     def __init__(self, block, loss_fn, optimizer, strategy=None, mesh=None,
-                 donate=True, remat_policy=None):
-        """remat_policy="conv_outs" wraps the forward in jax.checkpoint
+                 donate=True, remat_policy=None, overlap_grads=False,
+                 bucket_bytes=None):
+        """overlap_grads=True builds the step inside ``shard_map`` with
+        the gradient reduction issued as size-capped bucketed
+        collectives placed MID-BACKWARD (parallel/overlap.py): each
+        bucket's all-reduce is data-ready the moment its backward
+        segment completes, so it can hide under the remaining backward
+        compute — the SCALING_r05 overlap story. Requires a pure
+        data-parallel strategy (replicated params, batch on 'dp');
+        ``bucket_bytes`` caps each bucket (default
+        ``MXTPU_ELASTIC_BUCKET_MB``). Gradient math matches the GSPMD
+        path (mean over the global batch) up to float reassociation
+        for per-sample losses; two semantics differ by construction:
+        dropout draws — each shard folds its 'dp' axis index into the
+        rng key (a replicated key would hand every shard identical
+        mask values) — and cross-batch normalization (BatchNorm):
+        inside ``shard_map`` the block sees only its local shard, so
+        BN normalizes with LOCAL-batch statistics and the moving stats
+        are a pmean of per-shard estimates (standard DDP semantics;
+        the GSPMD path computes true global-batch statistics). Models
+        whose training depends on global-batch BN should keep
+        ``overlap_grads=False`` or use a cross-replica norm.
+
+        remat_policy="conv_outs" wraps the forward in jax.checkpoint
         saving ONLY checkpoint_name-tagged values (conv_out/pool_out/
         bn_stat — see ops/nn.py _ckpt_name): backward recomputes the
         elementwise normalize/activation chains from raw conv outputs,
@@ -151,6 +173,16 @@ class ShardedTrainStep:
         self._batch_sharding = strategy.batch_sharding()
         self._jitted = None
         self._donate = donate
+        self._overlap = bool(overlap_grads)
+        self._bucket_bytes = bucket_bytes
+        if self._overlap:
+            replicated = all(all(p is None for p in sh.spec)
+                             for sh in shardings.values())
+            if strategy.batch_axes != ("dp",) or not replicated:
+                raise ValueError(
+                    "overlap_grads needs a pure data-parallel strategy "
+                    "(replicated params, batch on 'dp'); got %r"
+                    % (strategy,))
 
     def _build(self):
         block, loss_fn, optimizer = self.block, self.loss_fn, self.optimizer
@@ -205,15 +237,97 @@ class ShardedTrainStep:
                 out_shardings=(param_sh, state_sh, None),
                 donate_argnums=(0, 1) if self._donate else ())
 
+    def _build_overlapped(self):
+        """The overlap_grads=True program: same math as ``_build``, but
+        inside ``shard_map`` over the mesh with the gradient reduction
+        issued as bucketed mid-backward collectives
+        (overlap.tag_gradient_buckets) instead of GSPMD's
+        one-AR-per-grad-after-backward lowering."""
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+        from . import overlap as _overlap
+        from .compat import shard_map as _shard_map
+        block, loss_fn, optimizer = self.block, self.loss_fn, self.optimizer
+        paths = self._param_paths
+        raw_mesh = getattr(self.mesh, "mesh", self.mesh)
+        plan = _overlap.bucket_plan([self.params[p] for p in paths],
+                                    self._bucket_bytes)
+
+        def train_step(params, opt_states, x, y, rng):
+            # per-shard rng: a replicated key would hand every 'dp'
+            # shard identical dropout masks
+            rng = jax.random.fold_in(rng, lax.axis_index("dp"))
+
+            def loss_of(ps):
+                # bucket markers BETWEEN the grad variables and their
+                # use: each bucket's pmean fires in the backward right
+                # after its segment produces the last cotangent
+                tagged = _overlap.tag_gradient_buckets(
+                    [ps[p] for p in paths], "dp", plan=plan, op="mean")
+                out, aux = functional_call(block, dict(zip(paths, tagged)),
+                                           [x], training=True, rng=rng,
+                                           return_aux=True)
+                out0 = out[0] if isinstance(out, tuple) else out
+                loss = loss_fn(NDArray(out0), NDArray(y))._data
+                # mean over the LOCAL shard; grads pmean over 'dp' via
+                # the markers == grad of the global-batch mean
+                return jnp.mean(loss), aux
+
+            (loss, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            loss = lax.pmean(loss, "dp")
+            new_params, new_states = {}, {}
+            for i, path in enumerate(paths):
+                w = NDArray(params[path])
+                g = NDArray(grads[path])
+                st = opt_states[path]
+                st_nd = _state_to_nd(st)
+                optimizer.update_multi_precision(i, w, g, st_nd)
+                new_params[path] = w._data
+                new_states[path] = _nd_to_state(st, st_nd)
+            # aux (moving stats) are per-shard estimates: average them
+            # so every replica applies the identical update
+            for path, new in aux.items():
+                if path in new_params:
+                    new_params[path] = lax.pmean(new, "dp")
+            return new_params, new_states, loss
+
+        p_spec = {k: P() for k in paths}
+        state_spec = jax.tree_util.tree_map(
+            lambda a: P(), self.opt_states,
+            is_leaf=lambda l: hasattr(l, "shape"))
+        body = _shard_map(
+            train_step, raw_mesh,
+            in_specs=(p_spec, state_spec, P("dp"), P("dp"), P()),
+            out_specs=(p_spec, state_spec, P()), check_vma=False)
+        param_sh = {k: self._shardings[k] for k in self.params}
+        state_sh = jax.tree_util.tree_map(
+            lambda a: self._shardings_for_state(a), self.opt_states,
+            is_leaf=lambda l: hasattr(l, "shape"))
+        with raw_mesh:
+            # mxlint: disable=MX005 (one overlapped train step per ShardedTrainStep instance; shapes fixed by the strategy, single key)
+            self._jitted = jax.jit(
+                body,
+                in_shardings=(param_sh, state_sh, self._batch_sharding,
+                              self._batch_sharding, None),
+                out_shardings=(param_sh, state_sh, None),
+                donate_argnums=(0, 1) if self._donate else ())
+
     def _shardings_for_state(self, a):
         # states were placed at construction; reuse their current sharding
         return a.sharding
 
+    def _ensure_built(self):
+        if self._jitted is None:
+            if self._overlap:
+                self._build_overlapped()
+            else:
+                self._build()
+
     def step(self, x, y):
         """One async update; returns the loss as a device scalar (no host
         sync — the NDArray wait-to-read discipline, ref: SURVEY §3.1)."""
-        if self._jitted is None:
-            self._build()
+        self._ensure_built()
         xd = x._data if isinstance(x, NDArray) else jnp.asarray(x)
         yd = y._data if isinstance(y, NDArray) else jnp.asarray(y)
         if getattr(xd, "sharding", None) != self._batch_sharding:
@@ -227,8 +341,7 @@ class ShardedTrainStep:
     def lower(self, x, y):
         """AOT-lower the step for inspection (cost analysis, optimized
         HLO) without running it — profiling seam for benchmark/."""
-        if self._jitted is None:
-            self._build()
+        self._ensure_built()
         xd, yd = self.place_batch(x, y)
         return self._jitted.lower(self.params, self.opt_states, xd, yd,
                                   _random.next_key())

@@ -60,6 +60,7 @@ __all__ = [
     "record_clock_sync", "clock_sync", "latency_metrics",
     "serve_metrics", "stop_metrics_server", "prometheus_text",
     "merge_traces", "PID",
+    "marker", "bump_elastic", "elastic_stats", "reset_elastic_stats",
 ]
 
 # chrome-trace pid of every event this process emits: the worker rank.
@@ -514,6 +515,57 @@ def record_flow(name, flow_id, phase, ts_us=None, lane="kvstore",
         _append_locked(ev)
 
 
+def marker(name, args=None, lane="user", category="instant"):
+    """Drop one instant event (chrome ``ph:"i"``) into ``lane`` at the
+    current trace time — the public form of the internal ``_emit`` the
+    faultpoint subsystem uses for ``fault:<point>`` markers. No-op while
+    profiling is off (internally guarded, so call sites off the per-op
+    hot path don't need their own guard)."""
+    if not _ACTIVE:
+        return
+    ev = {"name": name, "cat": category, "ph": "i", "s": "p",
+          "ts": _now_us(), "pid": PID,
+          "tid": LANES.get(lane, LANES["user"])}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _append_locked(ev)
+
+
+# -- elastic-recovery accounting (ISSUE 7) -----------------------------------
+# One shared store for the elastic-training event counters so BOTH sides
+# of the recovery loop — the kvstore dead-node poll (kvstore_async.py)
+# and the controller/checkpoint machinery (parallel/elastic.py) — count
+# into the same ``metrics()['elastic']`` section without kvstore having
+# to import the (heavy) parallel package.
+_elastic = {}   # event name -> count (restores, reshards, preemptions, ...)
+
+
+def bump_elastic(name, delta=1, args=None, lane="user"):
+    """Count one elastic-recovery event into ``metrics()['elastic']``
+    and, while a profile run is active, drop an ``elastic:<name>``
+    instant marker next to the spans it perturbs. The count accumulates
+    UNCONDITIONALLY (same contract as ``account``): recovery accounting
+    must be trustworthy in production, not only under a profile run."""
+    with _lock:
+        _elastic[name] = _elastic.get(name, 0) + delta
+    if _ACTIVE:
+        marker("elastic:%s" % name, args=args, lane=lane,
+               category="elastic")
+
+
+def elastic_stats():
+    """Snapshot of the elastic-recovery event counters — the
+    ``metrics()['elastic']`` section (registered stats provider)."""
+    with _lock:
+        return dict(_elastic)
+
+
+def reset_elastic_stats():
+    with _lock:
+        _elastic.clear()
+
+
 def record_clock_sync(peer, offset_us, rtt_us, primary=False):
     """Record one clock-offset estimate against ``peer`` (an NTP-style
     sample from the kvstore heartbeat path: ``offset_us`` added to THIS
@@ -667,6 +719,11 @@ def register_stats_provider(name, snapshot, reset=None):
     invoked by ``metrics(reset=True)`` / ``dumps(reset=True)``."""
     with _lock:
         _STATS_PROVIDERS[name] = (snapshot, reset)
+
+
+# the elastic-recovery counters live in this module (see bump_elastic);
+# registering them here makes metrics()['elastic'] exist from import
+register_stats_provider("elastic", elastic_stats, reset_elastic_stats)
 
 
 def _provider_sections(reset):
@@ -1072,6 +1129,7 @@ def _reset():
         _mem_last.clear()
         _latency.clear()
         _clock_sync.clear()
+        _elastic.clear()
     reset_imperative_stats()
 
 

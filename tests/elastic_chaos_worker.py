@@ -1,0 +1,151 @@
+"""Worker for the elastic rank-kill chaos test (ISSUE 7 acceptance).
+
+Each rank runs a deterministic synchronous-DP training loop:
+
+- local gradients on a 2-device in-process mesh with BUCKETED,
+  BACKWARD-OVERLAPPED reduction (``parallel.overlap`` inside
+  ``shard_map`` — tentpole b),
+- cross-process reduction through ``parallel.elastic.HostGradReducer``
+  over the async-PS kvstore, summed in sorted-rank order so every rank
+  applies bitwise-identical updates,
+- ``elastic_train_loop`` + ``ElasticController`` + ``CheckpointManager``
+  wrapping the whole thing (tentpole a).
+
+Chaos: the rank named by ``MXTPU_CHAOS_DIE_RANK`` SIGKILLs itself at
+step ``MXTPU_CHAOS_DIE_AT`` (mid-epoch, no cleanup, no done()). The
+survivors' barriers abort naming the dead rank, the controller confirms
+via the heartbeat staleness table, reshards the world onto the
+survivors, rewinds to the newest crash-consistent checkpoint, and the
+job converges. Rank 0 prints the restore/metrics breadcrumbs the test
+asserts on and saves the final params for the bitwise comparison
+against a clean run resumed from the same checkpoint.
+
+Run via: python tools/launch.py --elastic -n 2 python
+         tests/elastic_chaos_worker.py
+(single-process clean-reference mode: MXTPU_NUM_PROCS=1, no kvstore
+barriers — the reducer short-circuits at world size 1.)
+"""
+import json
+import os
+import signal
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import jax.random as jr  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+from mxnet_tpu.parallel import (  # noqa: E402
+    CheckpointManager, ElasticController, HostGradReducer, create_mesh,
+    elastic_train_loop, shard_for_rank, shard_map, tag_gradient_buckets)
+
+DIM = 16
+GLOBAL_BATCH = 8
+W_TRUE = np.linspace(-1.0, 1.0, DIM).astype(np.float32)
+
+
+def gen_batch(step):
+    """Global batch for one step — a pure function of the step index, so
+    any world split of it is epoch-reproducible."""
+    rs = np.random.RandomState(1234 + int(step))
+    X = rs.randn(GLOBAL_BATCH, DIM).astype(np.float32)
+    Y = (X @ W_TRUE).astype(np.float32)
+    return X, Y
+
+
+def make_grad_fn(mesh):
+    """Local-shard loss+grad with the gradient psum bucketed and placed
+    mid-backward (overlap markers) over the in-process 'dp' axis."""
+
+    def body(w, Xl, Yl):
+        def loss_of(wv):
+            (wv_t,) = tag_gradient_buckets([wv], "dp", op="sum")
+            r = Xl @ wv_t - Yl
+            return 0.5 * jnp.sum(r * r)
+
+        loss, g = jax.value_and_grad(loss_of)(w)
+        return jax.lax.psum(loss, "dp"), g
+
+    smapped = shard_map(body, mesh, in_specs=(P(), P("dp"), P("dp")),
+                        out_specs=(P(), P()), check_vma=False)
+    return jax.jit(smapped)
+
+
+def main():
+    rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+    nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+    steps = int(os.environ.get("MXTPU_CHAOS_STEPS", "30"))
+    save_every = int(os.environ.get("MXTPU_CHAOS_SAVE_EVERY", "5"))
+    die_rank = int(os.environ.get("MXTPU_CHAOS_DIE_RANK", "-1"))
+    die_at = int(os.environ.get("MXTPU_CHAOS_DIE_AT", "-1"))
+    ckpt_dir = os.environ["MXTPU_CHAOS_CKPT_DIR"]
+    out_dir = os.environ["MXTPU_CHAOS_OUT_DIR"]
+
+    mesh = create_mesh(devices=jax.devices()[:2])  # local dp=2
+    grad_fn = make_grad_fn(mesh)
+
+    kv = mx.kv.create("dist_async") if nproc > 1 else None
+    reducer = HostGradReducer(kv) if kv is not None else None
+    controller = ElasticController(kvstore=kv, world=range(nproc),
+                                   rank=rank) if kv is not None else None
+
+    restores = []
+
+    def on_restore(state, step):
+        restores.append(int(step))
+        print("ELASTIC_RESTORED rank=%d step=%d world=%s"
+              % (rank, step, controller.survivors if controller
+                 else [0]), flush=True)
+
+    def step_fn(state, idx):
+        idx = int(idx)
+        if rank == die_rank and idx == die_at:
+            # mid-epoch SIGKILL: no cleanup, no done(), heartbeats stop
+            os.kill(os.getpid(), signal.SIGKILL)
+        world = controller.survivors if controller else [0]
+        X, Y = gen_batch(idx)
+        rows = shard_for_rank(GLOBAL_BATCH, world, rank)
+        Xl = jnp.asarray(X[rows.start:rows.stop])
+        Yl = jnp.asarray(Y[rows.start:rows.stop])
+        _, g_local = grad_fn(state["w"], Xl, Yl)
+        g_local = np.asarray(g_local, np.float32)
+        g_total = reducer.allreduce(g_local, world, rank) \
+            if reducer is not None else g_local
+        key, sub = jr.split(state["rng"])
+        noise = 0.001 * jr.normal(sub, (DIM,), jnp.float32)
+        grad = jnp.asarray(g_total) / GLOBAL_BATCH + noise
+        m = 0.9 * state["m"] + grad
+        w = state["w"] - 0.02 * m
+        return {"w": w, "m": m, "rng": key}, None
+
+    ckpt = CheckpointManager(ckpt_dir, keep=50, use_orbax=False)
+    state0 = {"w": jnp.zeros((DIM,), jnp.float32),
+              "m": jnp.zeros((DIM,), jnp.float32),
+              "rng": jr.PRNGKey(7)}
+    state, last, done = elastic_train_loop(
+        step_fn, state0, list(range(steps)), ckpt,
+        save_every=(save_every if rank == 0 else 0),
+        max_failures=3, on_restore=on_restore, controller=controller)
+
+    w = np.asarray(state["w"], np.float32)
+    err = float(np.max(np.abs(w - W_TRUE)))
+    np.save(os.path.join(out_dir, "params_rank%d.npy" % rank), w)
+    print("ELASTIC_METRICS rank=%d %s"
+          % (rank, json.dumps(profiler.metrics().get("elastic", {}))),
+          flush=True)
+    print("ELASTIC_OK rank=%d done=%s last=%d err=%.5f restores=%s"
+          % (rank, done, last, err, restores), flush=True)
+
+    if kv is not None:
+        kv.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,19 +1,38 @@
-"""Elastic training: checkpoint/recovery (parallel/elastic.py).
+"""Elastic training: checkpoint/recovery + live resharding
+(parallel/elastic.py, ISSUE 7).
 
 The reference has only ps-lite heartbeat dead-node detection
 (ref: src/kvstore/kvstore_dist.h:121 GetDeadNodes) and no checkpoint
 recovery (SURVEY §5); these tests pin the TPU-native upgrade: resume
-after simulated collective failures and preemption-save semantics."""
+after simulated collective failures, preemption-save semantics with
+handler chaining, incomplete-checkpoint hygiene, bitwise-deterministic
+resume, controller-driven resharding, and the slow 2-process rank-kill
+chaos run (SIGKILL mid-epoch -> reshard -> converge, bitwise-equal to a
+clean run resumed from the same checkpoint)."""
+import json
 import os
+import shutil
 import signal
+import subprocess
+import sys
+import time
 
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
+import jax.random as jr
 
-from mxnet_tpu.parallel import CheckpointManager, elastic_train_loop
+from mxnet_tpu import profiler
+from mxnet_tpu.parallel import (CheckpointManager, ElasticController,
+                                HostGradReducer, PreemptionGuard,
+                                ReshardRequired, create_mesh,
+                                data_parallel, elastic_train_loop,
+                                relayout_params, shard_for_rank,
+                                shrink_mesh, surviving_devices)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _mgr(tmp_path, **kw):
@@ -121,3 +140,423 @@ def test_preemption_guard_saves_and_exits(tmp_path):
         step, {"acc": jnp.asarray(0.0)}, batches, m, save_every=100)
     assert done2
     np.testing.assert_allclose(float(state2["acc"]), sum(range(10)))
+
+
+# -- incomplete-checkpoint hygiene (ISSUE 7 satellite 1) ----------------------
+
+class TestIncompleteCheckpoints:
+    def test_truncated_newest_restores_previous(self, tmp_path):
+        """A crash between multi-host shard writes leaves a truncated
+        file: it must never be a restore candidate, and the previous
+        complete step must restore."""
+        m = _mgr(tmp_path, use_orbax=False, keep=10)
+        m.save(1, {"x": jnp.arange(3.0)})
+        m.save(2, {"x": jnp.arange(3.0) * 2})
+        p2 = m._step_path(2)
+        data = open(p2, "rb").read()
+        with open(p2, "wb") as f:
+            f.write(data[: len(data) // 2])
+        assert m.latest_step() == 1
+        state, step = m.restore()
+        assert step == 1
+        np.testing.assert_allclose(
+            np.asarray(jax.tree_util.tree_leaves(state)[0]),
+            np.arange(3.0))
+
+    def test_unreadable_but_complete_looking_is_skipped(self, tmp_path):
+        """Corruption past the STOP byte: the cheap probe passes but
+        unpickling fails — restore() walks to the previous step and
+        counts the skip."""
+        m = _mgr(tmp_path, use_orbax=False, keep=10)
+        m.save(1, {"x": jnp.arange(3.0)})
+        with open(m._step_path(5), "wb") as f:
+            f.write(b"\x93garbage-not-a-pickle.")
+        assert m.latest_step() == 5  # probe can't tell
+        before = profiler.elastic_stats().get("incomplete_skipped", 0)
+        state, step = m.restore()
+        assert step == 1
+        assert profiler.elastic_stats().get(
+            "incomplete_skipped", 0) == before + 1
+
+    def test_incomplete_pruned_on_next_save(self, tmp_path):
+        m = _mgr(tmp_path, use_orbax=False, keep=10)
+        m.save(1, {"x": jnp.zeros(2)})
+        # a truncated step + a stale .tmp from a SIGKILLed save
+        with open(m._step_path(2), "wb") as f:
+            f.write(b"\x80\x04trunc")
+        with open(m._step_path(3) + ".tmp", "wb") as f:
+            f.write(b"partial")
+        m.save(4, {"x": jnp.zeros(2)})
+        names = sorted(os.listdir(m.directory))
+        assert names == ["step_1.ckpt", "step_4.ckpt"]
+
+    def test_orbax_dir_without_commit_marker_skipped(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        m = CheckpointManager(str(tmp_path / "o"), use_orbax=True,
+                              keep=10)
+        m.save(1, {"x": jnp.arange(4.0)})
+        m.save(2, {"x": jnp.arange(4.0) * 3})
+        # simulate a crash between multi-host shard writes: step dir
+        # exists but the commit marker never landed
+        os.remove(os.path.join(m._step_path(2), "_COMMIT"))
+        assert m.latest_step() == 1
+        state, step = m.restore()
+        assert step == 1
+        m.save(3, {"x": jnp.arange(4.0)})
+        assert m.all_steps() == [1, 3]
+        assert not os.path.exists(m._step_path(2))
+
+
+# -- PreemptionGuard chaining (ISSUE 7 satellite 2) ---------------------------
+
+_GUARD_SCRIPT = r"""
+import os, signal, sys
+sys.path.insert(0, %(repo)r)
+from mxnet_tpu.parallel.elastic import PreemptionGuard
+
+calls = []
+def old_handler(signum, frame):
+    calls.append(signum)
+signal.signal(signal.SIGTERM, old_handler)
+
+with PreemptionGuard() as g:
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert g.preempted
+    assert calls == [signal.SIGTERM], calls       # chained, once
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert calls == [signal.SIGTERM], calls       # at most once
+    assert g.preempted
+assert signal.getsignal(signal.SIGTERM) is old_handler  # restored
+os.kill(os.getpid(), signal.SIGTERM)
+assert calls == [signal.SIGTERM, signal.SIGTERM], calls
+print("GUARD_OK")
+"""
+
+
+def test_preemption_guard_chains_and_restores():
+    """SIGTERM delivered for real (subprocess): the guard chains to the
+    pre-existing handler exactly once, ignores repeats, and restores
+    the handler on __exit__."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", _GUARD_SCRIPT % {"repo": REPO}],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "GUARD_OK" in r.stdout
+
+
+# -- bitwise-deterministic resume (ISSUE 7 satellite 4) -----------------------
+
+def _noisy_step(state, idx):
+    """Deterministic quadratic step with rng-derived noise: resume is
+    bitwise only if params, momentum AND the rng key round-trip."""
+    rs = np.random.RandomState(100 + int(idx))
+    X = jnp.asarray(rs.randn(4, 8).astype(np.float32))
+    g = X.T @ (X @ state["w"])
+    key, sub = jr.split(state["rng"])
+    g = g + 0.01 * jr.normal(sub, g.shape, jnp.float32)
+    m = 0.9 * state["m"] + g
+    return {"w": state["w"] - 0.05 * m, "m": m, "rng": key}, None
+
+
+def test_bitwise_deterministic_resume(tmp_path):
+    """Kill at step k (SIGTERM mid-run), restart, reach step N: params
+    bitwise-equal to an uninterrupted run (rng + optimizer state
+    round-trip through the checkpoint)."""
+    state0 = {"w": jnp.ones((8,), jnp.float32),
+              "m": jnp.zeros((8,), jnp.float32),
+              "rng": jr.PRNGKey(3)}
+    batches = list(range(12))
+
+    m_a = CheckpointManager(str(tmp_path / "a"), use_orbax=False)
+    s_a, last_a, done_a = elastic_train_loop(
+        _noisy_step, dict(state0), batches, m_a, save_every=3)
+    assert done_a and last_a == 11
+
+    m_b = CheckpointManager(str(tmp_path / "b"), use_orbax=False,
+                            keep=10)
+
+    def killing_step(state, idx):
+        out = _noisy_step(state, idx)
+        if int(idx) == 7:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return out
+
+    s_b1, last_b1, done_b1 = elastic_train_loop(
+        killing_step, dict(state0), batches, m_b, save_every=3)
+    assert not done_b1 and last_b1 == 7
+    # second incarnation resumes from the preemption checkpoint
+    s_b, last_b, done_b = elastic_train_loop(
+        _noisy_step, dict(state0), batches, m_b, save_every=3)
+    assert done_b and last_b == 11
+    for k in ("w", "m", "rng"):
+        assert np.array_equal(np.asarray(s_a[k]), np.asarray(s_b[k])), k
+
+
+# -- controller / resharding --------------------------------------------------
+
+class _FakeKV:
+    def __init__(self, nworkers=3):
+        self.dead = []
+        self.num_workers = nworkers
+        self.resized = []
+
+    def dead_nodes(self, timeout=3.0):
+        return list(self.dead)
+
+    def resize(self, n):
+        self.resized.append(int(n))
+        self.num_workers = int(n)
+
+
+class TestElasticController:
+    def test_poll_reshard_cycle(self):
+        kv = _FakeKV(3)
+        seen = []
+        ctl = ElasticController(kvstore=kv, world=range(3), rank=0,
+                                poll_interval=0.0,
+                                reshard_fn=lambda st, w: seen.append(
+                                    (st, list(w))))
+        assert ctl.poll() == []
+        kv.dead = [2]
+        assert ctl.poll(force=True) == [2]
+        assert ctl.poll(force=True) == []          # reported once
+        assert ctl.survivors == [0, 1]
+        assert ctl.handle_failure(RuntimeError("boom"))
+        before = profiler.elastic_stats().get("reshards", 0)
+        survivors, _ = ctl.reshard({"s": 1})
+        assert survivors == [0, 1]
+        assert kv.resized == [2]
+        assert seen == [({"s": 1}, [0, 1])]
+        assert profiler.elastic_stats().get("reshards", 0) == before + 1
+        # dead rank no longer in the committed world: a later transient
+        # failure is retried, not resharded
+        assert not ctl.handle_failure(RuntimeError("transient"))
+
+    def test_poll_ignores_out_of_world_deaths(self):
+        # a controller scoped to a sub-world of a shared PS (or one
+        # whose world already shrank) must not reshard-and-rewind when
+        # a rank OUTSIDE its committed world dies
+        kv = _FakeKV(4)
+        ctl = ElasticController(kvstore=kv, world=[0, 1], rank=0,
+                                poll_interval=0.0)
+        kv.dead = [3]
+        assert ctl.poll(force=True) == []          # noted, not actionable
+        assert 3 in ctl._dead                      # still tracked
+        assert not ctl.handle_failure(RuntimeError("transient"))
+        kv.dead = [1, 3]                           # now an in-world death
+        assert ctl.poll(force=True) == [1]
+
+    def test_fail_policy_raises(self):
+        kv = _FakeKV(2)
+        kv.dead = [1]
+        ctl = ElasticController(kvstore=kv, world=range(2), rank=0,
+                                poll_interval=0.0,
+                                reshard_policy="fail")
+        ctl.poll(force=True)
+        with pytest.raises(ReshardRequired):
+            ctl.reshard()
+
+    def test_loop_reshards_on_dead_rank(self, tmp_path):
+        """A step failure attributed to a dead rank reshards (instead of
+        exhausting max_failures) and the loop completes on the
+        survivors."""
+        kv = _FakeKV(2)
+        ctl = ElasticController(kvstore=kv, world=range(2), rank=0,
+                                poll_interval=0.0)
+        m = _mgr(tmp_path, use_orbax=False)
+        live_world = []
+
+        def step(state, b):
+            world = ctl.survivors
+            if int(b) == 3 and len(world) == 2:
+                kv.dead = [1]          # rank 1 vanishes mid-epoch
+                raise ConnectionError("collective failed: peer gone")
+            live_world.append((int(b), list(world)))
+            return {"acc": state["acc"] + b}, None
+
+        state, last, done = elastic_train_loop(
+            step, {"acc": jnp.asarray(0.0)},
+            [jnp.asarray(float(i)) for i in range(6)], m,
+            save_every=1, max_failures=0, controller=ctl)
+        assert done and last == 5
+        assert kv.resized == [1]
+        # steps 3.. ran on the shrunk world
+        assert ([w for b, w in live_world if b == 3][-1]) == [0]
+        np.testing.assert_allclose(float(state["acc"]), sum(range(6)))
+
+    def test_shard_for_rank_is_deterministic_partition(self):
+        for n in (1, 2, 3, 5, 8):
+            world = list(range(n))
+            all_rows = []
+            for r in world:
+                all_rows.extend(shard_for_rank(10, world, r))
+            assert sorted(all_rows) == list(range(10))
+        # pure function of (n_items, world, rank): survivors agree
+        assert list(shard_for_rank(8, [0, 2], 2)) == [4, 5, 6, 7]
+        assert list(shard_for_rank(8, [0], 0)) == list(range(8))
+
+
+class TestMeshResharding:
+    def test_shrink_mesh_drops_dead_process_devices(self):
+        mesh = create_mesh(dp=8)
+        # single-host CPU: every device is process 0; simulate a lost
+        # host by dropping half the devices explicitly
+        keep = list(jax.devices()[:4])
+        small = shrink_mesh(mesh, devices=keep)
+        assert small.size() == 4
+        assert dict(small.shape)["dp"] == 4
+        assert surviving_devices([], keep) == keep
+
+    def test_shrink_preserves_model_axes(self):
+        mesh = create_mesh(dp=4, tp=2)
+        small = shrink_mesh(mesh, devices=list(jax.devices()[:4]))
+        assert dict(small.shape)["tp"] == 2
+        assert dict(small.shape)["dp"] == 2
+        with pytest.raises(ValueError):
+            shrink_mesh(mesh, devices=[])
+
+    def test_relayout_params_onto_new_mesh(self):
+        mesh = create_mesh(dp=8)
+        strategy = data_parallel(mesh)
+        params = {"w": jnp.arange(16.0).reshape(4, 4)}
+        placed = relayout_params(params, strategy)
+        assert len(placed["w"].sharding.device_set) == 8
+        small = shrink_mesh(mesh, devices=list(jax.devices()[:2]))
+        placed2 = relayout_params(placed, data_parallel(small))
+        assert len(placed2["w"].sharding.device_set) == 2
+        np.testing.assert_array_equal(np.asarray(placed2["w"]),
+                                      np.asarray(params["w"]))
+
+
+class TestDeadNodeSignal:
+    def test_kvstore_dead_nodes_counts_growth_once(self, monkeypatch):
+        """AsyncKVStore.dead_nodes wraps the _OP_DEADNODES wire op and
+        counts each newly-dead rank exactly once into
+        metrics()['elastic']['dead_rank_detected']."""
+        import mxnet_tpu as mx
+        monkeypatch.delenv("MXTPU_COORDINATOR", raising=False)
+        monkeypatch.setenv("MXTPU_PROC_ID", "0")
+        monkeypatch.setenv("MXTPU_NUM_PROCS", "1")
+        monkeypatch.setenv("MXTPU_ASYNC_PS_PORT", "0")
+        # keep our own rank-0 heartbeat fresher than the poll timeout
+        # so only the ghost rank goes stale
+        monkeypatch.setenv("MXTPU_PS_HEARTBEAT_INTERVAL", "0.05")
+        kv = mx.kv.create("dist_async")
+        try:
+            from mxnet_tpu.kvstore_async import AsyncPSClient
+            ghost = AsyncPSClient("127.0.0.1", kv._server.port)
+            ghost.heartbeat(9)     # one beat, then silence
+            time.sleep(0.3)
+            before = profiler.elastic_stats().get(
+                "dead_rank_detected", 0)
+            dead = kv.dead_nodes(timeout=0.2)
+            assert 9 in dead
+            after = profiler.elastic_stats().get("dead_rank_detected", 0)
+            assert after == before + 1
+            kv.dead_nodes(timeout=0.2)   # same set: no re-count
+            assert profiler.elastic_stats().get(
+                "dead_rank_detected", 0) == after
+        finally:
+            kv.close()
+
+    def test_resize_validates(self, monkeypatch):
+        import mxnet_tpu as mx
+        monkeypatch.delenv("MXTPU_COORDINATOR", raising=False)
+        monkeypatch.setenv("MXTPU_PROC_ID", "0")
+        monkeypatch.setenv("MXTPU_NUM_PROCS", "1")
+        monkeypatch.setenv("MXTPU_ASYNC_PS_PORT", "0")
+        kv = mx.kv.create("dist_async")
+        try:
+            with pytest.raises(ValueError):
+                kv.resize(0)
+            kv.resize(1)
+            assert kv.num_workers == 1
+        finally:
+            kv.close()
+
+
+# -- slow: the ISSUE 7 acceptance chaos run -----------------------------------
+
+@pytest.mark.slow
+def test_rank_kill_chaos_reshards_and_resumes_bitwise(tmp_path):
+    """2 processes, rank 1 SIGKILLed mid-epoch: the job reshards onto
+    the survivor, resumes from the newest crash-consistent checkpoint,
+    converges (no hang — bounded by the barrier/retry deadlines), the
+    recovery is fully accounted in metrics()['elastic'], and the final
+    params are BITWISE equal to a clean run resumed from the same
+    checkpoint."""
+    ckpt = tmp_path / "ckpt"
+    out = tmp_path / "out"
+    ckpt.mkdir()
+    out.mkdir()
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+        "MXTPU_CHAOS_CKPT_DIR": str(ckpt),
+        "MXTPU_CHAOS_OUT_DIR": str(out),
+        "MXTPU_CHAOS_DIE_RANK": "1", "MXTPU_CHAOS_DIE_AT": "13",
+        "MXTPU_CHAOS_STEPS": "30", "MXTPU_CHAOS_SAVE_EVERY": "5",
+        "MXTPU_PS_HEARTBEAT_INTERVAL": "0.1",
+        "MXTPU_PS_BARRIER_TIMEOUT": "4",
+        "MXTPU_PS_DEAD_TIMEOUT": "1.0",
+        "MXTPU_ELASTIC_POLL_S": "0.2",
+        "MXTPU_PS_DONE_TIMEOUT": "10",
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "--elastic", "-n", "2", sys.executable,
+         os.path.join(REPO, "tests", "elastic_chaos_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    log = r.stdout + r.stderr
+    assert r.returncode == 0, log              # no hang, survivor clean
+    assert "ELASTIC_OK rank=0 done=True" in log, log
+
+    # full fault accounting in metrics()['elastic']
+    metrics_line = [ln for ln in log.splitlines()
+                    if ln.startswith("ELASTIC_METRICS rank=0")][0]
+    elastic = json.loads(metrics_line.split(None, 2)[2])
+    assert elastic.get("dead_rank_detected", 0) >= 1, elastic
+    assert elastic.get("reshards", 0) == 1, elastic
+    assert elastic.get("restores", 0) >= 1, elastic
+    assert elastic.get("checkpoint_saves", 0) >= 2, elastic
+
+    # the survivor restored from this step after the SIGKILL
+    restored = [int(ln.split("step=")[1].split()[0])
+                for ln in log.splitlines()
+                if ln.startswith("ELASTIC_RESTORED rank=0")]
+    assert restored, log
+    resumed_from = restored[0]
+    assert resumed_from < 13
+
+    w_chaos = np.load(out / "params_rank0.npy")
+    # convergence: error vs the generating weights shrank from 1.0
+    from tests.elastic_chaos_worker import W_TRUE
+    assert float(np.max(np.abs(w_chaos - W_TRUE))) < 0.6
+
+    # clean reference: a fresh single-process incarnation resumed from
+    # the SAME checkpoint must reach bitwise-identical params
+    ckpt2 = tmp_path / "ckpt_clean"
+    ckpt2.mkdir()
+    shutil.copy(ckpt / ("step_%d.ckpt" % resumed_from),
+                ckpt2 / ("step_%d.ckpt" % resumed_from))
+    out2 = tmp_path / "out_clean"
+    out2.mkdir()
+    env2 = dict(env)
+    env2.update({
+        "MXTPU_NUM_PROCS": "1", "MXTPU_PROC_ID": "0",
+        "MXTPU_CHAOS_CKPT_DIR": str(ckpt2),
+        "MXTPU_CHAOS_OUT_DIR": str(out2),
+        "MXTPU_CHAOS_DIE_RANK": "-1", "MXTPU_CHAOS_DIE_AT": "-1",
+    })
+    r2 = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tests", "elastic_chaos_worker.py")],
+        env=env2, capture_output=True, text=True, timeout=300)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert ("ELASTIC_RESTORED rank=0 step=%d" % resumed_from) \
+        in r2.stdout
+    w_clean = np.load(out2 / "params_rank0.npy")
+    assert np.array_equal(w_chaos, w_clean), \
+        np.max(np.abs(w_chaos - w_clean))

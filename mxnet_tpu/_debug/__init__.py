@@ -6,5 +6,6 @@ their own disabled fast paths, so production runs pay (almost) nothing.
 """
 from . import locktrace
 from . import faultpoint
+from . import flightrec
 
-__all__ = ["locktrace", "faultpoint"]
+__all__ = ["locktrace", "faultpoint", "flightrec"]

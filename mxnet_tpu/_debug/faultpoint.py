@@ -300,9 +300,15 @@ def check(name):
 
 def _mark(name, action):
     """Instant marker in the trace so injected faults are visible next to
-    the spans they perturb. Lazy profiler import: profiler imports this
-    package at module load (the stats-provider registration), so a
-    top-level import here would be circular."""
+    the spans they perturb — and in the always-on flight-recorder ring,
+    where a ``fault:*`` breadcrumb right before a crash dump is exactly
+    the evidence a post-mortem wants. Lazy profiler import: profiler
+    imports this package at module load (the stats-provider
+    registration), so a top-level import here would be circular."""
+    from . import flightrec as _flightrec
+    if _flightrec.ENABLED:
+        _flightrec.record_marker("fault:%s" % name, "fault",
+                                 args={"action": action})
     from .. import profiler as _profiler
     if _profiler._ACTIVE:
         _profiler._emit("fault:%s" % name, "i", "fault",

@@ -1,0 +1,312 @@
+"""Step watchdog: hang and straggler detection for training loops.
+
+Dean & Barroso's tail-at-scale argument applies with a vengeance to
+synchronous training: one wedged collective or one straggling rank sets
+the fleet's step time, and a job nobody is watching just silently runs
+3x slow (or not at all). This module closes that gap with a progress
+beacon + a daemon thread:
+
+- The fused train step (``gluon/fused_step.py``) and
+  ``parallel.elastic_train_loop`` bracket every step with
+  ``step_begin()`` / ``step_end()`` (re-entrant: nested loops count the
+  outermost step only).
+- Completed non-warmup step durations feed a rolling-median window.
+  Once ``MXTPU_WATCHDOG_MIN_SAMPLES`` steps completed, the watchdog is
+  *armed* with threshold ``max(MXTPU_WATCHDOG_FACTOR * median,
+  MXTPU_WATCHDOG_MIN_S)``.
+- A daemon thread polls the in-flight step; one that exceeds the
+  threshold is a **stall**: counted (``metrics()['watchdog']``), marked
+  in the trace, and the flight recorder dumps a post-mortem shard —
+  exactly once per stall, so a wedged collective yields one readable
+  black box, not a dump storm.
+- Completed steps beyond the threshold count as ``slow_steps``
+  (stragglers that eventually finished).
+
+Warm-up discipline: the first steps of a run (eager warming + the jit
+compile) are slow by construction. They are excluded from the median
+(the beacon flags them ``warmup=True``) and the watchdog is not armed
+until enough representative steps completed — the compile step can
+never false-positive. After arming, a *re*trace (shape churn) or a
+wedged collective that exceeds the threshold does trip: that is the
+black box working as intended.
+
+The per-rank half: ``last_step()`` exposes the newest completed step's
+(seq, duration) and the async-PS client rides it on every v1 heartbeat
+(``kvstore_async``), so the PS server computes cross-rank skew and
+names stragglers in ``metrics()['kvstore_server']`` and ``/metrics``
+without any extra wire round trip.
+
+Env knobs (docs/ENV_VARS.md): ``MXTPU_WATCHDOG`` (default 1),
+``MXTPU_WATCHDOG_FACTOR`` (default 8), ``MXTPU_WATCHDOG_MIN_S``
+(default 5), ``MXTPU_WATCHDOG_POLL_S`` (default min_s/5, clamped to
+[0.02, 1]), ``MXTPU_WATCHDOG_WINDOW`` (default 32),
+``MXTPU_WATCHDOG_MIN_SAMPLES`` (default 3).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import statistics
+import threading
+import time
+
+from . import flightrec as _flightrec
+from . import locktrace as _locktrace
+
+__all__ = [
+    "ENABLED", "configure", "reset", "step_begin", "step_end",
+    "last_step", "threshold_s", "stats", "check_now",
+]
+
+
+def _envf(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+ENABLED = os.environ.get("MXTPU_WATCHDOG", "1") not in ("0", "false",
+                                                        "off")
+
+_lock = _locktrace.named_lock("watchdog.state")
+_cfg = {}        # factor/min_s/poll_s/window/min_samples (see _defaults)
+_seq = 0         # beacon sequence: id of the newest step_begin
+_depth = 0       # re-entrancy: nested loops track the OUTER step
+_inflight = None  # (seq, monotonic start) of the running outer step
+_inflight_warmup = False  # a nested warmup end taints the outer step
+_last = None     # (seq, dur_s) of the newest COMPLETED step
+_tripped = None  # seq already dumped for — exactly one dump per stall
+_stats = {"steps": 0, "warmup_steps": 0, "stalls": 0, "dumps": 0,
+          "slow_steps": 0, "armed": 0, "median_s": 0.0,
+          "threshold_s": 0.0, "last_stall_step": -1,
+          "last_stall_elapsed_s": 0.0}
+_thread = None
+_stop = None
+
+
+def _defaults():
+    return {
+        "factor": _envf("MXTPU_WATCHDOG_FACTOR", 8.0),
+        "min_s": _envf("MXTPU_WATCHDOG_MIN_S", 5.0),
+        "poll_s": _envf("MXTPU_WATCHDOG_POLL_S", 0.0),  # 0 = derive
+        "window": int(_envf("MXTPU_WATCHDOG_WINDOW", 32)),
+        "min_samples": int(_envf("MXTPU_WATCHDOG_MIN_SAMPLES", 3)),
+    }
+
+
+_cfg.update(_defaults())
+
+# completed non-warmup durations; sized AFTER the env knobs are read so
+# MXTPU_WATCHDOG_WINDOW applies from import, not only after reset()
+_durs = collections.deque(maxlen=max(1, _cfg["window"]))
+
+
+def configure(factor=None, min_s=None, poll_s=None, window=None,
+              min_samples=None, enabled=None):
+    """Override the env-derived knobs at runtime (tests, notebooks)."""
+    global ENABLED, _durs
+    with _lock:
+        if factor is not None:
+            _cfg["factor"] = float(factor)
+        if min_s is not None:
+            _cfg["min_s"] = float(min_s)
+        if poll_s is not None:
+            _cfg["poll_s"] = float(poll_s)
+        if min_samples is not None:
+            _cfg["min_samples"] = int(min_samples)
+        if window is not None:
+            _cfg["window"] = int(window)
+            _durs = collections.deque(_durs, maxlen=max(1, int(window)))
+    if enabled is not None:
+        ENABLED = bool(enabled)
+
+
+def reset():
+    """Stop the poller and clear all state; knobs re-read from the env
+    (test isolation)."""
+    global _seq, _depth, _inflight, _last, _tripped, _thread, _stop
+    global ENABLED, _durs
+    with _lock:
+        stop, thread = _stop, _thread
+        _thread = _stop = None
+    if stop is not None:
+        stop.set()
+    if thread is not None and thread.is_alive():
+        thread.join(timeout=5)
+    with _lock:
+        _seq = _depth = 0
+        _inflight = _last = _tripped = None
+        _cfg.clear()
+        _cfg.update(_defaults())
+        _durs = collections.deque(maxlen=_cfg["window"])
+        for k in _stats:
+            _stats[k] = -1 if k == "last_stall_step" else 0
+        _stats["median_s"] = _stats["threshold_s"] = 0.0
+        _stats["last_stall_elapsed_s"] = 0.0
+    ENABLED = os.environ.get("MXTPU_WATCHDOG", "1") not in (
+        "0", "false", "off")
+
+
+def _poll_interval():
+    p = _cfg["poll_s"]
+    if p > 0:
+        return p
+    return min(1.0, max(0.02, _cfg["min_s"] / 5.0))
+
+
+def _median_locked():
+    return statistics.median(_durs) if _durs else 0.0
+
+
+def threshold_s():
+    """Current stall threshold in seconds, or ``None`` while unarmed
+    (not enough representative completed steps yet)."""
+    with _lock:
+        if len(_durs) < _cfg["min_samples"]:
+            return None
+        return max(_cfg["factor"] * _median_locked(), _cfg["min_s"])
+
+
+def last_step():
+    """(seq, duration_s) of the newest completed step, or None — the
+    per-rank gauge the kvstore heartbeat carries to the PS server."""
+    return _last
+
+
+def stats():
+    """Flat JSON-safe snapshot — ``profiler.metrics()['watchdog']``."""
+    with _lock:
+        out = dict(_stats)
+        out["median_s"] = round(_median_locked(), 6)
+        thr = (max(_cfg["factor"] * _median_locked(), _cfg["min_s"])
+               if len(_durs) >= _cfg["min_samples"] else 0.0)
+        out["threshold_s"] = round(thr, 6)
+        out["armed"] = int(len(_durs) >= _cfg["min_samples"])
+        out["enabled"] = int(ENABLED)
+    return out
+
+
+def step_begin():
+    """Mark the start of a training step (re-entrant). Starts the
+    poller thread lazily on first use when the watchdog is enabled."""
+    global _seq, _depth, _inflight, _inflight_warmup
+    if not ENABLED:
+        return
+    with _lock:
+        _depth += 1
+        if _depth > 1:
+            return  # nested loop: the outer step owns the beacon
+        _seq += 1
+        _inflight = (_seq, time.monotonic())
+        _inflight_warmup = False
+    _ensure_thread()
+
+
+def step_end(warmup=False):
+    """Mark the end of the innermost-begun step. ``warmup=True`` steps
+    (eager warming, jit compile, fallbacks) complete the beacon but do
+    not feed the median — they are not representative of steady state.
+    A nested warmup end taints the whole outer step: when
+    ``elastic_train_loop``'s beacon wraps a fused step whose inner end
+    reported warmup, the outer completion is warmup too (the outer
+    duration CONTAINS the compile)."""
+    global _depth, _inflight, _last, _inflight_warmup
+    if not ENABLED:
+        return
+    with _lock:
+        if _depth == 0:
+            return
+        _depth -= 1
+        if warmup:
+            _inflight_warmup = True
+        if _depth > 0 or _inflight is None:
+            return
+        seq, t0 = _inflight
+        _inflight = None
+        warmup = warmup or _inflight_warmup
+        _inflight_warmup = False
+        dur = time.monotonic() - t0
+        _last = (seq, dur)
+        if warmup:
+            _stats["warmup_steps"] += 1
+            return
+        _stats["steps"] += 1
+        thr = (max(_cfg["factor"] * _median_locked(), _cfg["min_s"])
+               if len(_durs) >= _cfg["min_samples"] else None)
+        _durs.append(dur)
+        if thr is not None and dur > thr and seq != _tripped:
+            # finished, but way beyond the envelope: a straggler
+            # (the in-flight poller may already have dumped for it)
+            _stats["slow_steps"] += 1
+
+
+def check_now():
+    """Force one poll pass synchronously (tests; also useful from a
+    debugger). Returns True when it tripped."""
+    return _check(time.monotonic())
+
+
+def _check(now):
+    global _tripped
+    with _lock:
+        if _inflight is None or len(_durs) < _cfg["min_samples"]:
+            return False
+        seq, t0 = _inflight
+        if seq == _tripped:
+            return False
+        thr = max(_cfg["factor"] * _median_locked(), _cfg["min_s"])
+        elapsed = now - t0
+        if elapsed <= thr:
+            return False
+        _tripped = seq
+        _stats["stalls"] += 1
+        _stats["last_stall_step"] = seq
+        _stats["last_stall_elapsed_s"] = round(elapsed, 3)
+        median = _median_locked()
+    from .. import profiler as _profiler
+    _profiler.marker("watchdog:stall",
+                     args={"step": seq, "elapsed_s": round(elapsed, 3),
+                           "threshold_s": round(thr, 3)},
+                     category="watchdog")
+    path = _flightrec.dump(
+        "watchdog",
+        extra={"step": seq, "elapsed_s": round(elapsed, 3),
+               "threshold_s": round(thr, 3),
+               "median_step_s": round(median, 6)},
+        swallow=True)
+    if path is not None:
+        with _lock:
+            _stats["dumps"] += 1
+    return True
+
+
+def _loop(stop):
+    while not stop.wait(_poll_interval()):
+        try:
+            _check(time.monotonic())
+        except Exception:
+            pass  # the watchdog must never take the training loop down
+
+
+def _ensure_thread():
+    global _thread, _stop
+    with _lock:
+        if _thread is not None and _thread.is_alive():
+            return
+        _stop = threading.Event()
+        _thread = threading.Thread(target=_loop, args=(_stop,),
+                                   daemon=True, name="mxtpu-watchdog")
+        # start() UNDER the lock: it does not wait for the thread body
+        # (which takes the lock itself), and a concurrent step_begin
+        # must never observe a created-but-unstarted (is_alive()
+        # False) thread and orphan it with a second poller
+        _thread.start()
+
+
+# surfaces as metrics()['watchdog'] and a dumps() provider line;
+# registered here (watchdog is imported by fused_step/kvstore, after
+# the profiler module is fully loaded — no cycle)
+from .. import profiler as _profiler  # noqa: E402
+
+_profiler.register_stats_provider("watchdog", stats)

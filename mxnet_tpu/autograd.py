@@ -195,7 +195,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     if len(heads) != len(head_grads):
         raise ValueError("heads and head_grads must have the same length")
 
-    t0 = _time.perf_counter() if _profiler._ACTIVE else None
+    t0 = _time.perf_counter() if _profiler._LIVE else None
     grads = _run_backward(heads, head_grads, retain_graph)
     if t0 is not None:
         _profiler.record_op("autograd.backward",
@@ -247,7 +247,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     if create_graph:
         return _grad_create_graph(heads, variables, head_grads, single)
 
-    t0 = _time.perf_counter() if _profiler._ACTIVE else None
+    t0 = _time.perf_counter() if _profiler._LIVE else None
     grads = _run_backward(heads, head_grads, retain_graph,
                           targets=variables)
     if t0 is not None:

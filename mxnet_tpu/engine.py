@@ -149,7 +149,7 @@ def wait_for_all():
     import jax
     import time as _time
     from . import profiler as _profiler
-    t0 = _time.perf_counter() if _profiler._ACTIVE else None
+    t0 = _time.perf_counter() if _profiler._LIVE else None
     if _locktrace.ENABLED:
         _locktrace.boundary("engine.wait_for_all")
     _flush_pending_segment()

@@ -60,6 +60,7 @@ from .. import random as _random
 from ..ndarray import NDArray
 from ..ndarray import register as _register
 from .._debug import faultpoint as _faultpoint
+from .._debug import watchdog as _watchdog
 from ..optimizer.optimizer import _is_low_precision
 from .block import make_pure_forward
 
@@ -82,6 +83,8 @@ _STATS = {
                      # input/param avals — shape churn indicator
     "fallbacks": 0,  # step took the eager path for an eligibility or
                      # trace-failure reason (see the span's mode arg)
+    "attr_errors": 0,  # compile-attribution bookkeeping failed after a
+                       # committed compile step (telemetry lost, step kept)
 }
 
 
@@ -112,6 +115,33 @@ def reset_stats():
 
 # surfaces as metrics()['fused_step'] and a dumps() line
 _profiler.register_stats_provider("fused_step", stats, reset_stats)
+
+
+# benchmark/comm_model.py is the ONE home of the wire-time formula and
+# the v5e model assumptions (deduped there by the PR 7 review); it
+# lives beside the package, not inside it, so load it by path — and
+# degrade to attribution-less operation when the tree layout differs
+# (an installed wheel without the benchmark/ dir).
+_COMM_MODEL_UNSET = object()
+_COMM_MODEL = _COMM_MODEL_UNSET
+
+
+def _load_comm_model():
+    global _COMM_MODEL
+    if _COMM_MODEL is _COMM_MODEL_UNSET:
+        try:
+            import importlib.util
+            path = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                "benchmark", "comm_model.py")
+            spec = importlib.util.spec_from_file_location(
+                "_mxtpu_comm_model", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _COMM_MODEL = mod
+        except Exception:
+            _COMM_MODEL = None
+    return _COMM_MODEL
 
 
 def _state_to_data(state):
@@ -188,6 +218,13 @@ class FusedTrainStep:
         self._partial_keys = set()  # configs compiled (retrace detection)
         self._failed_keys = set()   # signatures that failed to trace
         self.last_mode = None   # how the previous call executed
+        self._aot = None        # (compiled, cost, hlo) from the last AOT
+        # signature -> modeled compute/comm split (ISSUE 8c): keyed like
+        # _cache so a run alternating compiled signatures (main batch +
+        # remainder shape) never subtracts the OTHER program's modeled
+        # device time from this step's wall time
+        self._attr_models = {}
+        self._step_attr = None  # the executing step's model (set by hits)
 
     # -- public ------------------------------------------------------------
     def __call__(self, *args, batch_size=None, ignore_stale_grad=False):
@@ -197,13 +234,18 @@ class FusedTrainStep:
         if batch_size is None:
             batch_size = int(nd_args[0].shape[0]) \
                 if nd_args and nd_args[0].shape else 1
-        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        # watchdog beacon: the outermost in-flight step the stall
+        # detector watches; non-"fused" completions are warm-up/compile/
+        # fallback shapes and stay out of the rolling median
+        _watchdog.step_begin()
+        t0 = _time.perf_counter() if _profiler._LIVE else None
         mode = "error"
         try:
             loss, mode = self._dispatch(nd_args, batch_size,
                                         ignore_stale_grad)
         finally:
             self.last_mode = mode
+            _watchdog.step_end(warmup=mode != "fused")
             if t0 is not None:
                 dur_us = (_time.perf_counter() - t0) * 1e6
                 _profiler.record_op(
@@ -214,6 +256,15 @@ class FusedTrainStep:
                 # the latency histogram ROADMAP item 1's serve gate
                 # reports p50/p99 from (metrics()['latency'])
                 _profiler.record_latency("fused_step.step", dur_us)
+                if mode == "fused" and self._step_attr is not None:
+                    # host share of THIS step = measured wall minus the
+                    # modeled device time of the program that EXECUTED
+                    # it — the latency series behind the dumps()
+                    # attribution row
+                    host = dur_us - self._step_attr["device_us"]
+                    if host > 0:
+                        _profiler.record_latency("fused_step.host_us",
+                                                 host)
         return loss
 
     # -- dispatch ----------------------------------------------------------
@@ -257,6 +308,7 @@ class FusedTrainStep:
         entry = self._cache.get(key)
         if entry is not None:
             _STATS["hits"] += 1
+            self._step_attr = self._attr_models.get(key)
             return self._run(entry, all_params, train_pos, indices, states,
                             nd_args, batch_size), "fused"
 
@@ -271,13 +323,26 @@ class FusedTrainStep:
         if len(self._cache) >= _CACHE_CAP:
             self._cache.clear()
             self._partial_keys.clear()
+            self._attr_models.clear()
         if partial in self._partial_keys:
             _STATS["retraces"] += 1
         self._partial_keys.add(partial)
         try:
+            c0 = _time.perf_counter()
+            self._aot = None
             entry = self._build(all_params, train_pos)
             loss = self._run(entry, all_params, train_pos, indices, states,
-                             nd_args, batch_size)
+                             nd_args, batch_size, aot=True)
+            if self._aot is not None:
+                # keep the AOT-compiled executable: jit's internal cache
+                # does not share the AOT compilation, so calling the
+                # plain jitted fn next step would compile a second time
+                compiled, cost, hlo = self._aot
+                entry = (compiled,) + tuple(entry[1:])
+                self._aot = None
+            else:
+                cost = hlo = None
+            compile_us = (_time.perf_counter() - c0) * 1e6
         except Exception:
             # trace-incompatible step (data-dependent control flow, host
             # callback, ...): remember the signature and run the genuine
@@ -290,6 +355,16 @@ class FusedTrainStep:
                                     ignore_stale_grad), \
                 "fallback:trace-failed"
         self._cache[key] = entry
+        # attribution AFTER the step committed, outside the trace-failure
+        # try: the step above already mutated params/optimizer state, so a
+        # cost-model or JAX-API error here must neither re-run the batch
+        # eagerly (double update) nor blacklist a signature that compiled
+        try:
+            self._record_compile(key, compile_us, cost, hlo, all_params,
+                                 train_pos)
+        except Exception:
+            self._attr_models.pop(key, None)
+            _STATS["attr_errors"] += 1
         return loss, "compile"
 
     def _fallback_reason(self):
@@ -500,11 +575,62 @@ class FusedTrainStep:
 
         return call
 
+    def _record_compile(self, key, dur_us, cost, hlo, all_params,
+                        train_pos):
+        """Feed the compile-attribution registry (ISSUE 8c): measured
+        trace+compile+first-run wall time, the program's cost-analysis
+        flops/bytes, its collective payload, and the comm_model's
+        modeled compute/comm times — the split that turns "step is
+        slow" into "DCN all-reduce grew 40%"."""
+        flops = bytes_acc = comm_bytes = comp_us = comm_us = None
+        if cost:
+            flops = float(cost.get("flops", 0.0)) or None
+            bytes_acc = float(cost.get("bytes accessed", 0.0)) or None
+        cm = _load_comm_model()
+        if cm is not None:
+            if hlo is not None:
+                try:
+                    comm_bytes = sum(
+                        cm.hlo_collective_bytes(hlo)[0].values()) or None
+                except Exception:
+                    comm_bytes = None
+            if comm_bytes is None and self._dp > 1:
+                # mesh mode without an inspectable HLO: the gradient
+                # all-reduce payload is analytic — 4 bytes per trainable
+                # f32 param (SCALING_r05's validated model)
+                comm_bytes = 4 * sum(
+                    int(all_params[pos].data().size)
+                    for pos in train_pos)
+            if flops:
+                comp_us = flops / (
+                    cm.ASSUMPTIONS["bf16_peak_tflops"] * 1e12) * 1e6
+            if comm_bytes:
+                comm_us = sum(cm.allreduce_seconds(
+                    comm_bytes, max(self._dp, 2))) * 1e6 \
+                    if self._dp > 1 else 0.0
+        self._attr_models.pop(key, None)
+        if comp_us is not None:
+            self._attr_models[key] = {
+                "compute_us": comp_us,
+                "comm_us": comm_us or 0.0,
+                "device_us": comp_us + (comm_us or 0.0),
+            }
+        _profiler.record_compile(
+            "fused_step", key="%08x" % (abs(hash(key)) & 0xFFFFFFFF),
+            dur_us=dur_us, flops=flops, bytes_accessed=bytes_acc,
+            comm_bytes=comm_bytes, modeled_compute_us=comp_us,
+            modeled_comm_us=comm_us,
+            args={"params": len(train_pos), "dp": self._dp})
+
     def _run(self, entry, all_params, train_pos, indices, states, nd_args,
-             batch_size):
+             batch_size, aot=False):
         """Execute one fused step: host hyperparameter math (identical to
         the eager update()'s), the compiled program, then pending-result
-        adoption back into Parameter.data()/grad() and the state store."""
+        adoption back into Parameter.data()/grad() and the state store.
+        With ``aot=True`` (the compile step) the program is lowered and
+        compiled ahead-of-time so its ``cost_analysis()`` (flops/bytes)
+        and optimized HLO feed the attribution registry; the compiled
+        executable is kept (``self._aot``) and runs this step."""
         jfn, aux_params, fixed_pos = entry
         tr = self._trainer
         opt = tr._optimizer
@@ -527,11 +653,33 @@ class FusedTrainStep:
             # f32 operands: the framework canonicalizes float64 away at
             # the NDArray boundary (jax x64 stays off), so f32 is full
             # precision for every reachable weight dtype
-            loss_data, new_ws, new_sts, grads, aux_datas = jfn(
-                train_datas, state_datas, fixed_datas, in_datas,
-                jnp.asarray(lrs, jnp.float32),
-                jnp.asarray(wds, jnp.float32),
-                jnp.float32(rescale), _random.next_key())
+            operands = (train_datas, state_datas, fixed_datas, in_datas,
+                        jnp.asarray(lrs, jnp.float32),
+                        jnp.asarray(wds, jnp.float32),
+                        jnp.float32(rescale), _random.next_key())
+            runner = jfn
+            if aot and hasattr(jfn, "lower"):
+                # AOT lower+compile the compile step so the executable's
+                # cost_analysis/HLO feed the attribution registry; the
+                # cache key pins every operand aval, so the executable
+                # stays valid for all later hits of this signature.
+                # (The mesh placement shim has no .lower — mesh mode
+                # stays on the plain jit path with analytic comm.)
+                try:
+                    compiled = jfn.lower(*operands).compile()
+                    cost = compiled.cost_analysis()
+                    cost = cost[0] if isinstance(cost, (list, tuple)) \
+                        else cost
+                    try:
+                        hlo = compiled.as_text()
+                    except Exception:
+                        hlo = None
+                    self._aot = (compiled, cost, hlo)
+                    runner = compiled
+                except Exception:
+                    self._aot = None  # AOT API drift: plain path works
+            loss_data, new_ws, new_sts, grads, aux_datas = \
+                runner(*operands)
         except BaseException:
             opt.num_update = prev_num
             for i, c in prev_counts.items():

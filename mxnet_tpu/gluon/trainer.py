@@ -160,7 +160,7 @@ class Trainer:
         (ref: trainer.py:305). A span in the profiler's ``gluon`` lane when
         profiling is on — the per-step anchor the other lanes (imperative,
         bulk, kvstore, autograd, memory) line up under."""
-        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        t0 = _time.perf_counter() if _profiler._LIVE else None
         rescale_grad = self._scale / batch_size
         self._check_and_rescale_grad(rescale_grad)
         if not self._kv_initialized:

@@ -375,7 +375,7 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
-        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        t0 = _time.perf_counter() if _profiler._LIVE else None
         batch = self._next_impl()
         if t0 is not None:
             wait_us = (_time.perf_counter() - t0) * 1e6

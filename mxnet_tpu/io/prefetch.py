@@ -88,7 +88,7 @@ class DevicePrefetchIter:
         def worker():
             try:
                 for batch in self._it:
-                    t0 = _time.perf_counter() if _profiler._ACTIVE \
+                    t0 = _time.perf_counter() if _profiler._LIVE \
                         else None
                     if _faultpoint.ACTIVE:
                         _faultpoint.check("io.prefetch.place")
@@ -152,7 +152,7 @@ class DevicePrefetchIter:
             raise StopIteration
         # batch-fetch span: how long the consumer stalled waiting on the
         # producer (queue-empty time = the pipeline is io-bound)
-        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        t0 = _time.perf_counter() if _profiler._LIVE else None
         item = self._q.get()
         if t0 is not None:
             wait_us = (_time.perf_counter() - t0) * 1e6

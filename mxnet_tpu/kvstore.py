@@ -96,7 +96,7 @@ class KVStore:
     # -- init/push/pull ----------------------------------------------------
     def init(self, key, value):
         """Initialize a key with a value (ref: kvstore.py init)."""
-        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        t0 = _time.perf_counter() if _profiler._LIVE else None
         keys, vals = _ctype_key_value(key, value)
         nbytes = 0
         for k, vlist in zip(keys, vals):
@@ -117,7 +117,7 @@ class KVStore:
         set, the update is applied server-side (update_on_kvstore mode,
         ref: src/kvstore/kvstore_dist_server.h:346 ApplyUpdates)."""
         from .ndarray.sparse import RowSparseNDArray
-        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        t0 = _time.perf_counter() if _profiler._LIVE else None
         b0 = self.bytes_pushed
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
@@ -149,7 +149,7 @@ class KVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Pull values into `out` (ref: kvstore.py pull)."""
         assert out is not None
-        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        t0 = _time.perf_counter() if _profiler._LIVE else None
         b0 = self.bytes_pulled
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
@@ -181,7 +181,7 @@ class KVStore:
         src/kvstore/kvstore_dist.h:522 EncodeRowSparseKey). Dense storage
         with row gather on TPU."""
         assert out is not None and row_ids is not None
-        t0 = _time.perf_counter() if _profiler._ACTIVE else None
+        t0 = _time.perf_counter() if _profiler._LIVE else None
         b0 = self.bytes_pulled
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):

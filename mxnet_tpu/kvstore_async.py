@@ -54,6 +54,7 @@ import secrets as _secrets
 import socket
 import struct
 import threading
+from statistics import median as _median
 import time as _ptime
 import warnings
 import weakref
@@ -64,6 +65,7 @@ from . import _retry
 from . import profiler as _profiler
 from ._debug import faultpoint as _faultpoint
 from ._debug import locktrace as _locktrace
+from ._debug import watchdog as _watchdog
 
 __all__ = ["AsyncPSServer", "AsyncPSClient", "serve_if_rank0"]
 
@@ -214,12 +216,41 @@ def _server_stats():
     the ``rank_heartbeat_age.<rank>`` gauge (seconds since that rank's
     last beat — operators see a rank going stale BEFORE the
     barrier-timeout autopsy names it dead) plus apply/done totals,
-    aggregated over every live server hosted in this process."""
+    aggregated over every live server hosted in this process.
+
+    Straggler detection (ISSUE 8): each rank's v1 heartbeat carries the
+    duration of its newest completed training step (the watchdog
+    beacon), so the server sees every rank's step time without an extra
+    round trip — durations are interval measurements on each rank's own
+    monotonic clock, so no cross-rank clock alignment is needed (the
+    beat *timestamps* ride the PR 6 clock-sync exchange). With >= 2
+    reporting ranks the gauges name who is slow:
+
+    - ``rank_step_s.<r>``: newest completed step duration of rank r
+    - ``step_skew.<r>``: that duration over the median of the OTHER
+      ranks' durations (leave-one-out — with few ranks a straggler
+      would otherwise drag the baseline up toward itself and mask its
+      own skew)
+    - ``straggler.<r>`` = 1 and ``stragglers`` list membership when the
+      skew exceeds ``MXTPU_STRAGGLER_FACTOR`` (default 2.0)
+    """
     out = {}
     now = _ptime.monotonic()
+    try:
+        factor = float(os.environ.get("MXTPU_STRAGGLER_FACTOR", "2.0")
+                       or 2.0)
+    except ValueError:
+        factor = 2.0
+    try:
+        stale_s = float(os.environ.get("MXTPU_PS_DEAD_TIMEOUT", "3.0")
+                        or 3.0)
+    except ValueError:
+        stale_s = 3.0
+    durs = {}
     for srv in list(_SERVERS):
         with srv._lock:
             beats = dict(srv._heartbeats)
+            steps = dict(srv._step_stats)
             out["updates_applied"] = out.get("updates_applied", 0) \
                 + srv.updates_applied
             out["workers_done"] = out.get("workers_done", 0) \
@@ -227,6 +258,28 @@ def _server_stats():
         for rank, t in beats.items():
             key = "rank_heartbeat_age.%d" % rank
             out[key] = max(out.get(key, 0.0), round(now - t, 3))
+        for rank, (dur, seq, at) in steps.items():
+            if now - at > stale_s:
+                # the rank stopped beating (every beat refreshes its
+                # entry): a dead rank's last duration must not sit in
+                # the skew baseline — or the straggler list — forever
+                continue
+            durs[rank] = max(durs.get(rank, 0.0), dur)
+            out["rank_step_s.%d" % rank] = round(durs[rank], 6)
+            out["rank_step_seq.%d" % rank] = seq
+    if len(durs) >= 2:
+        stragglers = []
+        for rank, dur in durs.items():
+            others = _median([d for r, d in durs.items() if r != rank])
+            if others <= 0:
+                continue
+            skew = dur / others
+            out["step_skew.%d" % rank] = round(skew, 3)
+            if skew > factor:
+                out["straggler.%d" % rank] = 1
+                stragglers.append(rank)
+        out["stragglers"] = sorted(stragglers)
+        out["straggler_count"] = len(stragglers)
     return out
 
 
@@ -247,6 +300,10 @@ class AsyncPSServer:
         self._updater = None
         self._lock = _locktrace.named_lock("kvstore_async.server")
         self._heartbeats = {}  # rank -> monotonic time of last beat
+        # rank -> (step duration s, step seq, monotonic arrival): the
+        # per-rank step gauges the v1 heartbeat carries (straggler
+        # detection, ISSUE 8)
+        self._step_stats = {}
         self._barrier_cv = _locktrace.named_condition(
             "kvstore_async.server", self._lock)
         self._barrier_count = 0
@@ -301,7 +358,7 @@ class AsyncPSServer:
                 # so _handle sees the plain v0 payload
                 ctx = struct.unpack_from(_CTX_FMT, buf, 1)
                 buf = bytes([buf[0] & ~_TRACE_FLAG]) + buf[1 + _CTX_SIZE:]
-            t0 = _ptime.perf_counter() if _profiler._ACTIVE else None
+            t0 = _ptime.perf_counter() if _profiler._LIVE else None
             try:
                 self._handle(conn, buf)
             except Exception as e:  # noqa: BLE001 — reply, don't die
@@ -400,6 +457,7 @@ class AsyncPSServer:
                 if len(buf) >= off + 8:
                     (rank,) = struct.unpack_from(">q", buf, off)
                     self._heartbeats.pop(int(rank), None)
+                    self._step_stats.pop(int(rank), None)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_WAIT_DONE:
             n, timeout = struct.unpack_from(">qd", buf, off)
@@ -526,6 +584,14 @@ class AsyncPSServer:
             import time as _t
             with self._lock:
                 self._heartbeats[int(rank)] = _t.monotonic()
+                if len(buf) >= off + 32:
+                    # trailing (step duration f64, step seq i64): the
+                    # rank's newest completed training step — the
+                    # straggler gauge payload. Old servers never reach
+                    # here (length-gated); old clients never send it.
+                    dur, seq = struct.unpack_from(">dq", buf, off + 16)
+                    self._step_stats[int(rank)] = (
+                        float(dur), int(seq), _t.monotonic())
             if len(buf) >= off + 16:
                 # v1 beat carries the client's trace-clock timestamp:
                 # answer with OUR trace clock so the client can estimate
@@ -931,9 +997,17 @@ class AsyncPSClient:
             # NTP-style pair behind merge_traces clock alignment.
             # offset ≈ server_ts - midpoint(t0, t1); error <= rtt/2.
             t0 = _profiler._now_us()
-            arr = self._call(struct.pack(">Bqd", _OP_HEARTBEAT,
-                                         int(rank), float(t0)),
-                             idempotent=False)
+            payload = struct.pack(">Bqd", _OP_HEARTBEAT, int(rank),
+                                  float(t0))
+            last = _watchdog.last_step()
+            if last is not None:
+                # the per-rank step-duration gauge rides the beat
+                # (straggler detection, ISSUE 8): newest completed
+                # step's (duration, seq) — a v1 server stores it, an
+                # old server's length check ignores the extra bytes
+                payload += struct.pack(">dq", float(last[1]),
+                                       int(last[0]))
+            arr = self._call(payload, idempotent=False)
             t1 = _profiler._now_us()
             if arr is not None and len(arr):
                 _profiler.record_clock_sync(
@@ -1092,7 +1166,7 @@ class AsyncKVStore:
     def init(self, key, value):
         from .kvstore import _ctype_key_value
         from .ndarray.sparse import RowSparseNDArray
-        t0 = _ptime.perf_counter() if _profiler._ACTIVE else None
+        t0 = _ptime.perf_counter() if _profiler._LIVE else None
         nbytes = 0
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
@@ -1127,7 +1201,7 @@ class AsyncKVStore:
         from .kvstore import _ctype_key_value
         from .ndarray.sparse import RowSparseNDArray
         import mxnet_tpu.ndarray as nd
-        t0 = _ptime.perf_counter() if _profiler._ACTIVE else None
+        t0 = _ptime.perf_counter() if _profiler._LIVE else None
         nbytes = 0
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
@@ -1226,7 +1300,7 @@ class AsyncKVStore:
         from .kvstore import _ctype_key_value
         import jax.numpy as jnp
         assert out is not None
-        t0 = _ptime.perf_counter() if _profiler._ACTIVE else None
+        t0 = _ptime.perf_counter() if _profiler._LIVE else None
         nbytes = 0
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):

@@ -47,6 +47,7 @@ from .. import engine as _engine
 from .. import profiler as _profiler
 from .. import random as _random
 from .._debug import faultpoint as _faultpoint
+from .._debug import flightrec as _flightrec
 from .._debug import locktrace as _locktrace
 from ..ops import registry as _registry
 from .ndarray import NDArray, _PendingSlot
@@ -57,12 +58,23 @@ __all__ = ["invoke", "invoke_by_name", "make_op_func", "populate",
            "bulk_segment_depth", "set_profiler_hooks", "aval"]
 
 # Telemetry hooks at the dispatch choke points (the engine OprBlock hook
-# analog, src/profiler/profiler.h:251). When profiling is off the entire
-# cost is `_HOOKS and _profiler._ACTIVE` — two truth tests — per op;
-# BENCH_MODEL=profiler_overhead gates that at <2% of eager dispatch.
+# analog, src/profiler/profiler.h:251). The per-op guard is the SHARED
+# `_HOOKS and _profiler._LIVE` truth test: _LIVE covers both an active
+# profile run and the always-on flight recorder (ISSUE 8) with ONE
+# branch — when both are off the entire cost is two truth tests per op
+# (BENCH_MODEL=profiler_overhead gates that at <2% of eager dispatch);
+# with only the flight recorder on, the extra work is one bare-name
+# ring append, no clock read (BENCH_MODEL=flightrec_overhead gates it
+# at <0.5%).
 # MXNET_PROFILER_HOOKS=0 removes even that (bench baseline / paranoia).
 _HOOKS = os.environ.get("MXNET_PROFILER_HOOKS", "1") \
     not in ("0", "false", "off")
+
+# Sentinel the shared guard yields when ONLY the flight recorder is on
+# (_LIVE true, _ACTIVE false): the return sites discriminate on
+# identity — `_prof_t0 is _FREC` → bare-name ring breadcrumb, any float
+# → full profiler record. No clock read on the flightrec-only path.
+_FREC = object()
 
 
 def set_profiler_hooks(enabled):
@@ -325,8 +337,41 @@ def _cached_callable(opdef, key, partial_key, args, kwargs, arg_slots,
         _faultpoint.check("imperative.jit.compile")
     fn = jax.jit(traced, donate_argnums=donate) if donate \
         else jax.jit(traced)
-    _DISPATCH_CACHE[key] = fn
-    return fn
+    probe = _compile_probe(opdef, key, fn)
+    _DISPATCH_CACHE[key] = probe
+    return probe
+
+
+def _sig_repr(key):
+    """Compact human-readable form of a dispatch-cache key's avals for
+    the compile-attribution registry (shape churn reads as the same
+    name with a changing key)."""
+    avals = key[-1]
+    try:
+        return ",".join("%s%s" % (_np.dtype(dt).name, list(shape))
+                        for shape, dt, _w in avals)
+    except Exception:
+        return repr(avals)[:80]
+
+
+def _compile_probe(opdef, key, fn):
+    """One-shot wrapper timing the FIRST call of a fresh jitted
+    callable — trace + XLA compile + first run — into the compile-
+    attribution registry (profiler.record_compile, ISSUE 8c), then
+    unwraps itself from the dispatch cache so every later hit pays
+    nothing. Compiles are rare and expensive: they are recorded
+    unconditionally (the ``account`` contract), not only under a
+    profile run."""
+    def probe(*xs):
+        t0 = _time.perf_counter()
+        out = fn(*xs)
+        if _DISPATCH_CACHE.get(key) is probe:
+            _DISPATCH_CACHE[key] = fn
+        _profiler.record_compile("imperative:%s" % opdef.name,
+                                 key=_sig_repr(key),
+                                 dur_us=(_time.perf_counter() - t0) * 1e6)
+        return out
+    return probe
 
 
 def _record_invoke(opdef, t0):
@@ -336,11 +381,19 @@ def _record_invoke(opdef, t0):
 
 
 def invoke(opdef, args, kwargs):
-    # telemetry guard is inlined (no wrapper call): with profiling off the
-    # whole cost is this one conditional plus two `is not None` tests at
-    # the return sites (BENCH_MODEL=profiler_overhead gates it at <2%)
-    _prof_t0 = _time.perf_counter() if (_HOOKS and _profiler._ACTIVE) \
-        else None
+    # telemetry guard is inlined (no wrapper call) and SHARED between
+    # the profiler and the always-on flight recorder (_LIVE, ISSUE 8):
+    # with both off the whole cost is this one conditional plus two
+    # `is not None` tests at the return sites. With only the flight
+    # recorder on, the guard yields the _FREC sentinel instead of a
+    # timestamp — no clock read — and the return sites append ONE bare
+    # op-name breadcrumb to the ring (dump-time rendering anchors it to
+    # the nearest timestamped neighbor). A perf_counter pair alone
+    # costs ~3x the flightrec budget per op, which is why the
+    # flightrec-only path records order, not durations
+    # (BENCH_MODEL=profiler_overhead / flightrec_overhead gate both).
+    _prof_t0 = (_time.perf_counter() if _profiler._ACTIVE else _FREC) \
+        if (_HOOKS and _profiler._LIVE) else None
     spec = _spec(opdef)
     if _amp_cast_hook is not None or spec["has_key"] or spec["has_training"]:
         kwargs = dict(kwargs)
@@ -375,7 +428,14 @@ def invoke(opdef, args, kwargs):
                                 kw_slots, nd_inputs)
             if out is not _NOT_BULKED:
                 if _prof_t0 is not None:
-                    _record_invoke(opdef, _prof_t0)
+                    if _prof_t0 is _FREC:
+                        # flight-recorder-only path: bare-name ring
+                        # append, inlined — even a helper call or one
+                        # clock read would breach the <0.5%-of-dispatch
+                        # budget
+                        _flightrec.RING.append(opdef.name)
+                    else:
+                        _record_invoke(opdef, _prof_t0)
                 return out
 
     datas = tuple(a._data for a in nd_inputs)
@@ -476,7 +536,11 @@ def invoke(opdef, args, kwargs):
             node.fwd_fn = fwd
         # else: non-differentiable output — gradient stops here
     if _prof_t0 is not None:
-        _record_invoke(opdef, _prof_t0)
+        if _prof_t0 is _FREC:
+            # flight-recorder-only path: see the bulk return site above
+            _flightrec.RING.append(opdef.name)
+        else:
+            _record_invoke(opdef, _prof_t0)
     return tuple(outs) if multi else outs[0]
 
 
@@ -741,7 +805,7 @@ class _BulkSegment:
         a memory sample lands at the boundary (allocation churn point)."""
         if not self.ops:
             return
-        if _HOOKS and _profiler._ACTIVE:
+        if _HOOKS and _profiler._LIVE:
             n_ops = len(self.ops)
             t0 = _time.perf_counter()
             mode = self._flush_impl()
@@ -808,6 +872,7 @@ class _BulkSegment:
                 # runner stays cached — a later flush of the same
                 # signature replays it, mirroring a transient failure)
                 _faultpoint.check("engine.bulk.compile")
+            c0 = _time.perf_counter() if mode == "compile" else None
             results = runner(leaves)
         except Exception:
             # a queued op turned out to be unjittable: replay the segment
@@ -817,6 +882,12 @@ class _BulkSegment:
             _STATS["bulk_flushes"] += 1
             _STATS["bulk_fallbacks"] += 1
             return "eager-fallback"
+        if c0 is not None:
+            # compile-attribution span (ISSUE 8): the first run of a
+            # fresh segment runner = trace + XLA compile + execute
+            _profiler.record_compile(
+                "bulk_segment", key="%d ops" % len(ops),
+                dur_us=(_time.perf_counter() - c0) * 1e6)
         _STATS["bulk_flushes"] += 1
         for arr, slot, i, k in outs:
             if arr._buf is slot:  # not overwritten since queueing

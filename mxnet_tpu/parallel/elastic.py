@@ -49,6 +49,8 @@ import numpy as np
 
 from .. import profiler as _profiler
 from .._debug import faultpoint as _faultpoint
+from .._debug import flightrec as _flightrec
+from .._debug import watchdog as _watchdog
 from .sharding import host_array
 
 __all__ = ["CheckpointManager", "elastic_train_loop", "PreemptionGuard",
@@ -352,6 +354,19 @@ class ElasticController:
         self._dead = set()
         self._last_poll = 0.0
         self._log = logger or logging.getLogger("mxnet_tpu.elastic")
+        self._publish_world()
+
+    def _publish_world(self):
+        """Publish the committed world view into the flight recorder's
+        dump context: a post-mortem shard then names the job topology —
+        world, survivors, known-dead — at the instant of death."""
+        _flightrec.set_context("elastic_world", {
+            "rank": self.rank,
+            "world": list(self.world),
+            "dead": sorted(self._dead),
+            "survivors": self.survivors,
+            "reshard_policy": self.reshard_policy,
+        })
 
     @property
     def dead_ranks(self):
@@ -384,6 +399,7 @@ class ElasticController:
             self._dead.update(new)
             self._log.warning("elastic: dead ranks detected: %s "
                               "(survivors %s)", new, self.survivors)
+            self._publish_world()
         # only deaths inside the COMMITTED world are actionable — same
         # guard handle_failure applies: a rank already resharded away,
         # or one outside this controller's world (a sub-world scoped
@@ -431,6 +447,7 @@ class ElasticController:
         self._log.warning("elastic: resharded onto %s (world was %s)",
                           survivors, self.world)
         self.world = survivors
+        self._publish_world()
         return survivors, state
 
 
@@ -485,7 +502,7 @@ class HostGradReducer:
         if len(world) <= 1:
             return host
         import mxnet_tpu.ndarray as nd
-        t0 = time.perf_counter() if _profiler._ACTIVE else None
+        t0 = time.perf_counter() if _profiler._LIVE else None
         self.kv.push(self._key(rank), nd.array(host))
         self.kv._barrier()
         total = None
@@ -600,7 +617,15 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                 failures = 0
                 continue
             try:
-                state, _ = step_fn(state, batches[i])
+                # watchdog beacon: a step wedged in a dead-rank
+                # collective trips the stall detector and dumps the
+                # flight record while this loop is still blocked
+                # (re-entrant: a fused step_fn's own beacon nests)
+                _watchdog.step_begin()
+                try:
+                    state, _ = step_fn(state, batches[i])
+                finally:
+                    _watchdog.step_end()
                 failures = 0
             except Exception as e:  # collective failure / dead rank
                 failures += 1

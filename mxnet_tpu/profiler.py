@@ -49,6 +49,7 @@ import os
 import threading
 import time
 
+from ._debug import flightrec as _flightrec
 from ._debug import locktrace as _locktrace
 
 __all__ = [
@@ -61,6 +62,7 @@ __all__ = [
     "serve_metrics", "stop_metrics_server", "prometheus_text",
     "merge_traces", "PID",
     "marker", "bump_elastic", "elastic_stats", "reset_elastic_stats",
+    "record_compile", "compile_stats",
 ]
 
 # chrome-trace pid of every event this process emits: the worker rank.
@@ -78,6 +80,7 @@ LANES = {
     "memory": 5,
     "gluon": 6,
     "user": 7,
+    "compile": 8,
 }
 
 _lock = _locktrace.named_lock("profiler.events")
@@ -98,6 +101,20 @@ _state = {
 # profiling-off cost of the whole telemetry layer is this one truth test
 # (BENCH_MODEL=profiler_overhead keeps it honest).
 _ACTIVE = False
+# The SHARED hot-path guard (ISSUE 8): true when a profile run is
+# active OR the always-on flight recorder wants span feeds. Hot call
+# sites guard on `_HOOKS and _profiler._LIVE` — ONE inlined truth test
+# covers both consumers (mxlint MX002/MX010/MX011), and record_op /
+# record_counter / marker / account internally fan out to the flight-
+# recorder ring before gating trace emission on _ACTIVE. Maintained by
+# _update_live() from set_state/pause/resume and flightrec.enable/
+# disable.
+_LIVE = _flightrec.ENABLED
+
+
+def _update_live():
+    global _LIVE
+    _LIVE = _ACTIVE or _flightrec.ENABLED
 
 _events = []          # chrome-trace event dicts
 _agg = {}             # name -> [count, total_us, min_us, max_us]
@@ -206,6 +223,7 @@ def set_state(state="stop", profile_process="worker"):
             _state["running"] = True
             _state["paused"] = False
             _ACTIVE = True
+            _update_live()
             # xprof start/stop stays under _lock so a racing stop can
             # never observe a half-started device trace
             if _state["xprof"]:
@@ -227,8 +245,9 @@ def set_state(state="stop", profile_process="worker"):
             period = _state["dump_period"]
         _start_daemons(profile_memory, continuous, period)
         # live export: MXNET_PROFILER_HTTP_PORT opts a run into the
-        # /metrics endpoint without any code change; the server stays
-        # up across stop so the final snapshot remains scrapable
+        # /metrics endpoint without any code change; set_state('stop')
+        # takes it down again (before the final trace dump — see the
+        # shutdown-ordering note there)
         if os.environ.get("MXNET_PROFILER_HTTP_PORT"):
             try:
                 serve_metrics()
@@ -242,6 +261,7 @@ def set_state(state="stop", profile_process="worker"):
                 return
             _state["running"] = False
             _ACTIVE = False
+            _update_live()
             continuous = _state["continuous_dump"]
             if _state["xprof_active"]:
                 _state["xprof_active"] = False
@@ -250,6 +270,15 @@ def set_state(state="stop", profile_process="worker"):
                     jax.profiler.stop_trace()
                 except Exception:
                     pass
+        # shutdown ordering (ISSUE 8 satellite): the /metrics endpoint
+        # goes down FIRST, before the daemons stop and the final trace
+        # rewrite — a scrape racing shutdown could otherwise interleave
+        # with a reset and observe a partially-reset histogram snapshot
+        # (prometheus_text reads metrics() and _latency under two
+        # separate lock acquisitions). Restart-able: the next
+        # set_state('run') re-serves via the env autostart, and
+        # serve_metrics() can be called again explicitly.
+        stop_metrics_server()
         _stop_daemons()
         if continuous:
             _write_trace()  # final rewrite covers events since last period
@@ -328,6 +357,7 @@ def pause(profile_process="worker"):
                             "tid": LANES["user"]})
         _state["paused"] = True
         _ACTIVE = False
+        _update_live()
 
 
 def resume(profile_process="worker"):
@@ -338,6 +368,7 @@ def resume(profile_process="worker"):
         was_paused = _state["paused"]
         _state["paused"] = False
         _ACTIVE = _state["running"]
+        _update_live()
         if _state["running"] and was_paused:
             _append_locked({"name": "profiler.resume", "cat": "profiler",
                             "ph": "i", "s": "g", "ts": _now_us(), "pid": PID,
@@ -346,9 +377,18 @@ def resume(profile_process="worker"):
 
 def record_op(name, dur_us, category="operator", args=None,
               lane="imperative"):
-    """Record one completed span into ``lane`` (called by the runtime when
-    profiling is on). Mirrors the engine's ProfileOperator
+    """Record one completed span into ``lane``. Always feeds the
+    flight-recorder ring (the post-mortem black box, ISSUE 8); the
+    trace event + aggregate row are recorded only while a profile run
+    is active. Call sites guard with the shared ``_HOOKS and _LIVE``
+    idiom. Mirrors the engine's ProfileOperator
     (src/engine/threaded_engine.h:83)."""
+    if _flightrec.ENABLED:
+        # inlined ring append (record_span's shape): the fused step
+        # pays this once per step — the helper call + stats bump would
+        # eat a third of the <0.1%-of-step flightrec budget
+        _flightrec.RING.append(("X", name, category, LANES.get(lane, 7),
+                                time.perf_counter(), dur_us, args))
     if not _ACTIVE:
         return
     end = _now_us()
@@ -369,7 +409,11 @@ def record_op(name, dur_us, category="operator", args=None,
 def record_counter(name, value, lane="user", series=None):
     """Emit a gauge sample (chrome Counter event) into ``lane`` — e.g. the
     io prefetch queue depth. ``series`` optionally names multiple stacked
-    series (a dict of series -> value)."""
+    series (a dict of series -> value). Always feeds the flight-recorder
+    ring; the trace event gates on the profile run."""
+    if _flightrec.ENABLED:
+        _flightrec.record_counter(name, series if series is not None
+                                  else value, LANES.get(lane, 7))
     if not _ACTIVE:
         return
     args = dict(series) if series is not None else {"value": value}
@@ -391,7 +435,8 @@ def account(name, delta, lane="kvstore", emit=True):
     worker deaths) never silently drop deltas while profiling is off.
     Accounting sites sit on network/IO/exception paths, not the per-op
     dispatch hot path, so the always-on cost is one lock + dict update
-    per already-expensive event."""
+    per already-expensive event (plus one flight-recorder ring append —
+    the black box keeps the counter timeline a post-mortem needs)."""
     with _lock:
         total = _counters.get(name, 0) + delta
         _counters[name] = total
@@ -400,6 +445,8 @@ def account(name, delta, lane="kvstore", emit=True):
                             "ts": _now_us(), "pid": PID,
                             "tid": LANES.get(lane, LANES["user"]),
                             "args": {"value": total}})
+    if emit and _flightrec.ENABLED:
+        _flightrec.record_counter(name, total, LANES.get(lane, 7))
 
 
 # -- latency histograms (ISSUE 6 tentpole c) ---------------------------------
@@ -515,12 +562,71 @@ def record_flow(name, flow_id, phase, ts_us=None, lane="kvstore",
         _append_locked(ev)
 
 
+# -- compile/device-time attribution (ISSUE 8 tentpole c) --------------------
+# Every jit compile in the tree — the imperative dispatch cache, bulk
+# segment runners, the fused train step — reports here: a span in the
+# ``compile`` lane with its signature key, plus per-program
+# cost-analysis numbers (flops / bytes accessed) and the comm_model's
+# modeled compute/comm split when the compiler provided them. Like
+# ``account``, the registry accumulates UNCONDITIONALLY (compiles are
+# rare and expensive; their accounting must not depend on a profile
+# run) — only the trace span gates on ``_ACTIVE``.
+_compiles = {}  # name -> {count, total_us, key, flops, ...}
+
+
+def record_compile(name, key=None, dur_us=0.0, flops=None,
+                   bytes_accessed=None, comm_bytes=None,
+                   modeled_compute_us=None, modeled_comm_us=None,
+                   args=None):
+    """Record one jit compilation: ``name`` identifies the compiling
+    subsystem + program (e.g. ``imperative:softmax``, ``fused_step``),
+    ``key`` a short signature string (shape churn shows as the same
+    name with a new key), ``dur_us`` the measured trace+compile(+first
+    run) wall time. Optional attribution inputs: XLA cost-analysis
+    ``flops``/``bytes_accessed``, collective payload ``comm_bytes``,
+    and the comm_model's ``modeled_compute_us``/``modeled_comm_us`` —
+    surfaced in ``metrics()['compile']`` and the ``dumps()``
+    attribution table."""
+    with _lock:
+        st = _compiles.get(name)
+        if st is None:
+            st = _compiles[name] = {"count": 0, "total_us": 0.0,
+                                    "last_us": 0.0, "key": None}
+        st["count"] += 1
+        st["total_us"] += float(dur_us)
+        st["last_us"] = float(dur_us)
+        if key is not None:
+            st["key"] = str(key)
+        for field, val in (("flops", flops),
+                           ("bytes_accessed", bytes_accessed),
+                           ("comm_bytes", comm_bytes),
+                           ("modeled_compute_us", modeled_compute_us),
+                           ("modeled_comm_us", modeled_comm_us)):
+            if val is not None:
+                st[field] = float(val)
+    ev_args = {"key": str(key)} if key is not None else {}
+    if args:
+        ev_args.update(args)
+    record_op(name, dur_us, category="compile", args=ev_args or None,
+              lane="compile")
+
+
+def compile_stats():
+    """Snapshot of the compile registry — ``metrics()['compile']``."""
+    with _lock:
+        return {n: dict(st) for n, st in _compiles.items()}
+
+
 def marker(name, args=None, lane="user", category="instant"):
     """Drop one instant event (chrome ``ph:"i"``) into ``lane`` at the
     current trace time — the public form of the internal ``_emit`` the
-    faultpoint subsystem uses for ``fault:<point>`` markers. No-op while
-    profiling is off (internally guarded, so call sites off the per-op
-    hot path don't need their own guard)."""
+    faultpoint subsystem uses for ``fault:<point>`` markers. Always
+    feeds the flight-recorder ring (markers are exactly the breadcrumbs
+    a post-mortem needs); the trace event gates on the profile run, so
+    call sites off the per-op hot path don't need their own guard."""
+    if _flightrec.ENABLED:
+        _flightrec.record_marker(name, category, LANES.get(lane, 7),
+                                 args)
     if not _ACTIVE:
         return
     ev = {"name": name, "cat": category, "ph": "i", "s": "p",
@@ -549,9 +655,10 @@ def bump_elastic(name, delta=1, args=None, lane="user"):
     must be trustworthy in production, not only under a profile run."""
     with _lock:
         _elastic[name] = _elastic.get(name, 0) + delta
-    if _ACTIVE:
-        marker("elastic:%s" % name, args=args, lane=lane,
-               category="elastic")
+    # marker() gates internally: flight-recorder ring always, trace
+    # event only while a profile run is active
+    marker("elastic:%s" % name, args=args, lane=lane,
+           category="elastic")
 
 
 def elastic_stats():
@@ -773,12 +880,14 @@ def metrics(reset=False):
         rows = _agg_rows()
         counters = dict(_counters)
         memory = {dev: dict(vals) for dev, vals in _mem_last.items()}
+        compiles = {n: dict(st) for n, st in _compiles.items()}
         num_events = len(_events)
         if reset:
             _agg.clear()
             _events.clear()
             _counters.clear()
             _mem_last.clear()
+            _compiles.clear()
     latency = latency_metrics(reset)
     # _clock_sync survives reset on purpose: it is calibration
     # state (clock offsets), not accumulated telemetry
@@ -791,6 +900,7 @@ def metrics(reset=False):
         "counters": counters,
         "latency": latency,
         "memory": memory,
+        "compile": compiles,
         "clock_sync": clock_sync(),
         "num_events": num_events,
     }
@@ -817,11 +927,13 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         rows = _agg_rows()
         counters = dict(_counters)
         memory = {dev: dict(vals) for dev, vals in _mem_last.items()}
+        compiles = {n: dict(st) for n, st in _compiles.items()}
         if reset:
             _agg.clear()
             _events.clear()
             _counters.clear()
             _mem_last.clear()
+            _compiles.clear()
     latency = latency_metrics(reset)
     if key_idx is None:
         rows.sort(key=lambda r: r[5], reverse=not ascending)
@@ -851,6 +963,44 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             lines.append("%-40s %8d %10.1f %10.1f %10.1f %10.1f" % (
                 name[:40], h["count"], h["p50_us"], h["p95_us"],
                 h["p99_us"], h["max_us"]))
+    if compiles:
+        lines.append("")
+        lines.append("%-28s %6s %12s %14s %14s" % (
+            "Compile", "Count", "Total(ms)", "GFLOPs", "GB moved"))
+        for name in sorted(compiles):
+            st = compiles[name]
+            lines.append("%-28s %6d %12.1f %14s %14s" % (
+                name[:28], st["count"], st["total_us"] / 1e3,
+                "%.3f" % (st["flops"] / 1e9)
+                if st.get("flops") is not None else "-",
+                "%.4f" % (st["bytes_accessed"] / 1e9)
+                if st.get("bytes_accessed") is not None else "-"))
+        # attribution: modeled split of the measured step into compute
+        # vs comm vs host time (ISSUE 8 tentpole c). Compute/comm are
+        # the comm_model's projections from the program's cost analysis
+        # (v5e assumptions, benchmark/comm_model.py ASSUMPTIONS); host
+        # is the measured mean step minus both, i.e. everything the
+        # device model cannot explain — dispatch, adoption, Python.
+        attr_rows = []
+        for name in sorted(compiles):
+            st = compiles[name]
+            if st.get("modeled_compute_us") is None:
+                continue
+            comp = st["modeled_compute_us"]
+            comm = st.get("modeled_comm_us") or 0.0
+            meas = latency.get("fused_step.step", {}).get("mean_us")
+            host = max(0.0, meas - comp - comm) if meas else None
+            attr_rows.append((name, comp, comm, meas, host))
+        if attr_rows:
+            lines.append("")
+            lines.append("%-28s %12s %12s %12s %12s" % (
+                "Attribution (modeled)", "compute(us)", "comm(us)",
+                "step(us)", "host(us)"))
+            for name, comp, comm, meas, host in attr_rows:
+                lines.append("%-28s %12.1f %12.1f %12s %12s" % (
+                    name[:28], comp, comm,
+                    "%.1f" % meas if meas else "-",
+                    "%.1f" % host if host is not None else "-"))
     if counters:
         lines.append("counters: " + " ".join(
             "%s=%s" % (k, counters[k]) for k in sorted(counters)))
@@ -983,7 +1133,10 @@ def serve_metrics(port=None, host="127.0.0.1"):
     reads ``MXNET_PROFILER_HTTP_PORT``; ``0`` binds an ephemeral port.
     Returns the bound port. Binds loopback by default — expose it
     beyond the host via your scrape proxy, not by changing ``host``,
-    unless the fabric is trusted."""
+    unless the fabric is trusted. ``set_state('stop')`` shuts the
+    endpoint down BEFORE the final trace dump (a scrape racing
+    shutdown must not observe a partially-reset snapshot); call
+    ``serve_metrics`` again to re-serve after a stop."""
     global _http_server, _http_thread
     with _lock:
         if _http_server is not None:
@@ -1069,13 +1222,21 @@ def merge_traces(shards, output=None, align=True):
                 sh = json.load(f)
         loaded.append(sh)
     events = []
-    summary = {"ranks": [], "offsets_us": {}, "events": 0}
+    summary = {"ranks": [], "offsets_us": {}, "events": 0,
+               "flightrec_shards": 0}
     seen_meta = set()
     for i, sh in enumerate(loaded):
         meta = sh.get("metadata", {}) or {}
         rank = meta.get("rank")
         if rank is None:  # pre-ISSUE-6 shard: fall back to position
             rank = i
+        # a flight-recorder post-mortem shard (ISSUE 8): same rank/pid
+        # and timebase as the live profiler shards, but every event is
+        # tagged so the merged view distinguishes black-box evidence
+        # from live-profile evidence (they can overlap when profiling
+        # was on at crash time)
+        flightrec = bool(meta.get("flightrec"))
+        summary["flightrec_shards"] += int(flightrec)
         offset = 0.0
         sync = meta.get("clock_sync", {}) or {}
         if align and sync:
@@ -1099,6 +1260,10 @@ def merge_traces(shards, output=None, align=True):
                     ev["args"] = {"name": "mxnet_tpu rank %d" % rank}
             if "ts" in ev:
                 ev["ts"] = ev["ts"] + offset
+            if flightrec and ev.get("ph") != "M":
+                a = dict(ev.get("args", ()))
+                a["source"] = "flightrec"
+                ev["args"] = a
             events.append(ev)
     events.sort(key=lambda e: e.get("ts", -1.0))
     starts = {e["id"] for e in events
@@ -1130,6 +1295,7 @@ def _reset():
         _latency.clear()
         _clock_sync.clear()
         _elastic.clear()
+        _compiles.clear()
     reset_imperative_stats()
 
 
@@ -1271,6 +1437,17 @@ from ._debug import faultpoint as _faultpoint  # noqa: E402
 
 register_stats_provider("faults", _faultpoint.metrics,
                         _faultpoint.reset_counters)
+
+# Flight-recorder occupancy/dump accounting (ISSUE 8): always-on black
+# box, so its health belongs in every metrics() snapshot.
+register_stats_provider("flightrec", _flightrec.stats)
+
+# Watchdog beacon stats: imported HERE (module bottom — the watchdog
+# registers itself via register_stats_provider, which must already be
+# defined) rather than from _debug/__init__, so every process has a
+# metrics()['watchdog'] section even before the fused step or kvstore
+# pull it in.
+from ._debug import watchdog as _watchdog  # noqa: E402,F401
 
 
 # deprecated aliases kept for parity (ref: profiler.py:70,109,143)

@@ -1,0 +1,57 @@
+"""Worker for the 2-process straggler-detection test (ISSUE 8
+acceptance): rank 1 runs each step ~9x slower than rank 0 (an injected
+per-rank delay). Every v1 heartbeat carries the rank's newest completed
+step duration (the watchdog beacon), so the PS server's
+``metrics()['kvstore_server']`` must name rank 1 in ``stragglers``
+without any extra wire round trip — which both ranks verify by pulling
+``kv.server_metrics()``.
+
+Run via: python tools/launch.py -n 2 python tests/flightrec_straggler_worker.py
+"""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu._debug import watchdog  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["MXTPU_PROC_ID"])
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.zeros((8,)))
+    delay = 0.45 if rank == 1 else 0.05
+
+    ks = {}
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        watchdog.step_begin()
+        kv.push("w", mx.nd.ones((8,)))
+        out = mx.nd.zeros((8,))
+        kv.pull("w", out=out)
+        time.sleep(delay)  # the injected per-rank step-time skew
+        watchdog.step_end()
+        ks = kv.server_metrics()[0].get("kvstore_server", {})
+        if ks.get("stragglers") == [1] \
+                and "rank_step_s.0" in ks and "rank_step_s.1" in ks:
+            break
+    assert ks.get("stragglers") == [1], \
+        "server never named rank 1 as the straggler: %r" % (ks,)
+    assert ks["straggler.1"] == 1 and "straggler.0" not in ks, ks
+    assert ks["step_skew.1"] > 2.0 > ks["step_skew.0"], ks
+    assert ks["rank_step_s.1"] > ks["rank_step_s.0"] > 0, ks
+    print("rank %d: STRAGGLER_OK" % rank, flush=True)
+
+    kv._barrier()
+    if rank == 0:
+        kv.close()
+    else:
+        kv.done()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Subprocess worker for the flight-recorder post-mortem tests
+(ISSUE 8 acceptance): a training run that dies badly and must leave a
+readable black box behind.
+
+Modes (``sys.argv[1]``):
+
+- ``crash``: run a few fused steps + eager ops with profiling on, dump
+  the live profiler shard, then raise an uncaught exception mid-epoch —
+  the chained ``sys.excepthook`` must write a flight-recorder shard.
+- ``stall``: same warm-up, then wedge a watchdog-beaconed kvstore pull
+  under a long faultpoint delay. The watchdog daemon must trip, dump
+  exactly one shard, and the parent SIGKILLs this process mid-stall
+  (nothing after the wedged pull ever runs — like a real hang).
+
+Run via: python tests/flightrec_worker.py {crash|stall}
+with MXTPU_FLIGHTREC_DIR pointing at the parent's scratch dir.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, profiler  # noqa: E402
+from mxnet_tpu._debug import faultpoint, watchdog  # noqa: E402
+
+
+def _train_a_bit():
+    """A few fused steps + eager ops: fills the ring with bare-name
+    dispatch breadcrumbs AND timestamped anchors (step spans, bulk
+    flushes)."""
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Uniform(0.1))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = gluon.train_step(net, gluon.loss.L2Loss(), trainer)
+    x = mx.nd.array(onp.ones((4, 8), onp.float32))
+    y = mx.nd.array(onp.zeros((4, 4), onp.float32))
+    for _ in range(4):
+        step(x, y, batch_size=4)
+    a = mx.nd.array(onp.ones((8, 8), onp.float32))
+    b = mx.nd.softmax(a * 2 + 1)
+    b.wait_to_read()
+
+
+def main():
+    mode = sys.argv[1]
+    outdir = os.environ["MXTPU_FLIGHTREC_DIR"]
+    live = os.path.join(outdir, "live_trace.json")
+    profiler.set_config(filename=live, xprof=False)
+    profiler.set_state("run")
+    _train_a_bit()
+    profiler.dump()  # the live shard a surviving profiler leaves behind
+
+    if mode == "crash":
+        raise RuntimeError("boom mid-epoch")
+
+    assert mode == "stall", mode
+    from mxnet_tpu import kvstore_async as KA
+    watchdog.configure(factor=3.0, min_s=0.4, poll_s=0.05,
+                       min_samples=3)
+    srv = KA.AsyncPSServer()
+    cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+    cli.init("w", onp.zeros(8, onp.float32))
+    for _ in range(4):  # arm the watchdog with representative steps
+        watchdog.step_begin()
+        cli.pull("w")
+        watchdog.step_end()
+    assert watchdog.threshold_s() is not None
+    faultpoint.configure({"kvstore.pull": "delay:120s@n=1"})
+    print("STALLING", flush=True)
+    watchdog.step_begin()
+    cli.pull("w")  # wedges 120 s: the watchdog dumps, the parent kills
+    watchdog.step_end()
+
+
+if __name__ == "__main__":
+    main()

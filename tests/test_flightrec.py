@@ -1,0 +1,919 @@
+"""Flight recorder + watchdog + compile attribution tests (ISSUE 8):
+the always-on black box under the opt-in telemetry plane. Ring
+semantics, dump triggers (exception hooks, SIGUSR2, watchdog), the
+shared hot-path guard, straggler gauges on the heartbeat wire,
+compile/device-time attribution, and the subprocess post-mortems the
+acceptance criteria name (crash mid-epoch, SIGKILLed stall — each
+leaving a shard ``tools/trace_merge.py`` merges with a live profiler
+shard)."""
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, gluon, profiler
+from mxnet_tpu import kvstore_async as KA
+from mxnet_tpu._debug import faultpoint, flightrec, watchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(tmp_path))
+    profiler._reset()
+    profiler.set_config(filename=str(tmp_path / "live.json"),
+                        xprof=False)
+    faultpoint.reset()
+    watchdog.reset()
+    flightrec.reset_ring()
+    with flightrec._context_lock:
+        flightrec._context.clear()
+    if not flightrec.ENABLED:
+        flightrec.enable()
+    yield
+    faultpoint.reset()
+    watchdog.reset()
+    flightrec.reset_ring()
+    flightrec.configure(capacity=4096, enabled=True)
+    profiler._reset()
+    profiler.set_config(filename="profile.json", xprof=True)
+
+
+def _frec_dumps(tmp_path, trigger="*"):
+    return sorted(glob.glob(
+        str(tmp_path / ("flightrec_r*_%s_*.json" % trigger))))
+
+
+# -- ring semantics ----------------------------------------------------------
+
+def test_ring_capacity_and_overwrite():
+    """deque(maxlen) semantics: the ring keeps exactly the newest
+    ``capacity`` entries, oldest fall off, accounting stays truthful."""
+    flightrec.configure(capacity=16)
+    try:
+        flightrec.reset_ring()
+        for i in range(40):
+            flightrec.record_marker("m%d" % i)
+        st = flightrec.stats()
+        assert st["capacity"] == 16
+        assert st["buffered"] == 16
+        assert st["recorded"] == 40  # all appends counted, 24 overwritten
+        names = [e[1] for e in flightrec.snapshot()]
+        assert names == ["m%d" % i for i in range(24, 40)]
+    finally:
+        flightrec.configure(capacity=4096)
+
+
+def test_ring_shrink_keeps_newest():
+    flightrec.configure(capacity=64)
+    try:
+        flightrec.reset_ring()
+        for i in range(32):
+            flightrec.record_marker("m%d" % i)
+        flightrec.configure(capacity=16)
+        names = [e[1] for e in flightrec.snapshot()]
+        assert names == ["m%d" % i for i in range(16, 32)]
+        assert flightrec.stats()["capacity"] == 16
+    finally:
+        flightrec.configure(capacity=4096)
+
+
+def test_reset_ring_clears_entries_and_counters(tmp_path):
+    flightrec.record_marker("x")
+    flightrec.dump("manual")
+    assert flightrec.stats()["dumps"] == 1
+    flightrec.reset_ring()
+    st = flightrec.stats()
+    assert st["buffered"] == 0 and st["recorded"] == 0 \
+        and st["dumps"] == 0
+    assert flightrec.last_dumps() == []
+
+
+def test_enable_disable_syncs_shared_guard():
+    """flightrec.ENABLED and profiler._ACTIVE are the two inputs of the
+    ONE shared hot-path guard (profiler._LIVE)."""
+    assert profiler._LIVE  # recorder on by default
+    prev = flightrec.disable()
+    assert prev is True
+    assert not profiler._LIVE
+    flightrec.enable()
+    assert profiler._LIVE
+    # a profile run keeps the guard live even with the recorder off
+    flightrec.disable()
+    profiler.set_state("run")
+    try:
+        assert profiler._LIVE
+    finally:
+        profiler.set_state("stop")
+    assert not profiler._LIVE
+    flightrec.enable()
+
+
+# -- hot-path feeds ----------------------------------------------------------
+
+def test_eager_ops_leave_bare_name_breadcrumbs():
+    """With profiling OFF, the per-op dispatch path appends bare op
+    names (no clock read) — order exact, anchored at dump time."""
+    flightrec.reset_ring()
+    a = mx.nd.array(np.ones((8, 8), np.float32))
+    b = mx.nd.softmax(a * 2 + 1)
+    b.wait_to_read()
+    engine.wait_for_all()
+    entries = flightrec.snapshot()
+    bare = [e for e in entries if isinstance(e, str)]
+    assert "softmax" in bare
+    assert "multiply" in bare and "add" in bare
+    # dispatch order is preserved verbatim
+    assert bare.index("multiply") < bare.index("add") \
+        < bare.index("softmax")
+
+
+def test_profiling_on_records_full_spans_into_ring():
+    """While a profile run is active the ring gets the full timestamped
+    span tuples (record_op fans out before gating on _ACTIVE)."""
+    flightrec.reset_ring()
+    profiler.set_state("run")
+    try:
+        a = mx.nd.array(np.ones((4, 4), np.float32))
+        (a + 1).wait_to_read()
+        engine.wait_for_all()
+    finally:
+        profiler.set_state("stop")
+    spans = [e for e in flightrec.snapshot()
+             if not isinstance(e, str) and e[0] == "X"]
+    assert spans, "no timestamped spans reached the ring"
+    ph, name, cat, tid, ts_s, dur_us, args = spans[0]
+    assert isinstance(ts_s, float) and dur_us >= 0
+
+
+def test_counters_and_markers_feed_ring_with_profiling_off():
+    flightrec.reset_ring()
+    profiler.account("unit.bytes", 64, emit=True)
+    profiler.marker("unit.marker", args={"k": 1})
+    kinds = {e[0] for e in flightrec.snapshot() if not isinstance(e, str)}
+    assert "C" in kinds and "i" in kinds
+    # the trace itself stayed empty: profiling is off
+    assert profiler.metrics()["num_events"] == 0
+
+
+# -- dump contents and rendering ---------------------------------------------
+
+def test_dump_bundles_stacks_metrics_faults_context(tmp_path):
+    flightrec.record_marker("breadcrumb")
+    flightrec.set_context("unit_ctx", {"hello": 1})
+    path = flightrec.dump("manual", extra={"why": "test"})
+    d = json.load(open(path))
+    meta = d["metadata"]
+    assert meta["flightrec"] is True
+    assert meta["trigger"] == "manual"
+    assert meta["trigger_info"] == {"why": "test"}
+    assert meta["context"]["unit_ctx"] == {"hello": 1}
+    assert meta["ring"]["buffered"] >= 1
+    # all-thread python stacks: at least this (the main) thread
+    assert any("MainThread" in k for k in meta["python_stacks"])
+    assert any("test_dump_bundles" in ln
+               for lines in meta["python_stacks"].values()
+               for ln in lines)
+    # metrics snapshot carries the provider sections
+    for section in ("watchdog", "faults", "flightrec"):
+        assert section in meta["metrics"], sorted(meta["metrics"])
+    assert "faults" in meta
+    names = {e.get("name") for e in d["traceEvents"]}
+    assert "breadcrumb" in names
+    assert "flightrec:manual" in names  # the dump's own marker
+
+
+def test_bare_names_render_anchored_to_neighbors(tmp_path):
+    """A bare-name breadcrumb renders as an instant event at the
+    nearest timestamped neighbor, flagged ts_approx; leading ones
+    backfill from the first anchor."""
+    flightrec.reset_ring()
+    flightrec.RING.append("lead_op")       # before any anchor
+    flightrec.record_marker("anchor1")
+    flightrec.RING.append("mid_op")
+    flightrec.record_marker("anchor2")
+    path = flightrec.dump("manual")
+    evs = json.load(open(path))["traceEvents"]
+    by_name = {e["name"]: e for e in evs if e.get("name", "").endswith(
+        ("_op", "anchor1", "anchor2"))}
+    a1, a2 = by_name["anchor1"], by_name["anchor2"]
+    lead, mid = by_name["lead_op"], by_name["mid_op"]
+    assert lead["args"]["ts_approx"] and mid["args"]["ts_approx"]
+    assert lead["ts"] == a1["ts"]  # backfilled from the first anchor
+    assert mid["ts"] == a1["ts"]   # carried forward from anchor1
+    assert a1["ts"] <= a2["ts"]
+
+
+def test_bare_names_render_without_any_anchor(tmp_path):
+    flightrec.reset_ring()
+    flightrec.RING.append("only_op")
+    path = flightrec.dump("manual")
+    evs = json.load(open(path))["traceEvents"]
+    ev = next(e for e in evs if e["name"] == "only_op")
+    assert ev["args"]["ts_approx"] and ev["ts"] >= 0
+
+
+def test_dump_storm_cap(tmp_path, monkeypatch):
+    monkeypatch.setattr(flightrec, "_MAX_DUMPS", 2)
+    flightrec.record_marker("x")
+    assert flightrec.dump("manual") is not None
+    assert flightrec.dump("manual") is not None
+    assert flightrec.dump("manual") is None  # capped
+    assert flightrec.stats()["dumps"] == 2
+    # an explicit path (operator asked for it) bypasses the storm cap
+    p = flightrec.dump("manual", path=str(tmp_path / "explicit.json"))
+    assert p is not None and os.path.exists(p)
+
+
+def test_dump_failure_swallowed_and_counted(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR",
+                       str(tmp_path / "missing" / "nope"))
+    assert flightrec.dump("manual", swallow=True) is None
+    assert flightrec.stats()["dump_failures"] == 1
+    with pytest.raises(Exception):
+        flightrec.dump("manual", swallow=False)
+
+
+# -- crash hooks -------------------------------------------------------------
+
+def test_excepthook_dumps_and_chains(tmp_path, monkeypatch):
+    called = []
+    monkeypatch.setattr(flightrec, "_prev_sys_hook",
+                        lambda *a: called.append(a))
+    try:
+        raise ValueError("unit boom")
+    except ValueError:
+        ei = sys.exc_info()
+    flightrec._sys_excepthook(*ei)
+    assert len(called) == 1, "previous excepthook must still run"
+    dumps = _frec_dumps(tmp_path, "exception")
+    assert len(dumps) == 1
+    meta = json.load(open(dumps[0]))["metadata"]
+    assert "unit boom" in meta["trigger_info"]["exception"]
+
+
+def test_threading_excepthook_dumps_and_skips_systemexit(tmp_path,
+                                                         monkeypatch):
+    chained = []
+    monkeypatch.setattr(flightrec, "_prev_threading_hook",
+                        lambda a: chained.append(a))
+
+    class Args:
+        def __init__(self, exc_type, exc_value):
+            self.exc_type = exc_type
+            self.exc_value = exc_value
+            self.exc_traceback = None
+            self.thread = None
+
+    flightrec._threading_excepthook(Args(SystemExit, SystemExit(0)))
+    assert _frec_dumps(tmp_path, "thread-exception") == []
+    flightrec._threading_excepthook(Args(RuntimeError,
+                                         RuntimeError("worker died")))
+    dumps = _frec_dumps(tmp_path, "thread-exception")
+    assert len(dumps) == 1
+    meta = json.load(open(dumps[0]))["metadata"]
+    assert "worker died" in meta["trigger_info"]["exception"]
+    assert len(chained) == 2  # chained for BOTH (SystemExit included)
+
+
+def test_install_uninstall_roundtrip():
+    assert flightrec._installed  # installed at import (hooks default on)
+    assert sys.excepthook is flightrec._sys_excepthook
+    assert signal.getsignal(signal.SIGUSR2) is flightrec._sigusr2_handler
+    try:
+        flightrec.uninstall()
+        assert sys.excepthook is not flightrec._sys_excepthook
+        assert signal.getsignal(signal.SIGUSR2) \
+            is not flightrec._sigusr2_handler
+    finally:
+        flightrec.install()
+    assert sys.excepthook is flightrec._sys_excepthook
+    flightrec.install()  # idempotent: no double-chain
+    assert flightrec._prev_sys_hook is not flightrec._sys_excepthook
+
+
+def test_faulthandler_file_appends_across_incarnations(tmp_path, monkeypatch):
+    """Regression: an elastic restart in the same dump dir (same
+    MXTPU_PROC_ID) must not truncate the previous incarnation's native
+    stacks — install() opens the fatal file in append mode, and the
+    clean-exit cleanup removes it only when empty."""
+    import faulthandler
+    fatal = tmp_path / "flightrec_r0_fatal.txt"
+    fatal.write_text("previous incarnation's SIGSEGV stacks\n")
+    # simulate the fresh process: hooks not yet installed, faulthandler
+    # not yet owned (pytest enables it globally — restore after)
+    had_fh = faulthandler.is_enabled()
+    flightrec.uninstall()
+    if had_fh:
+        faulthandler.disable()
+    try:
+        flightrec.install()
+        assert flightrec._fatal_file is not None
+        assert "previous incarnation" in fatal.read_text()
+        flightrec._cleanup_fatal_file(str(fatal))
+        # non-empty: the preserved post-mortem is NOT litter
+        assert fatal.exists()
+        assert "previous incarnation" in fatal.read_text()
+    finally:
+        flightrec.uninstall()
+        if had_fh:
+            faulthandler.enable()
+        flightrec.install()
+
+
+def test_sigusr2_while_holding_profiler_lock_does_not_deadlock(tmp_path):
+    """Regression: the handler preempts the main thread between
+    bytecodes, and dump() takes profiler._lock — non-reentrant. With the
+    signal landing while THIS thread holds that lock (any account() on a
+    kvstore byte ledger is such a window), an inline dump would deadlock
+    the process; the handler must hand off to a helper thread instead."""
+    with profiler._lock:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        time.sleep(0.05)  # handler ran inline here; dump thread blocks
+    deadline = time.monotonic() + 10.0
+    while flightrec._sigusr2_inflight.locked() \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert _frec_dumps(tmp_path, "sigusr2")
+
+
+def _deterministic_run(kick_at=None):
+    """6 deterministic fused steps; optionally SIGUSR2 ourselves
+    mid-run. Returns (per-step losses, final param bytes)."""
+    mx.random.seed(7)
+    np.random.seed(7)
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Uniform(0.1), force_reinit=True)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = gluon.train_step(net, gluon.loss.L2Loss(), trainer)
+    x = mx.nd.array(np.random.rand(4, 8).astype(np.float32))
+    y = mx.nd.array(np.random.rand(4, 4).astype(np.float32))
+    losses = []
+    for i in range(6):
+        if i == kick_at:
+            os.kill(os.getpid(), signal.SIGUSR2)
+        loss = step(x, y, batch_size=4)
+        losses.append(loss.asnumpy().copy())
+    # ordered values, not a dict: a fresh net gets fresh auto-generated
+    # param name prefixes, but the (weight, bias) order is stable
+    params = [p.data().asnumpy().tobytes()
+              for p in net.collect_params().values()]
+    return losses, params
+
+
+def test_sigusr2_dump_is_loss_and_bitwise_neutral(tmp_path):
+    """An on-demand SIGUSR2 dump mid-training changes nothing: same
+    per-step losses, bitwise-identical final params — and one shard
+    with trigger 'sigusr2' lands on disk."""
+    base_losses, base_params = _deterministic_run(kick_at=None)
+    watchdog.reset()
+    kicked_losses, kicked_params = _deterministic_run(kick_at=3)
+    # the handler hands the dump to a helper thread (dumping inline from
+    # a signal handler could deadlock on the profiler lock): wait for it
+    deadline = time.monotonic() + 10.0
+    while flightrec._sigusr2_inflight.locked() \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    dumps = _frec_dumps(tmp_path, "sigusr2")
+    assert len(dumps) == 1
+    meta = json.load(open(dumps[0]))["metadata"]
+    assert meta["trigger"] == "sigusr2"
+    for a, b in zip(base_losses, kicked_losses):
+        assert a.tobytes() == b.tobytes()
+    assert base_params == kicked_params
+
+
+# -- watchdog ----------------------------------------------------------------
+
+def test_watchdog_arms_after_min_samples_and_thresholds():
+    watchdog.configure(factor=4.0, min_s=0.05, min_samples=3)
+    assert watchdog.threshold_s() is None
+    for dur in (0.01, 0.02, 0.01):
+        watchdog.step_begin()
+        time.sleep(dur)
+        watchdog.step_end()
+    thr = watchdog.threshold_s()
+    assert thr is not None
+    # max(factor * median, min_s); median ~= 0.01-0.03
+    assert thr >= 0.05
+    st = watchdog.stats()
+    assert st["armed"] == 1 and st["steps"] == 3
+    assert watchdog.last_step()[0] == 3
+
+
+def test_watchdog_warmup_steps_excluded_from_median():
+    watchdog.configure(factor=2.0, min_s=0.01, min_samples=2)
+    watchdog.step_begin()
+    time.sleep(0.3)
+    watchdog.step_end(warmup=True)  # the compile step
+    st = watchdog.stats()
+    assert st["warmup_steps"] == 1 and st["steps"] == 0
+    assert watchdog.threshold_s() is None  # warmup never arms
+    for _ in range(2):
+        watchdog.step_begin()
+        time.sleep(0.01)
+        watchdog.step_end()
+    assert watchdog.stats()["median_s"] < 0.1  # 0.3s warmup not in it
+
+
+def test_watchdog_reentrant_outer_step_owns_beacon():
+    watchdog.configure(min_samples=1)
+    watchdog.step_begin()          # outer (elastic_train_loop)
+    watchdog.step_begin()          # nested (fused step)
+    time.sleep(0.02)
+    watchdog.step_end()            # nested end: beacon still in flight
+    assert watchdog.stats()["steps"] == 0
+    watchdog.step_end()
+    assert watchdog.stats()["steps"] == 1
+    assert watchdog.last_step()[1] >= 0.02
+
+
+def test_watchdog_check_now_idle_is_false():
+    watchdog.configure(min_samples=1)
+    assert watchdog.check_now() is False  # nothing in flight
+    watchdog.step_begin()
+    watchdog.step_end()
+    assert watchdog.check_now() is False  # in-flight step completed
+
+
+def test_watchdog_trips_on_kvstore_stall_one_dump_per_stall(tmp_path):
+    """E2E: a faultpoint delay in kvstore.pull wedges a beaconed step;
+    the watchdog daemon trips within the bound, dumps the flight record
+    exactly once for that stall, and a second stall dumps again."""
+    watchdog.configure(factor=3.0, min_s=0.3, poll_s=0.02,
+                       min_samples=3)
+    srv = KA.AsyncPSServer()
+    cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+    try:
+        cli.init("w", np.zeros(8, np.float32))
+
+        def beat_step():
+            watchdog.step_begin()
+            cli.pull("w")
+            watchdog.step_end()
+
+        for _ in range(4):
+            beat_step()
+        assert watchdog.threshold_s() is not None
+        assert watchdog.stats()["stalls"] == 0
+
+        faultpoint.configure({"kvstore.pull": "delay:1200ms@n=1"})
+        t0 = time.monotonic()
+        beat_step()
+        wall = time.monotonic() - t0
+        assert wall >= 1.0  # the injected stall really happened
+        st = watchdog.stats()
+        assert st["stalls"] == 1 and st["dumps"] == 1
+        # tripped while the step was still wedged, not at step_end
+        assert st["last_stall_elapsed_s"] < wall
+        assert st["last_stall_elapsed_s"] >= 0.3
+        dumps = _frec_dumps(tmp_path, "watchdog")
+        assert len(dumps) == 1
+        meta = json.load(open(dumps[0]))["metadata"]
+        assert meta["trigger"] == "watchdog"
+        assert meta["trigger_info"]["threshold_s"] >= 0.3
+        assert meta["trigger_info"]["step"] == st["last_stall_step"]
+
+        # healthy steps after the stall: no further dumps
+        for _ in range(3):
+            beat_step()
+        assert watchdog.stats()["stalls"] == 1
+        assert len(_frec_dumps(tmp_path, "watchdog")) == 1
+
+        # a SECOND stall is a new incident: one more dump
+        faultpoint.configure({"kvstore.pull": "delay:1200ms@n=1"})
+        beat_step()
+        assert watchdog.stats()["stalls"] == 2
+        assert len(_frec_dumps(tmp_path, "watchdog")) == 2
+    finally:
+        cli.stop_server()
+        srv.stop()
+
+
+def test_watchdog_never_false_positives_on_compile_step(tmp_path):
+    """A faultpoint delay in fused_step.trace makes the compile step
+    ~40x the steady-state step time — but warm-up steps never feed the
+    median and the watchdog is unarmed until enough representative
+    steps completed, so it must NOT trip."""
+    watchdog.configure(factor=2.0, min_s=0.05, poll_s=0.01,
+                       min_samples=2)
+    faultpoint.configure({"fused_step.trace": "delay:800ms@n=1"})
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Uniform(0.1), force_reinit=True)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = gluon.train_step(net, gluon.loss.L2Loss(), trainer)
+    x = mx.nd.array(np.ones((4, 8), np.float32))
+    y = mx.nd.array(np.zeros((4, 4), np.float32))
+    for _ in range(5):
+        step(x, y, batch_size=4)
+    assert step.last_mode == "fused", step.last_mode
+    st = watchdog.stats()
+    assert faultpoint.triggers("fused_step.trace") == 1  # delay fired
+    assert st["stalls"] == 0 and st["dumps"] == 0
+    assert st["warmup_steps"] >= 2  # eager-warming + delayed compile
+    assert st["steps"] >= 2         # the fused steady-state steps
+    assert st["median_s"] < 0.4     # 0.8s compile not in the median
+    assert _frec_dumps(tmp_path, "watchdog") == []
+
+
+# -- straggler gauges on the heartbeat wire ----------------------------------
+
+@pytest.fixture
+def _only_my_servers(monkeypatch):
+    """_server_stats aggregates over every live AsyncPSServer; a
+    stopped-but-uncollected server from an earlier test (a handler
+    thread sleeping out an injected delay keeps it referenced) would
+    leak phantom ranks into these exact-gauge assertions. Give each
+    unit test a private registry."""
+    import weakref
+    monkeypatch.setattr(KA, "_SERVERS", weakref.WeakSet())
+
+
+def test_server_stats_names_straggler_leave_one_out(_only_my_servers):
+    """Unit: skew = own step duration over the median of the OTHERS'
+    (leave-one-out), straggler when above MXTPU_STRAGGLER_FACTOR."""
+    srv = KA.AsyncPSServer()
+    try:
+        now = time.monotonic()
+        with srv._lock:
+            srv._step_stats = {0: (0.05, 9, now), 1: (0.06, 9, now),
+                               2: (0.5, 8, now)}
+        ks = profiler.metrics()["kvstore_server"]
+        assert ks["stragglers"] == [2]
+        assert ks["straggler_count"] == 1
+        assert ks["straggler.2"] == 1
+        assert "straggler.0" not in ks and "straggler.1" not in ks
+        assert ks["step_skew.2"] > 2.0
+        assert ks["step_skew.0"] < 1.5 and ks["step_skew.1"] < 1.5
+        assert ks["rank_step_s.2"] == 0.5
+        assert ks["rank_step_seq.2"] == 8
+    finally:
+        srv.stop()
+
+
+def test_server_stats_ages_out_dead_rank_step_entries(monkeypatch, _only_my_servers):
+    """A rank that stopped beating (SIGKILL, no _OP_DONE) must fall out
+    of the straggler gauges after MXTPU_PS_DEAD_TIMEOUT — its last
+    duration must not distort the leave-one-out baseline, or keep it on
+    the straggler list, forever."""
+    monkeypatch.setenv("MXTPU_PS_DEAD_TIMEOUT", "3.0")
+    srv = KA.AsyncPSServer()
+    try:
+        now = time.monotonic()
+        with srv._lock:
+            # rank 2 died mid-slow-step 10s ago; 0 and 1 are current
+            srv._step_stats = {0: (0.05, 9, now), 1: (0.06, 9, now),
+                               2: (0.5, 8, now - 10.0)}
+        ks = profiler.metrics()["kvstore_server"]
+        assert "rank_step_s.2" not in ks
+        assert "step_skew.2" not in ks
+        assert ks["stragglers"] == []
+        assert ks["rank_step_s.0"] == 0.05 and ks["rank_step_s.1"] == 0.06
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_carries_step_duration_to_server(_only_my_servers):
+    """The v1 timestamped beat rides the watchdog beacon's newest
+    completed step (duration, seq) — no extra wire round trip."""
+    watchdog.configure(min_samples=1)
+    srv = KA.AsyncPSServer()
+    cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+    try:
+        cli.init("w", np.zeros(4, np.float32))  # negotiates v1
+        assert cli._peer_version >= 1
+        watchdog.step_begin()
+        time.sleep(0.02)
+        watchdog.step_end()
+        seq, dur = watchdog.last_step()
+        cli.heartbeat(0, sync_clock=True)
+        ks = profiler.metrics()["kvstore_server"]
+        assert ks["rank_step_s.0"] == pytest.approx(dur, abs=1e-6)
+        assert ks["rank_step_seq.0"] == seq
+        # a single reporting rank: no skew/straggler keys
+        assert not any(k.startswith("step_skew.") for k in ks)
+    finally:
+        cli.stop_server()
+        srv.stop()
+
+
+def test_plain_v0_heartbeat_still_works_without_step_stats(_only_my_servers):
+    srv = KA.AsyncPSServer()
+    cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+    try:
+        cli.heartbeat(3)  # un-timestamped beat, no step payload
+        ks = profiler.metrics()["kvstore_server"]
+        assert "rank_heartbeat_age.3" in ks
+        assert "rank_step_s.3" not in ks
+    finally:
+        cli.stop_server()
+        srv.stop()
+
+
+# -- compile/device-time attribution -----------------------------------------
+
+def test_fused_step_compile_attribution():
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Uniform(0.1), force_reinit=True)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = gluon.train_step(net, gluon.loss.L2Loss(), trainer)
+    x = mx.nd.array(np.ones((4, 8), np.float32))
+    y = mx.nd.array(np.zeros((4, 4), np.float32))
+    for _ in range(4):
+        step(x, y, batch_size=4)
+    assert step.last_mode == "fused"
+    cs = profiler.compile_stats()
+    assert "fused_step" in cs, sorted(cs)
+    st = cs["fused_step"]
+    assert st["count"] == 1          # one signature, one compile
+    assert st["last_us"] > 0 and st["key"]
+    # AOT cost analysis fed flops/bytes on the CPU backend
+    assert st.get("flops", 0) > 0
+    assert st.get("bytes_accessed", 0) > 0
+    assert st.get("modeled_compute_us", 0) > 0
+    # replays never re-enter the registry
+    for _ in range(3):
+        step(x, y, batch_size=4)
+    assert profiler.compile_stats()["fused_step"]["count"] == 1
+
+
+def test_fused_step_attribution_failure_never_reruns_the_step(monkeypatch):
+    """Regression: _record_compile runs AFTER the compile step committed
+    (outside the trace-failure try). If it raises — cost-model or JAX
+    API drift — the already-applied update must stand (no eager re-run =
+    double update), the signature must stay cached, and the error is
+    counted, not raised."""
+    from mxnet_tpu.gluon import fused_step as FS
+    monkeypatch.setattr(
+        FS.FusedTrainStep, "_record_compile",
+        lambda self, *a, **k: (_ for _ in ()).throw(RuntimeError("drift")))
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Uniform(0.1), force_reinit=True)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = gluon.train_step(net, gluon.loss.L2Loss(), trainer)
+    x = mx.nd.array(np.ones((4, 8), np.float32))
+    y = mx.nd.array(np.zeros((4, 4), np.float32))
+    FS.reset_stats()
+    w0 = net.weight.data().asnumpy().copy()
+    modes = []
+    for _ in range(4):
+        step(x, y, batch_size=4)
+        modes.append(step.last_mode)
+    assert "compile" in modes           # the compile step itself succeeded
+    assert step.last_mode == "fused"    # ...and stayed cached (no blacklist)
+    st = FS.stats()
+    assert st["attr_errors"] >= 1
+    assert st["fallbacks"] == 0
+    assert not np.allclose(w0, net.weight.data().asnumpy())
+
+
+def test_fused_step_attribution_model_is_per_signature():
+    """Regression: the modeled compute/comm split is keyed by signature.
+    A run alternating two compiled batch shapes must subtract each
+    step's OWN program's modeled device time — not whichever program
+    compiled last."""
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Uniform(0.1), force_reinit=True)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = gluon.train_step(net, gluon.loss.L2Loss(), trainer)
+    big = (mx.nd.array(np.ones((64, 8), np.float32)),
+           mx.nd.array(np.zeros((64, 4), np.float32)))
+    small = (mx.nd.array(np.ones((4, 8), np.float32)),
+             mx.nd.array(np.zeros((4, 4), np.float32)))
+    for _ in range(4):  # compile both signatures, then alternate hits
+        step(*big, batch_size=64)
+        step(*small, batch_size=4)
+    assert step.last_mode == "fused"
+    models = {k: v for k, v in step._attr_models.items()}
+    assert len(models) == 2
+    # the executing step's model is the one looked up by ITS key
+    step(*big, batch_size=64)
+    big_key = next(k for k in models
+                   if step._step_attr is models[k])
+    step(*small, batch_size=4)
+    small_key = next(k for k in models
+                     if step._step_attr is models[k])
+    assert big_key != small_key
+    # the bigger batch models strictly more compute
+    assert models[big_key]["compute_us"] > models[small_key]["compute_us"]
+
+
+def test_imperative_compile_attribution_records_signature():
+    a = mx.nd.array(np.ones((8, 8), np.float32))
+    for _ in range(8):
+        b = mx.nd.softmax(a)
+        b.wait_to_read()
+    cs = profiler.compile_stats()
+    key = "imperative:softmax"
+    assert key in cs, sorted(cs)
+    assert cs[key]["count"] >= 1
+    assert cs[key]["last_us"] > 0
+    assert "float32[8, 8]" in cs[key]["key"]
+    count = cs[key]["count"]
+    for _ in range(4):  # cache hits do not re-record
+        mx.nd.softmax(a).wait_to_read()
+    assert profiler.compile_stats()[key]["count"] == count
+
+
+def test_dumps_renders_compile_and_attribution_tables():
+    profiler.record_compile("unit:prog", key="sig0", dur_us=1500.0,
+                            flops=2.0e9, bytes_accessed=1.0e6,
+                            modeled_compute_us=10.0,
+                            modeled_comm_us=2.0)
+    out = profiler.dumps()
+    assert "Compile" in out and "unit:prog" in out
+    assert "Attribution (modeled)" in out
+
+
+# -- elastic world context ---------------------------------------------------
+
+def test_elastic_controller_publishes_world_to_dump_context(tmp_path):
+    from mxnet_tpu.parallel.elastic import ElasticController
+    ElasticController(kvstore=None, world=[0, 1, 2], rank=1)
+    path = flightrec.dump("manual")
+    ctx = json.load(open(path))["metadata"]["context"]
+    assert ctx["elastic_world"]["world"] == [0, 1, 2]
+    assert ctx["elastic_world"]["rank"] == 1
+    assert ctx["elastic_world"]["dead"] == []
+
+
+# -- trace_merge integration -------------------------------------------------
+
+def _make_live_shard(tmp_path):
+    shard = str(tmp_path / "live.json")
+    profiler.set_config(filename=shard, xprof=False)
+    profiler.set_state("run")
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    (a + 1).wait_to_read()
+    engine.wait_for_all()
+    profiler.set_state("stop")
+    profiler.dump()
+    return shard
+
+
+def test_merge_tags_flightrec_events(tmp_path):
+    live = _make_live_shard(tmp_path)
+    flightrec.record_marker("black_box_marker")
+    frec = flightrec.dump("manual")
+    out = str(tmp_path / "merged.json")
+    merged, summary = profiler.merge_traces([live, frec], output=out)
+    assert summary["flightrec_shards"] == 1
+    evs = merged["traceEvents"]
+    tagged = [e for e in evs
+              if e.get("args", {}).get("source") == "flightrec"]
+    untagged = [e for e in evs if e.get("ph") != "M"
+                and e.get("args", {}).get("source") != "flightrec"]
+    assert tagged and untagged, "both sources must be distinguishable"
+    assert any(e["name"] == "black_box_marker" for e in tagged)
+    assert json.load(open(out))["traceEvents"]
+
+
+def test_trace_merge_cli_zero_shards_exits_nonzero(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    cli = os.path.join(REPO, "tools", "trace_merge.py")
+    r = subprocess.run([sys.executable, cli], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2
+    assert "no input shards" in r.stderr
+
+    empty = tmp_path / "empty_shard.json"
+    empty.write_text(json.dumps({"traceEvents": [],
+                                 "metadata": {"rank": 0}}))
+    out = tmp_path / "should_not_exist.json"
+    r2 = subprocess.run([sys.executable, cli, str(empty),
+                         "-o", str(out)], env=env,
+                        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 1
+    assert "zero events" in r2.stderr
+    assert not out.exists(), "an empty trace must not be written"
+
+
+# -- subprocess post-mortems (acceptance) ------------------------------------
+
+def _worker_env(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["MXTPU_FLIGHTREC_DIR"] = str(tmp_path)
+    return env
+
+
+def _merge_with_cli(tmp_path, shards):
+    out = str(tmp_path / "merged.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_merge.py")]
+        + shards + ["-o", out],
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO),
+        capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    merged = json.load(open(out))
+    evs = merged["traceEvents"]
+    assert any(e.get("args", {}).get("source") == "flightrec"
+               for e in evs)
+    assert any(e.get("ph") != "M"
+               and e.get("args", {}).get("source") != "flightrec"
+               for e in evs)
+    return merged
+
+
+def test_crash_subprocess_leaves_postmortem_that_merges(tmp_path):
+    """Acceptance: an uncaught exception mid-epoch leaves a valid
+    chrome-trace shard (last spans + all-thread stacks) that the CLI
+    merges with the run's live profiler shard."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "flightrec_worker.py"), "crash"],
+        env=_worker_env(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode != 0
+    assert "boom mid-epoch" in r.stderr
+    dumps = _frec_dumps(tmp_path, "exception")
+    assert len(dumps) == 1
+    d = json.load(open(dumps[0]))  # valid JSON or this raises
+    meta = d["metadata"]
+    assert "boom mid-epoch" in meta["trigger_info"]["exception"]
+    assert meta["python_stacks"], "no thread stacks in the post-mortem"
+    names = {e.get("name") for e in d["traceEvents"]}
+    # the last spans of the dying run: the fused step anchor + eager ops
+    assert "gluon.train_step" in names, sorted(names)[:40]
+    assert "softmax" in names
+    live = str(tmp_path / "live_trace.json")
+    assert os.path.exists(live)
+    _merge_with_cli(tmp_path, [live, dumps[0]])
+
+
+def test_sigkill_stalled_subprocess_watchdog_postmortem(tmp_path):
+    """Acceptance: a run wedged by a faultpoint delay gets a watchdog
+    flight-record dump while still stalled; the process is then
+    SIGKILLed (a real hang autopsy: nothing after the wedge ever ran)
+    and the shard still merges with the live profiler shard."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "flightrec_worker.py"), "stall"],
+        env=_worker_env(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 240
+        dumps = []
+        while time.time() < deadline and not dumps:
+            if proc.poll() is not None:
+                out, err = proc.communicate()
+                raise AssertionError(
+                    "worker exited before stalling: %s%s" % (out, err))
+            dumps = _frec_dumps(tmp_path, "watchdog")
+            time.sleep(0.1)
+        assert dumps, "watchdog never dumped within the deadline"
+    finally:
+        if proc.poll() is None:
+            proc.kill()  # SIGKILL mid-stall
+        proc.wait(timeout=30)
+    assert proc.returncode != 0  # killed, not a clean exit
+    d = json.load(open(dumps[0]))
+    meta = d["metadata"]
+    assert meta["trigger"] == "watchdog"
+    assert meta["trigger_info"]["elapsed_s"] >= 0.3
+    # the wedged pull is visible in the stacks the dump captured
+    assert any("pull" in ln for lines in meta["python_stacks"].values()
+               for ln in lines)
+    live = str(tmp_path / "live_trace.json")
+    assert os.path.exists(live)
+    _merge_with_cli(tmp_path, [live, dumps[0]])
+
+
+@pytest.mark.slow
+def test_two_process_straggler_gauge_names_slow_rank(tmp_path):
+    """Acceptance: in a 2-process run with an injected per-rank delay
+    the PS server's metrics name the slow rank — verified in-worker by
+    both ranks via kv.server_metrics()."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["MXTPU_PS_HEARTBEAT_INTERVAL"] = "0.1"
+    env["MXTPU_FLIGHTREC_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(REPO, "tests", "flightrec_straggler_worker.py")],
+        env=env, capture_output=True, text=True, timeout=480)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout + r.stderr
+    for rank in range(2):
+        assert "rank %d: STRAGGLER_OK" % rank in out, out

@@ -312,6 +312,64 @@ def test_mx010_out_of_scope_module_is_exempt(tmp_path):
     assert findings == []
 
 
+def test_mx011_flags_second_hot_path_branch(tmp_path):
+    """Flight-recorder records in hot modules must sit under the ONE
+    shared guard — a standalone `if _flightrec.ENABLED:` branch (or no
+    guard at all) is a second hot-path cost the flightrec_overhead
+    budget does not price. Covers both the helper recorders and the
+    raw inlined RING.append form."""
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/ndarray/thing.py", """\
+        from .._debug import flightrec as _flightrec
+
+        def bad_own_branch(name):
+            if _flightrec.ENABLED:
+                _flightrec.RING.append(name)
+
+        def bad_unguarded(name, dur):
+            _flightrec.record_span(name, dur)
+
+        def bad_marker(name):
+            _flightrec.record_marker(name)
+        """, {"MX011"})
+    assert [f.code for f in findings] == ["MX011"] * 3
+    assert sorted(f.line for f in findings) == [5, 8, 11]
+
+
+def test_mx011_accepts_shared_and_derived_guards(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/ndarray/thing.py", """\
+        from .. import profiler as _profiler
+        from .._debug import flightrec as _flightrec
+
+        def good_shared(name, t0):
+            if _profiler._HOOKS and _profiler._LIVE:
+                _flightrec.RING.append(name)
+
+        def good_derived(name, _prof_t0):
+            if _prof_t0 is not None:
+                _flightrec.RING.append(name)
+
+        def good_helper(name, dur, t0):
+            if t0 is not None:
+                _flightrec.record_span(name, dur)
+        """, {"MX011"})
+    assert findings == []
+
+
+def test_mx011_out_of_scope_module_is_exempt(tmp_path):
+    """Cold modules (the dump path itself, tools) may record freely —
+    only the hot dispatch/step modules carry the one-guard contract."""
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/callback.py", """\
+        from .._debug import flightrec as _flightrec
+
+        def f(name):
+            _flightrec.record_marker(name)
+        """, {"MX011"})
+    assert findings == []
+
+
 # -- waiver machinery --------------------------------------------------------
 
 def test_waiver_without_reason_is_flagged(tmp_path):

@@ -706,3 +706,87 @@ def test_end_to_end_gluon_loop_four_lanes(tmp_path):
         assert name[:40] in table
     assert m["aggregate"]["gluon.Trainer.step"]["count"] == 3
     assert m["aggregate"]["autograd.backward"]["count"] == 3
+
+
+# -- ISSUE 8 satellites: shutdown ordering + compile registry ---------------
+
+def test_stop_shuts_metrics_server_before_final_trace_dump(tmp_path,
+                                                           monkeypatch):
+    """Regression (ISSUE 8 satellite): set_state('stop') must take the
+    /metrics endpoint down BEFORE the final trace rewrite, so a scrape
+    racing shutdown can never observe a partially-reset histogram
+    snapshot — and after stop the endpoint is really gone."""
+    from urllib.request import urlopen
+    import urllib.error
+    order = []
+    real_stop = profiler.stop_metrics_server
+    real_write = profiler._write_trace
+
+    def spy_stop():
+        order.append("stop_server")
+        return real_stop()
+
+    def spy_write():
+        order.append("write_trace")
+        return real_write()
+
+    monkeypatch.setattr(profiler, "stop_metrics_server", spy_stop)
+    monkeypatch.setattr(profiler, "_write_trace", spy_write)
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        continuous_dump=True, dump_period=60.0)
+    profiler.set_state("run")
+    port = profiler.serve_metrics(port=0)
+    profiler.record_latency("unit.lat", 100.0)
+    body = urlopen("http://127.0.0.1:%d/metrics" % port,
+                   timeout=5).read().decode()
+    assert 'name="unit.lat"' in body
+    order.clear()
+    profiler.set_state("stop")
+    assert "stop_server" in order and "write_trace" in order, order
+    assert order.index("stop_server") < order.index("write_trace"), \
+        "endpoint must go down before the final dump"
+    with pytest.raises((urllib.error.URLError, ConnectionError,
+                        OSError)):
+        urlopen("http://127.0.0.1:%d/metrics" % port, timeout=1)
+    # re-serving after a stop still works (operator recipe)
+    port2 = profiler.serve_metrics(port=0)
+    try:
+        assert urlopen("http://127.0.0.1:%d/metrics" % port2,
+                       timeout=5).read()
+    finally:
+        profiler.stop_metrics_server()
+
+
+def test_record_compile_registry_accumulates_unconditionally():
+    """Compiles are rare and expensive: the registry counts with
+    profiling OFF (the `account` contract); only the trace span gates
+    on an active run."""
+    assert not profiler._ACTIVE
+    profiler.record_compile("unit:prog", key="sig-a", dur_us=1000.0,
+                            flops=2.0e9, bytes_accessed=5.0e5)
+    profiler.record_compile("unit:prog", key="sig-b", dur_us=500.0)
+    st = profiler.compile_stats()["unit:prog"]
+    assert st["count"] == 2
+    assert st["total_us"] == pytest.approx(1500.0)
+    assert st["last_us"] == pytest.approx(500.0)
+    assert st["key"] == "sig-b"          # newest signature wins
+    assert st["flops"] == pytest.approx(2.0e9)  # sticky across records
+    assert profiler.metrics()["num_events"] == 0  # no trace while off
+    m = profiler.metrics(reset=True)
+    assert m["compile"]["unit:prog"]["count"] == 2
+    assert profiler.compile_stats() == {}  # reset clears the registry
+
+
+def test_record_compile_emits_span_in_compile_lane():
+    profiler.set_state("run")
+    try:
+        profiler.record_compile("unit:prog", key="sig", dur_us=250.0)
+    finally:
+        profiler.set_state("stop")
+    profiler.dump()
+    data = _trace()
+    evs = [e for e in data["traceEvents"]
+           if e.get("name") == "unit:prog" and e.get("ph") == "X"]
+    assert len(evs) == 1
+    assert evs[0]["tid"] == profiler.LANES["compile"]
+    assert evs[0]["args"]["key"] == "sig"

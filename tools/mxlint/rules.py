@@ -127,7 +127,7 @@ class MX001JnpBypassesInvoke:
 
 # -- MX002 -------------------------------------------------------------------
 
-_GUARD_TOKENS = ("_ACTIVE", "_HOOKS", "is_running")
+_GUARD_TOKENS = ("_ACTIVE", "_HOOKS", "_LIVE", "is_running")
 # `account` is deliberately NOT here: since ISSUE 6 it accumulates its
 # cumulative counter unconditionally (only the trace-event emission
 # gates on _ACTIVE internally), so production counters stay trustworthy
@@ -660,6 +660,90 @@ class MX010UnguardedLatencyTelemetry:
         return out
 
 
+# -- MX011 -------------------------------------------------------------------
+
+_FLIGHTREC_FNS = ("record_span", "record_counter", "record_marker")
+
+
+def _flightrec_aliases(tree):
+    """Names the file binds to the flight-recorder module (``from
+    .._debug import flightrec as _flightrec`` and friends)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "flightrec":
+                    names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("flightrec"):
+                    names.add(a.asname or a.name.split(".")[0])
+    return names
+
+
+class MX011FlightrecSecondBranch:
+    """Flight-recorder record calls in hot modules must sit under the
+    SAME inlined guard as the profiler hooks (``_HOOKS and
+    _profiler._LIVE``, or the derived ``t0 is not None`` form) — never
+    under their own ``if _flightrec.ENABLED:`` as a separate hot-path
+    branch. The always-on budget (<0.5% of eager dispatch,
+    BENCH_MODEL=flightrec_overhead) is only true because the off path
+    is ONE shared truth test; a second guard per call site doubles the
+    branch cost and silently drifts as sites are added. This covers
+    both the helper recorders (``record_span``/``record_counter``/
+    ``record_marker``) and the raw inlined ``RING.append`` form the
+    dispatch choke point uses."""
+
+    code = "MX011"
+    summary = "flight-recorder record not under the shared guard"
+    kind = "python"
+
+    def scope(self, path):
+        return _is_hot(path) \
+            or path == "mxnet_tpu/gluon/fused_step.py"
+
+    def check(self, path, src, tree, parents):
+        aliases = _flightrec_aliases(tree)
+        if not aliases:
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_rec = (isinstance(f, ast.Attribute)
+                      and f.attr in _FLIGHTREC_FNS
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id in aliases)
+            # raw form: <alias>.RING.append(...)
+            is_raw = (isinstance(f, ast.Attribute)
+                      and f.attr == "append"
+                      and isinstance(f.value, ast.Attribute)
+                      and f.value.attr == "RING"
+                      and isinstance(f.value.value, ast.Name)
+                      and f.value.value.id in aliases)
+            if not (is_rec or is_raw):
+                continue
+            guarded = False
+            for anc in _ancestors(node, parents):
+                if isinstance(anc, (ast.If, ast.IfExp)) \
+                        and _test_is_guard(anc.test):
+                    guarded = True
+                    break
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    break
+            if not guarded:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "flight-recorder record on a hot path must share "
+                    "the inlined `_HOOKS and _profiler._LIVE` (or "
+                    "derived `t0 is not None`) guard — a standalone "
+                    "`if ENABLED:` branch is a second hot-path guard "
+                    "the flightrec_overhead budget does not price"))
+        return out
+
+
 ALL_RULES = (
     MX001JnpBypassesInvoke(),
     MX002UnguardedProfilerHook(),
@@ -671,4 +755,5 @@ ALL_RULES = (
     MX008BareExcept(),
     MX009SwallowedBroadExcept(),
     MX010UnguardedLatencyTelemetry(),
+    MX011FlightrecSecondBranch(),
 )

@@ -9,39 +9,71 @@ shard onto PS server 0's clock and writes one chrome://tracing /
 Perfetto file in which the wire flow events (``ph:"s"/"f"``) draw
 client→server causality arrows per push/pull/barrier.
 
-    python tools/trace_merge.py trace_rank0.json trace_rank1.json \
+Flight-recorder shards (the always-on post-mortem ring dumps of
+``mxnet_tpu._debug.flightrec`` — ISSUE 8) merge the same way: they
+carry the same rank/pid and timebase, so a crash dump interleaves with
+the live shards of the surviving ranks on one timeline; every event
+from a flight-record shard is tagged ``args.source = "flightrec"`` so
+black-box evidence is distinguishable from live-profile evidence.
+
+    python tools/trace_merge.py trace_rank0.json flightrec_r1_*.json \
         -o merged.json
 
 ``--no-align`` keeps raw per-rank timestamps (debugging the alignment
-itself). Exit status is non-zero when no flow pairs match while both
-sides emitted flows — the signature of mismatched shards.
+itself). Exit status is non-zero when: no input shards were given, the
+shards contain zero events (writing an empty trace would hide the
+mistake), or no flow pairs match while both sides emitted flows — the
+signature of mismatched shards.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="merge per-rank chrome-trace shards into one trace")
-    ap.add_argument("shards", nargs="+",
-                    help="per-rank trace JSON files (profiler.dump())")
+        description="merge per-rank chrome-trace shards (live profiler "
+                    "dumps and/or flight-recorder post-mortems) into "
+                    "one trace")
+    ap.add_argument("shards", nargs="*",
+                    help="per-rank trace JSON files (profiler.dump() "
+                         "shards and/or flightrec_r*.json post-mortems)")
     ap.add_argument("-o", "--output", default="merged_trace.json",
                     help="merged trace path (default: %(default)s)")
     ap.add_argument("--no-align", action="store_true",
                     help="skip heartbeat-based clock alignment")
     args = ap.parse_args(argv)
 
+    if not args.shards:
+        print("error: no input shards — pass at least one trace file "
+              "(a profiler.dump() shard or a flightrec_r*.json "
+              "post-mortem); refusing to write an empty trace",
+              file=sys.stderr)
+        return 2
+
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from mxnet_tpu import profiler
+    from mxnet_tpu import base, profiler
 
-    _, summary = profiler.merge_traces(
-        args.shards, output=args.output, align=not args.no_align)
-    print("merged %d shard(s) (ranks %s) -> %s: %d events"
-          % (len(args.shards), summary["ranks"], args.output,
+    merged, summary = profiler.merge_traces(
+        args.shards, output=None, align=not args.no_align)
+    real_events = sum(1 for e in merged["traceEvents"]
+                      if e.get("ph") != "M")
+    if real_events == 0:
+        print("error: the %d input shard(s) contain zero events — "
+              "refusing to write an empty trace (was the profiler "
+              "ever running / the flight recorder enabled?)"
+              % len(args.shards), file=sys.stderr)
+        return 1
+    with base.atomic_write(args.output, "w") as f:
+        json.dump(merged, f)
+    print("merged %d shard(s) (ranks %s, %d flight-recorder) -> %s: "
+          "%d events"
+          % (len(args.shards), summary["ranks"],
+             summary["flightrec_shards"], args.output,
              summary["events"]))
     for rank, off in sorted(summary["offsets_us"].items()):
         print("  rank %s: clock offset %+.1f us" % (rank, off))

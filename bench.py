@@ -1269,6 +1269,246 @@ def bench_comm_overlap():
     }
 
 
+def bench_fused_kernels():
+    """BENCH_MODEL=fused_kernels: the PR 9 Pallas kernel campaign gate
+    (ROADMAP item 4) over batchnorm_fused, optimizer_apply, and
+    quantized_matmul — the modules KERNEL_BENCH maps here.
+
+    On every backend: parity — fused BN vs its reference within 64 ULP
+    (forward + grads), packed optimizer apply BITWISE-equal to the
+    per-parameter step_fn chain inside one jit (SGD-momentum and Adam),
+    int8 matmul exactly equal to the XLA int32 dot (integer math is
+    exact), and a 5-step fused-train-step run bitwise-identical with
+    MXTPU_FUSED_APPLY=0/1. The kernels run in interpreter mode on CPU
+    (the real kernel code, interpreted) and compiled on TPU. On a real
+    backend additionally: >=1.5x vs the jitted XLA baseline per kernel.
+    Kernel first-builds must appear in profiler.compile_stats() (the
+    ISSUE 8 Compile table). Exits non-zero on any breach."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    BN = importlib.import_module(
+        "mxnet_tpu.pallas_kernels.batchnorm_fused")
+    OA = importlib.import_module(
+        "mxnet_tpu.pallas_kernels.optimizer_apply")
+    QM = importlib.import_module(
+        "mxnet_tpu.pallas_kernels.quantized_matmul")
+    from mxnet_tpu import profiler
+    from mxnet_tpu.optimizer.optimizer import SGD, Adam
+
+    # the ONE ULP-distance definition (shared with the per-op sweep)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "benchmark"))
+    from tpu_numerics import _max_ulp as _ulp
+
+    def _max_ulp(a, b):
+        return _ulp(np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    interp = not on_tpu
+    breaches = []
+    out = {"metric": "fused_kernels", "platform": platform,
+           "mode": "compiled" if on_tpu else "interpret"}
+
+    def _speedup(fast, slow, args):
+        """median-of-3 alternating rounds of jitted fast vs slow."""
+        jf, js = jax.jit(fast), jax.jit(slow)
+        jax.block_until_ready(jf(*args))
+        jax.block_until_ready(js(*args))
+        iters = int(os.environ.get("BENCH_KERNEL_ITERS", 20))
+        rates = {"f": [], "s": []}
+        for _ in range(3):
+            for key, fn in (("f", jf), ("s", js)):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    r = fn(*args)
+                jax.block_until_ready(r)
+                rates[key].append(iters / (time.perf_counter() - t0))
+        med = {k: sorted(v)[1] for k, v in rates.items()}
+        return med["f"] / med["s"]
+
+    rs = np.random.RandomState(0)
+
+    # -- (a) fused BatchNorm ------------------------------------------------
+    x = jnp.asarray(rs.randn(8, 16, 16, 256).astype("float32") * 2 + 1)
+    g = jnp.asarray(rs.rand(256).astype("float32") + 0.5)
+    b = jnp.asarray(rs.randn(256).astype("float32"))
+    o_k, m_k, v_k = jax.jit(
+        lambda *a: BN.fused_batch_norm(*a, act="relu",
+                                       interpret=interp))(x, g, b)
+    o_r, m_r, v_r = jax.jit(
+        lambda *a: BN.batchnorm_reference(*a, act="relu"))(x, g, b)
+    bn_ulp = max(_max_ulp(o_k, o_r), _max_ulp(m_k, m_r),
+                 _max_ulp(v_k, v_r))
+
+    def loss_k(x, g, b):
+        return jnp.sum(BN.fused_batch_norm(x, g, b,
+                                           interpret=interp)[0] ** 2)
+
+    def loss_r(x, g, b):
+        return jnp.sum(BN.batchnorm_reference(x, g, b)[0] ** 2)
+
+    gk = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))(x, g, b)
+    gr = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(x, g, b)
+    bn_grad_ok = all(
+        float(jnp.max(jnp.abs(a - c))) <=
+        1e-4 * (1.0 + float(jnp.max(jnp.abs(c))))
+        for a, c in zip(gk, gr))
+    out["batchnorm_fused"] = {"max_ulp": bn_ulp, "grads_ok": bn_grad_ok}
+    if bn_ulp > 64:
+        breaches.append("batchnorm_fused parity %d ULP > 64" % bn_ulp)
+    if not bn_grad_ok:
+        breaches.append("batchnorm_fused grads diverge from reference")
+    if on_tpu:
+        sp = _speedup(
+            lambda x, g, b: BN.fused_batch_norm(x, g, b, act="relu")[0],
+            lambda x, g, b: jnp.maximum(
+                BN.batchnorm_reference(x, g, b)[0], 0.0),
+            (x, g, b))
+        out["batchnorm_fused"]["speedup"] = round(sp, 2)
+        if sp < 1.5:
+            breaches.append("batchnorm_fused %.2fx < 1.5x" % sp)
+
+    # -- (b) packed optimizer apply -----------------------------------------
+    shapes = [(256, 256), (256,), (256, 128), (128,), (512, 64), (64,),
+              (33, 7)]
+    ws = [jnp.asarray(rs.randn(*s).astype("float32")) for s in shapes]
+    gs = [jnp.asarray(rs.randn(*s).astype("float32")) for s in shapes]
+    apply_res = {}
+    for name, opt, states in [
+            ("sgd_momentum", SGD(momentum=0.9, learning_rate=0.05,
+                                 wd=1e-4),
+             [jnp.zeros_like(w) for w in ws]),
+            ("adam", Adam(learning_rate=1e-3),
+             [(jnp.zeros_like(w), jnp.zeros_like(w)) for w in ws])]:
+        lrs = [jnp.float32(0.05 + 0.001 * i) for i in range(len(ws))]
+        wds = [jnp.float32(1e-4)] * len(ws)
+        rescale = jnp.float32(1.0 / 32)
+
+        def perparam(ws, gs, states, lrs, wds, rescale):
+            outs = [opt.step_fn(w, g, st, lr, wd, rescale)
+                    for w, g, st, lr, wd in zip(ws, gs, states, lrs,
+                                                wds)]
+            return [o[0] for o in outs], [o[1] for o in outs]
+
+        def packed(ws, gs, states, lrs, wds, rescale):
+            return OA.packed_apply(opt, ws, gs, states, lrs, wds,
+                                   rescale, interpret=interp)
+
+        r_pp = jax.jit(perparam)(ws, gs, states, lrs, wds, rescale)
+        r_pk = jax.jit(packed)(ws, gs, states, lrs, wds, rescale)
+        bitwise = all(
+            bool(jnp.array_equal(a, c))
+            for a, c in zip(jax.tree_util.tree_leaves(r_pp),
+                            jax.tree_util.tree_leaves(r_pk)))
+        apply_res[name] = {"bitwise": bitwise}
+        if not bitwise:
+            breaches.append("optimizer_apply %s not bitwise-equal to "
+                            "step_fn" % name)
+        if on_tpu:
+            sp = _speedup(packed, perparam,
+                          (ws, gs, states, lrs, wds, rescale))
+            apply_res[name]["speedup"] = round(sp, 2)
+            if sp < 1.5:
+                breaches.append("optimizer_apply %s %.2fx < 1.5x"
+                                % (name, sp))
+    out["optimizer_apply"] = apply_res
+
+    # -- (b2) the fused train step with MXTPU_FUSED_APPLY -------------------
+    def train_params(mode):
+        prev = os.environ.get("MXTPU_FUSED_APPLY")
+        os.environ["MXTPU_FUSED_APPLY"] = mode
+        try:
+            import random as _pyrandom
+
+            import mxnet_tpu as mx
+            from mxnet_tpu import gluon
+            _pyrandom.seed(0)
+            np.random.seed(0)
+            mx.random.seed(0)
+            net = gluon.nn.HybridSequential()
+            with net.name_scope():
+                net.add(gluon.nn.Dense(32, in_units=16,
+                                       activation="relu"))
+                net.add(gluon.nn.Dense(1, in_units=32))
+            net.initialize(mx.init.Uniform(0.1))
+            net.hybridize()
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05, "momentum": 0.9})
+            step = gluon.train_step(net, gluon.loss.L2Loss(), tr)
+            rsl = np.random.RandomState(0)
+            xb = mx.nd.array(rsl.rand(16, 16).astype("float32"))
+            yb = mx.nd.array(rsl.rand(16, 1).astype("float32"))
+            for _ in range(5):
+                step(xb, yb, batch_size=16)
+            assert step.last_mode == "fused", step.last_mode
+            return [p.data().asnumpy()
+                    for _, p in sorted(net.collect_params().items())]
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_FUSED_APPLY", None)
+            else:
+                os.environ["MXTPU_FUSED_APPLY"] = prev
+
+    base = train_params("0")
+    fused_apply_bitwise = all(
+        np.array_equal(a, c) for a, c in zip(base, train_params("1")))
+    interp_bitwise = all(
+        np.array_equal(a, c)
+        for a, c in zip(base, train_params("interpret")))
+    out["fused_step_apply_bitwise"] = {"packed": fused_apply_bitwise,
+                                       "interpret": interp_bitwise}
+    if not (fused_apply_bitwise and interp_bitwise):
+        breaches.append("MXTPU_FUSED_APPLY train step not bitwise vs "
+                        "per-param")
+
+    # -- (c) quantized matmul -----------------------------------------------
+    xq = jnp.asarray(rs.randint(-127, 128, (256, 512)).astype("int8"))
+    wq = jnp.asarray(rs.randint(-127, 128, (512, 256)).astype("int8"))
+    scales = jnp.asarray(rs.rand(256).astype("float32") * 0.01)
+    acc_k = jax.jit(
+        lambda x, w: QM.quantized_matmul(x, w, interpret=interp))(xq, wq)
+    acc_r = jax.jit(QM.quantized_matmul_reference)(xq, wq)
+    qm_exact = bool(jnp.array_equal(acc_k, acc_r))
+    sc_k = jax.jit(lambda x, w, s: QM.quantized_matmul(
+        x, w, scales=s, interpret=interp))(xq, wq, scales)
+    sc_r = jax.jit(lambda x, w, s: QM.quantized_matmul_reference(
+        x, w, scales=s))(xq, wq, scales)
+    qm_scaled_ulp = _max_ulp(sc_k, sc_r)
+    out["quantized_matmul"] = {"int32_exact": qm_exact,
+                               "scaled_max_ulp": qm_scaled_ulp}
+    if not qm_exact:
+        breaches.append("quantized_matmul int32 accumulator != XLA dot")
+    if qm_scaled_ulp > 1:
+        breaches.append("quantized_matmul scaled epilogue %d ULP > 1"
+                        % qm_scaled_ulp)
+    if on_tpu:
+        sp = _speedup(lambda x, w: QM.quantized_matmul(x, w),
+                      QM.quantized_matmul_reference, (xq, wq))
+        out["quantized_matmul"]["speedup"] = round(sp, 2)
+        if sp < 1.5:
+            breaches.append("quantized_matmul %.2fx < 1.5x" % sp)
+
+    # -- compile attribution (ISSUE 8c): kernel builds in the Compile table
+    compiles = [k for k in profiler.compile_stats() if
+                k.startswith("pallas:")]
+    out["compile_attribution"] = sorted(compiles)
+    if not any("batchnorm_fused" in k for k in compiles) \
+            or not any("optimizer_apply" in k for k in compiles) \
+            or not any("quantized_matmul" in k for k in compiles):
+        breaches.append("kernel compiles missing from "
+                        "profiler.compile_stats(): %s" % compiles)
+
+    out["value"] = len(breaches)
+    out["unit"] = "breaches"
+    out["gate"] = {"ok": not breaches, "breaches": breaches,
+                   "min_speedup": 1.5}
+    return out
+
+
 def bench_numerics():
     """BENCH_NUMERICS=1: device-vs-CPU-golden op sweep + flash kernel
     check (benchmark/tpu_numerics.py; VERDICT r3 item 8). The full
@@ -1322,6 +1562,8 @@ if __name__ == "__main__":
         result = bench_flightrec_overhead()
     elif which == "comm_overlap":
         result = bench_comm_overlap()
+    elif which == "fused_kernels":
+        result = bench_fused_kernels()
     else:
         def _section(fn):
             # retry ONLY transient remote-attach channel drops — a
@@ -1407,6 +1649,14 @@ if __name__ == "__main__":
                     result["chunked_ce"]["allreduce_bytes_baseline"],
                     result["chunked_ce"]["allreduce_bytes_local_accum"],
                     result["gate"]["overlap_strictly_reduces_exposed"]))
+    if result.get("metric") == "fused_kernels" \
+            and not result["gate"]["ok"]:
+        # the kernel campaign contract: parity (ULP-bounded BN, bitwise
+        # optimizer apply, exact int8 matmul) everywhere, >=1.5x vs the
+        # XLA baseline where a real backend is present, and every
+        # kernel build visible in the compile-attribution table
+        sys.exit("fused_kernels gate breached: %s"
+                 % "; ".join(result["gate"]["breaches"]))
     gate = result.get("numerics", {}).get("gate")
     if gate is not None and not gate["ok"]:
         # per-op ULP budget breached (benchmark/tpu_numerics.py

@@ -104,12 +104,21 @@ OPS = ["exp", "log", "tanh", "sigmoid", "erf", "rsqrt",
 # 80k budgets there; the two precision-control entries prove the
 # float32/highest escape hatches stay tight.
 ULP_BUDGETS = {
-    "exp": 256, "log": 16384, "tanh": 8192, "sigmoid": 512, "erf": 64,
+    # log/tanh dropped 16384/8192 -> 256 in PR 9: ops/elemwise.py now
+    # routes log through an exponent-split + log1p form (1 ULP vs f64
+    # truth on CPU) and tanh through an expm1 form (4 ULP), so the
+    # gate ENFORCES the campaign target instead of reporting the raw
+    # TPU polynomial drift (was 3,396 / 1,267 measured in r05).
+    "exp": 256, "log": 256, "tanh": 256, "sigmoid": 512, "erf": 64,
     "rsqrt": 32,
     "sum": 32, "mean": 32, "max": 8, "norm": 32,
     "dot": 80000, "linalg_gemm2": 80000, "linalg_potrf": 4096,
     "FullyConnected": 80000, "Convolution": 80000,
-    "BatchNorm": 50000, "Pooling": 8, "softmax": 512, "LayerNorm": 4096,
+    # BatchNorm 50000 -> 64: batch_moments pins the mean to a
+    # deterministic pairwise tree (bitwise equal across backends), so
+    # the x-mean cancellation no longer amplifies reduction-order
+    # noise; what remains is var last-bit noise through 1/sqrt
+    "BatchNorm": 64, "Pooling": 8, "softmax": 512, "LayerNorm": 4096,
     "log_softmax": 4096,
     "topk": 8, "sort": 8, "cumsum": 64, "take": 8,
     "dot_precision_highest": 16,

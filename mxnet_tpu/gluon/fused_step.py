@@ -429,6 +429,13 @@ class FusedTrainStep:
         partial = (self._trainer._optimizer._fused_static_key(),
                    len(all_params), tuple(train_pos),
                    _register._amp_version,
+                   # the packed-apply toggle changes the traced update
+                   # graph — and the kernel-routing envs change the
+                   # traced FORWARD (batch_norm/quantized routing) — so
+                   # flipping any of them mid-run must recompile, not
+                   # silently replay the other form
+                   os.environ.get("MXTPU_FUSED_APPLY", "0"),
+                   _register._kernel_env_token(),
                    jax.tree_util.tree_structure(state_datas))
         full = partial + (
             tuple(_register.aval(a._data) for a in nd_args),
@@ -455,6 +462,7 @@ class FusedTrainStep:
         train_set = set(train_pos)
         fixed_pos = tuple(i for i in range(n_all) if i not in train_set)
         mp = opt.multi_precision
+        packed_apply = self._packed_apply_fn(opt, all_params, train_pos)
 
         tag = None
         if self._mesh is not None:
@@ -500,8 +508,28 @@ class FusedTrainStep:
             # vjp of the same jitted forward) this program is bitwise
             # identical; the non-hybridized per-op tape can differ by
             # ~1 ULP because XLA fuses tiny dots differently per context
-            new_ws, new_sts = [], []
+            new_ws, new_sts = [None] * len(train_datas), \
+                [None] * len(train_datas)
+            packed_idx = packed_apply(train_datas, state_datas) \
+                if packed_apply else []
+            if packed_idx:
+                # MXTPU_FUSED_APPLY: the packed multi-tensor apply —
+                # dtype-homogeneous flat segments, ONE kernel launch
+                # per bucket, bitwise-equal to the per-param chain
+                # (pallas_kernels/optimizer_apply.py)
+                from ..pallas_kernels import optimizer_apply as _oa
+                pw, ps = _oa.packed_apply(
+                    opt, [train_datas[i] for i in packed_idx],
+                    [grads[i] for i in packed_idx],
+                    [state_datas[i] for i in packed_idx],
+                    [lrs[i] for i in packed_idx],
+                    [wds[i] for i in packed_idx], rescale)
+                for i, nw, ns in zip(packed_idx, pw, ps):
+                    new_ws[i] = nw
+                    new_sts[i] = ns
             for i in range(len(train_datas)):
+                if new_ws[i] is not None:
+                    continue
                 w, g, st = train_datas[i], grads[i], state_datas[i]
                 lr_i, wd_i, rs_i = lrs[i], wds[i], rescale
                 if not (mp and _is_low_precision(w.dtype)) \
@@ -515,8 +543,8 @@ class FusedTrainStep:
                     rs_i = rs_i.astype(w.dtype)
                 nw, ns = opt.step_fn_multi_precision(w, g, st, lr_i, wd_i,
                                                      rs_i)
-                new_ws.append(nw)
-                new_sts.append(ns)
+                new_ws[i] = nw
+                new_sts[i] = ns
             if self._mesh is not None:
                 # aux (BN moving stats) are per-shard estimates —
                 # average them so every replica adopts the same value
@@ -548,6 +576,38 @@ class FusedTrainStep:
         if self._mesh is not None:
             jfn = self._mesh_placed(jfn)
         return jfn, aux_params, fixed_pos
+
+    def _packed_apply_fn(self, opt, all_params, train_pos):
+        """The MXTPU_FUSED_APPLY eligibility selector, or None when the
+        packed multi-tensor apply is off or the optimizer's step math
+        is not packable (``Optimizer.fused_apply_supported``). The
+        selector runs at trace time over the operand trees and returns
+        the positions whose update goes through ``packed_apply`` —
+        everything static (dtypes, state structure), so the decision
+        bakes into the compiled program and the env toggle is part of
+        the cache signature."""
+        from ..pallas_kernels import optimizer_apply as _oa
+        if not (_oa.enabled() and opt.fused_apply_supported()):
+            return None
+        mp = opt.multi_precision
+
+        def select(train_datas, state_datas):
+            idx, ref_struct = [], None
+            for k, d in enumerate(train_datas):
+                if mp and _is_low_precision(d.dtype):
+                    continue  # (master, base) state: per-param path
+                leaves = jax.tree_util.tree_leaves(state_datas[k])
+                if any(l.shape != d.shape or l.dtype != d.dtype
+                       for l in leaves):
+                    continue
+                struct = jax.tree_util.tree_structure(state_datas[k])
+                if ref_struct is None:
+                    ref_struct = struct
+                elif struct != ref_struct:
+                    continue
+                idx.append(k)
+            return idx
+        return select
 
     def _mesh_placed(self, inner):
         """Mesh-mode placement shim: the first fused call receives

@@ -544,6 +544,21 @@ def invoke(opdef, args, kwargs):
     return tuple(outs) if multi else outs[0]
 
 
+def _kernel_env_token():
+    """The Pallas kernel-routing env settings that change an op's traced
+    graph (ops/nn.py batch_norm, ops/quantized.py). Part of every
+    dispatch-cache key: flipping MXTPU_FUSED_BN/MXTPU_QUANT_MATMUL (or
+    the global MXTPU_NO_PALLAS kill switch) mid-process must recompile,
+    not silently replay the other path for an already-hot signature —
+    the same contract MXTPU_FUSED_APPLY has in the fused-step
+    signature. Three dict lookups per key build, far below the aval
+    hashing already paid."""
+    env = os.environ
+    return (env.get("MXTPU_NO_PALLAS", "0"),
+            env.get("MXTPU_FUSED_BN", "1"),
+            env.get("MXTPU_QUANT_MATMUL", "1"))
+
+
 def _dispatch_key(opdef, args, kwargs, arg_slots, kw_slots, datas, key_val,
                   take_key, recording):
     """(full cache key, partial key) or (None, None) if unhashable."""
@@ -571,7 +586,7 @@ def _dispatch_key(opdef, args, kwargs, arg_slots, kw_slots, datas, key_val,
     if take_key:
         avals = avals + (_aval(key_val),)
     partial = (opdef.name, statics, tuple(arg_slots), tuple(kw_slots),
-               _amp_version, recording)
+               _amp_version, recording, _kernel_env_token())
     return partial + (avals,), partial
 
 

@@ -420,37 +420,87 @@ def logistic_regression_output(data, label, grad_scale=1.0):
         data, label, grad_scale)
 
 
-def batch_moments(x, axes, axis=None):
-    """Batch mean/var for normalization, cast to x.dtype — the ONE
-    definition of this framework's BN stat semantics (the fused-conv
-    BN fold in gluon/model_zoo/vision/resnet.py must stay bit-identical
-    to the BatchNorm op, so both call here).
+import functools as _functools
 
-    Half-precision inputs: single-pass E[x^2]-E[x]^2 in f32. The
-    cancellation error is ~mean^2 * 2^-24, ~256x SMALLER than the
-    variance noise the bf16 input quantization itself injects
-    (~mean^2 * 2^-16) — so this loses nothing, and fusing both moments
-    into ONE reduction pass removes most of the train-mode BN overhead
-    (measured +13% ResNet step throughput vs jnp.var's re-read of x).
-    Full-precision inputs: two-pass E[(x-mean)^2], where single-pass
-    cancellation WOULD dominate for |mean| >> std.
-    """
-    xf = x.astype(jnp.float32)
-    mean32 = jnp.mean(xf, axis=axes)
-    if jnp.dtype(x.dtype).itemsize <= 2:
-        var32 = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean32)
+
+# mxlint: disable=MX005 (shape-keyed by jax's own cache, bounded by the
+#         distinct normalization shapes a model contains; the ONE stable
+#         jit object keeps the ~50-eqn deterministic reduction a single
+#         call eqn inside every enclosing trace, so record-mode
+#         per-call linearization does not re-walk it)
+@_functools.partial(jax.jit, static_argnames=("single_pass",))
+def _moments_core(x2, single_pass):
+    """(R, C) f32 -> (mean32, var32): the deterministic stat math of
+    batch_moments (see its docstring for the numerics contract)."""
+    from ..pallas_kernels.batchnorm_fused import exact_sq, tree_fold_rows
+    n = x2.shape[0]
+    mean32 = tree_fold_rows(x2)[0] / n
+    if single_pass:
+        var32 = tree_fold_rows(exact_sq(x2))[0] / n - exact_sq(mean32)
         var32 = jnp.maximum(var32, 0.0)
     else:
-        shape0 = [1] * x.ndim
-        keep = (axis % x.ndim) if axis is not None else [
-            i for i in range(x.ndim) if i not in axes][0]
-        shape0[keep] = x.shape[keep]
-        var32 = jnp.mean(jnp.square(xf - mean32.reshape(shape0)),
-                         axis=axes)
+        var32 = tree_fold_rows(exact_sq(x2 - mean32))[0] / n
+    return mean32, var32
+
+
+# mxlint: disable=MX005 (same bounded shape-keyed contract as
+#         _moments_core above: one stable jit object per process)
+@_functools.partial(jax.jit, static_argnames=("cax",))
+def _bn_apply_core(x, mean32, var32, g, beta, eps, cax):
+    """The BN normalize chain over f32 stats. ``exact_mul`` + a
+    trailing add of already-rounded values: every op is
+    correctly-rounded over deterministic inputs, so no fusion context
+    or backend can move the output by a bit (the per-op ULP gate's
+    BatchNorm<=64 relies on this — last-bit noise here gets amplified
+    without bound in ULP terms wherever the output crosses zero)."""
+    from ..pallas_kernels.batchnorm_fused import exact_mul
+    shape = [1] * x.ndim
+    shape[cax] = x.shape[cax]
+    inv = 1.0 / jnp.sqrt(var32 + eps)
+    out = exact_mul(
+        x.astype(jnp.float32) - mean32.reshape(shape),
+        (inv * g.astype(jnp.float32)).reshape(shape)) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype)
+
+
+def batch_moments(x, axes, axis=None, fp32_out=False):
+    """Batch mean/var for normalization — the ONE definition of this
+    framework's BN stat semantics (the fused-conv BN fold in
+    gluon/model_zoo/vision/resnet.py must stay bit-identical to the
+    BatchNorm op, so both call here). Returns stats cast to x.dtype,
+    or raw f32 with ``fp32_out=True`` (the BatchNorm op normalizes
+    with the f32 stats and casts only the values it RETURNS, so
+    half-precision inputs never round the stats before the rsqrt).
+
+    Both stats accumulate in f32 through the deterministic reduction
+    of ``pallas_kernels/batchnorm_fused``: a fixed block-structured
+    pairwise tree (``tree_fold_rows``) of pure correctly-rounded f32
+    adds, with squares produced by ``exact_sq`` (exact-product
+    splitting, so FMA contraction — which differs per compiled
+    program — cannot perturb a single bit). The same statistic is
+    therefore bitwise-identical across platforms, fusion contexts,
+    and the Pallas kernel's tiling. That is the lever behind the
+    BatchNorm entry of the per-op ULP gate (budget 64, down from the
+    11,482 BENCH_r05 measured): the big outlier was free-order
+    ``jnp.mean`` noise amplified by the ``x - mean`` cancellation.
+    Half-precision inputs: single-pass E[x^2]-E[x]^2 (the cancellation
+    term ~mean^2 * 2^-24 is ~256x smaller than the bf16
+    input-quantization noise). Full-precision inputs: two-pass
+    E[(x-mean)^2], where single-pass cancellation WOULD dominate for
+    |mean| >> std.
+    """
+    keep = (axis % x.ndim) if axis is not None else [
+        i for i in range(x.ndim) if i not in axes][0]
+    c = x.shape[keep]
+    x2 = jnp.moveaxis(x.astype(jnp.float32), keep, -1).reshape(-1, c)
+    mean32, var32 = _moments_core(
+        x2, jnp.dtype(x.dtype).itemsize <= 2)
+    out_dt = jnp.float32 if fp32_out else x.dtype
     # tagged so conv-outs remat policies keep the (tiny) stat vectors
     # instead of re-reducing the activation in backward
-    return (_ckpt_name(mean32.astype(x.dtype), "bn_stat"),
-            _ckpt_name(var32.astype(x.dtype), "bn_stat"))
+    return (_ckpt_name(mean32.astype(out_dt), "bn_stat"),
+            _ckpt_name(var32.astype(out_dt), "bn_stat"))
 
 
 @register("BatchNorm", aliases=("batch_norm",))
@@ -461,19 +511,36 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
     """Returns (out, batch_mean, batch_var). Moving-stat update is done by the
     caller (gluon layer / stateful executor) — functional purity for XLA.
     ref: src/operator/nn/batch_norm-inl.h.
+
+    Numerics: stats accumulate in f32 (batch_moments' deterministic
+    tree) and the normalize chain runs in f32 off f32 stats —
+    ``1/sqrt`` (correctly rounded on every backend) instead of the
+    approximate ``lax.rsqrt`` — casting only the returned values, so
+    half-precision inputs no longer round mean/var before the inverse
+    and the per-op ULP gate holds BatchNorm at <=64. Training-mode
+    channels-last calls route through the fused Pallas kernel
+    (pallas_kernels/batchnorm_fused.py, ``MXTPU_FUSED_BN``) on TPU;
+    identical stat semantics, moving-stat contract unchanged.
     """
-    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    cax = axis % x.ndim
+    axes = tuple(i for i in range(x.ndim) if i != cax)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     use_batch = _training and not use_global_stats
     if use_batch:
-        mean, var = batch_moments(x, axes, axis)
+        from ..pallas_kernels import batchnorm_fused as _bnf
+        if _bnf.engaged(x, cax):
+            out, mean32, var32 = _bnf.fused_batch_norm(
+                x, g, beta, eps=eps)
+            return (out, _ckpt_name(mean32.astype(x.dtype), "bn_stat"),
+                    _ckpt_name(var32.astype(x.dtype), "bn_stat"))
+        mean32, var32 = batch_moments(x, axes, axis, fp32_out=True)
+        mean, var = mean32.astype(x.dtype), var32.astype(x.dtype)
     else:
         mean, var = moving_mean, moving_var
-    shape = [1] * x.ndim
-    shape[axis % x.ndim] = x.shape[axis % x.ndim]
-    inv = jax.lax.rsqrt(var + eps).reshape(shape)
-    out = (x - mean.reshape(shape)) * inv * g.reshape(shape) + beta.reshape(shape)
-    return out, mean, var
+        mean32 = mean.astype(jnp.float32)
+        var32 = var.astype(jnp.float32)
+    return (_bn_apply_core(x, mean32, var32, g, beta,
+                           jnp.float32(eps), cax), mean, var)
 
 
 @register("LayerNorm", num_inputs=3, aliases=("layer_norm",))

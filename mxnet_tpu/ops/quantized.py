@@ -22,8 +22,24 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register
+# direct submodule import: the package __init__ re-exports the
+# quantized_matmul FUNCTION under the module's name
+from ..pallas_kernels.quantized_matmul import (
+    engaged as _qmm_engaged, quantized_matmul as _qmm)
 
 __all__ = []
+
+
+def _int8_dot(x2, wt):
+    """(M, K) int8 @ (K, N) int8 -> (M, N) int32, through the Pallas
+    MXU int-path kernel when it engages (TPU + aligned shapes, or the
+    ``MXTPU_QUANT_MATMUL=interpret`` test hook) and the XLA int32
+    ``dot_general`` otherwise. Integer accumulation is exact, so the
+    two paths are bitwise identical."""
+    if _qmm_engaged(x2, wt):
+        return _qmm(x2, wt)
+    return lax.dot_general(x2.astype(jnp.int32), wt.astype(jnp.int32),
+                           (((1,), (0,)), ((), ())))
 
 
 def _scale(mn, mx):
@@ -160,10 +176,17 @@ def quantized_fully_connected(data, weight, bias, min_data, max_data,
                               min_weight, max_weight, min_bias, max_bias,
                               num_hidden=1, no_bias=False, flatten=True):
     """int8 FC -> int32 (ref: quantized_fully_connected.cc). The int8 x
-    int8 dot accumulates in int32 on the MXU int path."""
+    int8 dot accumulates in int32 on the MXU int path — via the Pallas
+    tiled kernel (pallas_kernels/quantized_matmul.py) when it
+    engages."""
     x = data.reshape(data.shape[0], -1) if flatten else data
-    acc = lax.dot_general(x.astype(jnp.int32), weight.astype(jnp.int32),
-                          (((x.ndim - 1,), (1,)), ((), ())))
+    if x.ndim == 2 and jnp.dtype(x.dtype) == jnp.int8 \
+            and jnp.dtype(weight.dtype) == jnp.int8:
+        acc = _int8_dot(x, weight.T)
+    else:
+        acc = lax.dot_general(x.astype(jnp.int32),
+                              weight.astype(jnp.int32),
+                              (((x.ndim - 1,), (1,)), ((), ())))
     sd = _scale(min_data, max_data)
     sw = _scale(min_weight, max_weight)
     out_scale = sd * sw
@@ -181,15 +204,31 @@ def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
                    max_weight, min_bias, max_bias, kernel=(1, 1),
                    stride=(1, 1), pad=(0, 0), dilate=(1, 1), num_filter=1,
                    num_group=1, no_bias=False, layout="NCHW"):
-    """int8 conv -> int32 (ref: quantized_conv.cc)."""
+    """int8 conv -> int32 (ref: quantized_conv.cc). 1x1/stride-1
+    convolutions — the ResNet bottleneck reductions that dominate
+    quantized inference — are a plain matmul over the flattened
+    spatial positions and route through the Pallas int8 kernel when it
+    engages; everything else stays on the XLA int32 conv."""
     sh, sw = int(stride[0]), int(stride[1])
     ph, pw = int(pad[0]), int(pad[1])
     dh, dw = int(dilate[0]), int(dilate[1])
-    acc = lax.conv_general_dilated(
-        data.astype(jnp.int32), weight.astype(jnp.int32),
-        window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
-        rhs_dilation=(dh, dw), feature_group_count=int(num_group),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ci, h, w_sp = data.shape
+    is_1x1 = (weight.shape[2:] == (1, 1) and (sh, sw) == (1, 1)
+              and (ph, pw) == (0, 0) and int(num_group) == 1
+              and jnp.dtype(data.dtype) == jnp.int8
+              and jnp.dtype(weight.dtype) == jnp.int8)
+    if is_1x1:
+        x2 = jnp.transpose(data, (0, 2, 3, 1)).reshape(-1, ci)
+        wt = weight.reshape(weight.shape[0], ci).T     # (Ci, Co)
+        acc = _int8_dot(x2, wt)
+        acc = jnp.transpose(
+            acc.reshape(n, h, w_sp, weight.shape[0]), (0, 3, 1, 2))
+    else:
+        acc = lax.conv_general_dilated(
+            data.astype(jnp.int32), weight.astype(jnp.int32),
+            window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+            rhs_dilation=(dh, dw), feature_group_count=int(num_group),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
     sd = _scale(min_data, max_data)
     sw_ = _scale(min_weight, max_weight)
     out_scale = sd * sw_

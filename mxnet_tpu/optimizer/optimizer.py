@@ -241,6 +241,17 @@ class Optimizer:
         """Whether this optimizer defines the pure step_fn form."""
         return type(self).step_fn is not Optimizer.step_fn
 
+    def fused_apply_supported(self):
+        """Whether ``step_fn`` is purely ELEMENTWISE over (weight,
+        grad, state leaves, lr, wd, rescale) — the property that makes
+        the packed multi-tensor apply
+        (pallas_kernels/optimizer_apply.py, ``MXTPU_FUSED_APPLY``)
+        bitwise-equal to the per-parameter chain. Opt-in per optimizer:
+        a reduction or shape-dependent term in the update math (e.g.
+        LAMB's trust ratio) silently breaks under packing, so the base
+        says no."""
+        return False
+
     def step_lr(self, index):
         """Effective learning rate ``step_fn`` should receive for one
         weight this step — computed with the SAME host float64 math
@@ -326,6 +337,9 @@ class SGD(Optimizer):
             return weight - lr * (g + wd * weight), state
         m2 = self.momentum * state - lr * (g + wd * weight)
         return weight + m2, m2
+
+    def fused_apply_supported(self):
+        return True
 
     def _fused_static_key(self):
         return super()._fused_static_key() + (self.momentum,)
@@ -662,6 +676,9 @@ class Adam(Optimizer):
         v2 = self.beta2 * v + (1 - self.beta2) * g * g
         w2 = weight - lr * m2 / (jnp.sqrt(v2) + self.epsilon)
         return w2, (m2, v2)
+
+    def fused_apply_supported(self):
+        return True
 
     def step_lr(self, index):
         t = self._index_update_count[index]

@@ -1,0 +1,53 @@
+"""First-build compile attribution for Pallas kernels (ISSUE 8c).
+
+Every hand-written kernel registers its first build per signature in
+``profiler.record_compile`` so kernel compiles show up in the same
+Compile table as the imperative dispatch cache and the fused train
+step (``profiler.dumps()`` / ``metrics()['compile']``). The wall time
+recorded is trace+compile+first-run when the kernel is invoked
+eagerly; under an ENCLOSING jit trace it prices trace construction
+only — the enclosing program's own compile probe (register.py
+``_compile_probe`` / FusedTrainStep AOT) attributes the XLA compile
+that actually contains the kernel, so nothing is double-counted.
+
+Steady-state cost per kernel launch is one dict lookup; kernels are
+macro ops (a whole BN/matmul/optimizer pass), so this sits far below
+the per-op telemetry budgets.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+
+from .. import profiler as _profiler
+from .._debug.locktrace import named_lock
+
+__all__ = ["attributed"]
+
+_SEEN = set()
+_LOCK = named_lock("pallas.compile_attr")
+
+
+def attributed(name, key, fn):
+    """Run ``fn()`` (a zero-arg closure over one pallas_call launch),
+    timing and recording the FIRST call per (kernel, signature) via
+    ``profiler.record_compile('pallas:<name>', ...)``. Later calls run
+    ``fn`` straight through."""
+    sig = (name, str(key))
+    if sig in _SEEN:
+        return fn()
+    t0 = time.perf_counter()
+    out = fn()
+    try:
+        out = jax.block_until_ready(out)
+    except Exception:
+        pass  # tracers under an enclosing jit cannot block
+    dur_us = (time.perf_counter() - t0) * 1e6
+    with _LOCK:
+        first = sig not in _SEEN
+        _SEEN.add(sig)
+    if first:
+        _profiler.record_compile("pallas:" + name, key=str(key),
+                                 dur_us=dur_us)
+    return out

@@ -1,0 +1,226 @@
+"""Fused multi-tensor optimizer apply as a Pallas TPU kernel.
+
+The fused train step (gluon/fused_step.py) traces one ``step_fn`` call
+per parameter — for a ResNet/transformer that is hundreds of small
+elementwise op chains XLA schedules as separate fusions, each paying
+its own HBM round trip and launch. This module restores the reference's
+multi-tensor apply shape (ref: src/operator/contrib/multi_sum_sq.cu +
+multi_sgd/multi_lamb fused update kernels): the parameter tree is
+flattened into dtype-homogeneous packed segments (the
+``parallel/overlap.py:bucket_plan`` shape — same size cap, same
+order-preserving dtype grouping) and the optimizer math runs as ONE
+kernel launch per bucket over the packed 1-D views.
+
+Bitwise parity contract: every supported ``step_fn``
+(``Optimizer.fused_apply_supported``; SGD/momentum and Adam) is purely
+ELEMENTWISE over (weight, grad, state..., lr, wd, rescale). Packing
+therefore changes only the array SHAPE the math runs over, never a
+single rounding: concatenation and splitting are exact, per-parameter
+lr/wd scalars become per-element vectors holding the identical values,
+and the kernel body calls the optimizer's own ``step_fn`` on the packed
+block — so packed results are bitwise-equal to the per-parameter chain
+(gated in ``BENCH_MODEL=fused_kernels`` and tests).
+
+Consumed by ``gluon/fused_step.py``'s update phase behind
+``MXTPU_FUSED_APPLY`` (default off; ``1`` packs, ``interpret`` forces
+the Pallas kernel in interpreter mode for CPU tests). Off-TPU the
+packed segments still run — as one jnp elementwise chain per bucket,
+which XLA fuses into one program instead of per-parameter op chains.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ._compile_attr import attributed
+from .conv_fused import _use_pallas
+
+__all__ = ["packed_apply", "packed_apply_reference", "enabled",
+           "bucketize"]
+
+_ENV = "MXTPU_FUSED_APPLY"
+
+
+def _setting():
+    return os.environ.get(_ENV, "0")
+
+
+def enabled():
+    return _setting() != "0"
+
+
+def _force_interpret():
+    return _setting() == "interpret"
+
+
+def bucketize(ws):
+    """Dtype-homogeneous, size-capped packing plan over the weight
+    leaves — literally ``parallel/overlap.bucket_plan`` (one shared
+    definition of how this framework groups a param tree into flat
+    segments, whether for wire messages or kernel launches)."""
+    from ..parallel.overlap import bucket_plan
+    return bucket_plan(ws)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: one elementwise apply over a packed (rows, 128) segment
+# ---------------------------------------------------------------------------
+
+from jax.experimental import pallas as pl                # noqa: E402
+from jax.experimental.pallas import tpu as pltpu         # noqa: E402
+
+_LANES = 128
+_ROW_TILE = 512
+
+
+def _apply_kernel(*refs, n_state, n_out, math):
+    w_ref, g_ref, lr_ref, wd_ref, rs_ref = refs[:5]
+    s_refs = refs[5:5 + n_state]
+    out_refs = refs[5 + n_state:5 + n_state + n_out]
+    rs = rs_ref[0, 0].astype(w_ref.dtype)
+    outs = math(w_ref[:], g_ref[:], tuple(s[:] for s in s_refs),
+                lr_ref[:], wd_ref[:], rs)
+    for o_ref, o in zip(out_refs, outs):
+        o_ref[:] = o.astype(o_ref.dtype)
+
+
+def _sublane(dtype):
+    b = jnp.dtype(dtype).itemsize
+    return 8 if b >= 4 else (16 if b == 2 else 32)
+
+
+def _pad_rows(flat, rows, dtype):
+    pad = rows * _LANES - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
+    return flat.reshape(rows, _LANES)
+
+
+def _pallas_apply(math, w, g, sleaves, lrv, wdv, rescale, out_structs,
+                  interpret):
+    L = w.shape[0]
+    dt = w.dtype
+    q = _sublane(dt)
+    rows = pl.cdiv(L, _LANES)
+    tr = min(_ROW_TILE, ((rows + q - 1) // q) * q)
+    rows = ((rows + tr - 1) // tr) * tr
+    n_state = len(sleaves)
+    n_out = len(out_structs)
+    ops = [_pad_rows(a, rows, a.dtype)
+           for a in (w, g, lrv, wdv)] + \
+        [jnp.asarray(rescale, jnp.float32).reshape(1, 1)] + \
+        [_pad_rows(s, rows, s.dtype) for s in sleaves]
+    blk = pl.BlockSpec((tr, _LANES), lambda r: (r, 0))
+    outs = attributed(
+        "optimizer_apply", (L, str(dt), n_state, n_out), lambda:
+        pl.pallas_call(
+            functools.partial(_apply_kernel, n_state=n_state,
+                              n_out=n_out, math=math),
+            grid=(rows // tr,),
+            in_specs=[blk, blk, blk, blk,
+                      pl.BlockSpec((1, 1), lambda r: (0, 0),
+                                   memory_space=pltpu.SMEM)]
+            + [blk] * n_state,
+            out_specs=tuple([blk] * n_out),
+            out_shape=tuple(
+                jax.ShapeDtypeStruct((rows, _LANES), s.dtype)
+                for s in out_structs),
+            interpret=interpret,
+        )(ops[0], ops[1], ops[2], ops[3], ops[4], *ops[5:]))
+    return [o.reshape(-1)[:L] for o in outs]
+
+
+def packed_apply_reference(math, w, g, sleaves, lrv, wdv, rescale):
+    """The packed apply without the kernel: the optimizer's own
+    ``step_fn`` over the flat segment — one jnp elementwise chain XLA
+    fuses per bucket. Bitwise-identical to the kernel (same math, same
+    operands) and to the per-parameter chain (elementwise argument in
+    the module docstring)."""
+    rs = jnp.asarray(rescale, jnp.float32).astype(w.dtype)
+    return list(math(w, g, tuple(sleaves), lrv, wdv, rs))
+
+
+def packed_apply(opt, ws, gs, states, lrs, wds, rescale,
+                 interpret=False):
+    """Apply ``opt.step_fn`` to every parameter in ONE launch per
+    packed segment.
+
+    ws/gs: lists of weight/grad arrays (any shapes, mixed dtypes).
+    states: per-parameter optimizer-state pytrees, structurally
+    identical across the list and with every leaf shaped/typed like its
+    weight (the caller — gluon/fused_step — checks eligibility).
+    lrs/wds: per-parameter f32 scalars (traced operands); rescale: f32
+    scalar. Returns ``(new_ws, new_states)`` lists, bitwise-equal to
+    looping ``opt.step_fn`` per parameter.
+    """
+    interpret = bool(interpret) or _force_interpret()
+    n = len(ws)
+    new_ws = [None] * n
+    new_states = [None] * n
+    treedef = jax.tree_util.tree_structure(states[0]) if n else None
+    for bucket in bucketize(ws):
+        dt = ws[bucket[0]].dtype
+        sizes = [int(ws[i].size) for i in bucket]
+
+        def cat(parts):
+            parts = [jnp.ravel(p) for p in parts]
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+        w = cat([ws[i] for i in bucket])
+        g = cat([gs[i] for i in bucket])
+        sleaves_per = [jax.tree_util.tree_leaves(states[i])
+                       for i in bucket]
+        sleaves = [cat([sl[k] for sl in sleaves_per])
+                   for k in range(len(sleaves_per[0]))]
+        # per-parameter scalars -> per-element vectors with the exact
+        # same values; demoted to the bucket dtype exactly where the
+        # per-parameter loop demotes (non-f32 weights). The vectors add
+        # two param-sized operands per bucket — the price of keeping
+        # the bitwise-parity argument trivially elementwise; a
+        # per-segment SMEM scalar table would carry the same values
+        # with less HBM traffic but per-element indexing in the kernel
+        # (revisit if the TPU gate's >=1.5x headroom ever thins)
+        lrv = cat([jnp.broadcast_to(jnp.asarray(lrs[i], jnp.float32),
+                                    (sz,)) for i, sz in zip(bucket, sizes)])
+        wdv = cat([jnp.broadcast_to(jnp.asarray(wds[i], jnp.float32),
+                                    (sz,)) for i, sz in zip(bucket, sizes)])
+        if dt != jnp.float32:
+            lrv = lrv.astype(dt)
+            wdv = wdv.astype(dt)
+
+        def math(w_, g_, sl_, lr_, wd_, rs_):
+            state = jax.tree_util.tree_unflatten(treedef, list(sl_))
+            nw, ns = opt.step_fn(w_, g_, state, lr_, wd_, rs_)
+            ns_leaves = jax.tree_util.tree_leaves(ns)
+            if len(ns_leaves) != len(sl_):
+                raise ValueError(
+                    "%s.step_fn changed the state structure — not "
+                    "packable" % type(opt).__name__)
+            return (nw,) + tuple(ns_leaves)
+
+        def _sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        out_structs = jax.eval_shape(
+            math, _sds(w), _sds(g), tuple(_sds(s) for s in sleaves),
+            _sds(lrv), _sds(wdv), jax.ShapeDtypeStruct((), dt))
+        if interpret or _use_pallas(w):
+            outs = _pallas_apply(math, w, g, sleaves, lrv, wdv, rescale,
+                                 out_structs, interpret)
+        else:
+            outs = packed_apply_reference(math, w, g, sleaves, lrv, wdv,
+                                          rescale)
+        # split the packed results back into per-parameter views; the
+        # state structure is unchanged by contract (asserted in math)
+        nw_flat, ns_flats = outs[0], outs[1:]
+        off = 0
+        for i, sz in zip(bucket, sizes):
+            new_ws[i] = nw_flat[off:off + sz].reshape(ws[i].shape)
+            leaves = [f[off:off + sz].reshape(ws[i].shape)
+                      for f in ns_flats]
+            new_states[i] = jax.tree_util.tree_unflatten(treedef, leaves)
+            off += sz
+    return new_ws, new_states

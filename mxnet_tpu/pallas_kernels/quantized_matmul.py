@@ -1,0 +1,201 @@
+"""int8 x int8 -> int32 tiled matmul with per-channel scales, as a
+Pallas TPU kernel.
+
+The quantized operator family (ops/quantized.py, mirroring the
+reference's src/operator/quantization/) has been pure-XLA since its
+port: ``quantized_fully_connected``/``quantized_conv`` cast int8
+payloads up to int32 and run a float-path ``dot_general``. On TPU the
+MXU has a native int8 path (2x the bf16 rate on v5e) that XLA only
+picks when it sees int8 operands with an int32 accumulator — this
+kernel guarantees that shape:
+
+- grid (M/TM, N/TN, K/TK) with K innermost; an (TM, TN) int32 VMEM
+  scratch accumulates ``dot(int8, int8, preferred_element_type=int32)``
+  partials across the K sweep and writes once at the last K tile —
+  int8 operand tiles move through VMEM exactly once.
+- optional per-output-channel dequantize fused into the epilogue: with
+  ``scales`` (f32 (N,), = input_scale * per-channel weight scale) the
+  kernel writes f32 ``acc * scales`` instead of raw int32, so a
+  serving path gets dequantized activations without a second HBM pass.
+
+Integer accumulation is EXACT, so kernel-vs-reference parity is
+bitwise on the int32 payload (the ``BENCH_MODEL=fused_kernels`` gate
+checks equality, not a ULP bound); the scaled f32 epilogue is one
+correctly-rounded multiply per element.
+
+Consumed by ``ops/quantized.py`` ``quantized_fully_connected`` (always,
+when shapes fit) and ``quantized_conv`` (1x1/stride-1 convolutions —
+the ResNet bottleneck reductions that dominate quantized inference),
+behind ``MXTPU_QUANT_MATMUL``. The ``resnet50_infer`` bench picks this
+up through ``contrib.quantization.quantize_net``.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ._compile_attr import attributed
+from .conv_fused import _use_pallas
+
+__all__ = ["quantized_matmul", "quantized_matmul_reference", "engaged"]
+
+_ENV = "MXTPU_QUANT_MATMUL"
+
+
+def _setting():
+    return os.environ.get(_ENV, "1")
+
+
+def _force_interpret():
+    return _setting() == "interpret"
+
+
+def quantized_matmul_reference(x, w, scales=None):
+    """jnp semantics of the kernel (fallback + goldens): x (M, K) int8,
+    w (K, N) int8 -> (M, N) int32 accumulator, or f32 ``acc * scales``
+    with per-output-channel scales (N,) f32."""
+    acc = lax.dot_general(x.astype(jnp.int32), w.astype(jnp.int32),
+                          (((1,), (0,)), ((), ())))
+    if scales is None:
+        return acc
+    return acc.astype(jnp.float32) * scales
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+from jax.experimental import pallas as pl                # noqa: E402
+from jax.experimental.pallas import tpu as pltpu         # noqa: E402
+
+_VMEM_BUDGET = 7 * 1024 * 1024
+
+
+def _mm_kernel(x_ref, w_ref, o_ref, acc_scr, *, nk):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(k == nk - 1)
+    def _write():
+        o_ref[:] = acc_scr[:]
+
+
+def _mm_scaled_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(k == nk - 1)
+    def _write():
+        o_ref[:] = acc_scr[:].astype(jnp.float32) * s_ref[:]
+
+
+def _tiles(M, K, N):
+    """(TM, TN, TK, fits). int8 tiling quanta: 32 sublanes, 128 lanes.
+    The VMEM working set (double-buffered int8 operand tiles + the
+    int32 accumulator + the output tile) stays comfortably inside the
+    budget at the default 128^3 tiling; M shrinks to the largest
+    32-multiple tile that divides it (small batches), K/N require lane
+    alignment outright — anything else falls back to the reference."""
+    tm = 128
+    while tm > 32 and M % tm != 0:
+        tm //= 2
+    tk = 128 if K % 128 == 0 else 0
+    tn = 128 if N % 128 == 0 else 0
+    if not tk or not tn or M % tm != 0:
+        return tm, tn, tk, False
+    est = 2 * (tm * tk + tk * tn) + tm * tn * (4 + 2 * 4)
+    return tm, tn, tk, est <= _VMEM_BUDGET
+
+
+def _fits(M, K, N):
+    return _tiles(M, K, N)[3]
+
+
+def _pallas_matmul(x, w, scales, interpret):
+    M, K = x.shape
+    N = w.shape[1]
+    if interpret:
+        tm, tn, tk = min(128, M), min(128, N), min(128, K)
+        if M % tm or N % tn or K % tk:
+            tm, tn, tk = M, N, K
+    else:
+        tm, tn, tk, _ = _tiles(M, K, N)
+    nk = K // tk
+    key = (M, K, N, scales is not None)
+    grid = (M // tm, N // tn, nk)
+    x_spec = pl.BlockSpec((tm, tk), lambda i, j, k: (i, k))
+    w_spec = pl.BlockSpec((tk, tn), lambda i, j, k: (k, j))
+    o_spec = pl.BlockSpec((tm, tn), lambda i, j, k: (i, j))
+    scratch = [pltpu.VMEM((tm, tn), jnp.int32)]
+    if scales is None:
+        return attributed("quantized_matmul", key, lambda:
+            pl.pallas_call(
+                functools.partial(_mm_kernel, nk=nk),
+                grid=grid, in_specs=[x_spec, w_spec], out_specs=o_spec,
+                out_shape=jax.ShapeDtypeStruct((M, N), jnp.int32),
+                scratch_shapes=scratch, interpret=interpret,
+            )(x, w))
+    s2 = scales.astype(jnp.float32).reshape(1, N)
+    return attributed("quantized_matmul", key, lambda:
+        pl.pallas_call(
+            functools.partial(_mm_scaled_kernel, nk=nk),
+            grid=grid,
+            in_specs=[x_spec, w_spec,
+                      pl.BlockSpec((1, tn), lambda i, j, k: (0, j))],
+            out_specs=o_spec,
+            out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+            scratch_shapes=scratch, interpret=interpret,
+        )(x, w, s2))
+
+
+def engaged(x, w):
+    """Whether ops/quantized.py should route this (M, K) x (K, N) int8
+    product through the kernel: enabled, int8 payloads, and either on
+    TPU with an aligned tiling or force-interpreted
+    (``MXTPU_QUANT_MATMUL=interpret``, the CPU test hook)."""
+    if _setting() == "0":
+        return False
+    if x.ndim != 2 or w.ndim != 2 or x.shape[1] != w.shape[0]:
+        return False
+    if jnp.dtype(x.dtype) != jnp.int8 or jnp.dtype(w.dtype) != jnp.int8:
+        return False
+    if _force_interpret():
+        return True
+    return _use_pallas(x) and _fits(x.shape[0], x.shape[1], w.shape[1])
+
+
+def quantized_matmul(x, w, scales=None, interpret=False):
+    """x (M, K) int8 @ w (K, N) int8 with int32 accumulation on the MXU
+    int path. Returns the (M, N) int32 accumulator, or — with per-
+    output-channel ``scales`` (N,) f32 — the dequantized f32 product
+    ``acc * scales`` fused into the kernel epilogue. Falls back to an
+    identical-semantics jnp reference off-TPU or for unaligned shapes;
+    ``interpret=True`` runs the Pallas kernel in interpreter mode for
+    CPU tests.
+    """
+    if x.ndim != 2 or w.ndim != 2 or x.shape[1] != w.shape[0]:
+        raise ValueError("quantized_matmul: need (M, K) x and (K, N) w, "
+                         "got %s / %s" % (x.shape, w.shape))
+    interpret = bool(interpret) or _force_interpret()
+    if interpret or (_use_pallas(x)
+                     and _fits(x.shape[0], x.shape[1], w.shape[1])):
+        return _pallas_matmul(x, w, scales, interpret)
+    return quantized_matmul_reference(x, w, scales)

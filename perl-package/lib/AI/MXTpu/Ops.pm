@@ -717,7 +717,7 @@ sub linear_regression_output { AI::MXTpu::op('linear_regression_output', @_) }
 # linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype='float32')
 sub linspace { AI::MXTpu::op('linspace', @_) }
 
-# log(x: 'ArrayLike', /) -> 'Array'
+# log(x)
 sub log_ { AI::MXTpu::op('log', @_) }
 
 # log10(x: 'ArrayLike', /) -> 'Array'
@@ -1152,7 +1152,7 @@ sub take { AI::MXTpu::op('take', @_) }
 # tan(x: 'ArrayLike', /) -> 'Array'
 sub tan { AI::MXTpu::op('tan', @_) }
 
-# tanh(x: 'ArrayLike', /) -> 'Array'
+# tanh(x)
 sub tanh { AI::MXTpu::op('tanh', @_) }
 
 # tile(x, reps=())

@@ -370,6 +370,64 @@ def test_mx011_out_of_scope_module_is_exempt(tmp_path):
     assert findings == []
 
 
+def test_mx012_flags_contractless_kernel_module(tmp_path):
+    """A pallas_kernels module without a reference implementation, an
+    interpret= path, or a KERNEL_BENCH registration breaks the kernel
+    contract threefold."""
+    (tmp_path / "mxnet_tpu" / "pallas_kernels").mkdir(parents=True)
+    (tmp_path / "mxnet_tpu" / "pallas_kernels" / "__init__.py") \
+        .write_text("KERNEL_BENCH = {'other': 'resnet50'}\n")
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/pallas_kernels/shiny.py", """\
+        import jax.numpy as jnp
+
+        def shiny_kernel(x):
+            return x * 2
+        """, {"MX012"})
+    assert [f.code for f in findings] == ["MX012"] * 3
+    msgs = " ".join(f.message for f in findings)
+    assert "reference" in msgs and "interpret" in msgs \
+        and "KERNEL_BENCH" in msgs
+
+
+def test_mx012_accepts_contract_compliant_module(tmp_path):
+    (tmp_path / "mxnet_tpu" / "pallas_kernels").mkdir(parents=True)
+    (tmp_path / "mxnet_tpu" / "pallas_kernels" / "__init__.py") \
+        .write_text("KERNEL_BENCH = {'shiny': 'fused_kernels'}\n")
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/pallas_kernels/shiny.py", """\
+        import jax.numpy as jnp
+
+        def shiny_reference(x):
+            return x * 2
+
+        def shiny(x, interpret=False):
+            return shiny_reference(x)
+        """, {"MX012"})
+    assert findings == []
+
+
+def test_mx012_private_helpers_and_init_are_exempt(tmp_path):
+    """_compile_attr.py-style private helpers and the package __init__
+    are not kernel modules."""
+    for rel in ("mxnet_tpu/pallas_kernels/_helper.py",
+                "mxnet_tpu/pallas_kernels/__init__.py"):
+        findings, _, _, _ = _lint_snippet(
+            tmp_path, rel, "X = 1\n", {"MX012"})
+        assert findings == [], rel
+
+
+def test_mx012_real_tree_kernels_registered():
+    """Every shipped kernel module appears in KERNEL_BENCH, and the
+    campaign kernels map to the fused_kernels gate."""
+    from mxnet_tpu import pallas_kernels as pk
+    for mod in ("batchnorm_fused", "optimizer_apply",
+                "quantized_matmul"):
+        assert pk.KERNEL_BENCH[mod] == "fused_kernels"
+    for mod in ("flash_attention", "compression", "conv_fused"):
+        assert mod in pk.KERNEL_BENCH
+
+
 # -- waiver machinery --------------------------------------------------------
 
 def test_waiver_without_reason_is_flagged(tmp_path):

@@ -10,6 +10,7 @@ for the catalog with rationale and example waivers.
 from __future__ import annotations
 
 import ast
+import os
 import re
 
 from .core import Finding
@@ -744,6 +745,85 @@ class MX011FlightrecSecondBranch:
         return out
 
 
+# -- MX012 -------------------------------------------------------------------
+
+class MX012PallasKernelContract:
+    """Every kernel module in ``pallas_kernels/`` carries the
+    conv_fused contract: a pure-jnp reference implementation with
+    identical semantics (``*_reference`` / ``*_jnp`` naming), an
+    ``interpret=`` path so the CPU tier-1 suite executes the real
+    kernel code in interpreter mode, and registration in the package's
+    ``KERNEL_BENCH`` map so a bench gate prices it (the
+    ``fused_kernels`` gate for the PR 9 campaign kernels). A kernel
+    without a reference can't be parity-gated, one without interpret
+    is dead code on the CPU suite, and one outside KERNEL_BENCH ships
+    unpriced."""
+
+    code = "MX012"
+    summary = "pallas kernel module missing reference/interpret/bench"
+    kind = "python"
+
+    def scope(self, path):
+        if not path.startswith("mxnet_tpu/pallas_kernels/"):
+            return False
+        name = path.rsplit("/", 1)[-1]
+        return (name.endswith(".py") and name != "__init__.py"
+                and not name.startswith("_"))
+
+    def _bench_registry(self):
+        from . import core
+        init = os.path.join(core.REPO_ROOT, "mxnet_tpu",
+                            "pallas_kernels", "__init__.py")
+        try:
+            with open(init, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "KERNEL_BENCH"
+                    for t in node.targets):
+                if isinstance(node.value, ast.Dict):
+                    return {k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant)}
+        return set()
+
+    def check(self, path, src, tree, parents):
+        out = []
+        defs = [n for n in tree.body
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))]
+        has_ref = any("reference" in n.name or n.name.endswith("_jnp")
+                      for n in defs)
+        has_interp = any(
+            any(a.arg == "interpret" for a in
+                list(n.args.args) + list(n.args.kwonlyargs))
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+        if not has_ref:
+            out.append(Finding(
+                self.code, path, 1,
+                "pallas kernel module exports no reference "
+                "implementation (*_reference / *_jnp) — parity gates "
+                "need the identical-semantics jnp form"))
+        if not has_interp:
+            out.append(Finding(
+                self.code, path, 1,
+                "pallas kernel module has no interpret= path — the "
+                "CPU tier-1 suite must run the real kernel code in "
+                "interpreter mode"))
+        mod = path.rsplit("/", 1)[-1][:-3]
+        if mod not in self._bench_registry():
+            out.append(Finding(
+                self.code, path, 1,
+                "kernel module %r is not registered in "
+                "pallas_kernels/__init__.py KERNEL_BENCH — every "
+                "kernel must be priced by a bench gate "
+                "(BENCH_MODEL=fused_kernels for campaign kernels)"
+                % mod))
+        return out
+
+
 ALL_RULES = (
     MX001JnpBypassesInvoke(),
     MX002UnguardedProfilerHook(),
@@ -756,4 +836,5 @@ ALL_RULES = (
     MX009SwallowedBroadExcept(),
     MX010UnguardedLatencyTelemetry(),
     MX011FlightrecSecondBranch(),
+    MX012PallasKernelContract(),
 )

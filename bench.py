@@ -461,6 +461,188 @@ def bench_input_pipeline(step=None, batch=128, dtype="bfloat16",
     return out
 
 
+def _synth_raw_rec_io(n=384, side=64, path=None):
+    """Synthetic raw-pixel .rec + .idx + .crc for the data-plane gate —
+    cv2-free (pack_raw_img stores pre-decoded pixels), written once
+    via temp+rename so an interrupted run never leaves truncated files
+    a later run silently reuses. The cache is keyed on (n, side) in
+    the filename, so a BENCH_IO_RECORDS override can never silently
+    reuse a dataset of the wrong size."""
+    from mxnet_tpu.io import build_crc_sidecar
+    from mxnet_tpu.recordio import (MXIndexedRecordIO, pack_raw_img,
+                                    IRHeader)
+    if path is None:
+        path = "/tmp/mxtpu_bench_io_plane_%dx%d.rec" % (n, side)
+    idx = path.replace(".rec", ".idx")
+    if not (os.path.exists(path) and os.path.exists(idx)
+            and os.path.exists(path + ".crc")):
+        tmp_rec, tmp_idx = path + ".tmp", idx + ".tmp"
+        w = MXIndexedRecordIO(tmp_idx, tmp_rec, "w")
+        rng = np.random.RandomState(0)
+        for i in range(n):
+            img = rng.randint(0, 255, (side, side, 3), np.uint8)
+            w.write_idx(i, pack_raw_img(IRHeader(0, float(i % 10), i, 0),
+                                        img))
+        w.close()
+        os.rename(tmp_rec, path)
+        os.rename(tmp_idx, idx)
+        build_crc_sidecar(path)
+    return path, idx
+
+
+def bench_input_pipeline_gate():
+    """BENCH_MODEL=input_pipeline: the ISSUE 11 data-plane gate.
+
+    The sharded streaming service (ShardService -> RecordIORangeReader
+    -> DecodePool -> DevicePrefetchIter) must sustain **>= 2x the
+    fused-step consumption rate** so the accelerator can never starve
+    even if decode momentarily halves, with the
+    ``io.prefetch_queue_depth`` gauge nonzero while stepping at full
+    rate (depth 0 at the consumer = the pipeline IS the ceiling). The
+    chaos variant re-runs the same plane under 15% injected decode
+    faults (worker deaths + restarts) and 15% injected read faults
+    (retried range fetches) and must still beat **1x** — degraded, not
+    starving. Exits non-zero on breach (driven from __main__)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import profiler
+    from mxnet_tpu._debug import faultpoint
+    from mxnet_tpu._retry import RetryPolicy
+    from mxnet_tpu.io import (ShardService, RecordIORangeReader,
+                              DevicePrefetchIter)
+    from mxnet_tpu.io import _stats as io_stats
+    from mxnet_tpu.recordio import unpack_img
+
+    side = 64
+    n_rec = int(os.environ.get("BENCH_IO_RECORDS", "384"))
+    batch = int(os.environ.get("BENCH_IO_BATCH", "32"))
+    workers = int(os.environ.get("BENCH_IO_WORKERS", "2"))
+    rec, idx = _synth_raw_rec_io(n=n_rec, side=side)
+
+    crop = side - 8
+
+    def decode(payload):
+        _, img = unpack_img(payload)  # raw fast path: no JPEG decode
+        return np.ascontiguousarray(
+            img[4:4 + crop, 4:4 + crop].transpose(2, 0, 1))
+
+    # the consumer this plane must outrun: a jitted multi-layer conv
+    # step — an honest stand-in for a fused TRAIN step's per-batch
+    # device time (a single tiny conv measures noise, not a workload,
+    # and a noisy denominator makes the 2x/1x ratios flap run-to-run)
+    key = jax.random.PRNGKey(0)
+    ws = [jax.random.normal(key, (32, 3, 3, 3), jnp.float32) * 0.1] + \
+        [jax.random.normal(key, (32, 32, 3, 3), jnp.float32) * 0.1
+         for _ in range(3)]
+
+    @jax.jit
+    def step_fn(x):
+        y = x.astype(jnp.float32) / 255.0
+        for w in ws:
+            y = jax.nn.relu(jax.lax.conv_general_dilated(
+                y, w, (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW")))
+        return jnp.tanh(y).mean()
+
+    probe = np.zeros((batch, 3, crop, crop), np.uint8)
+    float(step_fn(probe))  # compile
+    reps, times = 7, []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(step_fn(probe))
+        times.append(time.perf_counter() - t0)
+    step_s = sorted(times)[reps // 2]  # median: robust to one stall
+    consume_rate = 1.0 / step_s  # batches/sec the device eats
+
+    def make_plane():
+        reader = RecordIORangeReader(
+            rec, index=idx,
+            # chaos injects transient read faults at 15%: keep the
+            # backoff small so the gate prices the retry MACHINERY,
+            # not a production-tuned sleep schedule
+            retry_policy=RetryPolicy(base=0.0005, cap=0.01,
+                                     deadline=30))
+        svc = ShardService(n_rec, shard_size=batch, seed=0, world=(0,),
+                           rank=0, reader=reader, decode_fn=decode)
+        return svc
+
+    def pipeline_rate(chaos):
+        svc = make_plane()
+        if chaos:
+            faultpoint.configure(
+                {"io.worker.decode": "raise:ValueError@p=0.15",
+                 "io.shard.read": "raise:OSError@p=0.15"}, seed=11)
+        else:
+            faultpoint.reset()
+        try:
+            nb = 0
+            t0 = time.perf_counter()
+            for _, samples in svc.iter_batches(batch, workers=workers):
+                np.stack(samples)
+                nb += 1
+            dt = time.perf_counter() - t0
+            faults = dict(profiler.metrics().get("faults", {}))
+        finally:
+            faultpoint.reset()  # also zeroes the trigger counters
+        return nb / dt, nb, faults
+
+    plain_rate, plain_batches, _ = pipeline_rate(chaos=False)
+    chaos_rate, chaos_batches, chaos_faults = pipeline_rate(chaos=True)
+
+    # full-step-rate run: the plane feeds the jitted step through the
+    # device double buffer; the queue-depth gauge must be nonzero while
+    # the consumer is busy (i.e. the producer stays ahead)
+    svc = make_plane()
+
+    def host_batches():
+        for _, samples in svc.iter_batches(batch, workers=workers):
+            yield np.stack(samples)
+
+    depth_samples = []
+    pf = DevicePrefetchIter(host_batches(), depth=2)
+    first = next(pf)
+    float(step_fn(first))
+    for x in pf:
+        float(step_fn(x))
+        depth_samples.append(
+            io_stats.get("prefetch_queue_depth", 0))
+    nonzero_frac = (sum(1 for d in depth_samples if d > 0)
+                    / max(1, len(depth_samples)))
+
+    gate = {
+        "min_speedup": 2.0,
+        "min_chaos_speedup": 1.0,
+        "min_depth_nonzero_frac": 0.5,
+        "plain_ok": plain_rate >= 2.0 * consume_rate,
+        "chaos_ok": chaos_rate >= 1.0 * consume_rate,
+        # chaos must actually have injected (a zero-fault chaos run
+        # pricing at full speed would be a lie)
+        "chaos_injected": (chaos_faults.get("io.worker.decode", 0) > 0
+                           and chaos_faults.get("io.shard.read", 0)
+                           > 0),
+        "depth_ok": nonzero_frac >= 0.5,
+    }
+    gate["ok"] = (gate["plain_ok"] and gate["chaos_ok"]
+                  and gate["chaos_injected"] and gate["depth_ok"])
+    io_m = {k: v for k, v in profiler.metrics().get("io", {}).items()
+            if not k.startswith("service_")}
+    return {
+        "metric": "input_pipeline_plane",
+        "records": n_rec, "batch": batch, "workers": workers,
+        "consume_batches_per_sec": round(consume_rate, 2),
+        "plain_batches_per_sec": round(plain_rate, 2),
+        "plain_speedup": round(plain_rate / consume_rate, 2),
+        "chaos_batches_per_sec": round(chaos_rate, 2),
+        "chaos_speedup": round(chaos_rate / consume_rate, 2),
+        "chaos_faults": chaos_faults,
+        "queue_depth_nonzero_frac": round(nonzero_frac, 3),
+        "batches_streamed": {"plain": plain_batches,
+                             "chaos": chaos_batches},
+        "io_metrics": io_m,
+        "gate": gate,
+    }
+
+
 def bench_resnet_inference(net=None, batch=None, dtype=None):
     """ResNet-50 inference throughput — the reference's benchmark_score
     headline (perf.md V100 fp16 batch 128: 2355.04 img/s, BASELINE.md
@@ -1564,6 +1746,8 @@ if __name__ == "__main__":
         result = bench_comm_overlap()
     elif which == "fused_kernels":
         result = bench_fused_kernels()
+    elif which == "input_pipeline":
+        result = bench_input_pipeline_gate()
     else:
         def _section(fn):
             # retry ONLY transient remote-attach channel drops — a
@@ -1649,6 +1833,22 @@ if __name__ == "__main__":
                     result["chunked_ce"]["allreduce_bytes_baseline"],
                     result["chunked_ce"]["allreduce_bytes_local_accum"],
                     result["gate"]["overlap_strictly_reduces_exposed"]))
+    if result.get("metric") == "input_pipeline_plane" \
+            and not result["gate"]["ok"]:
+        # the data plane must outrun the device 2x clean and 1x under
+        # 15% injected decode/read chaos, with the prefetch queue
+        # nonzero at full step rate — anything less and the input
+        # pipeline, not the TPU, is the training ceiling (ROADMAP 5)
+        sys.exit("input_pipeline gate breached: plain %.2fx (need >= "
+                 "%.1fx), chaos %.2fx (need >= %.1fx, injected=%s), "
+                 "queue-depth nonzero %.0f%% (need >= %.0f%%)"
+                 % (result["plain_speedup"],
+                    result["gate"]["min_speedup"],
+                    result["chaos_speedup"],
+                    result["gate"]["min_chaos_speedup"],
+                    result["gate"]["chaos_injected"],
+                    100 * result["queue_depth_nonzero_frac"],
+                    100 * result["gate"]["min_depth_nonzero_frac"]))
     if result.get("metric") == "fused_kernels" \
             and not result["gate"]["ok"]:
         # the kernel campaign contract: parity (ULP-bounded BN, bitwise

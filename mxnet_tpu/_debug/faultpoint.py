@@ -38,6 +38,16 @@ failure → behavior → counter table):
                             any checkpoint bytes are read
 ``elastic.reshard``         ``ElasticController.reshard`` entry, before
                             the surviving world is committed
+``io.shard.read``           ``RecordIORangeReader`` range-fetch, per
+                            attempt (retried under ``_retry``)
+``io.record.corrupt``       ``RecordIORangeReader`` record validation —
+                            an injected raise is treated as a corrupt
+                            record (skip-and-count under the budget)
+``io.worker.decode``        ``DecodePool`` worker, before ``decode_fn``
+                            (a raise is a worker death; the pool
+                            restarts it under its per-worker budget)
+``io.service.fetch``        ``ShardService.fetch_batch`` entry — the
+                            disaggregated-service RPC seam
 ==========================  ================================================
 
 Configuration — env var (parsed at import) or programmatic::
@@ -106,6 +116,10 @@ POINTS = frozenset((
     "collective.allreduce",
     "elastic.restore",
     "elastic.reshard",
+    "io.shard.read",
+    "io.record.corrupt",
+    "io.worker.decode",
+    "io.service.fetch",
 ))
 
 _lock = _locktrace.named_lock("faultpoint.config")

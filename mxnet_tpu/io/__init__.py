@@ -7,12 +7,30 @@ analog, ref: src/io/iter_image_recordio_2.cc:79) and batches are prefetched
 on a background thread (ref: src/io/iter_prefetcher.h) so the accelerator
 never waits on the host. Host->HBM transfer is the jax device_put double
 buffer in PrefetchingIter.
+
+The scale-out half (ISSUE 11, docs/DATA.md) is the fault-tolerant
+sharded streaming service: deterministic global shard assignment with a
+committed sample cursor (``shard_service``), an N-worker restart-or-die
+decode pool (``worker_pool``), and a range-read RecordIO reader with
+retry + corrupt-record budgets (``range_reader``). Accounting for the
+whole plane surfaces as ``profiler.metrics()['io']`` (``_stats``).
 """
+from . import _stats  # registers the metrics()['io'] provider
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
                  LibSVMIter, ResizeIter, PrefetchingIter, MNISTIter)
 from .image_iter import ImageRecordIter
 from .prefetch import DevicePrefetchIter, DevicePrefetcher
+from .range_reader import (RecordIORangeReader, CorruptRecordError,
+                           build_crc_sidecar)
+from .worker_pool import DecodePool
+from .shard_service import (ShardService, epoch_order, assign_shards,
+                            reassign_shards, unconsumed_shards,
+                            batch_slices)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "ResizeIter", "PrefetchingIter", "MNISTIter",
-           "ImageRecordIter", "DevicePrefetchIter", "DevicePrefetcher"]
+           "ImageRecordIter", "DevicePrefetchIter", "DevicePrefetcher",
+           "RecordIORangeReader", "CorruptRecordError",
+           "build_crc_sidecar", "DecodePool", "ShardService",
+           "epoch_order", "assign_shards", "reassign_shards",
+           "unconsumed_shards", "batch_slices"]

@@ -1,0 +1,74 @@
+"""Data-plane accounting: the ``profiler.metrics()['io']`` provider.
+
+One module owns every input-pipeline counter and gauge (worker deaths
+and restarts per worker, corrupt records skipped, shard/cursor
+progress, the live prefetch queue depth) so the flight recorder, the
+``/metrics`` exporter, and the ``BENCH_MODEL=input_pipeline`` gate all
+read the same numbers. Counters accumulate unconditionally — the
+``profiler.account`` contract — because the restart diagnostic must be
+trustworthy in production, not just while a profile run is active.
+
+Gauges are *live values*, not accumulators: ``prefetch_queue_depth``
+is re-seeded from the actual queue size whenever a prefetch worker
+restarts, so a death with items still queued can never leave the gauge
+stale (or, for a delta-tracked implementation, negative) — the ISSUE
+11 satellite regression ``tests/test_prefetch.py`` pins.
+
+Like the PR 2 ``io.prefetch_queue_depth`` trace-counter series it
+mirrors, the gauge is ONE series per process: every prefetcher
+publishes to it, so its value is the most recent sample across them —
+the consumer-stall story for "the" training feed. A process running
+several concurrent pipelines should read the per-pool
+``io_workers:<name>`` flight-recorder context (and per-worker lanes)
+for disambiguation.
+"""
+from __future__ import annotations
+
+from .. import profiler as _profiler
+from .._debug import locktrace as _locktrace
+
+__all__ = ["bump", "set_gauge", "get", "snapshot", "reset"]
+
+_lock = _locktrace.named_lock("io.stats")
+_counters = {}  # cumulative (worker_deaths.<i>, corrupt_records, ...)
+_gauges = {}    # live values (prefetch_queue_depth, pool_workers, ...)
+
+
+def bump(name, delta=1, args=None):
+    """Accumulate a cumulative io counter (unconditionally) and mirror
+    it into the profiler's counter ledger so the trace timeline shows
+    it when a run is active."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + delta
+    _profiler.account("io.%s" % name, delta, lane="io")
+
+
+def set_gauge(name, value):
+    """Publish a live gauge value (replaces, never accumulates)."""
+    with _lock:
+        _gauges[name] = value
+
+
+def get(name, default=0):
+    with _lock:
+        if name in _counters:
+            return _counters[name]
+        return _gauges.get(name, default)
+
+
+def snapshot():
+    """JSON-safe merged view — the ``io`` section of
+    ``profiler.metrics()``."""
+    with _lock:
+        out = dict(_counters)
+        out.update(_gauges)
+        return out
+
+
+def reset():
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+
+
+_profiler.register_stats_provider("io", snapshot, reset)

@@ -226,12 +226,12 @@ class _Prefetcher:
                     item = it._assemble_next()
                 except StopIteration:
                     item = None
-                except Exception as e:  # noqa: BLE001 — forward to consumer
-                    from .. import profiler as _profiler
-                    # counted with profiling off too: account gates only
-                    # the trace event, never the production counter
-                    _profiler.account("io.prefetch_worker_deaths", 1,
-                                      lane="io", emit=False)
+                except Exception as e:  # mxlint: disable=MX009 (forwarded to the consumer's next() and counted via _stats.bump -> profiler.account)
+                    from . import _stats
+                    # counted with profiling off too: _stats.bump feeds
+                    # both metrics()['io'] and the unconditional
+                    # profiler.account ledger
+                    _stats.bump("prefetch_worker_deaths")
                     item = e
                 # bounded put that keeps observing the stop flag, so
                 # stop() never deadlocks against a full queue

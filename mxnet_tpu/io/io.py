@@ -11,6 +11,7 @@ import numpy as np
 from ..ndarray import NDArray, array as nd_array
 from .. import ndarray as nd
 from .. import profiler as _profiler
+from . import _stats
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "ResizeIter", "PrefetchingIter", "MNISTIter"]
@@ -360,6 +361,12 @@ class PrefetchingIter(DataIter):
         return self.iter.provide_label
 
     def reset(self):
+        # drain AND JOIN the old worker before anything else touches
+        # the underlying iterator (ISSUE 11 satellite): a worker merely
+        # observed as not-alive could in principle still be between its
+        # last put() and thread exit — after join() it provably cannot
+        # place a stale batch into the queue the fresh epoch reads, and
+        # it cannot race self.iter.reset() on the shared source
         self._stop.set()
         # drain so a worker blocked in put() can finish and observe _stop
         while self._thread is not None and self._thread.is_alive():
@@ -367,8 +374,13 @@ class PrefetchingIter(DataIter):
                 self._queue.get(timeout=0.05)
             except _queue.Empty:
                 pass
+        if self._thread is not None:
+            self._thread.join()
         while not self._queue.empty():
             self._queue.get_nowait()
+        # gauge re-seed from the LIVE (drained) queue: pre-reset samples
+        # must not linger as the published depth
+        _stats.set_gauge("prefetch_queue_depth", self._queue.qsize())
         self._stop.clear()
         self._epoch += 1
         self.iter.reset()
@@ -377,6 +389,7 @@ class PrefetchingIter(DataIter):
     def next(self):
         t0 = _time.perf_counter() if _profiler._LIVE else None
         batch = self._next_impl()
+        _stats.set_gauge("prefetch_queue_depth", self._queue.qsize())
         if t0 is not None:
             wait_us = (_time.perf_counter() - t0) * 1e6
             _profiler.record_op(

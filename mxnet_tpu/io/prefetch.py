@@ -18,6 +18,7 @@ import time as _time
 
 from .. import profiler as _profiler
 from .._debug import faultpoint as _faultpoint
+from . import _stats
 
 __all__ = ["DevicePrefetchIter", "DevicePrefetcher"]
 
@@ -72,6 +73,11 @@ class DevicePrefetchIter:
         # iterator is exhausted (StopIteration, like any finished
         # iterator) until reset() launches a fresh worker
         self._worker_failed = False
+        # gauge re-seed (ISSUE 11 satellite): a restart discards
+        # whatever sat in the old queue, so the published depth must
+        # come from the LIVE queue — never a stale pre-death sample,
+        # never a negative from delta bookkeeping over dropped items
+        _stats.set_gauge("prefetch_queue_depth", self._q.qsize())
         q, stop = self._q, self._stop
 
         def put(item):
@@ -101,18 +107,19 @@ class DevicePrefetchIter:
                             args={"queue_depth": q.qsize()})
                     if stop.is_set() or not put(placed):
                         return
+                    _stats.set_gauge("prefetch_queue_depth", q.qsize())
                     if t0 is not None:
                         _profiler.record_counter(
                             "io.prefetch_queue_depth", q.qsize(),
                             lane="io")
-            except BaseException as e:  # noqa: BLE001 — propagate to consumer
+            except BaseException as e:  # mxlint: disable=MX009 (queued to the consumer — raised once at __next__ — and counted via _stats.bump -> profiler.account)
                 # a worker death is a counted event, not just a raised
                 # exception: io.prefetch_worker_deaths is the restart
                 # diagnostic (how often did reset() have to recover?) —
-                # counted even with profiling off (account accumulates
-                # unconditionally; only trace emission gates on _ACTIVE)
-                _profiler.account("io.prefetch_worker_deaths", 1,
-                                  lane="io", emit=False)
+                # counted even with profiling off (_stats.bump feeds
+                # both metrics()['io'] and the unconditional
+                # profiler.account ledger)
+                _stats.bump("prefetch_worker_deaths")
                 put(e)
                 return
             put(_SENTINEL)
@@ -154,6 +161,7 @@ class DevicePrefetchIter:
         # producer (queue-empty time = the pipeline is io-bound)
         t0 = _time.perf_counter() if _profiler._LIVE else None
         item = self._q.get()
+        _stats.set_gauge("prefetch_queue_depth", self._q.qsize())
         if t0 is not None:
             wait_us = (_time.perf_counter() - t0) * 1e6
             _profiler.record_op(

@@ -538,7 +538,7 @@ def shard_for_rank(n_items, world, rank):
 
 def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                        max_failures=3, on_restore=None, logger=None,
-                       controller=None):
+                       controller=None, data_service=None):
     """Run `state, metrics = step_fn(state, batch)` over `batches` with
     checkpoint-based recovery and (optionally) live resharding.
 
@@ -553,6 +553,15 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
       (rate-limited), so a vanished rank triggers resharding even when
       this rank's own step did not fail
     - on SIGTERM: save synchronously and return early with the state
+    - with a ``data_service`` (``io.ShardService``): the service's
+      sample cursor is **embedded in every checkpoint payload**
+      (``cursor_for_checkpoint``/``apply_cursor``), so ONE atomic
+      temp+rename publishes params@step and cursor@step together — a
+      crash at any instant leaves either both or neither, never a torn
+      pair that would replay already-trained samples; the service is
+      resized onto the survivors after every reshard — the ISSUE 11
+      weld that keeps the global sample sequence intact across a
+      mid-epoch rank death
 
     `batches` must be re-iterable (a list or a factory-backed sequence)
     so recovery can rewind. Returns (state, last_step, completed: bool).
@@ -562,14 +571,38 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
         save_every = int(os.environ.get("MXTPU_ELASTIC_CKPT_EVERY",
                                         "100"))
     batches = list(batches)
+
+    def _unwrap(restored):
+        """Split a restored payload: adopt the embedded data cursor
+        (when present) and return the bare train state. Pre-weld
+        checkpoints (no wrapper) pass through unchanged."""
+        if isinstance(restored, dict) \
+                and "__data_cursor__" in restored:
+            if data_service is not None:
+                data_service.apply_cursor(restored["__data_cursor__"])
+            return restored["__elastic_state__"]
+        return restored
+
     start = 0
     restored, step0 = ckpt.restore()
     if restored is not None:
-        state = _retree(state, restored)
+        state = _retree(state, _unwrap(restored))
         start = step0 + 1
         if on_restore is not None:
             on_restore(state, step0)
         log.info("elastic: resumed from checkpoint step %d", step0)
+
+    def _save(step):
+        payload = state
+        if data_service is not None:
+            # the cursor rides INSIDE the params payload: one
+            # temp+rename publishes both, so no crash instant can
+            # leave params@step paired with an older cursor (which
+            # would replay already-trained samples on resume)
+            payload = {"__elastic_state__": state,
+                       "__data_cursor__":
+                           data_service.cursor_for_checkpoint()}
+        ckpt.save(step, payload)
 
     def _recover(need_reshard):
         """Reshard (when attributed to a dead rank) then rewind to the
@@ -581,11 +614,16 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                 # nothing to rewind to: bail BEFORE the reshard commits
                 # a shrunk world the caller can't resume into
                 return None
-            _, state = controller.reshard(state)
+            survivors, state = controller.reshard(state)
+            if data_service is not None:
+                # the dead rank's unconsumed shards reassign onto the
+                # survivors — pure math over committed state, so every
+                # survivor computes the identical new ownership
+                data_service.resize(survivors)
         restored, s0 = ckpt.restore()
         if restored is None:
             return None
-        state = _retree(state, restored)
+        state = _retree(state, _unwrap(restored))
         if on_restore is not None:
             on_restore(state, s0)
         return state, s0 + 1
@@ -597,7 +635,7 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
             if guard.preempted:
                 last = i - 1
                 if i > start or restored is not None:
-                    ckpt.save(last, state)
+                    _save(last)
                 _profiler.bump_elastic("preemptions",
                                        args={"step": last})
                 log.warning("elastic: preempted, checkpointed step %d",
@@ -647,7 +685,7 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                 time.sleep(0.1 * failures)
                 continue
             if save_every and i % save_every == 0:
-                ckpt.save(i, state)
+                _save(i)
             i += 1
     return state, len(batches) - 1, True
 

@@ -62,7 +62,7 @@ __all__ = [
     "serve_metrics", "stop_metrics_server", "prometheus_text",
     "merge_traces", "PID",
     "marker", "bump_elastic", "elastic_stats", "reset_elastic_stats",
-    "record_compile", "compile_stats",
+    "record_compile", "compile_stats", "ensure_lane",
 ]
 
 # chrome-trace pid of every event this process emits: the worker rank.
@@ -82,6 +82,26 @@ LANES = {
     "user": 7,
     "compile": 8,
 }
+
+# dynamic lanes (ensure_lane) are allocated from here up, so the fixed
+# rows above keep their stable sort indices even as subsystems add rows
+_DYN_LANE_BASE = 16
+
+
+def ensure_lane(name, base=None):
+    """Allocate (or return) a stable trace tid for a *dynamic* lane —
+    e.g. one trace row per decode-pool worker (``io.w0``, ``io.w1``,
+    ...). Idempotent: the first caller wins the tid, every later call
+    returns it, and the lane shows up in the trace's thread_name
+    metadata like the built-in rows. Dynamic tids start at
+    ``_DYN_LANE_BASE`` so the fixed lanes keep their sort order."""
+    floor = _DYN_LANE_BASE if base is None else int(base)
+    with _lock:
+        tid = LANES.get(name)
+        if tid is None:
+            tid = max(max(LANES.values()) + 1, floor)
+            LANES[name] = tid
+        return tid
 
 _lock = _locktrace.named_lock("profiler.events")
 _state = {

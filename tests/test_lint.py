@@ -428,6 +428,63 @@ def test_mx012_real_tree_kernels_registered():
         assert mod in pk.KERNEL_BENCH
 
 
+def _plant_catalog(tmp_path, points):
+    d = tmp_path / "mxnet_tpu" / "_debug"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "faultpoint.py").write_text(
+        "POINTS = frozenset((%s,))\n"
+        % ", ".join("%r" % p for p in points))
+
+
+def test_mx013_flags_uncataloged_literal(tmp_path):
+    _plant_catalog(tmp_path, ["io.known.point"])
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/io/newthing.py", """\
+        from .._debug import faultpoint as _faultpoint
+
+        def f(point):
+            _faultpoint.check("io.known.point")    # cataloged: ok
+            _faultpoint.check("io.typo.point")     # flagged
+            _faultpoint.check(point)               # computed: exempt
+        """, {"MX013"})
+    assert [f.code for f in findings] == ["MX013"]
+    assert "io.typo.point" in findings[0].message
+    assert findings[0].line == 5
+
+
+def test_mx013_import_alias_forms(tmp_path):
+    """Both import spellings bind the alias the rule tracks."""
+    _plant_catalog(tmp_path, ["a.b"])
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/x.py", """\
+        import mxnet_tpu._debug.faultpoint as fp
+
+        def f():
+            fp.check("a.b")
+            fp.check("a.nope")
+        """, {"MX013"})
+    assert [f.code for f in findings] == ["MX013"]
+
+
+def test_mx013_scope_excludes_tests():
+    rule = next(r for r in rules.ALL_RULES if r.code == "MX013")
+    assert rule.scope("mxnet_tpu/io/shard_service.py")
+    assert rule.scope("bench.py")
+    assert not rule.scope("tests/test_faultpoints.py")
+    assert not rule.scope("docs/DATA.md")
+
+
+def test_mx013_real_catalog_includes_io_points():
+    """The rule reads the REAL catalog: the ISSUE 11 io seams are in
+    it, so the clean-tree gate genuinely checks the new check() sites."""
+    rule = next(r for r in rules.ALL_RULES if r.code == "MX013")
+    catalog = rule._catalog()
+    for p in ("io.shard.read", "io.record.corrupt",
+              "io.worker.decode", "io.service.fetch",
+              "kvstore.send", "checkpoint.save"):
+        assert p in catalog, p
+
+
 # -- waiver machinery --------------------------------------------------------
 
 def test_waiver_without_reason_is_flagged(tmp_path):

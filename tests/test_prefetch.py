@@ -134,6 +134,141 @@ def test_ndarray_batches_stay_ndarray():
     np.testing.assert_allclose(x.asnumpy(), 1.0)
 
 
+# -- ISSUE 11 satellites: gauge accounting + drain-and-join resets ------------
+
+def _poll(cond, timeout=5.0):
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_queue_depth_gauge_reseeds_from_live_queue_on_restart():
+    """Regression (ISSUE 11): a worker restart while items sit in the
+    queue must re-seed the ``io.prefetch_queue_depth`` gauge from the
+    LIVE queue — never leave the pre-restart depth published (stale),
+    and never go negative the way delta bookkeeping over discarded
+    items would."""
+    from mxnet_tpu.io import _stats as io_stats
+
+    class FirstRunFull:
+        """First run streams plenty (queue fills); after reset the
+        source is empty — so any nonzero post-reset gauge value can
+        only be staleness."""
+
+        def __init__(self):
+            self.runs = 0
+
+        def __iter__(self):
+            self.runs += 1
+            if self.runs == 1:
+                for i in range(100):
+                    yield np.full((2,), i, dtype=np.float32)
+
+        def reset(self):
+            pass
+
+    io_stats.reset()
+    pf = DevicePrefetchIter(FirstRunFull(), depth=4)
+    next(pf)
+    # let the producer run ahead: the gauge reflects a filling queue
+    assert _poll(lambda: io_stats.get("prefetch_queue_depth", 0) >= 1)
+    stale = io_stats.get("prefetch_queue_depth")
+    assert stale >= 1
+    pf.reset()  # discards the queued items, restarts onto an empty src
+    g = io_stats.get("prefetch_queue_depth", None)
+    assert g == 0, "gauge must be re-seeded from the live queue, " \
+        "got %r (pre-reset %r)" % (g, stale)
+    assert list(pf) == []  # second run really is empty
+    assert io_stats.get("prefetch_queue_depth") >= 0
+
+
+def test_gauge_never_negative_across_death_and_reset():
+    from mxnet_tpu.io import _stats as io_stats
+
+    class DieMidStream:
+        def __init__(self):
+            self.runs = 0
+
+        def __iter__(self):
+            self.runs += 1
+            for i in range(3):
+                yield np.full((1,), i, dtype=np.float32)
+            if self.runs == 1:
+                raise RuntimeError("source died")
+
+        def reset(self):
+            pass
+
+    io_stats.reset()
+    pf = DevicePrefetchIter(DieMidStream(), depth=4)
+    seen = []
+    with pytest.raises(RuntimeError):
+        for b in pf:
+            seen.append(b)
+            assert io_stats.get("prefetch_queue_depth", 0) >= 0
+    assert len(seen) == 3
+    pf.reset()
+    assert io_stats.get("prefetch_queue_depth", 0) >= 0
+    assert len(list(pf)) == 3  # recovered run delivers everything
+    assert io_stats.get("prefetch_queue_depth", 0) >= 0
+
+
+def test_device_prefetch_reset_joins_old_worker():
+    """reset() must drain AND JOIN: after it returns, the previous
+    worker thread is provably finished — it cannot place into the
+    replaced (dead) queue or race the restarted source."""
+    pf = DevicePrefetchIter(_SlowIter(50, 0.001), depth=2)
+    next(pf)
+    old_threads = []
+    for _ in range(4):
+        old_threads.append(pf._thread)
+        pf.reset()
+        assert not old_threads[-1].is_alive()
+    for t in old_threads:
+        assert not t.is_alive()
+    assert len(list(pf)) == 50
+
+
+def test_prefetching_iter_reset_joins_old_worker_lock_clean():
+    """PrefetchingIter.reset() under the runtime lock detector:
+    repeated mid-production resets leave no orphan producer (the old
+    thread is joined before a new one starts) and no lock-order
+    inversions."""
+    from mxnet_tpu._debug import locktrace
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+
+    prev = locktrace.enable()
+    locktrace.reset()
+    try:
+        data = np.arange(64, dtype="f").reshape(16, 4)
+        it = PrefetchingIter(NDArrayIter(data, batch_size=4))
+        for _ in range(5):
+            it.next()  # mid-epoch: the producer is live
+            old = it._thread
+            it.reset()
+            # the join happened INSIDE reset — the old producer is done
+            assert not old.is_alive()
+            assert it._thread is not old
+        # post-reset epochs deliver the full pass
+        n = 0
+        try:
+            while True:
+                it.next()
+                n += 1
+        except StopIteration:
+            pass
+        assert n == 4
+        r = locktrace.report()
+        assert r["inversions"] == [], r["inversions"]
+    finally:
+        locktrace.reset()
+        if not prev:
+            locktrace.disable()
+
+
 def test_reset_cancels_infinite_producer():
     """reset() must not require the producer to finish (review r4)."""
     def forever():

@@ -1,0 +1,765 @@
+"""The fault-tolerant sharded data plane (ISSUE 11; docs/DATA.md).
+
+Four layers, matching the module split:
+
+* pure assignment math — partition/coverage/purity of
+  ``epoch_order``/``assign_shards``/``reassign_shards``/``batch_slices``
+  at world sizes 1/2/4, plus the mid-epoch reassignment of a dead
+  rank's unconsumed shards;
+* the committed sample cursor — commit/seek round-trips through the
+  PR 7 crash-consistency contract (temp+rename, injected mid-save
+  crash leaves the previous cursor restorable);
+* the hardened io plane — ``RecordIORangeReader`` (retry, crc,
+  corrupt-record budget) and ``DecodePool`` (order preservation at any
+  worker count, bounded per-worker restarts, graceful degradation,
+  poison items, the raise-once surface);
+* chaos acceptance — a training run with a decode worker killed
+  abruptly, 15% injected read faults, and a rank death mid-epoch
+  produces final params BITWISE-equal to the fault-free run resumed
+  from the same checkpoint, with full ``metrics()['io']``/``['faults']``
+  accounting.
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401 — package init wires the io provider
+import mxnet_tpu._debug.faultpoint as fp
+from mxnet_tpu import profiler
+from mxnet_tpu._retry import RetryPolicy
+from mxnet_tpu.io import (ShardService, DecodePool, RecordIORangeReader,
+                          CorruptRecordError, build_crc_sidecar,
+                          epoch_order, assign_shards, reassign_shards,
+                          unconsumed_shards, batch_slices)
+from mxnet_tpu.io import _stats as io_stats
+from mxnet_tpu.io.shard_service import num_shards, shard_positions
+from mxnet_tpu.parallel.elastic import CheckpointManager, \
+    elastic_train_loop
+from mxnet_tpu.recordio import MXIndexedRecordIO
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fp.reset()
+    io_stats.reset()
+    yield
+    fp.reset()
+    io_stats.reset()
+
+
+# -- pure assignment math -----------------------------------------------------
+
+class TestAssignmentMath:
+    def test_epoch_order_is_pure_permutation(self):
+        a = epoch_order(257, 4, seed=9)
+        b = epoch_order(257, 4, seed=9)
+        np.testing.assert_array_equal(a, b)
+        assert sorted(a) == list(range(257))
+        # epoch and seed both move the sequence
+        assert not np.array_equal(a, epoch_order(257, 5, seed=9))
+        assert not np.array_equal(a, epoch_order(257, 4, seed=10))
+
+    @pytest.mark.parametrize("world", [(0,), (0, 1), (0, 1, 2, 3),
+                                       (3, 7, 11)])
+    def test_assign_shards_partitions_exactly(self, world):
+        ns = 13
+        owned = [assign_shards(2, world, r, ns) for r in world]
+        flat = sorted(s for o in owned for s in o)
+        assert flat == list(range(ns))  # disjoint AND complete
+        # pure: identical on recomputation (the survivors-agree
+        # property is exactly this)
+        assert owned == [assign_shards(2, world, r, ns) for r in world]
+
+    def test_assign_shards_rotates_by_epoch(self):
+        w = (0, 1, 2)
+        e0 = assign_shards(0, w, 0, 9)
+        e1 = assign_shards(1, w, 0, 9)
+        assert e0 != e1  # pairing rebalances across epochs
+        for e in (0, 1, 2):
+            flat = sorted(s for r in w
+                          for s in assign_shards(e, w, r, 9))
+            assert flat == list(range(9))
+
+    def test_assign_shards_rejects_foreign_rank(self):
+        with pytest.raises(ValueError, match="not in world"):
+            assign_shards(0, (0, 1), 2, 4)
+
+    def test_reassign_covers_exactly_the_given_set(self):
+        un = unconsumed_shards(130, 1000, 64)  # shards 2..15
+        assert un == tuple(range(2, 16))
+        survivors = (0, 2)
+        re = [reassign_shards(3, survivors, r, un) for r in survivors]
+        assert sorted(s for o in re for s in o) == sorted(un)
+        assert re == [reassign_shards(3, survivors, r, un)
+                      for r in survivors]
+
+    def test_unconsumed_boundaries(self):
+        assert unconsumed_shards(0, 100, 10) == tuple(range(10))
+        assert unconsumed_shards(100, 100, 10) == ()
+        # offset mid-shard: that shard is still (partially) unconsumed
+        assert unconsumed_shards(15, 100, 10)[0] == 1
+
+    def test_batch_slices_contiguous_sorted_ragged(self):
+        sl = batch_slices(40, 10, (2, 0, 1))
+        assert [list(sl[r]) for r in (0, 1, 2)] == \
+            [[40, 41, 42, 43], [44, 45, 46], [47, 48, 49]]
+        # total coverage, no overlap, in sorted-rank order
+        flat = [p for r in sorted(sl) for p in sl[r]]
+        assert flat == list(range(40, 50))
+
+    @pytest.mark.parametrize("world", [(0,), (0, 1), (0, 1, 2, 3)])
+    def test_global_sequence_identical_across_world_sizes(self, world):
+        """THE determinism contract: the union of all ranks' streams,
+        ordered by global position, is the same sample sequence at
+        every world size."""
+        n, seed = 50, 1
+        out = {}
+        for r in world:
+            svc = ShardService(n, shard_size=8, seed=seed, world=world,
+                               rank=r)
+            for pos, sid in svc.iter_samples(0):
+                assert pos not in out, "duplicate position"
+                out[pos] = sid
+        seq = [out[p] for p in sorted(out)]
+        assert sorted(out) == list(range(n))
+        assert seq == list(epoch_order(n, 0, seed))
+
+    def test_mid_epoch_resize_covers_unconsumed_exactly(self):
+        """After a rank death the survivors' reassigned streams cover
+        exactly the positions at or past the committed cursor — no
+        loss, no duplication — computed from committed state alone."""
+        n, sz, seed = 96, 8, 2
+        world, survivors, offset = (0, 1, 2), (0, 2), 40
+        cover = {}
+        for r in survivors:
+            svc = ShardService(n, shard_size=sz, seed=seed,
+                               world=world, rank=r)
+            svc.offset = offset       # the committed cursor
+            svc.resize(survivors)
+            for pos, sid in svc.iter_samples():
+                assert pos not in cover
+                cover[pos] = sid
+        assert sorted(cover) == list(range(offset, n))
+        order = epoch_order(n, 0, seed)
+        assert [cover[p] for p in sorted(cover)] == \
+            [int(order[p]) for p in range(offset, n)]
+
+    def test_shard_positions_ragged_tail(self):
+        assert list(shard_positions(2, 20, 8)) == [16, 17, 18, 19]
+        assert num_shards(20, 8) == 3
+
+
+# -- the committed sample cursor ---------------------------------------------
+
+class TestSampleCursor:
+    def test_commit_seek_roundtrip(self, tmp_path):
+        svc = ShardService(100, shard_size=10, seed=3,
+                           cursor_dir=str(tmp_path / "cur"))
+        svc.begin_epoch(2)
+        svc.advance(37)
+        svc.commit(step=5)
+        svc.advance(20)
+        svc.commit(step=6)
+        # a fresh incarnation (the restarted process) seeks back
+        svc2 = ShardService(100, shard_size=10, seed=3,
+                            cursor_dir=str(tmp_path / "cur"))
+        cur = svc2.seek(5)
+        assert (cur["epoch"], cur["offset"]) == (2, 37)
+        assert (svc2.epoch, svc2.offset) == (2, 37)
+        # seek(None) -> newest; seek past the last commit -> newest <=
+        assert svc2.seek(None)["offset"] == 57
+        assert svc2.seek(99)["offset"] == 57
+        m = profiler.metrics()["io"]
+        assert m.get("cursor_commits", 0) >= 2
+        assert m.get("cursor_restores", 0) >= 3
+
+    def test_seek_without_commits_is_fresh_epoch0(self, tmp_path):
+        svc = ShardService(10, shard_size=5,
+                           cursor_dir=str(tmp_path / "cur"))
+        cur = svc.seek(7)
+        assert (cur["epoch"], cur["offset"]) == (0, 0)
+
+    def test_cursor_commit_is_crash_consistent(self, tmp_path):
+        """An injected crash between the cursor's temp write and its
+        rename (the PR 5 `checkpoint.save` seam — the cursor rides the
+        SAME contract) leaves the previous committed cursor
+        restorable."""
+        svc = ShardService(100, shard_size=10,
+                           cursor_dir=str(tmp_path / "cur"))
+        svc.advance(30)
+        svc.commit(step=3)
+        svc.advance(10)
+        fp.configure({"checkpoint.save": "raise:OSError@n=1"})
+        with pytest.raises(OSError):
+            svc.commit(step=4)
+        fp.reset()
+        svc2 = ShardService(100, shard_size=10,
+                            cursor_dir=str(tmp_path / "cur"))
+        assert svc2.seek(None)["offset"] == 30  # step-3 cursor intact
+
+    def test_advance_rolls_epochs(self):
+        svc = ShardService(20, shard_size=5)
+        svc.advance(20 + 7)
+        assert (svc.epoch, svc.offset) == (1, 7)
+        # the new epoch re-derives the full-epoch pure assignment
+        assert svc.my_shards == assign_shards(1, svc.world, 0,
+                                              svc.n_shards, svc.seed)
+
+
+# -- the decode pool ----------------------------------------------------------
+
+class TestDecodePool:
+    @pytest.mark.parametrize("workers", [1, 2, 4])
+    def test_order_preserved_at_any_worker_count(self, workers):
+        pool = DecodePool(list(range(40)), lambda x: x * 3,
+                          workers=workers)
+        assert list(pool) == [x * 3 for x in range(40)]
+
+    def test_transient_chaos_recovers_with_accounting(self):
+        fp.configure({"io.worker.decode": "raise:ValueError@p=0.3"},
+                     seed=5)
+        pool = DecodePool(list(range(40)), lambda x: x * 2, workers=2)
+        got = list(pool)
+        deaths = fp.triggers("io.worker.decode")
+        fp.reset()
+        assert got == [x * 2 for x in range(40)]  # nothing lost/reordered
+        assert deaths > 0
+        m = profiler.metrics()["io"]
+        assert sum(v for k, v in m.items()
+                   if k.startswith("worker_deaths.")) == deaths
+        assert sum(v for k, v in m.items()
+                   if k.startswith("worker_restarts.")) == deaths
+
+    def test_abrupt_systemexit_death_is_recovered(self):
+        """The thread-world SIGKILL: a worker dying via SystemExit
+        (BaseException, no cleanup by the decode_fn) still requeues the
+        claimed item and restarts — no sample lost, order intact."""
+        killed = []
+
+        def decode(x):
+            if x == 5 and not killed:
+                killed.append(x)
+                raise SystemExit("worker killed")
+            return x + 100
+
+        pool = DecodePool(list(range(12)), decode, workers=2)
+        assert list(pool) == [x + 100 for x in range(12)]
+        m = profiler.metrics()["io"]
+        assert sum(v for k, v in m.items()
+                   if k.startswith("worker_deaths.")) == 1
+        assert sum(v for k, v in m.items()
+                   if k.startswith("worker_restarts.")) == 1
+
+    def test_budget_exhaustion_degrades_then_serves(self):
+        """One injected death with a zero-restart budget retires that
+        worker; the pool degrades to fewer workers and still delivers
+        everything in order."""
+        fp.configure({"io.worker.decode": "raise:ValueError@n=1"})
+        pool = DecodePool(list(range(20)), lambda x: x, workers=2,
+                          restarts_per_worker=0)
+        got = list(pool)
+        fp.reset()
+        assert got == list(range(20))
+        assert len(pool.live_workers) == 1
+        m = profiler.metrics()["io"]
+        assert m.get("workers_retired") == 1
+        assert m.get("pool_workers") == 1  # the degraded gauge
+
+    def test_all_workers_dead_raises_once_then_exhausts_then_resets(self):
+        calls = {"broken": True}
+
+        def decode(x):
+            if calls["broken"]:
+                raise IOError("decoder broken")
+            return x * 7
+
+        src = list(range(6))
+        pool = DecodePool(src, decode, workers=2,
+                          restarts_per_worker=1, item_retries=1000)
+        with pytest.raises(RuntimeError, match="all 2 workers retired"):
+            list(pool)
+        # raise-once surface: afterwards it reads exhausted
+        with pytest.raises(StopIteration):
+            next(pool)
+        assert list(pool) == []
+        # reset() rebuilds with fresh budgets; a healed decoder serves
+        calls["broken"] = False
+        pool.reset()
+        assert list(pool) == [x * 7 for x in src]
+
+    def test_poison_item_surfaces_once_at_its_ordered_position(self):
+        def decode(x):
+            if x == 7:
+                raise ValueError("poison payload 7")
+            return x
+
+        pool = DecodePool(list(range(12)), decode, workers=2,
+                          item_retries=2)
+        got = []
+        with pytest.raises(ValueError, match="poison payload 7"):
+            for v in pool:
+                got.append(v)
+        assert got == list(range(7))  # everything before, in order
+        with pytest.raises(StopIteration):
+            next(pool)
+        # the workers survived — the item was poison, not the pool
+        assert pool.live_workers == [0, 1]
+
+    def test_source_error_surfaces_once_in_order(self):
+        def src():
+            yield from range(5)
+            raise OSError("source broke")
+
+        pool = DecodePool(src(), lambda x: x, workers=2)
+        got = []
+        with pytest.raises(OSError, match="source broke"):
+            for v in pool:
+                got.append(v)
+        assert got == list(range(5))
+
+    def test_per_worker_lanes_and_flightrec_context(self):
+        from mxnet_tpu._debug import flightrec
+        pool = DecodePool(list(range(4)), lambda x: x, workers=2,
+                          name="lanes-test")
+        list(pool)
+        assert profiler.LANES["io.w0"] >= 16
+        assert profiler.LANES["io.w1"] >= 16
+        assert profiler.LANES["io.w0"] != profiler.LANES["io.w1"]
+        with flightrec._context_lock:
+            ctx = flightrec._context.get("io_workers:lanes-test")
+        assert ctx is not None and set(ctx) == {"0", "1"}
+        assert ctx["0"]["state"] in ("idle", "decoding", "retired")
+
+
+# -- the range reader ---------------------------------------------------------
+
+def _write_rec(tmp_path, payloads, name="a"):
+    rec = str(tmp_path / ("%s.rec" % name))
+    idx = str(tmp_path / ("%s.idx" % name))
+    w = MXIndexedRecordIO(idx, rec, "w")
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+    return rec, idx
+
+
+class TestRangeReader:
+    def test_parity_with_indexed_reader_and_scan(self, tmp_path):
+        payloads = [bytes([i]) * (5 + 3 * i) for i in range(15)]
+        rec, idx = _write_rec(tmp_path, payloads)
+        by_idx = RecordIORangeReader(rec, index=idx)
+        by_scan = RecordIORangeReader(rec)  # header-hop scan
+        assert len(by_idx) == len(by_scan) == 15
+        for i in range(15):
+            assert by_idx.read_record(i) == payloads[i]
+            assert by_scan.read_record(i) == payloads[i]
+        assert by_idx._offsets == by_scan._offsets
+
+    def test_transient_read_fault_is_retried_and_counted(self, tmp_path):
+        rec, idx = _write_rec(tmp_path, [b"hello world"])
+        fp.configure({"io.shard.read": "raise:ConnectionError@n=3"})
+        r = RecordIORangeReader(rec, index=idx,
+                                retry_policy=RetryPolicy(base=0.001))
+        assert r.read_record(0) == b"hello world"
+        fp.reset()
+        assert profiler.metrics()["io"]["read_retries"] == 3
+
+    def test_crc_catches_payload_bitflip(self, tmp_path):
+        payloads = [b"A" * 16, b"B" * 16, b"C" * 16]
+        rec, idx = _write_rec(tmp_path, payloads)
+        build_crc_sidecar(rec)
+        data = bytearray(open(rec, "rb").read())
+        # flip one payload byte of record 1 (header 8B + 16B + pad...):
+        # structure (magic/length) stays valid — only the crc can tell
+        off1 = RecordIORangeReader(rec, index=idx)._offsets[1]
+        data[off1 + 8 + 3] ^= 0x01
+        with open(rec, "wb") as f:
+            f.write(bytes(data))
+        r = RecordIORangeReader(rec, index=idx)  # .crc auto-loaded
+        assert r.read_record(0) == payloads[0]
+        with pytest.raises(CorruptRecordError, match="crc mismatch"):
+            r.read_record(1)
+        # skip-and-count form drops the sample and keeps serving
+        assert r.read(1) is None
+        assert r.read(2) == payloads[2]
+        assert profiler.metrics()["io"]["corrupt_records"] == 1
+
+    def test_bad_magic_is_corrupt_not_retried(self, tmp_path):
+        payloads = [b"x" * 8, b"y" * 8]
+        rec, idx = _write_rec(tmp_path, payloads)
+        data = bytearray(open(rec, "rb").read())
+        data[0] ^= 0xFF  # clobber record 0's magic
+        with open(rec, "wb") as f:
+            f.write(bytes(data))
+        r = RecordIORangeReader(rec, index=idx)
+        t0 = __import__("time").perf_counter()
+        with pytest.raises(CorruptRecordError, match="bad magic"):
+            r.read_record(0)
+        # CorruptRecordError must NOT enter the transient-retry set:
+        # no backoff sleeps happened
+        assert __import__("time").perf_counter() - t0 < 1.0
+        assert io_stats.get("read_retries") == 0
+
+    def test_corrupt_budget_trips_to_hard_error(self, tmp_path):
+        rec, idx = _write_rec(tmp_path, [b"ok%d" % i for i in range(6)])
+        fp.configure({"io.record.corrupt": "raise:ValueError"})
+        r = RecordIORangeReader(rec, index=idx, corrupt_budget=2)
+        assert r.read(0) is None and r.read(1) is None
+        with pytest.raises(CorruptRecordError,
+                           match="budget exhausted"):
+            r.read(2)
+        assert fp.metrics().get("io.record.corrupt") == 3
+        fp.reset()
+        assert r.corrupt_skipped == 3
+
+
+# -- service faultpoints ------------------------------------------------------
+
+class TestServiceFaultpoints:
+    def test_service_fetch_seam_counts_and_propagates(self):
+        svc = ShardService(10, shard_size=5)
+        fp.configure({"io.service.fetch": "raise:ConnectionError@n=1"})
+        with pytest.raises(ConnectionError):
+            svc.fetch_batch([1, 2, 3])
+        # the schedule is exhausted: the retried RPC succeeds
+        assert svc.fetch_batch([1, 2, 3]) == [1, 2, 3]
+        assert fp.metrics().get("io.service.fetch") == 1
+        fp.reset()
+
+
+# -- end-to-end determinism + chaos ------------------------------------------
+
+def _order_sensitive_step(w, batch_vals):
+    """An UPDATE whose result depends on the order of the batch — so
+    bitwise equality below really pins the global sample order, not
+    just the sample multiset."""
+    acc = np.float32(0.0)
+    for v in batch_vals:
+        acc = np.float32(acc * np.float32(1.0009765625)
+                         + np.float32(v))
+    return np.float32(w * np.float32(0.75) + np.float32(0.01) * acc)
+
+
+def _assemble_global_batch(svcs, live_world, offset, B):
+    """Trainer-side batch assembly: each live rank contributes ITS
+    slice of the global batch (batch_slices), concatenated by global
+    position — reproducing the world-independent global order."""
+    sl = batch_slices(offset, B, live_world)
+    parts = []
+    for r in live_world:
+        order = svcs[r].global_sequence()
+        parts.extend((p, int(order[p])) for p in sl[r])
+    parts.sort()
+    return [sid for _, sid in parts]
+
+
+class TestEpochDeterminismTraining:
+    N, B, SEED = 64, 8, 5  # 8 steps per epoch
+
+    def _run(self, tmp_path, tag, world, kill_rank_at=None,
+             resume_ckpt_from=None):
+        """One training run over a single epoch. ``kill_rank_at=k``
+        declares the highest rank dead after step k completed:
+        survivors reshard, rewind params AND cursor to the newest
+        checkpoint, and finish the epoch alone. Returns (final w,
+        [global batches consumed], ckpt dir)."""
+        steps = self.N // self.B
+        ckdir = str(tmp_path / ("ck_%s" % tag)) \
+            if resume_ckpt_from is None else resume_ckpt_from
+        ck = CheckpointManager(ckdir, keep=10, use_orbax=False)
+        live = sorted(world)
+        svcs = {r: ShardService(
+            self.N, shard_size=self.B, seed=self.SEED, world=world,
+            rank=r, cursor_dir=str(tmp_path / ("cur_%s_%d" % (tag, r))))
+            for r in world}
+        w = np.float32(1.0)
+        restored, s0 = ck.restore()
+        k = 0
+        if restored is not None:
+            w = np.float32(restored["w"])
+            for r in live:
+                svcs[r].seek(s0)
+            k = s0 + 1
+        batches = []
+        while k < steps:
+            offset = k * self.B
+            ids = _assemble_global_batch(svcs, live, offset, self.B)
+            batches.append(ids)
+            w = _order_sensitive_step(w, ids)
+            for r in live:
+                svcs[r].advance(self.B)
+            if k % 2 == 1:  # checkpoint cadence
+                ck.save(k, {"w": w})
+                for r in live:
+                    svcs[r].commit(k)
+            if kill_rank_at is not None and k == kill_rank_at:
+                dead = live[-1]
+                live = [r for r in live if r != dead]
+                # survivors: pure reshard + rewind to the committed pair
+                restored, s0 = ck.restore()
+                w = np.float32(restored["w"])
+                for r in live:
+                    svcs[r].resize(live)
+                    svcs[r].seek(s0)
+                k = s0 + 1
+                kill_rank_at = None
+                # drop the rolled-back batches from the consumed log
+                batches = batches[:k]
+                continue
+            k += 1
+        return w, batches, ckdir
+
+    def test_global_batches_identical_across_world_sizes(self, tmp_path):
+        runs = [self._run(tmp_path, "w%d" % len(ws), ws)
+                for ws in [(0,), (0, 1), (0, 1, 2, 3)]]
+        (w1, b1, _), (w2, b2, _), (w4, b4, _) = runs
+        assert b1 == b2 == b4  # the same (seed, epoch) sample sequence
+        # and therefore bitwise-identical training
+        assert w1.tobytes() == w2.tobytes() == w4.tobytes()
+
+    def test_mid_epoch_rank_death_is_bitwise_equal_to_clean_run(
+            self, tmp_path):
+        """THE chaos determinism contract: rank 1 dies after step 4;
+        rank 0 reshards, rewinds to the step-3 checkpoint+cursor, and
+        finishes the epoch alone — final params bitwise-equal to the
+        uninterrupted world-(0,1) run AND to a clean run resumed from
+        the same checkpoint."""
+        w_clean, b_clean, _ = self._run(tmp_path, "clean", (0, 1))
+        w_chaos, b_chaos, _ = self._run(tmp_path, "chaos", (0, 1),
+                                        kill_rank_at=4)
+        assert b_chaos == b_clean
+        assert w_chaos.tobytes() == w_clean.tobytes()
+        assert profiler.metrics()["io"]["service_resizes"] >= 1
+
+    def test_chaos_resume_equals_clean_resume_from_same_ckpt(
+            self, tmp_path):
+        """Kill the whole job at step 5 (both variants share the same
+        checkpoint dir), then resume once cleanly and once with a rank
+        death mid-resume: bitwise-equal finals."""
+        steps = self.N // self.B
+
+        def partial(tag):
+            ckdir = str(tmp_path / ("ck_%s" % tag))
+            ck = CheckpointManager(ckdir, keep=10, use_orbax=False)
+            svcs = {r: ShardService(
+                self.N, shard_size=self.B, seed=self.SEED,
+                world=(0, 1), rank=r,
+                cursor_dir=str(tmp_path / ("cur_%s_%d" % (tag, r))))
+                for r in (0, 1)}
+            w = np.float32(1.0)
+            for k in range(6):  # die after step 5 (ckpt at 5)
+                ids = _assemble_global_batch(svcs, [0, 1], k * self.B,
+                                             self.B)
+                w = _order_sensitive_step(w, ids)
+                for r in (0, 1):
+                    svcs[r].advance(self.B)
+                if k % 2 == 1:
+                    ck.save(k, {"w": w})
+                    for r in (0, 1):
+                        svcs[r].commit(k)
+            return ckdir
+
+        ck_a, ck_b = partial("ra"), partial("rb")
+        w_clean, _, _ = self._run(tmp_path, "ra", (0, 1),
+                                  resume_ckpt_from=ck_a)
+        w_chaos, _, _ = self._run(tmp_path, "rb", (0, 1),
+                                  kill_rank_at=6,
+                                  resume_ckpt_from=ck_b)
+        assert w_chaos.tobytes() == w_clean.tobytes()
+
+
+class TestFullPlaneChaos:
+    """The acceptance scenario: records on disk, range reads with 15%
+    injected faults, a decode worker killed abruptly, AND a rank death
+    mid-epoch — the survivors' resumed run is bitwise-equal to the
+    fault-free run, with full accounting."""
+
+    N, B, SEED = 48, 8, 7
+
+    def _make_rec(self, tmp_path):
+        payloads = [struct.pack("<I", i * 11 + 3)
+                    for i in range(self.N)]
+        rec, idx = _write_rec(tmp_path, payloads, name="plane")
+        build_crc_sidecar(rec)
+        return rec, idx
+
+    def _run(self, tmp_path, rec, idx, tag, chaos):
+        steps = self.N // self.B
+
+        # the decode-worker SIGKILL leg of the chaos runs through the
+        # DecodePool in the companion stream check (below); this
+        # trainer-side run injects the READ faults + the rank death
+        live = [0, 1]
+
+        def decode(payload):
+            return struct.unpack("<I", payload)[0]
+
+        readers = {r: RecordIORangeReader(
+            rec, index=idx, retry_policy=RetryPolicy(base=0.001))
+            for r in live}
+        svcs = {r: ShardService(
+            self.N, shard_size=self.B, seed=self.SEED, world=(0, 1),
+            rank=r, reader=readers[r], decode_fn=decode,
+            cursor_dir=str(tmp_path / ("cur_%s_%d" % (tag, r))))
+            for r in live}
+        ck = CheckpointManager(str(tmp_path / ("ck_%s" % tag)),
+                               keep=10, use_orbax=False)
+        if chaos:
+            fp.configure({"io.shard.read": "raise:OSError@p=0.15"},
+                         seed=13)
+        try:
+            w = np.float32(2.0)
+            k = 0
+            kill_at = 3 if chaos else None
+            while k < steps:
+                offset = k * self.B
+                sl = batch_slices(offset, self.B, live)
+                # each live rank FETCHES its slice through the full
+                # hardened plane (range reader + decode pool),
+                # concatenated by global position
+                parts = []
+                for r in live:
+                    order = svcs[r].global_sequence()
+                    ids = [int(order[p]) for p in sl[r]]
+                    vals = svcs[r].fetch_batch(ids)
+                    parts.extend(zip(sl[r], vals))
+                parts.sort()
+                w = _order_sensitive_step(w, [v for _, v in parts])
+                for r in live:
+                    svcs[r].advance(self.B)
+                if k % 2 == 1:
+                    ck.save(k, {"w": w})
+                    for r in live:
+                        svcs[r].commit(k)
+                if kill_at is not None and k == kill_at:
+                    live = [0]
+                    restored, s0 = ck.restore()
+                    w = np.float32(restored["w"])
+                    svcs[0].resize(live)
+                    svcs[0].seek(s0)
+                    k = s0 + 1
+                    kill_at = None
+                    continue
+                k += 1
+            return w
+        finally:
+            fp.reset()
+
+    def test_chaos_run_bitwise_equals_fault_free(self, tmp_path):
+        rec, idx = self._make_rec(tmp_path)
+        # decode-pool leg of the chaos: stream one rank's epoch through
+        # DecodePool under the fault schedule PLUS one abrupt
+        # SystemExit (the thread-world decode-worker SIGKILL)
+        killed = []
+
+        def decode(payload):
+            val = struct.unpack("<I", payload)[0]
+            if not killed and val == 5 * 11 + 3:
+                killed.append(val)
+                raise SystemExit("decode worker SIGKILLed")
+            return val
+
+        svc = ShardService(self.N, shard_size=self.B, seed=self.SEED,
+                           reader=RecordIORangeReader(
+                               rec, index=idx,
+                               retry_policy=RetryPolicy(base=0.001)),
+                           decode_fn=decode)
+        fp.configure({"io.worker.decode": "raise:ValueError@p=0.15",
+                      "io.shard.read": "raise:OSError@p=0.15"},
+                     seed=13)
+        pooled = [v for _, vals in svc.iter_batches(self.B, workers=2)
+                  for v in vals]
+        fp.reset()
+        order = epoch_order(self.N, 0, self.SEED)
+        assert pooled == [int(order[p]) * 11 + 3
+                          for p in range(self.N)]
+
+        w_clean = self._run(tmp_path, rec, idx, "clean", chaos=False)
+        w_chaos = self._run(tmp_path, rec, idx, "chaos", chaos=True)
+        assert w_chaos.tobytes() == w_clean.tobytes()
+        m = profiler.metrics()
+        # full accounting: faults were really injected and the io
+        # section carries the whole story
+        assert m["io"].get("read_retries", 0) > 0
+        assert m["io"].get("service_resizes", 0) >= 1
+        assert m["io"].get("cursor_restores", 0) >= 1
+        assert sum(v for k_, v in m["io"].items()
+                   if k_.startswith("worker_deaths.")) >= 1
+
+
+# -- elastic_train_loop composition ------------------------------------------
+
+class TestElasticLoopComposition:
+    def test_data_service_commits_and_seeks_with_the_loop(
+            self, tmp_path):
+        """The weld: the loop commits the cursor beside every
+        checkpoint, and an injected step failure restores BOTH params
+        and cursor to the same step — the resumed run is bitwise-equal
+        to a fault-free one."""
+        n, B = 48, 8
+
+        def build(tag):
+            svc = ShardService(n, shard_size=B, seed=3,
+                               cursor_dir=str(tmp_path / ("c" + tag)))
+            ck = CheckpointManager(str(tmp_path / ("k" + tag)),
+                                   use_orbax=False)
+            return svc, ck
+
+        def make_step(svc, fail_at=None):
+            state = {"calls": 0}
+
+            def step(s, k):
+                if fail_at is not None and k == fail_at \
+                        and state["calls"] == 0:
+                    state["calls"] = 1
+                    raise ConnectionError("transient collective")
+                order = svc.global_sequence()
+                ids = [int(order[p])
+                       for p in range(svc.offset, svc.offset + B)]
+                w = _order_sensitive_step(np.float32(s["w"]), ids)
+                svc.advance(B)
+                return {"w": w}, None
+
+            return step
+
+        svc_a, ck_a = build("a")
+        state_a, _, done_a = elastic_train_loop(
+            make_step(svc_a), {"w": np.float32(1.0)}, list(range(6)),
+            ck_a, save_every=2, data_service=svc_a)
+        svc_b, ck_b = build("b")
+        state_b, _, done_b = elastic_train_loop(
+            make_step(svc_b, fail_at=5), {"w": np.float32(1.0)},
+            list(range(6)), ck_b, save_every=2, data_service=svc_b)
+        assert done_a and done_b
+        assert np.float32(state_b["w"]).tobytes() == \
+            np.float32(state_a["w"]).tobytes()
+        # the cursor really committed through the loop's saves
+        assert profiler.metrics()["io"]["cursor_commits"] >= 2
+        assert profiler.metrics()["io"]["cursor_restores"] >= 1
+        # ATOMIC pairing (review fix): params and cursor ride ONE
+        # checkpoint payload — no crash instant can tear the pair the
+        # way two separate stores' back-to-back renames could
+        newest = ck_a.latest_step()
+        payload, _ = ck_a.restore(newest)
+        assert set(payload) == {"__elastic_state__", "__data_cursor__"}
+        assert int(payload["__data_cursor__"]["offset"]) == \
+            (newest + 1) * B  # the cursor AT that step, not an older one
+
+
+# -- provider wiring ----------------------------------------------------------
+
+class TestIoProvider:
+    def test_metrics_io_section_exists_and_resets(self):
+        io_stats.bump("probe_counter", 3)
+        io_stats.set_gauge("probe_gauge", 9)
+        m = profiler.metrics()
+        assert m["io"]["probe_counter"] == 3
+        assert m["io"]["probe_gauge"] == 9
+        m = profiler.metrics(reset=True)
+        assert profiler.metrics()["io"].get("probe_counter", 0) == 0
+
+    def test_counters_mirror_into_account_ledger(self):
+        io_stats.bump("probe_counter", 2)
+        assert profiler.metrics()["counters"]["io.probe_counter"] >= 2

@@ -32,6 +32,24 @@ def _trace(fn=None):
         return json.load(f)
 
 
+def _wait_flow_pairing(timeout=5.0):
+    """Fence before set_state('stop'): the server records its
+    ``ph:"f"`` half AFTER sending the response (the span must cover the
+    handling), so the final request's client side can return before the
+    server's bookkeeping lands — stopping the profiler inside that
+    window drops the closing flow event and the s/f pairing asserts
+    flake. Wait (bounded) until every opened flow has closed."""
+    import time as _t
+    deadline = _t.monotonic() + timeout
+    while _t.monotonic() < deadline:
+        with profiler._lock:
+            n_s = sum(1 for e in profiler._events if e.get("ph") == "s")
+            n_f = sum(1 for e in profiler._events if e.get("ph") == "f")
+        if n_f >= n_s:
+            return
+        _t.sleep(0.01)
+
+
 # -- wire trace-context: in-process client/server round trip ----------------
 
 def test_wire_context_pairs_client_server_flows():
@@ -43,6 +61,7 @@ def test_wire_context_pairs_client_server_flows():
         for _ in range(3):
             cli.push("w", np.ones(4, np.float32))
             cli.pull("w")
+        _wait_flow_pairing()
     finally:
         profiler.set_state("stop")
         cli.stop_server()
@@ -76,6 +95,7 @@ def test_flow_ids_unique_across_clients_same_rank():
         for _ in range(3):
             a.push("w", np.ones(4, np.float32))
             b.pull("w")
+        _wait_flow_pairing()
     finally:
         profiler.set_state("stop")
         a.stop_server()

@@ -824,6 +824,111 @@ class MX012PallasKernelContract:
         return out
 
 
+# -- MX013 -------------------------------------------------------------------
+
+def _faultpoint_aliases(tree):
+    """Names the faultpoint module is bound to in this file
+    (``from .._debug import faultpoint as _faultpoint``,
+    ``import mxnet_tpu._debug.faultpoint as fp``, ...)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "faultpoint":
+                    out.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith(".faultpoint") \
+                        or a.name == "faultpoint":
+                    out.add(a.asname or a.name.split(".")[0])
+    return out
+
+
+class MX013FaultpointInCatalog:
+    """Every ``faultpoint.check("<literal>")`` in the tree must name a
+    point in the ``POINTS`` catalog (``mxnet_tpu/_debug/faultpoint.py``).
+    ``configure()`` validates spec names at runtime, but an instrumented
+    *seam* with a typo'd or never-cataloged name fails silently the
+    other way: the check is a permanent no-op, the chaos suite can
+    never arm it, and the docs/RESILIENCE.md catalog (whose sync the
+    faultpoint catalog test enforces) never hears about it. Variable
+    arguments are exempt (the kvstore per-op dispatch passes a
+    computed name)."""
+
+    code = "MX013"
+    summary = "faultpoint.check() literal not in the POINTS catalog"
+    kind = "python"
+
+    def scope(self, path):
+        # instrumented seams live in the framework tree (tests arm
+        # points through configure(), which validates at runtime)
+        return path.endswith(".py") and (
+            path.startswith("mxnet_tpu/") or path.startswith("tools/")
+            or path == "bench.py")
+
+    _catalog_cache = None  # (repo_root, frozenset) — one parse per run
+
+    def _catalog(self):
+        from . import core
+        cached = self._catalog_cache
+        if cached is not None and cached[0] == core.REPO_ROOT:
+            return cached[1]
+        points = self._parse_catalog()
+        self._catalog_cache = (core.REPO_ROOT, points)
+        return points
+
+    def _parse_catalog(self):
+        from . import core
+        src_path = os.path.join(core.REPO_ROOT, "mxnet_tpu", "_debug",
+                                "faultpoint.py")
+        try:
+            with open(src_path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None  # no catalog to check against (synthetic tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "POINTS"
+                    for t in node.targets):
+                call = node.value
+                if isinstance(call, ast.Call) and call.args and \
+                        isinstance(call.args[0], (ast.Tuple, ast.List,
+                                                  ast.Set)):
+                    return {e.value for e in call.args[0].elts
+                            if isinstance(e, ast.Constant)}
+        return None
+
+    def check(self, path, src, tree, parents):
+        aliases = _faultpoint_aliases(tree)
+        if not aliases:
+            return []
+        catalog = self._catalog()
+        if catalog is None:
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "check"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in aliases):
+                continue
+            if not node.args or not isinstance(node.args[0],
+                                               ast.Constant):
+                continue  # computed names validate at configure() time
+            name = node.args[0].value
+            if isinstance(name, str) and name not in catalog:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "faultpoint.check(%r) names a point missing from "
+                    "the POINTS catalog — the seam is a permanent "
+                    "no-op chaos can never arm; add it to "
+                    "mxnet_tpu/_debug/faultpoint.py POINTS (and its "
+                    "docstring/RESILIENCE.md rows)" % (name,)))
+        return out
+
+
 ALL_RULES = (
     MX001JnpBypassesInvoke(),
     MX002UnguardedProfilerHook(),
@@ -837,4 +942,5 @@ ALL_RULES = (
     MX010UnguardedLatencyTelemetry(),
     MX011FlightrecSecondBranch(),
     MX012PallasKernelContract(),
+    MX013FaultpointInCatalog(),
 )

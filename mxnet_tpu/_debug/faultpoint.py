@@ -90,6 +90,7 @@ import random
 import time
 
 from . import locktrace as _locktrace
+from ..base import getenv as _getenv
 
 __all__ = [
     "ACTIVE", "POINTS", "configure", "reset", "check", "is_active",
@@ -239,7 +240,7 @@ def configure(spec, seed=None):
     point names."""
     global ACTIVE
     if seed is None:
-        seed = int(os.environ.get("MXNET_FAULTPOINTS_SEED", "0"))
+        seed = int(_getenv("MXNET_FAULTPOINTS_SEED", "0"))
     rules = parse(spec, seed)
     with _lock:
         _rules.clear()
@@ -342,6 +343,6 @@ def report():
 # Env activation at import: the instrumented modules load after this one
 # (profiler pulls in the _debug package before any subsystem), so an env
 # schedule is live for the whole process without code changes.
-_env_spec = os.environ.get("MXNET_FAULTPOINTS", "").strip()
+_env_spec = _getenv("MXNET_FAULTPOINTS", "").strip()
 if _env_spec:
     configure(_env_spec)

@@ -70,6 +70,7 @@ import sys
 import threading
 import time
 import traceback
+from ..base import getenv as _getenv
 
 __all__ = [
     "ENABLED", "RING", "enable", "disable", "configure", "reset_ring",
@@ -80,7 +81,7 @@ __all__ = [
 
 
 def _env_on(name, default="1"):
-    return os.environ.get(name, default) not in ("0", "false", "off")
+    return _getenv(name, default) not in ("0", "false", "off")
 
 
 # Master switch, read inline (one attribute load) by profiler._LIVE and
@@ -88,8 +89,8 @@ def _env_on(name, default="1"):
 # _LIVE mirror in sync.
 ENABLED = _env_on("MXTPU_FLIGHTREC")
 
-_CAP = max(16, int(os.environ.get("MXTPU_FLIGHTREC_EVENTS", "4096") or 4096))
-_MAX_DUMPS = int(os.environ.get("MXTPU_FLIGHTREC_MAX_DUMPS", "32") or 32)
+_CAP = max(16, int(_getenv("MXTPU_FLIGHTREC_EVENTS", "4096") or 4096))
+_MAX_DUMPS = int(_getenv("MXTPU_FLIGHTREC_MAX_DUMPS", "32") or 32)
 
 # The ring. deque(maxlen=) is a C ring buffer: append is O(1) and
 # GIL-atomic, old entries fall off the far end — lock-light by
@@ -163,7 +164,7 @@ def reset_ring():
 
 
 def dump_dir():
-    return os.environ.get("MXTPU_FLIGHTREC_DIR", "") or os.getcwd()
+    return _getenv("MXTPU_FLIGHTREC_DIR", "") or os.getcwd()
 
 
 def set_context(key, value):
@@ -435,7 +436,7 @@ def install():
         if not faulthandler.is_enabled():
             fatal_path = os.path.join(
                 dump_dir(), "flightrec_r%d_fatal.txt"
-                % int(os.environ.get("MXTPU_PROC_ID", "0") or 0))
+                % int(_getenv("MXTPU_PROC_ID", "0") or 0))
             # append, never truncate: an elastic restart in the same
             # dump dir (same MXTPU_PROC_ID) must not erase the PREVIOUS
             # incarnation's native stacks — the one artifact a SIGSEGV

@@ -38,6 +38,7 @@ from __future__ import annotations
 import os
 import threading
 import traceback
+from ..base import getenv as _getenv
 
 __all__ = [
     "named_lock", "named_condition", "enable", "disable", "is_enabled",
@@ -47,7 +48,7 @@ __all__ = [
 # Module-level gate, read inline by the proxies and by the framework's
 # boundary hooks (`if _locktrace.ENABLED: ...`) so the disabled cost is
 # one attribute load + truth test.
-ENABLED = os.environ.get("MXNET_DEBUG_LOCKS", "0") in ("1", "true", "on")
+ENABLED = _getenv("MXNET_DEBUG_LOCKS", "0") in ("1", "true", "on")
 
 _tls = threading.local()  # .held: list of _NamedLock in acquisition order
 

@@ -52,6 +52,7 @@ import time
 
 from . import flightrec as _flightrec
 from . import locktrace as _locktrace
+from ..base import getenv as _getenv
 
 __all__ = [
     "ENABLED", "configure", "reset", "step_begin", "step_end",
@@ -61,12 +62,12 @@ __all__ = [
 
 def _envf(name, default):
     try:
-        return float(os.environ.get(name, "") or default)
+        return float(_getenv(name, "") or default)
     except ValueError:
         return default
 
 
-ENABLED = os.environ.get("MXTPU_WATCHDOG", "1") not in ("0", "false",
+ENABLED = _getenv("MXTPU_WATCHDOG", "1") not in ("0", "false",
                                                         "off")
 
 _lock = _locktrace.named_lock("watchdog.state")
@@ -144,7 +145,7 @@ def reset():
             _stats[k] = -1 if k == "last_stall_step" else 0
         _stats["median_s"] = _stats["threshold_s"] = 0.0
         _stats["last_stall_elapsed_s"] = 0.0
-    ENABLED = os.environ.get("MXTPU_WATCHDOG", "1") not in (
+    ENABLED = _getenv("MXTPU_WATCHDOG", "1") not in (
         "0", "false", "off")
 
 

@@ -18,6 +18,7 @@ import os
 import subprocess
 
 from ._debug import locktrace as _locktrace
+from .base import getenv as _getenv
 
 _LIB = None
 _LIB_LOCK = _locktrace.named_lock("native.lib")
@@ -116,7 +117,7 @@ def get_lib():
         if _LIB is not None or _TRIED:
             return _LIB
         _TRIED = True
-        if os.environ.get("MXNET_TPU_NO_NATIVE", "0") == "1":
+        if _getenv("MXNET_TPU_NO_NATIVE", "0") == "1":
             return None
         path = _lib_path()
         if not os.path.exists(path) and not _build():

@@ -28,6 +28,8 @@ import os
 import random
 import time
 
+from .base import getenv as _getenv
+
 __all__ = ["RetryPolicy", "call"]
 
 
@@ -39,14 +41,13 @@ class RetryPolicy:
 
     def __init__(self, max_retries=None, base=None, cap=None,
                  deadline=None):
-        env = os.environ.get
-        self.max_retries = int(env("MXTPU_PS_RETRY_MAX", "8")) \
+        self.max_retries = int(_getenv("MXTPU_PS_RETRY_MAX", "8")) \
             if max_retries is None else int(max_retries)
-        self.base = float(env("MXTPU_PS_RETRY_BASE", "0.05")) \
+        self.base = float(_getenv("MXTPU_PS_RETRY_BASE", "0.05")) \
             if base is None else float(base)
-        self.cap = float(env("MXTPU_PS_RETRY_CAP", "2.0")) \
+        self.cap = float(_getenv("MXTPU_PS_RETRY_CAP", "2.0")) \
             if cap is None else float(cap)
-        self.deadline = float(env("MXTPU_PS_RETRY_DEADLINE", "30")) \
+        self.deadline = float(_getenv("MXTPU_PS_RETRY_DEADLINE", "30")) \
             if deadline is None else float(deadline)
 
     def backoff(self, attempt):

@@ -15,7 +15,36 @@ import numpy as _np
 __all__ = [
     "MXNetError", "string_types", "numeric_types",
     "canonical_dtype", "DTYPE_NAMES", "atomic_write",
+    "getenv", "getenv_dynamic",
 ]
+
+
+def getenv(name, default=None):
+    """THE env-read choke point for the framework tree.
+
+    Semantics are exactly ``os.environ.get(name, default)`` — this
+    exists so the env-var surface is statically analyzable: mxlint
+    MX015 checks that every ``getenv`` call passes a literal name that
+    is documented in docs/ENV_VARS.md, and MX014 checks that names read
+    on traced paths are registered as compile-signature tokens
+    (``ndarray/register.register_signature_token``). Direct
+    ``os.environ`` reads anywhere else under ``mxnet_tpu/`` are MX015
+    findings.
+
+    Call sites that compute the variable name (the kvstore per-server
+    port family) must use :func:`getenv_dynamic` and name the
+    documented family instead."""
+    return _os.environ.get(name, default)
+
+
+def getenv_dynamic(name, default=None, family=None):
+    """Env read with a COMPUTED name (``family`` is the documented base
+    name). The only sanctioned form for derived variables like
+    ``MXTPU_ASYNC_PS_PORT_<s>``: mxlint MX015 cannot resolve a computed
+    name, so the call site declares the ENV_VARS.md row it derives from
+    and the checker validates the family literal instead."""
+    del family  # documentation-only: consumed by mxlint, not at runtime
+    return _os.environ.get(name, default)
 
 
 class MXNetError(RuntimeError):

@@ -21,6 +21,7 @@ import numpy as np
 from . import autograd
 from .ndarray import NDArray
 from .ndarray import register as _register
+from .base import getenv as _getenv
 
 __all__ = ["create", "from_bytes", "to_bytes", "shape_of", "dtype_of",
            "invoke", "mark_variables", "record_start", "record_stop",
@@ -687,7 +688,7 @@ def kv_role(which):
     (ref: MXKVStoreIsWorkerNode; every process is a worker here unless a
     reference-era launcher says otherwise)."""
     import os
-    role = os.environ.get("DMLC_ROLE", "worker")
+    role = _getenv("DMLC_ROLE", "worker")
     return 1 if role == which else 0
 
 

@@ -27,6 +27,7 @@ import os
 import threading
 
 from ._debug import locktrace as _locktrace
+from .base import getenv as _getenv
 
 __all__ = [
     "engine_type", "is_naive", "set_bulk_size", "bulk_size", "bulk",
@@ -40,7 +41,7 @@ def engine_type():
     """Selected engine kind. ``MXNET_ENGINE_TYPE=NaiveEngine`` (ref:
     src/engine/engine.cc:32-48) forces synchronous execution: every op blocks
     until its result is ready — the serial-debugging mode of the reference."""
-    return os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+    return _getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
 
 
 def is_naive():
@@ -56,7 +57,7 @@ def maybe_sync(data):
     return data
 
 
-_bulk_size = [int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))]  # mxlint: disable=MX003 (process-wide knob, GIL-atomic int store; per-thread segments snapshot it at scope entry)
+_bulk_size = [int(_getenv("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))]  # mxlint: disable=MX003 (process-wide knob, GIL-atomic int store; per-thread segments snapshot it at scope entry)
 
 
 def set_bulk_size(size):

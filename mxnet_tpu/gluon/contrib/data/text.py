@@ -17,6 +17,7 @@ import numpy as np
 from ....contrib import text
 from ...data import dataset
 from . import _constants as C
+from ....base import getenv as _getenv
 
 __all__ = ["WikiText2", "WikiText103"]
 
@@ -24,7 +25,7 @@ __all__ = ["WikiText2", "WikiText103"]
 def _synth_ok():
     # opt-in, matching the vision datasets: a mistyped root must raise,
     # not silently train on the fake corpus
-    return os.environ.get("MXTPU_SYNTHETIC_DATA", "0") == "1"
+    return _getenv("MXTPU_SYNTHETIC_DATA", "0") == "1"
 
 
 class _LanguageModelDataset(dataset.Dataset):

@@ -17,13 +17,14 @@ import numpy as np
 
 from ..dataset import Dataset
 from ....ndarray import array as nd_array
+from ....base import getenv as _getenv
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageRecordDataset", "ImageFolderDataset"]
 
 
 def _synth_ok():
-    return os.environ.get("MXTPU_SYNTHETIC_DATA", "0") == "1"
+    return _getenv("MXTPU_SYNTHETIC_DATA", "0") == "1"
 
 
 class _DownloadedDataset(Dataset):

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import profiler as _profiler
+from ..base import getenv as _getenv
 from .. import random as _random
 from ..ndarray import NDArray
 from ..ndarray import register as _register
@@ -67,7 +68,7 @@ from .block import make_pure_forward
 __all__ = ["FusedTrainStep", "train_step", "fused_step_enabled",
            "set_fused_step", "stats", "reset_stats"]
 
-_ENABLED = os.environ.get("MXNET_GLUON_FUSED_STEP", "1") \
+_ENABLED = _getenv("MXNET_GLUON_FUSED_STEP", "1") \
     not in ("0", "false", "off")
 # compile a signature only once it repeats (one-shot shapes stay on the
 # genuine eager path) — same contract as register._JIT_THRESHOLD
@@ -429,13 +430,12 @@ class FusedTrainStep:
         partial = (self._trainer._optimizer._fused_static_key(),
                    len(all_params), tuple(train_pos),
                    _register._amp_version,
-                   # the packed-apply toggle changes the traced update
-                   # graph — and the kernel-routing envs change the
-                   # traced FORWARD (batch_norm/quantized routing) — so
-                   # flipping any of them mid-run must recompile, not
-                   # silently replay the other form
-                   os.environ.get("MXTPU_FUSED_APPLY", "0"),
-                   _register._kernel_env_token(),
+                   # the signature-token registry: every env var that
+                   # changes a traced graph (the packed-apply toggle for
+                   # the update phase, the kernel-routing envs for the
+                   # forward) — flipping any of them mid-run must
+                   # recompile, not silently replay the other form
+                   _register.signature_tokens(),
                    jax.tree_util.tree_structure(state_datas))
         full = partial + (
             tuple(_register.aval(a._data) for a in nd_args),

@@ -42,6 +42,7 @@ from ..base import atomic_write
 from .._debug import faultpoint as _faultpoint
 from .._debug import locktrace as _locktrace
 from . import _stats
+from ..base import getenv as _getenv
 
 __all__ = ["RecordIORangeReader", "CorruptRecordError",
            "build_crc_sidecar"]
@@ -61,7 +62,7 @@ class CorruptRecordError(RuntimeError):
 
 
 def _corrupt_budget():
-    return int(os.environ.get("MXTPU_IO_CORRUPT_BUDGET", "8"))
+    return int(_getenv("MXTPU_IO_CORRUPT_BUDGET", "8"))
 
 
 class RecordIORangeReader:

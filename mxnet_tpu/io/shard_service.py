@@ -57,6 +57,7 @@ from .._debug import faultpoint as _faultpoint
 from .._debug import flightrec as _flightrec
 from . import _stats
 from .worker_pool import DecodePool
+from ..base import getenv as _getenv
 
 __all__ = ["epoch_order", "num_shards", "shard_positions",
            "assign_shards", "reassign_shards", "unconsumed_shards",
@@ -178,7 +179,7 @@ class ShardService:
                  keep=3):
         self.n_samples = int(n_samples)
         if shard_size is None:
-            shard_size = int(os.environ.get("MXTPU_IO_SHARD_SIZE",
+            shard_size = int(_getenv("MXTPU_IO_SHARD_SIZE",
                                             "64") or 64)
         self.shard_size = int(shard_size)
         self.seed = int(seed)

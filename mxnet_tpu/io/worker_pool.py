@@ -49,13 +49,14 @@ from .._debug import faultpoint as _faultpoint
 from .._debug import flightrec as _flightrec
 from .._debug import locktrace as _locktrace
 from . import _stats
+from ..base import getenv as _getenv
 
 __all__ = ["DecodePool"]
 
 
 def _env_int(name, default):
     try:
-        return int(os.environ.get(name, "") or default)
+        return int(_getenv(name, "") or default)
     except ValueError:
         return default
 

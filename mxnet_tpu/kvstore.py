@@ -38,6 +38,7 @@ from .ndarray import NDArray
 from . import ndarray as nd
 from . import optimizer as opt
 from . import profiler as _profiler
+from .base import getenv as _getenv
 
 __all__ = ["KVStore", "create"]
 
@@ -247,7 +248,7 @@ class KVStore:
         # {0, +-thr}; gate like the reference gates big-array handling
         self._compression_params.setdefault(
             "size_lower_bound",
-            int(os.environ.get("MXNET_KVSTORE_SIZE_LOWER_BOUND", 4096)))
+            int(_getenv("MXNET_KVSTORE_SIZE_LOWER_BOUND", 4096)))
         self._compression_residuals = {}
 
     def _compression_active(self, merged):
@@ -361,7 +362,7 @@ def create(name="local"):
         # kvstore_dist_server.h:358 async ApplyUpdates semantics)
         from .kvstore_async import AsyncKVStore
         return AsyncKVStore()
-    if kind.startswith("dist") and os.environ.get("MXTPU_COORDINATOR"):
+    if kind.startswith("dist") and _getenv("MXTPU_COORDINATOR"):
         # join the job the launcher (tools/launch.py) wired via env — the
         # analog of ps-lite reading DMLC_* at KVStore::Create time
         # (ref: src/kvstore/kvstore_dist.h:50). jax.distributed must run

@@ -66,6 +66,8 @@ from . import profiler as _profiler
 from ._debug import faultpoint as _faultpoint
 from ._debug import locktrace as _locktrace
 from ._debug import watchdog as _watchdog
+from .base import getenv as _getenv
+from .base import getenv_dynamic as _getenv_dynamic
 
 __all__ = ["AsyncPSServer", "AsyncPSClient", "serve_if_rank0"]
 
@@ -138,7 +140,7 @@ def _flow_id(rank, req_id):
 
 
 def _ps_secret():
-    s = os.environ.get("MXTPU_PS_SECRET", "")
+    s = _getenv("MXTPU_PS_SECRET", "")
     return s.encode() if s else None
 
 
@@ -237,12 +239,12 @@ def _server_stats():
     out = {}
     now = _ptime.monotonic()
     try:
-        factor = float(os.environ.get("MXTPU_STRAGGLER_FACTOR", "2.0")
+        factor = float(_getenv("MXTPU_STRAGGLER_FACTOR", "2.0")
                        or 2.0)
     except ValueError:
         factor = 2.0
     try:
-        stale_s = float(os.environ.get("MXTPU_PS_DEAD_TIMEOUT", "3.0")
+        stale_s = float(_getenv("MXTPU_PS_DEAD_TIMEOUT", "3.0")
                         or 3.0)
     except ValueError:
         stale_s = 3.0
@@ -536,7 +538,7 @@ class AsyncPSServer:
             # client never sees a rendezvous that did not happen.
             (n,) = struct.unpack_from(">q", buf, off)
             import time as _t
-            timeout = float(os.environ.get("MXTPU_PS_BARRIER_TIMEOUT",
+            timeout = float(_getenv("MXTPU_PS_BARRIER_TIMEOUT",
                                            "600"))
             deadline = _t.monotonic() + timeout
             with self._barrier_cv:
@@ -565,7 +567,7 @@ class AsyncPSServer:
                         # lock as the cv) knows who stopped beating, so
                         # the abort tells operators WHO is dead, not
                         # just how many arrivals were short
-                        stale = float(os.environ.get(
+                        stale = float(_getenv(
                             "MXTPU_PS_DEAD_TIMEOUT", "3.0"))
                         now = _t.monotonic()
                         dead = sorted(
@@ -714,7 +716,7 @@ class AsyncPSClient:
         # wire trace-context state: what protocol the peer speaks
         # (negotiated per connection) and this client's request counter
         self._peer_version = 0
-        self._rank = int(os.environ.get("MXTPU_PROC_ID", "0") or 0)
+        self._rank = int(_getenv("MXTPU_PROC_ID", "0") or 0)
         self._req_id = 0
 
     def _connect_once(self):
@@ -1064,7 +1066,7 @@ class AsyncPSClient:
         did before the deadline (default MXTPU_PS_DONE_TIMEOUT, 120s —
         matching the reference's barrier-before-exit patience)."""
         if timeout is None:
-            timeout = float(os.environ.get("MXTPU_PS_DONE_TIMEOUT", "120"))
+            timeout = float(_getenv("MXTPU_PS_DONE_TIMEOUT", "120"))
         reached = self._call(struct.pack(">Bqd", _OP_WAIT_DONE, n,
                                          float(timeout)))
         if not reached:
@@ -1088,8 +1090,8 @@ class AsyncKVStore:
     the reference's async convergence semantics, not sync's."""
 
     def __init__(self):
-        rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
-        nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
+        rank = int(_getenv("MXTPU_PROC_ID", "0"))
+        nproc = int(_getenv("MXTPU_NUM_PROCS", "1"))
         self._rank = rank
         self._num_workers = nproc
         self._servers, self._clients = serve_group(rank)
@@ -1098,7 +1100,7 @@ class AsyncKVStore:
         self._optimizer = None
         self._done_sent = False
         self._compression = None
-        self._compression_bound = int(os.environ.get(
+        self._compression_bound = int(_getenv(
             "MXNET_KVSTORE_SIZE_LOWER_BOUND", "4096"))
         # dead ranks already reported by dead_nodes(): growth of this
         # set is THE elastic signal (counter + trace marker), so the
@@ -1106,14 +1108,14 @@ class AsyncKVStore:
         self._known_dead = set()
         # dense arrays >= this many elements are SPLIT across the server
         # group (ref: kvstore_dist.h:58 MXNET_KVSTORE_BIGARRAY_BOUND)
-        self._bigarray_bound = int(os.environ.get(
+        self._bigarray_bound = int(_getenv(
             "MXNET_KVSTORE_BIGARRAY_BOUND", str(1000 * 1000)))
         self._split = {}  # key -> (shape, dtype, [shard lengths])
         self._residuals = {}
         # liveness beats feed each server's dead-node tracking; they
         # also carry the clock-sync timestamps (server 0 = the primary
         # clock every rank's trace shard aligns to in merge_traces)
-        hb = float(os.environ.get("MXTPU_PS_HEARTBEAT_INTERVAL", "0.5"))
+        hb = float(_getenv("MXTPU_PS_HEARTBEAT_INTERVAL", "0.5"))
         for i, c in enumerate(self._clients):
             c.start_heartbeat(rank, interval=hb, sync_clock=True,
                               clock_primary=(i == 0))
@@ -1359,7 +1361,7 @@ class AsyncKVStore:
         # same gating source as the sync path (kvstore.py)
         self._compression_bound = int(compression_params.get(
             "size_lower_bound",
-            os.environ.get("MXNET_KVSTORE_SIZE_LOWER_BOUND", 4096)))
+            _getenv("MXNET_KVSTORE_SIZE_LOWER_BOUND", 4096)))
 
     def set_updater(self, updater):
         raise NotImplementedError(
@@ -1524,9 +1526,9 @@ def serve_group(rank, port_env="MXTPU_ASYNC_PS_PORT"):
     Returns (servers_hosted_here, clients[num_servers]). Servers bind
     the coordinator interface when one is configured (multi-host), else
     loopback — never 0.0.0.0."""
-    num_servers = max(1, int(os.environ.get("MXTPU_NUM_SERVERS", "1")))
-    nproc = int(os.environ.get("MXTPU_NUM_PROCS", "1"))
-    coord = os.environ.get("MXTPU_COORDINATOR", "")
+    num_servers = max(1, int(_getenv("MXTPU_NUM_SERVERS", "1")))
+    nproc = int(_getenv("MXTPU_NUM_PROCS", "1"))
+    coord = _getenv("MXTPU_COORDINATOR", "")
     if coord and ":" in coord:
         host, cport = coord.rsplit(":", 1)
         host = host or "127.0.0.1"
@@ -1539,10 +1541,12 @@ def serve_group(rank, port_env="MXTPU_ASYNC_PS_PORT"):
             # from the same coordinator port every rank sees, so the
             # group still agrees on the endpoints without talking.
             derived -= 50000
-        base = int(os.environ.get(port_env, 0)) or derived
+        base = int(_getenv_dynamic(port_env, 0,
+                                   family="MXTPU_ASYNC_PS_PORT")) or derived
     else:
-        host, base = "127.0.0.1", int(os.environ.get(port_env, 0))
-    if rank == 0 and "MXTPU_PS_SECRET" not in os.environ:
+        host, base = "127.0.0.1", int(_getenv_dynamic(
+            port_env, 0, family="MXTPU_ASYNC_PS_PORT"))
+    if rank == 0 and _getenv("MXTPU_PS_SECRET") is None:
         # generated before fork/spawn of local workers; multi-host
         # launchers pass MXTPU_* env through (tools/launch.py)
         os.environ["MXTPU_PS_SECRET"] = _secrets.token_hex(32)
@@ -1561,7 +1565,8 @@ def serve_group(rank, port_env="MXTPU_ASYNC_PS_PORT"):
     def _derived_port(s):
         """env override first, else deterministic base+s (0 = ephemeral,
         valid only for servers hosted in this process)."""
-        return int(os.environ.get(_env_key(s), 0)) \
+        return int(_getenv_dynamic(_env_key(s), 0,
+                                   family="MXTPU_ASYNC_PS_PORT")) \
             or (base + s if base else 0)
 
     servers = []

@@ -44,6 +44,7 @@ import numpy as _np
 
 from .. import autograd
 from .. import engine as _engine
+from ..base import getenv as _getenv
 from .. import profiler as _profiler
 from .. import random as _random
 from .._debug import faultpoint as _faultpoint
@@ -55,7 +56,9 @@ from .ndarray import NDArray, _PendingSlot
 __all__ = ["invoke", "invoke_by_name", "make_op_func", "populate",
            "invoke_getitem", "imperative_jit_enabled", "set_imperative_jit",
            "dispatch_stats", "reset_dispatch_stats", "flush_bulk_segment",
-           "bulk_segment_depth", "set_profiler_hooks", "aval"]
+           "bulk_segment_depth", "set_profiler_hooks", "aval",
+           "register_signature_token", "signature_tokens",
+           "signature_token_names"]
 
 # Telemetry hooks at the dispatch choke points (the engine OprBlock hook
 # analog, src/profiler/profiler.h:251). The per-op guard is the SHARED
@@ -67,7 +70,7 @@ __all__ = ["invoke", "invoke_by_name", "make_op_func", "populate",
 # ring append, no clock read (BENCH_MODEL=flightrec_overhead gates it
 # at <0.5%).
 # MXNET_PROFILER_HOOKS=0 removes even that (bench baseline / paranoia).
-_HOOKS = os.environ.get("MXNET_PROFILER_HOOKS", "1") \
+_HOOKS = _getenv("MXNET_PROFILER_HOOKS", "1") \
     not in ("0", "false", "off")
 
 # Sentinel the shared guard yields when ONLY the flight recorder is on
@@ -125,7 +128,7 @@ def set_amp_cast_hook(hook):
 # Jitted dispatch cache (fast path piece 1).
 # ---------------------------------------------------------------------------
 
-_JIT_ENABLED = os.environ.get("MXNET_IMPERATIVE_JIT", "1") \
+_JIT_ENABLED = _getenv("MXNET_IMPERATIVE_JIT", "1") \
     not in ("0", "false", "off")
 # compile a key only once it repeats: one-shot (op, attrs, avals) combos —
 # the norm in test sweeps — stay eager instead of paying a trace+compile
@@ -544,19 +547,67 @@ def invoke(opdef, args, kwargs):
     return tuple(outs) if multi else outs[0]
 
 
-def _kernel_env_token():
-    """The Pallas kernel-routing env settings that change an op's traced
-    graph (ops/nn.py batch_norm, ops/quantized.py). Part of every
-    dispatch-cache key: flipping MXTPU_FUSED_BN/MXTPU_QUANT_MATMUL (or
-    the global MXTPU_NO_PALLAS kill switch) mid-process must recompile,
-    not silently replay the other path for an already-hot signature —
-    the same contract MXTPU_FUSED_APPLY has in the fused-step
-    signature. Three dict lookups per key build, far below the aval
-    hashing already paid."""
-    env = os.environ
-    return (env.get("MXTPU_NO_PALLAS", "0"),
-            env.get("MXTPU_FUSED_BN", "1"),
-            env.get("MXTPU_QUANT_MATMUL", "1"))
+# ---------------------------------------------------------------------------
+# Compile-signature token registry.
+#
+# Env vars whose VALUE changes a traced graph (Pallas kernel routing,
+# the packed optimizer apply) are exactly the ambient state the PR 9
+# review pass caught leaking into cached executables: a hot signature
+# silently replayed the pre-flip path until the kernel envs joined the
+# dispatch key. The registry formalizes that fix — register a var here
+# and its current value joins EVERY compile-cache signature (the
+# imperative dispatch key below AND gluon/fused_step's program key), so
+# flipping it mid-process recompiles instead of replaying stale code.
+# mxlint MX014 closes the loop statically: an env read reachable from a
+# trace entry point must name a registered token (or carry a waiver).
+# ---------------------------------------------------------------------------
+
+# [(name, default)] in registration order
+_SIG_TOKENS = []  # mxlint: disable=MX003 (appended at import/plugin-registration time only, which serializes under the import lock / lib_api load lock; key builds only iterate)
+
+
+def register_signature_token(name, default=""):
+    """Register an env var as part of every compile-cache signature.
+    Idempotent per name; returns the name so modules can do
+    ``_ENV = register_signature_token("MXTPU_X", "1")``."""
+    for n, _ in _SIG_TOKENS:
+        if n == name:
+            return name
+    _SIG_TOKENS.append((str(name), str(default)))
+    return name
+
+
+def signature_token_names():
+    """Registered token names, registration order (doc/lint surface)."""
+    return tuple(n for n, _ in _SIG_TOKENS)
+
+
+def signature_tokens():
+    """Current values of every registered token, as one hashable tuple.
+    Both cache-key builders consume this: a handful of dict lookups per
+    key build, far below the aval hashing already paid."""
+    # mxlint: disable=MX015 (the registry's own read loop: every name here came through register_signature_token, which MX015 doc-checks individually)
+    return tuple(_getenv(n, d) for n, d in _SIG_TOKENS)
+
+
+# The kernel-routing switches (ops/nn.py batch_norm, ops/quantized.py,
+# the global kill switch) and the packed-apply/autotune toggles that
+# change traced update/kernel graphs. New env-routed kernels register
+# theirs alongside these.
+register_signature_token("MXTPU_NO_PALLAS", "0")
+register_signature_token("MXTPU_FUSED_BN", "1")
+register_signature_token("MXTPU_QUANT_MATMUL", "1")
+register_signature_token("MXTPU_FUSED_APPLY", "0")
+register_signature_token("MXTPU_FLASH_AUTOTUNE", "0")
+# the packed-apply bucket plan (parallel/overlap.bucket_plan) reads the
+# bucket-size cap at trace time, so it shapes the traced update graph —
+# found by mxlint MX014 on its first whole-tree run (exactly the PR 9
+# stale-replay class: flip the cap mid-run, replay the old bucketing)
+register_signature_token("MXTPU_ELASTIC_BUCKET_MB", "4")
+
+# back-compat spelling (PR 9 introduced the kernel-env tuple under this
+# name; the registry supersedes it)
+_kernel_env_token = signature_tokens
 
 
 def _dispatch_key(opdef, args, kwargs, arg_slots, kw_slots, datas, key_val,
@@ -586,7 +637,7 @@ def _dispatch_key(opdef, args, kwargs, arg_slots, kw_slots, datas, key_val,
     if take_key:
         avals = avals + (_aval(key_val),)
     partial = (opdef.name, statics, tuple(arg_slots), tuple(kw_slots),
-               _amp_version, recording, _kernel_env_token())
+               _amp_version, recording, signature_tokens())
     return partial + (avals,), partial
 
 

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import canonical_dtype
+from ..base import getenv as _getenv
 from ..ndarray import NDArray
 from .. import ndarray as nd
 
@@ -61,7 +62,7 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
-                 param_dict=None, aggregate_num=0):
+                 param_dict=None, aggregate_num=None):
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
@@ -70,6 +71,13 @@ class Optimizer:
         self.wd = wd
         self.clip_gradient = clip_gradient
         self.multi_precision = multi_precision
+        # parity knob (ref: MXNET_OPTIMIZER_AGGREGATION_SIZE): accepted
+        # and surfaced, but aggregation is a no-op here — independent
+        # per-weight updates async-dispatch and XLA overlaps them, and
+        # the packed path is MXTPU_FUSED_APPLY inside the fused step
+        if aggregate_num is None:
+            aggregate_num = int(
+                _getenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "0") or 0)
         self.aggregate_num = aggregate_num
 
         self.begin_num_update = begin_num_update

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 
 from ._compile_attr import attributed
 from .conv_fused import _use_pallas
+from ..base import getenv as _getenv
 
 __all__ = ["fused_batch_norm", "batchnorm_reference", "tree_fold_rows",
            "engaged"]
@@ -61,7 +62,7 @@ _ENV = "MXTPU_FUSED_BN"
 
 
 def _setting():
-    return os.environ.get(_ENV, "1")
+    return _getenv(_ENV, "1")
 
 
 def _force_interpret():

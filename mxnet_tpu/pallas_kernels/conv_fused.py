@@ -56,6 +56,7 @@ import os
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..base import getenv as _getenv
 
 __all__ = ["fused_scale_relu_conv3x3", "fused_conv_reference"]
 
@@ -385,7 +386,7 @@ def _pallas_backward(x, s, b, w, relu, interpret, g):
 
 
 def _use_pallas(x=None):
-    if os.environ.get("MXTPU_NO_PALLAS", "0") == "1":
+    if _getenv("MXTPU_NO_PALLAS", "0") == "1":
         return False
     # a CONCRETE array knows where it lives — eager ops on host-committed
     # arrays (default-ctx cpu NDArrays on a TPU-attached process) must

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ._compile_attr import attributed
+from ..base import getenv as _getenv
 from .conv_fused import _use_pallas
 
 __all__ = ["packed_apply", "packed_apply_reference", "enabled",
@@ -45,7 +46,7 @@ _ENV = "MXTPU_FUSED_APPLY"
 
 
 def _setting():
-    return os.environ.get(_ENV, "0")
+    return _getenv(_ENV, "0")
 
 
 def enabled():

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ._compile_attr import attributed
+from ..base import getenv as _getenv
 from .conv_fused import _use_pallas
 
 __all__ = ["quantized_matmul", "quantized_matmul_reference", "engaged"]
@@ -47,7 +48,7 @@ _ENV = "MXTPU_QUANT_MATMUL"
 
 
 def _setting():
-    return os.environ.get(_ENV, "1")
+    return _getenv(_ENV, "1")
 
 
 def _force_interpret():

@@ -18,6 +18,7 @@ import os
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..base import getenv as _getenv
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all",
            "ppermute", "ring_exchange", "host_allreduce", "host_barrier",
@@ -128,14 +129,14 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     """Bring up the multi-process runtime (≙ the DMLC_* env handshake,
     ref: src/kvstore/kvstore_dist.h:50 ps::KVWorker setup). Reads
     MXTPU_COORDINATOR / MXTPU_NUM_PROCS / MXTPU_PROC_ID when args omitted."""
-    coordinator_address = coordinator_address or os.environ.get(
+    coordinator_address = coordinator_address or _getenv(
         "MXTPU_COORDINATOR")
     if coordinator_address is None:
         return False
     if num_processes is None:
-        num_processes = os.environ.get("MXTPU_NUM_PROCS", 1)
+        num_processes = _getenv("MXTPU_NUM_PROCS", 1)
     if process_id is None:
-        process_id = os.environ.get("MXTPU_PROC_ID", 0)
+        process_id = _getenv("MXTPU_PROC_ID", 0)
     jax.distributed.initialize(coordinator_address, int(num_processes),
                                int(process_id))
     return True

@@ -52,6 +52,7 @@ from .._debug import faultpoint as _faultpoint
 from .._debug import flightrec as _flightrec
 from .._debug import watchdog as _watchdog
 from .sharding import host_array
+from ..base import getenv as _getenv
 
 __all__ = ["CheckpointManager", "elastic_train_loop", "PreemptionGuard",
            "ElasticController", "HostGradReducer", "ReshardRequired",
@@ -331,20 +332,20 @@ class ElasticController:
                  reshard_policy=None, reshard_fn=None, logger=None):
         self.kv = kvstore
         if rank is None:
-            rank = int(os.environ.get("MXTPU_PROC_ID", "0") or 0)
+            rank = int(_getenv("MXTPU_PROC_ID", "0") or 0)
         self.rank = int(rank)
         if world is None:
             n = getattr(kvstore, "num_workers", 1) if kvstore else 1
             world = range(int(n))
         self.world = sorted(int(r) for r in world)
         self.poll_interval = float(
-            os.environ.get("MXTPU_ELASTIC_POLL_S", "1.0")
+            _getenv("MXTPU_ELASTIC_POLL_S", "1.0")
             if poll_interval is None else poll_interval)
         self.dead_timeout = float(
-            os.environ.get("MXTPU_PS_DEAD_TIMEOUT", "3.0")
+            _getenv("MXTPU_PS_DEAD_TIMEOUT", "3.0")
             if dead_timeout is None else dead_timeout)
         self.reshard_policy = (
-            os.environ.get("MXTPU_ELASTIC_RESHARD", "shrink")
+            _getenv("MXTPU_ELASTIC_RESHARD", "shrink")
             if reshard_policy is None else reshard_policy)
         if self.reshard_policy not in ("shrink", "fail"):
             raise ValueError(
@@ -568,7 +569,7 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
     """
     log = logger or logging.getLogger("mxnet_tpu.elastic")
     if save_every is None:
-        save_every = int(os.environ.get("MXTPU_ELASTIC_CKPT_EVERY",
+        save_every = int(_getenv("MXTPU_ELASTIC_CKPT_EVERY",
                                         "100"))
     batches = list(batches)
 

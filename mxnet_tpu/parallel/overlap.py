@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 
 from .. import profiler as _profiler
+from ..base import getenv as _getenv
 
 __all__ = ["bucket_plan", "tag_gradient_buckets", "bucketed_reduce",
            "default_bucket_bytes"]
@@ -49,7 +50,7 @@ def default_bucket_bytes():
     """Size cap per bucket, from ``MXTPU_ELASTIC_BUCKET_MB`` (default 4
     MiB — large enough to amortize collective latency, small enough
     that the first reduction launches early in the backward)."""
-    mb = float(os.environ.get("MXTPU_ELASTIC_BUCKET_MB", "4"))
+    mb = float(_getenv("MXTPU_ELASTIC_BUCKET_MB", "4"))
     return max(1, int(mb * (1 << 20)))
 
 

@@ -37,6 +37,7 @@ import contextlib
 import os
 
 import jax
+from .base import getenv as _getenv
 
 __all__ = ["set_matmul_precision", "get_matmul_precision",
            "matmul_precision"]
@@ -78,7 +79,7 @@ def matmul_precision(precision):
 
 def _apply_env():
     """Honor MXTPU_MATMUL_PRECISION at import (package __init__)."""
-    val = os.environ.get(ENV_VAR)
+    val = _getenv(ENV_VAR)
     if val:
         set_matmul_precision(val)
 

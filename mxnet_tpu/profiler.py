@@ -51,6 +51,7 @@ import time
 
 from ._debug import flightrec as _flightrec
 from ._debug import locktrace as _locktrace
+from .base import getenv as _getenv
 
 __all__ = [
     "set_config", "set_state", "dump", "dumps", "pause", "resume",
@@ -68,7 +69,7 @@ __all__ = [
 # chrome-trace pid of every event this process emits: the worker rank.
 # Per-rank trace shards then merge into ONE job-wide trace with each
 # rank as its own process row (merge_traces / tools/trace_merge.py).
-PID = int(os.environ.get("MXTPU_PROC_ID", "0") or 0)
+PID = int(_getenv("MXTPU_PROC_ID", "0") or 0)
 
 # Stable pid/tid lanes of the host trace. tid doubles as the sort index.
 LANES = {
@@ -154,7 +155,7 @@ _t0 = time.perf_counter()
 # it) without bound. Aggregate/counter totals keep counting past the cap;
 # only raw timeline events are dropped, tallied in
 # counters['profiler.dropped_events'].
-_MAX_EVENTS = int(os.environ.get("MXNET_PROFILER_MAX_EVENTS", "1000000"))
+_MAX_EVENTS = int(_getenv("MXNET_PROFILER_MAX_EVENTS", "1000000"))
 # serializes trace-file writers (continuous-dump daemon vs explicit
 # dump()): both write the same temp path, and interleaved writers would
 # break the atomic-rewrite guarantee
@@ -164,6 +165,7 @@ _dump_lock = _locktrace.named_lock("profiler.dump")
 def _append_locked(ev):
     """Append one trace event; caller holds _lock. Drops (and tallies)
     events past _MAX_EVENTS so unbounded runs stay bounded."""
+    # mxlint: disable=MX014 (telemetry side channel: the cap gates what gets RECORDED, never a value that flows into a traced graph)
     if len(_events) >= _MAX_EVENTS:
         # mxlint: disable=MX003 (caller holds _lock — the function's contract, see docstring)
         _counters["profiler.dropped_events"] = \
@@ -268,7 +270,7 @@ def set_state(state="stop", profile_process="worker"):
         # /metrics endpoint without any code change; set_state('stop')
         # takes it down again (before the final trace dump — see the
         # shutdown-ordering note there)
-        if os.environ.get("MXNET_PROFILER_HTTP_PORT"):
+        if _getenv("MXNET_PROFILER_HTTP_PORT"):
             try:
                 serve_metrics()
             except (OSError, ValueError, OverflowError):
@@ -322,7 +324,7 @@ def _start_daemons(profile_memory, continuous, period):
     stop = _threads_stop
     if profile_memory:
         sample_memory("start")
-        sample_period = float(os.environ.get(
+        sample_period = float(_getenv(
             "MXNET_PROFILER_MEMORY_SAMPLE_PERIOD", "0.1"))
 
         def _mem_loop():
@@ -413,6 +415,7 @@ def record_op(name, dur_us, category="operator", args=None,
         return
     end = _now_us()
     ev = {"name": name, "cat": category, "ph": "X",
+          # mxlint: disable=MX014 (telemetry side channel: PID only tags the emitted event with the rank; no traced value depends on it)
           "ts": end - dur_us, "dur": dur_us, "pid": PID,
           "tid": LANES.get(lane, LANES["user"])}
     if args:
@@ -462,6 +465,7 @@ def account(name, delta, lane="kvstore", emit=True):
         _counters[name] = total
         if emit and _ACTIVE:
             _append_locked({"name": name, "cat": "counter", "ph": "C",
+                            # mxlint: disable=MX014 (telemetry side channel: rank tag on the emitted event only)
                             "ts": _now_us(), "pid": PID,
                             "tid": LANES.get(lane, LANES["user"]),
                             "args": {"value": total}})
@@ -1162,7 +1166,7 @@ def serve_metrics(port=None, host="127.0.0.1"):
         if _http_server is not None:
             return _http_server.server_address[1]
     if port is None:
-        port = int(os.environ.get("MXNET_PROFILER_HTTP_PORT", "0"))
+        port = int(_getenv("MXNET_PROFILER_HTTP_PORT", "0"))
     import http.server
 
     class _Handler(http.server.BaseHTTPRequestHandler):

@@ -523,6 +523,708 @@ def test_file_level_waiver(tmp_path):
     assert findings == [] and bad == [] and n_waived == 2
 
 
+# -- MX014: traced-ambient-state capture -------------------------------------
+
+_MINI_REGISTRY = """\
+def register(name, **kw):
+    def _reg(fn):
+        return fn
+    return _reg
+"""
+
+
+def _plant(tmp_path, rel, src):
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(src))
+    return target
+
+
+def _lint_tree(tmp_path, rule_codes, roots=("mxnet_tpu",)):
+    """Run mxlint over a planted synthetic tree (multi-file: the
+    dataflow rules need the whole project model)."""
+    prev = core.REPO_ROOT
+    core.REPO_ROOT = str(tmp_path)
+    try:
+        sel = [r for r in rules.ALL_RULES if r.code in rule_codes]
+        return mxlint.run([str(tmp_path / r) for r in roots],
+                          rules=sel, baseline=[])
+    finally:
+        core.REPO_ROOT = prev
+
+
+def test_mx014_flags_unregistered_env_read_in_op_body(tmp_path):
+    """The PR 9 `_kernel_env_token` bug class as a fixture: an op body
+    (trace entry) reads an env var that is NOT in the signature-token
+    registry — the compiled path would silently replay the stale value.
+    The registered var and the read in plain host code stay clean."""
+    _plant(tmp_path, "mxnet_tpu/ops/registry.py", _MINI_REGISTRY)
+    _plant(tmp_path, "mxnet_tpu/ndarray/register.py", """\
+        def register_signature_token(name, default=""):
+            return name
+
+        register_signature_token("MXTPU_GOOD_TOKEN", "1")
+        """)
+    _plant(tmp_path, "mxnet_tpu/ops/myops.py", """\
+        import os
+
+        from ..ops.registry import register
+
+        @register("shiny_op")
+        def shiny_op(x):
+            if os.environ.get("MXTPU_SHINY_MODE") == "1":   # flagged
+                return x * 2
+            if os.environ.get("MXTPU_GOOD_TOKEN") == "1":   # registered
+                return x * 3
+            return x
+
+        def host_only():
+            return os.environ.get("MXTPU_SHINY_MODE")       # not traced
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX014"})
+    assert [f.code for f in findings] == ["MX014"]
+    assert "MXTPU_SHINY_MODE" in findings[0].message
+    assert findings[0].path == "mxnet_tpu/ops/myops.py"
+    assert findings[0].line == 7
+
+
+def test_mx014_follows_the_call_graph(tmp_path):
+    """The read sits two calls deep behind the entry — per-line rules
+    cannot see it; the project-model reachability does."""
+    _plant(tmp_path, "mxnet_tpu/ops/registry.py", _MINI_REGISTRY)
+    _plant(tmp_path, "mxnet_tpu/ops/helpers.py", """\
+        import os
+
+        def leaf_config():
+            return os.environ.get("MXTPU_DEEP_KNOB", "0")
+
+        def middle(x):
+            return leaf_config()
+        """)
+    _plant(tmp_path, "mxnet_tpu/ops/myops.py", """\
+        from ..ops.registry import register
+        from .helpers import middle
+
+        @register("deep_op")
+        def deep_op(x):
+            return middle(x)
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX014"})
+    assert [f.code for f in findings] == ["MX014"]
+    assert findings[0].path == "mxnet_tpu/ops/helpers.py"
+    assert "MXTPU_DEEP_KNOB" in findings[0].message
+
+
+def test_mx014_flags_clock_rng_and_env_globals(tmp_path):
+    _plant(tmp_path, "mxnet_tpu/ops/registry.py", _MINI_REGISTRY)
+    _plant(tmp_path, "mxnet_tpu/ops/myops.py", """\
+        import os
+        import random
+        import time
+
+        from ..ops.registry import register
+
+        _MODE = os.environ.get("MXTPU_AMBIENT_MODE", "fast")
+
+        @register("leaky_op")
+        def leaky_op(x):
+            t = time.perf_counter()         # clock: flagged
+            r = random.random()             # host RNG: flagged
+            if _MODE == "fast":             # env-derived global: flagged
+                return x + t + r
+            return x
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX014"})
+    assert len(findings) == 3
+    msgs = " ".join(f.message for f in findings)
+    assert "clock" in msgs and "RNG" in msgs \
+        and "MXTPU_AMBIENT_MODE" in msgs
+
+
+def test_mx014_cross_module_env_global(tmp_path):
+    """A traced op body reading ANOTHER module's env-derived global
+    (`cfg.FLAG`) is the same stale-replay hazard as a same-module read
+    (review regression: dotted attribute refs must resolve)."""
+    _plant(tmp_path, "mxnet_tpu/ops/registry.py", _MINI_REGISTRY)
+    _plant(tmp_path, "mxnet_tpu/cfg.py", """\
+        import os
+
+        FLAG = os.environ.get("MXTPU_CROSS_FLAG", "0")
+        """)
+    _plant(tmp_path, "mxnet_tpu/ops/myops.py", """\
+        from ..ops.registry import register
+        from .. import cfg
+
+        @register("crossy_op")
+        def crossy_op(x):
+            if cfg.FLAG == "1":
+                return x * 2
+            return x
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX014"})
+    assert [f.code for f in findings] == ["MX014"]
+    assert "MXTPU_CROSS_FLAG" in findings[0].message
+    assert findings[0].path == "mxnet_tpu/ops/myops.py"
+
+
+def test_mx014_step_fn_and_waiver(tmp_path):
+    """Optimizer step_fns are entries; the waiver idiom applies."""
+    findings, n_waived, _, _ = _lint_tree(tmp_path, {"MX014"})
+    assert findings == []  # empty tree
+    _plant(tmp_path, "mxnet_tpu/optimizer/opt.py", """\
+        import os
+
+        class Shiny:
+            def step_fn(self, w, g, state, lr, wd, rescale):
+                # mxlint: disable=MX014 (test waiver: pretend operand)
+                knob = os.environ.get("MXTPU_STEP_KNOB", "0")
+                return w - lr * g * float(knob)
+        """)
+    findings, n_waived, _, _ = _lint_tree(tmp_path, {"MX014"})
+    assert findings == [] and n_waived == 1
+
+
+def test_mx014_real_tree_tokens_registered():
+    """The real registry carries the kernel-routing tokens AND the
+    bucket-plan cap MX014 found on its first whole-tree run; both
+    cache-key builders consume the same tuple."""
+    from mxnet_tpu.ndarray import register as r
+    names = r.signature_token_names()
+    for tok in ("MXTPU_NO_PALLAS", "MXTPU_FUSED_BN",
+                "MXTPU_QUANT_MATMUL", "MXTPU_FUSED_APPLY",
+                "MXTPU_ELASTIC_BUCKET_MB"):
+        assert tok in names, tok
+    assert len(r.signature_tokens()) == len(names)
+
+
+def test_signature_tokens_change_dispatch_key(monkeypatch):
+    """Flipping a registered token must change the dispatch partial key
+    (the runtime contract MX014 enforces statically)."""
+    from mxnet_tpu.ndarray import register as r
+    before = r.signature_tokens()
+    monkeypatch.setenv("MXTPU_ELASTIC_BUCKET_MB", "17")
+    after = r.signature_tokens()
+    assert before != after
+
+
+# -- MX015: env contract sync ------------------------------------------------
+
+_DOCS = """\
+# Environment variables
+
+| Variable | Default | Meaning |
+|---|---|---|
+| `MXTPU_DOCUMENTED` | `1` | a documented knob |
+| `MXTPU_PORT_FAMILY` | derived | a documented computed-name family |
+"""
+
+
+def test_mx015_direct_environ_and_undocumented(tmp_path):
+    _plant(tmp_path, "docs/ENV_VARS.md", _DOCS)
+    _plant(tmp_path, "mxnet_tpu/thing.py", """\
+        import os
+
+        from .base import getenv as _getenv
+
+        def bad_direct():
+            return os.environ.get("MXTPU_DOCUMENTED")    # choke point
+
+        def bad_direct_getenv():
+            return os.getenv("MXTPU_DOCUMENTED")         # choke point
+
+        def bad_undocumented():
+            return _getenv("MXTPU_MYSTERY_KNOB", "0")    # not in docs
+
+        def good():
+            return _getenv("MXTPU_DOCUMENTED", "1")
+
+        def writes_are_fine(v):
+            os.environ["MXTPU_DOCUMENTED"] = v
+        """)
+    _plant(tmp_path, "mxnet_tpu/base.py",
+           "def getenv(name, default=None):\n    return None\n")
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX015"})
+    assert [f.code for f in findings] == ["MX015"] * 3
+    msgs = " ".join(f.message for f in findings)
+    assert "choke point" in msgs and "MXTPU_MYSTERY_KNOB" in msgs
+
+
+def test_mx015_dynamic_family_forms(tmp_path):
+    _plant(tmp_path, "docs/ENV_VARS.md", _DOCS)
+    _plant(tmp_path, "mxnet_tpu/ports.py", """\
+        from .base import getenv_dynamic as _getenv_dynamic
+
+        def good(s):
+            name = "MXTPU_PORT_FAMILY_%d" % s
+            return _getenv_dynamic(name, 0, family="MXTPU_PORT_FAMILY")
+
+        def bad_no_family(s):
+            return _getenv_dynamic("MXTPU_PORT_FAMILY_%d" % s, 0)
+
+        def bad_undoc_family(s):
+            return _getenv_dynamic("X_%d" % s, 0, family="MXTPU_NOPE")
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX015"})
+    assert [f.code for f in findings] == ["MX015", "MX015"]
+    msgs = " ".join(f.message for f in findings)
+    assert "family" in msgs and "MXTPU_NOPE" in msgs
+
+
+def test_mx015_resolves_helper_params_through_callers(tmp_path):
+    """The watchdog/flightrec idiom: a helper takes the env NAME as a
+    parameter. The rule follows the dataflow one level: literals at
+    call sites are doc-checked, computed names are flagged AT THE
+    CALLER."""
+    _plant(tmp_path, "docs/ENV_VARS.md", _DOCS)
+    _plant(tmp_path, "mxnet_tpu/helper.py", """\
+        from .base import getenv as _getenv
+
+        def _env_float(name, default):
+            return float(_getenv(name, "") or default)
+
+        def good():
+            return _env_float("MXTPU_DOCUMENTED", 1.0)
+
+        def bad_literal():
+            return _env_float("MXTPU_UNDOC_VIA_HELPER", 0.0)
+
+        def bad_computed(suffix):
+            return _env_float("MXTPU_" + suffix, 0.0)
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX015"})
+    assert len(findings) == 2
+    by_line = {f.line: f.message for f in findings}
+    assert any("MXTPU_UNDOC_VIA_HELPER" in m for m in by_line.values())
+    assert any("cannot resolve" in m or "computed env name" in m
+               for m in by_line.values())
+
+
+def test_mx015_real_tree_docs_cover_the_satellite_vars():
+    """The env-doc drift the ISSUE names is fixed: the seven vars MX015
+    found undocumented on its first run now have ENV_VARS.md rows."""
+    with open(os.path.join(REPO, "docs", "ENV_VARS.md"),
+              encoding="utf-8") as f:
+        doc = f.read()
+    for var in ("MXTPU_PS_SECRET", "MXTPU_PS_BARRIER_TIMEOUT",
+                "MXTPU_PS_DONE_TIMEOUT", "MXTPU_ASYNC_PS_PORT",
+                "MXTPU_NUM_SERVERS", "MXTPU_FLASH_AUTOTUNE",
+                "MXNET_OPTIMIZER_AGGREGATION_SIZE"):
+        assert "`%s`" % var in doc, var
+
+
+def test_mx015_waiver_form(tmp_path):
+    _plant(tmp_path, "docs/ENV_VARS.md", _DOCS)
+    _plant(tmp_path, "mxnet_tpu/thing.py", """\
+        import os
+
+        def sanctioned():
+            # mxlint: disable=MX015 (test: exempted direct read)
+            return os.environ.get("MXTPU_DOCUMENTED")
+        """)
+    findings, n_waived, _, bad = _lint_tree(tmp_path, {"MX015"})
+    assert findings == [] and bad == [] and n_waived == 1
+
+
+# -- MX016: use-after-donation -----------------------------------------------
+
+_MINI_OPS = """\
+from .registry import register
+
+@register("sgd_mom_update", num_inputs=3, inplace=(2,))
+def sgd_mom_update(weight, grad, mom, lr=None):
+    return weight, mom
+"""
+
+
+def test_mx016_jit_donate_use_after_donation(tmp_path):
+    """The synthetic use-after-donate repro: a local jitted program
+    donates its args; reading one afterwards is the TPU crash the CPU
+    tier-1 suite cannot see."""
+    _plant(tmp_path, "mxnet_tpu/repro.py", """\
+        import jax
+
+        def train_step(w, s, step):
+            jfn = jax.jit(step, donate_argnums=(0, 1))
+            new_w, new_s = jfn(w, s)
+            stale = w + 1          # flagged: w was donated
+            return new_w, new_s, stale
+
+        def clean_step(w, s, step):
+            jfn = jax.jit(step, donate_argnums=(0, 1))
+            new_w, new_s = jfn(w, s)
+            w = new_w              # rebind clears the binding
+            return w + 1
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX016"})
+    assert [f.code for f in findings] == ["MX016"]
+    assert findings[0].line == 6
+    assert "'w'" in findings[0].message
+
+
+def test_mx016_registry_op_alias_donation(tmp_path):
+    """Registry `*_update` ops donate their inplace positions. The
+    wrapper re-adopts the state arg itself, so reading `mom` after is
+    fine — but a PRE-call alias (`.copy()` shares the buffer, O(1))
+    goes stale. `.asnumpy()` BEFORE the call is the sanctioned
+    snapshot."""
+    _plant(tmp_path, "mxnet_tpu/ops/registry.py", _MINI_REGISTRY)
+    _plant(tmp_path, "mxnet_tpu/ops/optimizer_ops.py", _MINI_OPS)
+    _plant(tmp_path, "mxnet_tpu/user.py", """\
+        from . import nd
+
+        def bad(weight, grad, mom):
+            snap = mom.copy()                    # buffer share
+            nd.sgd_mom_update(weight, grad, mom, lr=0.1)
+            return snap                          # flagged: stale
+
+        def good(weight, grad, mom):
+            snap = mom.asnumpy()                 # real host snapshot
+            nd.sgd_mom_update(weight, grad, mom, lr=0.1)
+            return snap, mom                     # mom was re-adopted
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX016"})
+    assert [f.code for f in findings] == ["MX016"]
+    assert findings[0].line == 6
+    assert "'snap'" in findings[0].message
+
+
+def test_mx016_adopt_fused_clears(tmp_path):
+    _plant(tmp_path, "mxnet_tpu/repro2.py", """\
+        import jax
+
+        def step(w, s, f, p):
+            jfn = jax.jit(f, donate_argnums=(0,))
+            new_w = jfn(w, s)
+            p._adopt_fused(w)
+            return w        # re-adopted: clean
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX016"})
+    assert findings == []
+
+
+def test_mx016_real_tree_is_clean_and_table_parsed():
+    """On the real tree the rule runs against the real inplace table
+    (sanity: the fused optimizer state ops are in it)."""
+    rule = next(r for r in rules.ALL_RULES if r.code == "MX016")
+    table = rule._table()
+    assert table.get("sgd_mom_update") == (2,)
+    assert table.get("adam_update") == (2, 3)
+
+
+def test_mx016_tuple_unpack_rebind_and_augassign(tmp_path):
+    """`w, s = jfn(w, s)` is the documented-clean rebind idiom (no
+    finding); `w += 1` after a donation READS the stale buffer even
+    though the AST target is Store ctx (review regressions)."""
+    _plant(tmp_path, "mxnet_tpu/repro5.py", """\
+        import jax
+
+        def clean_tuple_rebind(w, s, f):
+            jfn = jax.jit(f, donate_argnums=(0, 1))
+            w, s = jfn(w, s)
+            return w + s
+
+        def bad_augassign(w, f):
+            jfn = jax.jit(f, donate_argnums=(0,))
+            out = jfn(w)
+            w += 1
+            return out
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX016"})
+    assert [f.code for f in findings] == ["MX016"]
+    assert findings[0].line == 11 and "'w'" in findings[0].message
+
+
+def test_mx014_subscript_env_read_and_telemetry_globals(tmp_path):
+    """os.environ["X"] subscript reads inside a traced function carry
+    the name to MX014, and the telemetry-module exemption covers ONLY
+    the clock clause — env-derived globals there stay checked (review
+    regressions)."""
+    _plant(tmp_path, "mxnet_tpu/ops/registry.py", _MINI_REGISTRY)
+    _plant(tmp_path, "mxnet_tpu/_debug/telem.py", """\
+        import os
+        import time
+
+        _MODE = os.environ.get("MXTPU_TELEM_MODE", "0")
+
+        def helper():
+            t = time.perf_counter()   # telemetry clock: exempt
+            if _MODE == "1":          # env-derived global: NOT exempt
+                return t
+            return 0.0
+        """)
+    _plant(tmp_path, "mxnet_tpu/ops/myops.py", """\
+        import os
+
+        from ..ops.registry import register
+        from .._debug.telem import helper
+
+        @register("sub_op")
+        def sub_op(x):
+            helper()
+            return x * int(os.environ["MXTPU_SUBSCRIPT_KNOB"])
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX014"})
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 2, findings
+    assert any("MXTPU_SUBSCRIPT_KNOB" in m for m in msgs)
+    assert any("MXTPU_TELEM_MODE" in m for m in msgs)
+    assert not any("clock" in m for m in msgs)
+
+
+def test_mx016_rhs_read_of_own_reassignment(tmp_path):
+    """`w = w.copy()` after a donation READS the donated buffer on its
+    own RHS — the rebind must not clear the poison before the read is
+    seen (review regression)."""
+    _plant(tmp_path, "mxnet_tpu/repro4.py", """\
+        import jax
+
+        def step(w, f):
+            jfn = jax.jit(f, donate_argnums=(0,))
+            out = jfn(w)
+            w = w.copy()
+            return out, w
+
+        def rebind_to_result_is_clean(w, f):
+            jfn = jax.jit(f, donate_argnums=(0,))
+            w = jfn(w)
+            return w + 1
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX016"})
+    assert [f.code for f in findings] == ["MX016"]
+    assert findings[0].line == 6 and "'w'" in findings[0].message
+
+
+def test_mx016_waiver_form(tmp_path):
+    _plant(tmp_path, "mxnet_tpu/repro3.py", """\
+        import jax
+
+        def step(w, s, f):
+            jfn = jax.jit(f, donate_argnums=(0,))
+            new_w = jfn(w, s)
+            # mxlint: disable=MX016 (test: deliberate stale read)
+            return w
+        """)
+    findings, n_waived, _, bad = _lint_tree(tmp_path, {"MX016"})
+    assert findings == [] and bad == [] and n_waived == 1
+
+
+# -- MX017: static lock-order graph ------------------------------------------
+
+_CYCLIC_LOCKS = """\
+from .._debug.locktrace import named_lock
+
+_A = named_lock("fix.a")
+_B = named_lock("fix.b")
+
+def path_one():
+    with _A:
+        with _B:
+            pass
+
+def path_two():
+    with _B:
+        with _A:
+            pass
+"""
+
+
+def test_mx017_flags_cyclic_two_lock_fixture(tmp_path):
+    _plant(tmp_path, "mxnet_tpu/sub/locky.py", _CYCLIC_LOCKS)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX017"})
+    assert [f.code for f in findings] == ["MX017"]
+    assert "fix.a" in findings[0].message \
+        and "fix.b" in findings[0].message
+
+
+def test_mx017_consistent_order_and_self_attr_locks(tmp_path):
+    _plant(tmp_path, "mxnet_tpu/sub/locky.py", """\
+        from .._debug.locktrace import named_lock
+
+        _A = named_lock("ok.outer")
+
+        class Thing:
+            def __init__(self):
+                self._lock = named_lock("ok.inner")
+
+            def work(self):
+                with _A:
+                    with self._lock:
+                        pass
+
+            def also(self):
+                with _A:
+                    with self._lock:
+                        pass
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX017"})
+    assert findings == []
+
+
+def test_mx017_cycle_through_three_modules(tmp_path):
+    """The graph is global: each module's nesting is locally consistent
+    but the union cycles — only a whole-program pass can see it."""
+    _plant(tmp_path, "mxnet_tpu/m1.py",
+           "from ._debug.locktrace import named_lock\n"
+           "A = named_lock('g.a')\nB = named_lock('g.b')\n"
+           "def f():\n    with A:\n        with B:\n            pass\n")
+    _plant(tmp_path, "mxnet_tpu/m2.py",
+           "from ._debug.locktrace import named_lock\n"
+           "B = named_lock('g.b')\nC = named_lock('g.c')\n"
+           "def f():\n    with B:\n        with C:\n            pass\n")
+    _plant(tmp_path, "mxnet_tpu/m3.py",
+           "from ._debug.locktrace import named_lock\n"
+           "C = named_lock('g.c')\nA = named_lock('g.a')\n"
+           "def f():\n    with C:\n        with A:\n            pass\n")
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX017"})
+    assert len(findings) == 1
+    assert "g.a" in findings[0].message
+
+
+def test_mx017_real_tree_has_no_lexical_nesting():
+    """The framework tree deliberately holds at most one named lock per
+    lexical scope (matching the runtime detector's zero inversions) —
+    the static graph over the real tree has nodes but no edges."""
+    model = core.build_model(["mxnet_tpu"])
+    assert model.lock_nodes(lambda p: True)
+    assert model.lock_graph(lambda p: True) == {}
+
+
+def test_mx017_waiver_form(tmp_path):
+    """A lock-cycle waiver sits on the finding's anchor site (the
+    first edge of the cycle in path/line order)."""
+    _plant(tmp_path, "mxnet_tpu/sub/locky.py", """\
+        from .._debug.locktrace import named_lock
+
+        _A = named_lock("wf.a")
+        _B = named_lock("wf.b")
+
+        def path_one():
+            with _A:
+                # mxlint: disable=MX017 (test: cycle acknowledged)
+                with _B:
+                    pass
+
+        def path_two():
+            with _B:
+                with _A:
+                    pass
+        """)
+    findings, n_waived, _, bad = _lint_tree(tmp_path, {"MX017"})
+    assert findings == [] and bad == [] and n_waived == 1
+
+
+# -- --lock-graph CLI + runtime diff -----------------------------------------
+
+def _run_cli(args, cwd=REPO, repo_root=None):
+    env = dict(os.environ)
+    if repo_root is not None:
+        env["MXLINT_REPO_ROOT"] = str(repo_root)
+    else:
+        env.pop("MXLINT_REPO_ROOT", None)
+    return subprocess.run([sys.executable, "-m", "tools.mxlint"] + args,
+                          cwd=cwd, capture_output=True, text=True,
+                          env=env, timeout=300)
+
+
+def test_lock_graph_cli_clean_tree():
+    r = _run_cli(["--lock-graph"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert "profiler.events" in rep["locks"]
+    assert rep["static_cycles"] == []
+
+
+def test_lock_graph_diff_against_runtime_dump(tmp_path):
+    """The PR 3 enforcement pair verifies itself: drive the REAL
+    framework locks under the runtime detector (the test_locktrace
+    suites' setup), dump locktrace.report(), and diff the static graph
+    against it — zero cycles, zero ordering contradictions."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu._debug import locktrace
+    import mxnet_tpu as mx
+
+    prev = locktrace.enable()
+    locktrace.reset()
+    try:
+        profiler.set_config(filename=str(tmp_path / "t.json"))
+        profiler.set_state("run")
+        (mx.nd.array([1.0, 2.0]) * 2).asnumpy()
+        profiler.set_state("stop")
+        dump = locktrace.report()
+        assert dump["acquisitions"] > 0
+    finally:
+        locktrace.reset()
+        if not prev:
+            locktrace.disable()
+    dump_path = tmp_path / "locktrace.json"
+    dump_path.write_text(json.dumps(dump))
+    r = _run_cli(["--lock-graph", "--runtime-dump", str(dump_path)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["static_cycles"] == [] and rep["runtime_cycles"] == []
+    assert rep["contradictions"] == []
+
+
+def test_lock_graph_diff_detects_contradiction(tmp_path):
+    """A runtime dump ordering two locks OPPOSITE to the static graph
+    is a contradiction and a non-zero exit."""
+    _plant(tmp_path, "mxnet_tpu/locky.py",
+           "from ._debug.locktrace import named_lock\n"
+           "A = named_lock('d.a')\nB = named_lock('d.b')\n"
+           "def f():\n    with A:\n        with B:\n            pass\n")
+    dump_path = tmp_path / "rt.json"
+    dump_path.write_text(json.dumps({"order_edges": ["d.b->d.a"]}))
+    r = _run_cli(["--lock-graph", "--runtime-dump", str(dump_path),
+                  str(tmp_path / "mxnet_tpu")], repo_root=tmp_path)
+    assert r.returncode == 1, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["contradictions"]
+
+
+def test_lock_graph_diff_static_cycle_is_not_a_contradiction(tmp_path):
+    """A cycle that exists entirely WITHIN the static graph is a
+    static cycle, never a cross-graph contradiction — even when the
+    runtime dump adds unrelated edges that change the union-cycle DFS
+    entry point (review regression: cycle identity must be by edge
+    membership, not node-list spelling)."""
+    _plant(tmp_path, "mxnet_tpu/locky.py",
+           "from ._debug.locktrace import named_lock\n"
+           "A = named_lock('s.a')\nB = named_lock('s.b')\n"
+           "def f():\n    with A:\n        with B:\n            pass\n"
+           "def g():\n    with B:\n        with A:\n            pass\n")
+    dump_path = tmp_path / "rt.json"
+    dump_path.write_text(json.dumps({"order_edges": ["s.0->s.b"]}))
+    r = _run_cli(["--lock-graph", "--runtime-dump", str(dump_path),
+                  str(tmp_path / "mxnet_tpu")], repo_root=tmp_path)
+    assert r.returncode == 1  # the static cycle still fails the run
+    rep = json.loads(r.stdout)
+    assert rep["static_cycles"] and rep["contradictions"] == []
+
+
+# -- CLI: --format=github, --jobs --------------------------------------------
+
+def test_github_format_annotations(tmp_path):
+    _plant(tmp_path, "mxnet_tpu/w.py",
+           "import jax\nfast = jax.jit(lambda x: x)\n")
+    r = _run_cli(["--format=github", "--rule", "MX005",
+                  str(tmp_path / "mxnet_tpu" / "w.py")],
+                 repo_root=tmp_path)
+    assert r.returncode == 1
+    assert "::error file=" in r.stdout and "MX005" in r.stdout
+
+
+def test_jobs_parallel_matches_serial():
+    """--jobs must not change results — identical findings and waiver
+    counts on a real subtree (via the CLI: forking inside the test
+    process would drag the loaded jax runtime across fork)."""
+    serial = _run_cli(["mxnet_tpu/io"])
+    par = _run_cli(["--jobs", "2", "mxnet_tpu/io"])
+    assert serial.returncode == par.returncode == 0, \
+        serial.stdout + par.stdout + serial.stderr + par.stderr
+    assert serial.stdout == par.stdout
+    assert serial.stderr == par.stderr  # same waived/baselined summary
+
+
 def test_baseline_suppresses_and_reports(tmp_path):
     target = tmp_path / "mxnet_tpu" / "b.py"
     target.parent.mkdir(parents=True, exist_ok=True)

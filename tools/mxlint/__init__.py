@@ -1,21 +1,32 @@
 """mxlint: framework-invariant static analysis for mxnet_tpu.
 
 The AST/text half of the enforcement pair (the runtime half is
-``mxnet_tpu/_debug/locktrace.py``): ~8 framework-specific rules that
-keep the PR 1-2 invariants — single dispatch choke point, guarded
-telemetry, locked shared state, API_BEGIN/API_END on the C ABI — true
-across future PRs the way the reference wires cpplint/pylint into ci/.
+``mxnet_tpu/_debug/locktrace.py``): 17 framework-specific rules. The
+lexical set (MX001-MX013) keeps the PR 1-2 invariants — single
+dispatch choke point, guarded telemetry, locked shared state,
+API_BEGIN/API_END on the C ABI — true across future PRs the way the
+reference wires cpplint/pylint into ci/; the whole-program set
+(MX014-MX017, ``dataflow.py`` over the ``project.py`` model) checks
+the *dataflow* bug classes recent PRs actually hit: traced code
+capturing ambient state outside the compile-signature token registry,
+env-contract drift between code and docs/ENV_VARS.md, use-after-
+donation, and lock-order cycles (``--lock-graph`` diffs the static
+digraph against a locktrace runtime dump).
 
     python -m tools.mxlint                 # lint mxnet_tpu src tests
     python -m tools.mxlint mxnet_tpu/io    # lint a subtree
     python -m tools.mxlint --rule MX003 .  # one rule
+    python -m tools.mxlint --jobs 4        # parallel per-file phase
+    python -m tools.mxlint --lock-graph --runtime-dump locks.json
 
-See docs/LINTING.md for the rule catalog, the waiver idiom, and the
-baseline workflow. tests/test_lint.py runs this over the tree in
-tier-1 and fails on any unwaived finding.
+See docs/LINTING.md for the rule catalog, the waiver idiom, the
+baseline workflow, and the dataflow-engine notes. tests/test_lint.py
+runs this over the tree in tier-1 and fails on any unwaived finding.
 """
-from .core import Finding, load_baseline, main, parse_waivers, run
+from .core import Finding, build_model, load_baseline, main, \
+    parse_waivers, run
+from .project import ProjectModel
 from .rules import ALL_RULES
 
 __all__ = ["Finding", "ALL_RULES", "run", "main", "parse_waivers",
-           "load_baseline"]
+           "load_baseline", "build_model", "ProjectModel"]

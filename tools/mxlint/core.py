@@ -29,8 +29,10 @@ import os
 import re
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+# MXLINT_REPO_ROOT: re-root the analysis (scope checks, doc/catalog
+# lookups) onto another tree — tooling/test hook, not needed in-repo
+REPO_ROOT = os.environ.get("MXLINT_REPO_ROOT") or os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "baseline.json")
 
@@ -110,63 +112,172 @@ def _rel(path):
     return os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
 
 
-def run(paths, rules=None, baseline=None):
+def _analyze_file(abspath, rel, rules_or_codes, want_facts):
+    """Per-file phase: ONE read + ONE parse shared by every per-file
+    rule and by the project-model fact extraction. Returns a picklable
+    record (``--jobs`` runs this in worker processes):
+
+        (rel, findings, waivers, file_waivers, bad, facts)
+
+    or None when the file is out of scope / unreadable.
+
+    ``rules_or_codes``: rule INSTANCES (serial path — custom rule
+    objects outside ALL_RULES run as-is) or a set of code strings
+    (parallel path — workers re-derive the instances from ALL_RULES,
+    which is why run() keeps custom per-file rules on the serial
+    path)."""
+    from .rules import ALL_RULES, _parents
+    from . import project as _project
+    if rules_or_codes is None or all(isinstance(r, str)
+                                     for r in rules_or_codes):
+        rules = [r for r in ALL_RULES
+                 if rules_or_codes is None or r.code in rules_or_codes]
+    else:
+        rules = list(rules_or_codes)
+    per_file = [r for r in rules if not getattr(r, "project", False)
+                and r.scope(rel)]
+    project_rules = [r for r in rules if getattr(r, "project", False)]
+    want_facts = want_facts and rel.endswith(".py") and \
+        any(r.scope(rel) for r in project_rules)
+    if not per_file and not want_facts:
+        return None
+    try:
+        with open(abspath, encoding="utf-8", errors="replace") as f:
+            src = f.read()
+    except OSError:
+        return None
+    waivers, file_waivers, bad = parse_waivers(src)
+    findings = []
+    tree = parents = facts = None
+    if rel.endswith(".py"):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            bad.append((e.lineno or 0, ["<parse:%s>" % e.msg]))
+            return (rel, findings, waivers, file_waivers, bad, None)
+        parents = _parents(tree)
+        if want_facts:
+            facts = _project.extract(rel, tree, parents=parents)
+    for rule in per_file:
+        if rule.kind == "python" and tree is None:
+            continue
+        if rule.kind == "cc" and rel.endswith(".py"):
+            continue
+        findings.extend(rule.check(rel, src, tree, parents))
+    return (rel, findings, waivers, file_waivers, bad, facts)
+
+
+def _analyze_parallel(files, rule_codes, want_facts, jobs):
+    import multiprocessing as mp
+    try:
+        ctx = mp.get_context("fork")
+    except ValueError:   # no fork (non-POSIX): stay serial
+        return [_analyze_file(ab, rel, rule_codes, want_facts)
+                for ab, rel in files]
+    chunk = max(1, len(files) // (jobs * 4) or 1)
+    with ctx.Pool(jobs) as pool:
+        return pool.starmap(
+            _analyze_file,
+            [(ab, rel, rule_codes, want_facts) for ab, rel in files],
+            chunksize=chunk)
+
+
+def run(paths, rules=None, baseline=None, jobs=1):
     """Lint ``paths`` (repo-relative or absolute files/dirs).
+
+    Two phases: a per-file phase (one parse per file, shared by every
+    lexical rule and the project-model extraction; ``jobs > 1``
+    parallelizes it across processes) and a project phase where the
+    dataflow rules (MX014/MX015/MX017) query the aggregated
+    :class:`project.ProjectModel`.
 
     Returns (unwaived findings, waived count, baselined count,
     bad-waiver findings)."""
     from .rules import ALL_RULES
-    from .rules import _parents
+    from . import project as _project
     rules = list(ALL_RULES if rules is None else rules)
+    rule_codes = {r.code for r in rules}
+    project_rules = [r for r in rules if getattr(r, "project", False)]
     if baseline is None:
         baseline = load_baseline()
     base_keys = {(b["code"], b["path"], b.get("line")) for b in baseline}
 
-    findings, bad_waivers = [], []
-    n_waived = n_baselined = 0
-    for abspath in _iter_files(paths):
-        rel = _rel(abspath)
-        active = [r for r in rules if r.scope(rel)]
-        if not active:
+    files = [(ab, _rel(ab)) for ab in _iter_files(paths)]
+    # workers rebuild rule instances from ALL_RULES by code — ANY
+    # custom rule object outside the registry (per-file OR project:
+    # project rules gate fact extraction via scope()) forces the
+    # serial path so results never differ between jobs settings
+    known = {id(r) for r in ALL_RULES}
+    all_known = all(id(r) in known for r in rules)
+    if jobs and jobs > 1 and len(files) > 1 and all_known:
+        results = _analyze_parallel(files, rule_codes,
+                                    bool(project_rules), jobs)
+    else:
+        results = [_analyze_file(ab, rel, rules,
+                                 bool(project_rules))
+                   for ab, rel in files]
+
+    findings, bad_waivers, facts = [], [], []
+    waiver_maps = {}  # rel -> (line waivers, file waivers)
+    for res in results:
+        if res is None:
             continue
-        try:
-            with open(abspath, encoding="utf-8", errors="replace") as f:
-                src = f.read()
-        except OSError:
-            continue
-        waivers, file_waivers, bad = parse_waivers(src)
+        rel, file_findings, waivers, file_waivers, bad, fact = res
+        waiver_maps[rel] = (waivers, file_waivers)
+        findings.extend(file_findings)
         for line, codes in bad:
-            bad_waivers.append(Finding(
-                "MX000", rel, line,
-                "waiver for %s has no justification — write "
-                "`# mxlint: disable=CODE (reason)`" % ",".join(codes)))
-        tree = parents = None
-        if rel.endswith(".py"):
-            try:
-                tree = ast.parse(src)
-            except SyntaxError as e:
+            if codes and codes[0].startswith("<parse:"):
                 bad_waivers.append(Finding(
-                    "MX000", rel, e.lineno or 0,
-                    "file does not parse: %s" % e.msg))
-                continue
-            parents = _parents(tree)
-        for rule in active:
-            if rule.kind == "python" and tree is None:
-                continue
-            if rule.kind == "cc" and rel.endswith(".py"):
-                continue
-            for fi in rule.check(rel, src, tree, parents):
-                lines = (fi.line,) + fi.extra_waiver_lines
-                if fi.code in file_waivers or \
-                        any(fi.code in waivers.get(l, ()) for l in lines):
-                    n_waived += 1
-                elif (fi.code, fi.path, fi.line) in base_keys or \
-                        (fi.code, fi.path, None) in base_keys:
-                    n_baselined += 1
-                else:
-                    findings.append(fi)
-    findings.sort(key=lambda f: (f.path, f.line, f.code))
-    return findings, n_waived, n_baselined, bad_waivers
+                    "MX000", rel, line, "file does not parse: %s"
+                    % codes[0][7:-1]))
+            else:
+                bad_waivers.append(Finding(
+                    "MX000", rel, line,
+                    "waiver for %s has no justification — write "
+                    "`# mxlint: disable=CODE (reason)`"
+                    % ",".join(codes)))
+        if fact is not None:
+            facts.append(fact)
+
+    if project_rules:
+        model = _project.ProjectModel(facts)
+        for rule in project_rules:
+            findings.extend(rule.check_project(model))
+
+    kept = []
+    n_waived = n_baselined = 0
+    for fi in findings:
+        waivers, file_waivers = waiver_maps.get(fi.path, ({}, set()))
+        lines = (fi.line,) + fi.extra_waiver_lines
+        if fi.code in file_waivers or \
+                any(fi.code in waivers.get(l, ()) for l in lines):
+            n_waived += 1
+        elif (fi.code, fi.path, fi.line) in base_keys or \
+                (fi.code, fi.path, None) in base_keys:
+            n_baselined += 1
+        else:
+            kept.append(fi)
+    kept.sort(key=lambda f: (f.path, f.line, f.code))
+    return kept, n_waived, n_baselined, bad_waivers
+
+
+def build_model(paths):
+    """Parse+extract a ProjectModel over ``paths`` (no rule checks) —
+    the ``--lock-graph`` entry point and a library hook for tools.
+    Always serial: extraction over a tree this size is sub-second."""
+    from . import project as _project
+    files = [(ab, _rel(ab)) for ab in _iter_files(paths)
+             if ab.endswith(".py")]
+    facts = []
+    for ab, rel in files:
+        try:
+            with open(ab, encoding="utf-8", errors="replace") as f:
+                src = f.read()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError):
+            continue
+        facts.append(_project.extract(rel, tree))
+    return _project.ProjectModel(facts)
 
 
 def load_baseline(path=BASELINE_PATH):
@@ -189,6 +300,44 @@ def write_baseline(findings, path=BASELINE_PATH):
         f.write("\n")
 
 
+def _emit(findings, fmt):
+    for f in findings:
+        if fmt == "github":
+            # GitHub Actions annotation syntax: shows inline on the PR
+            print("::error file=%s,line=%d,title=mxlint %s::%s"
+                  % (f.path, f.line, f.code, f.message))
+        else:
+            print("%s:%d: %s %s" % (f.path, f.line, f.code, f.message))
+
+
+def _lock_graph_main(args):
+    from . import dataflow as _dataflow
+    paths = args.paths or ["mxnet_tpu"]
+    model = build_model(paths)
+    dump = None
+    if args.runtime_dump:
+        with open(args.runtime_dump, encoding="utf-8") as f:
+            dump = json.load(f)
+        if "order_edges" not in dump and "locks" in dump and \
+                isinstance(dump["locks"], dict):
+            dump = dump["locks"]  # profiler.metrics() embedding
+    rep = _dataflow.lock_graph_report(model, runtime_dump=dump)
+    print(json.dumps(rep, indent=2, sort_keys=True))
+    bad = list(rep.get("static_cycles", ()))
+    bad += rep.get("runtime_cycles", ())
+    bad += rep.get("contradictions", ())
+    for c in bad:
+        print("lock-graph: CYCLE %s" % c, file=sys.stderr)
+    print("lock-graph: %d locks, %d static edges%s, %d cycle%s/"
+          "contradiction%s" % (
+              len(rep["locks"]), len(rep["static_edges"]),
+              ", %d runtime edges" % len(rep["runtime_edges"])
+              if "runtime_edges" in rep else "",
+              len(bad), "" if len(bad) == 1 else "s",
+              "" if len(bad) == 1 else "s"), file=sys.stderr)
+    return 1 if bad else 0
+
+
 def main(argv=None):
     import argparse
     from .rules import ALL_RULES
@@ -204,20 +353,41 @@ def main(argv=None):
                     help="restrict to specific rule codes (repeatable)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="record current findings as the new baseline")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parallel per-file analysis processes")
+    ap.add_argument("--format", choices=("text", "github"),
+                    default="text",
+                    help="finding output format (github = ::error "
+                         "workflow annotations)")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the static lexical lock-nesting "
+                         "digraph (JSON) instead of linting; non-zero "
+                         "exit on a cycle")
+    ap.add_argument("--runtime-dump", metavar="FILE", default=None,
+                    help="with --lock-graph: diff the static graph "
+                         "against a locktrace.report() JSON dump "
+                         "(cycles + ordering contradictions fail)")
     args = ap.parse_args(argv)
+
+    if args.lock_graph:
+        # the default lint roots include tests/; the lock-order
+        # contract is scoped to the framework tree
+        if args.paths == ["mxnet_tpu", "src", "tests"]:
+            args.paths = ["mxnet_tpu"]
+        return _lock_graph_main(args)
 
     rules = None
     if args.rule:
         rules = [r for r in ALL_RULES if r.code in set(args.rule)]
-    findings, n_waived, n_baselined, bad = run(args.paths, rules=rules)
+    findings, n_waived, n_baselined, bad = run(
+        args.paths, rules=rules, jobs=args.jobs)
 
     if args.write_baseline:
         write_baseline(findings)
         print("baseline: recorded %d findings" % len(findings))
         return 0
 
-    for f in findings + bad:
-        print("%s:%d: %s %s" % (f.path, f.line, f.code, f.message))
+    _emit(findings + bad, args.format)
     summary = "mxlint: %d finding%s (%d waived, %d baselined)" % (
         len(findings), "" if len(findings) == 1 else "s", n_waived,
         n_baselined)

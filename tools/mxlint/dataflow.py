@@ -1,0 +1,682 @@
+"""mxlint dataflow rules (MX014-MX017): whole-program analyses over the
+project model.
+
+Where the PR 3 rules check what a LINE looks like, these check what the
+PROGRAM does: reachability from trace entry points (MX014), the env-var
+contract across code + docs + the signature-token registry (MX015),
+buffer liveness across donating calls (MX016), and the global lexical
+lock-nesting digraph (MX017). MX014/MX015/MX017 are *project* rules —
+``core.run`` hands them the aggregated :class:`project.ProjectModel`
+instead of per-file ASTs; MX016 is intraprocedural, so it stays a
+per-file rule (sharing the one parse) with a cached cross-file table of
+donating ops. See docs/LINTING.md for the catalog entries.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding
+from . import project as _project
+
+
+# ---------------------------------------------------------------------------
+# MX014 — traced-ambient-state capture
+# ---------------------------------------------------------------------------
+
+# Telemetry modules: clock reads there are trace-emission TIMESTAMPS
+# (span metadata recorded on the host), never values that flow into a
+# traced graph — MX007 already polices their clock discipline
+# (monotonic-only). The env-read clause still applies to them.
+_TELEMETRY_MODULES = (
+    "mxnet_tpu/profiler.py",
+    "mxnet_tpu/_debug/",
+    "mxnet_tpu/pallas_kernels/_compile_attr.py",  # compile attribution
+)
+
+# (module path -> builder functions whose nested closures are traced)
+_TRACE_HOSTS = {
+    "mxnet_tpu/gluon/fused_step.py": ("_build", "_packed_apply_fn"),
+    "mxnet_tpu/gluon/block.py": ("make_pure_forward",),
+    "mxnet_tpu/ndarray/register.py": ("_build_traced", "_flush_impl"),
+}
+
+
+class MX014TracedAmbientState:
+    """Functions reachable from a trace entry point (``register.invoke``
+    op bodies, fused-step loss/step closures, Pallas kernels, optimizer
+    ``step_fn``s, bulk-segment flushes) execute INSIDE a jitted program:
+    whatever ambient state they read — ``os.environ``, env-derived
+    module globals, wall/monotonic clocks, host RNG — is baked into the
+    cached executable at trace time and silently replayed on every
+    later hit. That is the bug class PR 9's review pass caught by hand
+    (kernel-routing envs missing from the dispatch key); the static
+    contract is: an env var read on a traced path must be registered in
+    the compile-signature token registry
+    (``register.register_signature_token``), and clocks/host-RNG must
+    not appear at all (thread them in as operands)."""
+
+    code = "MX014"
+    summary = "traced code reads ambient state outside the token registry"
+    kind = "python"
+    project = True
+
+    def scope(self, path):
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    # -- entry points --------------------------------------------------
+
+    def _is_op_body(self, mf, fn):
+        for dn, _ln in fn.decorators:
+            leaf = dn.split(".")[-1]
+            if leaf != "register":
+                continue
+            if "." in dn:
+                root = dn.split(".")[0]
+                target = mf.imports.get(root, root)
+                if target.endswith("registry") or target.endswith("ops"):
+                    return True
+            else:
+                target = mf.imports.get(dn, "")
+                if target.endswith("registry.register"):
+                    return True
+        return False
+
+    def entries(self, model):
+        keys = []
+        for key, fn in model.functions.items():
+            path, qual = key
+            if not path.startswith("mxnet_tpu/"):
+                continue
+            mf = model.modules[path]
+            if self._is_op_body(mf, fn):
+                keys.append(key)
+                continue
+            if path.startswith("mxnet_tpu/pallas_kernels/"):
+                name = path.rsplit("/", 1)[-1]
+                if name != "__init__.py" and not name.startswith("_") \
+                        and qual != "<module>":
+                    keys.append(key)
+                    continue
+            last = qual.split(".")[-1]
+            if last in ("step_fn", "step_fn_multi_precision") \
+                    and "<locals>" not in qual:
+                keys.append(key)
+                continue
+            hosts = _TRACE_HOSTS.get(path, ())
+            for host in hosts:
+                if (".%s.<locals>." % host) in qual \
+                        or qual.startswith("%s.<locals>." % host):
+                    keys.append(key)
+                    break
+        return keys
+
+    # -- the check -----------------------------------------------------
+
+    def check_project(self, model):
+        tokens = set(model.signature_tokens())
+        out = []
+        for key in sorted(model.reachable(self.entries(model))):
+            path, qual = key
+            if not path.startswith("mxnet_tpu/") \
+                    or path == "mxnet_tpu/base.py":
+                # the getenv choke point itself: its internal
+                # os.environ read is attributed to each CALLER (the
+                # extractor records getenv() call sites as env reads)
+                continue
+            fn = model.functions[key]
+            mf = model.modules[path]
+            for kind, name, ln, family in fn.env_reads:
+                label = name if isinstance(name, str) else (
+                    family if family else "<computed>")
+                if isinstance(name, str) and name in tokens:
+                    continue
+                out.append(Finding(
+                    self.code, path, ln,
+                    "env read of %r inside traced code (reachable from "
+                    "a trace entry via %s) — the value is baked into "
+                    "the cached executable; register it with "
+                    "register.register_signature_token so flipping it "
+                    "recompiles, or hoist the read out of the traced "
+                    "path" % (label, qual)))
+            if not any(path.startswith(t) for t in _TELEMETRY_MODULES):
+                # the telemetry exemption covers ONLY this clause:
+                # clocks/RNG there are span metadata, but env-derived
+                # globals and env reads stay checked everywhere
+                for akind, dn, ln in fn.ambient:
+                    what = "clock" if akind == "clock" else "host RNG"
+                    out.append(Finding(
+                        self.code, path, ln,
+                        "%s read (%s) inside traced code (reachable "
+                        "from a trace entry via %s) — traces bake the "
+                        "value at compile time and replay it forever; "
+                        "thread it in as an operand (clocks) or use "
+                        "the framework key plumbing (RNG)"
+                        % (what, dn, qual)))
+            for ref, ln in fn.refs:
+                if "." in ref:
+                    alias, attr = ref.split(".", 1)
+                    target = model.by_name.get(
+                        mf.imports.get(alias, ""))
+                    if target and attr in target.env_globals and \
+                            target.env_globals[attr] not in tokens:
+                        out.append(self._global_finding(
+                            path, ln, ref, target.env_globals[attr],
+                            qual))
+                elif ref in mf.env_globals and \
+                        mf.env_globals[ref] not in tokens:
+                    out.append(self._global_finding(
+                        path, ln, ref, mf.env_globals[ref], qual))
+        return out
+
+    def _global_finding(self, path, ln, ref, env, qual):
+        return Finding(
+            self.code, path, ln,
+            "read of env-derived global %r (from %s) inside traced "
+            "code (reachable via %s) — same stale-replay hazard as a "
+            "direct env read; register %s as a signature token or "
+            "thread the value as an operand" % (ref, env, qual, env))
+
+
+# ---------------------------------------------------------------------------
+# MX015 — env-var contract sync
+# ---------------------------------------------------------------------------
+
+_DOC_NAME_RE = re.compile(r"`([A-Z][A-Z0-9_]{2,})`")
+
+
+class MX015EnvContract:
+    """Every env read in ``mxnet_tpu/`` goes through the ``base.getenv``
+    choke point (computed names through ``getenv_dynamic(family=...)``),
+    and every name read is documented in docs/ENV_VARS.md. Helper
+    wrappers that take the name as a parameter are resolved ONE level
+    through the call graph (the watchdog/flightrec ``_env_float(name)``
+    idiom), so the contract follows the dataflow, not the spelling.
+    Registered signature tokens must be documented too."""
+
+    code = "MX015"
+    summary = "env read bypasses base.getenv or is undocumented"
+    kind = "python"
+    project = True
+
+    def scope(self, path):
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    _doc_cache = None  # (repo_root, frozenset | None)
+
+    def _documented(self):
+        from . import core
+        cached = self._doc_cache
+        if cached is not None and cached[0] == core.REPO_ROOT:
+            return cached[1]
+        doc_path = os.path.join(core.REPO_ROOT, "docs", "ENV_VARS.md")
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                names = frozenset(_DOC_NAME_RE.findall(f.read()))
+        except OSError:
+            names = None  # no contract file: skip the doc clause
+        self._doc_cache = (core.REPO_ROOT, names)
+        return names
+
+    def check_project(self, model):
+        docs = self._documented()
+        out = []
+
+        def check_doc(name, path, ln, how):
+            if docs is not None and name not in docs:
+                out.append(Finding(
+                    self.code, path, ln,
+                    "env var %r is read in code (%s) but missing from "
+                    "docs/ENV_VARS.md — document it (default + "
+                    "consumer) or remove the read" % (name, how)))
+
+        for mf in sorted(model.modules.values(), key=lambda m: m.path):
+            if not mf.path.startswith("mxnet_tpu/") \
+                    or mf.path == "mxnet_tpu/base.py":
+                continue
+            for qual in sorted(mf.functions):
+                fn = mf.functions[qual]
+                for kind, name, ln, family in fn.env_reads:
+                    if kind == _project.READ_DIRECT:
+                        out.append(Finding(
+                            self.code, mf.path, ln,
+                            "direct os.environ/os.getenv read — route "
+                            "through the base.getenv choke point "
+                            "(base.getenv_dynamic for computed names) "
+                            "so the env contract stays analyzable"))
+                    elif kind == _project.READ_DYNAMIC:
+                        if family is None:
+                            out.append(Finding(
+                                self.code, mf.path, ln,
+                                "getenv_dynamic without a literal "
+                                "family= — the computed name must "
+                                "declare the documented ENV_VARS.md "
+                                "row it derives from"))
+                        else:
+                            check_doc(family, mf.path, ln,
+                                      "dynamic family")
+                    else:  # READ_GETENV
+                        if isinstance(name, str):
+                            check_doc(name, mf.path, ln, "getenv")
+                        elif isinstance(name, tuple):
+                            self._resolve_param(
+                                model, mf, fn, name[1], ln, check_doc,
+                                out)
+                        else:
+                            out.append(Finding(
+                                self.code, mf.path, ln,
+                                "base.getenv with a computed name — "
+                                "use getenv_dynamic(family=...) and "
+                                "document the family"))
+        for name, (path, ln) in sorted(
+                model.signature_tokens().items()):
+            check_doc(name, path, ln, "signature token")
+        return out
+
+    def _resolve_param(self, model, mf, fn, param, ln, check_doc, out):
+        """getenv(name) where name is a parameter of the enclosing
+        helper: resolve the literal one level up through every caller."""
+        shift = 1 if fn.params and fn.params[0] in ("self", "cls") else 0
+        try:
+            idx = fn.params.index(param) - shift
+        except ValueError:
+            idx = None
+        callers = model.callers_of((mf.path, fn.qualname))
+        if not callers:
+            default = fn.param_defaults.get(param)
+            if isinstance(default, str):
+                check_doc(default, mf.path, ln, "helper default")
+            else:
+                out.append(Finding(
+                    self.code, mf.path, ln,
+                    "getenv(%s) takes its name from parameter %r with "
+                    "no resolvable caller — pass a literal, or use "
+                    "getenv_dynamic(family=...)" % (param, param)))
+            return
+        for (cpath, _cqual), (dn, cln, args_lits, kw_lits) in callers:
+            lit = None
+            if param in kw_lits:
+                lit = kw_lits[param]
+            elif idx is not None and 0 <= idx < len(args_lits):
+                lit = args_lits[idx]
+            elif isinstance(fn.param_defaults.get(param), str):
+                lit = fn.param_defaults[param]
+            if isinstance(lit, str):
+                check_doc(lit, cpath, cln,
+                          "via helper %s" % fn.qualname)
+            else:
+                out.append(Finding(
+                    self.code, cpath, cln,
+                    "%s() forwards a computed env name to getenv — "
+                    "the contract checker cannot resolve it; pass a "
+                    "literal or use getenv_dynamic(family=...)" % dn))
+
+
+# ---------------------------------------------------------------------------
+# MX016 — use-after-donation
+# ---------------------------------------------------------------------------
+
+class MX016UseAfterDonation:
+    """Intraprocedural liveness across donating calls. Two donation
+    sources: (a) registry ops with ``inplace=`` positions (the
+    ``*_update`` optimizer family — the NDArray wrapper re-adopts the
+    state arg itself, so only PRE-call aliases of it — ``x``,
+    ``x.copy()``, ``x.detach()``, all O(1) buffer shares — go stale),
+    and (b) local ``jax.jit(..., donate_argnums=...)`` programs (raw
+    arrays: the args THEMSELVES go stale). Reading a stale binding
+    after the call is a silent no-op on the CPU tier-1 suite but a
+    runtime crash on TPU — only static analysis can gate it here. A
+    reassignment or an ``_adopt_fused(...)`` re-adoption clears the
+    binding; snapshot with ``.asnumpy()`` BEFORE the call if you need
+    pre-update values."""
+
+    code = "MX016"
+    summary = "read of a donated buffer binding after the donating call"
+    kind = "python"
+
+    def scope(self, path):
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    # -- the donating-op table (one parse per run, like MX013) ---------
+
+    _table_cache = None  # (repo_root, {op name: (positions,)})
+
+    def _table(self):
+        from . import core
+        cached = self._table_cache
+        if cached is not None and cached[0] == core.REPO_ROOT:
+            return cached[1]
+        table = {}
+        ops_dir = os.path.join(core.REPO_ROOT, "mxnet_tpu", "ops")
+        try:
+            names = sorted(os.listdir(ops_dir))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(ops_dir, name),
+                          encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    dfn = dec.func
+                    leaf = dfn.id if isinstance(dfn, ast.Name) else (
+                        dfn.attr if isinstance(dfn, ast.Attribute)
+                        else "")
+                    if leaf != "register":
+                        continue
+                    opname = None
+                    if dec.args and isinstance(dec.args[0],
+                                               ast.Constant):
+                        opname = dec.args[0].value
+                    pos = None
+                    for kw in dec.keywords:
+                        if kw.arg == "inplace" and isinstance(
+                                kw.value, (ast.Tuple, ast.List)):
+                            pos = tuple(
+                                e.value for e in kw.value.elts
+                                if isinstance(e, ast.Constant))
+                    if opname and pos:
+                        table[str(opname)] = pos
+        self._table_cache = (core.REPO_ROOT, table)
+        return table
+
+    # -- per-function linear simulation --------------------------------
+
+    def check(self, path, src, tree, parents):
+        table = self._table()
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_fn(path, node, table))
+        return out
+
+    @staticmethod
+    def _pos(n):
+        return (n.lineno, n.col_offset)
+
+    @staticmethod
+    def _end(n):
+        return (getattr(n, "end_lineno", n.lineno),
+                getattr(n, "end_col_offset", n.col_offset))
+
+    def _check_fn(self, path, fnnode, table):
+        jit_donors = {}   # local name -> donated positions
+        events = []       # (pos, kind, payload)
+
+        own_body = [n for n in ast.walk(fnnode)
+                    if not isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                    or n is fnnode]
+        # exclude nodes belonging to NESTED defs (their dataflow is
+        # their own; closures over donated names are beyond this rule)
+        nested = [n for n in ast.walk(fnnode)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+                  and n is not fnnode]
+        skip = set()
+        for nd in nested:
+            for sub in ast.walk(nd):
+                skip.add(id(sub))
+        for n in own_body:
+            if id(n) in skip:
+                continue
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                tname = n.targets[0].id
+                v = n.value
+                root = None
+                if isinstance(v, ast.Name):
+                    root = v.id
+                elif isinstance(v, ast.Call) and \
+                        isinstance(v.func, ast.Attribute) and \
+                        v.func.attr in ("copy", "detach") and \
+                        isinstance(v.func.value, ast.Name):
+                    root = v.func.value.id
+                donate = self._jit_donate(v)
+                if donate is not None:
+                    jit_donors[tname] = donate
+                # anchored at the statement END so the RHS's own reads
+                # are processed first: `w = w.copy()` after a donation
+                # must flag the read of `w` before clearing the binding
+                events.append((self._end(n), "assign", (tname, root)))
+            elif isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], (ast.Tuple, ast.List)):
+                # tuple-unpack rebind — `w, s = jfn(w, s)` — clears
+                # each Name target (the documented clean idiom)
+                for t in n.targets[0].elts:
+                    if isinstance(t, ast.Name):
+                        events.append((self._end(n), "assign",
+                                       (t.id, None)))
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.target, ast.Name):
+                # `w += 1` READS w (Store ctx on the node, but the
+                # operation loads the old buffer first)
+                events.append((self._pos(n), "read", n.target.id))
+            elif isinstance(n, ast.Call):
+                rec = self._donating_call(n, table, jit_donors)
+                if rec is not None:
+                    events.append((self._end(n), "donate", rec))
+                if isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "_adopt_fused":
+                    names = [a.id for a in n.args
+                             if isinstance(a, ast.Name)]
+                    if isinstance(n.func.value, ast.Name):
+                        names.append(n.func.value.id)
+                    # anchored at the CALL start so the re-adoption
+                    # clears the binding before its own arg reads
+                    events.append((self._pos(n), "adopt", names))
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                events.append((self._pos(n), "read", n.id))
+
+        # at one position: reads first (RHS before its own assign),
+        # adopt clears before its arg reads would flag, donate before
+        # an enclosing assign (so `w = jfn(w)` poisons w, then the
+        # rebind immediately clears it)
+        events.sort(key=lambda e: (e[0], {"read": 0, "adopt": 1,
+                                          "donate": 2,
+                                          "assign": 3}[e[1]]))
+        aliases = {}
+        poisoned = {}  # name -> (donor description, donate lineno)
+        out = []
+        for pos, kind, payload in events:
+            if kind == "assign":
+                tname, root = payload
+                poisoned.pop(tname, None)
+                if root is not None:
+                    aliases[tname] = aliases.get(root, root)
+                else:
+                    aliases.pop(tname, None)
+            elif kind == "adopt":
+                for nm in payload:
+                    poisoned.pop(nm, None)
+            elif kind == "donate":
+                desc, arg_names, rebinds = payload
+                roots = set(arg_names)
+                stale = set()
+                for al, rt in aliases.items():
+                    if rt in roots or al in roots:
+                        stale.add(al)
+                if not rebinds:
+                    stale.update(roots)
+                else:
+                    # the wrapper re-adopts the args themselves; only
+                    # pre-call buffer shares stay stale
+                    stale.difference_update(arg_names)
+                for nm in stale:
+                    poisoned.setdefault(nm, (desc, pos[0]))
+            elif kind == "read" and payload in poisoned:
+                desc, dln = poisoned[payload]
+                out.append(Finding(
+                    self.code, path, pos[0],
+                    "%r aliases a buffer donated at line %d (%s) — "
+                    "reading it is a stale-buffer crash on TPU (and a "
+                    "silent wrong answer under interpret); re-adopt "
+                    "via _adopt_fused, reassign, or snapshot with "
+                    ".asnumpy() BEFORE the donating call"
+                    % (payload, dln, desc)))
+                del poisoned[payload]  # one finding per binding
+        return out
+
+    @staticmethod
+    def _jit_donate(v):
+        """donate_argnums tuple for `jax.jit(f, donate_argnums=...)`
+        (any alias spelled `*.jit`), else None."""
+        if not (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "jit"):
+            return None
+        for kw in v.keywords:
+            if kw.arg == "donate_argnums":
+                val = kw.value
+                if isinstance(val, (ast.Tuple, ast.List)):
+                    return tuple(e.value for e in val.elts
+                                 if isinstance(e, ast.Constant))
+                if isinstance(val, ast.Constant):
+                    return (val.value,)
+        return None
+
+    def _donating_call(self, n, table, jit_donors):
+        """(description, [donated arg Names], rebinds) or None."""
+        f = n.func
+        positions = None
+        rebinds = True
+        desc = None
+        if isinstance(f, ast.Attribute) and f.attr in table:
+            positions, desc = table[f.attr], "%s, inplace args" % f.attr
+        elif isinstance(f, ast.Name):
+            if f.id in table:
+                positions, desc = table[f.id], \
+                    "%s, inplace args" % f.id
+            elif f.id in jit_donors:
+                positions, desc, rebinds = jit_donors[f.id], \
+                    "jitted program %s, donate_argnums" % f.id, False
+        if positions is None:
+            return None
+        if any(isinstance(a, ast.Starred) for a in n.args):
+            return None  # *operands calls: positions unknowable
+        names = []
+        for i in positions:
+            if isinstance(i, int) and i < len(n.args) and \
+                    isinstance(n.args[i], ast.Name):
+                names.append(n.args[i].id)
+        if not names:
+            return None
+        return (desc, names, rebinds)
+
+
+# ---------------------------------------------------------------------------
+# MX017 — static lock-order graph
+# ---------------------------------------------------------------------------
+
+class MX017StaticLockOrder:
+    """The lexical ``with <named_lock>:`` nesting graph across
+    ``mxnet_tpu/`` must be acyclic: an edge pair A->B / B->A is the
+    same lock-order inversion the runtime detector
+    (``_debug/locktrace.py``, MXNET_DEBUG_LOCKS=1) reports from real
+    interleavings — this is the static half of the PR 3 enforcement
+    pair, and ``tools/mxlint --lock-graph`` cross-checks the two
+    (zero contradictions on a clean tree)."""
+
+    code = "MX017"
+    summary = "cycle in the lexical named-lock nesting graph"
+    kind = "python"
+    project = True
+
+    def scope(self, path):
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    def check_project(self, model):
+        edges = model.lock_graph(
+            lambda p: p.startswith("mxnet_tpu/"))
+        out = []
+        for cyc in _project.find_cycles(edges):
+            pair_sites = []
+            for a, b in zip(cyc, cyc[1:]):
+                pair_sites.extend(edges.get((a, b), []))
+            site = sorted(pair_sites)[0] if pair_sites else ("", 0)
+            out.append(Finding(
+                self.code, site[0], site[1],
+                "lock-order cycle %s in the lexical with-nesting "
+                "graph — two threads interleaving these paths can "
+                "deadlock; impose one global order (see "
+                "docs/LINTING.md, `--lock-graph` prints the digraph; "
+                "other edges of this cycle: %s)"
+                % (" -> ".join(cyc),
+                   ", ".join("%s:%d" % s for s in sorted(pair_sites)))))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# --lock-graph: static graph dump + runtime-trace diff
+# ---------------------------------------------------------------------------
+
+def lock_graph_report(model, runtime_dump=None):
+    """Build the --lock-graph report dict.
+
+    ``runtime_dump`` is a ``locktrace.report()`` JSON payload (or the
+    ``profiler.metrics()['locks']`` embedding): ``order_edges`` as
+    ``"a->b"`` strings. Contradictions = a pair ordered one way
+    statically and the other way at runtime (i.e. any cycle in the
+    UNION graph that neither graph has alone); static-only /
+    runtime-only edges are coverage info, not errors — lexical nesting
+    cannot see cross-function acquisition chains, and a runtime trace
+    only covers the paths the suite drove."""
+    in_scope = lambda p: p.startswith("mxnet_tpu/")  # noqa: E731
+    static_edges = model.lock_graph(in_scope)
+    static_set = set(static_edges)
+    report = {
+        "locks": sorted(model.lock_nodes(in_scope)
+                        | {n for e in static_set for n in e}),
+        "static_edges": sorted("%s->%s" % e for e in static_set),
+        "static_sites": {"%s->%s" % e: ["%s:%d" % s for s in sites]
+                         for e, sites in sorted(static_edges.items())},
+        "static_cycles": [" -> ".join(c)
+                          for c in _project.find_cycles(static_set)],
+    }
+    if runtime_dump is not None:
+        rt = set()
+        for e in runtime_dump.get("order_edges", ()):
+            a, _, b = e.partition("->")
+            if a and b:
+                rt.add((a, b))
+        rt_cycles = _project.find_cycles(rt)
+        # a union cycle lying entirely inside ONE graph is that graph's
+        # own cycle (reported above/below); only a cycle that NEEDS
+        # edges from both graphs is a cross-graph ordering
+        # contradiction — classification by edge membership, so cycle
+        # rotation/entry-point never misclassifies
+        contradictions = []
+        for c in _project.find_cycles(static_set | rt):
+            cyc_edges = set(zip(c, c[1:]))
+            if not cyc_edges <= static_set and not cyc_edges <= rt:
+                contradictions.append(c)
+        report.update({
+            "runtime_edges": sorted("%s->%s" % e for e in rt),
+            "runtime_cycles": [" -> ".join(c) for c in rt_cycles],
+            "static_only": sorted("%s->%s" % e
+                                  for e in static_set - rt),
+            "runtime_only": sorted("%s->%s" % e
+                                   for e in rt - static_set),
+            "contradictions": [" -> ".join(c)
+                               for c in contradictions],
+        })
+    return report
+
+
+DATAFLOW_RULES = (
+    MX014TracedAmbientState(),
+    MX015EnvContract(),
+    MX016UseAfterDonation(),
+    MX017StaticLockOrder(),
+)

@@ -1,0 +1,630 @@
+"""mxlint project model: whole-program facts for the dataflow rules.
+
+PR 3's rules are per-line AST passes; the MX014-MX017 bug classes
+(traced ambient state, env-contract drift, use-after-donation,
+lock-order cycles) are *dataflow* properties that no single line
+reveals. This module is the shared analysis substrate: each Python file
+is parsed ONCE (the same parse the lexical rules consume), one AST walk
+extracts a compact, picklable :class:`ModuleFacts` record — imports,
+function symbol tables, an approximate call graph, env-var reads,
+ambient-state reads (clocks / host RNG), named-lock bindings and their
+lexical ``with`` nesting — and :class:`ProjectModel` aggregates the
+records into the cross-file indexes the rules query:
+
+* ``resolve(mf, dotted)`` — best-effort callee resolution through the
+  import graph (module-level functions, ``mod.fn`` attribute calls,
+  ``from x import fn`` aliases, same-class ``self.fn`` methods),
+* ``reachable(entries)`` — BFS over calls *and* bare function
+  references (a traced closure usually receives its callees as
+  values, not calls),
+* ``callers_of(key)`` — the reverse graph, used by MX015 to resolve
+  env-var names one level through helper functions like
+  ``watchdog._env_float(name, ...)``,
+* ``lock_graph()`` — the global lexical lock-nesting digraph MX017
+  checks for cycles and ``--lock-graph`` diffs against a locktrace
+  runtime dump.
+
+Facts are plain tuples/dicts so ``--jobs N`` can extract them in
+worker processes and merge in the parent; the ASTs never cross the
+process boundary.
+"""
+from __future__ import annotations
+
+import ast
+
+# env-read kinds recorded by the extractor
+READ_DIRECT = "environ"      # os.environ / os.getenv, any spelling
+READ_GETENV = "getenv"       # base.getenv(...)
+READ_DYNAMIC = "dynamic"     # base.getenv_dynamic(..., family=...)
+
+CLOCK_FNS = frozenset(("time", "monotonic", "perf_counter", "now",
+                       "time_ns", "monotonic_ns", "perf_counter_ns"))
+RNG_MODULES = ("random", "numpy.random")
+
+
+class FunctionFacts:
+    __slots__ = ("qualname", "lineno", "params", "param_defaults",
+                 "calls", "refs", "env_reads", "ambient", "decorators")
+
+    def __init__(self, qualname, lineno, params, param_defaults):
+        self.qualname = qualname
+        self.lineno = lineno
+        self.params = tuple(params)          # positional params, in order
+        self.param_defaults = param_defaults  # {param: literal str | None}
+        # (dotted callee, lineno, positional literal-str args (None for
+        #  non-literals), {kw: literal str}) — the approximate call graph
+        self.calls = []
+        self.refs = []          # (dotted name referenced, lineno)
+        # (kind, name-or-(param,..)-or-None, lineno, family-or-None)
+        self.env_reads = []
+        self.ambient = []       # ("clock"|"rng", dotted, lineno)
+        self.decorators = []    # (dotted, lineno)
+
+
+class ModuleFacts:
+    __slots__ = ("path", "module", "package", "imports", "functions",
+                 "consts", "env_globals", "lock_names", "lock_edges",
+                 "sig_tokens", "classes")
+
+    def __init__(self, path, module, package):
+        self.path = path            # repo-relative, forward slashes
+        self.module = module        # dotted module name
+        self.package = package      # dotted package (for relative imports)
+        self.imports = {}           # alias -> absolute dotted target
+        self.functions = {}         # qualname -> FunctionFacts
+        self.consts = {}            # module-level NAME -> str literal
+        self.env_globals = {}       # module global -> env var it derives from
+        self.lock_names = {}        # "VAR" or ".attr" -> lock name literal
+        self.lock_edges = []        # (outer name, inner name, lineno)
+        self.sig_tokens = []        # (env name, lineno) registered as tokens
+        self.classes = {}           # class name -> [method qualnames]
+
+
+def module_name_of(path):
+    """Repo-relative path -> dotted module name."""
+    mod = path[:-3] if path.endswith(".py") else path
+    parts = mod.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _dotted(node):
+    """Best-effort dotted name of an expression: Name/Attribute chains
+    ('a.b.c'), with 'self.x' kept literally. None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _lit_str(node):
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, str) else None
+
+
+class _Extractor(ast.NodeVisitor):
+    """One walk per file; fills a ModuleFacts."""
+
+    def __init__(self, mf):
+        self.mf = mf
+        self._stack = []        # enclosing FunctionFacts qualname parts
+        self._class = []        # enclosing class names
+        self._fn = None         # innermost FunctionFacts (or None)
+        self._fn_stack = []
+        self._with_locks = []   # lexical stack of held lock names
+        self._os_aliases = {"os"}
+
+    # -- plumbing ------------------------------------------------------
+
+    def _module_fn(self):
+        """Facts bucket for module-level statements."""
+        mf = self.mf
+        top = mf.functions.get("<module>")
+        if top is None:
+            top = mf.functions["<module>"] = FunctionFacts(
+                "<module>", 0, (), {})
+        return top
+
+    def _cur(self):
+        return self._fn if self._fn is not None else self._module_fn()
+
+    # -- imports -------------------------------------------------------
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.mf.imports[a.asname or a.name.split(".")[0]] = a.name
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        base = node.module or ""
+        if node.level:
+            pkg = self.mf.package.split(".") if self.mf.package else []
+            up = node.level - 1
+            pkg = pkg[:len(pkg) - up] if up else pkg
+            base = ".".join(pkg + ([node.module] if node.module else []))
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.mf.imports[a.asname or a.name] = \
+                ("%s.%s" % (base, a.name)) if base else a.name
+        self.generic_visit(node)
+
+    # -- defs ----------------------------------------------------------
+
+    def _qual(self, name):
+        parts = []
+        for kind, n in self._stack:
+            parts.append(n)
+            if kind == "fn":
+                parts.append("<locals>")
+        parts.append(name)
+        return ".".join(parts)
+
+    def visit_ClassDef(self, node):
+        self._stack.append(("class", node.name))
+        self._class.append(node.name)
+        self.mf.classes.setdefault(node.name, [])
+        self.generic_visit(node)
+        self._class.pop()
+        self._stack.pop()
+
+    def _visit_fn(self, node):
+        qual = self._qual(node.name)
+        a = node.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        defaults = {}
+        pos_defaults = a.defaults
+        if pos_defaults:
+            for p, d in zip(params[-len(pos_defaults):], pos_defaults):
+                defaults[p] = _lit_str(d)
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            defaults[p.arg] = _lit_str(d) if d is not None else None
+        fn = FunctionFacts(qual, node.lineno, params, defaults)
+        for dec in node.decorator_list:
+            d = dec.func if isinstance(dec, ast.Call) else dec
+            dn = _dotted(d)
+            if dn:
+                fn.decorators.append((dn, dec.lineno))
+        self.mf.functions[qual] = fn
+        if self._class:
+            self.mf.classes.setdefault(self._class[-1], []).append(qual)
+        # decorators execute at DEF time in the enclosing scope — visit
+        # them there, not as part of the function body (a kernel's
+        # @attributed(...) must not become a call edge from the kernel)
+        decs = node.decorator_list
+        for dec in decs:
+            self.visit(dec)
+        node.decorator_list = []
+        self._stack.append(("fn", node.name))
+        self._fn_stack.append(self._fn)
+        self._fn = fn
+        outer_locks = self._with_locks
+        self._with_locks = []  # lexical nesting does not cross a def
+        self.generic_visit(node)
+        self._with_locks = outer_locks
+        self._fn = self._fn_stack.pop()
+        self._stack.pop()
+        node.decorator_list = decs
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- assignments (consts, env globals, lock bindings) --------------
+
+    def _lock_name_of_call(self, call):
+        """'x' for named_lock('x')/named_condition('x') calls, else
+        None. Resolution of the callee is lexical: any callee whose
+        final attribute is named_lock/named_condition counts."""
+        if not isinstance(call, ast.Call):
+            return None
+        dn = _dotted(call.func)
+        if dn and dn.split(".")[-1] in ("named_lock", "named_condition"):
+            return _lit_str(call.args[0]) if call.args else None
+        return None
+
+    def visit_Assign(self, node):
+        if len(node.targets) == 1:
+            t = node.targets[0]
+            v = node.value
+            lock = self._lock_name_of_call(v)
+            if isinstance(t, ast.Name):
+                if self._fn is None and not self._class:
+                    s = _lit_str(v)
+                    if s is not None:
+                        self.mf.consts[t.id] = s
+                    if self._reads_env(v):
+                        name = self._env_name_in(v)
+                        if name:
+                            self.mf.env_globals[t.id] = name
+                if lock:
+                    self.mf.lock_names[t.id] = lock
+            elif isinstance(t, ast.Attribute) and lock and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                # class-qualified first (two classes in one module may
+                # both use `self._lock`); bare-attr entry is the
+                # first-wins fallback for cross-class helper methods
+                if self._class:
+                    self.mf.lock_names[
+                        "%s.%s" % (self._class[-1], t.attr)] = lock
+                self.mf.lock_names.setdefault("." + t.attr, lock)
+        self.generic_visit(node)
+
+    def _reads_env(self, node):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                dn = _dotted(n.func)
+                if dn and (dn.endswith(".environ.get")
+                           or dn.endswith("os.getenv")
+                           or dn.split(".")[-1] in ("_getenv", "getenv")):
+                    return True
+        return False
+
+    def _env_name_in(self, node):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and n.args:
+                s = _lit_str(n.args[0])
+                if s is not None and self._reads_env(n):
+                    return s
+        return None
+
+    # -- with (lock nesting) -------------------------------------------
+
+    def _lock_of_expr(self, e):
+        """Lock NAME for a with-item context expression, or None."""
+        if isinstance(e, ast.Call):
+            # with named_lock("x"): — anonymous, still carries the name
+            return self._lock_name_of_call(e)
+        if isinstance(e, ast.Name):
+            return self.mf.lock_names.get(e.id)
+        if isinstance(e, ast.Attribute) and \
+                isinstance(e.value, ast.Name) and e.value.id == "self":
+            if self._class:
+                got = self.mf.lock_names.get(
+                    "%s.%s" % (self._class[-1], e.attr))
+                if got is not None:
+                    return got
+            return self.mf.lock_names.get("." + e.attr)
+        return None
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            name = self._lock_of_expr(item.context_expr)
+            if name is None:
+                continue
+            # mirror the runtime detector: one edge from EVERY held
+            # lock, not just the innermost
+            for holder in self._with_locks:
+                if holder != name:
+                    self.mf.lock_edges.append(
+                        (holder, name, node.lineno))
+            self._with_locks.append(name)
+            acquired.append(name)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._with_locks.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- calls / reads -------------------------------------------------
+
+    def visit_Call(self, node):
+        fn = self._cur()
+        dn = _dotted(node.func)
+        if dn:
+            args_lits = tuple(_lit_str(a) for a in node.args)
+            kw_lits = {k.arg: _lit_str(k.value)
+                       for k in node.keywords if k.arg}
+            fn.calls.append((dn, node.lineno, args_lits, kw_lits))
+            leaf = dn.split(".")[-1]
+            root = self.mf.imports.get(dn.split(".")[0],
+                                       dn.split(".")[0])
+            if leaf in ("register_signature_token",) and node.args:
+                s = _lit_str(node.args[0])
+                if s:
+                    self.mf.sig_tokens.append((s, node.lineno))
+            if dn.endswith("environ.get") or \
+                    (root == "os" and leaf == "getenv"):
+                # os.environ.get / os.getenv (any os alias): a direct
+                # read bypassing the base.getenv choke point
+                fn.env_reads.append((READ_DIRECT,
+                                     _lit_str(node.args[0])
+                                     if node.args else None,
+                                     node.lineno, None))
+            elif leaf in ("getenv", "_getenv", "getenv_dynamic",
+                          "_getenv_dynamic"):
+                self._record_env_call(fn, node, dn, leaf)
+            self._record_ambient(fn, node, dn)
+        self.generic_visit(node)
+
+    def _record_env_call(self, fn, node, dn, leaf):
+        dynamic = "dynamic" in leaf
+        name = None
+        if node.args:
+            a = node.args[0]
+            name = _lit_str(a)
+            if name is None and isinstance(a, ast.Name):
+                if a.id in self.mf.consts:
+                    name = self.mf.consts[a.id]
+                elif a.id in fn.params:
+                    name = ("param", a.id)
+        family = None
+        for k in node.keywords:
+            if k.arg == "family":
+                family = _lit_str(k.value)
+        fn.env_reads.append((READ_DYNAMIC if dynamic else READ_GETENV,
+                             name, node.lineno, family))
+
+    def _record_ambient(self, fn, node, dn):
+        parts = dn.split(".")
+        if len(parts) < 2:
+            return
+        leaf = parts[-1]
+        root = self.mf.imports.get(parts[0], parts[0])
+        full = ".".join([root] + parts[1:])
+        if leaf in CLOCK_FNS and (root == "time"
+                                  or full.startswith("datetime.")):
+            fn.ambient.append(("clock", dn, node.lineno))
+        elif full.startswith("random.") \
+                or full.startswith("numpy.random."):
+            fn.ambient.append(("rng", dn, node.lineno))
+
+    def visit_Attribute(self, node):
+        # direct os.environ access (subscripts, membership tests,
+        # aliases) — everything except the sanctioned write form
+        # os.environ[k] = v / del os.environ[k]. The ENCLOSING function
+        # is captured here so the read lands in its facts (MX014
+        # reachability needs the real owner, not <module>).
+        if node.attr == "environ" and isinstance(node.value, ast.Name) \
+                and self.mf.imports.get(node.value.id,
+                                        node.value.id) == "os":
+            self._env_attr_sites.append((node, self._cur()))
+        elif isinstance(node.value, ast.Name) and \
+                isinstance(node.ctx, ast.Load):
+            # two-part `alias.NAME` loads become dotted refs — the
+            # cross-module env-derived-global clause (MX014) and
+            # function-reference edges resolve them; unresolvable ones
+            # are pruned in extract()
+            self._cur().refs.append(
+                ("%s.%s" % (node.value.id, node.attr), node.lineno))
+        self.generic_visit(node)
+
+    _env_attr_sites = None  # set per-run in extract()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            fn = self._cur()
+            # bare references to known/imported callables feed the
+            # reference edges (callbacks handed to jit/closures)
+            fn.refs.append((node.id, node.lineno))
+        self.generic_visit(node)
+
+
+def extract(path, tree, parents=None):
+    """Extract ModuleFacts for one parsed file. ``parents`` (a child ->
+    parent node map) is reused from the caller's per-file phase when
+    available so the tree is walked for it only once."""
+    module = module_name_of(path)
+    package = module if path.endswith("__init__.py") \
+        else module.rpartition(".")[0]
+    mf = ModuleFacts(path, module, package)
+    ex = _Extractor(mf)
+    ex._env_attr_sites = []
+    ex.visit(tree)
+
+    # classify raw os.environ attribute sites: a Subscript STORE/DEL
+    # through os.environ is the sanctioned publish form; anything else
+    # (get/subscript-load/membership/aliasing) is a direct read.
+    if parents is None:
+        parents = {}
+        for n in ast.walk(tree):
+            for c in ast.iter_child_nodes(n):
+                parents[c] = n
+    seen_direct = set()
+    for site, owner in ex._env_attr_sites:
+        p = parents.get(site)
+        if isinstance(p, ast.Attribute) and p.attr == "get":
+            continue  # already recorded as a call read
+        name = None
+        if isinstance(p, ast.Subscript) and p.value is site:
+            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                continue  # the sanctioned publish form
+            name = _lit_str(p.slice)  # os.environ["X"] subscript READ
+        key = (owner.qualname, site.lineno)
+        if key in seen_direct:
+            continue
+        seen_direct.add(key)
+        owner.env_reads.append((READ_DIRECT, name, site.lineno, None))
+    # prune the (noisy) reference lists down to names that can resolve:
+    # module-level functions, imported symbols, and env-derived globals
+    resolvable = set(mf.imports)
+    resolvable.update(q for q in mf.functions if "." not in q)
+    resolvable.update(mf.env_globals)
+    for fn in mf.functions.values():
+        fn.refs = [(n, ln) for n, ln in fn.refs
+                   if (n.split(".")[0] if "." in n else n)
+                   in resolvable and n not in fn.params]
+    return mf
+
+
+class ProjectModel:
+    """Cross-file index over ModuleFacts."""
+
+    def __init__(self, facts):
+        self.modules = {mf.path: mf for mf in facts}
+        self.by_name = {mf.module: mf for mf in facts}
+        self.functions = {}
+        for mf in facts:
+            for q, fn in mf.functions.items():
+                self.functions[(mf.path, q)] = fn
+        self._callers = None
+
+    # -- resolution ----------------------------------------------------
+
+    def _fn_in_module(self, mf, name):
+        if name in mf.functions:
+            return (mf.path, name)
+        return None
+
+    def resolve(self, mf, dotted, from_qual=None):
+        """Resolve a dotted callee to [(path, qualname)] candidates."""
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+        out = []
+        if head == "self" and rest and from_qual:
+            cls = from_qual.split(".")[0]
+            cand = "%s.%s" % (cls, rest[0])
+            got = self._fn_in_module(mf, cand)
+            if got:
+                out.append(got)
+            return out
+        if not rest:
+            got = self._fn_in_module(mf, head)
+            if got:
+                return [got]
+        target = mf.imports.get(head)
+        if target is None:
+            return out
+        if not rest:
+            # from x import fn as head
+            tmod, _, tfn = target.rpartition(".")
+            tm = self.by_name.get(tmod)
+            if tm:
+                got = self._fn_in_module(tm, tfn)
+                if got:
+                    out.append(got)
+            return out
+        # mod.fn / mod.sub.fn
+        tm = self.by_name.get(target)
+        if tm is None:
+            tm = self.by_name.get("%s.%s" % (target,
+                                             ".".join(rest[:-1])))
+            if tm:
+                got = self._fn_in_module(tm, rest[-1])
+                if got:
+                    out.append(got)
+                return out
+        if tm:
+            got = self._fn_in_module(tm, ".".join(rest)) or \
+                self._fn_in_module(tm, rest[0])
+            if got:
+                out.append(got)
+        return out
+
+    # -- call/reference graph ------------------------------------------
+
+    def edges_from(self, key):
+        path, qual = key
+        mf = self.modules[path]
+        fn = self.functions[key]
+        seen = set()
+        for dn, _ln, _a, _k in fn.calls:
+            for tgt in self.resolve(mf, dn, from_qual=qual):
+                seen.add(tgt)
+        for name, _ln in fn.refs:
+            for tgt in self.resolve(mf, name, from_qual=qual):
+                seen.add(tgt)
+        # a function lexically encloses its nested defs: anything a
+        # nested (traced) closure does, the closure's creator wired up
+        prefix = qual + ".<locals>."
+        for (p, q) in self.functions:
+            if p == path and q.startswith(prefix) \
+                    and "." not in q[len(prefix):]:
+                seen.add((p, q))
+        return seen
+
+    def reachable(self, entries):
+        seen = set()
+        work = [k for k in entries if k in self.functions]
+        while work:
+            k = work.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            for nxt in self.edges_from(k):
+                if nxt not in seen:
+                    work.append(nxt)
+        return seen
+
+    def callers_of(self, key):
+        """[(caller key, call record), ...] for calls resolving to key."""
+        if self._callers is None:
+            idx = {}
+            for ck, fn in self.functions.items():
+                mf = self.modules[ck[0]]
+                for rec in fn.calls:
+                    for tgt in self.resolve(mf, rec[0],
+                                            from_qual=ck[1]):
+                        idx.setdefault(tgt, []).append((ck, rec))
+            self._callers = idx
+        return self._callers.get(key, [])
+
+    # -- locks ---------------------------------------------------------
+
+    def lock_graph(self, path_filter=None):
+        """{(outer, inner): [(path, lineno), ...]} over matching files."""
+        edges = {}
+        for mf in self.modules.values():
+            if path_filter and not path_filter(mf.path):
+                continue
+            for a, b, ln in mf.lock_edges:
+                edges.setdefault((a, b), []).append((mf.path, ln))
+        return edges
+
+    def lock_nodes(self, path_filter=None):
+        """Every named-lock NAME allocated in matching files."""
+        out = set()
+        for mf in self.modules.values():
+            if path_filter and not path_filter(mf.path):
+                continue
+            out.update(mf.lock_names.values())
+        return out
+
+    # -- env tokens ----------------------------------------------------
+
+    def signature_tokens(self):
+        """{env name: (path, lineno)} for every registered token."""
+        out = {}
+        for mf in self.modules.values():
+            for name, ln in mf.sig_tokens:
+                out.setdefault(name, (mf.path, ln))
+        return out
+
+
+def find_cycles(edges):
+    """Cycles in a digraph given as {(a, b): ...} or iterable of (a, b).
+    Returns a list of cycles, each a list of nodes [n0, n1, ..., n0]."""
+    adj = {}
+    for e in (edges.keys() if isinstance(edges, dict) else edges):
+        a, b = e
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    stack = []
+    cycles = []
+
+    def dfs(n):
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(adj[n]):
+            if color[m] == GRAY:
+                i = stack.index(m)
+                cyc = stack[i:] + [m]
+                if sorted(cyc[:-1]) not in [sorted(c[:-1])
+                                            for c in cycles]:
+                    cycles.append(cyc)
+            elif color[m] == WHITE:
+                dfs(m)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in sorted(adj):
+        if color[n] == WHITE:
+            dfs(n)
+    return cycles

@@ -929,6 +929,8 @@ class MX013FaultpointInCatalog:
         return out
 
 
+from .dataflow import DATAFLOW_RULES  # noqa: E402 (needs Finding above)
+
 ALL_RULES = (
     MX001JnpBypassesInvoke(),
     MX002UnguardedProfilerHook(),
@@ -943,4 +945,4 @@ ALL_RULES = (
     MX011FlightrecSecondBranch(),
     MX012PallasKernelContract(),
     MX013FaultpointInCatalog(),
-)
+) + DATAFLOW_RULES

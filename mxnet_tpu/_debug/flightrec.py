@@ -48,7 +48,9 @@ Env knobs (docs/ENV_VARS.md):
 
 - ``MXTPU_FLIGHTREC`` (default 1): master switch.
 - ``MXTPU_FLIGHTREC_EVENTS`` (default 4096): ring capacity.
-- ``MXTPU_FLIGHTREC_DIR`` (default cwd): where shards land.
+- ``MXTPU_FLIGHTREC_DIR`` (default ``./flightrec/``, created lazily at
+  the first write): where shards land. Dumps used to land bare in the
+  CWD, which litters repos and working trees (ISSUE 13 satellite).
 - ``MXTPU_FLIGHTREC_MAX_DUMPS`` (default 32): per-process dump cap, so
   a crash loop or a thread-death storm cannot fill the disk.
 
@@ -164,7 +166,21 @@ def reset_ring():
 
 
 def dump_dir():
-    return _getenv("MXTPU_FLIGHTREC_DIR", "") or os.getcwd()
+    """Where shards (and the faulthandler fatal file) land:
+    ``MXTPU_FLIGHTREC_DIR`` or ``./flightrec`` — created lazily by
+    :func:`_ensure_dump_dir` at the first actual write, so importing the
+    framework never litters the CWD."""
+    return _getenv("MXTPU_FLIGHTREC_DIR", "") or \
+        os.path.join(os.getcwd(), "flightrec")
+
+
+def _ensure_dump_dir():
+    d = dump_dir()
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        pass  # read-only CWD: the write itself will surface the error
+    return d
 
 
 def set_context(key, value):
@@ -353,7 +369,7 @@ def _dump(trigger, extra, path):
     if path is None:
         _SEQ[0] += 1
         path = os.path.join(
-            dump_dir(), "flightrec_r%d_%s_%03d.json"
+            _ensure_dump_dir(), "flightrec_r%d_%s_%03d.json"
             % (profiler.PID, trigger, _SEQ[0]))
     with base.atomic_write(path, "w") as f:
         json.dump(data, f, default=str)
@@ -365,9 +381,27 @@ def _dump(trigger, extra, path):
 # -- crash hooks -------------------------------------------------------------
 
 def _sys_excepthook(exc_type, exc, tb):
-    dump("exception",
-         extra={"exception": "%s: %s" % (exc_type.__name__, exc)},
-         swallow=True)
+    # an unhandled XLA RESOURCE_EXHAUSTED is the OOM post-mortem seam
+    # (ISSUE 13): upgrade the trigger so the shard names its cause and
+    # carries the allocation ledger's view of what was resident
+    trigger = "exception"
+    extra = {"exception": "%s: %s" % (exc_type.__name__, exc)}
+    try:
+        from . import memwatch
+        if memwatch.is_oom(exc):
+            if memwatch.was_reported(exc):
+                trigger = None  # a handled-then-reraised OOM: one shard
+            else:
+                trigger = "oom"
+                from .. import storage
+                ledger = storage.ledger_metrics()
+                extra["ledger_total_bytes"] = ledger.get("total_bytes")
+                extra["ledger_by_tag"] = ledger.get("by_tag", {})
+                extra["top_sites"] = ledger.get("top_sites", [])
+    except Exception:
+        pass
+    if trigger is not None:
+        dump(trigger, extra=extra, swallow=True)
     if _prev_sys_hook is not None:
         _prev_sys_hook(exc_type, exc, tb)
 
@@ -435,7 +469,7 @@ def install():
         import faulthandler
         if not faulthandler.is_enabled():
             fatal_path = os.path.join(
-                dump_dir(), "flightrec_r%d_fatal.txt"
+                _ensure_dump_dir(), "flightrec_r%d_fatal.txt"
                 % int(_getenv("MXTPU_PROC_ID", "0") or 0))
             # append, never truncate: an elastic restart in the same
             # dump dir (same MXTPU_PROC_ID) must not erase the PREVIOUS
@@ -464,6 +498,14 @@ def _cleanup_fatal_file(path):
         f.close()
         if os.path.getsize(path) == 0:
             os.remove(path)
+            # only the lazily-created DEFAULT dir is cleaned up on a
+            # clean exit; an operator-configured MXTPU_FLIGHTREC_DIR
+            # (pre-created, owned, permissioned) is never touched
+            if not _getenv("MXTPU_FLIGHTREC_DIR", ""):
+                try:
+                    os.rmdir(os.path.dirname(path))
+                except OSError:
+                    pass
     except Exception:
         pass
 

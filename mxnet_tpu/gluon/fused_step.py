@@ -62,6 +62,7 @@ from ..ndarray import NDArray
 from ..ndarray import register as _register
 from .._debug import faultpoint as _faultpoint
 from .._debug import watchdog as _watchdog
+from .. import storage as _storage
 from ..optimizer.optimizer import _is_low_precision
 from .block import make_pure_forward
 
@@ -158,11 +159,14 @@ def _state_to_data(state):
 
 def _adopt_state(state, new):
     """Write a returned jax-array pytree back into the NDArray state
-    tree in place (the pending-result adoption of optimizer state)."""
+    tree in place (the pending-result adoption of optimizer state).
+    Fresh buffers re-register in the allocation ledger; the replaced
+    ones retire via weakref death / donation ``is_deleted()``."""
     if state is None:
         return
     if isinstance(state, NDArray):
         state._data = new
+        _storage.ledger_register(new, "opt_state", site="fused_step")
         return
     for s, n in zip(state, new):
         _adopt_state(s, n)
@@ -262,10 +266,23 @@ class FusedTrainStep:
                     # modeled device time of the program that EXECUTED
                     # it — the latency series behind the dumps()
                     # attribution row
-                    host = dur_us - self._step_attr["device_us"]
-                    if host > 0:
-                        _profiler.record_latency("fused_step.host_us",
-                                                 host)
+                    if self._step_attr["device_us"] > 0:
+                        host = dur_us - self._step_attr["device_us"]
+                        if host > 0:
+                            _profiler.record_latency(
+                                "fused_step.host_us", host)
+                    # per-step memory.headroom gauge (ISSUE 13b): the
+                    # EXECUTING signature's modeled peak vs the
+                    # framework-side measured peak vs the device limit
+                    # (cached snapshot — no backend walk per step)
+                    if _profiler._ACTIVE and \
+                            self._step_attr.get("peak_bytes"):
+                        hr = _storage.headroom(
+                            self._step_attr["peak_bytes"])
+                        if hr:
+                            _profiler.record_counter(
+                                "memory.headroom", 0, lane="memory",
+                                series=hr)
         return loss
 
     # -- dispatch ----------------------------------------------------------
@@ -338,11 +355,11 @@ class FusedTrainStep:
                 # keep the AOT-compiled executable: jit's internal cache
                 # does not share the AOT compilation, so calling the
                 # plain jitted fn next step would compile a second time
-                compiled, cost, hlo = self._aot
+                compiled, cost, hlo, mem = self._aot
                 entry = (compiled,) + tuple(entry[1:])
                 self._aot = None
             else:
-                cost = hlo = None
+                cost = hlo = mem = None
             compile_us = (_time.perf_counter() - c0) * 1e6
         except Exception:
             # trace-incompatible step (data-dependent control flow, host
@@ -361,8 +378,8 @@ class FusedTrainStep:
         # cost-model or JAX-API error here must neither re-run the batch
         # eagerly (double update) nor blacklist a signature that compiled
         try:
-            self._record_compile(key, compile_us, cost, hlo, all_params,
-                                 train_pos)
+            self._record_compile(key, compile_us, cost, hlo, mem,
+                                 all_params, train_pos)
         except Exception:
             self._attr_models.pop(key, None)
             _STATS["attr_errors"] += 1
@@ -624,6 +641,7 @@ class FusedTrainStep:
         def place(tree, sh):
             return jax.tree_util.tree_map(
                 lambda a: a if getattr(a, "sharding", None) == sh
+                # mxlint: disable=MX018 (mesh re-placement of ALREADY-LEDGERED operands: the post-step adoption (_adopt_fused/_adopt_state) re-registers every surviving buffer; the replaced single-device ones retire via weakref death)
                 else jax.device_put(a, sh), tree)
 
         def call(train_datas, state_datas, fixed_datas, in_datas,
@@ -635,13 +653,16 @@ class FusedTrainStep:
 
         return call
 
-    def _record_compile(self, key, dur_us, cost, hlo, all_params,
+    def _record_compile(self, key, dur_us, cost, hlo, mem, all_params,
                         train_pos):
         """Feed the compile-attribution registry (ISSUE 8c): measured
         trace+compile+first-run wall time, the program's cost-analysis
         flops/bytes, its collective payload, and the comm_model's
         modeled compute/comm times — the split that turns "step is
-        slow" into "DCN all-reduce grew 40%"."""
+        slow" into "DCN all-reduce grew 40%". ``mem`` (ISSUE 13b) is
+        the executable's ``memory_analysis()`` dict: its
+        argument+output+temp total is the modeled HBM peak behind the
+        ``memory.headroom`` gauge and the ``dumps()`` Memory table."""
         flops = bytes_acc = comm_bytes = comp_us = comm_us = None
         if cost:
             flops = float(cost.get("flops", 0.0)) or None
@@ -668,18 +689,34 @@ class FusedTrainStep:
                 comm_us = sum(cm.allreduce_seconds(
                     comm_bytes, max(self._dp, 2))) * 1e6 \
                     if self._dp > 1 else 0.0
+        peak_bytes = None
+        if mem is not None:
+            # modeled resident peak while the program runs: live
+            # arguments + outputs + XLA temp arena, minus the aliased
+            # bytes — under donation (donate_argnums=(0,1) off-CPU) the
+            # weight/opt-state outputs REUSE the argument buffers, and
+            # memory_analysis counts those bytes on both sides with
+            # alias_size recording the overlap. Generated code is
+            # reported separately and lives outside HBM data space.
+            peak_bytes = (mem.get("argument_bytes", 0)
+                          + mem.get("output_bytes", 0)
+                          + mem.get("temp_bytes", 0)
+                          - mem.get("alias_bytes", 0))
+            mem = dict(mem, peak_bytes=peak_bytes)
+            _storage.note_modeled_peak("fused_step", peak_bytes)
         self._attr_models.pop(key, None)
-        if comp_us is not None:
+        if comp_us is not None or peak_bytes is not None:
             self._attr_models[key] = {
-                "compute_us": comp_us,
+                "compute_us": comp_us or 0.0,
                 "comm_us": comm_us or 0.0,
-                "device_us": comp_us + (comm_us or 0.0),
+                "device_us": (comp_us or 0.0) + (comm_us or 0.0),
+                "peak_bytes": peak_bytes,
             }
         _profiler.record_compile(
             "fused_step", key="%08x" % (abs(hash(key)) & 0xFFFFFFFF),
             dur_us=dur_us, flops=flops, bytes_accessed=bytes_acc,
             comm_bytes=comm_bytes, modeled_compute_us=comp_us,
-            modeled_comm_us=comm_us,
+            modeled_comm_us=comm_us, memory=mem,
             args={"params": len(train_pos), "dp": self._dp})
 
     def _run(self, entry, all_params, train_pos, indices, states, nd_args,
@@ -734,7 +771,27 @@ class FusedTrainStep:
                         hlo = compiled.as_text()
                     except Exception:
                         hlo = None
-                    self._aot = (compiled, cost, hlo)
+                    mem = None
+                    try:
+                        # ISSUE 13b: the executable knows its own HBM
+                        # footprint — argument/output/temp/generated
+                        # bytes feed the compile registry's Memory
+                        # table and the headroom gauge
+                        ma = compiled.memory_analysis()
+                        mem = {
+                            "argument_bytes":
+                                int(ma.argument_size_in_bytes),
+                            "output_bytes":
+                                int(ma.output_size_in_bytes),
+                            "temp_bytes": int(ma.temp_size_in_bytes),
+                            "alias_bytes":
+                                int(ma.alias_size_in_bytes),
+                            "generated_code_bytes":
+                                int(ma.generated_code_size_in_bytes),
+                        }
+                    except Exception:
+                        mem = None  # backend without memory_analysis
+                    self._aot = (compiled, cost, hlo, mem)
                     runner = compiled
                 except Exception:
                     self._aot = None  # AOT API drift: plain path works
@@ -776,29 +833,36 @@ class FusedTrainStep:
         step (rare: warming, indivisible batch, trace failure)."""
         dev = jax.devices()[0]
 
-        def pull(a):
+        def pull(a, tag):
             if a is None:
                 return None
             sh = getattr(a, "sharding", None)
             if sh is not None and len(getattr(sh, "device_set", ())) > 1:
-                return jax.device_put(a, dev)
+                gathered = jax.device_put(a, dev)
+                # the gathered single-device buffer replaces a ledgered
+                # one (which retires via weakref death) — re-register
+                # under the same tag so unplacing never loses bytes
+                _storage.ledger_register(gathered, tag,
+                                         site="fused_step.unplace")
+                return gathered
             return a
 
-        def pull_nd(nd_):
+        def pull_nd(nd_, tag):
             if nd_ is not None and getattr(nd_, "_data", None) is not None:
-                nd_._data = pull(nd_._data)
+                nd_._data = pull(nd_._data, tag)
 
         params = self._param_split()[0] if self._block is not None \
             else list(self._trainer._params)
         for p in params:
-            pull_nd(p._data)
-            pull_nd(getattr(p, "_grad", None))
+            pull_nd(p._data, "param")
+            pull_nd(getattr(p, "_grad", None), "grad")
         upd = getattr(self._trainer, "_updater", None)
         if upd is not None:
             for st in upd.states.values():
                 for leaf in jax.tree_util.tree_leaves(
                         st, is_leaf=lambda x: hasattr(x, "_data")):
-                    pull_nd(leaf if hasattr(leaf, "_data") else None)
+                    pull_nd(leaf if hasattr(leaf, "_data") else None,
+                            "opt_state")
 
     def _eager_step(self, nd_args, batch_size, ignore_stale_grad):
         """The untraced truth: record, backward, Trainer.step — used for

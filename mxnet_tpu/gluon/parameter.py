@@ -17,6 +17,7 @@ from ..context import cpu, current_context
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import autograd
+from .. import storage as _storage
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
 
@@ -109,6 +110,10 @@ class Parameter:
         ctx = ctx if ctx is not None and not isinstance(ctx, (list, tuple)) \
             else (ctx[0] if ctx else current_context())
         self._data = nd.array(data, ctx=ctx, dtype=self.dtype)
+        # allocation-ledger tag upgrade: nd.array registered the buffer
+        # as generic 'other'; adopting it into a Parameter makes it
+        # 'param' (the specific tag wins the ledger slot)
+        _storage.ledger_register(self._data, "param", site=self.name)
         self._deferred_init = None
         if self._grad_req != "null":
             self._init_grad()
@@ -175,6 +180,7 @@ class Parameter:
                 self._init_grad()
         else:
             self._data._data = data._data.astype(self._data.dtype)
+        _storage.ledger_register(self._data, "param", site=self.name)
 
     def _adopt_fused(self, weight_data, grad_data=None):
         """Adopt one fused-train-step result into this parameter's live
@@ -186,8 +192,16 @@ class Parameter:
         data = self.data()
         data._data = weight_data if weight_data.dtype == data.dtype \
             else weight_data.astype(data.dtype)
+        # allocation-ledger choke point (ISSUE 13a): the fused step's
+        # donated program produced fresh weight/grad buffers — register
+        # them; the buffers they replaced retire via weakref death (CPU)
+        # or is_deleted() (donation), observed by the next drain
+        _storage.ledger_register(data, "param", site=self.name)
         if grad_data is not None:
             autograd.deliver_grad(data, grad_data)
+            if data._grad is not None:
+                _storage.ledger_register(data._grad, "grad",
+                                          site=self.name)
         data._fresh_grad = False
 
     def reset_ctx(self, ctx):

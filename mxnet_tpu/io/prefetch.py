@@ -45,14 +45,22 @@ class DevicePrefetchIter:
             import jax
             from ..ndarray import NDArray
 
+            from .. import storage as _storage_mod
+
             def place_one(a):
                 if isinstance(a, NDArray):
-                    return NDArray(jax.device_put(
-                        a._data, sharding) if sharding is not None
-                        else jax.device_put(a._data))
-                if sharding is not None:
-                    return jax.device_put(a, sharding)
-                return jax.device_put(a)
+                    placed = jax.device_put(
+                        a._data, sharding) if sharding is not None \
+                        else jax.device_put(a._data)
+                else:
+                    placed = jax.device_put(a, sharding) \
+                        if sharding is not None else jax.device_put(a)
+                # allocation-ledger choke point (ISSUE 13a): host->HBM
+                # input batches are the 'io' tag
+                _storage_mod.ledger_register(placed, "io",
+                                             site="io.prefetch")
+                return NDArray(placed) if isinstance(a, NDArray) \
+                    else placed
 
             def place_fn(batch):
                 return jax.tree_util.tree_map(

@@ -514,8 +514,14 @@ class AsyncPSServer:
             words, off = _unpack_arr(buf, off)
             from .pallas_kernels.compression import dequantize_2bit_jnp
             import jax.numpy as jnp
+            from . import storage as _storage_mod
+            packed = jnp.asarray(words)
+            # allocation-ledger choke point: transient dequantize
+            # scratch on the server is 'workspace' memory
+            _storage_mod.ledger_register(packed, "workspace",
+                                         site="kvstore.dequantize")
             grad = np.asarray(dequantize_2bit_jnp(
-                jnp.asarray(words), int(n), float(thr)))
+                packed, int(n), float(thr)))
             with self._lock:
                 grad = grad.reshape(self._store[key].shape)
                 if self._updater is not None:
@@ -1257,6 +1263,11 @@ class AsyncKVStore:
         if res is None or res.shape != flat.shape:
             res = jnp.zeros_like(flat)
         words, new_res = quantize_2bit_jnp(flat, res, thr)
+        # allocation-ledger choke point: the per-key error-feedback
+        # residual is long-lived device memory — 'workspace'
+        from . import storage as _storage_mod
+        _storage_mod.ledger_register(new_res, "workspace",
+                                     site="kvstore.residual")
         self._residuals[key] = new_res
         self._clients[cidx].push_compressed(key, np.asarray(words),
                                             flat.shape[0], thr)
@@ -1305,10 +1316,14 @@ class AsyncKVStore:
         t0 = _ptime.perf_counter() if _profiler._LIVE else None
         nbytes = 0
         keys, outs = _ctype_key_value(key, out)
+        from . import storage as _storage
         for k, olist in zip(keys, outs):
             host = self._pull_host(k)
             nbytes += int(host.nbytes) * len(olist)
             arr = jnp.asarray(host)
+            # allocation-ledger choke point (ISSUE 13a): pulled
+            # parameter buffers are fresh device memory on the 'io' tag
+            _storage.ledger_register(arr, "io", site="kvstore.pull")
             for o in olist:
                 o._data = arr
         _profiler.account("kvstore.bytes_pulled", nbytes)
@@ -1413,7 +1428,11 @@ class AsyncKVStore:
                 else:
                     dense = np.zeros(full_shape, rows.dtype)
                     dense[ids] = rows
-                    o._data = jnp.asarray(dense)
+                    densified = jnp.asarray(dense)
+                    from . import storage as _storage_mod
+                    _storage_mod.ledger_register(
+                        densified, "io", site="kvstore.pull_row_sparse")
+                    o._data = densified
         return out
 
     def _barrier(self):

@@ -17,7 +17,9 @@ from ..base import atomic_write as _atomic_write
 from ..base import canonical_dtype
 from ..context import current_context, Context
 from .._debug import faultpoint as _faultpoint
+from .._debug import memwatch as _memwatch
 from .. import profiler as _profiler
+from .. import storage as _storage
 from .ndarray import NDArray, array, concatenate
 from . import register as _register_mod
 
@@ -33,17 +35,33 @@ def _ctx_place(data, ctx):
     path: a failed device_put (unknown ctx, backend OOM, or an injected
     ``storage.alloc`` fault) yields a host-resident NDArray with the
     same values instead of crashing — counted so the degradation is
-    visible (``storage.alloc_fallbacks``)."""
+    visible (``metrics()['memory']['alloc_fallbacks']``, the section's
+    single owner), and written up as an OOM post-mortem flight-record
+    shard naming the failed request size and what was resident
+    (``_debug.memwatch.oom_report``)."""
     ctx = ctx or current_context()
     try:
         if _faultpoint.ACTIVE:
             _faultpoint.check("storage.alloc")
-        return NDArray(jax.device_put(data, ctx.jax_device()), ctx=ctx)
-    except Exception:
-        # counted with profiling off too: account gates only the trace
-        # event, never the production counter
-        _profiler.account("storage.alloc_fallbacks", 1, lane="memory",
-                          emit=False)
+        placed = jax.device_put(data, ctx.jax_device())
+        _storage.ledger_register(placed, "other")
+        return NDArray(placed, ctx=ctx)
+    except Exception as e:
+        # counted with profiling off too (the account contract) — and
+        # the memory section of metrics() is the one owner of
+        # allocation accounting (ISSUE 13 satellite)
+        _storage.bump("alloc_fallbacks")
+        # only genuine memory exhaustion (or an injected storage.alloc
+        # chaos fault, whose message names the point) writes the 'oom'
+        # shard — an unknown-ctx TypeError in a loop must not mislabel
+        # post-mortems or burn the dump cap
+        if _memwatch.is_oom(e) or "storage.alloc" in str(e):
+            try:
+                nbytes = int(getattr(data, "nbytes", 0))
+            except Exception:
+                nbytes = None
+            _memwatch.oom_report(e, requested_bytes=nbytes,
+                                 where="storage.alloc")
         return NDArray(data, ctx=ctx)
 
 

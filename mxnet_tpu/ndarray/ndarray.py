@@ -23,6 +23,7 @@ import numpy as _np
 from ..base import canonical_dtype
 from ..context import Context, current_context
 from .. import autograd
+from .. import storage as _storage
 
 __all__ = ["NDArray", "array", "concatenate"]
 
@@ -222,6 +223,8 @@ class NDArray:
         # mxlint: disable=MX001 (grad-buffer alloc, not an op — must not hit the tape/cache)
         self._grad = NDArray(jnp.zeros(self.shape, self.dtype)) \
             if grad_req != "null" else None
+        if self._grad is not None:
+            _storage.ledger_register(self._grad._buf, "grad")
         self._grad_req = grad_req
         self._autograd_entry = None
 
@@ -589,7 +592,12 @@ def _register_mod():
 def _place(data, ctx):
     if _is_tracer(data):
         return data
-    return jax.device_put(data, ctx.jax_device())
+    out = jax.device_put(data, ctx.jax_device())
+    # allocation-ledger choke point (ISSUE 13a): every framework-side
+    # device placement — array(), copyto, as_in_context — lands in the
+    # tagged ledger; cheap no-op when the ledger/telemetry is off
+    _storage.ledger_register(out, "other")
+    return out
 
 
 def array(source_array, ctx=None, dtype=None):

@@ -1152,6 +1152,11 @@ class Updater:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)
             self.states_synced[index] = True
+            # allocation-ledger choke point (ISSUE 13a): optimizer
+            # state is long-lived HBM — tag its leaves at creation
+            from .. import storage as _storage
+            _storage.ledger_register_tree(self.states[index], "opt_state",
+                                          site="opt_state[%s]" % (index,))
         elif not self.states_synced[index]:
             self.states[index] = self.sync_state_context(self.states[index],
                                                          weight.context)

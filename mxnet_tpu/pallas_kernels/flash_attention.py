@@ -489,7 +489,15 @@ def _autotune_blocks(q, k, v, causal, scale):
                             vv + 1e-12 * dv)
                 return lax.fori_loop(0, 5, body, (q_, k_, v_))[0]
 
-            float(jnp.sum(many(q, k, v).astype(jnp.float32)))  # compile
+            warm = many(q, k, v)  # compile
+            # allocation-ledger choke point (ISSUE 13a): the autotune
+            # trial buffers are the 'workspace' tag — the transient HBM
+            # spike a tuning pass costs shows up attributed, not as
+            # anonymous growth
+            from .. import storage as _storage
+            _storage.ledger_register(warm, "workspace",
+                                     site="flash.autotune")
+            float(jnp.sum(warm.astype(jnp.float32)))
             # mxlint: disable=MX014 (host-side autotune timing: the measured winner is memoized per shape and MXTPU_FLASH_AUTOTUNE is a signature token, so timing noise never changes an already-cached executable)
             t0 = time.perf_counter()
             float(jnp.sum(many(q, k, v).astype(jnp.float32)))

@@ -332,6 +332,7 @@ def _start_daemons(profile_memory, continuous, period):
                 if not _state["running"]:
                     return
                 sample_memory("sampler")
+                _sample_ledger()
 
         _mem_thread = threading.Thread(
             target=_mem_loop, daemon=True, name="profiler-mem-sampler")
@@ -601,7 +602,7 @@ _compiles = {}  # name -> {count, total_us, key, flops, ...}
 def record_compile(name, key=None, dur_us=0.0, flops=None,
                    bytes_accessed=None, comm_bytes=None,
                    modeled_compute_us=None, modeled_comm_us=None,
-                   args=None):
+                   memory=None, args=None):
     """Record one jit compilation: ``name`` identifies the compiling
     subsystem + program (e.g. ``imperative:softmax``, ``fused_step``),
     ``key`` a short signature string (shape churn shows as the same
@@ -610,7 +611,12 @@ def record_compile(name, key=None, dur_us=0.0, flops=None,
     ``flops``/``bytes_accessed``, collective payload ``comm_bytes``,
     and the comm_model's ``modeled_compute_us``/``modeled_comm_us`` —
     surfaced in ``metrics()['compile']`` and the ``dumps()``
-    attribution table."""
+    attribution table. ``memory`` (ISSUE 13b) is the program's
+    ``compiled.memory_analysis()`` as a flat dict (``argument_bytes``,
+    ``output_bytes``, ``temp_bytes``, ``generated_code_bytes``,
+    ``peak_bytes``) — the modeled-peak half of the ``memory.headroom``
+    gauge and the ``dumps()`` Memory table, keyed per signature via
+    ``key`` like every other field here."""
     with _lock:
         st = _compiles.get(name)
         if st is None:
@@ -628,6 +634,9 @@ def record_compile(name, key=None, dur_us=0.0, flops=None,
                            ("modeled_comm_us", modeled_comm_us)):
             if val is not None:
                 st[field] = float(val)
+        if memory is not None:
+            st["memory"] = {k: int(v) for k, v in dict(memory).items()
+                            if v is not None}
     ev_args = {"key": str(key)} if key is not None else {}
     if args:
         ev_args.update(args)
@@ -756,6 +765,31 @@ def sample_memory(trigger=None):
         for ev in events:
             _append_locked(ev)
         _mem_last.update(snap)
+
+
+def _sample_ledger():
+    """Sampler-daemon companion to :func:`sample_memory` (ISSUE 13a):
+    one stacked Counter series per allocation-ledger tag in the memory
+    lane, plus the denser-cadence feed into the leak detector's rolling
+    window. Runs ONLY on the daemon thread — the detector/dump chain
+    must never be reachable from a bulk-flush/trace path (mxlint
+    MX014's reachability contract)."""
+    if not (_ACTIVE and _state["profile_memory"]):
+        return
+    try:
+        from . import storage
+        led = storage.ledger_metrics()
+        by_tag = {t: b for t, b in led["by_tag"].items() if b}
+        if by_tag:
+            ev = {"name": "memory.ledger", "cat": "memory", "ph": "C",
+                  "ts": _now_us(), "pid": PID, "tid": LANES["memory"],
+                  "args": by_tag}
+            with _lock:
+                _append_locked(ev)
+        from ._debug import memwatch as _memwatch
+        _memwatch.observe(led)
+    except Exception:
+        pass  # ledger/detector trouble must not kill the sampler
 
 
 def _lane_metadata():
@@ -913,6 +947,16 @@ def metrics(reset=False):
             _mem_last.clear()
             _compiles.clear()
     latency = latency_metrics(reset)
+    # the memory section (ISSUE 13): the sampler's per-device snapshot
+    # plus the storage-owned ledger/headroom/allocation counters —
+    # composed OUTSIDE _lock (the ledger drain takes its own named
+    # lock; nesting it under the event lock would order them)
+    mem_section = {"devices": memory}
+    try:
+        from . import storage as _storage_mod
+        mem_section.update(_storage_mod.memory_metrics())
+    except Exception as e:
+        mem_section["error"] = "%s: %s" % (type(e).__name__, e)
     # _clock_sync survives reset on purpose: it is calibration
     # state (clock offsets), not accumulated telemetry
     out = {
@@ -923,7 +967,7 @@ def metrics(reset=False):
         "imperative": imperative_stats(),
         "counters": counters,
         "latency": latency,
-        "memory": memory,
+        "memory": mem_section,
         "compile": compiles,
         "clock_sync": clock_sync(),
         "num_events": num_events,
@@ -1025,9 +1069,53 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
                     name[:28], comp, comm,
                     "%.1f" % meas if meas else "-",
                     "%.1f" % host if host is not None else "-"))
+    # Memory table (ISSUE 13b): per-program modeled HBM footprint from
+    # compiled.memory_analysis(), recorded by the fused-step AOT path
+    mem_rows = [(n, st["memory"]) for n, st in sorted(compiles.items())
+                if st.get("memory")]
+    if mem_rows:
+        lines.append("")
+        lines.append("%-28s %10s %10s %10s %10s" % (
+            "Memory (modeled)", "args(MB)", "out(MB)", "temp(MB)",
+            "peak(MB)"))
+        for name, mm in mem_rows:
+            lines.append("%-28s %10.2f %10.2f %10.2f %10.2f" % (
+                name[:28], mm.get("argument_bytes", 0) / 1e6,
+                mm.get("output_bytes", 0) / 1e6,
+                mm.get("temp_bytes", 0) / 1e6,
+                mm.get("peak_bytes", 0) / 1e6))
     if counters:
         lines.append("counters: " + " ".join(
             "%s=%s" % (k, counters[k]) for k in sorted(counters)))
+    # allocation ledger: live bytes by tag + headroom (storage owns it)
+    try:
+        from . import storage as _storage_mod
+        smm = _storage_mod.memory_metrics()
+    except Exception:
+        smm = None
+    if smm is not None:
+        led = smm.get("ledger", {})
+        by_tag = led.get("by_tag", {})
+        if any(by_tag.values()):
+            lines.append("")
+            lines.append("memory ledger (live bytes): total=%d %s" % (
+                led.get("total_bytes", 0),
+                " ".join("%s=%d" % (t, by_tag[t])
+                         for t in sorted(by_tag) if by_tag[t])))
+        hr = smm.get("headroom")
+        if hr:
+            lines.append(
+                "memory headroom: modeled_peak=%d device_peak=%d "
+                "limit=%d%s" % (
+                    hr.get("modeled_peak_bytes", 0),
+                    hr.get("device_peak_bytes", 0),
+                    hr.get("device_limit_bytes", 0),
+                    " headroom=%d" % hr["headroom_bytes"]
+                    if "headroom_bytes" in hr else ""))
+        lines.append("memory accounting: alloc_fallbacks=%d "
+                     "empty_cache_calls=%d" % (
+                         smm.get("alloc_fallbacks", 0),
+                         smm.get("empty_cache_calls", 0)))
     if memory:
         lines.append("")
         lines.append("%-24s %16s %16s %16s" % (
@@ -1104,14 +1192,36 @@ def prometheus_text():
                          % (series, _prom_num(total / 1e6)))
             lines.append("mxtpu_latency_seconds_count{%s} %d"
                          % (series, count))
+    mem = m["memory"]
     mem_samples = []
-    for dev, vals in sorted(m["memory"].items()):
+    for dev, vals in sorted(mem.get("devices", {}).items()):
         for k, v in sorted(vals.items()):
-            mem_samples.append(
-                (['device="%s"' % dev, 'stat="%s"' % k], v))
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                mem_samples.append(
+                    (['device="%s"' % dev, 'stat="%s"' % k], v))
     if mem_samples:
         emit("mxtpu_memory_bytes", "gauge",
              "Per-device memory stats (storage.stats).", mem_samples)
+    led = mem.get("ledger", {})
+    led_samples = [(['tag="%s"' % t], b)
+                   for t, b in sorted(led.get("by_tag", {}).items())]
+    if led_samples:
+        emit("mxtpu_memory_ledger_bytes", "gauge",
+             "Live device bytes by allocation-ledger tag "
+             "(storage.ledger_metrics).", led_samples)
+    alloc_samples = [
+        (['name="%s"' % k], mem[k])
+        for k in ("alloc_fallbacks", "empty_cache_calls") if k in mem]
+    if alloc_samples:
+        emit("mxtpu_memory_alloc_events_total", "counter",
+             "Allocation-accounting counters (storage.counters).",
+             alloc_samples)
+    hr = mem.get("headroom")
+    if hr:
+        emit("mxtpu_memory_headroom_bytes", "gauge",
+             "Modeled program peak vs measured peak vs device limit "
+             "(storage.headroom).",
+             [(['stat="%s"' % k], v) for k, v in sorted(hr.items())])
     # span aggregates: count + total time per named span
     agg_counts, agg_totals = [], []
     for name, st in sorted(m["aggregate"].items()):
@@ -1321,6 +1431,11 @@ def _reset():
         _elastic.clear()
         _compiles.clear()
     reset_imperative_stats()
+    try:
+        from . import storage as _storage_mod
+        _storage_mod.ledger_reset()
+    except Exception:
+        pass
 
 
 def _emit(name, ph, cat, ts=None, args=None, tid=None):

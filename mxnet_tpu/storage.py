@@ -1,4 +1,4 @@
-"""Device memory / storage introspection.
+"""Device memory / storage introspection + the tagged allocation ledger.
 
 TPU-native re-design of the reference storage layer (ref: src/storage/,
 include/mxnet/storage.h:36-137). The reference implements its own pooled
@@ -7,20 +7,57 @@ cudaMalloc is slow; on TPU the PJRT runtime owns the HBM allocator (BFC-style
 pooling lives below XLA), so the framework's job is *introspection and
 control*, not reimplementation:
 
-* per-device usage stats (≙ the pool counters the reference keeps),
+* per-device usage stats (≙ the pool counters the reference keeps) — with a
+  ``jax.live_arrays()`` fallback for backends (CPU) whose devices report no
+  ``memory_stats()``, so the numbers exist on the tier-1 suite too,
 * an explicit release hook (≙ ``Storage::ReleaseAll`` / ``MXStorageEmptyCache``)
   implemented by dropping framework references and forcing a GC,
 * host-side pinned/shared-memory roles are covered by the data-IO stack
   (gluon DataLoader shared workers).
+
+Tagged allocation ledger (ISSUE 13 tentpole a) — the attribution the
+reference gets from ``Storage::Get()->Alloc/Free`` pooled-allocator
+accounting and we lost in the JAX graft. Every device buffer created at a
+framework choke point (``register.invoke`` results, bulk-segment delivery,
+``Parameter._adopt_fused``, optimizer-state creation, creation factories,
+io device placement, kvstore pull buffers, pallas autotune workspaces) is
+weakref-registered with a category tag:
+
+    param / grad / opt_state / activation / io / workspace / other
+
+Hot-path price engineering (the flightrec discipline): the per-op dispatch
+site appends ONE ``(weakref, site)`` pair to a per-tag ``deque`` — no
+callback closure, no nbytes read (``jax.Array.nbytes`` costs ~3us), no
+lock; ``deque.append`` is a GIL-atomic C call. All bookkeeping (folding
+pending appends into the live-entry table, pruning dead/donated buffers,
+computing per-tag byte totals) happens at DRAIN time on whoever asks —
+the profiler memory sampler, the memwatch daemon, ``metrics()`` — under
+one named lock. A buffer leaves the ledger exactly once: its weakref dies
+(refcount/GC) or XLA donation marks it ``is_deleted()`` (``donate_argnums``,
+``OpDef.inplace``), both observed by the same prune. Call-site attribution
+is sampled (1-in-``_SITE_SAMPLE`` helper registrations walk the stack) so
+a leak dump can name allocation sites without pricing every allocation.
+
+``BENCH_MODEL=memory_overhead`` gates the add/retire pair at <0.5% of
+eager dispatch. ``MXTPU_MEMLEDGER=0`` is the kill switch; the hot sites
+additionally sit behind the shared ``_HOOKS and _LIVE`` telemetry guard,
+so with everything off the ledger costs nothing at all.
 """
 from __future__ import annotations
 
+import collections
 import gc
+import weakref
 
 from ._debug import locktrace as _locktrace
+from .base import getenv as _getenv
 
 __all__ = ["DeviceStats", "stats", "total_bytes_in_use", "release_all",
-           "empty_cache", "reset_peak"]
+           "empty_cache", "reset_peak",
+           "LEDGER_TAGS", "ledger_register", "ledger_register_tree",
+           "ledger_retire", "ledger_metrics", "ledger_reset",
+           "pending_append", "set_ledger_enabled", "memory_metrics",
+           "note_modeled_peak", "headroom", "bump", "counters"]
 
 # Framework-side high-water mark per device, updated on every stats() call.
 # PJRT's own peak_bytes_in_use is cumulative for the process and cannot be
@@ -29,6 +66,10 @@ __all__ = ["DeviceStats", "stats", "total_bytes_in_use", "release_all",
 # current usage and the next samples grow it from there.
 _hwm_lock = _locktrace.named_lock("storage.hwm")
 _hwm = {}  # str(device) -> high-water bytes_in_use since last reset_peak()
+# newest stats() snapshot: str(device) -> (bytes_in_use, peak_since_reset,
+# bytes_limit). The headroom gauge reads this instead of re-walking the
+# backend per training step.
+_last_stats = {}  # mxlint: disable=MX003 (written only under _hwm_lock in stats(); readers take a GIL-atomic snapshot)
 
 
 class DeviceStats:
@@ -51,18 +92,62 @@ class DeviceStats:
                    self.bytes_limit))
 
 
+def _live_array_stats():
+    """{str(device): {bytes_in_use, num_allocs, largest_alloc_size}}
+    synthesized from ``jax.live_arrays()`` — the introspection fallback
+    for backends whose devices report no ``memory_stats()`` (CPU). A
+    sharded array's bytes split evenly across its devices. O(live
+    arrays); callers are the 10Hz sampler / 1Hz memwatch poll, never a
+    hot path."""
+    import jax
+    per = {}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return per
+    for a in arrays:
+        try:
+            if a.is_deleted():
+                continue
+            nb = int(a.nbytes)
+            devs = list(a.devices())
+        except Exception:
+            continue
+        if not devs:
+            continue
+        share = nb // len(devs)
+        for d in devs:
+            st = per.setdefault(str(d), {"bytes_in_use": 0,
+                                         "num_allocs": 0,
+                                         "largest_alloc_size": 0})
+            st["bytes_in_use"] += share
+            st["num_allocs"] += 1
+            if share > st["largest_alloc_size"]:
+                st["largest_alloc_size"] = share
+    return per
+
+
 def stats():
-    """Per-device memory stats from PJRT. CPU devices may not report stats;
-    they yield zeroed entries. Each call advances the framework-side
-    high-water mark backing ``peak_since_reset`` (see ``reset_peak``)."""
+    """Per-device memory stats from PJRT. Devices that report no stats
+    (CPU) synthesize ``bytes_in_use`` from ``jax.live_arrays()`` so the
+    numbers exist on the tier-1 suite. Each call advances the
+    framework-side high-water mark backing ``peak_since_reset`` (see
+    ``reset_peak``)."""
     import jax
     out = []
+    synth = None  # computed lazily, once, only if some device needs it
     with _hwm_lock:
         for d in jax.devices():
             try:
                 raw = d.memory_stats() or {}
             except Exception:
                 raw = {}
+            if not raw:
+                if synth is None:
+                    synth = _live_array_stats()
+                raw = dict(synth.get(str(d), ()))
+                if raw:
+                    raw["source"] = "live_arrays"
             ds = DeviceStats(d, raw)
             key = str(d)
             mark = _hwm.get(key)
@@ -70,6 +155,7 @@ def stats():
                 mark = ds.bytes_in_use
                 _hwm[key] = mark
             ds.peak_since_reset = mark
+            _last_stats[key] = (ds.bytes_in_use, mark, ds.bytes_limit)
             out.append(ds)
     return out
 
@@ -82,12 +168,17 @@ def reset_peak():
     and stays untouched. Returns {str(device): rebased bytes_in_use}."""
     import jax
     out = {}
+    synth = None
     with _hwm_lock:
         for d in jax.devices():
             try:
                 raw = d.memory_stats() or {}
             except Exception:
                 raw = {}
+            if not raw:
+                if synth is None:
+                    synth = _live_array_stats()
+                raw = synth.get(str(d), {})
             key = str(d)
             _hwm[key] = int(raw.get("bytes_in_use", 0))
             out[key] = _hwm[key]
@@ -102,8 +193,358 @@ def release_all():
     """Drop unreferenced device buffers (ref: Storage::ReleaseAll,
     include/mxnet/storage.h; MXStorageEmptyCache in the C API). PJRT frees a
     buffer when its last reference dies, so this forces a collection pass and
-    deletes donated/aliased temporaries."""
+    deletes donated/aliased temporaries. Counted in
+    ``metrics()['memory']['empty_cache_calls']`` (the account contract:
+    counts with profiling off)."""
+    bump("empty_cache_calls")
     gc.collect()
 
 
 empty_cache = release_all
+
+
+# ---------------------------------------------------------------------------
+# Allocation accounting counters (ISSUE 13 satellite: metrics()['memory']
+# is the single owner — storage.alloc_fallbacks moved here from the
+# generic profiler counter namespace).
+# ---------------------------------------------------------------------------
+
+# mxlint: disable=MX003 (GIL-atomic best-effort counters on degradation paths, same contract as ndarray/register._STATS)
+_counters = {
+    "alloc_fallbacks": 0,   # device placement degraded to a host array
+    "empty_cache_calls": 0,
+}
+
+
+def bump(name, delta=1):
+    """Accumulate one allocation-accounting counter. Unconditional (the
+    ``profiler.account`` contract): degradation accounting must be
+    trustworthy with profiling off."""
+    _counters[name] = _counters.get(name, 0) + delta
+
+
+def counters():
+    return dict(_counters)
+
+
+# ---------------------------------------------------------------------------
+# The tagged allocation ledger (ISSUE 13 tentpole a).
+# ---------------------------------------------------------------------------
+
+LEDGER_TAGS = ("param", "grad", "opt_state", "activation", "io",
+               "workspace", "other")
+
+_LEDGER_ON = _getenv("MXTPU_MEMLEDGER", "1") not in ("0", "false", "off")
+# emergency bound per pending deque: maxlen drops OLDEST registrations if
+# no drainer runs for a long time (daemons dead) — bounded memory beats
+# perfect accounting in that degenerate state. At full eager rate
+# (~30k ops/s) this is several seconds of slack against the 1s memwatch
+# poll and the 0.1s profiler sampler.
+_PENDING_CAP = 1 << 16
+# STABLE deque objects: hot modules cache `pending_append(tag)` bound
+# methods at import, so reset clears these in place, never replaces them.
+_pending = {t: collections.deque(maxlen=_PENDING_CAP) for t in LEDGER_TAGS}
+
+_ledger_lock = _locktrace.named_lock("storage.ledger")
+_entries = {}       # id(buf) -> [weakref, tag, nbytes | None, site | None]
+# Explicit retires that arrived before their registration drained:
+# id(buf) -> weakref(buf). The weakref validates the marker at drain
+# time — CPython reuses freed addresses, and a stale id-only marker
+# would silently swallow some FUTURE buffer's registration forever.
+_retired = {}
+_cum = {t: 0 for t in LEDGER_TAGS}   # registrations integrated, per tag
+_modeled_peaks = {}  # program name -> modeled peak bytes (fused_step AOT)
+# sampled call-site capture budget: 1-in-N helper registrations walk the
+# stack (a full walk costs ~10us; the sample keeps attribution ~free)
+_SITE_SAMPLE = 64
+_site_tick = [0]  # mxlint: disable=MX003 (GIL-atomic bump; a lost update skews the sample phase, never the accounting)
+_watch_started = [False]  # mxlint: disable=MX003 (GIL-atomic once-flag; ensure_thread is idempotent so a racing double start is harmless)
+
+
+def set_ledger_enabled(enabled):
+    """Runtime kill switch (``MXTPU_MEMLEDGER`` sets the process
+    default). Returns the previous value."""
+    global _LEDGER_ON
+    prev = _LEDGER_ON
+    _LEDGER_ON = bool(enabled)
+    return prev
+
+
+def pending_append(tag):
+    """The raw hot-path registration primitive: the bound
+    ``deque.append`` for ``tag``'s pending queue. Hot modules cache it at
+    import and append ``(weakref.ref(buf), site)`` pairs directly —
+    everything else (liveness, sizes, totals) is drain-time work. The
+    deque object is stable for the life of the process."""
+    return _pending[tag].append
+
+
+# Memoized profiler module ref: the lazy import breaks the storage <->
+# profiler cycle (profiler pulls storage only inside sample_memory),
+# and reading `_PROFILER._LIVE` inline in ledger_register spares the
+# helper-call cost the <0.5%-of-step budget cannot afford.
+_PROFILER = None
+
+
+def _capture_site():
+    """First stack frame outside this module / the ndarray package —
+    the user-ish code that triggered the allocation."""
+    import sys
+    try:
+        f = sys._getframe(2)
+    except ValueError:
+        return None
+    for _ in range(12):
+        if f is None:
+            return None
+        fn = f.f_code.co_filename
+        if "mxnet_tpu" not in fn.replace("\\", "/"):
+            return "%s:%d" % (fn.rsplit("/", 1)[-1], f.f_lineno)
+        f = f.f_back
+    return None
+
+
+def ledger_register(buf, tag, site=None):
+    """Register one device buffer (a ``jax.Array`` or an NDArray, whose
+    buffer is taken) under ``tag``. Cheap no-op when the ledger is off or
+    telemetry is fully disabled (the shared ``_LIVE`` guard). ``site``
+    labels the allocation for the leak watchdog's top-sites table; when
+    omitted, a sampled stack capture fills it in 1-in-``_SITE_SAMPLE``
+    calls."""
+    p = _PROFILER
+    if p is None:
+        from . import profiler as p
+        globals()["_PROFILER"] = p
+    if not (_LEDGER_ON and p._LIVE):
+        return
+    if not _watch_started[0]:
+        # the first registration lazily starts the memwatch daemon (the
+        # step-watchdog idiom): leak detection is on whenever the
+        # ledger has anything to watch, no wiring required
+        _watch_started[0] = True
+        try:
+            from ._debug import memwatch
+            memwatch.ensure_thread()
+        except Exception:
+            pass
+    b = getattr(buf, "_buf", buf)
+    if site is None:
+        _site_tick[0] += 1
+        if _site_tick[0] % _SITE_SAMPLE == 0:
+            site = _capture_site()
+    try:
+        _pending[tag].append((weakref.ref(b), site))
+    except TypeError:
+        pass  # not weakref-able (python scalar, numpy view): not a
+        #      device buffer the ledger needs to own
+
+
+def ledger_register_tree(tree, tag, site=None):
+    """Register every NDArray/array leaf of a nested tuple/list state
+    tree (the optimizer-state shape)."""
+    if tree is None:
+        return
+    if isinstance(tree, (tuple, list)):
+        for t in tree:
+            ledger_register_tree(t, tag, site)
+        return
+    if hasattr(tree, "_buf") or hasattr(tree, "nbytes"):
+        ledger_register(tree, tag, site)
+
+
+def ledger_retire(buf):
+    """Explicitly retire a buffer (donation sites that want deterministic
+    accounting before GC gets there). Exactly-once: the entry pop is the
+    single ownership transfer; the weakref death or ``is_deleted()``
+    prune later finds nothing."""
+    b = getattr(buf, "_buf", buf)
+    key = id(b)
+    with _ledger_lock:
+        if _entries.pop(key, None) is None:
+            try:
+                _retired[key] = weakref.ref(b)
+            except TypeError:
+                return
+            if len(_retired) > 4 * _PENDING_CAP:
+                _retired.clear()  # unmatched retires must not leak
+
+
+# Drain precedence: generic tags fold in first so a buffer re-registered
+# under a more SPECIFIC tag in the same pending window keeps the
+# specific one (nd.array creates a weight as 'other', Parameter adoption
+# re-registers it as 'param' — param must win the id(buf) table slot).
+_DRAIN_ORDER = ("activation", "io", "workspace", "other", "grad",
+                "opt_state", "param")
+
+
+def _drain_locked():
+    """Fold pending registrations into the live-entry table. Caller
+    holds _ledger_lock. Entries whose buffer already died (the typical
+    eager temporary) integrate as nothing — that IS their retirement."""
+    import jax
+    tracer = jax.core.Tracer
+    for tag in _DRAIN_ORDER:
+        pop = _pending[tag].popleft  # bound-method hoist: the drain is
+        #                              priced per entry by the bench gate
+        while True:
+            try:
+                ref, site = pop()
+            except IndexError:
+                break
+            o = ref()
+            if o is None or isinstance(o, tracer):
+                continue  # died before integration / trace-time phantom
+            deleted = getattr(o, "is_deleted", None)
+            if deleted is not None:
+                try:
+                    if deleted():
+                        continue  # donated away before integration
+                except Exception:
+                    continue
+            key = id(o)
+            marker = _retired.get(key)
+            if marker is not None:
+                # mxlint: disable=MX003 (caller holds _ledger_lock — the function's contract, see docstring)
+                del _retired[key]
+                if marker() is o:
+                    continue  # the retire matches THIS buffer
+                # stale marker (its buffer died, the id was reused):
+                # fall through and register the new buffer normally
+            # mxlint: disable=MX003 (caller holds _ledger_lock — the function's contract, see docstring)
+            _entries[key] = [ref, tag, None, site]
+            _cum[tag] = _cum.get(tag, 0) + 1
+    # markers whose buffer died can never legitimately match again —
+    # any future hit on that id is address reuse. Prune them.
+    for k in [k for k, r in _retired.items() if r() is None]:
+        # mxlint: disable=MX003 (caller holds _ledger_lock — the function's contract, see docstring)
+        del _retired[k]
+
+
+def _walk_locked():
+    """(live bytes by tag, live counts by tag, live bytes by (tag, site))
+    — prunes dead/donated entries as it goes. Caller holds _ledger_lock."""
+    by_tag = dict.fromkeys(LEDGER_TAGS, 0)
+    counts = dict.fromkeys(LEDGER_TAGS, 0)
+    sites = {}
+    dead = []
+    for key, ent in _entries.items():
+        o = ent[0]()
+        if o is None:
+            dead.append(key)
+            continue
+        deleted = getattr(o, "is_deleted", None)
+        if deleted is not None:
+            try:
+                if deleted():
+                    dead.append(key)  # donation retired it on-device
+                    continue
+            except Exception:
+                dead.append(key)
+                continue
+        nb = ent[2]
+        if nb is None:
+            try:
+                nb = int(o.nbytes)
+            except Exception:
+                nb = 0
+            ent[2] = nb
+        tag = ent[1]
+        by_tag[tag] = by_tag.get(tag, 0) + nb
+        counts[tag] = counts.get(tag, 0) + 1
+        if ent[3]:
+            k = (tag, ent[3])
+            sites[k] = sites.get(k, 0) + nb
+    for key in dead:
+        # mxlint: disable=MX003 (caller holds _ledger_lock — the function's contract, see docstring)
+        del _entries[key]
+    return by_tag, counts, sites
+
+
+def ledger_metrics(top_sites=8):
+    """One drained snapshot of the ledger: live bytes/counts by tag,
+    total, cumulative integrations, and the top-``top_sites`` allocation
+    sites by live bytes."""
+    with _ledger_lock:
+        _drain_locked()
+        by_tag, counts, sites = _walk_locked()
+        cum = dict(_cum)
+    top = sorted(sites.items(), key=lambda kv: -kv[1])[:top_sites]
+    return {
+        "enabled": bool(_LEDGER_ON),
+        "by_tag": by_tag,
+        "counts": counts,
+        "total_bytes": sum(by_tag.values()),
+        "registered_total": cum,
+        "top_sites": [{"tag": t, "site": s, "bytes": b}
+                      for (t, s), b in top],
+    }
+
+
+def ledger_reset():
+    """Drop every ledger entry and pending registration (test
+    isolation)."""
+    with _ledger_lock:
+        for dq in _pending.values():
+            dq.clear()
+        _entries.clear()
+        _retired.clear()
+        for t in list(_cum):
+            _cum[t] = 0
+        _modeled_peaks.clear()
+    for k in list(_counters):
+        _counters[k] = 0
+
+
+def note_modeled_peak(name, peak_bytes):
+    """Record one compiled program's modeled peak HBM (argument + output
+    + temp bytes from ``compiled.memory_analysis()``) — the ``modeled``
+    leg of the headroom gauge. Keyed by program name; the newest compile
+    of a name wins (per-signature history lives in the compile
+    registry)."""
+    with _ledger_lock:
+        _modeled_peaks[str(name)] = int(peak_bytes)
+
+
+def headroom(modeled_peak=None):
+    """The ``memory.headroom`` gauge: modeled program peak vs the
+    framework-side measured peak (``DeviceStats.peak_since_reset``) vs
+    the device limit, from the newest ``stats()`` snapshot (cheap — no
+    backend walk). Returns None when nothing is known yet."""
+    with _ledger_lock:
+        if modeled_peak is None and _modeled_peaks:
+            modeled_peak = max(_modeled_peaks.values())
+    snap = dict(_last_stats)
+    dev_peak = max((v[1] for v in snap.values()), default=0)
+    dev_limit = max((v[2] for v in snap.values()), default=0)
+    if not snap and modeled_peak is None:
+        return None
+    out = {
+        "modeled_peak_bytes": int(modeled_peak or 0),
+        "device_peak_bytes": int(dev_peak),
+        "device_limit_bytes": int(dev_limit),
+    }
+    if dev_limit:
+        out["headroom_bytes"] = int(
+            dev_limit - max(int(modeled_peak or 0), dev_peak))
+    return out
+
+
+def memory_metrics():
+    """The storage-owned half of ``profiler.metrics()['memory']``: the
+    ledger snapshot, the allocation-accounting counters (single owner —
+    the account contract, counts with profiling off), the headroom
+    gauge, and the leak-watchdog state."""
+    out = {
+        "ledger": ledger_metrics(),
+        "alloc_fallbacks": _counters.get("alloc_fallbacks", 0),
+        "empty_cache_calls": _counters.get("empty_cache_calls", 0),
+    }
+    hr = headroom()
+    if hr is not None:
+        out["headroom"] = hr
+    try:
+        from ._debug import memwatch
+        out["memwatch"] = memwatch.stats()
+    except Exception:
+        pass
+    return out

@@ -26,6 +26,14 @@ if _WANT_FLAG not in os.environ.get("XLA_FLAGS", ""):
                                + " %s=8" % _WANT_FLAG).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Flight-recorder shards written by suites that exercise crash/OOM/leak
+# paths land in a session tmpdir, never the working tree (tests that
+# assert on shard paths override per-test with monkeypatch).
+if "MXTPU_FLIGHTREC_DIR" not in os.environ:
+    import tempfile
+    os.environ["MXTPU_FLIGHTREC_DIR"] = tempfile.mkdtemp(
+        prefix="mxtpu_flightrec_")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

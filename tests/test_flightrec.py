@@ -234,12 +234,33 @@ def test_dump_storm_cap(tmp_path, monkeypatch):
 
 
 def test_dump_failure_swallowed_and_counted(tmp_path, monkeypatch):
-    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR",
-                       str(tmp_path / "missing" / "nope"))
+    # a FILE where the dump dir should be: lazy creation (makedirs)
+    # cannot help, the shard write fails — swallowed and counted
+    blocker = tmp_path / "blocked"
+    blocker.write_text("not a directory")
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(blocker))
     assert flightrec.dump("manual", swallow=True) is None
     assert flightrec.stats()["dump_failures"] == 1
     with pytest.raises(Exception):
         flightrec.dump("manual", swallow=False)
+
+
+def test_dump_dir_created_lazily(tmp_path, monkeypatch):
+    # ISSUE 13 satellite: a missing dump dir is created at the first
+    # write (default ./flightrec), never at import
+    target = tmp_path / "fresh" / "flightrec"
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(target))
+    assert not target.exists()
+    p = flightrec.dump("manual")
+    assert p is not None and os.path.exists(p)
+    assert str(target) == os.path.dirname(p)
+
+
+def test_default_dump_dir_is_flightrec_subdir(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXTPU_FLIGHTREC_DIR", raising=False)
+    monkeypatch.chdir(tmp_path)
+    assert flightrec.dump_dir() == str(tmp_path / "flightrec")
+    assert not (tmp_path / "flightrec").exists()  # lazy until a write
 
 
 # -- crash hooks -------------------------------------------------------------

@@ -936,9 +936,12 @@ def test_mx016_tuple_unpack_rebind_and_augassign(tmp_path):
 
 def test_mx014_subscript_env_read_and_telemetry_globals(tmp_path):
     """os.environ["X"] subscript reads inside a traced function carry
-    the name to MX014, and the telemetry-module exemption covers ONLY
-    the clock clause — env-derived globals there stay checked (review
-    regressions)."""
+    the name to MX014. The telemetry-module exemption (ISSUE 13: the
+    ledger/detector hooks make the whole dump/metrics subsystem LOOK
+    trace-reachable) covers all clauses for telemetry modules — their
+    ambient state gates what gets recorded, never a traced value —
+    while env-derived globals in COMPUTE modules stay checked (the PR 9
+    bug class the rule exists for)."""
     _plant(tmp_path, "mxnet_tpu/ops/registry.py", _MINI_REGISTRY)
     _plant(tmp_path, "mxnet_tpu/_debug/telem.py", """\
         import os
@@ -948,7 +951,7 @@ def test_mx014_subscript_env_read_and_telemetry_globals(tmp_path):
 
         def helper():
             t = time.perf_counter()   # telemetry clock: exempt
-            if _MODE == "1":          # env-derived global: NOT exempt
+            if _MODE == "1":          # telemetry-owned global: exempt
                 return t
             return 0.0
         """)
@@ -958,16 +961,21 @@ def test_mx014_subscript_env_read_and_telemetry_globals(tmp_path):
         from ..ops.registry import register
         from .._debug.telem import helper
 
+        _ROUTE = os.environ.get("MXTPU_COMPUTE_ROUTE", "0")
+
         @register("sub_op")
         def sub_op(x):
             helper()
+            if _ROUTE == "1":         # compute-module global: flagged
+                x = x + 1
             return x * int(os.environ["MXTPU_SUBSCRIPT_KNOB"])
         """)
     findings, _, _, _ = _lint_tree(tmp_path, {"MX014"})
     msgs = sorted(f.message for f in findings)
     assert len(findings) == 2, findings
     assert any("MXTPU_SUBSCRIPT_KNOB" in m for m in msgs)
-    assert any("MXTPU_TELEM_MODE" in m for m in msgs)
+    assert any("MXTPU_COMPUTE_ROUTE" in m for m in msgs)
+    assert not any("MXTPU_TELEM_MODE" in m for m in msgs)
     assert not any("clock" in m for m in msgs)
 
 
@@ -1240,3 +1248,100 @@ def test_baseline_suppresses_and_reports(tmp_path):
         assert findings == [] and n_baselined == 1
     finally:
         core.REPO_ROOT = prev
+
+
+# -- MX018: unledgered device-buffer creation (ISSUE 13) ---------------------
+
+def test_mx018_flags_unledgered_device_put(tmp_path):
+    """A device_put in a hot module whose function never reaches a
+    storage.ledger_* choke point is anonymous HBM — flagged."""
+    _plant(tmp_path, "mxnet_tpu/io/myfeed.py", """\
+        import jax
+
+        def place(batch):
+            return jax.device_put(batch)
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX018"})
+    assert [f.code for f in findings] == ["MX018"]
+    assert "device_put" in findings[0].message
+    assert findings[0].path.endswith("myfeed.py")
+
+
+def test_mx018_choke_point_in_function_is_clean(tmp_path):
+    _plant(tmp_path, "mxnet_tpu/storage.py", """\
+        def ledger_register(buf, tag, site=None):
+            pass
+        """)
+    _plant(tmp_path, "mxnet_tpu/io/myfeed.py", """\
+        import jax
+
+        from .. import storage as _storage
+
+        def place(batch):
+            placed = jax.device_put(batch)
+            _storage.ledger_register(placed, "io")
+            return placed
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX018"})
+    assert findings == []
+
+
+def test_mx018_registration_one_call_away_is_clean(tmp_path):
+    """The choke point may live in a helper one resolvable call away
+    (the _ctx_place idiom)."""
+    _plant(tmp_path, "mxnet_tpu/storage.py", """\
+        def ledger_register(buf, tag, site=None):
+            pass
+        """)
+    _plant(tmp_path, "mxnet_tpu/ndarray/myfactory.py", """\
+        import jax
+
+        from .. import storage as _storage
+
+        def _register_io(buf):
+            _storage.ledger_register(buf, "io")
+
+        def place(batch):
+            placed = jax.device_put(batch)
+            _register_io(placed)
+            return placed
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX018"})
+    assert findings == []
+
+
+def test_mx018_jnp_asarray_scoped_to_transport_modules(tmp_path):
+    """jnp.asarray is a creator only in the transport/input modules —
+    and np.asarray (a HOST array) is never one."""
+    _plant(tmp_path, "mxnet_tpu/kvstore_async.py", """\
+        import jax.numpy as jnp
+        import numpy as np
+
+        def pull_decode(host):
+            return jnp.asarray(host)
+
+        def host_only(x):
+            return np.asarray(x)
+        """)
+    _plant(tmp_path, "mxnet_tpu/gluon/parameter.py", """\
+        import jax.numpy as jnp
+
+        def outside_asarray_scope(x):
+            return jnp.asarray(x)
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX018"})
+    assert len(findings) == 1, findings
+    assert findings[0].path.endswith("kvstore_async.py")
+    assert "jnp.asarray" in findings[0].message
+
+
+def test_mx018_waiver_form(tmp_path):
+    _plant(tmp_path, "mxnet_tpu/io/myfeed.py", """\
+        import jax
+
+        def place(batch):
+            # mxlint: disable=MX018 (transient staging buffer: consumed and dropped before the call returns)
+            return jax.device_put(batch)
+        """)
+    findings, _, waived, _ = _lint_tree(tmp_path, {"MX018"})
+    assert findings == []

@@ -1,0 +1,482 @@
+"""HBM memory observability (ISSUE 13): the tagged allocation ledger,
+compiled-program peak attribution, the leak watchdog, and the OOM
+post-mortem.
+
+Pins the acceptance contract:
+
+* ledger tag totals sum to within 5% of ``DeviceStats.bytes_in_use``
+  deltas under ``JAX_PLATFORMS=cpu`` (the live_arrays stats fallback),
+* a fused step with donated weights+state shows ZERO ledger growth
+  across steps; a deliberately retained activation list shows exactly
+  the retained bytes (bulked-eager and ``OpDef.inplace`` forms too),
+* the synthetic-leak watchdog trips EXACTLY once per episode with a
+  dump naming the leaking tag,
+* an injected ``storage.alloc`` fault produces an OOM post-mortem shard
+  carrying the ledger + modeled peaks + the failed request size,
+* ``metrics()['memory']`` is the single owner of allocation accounting
+  and counts with profiling off (the account contract).
+"""
+import gc
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, profiler, storage
+from mxnet_tpu.gluon import nn
+from mxnet_tpu._debug import faultpoint, flightrec, memwatch
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(tmp_path))
+    memwatch.reset()
+    storage.ledger_reset()
+    flightrec.reset_ring()
+    profiler._reset()
+    yield
+    faultpoint.reset()
+    memwatch.reset()
+    storage.ledger_reset()
+    flightrec.reset_ring()
+    profiler._reset()
+
+
+def _settle():
+    """Let transient buffers die and the ledger observe it."""
+    gc.collect()
+    return storage.ledger_metrics()
+
+
+def _bytes_in_use():
+    return sum(s.bytes_in_use for s in storage.stats())
+
+
+# -- the ledger core ---------------------------------------------------------
+
+def test_register_and_weakref_retire():
+    led0 = _settle()
+    a = mx.nd.ones((128, 1024))  # registered 'other' via _ctx_place
+    led1 = _settle()
+    grown = led1["by_tag"]["other"] - led0["by_tag"]["other"]
+    assert grown == a.nbytes
+    del a
+    led2 = _settle()
+    assert led2["by_tag"]["other"] == led0["by_tag"]["other"]
+
+
+def test_pending_retire_marker_validated_and_pruned():
+    """A retire that lands while the registration is still pending must
+    not leave a stale id marker behind once the buffer dies — CPython
+    reuses addresses, and a stale marker would silently swallow some
+    future buffer's registration (review fix)."""
+    a = mx.nd.ones((16, 16))
+    storage.ledger_register(a._data, "workspace")  # pending, undrained
+    storage.ledger_retire(a._data)                 # marker, not entry pop
+    del a
+    gc.collect()
+    storage.ledger_metrics()  # drain: dead pending + marker both prune
+    with storage._ledger_lock:
+        assert storage._retired == {}
+
+
+def test_non_oom_placement_failure_does_not_dump(tmp_path):
+    """An unknown-ctx failure degrades (counted) but must NOT mislabel
+    a post-mortem as OOM or burn the dump cap (review fix)."""
+    class _BadCtx:
+        def jax_device(self):
+            raise TypeError("no such device")
+
+    z = mx.nd.zeros((8, 8), ctx=_BadCtx())
+    assert z.shape == (8, 8)  # degraded to host, never raised
+    assert profiler.metrics()["memory"]["alloc_fallbacks"] == 1
+    assert glob.glob(str(tmp_path / "flightrec_r*_oom_*.json")) == []
+
+
+def test_explicit_retire_is_exactly_once():
+    a = mx.nd.ones((64, 64))
+    led = _settle()
+    base = led["by_tag"]["other"]
+    storage.ledger_retire(a._data)
+    led = storage.ledger_metrics()
+    assert led["by_tag"]["other"] == base - a.nbytes
+    # the weakref death later must not double-retire
+    del a
+    led2 = _settle()
+    assert led2["by_tag"]["other"] == base - 64 * 64 * 4
+
+
+def test_specific_tag_wins_the_slot():
+    """A buffer registered 'other' (creation) then re-registered
+    'param' (adoption) counts once, under param."""
+    a = mx.nd.ones((32, 32))
+    storage.ledger_register(a, "param", site="test")
+    led = _settle()
+    assert led["counts"]["param"] >= 1
+    # not double-counted: total growth is one buffer
+    assert led["by_tag"]["param"] >= a.nbytes
+
+
+def test_eager_activation_sites_carry_op_names():
+    x = mx.nd.ones((64, 64))
+    kept = mx.nd.softmax(x)  # retained activation
+    led = _settle()
+    assert led["by_tag"]["activation"] >= kept.nbytes
+    sites = {s["site"] for s in led["top_sites"]}
+    assert "softmax" in sites
+
+
+def test_ledger_kill_switch():
+    prev = storage.set_ledger_enabled(False)
+    try:
+        a = mx.nd.ones((128, 128))
+        led = _settle()
+        assert led["by_tag"]["other"] == 0
+        assert led["enabled"] is False
+        del a
+    finally:
+        storage.set_ledger_enabled(prev)
+
+
+# -- retained activations: exact bytes (satellite) ---------------------------
+
+def test_retained_activation_list_shows_exact_bytes():
+    x = mx.nd.ones((128, 128))
+    _settle()
+    base = storage.ledger_metrics()["by_tag"]["activation"]
+    retained = [x * (i + 1.0) for i in range(5)]
+    led = _settle()
+    expect = sum(r.nbytes for r in retained)
+    assert led["by_tag"]["activation"] - base == expect
+    # dropping the list retires exactly those bytes
+    retained.clear()
+    led2 = _settle()
+    assert led2["by_tag"]["activation"] == base
+
+
+def test_retained_bulk_activations_exact_bytes():
+    from mxnet_tpu import engine
+    x = mx.nd.ones((64, 64))
+    _settle()
+    base = storage.ledger_metrics()["by_tag"]["activation"]
+    retained = []
+    for _ in range(2):  # second pass replays the cached segment runner
+        with engine.bulk(8):
+            a = x + 1.0
+            b = a * 2.0
+        b.wait_to_read()
+        retained.append(b)
+        del a
+    led = _settle()
+    expect = sum(r.nbytes for r in retained)
+    assert led["by_tag"]["activation"] - base == expect
+
+
+def test_inplace_opdef_update_keeps_ledger_flat():
+    """The OpDef.inplace form (mx.nd.sgd_update's state rebind): the new
+    state buffer registers, the replaced one retires — no growth."""
+    w = mx.nd.ones((64, 64))
+    g = mx.nd.ones((64, 64))
+    mom = mx.nd.zeros((64, 64))
+    for _ in range(3):
+        mx.nd.sgd_mom_update(w, g, mom, out=w, lr=0.1, momentum=0.9)
+    w.wait_to_read()
+    led0 = _settle()
+    total0 = led0["total_bytes"]
+    for _ in range(5):
+        mx.nd.sgd_mom_update(w, g, mom, out=w, lr=0.1, momentum=0.9)
+    w.wait_to_read()
+    led1 = _settle()
+    assert led1["total_bytes"] == total0
+
+
+# -- fused step: donation shows zero growth (satellite) ----------------------
+
+def _train_setup(opt="adam"):
+    rs = np.random.RandomState(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(16))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), opt,
+                            {"learning_rate": 0.01})
+    l2 = gluon.loss.L2Loss()
+    step = gluon.train_step(net, lambda o, t: l2(o, t), trainer)
+    bx = mx.nd.array(rs.rand(32, 32).astype("float32"))
+    by = mx.nd.array(rs.rand(32, 16).astype("float32"))
+    return step, bx, by
+
+
+def test_fused_step_zero_ledger_growth_across_steps():
+    step, bx, by = _train_setup()
+    for _ in range(6):  # warm + compile; params/grads/opt_state settle
+        step(bx, by, batch_size=32)
+    led0 = _settle()
+    for _ in range(10):
+        step(bx, by, batch_size=32)
+    assert step.last_mode == "fused"
+    led1 = _settle()
+    assert led1["total_bytes"] == led0["total_bytes"], (led0, led1)
+    # and the long-lived tags are populated (not trivially zero)
+    assert led1["by_tag"]["param"] > 0
+    assert led1["by_tag"]["grad"] > 0
+    assert led1["by_tag"]["opt_state"] > 0
+
+
+# -- acceptance: tag totals vs DeviceStats deltas (5%) -----------------------
+
+def test_ledger_sums_within_5pct_of_device_bytes_delta():
+    """Under JAX_PLATFORMS=cpu the live_arrays stats fallback makes
+    DeviceStats.bytes_in_use real; a train_step run's ledger growth must
+    explain the device-bytes growth to within 5%."""
+    _settle()
+    base_dev = _bytes_in_use()
+    base_led = storage.ledger_metrics()["total_bytes"]
+    step, bx, by = _train_setup()
+    for _ in range(6):
+        step(bx, by, batch_size=32)
+    assert step.last_mode == "fused"
+    keep = [mx.nd.softmax(bx) for _ in range(4)]  # retained activations
+    gc.collect()
+    dev_delta = _bytes_in_use() - base_dev
+    led_delta = storage.ledger_metrics()["total_bytes"] - base_led
+    assert dev_delta > 0
+    assert abs(led_delta - dev_delta) <= 0.05 * dev_delta, \
+        (led_delta, dev_delta)
+    del keep
+
+
+def test_cpu_device_stats_synthesized_from_live_arrays():
+    before = _bytes_in_use()
+    big = mx.nd.ones((512, 1024))
+    after = _bytes_in_use()
+    assert after - before >= big.nbytes
+    del big
+
+
+# -- compiled-program peak attribution + headroom ----------------------------
+
+def test_fused_step_memory_analysis_in_compile_registry():
+    step, bx, by = _train_setup()
+    for _ in range(4):
+        step(bx, by, batch_size=32)
+    assert step.last_mode == "fused"
+    m = profiler.metrics()
+    mem = m["compile"]["fused_step"].get("memory")
+    assert mem, "fused-step AOT compile did not record memory_analysis"
+    # peak = args + out + temp - alias: under donation (off-CPU) the
+    # weight/state outputs REUSE argument buffers and alias_bytes
+    # records the overlap; on this CPU run alias is 0
+    assert mem["peak_bytes"] == (mem["argument_bytes"]
+                                 + mem["output_bytes"]
+                                 + mem["temp_bytes"]
+                                 - mem["alias_bytes"])
+    assert mem["argument_bytes"] > 0
+    hr = m["memory"]["headroom"]
+    assert hr["modeled_peak_bytes"] == mem["peak_bytes"]
+    # dumps() renders the Memory table
+    text = profiler.dumps()
+    assert "Memory (modeled)" in text
+    assert "memory ledger" in text
+
+
+def test_headroom_gauge_emitted_per_step_while_profiling(tmp_path):
+    step, bx, by = _train_setup()
+    for _ in range(4):
+        step(bx, by, batch_size=32)
+    assert step.last_mode == "fused"
+    fn = str(tmp_path / "prof.json")
+    profiler.set_config(filename=fn, xprof=False)
+    profiler.set_state("run")
+    try:
+        for _ in range(3):
+            step(bx, by, batch_size=32)
+    finally:
+        profiler.set_state("stop")
+    profiler.dump()
+    with open(fn) as f:
+        events = json.load(f)["traceEvents"]
+    gauges = [e for e in events if e.get("name") == "memory.headroom"]
+    assert gauges, "no per-step memory.headroom gauge"
+    assert gauges[0]["args"]["modeled_peak_bytes"] > 0
+
+
+# -- leak watchdog -----------------------------------------------------------
+
+def test_leak_watchdog_trips_once_and_names_tag(tmp_path):
+    memwatch.configure(window=4, warmup_s=0.0, min_bytes=1 << 20,
+                       poll_s=100)
+    leak = []
+    trips = []
+    for _ in range(10):
+        leak.append(mx.nd.ones((256, 1024)))  # 1 MiB each, retained
+        trips.append(memwatch.check_now())
+    assert sum(trips) == 1, trips  # exactly one dump per episode
+    st = memwatch.stats()
+    assert st["trips"] == 1 and st["dumps"] == 1
+    dumps = glob.glob(str(tmp_path / "flightrec_r*_memleak_*.json"))
+    assert len(dumps) == 1
+    with open(dumps[0]) as f:
+        d = json.load(f)
+    info = d["metadata"]["trigger_info"]
+    assert info["grown_bytes"] >= 3 * (1 << 20)
+    assert info["top_tags"][0]["tag"] == "other"
+    assert "slope_bytes_per_s" in info
+    # the bundled metrics carry the full ledger too
+    assert d["metadata"]["metrics"]["memory"]["ledger"]["total_bytes"] > 0
+
+
+def test_leak_watchdog_rearms_after_recede(tmp_path):
+    memwatch.configure(window=3, warmup_s=0.0, min_bytes=1 << 20,
+                       poll_s=100)
+    leak = []
+    trips = 0
+    for _ in range(6):
+        leak.append(mx.nd.ones((256, 1024)))
+        trips += int(memwatch.check_now())
+    assert trips == 1
+    # episode ends: usage recedes, window refills, second leak re-trips
+    leak.clear()
+    gc.collect()
+    for _ in range(3):
+        memwatch.check_now()
+    leak2 = []
+    for _ in range(6):
+        leak2.append(mx.nd.ones((256, 1024)))
+        trips += int(memwatch.check_now())
+    assert trips == 2
+    assert memwatch.stats()["trips"] == 2
+
+
+def test_leak_watchdog_ignores_churn():
+    """Non-monotone usage (alloc/free churn) never trips."""
+    memwatch.configure(window=4, warmup_s=0.0, min_bytes=1 << 20,
+                       poll_s=100)
+    for i in range(12):
+        a = mx.nd.ones((512, 1024))  # 2 MiB, dropped each iteration
+        assert memwatch.check_now() is False
+        del a
+        gc.collect()
+    assert memwatch.stats()["trips"] == 0
+
+
+def test_memwatch_warmup_blocks_arming():
+    memwatch.configure(window=2, warmup_s=3600.0, min_bytes=1,
+                       poll_s=100)
+    leak = [mx.nd.ones((256, 1024))]
+    for _ in range(5):
+        leak.append(mx.nd.ones((256, 1024)))
+        assert memwatch.check_now() is False
+
+
+# -- OOM post-mortem ---------------------------------------------------------
+
+def test_injected_alloc_fault_writes_oom_shard(tmp_path):
+    step, bx, by = _train_setup()
+    for _ in range(4):
+        step(bx, by, batch_size=32)  # modeled peaks exist
+    faultpoint.configure("storage.alloc=raise:RuntimeError@n=1")
+    z = mx.nd.zeros((128, 128))  # degrades to host, never raises
+    assert z.shape == (128, 128)
+    shards = glob.glob(str(tmp_path / "flightrec_r*_oom_*.json"))
+    assert len(shards) == 1
+    with open(shards[0]) as f:
+        d = json.load(f)
+    info = d["metadata"]["trigger_info"]
+    assert info["where"] == "storage.alloc"
+    assert info["requested_bytes"] == 128 * 128 * 4
+    assert "ledger_by_tag" in info
+    metrics = d["metadata"]["metrics"]
+    assert "ledger" in metrics["memory"]         # the full ledger
+    assert metrics["compile"]["fused_step"]["memory"]["peak_bytes"] > 0
+    assert metrics["memory"]["alloc_fallbacks"] == 1
+
+
+def test_is_oom_classifier():
+    assert memwatch.is_oom(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                     "1073741824 bytes"))
+    assert memwatch.is_oom(ValueError("Out of memory while trying"))
+    assert not memwatch.is_oom(ValueError("shape mismatch"))
+    assert not memwatch.is_oom(None)
+
+
+def test_oom_excepthook_upgrade_and_no_double_dump(tmp_path):
+    """An unhandled OOM-looking exception dumps with trigger 'oom'; one
+    already reported via oom_report yields NO second shard."""
+    exc = RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+    flightrec._sys_excepthook(RuntimeError, exc, None)
+    shards = glob.glob(str(tmp_path / "flightrec_r*_oom_*.json"))
+    assert len(shards) == 1
+    exc2 = RuntimeError("RESOURCE_EXHAUSTED: out of memory again")
+    memwatch.oom_report(exc2, requested_bytes=7, where="test")
+    flightrec._sys_excepthook(RuntimeError, exc2, None)
+    shards = sorted(glob.glob(str(tmp_path / "flightrec_r*_oom_*.json")))
+    assert len(shards) == 2  # one per exception, never two for one
+    # a NON-oom exception still dumps under the plain trigger
+    flightrec._sys_excepthook(ValueError, ValueError("boom"), None)
+    assert glob.glob(str(tmp_path / "flightrec_r*_exception_*.json"))
+
+
+# -- metrics()['memory'] single ownership (satellite) ------------------------
+
+def test_alloc_fallbacks_counted_with_profiling_off():
+    assert not profiler.is_running()
+    faultpoint.configure("storage.alloc=raise:RuntimeError@n=2")
+    mx.nd.zeros((8, 8))
+    mx.nd.zeros((8, 8))
+    faultpoint.reset()
+    m = profiler.metrics()
+    assert m["memory"]["alloc_fallbacks"] == 2
+    # single owner: the old generic counter namespace no longer has it
+    assert "storage.alloc_fallbacks" not in m["counters"]
+
+
+def test_empty_cache_counted_with_profiling_off():
+    assert not profiler.is_running()
+    before = profiler.metrics()["memory"]["empty_cache_calls"]
+    storage.empty_cache()
+    storage.release_all()
+    m = profiler.metrics()
+    assert m["memory"]["empty_cache_calls"] == before + 2
+
+
+def test_memory_section_shape_and_prometheus():
+    a = mx.nd.ones((64, 64))
+    _settle()
+    m = profiler.metrics()
+    mem = m["memory"]
+    assert set(storage.LEDGER_TAGS) == set(mem["ledger"]["by_tag"])
+    assert {"alloc_fallbacks", "empty_cache_calls",
+            "ledger"} <= set(mem)
+    assert "memwatch" in mem
+    text = profiler.prometheus_text()
+    assert 'mxtpu_memory_ledger_bytes{rank="0",tag="other"}' in text
+    assert "mxtpu_memory_alloc_events_total" in text
+    del a
+
+
+def test_ledger_series_in_memory_lane(tmp_path):
+    """profile_memory runs emit the per-tag memory.ledger Counter
+    series in the memory lane (sampler-daemon fed)."""
+    import time
+    fn = str(tmp_path / "prof.json")
+    profiler.set_config(filename=fn, profile_memory=True, xprof=False)
+    profiler.set_state("run")
+    try:
+        keep = mx.nd.ones((128, 128))
+        time.sleep(0.4)  # let the sampler daemon tick
+    finally:
+        profiler.set_state("stop")
+        profiler.set_config(profile_memory=False)
+    profiler.dump()
+    with open(fn) as f:
+        events = json.load(f)["traceEvents"]
+    series = [e for e in events if e.get("name") == "memory.ledger"]
+    assert series, "no memory.ledger counter series"
+    assert series[-1]["tid"] == profiler.LANES["memory"]
+    assert any(v > 0 for v in series[-1]["args"].values())
+    del keep

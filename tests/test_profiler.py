@@ -235,10 +235,14 @@ def test_memory_sampling_counters_and_table():
     assert set(mem[0]["args"]) == {"bytes_in_use", "peak_bytes_in_use"}
     assert "Device memory" in profiler.dumps()
     m = profiler.metrics()
-    assert m["memory"], "metrics() lost the memory snapshot"
-    for vals in m["memory"].values():
+    assert m["memory"]["devices"], "metrics() lost the memory snapshot"
+    for vals in m["memory"]["devices"].values():
         assert {"bytes_in_use", "peak_bytes_in_use",
                 "peak_since_reset"} <= set(vals)
+    # the memory section is the single owner of allocation accounting
+    # and the ledger (ISSUE 13)
+    assert "ledger" in m["memory"]
+    assert "alloc_fallbacks" in m["memory"]
 
 
 def test_memory_sampling_off_by_default():

@@ -25,12 +25,20 @@ from . import project as _project
 # MX014 — traced-ambient-state capture
 # ---------------------------------------------------------------------------
 
-# Telemetry modules: clock reads there are trace-emission TIMESTAMPS
-# (span metadata recorded on the host), never values that flow into a
-# traced graph — MX007 already polices their clock discipline
-# (monotonic-only). The env-read clause still applies to them.
+# Telemetry/introspection modules: their ambient state — clocks, event
+# tags (PID), recorder switches, ring caps, the allocation-ledger knobs
+# — gates what gets RECORDED about a program, never a value that flows
+# into a traced graph (no function here returns array data to a
+# caller). Since ISSUE 13 weaves ledger/detector hooks into code the
+# call graph reaches from trace entries, the whole dump/metrics
+# subsystem LOOKS trace-reachable statically; exempting these modules
+# from all three clauses keeps the rule aimed at its real target —
+# compute modules whose env reads shape cached executables (the PR 9
+# bug class). MX007 polices their clock discipline and MX015 their env
+# contract regardless.
 _TELEMETRY_MODULES = (
     "mxnet_tpu/profiler.py",
+    "mxnet_tpu/storage.py",  # introspection + the allocation ledger
     "mxnet_tpu/_debug/",
     "mxnet_tpu/pallas_kernels/_compile_attr.py",  # compile attribution
 )
@@ -127,23 +135,22 @@ class MX014TracedAmbientState:
                 continue
             fn = model.functions[key]
             mf = model.modules[path]
-            for kind, name, ln, family in fn.env_reads:
-                label = name if isinstance(name, str) else (
-                    family if family else "<computed>")
-                if isinstance(name, str) and name in tokens:
-                    continue
-                out.append(Finding(
-                    self.code, path, ln,
-                    "env read of %r inside traced code (reachable from "
-                    "a trace entry via %s) — the value is baked into "
-                    "the cached executable; register it with "
-                    "register.register_signature_token so flipping it "
-                    "recompiles, or hoist the read out of the traced "
-                    "path" % (label, qual)))
-            if not any(path.startswith(t) for t in _TELEMETRY_MODULES):
-                # the telemetry exemption covers ONLY this clause:
-                # clocks/RNG there are span metadata, but env-derived
-                # globals and env reads stay checked everywhere
+            telemetry = any(path.startswith(t)
+                            for t in _TELEMETRY_MODULES)
+            if not telemetry:
+                for kind, name, ln, family in fn.env_reads:
+                    label = name if isinstance(name, str) else (
+                        family if family else "<computed>")
+                    if isinstance(name, str) and name in tokens:
+                        continue
+                    out.append(Finding(
+                        self.code, path, ln,
+                        "env read of %r inside traced code (reachable "
+                        "from a trace entry via %s) — the value is "
+                        "baked into the cached executable; register it "
+                        "with register.register_signature_token so "
+                        "flipping it recompiles, or hoist the read out "
+                        "of the traced path" % (label, qual)))
                 for akind, dn, ln in fn.ambient:
                     what = "clock" if akind == "clock" else "host RNG"
                     out.append(Finding(
@@ -154,12 +161,20 @@ class MX014TracedAmbientState:
                         "thread it in as an operand (clocks) or use "
                         "the framework key plumbing (RNG)"
                         % (what, dn, qual)))
+            if telemetry:
+                continue
+
+            def _telemetry_target(target_mf):
+                return any(target_mf.path.startswith(t)
+                           for t in _TELEMETRY_MODULES)
+
             for ref, ln in fn.refs:
                 if "." in ref:
                     alias, attr = ref.split(".", 1)
                     target = model.by_name.get(
                         mf.imports.get(alias, ""))
-                    if target and attr in target.env_globals and \
+                    if target and not _telemetry_target(target) and \
+                            attr in target.env_globals and \
                             target.env_globals[attr] not in tokens:
                         out.append(self._global_finding(
                             path, ln, ref, target.env_globals[attr],
@@ -576,6 +591,113 @@ class MX016UseAfterDonation:
 
 
 # ---------------------------------------------------------------------------
+# MX018 — unledgered device-buffer creation
+# ---------------------------------------------------------------------------
+
+# Hot modules under the allocation-ledger contract (ISSUE 13): the
+# dispatch/creation core, input placement, the kvstore transport, and
+# the fused-step adoption path.
+_LEDGER_HOT = (
+    "mxnet_tpu/ndarray/",
+    "mxnet_tpu/io/",
+    "mxnet_tpu/kvstore_async.py",
+    "mxnet_tpu/gluon/parameter.py",
+    "mxnet_tpu/gluon/fused_step.py",
+)
+# jnp.asarray creates device buffers too, but flagging it everywhere
+# would drown the rule in index/scalar conversions — it is a creator
+# only in the transport/input modules, where an asarray IS a fresh
+# resident payload buffer.
+_ASARRAY_SCOPED = ("mxnet_tpu/kvstore_async.py", "mxnet_tpu/io/")
+# The ledger choke points (storage.py) + the cached hot alias spelling.
+_LEDGER_CHOKES = frozenset((
+    "ledger_register", "ledger_register_tree", "ledger_retire",
+    "pending_append", "_ctx_place", "_LEDGER_ACT", "_place",
+))
+
+
+class MX018UnledgeredBufferCreation:
+    """Device-buffer creation in the hot modules — ``jax.device_put``
+    anywhere, ``jnp.asarray`` in the transport/input modules — must
+    flow through the tagged allocation ledger (ISSUE 13): the creating
+    function calls a ``storage.ledger_*`` choke point (or a helper one
+    resolvable call away that does), so every resident buffer carries a
+    category tag and an OOM post-mortem can name what was resident. A
+    creation site the ledger cannot see is anonymous HBM — exactly the
+    blind spot the ledger exists to close. Waive only buffers that are
+    provably transient or re-registered by their adopter, with the
+    justification saying which."""
+
+    code = "MX018"
+    summary = "device-buffer creation site misses the allocation ledger"
+    kind = "python"
+    project = True
+
+    def scope(self, path):
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    @staticmethod
+    def _leaf(dn):
+        return dn.rsplit(".", 1)[-1]
+
+    def _creator_calls(self, path, fn):
+        out = []
+        asarray_ok = any(path.startswith(p) for p in _ASARRAY_SCOPED)
+        for dn, ln, _a, _k in fn.calls:
+            leaf = self._leaf(dn)
+            if leaf == "device_put":
+                out.append((dn, ln))
+            elif asarray_ok and leaf == "asarray" and (
+                    dn.split(".")[0] == "jnp"
+                    or dn.endswith("jax.numpy.asarray")):
+                # np.asarray makes HOST arrays — only the jnp spelling
+                # creates a device buffer
+                out.append((dn, ln))
+        return out
+
+    def _calls_choke(self, fn):
+        return any(self._leaf(dn) in _LEDGER_CHOKES
+                   for dn, _ln, _a, _k in fn.calls)
+
+    def _registered(self, model, key, fn, depth=1):
+        """The function (or a callee one resolvable hop away, or a
+        nested closure it builds) reaches a ledger choke point."""
+        if self._calls_choke(fn):
+            return True
+        if depth <= 0:
+            return False
+        for nxt in model.edges_from(key):
+            nfn = model.functions.get(nxt)
+            if nfn is not None and self._registered(model, nxt, nfn,
+                                                    depth - 1):
+                return True
+        return False
+
+    def check_project(self, model):
+        out = []
+        for key in sorted(model.functions):
+            path, qual = key
+            if not any(path.startswith(p) for p in _LEDGER_HOT):
+                continue
+            fn = model.functions[key]
+            creators = self._creator_calls(path, fn)
+            if not creators:
+                continue
+            if self._registered(model, key, fn):
+                continue
+            for dn, ln in creators:
+                out.append(Finding(
+                    self.code, path, ln,
+                    "%s() in %s creates a device buffer the allocation "
+                    "ledger never sees — register it at a "
+                    "storage.ledger_* choke point (tag taxonomy in "
+                    "docs/OBSERVABILITY.md) or waive with a "
+                    "justification naming why the buffer is transient "
+                    "or re-registered by its adopter" % (dn, qual)))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # MX017 — static lock-order graph
 # ---------------------------------------------------------------------------
 
@@ -679,4 +801,5 @@ DATAFLOW_RULES = (
     MX015EnvContract(),
     MX016UseAfterDonation(),
     MX017StaticLockOrder(),
+    MX018UnledgeredBufferCreation(),
 )

@@ -350,6 +350,15 @@ def _dump(trigger, extra, path):
         metrics = {"error": "%s: %s" % (type(e).__name__, e)}
     with _context_lock:
         context = dict(_context)
+    # the run-level goodput partition (ISSUE 14): a post-mortem names
+    # not just the instant of death but what the whole run's wall-clock
+    # had bought up to it. Lazy import — goodput bottom-imports the
+    # profiler, which imports this module at load.
+    try:
+        from . import goodput
+        goodput_block = goodput.snapshot()
+    except Exception:
+        goodput_block = None
     data = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -362,6 +371,7 @@ def _dump(trigger, extra, path):
             "python_stacks": _thread_stacks(),
             "metrics": metrics,
             "faults": faultpoint.metrics(),
+            "goodput": goodput_block,
             "context": context,
             "ring": {"buffered": len(entries), "capacity": _CAP},
         },

@@ -51,12 +51,13 @@ import threading
 import time
 
 from . import flightrec as _flightrec
+from . import goodput as _goodput
 from . import locktrace as _locktrace
 from ..base import getenv as _getenv
 
 __all__ = [
-    "ENABLED", "configure", "reset", "step_begin", "step_end",
-    "last_step", "threshold_s", "stats", "check_now",
+    "ENABLED", "configure", "reset", "reset_window", "step_begin",
+    "step_end", "last_step", "threshold_s", "stats", "check_now",
 ]
 
 
@@ -76,12 +77,13 @@ _seq = 0         # beacon sequence: id of the newest step_begin
 _depth = 0       # re-entrancy: nested loops track the OUTER step
 _inflight = None  # (seq, monotonic start) of the running outer step
 _inflight_warmup = False  # a nested warmup end taints the outer step
+_inflight_mode = None     # nested step's execution mode (fused_step)
 _last = None     # (seq, dur_s) of the newest COMPLETED step
 _tripped = None  # seq already dumped for — exactly one dump per stall
 _stats = {"steps": 0, "warmup_steps": 0, "stalls": 0, "dumps": 0,
           "slow_steps": 0, "armed": 0, "median_s": 0.0,
           "threshold_s": 0.0, "last_stall_step": -1,
-          "last_stall_elapsed_s": 0.0}
+          "last_stall_elapsed_s": 0.0, "window_resets": 0}
 _thread = None
 _stop = None
 
@@ -127,7 +129,7 @@ def reset():
     """Stop the poller and clear all state; knobs re-read from the env
     (test isolation)."""
     global _seq, _depth, _inflight, _last, _tripped, _thread, _stop
-    global ENABLED, _durs
+    global ENABLED, _durs, _inflight_warmup, _inflight_mode
     with _lock:
         stop, thread = _stop, _thread
         _thread = _stop = None
@@ -138,6 +140,8 @@ def reset():
     with _lock:
         _seq = _depth = 0
         _inflight = _last = _tripped = None
+        _inflight_warmup = False
+        _inflight_mode = None
         _cfg.clear()
         _cfg.update(_defaults())
         _durs = collections.deque(maxlen=_cfg["window"])
@@ -147,6 +151,22 @@ def reset():
         _stats["last_stall_elapsed_s"] = 0.0
     ENABLED = _getenv("MXTPU_WATCHDOG", "1") not in (
         "0", "false", "off")
+
+
+def reset_window():
+    """Drop the rolling step-time median window — nothing else: the
+    poller, cumulative stats and the in-flight beacon survive.
+
+    Called by ``elastic_train_loop`` on every reshard/restore: step
+    durations measured at the OLD world size pollute the median after a
+    resize — a shrunk world's slower cadence against a fast stale
+    median trips false stalls, and a grown world's fast cadence against
+    a slow stale median masks real ones. Clearing the window disarms
+    the watchdog until ``min_samples`` fresh steps at the NEW cadence
+    complete (the same warm-up discipline the compile step gets)."""
+    with _lock:
+        _durs.clear()
+        _stats["window_resets"] += 1
 
 
 def _poll_interval():
@@ -191,7 +211,7 @@ def stats():
 def step_begin():
     """Mark the start of a training step (re-entrant). Starts the
     poller thread lazily on first use when the watchdog is enabled."""
-    global _seq, _depth, _inflight, _inflight_warmup
+    global _seq, _depth, _inflight, _inflight_warmup, _inflight_mode
     if not ENABLED:
         return
     with _lock:
@@ -201,45 +221,67 @@ def step_begin():
         _seq += 1
         _inflight = (_seq, time.monotonic())
         _inflight_warmup = False
+        _inflight_mode = None
     _ensure_thread()
 
 
-def step_end(warmup=False):
+def step_end(warmup=False, mode=None):
     """Mark the end of the innermost-begun step. ``warmup=True`` steps
     (eager warming, jit compile, fallbacks) complete the beacon but do
     not feed the median — they are not representative of steady state.
     A nested warmup end taints the whole outer step: when
     ``elastic_train_loop``'s beacon wraps a fused step whose inner end
     reported warmup, the outer completion is warmup too (the outer
-    duration CONTAINS the compile)."""
-    global _depth, _inflight, _last, _inflight_warmup
+    duration CONTAINS the compile). ``mode`` carries the fused step's
+    execution mode (``fused``/``compile``/``eager-warming``/
+    ``fallback:*``) so the goodput run ledger can attribute the step's
+    wall time to compute vs compile vs host overhead — a nested mode
+    taints the outer completion the same way warmup does.
+
+    The completed step feeds ``goodput.note_step`` AFTER this module's
+    lock is released — and that feed is itself one lock-free
+    GIL-atomic append riding the beacon's own clock reads."""
+    global _depth, _inflight, _last, _inflight_warmup, _inflight_mode
     if not ENABLED:
         return
+    done = None
     with _lock:
         if _depth == 0:
             return
         _depth -= 1
         if warmup:
             _inflight_warmup = True
+        if mode is not None:
+            _inflight_mode = mode
         if _depth > 0 or _inflight is None:
             return
         seq, t0 = _inflight
         _inflight = None
         warmup = warmup or _inflight_warmup
+        mode = mode if mode is not None else _inflight_mode
         _inflight_warmup = False
+        _inflight_mode = None
         dur = time.monotonic() - t0
         _last = (seq, dur)
+        done = (t0, dur, warmup, mode)
         if warmup:
             _stats["warmup_steps"] += 1
-            return
-        _stats["steps"] += 1
-        thr = (max(_cfg["factor"] * _median_locked(), _cfg["min_s"])
-               if len(_durs) >= _cfg["min_samples"] else None)
-        _durs.append(dur)
-        if thr is not None and dur > thr and seq != _tripped:
-            # finished, but way beyond the envelope: a straggler
-            # (the in-flight poller may already have dumped for it)
-            _stats["slow_steps"] += 1
+        else:
+            _stats["steps"] += 1
+            thr = (max(_cfg["factor"] * _median_locked(),
+                       _cfg["min_s"])
+                   if len(_durs) >= _cfg["min_samples"] else None)
+            _durs.append(dur)
+            if thr is not None and dur > thr and seq != _tripped:
+                # finished, but way beyond the envelope: a straggler
+                # (the in-flight poller may already have dumped for it)
+                _stats["slow_steps"] += 1
+    if _goodput.OPEN:
+        # the goodput feed rides the beacon's OWN clock reads (t0/dur
+        # above): the run ledger costs this one call per STEP, nothing
+        # per op (BENCH_MODEL=goodput_overhead prices it)
+        _goodput.note_step(done[0], done[1], warmup=done[2],
+                           mode=done[3])
 
 
 def check_now():
@@ -286,6 +328,9 @@ def _loop(stop):
     while not stop.wait(_poll_interval()):
         try:
             _check(time.monotonic())
+            # drain the goodput ledger's hot-path mailboxes off the
+            # training thread (the PR 12 drain-on-whoever-asks idiom)
+            _goodput.fold_pending()
         except Exception:
             pass  # the watchdog must never take the training loop down
 

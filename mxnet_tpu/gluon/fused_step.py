@@ -250,7 +250,10 @@ class FusedTrainStep:
                                         ignore_stale_grad)
         finally:
             self.last_mode = mode
-            _watchdog.step_end(warmup=mode != "fused")
+            # mode rides the beacon so the goodput run ledger can split
+            # step wall time into compute ('fused') vs compile
+            # ('compile'/'eager-warming') vs host-bound fallbacks
+            _watchdog.step_end(warmup=mode != "fused", mode=mode)
             if t0 is not None:
                 dur_us = (_time.perf_counter() - t0) * 1e6
                 _profiler.record_op(

@@ -11,6 +11,7 @@ import numpy as np
 from ..ndarray import NDArray, array as nd_array
 from .. import ndarray as nd
 from .. import profiler as _profiler
+from .._debug import goodput as _goodput
 from . import _stats
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
@@ -387,7 +388,11 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
-        t0 = _time.perf_counter() if _profiler._LIVE else None
+        # goodput.OPEN joins the guard: with the recorder AND profiler
+        # off but a goodput run open, input stalls must still book
+        # under input_wait, not silently land in host_overhead
+        t0 = _time.perf_counter() \
+            if _profiler._LIVE or _goodput.OPEN else None
         batch = self._next_impl()
         _stats.set_gauge("prefetch_queue_depth", self._queue.qsize())
         if t0 is not None:
@@ -401,6 +406,10 @@ class PrefetchingIter(DataIter):
             _profiler.record_latency("io.prefetch_wait", wait_us)
             _profiler.record_counter("io.prefetch_queue_depth",
                                      self._queue.qsize(), lane="io")
+            if _goodput.OPEN:
+                # the run ledger's input_wait category rides the SAME
+                # wait_us this guard already measured — no new clocks
+                _goodput.note_input_wait(wait_us)
         return batch
 
     def _next_impl(self):

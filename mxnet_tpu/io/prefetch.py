@@ -18,6 +18,7 @@ import time as _time
 
 from .. import profiler as _profiler
 from .._debug import faultpoint as _faultpoint
+from .._debug import goodput as _goodput
 from . import _stats
 
 __all__ = ["DevicePrefetchIter", "DevicePrefetcher"]
@@ -166,8 +167,11 @@ class DevicePrefetchIter:
         if self._worker_failed:
             raise StopIteration
         # batch-fetch span: how long the consumer stalled waiting on the
-        # producer (queue-empty time = the pipeline is io-bound)
-        t0 = _time.perf_counter() if _profiler._LIVE else None
+        # producer (queue-empty time = the pipeline is io-bound).
+        # goodput.OPEN joins the guard so input_wait attribution
+        # survives a flightrec-off deployment
+        t0 = _time.perf_counter() \
+            if _profiler._LIVE or _goodput.OPEN else None
         item = self._q.get()
         _stats.set_gauge("prefetch_queue_depth", self._q.qsize())
         if t0 is not None:
@@ -181,6 +185,9 @@ class DevicePrefetchIter:
             _profiler.record_latency("io.prefetch_wait", wait_us)
             _profiler.record_counter("io.prefetch_queue_depth",
                                      self._q.qsize(), lane="io")
+            if _goodput.OPEN:
+                # goodput input_wait rides the already-measured stall
+                _goodput.note_input_wait(wait_us)
         if item is _SENTINEL:
             raise StopIteration
         if isinstance(item, BaseException):

@@ -47,11 +47,16 @@ from .. import _retry
 from .. import profiler as _profiler
 from .._debug import faultpoint as _faultpoint
 from .._debug import flightrec as _flightrec
+from .._debug import goodput as _goodput
 from .._debug import locktrace as _locktrace
 from . import _stats
 from ..base import getenv as _getenv
 
 __all__ = ["DecodePool"]
+
+# distinguishes "no slot delivered" from a legitimate None item in the
+# consumer's post-lock telemetry hand-off
+_NO_RESULT = object()
 
 
 def _env_int(name, default):
@@ -287,7 +292,16 @@ class DecodePool:
         return self
 
     def __next__(self):
+        # consumer-stall timing (the input-wait half of the goodput
+        # ledger + the shared io.prefetch_wait histogram): measured
+        # over the whole ordered-slot wait, recorded AFTER the pool
+        # condition is released so the telemetry locks never nest
+        # under it. goodput.OPEN joins the guard so input_wait
+        # attribution survives a flightrec-off deployment
+        t0 = _time.perf_counter() \
+            if _profiler._LIVE or _goodput.OPEN else None
         err = None
+        result = _NO_RESULT
         with self._cond:
             if self._dead:
                 # terminal error already surfaced once: the pool reads
@@ -302,7 +316,8 @@ class DecodePool:
                         self._dead = True
                         err = val
                         break
-                    return val
+                    result = val
+                    break
                 if self._exhausted and self._last is not None \
                         and self._expect >= self._last:
                     # everything owed was delivered — a pool that
@@ -314,6 +329,13 @@ class DecodePool:
                     err = self._failed
                     break
                 self._cond.wait(0.05)
+        if result is not _NO_RESULT:
+            if t0 is not None:
+                wait_us = (_time.perf_counter() - t0) * 1e6
+                _profiler.record_latency("io.prefetch_wait", wait_us)
+                if _goodput.OPEN:
+                    _goodput.note_input_wait(wait_us)
+            return result
         _stats.bump("pool_failures")
         raise err
 

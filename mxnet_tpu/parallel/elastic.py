@@ -50,6 +50,7 @@ import numpy as np
 from .. import profiler as _profiler
 from .._debug import faultpoint as _faultpoint
 from .._debug import flightrec as _flightrec
+from .._debug import goodput as _goodput
 from .._debug import watchdog as _watchdog
 from .sharding import host_array
 from ..base import getenv as _getenv
@@ -159,6 +160,7 @@ class CheckpointManager:
         published step restorable and at worst a `.tmp` leftover or a
         marker-less dir, which `all_steps()` never considers and the
         next `save()` prunes."""
+        t0 = time.monotonic()
         path = self._step_path(step)
         tmp = path + ".tmp"
         host_state = jax.tree_util.tree_map(host_array, state)
@@ -195,6 +197,16 @@ class CheckpointManager:
         _profiler.bump_elastic("checkpoint_saves",
                                args={"step": int(step)})
         self._prune()
+        # checkpoint span (rare path — its own clock reads are fine):
+        # the trace lane sees it while profiling runs, the flight
+        # recorder always, and the goodput run ledger books the wall
+        # time under 'checkpoint'
+        dur_s = time.monotonic() - t0
+        _profiler.record_op("elastic.checkpoint_save", dur_s * 1e6,
+                            category="elastic", lane="user",
+                            args={"step": int(step)})
+        if _goodput.OPEN:
+            _goodput.note_checkpoint(dur_s, "save")
         return path
 
     def restore(self, step=None):
@@ -208,10 +220,23 @@ class CheckpointManager:
             # caller's recovery path exactly where a real read failure
             # (lost filesystem, corrupt bytes) would surface
             _faultpoint.check("elastic.restore")
+        t0 = time.monotonic()
+
+        def _done(state, s):
+            dur_s = time.monotonic() - t0
+            _profiler.record_op("elastic.checkpoint_restore",
+                                dur_s * 1e6, category="elastic",
+                                lane="user", args={"step": s})
+            if _goodput.OPEN:
+                # inside a recovery interval the interval's own clock
+                # covers this time; note_checkpoint only counts then
+                _goodput.note_checkpoint(dur_s, "restore")
+            return state, s
+
         if step is not None:
             state = self._load(self._step_path(step))
             _profiler.bump_elastic("restores", args={"step": int(step)})
-            return state, int(step)
+            return _done(state, int(step))
         for s in reversed(self.all_steps()):
             try:
                 state = self._load(self._step_path(s))
@@ -222,7 +247,7 @@ class CheckpointManager:
                                        args={"step": int(s)})
                 continue
             _profiler.bump_elastic("restores", args={"step": int(s)})
-            return state, int(s)
+            return _done(state, int(s))
         return None, None
 
     def _load(self, path):
@@ -573,6 +598,17 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                                         "100"))
     batches = list(batches)
 
+    # run-level goodput ledger (ISSUE 14): the loop brackets the run,
+    # so every second between here and the return is attributed. An
+    # already-open run (an outer harness opened one) is left alone.
+    run_meta = {"loop": "elastic_train_loop", "batches": len(batches),
+                "save_every": int(save_every or 0)}
+    if controller is not None:
+        run_meta["world"] = list(controller.world)
+        run_meta["rank"] = controller.rank
+    own_run = _goodput.open_run(meta=run_meta) \
+        if _goodput.ENABLED and not _goodput.is_open() else None
+
     def _unwrap(restored):
         """Split a restored payload: adopt the embedded data cursor
         (when present) and return the bare train state. Pre-weld
@@ -585,13 +621,32 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
         return restored
 
     start = 0
-    restored, step0 = ckpt.restore()
-    if restored is not None:
-        state = _retree(state, _unwrap(restored))
-        start = step0 + 1
-        if on_restore is not None:
-            on_restore(state, step0)
-        log.info("elastic: resumed from checkpoint step %d", step0)
+    # resuming a previous incarnation IS recovery badput: the interval
+    # (restore probe + load + re-layout) books under 'recovery' when a
+    # checkpoint existed, and is discarded when this is a fresh run. A
+    # restore that RAISES (the elastic.restore faultpoint, a lost
+    # filesystem) must still close the run it opened — a leaked-open
+    # run would suppress every later loop's manifest in this process
+    try:
+        _goodput.recovery_begin()
+        restored, step0 = ckpt.restore()
+        if restored is not None:
+            state = _retree(state, _unwrap(restored))
+            start = step0 + 1
+            if on_restore is not None:
+                on_restore(state, step0)
+            _watchdog.reset_window()
+            _goodput.recovery_end(kind="resume", restored_step=step0)
+            log.info("elastic: resumed from checkpoint step %d", step0)
+        else:
+            _goodput.recovery_end(count=False)
+    except BaseException:
+        # book the failed attempt's wall time as recovery (no-op when
+        # the interval already ended) and publish the failed run
+        _goodput.recovery_end(kind="resume", ok=False)
+        if own_run is not None:
+            _goodput.close_run(outcome="failed")
+        raise
 
     def _save(step):
         payload = state
@@ -608,86 +663,134 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
     def _recover(need_reshard):
         """Reshard (when attributed to a dead rank) then rewind to the
         newest checkpoint; returns (state, next index) or None when no
-        checkpoint exists (caller re-raises the original error)."""
+        checkpoint exists (caller re-raises the original error).
+
+        The whole interval — policy check, reshard, restore, re-layout
+        — is one goodput 'recovery' span, and the watchdog's rolling
+        median window resets on the way out: durations from the old
+        world size must not police the resized world's cadence."""
         nonlocal state
-        if need_reshard and controller is not None:
-            if ckpt.latest_step() is None:
-                # nothing to rewind to: bail BEFORE the reshard commits
-                # a shrunk world the caller can't resume into
+        _goodput.recovery_begin()
+        resharded = False
+        s0 = None
+        ok = False
+        try:
+            if need_reshard and controller is not None:
+                if ckpt.latest_step() is None:
+                    # nothing to rewind to: bail BEFORE the reshard
+                    # commits a shrunk world the caller can't resume
+                    # into
+                    return None
+                survivors, state = controller.reshard(state)
+                resharded = True
+                if data_service is not None:
+                    # the dead rank's unconsumed shards reassign onto
+                    # the survivors — pure math over committed state,
+                    # so every survivor computes the identical new
+                    # ownership
+                    data_service.resize(survivors)
+            restored, s0 = ckpt.restore()
+            if restored is None:
                 return None
-            survivors, state = controller.reshard(state)
-            if data_service is not None:
-                # the dead rank's unconsumed shards reassign onto the
-                # survivors — pure math over committed state, so every
-                # survivor computes the identical new ownership
-                data_service.resize(survivors)
-        restored, s0 = ckpt.restore()
-        if restored is None:
-            return None
-        state = _retree(state, _unwrap(restored))
-        if on_restore is not None:
-            on_restore(state, s0)
-        return state, s0 + 1
+            state = _retree(state, _unwrap(restored))
+            if on_restore is not None:
+                on_restore(state, s0)
+            ok = True
+            return state, s0 + 1
+        finally:
+            _watchdog.reset_window()
+            _goodput.recovery_end(
+                kind="reshard" if resharded else "restore",
+                resharded=resharded,
+                restored_step=s0 if ok else None,
+                replay_span=max(0, hi - s0) if ok else 0, ok=ok)
 
     failures = 0
     i = start
-    with PreemptionGuard() as guard:
-        while i < len(batches):
-            if guard.preempted:
-                last = i - 1
-                if i > start or restored is not None:
-                    _save(last)
-                _profiler.bump_elastic("preemptions",
-                                       args={"step": last})
-                log.warning("elastic: preempted, checkpointed step %d",
-                            last)
-                return state, last, False
-            if controller is not None and controller.poll():
-                # a rank died even though OUR step succeeded: reshard
-                # proactively and rewind to the newest checkpoint so
-                # every survivor resumes from the same consistent point
-                rec = _recover(need_reshard=True)
-                if rec is None:
-                    raise RuntimeError(
-                        "elastic: rank(s) %s died before the first "
-                        "checkpoint; nothing to reshard from"
-                        % controller.dead_ranks)
-                state, i = rec
-                failures = 0
-                continue
-            try:
-                # watchdog beacon: a step wedged in a dead-rank
-                # collective trips the stall detector and dumps the
-                # flight record while this loop is still blocked
-                # (re-entrant: a fused step_fn's own beacon nests)
-                _watchdog.step_begin()
-                try:
-                    state, _ = step_fn(state, batches[i])
-                finally:
-                    _watchdog.step_end()
-                failures = 0
-            except Exception as e:  # collective failure / dead rank
-                failures += 1
-                _profiler.bump_elastic("failures")
-                need_reshard = controller.handle_failure(e) \
-                    if controller is not None else False
-                log.warning(
-                    "elastic: step %d failed (%s); recovery %d/%d%s",
-                    i, e, failures, max_failures,
-                    " [resharding]" if need_reshard else "")
-                if failures > max_failures and not need_reshard:
-                    raise
-                rec = _recover(need_reshard)
-                if rec is None:
-                    raise
-                state, i = rec
-                if need_reshard:
+    hi = start - 1  # highest batch index this incarnation completed
+    try:
+        with PreemptionGuard() as guard:
+            while i < len(batches):
+                if guard.preempted:
+                    last = i - 1
+                    if i > start or restored is not None:
+                        _save(last)
+                    _profiler.bump_elastic("preemptions",
+                                           args={"step": last})
+                    _goodput.note_event("preemption", step=last)
+                    log.warning(
+                        "elastic: preempted, checkpointed step %d",
+                        last)
+                    if own_run is not None:
+                        _goodput.close_run(outcome="preempted")
+                        own_run = None
+                    return state, last, False
+                if controller is not None and controller.poll():
+                    # a rank died even though OUR step succeeded:
+                    # reshard proactively and rewind to the newest
+                    # checkpoint so every survivor resumes from the
+                    # same consistent point
+                    _goodput.note_event(
+                        "rank_death", dead=controller.dead_ranks,
+                        step=i)
+                    rec = _recover(need_reshard=True)
+                    if rec is None:
+                        raise RuntimeError(
+                            "elastic: rank(s) %s died before the first "
+                            "checkpoint; nothing to reshard from"
+                            % controller.dead_ranks)
+                    state, i = rec
                     failures = 0
-                time.sleep(0.1 * failures)
-                continue
-            if save_every and i % save_every == 0:
-                _save(i)
-            i += 1
+                    continue
+                try:
+                    # watchdog beacon: a step wedged in a dead-rank
+                    # collective trips the stall detector and dumps the
+                    # flight record while this loop is still blocked
+                    # (re-entrant: a fused step_fn's own beacon nests)
+                    if i <= hi:
+                        # re-executing a step a restore rewound past:
+                        # its wall time is rewind_replay badput, not
+                        # compute — the run already did this work once
+                        _goodput.mark_replay()
+                    _watchdog.step_begin()
+                    try:
+                        state, _ = step_fn(state, batches[i])
+                    finally:
+                        _watchdog.step_end()
+                    failures = 0
+                except Exception as e:  # collective failure/dead rank
+                    failures += 1
+                    _profiler.bump_elastic("failures")
+                    need_reshard = controller.handle_failure(e) \
+                        if controller is not None else False
+                    _goodput.note_event(
+                        "step_failure", step=i, error=str(e)[:200],
+                        reshard=bool(need_reshard))
+                    log.warning(
+                        "elastic: step %d failed (%s); recovery "
+                        "%d/%d%s", i, e, failures, max_failures,
+                        " [resharding]" if need_reshard else "")
+                    if failures > max_failures and not need_reshard:
+                        raise
+                    rec = _recover(need_reshard)
+                    if rec is None:
+                        raise
+                    state, i = rec
+                    if need_reshard:
+                        failures = 0
+                    time.sleep(0.1 * failures)
+                    continue
+                hi = max(hi, i)
+                if save_every and i % save_every == 0:
+                    _save(i)
+                i += 1
+    except BaseException:
+        if own_run is not None:
+            _goodput.close_run(outcome="failed")
+            own_run = None
+        raise
+    if own_run is not None:
+        _goodput.close_run(outcome="completed")
     return state, len(batches) - 1, True
 
 

@@ -1125,6 +1125,31 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             lines.append("%-24s %16d %16d %16d" % (
                 dev[:24], m["bytes_in_use"], m["peak_bytes_in_use"],
                 m["peak_since_reset"]))
+    # Goodput table (ISSUE 14): the run-level wall-clock partition —
+    # live while a run is open, the last closed run's totals after.
+    # Composed OUTSIDE _lock (goodput owns its own named lock).
+    try:
+        from ._debug import goodput as _goodput_mod
+        g = _goodput_mod.snapshot()
+    except Exception:
+        g = None
+    if g and g.get("run_id"):
+        lines.append("")
+        lines.append(
+            "Goodput run=%s (%s): wall=%.3fs ratio=%.4f steps=%d "
+            "warmup=%d replayed=%d recoveries=%d" % (
+                g["run_id"], "open" if g.get("open") else
+                g.get("outcome", "closed"), g.get("wall_s", 0.0),
+                g.get("goodput_ratio", 0.0), g.get("steps", 0),
+                g.get("warmup_steps", 0), g.get("replayed_steps", 0),
+                g.get("recoveries", 0)))
+        lines.append("%-16s %12s %8s" % ("Category", "Seconds",
+                                         "Share"))
+        wall = g.get("wall_s") or 0.0
+        for c in _goodput_mod.CATEGORIES:
+            s = g.get("%s_s" % c, 0.0)
+            lines.append("%-16s %12.3f %7.1f%%" % (
+                c, s, 100.0 * s / wall if wall > 0 else 0.0))
     if reset:
         reset_imperative_stats()
     return "\n".join(lines)
@@ -1250,6 +1275,30 @@ def prometheus_text():
         emit("mxtpu_stat", "gauge",
              "Subsystem stats providers (register_stats_provider).",
              gauge_samples)
+    # run-level goodput partition (ISSUE 14): dedicated families beyond
+    # the generic mxtpu_stat{section="goodput"} gauges, so dashboards
+    # can stack the categories without label gymnastics
+    g = m.get("goodput")
+    if isinstance(g, dict) and g.get("run_id"):
+        try:
+            from ._debug import goodput as _goodput_mod
+            cats = _goodput_mod.CATEGORIES
+        except Exception:
+            cats = ()
+        cat_samples = [(['category="%s"' % c], g.get("%s_s" % c, 0.0))
+                       for c in cats]
+        if cat_samples:
+            emit("mxtpu_goodput_seconds", "gauge",
+                 "Run wall-clock by goodput category "
+                 "(goodput.snapshot).", cat_samples)
+        emit("mxtpu_goodput_ratio", "gauge",
+             "Productive (compute) fraction of run wall-clock.",
+             [([], g.get("goodput_ratio", 0.0))])
+        emit("mxtpu_goodput_steps_total", "counter",
+             "Completed representative steps in the run.",
+             [(['kind="steps"'], g.get("steps", 0)),
+              (['kind="warmup"'], g.get("warmup_steps", 0)),
+              (['kind="replayed"'], g.get("replayed_steps", 0))])
     emit("mxtpu_profiler_events", "gauge",
          "Raw trace events currently buffered.",
          [([], m["num_events"])])

@@ -34,6 +34,14 @@ if "MXTPU_FLIGHTREC_DIR" not in os.environ:
     os.environ["MXTPU_FLIGHTREC_DIR"] = tempfile.mkdtemp(
         prefix="mxtpu_flightrec_")
 
+# Goodput run manifests (elastic_train_loop opens a run per call) land
+# in a session tmpdir, never the working tree (tests that assert on
+# manifest paths override per-test with monkeypatch).
+if "MXTPU_RUNS_DIR" not in os.environ:
+    import tempfile
+    os.environ["MXTPU_RUNS_DIR"] = tempfile.mkdtemp(
+        prefix="mxtpu_runs_")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

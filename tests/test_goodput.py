@@ -1,0 +1,684 @@
+"""Run-level goodput ledger (ISSUE 14; mxnet_tpu/_debug/goodput.py).
+
+Four halves:
+
+* classification units — every category fed through its weld shape,
+  the drain-time partition summing exactly to wall-clock;
+* manifest contract — schema, atomic publication, failure surfacing;
+* surfaces — metrics()['goodput'], Prometheus families, the dumps()
+  table, the flight-record block;
+* the chaos-attribution acceptance pair + the compare CLI — a
+  rank-death run's manifest must price recovery+rewind within 20% of
+  the independently measured restore-to-caught-up interval, while the
+  fault-free twin attributes ~0 to recovery and ≥95% of non-warmup
+  wall-clock to compute+input_wait; `goodput_report --compare` flags
+  an injected 2x step-time slowdown and passes an identical pair.
+
+Plus the satellite watchdog bugfix: the rolling step-time median
+window resets on elastic reshard/restore, so old-world durations never
+skew stall detection after a resize.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu import profiler
+from mxnet_tpu._debug import flightrec, goodput, watchdog
+from mxnet_tpu.parallel.elastic import (CheckpointManager,
+                                        ElasticController,
+                                        elastic_train_loop)
+from tools import goodput_report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_RUNS_DIR", str(tmp_path / "runs"))
+    goodput.reset()
+    watchdog.reset()
+    yield
+    goodput.reset()
+    watchdog.reset()
+
+
+def _step(begin, dur, warmup=False, mode=None):
+    goodput.note_step(begin, dur, warmup=warmup, mode=mode)
+
+
+# -- classification units ----------------------------------------------------
+
+class TestClassification:
+    def test_mode_mapping(self):
+        goodput.open_run(run_id="cls")
+        t = time.monotonic()
+        _step(t, 0.10, warmup=False, mode="fused")          # compute
+        _step(t + 0.1, 0.20, warmup=True, mode="compile")   # compile
+        _step(t + 0.3, 0.05, warmup=True,
+              mode="eager-warming")                          # compile
+        _step(t + 0.35, 0.04, warmup=True,
+              mode="fallback:kvstore")                       # host
+        _step(t + 0.39, 0.08, warmup=False, mode=None)       # compute
+        m = goodput.close_run()
+        c = m["categories_s"]
+        assert c["compute"] == pytest.approx(0.18)
+        assert c["compile"] == pytest.approx(0.25)
+        assert c["host_overhead"] >= 0.04  # fallback + gap residual
+        assert m["steps"]["count"] == 2    # representative steps only
+        assert m["steps"]["warmup"] == 3
+        assert m["steps"]["fallback"] == 1
+
+    def test_replay_marks_exactly_next_step(self):
+        goodput.open_run(run_id="rp")
+        t = time.monotonic()
+        _step(t, 0.1)
+        goodput.mark_replay()
+        _step(t + 0.1, 0.2)          # replay
+        _step(t + 0.3, 0.1)          # back to compute
+        m = goodput.close_run()
+        assert m["categories_s"]["rewind_replay"] == pytest.approx(0.2)
+        assert m["categories_s"]["compute"] == pytest.approx(0.2)
+        assert m["steps"]["replayed"] == 1
+        # replays ARE representative (same program): all 3 in the stats
+        assert m["steps"]["count"] == 3
+
+    def test_replayed_compile_step_not_representative(self):
+        """A post-reshard rewind forces a recompile under the new mesh:
+        its seconds are rewind_replay badput, but a seconds-long
+        compile must NOT feed the representative step-time stats the
+        compare CLI judges regressions by (review finding)."""
+        goodput.open_run(run_id="rpw")
+        t = time.monotonic()
+        _step(t, 0.001)
+        _step(t + 0.1, 0.001)
+        goodput.mark_replay()
+        _step(t + 0.2, 5.0, warmup=True, mode="compile")
+        m = goodput.close_run()
+        assert m["categories_s"]["rewind_replay"] == pytest.approx(5.0)
+        assert m["steps"]["replayed"] == 1
+        assert m["steps"]["count"] == 2
+        assert m["steps"]["time_s"]["max"] == pytest.approx(0.001)
+
+    def test_input_wait_and_checkpoint(self):
+        goodput.open_run(run_id="iw")
+        time.sleep(0.05)  # real elapsed wall must cover the feeds
+        goodput.note_input_wait(15000.0)   # 0.015 s
+        goodput.note_input_wait(5000.0)
+        goodput.note_checkpoint(0.012, "save")
+        m = goodput.close_run()
+        assert m["categories_s"]["input_wait"] == pytest.approx(0.02)
+        assert m["categories_s"]["checkpoint"] == pytest.approx(0.012)
+        assert m["counters"]["checkpoint_saves"] == 1
+        assert m["counters"]["input_wait_overbooked_s"] == 0.0
+
+    def test_recovery_interval_subsumes_restore(self):
+        """A restore inside a recovery interval must not double-count:
+        the interval's clock owns the seconds, the counter still
+        ticks."""
+        goodput.open_run(run_id="rec")
+        goodput.recovery_begin()
+        time.sleep(0.05)
+        goodput.note_checkpoint(0.04, "restore")  # inside: no category
+        goodput.recovery_end(kind="reshard", resharded=True,
+                             restored_step=7, replay_span=3)
+        m = goodput.close_run()
+        assert m["categories_s"]["checkpoint"] == 0.0
+        assert m["categories_s"]["recovery"] >= 0.05
+        assert m["counters"]["checkpoint_restores"] == 1
+        assert m["counters"]["recoveries"] == 1
+        assert m["counters"]["reshards"] == 1
+        ev = [e for e in m["events"] if e["kind"] == "recovery"]
+        assert ev and ev[0]["restored_step"] == 7 \
+            and ev[0]["replay_span"] == 3
+
+    def test_discarded_recovery_counts_nothing(self):
+        goodput.open_run(run_id="rec0")
+        goodput.recovery_begin()
+        goodput.recovery_end(count=False)
+        m = goodput.close_run()
+        assert m["categories_s"]["recovery"] == 0.0
+        assert m["counters"]["recoveries"] == 0
+
+    def test_partition_sums_to_wall(self):
+        """The eight categories always partition wall-clock exactly —
+        including idle edges and the between-step host residual."""
+        goodput.open_run(run_id="sum")
+        time.sleep(0.03)                       # leading idle
+        t = time.monotonic()
+        _step(t, 0.02)
+        _step(t + 0.05, 0.02)                  # 0.03 un-attributed gap
+        goodput.note_input_wait(10000.0)       # 0.01 of that gap
+        time.sleep(0.09)
+        m = goodput.close_run()
+        total = sum(m["categories_s"].values())
+        assert total == pytest.approx(m["wall_s"], rel=1e-6)
+        assert m["categories_s"]["idle"] > 0.0
+        assert m["categories_s"]["host_overhead"] > 0.0
+        assert 0.0 <= m["goodput_ratio"] <= 1.0
+
+    def test_default_run_ids_unique_within_one_second(self):
+        """Review finding: two sub-second back-to-back runs in one
+        process must not collide on the default id and silently
+        overwrite each other's manifest."""
+        a = goodput.open_run()
+        goodput.close_run()
+        b = goodput.open_run()
+        goodput.close_run()
+        assert a != b
+
+    def test_overbooked_input_wait_trimmed_not_summed_past_wall(self):
+        """Review finding: input_wait fed from threads concurrent with
+        steps (a stacked consumer measuring the same stall twice) must
+        not break the categories-partition-wall contract — the excess
+        is trimmed and surfaced, never silently summed past wall."""
+        goodput.open_run(run_id="over")
+        t = time.monotonic()
+        _step(t, 0.01)
+        time.sleep(0.012)  # wall covers the step window
+        goodput.note_input_wait(3e6)  # 3s of "wait" in a ~12ms run
+        m = goodput.close_run()
+        total = sum(m["categories_s"].values())
+        assert total == pytest.approx(m["wall_s"], rel=1e-6)
+        assert m["counters"]["input_wait_overbooked_s"] > 2.0
+
+    def test_input_wait_attributed_with_flightrec_off(self):
+        """Review finding: with the flight recorder AND profiler both
+        off (profiler._LIVE false), an open goodput run must still see
+        consumer stalls — they book under input_wait, not silently
+        under host_overhead."""
+        from mxnet_tpu.io.worker_pool import DecodePool
+        prev = flightrec.disable()
+        try:
+            assert not profiler._LIVE
+            goodput.open_run(run_id="frecoff")
+            pool = DecodePool(iter(range(5)),
+                              lambda x: (time.sleep(0.002), x)[1],
+                              workers=1)
+            assert list(pool) == list(range(5))
+            m = goodput.close_run()
+            assert m["categories_s"]["input_wait"] > 0.0
+        finally:
+            if prev:
+                flightrec.enable()
+
+    def test_events_bounded(self):
+        goodput.open_run(run_id="ev")
+        for i in range(200):
+            goodput.note_event("step_failure", step=i)
+        m = goodput.close_run()
+        assert len(m["events"]) <= 64
+        assert m["counters"]["events_dropped"] == 200 - len(m["events"])
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setattr(goodput, "ENABLED", False)
+        assert goodput.open_run() is None
+        assert not goodput.OPEN
+        goodput.note_step(0.0, 1.0)  # no-op, no crash
+        assert goodput.close_run() is None
+
+    def test_open_is_exclusive(self):
+        assert goodput.open_run(run_id="a") == "a"
+        assert goodput.open_run(run_id="b") is None  # nested: no reopen
+        assert goodput.current_run_id() == "a"
+        goodput.close_run()
+
+    def test_step_time_summary_percentiles(self):
+        goodput.open_run(run_id="pct")
+        t = time.monotonic()
+        for i in range(100):
+            _step(t + i, 0.001 if i < 99 else 0.1)  # one straggler
+        m = goodput.close_run()
+        ts = m["steps"]["time_s"]
+        assert ts["p50"] == pytest.approx(0.001, rel=0.15)
+        assert ts["max"] == pytest.approx(0.1)
+        assert ts["p50"] <= ts["p95"] <= ts["p99"] <= ts["max"]
+
+
+# -- the watchdog beacon weld ------------------------------------------------
+
+class TestBeaconWeld:
+    def test_beacon_feeds_ledger_with_mode(self):
+        goodput.open_run(run_id="wd")
+        watchdog.step_begin()
+        time.sleep(0.02)
+        watchdog.step_end(mode="fused")
+        watchdog.step_begin()
+        time.sleep(0.01)
+        watchdog.step_end(warmup=True, mode="compile")
+        s = goodput.snapshot()
+        assert s["steps"] == 1 and s["warmup_steps"] == 1
+        assert s["compute_s"] >= 0.02
+        assert s["compile_s"] >= 0.01
+        goodput.close_run()
+
+    def test_nested_beacon_outer_owns_with_mode_taint(self):
+        """elastic_train_loop's outer beacon wraps the fused step's:
+        ONE ledger entry, carrying the inner mode."""
+        goodput.open_run(run_id="nest")
+        watchdog.step_begin()                 # outer (elastic loop)
+        watchdog.step_begin()                 # inner (fused step)
+        time.sleep(0.01)
+        watchdog.step_end(warmup=True, mode="compile")
+        watchdog.step_end()                   # outer completion
+        m = goodput.close_run()
+        assert m["steps"]["warmup"] == 1
+        assert m["steps"]["count"] == 0
+        assert m["categories_s"]["compile"] >= 0.01
+        assert m["categories_s"]["compute"] == 0.0
+
+    def test_fold_backstop_bounds_pending(self, monkeypatch):
+        monkeypatch.setattr(goodput, "_FOLD_AT", 64)
+        goodput.open_run(run_id="fold")
+        t = time.monotonic()
+        for i in range(1000):
+            _step(t, 0.001)
+        assert len(goodput._PENDING) < 64
+        m = goodput.close_run()
+        assert m["steps"]["count"] == 1000
+
+
+# -- manifest contract -------------------------------------------------------
+
+class TestManifest:
+    def test_schema_and_atomic_publication(self, tmp_path):
+        goodput.open_run(run_id="man", meta={"world": [0, 1]})
+        t = time.monotonic()
+        _step(t, 0.01)
+        m = goodput.close_run(outcome="completed")
+        path = m["manifest_path"]
+        assert os.path.exists(path)
+        run_dir = os.path.dirname(path)
+        assert os.listdir(run_dir) == ["manifest.json"]  # no .tmp
+        loaded = goodput.load_manifest(run_dir)
+        assert loaded["schema"] == goodput.SCHEMA
+        assert loaded["outcome"] == "completed"
+        assert loaded["meta"]["world"] == [0, 1]
+        assert set(loaded["categories_s"]) == set(goodput.CATEGORIES)
+        assert "signature_tokens" in loaded["env"]
+        assert loaded["closed_unix"] >= loaded["opened_unix"]
+        assert goodput.last_manifest()["run_id"] == "man"
+
+    def test_write_failure_surfaces_not_raises(self, tmp_path,
+                                               monkeypatch):
+        blocker = tmp_path / "blocker"
+        blocker.write_text("a file where the runs dir should be")
+        monkeypatch.setenv("MXTPU_RUNS_DIR", str(blocker))
+        goodput.open_run(run_id="wf")
+        m = goodput.close_run()
+        assert "write_error" in m
+        assert not goodput.is_open()  # run is closed regardless
+
+    def test_load_rejects_wrong_schema(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"schema": "nope/9"}))
+        with pytest.raises(ValueError):
+            goodput.load_manifest(str(p))
+
+
+# -- surfaces ----------------------------------------------------------------
+
+class TestSurfaces:
+    def test_metrics_provider_and_prometheus(self):
+        goodput.open_run(run_id="surf")
+        t = time.monotonic()
+        _step(t, 0.02)
+        m = profiler.metrics()["goodput"]
+        assert m["open"] == 1 and m["run_id"] == "surf"
+        assert m["compute_s"] >= 0.02
+        for c in goodput.CATEGORIES:
+            assert "%s_s" % c in m
+        prom = profiler.prometheus_text()
+        assert 'mxtpu_goodput_seconds' in prom
+        assert 'category="compute"' in prom
+        assert "mxtpu_goodput_ratio" in prom
+        assert 'mxtpu_goodput_steps_total' in prom
+        goodput.close_run()
+        # after close: the last run's totals keep serving
+        assert profiler.metrics()["goodput"]["open"] == 0
+
+    def test_dumps_table(self):
+        goodput.open_run(run_id="table")
+        t = time.monotonic()
+        _step(t, 0.01)
+        txt = profiler.dumps()
+        assert "Goodput run=table" in txt
+        assert "rewind_replay" in txt
+        goodput.close_run()
+
+    def test_flightrec_dump_carries_goodput_block(self, tmp_path):
+        goodput.open_run(run_id="frec")
+        t = time.monotonic()
+        _step(t, 0.01)
+        shard = str(tmp_path / "shard.json")
+        flightrec.dump("manual", path=shard)
+        data = json.load(open(shard))
+        g = data["metadata"]["goodput"]
+        assert g["run_id"] == "frec" and g["open"] == 1
+        goodput.close_run()
+
+
+# -- the chaos-attribution acceptance pair (ISSUE 14) ------------------------
+
+class _FakeKV:
+    def __init__(self, nworkers=2):
+        self.dead = []
+        self.num_workers = nworkers
+        self.resized = []
+
+    def dead_nodes(self, timeout=3.0):
+        return list(self.dead)
+
+    def resize(self, n):
+        self.resized.append(int(n))
+        self.num_workers = int(n)
+
+
+_SLEEP = 0.05
+
+
+def _sleep_step(state, idx):
+    time.sleep(_SLEEP)
+    return {"acc": state["acc"] + idx}, None
+
+
+class TestChaosAttribution:
+    def test_fault_free_twin_attributes_nothing_to_recovery(
+            self, tmp_path):
+        """The control half of the acceptance pair: no faults -> zero
+        recovery/rewind, and >=95% of non-warmup wall-clock is
+        compute+input_wait."""
+        ckpt = CheckpointManager(str(tmp_path / "ck"), use_orbax=False)
+        state, last, done = elastic_train_loop(
+            _sleep_step, {"acc": jnp.asarray(0.0)},
+            [jnp.asarray(float(i)) for i in range(8)], ckpt,
+            save_every=3)
+        assert done
+        m = goodput.last_manifest()
+        assert m is not None and m["outcome"] == "completed"
+        c = m["categories_s"]
+        assert c["recovery"] == 0.0
+        assert c["rewind_replay"] == 0.0
+        assert m["steps"]["count"] == 8
+        non_warmup_wall = m["wall_s"] - c["compile"]
+        assert (c["compute"] + c["input_wait"]) >= 0.95 * \
+            non_warmup_wall, m
+
+    def test_rank_death_recovery_and_rewind_match_measured(
+            self, tmp_path):
+        """The acceptance run: a rank dies mid-epoch; the survivor
+        reshards, rewinds to the newest checkpoint and replays. The
+        manifest's recovery+rewind seconds must match the
+        independently measured restore-to-caught-up interval within
+        20%."""
+        kv = _FakeKV(2)
+        ctl = ElasticController(kvstore=kv, world=range(2), rank=0,
+                                poll_interval=0.0)
+        ckpt = CheckpointManager(str(tmp_path / "ck"), use_orbax=False)
+        marks = {}
+
+        def step(state, b):
+            i = int(b)
+            if i == 6 and len(ctl.survivors) == 2:
+                kv.dead = [1]  # rank 1 vanishes mid-epoch
+                marks["fail_t"] = time.monotonic()
+                raise ConnectionError("collective failed: peer gone")
+            if i == 6 and "caught_t" not in marks:
+                # first NEW work after the rewind: caught up
+                marks["caught_t"] = time.monotonic()
+            return _sleep_step(state, b)
+
+        state, last, done = elastic_train_loop(
+            step, {"acc": jnp.asarray(0.0)},
+            [jnp.asarray(float(i)) for i in range(8)], ckpt,
+            save_every=3, max_failures=0, controller=ctl)
+        assert done and kv.resized == [1]
+        m = goodput.last_manifest()
+        assert m["outcome"] == "completed"
+        c = m["categories_s"]
+        # checkpoints landed at 3, 6 is never reached pre-death ->
+        # restore step 3, replay 4 and 5
+        assert m["steps"]["replayed"] == 2
+        assert c["recovery"] > 0.0
+        assert c["rewind_replay"] >= 2 * _SLEEP * 0.9
+        measured = marks["caught_t"] - marks["fail_t"]
+        booked = c["recovery"] + c["rewind_replay"]
+        assert booked == pytest.approx(measured, rel=0.20), \
+            (booked, measured, m)
+        kinds = {e["kind"] for e in m["events"]}
+        assert "step_failure" in kinds and "recovery" in kinds
+        rec = [e for e in m["events"] if e["kind"] == "recovery"][0]
+        assert rec["resharded"] is True and rec["restored_step"] == 3
+
+    def test_resume_counts_as_recovery(self, tmp_path):
+        """A second incarnation resuming from a checkpoint books the
+        restore under 'recovery' (the badput of the death it follows)."""
+        ckpt = CheckpointManager(str(tmp_path / "ck"), use_orbax=False)
+        elastic_train_loop(
+            _sleep_step, {"acc": jnp.asarray(0.0)},
+            [jnp.asarray(float(i)) for i in range(4)], ckpt,
+            save_every=2)
+        m1 = goodput.last_manifest()
+        assert m1["counters"]["recoveries"] == 0
+        elastic_train_loop(
+            _sleep_step, {"acc": jnp.asarray(0.0)},
+            [jnp.asarray(float(i)) for i in range(6)], ckpt,
+            save_every=2)
+        m2 = goodput.last_manifest()
+        assert m2["counters"]["recoveries"] == 1
+        assert m2["categories_s"]["recovery"] > 0.0
+
+    def test_failing_resume_restore_still_closes_run(self, tmp_path):
+        """Review finding: a restore that raises at loop start (the
+        elastic.restore faultpoint; a lost filesystem) must not leak
+        the run open — a leaked run would suppress every later loop's
+        manifest in this process."""
+        from mxnet_tpu._debug import faultpoint
+        ckpt = CheckpointManager(str(tmp_path / "ck"), use_orbax=False)
+        elastic_train_loop(_sleep_step, {"acc": jnp.asarray(0.0)},
+                           [jnp.asarray(0.0)], ckpt, save_every=1)
+        faultpoint.configure("elastic.restore=raise:RuntimeError@n=1")
+        try:
+            with pytest.raises(RuntimeError):
+                elastic_train_loop(
+                    _sleep_step, {"acc": jnp.asarray(0.0)},
+                    [jnp.asarray(0.0)], ckpt, save_every=1)
+        finally:
+            faultpoint.reset()
+        assert not goodput.is_open()
+        assert goodput.last_manifest()["outcome"] == "failed"
+        # the NEXT loop still opens, records, and publishes
+        elastic_train_loop(_sleep_step, {"acc": jnp.asarray(0.0)},
+                           [jnp.asarray(float(i)) for i in range(2)],
+                           ckpt, save_every=1)
+        m = goodput.last_manifest()
+        assert m["outcome"] == "completed"
+        # resumed past the first loop's step-0 checkpoint: 1 new step
+        assert m["steps"]["count"] == 1
+        # checkpoint accounting is live again (in_recovery not stuck)
+        assert m["categories_s"]["checkpoint"] > 0.0
+
+    def test_failed_run_closes_with_failed_outcome(self, tmp_path):
+        ckpt = CheckpointManager(str(tmp_path / "ck"), use_orbax=False)
+
+        def bad_step(state, b):
+            raise RuntimeError("unrecoverable")
+
+        with pytest.raises(RuntimeError):
+            elastic_train_loop(bad_step, {"acc": jnp.asarray(0.0)},
+                               [jnp.asarray(0.0)], ckpt,
+                               save_every=1, max_failures=0)
+        m = goodput.last_manifest()
+        assert m["outcome"] == "failed"
+        assert not goodput.is_open()
+
+
+# -- compare CLI -------------------------------------------------------------
+
+def _run_manifest(run_id, step_s, n=50, extra_cats=None):
+    """A deterministic synthetic manifest (published through the same
+    atomic writer): CLI verdicts must not depend on this process's
+    scheduling noise."""
+    cats = {c: 0.0 for c in goodput.CATEGORIES}
+    cats["compute"] = n * step_s
+    cats.update(extra_cats or {})
+    wall = sum(cats.values())
+    t = {"mean": step_s, "min": step_s, "max": step_s,
+         "p50": step_s, "p95": step_s, "p99": step_s}
+    m = {"schema": goodput.SCHEMA, "run_id": run_id, "rank": 0,
+         "opened_unix": 1.0, "closed_unix": 1.0 + wall,
+         "wall_s": wall, "open": False, "outcome": "completed",
+         "categories_s": cats,
+         "goodput_ratio": cats["compute"] / wall if wall else 0.0,
+         "steps": {"count": n, "warmup": 0, "replayed": 0,
+                   "fallback": 0, "time_s": t},
+         "counters": {"recoveries": 0, "reshards": 0,
+                      "checkpoint_saves": 0, "checkpoint_restores": 0,
+                      "events_dropped": 0},
+         "env": {"rank": 0, "world": None, "mesh": None,
+                 "signature_tokens": {}},
+         "events": [], "meta": {}}
+    goodput._write_manifest(m)
+    return os.path.dirname(goodput.manifest_path(run_id))
+
+
+class TestCompareCLI:
+    def test_identical_pair_passes(self):
+        a = _run_manifest("cmp_a", 0.001)
+        b = _run_manifest("cmp_b", 0.001)
+        assert goodput_report.main(["--compare", a, b]) == 0
+
+    def test_2x_slowdown_flagged(self, capsys):
+        a = _run_manifest("slow_a", 0.001)
+        b = _run_manifest("slow_b", 0.002)
+        assert goodput_report.main(["--compare", a, b]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "median step time" in out
+
+    def test_small_relative_noise_passes(self):
+        """Noise robustness: +30% on a 3us step is under the absolute
+        floor — never a page."""
+        a = _run_manifest("tiny_a", 3e-6)
+        b = _run_manifest("tiny_b", 4e-6)
+        assert goodput_report.main(["--compare", a, b]) == 0
+
+    def test_goodput_ratio_drop_and_category_drift_flagged(
+            self, capsys):
+        a = _run_manifest("drift_a", 0.001)
+        b = _run_manifest("drift_b", 0.001,
+                          extra_cats={"recovery": 5.0})
+        assert goodput_report.main(["--compare", a, b]) == 1
+        out = capsys.readouterr().out
+        assert "recovery" in out
+
+    def test_render_single_run(self, capsys):
+        a = _run_manifest("render", 0.001)
+        assert goodput_report.main([a]) == 0
+        out = capsys.readouterr().out
+        assert "goodput run render" in out and "compute" in out
+
+    def test_bad_manifest_exits_2(self, tmp_path):
+        p = tmp_path / "nope.json"
+        assert goodput_report.main([str(p)]) == 2
+        p.write_text("{}")
+        assert goodput_report.main([str(p)]) == 2
+        a = _run_manifest("one", 0.001)
+        assert goodput_report.main(["--compare", a]) == 2
+
+    def test_cli_subprocess_entry(self):
+        a = _run_manifest("sub_a", 0.001)
+        b = _run_manifest("sub_b", 0.0021)
+        script = os.path.join(REPO, "tools", "goodput_report.py")
+        r = subprocess.run([sys.executable, script, "--compare", a, b],
+                           capture_output=True, text=True)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "verdict: REGRESSION" in r.stdout
+
+
+# -- bench manifests (the trajectory satellite) ------------------------------
+
+class TestBenchManifests:
+    def test_gate_result_roundtrips_through_schema(self):
+        result = {"metric": "train_step_steps_per_sec", "value": 1234.5,
+                  "speedup": 6.1, "gate": {"ok": True}}
+        path = goodput.write_bench_manifest("train_step", result)
+        m = goodput.load_manifest(path)
+        assert m["schema"] == goodput.SCHEMA
+        assert m["bench"]["model"] == "train_step"
+        assert m["outcome"] == "completed"
+        assert m["steps"]["time_s"]["p50"] == pytest.approx(1 / 1234.5)
+        # identical rounds compare clean through the standing tool
+        assert goodput_report.main(
+            ["--compare", os.path.dirname(path),
+             os.path.dirname(path)]) == 0
+
+    def test_breached_gate_recorded(self):
+        result = {"metric": "goodput_overhead_pct", "value": 0.5,
+                  "fused_step_us": 800.0, "gate": {"ok": False}}
+        m = goodput.load_manifest(goodput.write_bench_manifest(
+            "goodput_overhead", result))
+        assert m["outcome"] == "gate_breached"
+        assert m["steps"]["time_s"]["p50"] == pytest.approx(8e-4)
+
+
+# -- satellite: watchdog median-window reset on reshard/restore --------------
+
+class TestWatchdogWindowReset:
+    def test_reshard_then_slower_cadence_does_not_false_trip(self):
+        """The bugfix regression: after a reshard the shrunk world's
+        slower cadence must NOT trip against the old world's fast
+        median. First demonstrate the false positive the fix targets,
+        then pin the fix."""
+        watchdog.configure(min_s=0.01, factor=2.0, min_samples=3,
+                           poll_s=100.0)  # poller effectively manual
+        for _ in range(3):  # old-world cadence: fast
+            watchdog.step_begin()
+            time.sleep(0.002)
+            watchdog.step_end()
+        assert watchdog.threshold_s() == pytest.approx(0.01, abs=0.005)
+        # WITHOUT the reset, a slower-world step trips falsely:
+        watchdog.step_begin()
+        time.sleep(0.03)
+        assert watchdog.check_now() is True  # the bug being fixed
+        watchdog.step_end()
+        # the fix: reshard/restore clears the window -> disarmed until
+        # min_samples at the NEW cadence, so no false trip
+        watchdog.reset_window()
+        assert watchdog.threshold_s() is None
+        watchdog.step_begin()
+        time.sleep(0.03)
+        assert watchdog.check_now() is False
+        watchdog.step_end()
+        for _ in range(2):
+            watchdog.step_begin()
+            time.sleep(0.02)
+            watchdog.step_end()
+        # re-armed on the new cadence: threshold reflects the NEW median
+        thr = watchdog.threshold_s()
+        assert thr is not None and thr >= 0.04
+        watchdog.step_begin()
+        time.sleep(0.025)  # slower-world step, inside the new envelope
+        assert watchdog.check_now() is False
+        watchdog.step_end()
+        assert watchdog.stats()["window_resets"] == 1
+
+    def test_elastic_recovery_resets_window(self, tmp_path):
+        """elastic_train_loop wires the reset on every restore path."""
+        ckpt = CheckpointManager(str(tmp_path / "ck"), use_orbax=False)
+        calls = {"n": 0}
+
+        def step(state, b):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise ConnectionError("transient")
+            return {"acc": state["acc"] + b}, None
+
+        elastic_train_loop(step, {"acc": jnp.asarray(0.0)},
+                           [jnp.asarray(float(i)) for i in range(4)],
+                           ckpt, save_every=1, max_failures=2)
+        assert watchdog.stats()["window_resets"] >= 1

@@ -1345,3 +1345,83 @@ def test_mx018_waiver_form(tmp_path):
         """)
     findings, _, waived, _ = _lint_tree(tmp_path, {"MX018"})
     assert findings == []
+
+
+# -- MX019: metrics() provider doc contract ----------------------------------
+
+def test_mx019_flags_undocumented_provider(tmp_path):
+    """A registered metrics() section OBSERVABILITY.md never mentions
+    is an API nobody can find — flagged at the registration site."""
+    _plant(tmp_path, "docs/OBSERVABILITY.md", """\
+        # Observability
+
+        The snapshot carries `metrics()['documented']` (counts stuff).
+        """)
+    _plant(tmp_path, "mxnet_tpu/mymod.py", """\
+        from . import profiler as _profiler
+
+        def stats():
+            return {}
+
+        _profiler.register_stats_provider("documented", stats)
+        _profiler.register_stats_provider("shiny", stats)
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX019"})
+    assert [f.code for f in findings] == ["MX019"]
+    assert "'shiny'" in findings[0].message
+    assert findings[0].path == "mxnet_tpu/mymod.py"
+
+
+def test_mx019_both_quote_styles_and_registration_in_function(tmp_path):
+    """The doc may use either quote style, and registrations inside
+    functions (the lazy-init idiom) are checked too."""
+    _plant(tmp_path, "docs/OBSERVABILITY.md", """\
+        `metrics()["lazy"]` — provider registered at first use.
+        """)
+    _plant(tmp_path, "mxnet_tpu/mymod.py", """\
+        from . import profiler as _profiler
+
+        def _install():
+            _profiler.register_stats_provider("lazy", dict)
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX019"})
+    assert findings == []
+
+
+def test_mx019_computed_name_flagged(tmp_path):
+    """A computed section name defeats the doc contract — the checker
+    cannot resolve it, so the call site must pass a literal."""
+    _plant(tmp_path, "docs/OBSERVABILITY.md", "everything documented\n")
+    _plant(tmp_path, "mxnet_tpu/mymod.py", """\
+        from . import profiler as _profiler
+
+        def install(name):
+            _profiler.register_stats_provider(name, dict)
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX019"})
+    assert [f.code for f in findings] == ["MX019"]
+    assert "computed" in findings[0].message
+
+
+def test_mx019_no_doc_file_skips_doc_clause(tmp_path):
+    """A tree without docs/OBSERVABILITY.md (a planted fixture, a
+    vendored subtree) only enforces the literal-name clause."""
+    _plant(tmp_path, "mxnet_tpu/mymod.py", """\
+        from . import profiler as _profiler
+
+        _profiler.register_stats_provider("anything", dict)
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX019"})
+    assert findings == []
+
+
+def test_mx019_tree_providers_all_documented():
+    """The live contract: every provider registered in the real tree
+    has its metrics() section documented (the rule found the `io`
+    section undocumented on its first run — this pins the fix)."""
+    rule = next(r for r in rules.ALL_RULES if r.code == "MX019")
+    docs = rule._documented()
+    assert docs is not None
+    for name in ("elastic", "faults", "flightrec", "fused_step",
+                 "goodput", "io", "kvstore_server", "watchdog"):
+        assert name in docs, "metrics()[%r] undocumented" % name

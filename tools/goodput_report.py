@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Render and compare run-level goodput manifests (ISSUE 14).
+
+The manifests come from ``mxnet_tpu._debug.goodput`` — every
+``elastic_train_loop`` run (and every ``bench.py`` BENCH_MODEL gate)
+publishes one under ``$MXTPU_RUNS_DIR/<run_id>/manifest.json``. This
+tool is deliberately dependency-free (stdlib json only, no jax import):
+it must run on a laptop against manifests rsync'd off a fleet.
+
+Usage::
+
+    python tools/goodput_report.py RUN            # human-readable report
+    python tools/goodput_report.py --compare A B  # regression verdict
+
+``RUN``/``A``/``B`` are manifest paths or run directories containing
+``manifest.json``. ``--compare`` treats A as the baseline and B as the
+candidate, and exits non-zero when B regresses past threshold — the
+machine-checkable perf trajectory across runs and bench rounds.
+
+The verdict is noise-robust by construction: the step-time check uses
+the run's MEDIAN step time (p50 from the log-bucketed histogram, not
+the mean a single straggler can drag), and every check requires BOTH a
+relative threshold and an absolute floor to fire — a 30% swing on a
+3us microbench step or a 0.1s blip in a category can never page
+anyone. Thresholds: ``--step-pct`` (default 25: median step-time
+growth %), ``--min-step-abs-us`` (50), ``--ratio-drop`` (0.05:
+goodput-ratio points), ``--category-pct`` (5: badput-category share
+growth in points of wall), ``--min-abs-s`` (0.25).
+
+Exit codes: 0 = no regression, 1 = regression past threshold,
+2 = bad usage / unreadable manifest.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# keep in sync with mxnet_tpu/_debug/goodput.py (not imported: this
+# tool must not drag the jax runtime in)
+SCHEMA = "mxtpu.goodput.run/1"
+CATEGORIES = ("compute", "compile", "input_wait", "checkpoint",
+              "recovery", "rewind_replay", "host_overhead", "idle")
+# categories whose GROWTH is badput (compute growing is fine)
+BADPUT = tuple(c for c in CATEGORIES if c != "compute")
+
+
+def load_manifest(path):
+    if os.path.isdir(path):
+        path = os.path.join(path, "manifest.json")
+    with open(path, encoding="utf-8") as f:
+        m = json.load(f)
+    if m.get("schema") != SCHEMA:
+        raise ValueError("%s: schema %r is not %r (not a goodput run "
+                         "manifest)" % (path, m.get("schema"), SCHEMA))
+    return m
+
+
+def _fmt_s(s):
+    return "%.3fs" % s if s < 120 else "%dm%04.1fs" % divmod(s, 60)
+
+
+def render(m):
+    """One manifest -> a human-readable report (list of lines)."""
+    lines = []
+    wall = float(m.get("wall_s") or 0.0)
+    lines.append("goodput run %s  [%s]" % (m["run_id"],
+                                           m.get("outcome", "open")))
+    env = m.get("env", {})
+    lines.append("  rank=%s world=%s mesh=%s" % (
+        env.get("rank"), env.get("world"), env.get("mesh")))
+    toks = env.get("signature_tokens") or {}
+    if toks:
+        lines.append("  signature tokens: " + " ".join(
+            "%s=%s" % (k, toks[k]) for k in sorted(toks)))
+    lines.append("  wall %s   goodput ratio %.4f" % (
+        _fmt_s(wall), float(m.get("goodput_ratio") or 0.0)))
+    lines.append("  %-16s %12s %8s" % ("category", "seconds", "share"))
+    cats = m.get("categories_s", {})
+    for c in CATEGORIES:
+        s = float(cats.get(c, 0.0))
+        lines.append("  %-16s %12.3f %7.1f%%" % (
+            c, s, 100.0 * s / wall if wall > 0 else 0.0))
+    st = m.get("steps", {})
+    t = st.get("time_s")
+    if t:
+        lines.append(
+            "  steps %d (warmup %d, replayed %d, fallback %d): "
+            "p50 %.6fs  p95 %.6fs  p99 %.6fs  mean %.6fs" % (
+                st.get("count", 0), st.get("warmup", 0),
+                st.get("replayed", 0), st.get("fallback", 0),
+                t["p50"], t["p95"], t["p99"], t["mean"]))
+    cn = m.get("counters", {})
+    if any(cn.values()):
+        lines.append("  " + " ".join("%s=%s" % (k, cn[k])
+                                     for k in sorted(cn) if cn[k]))
+    for ev in m.get("events", [])[:20]:
+        detail = " ".join("%s=%s" % (k, ev[k]) for k in sorted(ev)
+                          if k not in ("t_s", "kind"))
+        lines.append("  event +%8.3fs %-14s %s" % (
+            ev.get("t_s", 0.0), ev.get("kind", "?"), detail))
+    bench = m.get("bench")
+    if bench:
+        lines.append("  bench model=%s gate_ok=%s" % (
+            bench.get("model"),
+            (bench.get("result", {}).get("gate") or {}).get("ok")))
+    return lines
+
+
+def _p50(m):
+    t = m.get("steps", {}).get("time_s")
+    return float(t["p50"]) if t and t.get("p50") else None
+
+
+def compare(a, b, step_pct=25.0, min_step_abs_us=50.0,
+            ratio_drop=0.05, category_pct=5.0, min_abs_s=0.25):
+    """Regression verdict for candidate ``b`` against baseline ``a``.
+    Returns (lines, regressed: bool)."""
+    lines = ["baseline  %s  [%s]" % (a["run_id"],
+                                     a.get("outcome", "?")),
+             "candidate %s  [%s]" % (b["run_id"],
+                                     b.get("outcome", "?"))]
+    regressed = False
+
+    # 1) median step time — the core cross-run/bench-round number
+    pa, pb = _p50(a), _p50(b)
+    if pa and pb:
+        rel = 100.0 * (pb - pa) / pa
+        bad = rel > step_pct and (pb - pa) * 1e6 > min_step_abs_us
+        regressed |= bad
+        lines.append(
+            "%-11s median step time: %.6fs -> %.6fs (%+.1f%%; "
+            "threshold +%.0f%% and +%.0fus)" % (
+                "REGRESSION" if bad else "ok", pa, pb, rel, step_pct,
+                min_step_abs_us))
+    else:
+        lines.append("skip        median step time: missing in %s" % (
+            "both" if not (pa or pb) else
+            ("baseline" if not pa else "candidate")))
+
+    # 2) goodput-ratio drop
+    ra = float(a.get("goodput_ratio") or 0.0)
+    rb = float(b.get("goodput_ratio") or 0.0)
+    wa = float(a.get("wall_s") or 0.0)
+    wb = float(b.get("wall_s") or 0.0)
+    if wa > 0 and wb > 0:
+        drop = ra - rb
+        bad = drop > ratio_drop
+        regressed |= bad
+        lines.append(
+            "%-11s goodput ratio: %.4f -> %.4f (%+.4f; threshold "
+            "-%.2f)" % ("REGRESSION" if bad else "ok", ra, rb, -drop,
+                        ratio_drop))
+
+    # 3) per-category drift (badput categories only — compute growing
+    #    is the point of the exercise)
+    ca = a.get("categories_s", {})
+    cb = b.get("categories_s", {})
+    for c in BADPUT:
+        sa, sb = float(ca.get(c, 0.0)), float(cb.get(c, 0.0))
+        if wa <= 0 or wb <= 0 or (sa == 0 and sb == 0):
+            continue
+        drift_pp = 100.0 * (sb / wb - sa / wa)
+        grew_s = sb - sa
+        bad = drift_pp > category_pct and grew_s > min_abs_s
+        regressed |= bad
+        mark = "REGRESSION" if bad else (
+            "drift" if abs(drift_pp) > 0.5 else "ok")
+        lines.append(
+            "%-11s %-14s %8.3fs (%5.1f%%) -> %8.3fs (%5.1f%%)  "
+            "%+0.1fpp" % (mark, c, sa,
+                          100.0 * sa / wa, sb, 100.0 * sb / wb,
+                          drift_pp))
+
+    lines.append("verdict: %s" % ("REGRESSION" if regressed else
+                                  "no regression"))
+    return lines, regressed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="goodput_report",
+        description="Render / compare run-level goodput manifests.")
+    ap.add_argument("runs", nargs="+",
+                    help="manifest path(s) or run director(ies)")
+    ap.add_argument("--compare", action="store_true",
+                    help="compare two runs: baseline candidate")
+    ap.add_argument("--step-pct", type=float, default=25.0)
+    ap.add_argument("--min-step-abs-us", type=float, default=50.0)
+    ap.add_argument("--ratio-drop", type=float, default=0.05)
+    ap.add_argument("--category-pct", type=float, default=5.0)
+    ap.add_argument("--min-abs-s", type=float, default=0.25)
+    args = ap.parse_args(argv)
+    try:
+        manifests = [load_manifest(p) for p in args.runs]
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("goodput_report: %s" % e, file=sys.stderr)
+        return 2
+    if args.compare:
+        if len(manifests) != 2:
+            print("goodput_report: --compare takes exactly two runs "
+                  "(baseline candidate)", file=sys.stderr)
+            return 2
+        lines, regressed = compare(
+            manifests[0], manifests[1], step_pct=args.step_pct,
+            min_step_abs_us=args.min_step_abs_us,
+            ratio_drop=args.ratio_drop,
+            category_pct=args.category_pct, min_abs_s=args.min_abs_s)
+        print("\n".join(lines))
+        return 1 if regressed else 0
+    for m in manifests:
+        print("\n".join(render(m)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

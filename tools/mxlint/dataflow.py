@@ -796,10 +796,88 @@ def lock_graph_report(model, runtime_dump=None):
     return report
 
 
+# ---------------------------------------------------------------------------
+# MX019 — metrics() provider doc contract
+# ---------------------------------------------------------------------------
+
+_PROVIDER_DOC_RE = re.compile(
+    r"metrics\(\)\[['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]\]")
+
+
+class MX019MetricsProviderDocs:
+    """Every ``profiler.register_stats_provider("<name>", ...)`` call
+    publishes a ``metrics()['<name>']`` section scrapers and operators
+    build on — an undocumented section is an API nobody can find and a
+    doc rot vector when it changes. The MX015 idiom applied to the
+    metrics surface: each registered section name must appear in
+    docs/OBSERVABILITY.md as ``metrics()['<name>']`` (either quote
+    style), and the name must be a literal so the contract stays
+    statically checkable."""
+
+    code = "MX019"
+    summary = "metrics() provider section undocumented in " \
+              "OBSERVABILITY.md"
+    kind = "python"
+    project = True
+
+    def scope(self, path):
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    _doc_cache = None  # (repo_root, frozenset | None)
+
+    def _documented(self):
+        from . import core
+        cached = self._doc_cache
+        if cached is not None and cached[0] == core.REPO_ROOT:
+            return cached[1]
+        doc_path = os.path.join(core.REPO_ROOT, "docs",
+                                "OBSERVABILITY.md")
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                names = frozenset(_PROVIDER_DOC_RE.findall(f.read()))
+        except OSError:
+            names = None  # no contract file: skip the doc clause
+        self._doc_cache = (core.REPO_ROOT, names)
+        return names
+
+    def check_project(self, model):
+        docs = self._documented()
+        out = []
+        for mf in sorted(model.modules.values(), key=lambda m: m.path):
+            if not mf.path.startswith("mxnet_tpu/"):
+                continue
+            for qual in sorted(mf.functions):
+                for dn, ln, args_lits, kw_lits in \
+                        mf.functions[qual].calls:
+                    if dn.split(".")[-1] != "register_stats_provider":
+                        continue
+                    name = kw_lits.get("name")
+                    if name is None and args_lits:
+                        name = args_lits[0]
+                    if name is None:
+                        out.append(Finding(
+                            self.code, mf.path, ln,
+                            "register_stats_provider with a computed "
+                            "section name — pass a string literal so "
+                            "the metrics() doc contract stays "
+                            "checkable"))
+                    elif docs is not None and name not in docs:
+                        out.append(Finding(
+                            self.code, mf.path, ln,
+                            "metrics() provider section %r is "
+                            "registered here but never documented — "
+                            "add a metrics()['%s'] section to "
+                            "docs/OBSERVABILITY.md (what the keys "
+                            "mean, who feeds them) or drop the "
+                            "registration" % (name, name)))
+        return out
+
+
 DATAFLOW_RULES = (
     MX014TracedAmbientState(),
     MX015EnvContract(),
     MX016UseAfterDonation(),
     MX017StaticLockOrder(),
     MX018UnledgeredBufferCreation(),
+    MX019MetricsProviderDocs(),
 )

@@ -1681,6 +1681,188 @@ def bench_goodput_overhead():
     }
 
 
+def bench_health_overhead():
+    """BENCH_MODEL=health_overhead: price of the training-health plane
+    (ISSUE 15 hard constraint): the every-step sentinel — a handful of
+    fused sum reductions in-graph plus ONE packed host fetch — must
+    cost under 0.5% of a fused step, and the full per-layer Monitor
+    pass (per-parameter host transfers) must run ONLY on
+    `MXTPU_HEALTH_INTERVAL` boundaries, never per step.
+
+    Prices the exact hot shapes (the memory/goodput gate discipline —
+    an end-to-end on/off A/B at this budget sits below scheduler noise
+    on a 100ms CPU step, so the components are measured tight-loop):
+
+    1. ``summary_us``: the in-graph sentinel summary compiled
+       STANDALONE over the bench net's param/loss shapes — an upper
+       bound on its fused marginal cost (standalone it cannot fuse
+       into the backward, and it pays its own dispatch).
+    2. ``note_us``: the per-step host half (`healthmon.note_step`:
+       one device transfer of the packed vector, CRC digest, loss
+       window, episode latch) over a real committed summary.
+    3. ``fused_step_us``: the measured fused step of the scaled bench
+       net (3x Dense-512, batch 8192 — compute scales with
+       batch x params while the sentinel scales with params alone,
+       the ratio a real model has).
+
+    Gate: (summary_us + note_us) / fused_step_us < 0.5%. Sanity legs:
+    health=1 steady state actually runs 'fused' (a trace failure would
+    silently price the eager path), the sentinels checked the benched
+    steps, an interleaved end-to-end A/B delta stays under a loose 5%
+    noise bound, and the layer-pass counter equals exactly the
+    interval boundaries crossed."""
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, profiler
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu._debug import healthmon, watchdog
+    from mxnet_tpu.parallel import overlap
+
+    profiler.set_config(
+        filename=os.path.join(tempfile.mkdtemp(), "profile.json"),
+        xprof=False)
+    os.environ["MXTPU_HEALTH_ACTION"] = "record"
+    watchdog.reset()
+    rs = np.random.RandomState(0)
+    batch = int(os.environ.get("BENCH_HEALTH_BATCH", "8192"))
+    bx = rs.rand(batch, 512).astype("float32")
+    by = rs.rand(batch, 16).astype("float32")
+
+    def build_step():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(512, activation="relu"),
+                nn.Dense(512, activation="relu"), nn.Dense(16))
+        net.initialize()
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01, "momentum": 0.9})
+        l2 = gluon.loss.L2Loss()
+        step = gluon.train_step(net, lambda o, t: l2(o, t), trainer)
+        return step
+
+    def warm(health):
+        os.environ["MXTPU_HEALTH"] = health
+        step = build_step()
+        x, y = mx.nd.array(bx), mx.nd.array(by)
+        for _ in range(6):
+            step(x, y, batch_size=batch)
+        assert step.last_mode == "fused", step.last_mode
+        return step, x, y
+
+    def round_(cfg, n):
+        health, step, x, y = cfg
+        os.environ["MXTPU_HEALTH"] = health
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = step(x, y, batch_size=batch)
+        loss.wait_to_read()
+        return (time.perf_counter() - t0) / n
+
+    healthmon.reset()
+    cfg_off = ("0",) + warm("0")
+    cfg_on = ("1",) + warm("1")
+    # end-to-end A/B, interleaved (load drifts over seconds-long
+    # blocks): a loose sanity bound only — the precise price comes
+    # from the component measurements below
+    round_(cfg_off, 2)
+    round_(cfg_on, 2)
+    offs, ons = [], []
+    for _ in range(5):
+        offs.append(round_(cfg_off, 4))
+        ons.append(round_(cfg_on, 4))
+    off_us = min(offs) * 1e6
+    on_us = min(ons) * 1e6
+    e2e_delta_pct = (on_us - off_us) / off_us * 100.0
+    st = healthmon.stats()
+    sentinels_ran = st["steps"] > 0 and healthmon.last_digest() is not None
+    # every-step path must NOT have run the full per-layer pass
+    # (interval defaults to 0 and no Monitor is attached)
+    no_eager_layer_pass = st["layer_passes"] == 0
+
+    # -- component 1: the standalone-jitted summary over the net shapes
+    shapes = [(512, 512), (512,), (512, 512), (512,), (512, 16), (16,)]
+    gs = [jnp.asarray(rs.rand(*s).astype(np.float32)) for s in shapes]
+    ws = [jnp.asarray(rs.rand(*s).astype(np.float32)) for s in shapes]
+    loss_v = jnp.asarray(rs.rand(batch).astype(np.float32))
+    plan = overlap.bucket_plan(gs)
+
+    @jax.jit
+    def summary_fn(gs, ws, loss_v):
+        return healthmon.graph_summary(plan, gs, ws, loss_v)[0]
+
+    packed = summary_fn(gs, ws, loss_v)
+    jax.block_until_ready(packed)
+
+    def summary_round(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = summary_fn(gs, ws, loss_v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n
+
+    summary_round(50)
+    summary_us = min(summary_round(200) for _ in range(7)) * 1e6
+
+    # -- component 2: the note_step host half over a committed summary
+    names = ["p%d" % i for i in range(len(shapes))]
+    hmeta = {"plan": [list(b) for b in plan], "names": names,
+             "bucket_names": [[names[i] for i in b] for b in plan],
+             "action": "record", "select": False}
+    healthmon.reset()
+
+    def note_round(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            healthmon.note_step(packed, hmeta, gs, ws, batch)
+        return (time.perf_counter() - t0) / n
+
+    note_round(100)
+    note_us = min(note_round(500) for _ in range(7)) * 1e6
+    healthmon.reset()
+    overhead_pct = (summary_us + note_us) / off_us * 100.0
+
+    # -- interval leg: the full pass runs exactly on boundaries ----------
+    os.environ["MXTPU_HEALTH"] = "1"
+    healthmon.reset()
+    healthmon.configure(interval=5)
+    step = build_step()
+    x, y = mx.nd.array(bx), mx.nd.array(by)
+    for _ in range(2 + 20):  # 2 eager warming + 20 checked steps
+        step(x, y, batch_size=batch)
+    st_int = healthmon.stats()
+    interval_ok = st_int["steps"] == 20 and st_int["layer_passes"] == 4
+    os.environ["MXTPU_HEALTH"] = "0"
+    os.environ.pop("MXTPU_HEALTH_ACTION", None)
+    healthmon.reset()
+    watchdog.reset()
+
+    e2e_ok = e2e_delta_pct < 5.0
+    gate_ok = bool(overhead_pct < 0.5 and sentinels_ran
+                   and no_eager_layer_pass and interval_ok and e2e_ok)
+    return {
+        "metric": "health_overhead_pct",
+        "value": round(overhead_pct, 4),
+        "unit": "%",
+        "summary_us": round(summary_us, 1),
+        "note_us": round(note_us, 1),
+        "fused_step_off_us": round(off_us, 1),
+        "fused_step_on_us": round(on_us, 1),
+        "e2e_delta_pct": round(e2e_delta_pct, 3),
+        "e2e_noise_bound_ok": e2e_ok,
+        "sentinel_steps_checked": st["steps"],
+        "sentinels_ran": sentinels_ran,
+        "layer_passes_every_step_leg": st["layer_passes"],
+        "interval_leg": {"steps": st_int["steps"],
+                         "layer_passes": st_int["layer_passes"],
+                         "ok": interval_ok},
+        "gate": {"ok": gate_ok, "budget_pct": 0.5,
+                 "e2e_noise_bound_pct": 5.0},
+    }
+
+
 def bench_comm_overlap():
     """BENCH_MODEL=comm_overlap: the ISSUE 7 overlap story, gated.
 
@@ -2112,6 +2294,8 @@ if __name__ == "__main__":
         result = bench_memory_overhead()
     elif which == "goodput_overhead":
         result = bench_goodput_overhead()
+    elif which == "health_overhead":
+        result = bench_health_overhead()
     elif which == "comm_overlap":
         result = bench_comm_overlap()
     elif which == "fused_kernels":
@@ -2223,6 +2407,21 @@ if __name__ == "__main__":
                  % (result["fused_pct"],
                     result["gate"]["fused_budget_pct"],
                     result["ledger_recorded_benched_steps"]))
+    if result.get("metric") == "health_overhead_pct" \
+            and not result["gate"]["ok"]:
+        # the training-health sentinels must stay effectively free on
+        # the every-step path (<0.5% of a fused step), must actually
+        # have checked the benched steps (a disabled plane pricing at
+        # zero would lie), and the full per-layer pass may run ONLY on
+        # MXTPU_HEALTH_INTERVAL boundaries, never per step
+        sys.exit("health overhead gate breached: sentinel %.4f%% "
+                 "(budget %.1f%%), sentinels_ran=%s, "
+                 "every-step layer_passes=%d (must be 0), "
+                 "interval leg ok=%s"
+                 % (result["value"], result["gate"]["budget_pct"],
+                    result["sentinels_ran"],
+                    result["layer_passes_every_step_leg"],
+                    result["interval_leg"]["ok"]))
     if result.get("metric") == "train_step_steps_per_sec" \
             and not result["gate"]["ok"]:
         # the fused step must actually pay for itself AND replay cleanly

@@ -9,3 +9,7 @@ from . import faultpoint
 from . import flightrec
 
 __all__ = ["locktrace", "faultpoint", "flightrec"]
+
+# watchdog/goodput/memwatch/healthmon are imported lazily by their
+# weld sites (fused_step, kvstore, storage) — importing them here
+# would cycle through the profiler, which loads this package first.

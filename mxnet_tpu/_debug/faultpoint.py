@@ -48,6 +48,14 @@ failure → behavior → counter table):
                             restarts it under its per-worker budget)
 ``io.service.fetch``        ``ShardService.fetch_batch`` entry — the
                             disaggregated-service RPC seam
+``health.grad.corrupt``     fused-step gradient corruption
+                            (``_debug/healthmon.corruption_operand``):
+                            the configured exception type picks the
+                            in-graph poison — ``raise:OverflowError``
+                            → inf, any other ``ArithmeticError`` →
+                            NaN, any other raise → a finite exponent
+                            bit-flip (grads doubled, the pure-SDC
+                            shape only the cross-rank digest catches)
 ==========================  ================================================
 
 Configuration — env var (parsed at import) or programmatic::
@@ -121,6 +129,7 @@ POINTS = frozenset((
     "io.record.corrupt",
     "io.worker.decode",
     "io.service.fetch",
+    "health.grad.corrupt",
 ))
 
 _lock = _locktrace.named_lock("faultpoint.config")

@@ -37,7 +37,12 @@ class LossScaler:
         return not bool(jnp.stack(checks).all())
 
     def update_scale(self, overflow):
-        """ref: loss_scaler.py update_scale."""
+        """ref: loss_scaler.py update_scale.
+
+        Every call feeds ``metrics()['health']`` — the training-health
+        plane is the SINGLE owner of overflow/skip accounting
+        (``amp_overflow_skips`` / ``amp_loss_scale``), counted with or
+        without profiling (the ``account`` contract)."""
         if overflow:
             self.loss_scale = max(self._min_scale,
                                   self.loss_scale / self._scale_factor)
@@ -47,3 +52,5 @@ class LossScaler:
             if self._unskipped == self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+        from ..._debug import healthmon as _healthmon
+        _healthmon.note_amp(overflow, self.loss_scale)

@@ -61,6 +61,7 @@ from .. import random as _random
 from ..ndarray import NDArray
 from ..ndarray import register as _register
 from .._debug import faultpoint as _faultpoint
+from .._debug import healthmon as _healthmon
 from .._debug import watchdog as _watchdog
 from .. import storage as _storage
 from ..optimizer.optimizer import _is_low_precision
@@ -87,6 +88,9 @@ _STATS = {
                      # trace-failure reason (see the span's mode arg)
     "attr_errors": 0,  # compile-attribution bookkeeping failed after a
                        # committed compile step (telemetry lost, step kept)
+    "health_errors": 0,  # healthmon.note_step raised after a committed
+                         # program (sentinel verdict lost, step kept —
+                         # a telemetry failure must not skip adoption)
 }
 
 
@@ -364,6 +368,11 @@ class FusedTrainStep:
             else:
                 cost = hlo = mem = None
             compile_us = (_time.perf_counter() - c0) * 1e6
+        except _healthmon.HealthHaltError:
+            # a poisoned compile step under MXTPU_HEALTH_ACTION=halt is
+            # a detected anomaly, not a trace failure: the batch must
+            # NOT silently re-run on the eager path
+            raise
         except Exception:
             # trace-incompatible step (data-dependent control flow, host
             # callback, ...): remember the signature and run the genuine
@@ -484,6 +493,40 @@ class FusedTrainStep:
         mp = opt.multi_precision
         packed_apply = self._packed_apply_fn(opt, all_params, train_pos)
 
+        # health sentinels (ISSUE 15) share the overlap bucket plan with
+        # the mesh-mode reduction markers: dtype-homogeneous segments,
+        # so the whole summary is a handful of fused reductions.
+        # MXTPU_HEALTH / MXTPU_HEALTH_ACTION are signature tokens —
+        # flipping either lands on a fresh cache key, never a replay of
+        # the other graph.
+        plan = None
+        hmeta = None
+        if self._mesh is not None or _healthmon.enabled():
+            from ..parallel import overlap as _overlap
+            plan = _overlap.bucket_plan(
+                [all_params[pos].data()._data for pos in train_pos],
+                self._bucket_bytes)
+        if _healthmon.enabled():
+            names = [all_params[pos].name for pos in train_pos]
+            act = _healthmon.action()
+            hmeta = {
+                "plan": [list(b) for b in plan],
+                "names": names,
+                "bucket_names": [[names[i] for i in b] for b in plan],
+                "action": act,
+                # skip_step discards a poisoned update IN-GRAPH (the
+                # only donation-safe place: once the program ran, the
+                # old buffers are gone off-CPU); halt gets the same
+                # select so a caught HealthHaltError leaves clean
+                # weights behind
+                "select": act in ("skip_step", "halt"),
+                # digests are published for cross-rank SDC comparison
+                # only when this program's grads are bitwise-shared
+                # across ranks (the mesh-DP psum) — a local digest
+                # would false-diverge every healthy step
+                "replicated": self._dp > 1,
+            }
+
         tag = None
         if self._mesh is not None:
             # mesh mode: bucket markers between the grad variables and
@@ -491,16 +534,13 @@ class FusedTrainStep:
             # backward the moment its segment completes, hiding the
             # reduction under the rest of the backward (overlap.py)
             from ..parallel import overlap as _overlap
-            plan = _overlap.bucket_plan(
-                [all_params[pos].data()._data for pos in train_pos],
-                self._bucket_bytes)
 
             def tag(tds):
                 return tuple(_overlap.tag_gradient_buckets(
                     list(tds), "dp", plan=plan, op="sum"))
 
         def pure_step(train_datas, state_datas, fixed_datas, in_datas,
-                      lrs, wds, rescale, rng):
+                      lrs, wds, rescale, rng, corrupt=None):
             if tag is not None:
                 # per-shard rng: a replicated key would hand every 'dp'
                 # shard identical dropout masks (sample j of shard 0 and
@@ -524,6 +564,13 @@ class FusedTrainStep:
 
             (_, (loss, aux)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_datas)
+            if hmeta is not None:
+                # the health.grad.corrupt chaos seam: an exact
+                # multiply-by-one identity on clean steps, NaN/inf/
+                # bit-flip poison when the faultpoint armed the operand
+                # — placed after the (mesh) reduction, so injected
+                # corruption models post-reduction SDC
+                grads = _healthmon.apply_corruption(grads, corrupt)
             # parity note: against the HYBRIDIZED eager path (backward =
             # vjp of the same jitted forward) this program is bitwise
             # identical; the non-hybridized per-op tape can differ by
@@ -570,7 +617,29 @@ class FusedTrainStep:
                 # average them so every replica adopts the same value
                 from jax import lax
                 aux = tuple(lax.pmean(a, "dp") for a in aux)
-            return loss, tuple(new_ws), tuple(new_sts), grads, aux
+            if hmeta is None:
+                return loss, tuple(new_ws), tuple(new_sts), grads, aux
+            # health sentinels over the (reduced) grads, the PRE-update
+            # weights (their reductions overlap the whole program
+            # instead of extending the update's critical path — see
+            # graph_summary) and the loss — a few fused sum reductions
+            # threaded out as one extra tiny output
+            health, ok = _healthmon.graph_summary(
+                hmeta["plan"], grads, train_datas, loss,
+                axis_name="dp" if self._mesh is not None else None)
+            if hmeta["select"]:
+                # skip_step/halt: a poisoned update is discarded HERE,
+                # where both the old and the new buffers still exist
+                # (donation aliases them outside the program) — the
+                # select is exact when ok, so the clean path stays
+                # bitwise-identical
+                new_ws = [jnp.where(ok, nw, w)
+                          for nw, w in zip(new_ws, train_datas)]
+                new_sts = jax.tree_util.tree_map(
+                    lambda ns, s: jnp.where(ok, ns, s),
+                    tuple(new_sts), tuple(state_datas))
+            return loss, tuple(new_ws), tuple(new_sts), grads, aux, \
+                health
 
         body = pure_step
         if self._mesh is not None:
@@ -580,10 +649,14 @@ class FusedTrainStep:
             # params/states/hypers replicated, batch sharded on 'dp';
             # grads leave the body already psum'd (the markers), the
             # per-sample loss re-assembles across shards
+            in_specs = (P(), P(), P(), P("dp"), P(), P(), P(), P())
+            out_specs = (P("dp"), P(), P(), P(), P())
+            if hmeta is not None:
+                in_specs += (P(),)    # the corruption operand
+                out_specs += (P(),)   # the (replicated) health summary
             body = _shard_map(
                 pure_step, raw_mesh,
-                in_specs=(P(), P(), P(), P("dp"), P(), P(), P(), P()),
-                out_specs=(P("dp"), P(), P(), P(), P()),
+                in_specs=in_specs, out_specs=out_specs,
                 check_vma=False)
         donate = ()
         try:
@@ -595,7 +668,7 @@ class FusedTrainStep:
             else jax.jit(body)
         if self._mesh is not None:
             jfn = self._mesh_placed(jfn)
-        return jfn, aux_params, fixed_pos
+        return jfn, aux_params, fixed_pos, hmeta
 
     def _packed_apply_fn(self, opt, all_params, train_pos):
         """The MXTPU_FUSED_APPLY eligibility selector, or None when the
@@ -648,11 +721,14 @@ class FusedTrainStep:
                 else jax.device_put(a, sh), tree)
 
         def call(train_datas, state_datas, fixed_datas, in_datas,
-                 lrs, wds, rescale, rng):
+                 lrs, wds, rescale, rng, *rest):
+            # *rest: the health-sentinel corruption operand (scalar,
+            # replicated) when MXTPU_HEALTH threads it
             return inner(place(train_datas, rep), place(state_datas, rep),
                          place(fixed_datas, rep), place(in_datas, batch),
                          place(lrs, rep), place(wds, rep),
-                         place(rescale, rep), place(rng, rep))
+                         place(rescale, rep), place(rng, rep),
+                         *[place(r, rep) for r in rest])
 
         return call
 
@@ -731,7 +807,7 @@ class FusedTrainStep:
         compiled ahead-of-time so its ``cost_analysis()`` (flops/bytes)
         and optimized HLO feed the attribution registry; the compiled
         executable is kept (``self._aot``) and runs this step."""
-        jfn, aux_params, fixed_pos = entry
+        jfn, aux_params, fixed_pos, hmeta = entry
         tr = self._trainer
         opt = tr._optimizer
         rescale = tr._scale / batch_size
@@ -741,6 +817,14 @@ class FusedTrainStep:
         prev_num = opt.num_update
         prev_counts = {i: opt._index_update_count.get(i) for i in indices}
         opt._update_count(list(indices))
+
+        def _rollback_counts():
+            opt.num_update = prev_num
+            for i, c in prev_counts.items():
+                if c is None:
+                    opt._index_update_count.pop(i, None)
+                else:
+                    opt._index_update_count[i] = c
         try:
             lrs = [opt.step_lr(i) for i in indices]
             wds = opt._get_wds(list(indices))
@@ -757,6 +841,11 @@ class FusedTrainStep:
                         jnp.asarray(lrs, jnp.float32),
                         jnp.asarray(wds, jnp.float32),
                         jnp.float32(rescale), _random.next_key())
+            if hmeta is not None:
+                # the health.grad.corrupt chaos operand: 0.0 on clean
+                # steps (an exact in-graph multiply-by-one identity)
+                operands = operands + (
+                    jnp.float32(_healthmon.corruption_operand()),)
             runner = jfn
             if aot and hasattr(jfn, "lower"):
                 # AOT lower+compile the compile step so the executable's
@@ -798,25 +887,57 @@ class FusedTrainStep:
                     runner = compiled
                 except Exception:
                     self._aot = None  # AOT API drift: plain path works
-            loss_data, new_ws, new_sts, grads, aux_datas = \
-                runner(*operands)
+            if hmeta is not None:
+                loss_data, new_ws, new_sts, grads, aux_datas, health = \
+                    runner(*operands)
+            else:
+                loss_data, new_ws, new_sts, grads, aux_datas = \
+                    runner(*operands)
         except BaseException:
-            opt.num_update = prev_num
-            for i, c in prev_counts.items():
-                if c is None:
-                    opt._index_update_count.pop(i, None)
-                else:
-                    opt._index_update_count[i] = c
+            _rollback_counts()
             raise
+        verdict = None
+        if hmeta is not None:
+            # the per-step sentinel check runs OUTSIDE the rollback
+            # try: the program already committed (donated inputs are
+            # gone off-CPU), so a raising telemetry path — a buggy
+            # Monitor stat_func, a torn device_get — must neither skip
+            # the adoption below nor take the training step down; it is
+            # swallowed and counted. A halt verdict is RETURNED, never
+            # raised here — adoption must run first (the selected
+            # clean outputs are the only valid weights left).
+            try:
+                verdict = _healthmon.note_step(health, hmeta, grads,
+                                               new_ws, batch_size)
+            except Exception:
+                _STATS["health_errors"] += 1
+        halt = verdict.get("halt") if verdict else None
+        skipped = bool(verdict and verdict.get("skipped")) \
+            or halt is not None
+        if skipped:
+            # the poisoned update was discarded in-graph: host
+            # bookkeeping follows, so the step bitwise never happened
+            # (lr schedules keyed on num_update stay aligned with a run
+            # that never saw the poisoned step)
+            _rollback_counts()
         # pending-result adoption: weights + raw grads into the params,
         # state leaves into the updater's store, aux (moving stats) last
+        # (under skip/halt the selected outputs ARE the old weight/state
+        # values; the poisoned grads still adopt — next step's
+        # post-mortem evidence, overwritten by the next backward)
         for p, nw, g in zip(train_params, new_ws, grads):
             p._adopt_fused(nw, g)
         for st, ns in zip(states, new_sts):
             _adopt_state(st, ns)
-        for p, a in zip(aux_params, aux_datas):
-            tgt = p.data()
-            tgt._data = a if a.dtype == tgt.dtype else a.astype(tgt.dtype)
+        if not skipped:
+            for p, a in zip(aux_params, aux_datas):
+                tgt = p.data()
+                tgt._data = a if a.dtype == tgt.dtype \
+                    else a.astype(tgt.dtype)
+        if halt is not None:
+            # adopt-then-raise: params/state now hold the clean
+            # selected buffers on every backend, counts rolled back
+            raise halt
         return NDArray(loss_data)
 
     # -- eager fallback ----------------------------------------------------

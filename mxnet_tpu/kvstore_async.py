@@ -64,6 +64,7 @@ import numpy as np
 from . import _retry
 from . import profiler as _profiler
 from ._debug import faultpoint as _faultpoint
+from ._debug import healthmon as _healthmon
 from ._debug import locktrace as _locktrace
 from ._debug import watchdog as _watchdog
 from .base import getenv as _getenv
@@ -235,6 +236,18 @@ def _server_stats():
       own skew)
     - ``straggler.<r>`` = 1 and ``stragglers`` list membership when the
       skew exceeds ``MXTPU_STRAGGLER_FACTOR`` (default 2.0)
+
+    SDC divergence (ISSUE 15): each rank's v1 heartbeat also carries
+    its newest grad-bucket digest ``(health seq, CRC32)`` from
+    ``_debug/healthmon``. Same-seq digests must be bitwise-identical
+    under DP replication, so at the step the most ranks report:
+
+    - ``rank_health_seq.<r>``: newest digest seq of rank r
+    - ``sdc_divergence`` = 1 when same-seq checksums disagree
+    - ``sdc_suspect.<r>`` = 1 / ``sdc_suspects`` membership: the ranks
+      off the majority checksum (with only two ranks there is no
+      majority — divergence is certain, attribution is not, both are
+      flagged)
     """
     out = {}
     now = _ptime.monotonic()
@@ -249,14 +262,22 @@ def _server_stats():
     except ValueError:
         stale_s = 3.0
     durs = {}
+    health = {}
     for srv in list(_SERVERS):
         with srv._lock:
             beats = dict(srv._heartbeats)
             steps = dict(srv._step_stats)
+            hstats = dict(srv._health_stats)
             out["updates_applied"] = out.get("updates_applied", 0) \
                 + srv.updates_applied
             out["workers_done"] = out.get("workers_done", 0) \
                 + srv.workers_done
+        for rank, (hseq, hsum, at) in hstats.items():
+            if now - at > stale_s:
+                continue  # a dead rank's digest must not sit forever
+            cur = health.get(rank)
+            if cur is None or hseq > cur[0]:
+                health[rank] = (hseq, hsum)
         for rank, t in beats.items():
             key = "rank_heartbeat_age.%d" % rank
             out[key] = max(out.get(key, 0.0), round(now - t, 3))
@@ -282,6 +303,44 @@ def _server_stats():
                 stragglers.append(rank)
         out["stragglers"] = sorted(stragglers)
         out["straggler_count"] = len(stragglers)
+    for rank, (hseq, _hsum) in sorted(health.items()):
+        out["rank_health_seq.%d" % rank] = hseq
+    if len(health) >= 2:
+        # SDC divergence (ISSUE 15): compare checksums at the step the
+        # most ranks report. Under DP replication the reduced update is
+        # bitwise-shared, so same-seq digests must be identical — a
+        # divergent rank is computing different numbers from the same
+        # inputs (silent data corruption), exactly the leave-one-out
+        # shape of the straggler skew above.
+        seq_groups = {}
+        for rank, (hseq, hsum) in health.items():
+            seq_groups.setdefault(hseq, {})[rank] = hsum
+        cmp_seq, members = max(seq_groups.items(),
+                               key=lambda kv: (len(kv[1]), kv[0]))
+        suspects = []
+        if len(members) >= 2:
+            counts = {}
+            for s in members.values():
+                counts[s] = counts.get(s, 0) + 1
+            top_n = max(counts.values())
+            divergent = len(counts) > 1
+            out["sdc_divergence"] = int(divergent)
+            out["sdc_checked_seq"] = cmp_seq
+            if divergent:
+                if top_n * 2 > len(members):
+                    # a strict majority pins the truth: whoever is off
+                    # it is the suspect (>= 3 ranks names the bad one)
+                    top_sum = max(counts, key=lambda s: counts[s])
+                    suspects = sorted(r for r, s in members.items()
+                                      if s != top_sum)
+                else:
+                    # no majority (two ranks disagreeing): divergence
+                    # is certain, attribution is not — flag all
+                    suspects = sorted(members)
+            for r in suspects:
+                out["sdc_suspect.%d" % r] = 1
+            out["sdc_suspects"] = suspects
+            out["sdc_suspect_count"] = len(suspects)
     return out
 
 
@@ -306,6 +365,10 @@ class AsyncPSServer:
         # per-rank step gauges the v1 heartbeat carries (straggler
         # detection, ISSUE 8)
         self._step_stats = {}
+        # rank -> (health seq, grad-digest checksum, monotonic
+        # arrival): the SDC divergence payload (ISSUE 15) — under DP
+        # replication same-seq checksums must agree bitwise
+        self._health_stats = {}
         self._barrier_cv = _locktrace.named_condition(
             "kvstore_async.server", self._lock)
         self._barrier_count = 0
@@ -598,8 +661,21 @@ class AsyncPSServer:
                     # straggler gauge payload. Old servers never reach
                     # here (length-gated); old clients never send it.
                     dur, seq = struct.unpack_from(">dq", buf, off + 16)
-                    self._step_stats[int(rank)] = (
-                        float(dur), int(seq), _t.monotonic())
+                    if seq >= 0:
+                        # seq=-1 is the no-step-stats placeholder a
+                        # watchdog-off client packs so its SDC digest
+                        # can still ride the fixed offsets — it must
+                        # not enter the straggler gauges as a 0.0 step
+                        self._step_stats[int(rank)] = (
+                            float(dur), int(seq), _t.monotonic())
+                if len(buf) >= off + 48:
+                    # trailing (health seq i64, checksum i64): the
+                    # rank's newest grad-bucket digest — the SDC
+                    # divergence payload (same length-gating contract)
+                    hseq, hsum = struct.unpack_from(">qq", buf,
+                                                    off + 32)
+                    self._health_stats[int(rank)] = (
+                        int(hseq), int(hsum), _t.monotonic())
             if len(buf) >= off + 16:
                 # v1 beat carries the client's trace-clock timestamp:
                 # answer with OUR trace clock so the client can estimate
@@ -1008,13 +1084,29 @@ class AsyncPSClient:
             payload = struct.pack(">Bqd", _OP_HEARTBEAT, int(rank),
                                   float(t0))
             last = _watchdog.last_step()
-            if last is not None:
+            hd = _healthmon.shared_digest()
+            if last is not None or hd is not None:
                 # the per-rank step-duration gauge rides the beat
                 # (straggler detection, ISSUE 8): newest completed
                 # step's (duration, seq) — a v1 server stores it, an
-                # old server's length check ignores the extra bytes
-                payload += struct.pack(">dq", float(last[1]),
-                                       int(last[0]))
+                # old server's length check ignores the extra bytes.
+                # With the watchdog disabled a (0.0, -1) placeholder
+                # keeps the fixed offsets so the SDC digest can still
+                # ride (seq=-1 = "no step stats": the server skips it)
+                dur, seq = (float(last[1]), int(last[0])) \
+                    if last is not None else (0.0, -1)
+                payload += struct.pack(">dq", dur, seq)
+                if hd is not None:
+                    # trailing (health seq i64, grad-bucket CRC32 i64):
+                    # the SDC gauge (ISSUE 15) — the server leave-one-
+                    # out-compares same-seq checksums across ranks;
+                    # same length-gated contract as the straggler pair.
+                    # shared_digest is non-None only for mesh-DP fused
+                    # programs whose grads are bitwise-shared — a
+                    # local (single-device / host-reduced) digest
+                    # would false-diverge on every healthy step
+                    payload += struct.pack(">qq", int(hd[0]),
+                                           int(hd[1]))
             arr = self._call(payload, idempotent=False)
             t1 = _profiler._now_us()
             if arr is not None and len(arr):

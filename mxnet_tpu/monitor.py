@@ -16,6 +16,19 @@ from . import ndarray as nd
 __all__ = ["Monitor"]
 
 
+def _is_traced(array):
+    """True when ``array`` is (or wraps) a jax tracer — forward hooks
+    DO fire while a hybridized block's cached program is being traced,
+    and a stat captured there is an abstract value that would blow up
+    at ``toc()`` render time (and silently never update again: the
+    cached program replays without Python). Such hook hits are dropped;
+    the fused-step health plane (``_debug/healthmon``) is the supported
+    per-layer stat route for cached programs."""
+    import jax
+    data = array._data if isinstance(array, NDArray) else array
+    return isinstance(data, jax.core.Tracer)
+
+
 class Monitor:
     """Collect per-tensor stats every `interval` batches (ref: monitor.py:33)."""
 
@@ -32,15 +45,33 @@ class Monitor:
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        self._bypass_warned = False  # hybridized-hook bypass, once
 
     def stat_helper(self, name, array):
         if not self.activated or not self.re_prog.match(name):
             return
+        if _is_traced(array):
+            return  # hook fired inside a trace: abstract value, no stat
         array = array if isinstance(array, NDArray) else nd.array(array)
         self.queue.append((self.step, name, self.stat_func(array)))
 
-    def install(self, exe):
-        """Install the monitor on an executor or Gluon block."""
+    def install(self, exe, strict=False):
+        """Install the monitor on an executor or Gluon block.
+
+        A HYBRIDIZED block's cached/fused program never calls the
+        Python forward hooks this method registers — historically
+        ``install`` succeeded and then silently produced empty hook
+        rows forever (ISSUE 15 satellite). Now every Gluon block is
+        registered with the training-health plane
+        (``mxnet_tpu._debug.healthmon``, scoped to the block's own
+        parameters), which routes per-layer weight/grad rows out of
+        the fused step's in-graph health outputs under the same
+        ``(batch, name, stat)`` row contract whenever
+        ``MXTPU_HEALTH=1`` — including blocks that hybridize AFTER
+        install. When the health plane is OFF, the bypass is loudly
+        reported: a warning (at install if already hybridized, at the
+        first bypassed ``toc()`` otherwise), or ``ValueError`` with
+        ``strict=True``."""
         if hasattr(exe, "register_forward_hook"):
             mon = self
 
@@ -50,7 +81,27 @@ class Monitor:
                 for i, o in enumerate(outs):
                     mon.stat_helper("%s_output%d" % (block.name, i), o)
             exe.register_forward_hook(hook)
+            if hasattr(exe, "collect_params"):
+                from ._debug import healthmon as _healthmon
+                _healthmon.attach_monitor(
+                    self, params=exe.collect_params().keys())
+                if getattr(exe, "_active", False) \
+                        and not _healthmon.enabled():
+                    msg = self._bypass_msg(exe)
+                    if strict:
+                        raise ValueError(msg)
+                    self._bypass_warned = True
+                    logging.warning(msg)
         self.exes.append(exe)
+
+    @staticmethod
+    def _bypass_msg(exe):
+        return ("Monitor on %s: the block is hybridized — the "
+                "cached/fused program never calls Python forward "
+                "hooks, so hook rows stay empty. Set MXTPU_HEALTH=1 "
+                "to route per-layer stats through the fused step's "
+                "health outputs, or un-hybridize the block while "
+                "debugging." % getattr(exe, "name", exe))
 
     def tic(self):
         """Start collecting for this batch if the interval hits
@@ -61,11 +112,30 @@ class Monitor:
         self.step += 1
 
     def toc(self):
-        """Stop collecting and return (step, name, stat) rows."""
+        """Stop collecting and return (step, name, stat) rows.
+
+        When the training-health plane already delivered this batch's
+        per-layer rows out of the fused step (``healthmon`` sets
+        ``_fused_batch`` at delivery), the eager ``collect_params``
+        sweep is skipped for hybridized blocks — same rows, one
+        source, no duplicates."""
         if not self.activated:
             return []
         self.activated = False
+        fused_batch = getattr(self, "_fused_batch", None)
         for exe in self.exes:
+            if fused_batch == self.step \
+                    and getattr(exe, "_active", False):
+                continue
+            if getattr(exe, "_active", False) \
+                    and not self._bypass_warned:
+                # block hybridized AFTER install (the install-time
+                # check could not see it): hook rows are bypassed and
+                # the health plane is not delivering — say so ONCE
+                from ._debug import healthmon as _healthmon
+                if not _healthmon.enabled():
+                    self._bypass_warned = True
+                    logging.warning(self._bypass_msg(exe))
             if hasattr(exe, "collect_params"):
                 for name, p in exe.collect_params().items():
                     if p._data is not None:

@@ -620,6 +620,12 @@ register_signature_token("MXTPU_FLASH_AUTOTUNE", "0")
 # found by mxlint MX014 on its first whole-tree run (exactly the PR 9
 # stale-replay class: flip the cap mid-run, replay the old bucketing)
 register_signature_token("MXTPU_ELASTIC_BUCKET_MB", "4")
+# training-health sentinels (ISSUE 15): MXTPU_HEALTH threads the
+# summary/corruption operands through the fused-step program, and the
+# skip_step/halt actions add the in-graph discard select — both change
+# the traced graph, so flipping either must retrace, never replay
+register_signature_token("MXTPU_HEALTH", "0")
+register_signature_token("MXTPU_HEALTH_ACTION", "record")
 
 # back-compat spelling (PR 9 introduced the kernel-env tuple under this
 # name; the registry supersedes it)

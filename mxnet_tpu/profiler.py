@@ -82,6 +82,7 @@ LANES = {
     "gluon": 6,
     "user": 7,
     "compile": 8,
+    "health": 9,
 }
 
 # dynamic lanes (ensure_lane) are allocated from here up, so the fixed
@@ -1299,6 +1300,30 @@ def prometheus_text():
              [(['kind="steps"'], g.get("steps", 0)),
               (['kind="warmup"'], g.get("warmup_steps", 0)),
               (['kind="replayed"'], g.get("replayed_steps", 0))])
+    # training-health sentinels (ISSUE 15): dedicated families beyond
+    # the generic mxtpu_stat{section="health"} gauges, so alerting
+    # rules key on stable names
+    h = m.get("health")
+    if isinstance(h, dict) and h.get("enabled"):
+        emit("mxtpu_health_steps_total", "counter",
+             "Fused steps checked by the health sentinels, by outcome "
+             "(healthmon).",
+             [(['kind="checked"'], h.get("steps", 0)),
+              (['kind="anomalous"'], h.get("anomalies", 0)),
+              (['kind="nonfinite"'], h.get("nonfinite_steps", 0)),
+              (['kind="loss_spike"'], h.get("loss_spikes", 0)),
+              (['kind="skipped"'], h.get("skipped_steps", 0)),
+              (['kind="amp_overflow_skip"'],
+               h.get("amp_overflow_skips", 0))])
+        emit("mxtpu_health_anomaly", "gauge",
+             "1 while inside an anomaly episode (latched until a "
+             "clean step).",
+             [([], h.get("in_episode", 0))])
+        emit("mxtpu_health_loss", "gauge",
+             "Newest observed mean loss and its rolling median "
+             "(the spike-envelope baseline).",
+             [(['stat="last"'], h.get("last_loss", 0.0)),
+              (['stat="median"'], h.get("loss_median", 0.0))])
     emit("mxtpu_profiler_events", "gauge",
          "Raw trace events currently buffered.",
          [([], m["num_events"])])

@@ -4,8 +4,18 @@ value dumping with visit-count tagging).
 
 The reference's C++ class is constructed around a TBlob inside kernels;
 here the same checks work on any NDArray / jax array / numpy array from
-Python, which is where TPU debugging happens (device-side printing goes
-through jax.debug.print instead)."""
+Python, which is where TPU debugging happens. Two device-friendly paths
+(ISSUE 15 satellite):
+
+- :meth:`TensorInspector.snapshot` inspects MANY tensors with ONE
+  batched ``jax.device_get`` transfer — inspecting a whole parameter
+  dict no longer round-trips the device once per tensor.
+- :meth:`TensorInspector.print_in_trace` /
+  :meth:`TensorInspector.check_in_trace` are ``jax.debug.print``-based
+  variants usable INSIDE jitted code, where host-side numpy conversion
+  is impossible — they print shape/dtype plus nonfinite/abs-max/L2 at
+  run time and return the operand unchanged, so they drop into any
+  traced expression."""
 from __future__ import annotations
 
 import logging
@@ -15,18 +25,56 @@ import numpy as _np
 __all__ = ["TensorInspector"]
 
 
+def _to_host(tensor):
+    """One host copy of ``tensor`` (NDArray unwrapped first): device
+    arrays go through ``jax.device_get``, host values through
+    ``np.asarray``."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(tensor, NDArray):
+        tensor = tensor._data
+    if isinstance(tensor, _np.ndarray):
+        return tensor
+    if hasattr(tensor, "sharding") or hasattr(tensor, "devices"):
+        import jax
+        return _np.asarray(jax.device_get(tensor))
+    return _np.asarray(tensor)
+
+
 class TensorInspector:
     """ref: tensor_inspector.h TensorInspector(tb, ctx)."""
 
     _visit_count = {}
 
     def __init__(self, tensor, tag=""):
-        from .ndarray.ndarray import NDArray
-        if isinstance(tensor, NDArray):
-            self._a = tensor.asnumpy()
-        else:
-            self._a = _np.asarray(tensor)
+        self._a = _to_host(tensor)
         self.tag = tag
+
+    @classmethod
+    def snapshot(cls, tensors, tags=None):
+        """Build inspectors for many tensors with ONE batched host
+        transfer (``jax.device_get`` over the whole list — the per-call
+        numpy round-trip was the ISSUE 15 satellite complaint).
+
+        ``tensors``: an iterable of NDArray/jax/numpy values, or a
+        ``{name: tensor}`` dict (names become the tags). ``tags``
+        optionally labels list input. Returns a list (or dict, matching
+        the input shape) of :class:`TensorInspector`."""
+        from .ndarray.ndarray import NDArray
+        if isinstance(tensors, dict):
+            names = list(tensors)
+            vals = [tensors[k] for k in names]
+        else:
+            names = list(tags) if tags is not None else None
+            vals = list(tensors)
+        datas = [t._data if isinstance(t, NDArray) else t for t in vals]
+        import jax
+        hosts = jax.device_get(datas)
+        out = [cls(_np.asarray(h),
+                   tag=(names[i] if names is not None else ""))
+               for i, h in enumerate(hosts)]
+        if isinstance(tensors, dict):
+            return dict(zip(names, out))
+        return out
 
     def print_string(self):
         """Formatted dump with shape/dtype header (ref: print_string())."""
@@ -64,3 +112,49 @@ class TensorInspector:
         fname = "%s_%d.npy" % (tag, count)
         _np.save(fname, self._a)
         return fname
+
+    # -- in-trace variants (usable inside jitted code) -----------------------
+
+    @staticmethod
+    def print_in_trace(x, tag=""):
+        """``jax.debug.print``-based inspector usable INSIDE jitted
+        code: prints ``<tag shape dtype> nonfinite/absmax/l2`` at RUN
+        time (shape/dtype are trace-static and land in the format
+        string; the stats are traced values) and returns ``x``
+        unchanged, so it drops into any traced expression::
+
+            y = TensorInspector.print_in_trace(y, tag="logits")
+        """
+        import jax
+        import jax.numpy as jnp
+        hdr = ("TensorInspector[%s] <%s %s>" % (
+            tag or "Tensor", "x".join(map(str, x.shape)), x.dtype)
+        ).replace("{", "{{").replace("}", "}}")  # tag-safe fmt string
+        if jnp.issubdtype(x.dtype, jnp.floating) or \
+                jnp.issubdtype(x.dtype, jnp.complexfloating):
+            x32 = jnp.abs(x).astype(jnp.float32)
+            jax.debug.print(
+                hdr + " nonfinite={bad} absmax={amax} l2={l2}",
+                bad=jnp.sum((~jnp.isfinite(x)).astype(jnp.int32)),
+                amax=jnp.max(x32) if x.size else jnp.float32(0),
+                l2=jnp.sqrt(jnp.sum(x32 * x32)))
+        else:
+            jax.debug.print(hdr + " min={mn} max={mx}",
+                            mn=jnp.min(x) if x.size else 0,
+                            mx=jnp.max(x) if x.size else 0)
+        return x
+
+    @staticmethod
+    def check_in_trace(x, tag=""):
+        """In-trace NaN/inf check: prints a warning line (via
+        ``jax.debug.print``) carrying the nonfinite count — 0 on a
+        clean tensor — and returns ``x`` unchanged. The in-jit sibling
+        of :meth:`check_value` for code that cannot leave the trace."""
+        import jax
+        import jax.numpy as jnp
+        bad = jnp.sum((~jnp.isfinite(x)).astype(jnp.int32)) \
+            if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.int32(0)
+        hdr = ("TensorInspector[%s] check:" % (tag or "Tensor")) \
+            .replace("{", "{{").replace("}", "}}")
+        jax.debug.print(hdr + " nonfinite={bad}", bad=bad)
+        return x

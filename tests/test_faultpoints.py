@@ -109,6 +109,27 @@ class TestSpec:
         assert 0 < sum(a) < len(a)    # p=0.5 actually mixes
         assert a != c                 # and the seed actually matters
 
+    def test_health_corrupt_seeded_schedule_replays(self):
+        """The ISSUE 15 chaos seam draws from the same per-point seeded
+        stream: a probabilistic health.grad.corrupt schedule replays
+        identically run-to-run (through the healthmon probe that maps
+        the raise into a corruption operand), so a detected-SDC chaos
+        run is reproducible."""
+        from mxnet_tpu._debug import healthmon
+
+        def pattern(seed):
+            fp.configure(
+                {"health.grad.corrupt": "raise:ArithmeticError@p=0.5"},
+                seed=seed)
+            out = [healthmon.corruption_operand() for _ in range(32)]
+            fp.reset()
+            return [0 if v == 0.0 else 1 for v in out]
+
+        a, b, c = pattern(7), pattern(7), pattern(8)
+        assert a == b
+        assert 0 < sum(a) < len(a)
+        assert a != c
+
     def test_skip_and_n_modifiers(self):
         fp.configure({"kvstore.send": "raise:OSError@skip=2@n=1"})
         fp.check("kvstore.send")      # skipped

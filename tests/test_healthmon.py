@@ -1,0 +1,1008 @@
+"""Training-health plane tests (ISSUE 15): in-graph sentinels, anomaly
+actions (record / skip_step / halt), the numerics flight-record dump,
+Monitor routing through the fused step's health outputs, cross-rank SDC
+divergence gauges, TensorInspector device paths, and the AMP
+loss-scaler accounting fold.
+
+The acceptance pair the issue pins:
+
+- chaos: injected gradient corruption (``health.grad.corrupt``) is
+  detected within one step, trips exactly ONE ``numerics`` dump naming
+  the offending bucket/params (and the suspect rank), and a
+  ``skip_step`` run's final params are bitwise-equal to a run where the
+  poisoned step never happened;
+- fault-free twin: zero anomalies, and ``MXTPU_HEALTH=1`` training is
+  bitwise-identical to ``MXTPU_HEALTH=0`` — observability must not
+  perturb the numerics it observes.
+"""
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import profiler
+from mxnet_tpu.gluon import nn
+from mxnet_tpu._debug import faultpoint
+from mxnet_tpu._debug import flightrec
+from mxnet_tpu._debug import goodput
+from mxnet_tpu._debug import healthmon
+from mxnet_tpu._debug import watchdog
+from mxnet_tpu.monitor import Monitor
+from mxnet_tpu.tensor_inspector import TensorInspector
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(tmp_path / "frec"))
+    monkeypatch.delenv("MXTPU_HEALTH", raising=False)
+    monkeypatch.delenv("MXTPU_HEALTH_ACTION", raising=False)
+    faultpoint.reset()
+    healthmon.reset()
+    flightrec.reset_ring()
+    yield
+    faultpoint.reset()
+    healthmon.reset()
+
+
+def _batches(n, batch=8, in_dim=8, out_dim=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return [(rs.rand(batch, in_dim).astype("float32"),
+             rs.rand(batch, out_dim).astype("float32"))
+            for _ in range(n)]
+
+
+def _build_step(momentum=0.9, lr=0.05):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    args = {"learning_rate": lr}
+    if momentum:
+        args["momentum"] = momentum
+    trainer = gluon.Trainer(net.collect_params(), "sgd", args)
+    l2 = gluon.loss.L2Loss()
+    step = gluon.train_step(net, lambda o, t: l2(o, t), trainer)
+    return net, trainer, step
+
+
+def _train(batches, monkeypatch, health="0", action="record", fault=None,
+           momentum=0.9):
+    monkeypatch.setenv("MXTPU_HEALTH", health)
+    monkeypatch.setenv("MXTPU_HEALTH_ACTION", action)
+    faultpoint.reset()
+    healthmon.reset()
+    if fault:
+        faultpoint.configure({"health.grad.corrupt": fault})
+    net, trainer, step = _build_step(momentum=momentum)
+    losses = []
+    for x, y in batches:
+        loss = step(mx.nd.array(x), mx.nd.array(y), batch_size=x.shape[0])
+        losses.append(float(loss.asnumpy().sum()))
+    params = [p.data().asnumpy().copy()
+              for _, p in sorted(net.collect_params().items())]
+    faultpoint.reset()
+    return losses, params, net, trainer, step
+
+
+def _assert_bitwise(pa, pb):
+    assert len(pa) == len(pb)
+    for a, b in zip(pa, pb):
+        assert np.array_equal(a, b)
+
+
+# With _COMPILE_THRESHOLD=2, batches 0-1 run eager-warming, batch 2 is
+# the compile step; the corruption operand is consulted once per
+# fused-path call, so skip=K in the fault spec poisons batch K+2.
+_WARMUP = 2
+
+
+# -- graph_summary units -----------------------------------------------------
+
+class TestGraphSummary:
+    def test_per_bucket_indicators_and_norms(self):
+        import jax.numpy as jnp
+        g0 = jnp.asarray([1.0, float("nan"), 2.0], jnp.float32)
+        g1 = jnp.asarray([[3.0, float("inf")]], jnp.float32)
+        w0 = jnp.asarray([1.0, 1.0, 1.0], jnp.float32)
+        w1 = jnp.asarray([[0.5, -4.0]], jnp.float32)
+        loss = jnp.asarray([0.1, 0.2], jnp.float32)
+        packed, ok = healthmon.graph_summary(
+            [[0], [1]], (g0, g1), (w0, w1), loss)
+        s = healthmon.unpack_summary(packed, 2)
+        # a NaN/inf anywhere in a bucket poisons its sumsq: the bad
+        # flags are derived indicators, no per-element count pass
+        assert [int(v) for v in s["g_bad"]] == [1, 1]
+        assert [int(v) for v in s["w_bad"]] == [0, 0]
+        assert float(s["w_sumsq"][0]) == pytest.approx(3.0)
+        assert float(s["w_sumsq"][1]) == pytest.approx(16.25)
+        assert int(s["loss_bad"]) == 0
+        assert float(s["loss_sum"]) == pytest.approx(0.3, rel=1e-6)
+        assert float(s["loss_absmax"]) == pytest.approx(0.2, rel=1e-6)
+        assert not bool(ok)
+        assert not s["ok"]
+
+    def test_multi_leaf_bucket_folds(self):
+        import jax.numpy as jnp
+        g0 = jnp.asarray([1.0, 2.0], jnp.float32)
+        g1 = jnp.asarray([3.0], jnp.float32)
+        w = jnp.ones((1,), jnp.float32)
+        packed, ok = healthmon.graph_summary(
+            [[0, 1]], (g0, g1), (w, w),
+            jnp.asarray([0.5], jnp.float32))
+        s = healthmon.unpack_summary(packed, 1)
+        assert float(s["g_sumsq"][0]) == pytest.approx(14.0)
+        assert int(s["g_bad"][0]) == 0 and bool(ok)
+
+    def test_exploding_but_finite_overflow_flags(self):
+        import jax.numpy as jnp
+        # elements finite but sumsq overflows f32: an exploding bucket
+        # is exactly what the sentinel should flag
+        g = jnp.full((4,), 3e19, jnp.float32)
+        w = jnp.ones((4,), jnp.float32)
+        packed, ok = healthmon.graph_summary(
+            [[0]], (g,), (w,), jnp.asarray([0.1], jnp.float32))
+        s = healthmon.unpack_summary(packed, 1)
+        assert int(s["g_bad"][0]) == 1
+        assert not bool(ok)
+
+    def test_clean_summary_is_ok(self):
+        import jax.numpy as jnp
+        g = jnp.ones((4,), jnp.float32)
+        packed, ok = healthmon.graph_summary([[0]], (g,), (g,), g)
+        s = healthmon.unpack_summary(packed, 1)
+        assert bool(ok) and s["ok"]
+        assert int(s["g_bad"][0]) == 0
+
+    def test_nan_loss_flags_not_ok(self):
+        import jax.numpy as jnp
+        g = jnp.ones((4,), jnp.float32)
+        loss = jnp.asarray([1.0, float("nan")], jnp.float32)
+        packed, ok = healthmon.graph_summary([[0]], (g,), (g,), loss)
+        s = healthmon.unpack_summary(packed, 1)
+        assert int(s["loss_bad"]) == 1
+        assert not bool(ok)
+
+    def test_apply_corruption_identity_at_zero(self):
+        import jax.numpy as jnp
+        g = jnp.asarray([0.25, -0.0, 1e-30, -3.5], jnp.float32)
+        out = healthmon.apply_corruption((g,), jnp.float32(0.0))[0]
+        assert np.array_equal(np.asarray(out), np.asarray(g))
+        # sign of zero preserved (x * 1.0, not x + 0.0)
+        assert np.signbit(np.asarray(out)[1])
+
+    def test_corruption_operand_maps_exception_types(self):
+        faultpoint.configure(
+            {"health.grad.corrupt": "raise:OverflowError@n=1"})
+        assert healthmon.corruption_operand() == float("inf")
+        faultpoint.configure(
+            {"health.grad.corrupt": "raise:ArithmeticError@n=1"})
+        assert np.isnan(healthmon.corruption_operand())
+        faultpoint.configure(
+            {"health.grad.corrupt": "raise:ValueError@n=1"})
+        assert healthmon.corruption_operand() == 1.0
+        # disarmed (n exhausted): clean zero
+        assert healthmon.corruption_operand() == 0.0
+        faultpoint.reset()
+        assert healthmon.corruption_operand() == 0.0
+
+
+# -- fused-step sentinel integration ----------------------------------------
+
+class TestSentinels:
+    def test_fault_free_bitwise_identical_to_health_off(self, monkeypatch):
+        """The acceptance twin: sentinels must not perturb what they
+        observe — same losses, bitwise-same final params."""
+        batches = _batches(8)
+        l0, p0, _, _, _ = _train(batches, monkeypatch, health="0")
+        l1, p1, _, _, step = _train(batches, monkeypatch, health="1")
+        assert step.last_mode == "fused"
+        assert l0 == l1
+        _assert_bitwise(p0, p1)
+        st = healthmon.stats()
+        assert st["anomalies"] == 0
+        assert st["steps"] == len(batches) - _WARMUP
+
+    def test_sentinels_count_fused_steps_only(self, monkeypatch):
+        batches = _batches(5)
+        _train(batches, monkeypatch, health="1")
+        # warming steps run eagerly: no sentinel, no digest for them
+        assert healthmon.stats()["steps"] == len(batches) - _WARMUP
+        assert healthmon.last_digest()[0] == len(batches) - _WARMUP
+
+    def test_env_flip_retraces_cleanly(self, monkeypatch):
+        """MXTPU_HEALTH is a compile-signature token: flipping it
+        mid-run lands on a fresh cache entry (warm + compile again)
+        and the sentinels engage — never a stale replay of the other
+        graph."""
+        batches = _batches(10)
+        monkeypatch.setenv("MXTPU_HEALTH", "0")
+        net, trainer, step = _build_step()
+        for x, y in batches[:4]:
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        assert step.last_mode == "fused"
+        assert healthmon.stats()["steps"] == 0
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        modes = []
+        for x, y in batches[4:]:
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+            modes.append(step.last_mode)
+        # fresh key: one warming step, the compile, then fused hits
+        # (the config was already seen once under the old token set is
+        # irrelevant — the token is part of the key, so warming restarts)
+        assert modes[:2] == ["eager-warming", "compile"]
+        assert modes[-1] == "fused"
+        # the compile step runs the sentinels too: only the warming
+        # step is unchecked
+        assert healthmon.stats()["steps"] == len(modes) - 1
+
+    def test_action_flip_retraces(self, monkeypatch):
+        batches = _batches(8)
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        monkeypatch.setenv("MXTPU_HEALTH_ACTION", "record")
+        net, trainer, step = _build_step()
+        for x, y in batches[:4]:
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        assert step.last_mode == "fused"
+        monkeypatch.setenv("MXTPU_HEALTH_ACTION", "skip_step")
+        x, y = batches[4]
+        step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        assert step.last_mode == "eager-warming"  # new key, warms again
+
+    def test_nonfinite_trips_exactly_one_dump_per_episode(
+            self, monkeypatch, tmp_path):
+        batches = _batches(9)
+        _train(batches, monkeypatch, health="1", action="record",
+               fault="raise:ArithmeticError@n=1@skip=1")
+        st = healthmon.stats()
+        # record mode lets the NaN poison the weights: every later step
+        # is anomalous too — still ONE episode, ONE dump
+        assert st["nonfinite_steps"] >= 1
+        assert st["episodes"] == 1
+        assert st["dumps"] == 1
+        dumps = [p for p in os.listdir(str(tmp_path / "frec"))
+                 if "_numerics_" in p]
+        assert len(dumps) == 1
+
+    def test_dump_names_bucket_params_and_suspect_rank(
+            self, monkeypatch, tmp_path):
+        batches = _batches(6)
+        _, _, net, _, _ = _train(
+            batches, monkeypatch, health="1", action="skip_step",
+            fault="raise:ArithmeticError@n=1@skip=1")
+        shard = flightrec.last_dumps()[-1]
+        data = json.load(open(shard))
+        assert data["metadata"]["trigger"] == "numerics"
+        info = data["metadata"]["trigger_info"]
+        assert info["reason"] == "nonfinite"
+        # detected WITHIN the poisoned step: skip=1 passes the compile
+        # step (checked seq 1) and fires on checked step 2
+        assert info["step"] == 2
+        assert healthmon.stats()["last_anomaly_step"] == 2
+        assert info["suspect_rank"] == profiler.PID
+        assert info["skipped"] is True
+        param_names = set(net.collect_params())
+        named = {p for b in info["offending_buckets"]
+                 for p in b["params"]}
+        assert named and named <= param_names
+        # the bundled per-layer pass names the poisoned layers exactly
+        layer = {r["name"]: r for r in info["layer_stats"]}
+        assert set(layer) <= param_names
+        assert any(r["g_nonfinite"] > 0 for r in layer.values())
+        assert info["loss_window"]  # last-K losses ride along
+
+    def test_episode_rearms_after_clean_step(self, monkeypatch):
+        batches = _batches(12)
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        monkeypatch.setenv("MXTPU_HEALTH_ACTION", "skip_step")
+        healthmon.reset()
+        net, trainer, step = _build_step()
+        faultpoint.configure(
+            {"health.grad.corrupt": "raise:ArithmeticError@n=1@skip=1"})
+        for x, y in batches[:6]:
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        assert healthmon.stats()["dumps"] == 1
+        assert not healthmon.stats()["in_episode"]  # clean steps since
+        faultpoint.configure(
+            {"health.grad.corrupt": "raise:ArithmeticError@n=1"})
+        for x, y in batches[6:]:
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        st = healthmon.stats()
+        assert st["episodes"] == 2
+        assert st["dumps"] == 2
+        faultpoint.reset()
+
+    def test_skip_step_bitwise_equals_step_never_happened(
+            self, monkeypatch):
+        """The acceptance pin: a skipped poisoned update leaves params,
+        optimizer state AND update counts exactly as if the poisoned
+        step had never run."""
+        batches = _batches(8)
+        poisoned = _WARMUP + 1  # skip=1 -> the 2nd fused-path call
+        _, p_skip, _, tr_skip, _ = _train(
+            batches, monkeypatch, health="1", action="skip_step",
+            fault="raise:ArithmeticError@n=1@skip=1")
+        assert healthmon.stats()["skipped_steps"] == 1
+        ref = batches[:poisoned] + batches[poisoned + 1:]
+        _, p_ref, _, tr_ref, _ = _train(ref, monkeypatch, health="0")
+        _assert_bitwise(p_skip, p_ref)
+        assert tr_skip._optimizer.num_update == \
+            tr_ref._optimizer.num_update
+
+    def test_skip_step_counts_goodput_event(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MXTPU_RUNS_DIR", str(tmp_path / "runs"))
+        goodput.reset()
+        goodput.open_run(run_id="health_test")
+        try:
+            _train(_batches(6), monkeypatch, health="1",
+                   action="skip_step",
+                   fault="raise:ArithmeticError@n=1@skip=1")
+        finally:
+            manifest = goodput.close_run()
+        kinds = [e.get("kind") for e in manifest.get("events", [])]
+        assert "health_skip_step" in kinds
+
+    def test_halt_raises_and_rolls_back(self, monkeypatch):
+        batches = _batches(8)
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        monkeypatch.setenv("MXTPU_HEALTH_ACTION", "halt")
+        healthmon.reset()
+        net, trainer, step = _build_step()
+        faultpoint.configure(
+            {"health.grad.corrupt": "raise:ArithmeticError@n=1@skip=1"})
+        applied = 0
+        with pytest.raises(healthmon.HealthHaltError):
+            for x, y in batches:
+                step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+                applied += 1
+        faultpoint.reset()
+        st = healthmon.stats()
+        assert st["halts"] == 1
+        # the halted step's count bookkeeping was rolled back, and the
+        # in-graph select kept finite weights behind
+        assert trainer._optimizer.num_update == applied
+        for _, p in sorted(net.collect_params().items()):
+            assert np.isfinite(p.data().asnumpy()).all()
+        # adopt-then-raise (review fix): the halted step's outputs WERE
+        # adopted before the raise — the poisoned grads landed in the
+        # param grad buffers, proving the params hold the program's
+        # (clean, selected) output buffers rather than donated inputs
+        assert any(not np.isfinite(p.grad().asnumpy()).all()
+                   for _, p in sorted(net.collect_params().items()))
+
+    def test_finite_bitflip_is_invisible_locally_but_moves_digest(
+            self, monkeypatch):
+        """A finite corruption (grads doubled — the pure SDC shape) by
+        design does NOT trip the nonfinite sentinel; the grad-bucket
+        digest is what catches it, cross-rank."""
+        batches = _batches(6)
+        _train(batches, monkeypatch, health="1")
+        clean_seq, clean_sum = healthmon.last_digest()
+        _train(batches, monkeypatch, health="1",
+               fault="raise:ValueError@n=1@skip=%d"
+               % (len(batches) - _WARMUP - 1))
+        bad_seq, bad_sum = healthmon.last_digest()
+        assert healthmon.stats()["nonfinite_steps"] == 0
+        assert bad_seq == clean_seq
+        assert bad_sum != clean_sum
+
+    def test_loss_spike_detected_and_record_only(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        monkeypatch.setenv("MXTPU_HEALTH_ACTION", "skip_step")
+        healthmon.reset()
+        healthmon.configure(loss_factor=5.0, min_samples=3)
+        net, trainer, step = _build_step(lr=0.0)
+        rs = np.random.RandomState(0)
+        x = rs.rand(8, 8).astype("float32")
+        y = rs.rand(8, 4).astype("float32")
+        for _ in range(7):
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        step(mx.nd.array(x), mx.nd.array(y * 1e4), batch_size=8)
+        st = healthmon.stats()
+        assert st["loss_spikes"] == 1
+        assert st["nonfinite_steps"] == 0
+        # a finite spike is known only after the donated buffers
+        # committed: record-only under every action
+        assert st["skipped_steps"] == 0
+        assert st["dumps"] == 1
+
+    def test_spiked_loss_stays_out_of_median_window(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        healthmon.reset()
+        healthmon.configure(loss_factor=5.0, min_samples=3)
+        net, trainer, step = _build_step(lr=0.0)
+        rs = np.random.RandomState(0)
+        x = rs.rand(8, 8).astype("float32")
+        y = rs.rand(8, 4).astype("float32")
+        for _ in range(7):
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        med_before = healthmon.stats()["loss_median"]
+        for _ in range(2):
+            step(mx.nd.array(x), mx.nd.array(y * 1e4), batch_size=8)
+        st = healthmon.stats()
+        assert st["loss_spikes"] == 2
+        assert st["loss_median"] == med_before
+
+    def test_raising_note_step_never_skips_adoption(self, monkeypatch):
+        """Review fix: the sentinel host half runs AFTER the rollback
+        try — a raising telemetry path (buggy Monitor stat_func, torn
+        fetch) is swallowed and counted, and the committed program's
+        outputs still adopt (under donation they are the only valid
+        weights left)."""
+        from mxnet_tpu.gluon import fused_step as fs
+        batches = _batches(6)
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        healthmon.reset()
+        net, trainer, step = _build_step()
+        for x, y in batches[:4]:
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        assert step.last_mode == "fused"
+        before = [p.data().asnumpy().copy()
+                  for _, p in sorted(net.collect_params().items())]
+        errs = fs.stats()["health_errors"]
+
+        def boom(*a, **k):
+            raise RuntimeError("telemetry bug")
+        monkeypatch.setattr(healthmon, "note_step", boom)
+        x, y = batches[4]
+        loss = step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        assert step.last_mode == "fused"
+        assert np.isfinite(loss.asnumpy()).all()
+        assert fs.stats()["health_errors"] == errs + 1
+        after = [p.data().asnumpy()
+                 for _, p in sorted(net.collect_params().items())]
+        # the update WAS applied (adoption ran despite the raise)
+        assert any(not np.array_equal(a, b)
+                   for a, b in zip(before, after))
+
+    def test_anomaly_marker_lands_in_health_lane(self, monkeypatch):
+        assert profiler.LANES["health"] == 9
+        _train(_batches(6), monkeypatch, health="1", action="skip_step",
+               fault="raise:ArithmeticError@n=1@skip=1")
+        names = [e[1] for e in flightrec.snapshot()
+                 if not isinstance(e, str) and e[0] == "i"]
+        assert "health:nonfinite" in names
+        marks = [e for e in flightrec.snapshot() if not isinstance(e, str)
+                 and e[0] == "i" and e[1] == "health:nonfinite"]
+        assert marks[0][3] == profiler.LANES["health"]
+
+
+class TestMeshSentinels:
+    def test_mesh_health_sentinels_detect(self, monkeypatch):
+        """Mesh mode: the summary rides the shard_map program (loss
+        stats psum'd so every replica sees the global values), and the
+        corruption operand lands post-reduction — the SDC shape."""
+        import jax
+        from mxnet_tpu.parallel import create_mesh
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        monkeypatch.setenv("MXTPU_HEALTH_ACTION", "record")
+        healthmon.reset()
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+        net.initialize()
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05})
+        l2 = gluon.loss.L2Loss()
+        mesh = create_mesh(devices=jax.devices()[:4])
+        step = gluon.train_step(net, lambda o, t: l2(o, t), trainer,
+                                mesh=mesh)
+        batches = _batches(6)
+        for x, y in batches:
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        assert step.last_mode == "fused"
+        st = healthmon.stats()
+        assert st["steps"] > 0 and st["anomalies"] == 0
+        assert healthmon.last_digest() is not None
+        faultpoint.configure(
+            {"health.grad.corrupt": "raise:ArithmeticError@n=1"})
+        x, y = batches[0]
+        step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        faultpoint.reset()
+        st = healthmon.stats()
+        assert st["nonfinite_steps"] == 1
+        assert st["dumps"] == 1
+        # mesh-DP grads are psum'd in-graph (bitwise-shared): THIS
+        # digest is publishable, and a real heartbeat carries it to
+        # the server's SDC gauges — the end-to-end wire path
+        assert healthmon.shared_digest() == healthmon.last_digest()
+        from mxnet_tpu import kvstore_async as KA
+        import weakref as _weakref
+        monkeypatch.setattr(KA, "_SERVERS", _weakref.WeakSet())
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.init("w", np.zeros(2, np.float32))
+            cli.heartbeat(0, sync_clock=True)
+            ks = KA._server_stats()
+            assert ks["rank_health_seq.0"] == \
+                healthmon.shared_digest()[0]
+        finally:
+            srv.stop()
+
+
+# -- per-layer pass + Monitor routing ----------------------------------------
+
+class TestMonitorRouting:
+    def test_hybridized_install_warns_when_health_off(self, monkeypatch,
+                                                      caplog):
+        monkeypatch.delenv("MXTPU_HEALTH", raising=False)
+        net, _, _ = _build_step()
+        mon = Monitor(interval=1)
+        with caplog.at_level(logging.WARNING):
+            mon.install(net)
+        assert any("hybridized" in r.message for r in caplog.records)
+        with pytest.raises(ValueError, match="hybridized"):
+            Monitor(interval=1).install(net, strict=True)
+
+    def test_install_on_eager_block_does_not_warn(self, monkeypatch,
+                                                  caplog):
+        mx.random.seed(0)
+        net = nn.Dense(4)
+        net.initialize()
+        mon = Monitor(interval=1)
+        with caplog.at_level(logging.WARNING):
+            mon.install(net)
+        assert not caplog.records
+
+    def test_hybridized_hooks_silently_empty_regression(self,
+                                                        monkeypatch):
+        """The satellite bug, pinned: with the health plane OFF, a
+        hybridized block's forward produces ZERO hook (`*_output*`)
+        rows — the cached program bypasses Python hooks (and the trace
+        step's tracer hits are dropped instead of crashing toc)."""
+        monkeypatch.delenv("MXTPU_HEALTH", raising=False)
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8), nn.Dense(4))
+        net.initialize()
+        net.hybridize()
+        mon = Monitor(interval=1)
+        mon.install(net)
+        x = mx.nd.array(np.ones((2, 8), np.float32))
+        hook_rows = []
+        for _ in range(4):  # first call may run eagerly (deferred
+            mon.tic()       # init); later ones replay the cache
+            net(x).wait_to_read()
+            rows = mon.toc()
+            hook_rows.append([r for r in rows if "_output" in r[1]])
+        # once the program is cached, hook rows are empty forever —
+        # the bug install() now warns about (and healthmon replaces)
+        assert hook_rows[-1] == [] and hook_rows[-2] == []
+
+    def test_fused_rows_on_monitor_interval(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        healthmon.reset()
+        net, trainer, step = _build_step()
+        mon = Monitor(interval=2)
+        mon.install(net)
+        batches = _batches(8)
+        per_batch = []
+        for x, y in batches:
+            mon.tic()
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+            per_batch.append(mon.toc())
+        param_names = sorted(net.collect_params())
+        # fused interval batches (2, 4, 6): one weight + one grad row
+        # per trainable param, delivered from the health outputs
+        for i in (4, 6):
+            rows = per_batch[i]
+            names = [r[1] for r in rows]
+            assert sorted(n for n in names if not n.endswith("_grad")) \
+                == param_names
+            assert sorted(names) == sorted(
+                param_names + [n + "_grad" for n in param_names])
+            # no duplicates: healthmon delivery REPLACES the eager
+            # collect_params sweep for the hybridized block
+            assert len(names) == len(set(names))
+        # off-interval batches return nothing
+        assert per_batch[3] == [] and per_batch[5] == []
+        assert healthmon.stats()["monitor_rows"] > 0
+
+    def test_two_monitors_two_nets_no_crosstalk(self, monkeypatch):
+        """Review fix: delivery is scoped to the installed block's
+        parameters — monitor B (on an idle second net) receives NO rows
+        from net A's fused step, and B's own eager sweep still runs."""
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        healthmon.reset()
+        net_a, trainer, step = _build_step()
+        mx.random.seed(1)
+        net_b = nn.HybridSequential()
+        net_b.add(nn.Dense(4, in_units=3))
+        net_b.initialize()
+        net_b.hybridize()
+        mon_a, mon_b = Monitor(interval=1), Monitor(interval=1)
+        mon_a.install(net_a)
+        mon_b.install(net_b)
+        for x, y in _batches(4):
+            mon_a.tic()
+            mon_b.tic()
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+            rows_a = mon_a.toc()
+            rows_b = mon_b.toc()
+        assert step.last_mode == "fused"
+        names_a = {r[1] for r in rows_a}
+        assert names_a and all(
+            n.replace("_grad", "") in set(net_a.collect_params())
+            for n in names_a)
+        # B saw none of A's params, and its own eager sweep survived
+        names_b = {r[1].replace("_grad", "") for r in rows_b}
+        assert names_b == set(net_b.collect_params())
+
+    def test_pattern_filtered_monitor_keeps_eager_sweep(self,
+                                                        monkeypatch):
+        """Review fix: a monitor whose pattern matches none of the
+        delivered names gets ZERO rows counted and is NOT marked
+        fused-delivered — its own eager sweep (which applies the same
+        filter) still runs, and monitor_rows stays truthful."""
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        healthmon.reset()
+        net, trainer, step = _build_step()
+        mon = Monitor(interval=1, pattern=".*output.*")
+        mon.install(net)
+        for x, y in _batches(4):
+            mon.tic()
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+            rows = mon.toc()
+        assert step.last_mode == "fused"
+        assert rows == []  # nothing matches, nothing fabricated
+        assert healthmon.stats()["monitor_rows"] == 0
+        assert getattr(mon, "_fused_batch", None) is None
+
+    def test_hybridize_after_install_still_routes(self, monkeypatch,
+                                                  caplog):
+        """Review fix: install attaches the block regardless of
+        hybridization state — hybridize() AFTER install still delivers
+        rows with the health plane on, and with it off the bypass is
+        warned at the first bypassed toc() instead of staying silent."""
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        healthmon.reset()
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        mon = Monitor(interval=1)
+        mon.install(net)       # NOT hybridized yet
+        net.hybridize()        # the late hybridize
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05})
+        l2 = gluon.loss.L2Loss()
+        step = gluon.train_step(net, lambda o, t: l2(o, t), trainer)
+        rows = []
+        for x, y in _batches(5):
+            mon.tic()
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+            rows = mon.toc()
+        assert step.last_mode == "fused"
+        assert {r[1].replace("_grad", "") for r in rows} \
+            == set(net.collect_params())
+        # and with the plane OFF: the first bypassed toc warns, once
+        monkeypatch.delenv("MXTPU_HEALTH", raising=False)
+        mx.random.seed(0)
+        net2 = nn.HybridSequential()
+        net2.add(nn.Dense(4))
+        net2.initialize()
+        mon2 = Monitor(interval=1)
+        mon2.install(net2)
+        net2.hybridize()
+        x = mx.nd.array(np.ones((2, 8), np.float32))
+        with caplog.at_level(logging.WARNING):
+            for _ in range(3):
+                mon2.tic()
+                net2(x).wait_to_read()
+                mon2.toc()
+        warns = [r for r in caplog.records if "hybridized" in r.message]
+        assert len(warns) == 1
+
+    def test_interval_layer_passes(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        healthmon.reset()
+        healthmon.configure(interval=3)
+        net, trainer, step = _build_step()
+        batches = _batches(2 + 9)  # 2 warmup + 9 fused-path steps
+        for x, y in batches:
+            step(mx.nd.array(x), mx.nd.array(y), batch_size=8)
+        st = healthmon.stats()
+        assert st["steps"] == 9
+        assert st["layer_passes"] == 3  # steps 3, 6, 9 only
+        rows = healthmon.last_layer_stats()
+        assert sorted(n for n, _ in rows) == sorted(net.collect_params())
+        for _, r in rows:
+            assert r["g_nonfinite"] == 0 and r["w_nonfinite"] == 0
+            assert r["w_l2"] > 0
+
+
+# -- cross-rank SDC divergence ------------------------------------------------
+
+@pytest.fixture
+def _only_my_servers(monkeypatch):
+    """_server_stats aggregates over every live AsyncPSServer; a
+    stopped-but-uncollected server from an earlier test would leak
+    phantom ranks/digests into these exact-gauge assertions. Give each
+    unit test a private registry."""
+    import weakref
+    from mxnet_tpu import kvstore_async as KA
+    monkeypatch.setattr(KA, "_SERVERS", weakref.WeakSet())
+
+
+class TestSDCDivergence:
+    def _beat(self, srv, rank, digest):
+        from mxnet_tpu import kvstore_async as KA
+        cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+        cli.init("w%d" % rank, np.zeros(2, np.float32))  # negotiate v1
+        # simulate this rank's mesh-DP digest (digest_shared: only
+        # bitwise-shared-grads programs publish — review fix)
+        healthmon._state["digest"] = digest
+        healthmon._state["digest_shared"] = True
+        cli.heartbeat(rank, sync_clock=True)
+        return cli
+
+    def test_local_digest_never_rides_the_heartbeat(self,
+                                                    _only_my_servers,
+                                                    monkeypatch):
+        """Review fix: a single-device (non-replicated) digest would
+        false-diverge on every healthy step — it stays local. The
+        fused step marks replication per program, and only a
+        replicated digest reaches the wire."""
+        from mxnet_tpu import kvstore_async as KA
+        _train(_batches(5), monkeypatch, health="1")
+        assert healthmon.last_digest() is not None   # local gauge
+        assert healthmon.shared_digest() is None     # not publishable
+        watchdog._last = (7, 0.01)
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.init("w", np.zeros(2, np.float32))
+            cli.heartbeat(0, sync_clock=True)
+            ks = KA._server_stats()
+            assert "rank_health_seq.0" not in ks
+        finally:
+            srv.stop()
+
+    def test_digest_rides_heartbeat_and_agreement_is_clean(self, _only_my_servers):
+        from mxnet_tpu import kvstore_async as KA
+        watchdog._last = (7, 0.01)
+        srv = KA.AsyncPSServer()
+        try:
+            self._beat(srv, 0, (7, 12345))
+            self._beat(srv, 1, (7, 12345))
+            ks = KA._server_stats()
+            assert ks["rank_health_seq.0"] == 7
+            assert ks["rank_health_seq.1"] == 7
+            assert ks["sdc_divergence"] == 0
+            assert ks["sdc_suspects"] == []
+        finally:
+            srv.stop()
+
+    def test_two_rank_divergence_flags_both(self, _only_my_servers):
+        from mxnet_tpu import kvstore_async as KA
+        watchdog._last = (7, 0.01)
+        srv = KA.AsyncPSServer()
+        try:
+            self._beat(srv, 0, (7, 1111))
+            self._beat(srv, 1, (7, 2222))
+            ks = KA._server_stats()
+            assert ks["sdc_divergence"] == 1
+            assert ks["sdc_checked_seq"] == 7
+            # two ranks: divergence certain, attribution not — both
+            assert ks["sdc_suspects"] == [0, 1]
+            assert ks["sdc_suspect.0"] == 1 and ks["sdc_suspect.1"] == 1
+        finally:
+            srv.stop()
+
+    def test_three_rank_majority_names_the_suspect(self, _only_my_servers):
+        from mxnet_tpu import kvstore_async as KA
+        watchdog._last = (7, 0.01)
+        srv = KA.AsyncPSServer()
+        try:
+            self._beat(srv, 0, (7, 1111))
+            self._beat(srv, 1, (7, 2222))
+            self._beat(srv, 2, (7, 1111))
+            ks = KA._server_stats()
+            assert ks["sdc_divergence"] == 1
+            assert ks["sdc_suspects"] == [1]
+            assert "sdc_suspect.0" not in ks
+        finally:
+            srv.stop()
+
+    def test_mismatched_seqs_not_compared(self, _only_my_servers):
+        from mxnet_tpu import kvstore_async as KA
+        watchdog._last = (7, 0.01)
+        srv = KA.AsyncPSServer()
+        try:
+            self._beat(srv, 0, (7, 1111))
+            self._beat(srv, 1, (8, 2222))  # different step: no verdict
+            ks = KA._server_stats()
+            assert "sdc_divergence" not in ks
+            assert "sdc_suspects" not in ks
+        finally:
+            srv.stop()
+
+    def test_digest_rides_without_watchdog(self, _only_my_servers):
+        """Review fix: MXTPU_WATCHDOG=0 leaves last_step() None forever
+        — the digest must still ride (placeholder step pair, seq=-1),
+        and the placeholder must NOT enter the straggler gauges."""
+        from mxnet_tpu import kvstore_async as KA
+        watchdog._last = None
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.init("w", np.zeros(2, np.float32))
+            healthmon._state["digest"] = (9, 4242)
+            healthmon._state["digest_shared"] = True
+            cli.heartbeat(0, sync_clock=True)
+            ks = KA._server_stats()
+            assert ks["rank_health_seq.0"] == 9
+            assert "rank_step_s.0" not in ks
+            assert "rank_step_seq.0" not in ks
+        finally:
+            srv.stop()
+
+    def test_corrupted_rank_diverges_on_the_wire(self, monkeypatch,
+                                                 _only_my_servers):
+        """End-to-end 2-rank acceptance: train rank 0 clean and rank 1
+        with a finite bit-flip corruption on the same data, publish
+        both digests over real heartbeats, and the server flags the
+        divergence naming rank 1 among the suspects."""
+        from mxnet_tpu import kvstore_async as KA
+        batches = _batches(6)
+        _train(batches, monkeypatch, health="1")
+        clean = healthmon.last_digest()
+        _train(batches, monkeypatch, health="1",
+               fault="raise:ValueError@n=1@skip=%d"
+               % (len(batches) - _WARMUP - 1))
+        bad = healthmon.last_digest()
+        assert clean[0] == bad[0] and clean[1] != bad[1]
+        watchdog._last = (clean[0], 0.01)
+        srv = KA.AsyncPSServer()
+        try:
+            self._beat(srv, 0, clean)
+            self._beat(srv, 1, bad)
+            ks = KA._server_stats()
+            assert ks["sdc_divergence"] == 1
+            assert 1 in ks["sdc_suspects"]
+        finally:
+            srv.stop()
+
+
+# -- TensorInspector device paths --------------------------------------------
+
+class TestTensorInspector:
+    def test_snapshot_single_transfer(self, monkeypatch):
+        import jax
+        calls = []
+        real = jax.device_get
+
+        def spy(x):
+            calls.append(1)
+            return real(x)
+        monkeypatch.setattr(jax, "device_get", spy)
+        tensors = [mx.nd.array(np.full((3,), i, np.float32))
+                   for i in range(5)]
+        tensors[2][1] = float("nan")
+        insp = TensorInspector.snapshot(tensors)
+        assert len(calls) == 1  # ONE batched transfer, not per tensor
+        assert [i.has_nan_or_inf() for i in insp] \
+            == [False, False, True, False, False]
+        assert insp[2].check_value() == [(1,)]
+
+    def test_snapshot_dict_tags(self):
+        out = TensorInspector.snapshot(
+            {"a": np.zeros(2), "b": np.ones(2)})
+        assert set(out) == {"a", "b"}
+        assert out["a"].tag == "a"
+        assert "a 2" in out["a"].print_string()
+
+    def test_ndarray_constructor_still_works(self):
+        t = TensorInspector(mx.nd.array(np.eye(2)), tag="eye")
+        assert not t.has_nan_or_inf()
+        assert "eye 2x2" in t.print_string()
+
+    def test_print_in_trace_inside_jit(self, capsys):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return TensorInspector.print_in_trace(x, tag="probe") * 2.0
+
+        x = jnp.asarray([1.0, float("nan"), 3.0], jnp.float32)
+        y = f(x)
+        jax.effects_barrier()
+        out = capsys.readouterr().out
+        assert "TensorInspector[probe]" in out
+        assert "nonfinite=1" in out
+        # the probe is an identity: the traced value is unchanged
+        assert np.array_equal(np.asarray(y)[::2],
+                              np.asarray(x)[::2] * 2.0)
+
+    def test_braced_tag_is_format_safe(self, capsys):
+        """Review fix: a '{'/'}'-bearing tag must not corrupt the
+        jax.debug.print format string and abort the user's trace."""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return TensorInspector.print_in_trace(x, tag="block{0}.d")
+
+        f(jnp.ones((2,), jnp.float32))
+        jax.effects_barrier()
+        assert "block{0}.d" in capsys.readouterr().out
+
+    def test_check_in_trace_counts_nonfinite(self, capsys):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return TensorInspector.check_in_trace(x, tag="g")
+
+        f(jnp.asarray([1.0, float("inf")], jnp.float32))
+        jax.effects_barrier()
+        assert "nonfinite=1" in capsys.readouterr().out
+
+
+# -- AMP loss-scaler accounting ----------------------------------------------
+
+class TestAmpAccounting:
+    def test_overflow_skips_count_with_profiling_off(self):
+        from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+        assert not profiler.is_running()
+        scaler = LossScaler(init_scale=1024.0, scale_factor=2.0,
+                            scale_window=2)
+        scaler.update_scale(True)
+        h = profiler.metrics()["health"]
+        assert h["amp_overflow_skips"] == 1
+        assert h["amp_loss_scale"] == 512.0
+        scaler.update_scale(False)
+        scaler.update_scale(False)  # window hit: scale doubles back
+        h = profiler.metrics()["health"]
+        assert h["amp_overflow_skips"] == 1
+        assert h["amp_scale_updates"] == 3
+        assert h["amp_loss_scale"] == 1024.0
+
+
+# -- surfaces -----------------------------------------------------------------
+
+class TestSurfaces:
+    def test_metrics_section_and_dumps_line(self, monkeypatch):
+        m = profiler.metrics()
+        assert "health" in m
+        for key in ("steps", "anomalies", "skipped_steps",
+                    "amp_overflow_skips", "enabled", "action"):
+            assert key in m["health"]
+        assert "health:" in profiler.dumps()
+
+    def test_prometheus_families(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_HEALTH", "1")
+        healthmon.reset()
+        text = profiler.prometheus_text()
+        assert 'mxtpu_health_steps_total{rank="%d",kind="checked"}' \
+            % profiler.PID in text
+        assert "mxtpu_health_anomaly{" in text
+        assert "mxtpu_health_loss{" in text
+        monkeypatch.setenv("MXTPU_HEALTH", "0")
+        assert "mxtpu_health_steps_total" not in \
+            profiler.prometheus_text()
+
+    def test_faultpoint_cataloged(self):
+        assert "health.grad.corrupt" in faultpoint.POINTS
+        # configure() validates against the catalog — a typo'd health
+        # point fails loudly
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faultpoint.configure({"health.grad.corrupted": "raise"})
+
+    def test_numerics_dump_bundles_health_metrics(self, monkeypatch):
+        _train(_batches(6), monkeypatch, health="1", action="skip_step",
+               fault="raise:ArithmeticError@n=1@skip=1")
+        data = json.load(open(flightrec.last_dumps()[-1]))
+        h = data["metadata"]["metrics"]["health"]
+        assert h["nonfinite_steps"] == 1
+        assert h["skipped_steps"] == 1

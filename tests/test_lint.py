@@ -485,6 +485,25 @@ def test_mx013_real_catalog_includes_io_points():
         assert p in catalog, p
 
 
+def test_mx013_covers_health_points(tmp_path):
+    """ISSUE 15: the health chaos seam is cataloged (the real
+    healthmon.corruption_operand site lints clean) and a typo'd
+    `health.*` literal in an instrumented module is flagged."""
+    rule = next(r for r in rules.ALL_RULES if r.code == "MX013")
+    assert "health.grad.corrupt" in rule._catalog()
+    _plant_catalog(tmp_path, ["health.grad.corrupt"])
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/_debug/newhealth.py", """\
+        from . import faultpoint as _faultpoint
+
+        def probe():
+            _faultpoint.check("health.grad.corrupt")   # cataloged: ok
+            _faultpoint.check("health.grad.corrupted")  # flagged
+        """, {"MX013"})
+    assert [f.code for f in findings] == ["MX013"]
+    assert "health.grad.corrupted" in findings[0].message
+
+
 # -- waiver machinery --------------------------------------------------------
 
 def test_waiver_without_reason_is_flagged(tmp_path):

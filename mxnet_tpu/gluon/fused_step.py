@@ -48,11 +48,15 @@ API::
 """
 from __future__ import annotations
 
+import functools
+import inspect
 import os
 import time as _time
+import warnings
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import autograd
 from .. import profiler as _profiler
@@ -61,6 +65,7 @@ from .. import random as _random
 from ..ndarray import NDArray
 from ..ndarray import register as _register
 from .._debug import faultpoint as _faultpoint
+from .._debug import flightrec as _flightrec
 from .._debug import healthmon as _healthmon
 from .._debug import watchdog as _watchdog
 from .. import storage as _storage
@@ -91,6 +96,11 @@ _STATS = {
     "health_errors": 0,  # healthmon.note_step raised after a committed
                          # program (sentinel verdict lost, step kept —
                          # a telemetry failure must not skip adoption)
+    "mesh_fallbacks": 0,  # mesh-mode steps demoted to eager because the
+                          # batch dim does not divide the 'dp' axis —
+                          # every such step pays the single-device eager
+                          # cost (the warn-once + flightrec marker make
+                          # a 10x slowdown name itself)
 }
 
 
@@ -176,7 +186,8 @@ def _adopt_state(state, new):
         _adopt_state(s, n)
 
 
-def train_step(block, loss_fn, trainer, mesh=None, bucket_bytes=None):
+def train_step(block, loss_fn, trainer, mesh=None, bucket_bytes=None,
+               rules=None):
     """Fused training step for a (block, loss, trainer) triple:
     ``step(data, label, batch_size=...)`` computes
     ``loss_fn(block(data), label)``, backpropagates, and applies the
@@ -192,9 +203,19 @@ def train_step(block, loss_fn, trainer, mesh=None, bucket_bytes=None):
     all-reduce is issued as size-capped buckets placed MID-BACKWARD
     (``parallel/overlap.py``) so the reduction hides under the backward
     instead of serializing after it — the SCALING_r05 overlap story,
-    folded into the fused step."""
+    folded into the fused step.
+
+    With a 3D dp×tp×sp mesh (any model axis >1) or explicit ``rules``
+    (regex partition rules over the param tree —
+    ``parallel/sharding.PartitionRules``, a ``ShardingStrategy``, or a
+    raw ``[(regex, spec)]`` list), the program runs in GSPMD mode
+    instead: params carry NamedShardings from the rules, the batch is
+    sharded over dp (and sp when it divides), the SPMD partitioner
+    inserts the collectives, and the step's ``out_shardings`` are
+    matched to its ``in_shardings`` so donated weights/optimizer state
+    never reshard between steps (see docs/PARALLEL.md)."""
     return FusedTrainStep(trainer, loss_fn, block=block, mesh=mesh,
-                          bucket_bytes=bucket_bytes)
+                          bucket_bytes=bucket_bytes, rules=rules)
 
 
 class FusedTrainStep:
@@ -209,20 +230,42 @@ class FusedTrainStep:
     block parameter through the trace)."""
 
     def __init__(self, trainer, loss_fn, block=None, mesh=None,
-                 bucket_bytes=None):
+                 bucket_bytes=None, rules=None):
         if not callable(loss_fn):
             raise TypeError("loss_fn must be callable, got %r"
                             % type(loss_fn))
         self._trainer = trainer
-        self._loss_fn = loss_fn
         self._block = block
         self._mesh = mesh
         self._bucket_bytes = bucket_bytes
+        self._rules_arg = rules
+        self._rules = None       # resolved PartitionRules (GSPMD mode)
         self._dp = 1
+        self._sizes = {}
+        self._mesh_n = 1
+        self._warned_mesh_indivisible = False
+        self._last_compiled = None  # most recent AOT executable (mesh)
+        self._last_hlo = None       # ... and its optimized HLO text
         if mesh is not None:
             raw = getattr(mesh, "mesh", mesh)
-            self._dp = int(dict(raw.shape).get("dp", 1))
-        self._cache = {}        # full signature -> (jfn, aux_params, fixed)
+            self._sizes = {a: int(s) for a, s in dict(raw.shape).items()}
+            self._dp = int(self._sizes.get("dp", 1))
+            self._mesh_n = 1
+            for s in self._sizes.values():
+                self._mesh_n *= int(s)
+            # the Trainer/loss ce_local_accum weld: a mesh-aware loss
+            # (e.g. a closure over parallel/transformer.loss_fn, which
+            # auto-selects the single-reduction chunked CE) declares a
+            # ``mesh`` kwarg and receives THIS step's mesh — no side
+            # channel, the one mesh drives data, params and the loss
+            try:
+                if "mesh" in inspect.signature(loss_fn).parameters:
+                    loss_fn = functools.partial(loss_fn, mesh=mesh)
+            except (TypeError, ValueError):
+                pass
+        self._loss_fn = loss_fn
+        self._cache = {}  # full signature ->
+        #   (jfn, aux_params, fixed_pos, hmeta, in_shardings)
         self._key_counts = {}   # signature -> times seen (warming)
         self._partial_keys = set()  # configs compiled (retrace detection)
         self._failed_keys = set()   # signatures that failed to trace
@@ -234,6 +277,78 @@ class FusedTrainStep:
         # device time from this step's wall time
         self._attr_models = {}
         self._step_attr = None  # the executing step's model (set by hits)
+
+    # -- mesh-mode selection -----------------------------------------------
+    def _gspmd_mode(self):
+        """True when this step compiles as one GSPMD program (jit with
+        explicit in/out shardings) instead of the dp-only shard_map:
+        any model axis of the mesh >1, or explicit partition rules.
+        ``MXTPU_GSPMD_STEP=0`` (a compile-signature token) forces the
+        legacy treatment — params replicated, batch dp-sharded — as the
+        escape hatch for partitioner bugs; the token makes the flip
+        land on a fresh cache key."""
+        if self._mesh is None:
+            return False
+        model_axes = any(int(self._sizes.get(a, 1)) > 1
+                         for a in ("tp", "sp", "fsdp", "ep", "pp"))
+        if not (model_axes or self._rules_arg is not None):
+            return False
+        return _getenv("MXTPU_GSPMD_STEP", "1") not in ("0", "false",
+                                                        "off")
+
+    def _resolve_rules(self):
+        """The partition rules the GSPMD mode shards params by: the
+        constructor's ``rules`` (PartitionRules / ShardingStrategy /
+        raw list), else inferred from the block's param paths
+        (``sharding.infer_rules_for_block(..., 'auto')`` — Megatron TP
+        rules when they match, replicated otherwise)."""
+        if self._rules is not None:
+            return self._rules
+        from ..parallel import sharding as _sharding
+        rules = self._rules_arg
+        if rules is None:
+            rules = _sharding.infer_rules_for_block(
+                self._block, self._mesh, "auto")
+        if isinstance(rules, _sharding.ShardingStrategy):
+            rules = rules.param_rules
+        elif not isinstance(rules, _sharding.PartitionRules):
+            rules = _sharding.PartitionRules(rules)
+        self._rules = rules
+        return rules
+
+    def last_program(self):
+        """(compiled_executable, optimized_hlo_text) of the most recent
+        AOT-compiled signature, or (None, None). The bench gspmd_step
+        gate and the comm tests measure collective payloads from the
+        HLO and check the matched-shardings contract on the
+        executable."""
+        return self._last_compiled, self._last_hlo
+
+    def matched_step_shardings(self):
+        """The SNIPPETS [1] zero-resharding contract, checked on the
+        compiled program: the weight/optimizer-state OUTPUT shardings
+        equal the corresponding INPUT shardings, so step N's donated
+        outputs feed step N+1 without a single resharding transfer.
+        Returns True/False, or None when no AOT program is held."""
+        compiled = self._last_compiled
+        if compiled is None:
+            return None
+        try:
+            in_shs = compiled.input_shardings[0]
+            out_shs = compiled.output_shardings
+        except Exception:
+            return None
+
+        def _specs(tree):
+            return [getattr(s, "spec", s) for s in
+                    jax.tree_util.tree_leaves(tree)]
+
+        n_train = len(_specs(in_shs[0]))
+        n_state = len(_specs(in_shs[1]))
+        # outputs: (loss, new_ws, new_sts, grads, aux[, health])
+        return (_specs(out_shs[1]) == _specs(in_shs[0])
+                and _specs(out_shs[2]) == _specs(in_shs[1])
+                and n_train > 0 and n_state >= 0)
 
     # -- public ------------------------------------------------------------
     def __call__(self, *args, batch_size=None, ignore_stale_grad=False):
@@ -298,9 +413,29 @@ class FusedTrainStep:
         if reason is None and self._mesh is not None and nd_args \
                 and nd_args[0].shape \
                 and nd_args[0].shape[0] % max(self._dp, 1) != 0:
-            # shard_map shards dim 0 over 'dp'; an indivisible batch
-            # runs this step eagerly instead of crashing the trace
+            # the mesh step shards dim 0 over 'dp'; an indivisible batch
+            # runs this step eagerly instead of crashing the trace.
+            # Eager means SINGLE-DEVICE: a run whose loader emits such
+            # batches silently pays ~mesh-size x per step, so the
+            # demotion is never silent — a warn-once, a dedicated
+            # counter, and a flight-recorder marker per occurrence
             reason = "mesh-batch-indivisible"
+            _STATS["mesh_fallbacks"] += 1
+            batch = int(nd_args[0].shape[0])
+            if not self._warned_mesh_indivisible:
+                self._warned_mesh_indivisible = True
+                warnings.warn(
+                    "fused step: batch dim %d does not divide mesh axis "
+                    "dp=%d; this step (and every step with such a batch)"
+                    " runs EAGERLY on one device. Pad or drop the "
+                    "remainder batch, or size the loader batch to a "
+                    "multiple of dp. (warn-once; see "
+                    "fused_step.mesh_fallbacks in profiler.metrics())"
+                    % (batch, self._dp), stacklevel=3)
+            # mxlint: disable=MX011 (demotion path, not steady-state dispatch; the black box must see it with the profiler off)
+            _flightrec.record_marker(
+                "fused_step.mesh_fallback",
+                args={"batch": batch, "dp": self._dp})
         if reason is None:
             all_params, train_pos, indices = self._param_split()
             if not train_pos:
@@ -355,7 +490,7 @@ class FusedTrainStep:
         try:
             c0 = _time.perf_counter()
             self._aot = None
-            entry = self._build(all_params, train_pos)
+            entry = self._build(all_params, train_pos, nd_args, states)
             loss = self._run(entry, all_params, train_pos, indices, states,
                              nd_args, batch_size, aot=True)
             if self._aot is not None:
@@ -456,8 +591,19 @@ class FusedTrainStep:
         deliberately absent; the partial key (config without avals) is the
         retrace detector, same contract as register._dispatch_key."""
         state_datas = [_state_to_data(s) for s in states]
+        mesh_fp = None
+        if self._mesh is not None:
+            # mode fingerprint: GSPMD vs dp-shard_map, the mesh axis
+            # sizes, and (GSPMD) the partition-rule table — editing a
+            # rule or resizing an axis must land on a fresh program,
+            # never replay one compiled for another layout
+            gspmd = self._gspmd_mode()
+            mesh_fp = (gspmd, tuple(sorted(self._sizes.items())),
+                       self._resolve_rules().describe() if gspmd
+                       else None)
         partial = (self._trainer._optimizer._fused_static_key(),
                    len(all_params), tuple(train_pos),
+                   mesh_fp,
                    _register._amp_version,
                    # the signature-token registry: every env var that
                    # changes a traced graph (the packed-apply toggle for
@@ -474,17 +620,39 @@ class FusedTrainStep:
         return full, partial
 
     # -- the program -------------------------------------------------------
-    def _build(self, all_params, train_pos):
+    def _build(self, all_params, train_pos, nd_args=None, states=None):
         """Trace loss-forward + backward + the optimizer update for ALL
         parameters into one pure function and jit it with weight and
         optimizer-state buffers donated (off-CPU; donation is a no-op on
-        the host backend)."""
+        the host backend).
+
+        Mesh modes (``nd_args``/``states`` supply the operand shapes the
+        sharding trees need):
+
+        - dp-only (``_gspmd_mode()`` False): the body is ``shard_map``-ped
+          over 'dp' with the explicit psum bucket markers — byte-identical
+          to the pre-3D program.
+        - GSPMD (any model axis >1, or explicit rules): ONE ``jax.jit``
+          whose ``in_shardings`` place params by the partition rules and
+          the batch over dp×sp, and whose ``out_shardings`` pin the new
+          weights/optimizer state to EXACTLY the input placements — step
+          N's donated outputs are step N+1's inputs with zero resharding
+          (the matched-shardings contract). The SPMD partitioner supplies
+          every collective; the bucket markers run in their axis-free
+          form so the reduction still lands per-bucket, and the chunked
+          CE's own ``shard_map`` (``parallel/compat.py``) nests inside.
+        """
         if _faultpoint.ACTIVE:
             # trace-site fault seam: _dispatch wraps _build in the
             # fallback:trace-failed try, so a raise here exercises the
             # per-step eager degradation a real trace failure takes
             _faultpoint.check("fused_step.trace")
         opt = self._trainer._optimizer
+        gspmd = self._gspmd_mode()
+        # manual_dp: the legacy dp-only shard_map treatment (explicit
+        # axis, explicit psums); gspmd: plain jit + shardings, the
+        # partitioner owns the collectives
+        manual_dp = self._mesh is not None and not gspmd
         pure_fwd, aux_params = make_pure_forward(all_params, self._call,
                                                  training=True)
         n_all = len(all_params)
@@ -523,8 +691,10 @@ class FusedTrainStep:
                 # digests are published for cross-rank SDC comparison
                 # only when this program's grads are bitwise-shared
                 # across ranks (the mesh-DP psum) — a local digest
-                # would false-diverge every healthy step
-                "replicated": self._dp > 1,
+                # would false-diverge every healthy step. Under GSPMD
+                # rule-sharded params carry SHARDED grads, so digests
+                # stay local there.
+                "replicated": self._dp > 1 and not gspmd,
             }
 
         tag = None
@@ -532,20 +702,27 @@ class FusedTrainStep:
             # mesh mode: bucket markers between the grad variables and
             # their use — each bucket's psum over 'dp' fires in the
             # backward the moment its segment completes, hiding the
-            # reduction under the rest of the backward (overlap.py)
+            # reduction under the rest of the backward (overlap.py).
+            # GSPMD form: axis_name=None — the markers keep the flat
+            # per-bucket wire batching, the partitioner supplies the
+            # reduction itself.
             from ..parallel import overlap as _overlap
+            _tag_axis = "dp" if manual_dp else None
 
             def tag(tds):
                 return tuple(_overlap.tag_gradient_buckets(
-                    list(tds), "dp", plan=plan, op="sum"))
+                    list(tds), _tag_axis, plan=plan, op="sum"))
 
         def pure_step(train_datas, state_datas, fixed_datas, in_datas,
                       lrs, wds, rescale, rng, corrupt=None):
-            if tag is not None:
+            if manual_dp:
                 # per-shard rng: a replicated key would hand every 'dp'
                 # shard identical dropout masks (sample j of shard 0 and
                 # shard 1 sharing a mask), shrinking the effective
-                # randomness by the dp factor
+                # randomness by the dp factor. The GSPMD program traces
+                # GLOBALLY (no manual axis), so its one key already
+                # draws per-sample masks — and matches the single-device
+                # program bitwise.
                 rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
 
             def loss_of(tds):
@@ -612,9 +789,10 @@ class FusedTrainStep:
                                                      rs_i)
                 new_ws[i] = nw
                 new_sts[i] = ns
-            if self._mesh is not None:
+            if manual_dp:
                 # aux (BN moving stats) are per-shard estimates —
                 # average them so every replica adopts the same value
+                # (GSPMD computes them over the global batch already)
                 from jax import lax
                 aux = tuple(lax.pmean(a, "dp") for a in aux)
             if hmeta is None:
@@ -626,7 +804,7 @@ class FusedTrainStep:
             # threaded out as one extra tiny output
             health, ok = _healthmon.graph_summary(
                 hmeta["plan"], grads, train_datas, loss,
-                axis_name="dp" if self._mesh is not None else None)
+                axis_name="dp" if manual_dp else None)
             if hmeta["select"]:
                 # skip_step/halt: a poisoned update is discarded HERE,
                 # where both the old and the new buffers still exist
@@ -642,8 +820,8 @@ class FusedTrainStep:
                 health
 
         body = pure_step
-        if self._mesh is not None:
-            from jax.sharding import PartitionSpec as P
+        if manual_dp:
+            from ..parallel.compat import PartitionSpec as P
             from ..parallel.compat import shard_map as _shard_map
             raw_mesh = getattr(self._mesh, "mesh", self._mesh)
             # params/states/hypers replicated, batch sharded on 'dp';
@@ -664,11 +842,102 @@ class FusedTrainStep:
                 donate = (0, 1)  # weights + optimizer state
         except Exception:
             donate = ()
-        jfn = jax.jit(body, donate_argnums=donate) if donate \
-            else jax.jit(body)
+        in_shs = None
         if self._mesh is not None:
-            jfn = self._mesh_placed(jfn)
-        return jfn, aux_params, fixed_pos, hmeta
+            in_shs = self._input_shardings(all_params, train_pos,
+                                           fixed_pos, nd_args, states,
+                                           hmeta is not None, gspmd)
+        if gspmd:
+            # the matched-shardings contract (out == in for donated
+            # weights/optimizer state): the compiled program's weight
+            # outputs land EXACTLY where the next step reads them.
+            # Grads pin to the weight placements so adoption keeps the
+            # layout the next backward consumes. loss/aux/health pin
+            # REPLICATED (a tree-prefix sharding covers any rank):
+            # bytes are trivial, and a multi-process mesh needs them
+            # fully addressable on every rank (NDArray.asnumpy of a
+            # cross-process-sharded loss cannot materialize).
+            from ..parallel.compat import NamedSharding
+            from ..parallel.compat import PartitionSpec as P
+            rep = NamedSharding(getattr(self._mesh, "mesh", self._mesh),
+                                P())
+            out_shs = (rep, in_shs[0], in_shs[1], in_shs[0], rep)
+            if hmeta is not None:
+                out_shs += (rep,)
+            jfn = jax.jit(body, in_shardings=in_shs,
+                          out_shardings=out_shs,
+                          donate_argnums=donate)
+        else:
+            jfn = jax.jit(body, donate_argnums=donate) if donate \
+                else jax.jit(body)
+        return jfn, aux_params, fixed_pos, hmeta, in_shs
+
+    def _input_shardings(self, all_params, train_pos, fixed_pos, nd_args,
+                         states, with_corrupt, gspmd):
+        """The operand-placement tree, structured EXACTLY like the
+        operands tuple ``_run`` assembles (safe to bake at build time —
+        the cache key pins every operand aval). dp-only mode reproduces
+        the old placement shim: everything replicated, batch
+        'dp'-sharded. GSPMD mode places each parameter by the partition
+        rules (``PartitionRules.spec_for`` fits the spec to the shape
+        and drops axes that don't divide), gives every optimizer-state
+        leaf of weight shape the WEIGHT's placement (moments shard with
+        their param) and replicates the rest (scalar counts), and
+        shards the batch dim over 'dp' / the sequence dim over 'sp'
+        when they divide."""
+        from ..parallel.compat import NamedSharding, PartitionSpec as P
+        raw_mesh = getattr(self._mesh, "mesh", self._mesh)
+        rep = NamedSharding(raw_mesh, P())
+        dp = max(int(self._sizes.get("dp", 1)), 1)
+        sp = max(int(self._sizes.get("sp", 1)), 1)
+        rules = self._resolve_rules() if gspmd else None
+
+        def param_sh(pos):
+            if not gspmd:
+                return rep
+            p = all_params[pos]
+            shape = tuple(int(d) for d in p.data().shape)
+            return NamedSharding(
+                raw_mesh, rules.spec_for(p.name, shape, raw_mesh))
+
+        def data_sh(a):
+            if not gspmd:
+                return NamedSharding(raw_mesh, P("dp"))
+            shape = tuple(int(d) for d in a.shape)
+            parts = []
+            if shape:
+                parts.append("dp" if dp > 1 and shape[0] % dp == 0
+                             else None)
+            if len(shape) > 1 and np.issubdtype(
+                    np.dtype(getattr(a, "dtype", np.float32)),
+                    np.integer):
+                # dim 1 of an integer batch array is a token/sequence
+                # dim — shard it over 'sp' (the chunked-CE loss path
+                # consumes it sequence-parallel). Float dim 1 is a
+                # FEATURE dim: sharding it would split contractions
+                # into partial dots whose reordered sums break bitwise
+                # parity with the unsharded program for zero benefit.
+                parts.append("sp" if sp > 1 and shape[1] % sp == 0
+                             else None)
+            return NamedSharding(raw_mesh, P(*parts))
+
+        train_shs = tuple(param_sh(pos) for pos in train_pos)
+        state_shs = []
+        for i, st in enumerate(states):
+            wshape = tuple(int(d)
+                           for d in all_params[train_pos[i]].data().shape)
+            wsh = train_shs[i]
+            state_shs.append(jax.tree_util.tree_map(
+                lambda l, _w=wsh, _s=wshape:
+                    _w if tuple(getattr(l, "shape", ())) == _s else rep,
+                _state_to_data(st)))
+        fixed_shs = tuple(param_sh(pos) for pos in fixed_pos)
+        in_data_shs = tuple(data_sh(a) for a in nd_args)
+        shs = (train_shs, tuple(state_shs), fixed_shs, in_data_shs,
+               rep, rep, rep, rep)
+        if with_corrupt:
+            shs += (rep,)
+        return shs
 
     def _packed_apply_fn(self, opt, all_params, train_pos):
         """The MXTPU_FUSED_APPLY eligibility selector, or None when the
@@ -702,35 +971,25 @@ class FusedTrainStep:
             return idx
         return select
 
-    def _mesh_placed(self, inner):
-        """Mesh-mode placement shim: the first fused call receives
-        params/state committed to one device (their eager birthplace);
-        a shard_map program spans the whole mesh, so every operand is
-        re-placed onto it first — replicated for params/state/hypers,
-        'dp'-sharded for the batch. After step one the adopted outputs
-        already carry the mesh sharding and the put is a no-op."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        raw_mesh = getattr(self._mesh, "mesh", self._mesh)
-        rep = NamedSharding(raw_mesh, P())
-        batch = NamedSharding(raw_mesh, P("dp"))
-
-        def place(tree, sh):
-            return jax.tree_util.tree_map(
-                lambda a: a if getattr(a, "sharding", None) == sh
-                # mxlint: disable=MX018 (mesh re-placement of ALREADY-LEDGERED operands: the post-step adoption (_adopt_fused/_adopt_state) re-registers every surviving buffer; the replaced single-device ones retire via weakref death)
-                else jax.device_put(a, sh), tree)
-
-        def call(train_datas, state_datas, fixed_datas, in_datas,
-                 lrs, wds, rescale, rng, *rest):
-            # *rest: the health-sentinel corruption operand (scalar,
-            # replicated) when MXTPU_HEALTH threads it
-            return inner(place(train_datas, rep), place(state_datas, rep),
-                         place(fixed_datas, rep), place(in_datas, batch),
-                         place(lrs, rep), place(wds, rep),
-                         place(rescale, rep), place(rng, rep),
-                         *[place(r, rep) for r in rest])
-
-        return call
+    @staticmethod
+    def _place_operand(a, sh):
+        """Move one operand onto its slot in the mesh placement tree.
+        Already-placed arrays (every adopted output after step one, by
+        the matched-shardings contract) pass through untouched. A
+        single-process mesh takes the ``device_put`` fast path; a
+        MULTI-PROCESS mesh is not addressable from one rank, so the
+        global array is assembled shard-by-shard from this process's
+        full local copy (every operand on this path is process-
+        identical: params/state from the deterministic eager warmup,
+        the full batch from the loader, host hyperparameter scalars)."""
+        if getattr(a, "sharding", None) == sh:
+            return a
+        if getattr(sh, "is_fully_addressable", True):
+            # mxlint: disable=MX018 (mesh re-placement of ALREADY-LEDGERED operands: the post-step adoption (_adopt_fused/_adopt_state) re-registers every surviving buffer; the replaced single-device ones retire via weakref death)
+            return jax.device_put(a, sh)
+        host = np.asarray(a)
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx])
 
     def _record_compile(self, key, dur_us, cost, hlo, mem, all_params,
                         train_pos):
@@ -807,7 +1066,7 @@ class FusedTrainStep:
         compiled ahead-of-time so its ``cost_analysis()`` (flops/bytes)
         and optimized HLO feed the attribution registry; the compiled
         executable is kept (``self._aot``) and runs this step."""
-        jfn, aux_params, fixed_pos, hmeta = entry
+        jfn, aux_params, fixed_pos, hmeta, in_shs = entry
         tr = self._trainer
         opt = tr._optimizer
         rescale = tr._scale / batch_size
@@ -846,14 +1105,25 @@ class FusedTrainStep:
                 # steps (an exact in-graph multiply-by-one identity)
                 operands = operands + (
                     jnp.float32(_healthmon.corruption_operand()),)
+            if in_shs is not None:
+                # mesh-mode placement: the first fused call receives
+                # params/state committed to one device (their eager
+                # birthplace); the mesh program spans every device, so
+                # each operand moves to ITS slot in the placement tree
+                # first. After step one the adopted outputs already
+                # carry the matched out_shardings and every put is a
+                # no-op — that is the zero-resharding contract. Also
+                # what keeps AOT valid: the compiled executable demands
+                # exactly these input shardings every call.
+                operands = jax.tree_util.tree_map(
+                    self._place_operand, operands, in_shs)
             runner = jfn
             if aot and hasattr(jfn, "lower"):
                 # AOT lower+compile the compile step so the executable's
                 # cost_analysis/HLO feed the attribution registry; the
-                # cache key pins every operand aval, so the executable
-                # stays valid for all later hits of this signature.
-                # (The mesh placement shim has no .lower — mesh mode
-                # stays on the plain jit path with analytic comm.)
+                # cache key pins every operand aval (and mesh mode
+                # pre-places operands above), so the executable stays
+                # valid for all later hits of this signature.
                 try:
                     compiled = jfn.lower(*operands).compile()
                     cost = compiled.cost_analysis()
@@ -884,6 +1154,11 @@ class FusedTrainStep:
                     except Exception:
                         mem = None  # backend without memory_analysis
                     self._aot = (compiled, cost, hlo, mem)
+                    if self._mesh is not None:
+                        # the bench gspmd_step gate and the matched-
+                        # shardings check read the most recent program
+                        self._last_compiled = compiled
+                        self._last_hlo = hlo
                     runner = compiled
                 except Exception:
                     self._aot = None  # AOT API drift: plain path works

@@ -176,7 +176,8 @@ class Trainer:
                 args={"batch_size": batch_size,
                       "params": len(self._params)})
 
-    def fuse_step(self, loss_fn, block=None, mesh=None, bucket_bytes=None):
+    def fuse_step(self, loss_fn, block=None, mesh=None, bucket_bytes=None,
+                  rules=None):
         """Return a :class:`~mxnet_tpu.gluon.fused_step.FusedTrainStep`
         tracing ``loss_fn`` forward + backward + this trainer's optimizer
         update (all parameters at once) into ONE donated jitted program —
@@ -199,10 +200,20 @@ class Trainer:
         stats — standard DDP semantics, but NOT what the eager warmup
         steps (global batch) compute; BN-dependent models should make
         the per-device batch large enough or use a cross-replica
-        norm."""
+        norm.
+
+        A mesh with model axes (tp/sp > 1), or an explicit ``rules``
+        (regex → PartitionSpec partition rules, see
+        ``parallel/sharding.match_partition_rules``), selects the GSPMD
+        form instead: ONE jit program whose in/out shardings place the
+        params by the rules and keep step N's donated outputs exactly
+        where step N+1 reads them (zero resharding between steps); a
+        mesh-aware ``loss_fn`` (one declaring a ``mesh`` kwarg) receives
+        this mesh, which is how ``parallel.transformer.loss_fn``
+        auto-selects the single-reduction chunked CE."""
         from .fused_step import FusedTrainStep
         return FusedTrainStep(self, loss_fn, block=block, mesh=mesh,
-                              bucket_bytes=bucket_bytes)
+                              bucket_bytes=bucket_bytes, rules=rules)
 
     def allreduce_grads(self):
         """Explicit reduce step for when update() is called separately

@@ -16,7 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as _np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..parallel.compat import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..executor import Executor

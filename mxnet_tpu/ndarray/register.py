@@ -626,6 +626,13 @@ register_signature_token("MXTPU_ELASTIC_BUCKET_MB", "4")
 # the traced graph, so flipping either must retrace, never replay
 register_signature_token("MXTPU_HEALTH", "0")
 register_signature_token("MXTPU_HEALTH_ACTION", "record")
+# 3D-parallel trainer path (docs/PARALLEL.md): the chunked-CE
+# local-accumulation auto-select (parallel/transformer.loss_fn) and the
+# fused step's GSPMD mesh mode (gluon/fused_step.py) both branch the
+# traced graph on these at trace time — flipping either mid-run must
+# land on a fresh cache key, never replay the other program
+register_signature_token("MXTPU_CE_LOCAL_ACCUM", "auto")
+register_signature_token("MXTPU_GSPMD_STEP", "1")
 
 # back-compat spelling (PR 9 introduced the kernel-env tuple under this
 # name; the registry supersedes it)

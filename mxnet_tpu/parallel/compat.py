@@ -6,10 +6,19 @@
 (where the kwarg was renamed ``check_vma``). Every shard_map call in
 this package goes through this one shim so the rest of the code can
 use the modern spelling (``check_vma=``) on either jax.
+
+This module is also the ONE import point for the ``jax.sharding``
+names the package uses (``Mesh``/``NamedSharding``/``PartitionSpec``):
+hot modules import them from here instead of from jax directly, so a
+future relocation (as happened to shard_map twice) means editing one
+file. mxlint **MX020** enforces the routing statically.
 """
 from __future__ import annotations
 
 import functools
+
+# the sharding type names, re-exported for the whole package (MX020)
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: F401
 
 try:  # jax >= 0.6: top-level export taking check_vma
     from jax import shard_map as _shard_map
@@ -18,7 +27,7 @@ except ImportError:  # jax <= 0.5: experimental export taking check_rep
     from jax.experimental.shard_map import shard_map as _shard_map
     _KWARG = "check_rep"
 
-__all__ = ["shard_map"]
+__all__ = ["shard_map", "Mesh", "NamedSharding", "PartitionSpec"]
 
 
 @functools.wraps(_shard_map)

@@ -14,7 +14,7 @@ import threading
 
 import jax
 import numpy as _np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from .compat import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["DeviceMesh", "create_mesh", "current_mesh", "default_mesh_axes",
            "mesh_scope", "surviving_devices", "shrink_mesh"]

@@ -92,6 +92,13 @@ def bucket_plan(leaves, bucket_bytes=None):
 
 
 def _reduce_flat(flat, axis_name, op):
+    if axis_name is None:
+        # GSPMD form (fused_step 3D mesh mode): there is no manual axis
+        # to reduce over — the SPMD partitioner owns the collective. The
+        # marker still concatenates the bucket's cotangents into ONE
+        # flat segment, so the partitioner's reduction lands on the
+        # bucket, not leaf-by-leaf, preserving the DDP wire batching.
+        return flat
     if op == "mean":
         return lax.pmean(flat, axis_name)
     if op == "sum":
@@ -136,7 +143,11 @@ def tag_gradient_buckets(leaves, axis_name, plan=None, bucket_bytes=None,
     markers (see module docstring). Use on the parameter leaves BEFORE
     the forward inside a ``shard_map``; gradients w.r.t. the original
     leaves come back fully reduced over ``axis_name``, one collective
-    per bucket, placed mid-backward."""
+    per bucket, placed mid-backward. ``axis_name=None`` is the GSPMD
+    form (plain jit with shardings, no manual axis): the markers keep
+    the bucket STRUCTURE — cotangents concatenate into flat per-bucket
+    segments mid-backward — while the SPMD partitioner supplies the
+    reduction itself."""
     leaves = list(leaves)
     if plan is None:
         plan = bucket_plan(leaves, bucket_bytes)

@@ -18,7 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from .compat import PartitionSpec as P
 
 __all__ = ["pipeline_stages", "PipelineStage", "gpipe_loop"]
 

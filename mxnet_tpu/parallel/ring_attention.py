@@ -19,7 +19,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from .compat import PartitionSpec as P
 
 __all__ = ["ring_attention", "ring_self_attention", "blockwise_attention"]
 

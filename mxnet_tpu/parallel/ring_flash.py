@@ -233,7 +233,7 @@ def ring_flash_self_attention(q, k, v, mesh, axis_name="sp", causal=False,
     ring_attention.ring_self_attention) — the single place that owns the
     spec/mesh wiring for the ring x flash path."""
     from .compat import shard_map
-    from jax.sharding import PartitionSpec as P
+    from .compat import PartitionSpec as P
     spec = P(batch_axis, head_axis, axis_name, None)
 
     def fn(a, b, c):

@@ -5,17 +5,28 @@ reference ("local"/"device"/"nccl"/"dist_sync", ref: src/kvstore/kvstore.cc:40):
 it names HOW state and compute are distributed. Here a strategy is data — a
 list of (param-path regex, PartitionSpec) rules plus batch/activation specs —
 and GSPMD compiles it, instead of each mode being a separate C++ backend.
+
+``match_partition_rules`` maps a whole parameter pytree ('/'-joined key
+paths, first matching regex wins, scalars replicated) to a PartitionSpec
+tree — the EasyLM/levanter idiom — including stacked ``[L, ...]`` layer
+trees, where a rule written for the per-layer shape applies with the
+scanned leading axis replicated. Specs are always fitted to the array:
+trimmed to rank, and any mesh axis that does not divide its dimension is
+dropped (GSPMD would pad; the fused-step contract is divide-or-replicate
+so wire bytes stay analytic).
 """
 from __future__ import annotations
 
 import re
 
 import jax
-from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .compat import NamedSharding, PartitionSpec as P
 
 __all__ = ["PartitionRules", "ShardingStrategy", "data_parallel", "fsdp",
            "tensor_parallel", "make_param_sharding", "infer_rules_for_block",
-           "host_array", "relayout_params"]
+           "host_array", "relayout_params", "match_partition_rules",
+           "named_shardings"]
 
 
 class PartitionRules:
@@ -30,13 +41,18 @@ class PartitionRules:
         self.rules = [(re.compile(pat), P(*spec) if isinstance(spec, tuple)
                        else spec) for pat, spec in rules]
 
-    def spec_for(self, path, shape=None):
+    def spec_for(self, path, shape=None, mesh=None):
         for pat, spec in self.rules:
             if pat.search(path):
                 if shape is not None:
-                    spec = _fit_spec(spec, shape)
+                    spec = _fit_spec(spec, shape, mesh)
                 return spec
         return P()
+
+    def describe(self):
+        """[(pattern, spec)] — the rule table, for docs/fingerprints
+        (the fused step folds this into its cache signature)."""
+        return tuple((pat.pattern, tuple(spec)) for pat, spec in self.rules)
 
     def __add__(self, other):
         out = PartitionRules()
@@ -44,12 +60,108 @@ class PartitionRules:
         return out
 
 
-def _fit_spec(spec, shape):
-    """Trim a PartitionSpec to the array rank and drop axes that don't divide
-    the dimension (GSPMD requires divisibility; replicate instead)."""
+def _mesh_sizes(mesh):
+    """{axis: size} for a DeviceMesh/Mesh, or None."""
+    if mesh is None:
+        return None
+    raw = getattr(mesh, "mesh", mesh)
+    return {a: int(s) for a, s in dict(raw.shape).items()}
+
+
+def _axis_size(sizes, part):
+    """Total device count behind one PartitionSpec entry (an axis name
+    or a tuple of axis names)."""
+    if part is None:
+        return 1
+    names = part if isinstance(part, (tuple, list)) else (part,)
+    n = 1
+    for a in names:
+        n *= int(sizes.get(a, 1))
+    return n
+
+
+def _fit_spec(spec, shape, mesh=None):
+    """Fit a PartitionSpec to one array: trim to rank, pad with None,
+    and (when the mesh is known) drop axes that don't divide their
+    dimension — GSPMD would silently pad the shard; the divide-or-
+    replicate contract keeps the comm_model's wire-byte accounting
+    exact. Scalars are always replicated."""
+    if not shape:
+        return P()
     parts = list(spec)[:len(shape)]
     parts += [None] * (len(shape) - len(parts))
+    sizes = _mesh_sizes(mesh)
+    if sizes is not None:
+        parts = [None if p is not None and (
+            _axis_size(sizes, p) <= 1 or dim % _axis_size(sizes, p) != 0)
+            else p for p, dim in zip(parts, shape)]
     return P(*parts)
+
+
+def match_partition_rules(rules, tree, mesh=None, sep="/",
+                          stacked_prefixes=("layers",), strict=False):
+    """Map a parameter pytree to a same-structure PartitionSpec tree.
+
+    Each leaf's key path is '/'-joined (dict keys, sequence indices) and
+    run through ``rules`` (a ``PartitionRules``, a ``ShardingStrategy``,
+    or a raw ``[(regex, spec)]`` list); the FIRST matching rule's spec is
+    fitted to the leaf (see ``_fit_spec``). Scalars map to ``P()``
+    without consulting the rules. Leaves under a ``stacked_prefixes``
+    subtree whose matched spec is one short of the leaf rank are treated
+    as stacked ``[L, ...]`` layer trees: the spec is written for the
+    per-layer shape and the scanned leading axis gets ``None`` prepended
+    (the transformer's ``init_params`` layout). With ``strict=True`` an
+    unmatched non-scalar leaf raises instead of replicating — the
+    EasyLM ``match_partition_rules`` contract."""
+    rules = _as_rules(rules)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = []
+    for keypath, leaf in flat:
+        path = sep.join(_key_str(k) for k in keypath)
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if not shape:
+            specs.append(P())
+            continue
+        matched = None
+        for pat, spec in rules.rules:
+            if pat.search(path):
+                matched = spec
+                break
+        if matched is None:
+            if strict:
+                raise ValueError(
+                    "no partition rule matches param path %r" % path)
+            specs.append(P())
+            continue
+        if len(matched) == len(shape) - 1 and any(
+                path.startswith(pfx + sep) or (sep + pfx + sep) in path
+                for pfx in stacked_prefixes):
+            matched = P(None, *matched)
+        specs.append(_fit_spec(matched, shape, mesh))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def _as_rules(rules):
+    if isinstance(rules, PartitionRules):
+        return rules
+    if isinstance(rules, ShardingStrategy):
+        return rules.param_rules
+    return PartitionRules(rules)
+
+
+def _key_str(k):
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def named_shardings(mesh, spec_tree):
+    """PartitionSpec tree -> NamedSharding tree over ``mesh``."""
+    raw = getattr(mesh, "mesh", mesh)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(raw, s), spec_tree,
+        is_leaf=lambda l: isinstance(l, P))
 
 
 class ShardingStrategy:
@@ -90,7 +202,8 @@ def make_param_sharding(mesh, params, rules):
     out = {}
     for path, v in params.items():
         shape = tuple(v.shape) if hasattr(v, "shape") else tuple(v)
-        out[path] = NamedSharding(raw_mesh, rules.spec_for(path, shape))
+        out[path] = NamedSharding(raw_mesh,
+                                  rules.spec_for(path, shape, mesh))
     return out
 
 
@@ -141,7 +254,7 @@ def fsdp(mesh, axis="fsdp", min_size=1024):
     raw_mesh = getattr(mesh, "mesh", mesh)
 
     class _FsdpRules(PartitionRules):
-        def spec_for(self, path, shape=None):
+        def spec_for(self, path, shape=None, mesh=None):
             if shape is None or not shape:
                 return P()
             import numpy as _np
@@ -161,31 +274,60 @@ def fsdp(mesh, axis="fsdp", min_size=1024):
                             grad_reduce_axes=("dp",), name="fsdp")
 
 
-def tensor_parallel(mesh, extra_rules=(), axis="tp"):
+def tensor_parallel(mesh, extra_rules=(), axis="tp", batch_axes=("dp",)):
     """Megatron-style TP rules for common layer shapes:
     - column-parallel then row-parallel pairs for attention/FFN
     - embedding sharded on vocab
     Dense weight layout here is (out, in) (ref FullyConnected convention),
     so column-parallel = shard dim 0, row-parallel = shard dim 1.
+
+    Also covers the transformer's STACKED layer-tree names
+    (``layers/wq`` etc., written for the per-layer shape — the scanned
+    ``[L, ...]`` axis is handled by ``match_partition_rules``) and the
+    tied embed/unembed pair, matching ``transformer.param_specs``.
     """
     rules = PartitionRules(list(extra_rules) + [
+        # gluon Dense/attention parameter names ((out, in) layout)
         (r"(qkv|query|key|value|wq|wk|wv|w1|wi|gate|up|expand|fc1)"
          r".*weight$", (axis, None)),
         (r"(out_proj|wo|w2|down|proj|fc2|contract).*weight$", (None, axis)),
         (r"(qkv|query|key|value|wq|wk|wv|w1|wi|gate|up|expand|fc1)"
          r".*bias$", (axis,)),
         (r"embed.*weight$", (None, axis)),
+        # transformer stacked layer tree (per-layer shapes; see
+        # transformer.param_specs for the reference layout)
+        (r"(^|/)layers/(wq|wk|wv)$", (None, axis, None)),
+        (r"(^|/)layers/wo$", (axis, None, None)),
+        (r"(^|/)layers/(w_gate|w_up)$", (None, axis)),
+        (r"(^|/)layers/w_down$", (axis, None)),
+        (r"(^|/)embed$", (axis, None)),
+        (r"(^|/)w_out$", (None, axis)),
     ])
-    return ShardingStrategy(mesh, rules, batch_axes=("dp",),
+    return ShardingStrategy(mesh, rules, batch_axes=tuple(batch_axes),
                             grad_reduce_axes=("dp",), name="tensor_parallel")
 
 
 def infer_rules_for_block(block, mesh, strategy="dp"):
-    """Choose rules for a gluon Block by inspecting its parameter paths."""
+    """Choose rules for a gluon Block by inspecting its parameter paths.
+
+    ``strategy='auto'`` picks ``tensor_parallel`` when the mesh has a
+    'tp' axis >1 AND at least one of the block's parameter paths matches
+    a TP rule, else pure data-parallel — the safe default for the fused
+    step's 3D-mesh mode (an unmatched tree stays replicated rather than
+    guessing a layout)."""
     if strategy in ("dp", "data_parallel", "local", "device", "nccl"):
         return data_parallel(mesh)
     if strategy in ("fsdp", "zero", "dist_sync"):
         return fsdp(mesh)
     if strategy in ("tp", "tensor_parallel"):
         return tensor_parallel(mesh)
+    if strategy in ("auto", "3d"):
+        sizes = _mesh_sizes(mesh) or {}
+        tp = tensor_parallel(mesh)
+        if int(sizes.get("tp", 1)) > 1 and block is not None:
+            names = [p.name for p in block._all_params_list()] \
+                if hasattr(block, "_all_params_list") else []
+            if any(tp.param_rules.spec_for(n) != P() for n in names):
+                return tp
+        return data_parallel(mesh)
     raise ValueError("unknown strategy %r" % strategy)

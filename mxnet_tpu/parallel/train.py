@@ -244,7 +244,7 @@ class ShardedTrainStep:
         (overlap.tag_gradient_buckets) instead of GSPMD's
         one-AR-per-grad-after-backward lowering."""
         from jax import lax
-        from jax.sharding import PartitionSpec as P
+        from .compat import PartitionSpec as P
         from . import overlap as _overlap
         from .compat import shard_map as _shard_map
         block, loss_fn, optimizer = self.block, self.loss_fn, self.optimizer
@@ -416,5 +416,5 @@ def _nd_to_state(template, st_nd):
 
 
 def _replicated(mesh):
-    from jax.sharding import NamedSharding, PartitionSpec
+    from .compat import NamedSharding, PartitionSpec
     return NamedSharding(mesh, PartitionSpec())

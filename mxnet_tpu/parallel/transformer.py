@@ -27,22 +27,25 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import jax.random as jr
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
+from ..base import getenv as _getenv
+from .compat import NamedSharding, PartitionSpec as P
 
 from .ring_attention import ring_attention, blockwise_attention
 from .ulysses import ulysses_attention_local
 from .expert import moe_ffn
 
 __all__ = ["TransformerConfig", "init_params", "apply", "loss_fn",
-           "make_train_step", "param_specs"]
+           "make_train_step", "param_specs", "ce_local_accum_active"]
 
 
 @dataclasses.dataclass
@@ -84,8 +87,13 @@ class TransformerConfig:
     # finding: AR-per-chunk adds (loss_chunks-1)*vocab*dim*4 wire bytes
     # per step, ~36% extra transformer bytes at 256 chips). Needs the
     # mesh passed to loss_fn/make_train_step; covers dp x sp x tp
-    # layouts (tp-sharded vocab handled with a distributed logsumexp)
-    ce_local_accum: bool = False
+    # layouts (tp-sharded vocab handled with a distributed logsumexp).
+    # None = AUTO: on whenever the mesh shards the batch (dp*sp > 1),
+    # loss_chunks > 1 and the shapes divide; True forces it (indivisible
+    # shapes raise); False pins the plain chunked CE. The
+    # MXTPU_CE_LOCAL_ACCUM env var ('auto'/'1'/'0', a compile-signature
+    # token) overrides the auto default process-wide.
+    ce_local_accum: Optional[bool] = None
 
     @property
     def head_dim(self):
@@ -383,6 +391,55 @@ def _chunked_ce_local(x, w_out, targets, n_chunks, mesh):
     return total / (B * S)
 
 
+_WARNED = set()  # mxlint: disable=MX003 (warn-once dedup keys; worst case under a race is one duplicate warning)
+
+
+def _warn_once(key, msg):
+    if key in _WARNED:
+        return
+    _WARNED.add(key)
+    warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+
+def ce_local_accum_active(cfg, mesh, batch, seq):
+    """Whether this (cfg, mesh, batch shape) runs the single-reduction
+    chunked CE (``_chunked_ce_local``). ``cfg.ce_local_accum=None``
+    AUTO-selects it whenever the mesh shards the batch (dp*sp > 1 — the
+    only case the AR-per-chunk pattern costs wire bytes) and the shapes
+    divide; an explicit ``True`` forces it (indivisible shapes keep the
+    hard error from ``_chunked_ce_local``); ``False`` pins the plain
+    path. ``MXTPU_CE_LOCAL_ACCUM`` ('auto' default / '1' / '0', a
+    compile-signature token) is the process-wide override — before this
+    auto-select, real trainer runs silently paid the +36%-at-256-chips
+    wire bytes the local-accum fix already kills (SCALING_r05)."""
+    if cfg.loss_chunks <= 1 or mesh is None:
+        return False
+    env = str(_getenv("MXTPU_CE_LOCAL_ACCUM", "auto")).lower()
+    if env in ("0", "off", "false") or cfg.ce_local_accum is False:
+        return False
+    forced = cfg.ce_local_accum is True or env in ("1", "on", "true")
+    sizes = {a: int(s)
+             for a, s in dict(getattr(mesh, "mesh", mesh).shape).items()}
+    dp, sp = sizes.get("dp", 1), sizes.get("sp", 1)
+    if not forced and dp * sp <= 1:
+        return False  # no batch-sharded partial sums -> nothing to save
+    divisible = (int(batch) % max(dp, 1) == 0
+                 and int(seq) % max(sp, 1) == 0
+                 and (int(seq) // max(sp, 1)) % cfg.loss_chunks == 0)
+    if not divisible and cfg.ce_local_accum is not True:
+        # auto must not turn a shape quirk into a crash — but it also
+        # must not SILENTLY hand back the AR-per-chunk bytes
+        _warn_once(
+            "ce-local-accum-indivisible",
+            "ce_local_accum auto-select declined: batch=%d/seq=%d do "
+            "not divide over dp=%d/sp=%d with loss_chunks=%d; this "
+            "step pays the per-chunk unembedding-grad all-reduce "
+            "(+(loss_chunks-1)*vocab*dim*4 wire bytes)"
+            % (batch, seq, dp, sp, cfg.loss_chunks))
+        return False
+    return True
+
+
 def loss_fn(params, tokens, targets, cfg, mesh=None, aux_weight=0.01):
     if cfg.loss_chunks > 1:
         if tokens.shape[1] % cfg.loss_chunks != 0:
@@ -393,7 +450,8 @@ def loss_fn(params, tokens, targets, cfg, mesh=None, aux_weight=0.01):
                 "divisor or set loss_chunks=1"
                 % (cfg.loss_chunks, tokens.shape[1]))
         x, aux = _hidden(params, tokens, cfg, mesh)
-        if cfg.ce_local_accum and mesh is not None:
+        if ce_local_accum_active(cfg, mesh, tokens.shape[0],
+                                 tokens.shape[1]):
             loss = _chunked_ce_local(x, params["w_out"], targets,
                                      cfg.loss_chunks, mesh)
         else:

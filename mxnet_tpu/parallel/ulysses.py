@@ -13,7 +13,7 @@ import functools
 
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from .compat import PartitionSpec as P
 
 __all__ = ["ulysses_attention"]
 

@@ -103,9 +103,11 @@ def test_tuple_elements_tracks_layout_braces():
 
 def test_pure_dp_measurement_matches_analytic_model():
     """End-to-end on the virtual mesh: the HLO-measured all-reduce
-    payload of the pure-dp train step must match the analytic model
-    (params + (chunks-1)*vocab*dim + scalar) — the trust gate the
-    SCALING_r05 projection rests on."""
+    payload of the pure-dp train step must match the analytic model —
+    the trust gate the SCALING_r05 projection rests on. With the
+    auto-selected ce_local_accum (dp>1, chunked CE) the unembedding
+    grad accumulates locally and reduces ONCE inside the param
+    all-reduce, so the wire is exactly params + the scalar loss."""
     import jax
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-device virtual mesh")
@@ -116,12 +118,34 @@ def test_pure_dp_measurement_matches_analytic_model():
              ffn_hidden=4 * D, attn_mode="local", loss_chunks=4),
         B=16, S=64)
     assert m["unresolved_loops"] == 0
-    analytic = 4 * (m["params"] + 3 * V * D + 1)
+    analytic = 4 * (m["params"] + 1)
     got = m["collective_payload_bytes"]["all-reduce"]
     assert abs(got - analytic) / analytic < 0.05, (got, analytic)
     # pure dp must not need any other collective kind
     assert m["collective_payload_bytes"]["collective-permute"] == 0
     assert m["collective_payload_bytes"]["all-to-all"] == 0
+
+
+def test_pure_dp_per_chunk_reduction_when_local_accum_off():
+    """The pre-local-accum wire, pinned: with ``ce_local_accum=False``
+    the scan-carried unembedding grad all-reduces once per chunk —
+    (chunks-1)*vocab*dim extra payload (the first reduction merges into
+    the param all-reduce). The delta between this test and the one
+    above IS the single-reduction saving."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    V, D = 512, 128
+    m = comm_model.measure_config(
+        "pure_dp_chunk_ar", {"dp": 8},
+        dict(vocab_size=V, dim=D, n_layers=2, n_heads=4,
+             ffn_hidden=4 * D, attn_mode="local", loss_chunks=4,
+             ce_local_accum=False),
+        B=16, S=64)
+    assert m["unresolved_loops"] == 0
+    analytic = 4 * (m["params"] + 3 * V * D + 1)
+    got = m["collective_payload_bytes"]["all-reduce"]
+    assert abs(got - analytic) / analytic < 0.05, (got, analytic)
 
 
 def test_gspmd_keeps_scan_accumulated_reduction_in_loop():

@@ -1,0 +1,265 @@
+"""The 3D-parallel GSPMD fused step (ISSUE 16 tentpole).
+
+A mesh with model axes (tp/sp > 1), or explicit partition rules, turns
+``FusedTrainStep`` into ONE GSPMD program: ``jax.jit`` with the params
+placed by regex partition rules and the step's ``out_shardings`` pinned
+to its ``in_shardings`` (SNIPPETS [1] matched-shardings contract — step
+N's donated outputs feed step N+1 with zero resharding). The dp-only
+``shard_map`` treatment is untouched.
+
+Parity contract: the SAME mesh config replays bitwise (asserted); a
+DIFFERENT topology splits contractions at different points, so cross-
+topology agreement is reduction-order-limited (~1 ULP/step) and pinned
+with a tight allclose, not equality.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import fused_step as fs
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import create_mesh
+from mxnet_tpu.parallel.compat import PartitionSpec as P
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+def _net(seed=0):
+    rs = np.random.RandomState(seed)
+    w1 = rs.randn(16, 12).astype(np.float32) * 0.1
+    b1 = np.zeros(16, np.float32)
+    w2 = rs.randn(4, 16).astype(np.float32) * 0.1
+    b2 = np.zeros(4, np.float32)
+    net = nn.HybridSequential()
+    # explicit prefixes: rule tests regex-match on the param names, and
+    # the auto-generated denseN_ counter depends on how many Dense
+    # layers earlier tests created in this process
+    net.add(nn.Dense(16, activation="relu", in_units=12, prefix="d0_"))
+    net.add(nn.Dense(4, in_units=16, prefix="d1_"))
+    net.initialize()
+    net.hybridize()
+    params = [p for _, p in sorted(net.collect_params().items())]
+    vals = [b1, w1, b2, w2] if params[0].shape == (16,) \
+        else [w1, b1, w2, b2]
+    for p, v in zip(params, vals):
+        assert p.shape == v.shape
+        p.set_data(mx.nd.array(v))
+    return net
+
+
+def _train(mesh, steps=5, rules=None, seed=0):
+    net = _net(seed)
+    loss = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    step = tr.fuse_step(lambda xx, yy: loss(net(xx), yy),
+                        mesh=mesh, bucket_bytes=512, rules=rules)
+    rs = np.random.RandomState(7)
+    losses = []
+    for _ in range(steps):
+        x = mx.nd.array(rs.rand(8, 12).astype(np.float32))
+        y = mx.nd.array(rs.rand(8, 4).astype(np.float32))
+        losses.append(float(step(x, y, batch_size=8).asnumpy().mean()))
+    params = [p.data().asnumpy()
+              for _, p in sorted(net.collect_params().items())]
+    return losses, params, step
+
+
+class TestGspmdParity:
+    def test_mode_selection(self):
+        _, _, s_dp = _train(create_mesh(devices=jax.devices()[:4]),
+                            steps=1)
+        assert s_dp._gspmd_mode() is False       # dp-only: legacy path
+        _, _, s_3d = _train(create_mesh(dp=2, tp=2, sp=2), steps=1)
+        assert s_3d._gspmd_mode() is True        # model axes: GSPMD
+
+    def test_five_step_parity_across_topologies(self):
+        """Final params after 5 fused steps: single-device vs dp-only
+        vs dp×tp×sp agree to reduction-order (~1 ULP/step); the SAME
+        3D config replays BITWISE."""
+        l0, p0, _ = _train(None)
+        l1, p1, _ = _train(create_mesh(devices=jax.devices()[:4]))
+        l2, p2, s2 = _train(create_mesh(dp=2, tp=2, sp=2))
+        _, p2b, _ = _train(create_mesh(dp=2, tp=2, sp=2))
+        assert s2.last_mode == "fused"
+        for a, b in zip(p2, p2b):                # determinism: bitwise
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_allclose(l0, l1, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(l0, l2, rtol=1e-6, atol=1e-8)
+        for a, b in zip(p0, p1):
+            np.testing.assert_allclose(a, b, rtol=5e-6, atol=5e-8)
+        for a, b in zip(p0, p2):
+            np.testing.assert_allclose(a, b, rtol=5e-6, atol=5e-8)
+
+    def test_matched_step_shardings_zero_resharding(self):
+        """The compiled program's weight/optimizer-state OUTPUT
+        shardings equal its INPUT shardings — step N feeds step N+1
+        without a resharding transfer."""
+        _, _, step = _train(create_mesh(dp=2, tp=2, sp=2))
+        compiled, hlo = step.last_program()
+        assert compiled is not None and hlo is not None
+        assert step.matched_step_shardings() is True
+
+    def test_gspmd_wire_bytes_within_1pct_of_analytic(self):
+        """HLO-measured all-reduce payload of the dp×tp×sp MLP step ==
+        4 bytes * trainable params (replicated params, dp-sharded
+        batch: ONE gradient reduction) within 1%."""
+        from benchmark import comm_model as cm
+        _, _, step = _train(create_mesh(dp=2, tp=2, sp=2))
+        _, hlo = step.last_program()
+        by, counts, unresolved = cm.hlo_collective_bytes(hlo)
+        assert unresolved == 0
+        n_params = 16 * 12 + 16 + 4 * 16 + 4
+        analytic = 4 * n_params
+        got = by["all-reduce"]
+        assert abs(got - analytic) / analytic < 0.01, (got, analytic)
+        assert by["collective-permute"] == 0
+        assert by["all-to-all"] == 0
+
+
+class TestExplicitRules:
+    def test_tp_rules_shard_params_and_still_train(self):
+        """Explicit regex rules actually shard the weights over 'tp'
+        in the COMPILED program (not just in metadata), the matched-
+        shardings contract holds for genuinely distributed state, and
+        training matches the replicated run."""
+        mesh = create_mesh(dp=2, tp=2, sp=2)
+        rules = [
+            (r"d0.*weight$", ("tp", None)),       # column-parallel
+            (r"d1.*weight$", (None, "tp")),       # row-parallel
+            (r"d0.*bias$", ("tp",)),
+        ]
+        l0, p0, _ = _train(None)
+        l1, p1, step = _train(mesh, rules=rules)
+        assert step.last_mode == "fused"
+        assert step.matched_step_shardings() is True
+        compiled, _ = step.last_program()
+        in_specs = [getattr(s, "spec", None) for s in
+                    jax.tree_util.tree_leaves(
+                        compiled.input_shardings[0][0])]
+        assert any(sp is not None and any(ax is not None for ax in sp)
+                   for sp in in_specs), in_specs  # something IS sharded
+        np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-7)
+        for a, b in zip(p0, p1):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+class TestFallbacksAndKnobs:
+    def test_mesh_fallback_counter_warn_and_marker(self):
+        mesh = create_mesh(dp=2, tp=2, sp=2)
+        net = _net(3)
+        loss = gluon.loss.L2Loss()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05})
+        step = tr.fuse_step(lambda xx, yy: loss(net(xx), yy), mesh=mesh)
+        rs = np.random.RandomState(3)
+        x = mx.nd.array(rs.rand(7, 12).astype(np.float32))  # 7 % 2 != 0
+        y = mx.nd.array(rs.rand(7, 4).astype(np.float32))
+        before = fs.stats()["mesh_fallbacks"]
+        from mxnet_tpu._debug import flightrec
+        ring_before = sum(1 for ev in flightrec.snapshot()
+                          if ev[1] == "fused_step.mesh_fallback")
+        with pytest.warns(UserWarning, match="does not divide mesh"):
+            step(x, y, batch_size=7)
+        step(x, y, batch_size=7)                 # second demotion
+        assert fs.stats()["mesh_fallbacks"] == before + 2
+        assert step.last_mode == "fallback:mesh-batch-indivisible"
+        ring_after = sum(1 for ev in flightrec.snapshot()
+                         if ev[1] == "fused_step.mesh_fallback")
+        assert ring_after == ring_before + 2     # marker per occurrence
+        # ... but the warning fired ONCE (checked implicitly: a second
+        # pytest.warns here would hang on no-warning; assert the flag)
+        assert step._warned_mesh_indivisible is True
+
+    def test_gspmd_escape_hatch_env(self, monkeypatch):
+        """MXTPU_GSPMD_STEP=0 (a compile-signature token) forces the
+        legacy dp-only treatment on a 3D mesh."""
+        monkeypatch.setenv("MXTPU_GSPMD_STEP", "0")
+        l2, p2, step = _train(create_mesh(dp=2, tp=2, sp=2))
+        assert step._gspmd_mode() is False
+        assert step.last_mode == "fused"         # still fuses (manual dp)
+        l0, p0, _ = _train(None)
+        np.testing.assert_allclose(l0, l2, rtol=1e-6, atol=1e-8)
+
+    def test_loss_fn_mesh_weld(self):
+        """A loss callable declaring a ``mesh`` kwarg receives the
+        step's mesh — the Trainer/loss weld that lets
+        parallel.transformer.loss_fn auto-select the single-reduction
+        chunked CE without a side channel."""
+        mesh = create_mesh(dp=2, tp=2, sp=2)
+        seen = []
+        l2 = gluon.loss.L2Loss()
+
+        def lf(xx, yy, mesh=None):
+            seen.append(mesh)
+            return l2(xx, yy)
+
+        net = _net(1)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05})
+        step = tr.fuse_step(lambda xx, yy: lf(net(xx), yy, mesh=None),
+                            mesh=mesh)
+        # the weld binds on the OUTER loss callable handed to fuse_step
+        step2 = tr.fuse_step(lf, mesh=mesh)
+        rs = np.random.RandomState(1)
+        x = mx.nd.array(rs.rand(8, 4).astype(np.float32))
+        y = mx.nd.array(rs.rand(8, 4).astype(np.float32))
+        step2(x, y, batch_size=8)
+        assert seen and all(m is mesh for m in seen)
+
+
+class TestCeLocalAccumSelect:
+    def _cfg(self, **kw):
+        from mxnet_tpu.parallel import transformer as T
+        base = dict(vocab_size=64, dim=16, n_layers=2, n_heads=4,
+                    ffn_hidden=32, loss_chunks=4)
+        base.update(kw)
+        return T.TransformerConfig(**base)
+
+    def test_auto_matrix(self):
+        from mxnet_tpu.parallel import transformer as T
+        mesh3d = create_mesh(dp=2, tp=2, sp=2)
+        tp_only = create_mesh(tp=8)
+        cfg = self._cfg()
+        # dp*sp > 1, shapes divide -> auto ON
+        assert T.ce_local_accum_active(cfg, mesh3d, 8, 64) is True
+        # no mesh / no chunking -> OFF
+        assert T.ce_local_accum_active(cfg, None, 8, 64) is False
+        assert T.ce_local_accum_active(
+            self._cfg(loss_chunks=1), mesh3d, 8, 64) is False
+        # batch not sharded (dp*sp == 1) -> nothing to save
+        assert T.ce_local_accum_active(cfg, tp_only, 8, 64) is False
+        # explicit False pins the plain path
+        assert T.ce_local_accum_active(
+            self._cfg(ce_local_accum=False), mesh3d, 8, 64) is False
+
+    def test_env_override_and_indivisible_warns_once(self, monkeypatch):
+        from mxnet_tpu.parallel import transformer as T
+        mesh3d = create_mesh(dp=2, tp=2, sp=2)
+        cfg = self._cfg()
+        monkeypatch.setenv("MXTPU_CE_LOCAL_ACCUM", "0")
+        assert T.ce_local_accum_active(cfg, mesh3d, 8, 64) is False
+        monkeypatch.setenv("MXTPU_CE_LOCAL_ACCUM", "auto")
+        # indivisible shapes decline with a warn-once, never a crash
+        T._WARNED.discard("ce-local-accum-indivisible")
+        with pytest.warns(RuntimeWarning, match="auto-select declined"):
+            assert T.ce_local_accum_active(cfg, mesh3d, 7, 64) is False
+        assert T.ce_local_accum_active(cfg, mesh3d, 7, 64) is False
+
+    def test_env_is_signature_token(self):
+        from mxnet_tpu.ndarray import register as reg
+        names = [n for n, _ in reg._SIG_TOKENS]
+        assert "MXTPU_CE_LOCAL_ACCUM" in names
+        assert "MXTPU_GSPMD_STEP" in names
+        # ... and flipping one changes the token tuple (recompile key)
+        before = reg.signature_tokens()
+        import os
+        os.environ["MXTPU_GSPMD_STEP"] = "0"
+        try:
+            assert reg.signature_tokens() != before
+        finally:
+            os.environ.pop("MXTPU_GSPMD_STEP", None)

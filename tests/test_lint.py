@@ -504,6 +504,53 @@ def test_mx013_covers_health_points(tmp_path):
     assert "health.grad.corrupted" in findings[0].message
 
 
+def test_mx020_flags_direct_sharding_imports(tmp_path):
+    """Every import form that bypasses the compat seam is caught: the
+    from-import of the module path, the member pull off ``jax``/
+    ``jax.experimental``, and the plain ``import jax.sharding``."""
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/parallel/newplan.py", """\
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from jax.experimental import shard_map as smap
+        from jax import sharding
+        import jax.sharding
+
+        def f():
+            return P, shard_map, smap, sharding
+        """, {"MX020"})
+    assert [f.code for f in findings] == ["MX020"] * 5
+    assert "compat" in findings[0].message
+
+
+def test_mx020_compat_itself_and_routed_imports_pass(tmp_path):
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/parallel/compat.py", """\
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        from jax.experimental.shard_map import shard_map
+        """, {"MX020"})
+    assert findings == []
+    findings, _, _, _ = _lint_snippet(
+        tmp_path, "mxnet_tpu/parallel/user.py", """\
+        import jax
+        from .compat import PartitionSpec as P
+        from ..parallel.compat import shard_map
+
+        def f(x):
+            return jax.jit(lambda y: y)(x)  # mxlint: disable=MX005 (t)
+        """, {"MX020"})
+    assert findings == []
+
+
+def test_mx020_scope_is_the_package_not_tests():
+    rule = next(r for r in rules.ALL_RULES if r.code == "MX020")
+    assert rule.scope("mxnet_tpu/parallel/mesh.py")
+    assert rule.scope("mxnet_tpu/gluon/fused_step.py")
+    assert not rule.scope("mxnet_tpu/parallel/compat.py")
+    assert not rule.scope("tests/test_gspmd_step.py")
+    assert not rule.scope("bench.py")
+
+
 # -- waiver machinery --------------------------------------------------------
 
 def test_waiver_without_reason_is_flagged(tmp_path):

@@ -1,0 +1,129 @@
+"""Partition-rule matrix (ISSUE 16 satellite): the regex->PartitionSpec
+machinery the GSPMD fused step shards its param tree by.
+
+Covers the EasyLM-idiom ``match_partition_rules`` contract: first-match
+precedence, scalar and non-divisible dims falling back to replicated
+(``_fit_spec``), stacked ``[L, ...]`` layer trees, and the rule
+round-trip through ``relayout_params`` on a live mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import create_mesh
+from mxnet_tpu.parallel import sharding as sh
+from mxnet_tpu.parallel.compat import PartitionSpec as P
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+def mesh3d():
+    return create_mesh(dp=2, tp=2, sp=2)
+
+
+class TestRuleMatching:
+    def test_first_match_wins(self):
+        rules = sh.PartitionRules([
+            (r"wq$", ("tp", None)),
+            (r"w.*$", (None, "tp")),
+        ])
+        assert rules.spec_for("block/wq") == P("tp", None)
+        assert rules.spec_for("block/wk") == P(None, "tp")
+        # reversed order: the catch-all shadows the specific rule —
+        # precedence is positional, never specificity-based
+        rev = sh.PartitionRules([
+            (r"w.*$", (None, "tp")),
+            (r"wq$", ("tp", None)),
+        ])
+        assert rev.spec_for("block/wq") == P(None, "tp")
+
+    def test_unmatched_replicates_strict_raises(self):
+        rules = sh.PartitionRules([(r"weight$", ("tp", None))])
+        assert rules.spec_for("bias") == P()
+        tree = {"weight": jnp.zeros((4, 4)), "other": jnp.zeros((4,))}
+        specs = sh.match_partition_rules(rules, tree)
+        assert specs["other"] == P()
+        with pytest.raises(ValueError, match="no partition rule"):
+            sh.match_partition_rules(rules, tree, strict=True)
+
+    def test_scalar_always_replicated(self):
+        rules = sh.PartitionRules([(r".*", ("tp",))])
+        tree = {"count": jnp.float32(3.0), "vec": jnp.zeros((8,))}
+        specs = sh.match_partition_rules(rules, tree, mesh=mesh3d())
+        assert specs["count"] == P()          # never consults the rules
+        assert specs["vec"] == P("tp")
+
+    def test_fit_spec_drops_non_divisible_dims(self):
+        mesh = mesh3d()                       # tp=2
+        rules = sh.PartitionRules([(r"w$", ("tp", "sp"))])
+        # 7 % 2 != 0 on dim 0 -> that axis replicates; dim 1 divides
+        assert rules.spec_for("w", (7, 4), mesh) == P(None, "sp")
+        # both divide -> spec kept whole
+        assert rules.spec_for("w", (8, 4), mesh) == P("tp", "sp")
+        # rank shorter than the spec -> trimmed, not an error
+        assert rules.spec_for("w", (8,), mesh) == P("tp")
+        # size-1 mesh axis -> replicated (no sharding to express)
+        dp_only = create_mesh(devices=jax.devices()[:4])
+        assert rules.spec_for("w", (8, 4), dp_only) == P(None, None)
+
+    def test_stacked_layer_tree_prepends_scan_axis(self):
+        mesh = mesh3d()
+        from mxnet_tpu.parallel import tensor_parallel
+        strat = tensor_parallel(mesh)
+        L, D, H, Dh, F, V = 2, 8, 4, 2, 16, 32
+        tree = {
+            "embed": jnp.zeros((V, D)),
+            "layers": {
+                "wq": jnp.zeros((L, D, H, Dh)),
+                "wo": jnp.zeros((L, H, Dh, D)),
+                "w_up": jnp.zeros((L, D, F)),
+                "w_down": jnp.zeros((L, F, D)),
+                "ln1": jnp.zeros((L, D)),
+            },
+            "w_out": jnp.zeros((D, V)),
+        }
+        specs = sh.match_partition_rules(strat, tree, mesh=mesh)
+        # rule written for the PER-LAYER shape; the scanned [L, ...]
+        # axis gets None prepended (transformer.param_specs layout)
+        assert specs["layers"]["wq"] == P(None, None, "tp", None)
+        assert specs["layers"]["wo"] == P(None, "tp", None, None)
+        assert specs["layers"]["w_up"] == P(None, None, "tp")
+        assert specs["layers"]["w_down"] == P(None, "tp", None)
+        assert specs["layers"]["ln1"] == P()   # unmatched -> replicated
+        assert specs["embed"] == P("tp", None)
+        assert specs["w_out"] == P(None, "tp")
+
+    def test_describe_fingerprint_is_stable_and_hashable(self):
+        rules = sh.PartitionRules([(r"wq$", ("tp", None))])
+        d = rules.describe()
+        assert d == ((r"wq$", ("tp", None)),)
+        hash(d)  # the fused step folds this into its cache signature
+
+
+class TestRelayoutRoundTrip:
+    def test_rules_round_trip_through_relayout_params(self):
+        mesh = mesh3d()
+        from mxnet_tpu.parallel import tensor_parallel
+        strat = tensor_parallel(mesh)
+        rs = np.random.RandomState(0)
+        params = {
+            "blk_wq_weight": jnp.asarray(
+                rs.randn(8, 4).astype(np.float32)),
+            "blk_out_proj_weight": jnp.asarray(
+                rs.randn(4, 8).astype(np.float32)),
+            "blk_bias": jnp.asarray(rs.randn(5).astype(np.float32)),
+        }
+        placed = sh.relayout_params(params, strat)
+        raw = getattr(mesh, "mesh", mesh)
+        assert placed["blk_wq_weight"].sharding.spec == P("tp", None)
+        assert placed["blk_out_proj_weight"].sharding.spec \
+            == P(None, "tp")
+        # 5 % tp != 0 -> _fit_spec replicated it
+        assert placed["blk_bias"].sharding.spec == P()
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(placed[k]),
+                                          np.asarray(params[k]))
+            assert placed[k].sharding.mesh == raw

@@ -929,6 +929,57 @@ class MX013FaultpointInCatalog:
         return out
 
 
+# -- MX020 -------------------------------------------------------------------
+
+class MX020ShardingImportOutsideCompat:
+    """``shard_map`` has relocated twice across jax releases (and its
+    check kwarg renamed); the ``jax.sharding`` type names ride the same
+    churn risk. ``mxnet_tpu/parallel/compat.py`` is the ONE import
+    seam that absorbs those moves — the 3D GSPMD fused step and the
+    whole parallel stack import ``Mesh``/``NamedSharding``/
+    ``PartitionSpec``/``shard_map`` from there. A module importing
+    them from jax directly re-opens a version seam the shim already
+    closed: it works today and breaks on the next relocation, in
+    exactly the code (hot parallel paths) where the breakage is a
+    cluster-wide outage rather than a test failure."""
+
+    code = "MX020"
+    summary = "jax sharding/shard_map import bypasses parallel/compat"
+    kind = "python"
+    _MODULES = frozenset(("jax.sharding", "jax.experimental.shard_map"))
+
+    def scope(self, path):
+        return (path.startswith("mxnet_tpu/")
+                and path.endswith(".py")
+                and path != "mxnet_tpu/parallel/compat.py")
+
+    def check(self, path, src, tree, parents):
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                names = [a.name for a in node.names]
+                bad = (mod in self._MODULES
+                       or (mod == "jax.experimental"
+                           and "shard_map" in names)
+                       or (mod == "jax" and ("sharding" in names
+                                             or "shard_map" in names)))
+            elif isinstance(node, ast.Import):
+                bad = any(a.name in self._MODULES
+                          or a.name.startswith("jax.sharding.")
+                          for a in node.names)
+            else:
+                continue
+            if bad:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "sharding/shard_map imported from jax directly — "
+                    "import it from mxnet_tpu/parallel/compat.py, the "
+                    "one seam that tracks jax's relocations of these "
+                    "names (shard_map has moved twice already)"))
+        return out
+
+
 from .dataflow import DATAFLOW_RULES  # noqa: E402 (needs Finding above)
 
 ALL_RULES = (
@@ -945,4 +996,5 @@ ALL_RULES = (
     MX011FlightrecSecondBranch(),
     MX012PallasKernelContract(),
     MX013FaultpointInCatalog(),
+    MX020ShardingImportOutsideCompat(),
 ) + DATAFLOW_RULES

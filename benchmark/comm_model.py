@@ -235,6 +235,18 @@ def measure_config(name, mesh_axes, cfg_kwargs, B, S):
 ASSUMPTIONS = {
     "chip": "TPU v5e",
     "bf16_peak_tflops": 197.0,
+    # Peak matmul throughput by dominant program dtype. bf16 is the
+    # datasheet number; f32 runs the MXU at half rate; int8 doubles it
+    # (the PR 9 quantized_matmul path is what actually hits this peak —
+    # its epilogue-fused dequant keeps the 2x from being eaten by
+    # casts). f16 aliases bf16 (same MXU rate on this part).
+    "peak_tflops": {
+        "bf16": 197.0,
+        "f16": 197.0,
+        "f32": 98.5,
+        "int8": 394.0,
+    },
+    "hbm_bw_GBps": 819.0,
     "ici_bw_per_chip_GBps": 180.0,
     "dcn_bw_per_host_GBps": 25.0,
     "chips_per_host": 8,
@@ -242,6 +254,15 @@ ASSUMPTIONS = {
     "overlap": "both bounds reported: none (serial) and full "
                "(comm hidden under compute)",
 }
+
+
+def peak_tflops(dtype="bf16"):
+    """Peak TFLOP/s for a program whose dominant dtype is ``dtype``
+    (a short key: ``bf16``/``f16``/``f32``/``int8``). Unknown dtypes
+    fall back to the bf16 peak — the conservative default the modeled
+    compute time has always used."""
+    table = ASSUMPTIONS["peak_tflops"]
+    return table.get(str(dtype), table["bf16"])
 
 
 def allreduce_seconds(payload_bytes, n):

@@ -359,6 +359,14 @@ def _dump(trigger, extra, path):
         goodput_block = goodput.snapshot()
     except Exception:
         goodput_block = None
+    # the roofline/MFU join (ISSUE 17): a post-mortem names which
+    # signature was binding on what when the run died. Same lazy-import
+    # discipline as the goodput block.
+    try:
+        from . import perfmodel
+        perf_block = perfmodel.snapshot()
+    except Exception:
+        perf_block = None
     data = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -372,6 +380,7 @@ def _dump(trigger, extra, path):
             "metrics": metrics,
             "faults": faultpoint.metrics(),
             "goodput": goodput_block,
+            "perf": perf_block,
             "context": context,
             "ring": {"buffered": len(entries), "capacity": _CAP},
         },

@@ -218,6 +218,8 @@ def open_run(run_id=None, meta=None):
             "step_sum_s": 0.0, "step_min_s": math.inf,
             "step_max_s": 0.0,
             "buckets": {},         # log-bucket histogram of step seconds
+            "sigs": {},            # per-compile-signature step stats
+            "sigs_dropped": 0,
             "replay_next": False,
             "in_recovery": False, "rec_t0": None,
             "recoveries": 0, "reshards": 0, "checkpoints": 0,
@@ -250,15 +252,18 @@ def note_event(kind, **detail):
             _event_locked(_run, kind, detail)
 
 
-def note_step(begin_m, dur_s, warmup=False, mode=None):
+def note_step(begin_m, dur_s, warmup=False, mode=None, sig=None):
     """One completed outer training step (the watchdog beacon feed).
     ``begin_m`` is the beacon's monotonic start, ``dur_s`` the duration
     it already computed: no new clock reads, no lock — one GIL-atomic
     append; classification happens at drain
-    (:func:`_fold_step_locked`)."""
+    (:func:`_fold_step_locked`). ``sig`` is the fused step's
+    compile-signature tag (ISSUE 17): one extra tuple field, so the
+    manifest can carry per-signature step-time stats for the roofline
+    join."""
     if not OPEN:
         return
-    _PENDING.append((begin_m, dur_s, warmup, mode))
+    _PENDING.append((begin_m, dur_s, warmup, mode, sig))
     if len(_PENDING) >= _FOLD_AT:
         fold_pending()  # backstop: a never-drained run stays bounded
 
@@ -285,13 +290,18 @@ def note_input_wait(wait_us):
         fold_pending()
 
 
-def _fold_step_locked(r, begin_m, dur_s, warmup, mode):
+_MAX_SIGS = 64  # per-run signature stats cap (hot sigs are few)
+
+
+def _fold_step_locked(r, begin_m, dur_s, warmup, mode, sig=None):
     """Classify one step entry into the accumulator (caller holds
     ``_lock``): a replay-marked step is ``rewind_replay`` (work the run
     already did once); warm-up completions are ``compile`` (jit-compile
     + eager-warming ramp) except steady-state ``fallback:*`` modes,
     which are host-bound execution (``host_overhead``); everything else
-    is ``compute``."""
+    is ``compute``. A signature-tagged representative step additionally
+    feeds that signature's own stats (the manifest's measured half of
+    the ISSUE 17 roofline join)."""
     end = begin_m + dur_s
     if r["first_begin"] is None or begin_m < r["first_begin"]:
         r["first_begin"] = begin_m
@@ -324,6 +334,20 @@ def _fold_step_locked(r, begin_m, dur_s, warmup, mode):
         r["step_max_s"] = max(r["step_max_s"], dur_s)
         idx = _bucket_index(dur_s * 1e6)
         r["buckets"][idx] = r["buckets"].get(idx, 0) + 1
+        if sig is not None and not replay:
+            s = r["sigs"].get(sig)
+            if s is None:
+                if len(r["sigs"]) >= _MAX_SIGS:
+                    r["sigs_dropped"] += 1
+                    return
+                s = r["sigs"][sig] = {
+                    "count": 0, "sum_s": 0.0, "min_s": math.inf,
+                    "max_s": 0.0, "buckets": {}}
+            s["count"] += 1
+            s["sum_s"] += dur_s
+            s["min_s"] = min(s["min_s"], dur_s)
+            s["max_s"] = max(s["max_s"], dur_s)
+            s["buckets"][idx] = s["buckets"].get(idx, 0) + 1
 
 
 def _fold_locked(r):
@@ -473,6 +497,18 @@ def _derive_locked(r, now_m, closing):
             "p95": min(r["step_max_s"], _percentile(b, n, 0.95)),
             "p99": min(r["step_max_s"], _percentile(b, n, 0.99)),
         }
+    if r["sigs"]:
+        steps["signatures"] = {
+            sig: {
+                "count": s["count"],
+                "mean_s": s["sum_s"] / s["count"],
+                "min_s": s["min_s"],
+                "max_s": s["max_s"],
+                "p50_s": min(s["max_s"], _percentile(
+                    s["buckets"], s["count"], 0.50)),
+            } for sig, s in r["sigs"].items()}
+        if r["sigs_dropped"]:
+            steps["signatures_dropped"] = r["sigs_dropped"]
     return {
         "schema": SCHEMA,
         "run_id": r["run_id"],
@@ -513,6 +549,7 @@ def close_run(outcome="completed"):
         manifest = _derive_locked(r, time.monotonic(), closing=True)
         _run = None
         OPEN = False
+    _attach_perf(manifest)
     manifest["outcome"] = str(outcome)
     # mxlint: disable=MX007 (wall-clock METADATA: the manifest's closed-at timestamp, never interval math)
     manifest["closed_unix"] = time.time()
@@ -524,6 +561,20 @@ def close_run(outcome="completed"):
     with _lock:
         _last = manifest
     return manifest
+
+
+def _attach_perf(manifest):
+    """Attach the roofline join's ``perf`` block (ISSUE 17) — called
+    OUTSIDE ``_lock`` (perfmodel owns its own named lock; drain-time
+    lock discipline forbids nesting them). Lazy import: perfmodel
+    bottom-imports the profiler like this module does."""
+    try:
+        from . import perfmodel
+        blk = perfmodel.manifest_block()
+    except Exception:
+        blk = None
+    if blk:
+        manifest["perf"] = blk
 
 
 def _write_manifest(manifest):
@@ -672,6 +723,7 @@ def write_bench_manifest(model, result, run_id=None):
         "meta": {"bench_model": str(model)},
         "bench": {"model": str(model), "result": result},
     }
+    _attach_perf(manifest)
     _write_manifest(manifest)
     return manifest_path(manifest["run_id"])
 

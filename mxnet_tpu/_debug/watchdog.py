@@ -10,10 +10,16 @@ beacon + a daemon thread:
   ``parallel.elastic_train_loop`` bracket every step with
   ``step_begin()`` / ``step_end()`` (re-entrant: nested loops count the
   outermost step only).
-- Completed non-warmup step durations feed a rolling-median window.
-  Once ``MXTPU_WATCHDOG_MIN_SAMPLES`` steps completed, the watchdog is
-  *armed* with threshold ``max(MXTPU_WATCHDOG_FACTOR * median,
-  MXTPU_WATCHDOG_MIN_S)``.
+- Completed non-warmup step durations feed rolling-median windows
+  keyed by the step's compile-signature tag (``None`` for untagged
+  beacons): two interleaved cadences (train vs eval) each keep an
+  honest median instead of contaminating one mixed window. Once any
+  signature has ``MXTPU_WATCHDOG_MIN_SAMPLES`` completed steps, the
+  watchdog is *armed* with threshold ``max(MXTPU_WATCHDOG_FACTOR *
+  slowest_signature_median, MXTPU_WATCHDOG_MIN_S)`` (the in-flight
+  step's signature is unknown, so the envelope tracks the slowest
+  legitimate cadence); a COMPLETED step is judged a straggler against
+  its own signature's median.
 - A daemon thread polls the in-flight step; one that exceeds the
   threshold is a **stall**: counted (``metrics()['watchdog']``), marked
   in the trace, and the flight recorder dumps a post-mortem shard —
@@ -78,14 +84,21 @@ _depth = 0       # re-entrancy: nested loops track the OUTER step
 _inflight = None  # (seq, monotonic start) of the running outer step
 _inflight_warmup = False  # a nested warmup end taints the outer step
 _inflight_mode = None     # nested step's execution mode (fused_step)
+_inflight_sig = None      # nested step's compile-signature tag
 _last = None     # (seq, dur_s) of the newest COMPLETED step
 _tripped = None  # seq already dumped for — exactly one dump per stall
 _stats = {"steps": 0, "warmup_steps": 0, "stalls": 0, "dumps": 0,
           "slow_steps": 0, "armed": 0, "median_s": 0.0,
           "threshold_s": 0.0, "last_stall_step": -1,
-          "last_stall_elapsed_s": 0.0, "window_resets": 0}
+          "last_stall_elapsed_s": 0.0, "window_resets": 0,
+          "sig_windows": 0}
 _thread = None
 _stop = None
+
+# a run that churns through signatures must not leak windows; past the
+# cap everything clears (the _CACHE_CAP one-shot idiom) and the
+# watchdog re-arms from fresh samples
+_MAX_SIG_WINDOWS = 64
 
 
 def _defaults():
@@ -100,15 +113,36 @@ def _defaults():
 
 _cfg.update(_defaults())
 
-# completed non-warmup durations; sized AFTER the env knobs are read so
-# MXTPU_WATCHDOG_WINDOW applies from import, not only after reset()
-_durs = collections.deque(maxlen=max(1, _cfg["window"]))
+# completed non-warmup durations, keyed by the step's compile-signature
+# tag (None = untagged: eager/elastic beacons). ISSUE 17 satellite: a
+# single mixed window let a second hot signature (eval vs train) skew
+# the stall envelope — a majority of fast eval steps dragged the median
+# down until every train step read as a straggler. Per-signature
+# windows keep each cadence's own median honest; the stall envelope is
+# the SLOWEST armed cadence (conservative: interleaving can never
+# false-trip), and a completed step is judged against its OWN window.
+_durs = {}  # mxlint: disable=MX003 (mutated only from _win_locked/configure/reset, all run under _lock — the helper is named *_locked for exactly this contract)
+
+
+def _win_locked(sig):
+    w = _durs.get(sig)
+    if w is None:
+        if len(_durs) >= _MAX_SIG_WINDOWS:
+            _durs.clear()
+        w = _durs[sig] = collections.deque(
+            maxlen=max(1, _cfg["window"]))
+    return w
+
+
+def _armed_medians_locked():
+    return [statistics.median(w) for w in _durs.values()
+            if len(w) >= _cfg["min_samples"]]
 
 
 def configure(factor=None, min_s=None, poll_s=None, window=None,
               min_samples=None, enabled=None):
     """Override the env-derived knobs at runtime (tests, notebooks)."""
-    global ENABLED, _durs
+    global ENABLED
     with _lock:
         if factor is not None:
             _cfg["factor"] = float(factor)
@@ -120,7 +154,9 @@ def configure(factor=None, min_s=None, poll_s=None, window=None,
             _cfg["min_samples"] = int(min_samples)
         if window is not None:
             _cfg["window"] = int(window)
-            _durs = collections.deque(_durs, maxlen=max(1, int(window)))
+            for sig in list(_durs):
+                _durs[sig] = collections.deque(
+                    _durs[sig], maxlen=max(1, int(window)))
     if enabled is not None:
         ENABLED = bool(enabled)
 
@@ -129,7 +165,7 @@ def reset():
     """Stop the poller and clear all state; knobs re-read from the env
     (test isolation)."""
     global _seq, _depth, _inflight, _last, _tripped, _thread, _stop
-    global ENABLED, _durs, _inflight_warmup, _inflight_mode
+    global ENABLED, _inflight_warmup, _inflight_mode, _inflight_sig
     with _lock:
         stop, thread = _stop, _thread
         _thread = _stop = None
@@ -142,9 +178,10 @@ def reset():
         _inflight = _last = _tripped = None
         _inflight_warmup = False
         _inflight_mode = None
+        _inflight_sig = None
         _cfg.clear()
         _cfg.update(_defaults())
-        _durs = collections.deque(maxlen=_cfg["window"])
+        _durs.clear()
         for k in _stats:
             _stats[k] = -1 if k == "last_stall_step" else 0
         _stats["median_s"] = _stats["threshold_s"] = 0.0
@@ -163,7 +200,8 @@ def reset_window():
     median trips false stalls, and a grown world's fast cadence against
     a slow stale median masks real ones. Clearing the window disarms
     the watchdog until ``min_samples`` fresh steps at the NEW cadence
-    complete (the same warm-up discipline the compile step gets)."""
+    complete (the same warm-up discipline the compile step gets).
+    Clears EVERY signature's window — a reshard changes them all."""
     with _lock:
         _durs.clear()
         _stats["window_resets"] += 1
@@ -177,16 +215,24 @@ def _poll_interval():
 
 
 def _median_locked():
-    return statistics.median(_durs) if _durs else 0.0
+    """The stall-envelope baseline: the SLOWEST armed signature's
+    median. An in-flight step carries no signature (it is not known
+    until dispatch returns), so the envelope must accommodate the
+    slowest legitimate cadence — a fast eval window can never shrink
+    it under the train cadence (the cross-contamination bug this
+    keys-by-signature split fixes)."""
+    meds = _armed_medians_locked()
+    return max(meds) if meds else 0.0
 
 
 def threshold_s():
     """Current stall threshold in seconds, or ``None`` while unarmed
-    (not enough representative completed steps yet)."""
+    (no signature has enough representative completed steps yet)."""
     with _lock:
-        if len(_durs) < _cfg["min_samples"]:
+        meds = _armed_medians_locked()
+        if not meds:
             return None
-        return max(_cfg["factor"] * _median_locked(), _cfg["min_s"])
+        return max(_cfg["factor"] * max(meds), _cfg["min_s"])
 
 
 def last_step():
@@ -199,11 +245,13 @@ def stats():
     """Flat JSON-safe snapshot — ``profiler.metrics()['watchdog']``."""
     with _lock:
         out = dict(_stats)
-        out["median_s"] = round(_median_locked(), 6)
-        thr = (max(_cfg["factor"] * _median_locked(), _cfg["min_s"])
-               if len(_durs) >= _cfg["min_samples"] else 0.0)
+        meds = _armed_medians_locked()
+        out["median_s"] = round(max(meds), 6) if meds else 0.0
+        thr = (max(_cfg["factor"] * max(meds), _cfg["min_s"])
+               if meds else 0.0)
         out["threshold_s"] = round(thr, 6)
-        out["armed"] = int(len(_durs) >= _cfg["min_samples"])
+        out["armed"] = int(bool(meds))
+        out["sig_windows"] = len(_durs)
         out["enabled"] = int(ENABLED)
     return out
 
@@ -212,6 +260,7 @@ def step_begin():
     """Mark the start of a training step (re-entrant). Starts the
     poller thread lazily on first use when the watchdog is enabled."""
     global _seq, _depth, _inflight, _inflight_warmup, _inflight_mode
+    global _inflight_sig
     if not ENABLED:
         return
     with _lock:
@@ -222,10 +271,11 @@ def step_begin():
         _inflight = (_seq, time.monotonic())
         _inflight_warmup = False
         _inflight_mode = None
+        _inflight_sig = None
     _ensure_thread()
 
 
-def step_end(warmup=False, mode=None):
+def step_end(warmup=False, mode=None, sig=None):
     """Mark the end of the innermost-begun step. ``warmup=True`` steps
     (eager warming, jit compile, fallbacks) complete the beacon but do
     not feed the median — they are not representative of steady state.
@@ -236,12 +286,18 @@ def step_end(warmup=False, mode=None):
     execution mode (``fused``/``compile``/``eager-warming``/
     ``fallback:*``) so the goodput run ledger can attribute the step's
     wall time to compute vs compile vs host overhead — a nested mode
-    taints the outer completion the same way warmup does.
+    taints the outer completion the same way warmup does. ``sig`` is
+    the executing program's compile-signature tag (fused steps only):
+    it keys the rolling window this completion feeds, and it rides the
+    goodput/perfmodel feeds as one extra tuple field — no new clock
+    reads (ISSUE 17; ``BENCH_MODEL=perf_attrib`` prices it).
 
-    The completed step feeds ``goodput.note_step`` AFTER this module's
-    lock is released — and that feed is itself one lock-free
-    GIL-atomic append riding the beacon's own clock reads."""
+    The completed step feeds ``goodput.note_step`` and
+    ``perfmodel.note_step`` AFTER this module's lock is released — each
+    feed is one lock-free GIL-atomic append riding the beacon's own
+    clock reads."""
     global _depth, _inflight, _last, _inflight_warmup, _inflight_mode
+    global _inflight_sig
     if not ENABLED:
         return
     done = None
@@ -253,25 +309,33 @@ def step_end(warmup=False, mode=None):
             _inflight_warmup = True
         if mode is not None:
             _inflight_mode = mode
+        if sig is not None:
+            _inflight_sig = sig
         if _depth > 0 or _inflight is None:
             return
         seq, t0 = _inflight
         _inflight = None
         warmup = warmup or _inflight_warmup
         mode = mode if mode is not None else _inflight_mode
+        sig = sig if sig is not None else _inflight_sig
         _inflight_warmup = False
         _inflight_mode = None
+        _inflight_sig = None
         dur = time.monotonic() - t0
         _last = (seq, dur)
-        done = (t0, dur, warmup, mode)
+        done = (t0, dur, warmup, mode, sig)
         if warmup:
             _stats["warmup_steps"] += 1
         else:
             _stats["steps"] += 1
-            thr = (max(_cfg["factor"] * _median_locked(),
+            # the straggler verdict compares this completion against
+            # its OWN signature's window (threshold BEFORE appending,
+            # so a step can't vote itself normal)
+            w = _win_locked(sig)
+            thr = (max(_cfg["factor"] * statistics.median(w),
                        _cfg["min_s"])
-                   if len(_durs) >= _cfg["min_samples"] else None)
-            _durs.append(dur)
+                   if len(w) >= _cfg["min_samples"] else None)
+            w.append(dur)
             if thr is not None and dur > thr and seq != _tripped:
                 # finished, but way beyond the envelope: a straggler
                 # (the in-flight poller may already have dumped for it)
@@ -281,7 +345,11 @@ def step_end(warmup=False, mode=None):
         # above): the run ledger costs this one call per STEP, nothing
         # per op (BENCH_MODEL=goodput_overhead prices it)
         _goodput.note_step(done[0], done[1], warmup=done[2],
-                           mode=done[3])
+                           mode=done[3], sig=done[4])
+    if done[4] is not None and not done[2] and _perfmodel.ENABLED:
+        # the roofline join's measured side: same discipline — the
+        # tagged duration this beacon already computed, one append
+        _perfmodel.note_step(done[4], done[1])
 
 
 def check_now():
@@ -293,7 +361,7 @@ def check_now():
 def _check(now):
     global _tripped
     with _lock:
-        if _inflight is None or len(_durs) < _cfg["min_samples"]:
+        if _inflight is None or not _armed_medians_locked():
             return False
         seq, t0 = _inflight
         if seq == _tripped:
@@ -328,9 +396,11 @@ def _loop(stop):
     while not stop.wait(_poll_interval()):
         try:
             _check(time.monotonic())
-            # drain the goodput ledger's hot-path mailboxes off the
-            # training thread (the PR 12 drain-on-whoever-asks idiom)
+            # drain the goodput/perfmodel hot-path mailboxes off the
+            # training thread (the PR 12 drain-on-whoever-asks idiom);
+            # collapse dumps fire here, never on the step path
             _goodput.fold_pending()
+            _perfmodel.fold_pending()
         except Exception:
             pass  # the watchdog must never take the training loop down
 
@@ -352,7 +422,10 @@ def _ensure_thread():
 
 # surfaces as metrics()['watchdog'] and a dumps() provider line;
 # registered here (watchdog is imported by fused_step/kvstore, after
-# the profiler module is fully loaded — no cycle)
+# the profiler module is fully loaded — no cycle). perfmodel is a
+# bottom import too: it imports _envf from THIS module, so a top
+# import would race module initialization whichever side loads first.
 from .. import profiler as _profiler  # noqa: E402
+from . import perfmodel as _perfmodel  # noqa: E402
 
 _profiler.register_stats_provider("watchdog", stats)

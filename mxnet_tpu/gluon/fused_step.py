@@ -53,6 +53,7 @@ import inspect
 import os
 import time as _time
 import warnings
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -371,8 +372,12 @@ class FusedTrainStep:
             self.last_mode = mode
             # mode rides the beacon so the goodput run ledger can split
             # step wall time into compute ('fused') vs compile
-            # ('compile'/'eager-warming') vs host-bound fallbacks
-            _watchdog.step_end(warmup=mode != "fused", mode=mode)
+            # ('compile'/'eager-warming') vs host-bound fallbacks; the
+            # executing program's signature tag rides along (one tuple
+            # field) keying the watchdog window + the roofline join
+            attr = self._step_attr if mode == "fused" else None
+            _watchdog.step_end(warmup=mode != "fused", mode=mode,
+                               sig=attr.get("sig") if attr else None)
             if t0 is not None:
                 dur_us = (_time.perf_counter() - t0) * 1e6
                 _profiler.record_op(
@@ -1002,6 +1007,7 @@ class FusedTrainStep:
         argument+output+temp total is the modeled HBM peak behind the
         ``memory.headroom`` gauge and the ``dumps()`` Memory table."""
         flops = bytes_acc = comm_bytes = comp_us = comm_us = None
+        dtype = peak = None
         if cost:
             flops = float(cost.get("flops", 0.0)) or None
             bytes_acc = float(cost.get("bytes accessed", 0.0)) or None
@@ -1021,8 +1027,15 @@ class FusedTrainStep:
                     int(all_params[pos].data().size)
                     for pos in train_pos)
             if flops:
-                comp_us = flops / (
-                    cm.ASSUMPTIONS["bf16_peak_tflops"] * 1e12) * 1e6
+                # the peak is keyed by the program's DOMINANT dtype
+                # (by trainable-param bytes): an f32 net runs the MXU
+                # at half the bf16 rate, an int8 one (the PR 9
+                # quantized-matmul path) at double — a hardcoded bf16
+                # peak halved/doubled every modeled compute time and
+                # every MFU derived from it (ISSUE 17 satellite)
+                dtype = self._dominant_dtype(all_params, train_pos)
+                peak = cm.peak_tflops(dtype)
+                comp_us = flops / (peak * 1e12) * 1e6
             if comm_bytes:
                 comm_us = sum(cm.allreduce_seconds(
                     comm_bytes, max(self._dp, 2))) * 1e6 \
@@ -1042,6 +1055,13 @@ class FusedTrainStep:
                           - mem.get("alias_bytes", 0))
             mem = dict(mem, peak_bytes=peak_bytes)
             _storage.note_modeled_peak("fused_step", peak_bytes)
+        # the registry key must be STABLE across processes (ISSUE 17:
+        # tools/perf_report.py --compare joins runs by signature tag):
+        # crc32 of the signature tuple's repr, not the seed-randomized
+        # builtin hash(). Avals, token strings and static-key entries
+        # all repr deterministically.
+        keyhash = "%08x" % (zlib.crc32(
+            repr(key).encode("utf-8")) & 0xFFFFFFFF)
         self._attr_models.pop(key, None)
         if comp_us is not None or peak_bytes is not None:
             self._attr_models[key] = {
@@ -1049,13 +1069,40 @@ class FusedTrainStep:
                 "comm_us": comm_us or 0.0,
                 "device_us": (comp_us or 0.0) + (comm_us or 0.0),
                 "peak_bytes": peak_bytes,
+                # the tag cache hits thread through watchdog.step_end:
+                # same "name:key" string perfmodel derives from the
+                # record_compile call below, so the roofline join's
+                # two sides meet exactly
+                "sig": "fused_step:%s" % keyhash,
             }
         _profiler.record_compile(
-            "fused_step", key="%08x" % (abs(hash(key)) & 0xFFFFFFFF),
+            "fused_step", key=keyhash,
             dur_us=dur_us, flops=flops, bytes_accessed=bytes_acc,
             comm_bytes=comm_bytes, modeled_compute_us=comp_us,
             modeled_comm_us=comm_us, memory=mem,
-            args={"params": len(train_pos), "dp": self._dp})
+            args={"params": len(train_pos), "dp": self._dp,
+                  "dtype": dtype, "peak_tflops": peak})
+
+    @staticmethod
+    def _dominant_dtype(all_params, train_pos):
+        """Short dtype key (``bf16``/``f32``/``int8``/...) of the
+        dtype holding the majority of trainable-param bytes — what the
+        program's matmuls actually run in, hence which MXU peak the
+        modeled compute time must price against."""
+        by_dtype = {}
+        for pos in train_pos:
+            d = all_params[pos].data()
+            name = str(getattr(d, "dtype", None) or "float32")
+            size = int(getattr(d, "size", 0))
+            item = int(getattr(getattr(d, "dtype", None),
+                               "itemsize", 4) or 4)
+            by_dtype[name] = by_dtype.get(name, 0) + size * item
+        if not by_dtype:
+            return "bf16"
+        dom = max(by_dtype, key=by_dtype.get)
+        return {"float32": "f32", "bfloat16": "bf16",
+                "float16": "f16", "int8": "int8",
+                "float64": "f32"}.get(dom, "bf16")
 
     def _run(self, entry, all_params, train_pos, indices, states, nd_args,
              batch_size, aot=False):

@@ -182,3 +182,18 @@ def test_gspmd_keeps_scan_accumulated_reduction_in_loop():
     # in-loop: 4 dynamic executions of the [8, 32] f32 reduction
     assert counts["all-reduce"] == 4, counts
     assert by["all-reduce"] == 4 * 8 * 32 * 4, by
+
+
+def test_peak_tflops_table_dtype_aware():
+    """ISSUE 17 satellite: the dtype-aware peak table — f32 is half
+    the bf16 MXU rate, int8 double (the PR 9 quantized-matmul path),
+    f16 rides the bf16 MXU number, unknown dtypes fall back to bf16.
+    The legacy scalar stays aliased for old callers."""
+    t = comm_model.ASSUMPTIONS["peak_tflops"]
+    assert comm_model.peak_tflops("bf16") == t["bf16"] == 197.0
+    assert comm_model.peak_tflops("f32") == t["f32"] == 98.5
+    assert comm_model.peak_tflops("int8") == t["int8"] == 394.0
+    assert comm_model.peak_tflops("f16") == t["bf16"]
+    assert comm_model.peak_tflops("float8_e4m3") == t["bf16"]
+    assert comm_model.peak_tflops() == t["bf16"]
+    assert comm_model.ASSUMPTIONS["bf16_peak_tflops"] == t["bf16"]

@@ -1491,3 +1491,87 @@ def test_mx019_tree_providers_all_documented():
     for name in ("elastic", "faults", "flightrec", "fused_step",
                  "goodput", "io", "kvstore_server", "watchdog"):
         assert name in docs, "metrics()[%r] undocumented" % name
+
+
+# -- MX021: hardware-constant drift ------------------------------------------
+
+_ASSUMPTIONS_FIXTURE = """\
+ASSUMPTIONS = {
+    "chip": "tpu_v5e",
+    "bf16_peak_tflops": 197.0,
+    "peak_tflops": {"bf16": 197.0, "f32": 98.5, "int8": 394.0},
+    "hbm_bw_GBps": 819.0,
+    "dcn_bw_per_host_GBps": 25.0,
+    "chips_per_host": 4,
+}
+"""
+
+
+def test_mx021_flags_math_and_table_literals(tmp_path):
+    """A rate spelled as a literal in modeled math (a BinOp operand)
+    or as a lookup-table dict value forks the hardware model."""
+    _plant(tmp_path, "benchmark/comm_model.py", _ASSUMPTIONS_FIXTURE)
+    _plant(tmp_path, "mxnet_tpu/_debug/roof.py", """\
+        def mfu(flops, dur):
+            return flops / (dur * 197.0 * 1e12)
+
+        PEAKS = {"v5e": 98.5}
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX021"})
+    assert sorted(f.line for f in findings) == [2, 4]
+    assert all(f.code == "MX021" for f in findings)
+    assert "ASSUMPTIONS" in findings[0].message
+
+
+def test_mx021_defaults_thresholds_and_other_floats_clean(tmp_path):
+    """Only math-context literals fire: argparse-style defaults,
+    comparisons, and non-rate floats in arithmetic all stay clean —
+    the 25.0 DCN rate colliding with a --median-pct default must
+    never page."""
+    _plant(tmp_path, "benchmark/comm_model.py", _ASSUMPTIONS_FIXTURE)
+    _plant(tmp_path, "mxnet_tpu/_debug/clean.py", """\
+        def f(pct=25.0, bw=819.0):
+            if pct == 98.5:
+                return None
+            g(threshold=197.0)
+            return pct * 3.0
+
+        def g(threshold=0.0):
+            return threshold
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX021"})
+    assert findings == []
+
+
+def test_mx021_comm_model_itself_and_int_keys_exempt(tmp_path):
+    """The one home is exempt, and non-rate keys (chips_per_host) do
+    not poison the rate set."""
+    _plant(tmp_path, "benchmark/comm_model.py", _ASSUMPTIONS_FIXTURE
+           + "\nWIRE = 2 * (4 - 1) / 4 * 819.0\n")
+    _plant(tmp_path, "mxnet_tpu/_debug/ok.py", "N = 4 * 2\n")
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX021"})
+    assert findings == []
+
+
+def test_mx021_no_comm_model_skips(tmp_path):
+    """A tree without benchmark/comm_model.py (installed wheel,
+    planted fixture) has no rate table — the rule stays silent."""
+    _plant(tmp_path, "mxnet_tpu/_debug/roof.py", "X = 2.0 * 197.0\n")
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX021"})
+    assert findings == []
+
+
+def test_mx021_real_tree_rates_parsed_and_clean():
+    """The live contract: the real ASSUMPTIONS table parses into the
+    expected rate set, and the rule's full real scope (which includes
+    bench.py and tools/ — wider than the default lint paths) is clean.
+    First run caught bench.py's hardcoded v5e 197.0 — this pins the
+    fix."""
+    rule = next(r for r in rules.ALL_RULES if r.code == "MX021")
+    rates = rule._rates()
+    for v in (197.0, 98.5, 394.0, 819.0, 180.0, 25.0):
+        assert v in rates, "rate %r missing from parsed table" % v
+    findings, _, _, _ = mxlint.run(
+        ["bench.py", "benchmark", "tools", "mxnet_tpu"],
+        rules=[rule], baseline=[])
+    assert findings == [], "\n".join(map(repr, findings))

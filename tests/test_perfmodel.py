@@ -1,0 +1,531 @@
+"""Roofline/MFU attribution plane (ISSUE 17;
+mxnet_tpu/_debug/perfmodel.py).
+
+Five halves:
+
+* the drain-time join — modeled compile costs vs measured step
+  durations per signature: exact MFU math, one roofline verdict per
+  bound, membw utilization and arithmetic intensity;
+* the efficiency-collapse detector — latch semantics (ONE dump per
+  episode, re-arm on the first clean step) and the window-exclusion
+  invariant (a sustained collapse cannot drag its own baseline);
+* the feeds — watchdog sig passthrough into perfmodel AND the goodput
+  per-signature step summary, the AOT retrace re-record (satellite 3:
+  a signature flip re-records, cache-hit replay does NOT double-count
+  the compile registry), the dtype-aware peak (satellite 1: f32 pins
+  to the ASSUMPTIONS table, not the old bf16 hardcode), and the
+  MXTPU_PERF=0/1 bitwise-identity guarantee;
+* the surfaces — metrics()['perf'], Prometheus families, the dumps()
+  Roofline table, metadata.perf in flight-record dumps, the perf
+  block in run manifests;
+* the compare CLI — exit 0 on an identical pair, 1 on a 2x slowdown,
+  2 on unreadable input, and the noise floor (a relative MFU wobble
+  under the absolute floor never pages).
+
+Plus the satellite watchdog bugfix: per-signature rolling windows, so
+two interleaved cadences (train + eval) never false-trip the
+straggler counter against a mixed median.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, profiler
+from mxnet_tpu._debug import flightrec, goodput, perfmodel, watchdog
+from tools import perf_report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_RUNS_DIR", str(tmp_path / "runs"))
+    goodput.reset()
+    watchdog.reset()
+    perfmodel.reset()
+    yield
+    goodput.reset()
+    watchdog.reset()
+    perfmodel.reset()
+
+
+def _model(sig="prog:cafe0001", flops=None, bytes_accessed=None,
+           comm_us=None, peak=None, dtype=None):
+    name, key = sig.split(":")
+    perfmodel.note_compile(
+        name, key, flops=flops, bytes_accessed=bytes_accessed,
+        modeled_comm_us=comm_us,
+        args={"peak_tflops": peak, "dtype": dtype})
+
+
+def _steps(sig, durs):
+    for d in durs:
+        perfmodel.note_step(sig, d)
+    perfmodel.fold_pending()
+
+
+def _row(sig):
+    rows = {r["sig"]: r for r in perfmodel.table()}
+    return rows[sig]
+
+
+# -- the drain-time join -----------------------------------------------------
+
+class TestJoin:
+    def test_mfu_exact(self):
+        _model("prog:a", flops=2e9, peak=98.5, dtype="f32")
+        _steps("prog:a", [1e-3] * 6)
+        r = _row("prog:a")
+        assert r["steps"] == 6
+        assert r["median_s"] == pytest.approx(1e-3)
+        assert r["mfu"] == pytest.approx(2e9 / (1e-3 * 98.5e12),
+                                         rel=1e-9)
+        assert r["dtype"] == "f32" and r["peak_tflops"] == 98.5
+
+    def test_intensity_and_membw(self):
+        _model("prog:b", flops=4e9, bytes_accessed=2e8, peak=197.0)
+        _steps("prog:b", [1e-3] * 4)
+        r = _row("prog:b")
+        assert r["intensity"] == pytest.approx(4e9 / 2e8)
+        # memory term = bytes / (819 GB/s); utilization = term / median
+        assert r["membw_util"] == pytest.approx(
+            (2e8 / 819e9) / 1e-3, rel=1e-6)
+
+    def test_bound_compute(self):
+        _model("prog:c", flops=1e12, peak=100.0)  # t_compute = 10ms
+        _steps("prog:c", [0.011] * 4)
+        assert _row("prog:c")["bound"] == "compute"
+
+    def test_bound_memory(self):
+        _model("prog:m", flops=1e6, bytes_accessed=8.19e9,
+               peak=100.0)  # t_mem = 10ms at the 819 GB/s assumption
+        _steps("prog:m", [0.011] * 4)
+        assert _row("prog:m")["bound"] == "memory"
+
+    def test_bound_comm(self):
+        _model("prog:n", flops=1e6, peak=100.0, comm_us=10000.0)
+        _steps("prog:n", [0.012] * 4)
+        assert _row("prog:n")["bound"] == "comm"
+
+    def test_bound_overhead(self):
+        _model("prog:o", flops=1e6, peak=100.0)  # floor ~ 10ns
+        _steps("prog:o", [0.01] * 4)
+        r = _row("prog:o")
+        assert r["bound"] == "overhead"
+        assert r["terms_s"]["overhead"] == pytest.approx(0.01,
+                                                         rel=1e-3)
+
+    def test_terms_decompose_to_measured(self):
+        _model("prog:d", flops=5e11, bytes_accessed=1e9, peak=100.0,
+               comm_us=2000.0)
+        _steps("prog:d", [0.01] * 4)
+        t = _row("prog:d")["terms_s"]
+        floor = max(t["compute"], t["memory"]) + t["comm"]
+        assert floor + t["overhead"] == pytest.approx(0.01, rel=1e-6)
+
+    def test_unjoined_measured_sig_has_no_verdict(self):
+        _steps("prog:ghost", [1e-3] * 4)
+        r = _row("prog:ghost")
+        assert r["mfu"] is None and r["bound"] is None
+        assert r["steps"] == 4
+
+    def test_disabled_drops_append(self):
+        perfmodel.configure(enabled=False)
+        perfmodel.note_step("prog:x", 1e-3)
+        perfmodel.configure(enabled=True)
+        perfmodel.fold_pending()
+        assert perfmodel.snapshot()["steps"] == 0
+
+
+# -- the efficiency-collapse detector ----------------------------------------
+
+class TestCollapse:
+    def _arm(self, sig="prog:cl"):
+        _model(sig, flops=2e9, peak=98.5)
+        _steps(sig, [1e-3] * 6)  # min_samples=5 default: armed
+        return sig
+
+    def test_trip_counts_and_latches_one_dump(self):
+        sig = self._arm()
+        base = perfmodel.snapshot()["collapse_dumps"]
+        _steps(sig, [0.01, 0.01, 0.01])  # sustained 10x slowdown
+        s = perfmodel.snapshot()
+        assert s["collapses"] == 3
+        # latched: ONE dump for the whole episode
+        assert s["collapse_dumps"] == base + 1
+
+    def test_collapsed_steps_stay_out_of_windows(self):
+        sig = self._arm()
+        _steps(sig, [0.01] * 10)
+        r = _row(sig)
+        # the baseline median never absorbed the collapsed durations —
+        # a sustained collapse cannot self-heal the alarm
+        assert r["median_s"] == pytest.approx(1e-3)
+        assert r["collapses"] == 10
+
+    def test_clean_step_rearms_for_next_episode(self):
+        sig = self._arm()
+        _steps(sig, [0.01])          # episode 1: dump
+        _steps(sig, [1e-3] * 2)      # clean: re-arm
+        _steps(sig, [0.01])          # episode 2: new dump
+        s = perfmodel.snapshot()
+        assert s["collapses"] == 2
+        assert s["collapse_dumps"] == 2
+
+    def test_dump_names_signature_and_grown_term(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(tmp_path))
+        sig = self._arm()
+        _steps(sig, [0.01])
+        dumps = [p for p in os.listdir(tmp_path) if "perf" in p]
+        assert len(dumps) == 1
+        data = json.load(open(os.path.join(tmp_path, dumps[0])))
+        info = data["metadata"]["trigger_info"]
+        assert info["signature"] == sig
+        assert info["grew"] == "overhead"  # modeled terms are fixed
+        assert info["measured_s"] == pytest.approx(0.01)
+        assert info["baseline_median_s"] == pytest.approx(1e-3)
+
+    def test_no_trip_while_warming(self):
+        _model("prog:w", flops=2e9, peak=98.5)
+        _steps("prog:w", [1e-3, 0.01, 1e-3])  # under min_samples
+        assert perfmodel.snapshot()["collapses"] == 0
+
+
+# -- the feeds ---------------------------------------------------------------
+
+def _make_net():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, in_units=8, activation="relu"))
+        net.add(gluon.nn.Dense(1, in_units=16))
+    net.initialize(mx.init.Uniform(0.1))
+    net.hybridize()
+    return net
+
+
+def _fused(net, n=3, batch=4, seed=0):
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01})
+    l2 = gluon.loss.L2Loss()
+    step = gluon.train_step(net, lambda o, t: l2(o, t), tr)
+    rs = np.random.RandomState(seed)
+    x = mx.nd.array(rs.rand(batch, 8).astype("float32"))
+    y = mx.nd.array(rs.rand(batch, 1).astype("float32"))
+    for _ in range(n):
+        step(x, y, batch_size=batch)
+    return step, x, y
+
+
+class TestFeeds:
+    def test_watchdog_sig_passthrough(self):
+        _model("fs:1234", flops=1e9, peak=98.5)
+        goodput.open_run(run_id="feed")
+        for _ in range(4):
+            watchdog.step_begin()
+            watchdog.step_end(mode="fused", sig="fs:1234")
+        perfmodel.fold_pending()
+        r = _row("fs:1234")
+        assert r["steps"] == 4 and r["mfu"] is not None
+        m = goodput.close_run()
+        sigs = m["steps"]["signatures"]
+        assert sigs["fs:1234"]["count"] == 4
+        assert m["perf"]["signatures"]["fs:1234"]["steps"] == 4
+
+    def test_warmup_steps_do_not_feed(self):
+        _model("fs:warm", flops=1e9, peak=98.5)
+        watchdog.step_begin()
+        watchdog.step_end(warmup=True, mode="compile", sig="fs:warm")
+        perfmodel.fold_pending()
+        assert perfmodel.snapshot()["steps"] == 0
+
+    def test_fused_step_tags_and_joins(self):
+        step, x, y = _fused(_make_net(), n=5)
+        assert step.last_mode == "fused"
+        perfmodel.fold_pending()
+        rows = [r for r in perfmodel.table()
+                if r["sig"].startswith("fused_step:")]
+        assert len(rows) == 1
+        r = rows[0]
+        # the tag is the crc-stable form, joined against the compile
+        # registry's XLA cost analysis: a real MFU comes out
+        import re
+        assert re.fullmatch(r"fused_step:[0-9a-f]{8}", r["sig"])
+        assert r["mfu"] is not None and r["mfu"] > 0
+        assert r["dtype"] == "f32"
+
+    def test_f32_peak_from_assumptions_table(self):
+        """Satellite 1: an all-f32 net prices modeled compute against
+        the 98.5 TFLOPs f32 peak, not the old bf16 197.0 hardcode."""
+        _fused(_make_net(), n=3)
+        st = profiler.compile_stats()["fused_step"]
+        assert st["flops"] > 0
+        assert st["modeled_compute_us"] == pytest.approx(
+            st["flops"] / (98.5 * 1e12) * 1e6, rel=1e-6)
+        perfmodel.fold_pending()
+        r = [r for r in perfmodel.table()
+             if r["sig"].startswith("fused_step:")][0]
+        assert r["peak_tflops"] == 98.5
+
+    def test_retrace_rerecords_and_cache_hits_do_not(self):
+        """Satellite 3: a signature flip (new batch shape) re-records
+        the compile registry; cache-hit replay never double-counts."""
+        net = _make_net()
+        step, x, y = _fused(net, n=4)
+        before = profiler.compile_stats()["fused_step"]["count"]
+        for _ in range(5):  # pure cache hits
+            step(x, y, batch_size=4)
+        assert profiler.compile_stats()["fused_step"]["count"] == before
+        rs = np.random.RandomState(1)
+        x2 = mx.nd.array(rs.rand(6, 8).astype("float32"))
+        y2 = mx.nd.array(rs.rand(6, 1).astype("float32"))
+        for _ in range(3):  # new avals: one retrace, then hits
+            step(x2, y2, batch_size=6)
+        after = profiler.compile_stats()["fused_step"]
+        assert after["count"] == before + 1
+        perfmodel.fold_pending()
+        sigs = [r["sig"] for r in perfmodel.table()
+                if r["sig"].startswith("fused_step:")]
+        assert len(sigs) == 2  # each shape joined under its own tag
+
+    def test_perf_toggle_is_bitwise_invisible(self):
+        """MXTPU_PERF=1 training must be bitwise-identical to =0 —
+        the plane observes the beacon, it never touches the graph."""
+        net_on = _make_net()
+        net_off = _make_net()
+        for (_, pa), (_, pb) in zip(
+                sorted(net_on.collect_params().items()),
+                sorted(net_off.collect_params().items())):
+            pb.set_data(pa.data())
+        perfmodel.configure(enabled=True)
+        _fused(net_on, n=4, seed=7)
+        perfmodel.configure(enabled=False)
+        _fused(net_off, n=4, seed=7)
+        perfmodel.configure(enabled=True)
+        for (_, pa), (_, pb) in zip(
+                sorted(net_on.collect_params().items()),
+                sorted(net_off.collect_params().items())):
+            assert np.array_equal(pa.data().asnumpy(),
+                                  pb.data().asnumpy())
+
+
+# -- the watchdog per-signature windows (satellite bugfix) -------------------
+
+class TestWatchdogWindows:
+    # watchdog's clock is swapped for a fake that advances only by the
+    # injected duration: under full-suite load a real 1ms sleep can
+    # overshoot 3x its own median and false-trip the very check this
+    # class pins, so wall-clock never enters these tests
+
+    class _Clock:
+        def __init__(self):
+            self.now = 1000.0
+
+        def monotonic(self):
+            return self.now
+
+    @pytest.fixture(autouse=True)
+    def _fake_clock(self, monkeypatch):
+        self.clock = self._Clock()
+        monkeypatch.setattr(watchdog, "time", self.clock)
+
+    def _beat(self, dur, sig):
+        watchdog.step_begin()
+        self.clock.now += dur
+        watchdog.step_end(mode="fused", sig=sig)
+
+    def test_two_cadences_never_false_trip(self):
+        """Interleaved train (slow) + eval (fast) steps: the old mixed
+        window let the eval majority drag the median down until every
+        train step read as a straggler. Per-signature windows keep
+        each cadence honest: zero slow_steps."""
+        watchdog.configure(factor=3.0, min_s=0.0, min_samples=3)
+        for _ in range(4):
+            self._beat(0.02, "fs:train")
+            for _ in range(3):
+                self._beat(0.001, "fs:eval")
+        s = watchdog.stats()
+        assert s["steps"] == 16
+        assert s["slow_steps"] == 0
+        assert s["sig_windows"] == 2
+
+    def test_stall_envelope_is_slowest_armed_cadence(self):
+        watchdog.configure(factor=3.0, min_s=0.0, min_samples=3)
+        for _ in range(4):
+            self._beat(0.02, "fs:train")
+            self._beat(0.001, "fs:eval")
+        thr = watchdog.threshold_s()
+        # the in-flight step's signature is unknown, so the envelope
+        # must cover the SLOWEST armed cadence, not the mixed median
+        assert thr == pytest.approx(3.0 * 0.02, rel=0.5)
+        assert thr > 3.0 * 0.005  # far above the old mixed median
+
+    def test_own_window_still_catches_a_real_straggler(self):
+        # poll_s high: the completed-step verdict, not the in-flight
+        # poller (which would claim the trip first), owns this count
+        watchdog.configure(factor=3.0, min_s=0.0, min_samples=3,
+                           poll_s=60.0)
+        for _ in range(4):
+            self._beat(0.002, "fs:train")
+        self._beat(0.03, "fs:train")  # 15x its OWN median
+        assert watchdog.stats()["slow_steps"] == 1
+
+    def test_reset_window_clears_all_signatures(self):
+        watchdog.configure(factor=3.0, min_s=0.0, min_samples=3)
+        for _ in range(4):
+            self._beat(0.002, "fs:a")
+        assert watchdog.stats()["sig_windows"] == 1
+        watchdog.reset_window()
+        assert watchdog.stats()["sig_windows"] == 0
+        assert watchdog.threshold_s() is None
+
+
+# -- surfaces ----------------------------------------------------------------
+
+class TestSurfaces:
+    def test_metrics_provider_keys(self):
+        _model("prog:s", flops=2e9, peak=98.5)
+        _steps("prog:s", [1e-3] * 4)
+        m = profiler.metrics()["perf"]
+        for k in ("enabled", "signatures", "steps", "collapses",
+                  "collapse_dumps", "dropped_sigs", "per_signature"):
+            assert k in m
+        assert m["hot_signature"] == "prog:s"
+        assert m["hot_bound"] == "overhead"
+        assert m["per_signature"]["prog:s"]["mfu"] == pytest.approx(
+            m["mfu"], abs=1e-6)  # headline rounds at 6 places
+        json.dumps(m)  # JSON-safe contract
+
+    def test_prometheus_families(self):
+        _model("prog:p", flops=1e12, bytes_accessed=1e9, peak=100.0)
+        _steps("prog:p", [0.011] * 4)
+        prom = profiler.prometheus_text()
+        assert 'mxtpu_mfu{' in prom
+        assert 'signature="prog:p"' in prom
+        assert 'mxtpu_membw_util' in prom
+        assert 'mxtpu_roofline_bound' in prom
+        assert 'bound="compute"' in prom
+
+    def test_dumps_roofline_table(self):
+        _model("prog:t", flops=2e9, peak=98.5)
+        _steps("prog:t", [1e-3] * 4)
+        txt = profiler.dumps()
+        assert "Roofline" in txt and "prog:t" in txt
+
+    def test_flightrec_dump_carries_perf_metadata(self, tmp_path):
+        _model("prog:f", flops=2e9, peak=98.5)
+        _steps("prog:f", [1e-3] * 4)
+        shard = str(tmp_path / "shard.json")
+        flightrec.dump("manual", path=shard)
+        data = json.load(open(shard))
+        p = data["metadata"]["perf"]
+        assert p["per_signature"]["prog:f"]["steps"] == 4
+
+    def test_manifest_block_absent_without_join(self):
+        goodput.open_run(run_id="nojoin")
+        m = goodput.close_run()
+        assert "perf" not in m
+
+    def test_bench_manifest_carries_perf_block(self):
+        _model("prog:bm", flops=2e9, peak=98.5)
+        _steps("prog:bm", [1e-3] * 4)
+        path = goodput.write_bench_manifest(
+            "train_step", {"metric": "train_step_steps_per_sec",
+                           "value": 100.0, "gate": {"ok": True}})
+        m = goodput.load_manifest(path)
+        assert m["perf"]["schema"] == "mxtpu.perf/1"
+        assert "prog:bm" in m["perf"]["signatures"]
+        assert m["perf"]["assumptions"]["hbm_bw_GBps"] == 819.0
+
+
+# -- the compare CLI ---------------------------------------------------------
+
+def _manifest(tmp, name, median_s=0.01, mfu=0.4, bound="compute",
+              perf=True):
+    m = {"schema": "mxtpu.goodput.run/1", "run_id": name,
+         "outcome": "completed"}
+    if perf:
+        m["perf"] = {"schema": "mxtpu.perf/1", "signatures": {
+            "fused_step:cafef00d": {
+                "steps": 100, "median_s": median_s, "mfu": mfu,
+                "bound": bound}}}
+    p = os.path.join(str(tmp), name + ".json")
+    with open(p, "w") as f:
+        json.dump(m, f)
+    return p
+
+
+class TestCompareCLI:
+    def test_identical_pair_passes(self, tmp_path):
+        a = _manifest(tmp_path, "a")
+        b = _manifest(tmp_path, "b")
+        assert perf_report.main(["--compare", a, b]) == 0
+
+    def test_2x_slowdown_flagged(self, tmp_path, capsys):
+        a = _manifest(tmp_path, "a")
+        b = _manifest(tmp_path, "b", median_s=0.02, mfu=0.2)
+        assert perf_report.main(["--compare", a, b]) == 1
+        out = capsys.readouterr().out
+        assert "verdict: REGRESSION" in out
+
+    def test_mfu_drop_needs_relative_and_absolute(self, tmp_path):
+        """A 33% wobble on a 0.003 MFU microbench is under the 0.02
+        absolute floor — never a page."""
+        a = _manifest(tmp_path, "a", mfu=0.003)
+        b = _manifest(tmp_path, "b", mfu=0.002)
+        assert perf_report.main(["--compare", a, b]) == 0
+
+    def test_bound_move_noted_not_gated(self, tmp_path, capsys):
+        a = _manifest(tmp_path, "a", bound="compute")
+        b = _manifest(tmp_path, "b", bound="overhead")
+        assert perf_report.main(["--compare", a, b]) == 0
+        assert "bound moved" in capsys.readouterr().out
+
+    def test_render_single_run(self, tmp_path, capsys):
+        a = _manifest(tmp_path, "a")
+        assert perf_report.main([a]) == 0
+        out = capsys.readouterr().out
+        assert "fused_step:cafef00d" in out and "compute" in out
+
+    def test_unreadable_and_schema_exit_2(self, tmp_path):
+        assert perf_report.main([str(tmp_path / "nope.json")]) == 2
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        assert perf_report.main([str(bad)]) == 2
+        a = _manifest(tmp_path, "a")
+        assert perf_report.main(["--compare", a]) == 2
+
+    def test_no_perf_blocks_exit_2(self, tmp_path):
+        a = _manifest(tmp_path, "a", perf=False)
+        b = _manifest(tmp_path, "b", perf=False)
+        assert perf_report.main(["--compare", a, b]) == 2
+
+    def test_single_sig_joins_across_retrace(self, tmp_path, capsys):
+        """One signature on each side joins regardless of tag — a code
+        change retraces under a new tag but is the same campaign."""
+        a = _manifest(tmp_path, "a")
+        b = os.path.join(str(tmp_path), "b.json")
+        with open(b, "w") as f:
+            json.dump({"schema": "mxtpu.goodput.run/1", "run_id": "b",
+                       "outcome": "completed",
+                       "perf": {"schema": "mxtpu.perf/1",
+                                "signatures": {"fused_step:deadbeef": {
+                                    "steps": 100, "median_s": 0.03,
+                                    "mfu": 0.1,
+                                    "bound": "overhead"}}}}, f)
+        assert perf_report.main(["--compare", a, b]) == 1
+        assert "->" in capsys.readouterr().out
+
+    def test_cli_subprocess_entry(self, tmp_path):
+        a = _manifest(tmp_path, "a")
+        b = _manifest(tmp_path, "b", median_s=0.02, mfu=0.2)
+        script = os.path.join(REPO, "tools", "perf_report.py")
+        r = subprocess.run([sys.executable, script, "--compare", a, b],
+                           capture_output=True, text=True)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "verdict: REGRESSION" in r.stdout

@@ -980,6 +980,111 @@ class MX020ShardingImportOutsideCompat:
         return out
 
 
+class MX021HardwareConstantDrift:
+    """``benchmark/comm_model.py`` ``ASSUMPTIONS`` is the ONE home for
+    the chip's modeled rates (peak TFLOPs by dtype, HBM/ICI/DCN
+    bandwidth). A modeled-math surface (bench.py, the report tools,
+    the _debug attribution plane, the fused step) that spells one of
+    those rates as a numeric literal forks the hardware model: a chip
+    retarget then changes the roofline in one place and not the other,
+    and the MFU ledger silently disagrees with the comm model it is
+    supposed to share assumptions with (ISSUE 17). Only literals used
+    as *math* (inside an arithmetic expression or as a lookup-table
+    value) fire — argparse defaults and thresholds that merely collide
+    with a rate value stay clean."""
+
+    code = "MX021"
+    summary = "hardware rate literal duplicates comm_model.ASSUMPTIONS"
+    kind = "python"
+
+    # the modeled-math surfaces: files whose arithmetic prices steps
+    # against the hardware model
+    _SCOPE = (
+        "bench.py",
+        "benchmark/",
+        "tools/",
+        "mxnet_tpu/_debug/",
+        "mxnet_tpu/profiler.py",
+        "mxnet_tpu/gluon/fused_step.py",
+    )
+    _EXEMPT = (
+        "benchmark/comm_model.py",  # the one home itself
+        "tools/mxlint/",
+    )
+
+    def scope(self, path):
+        return (path.endswith(".py")
+                and any(path == p or path.startswith(p)
+                        for p in self._SCOPE)
+                and not any(path == p or path.startswith(p)
+                            for p in self._EXEMPT))
+
+    # -- the rate table (one comm_model.py parse per run) --------------
+
+    _rates_cache = None  # (repo_root, frozenset[float])
+
+    def _rates(self):
+        from . import core
+        cached = self._rates_cache
+        if cached is not None and cached[0] == core.REPO_ROOT:
+            return cached[1]
+        rates = set()
+        path = os.path.join(core.REPO_ROOT, "benchmark",
+                            "comm_model.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            tree = None
+        if tree is not None:
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Assign)
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "ASSUMPTIONS"
+                                for t in node.targets)
+                        and isinstance(node.value, ast.Dict)):
+                    continue
+                for k, v in zip(node.value.keys, node.value.values):
+                    key = k.value if isinstance(k, ast.Constant) else ""
+                    if not ("tflops" in str(key) or "GBps" in str(key)):
+                        continue
+                    vals = v.values if isinstance(v, ast.Dict) else (v,)
+                    for vv in vals:
+                        if isinstance(vv, ast.Constant) \
+                                and isinstance(vv.value, float):
+                            rates.add(vv.value)
+        out = frozenset(rates)
+        self._rates_cache = (core.REPO_ROOT, out)
+        return out
+
+    def check(self, path, src, tree, parents):
+        rates = self._rates()
+        if not rates:
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, float)
+                    and node.value in rates):
+                continue
+            p = parents.get(node)
+            # math context: an arithmetic operand, or a value in a
+            # lookup-table dict (the per-chip peaks idiom). Call
+            # kwargs, argparse defaults, and comparisons stay clean.
+            in_math = isinstance(p, (ast.BinOp, ast.AugAssign))
+            in_table = (isinstance(p, ast.Dict)
+                        and any(v is node for v in p.values))
+            if in_math or in_table:
+                out.append(Finding(
+                    self.code, path, node.lineno,
+                    "hardware rate %g duplicates comm_model."
+                    "ASSUMPTIONS — resolve it from the table "
+                    "(peak_tflops(dtype) / ASSUMPTIONS[...]) so a "
+                    "chip retarget changes one file, not a fork of "
+                    "the roofline" % node.value))
+        return out
+
+
 from .dataflow import DATAFLOW_RULES  # noqa: E402 (needs Finding above)
 
 ALL_RULES = (
@@ -997,4 +1102,5 @@ ALL_RULES = (
     MX012PallasKernelContract(),
     MX013FaultpointInCatalog(),
     MX020ShardingImportOutsideCompat(),
+    MX021HardwareConstantDrift(),
 ) + DATAFLOW_RULES

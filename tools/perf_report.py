@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Render and compare the per-signature roofline/MFU ``perf`` block of
+run manifests (ISSUE 17).
+
+The blocks come from ``mxnet_tpu._debug.perfmodel`` — every goodput run
+manifest and every ``bench.py`` BENCH_MODEL manifest that executed a
+tagged fused step carries one under ``manifest["perf"]`` (schema
+``mxtpu.perf/1`` inside the ``mxtpu.goodput.run/1`` manifest). Like
+``goodput_report``, this tool is deliberately dependency-free (stdlib
+json only, no jax import): it must run on a laptop against manifests
+rsync'd off a fleet.
+
+Usage::
+
+    python tools/perf_report.py RUN            # human-readable roofline
+    python tools/perf_report.py --compare A B  # MFU regression verdict
+
+``RUN``/``A``/``B`` are manifest paths or run directories containing
+``manifest.json``. ``--compare`` treats A as the baseline and B as the
+candidate and exits non-zero when a signature's MFU regresses past
+threshold — the standing gate the ROADMAP item 4 campaign (fp8, remat)
+is measured against.
+
+The verdict is noise-robust by construction (the ``goodput_report``
+discipline): signatures are joined by their STABLE compile-signature
+tag (crc of the signature tuple, identical across processes for the
+same program); when each side has exactly one signature they are
+compared regardless of tag (a code change retraces under a new tag but
+is still the same campaign); and an MFU drop must clear BOTH a
+relative threshold and an absolute floor to fire — a 30% wobble on an
+MFU of 0.003 from a microbench can never page anyone. Thresholds:
+``--mfu-pct`` (default 10: relative MFU drop %), ``--min-mfu-abs``
+(0.02: absolute MFU points), ``--median-pct``/``--min-median-abs-us``
+(25 / 50: per-signature median step-time growth, the same pair
+goodput_report uses run-wide).
+
+Exit codes: 0 = no regression, 1 = regression past threshold,
+2 = bad usage / unreadable manifest / no perf block to compare.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# keep in sync with mxnet_tpu/_debug/goodput.py + perfmodel.py (not
+# imported: this tool must not drag the jax runtime in)
+SCHEMA = "mxtpu.goodput.run/1"
+PERF_SCHEMA = "mxtpu.perf/1"
+BOUNDS = ("compute", "memory", "comm", "overhead")
+
+
+def load_manifest(path):
+    if os.path.isdir(path):
+        path = os.path.join(path, "manifest.json")
+    with open(path, encoding="utf-8") as f:
+        m = json.load(f)
+    if m.get("schema") != SCHEMA:
+        raise ValueError("%s: schema %r is not %r (not a run manifest)"
+                         % (path, m.get("schema"), SCHEMA))
+    perf = m.get("perf")
+    if perf is not None and perf.get("schema") != PERF_SCHEMA:
+        raise ValueError("%s: perf block schema %r is not %r"
+                         % (path, perf.get("schema"), PERF_SCHEMA))
+    return m
+
+
+def _sigs(m):
+    return (m.get("perf") or {}).get("signatures") or {}
+
+
+def _fmt(v, spec="%.4f"):
+    return spec % v if isinstance(v, (int, float)) else "-"
+
+
+def render(m):
+    """One manifest -> a human-readable roofline report (lines)."""
+    lines = ["perf %s  [%s]" % (m["run_id"], m.get("outcome", "open"))]
+    perf = m.get("perf")
+    if not perf:
+        lines.append("  (no perf block: the run executed no tagged "
+                     "fused step)")
+        return lines
+    a = perf.get("assumptions") or {}
+    if a:
+        lines.append("  model: %s  hbm %s GB/s  peaks %s" % (
+            a.get("chip"), a.get("hbm_bw_GBps"),
+            " ".join("%s=%s" % (k, v) for k, v in sorted(
+                (a.get("peak_tflops") or {}).items()))))
+    lines.append("  %-26s %6s %10s %7s %7s %8s %-9s %s" % (
+        "signature", "steps", "med(us)", "MFU", "membw", "AI",
+        "bound", "comp/mem/comm/ovh(us)"))
+    sigs = _sigs(m)
+    for sig in sorted(sigs, key=lambda s: -sigs[s].get("steps", 0)):
+        r = sigs[sig]
+        t = r.get("terms_s") or {}
+        med = r.get("median_s")
+        lines.append("  %-26s %6s %10s %7s %7s %8s %-9s %s" % (
+            sig[:26], r.get("steps", 0),
+            _fmt(med * 1e6 if med else None, "%.1f"),
+            _fmt(r.get("mfu")), _fmt(r.get("membw_util")),
+            _fmt(r.get("intensity"), "%.1f"), r.get("bound") or "-",
+            "/".join(_fmt(t.get(b, 0.0) * 1e6, "%.1f")
+                     for b in BOUNDS) if t else "-"))
+        if r.get("collapses"):
+            lines.append("  %-26s efficiency collapses: %d"
+                         % ("", r["collapses"]))
+    return lines
+
+
+def _pairs(a, b):
+    """(tag, baseline_row, candidate_row) join. Matched tags join by
+    tag; when each side has exactly ONE signature, they join regardless
+    (a retrace renames the tag, the campaign is the same program)."""
+    sa, sb = _sigs(a), _sigs(b)
+    common = sorted(set(sa) & set(sb))
+    if common:
+        return [(s, sa[s], sb[s]) for s in common]
+    if len(sa) == 1 and len(sb) == 1:
+        ta, tb = next(iter(sa)), next(iter(sb))
+        return [("%s -> %s" % (ta, tb), sa[ta], sb[tb])]
+    return []
+
+
+def compare(a, b, mfu_pct=10.0, min_mfu_abs=0.02, median_pct=25.0,
+            min_median_abs_us=50.0):
+    """MFU regression verdict for candidate ``b`` against baseline
+    ``a``. Returns (lines, regressed: bool, compared: int)."""
+    lines = ["baseline  %s  [%s]" % (a["run_id"],
+                                     a.get("outcome", "?")),
+             "candidate %s  [%s]" % (b["run_id"],
+                                     b.get("outcome", "?"))]
+    regressed = False
+    pairs = _pairs(a, b)
+    for tag, ra, rb in pairs:
+        ma, mb = ra.get("mfu"), rb.get("mfu")
+        if isinstance(ma, (int, float)) and ma > 0 and \
+                isinstance(mb, (int, float)):
+            drop = ma - mb
+            rel = 100.0 * drop / ma
+            bad = rel > mfu_pct and drop > min_mfu_abs
+            regressed |= bad
+            lines.append(
+                "%-11s %s MFU: %.4f -> %.4f (%+.1f%%; threshold "
+                "-%.0f%% and -%.3f abs)" % (
+                    "REGRESSION" if bad else "ok", tag, ma, mb, -rel,
+                    mfu_pct, min_mfu_abs))
+        else:
+            lines.append("skip        %s MFU: missing" % tag)
+        pa, pb = ra.get("median_s"), rb.get("median_s")
+        if isinstance(pa, (int, float)) and pa > 0 and \
+                isinstance(pb, (int, float)):
+            rel = 100.0 * (pb - pa) / pa
+            bad = rel > median_pct and \
+                (pb - pa) * 1e6 > min_median_abs_us
+            regressed |= bad
+            lines.append(
+                "%-11s %s median step: %.6fs -> %.6fs (%+.1f%%; "
+                "threshold +%.0f%% and +%.0fus)" % (
+                    "REGRESSION" if bad else "ok", tag, pa, pb, rel,
+                    median_pct, min_median_abs_us))
+        ba, bb = ra.get("bound"), rb.get("bound")
+        if ba and bb and ba != bb:
+            lines.append("note        %s roofline bound moved: "
+                         "%s -> %s" % (tag, ba, bb))
+    if not pairs:
+        lines.append("skip        no comparable signatures "
+                     "(baseline %d, candidate %d, none shared)"
+                     % (len(_sigs(a)), len(_sigs(b))))
+    lines.append("verdict: %s" % ("REGRESSION" if regressed else
+                                  "no regression"))
+    return lines, regressed, len(pairs)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="perf_report",
+        description="Render / compare per-signature roofline+MFU "
+                    "blocks of run manifests.")
+    ap.add_argument("runs", nargs="+",
+                    help="manifest path(s) or run director(ies)")
+    ap.add_argument("--compare", action="store_true",
+                    help="compare two runs: baseline candidate")
+    ap.add_argument("--mfu-pct", type=float, default=10.0)
+    ap.add_argument("--min-mfu-abs", type=float, default=0.02)
+    ap.add_argument("--median-pct", type=float, default=25.0)
+    ap.add_argument("--min-median-abs-us", type=float, default=50.0)
+    args = ap.parse_args(argv)
+    try:
+        manifests = [load_manifest(p) for p in args.runs]
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("perf_report: %s" % e, file=sys.stderr)
+        return 2
+    if args.compare:
+        if len(manifests) != 2:
+            print("perf_report: --compare takes exactly two runs "
+                  "(baseline candidate)", file=sys.stderr)
+            return 2
+        if not _sigs(manifests[0]) and not _sigs(manifests[1]):
+            print("perf_report: neither manifest carries a perf "
+                  "block — nothing to compare", file=sys.stderr)
+            return 2
+        lines, regressed, _ = compare(
+            manifests[0], manifests[1], mfu_pct=args.mfu_pct,
+            min_mfu_abs=args.min_mfu_abs, median_pct=args.median_pct,
+            min_median_abs_us=args.min_median_abs_us)
+        print("\n".join(lines))
+        return 1 if regressed else 0
+    for m in manifests:
+        print("\n".join(render(m)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

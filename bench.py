@@ -2095,10 +2095,10 @@ def bench_comm_overlap():
         with mesh.mesh:
             state = init_fn(jr.PRNGKey(0))
             toks = jnp.zeros((16, 64), jnp.int32)
-            txt = step_fn.lower(state, toks, toks).compile().as_text()
-        by_kind, _, _ = CM.hlo_collective_bytes(txt)
+            compiled = step_fn.lower(state, toks, toks).compile()
+        inv = CM.collect_hlo_inventory(compiled)
         ar_bytes["local_accum" if local else "baseline"] = \
-            by_kind.get("all-reduce", 0)
+            inv["bytes_by_kind"].get("all-reduce", 0)
     saved = ar_bytes["baseline"] - ar_bytes["local_accum"]
     expect_saved = (chunks - 1) * V * D * 4
     # gate the ANALYTIC drop, not merely "some" drop: a partial
@@ -2470,16 +2470,17 @@ def bench_gspmd_step():
             y = mx.nd.array(data.rand(8, 4).astype(onp.float32))
             step(x, y, batch_size=8)
         _, hlo = step.last_program()
-        by_kind, _, unresolved = CM.hlo_collective_bytes(hlo or "")
+        inv = CM.collect_hlo_inventory(hlo or "")
         n_params = sum(int(onp.prod(p.shape))
                        for _, p in net.collect_params().items())
         return {
             "mode": step.last_mode,
             "gspmd": step._gspmd_mode(),
             "matched_step_shardings": step.matched_step_shardings(),
-            "all_reduce_bytes": by_kind.get("all-reduce", 0),
+            "all_reduce_bytes": inv["bytes_by_kind"].get(
+                "all-reduce", 0),
             "analytic_bytes": 4 * n_params,
-            "unresolved_loops": unresolved,
+            "unresolved_loops": inv["unresolved_loops"],
         }
 
     configs = {
@@ -2513,9 +2514,9 @@ def bench_gspmd_step():
         with mesh.mesh:
             state = init_fn(jr.PRNGKey(0))
             toks = jnp.zeros((16, 64), jnp.int32)
-            txt = step_fn.lower(state, toks, toks).compile().as_text()
-        by_kind, _, _ = CM.hlo_collective_bytes(txt)
-        ar_by_chunks[chunks] = by_kind.get("all-reduce", 0)
+            compiled = step_fn.lower(state, toks, toks).compile()
+        inv = CM.collect_hlo_inventory(compiled)
+        ar_by_chunks[chunks] = inv["bytes_by_kind"].get("all-reduce", 0)
     chunks_invariant = ar_by_chunks[2] == ar_by_chunks[4]
 
     return {
@@ -2525,6 +2526,40 @@ def bench_gspmd_step():
         "ce_ar_bytes_chunks4": ar_by_chunks[4],
         "ce_chunk_invariant": chunks_invariant,
         "gate": bool(wire_ok and chunks_invariant),
+    }
+
+
+def bench_hlolint():
+    """BENCH_MODEL=hlolint: the ISSUE 18 compiled-program contract gate.
+
+    Captures the standing three-mesh fused-step programs (dp8 manual,
+    dp4×tp2, dp2×tp2×sp2 — the bench_gspmd_step configs, first one
+    lowered twice so H005 checks a real re-lowering group) and runs
+    every HLO contract rule (H001 donation-took, H002 collective
+    inventory vs the analytic plan, H003 replicated outputs, H004 dtype
+    discipline, H005 collective-order determinism). Gate: ZERO findings
+    with an EMPTY baseline, and analysis stays under 5 s per signature
+    — the contracts hold on real programs, cheaply enough to run on
+    every compile.
+    """
+    from tools.hlolint import capture as HC, core as HL
+
+    artifacts = HC.dryrun_programs(repeat_first=True)
+    baseline = HL.load_baseline()
+    findings, n_baselined, per_sig = HL.run(artifacts, baseline=baseline)
+    rep = HL.report(artifacts, findings, n_baselined, per_sig)
+    gate = bool(artifacts) and not findings and not baseline \
+        and rep["max_sig_seconds"] < 5.0
+    return {
+        "metric": "hlolint",
+        "n_programs": len(artifacts),
+        "n_signatures": len(per_sig),
+        "programs": rep["programs"],
+        "findings": rep["findings"],
+        "baseline_entries": len(baseline),
+        "max_sig_seconds": rep["max_sig_seconds"],
+        "per_sig_seconds": rep["per_sig_seconds"],
+        "gate": gate,
     }
 
 
@@ -2593,6 +2628,8 @@ if __name__ == "__main__":
         result = bench_input_pipeline_gate()
     elif which == "gspmd_step":
         result = bench_gspmd_step()
+    elif which == "hlolint":
+        result = bench_hlolint()
     elif which == "perf_attrib":
         result = bench_perf_attrib()
     else:

@@ -191,6 +191,25 @@ def hlo_collective_bytes(hlo_text):
     return out, counts, unresolved[0]
 
 
+def collect_hlo_inventory(program):
+    """The one choke point for compiled-program collective inventory:
+    accepts a compiled executable (anything with ``as_text()``) or raw
+    HLO text and returns the per-kind payload decomposition every
+    consumer reads the same way — bench gates, the fused-step compile
+    attribution, and hlolint H002 (which diffs it against the analytic
+    plan). Returns ``{"bytes_by_kind", "counts_by_kind",
+    "unresolved_loops", "total_bytes"}``."""
+    txt = program if isinstance(program, str) \
+        else program.as_text()
+    by_kind, counts, unresolved = hlo_collective_bytes(txt or "")
+    return {
+        "bytes_by_kind": by_kind,
+        "counts_by_kind": counts,
+        "unresolved_loops": unresolved,
+        "total_bytes": sum(by_kind.values()),
+    }
+
+
 def measure_config(name, mesh_axes, cfg_kwargs, B, S):
     """Compile one sharded train step on the virtual mesh; return the
     collective decomposition + cost-analysis FLOPs."""
@@ -207,8 +226,10 @@ def measure_config(name, mesh_axes, cfg_kwargs, B, S):
         state = init_fn(jr.PRNGKey(0))
         toks = jnp.zeros((B, S), jnp.int32)
         compiled = step_fn.lower(state, toks, toks).compile()
-    txt = compiled.as_text()
-    by_kind, counts, unresolved = hlo_collective_bytes(txt)
+    inv = collect_hlo_inventory(compiled)
+    by_kind, counts, unresolved = (inv["bytes_by_kind"],
+                                   inv["counts_by_kind"],
+                                   inv["unresolved_loops"])
     cost = compiled.cost_analysis()
     cost = cost[0] if isinstance(cost, (list, tuple)) else cost
     n_params = sum(int(jnp.size(p))

@@ -247,6 +247,7 @@ class FusedTrainStep:
         self._warned_mesh_indivisible = False
         self._last_compiled = None  # most recent AOT executable (mesh)
         self._last_hlo = None       # ... and its optimized HLO text
+        self._build_info = None     # contract facts of the last _build
         if mesh is not None:
             raw = getattr(mesh, "mesh", mesh)
             self._sizes = {a: int(s) for a, s in dict(raw.shape).items()}
@@ -506,7 +507,7 @@ class FusedTrainStep:
                 entry = (compiled,) + tuple(entry[1:])
                 self._aot = None
             else:
-                cost = hlo = mem = None
+                compiled = cost = hlo = mem = None
             compile_us = (_time.perf_counter() - c0) * 1e6
         except _healthmon.HealthHaltError:
             # a poisoned compile step under MXTPU_HEALTH_ACTION=halt is
@@ -531,7 +532,8 @@ class FusedTrainStep:
         # eagerly (double update) nor blacklist a signature that compiled
         try:
             self._record_compile(key, compile_us, cost, hlo, mem,
-                                 all_params, train_pos)
+                                 all_params, train_pos, states=states,
+                                 compiled=compiled)
         except Exception:
             self._attr_models.pop(key, None)
             _STATS["attr_errors"] += 1
@@ -875,6 +877,19 @@ class FusedTrainStep:
         else:
             jfn = jax.jit(body, donate_argnums=donate) if donate \
                 else jax.jit(body)
+        # contract facts the program-artifact capture (_record_compile →
+        # profiler.record_program, the hlolint feed) needs but the entry
+        # tuple doesn't carry: which operands were donated, whether this
+        # is the GSPMD/manual-dp program, and which top-level output
+        # slots were pinned replicated (loss=0, aux=4, health=5).
+        self._build_info = {
+            "donate": donate,
+            "gspmd": bool(gspmd),
+            "manual_dp": bool(manual_dp),
+            "replicated_slots":
+                ((0, 4, 5) if hmeta is not None else (0, 4))
+                if gspmd else (),
+        }
         return jfn, aux_params, fixed_pos, hmeta, in_shs
 
     def _input_shardings(self, all_params, train_pos, fixed_pos, nd_args,
@@ -997,7 +1012,7 @@ class FusedTrainStep:
             host.shape, sh, lambda idx: host[idx])
 
     def _record_compile(self, key, dur_us, cost, hlo, mem, all_params,
-                        train_pos):
+                        train_pos, states=None, compiled=None):
         """Feed the compile-attribution registry (ISSUE 8c): measured
         trace+compile+first-run wall time, the program's cost-analysis
         flops/bytes, its collective payload, and the comm_model's
@@ -1015,8 +1030,8 @@ class FusedTrainStep:
         if cm is not None:
             if hlo is not None:
                 try:
-                    comm_bytes = sum(
-                        cm.hlo_collective_bytes(hlo)[0].values()) or None
+                    comm_bytes = cm.collect_hlo_inventory(
+                        hlo)["total_bytes"] or None
                 except Exception:
                     comm_bytes = None
             if comm_bytes is None and self._dp > 1:
@@ -1082,6 +1097,65 @@ class FusedTrainStep:
             modeled_comm_us=comm_us, memory=mem,
             args={"params": len(train_pos), "dp": self._dp,
                   "dtype": dtype, "peak_tflops": peak})
+        if hlo is not None:
+            # artifact capture (ISSUE 18): hand the HLO plus the
+            # contract facts hlolint's H-rules check to the profiler's
+            # program store. Everything is extracted EAGERLY into plain
+            # Python so no record ever pins the executable.
+            try:
+                self._capture_program(keyhash, hlo, all_params,
+                                      train_pos, states, compiled)
+            except Exception:
+                _STATS["attr_errors"] += 1
+
+    def _capture_program(self, keyhash, hlo, all_params, train_pos,
+                         states, compiled):
+        """Build the hlolint program-meta dict for one compiled step and
+        feed ``profiler.record_program``. The meta keys are the contract
+        (tools/hlolint/capture.py documents them): ``donated`` — flat
+        entry-parameter numbers that must appear in the input-output
+        alias map (H001); ``plan`` — analytic per-kind collective bytes
+        (H002, the same 4-bytes-per-trainable-param model the
+        BENCH_MODEL=gspmd_step gate validated at <1%% wire error);
+        ``replicated_slots``/``out_specs`` — top-level output slots
+        pinned ``P()`` and the specs the executable actually carries
+        (H003); ``dtype`` — the dominant param dtype keying the bf16
+        upcast rule (H004)."""
+        info = self._build_info or {}
+        donated = ()
+        if info.get("donate"):
+            # donate_argnums=(0, 1) donates the train_datas and
+            # state_datas tuples; their leaves are the leading entry
+            # parameters of the flattened program, in order
+            n_donated = len(train_pos)
+            if states is not None:
+                n_donated += len(jax.tree_util.tree_leaves(
+                    [_state_to_data(s) for s in states]))
+            donated = tuple(range(n_donated))
+        plan = {"all-reduce": 0, "all-gather": 0, "reduce-scatter": 0,
+                "collective-permute": 0, "all-to-all": 0}
+        if self._mesh is not None and self._mesh_n > 1:
+            plan["all-reduce"] = 4 * sum(
+                int(all_params[pos].data().size) for pos in train_pos)
+        out_specs = None
+        if compiled is not None:
+            try:
+                out_specs = [
+                    [tuple(getattr(sh, "spec", None) or ())
+                     for sh in jax.tree_util.tree_leaves(slot)]
+                    for slot in compiled.output_shardings]
+            except Exception:
+                out_specs = None
+        _profiler.record_program(
+            "fused_step", "fused_step:%s" % keyhash, hlo,
+            meta={"donated": donated,
+                  "plan": plan,
+                  "replicated_slots":
+                      tuple(info.get("replicated_slots", ())),
+                  "out_specs": out_specs,
+                  "dtype": self._dominant_dtype(all_params, train_pos),
+                  "mesh": dict(self._sizes),
+                  "gspmd": bool(info.get("gspmd"))})
 
     @staticmethod
     def _dominant_dtype(all_params, train_pos):

@@ -28,11 +28,13 @@ from __future__ import annotations
 import logging
 import math
 import pickle
+import time as _time
 
 import jax
 import jax.numpy as jnp
 import numpy as _np
 
+from .. import profiler as _profiler
 from ..base import canonical_dtype
 from ..base import getenv as _getenv
 from ..ndarray import NDArray
@@ -289,8 +291,24 @@ class Optimizer:
         f = self._jit_cache.get(key)
         if f is None:
             # mxlint: disable=MX005 (per-optimizer keyed cache right here: _jitted IS this subsystem's bounded cache, keyed by update-rule signature)
-            f = jax.jit(fn)
-            self._jit_cache[key] = f
+            jf = jax.jit(fn)
+
+            # one-shot first-call probe (the register._compile_probe
+            # convention): trace + compile + first run lands in the
+            # compile-attribution registry, then the probe unwraps
+            # itself so steady-state hits pay nothing
+            def probe(*args):
+                t0 = _time.perf_counter()
+                out = jf(*args)
+                if self._jit_cache.get(key) is probe:
+                    self._jit_cache[key] = jf
+                _profiler.record_compile(
+                    "optimizer:%s" % type(self).__name__,
+                    key=repr(key)[:80],
+                    dur_us=(_time.perf_counter() - t0) * 1e6)
+                return out
+            self._jit_cache[key] = probe
+            f = probe
         return f
 
     def __getstate__(self):

@@ -478,7 +478,7 @@ def _autotune_blocks(q, k, v, causal, scale):
             # of what gets timed (grad on q alone would let XLA DCE it)
             grad = jax.grad(loss, argnums=(0, 1, 2))
 
-            @jax.jit  # mxlint: disable=MX005 (tuning micro-bench: compiled once per candidate block size inside the memoized autotune pass)
+            @jax.jit  # mxlint: disable=MX005,MX022 (tuning micro-bench: compiled once per candidate block size inside the memoized autotune pass, timed by the autotuner itself)
             def many(q_, k_, v_):
                 # chained fori so the device actually serializes the
                 # iterations (async dispatch would lie to the timer)

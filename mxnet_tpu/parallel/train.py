@@ -14,11 +14,14 @@ are tracers — the functional-core/imperative-shell trick.
 """
 from __future__ import annotations
 
+import time as _time
+
 import jax
 import jax.numpy as jnp
 
 from ..ndarray import NDArray
 from .. import autograd
+from .. import profiler as _profiler
 from ..gluon.block import Block
 from .. import random as _random
 from .sharding import ShardingStrategy, data_parallel
@@ -230,12 +233,33 @@ class ShardedTrainStep:
             is_leaf=lambda l: hasattr(l, "shape"))
         with raw_mesh:
             # mxlint: disable=MX005 (one sharded train step per ShardedTrainStep instance; shapes fixed by the strategy, single key)
-            self._jitted = jax.jit(
+            jf = jax.jit(
                 train_step,
                 in_shardings=(param_sh, state_sh, self._batch_sharding,
                               self._batch_sharding, None),
                 out_shardings=(param_sh, state_sh, None),
                 donate_argnums=(0, 1) if self._donate else ())
+        self._jitted = self._compile_probe(jf, "gspmd")
+
+    def _compile_probe(self, jf, mode):
+        """One-shot first-call wrapper (the register._compile_probe
+        convention): the first ``step()`` of a fresh program pays trace
+        + XLA compile + first run — record that wall time in the
+        compile-attribution registry, then unwrap so steady-state calls
+        pay nothing. ``lower`` is forwarded for the AOT inspection
+        seam, which bypasses the probe entirely."""
+        def probe(*args):
+            t0 = _time.perf_counter()
+            out = jf(*args)
+            if self._jitted is probe:
+                self._jitted = jf
+            _profiler.record_compile(
+                "sharded_step:%s" % mode,
+                key="%d params" % len(self._param_paths),
+                dur_us=(_time.perf_counter() - t0) * 1e6)
+            return out
+        probe.lower = jf.lower
+        return probe
 
     def _build_overlapped(self):
         """The overlap_grads=True program: same math as ``_build``, but
@@ -306,12 +330,13 @@ class ShardedTrainStep:
             is_leaf=lambda l: hasattr(l, "shape"))
         with raw_mesh:
             # mxlint: disable=MX005 (one overlapped train step per ShardedTrainStep instance; shapes fixed by the strategy, single key)
-            self._jitted = jax.jit(
+            jf = jax.jit(
                 body,
                 in_shardings=(param_sh, state_sh, self._batch_sharding,
                               self._batch_sharding, None),
                 out_shardings=(param_sh, state_sh, None),
                 donate_argnums=(0, 1) if self._donate else ())
+        self._jitted = self._compile_probe(jf, "overlap")
 
     def _shardings_for_state(self, a):
         # states were placed at construction; reuse their current sharding

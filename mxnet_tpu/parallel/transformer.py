@@ -571,7 +571,7 @@ def make_train_step(cfg: TransformerConfig, mesh, learning_rate=1e-3):
         batch_sh = NamedSharding(raw_mesh, P("dp", "sp"))
 
         @functools.partial(
-            jax.jit,
+            jax.jit,  # mxlint: disable=MX022 (benchmark/verification harness: callers AOT-compile the step and account inventories explicitly via comm_model)
             in_shardings=((param_sh, param_sh), batch_sh, batch_sh),
             out_shardings=((param_sh, param_sh), None),
             donate_argnums=(0,))
@@ -621,7 +621,7 @@ def make_train_step(cfg: TransformerConfig, mesh, learning_rate=1e-3):
             in_specs=(specs, specs, data_spec, data_spec),
             out_specs=(specs, specs, P()), check_vma=False)
 
-        @jax.jit  # mxlint: disable=MX005 (one pp-mode train step per make_train_step call; config and mesh are frozen into the closure, single key)
+        @jax.jit  # mxlint: disable=MX005,MX022 (one pp-mode train step per make_train_step call, AOT-compiled and inventoried by the bench harness; config and mesh are frozen into the closure, single key)
         def step_fn(state, tokens, targets):
             params, mom = state
             new_params, new_mom, loss = smapped(params, mom, tokens, targets)

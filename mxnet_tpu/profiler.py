@@ -64,6 +64,7 @@ __all__ = [
     "merge_traces", "PID",
     "marker", "bump_elastic", "elastic_stats", "reset_elastic_stats",
     "record_compile", "compile_stats", "ensure_lane",
+    "record_program", "program_records",
 ]
 
 # chrome-trace pid of every event this process emits: the worker rank.
@@ -662,6 +663,49 @@ def compile_stats():
     """Snapshot of the compile registry — ``metrics()['compile']``."""
     with _lock:
         return {n: dict(st) for n, st in _compiles.items()}
+
+
+# -- compiled-program artifact capture (ISSUE 18, the hlolint feed) ----------
+# The compile registry above keeps per-signature NUMBERS; hlolint needs
+# the per-signature ARTIFACTS (HLO text + the contract metadata the
+# builder knew at compile time: donated parameter numbers, replicated
+# output slots, out-sharding specs, the analytic collective plan).
+# Bounded ring of plain dicts — picklable, no executable references, so
+# holding a record never pins device buffers. Re-lowerings of the same
+# signature append (H005 compares collective order across them) rather
+# than overwrite. Survives metrics(reset=True) like clock sync state:
+# artifacts are analysis inputs, not accumulated telemetry.
+_programs = []  # [{name, sig, hlo, meta, seq}, ...] oldest first
+_PROGRAM_CAP = 32
+_program_seq = 0  # monotonic capture counter — NEVER reset by the cap
+
+
+def record_program(name, sig, hlo, meta=None):
+    """Capture one compiled program for static analysis: ``name`` the
+    compiling subsystem (``fused_step``), ``sig`` its signature tag
+    (the ``fused_step:%08x`` roofline join key), ``hlo`` the
+    ``compiled.as_text()`` dump, ``meta`` the contract dict hlolint
+    rules check against (see tools/hlolint/capture.py for the keys).
+    Each record carries a process-monotonic ``seq`` so consumers can
+    select "captured after X" robustly — list indexes shift whenever
+    the cap trims the front."""
+    global _program_seq
+    if not hlo:
+        return
+    rec = {"name": str(name), "sig": str(sig), "hlo": str(hlo),
+           "meta": dict(meta) if meta else {}}
+    with _lock:
+        _program_seq += 1
+        rec["seq"] = _program_seq
+        _programs.append(rec)
+        del _programs[:-_PROGRAM_CAP]
+
+
+def program_records(name=None):
+    """Captured program artifacts, oldest first — the hlolint feed."""
+    with _lock:
+        return [dict(r) for r in _programs
+                if name is None or r["name"] == name]
 
 
 def marker(name, args=None, lane="user", category="instant"):
@@ -1575,10 +1619,16 @@ def _reset():
         _clock_sync.clear()
         _elastic.clear()
         _compiles.clear()
+        del _programs[:]
     reset_imperative_stats()
     try:
         from . import storage as _storage_mod
         _storage_mod.ledger_reset()
+    except Exception:
+        pass
+    try:
+        from ._debug import perfmodel as _perfmodel_mod
+        _perfmodel_mod.reset()
     except Exception:
         pass
 

@@ -1,0 +1,303 @@
+"""hlolint — static contract verification of compiled programs
+(ISSUE 18 tentpole; tools/hlolint, docs/LINTING.md "HLO contracts").
+
+Two halves, the test_lint.py shape applied to the HLO plane:
+
+* unit coverage: every rule H001-H005 must flag its seeded violation
+  on a synthetic artifact AND stay silent on the matching clean
+  fixture, so a rule regression can't silently turn the gate into a
+  no-op, and
+* the tier-1 gate: real fused-step programs captured from the standing
+  three-mesh dryrun (dp8, dp4xtp2, dp2xtp2xsp2) analyze CLEAN — zero
+  findings, zero baseline entries — with the first signature lowered
+  twice so H005 checks a genuine re-lowering group.
+"""
+import pytest
+
+import jax
+
+from tools import hlolint
+from tools.hlolint import capture, core, rules
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+def _art(hlo, sig="fused_step:deadbeef", name="fused_step", **meta):
+    return capture.make_artifact(name, sig, hlo, meta)
+
+
+def _run(arts, codes=None):
+    sel = None
+    if codes:
+        sel = [r for r in rules.ALL_RULES if r.code in codes]
+    findings, _, _ = hlolint.run(arts, rules=sel, baseline=[])
+    return findings
+
+
+# -- H001 donation-took ------------------------------------------------------
+
+_H001_HLO = """\
+HloModule m, input_output_alias={ {0}: (0, {}, may-alias) }, \
+entry_computation_layout={(f32[8]{0}, f32[8]{0})->(f32[8]{0})}
+
+ENTRY %main (a: f32[8], b: f32[8]) -> (f32[8]) {
+  %a = f32[8]{0} parameter(0)
+  %b = f32[8]{0} parameter(1)
+  ROOT %t = (f32[8]{0}) tuple(%a)
+}
+"""
+
+
+def test_h001_flags_dropped_donation():
+    """Param 1 was donated but XLA kept only param 0 in the alias map —
+    the silently-copied buffer must be reported."""
+    fs = _run([_art(_H001_HLO, donated=(0, 1))], {"H001"})
+    assert [f.code for f in fs] == ["H001"]
+    assert "argument 1" in fs[0].message
+
+
+def test_h001_clean_when_all_donations_took():
+    assert _run([_art(_H001_HLO, donated=(0,))], {"H001"}) == []
+
+
+def test_h001_vacuous_without_donations():
+    # empty donation (the CPU-backend fused step) never fires
+    assert _run([_art("HloModule m", donated=())], {"H001"}) == []
+
+
+# -- H002 collective inventory -----------------------------------------------
+
+def _h002_hlo(extra=""):
+    return ("""\
+HloModule m, is_scheduled=true
+
+ENTRY %main (g: f32[250000]) -> f32[250000] {
+  %g = f32[250000]{0} parameter(0)
+  %ar = f32[250000]{0} all-reduce(%g), channel_id=1, to_apply=%add
+""" + extra + """\
+  ROOT %r = f32[250000]{0} copy(%ar)
+}
+""")
+
+
+def test_h002_clean_when_wire_matches_plan():
+    fs = _run([_art(_h002_hlo(), plan={"all-reduce": 1000000})], {"H002"})
+    assert fs == []
+
+
+def test_h002_flags_missing_reduction():
+    """Plan promises a 2 MB gradient all-reduce, the wire carries half —
+    a planned reduction missing from the program."""
+    fs = _run([_art(_h002_hlo(), plan={"all-reduce": 2000000})], {"H002"})
+    assert [f.code for f in fs] == ["H002"]
+    assert "missing from the wire" in fs[0].message
+
+
+def test_h002_flags_phantom_reshard():
+    """An all-gather the analytic plan never asked for (above the
+    bookkeeping floor) is phantom resharding traffic."""
+    extra = ("  %ag = f32[4096]{0} all-gather(%g), channel_id=2, "
+             "dimensions={0}\n")
+    fs = _run([_art(_h002_hlo(extra), plan={"all-reduce": 1000000})],
+              {"H002"})
+    assert [f.code for f in fs] == ["H002"]
+    assert "all-gather" in fs[0].message
+    assert "phantom" in fs[0].message
+
+
+def test_h002_tolerates_bookkeeping_floor():
+    # a sub-floor unplanned collective (loss gather, health sentinel)
+    # stays beneath the 4096 B absolute floor
+    extra = ("  %ag = f32[16]{0} all-gather(%g), channel_id=2, "
+             "dimensions={0}\n")
+    fs = _run([_art(_h002_hlo(extra), plan={"all-reduce": 1000000})],
+              {"H002"})
+    assert fs == []
+
+
+def test_h002_vacuous_without_plan():
+    assert _run([_art(_h002_hlo())], {"H002"}) == []
+
+
+# -- H003 replicated outputs -------------------------------------------------
+
+def test_h003_flags_sharded_loss():
+    fs = _run([_art("HloModule m", replicated_slots=(0,),
+                    out_specs=[[("dp", None)]])], {"H003"})
+    assert [f.code for f in fs] == ["H003"]
+    assert "slot 0" in fs[0].message and "gather" in fs[0].message
+
+
+def test_h003_clean_on_replicated_and_ignores_other_slots():
+    # slot 0 replicated (empty/None specs); slot 1 sharded but NOT in
+    # the contract — only declared slots are checked
+    fs = _run([_art("HloModule m", replicated_slots=(0,),
+                    out_specs=[[(), (None, None)], [("dp",)]])],
+              {"H003"})
+    assert fs == []
+
+
+def test_h003_flags_unverifiable_and_missing_slot():
+    no_specs = _run([_art("HloModule m", replicated_slots=(0,))],
+                    {"H003"})
+    assert [f.code for f in no_specs] == ["H003"]
+    assert "not verifiable" in no_specs[0].message
+    short = _run([_art("HloModule m", replicated_slots=(0, 4),
+                       out_specs=[[()]])], {"H003"})
+    assert [f.code for f in short] == ["H003"]
+    assert "slot 4" in short[0].message
+
+
+# -- H004 dtype discipline ---------------------------------------------------
+
+_H004_UPCAST = """\
+HloModule m
+
+ENTRY %main (p: bf16[8,16], w: f32[16,4]) -> f32[8,4] {
+  %p = bf16[8,16]{1,0} parameter(0)
+  %w = f32[16,4]{1,0} parameter(1)
+  %cvt = f32[8,16]{1,0} convert(%p)
+  ROOT %d = f32[8,4]{1,0} dot(%cvt, %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+_H004_CLEAN = """\
+HloModule m
+
+ENTRY %main (p: bf16[8,16], w: bf16[16,4]) -> bf16[8,4] {
+  %p = bf16[8,16]{1,0} parameter(0)
+  %w = bf16[16,4]{1,0} parameter(1)
+  ROOT %d = bf16[8,4]{1,0} dot(%p, %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+
+def test_h004_flags_f32_upcast_feeding_dot():
+    fs = _run([_art(_H004_UPCAST, dtype="bf16")], {"H004"})
+    assert [f.code for f in fs] == ["H004"]
+    assert "convert" in fs[0].message and "bf16" in fs[0].message
+
+
+def test_h004_clean_on_native_bf16_dot():
+    assert _run([_art(_H004_CLEAN, dtype="bf16")], {"H004"}) == []
+
+
+def test_h004_vacuous_on_f32_program():
+    # the same upcast pattern on a declared-f32 path is just mixed
+    # precision working as configured
+    assert _run([_art(_H004_UPCAST, dtype="f32")], {"H004"}) == []
+
+
+# -- H005 collective-order determinism ---------------------------------------
+
+def _h005_hlo(order):
+    body = {"ar": "  %ar = f32[64]{0} all-reduce(%g), channel_id=1, "
+                  "to_apply=%add\n",
+            "ag": "  %ag = f32[128]{0} all-gather(%g), channel_id=2, "
+                  "dimensions={0}\n"}
+    return ("HloModule m\n\nENTRY %main (g: f32[64]) -> f32[64] {\n"
+            "  %g = f32[64]{0} parameter(0)\n"
+            + "".join(body[k] for k in order)
+            + "  ROOT %r = f32[64]{0} copy(%g)\n}\n")
+
+
+def test_h005_flags_permuted_collective_order():
+    a = _art(_h005_hlo(("ar", "ag")), sig="fused_step:cafe0001")
+    b = _art(_h005_hlo(("ag", "ar")), sig="fused_step:cafe0001")
+    fs = _run([a, b], {"H005"})
+    assert [f.code for f in fs] == ["H005"]
+    assert "cluster hang" in fs[0].message
+
+
+def test_h005_clean_on_identical_relowering():
+    a = _art(_h005_hlo(("ar", "ag")), sig="fused_step:cafe0002")
+    b = _art(_h005_hlo(("ar", "ag")), sig="fused_step:cafe0002")
+    assert _run([a, b], {"H005"}) == []
+
+
+def test_h005_needs_a_group():
+    # different sigs are different programs — no cross-sig comparison
+    a = _art(_h005_hlo(("ar", "ag")), sig="fused_step:cafe0003")
+    b = _art(_h005_hlo(("ag", "ar")), sig="fused_step:cafe0004")
+    assert _run([a, b], {"H005"}) == []
+
+
+# -- driver machinery --------------------------------------------------------
+
+def test_baseline_suppresses_known_finding():
+    art = _art(_H001_HLO, donated=(0, 1), sig="fused_step:feed0001")
+    kept, n_base, _ = core.run(
+        [art], baseline=[{"code": "H001", "path": "fused_step:feed0001",
+                          "line": 1}])
+    assert kept == [] and n_base == 1
+
+
+def test_checked_in_baseline_is_empty():
+    """The committed baseline must stay empty: a new HLO-contract
+    violation is fixed, never silently baselined."""
+    assert core.load_baseline() == []
+
+
+def test_report_shape():
+    art = _art(_H004_UPCAST, dtype="bf16")
+    findings, n_base, per_sig = core.run([art], baseline=[])
+    rep = core.report([art], findings, n_base, per_sig)
+    assert rep["programs"][0]["lowerings"] == 1
+    assert rep["findings"] and rep["findings"][0]["code"] == "H004"
+    assert rep["max_sig_seconds"] >= 0.0
+
+
+# -- the tier-1 gate: real three-mesh programs analyze clean -----------------
+
+_DRYRUN = None
+
+
+def _dryrun_artifacts():
+    """One shared three-mesh capture for the e2e tests (the compile
+    work dominates; do it once per process)."""
+    global _DRYRUN
+    if _DRYRUN is None:
+        _DRYRUN = capture.dryrun_programs(repeat_first=True)
+    return _DRYRUN
+
+
+class TestRealProgramsClean:
+    def test_capture_meta_contract(self):
+        """Every captured fused-step artifact carries the meta keys the
+        rules read (capture.py's producer contract)."""
+        arts = _dryrun_artifacts()
+        assert len(arts) >= 4
+        for a in arts:
+            assert a["name"] == "fused_step"
+            assert a["sig"].startswith("fused_step:")
+            assert "HloModule" in a["hlo"]
+            for key in ("donated", "plan", "replicated_slots", "dtype",
+                        "mesh", "gspmd"):
+                assert key in a["meta"], (a["sig"], key)
+        # the analytic plan is live on every multi-device mesh
+        assert all(a["meta"]["plan"]["all-reduce"] > 0 for a in arts)
+        # GSPMD configs pin replicated output slots; manual-dp pins none
+        by_mode = {a["meta"]["gspmd"] for a in arts}
+        assert by_mode == {True, False}
+
+    def test_three_meshes_analyze_clean(self):
+        """The standing dp8 / dp4xtp2 / dp2xtp2xsp2 programs carry zero
+        contract findings with zero waivers or baseline entries — the
+        acceptance bar for the whole plane."""
+        arts = _dryrun_artifacts()
+        findings, n_base, per_sig = core.run(arts, baseline=[])
+        assert findings == [], "\n".join(map(repr, findings))
+        assert n_base == 0
+        assert len(per_sig) == 3
+        # the repeat_first group gives H005 a real re-lowering pair
+        sigs = [a["sig"] for a in arts]
+        assert any(sigs.count(s) >= 2 for s in set(sigs))
+        # the bench-gate latency bar, with margin: static analysis only
+        assert max(per_sig.values()) < 5.0
+
+    def test_from_profiler_sees_the_same_programs(self):
+        arts = _dryrun_artifacts()
+        drained = capture.from_profiler()
+        assert {a["sig"] for a in arts} <= {a["sig"] for a in drained}
+        assert all(a["name"] == "fused_step" for a in drained)

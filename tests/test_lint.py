@@ -1575,3 +1575,89 @@ def test_mx021_real_tree_rates_parsed_and_clean():
         ["bench.py", "benchmark", "tools", "mxnet_tpu"],
         rules=[rule], baseline=[])
     assert findings == [], "\n".join(map(repr, findings))
+
+
+# -- MX022: jit sites invisible to the compile registry ----------------------
+
+def test_mx022_flags_unregistered_jit(tmp_path):
+    """A jax.jit in a hot module that never reaches record_compile is
+    an unattributable compile — flagged at the jit site."""
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX022"}, roots=(
+        _plant(tmp_path, "mxnet_tpu/gluon/block.py", """\
+            import jax
+
+            def build(fn):
+                return jax.jit(fn)
+            """),))
+    assert [f.code for f in findings] == ["MX022"]
+    assert "record_compile" in findings[0].message
+    assert findings[0].path == "mxnet_tpu/gluon/block.py"
+
+
+def test_mx022_probe_and_caller_registration_clean(tmp_path):
+    """Both sanctioned shapes pass: the one-shot _compile_probe nested
+    closure, and a direct caller recording on the builder's behalf
+    (the fused_step._dispatch -> _build shape)."""
+    _plant(tmp_path, "mxnet_tpu/optimizer/optimizer.py", """\
+        import jax
+        from .. import profiler as _profiler
+
+        def _jitted(fn):
+            jf = jax.jit(fn)
+            def probe(*a):
+                out = jf(*a)
+                _profiler.record_compile("optimizer", dur_us=1.0)
+                return out
+            return probe
+        """)
+    _plant(tmp_path, "mxnet_tpu/parallel/train.py", """\
+        import functools
+        import jax
+        from .. import profiler as _profiler
+
+        def _build():
+            return functools.partial(jax.jit)(lambda x: x)
+
+        def _dispatch():
+            f = _build()
+            _profiler.record_compile("step", dur_us=1.0)
+            return f
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX022"})
+    assert findings == [], "\n".join(map(repr, findings))
+
+
+def test_mx022_scoped_to_hot_modules_and_waivable(tmp_path):
+    """Out-of-scope modules never fire; in-scope bench jits carry an
+    inline waiver naming who accounts the compile."""
+    _plant(tmp_path, "mxnet_tpu/metric.py", """\
+        import jax
+
+        def m(fn):
+            return jax.jit(fn)
+        """)
+    _plant(tmp_path, "mxnet_tpu/pallas_kernels/tune.py", """\
+        import jax
+
+        def bench(fn):
+            @jax.jit  # mxlint: disable=MX022 (micro-bench: the autotuner times this compile itself)
+            def many(x):
+                return fn(x)
+            return many
+        """)
+    findings, n_waived, _, _ = _lint_tree(tmp_path, {"MX022"})
+    assert findings == []
+    assert n_waived == 1
+
+
+def test_mx022_from_jax_import_jit_detected(tmp_path):
+    """The `from jax import jit` spelling resolves through imports —
+    the rule keys on the resolved target, not the literal text."""
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX022"}, roots=(
+        _plant(tmp_path, "mxnet_tpu/ndarray/register.py", """\
+            from jax import jit as _jit
+
+            def dispatch(fn):
+                return _jit(fn)
+            """),))
+    assert [f.code for f in findings] == ["MX022"]

@@ -551,7 +551,7 @@ def _parse_prometheus(text):
     typed = set()
     sample_re = re.compile(
         r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? "
-        r"(-?[0-9.eE+]+|\+Inf|-Inf|NaN)$")
+        r"(-?[0-9.]+(?:[eE][-+]?[0-9]+)?|\+Inf|-Inf|NaN)$")
     for line in text.strip().splitlines():
         if line.startswith("# TYPE "):
             parts = line.split()

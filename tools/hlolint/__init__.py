@@ -1,0 +1,31 @@
+"""hlolint: static contract verification of compiled programs.
+
+mxlint (tools/mxlint) analyzes the PYTHON layer; the invariants the
+GSPMD fused step (PR 16) and the roofline plane (PR 17) rest on live
+one layer down, in the XLA artifacts — where a regression becomes a
+2x HBM footprint (dropped donation), a phantom reshard (unplanned
+all-gather), a read-time cross-process gather (sharded loss), a
+half-rate MXU (silent f32 upcast), or a cluster hang (nondeterministic
+collective order). hlolint checks those five contracts (H001-H005,
+tools/hlolint/rules.py) over the program artifacts every fused-step
+AOT compile hands to ``profiler.record_program`` — so each tier-1
+dryrun signature is analyzable with no new lowering work.
+
+    python -m tools.hlolint          # three-mesh dryrun + analyze
+    python -m tools.hlolint --json   # machine output for CI
+    python -m tools.hlolint --rule H002 --from-profiler
+
+Shares the mxlint reporting core (tools/lintcommon.py): numbered
+rules, empty checked-in baseline (tools/hlolint/baseline.json), exit
+1 on findings (2 when nothing was captured). See docs/LINTING.md,
+"HLO contracts (H-rules)". tests/test_hlolint.py pins each rule with
+a deliberately contract-breaking program and runs the real three-mesh
+end-to-end clean check in tier-1.
+"""
+from .capture import dryrun_programs, from_profiler, make_artifact
+from .core import load_baseline, main, report, run
+from .rules import ALL_RULES, Finding
+
+__all__ = ["ALL_RULES", "Finding", "run", "main", "report",
+           "load_baseline", "make_artifact", "from_profiler",
+           "dryrun_programs"]

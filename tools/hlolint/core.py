@@ -1,0 +1,152 @@
+"""hlolint driver: analyze captured program artifacts, report, exit.
+
+Same reporting contract as mxlint (tools/lintcommon.py): numbered
+findings, a JSON baseline of known exemptions
+(``tools/hlolint/baseline.json`` — empty on a clean tree), text /
+GitHub-annotation / ``--json`` output, exit 1 on findings. One
+difference by design: there are no inline waiver comments — an HLO
+dump has no reviewable source line to annotate — so the baseline file
+is the ONLY exemption mechanism, which keeps every exemption in one
+diff-visible place.
+
+Exit codes: 0 clean, 1 findings, 2 nothing to analyze (an empty
+capture must fail CI loudly — a gate that analyzed zero programs
+proves nothing).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from tools import lintcommon as _common
+from tools.hlolint.rules import ALL_RULES
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def load_baseline(path=BASELINE_PATH):
+    return _common.load_baseline(path)
+
+
+def write_baseline(findings, path=BASELINE_PATH):
+    _common.write_baseline(
+        findings, path,
+        "Known findings exempt from failing hlolint. Keep empty; see "
+        "docs/LINTING.md (HLO contracts).")
+
+
+def run(artifacts, rules=None, baseline=None):
+    """Check every artifact against the per-artifact rules and every
+    same-``sig`` group against the group rules (H005). Returns
+    ``(kept findings, n_baselined, per_sig_seconds)`` — the timing dict
+    backs the BENCH_MODEL=hlolint <5 s/signature assertion."""
+    rules = list(ALL_RULES if rules is None else rules)
+    if baseline is None:
+        baseline = load_baseline()
+    base_keys = _common.baseline_keys(baseline)
+
+    groups = {}
+    for art in artifacts:
+        groups.setdefault(art["sig"], []).append(art)
+
+    findings = []
+    per_sig = {}
+    for sig in sorted(groups):
+        t0 = time.perf_counter()
+        for rule in rules:
+            if getattr(rule, "group", False):
+                findings.extend(rule.check_group(sig, groups[sig]))
+            else:
+                for art in groups[sig]:
+                    findings.extend(rule.check(art))
+        per_sig[sig] = time.perf_counter() - t0
+
+    kept, _n_waived, n_baselined = _common.apply_waivers_and_baseline(
+        findings, {}, base_keys)
+    return kept, n_baselined, per_sig
+
+
+def report(artifacts, findings, n_baselined, per_sig):
+    """JSON-safe result record — the ``--json`` body and the
+    BENCH_MODEL=hlolint manifest payload."""
+    return {
+        "programs": sorted(
+            {a["sig"]: {"sig": a["sig"], "name": a["name"],
+                        "mesh": a["meta"].get("mesh"),
+                        "gspmd": a["meta"].get("gspmd"),
+                        "lowerings": sum(
+                            1 for b in artifacts
+                            if b["sig"] == a["sig"])}
+             for a in artifacts}.values(),
+            key=lambda p: p["sig"]),
+        "findings": [{"code": f.code, "path": f.path, "line": f.line,
+                      "message": f.message} for f in findings],
+        "n_baselined": n_baselined,
+        "per_sig_seconds": {s: round(t, 4)
+                            for s, t in per_sig.items()},
+        "max_sig_seconds": round(max(per_sig.values()), 4)
+        if per_sig else 0.0,
+    }
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hlolint",
+        description="Static contract verification of compiled "
+                    "programs (docs/LINTING.md, 'HLO contracts').")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict to specific rule codes (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--format", choices=("text", "github"),
+                    default="text",
+                    help="finding output format (github = ::error "
+                         "workflow annotations)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current findings as the new baseline")
+    ap.add_argument("--from-profiler", action="store_true",
+                    help="analyze programs already captured in this "
+                         "process instead of running the built-in "
+                         "three-mesh dryrun")
+    args = ap.parse_args(argv)
+
+    from tools.hlolint import capture
+    if args.from_profiler:
+        artifacts = capture.from_profiler()
+    else:
+        # the built-in capture: fused-step dryruns on the standing
+        # three mesh configs, first config lowered twice so H005
+        # checks a genuine re-lowering group
+        artifacts = capture.dryrun_programs(repeat_first=True)
+    if not artifacts:
+        print("hlolint: no program artifacts captured — nothing to "
+              "analyze", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rule:
+        want = set(args.rule)
+        rules = [r for r in ALL_RULES if r.code in want]
+    findings, n_baselined, per_sig = run(artifacts, rules=rules)
+
+    if args.write_baseline:
+        write_baseline(findings)
+        print("baseline: recorded %d findings" % len(findings))
+        return 0
+
+    if args.json:
+        print(json.dumps(report(artifacts, findings, n_baselined,
+                                per_sig), indent=2, sort_keys=True))
+    else:
+        _common.emit(findings, args.format, "hlolint")
+    print("hlolint: %d program%s (%d signature%s), %d finding%s "
+          "(%d baselined)" % (
+              len(artifacts), "" if len(artifacts) == 1 else "s",
+              len(per_sig), "" if len(per_sig) == 1 else "s",
+              len(findings), "" if len(findings) == 1 else "s",
+              n_baselined), file=sys.stderr)
+    return 1 if findings else 0

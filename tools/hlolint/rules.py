@@ -1,0 +1,278 @@
+"""hlolint rules: contract checks over compiled-program artifacts.
+
+Each rule checks one invariant of a captured program (the ``{name,
+sig, hlo, meta}`` records ``profiler.record_program`` accumulates —
+see tools/hlolint/capture.py for the meta key contract):
+
+* **H001 donation-took** — every donated argument (``meta['donated']``,
+  flat entry-parameter numbers) appears in the program's
+  ``input_output_alias`` map. XLA silently DROPS an alias it cannot
+  honor (shape/dtype mismatch between the donated input and any
+  output), and a donated-but-copied buffer is a 2x HBM regression the
+  memory ledger only notices after OOM.
+* **H002 collective inventory** — the per-kind collective payload
+  (``comm_model.collect_hlo_inventory``) matches the analytic plan
+  (``meta['plan']``): the gradient all-reduce within 1%, every other
+  kind at zero (beneath a small absolute floor for bookkeeping ops).
+  Any all-gather/all-to-all/collective-permute outside the plan is a
+  phantom reshard.
+* **H003 replicated outputs** — the output slots the builder pinned
+  ``P()`` (``meta['replicated_slots']``: loss/aux/health) carry empty
+  partition specs in the executable (``meta['out_specs']``). A
+  sharded loss means a cross-process gather hides at read time.
+* **H004 dtype discipline** — on a declared-bf16/f16 program
+  (``meta['dtype']``), no f32 ``convert`` of a low-precision value
+  feeds a ``dot``/``convolution``: a silent upcast runs the MXU at
+  half rate and doubles the activation footprint.
+* **H005 collective-order determinism** — re-lowerings of the same
+  signature (artifacts sharing ``sig``) emit the identical ordered
+  collective sequence. Cross-rank collective-order mismatch is a
+  cluster hang, not a test failure, so it must die here.
+
+Rules are static text/metadata analysis only — no JAX import, no
+device work — so analysis stays cheap (the BENCH_MODEL=hlolint gate
+prices it under 5 s per signature with huge margin).
+"""
+from __future__ import annotations
+
+import re
+
+from tools.lintcommon import Finding
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+# -- HLO text parsing helpers ------------------------------------------------
+
+
+def alias_param_numbers(hlo):
+    """Entry-parameter numbers appearing as alias sources in the
+    HloModule header's ``input_output_alias={ {out_idx}: (param, {},
+    may-alias), ... }`` map (empty set when the header has none)."""
+    i = hlo.find("input_output_alias={")
+    if i < 0:
+        return set()
+    s = hlo[i + len("input_output_alias="):]
+    depth = 0
+    blob = ""
+    for j, ch in enumerate(s):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                blob = s[:j + 1]
+                break
+    return {int(m.group(1)) for m in re.finditer(
+        r"\((\d+),\s*\{[^}]*\},\s*(?:may|must)-alias\)", blob)}
+
+
+_COLL_RE = re.compile(r"=\s+(\(.*?\)|\S+)\s+(%s)(-start)?\("
+                      % "|".join(_COLLECTIVES))
+
+
+def collective_sequence(hlo):
+    """Ordered ``(kind, result shape, lineno)`` of every collective
+    instruction, top to bottom — the H005 determinism witness. Layout
+    annotations are stripped (same program, same layout; the sequence
+    identity that matters cross-rank is kind+shape+order)."""
+    seq = []
+    for n, line in enumerate(hlo.splitlines(), start=1):
+        m = _COLL_RE.search(line)
+        if m and "-done" not in line.split("=", 1)[-1][:60]:
+            shape = re.sub(r"\{[^}]*\}", "", m.group(1))
+            seq.append((m.group(2), shape, n))
+    return seq
+
+
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"([a-z0-9]+)\[[^\]]*\]\S*\s+([a-z0-9\-]+)\(([^)]*)\)")
+_OPERAND_RE = re.compile(r"%?([A-Za-z_][\w.\-]*)")
+_DTYPE_TOKENS = frozenset(
+    ("f64", "f32", "f16", "bf16", "f8e4m3fn", "f8e5m2", "s32", "s64",
+     "s16", "s8", "u32", "u64", "u16", "u8", "pred", "c64", "c128"))
+
+
+def instruction_defs(hlo):
+    """{name: (result dtype, opcode, operand names, lineno)} over every
+    computation in the module. Operand tokens that are dtype keywords
+    (the ``f32[8,16] %x`` long operand form) are dropped. Names are
+    module-global here; HLO uniquifies across computations with ``.N``
+    suffixes, which is exact enough for the def-use chains H004 walks."""
+    defs = {}
+    for n, line in enumerate(hlo.splitlines(), start=1):
+        m = _DEF_RE.match(line)
+        if not m:
+            continue
+        name, dtype, op, rands = m.groups()
+        operands = [t for t in _OPERAND_RE.findall(rands)
+                    if t not in _DTYPE_TOKENS]
+        defs[name] = (dtype, op, operands, n)
+    return defs
+
+
+# -- rules -------------------------------------------------------------------
+
+class H001DonationTook:
+    code = "H001"
+    summary = "every donated argument aliases an output buffer"
+
+    def check(self, art):
+        donated = tuple(art["meta"].get("donated") or ())
+        if not donated:
+            return []
+        aliased = alias_param_numbers(art["hlo"])
+        return [Finding(
+            self.code, art["sig"], 1,
+            "donated argument %d is NOT in the input-output alias map "
+            "— XLA dropped the donation (likely an output shape/dtype "
+            "mismatch) and the buffer is silently copied, a 2x HBM "
+            "cost for this operand" % p)
+            for p in donated if p not in aliased]
+
+
+class H002CollectiveInventory:
+    code = "H002"
+    summary = "collective payload matches the analytic plan per kind"
+    # planned kinds tolerate 1% modeling error; unplanned kinds allow a
+    # small absolute floor (sub-page bookkeeping ops: loss gathers,
+    # health sentinels) before they count as a phantom reshard
+    REL_TOL = 0.01
+    ABS_FLOOR = 4096
+
+    def check(self, art):
+        plan = art["meta"].get("plan")
+        if plan is None:
+            return []
+        from tools.hlolint.capture import load_comm_model
+        cm = load_comm_model()
+        if cm is None:
+            return [Finding(self.code, art["sig"], 1,
+                            "benchmark/comm_model.py unavailable — "
+                            "collective inventory not verifiable")]
+        inv = cm.collect_hlo_inventory(art["hlo"])
+        out = []
+        if inv["unresolved_loops"]:
+            out.append(Finding(
+                self.code, art["sig"], 1,
+                "%d loop(s) with unresolved trip counts — collective "
+                "bytes under-counted, inventory not certifiable"
+                % inv["unresolved_loops"]))
+        for kind in sorted(set(plan) | set(inv["bytes_by_kind"])):
+            measured = int(inv["bytes_by_kind"].get(kind, 0))
+            planned = int(plan.get(kind, 0))
+            tol = max(self.REL_TOL * planned, self.ABS_FLOOR) \
+                if planned else self.ABS_FLOOR
+            if abs(measured - planned) > tol:
+                out.append(Finding(
+                    self.code, art["sig"], 1,
+                    "%s payload %d B vs analytic plan %d B "
+                    "(tolerance %d B): %s" % (
+                        kind, measured, planned, int(tol),
+                        "phantom resharding traffic outside the plan"
+                        if measured > planned
+                        else "planned reduction missing from the wire")))
+        return out
+
+
+class H003ReplicatedOutputs:
+    code = "H003"
+    summary = "loss/aux/health output slots stay replicated (P())"
+
+    def check(self, art):
+        slots = tuple(art["meta"].get("replicated_slots") or ())
+        specs = art["meta"].get("out_specs")
+        if not slots:
+            return []
+        if specs is None:
+            return [Finding(
+                self.code, art["sig"], 1,
+                "program declares replicated output slots %r but "
+                "carries no out_specs — sharding not verifiable"
+                % (slots,))]
+        out = []
+        for slot in slots:
+            if slot >= len(specs):
+                out.append(Finding(
+                    self.code, art["sig"], 1,
+                    "declared replicated output slot %d is missing "
+                    "from the program's %d output slots"
+                    % (slot, len(specs))))
+                continue
+            for k, spec in enumerate(specs[slot]):
+                if any(ax is not None for ax in spec):
+                    out.append(Finding(
+                        self.code, art["sig"], 1,
+                        "output slot %d leaf %d is sharded %r but the "
+                        "contract pins it P() — reading it forces a "
+                        "cross-process gather" % (slot, k, spec)))
+        return out
+
+
+class H004DtypeDiscipline:
+    code = "H004"
+    summary = "no f32 upcast feeding a matmul on a bf16/f16 path"
+
+    def check(self, art):
+        if art["meta"].get("dtype") not in ("bf16", "f16"):
+            return []
+        defs = instruction_defs(art["hlo"])
+        low = ("bf16", "f16")
+        out = []
+        for name, (dtype, op, operands, lineno) in defs.items():
+            if op not in ("dot", "convolution"):
+                continue
+            for rand in operands:
+                rdef = defs.get(rand)
+                if rdef is None or rdef[1] != "convert" \
+                        or rdef[0] != "f32":
+                    continue
+                src = defs.get(rdef[2][0]) if rdef[2] else None
+                if src is not None and src[0] in low:
+                    out.append(Finding(
+                        self.code, art["sig"], lineno,
+                        "%s %s consumes f32 convert %s of a %s value "
+                        "— silent upcast on a declared-%s path (half "
+                        "MXU rate, 2x activation bytes)" % (
+                            op, name, rand, src[0],
+                            art["meta"]["dtype"])))
+        return out
+
+
+class H005CollectiveOrder:
+    code = "H005"
+    summary = "identical collective order across re-lowerings"
+    group = True  # checks all artifacts sharing one signature
+
+    def check_group(self, sig, arts):
+        if len(arts) < 2:
+            return []
+        ref = collective_sequence(arts[0]["hlo"])
+        ref_key = [(k, s) for k, s, _ in ref]
+        out = []
+        for i, art in enumerate(arts[1:], start=1):
+            seq = collective_sequence(art["hlo"])
+            key = [(k, s) for k, s, _ in seq]
+            if key == ref_key:
+                continue
+            # first divergence point, for the message
+            j = 0
+            while j < min(len(key), len(ref_key)) \
+                    and key[j] == ref_key[j]:
+                j += 1
+            here = "%s %s" % key[j] if j < len(key) else "<end>"
+            there = "%s %s" % ref_key[j] if j < len(ref_key) else "<end>"
+            line = seq[j][2] if j < len(seq) else 1
+            out.append(Finding(
+                self.code, sig, line,
+                "re-lowering %d diverges from lowering 0 at "
+                "collective %d: %s vs %s — nondeterministic collective "
+                "order across ranks is a cluster hang" % (
+                    i, j, here, there)))
+        return out
+
+
+ALL_RULES = (H001DonationTook(), H002CollectiveInventory(),
+             H003ReplicatedOutputs(), H004DtypeDiscipline(),
+             H005CollectiveOrder())

@@ -1,0 +1,151 @@
+"""Shared lint-driver core for the repo's static analyzers.
+
+Two analyzers share one reporting contract: ``tools.mxlint`` (Python/C
+AST rules, MXnnn) and ``tools.hlolint`` (compiled-program artifact
+rules, Hnnn). The pieces that define that contract — the
+:class:`Finding` record, the waiver grammar, the JSON baseline and the
+finding emitters with their exit-code semantics — live here so the two
+tools cannot drift apart on what a waiver means or how CI parses a
+finding.
+
+Waiver idiom (the tool tag selects the analyzer):
+
+    # mxlint: disable=MX003 (reason why this exemption is sound)
+    # hlolint: disable=H002 (reason)
+
+A waiver suppresses the listed codes on its own line and the line
+directly below it; ``disable-file=`` waives for the whole file. A
+waiver without a parenthesized justification is itself reported (the
+tool's 000 code): the point is a reviewed reason next to every
+exemption.
+
+Baseline: a JSON file of ``{code, path, line}`` triples that don't
+fail the run — the cpplint NOLINT-file escape hatch for bulk-adopting
+a rule. Checked-in baselines stay empty on a clean tree.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+
+class Finding:
+    __slots__ = ("code", "path", "line", "message", "extra_waiver_lines")
+
+    def __init__(self, code, path, line, message,
+                 extra_waiver_lines=()):
+        self.code = code
+        self.path = path
+        self.line = line
+        self.message = message
+        # additional lines whose waivers also suppress this finding
+        # (mxlint MX003: the container's definition line)
+        self.extra_waiver_lines = tuple(extra_waiver_lines)
+
+    def __repr__(self):
+        return "%s:%d: %s %s" % (self.path, self.line, self.code,
+                                 self.message)
+
+
+def waiver_regexes(tool, code_re):
+    """(line-waiver, file-waiver) regexes for a tool tag and code
+    pattern (e.g. ``("mxlint", r"MX\\d{3}")``)."""
+    codes = r"((?:%s)(?:\s*,\s*%s)*)" % (code_re, code_re)
+    line = re.compile(r"(?:#|//)\s*%s:\s*disable=%s\s*(\(.+)?"
+                      % (tool, codes))
+    file_ = re.compile(r"(?:#|//)\s*%s:\s*disable-file=%s\s*(\(.+)?"
+                       % (tool, codes))
+    return line, file_
+
+
+def parse_waivers(src, line_re, file_re):
+    """(line waivers, file waivers, bad waivers). Line waivers are
+    {line -> set(codes)}; a waiver covers its own line and the next
+    one. Waivers lacking a justification are returned as bad
+    ``(lineno, sorted codes)`` pairs."""
+    waivers = {}
+    file_waivers = set()
+    bad = []
+    for i, line in enumerate(src.splitlines(), start=1):
+        fm = file_re.search(line)
+        m = line_re.search(line) if fm is None else None
+        if fm is not None:
+            codes = {c.strip() for c in fm.group(1).split(",")}
+            file_waivers.update(codes)
+            reason = (fm.group(2) or "").strip("() \t")
+        elif m is not None:
+            codes = {c.strip() for c in m.group(1).split(",")}
+            reason = (m.group(2) or "").strip("() \t")
+            waivers.setdefault(i, set()).update(codes)
+            waivers.setdefault(i + 1, set()).update(codes)
+        else:
+            continue
+        if not reason:
+            bad.append((i, sorted(codes)))
+    return waivers, file_waivers, bad
+
+
+def apply_waivers_and_baseline(findings, waiver_maps, base_keys):
+    """Partition findings against per-file waivers and the baseline.
+
+    ``waiver_maps``: {path -> (line waivers, file waivers)};
+    ``base_keys``: set of (code, path, line) with line possibly None.
+    Returns (kept findings sorted, n_waived, n_baselined)."""
+    kept = []
+    n_waived = n_baselined = 0
+    for fi in findings:
+        waivers, file_waivers = waiver_maps.get(fi.path, ({}, set()))
+        lines = (fi.line,) + fi.extra_waiver_lines
+        if fi.code in file_waivers or \
+                any(fi.code in waivers.get(l, ()) for l in lines):
+            n_waived += 1
+        elif (fi.code, fi.path, fi.line) in base_keys or \
+                (fi.code, fi.path, None) in base_keys:
+            n_baselined += 1
+        else:
+            kept.append(fi)
+    kept.sort(key=lambda f: (f.path, f.line, f.code))
+    return kept, n_waived, n_baselined
+
+
+def load_baseline(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f).get("findings", [])
+    except (OSError, ValueError):
+        return []
+
+
+def baseline_keys(baseline):
+    return {(b["code"], b["path"], b.get("line")) for b in baseline}
+
+
+def write_baseline(findings, path, comment):
+    data = {
+        "comment": comment,
+        "findings": [{"code": f.code, "path": f.path, "line": f.line}
+                     for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def emit(findings, fmt, tool):
+    for f in findings:
+        if fmt == "github":
+            # GitHub Actions annotation syntax: shows inline on the PR
+            print("::error file=%s,line=%d,title=%s %s::%s"
+                  % (f.path, f.line, tool, f.code, f.message))
+        else:
+            print("%s:%d: %s %s" % (f.path, f.line, f.code, f.message))
+
+
+def summary_line(tool, findings, n_waived, n_baselined, bad):
+    s = "%s: %d finding%s (%d waived, %d baselined)" % (
+        tool, len(findings), "" if len(findings) == 1 else "s",
+        n_waived, n_baselined)
+    if bad:
+        s += ", %d bad waiver%s" % (len(bad),
+                                    "" if len(bad) == 1 else "s")
+    return s

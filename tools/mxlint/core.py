@@ -26,8 +26,10 @@ from __future__ import annotations
 import ast
 import json
 import os
-import re
 import sys
+
+from tools import lintcommon as _common
+from tools.lintcommon import Finding  # re-exported public API
 
 # MXLINT_REPO_ROOT: re-root the analysis (scope checks, doc/catalog
 # lookups) onto another tree — tooling/test hook, not needed in-repo
@@ -36,34 +38,12 @@ REPO_ROOT = os.environ.get("MXLINT_REPO_ROOT") or os.path.dirname(
 BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "baseline.json")
 
-_WAIVER_RE = re.compile(
-    r"(?:#|//)\s*mxlint:\s*disable=((?:MX\d{3})(?:\s*,\s*MX\d{3})*)"
-    r"\s*(\(.+)?")
-_FILE_WAIVER_RE = re.compile(
-    r"(?:#|//)\s*mxlint:\s*disable-file=((?:MX\d{3})(?:\s*,\s*MX\d{3})*)"
-    r"\s*(\(.+)?")
+_WAIVER_RE, _FILE_WAIVER_RE = _common.waiver_regexes(
+    "mxlint", r"MX\d{3}")
 
 # directories never worth walking
 _SKIP_DIRS = {".git", "__pycache__", "build", "blib", ".pytest_cache",
               "node_modules"}
-
-
-class Finding:
-    __slots__ = ("code", "path", "line", "message", "extra_waiver_lines")
-
-    def __init__(self, code, path, line, message,
-                 extra_waiver_lines=()):
-        self.code = code
-        self.path = path
-        self.line = line
-        self.message = message
-        # additional lines whose waivers also suppress this finding
-        # (MX003: the container's definition line)
-        self.extra_waiver_lines = tuple(extra_waiver_lines)
-
-    def __repr__(self):
-        return "%s:%d: %s %s" % (self.path, self.line, self.code,
-                                 self.message)
 
 
 def parse_waivers(src):
@@ -73,26 +53,7 @@ def parse_waivers(src):
     files whose entire design is the exemption (document the design in
     the justification). Waivers lacking a justification are returned
     as bad."""
-    waivers = {}
-    file_waivers = set()
-    bad = []
-    for i, line in enumerate(src.splitlines(), start=1):
-        fm = _FILE_WAIVER_RE.search(line)
-        m = _WAIVER_RE.search(line) if fm is None else None
-        if fm is not None:
-            codes = {c.strip() for c in fm.group(1).split(",")}
-            file_waivers.update(codes)
-            reason = (fm.group(2) or "").strip("() \t")
-        elif m is not None:
-            codes = {c.strip() for c in m.group(1).split(",")}
-            reason = (m.group(2) or "").strip("() \t")
-            waivers.setdefault(i, set()).update(codes)
-            waivers.setdefault(i + 1, set()).update(codes)
-        else:
-            continue
-        if not reason:
-            bad.append((i, sorted(codes)))
-    return waivers, file_waivers, bad
+    return _common.parse_waivers(src, _WAIVER_RE, _FILE_WAIVER_RE)
 
 
 def _iter_files(paths):
@@ -200,7 +161,7 @@ def run(paths, rules=None, baseline=None, jobs=1):
     project_rules = [r for r in rules if getattr(r, "project", False)]
     if baseline is None:
         baseline = load_baseline()
-    base_keys = {(b["code"], b["path"], b.get("line")) for b in baseline}
+    base_keys = _common.baseline_keys(baseline)
 
     files = [(ab, _rel(ab)) for ab in _iter_files(paths)]
     # workers rebuild rule instances from ALL_RULES by code — ANY
@@ -244,20 +205,8 @@ def run(paths, rules=None, baseline=None, jobs=1):
         for rule in project_rules:
             findings.extend(rule.check_project(model))
 
-    kept = []
-    n_waived = n_baselined = 0
-    for fi in findings:
-        waivers, file_waivers = waiver_maps.get(fi.path, ({}, set()))
-        lines = (fi.line,) + fi.extra_waiver_lines
-        if fi.code in file_waivers or \
-                any(fi.code in waivers.get(l, ()) for l in lines):
-            n_waived += 1
-        elif (fi.code, fi.path, fi.line) in base_keys or \
-                (fi.code, fi.path, None) in base_keys:
-            n_baselined += 1
-        else:
-            kept.append(fi)
-    kept.sort(key=lambda f: (f.path, f.line, f.code))
+    kept, n_waived, n_baselined = _common.apply_waivers_and_baseline(
+        findings, waiver_maps, base_keys)
     return kept, n_waived, n_baselined, bad_waivers
 
 
@@ -281,33 +230,18 @@ def build_model(paths):
 
 
 def load_baseline(path=BASELINE_PATH):
-    try:
-        with open(path, encoding="utf-8") as f:
-            return json.load(f).get("findings", [])
-    except (OSError, ValueError):
-        return []
+    return _common.load_baseline(path)
 
 
 def write_baseline(findings, path=BASELINE_PATH):
-    data = {
-        "comment": "Known findings exempt from failing mxlint. Keep "
-                   "empty; see docs/LINTING.md.",
-        "findings": [{"code": f.code, "path": f.path, "line": f.line}
-                     for f in findings],
-    }
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(data, f, indent=2, sort_keys=True)
-        f.write("\n")
+    _common.write_baseline(
+        findings, path,
+        "Known findings exempt from failing mxlint. Keep empty; see "
+        "docs/LINTING.md.")
 
 
 def _emit(findings, fmt):
-    for f in findings:
-        if fmt == "github":
-            # GitHub Actions annotation syntax: shows inline on the PR
-            print("::error file=%s,line=%d,title=mxlint %s::%s"
-                  % (f.path, f.line, f.code, f.message))
-        else:
-            print("%s:%d: %s %s" % (f.path, f.line, f.code, f.message))
+    _common.emit(findings, fmt, "mxlint")
 
 
 def _lock_graph_main(args):
@@ -388,11 +322,6 @@ def main(argv=None):
         return 0
 
     _emit(findings + bad, args.format)
-    summary = "mxlint: %d finding%s (%d waived, %d baselined)" % (
-        len(findings), "" if len(findings) == 1 else "s", n_waived,
-        n_baselined)
-    if bad:
-        summary += ", %d bad waiver%s" % (len(bad),
-                                          "" if len(bad) == 1 else "s")
-    print(summary, file=sys.stderr)
+    print(_common.summary_line("mxlint", findings, n_waived,
+                               n_baselined, bad), file=sys.stderr)
     return 1 if findings or bad else 0

@@ -873,6 +873,133 @@ class MX019MetricsProviderDocs:
         return out
 
 
+# ---------------------------------------------------------------------------
+# MX022 — jit sites invisible to the compile-attribution registry
+# ---------------------------------------------------------------------------
+
+# Hot modules under the compile-attribution contract (ISSUE 18): the
+# operator dispatch cache, the cached-graph executor, the fused step,
+# the optimizer update jits, the sharded/overlapped train steps, the
+# transformer bench harness, and the Pallas kernels. A compile these
+# modules trigger that ``profiler.compile_stats()`` cannot see is a
+# silent recompile vector — exactly what the registry (and hlolint's
+# capture feed riding on it) exists to close.
+_COMPILE_HOT = (
+    "mxnet_tpu/ndarray/register.py",
+    "mxnet_tpu/gluon/block.py",
+    "mxnet_tpu/gluon/fused_step.py",
+    "mxnet_tpu/optimizer/optimizer.py",
+    "mxnet_tpu/parallel/train.py",
+    "mxnet_tpu/parallel/transformer.py",
+    "mxnet_tpu/pallas_kernels/",
+)
+# The registry choke points: the profiler entry, the fused-step
+# recording seam, and the one-shot first-call probe spellings
+# (register._compile_probe / ShardedTrainStep._compile_probe) whose
+# bodies feed record_compile.
+_COMPILE_CHOKES = frozenset((
+    "record_compile", "_record_compile", "_compile_probe",
+))
+
+
+class MX022UnregisteredCompile:
+    """Every ``jax.jit``/``pjit`` in the hot modules must be visible to
+    the compile-attribution registry: the creating function reaches
+    ``profiler.record_compile`` (directly, one resolvable call away, or
+    from a direct caller that records on its behalf), so recompiles
+    show up in ``compile_stats()`` and the hlolint capture feed instead
+    of vanishing into step-time noise. A jit the registry cannot see is
+    an unattributable compile — the retracing class of bug MX005 flags
+    lexically, enforced here at the accounting layer. Waive only
+    harness/bench jits whose callers time and account the compile
+    themselves, with the justification saying where."""
+
+    code = "MX022"
+    summary = "jit site invisible to the compile-attribution registry"
+    kind = "python"
+    project = True
+
+    def scope(self, path):
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    @staticmethod
+    def _is_jit(mf, dn):
+        parts = dn.split(".")
+        if len(parts) == 1:
+            # from jax import jit [as alias]
+            return mf.imports.get(dn) in ("jax.jit", "jax.pjit")
+        if parts[-1] not in ("jit", "pjit"):
+            return False
+        root = mf.imports.get(parts[0], parts[0])
+        return root == "jax" or root.startswith("jax.")
+
+    def _jit_sites(self, mf, fn):
+        # a call `jax.jit(...)` also lands in refs at the same line
+        # (the attribute load) — dedup by line, calls win the label
+        sites = {}
+        for dn, ln, _a, _k in fn.calls:
+            if self._is_jit(mf, dn):
+                sites.setdefault(ln, dn)
+        for name, ln in fn.refs:
+            if self._is_jit(mf, name):
+                sites.setdefault(ln, name)
+        return sorted(sites.items())
+
+    def _calls_choke(self, fn):
+        return any(dn.rsplit(".", 1)[-1] in _COMPILE_CHOKES
+                   for dn, _ln, _a, _k in fn.calls)
+
+    def _registered(self, model, key, fn, depth=1):
+        """The function (or a callee one resolvable hop away, or a
+        nested closure it builds) reaches a registry choke point."""
+        if self._calls_choke(fn):
+            return True
+        if depth <= 0:
+            return False
+        for nxt in model.edges_from(key):
+            nfn = model.functions.get(nxt)
+            if nfn is not None and self._registered(model, nxt, nfn,
+                                                    depth - 1):
+                return True
+        return False
+
+    def _caller_records(self, model, key):
+        """A DIRECT caller records on the builder's behalf (the
+        fused_step._dispatch -> _build -> _record_compile shape)."""
+        for ck, _rec in model.callers_of(key):
+            cfn = model.functions.get(ck)
+            if cfn is not None and self._calls_choke(cfn):
+                return True
+        return False
+
+    def check_project(self, model):
+        out = []
+        for key in sorted(model.functions):
+            path, qual = key
+            if not any(path.startswith(p) for p in _COMPILE_HOT):
+                continue
+            fn = model.functions[key]
+            mf = model.modules[path]
+            sites = self._jit_sites(mf, fn)
+            if not sites:
+                continue
+            if self._registered(model, key, fn):
+                continue
+            if self._caller_records(model, key):
+                continue
+            for ln, dn in sites:
+                out.append(Finding(
+                    self.code, path, ln,
+                    "%s in %s builds a compiled program the "
+                    "compile-attribution registry never sees — reach "
+                    "profiler.record_compile within one call (the "
+                    "_compile_probe idiom), record from the direct "
+                    "caller, or waive with a justification naming who "
+                    "accounts this compile (docs/LINTING.md)"
+                    % (dn, qual)))
+        return out
+
+
 DATAFLOW_RULES = (
     MX014TracedAmbientState(),
     MX015EnvContract(),
@@ -880,4 +1007,5 @@ DATAFLOW_RULES = (
     MX017StaticLockOrder(),
     MX018UnledgeredBufferCreation(),
     MX019MetricsProviderDocs(),
+    MX022UnregisteredCompile(),
 )

@@ -27,6 +27,11 @@ failure → behavior → counter table):
 ``fused_step.trace``        ``FusedTrainStep._build`` trace entry
 ``checkpoint.save``         ``base.atomic_write``, after the temp write,
                             before the atomic rename (mid-save crash)
+``checkpoint.persist``      ``CheckpointManager._persist_bg``, after the
+                            snapshot is taken, before the durable write
+                            starts (the async snapshot→persist gap: a
+                            death here loses exactly the one
+                            unpublished step)
 ``storage.alloc``           creation-factory device placement
                             (``nd._ctx_place``)
 ``collective.allreduce``    gradient-reduction launch seams: the host
@@ -121,6 +126,7 @@ POINTS = frozenset((
     "imperative.jit.compile",
     "fused_step.trace",
     "checkpoint.save",
+    "checkpoint.persist",
     "storage.alloc",
     "collective.allreduce",
     "elastic.restore",

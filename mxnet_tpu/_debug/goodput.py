@@ -223,7 +223,8 @@ def open_run(run_id=None, meta=None):
             "replay_next": False,
             "in_recovery": False, "rec_t0": None,
             "recoveries": 0, "reshards": 0, "checkpoints": 0,
-            "restores": 0,
+            "restores": 0, "persists": 0, "persist_s": 0.0,
+            "peer_restores": 0,
             "events": [], "events_dropped": 0,
         }
         OPEN = True
@@ -377,7 +378,15 @@ def fold_pending():
 def note_checkpoint(dur_s, kind="save"):
     """Checkpoint save/restore wall time (``CheckpointManager`` weld).
     A restore inside a recovery interval is already covered by that
-    interval's clock — only the counter ticks, not the category."""
+    interval's clock — only the counter ticks, not the category.
+
+    ``kind="persist"`` (ISSUE 19 async checkpoints) is the background
+    publish leg: its seconds OVERLAP training on the persist thread, so
+    they never book into the ``checkpoint`` category — only the counter
+    and an overlap gauge (``persist_s``) tick, which is exactly how the
+    async path's badput win shows up in a manifest: ``checkpoint``
+    seconds shrink to the blocking snapshot while ``persist_s`` records
+    the hidden work."""
     if not OPEN:
         return
     with _lock:
@@ -386,6 +395,10 @@ def note_checkpoint(dur_s, kind="save"):
             return
         if kind == "save":
             r["checkpoints"] += 1
+        elif kind == "persist":
+            r["persists"] += 1
+            r["persist_s"] += dur_s
+            return
         else:
             r["restores"] += 1
         if not r["in_recovery"]:
@@ -427,6 +440,10 @@ def recovery_end(kind="restore", resharded=False, restored_step=None,
         r["recoveries"] += 1
         if resharded:
             r["reshards"] += 1
+        if kind == "peer":
+            # restore served from a live peer's in-memory replica
+            # (ISSUE 19c) instead of the filesystem
+            r["peer_restores"] += 1
         _event_locked(r, "recovery", {
             "recovery_kind": kind, "seconds": round(dur, 6),
             "resharded": bool(resharded),
@@ -524,6 +541,9 @@ def _derive_locked(r, now_m, closing):
             "reshards": r["reshards"],
             "checkpoint_saves": r["checkpoints"],
             "checkpoint_restores": r["restores"],
+            "checkpoint_persists": r["persists"],
+            "checkpoint_persist_s": round(r["persist_s"], 6),
+            "peer_restores": r["peer_restores"],
             "events_dropped": r["events_dropped"],
             "input_wait_overbooked_s": round(
                 r.get("input_wait_overbooked_s", 0.0), 6),

@@ -1,0 +1,149 @@
+"""Persistent AOT compile cache (ISSUE 19b): replay yesterday's XLA
+work from disk instead of recompiling the world.
+
+The fused step already compiles ahead-of-time on every compile step (the
+``_record_compile`` seam captures the executable for cost/HLO/memory
+attribution). This module adds the durable half: the serialized
+executable (``jax.experimental.serialize_executable``) lands under
+``MXTPU_COMPILE_CACHE_DIR`` keyed by the FULL compile signature — which
+already contains the signature-token registry snapshot, the aval
+signature of every operand, the mesh fingerprint and the optimizer
+static key — plus the jax/jaxlib versions and backend platform, so a
+cache entry can never replay under a different graph-shaping
+configuration, library build, or backend than the one that compiled it.
+
+Contract (the "never fatal" rule): every miss, deserialize failure,
+version skew, or store error degrades to a fresh trace+compile and
+ticks a counter in ``metrics()['compile_cache']`` — the cache can only
+ever make a run faster, never wrong and never dead. Entries publish via
+temp-write + atomic rename (`base.atomic_write`), so a crashed writer
+leaves no torn entry for the next process to trip over.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+from .. import base as _base
+from .. import profiler as _profiler
+from ..base import getenv as _getenv
+
+__all__ = ["enabled", "cache_dir", "cache_path", "load", "store",
+           "stats", "reset_stats"]
+
+# mxlint: disable=MX003 (GIL-atomic best-effort counters, same contract as fused_step._STATS)
+_STATS = {
+    "hits": 0,       # executable served from the persistent cache
+    "misses": 0,     # no entry for this key (fresh compile follows)
+    "stores": 0,     # executables serialized to disk
+    "deserialize_errors": 0,  # entry present but unloadable (version
+                              # skew the key missed, torn/corrupt file,
+                              # backend drift) — counted, then a fresh
+                              # compile; never fatal
+    "store_errors": 0,        # serialize/write failed — compile kept,
+                              # cache entry lost
+}
+
+
+def stats():
+    """Snapshot of the persistent-cache counters."""
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+# surfaces as metrics()['compile_cache'] and a dumps() line
+_profiler.register_stats_provider("compile_cache", stats, reset_stats)
+
+
+def cache_dir():
+    """The cache root, or ``None`` when the cache is off. Read per call
+    (not pinned at import) so tests and late-configured launchers can
+    flip it; the var is also a signature token, so flipping it mid-run
+    lands every later compile on a fresh in-memory key too."""
+    d = _getenv("MXTPU_COMPILE_CACHE_DIR", "")
+    return d or None
+
+
+def enabled():
+    return cache_dir() is not None
+
+
+def _fingerprint():
+    """Environment half of the key: serialized executables are only
+    valid for the exact jax/jaxlib build and backend that produced
+    them."""
+    import jax
+    import jaxlib
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+    return (jax.__version__, getattr(jaxlib, "__version__", "?"),
+            platform)
+
+
+def cache_path(sig_key):
+    """Entry path for one full compile-signature key. The digest is
+    sha256 of the key tuple's repr (avals, token snapshots and static
+    keys all repr deterministically — the same property the compile
+    registry's crc32 keyhash relies on) plus the version/backend
+    fingerprint."""
+    d = cache_dir()
+    if d is None:
+        return None
+    h = hashlib.sha256(
+        repr((sig_key, _fingerprint())).encode("utf-8")).hexdigest()
+    return os.path.join(d, h[:32] + ".xc")
+
+
+def load(sig_key):
+    """Return the cached compiled executable for ``sig_key``, or
+    ``None`` (miss or unloadable — counted). The caller falls back to
+    ``lower().compile()`` either way."""
+    path = cache_path(sig_key)
+    if path is None:
+        return None
+    if not os.path.exists(path):
+        _STATS["misses"] += 1
+        return None
+    try:
+        from jax.experimental.serialize_executable import \
+            deserialize_and_load
+        with open(path, "rb") as f:
+            blob, in_tree, out_tree = pickle.load(f)
+        compiled = deserialize_and_load(blob, in_tree, out_tree)
+    except Exception as e:
+        _STATS["deserialize_errors"] += 1
+        _profiler.record_op(
+            "compile_cache.deserialize_error", 0.0, category="elastic",
+            lane="user",
+            args={"error": "%s: %s" % (type(e).__name__, e)})
+        return None
+    _STATS["hits"] += 1
+    return compiled
+
+
+def store(sig_key, compiled):
+    """Serialize one compiled executable under its signature key.
+    Best-effort: a failure loses the cache entry, never the compile.
+    Returns True when the entry published."""
+    path = cache_path(sig_key)
+    if path is None:
+        return False
+    try:
+        from jax.experimental.serialize_executable import serialize
+        blob, in_tree, out_tree = serialize(compiled)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with _base.atomic_write(path, "wb") as f:
+            pickle.dump((blob, in_tree, out_tree), f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        _STATS["store_errors"] += 1
+        return False
+    _STATS["stores"] += 1
+    return True

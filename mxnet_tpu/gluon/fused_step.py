@@ -71,6 +71,7 @@ from .._debug import healthmon as _healthmon
 from .._debug import watchdog as _watchdog
 from .. import storage as _storage
 from ..optimizer.optimizer import _is_low_precision
+from . import compile_cache as _compile_cache
 from .block import make_pure_forward
 
 __all__ = ["FusedTrainStep", "train_step", "fused_step_enabled",
@@ -273,6 +274,12 @@ class FusedTrainStep:
         self._failed_keys = set()   # signatures that failed to trace
         self.last_mode = None   # how the previous call executed
         self._aot = None        # (compiled, cost, hlo) from the last AOT
+        self._ckey = None       # full signature key of the in-flight
+        #                         compile; _run's AOT branch keys the
+        #                         persistent compile cache by it
+        self._aot_from_cache = False  # last AOT came off disk, so
+        #                               _record_compile must not
+        #                               re-serialize it back
         # signature -> modeled compute/comm split (ISSUE 8c): keyed like
         # _cache so a run alternating compiled signatures (main batch +
         # remainder shape) never subtracts the OTHER program's modeled
@@ -496,6 +503,7 @@ class FusedTrainStep:
         try:
             c0 = _time.perf_counter()
             self._aot = None
+            self._ckey = key
             entry = self._build(all_params, train_pos, nd_args, states)
             loss = self._run(entry, all_params, train_pos, indices, states,
                              nd_args, batch_size, aot=True)
@@ -1097,6 +1105,14 @@ class FusedTrainStep:
             modeled_comm_us=comm_us, memory=mem,
             args={"params": len(train_pos), "dp": self._dp,
                   "dtype": dtype, "peak_tflops": peak})
+        if compiled is not None and _compile_cache.enabled() \
+                and not self._aot_from_cache:
+            # persist the executable for the NEXT process (ISSUE 19b);
+            # skip when it just came off disk — re-serializing the same
+            # entry buys nothing. store() is best-effort and counts its
+            # own failures; a lost entry costs one recompile, never the
+            # step.
+            _compile_cache.store(key, compiled)
         if hlo is not None:
             # artifact capture (ISSUE 18): hand the HLO plus the
             # contract facts hlolint's H-rules check to the profiler's
@@ -1246,7 +1262,21 @@ class FusedTrainStep:
                 # pre-places operands above), so the executable stays
                 # valid for all later hits of this signature.
                 try:
-                    compiled = jfn.lower(*operands).compile()
+                    # persistent cache first (ISSUE 19b): the key is the
+                    # full signature _dispatch stashed in self._ckey —
+                    # avals + signature-token snapshot + mesh
+                    # fingerprint + optimizer static key — so a disk hit
+                    # is exactly the executable this trace would have
+                    # produced, and the trace+XLA compile is skipped
+                    # entirely. Any load failure was counted by the
+                    # cache and falls through to a fresh compile.
+                    self._aot_from_cache = False
+                    compiled = None
+                    if _compile_cache.enabled() and self._ckey is not None:
+                        compiled = _compile_cache.load(self._ckey)
+                        self._aot_from_cache = compiled is not None
+                    if compiled is None:
+                        compiled = jfn.lower(*operands).compile()
                     cost = compiled.cost_analysis()
                     cost = cost[0] if isinstance(cost, (list, tuple)) \
                         else cost

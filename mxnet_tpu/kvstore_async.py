@@ -62,6 +62,7 @@ import weakref
 import numpy as np
 
 from . import _retry
+from . import kvstore_server as _kvstore_server
 from . import profiler as _profiler
 from ._debug import faultpoint as _faultpoint
 from ._debug import healthmon as _healthmon
@@ -92,6 +93,15 @@ _OP_DEADNODES = 14
 _OP_SHAPE = 15
 _OP_BARRIER = 16
 _OP_HELLO = 17
+# peer-snapshot plane (ISSUE 19c): a rank publishes its newest
+# in-memory training state as an opaque blob; a recovering rank pulls
+# the freshest live peer's copy before falling back to the checkpoint
+# filesystem. Length-gated like every op since PR 8: a v0 server
+# answers both with _RE_ERR ("unknown opcode"), which the client
+# surfaces as RuntimeError and elastic counts as a filesystem fallback
+# — old-server interop is the degraded path, never a hang or a crash.
+_OP_SNAP_PUT = 18
+_OP_SNAP_GET = 19
 
 # response opcodes
 _RE_OK = 0x10
@@ -118,6 +128,7 @@ _OP_NAMES = {
     _OP_PUSH_2BIT: "push_2bit", _OP_PROFILER: "profiler",
     _OP_HEARTBEAT: "heartbeat", _OP_DEADNODES: "dead_nodes",
     _OP_SHAPE: "shape", _OP_BARRIER: "barrier", _OP_HELLO: "hello",
+    _OP_SNAP_PUT: "snapshot_put", _OP_SNAP_GET: "snapshot_get",
 }
 
 
@@ -373,6 +384,10 @@ class AsyncPSServer:
             "kvstore_async.server", self._lock)
         self._barrier_count = 0
         self._barrier_gen = 0
+        # peer-snapshot table (ISSUE 19c): opaque per-rank state blobs
+        # served back to recovering ranks; liveness-filtered against
+        # self._heartbeats at get time
+        self._snapshots = _kvstore_server.SnapshotTable()
         if _ps_secret() is None:
             # same-host workers inherit this via the environment; the
             # launcher passes MXTPU_* through for remote ranks
@@ -721,6 +736,32 @@ class AsyncPSServer:
             else:
                 _send_frame(conn, struct.pack(">BI", _RE_BYTES,
                                               len(reply)) + reply)
+        elif op == _OP_SNAP_PUT:
+            # peer snapshot publish (ISSUE 19c): >qq rank|step header,
+            # remainder is the opaque HMAC+pickle blob elastic built.
+            # The server stores bytes and never unpickles them — the
+            # data-plane no-pickle contract holds on this op too.
+            rank, step = struct.unpack_from(">qq", buf, off)
+            self._snapshots.put(int(rank), int(step), buf[off + 16:])
+            _send_frame(conn, bytes([_RE_OK]))
+        elif op == _OP_SNAP_GET:
+            # >qd exclude_rank|stale_timeout: newest snapshot from a
+            # live peer other than the requester. _RE_BYTES reply is
+            # >qq rank|step then the blob; _RE_INT 0 means no live
+            # peer has one (the client returns None and elastic walks
+            # to the filesystem).
+            exclude, stale = struct.unpack_from(">qd", buf, off)
+            with self._lock:
+                beats = dict(self._heartbeats)
+            best = self._snapshots.get_newest(int(exclude), beats,
+                                              float(stale))
+            if best is None:
+                _send_frame(conn, struct.pack(">Bq", _RE_INT, 0))
+            else:
+                prank, pstep, blob = best
+                body = struct.pack(">qq", prank, pstep) + blob
+                _send_frame(conn, struct.pack(">BI", _RE_BYTES,
+                                              len(body)) + body)
         elif op == _OP_STOP:
             _send_frame(conn, bytes([_RE_OK]))
             self._stop.set()
@@ -1122,6 +1163,31 @@ class AsyncPSClient:
         arr = self._call(struct.pack(">Bd", _OP_DEADNODES,
                                      float(timeout)))
         return [int(r) for r in arr]
+
+    def put_snapshot(self, rank, step, blob):
+        """Publish this rank's opaque peer-snapshot blob (ISSUE 19c).
+        One slot per rank on the server; each publish replaces the
+        previous. Raises RuntimeError against a v0 server (unknown
+        opcode -> _RE_ERR) — callers treat that as "peer plane
+        unavailable" and count, never crash the step."""
+        self._call(struct.pack(">Bqq", _OP_SNAP_PUT, int(rank),
+                               int(step)) + bytes(blob))
+
+    def get_snapshot(self, exclude_rank, stale_timeout=None):
+        """Newest live peer snapshot as ``(rank, step, blob)``, or
+        ``None`` when no live peer (heartbeat fresher than
+        ``stale_timeout``, default MXTPU_PS_DEAD_TIMEOUT) other than
+        ``exclude_rank`` has published one."""
+        if stale_timeout is None:
+            stale_timeout = float(_getenv("MXTPU_PS_DEAD_TIMEOUT", "3"))
+        resp = self._call(struct.pack(">Bqd", _OP_SNAP_GET,
+                                      int(exclude_rank),
+                                      float(stale_timeout)))
+        if not isinstance(resp, (bytes, bytearray, memoryview)):
+            return None  # _RE_INT 0: nothing published by a live peer
+        resp = bytes(resp)
+        rank, step = struct.unpack_from(">qq", resp, 0)
+        return int(rank), int(step), resp[16:]
 
     def profiler_command(self, cmd, body=""):
         c, b = cmd.encode(), body.encode()
@@ -1571,6 +1637,24 @@ class AsyncKVStore:
             raise ValueError("resize needs >= 1 worker, got %d"
                              % num_workers)
         self._num_workers = num_workers
+
+    def publish_snapshot(self, step, blob):
+        """Publish this rank's opaque training-state blob to the
+        control-plane server's peer-snapshot table (ISSUE 19c). The
+        blob is built (HMAC-tagged pickle) and later verified by
+        ``parallel.elastic`` — this layer moves bytes only. Replaces
+        this rank's previous slot; raises RuntimeError against a v0
+        server (callers count and continue)."""
+        self._client.put_snapshot(self._rank, step, blob)
+
+    def peer_snapshot(self, stale_timeout=None):
+        """Newest snapshot a LIVE peer (heartbeat fresher than
+        ``stale_timeout``, default MXTPU_PS_DEAD_TIMEOUT) published, as
+        ``(rank, step, blob)`` — or ``None`` when no live peer has one.
+        This rank's own slot is excluded server-side: recovering from
+        your own pre-crash snapshot would resurrect exactly the state
+        the failure may have poisoned."""
+        return self._client.get_snapshot(self._rank, stale_timeout)
 
     def set_server_profiler_command(self, cmd, body=""):
         """Forward a profiler command to every PS server process

@@ -12,8 +12,70 @@ loop. `KVStoreServer` keeps the API for launch scripts that construct it.
 from __future__ import annotations
 
 import pickle
+import threading
+import time as _time
 
-__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+__all__ = ["KVStoreServer", "SnapshotTable",
+           "_init_kvstore_server_module"]
+
+
+class SnapshotTable:
+    """Server-side peer-snapshot store (ISSUE 19c): the newest
+    in-memory training-state blob each live rank published, so a rank
+    restarting after a failure can pull a peer's state over the wire
+    instead of walking back to the checkpoint filesystem.
+
+    Blobs are OPAQUE here — HMAC tag + pickle produced and verified by
+    ``parallel.elastic`` on the worker side; the server stores and
+    serves bytes, never unpickles (the v1 data-plane no-pickle
+    contract). One slot per rank: a publish replaces that rank's
+    previous snapshot, so the table is bounded by world size, not by
+    run length. ``get_newest`` picks the highest-step snapshot among
+    ranks that are both not the requester and alive by the heartbeat
+    table the server already keeps — a dead rank's stale snapshot must
+    never win over a live peer's fresher one.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}  # rank -> (step, blob, monotonic publish ts)
+
+    def put(self, rank, step, blob):
+        with self._lock:
+            self._slots[int(rank)] = (int(step), bytes(blob),
+                                      _time.monotonic())
+
+    def get_newest(self, exclude_rank, heartbeats, stale_timeout):
+        """Best ``(rank, step, blob)`` from a live peer, or ``None``.
+
+        ``heartbeats`` is the server's {rank: last monotonic heartbeat}
+        table; a publisher whose heartbeat is older than
+        ``stale_timeout`` seconds (or absent) is skipped — its snapshot
+        may predate the very failure the requester is recovering from.
+        ``stale_timeout <= 0`` disables the liveness filter (tests, or
+        single-controller setups that prune slots themselves).
+        """
+        now = _time.monotonic()
+        best = None
+        with self._lock:
+            for rank, (step, blob, _ts) in self._slots.items():
+                if rank == int(exclude_rank):
+                    continue
+                if stale_timeout > 0:
+                    hb = heartbeats.get(rank)
+                    if hb is None or (now - hb) > stale_timeout:
+                        continue
+                if best is None or step > best[1]:
+                    best = (rank, step, blob)
+        return best
+
+    def drop(self, rank):
+        with self._lock:
+            self._slots.pop(int(rank), None)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._slots)
 
 
 class KVStoreServer:

@@ -633,6 +633,16 @@ register_signature_token("MXTPU_HEALTH_ACTION", "record")
 # land on a fresh cache key, never replay the other program
 register_signature_token("MXTPU_CE_LOCAL_ACCUM", "auto")
 register_signature_token("MXTPU_GSPMD_STEP", "1")
+# zero-badput legs (ISSUE 19): the persistent AOT compile cache keys
+# serialized executables by the FULL token-registry snapshot, so every
+# switch that gates one of the three legs must itself be a token — a
+# cache entry written under one setting can then never be replayed
+# under another (the same stale-replay class MX014 polices for traced
+# graphs, applied to on-disk executables)
+register_signature_token("MXTPU_CKPT_ASYNC", "0")
+register_signature_token("MXTPU_CKPT_DELTA", "0")
+register_signature_token("MXTPU_COMPILE_CACHE_DIR", "")
+register_signature_token("MXTPU_PEER_RESTORE", "0")
 
 # back-compat spelling (PR 9 introduced the kernel-env tuple under this
 # name; the registry supersedes it)

@@ -38,10 +38,13 @@ fault points make the whole cycle chaos-testable
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
 import logging
 import os
 import pickle
 import signal
+import threading
 import time
 
 import jax
@@ -57,7 +60,8 @@ from ..base import getenv as _getenv
 
 __all__ = ["CheckpointManager", "elastic_train_loop", "PreemptionGuard",
            "ElasticController", "HostGradReducer", "ReshardRequired",
-           "shard_for_rank"]
+           "shard_for_rank", "publish_peer_snapshot",
+           "restore_from_peer"]
 
 # commit marker inside an orbax step dir: present iff the save ran to
 # completion (written before the atomic rename publishes the dir). A
@@ -87,9 +91,38 @@ class CheckpointManager:
     publication is temp-write + atomic rename, completeness is provable
     after the fact (commit marker / unpickle check), and `restore()`
     walks past corrupt candidates to the newest complete step.
+
+    Zero-badput legs (ISSUE 19a):
+
+    - ``async_persist`` (``MXTPU_CKPT_ASYNC``): ``save()`` splits into
+      snapshot-then-persist. The blocking half is only the device→host
+      copy — jax blocks that copy until the producing (donated) step's
+      outputs are committed, which is exactly the safe point the memory
+      ledger's donation-aware retirement tracks — and the temp-write +
+      atomic rename + prune run on a background persist thread. At most
+      ONE persist is in flight: when the writer falls behind, the next
+      ``save()`` blocks on it (visible backpressure, counted as
+      ``elastic.checkpoint_backpressure``) instead of queueing
+      snapshots without bound. A persist failure is remembered and
+      raised from the NEXT ``save()``/``flush()``; the ``checkpoint.
+      persist`` faultpoint fires on the persist thread between snapshot
+      and publish, so chaos tests prove a crash there loses only the
+      unpublished step.
+    - ``delta`` (``MXTPU_CKPT_DELTA``, pickle format only): a save
+      whose pytree structure matches the previous FULL snapshot and
+      whose changed-leaf fraction is ≤ 1/2 writes only the changed
+      leaves plus a one-hop base reference (never delta-of-delta). The
+      base step is pinned by a ``.base`` sidecar so ``_prune`` keeps it
+      alive as long as any kept delta needs it.
     """
 
-    def __init__(self, directory, keep=3, use_orbax=None):
+    # a delta payload referencing a base whose changed-leaf fraction
+    # exceeds this writes a full snapshot instead (a delta carrying
+    # most of the state costs full price plus a restore indirection)
+    _DELTA_MAX_CHANGED = 0.5
+
+    def __init__(self, directory, keep=3, use_orbax=None,
+                 async_persist=None, delta=None):
         self.directory = os.path.abspath(str(directory))
         os.makedirs(self.directory, exist_ok=True)
         self.keep = int(keep)
@@ -103,6 +136,27 @@ class CheckpointManager:
         if self._orbax:
             import orbax.checkpoint as ocp
             self._ckptr = ocp.PyTreeCheckpointer()
+        if async_persist is None:
+            async_persist = _getenv("MXTPU_CKPT_ASYNC", "0") \
+                not in ("0", "false", "off")
+        self.async_persist = bool(async_persist)
+        if delta is None:
+            delta = _getenv("MXTPU_CKPT_DELTA", "0") \
+                not in ("0", "false", "off")
+        # delta dedup rides the pickle payload format; orbax step dirs
+        # always hold full snapshots
+        self.delta = bool(delta) and not self._orbax
+        self._persist_thread = None
+        self._persist_step = None   # step an in-flight persist publishes
+        self._persist_exc = None    # surfaced on the next save()/flush()
+        self._persist_lock = threading.Lock()
+        self.backpressure_waits = 0
+        # delta state: step + per-leaf digests + structure of the last
+        # successfully PUBLISHED full snapshot (adopted by the persist,
+        # never by the snapshot, so a failed publish can't become a base)
+        self._base_step = None
+        self._base_digests = None
+        self._base_treedef = None
 
     # -- paths --------------------------------------------------------------
     def _step_path(self, step):
@@ -159,18 +213,146 @@ class CheckpointManager:
         an injected (or real) crash mid-save leaves every previously
         published step restorable and at worst a `.tmp` leftover or a
         marker-less dir, which `all_steps()` never considers and the
-        next `save()` prunes."""
+        next `save()` prunes.
+
+        With ``async_persist`` only the device→host snapshot (plus any
+        backpressure wait on a still-running previous persist) blocks
+        here; the write/rename/prune half runs on the persist thread
+        and a failure there surfaces from the NEXT call."""
         t0 = time.monotonic()
+        self._raise_persist_error()
+        if self.async_persist:
+            # at-most-one in-flight persist: block on the previous one
+            # BEFORE taking the snapshot, so the backpressure wait is
+            # visible badput on this save, never an unbounded queue
+            t = self._persist_thread
+            if t is not None and t.is_alive():
+                self.backpressure_waits += 1
+                _profiler.bump_elastic("checkpoint_backpressure",
+                                       args={"step": int(step)})
+                t.join()
+                self._raise_persist_error()
+        host_state = self._snapshot(state)
+        job = self._encode(step, host_state)
+        if not self.async_persist:
+            self._persist(step, job)
+            self._prune()
+            dur_s = time.monotonic() - t0
+            _profiler.record_op("elastic.checkpoint_save", dur_s * 1e6,
+                                category="elastic", lane="user",
+                                args={"step": int(step)})
+            if _goodput.OPEN:
+                _goodput.note_checkpoint(dur_s, "save")
+            return self._step_path(step)
+        with self._persist_lock:
+            self._persist_step = int(step)
+        th = threading.Thread(target=self._persist_bg,
+                              args=(int(step), job),
+                              name="mxtpu-ckpt-persist", daemon=True)
+        self._persist_thread = th
+        th.start()
+        # only the blocking half books under 'checkpoint': the persist
+        # overlaps training and reports through note_checkpoint(persist)
+        dur_s = time.monotonic() - t0
+        _profiler.record_op("elastic.checkpoint_snapshot", dur_s * 1e6,
+                            category="elastic", lane="user",
+                            args={"step": int(step)})
+        if _goodput.OPEN:
+            _goodput.note_checkpoint(dur_s, "save")
+        return self._step_path(step)
+
+    def _snapshot(self, state):
+        """Device→host copy of every leaf. jax blocks the copy until
+        the producing step's (donated) outputs are committed — the safe
+        point. Async mode additionally copies host-resident numpy
+        leaves (``host_array`` passes those through by reference, and
+        the persist thread must never race the caller mutating them).
+        The host copies register under the memory ledger's
+        ``checkpoint`` tag so the extra resident set the async path
+        holds while persisting is attributed, not invisible."""
+        def _leaf(a):
+            h = host_array(a)
+            if self.async_persist and h is a \
+                    and isinstance(a, np.ndarray):
+                h = np.array(a, copy=True)
+            return h
+        host_state = jax.tree_util.tree_map(_leaf, state)
+        try:
+            from .. import storage as _storage
+            _storage.ledger_register_tree(
+                [l for l in jax.tree_util.tree_leaves(host_state)
+                 if isinstance(l, np.ndarray)],
+                "checkpoint", site="elastic.snapshot")
+        except Exception:
+            pass  # attribution only; the snapshot itself is committed
+        return host_state
+
+    @staticmethod
+    def _digest(leaf):
+        try:
+            a = np.asarray(leaf)
+            return hashlib.sha1(
+                a.tobytes() + str((a.shape, a.dtype)).encode()
+            ).hexdigest()
+        except Exception:
+            return hashlib.sha1(pickle.dumps(leaf)).hexdigest()
+
+    def _encode(self, step, host_state):
+        """Decide the payload for one save: ``(payload, kind, base,
+        treedef, digests)``. Delta mode compares per-leaf digests to
+        the last published FULL snapshot; a structure change or a
+        changed fraction beyond the cap falls back to a full write."""
+        if not self.delta:
+            return (host_state, "full", None, None, None)
+        leaves, treedef = jax.tree_util.tree_flatten(host_state)
+        digests = [self._digest(l) for l in leaves]
+        if self._base_digests is not None \
+                and treedef == self._base_treedef \
+                and len(digests) == len(self._base_digests):
+            changed = {i: leaves[i] for i, d in enumerate(digests)
+                       if d != self._base_digests[i]}
+            if len(changed) <= self._DELTA_MAX_CHANGED * max(
+                    1, len(leaves)):
+                payload = {"__mxtpu_delta__": 1,
+                           "base": int(self._base_step),
+                           "n": len(leaves), "leaves": changed}
+                return (payload, "delta", int(self._base_step),
+                        treedef, None)
+        return (host_state, "full", None, treedef, digests)
+
+    def _write_sidecar(self, step, base):
+        """Pin a delta's base step in a crash-safe ``.base`` sidecar —
+        written BEFORE the delta publishes (an orphan sidecar of an
+        unpublished step is inert and pruned), read by ``_prune`` so a
+        kept delta's base survives the keep policy."""
+        p = self._step_path(step) + ".base"
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("%d\n" % int(base))
+        os.replace(tmp, p)
+
+    def _delta_base_of(self, step):
+        try:
+            with open(self._step_path(step) + ".base") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _persist(self, step, job):
+        """The durable half: temp-write + atomic rename + commit
+        marker. Runs inline (sync mode) or on the persist thread."""
+        payload, kind, base, treedef, digests = job
         path = self._step_path(step)
         tmp = path + ".tmp"
-        host_state = jax.tree_util.tree_map(host_array, state)
         try:
+            if kind == "delta":
+                self._write_sidecar(step, base)
             if self._orbax:
                 # orbax refuses to overwrite; write then atomic-rename
                 import shutil
                 if os.path.exists(tmp):
                     shutil.rmtree(tmp)
-                self._ckptr.save(tmp, host_state)
+                self._ckptr.save(tmp, payload)
                 with open(os.path.join(tmp, _COMMIT), "w") as f:
                     f.write("%d\n" % int(step))
                 if _faultpoint.ACTIVE:
@@ -180,7 +362,7 @@ class CheckpointManager:
                 os.replace(tmp, path)
             else:
                 with open(tmp, "wb") as f:
-                    pickle.dump(host_state, f)
+                    pickle.dump(payload, f)
                 if _faultpoint.ACTIVE:
                     _faultpoint.check("checkpoint.save")
                 os.replace(tmp, path)
@@ -194,27 +376,72 @@ class CheckpointManager:
             except OSError:
                 pass
             raise
+        # base bookkeeping only AFTER the publish committed — a failed
+        # persist must never become the base a later delta references
+        if kind == "full" and digests is not None:
+            self._base_step = int(step)
+            self._base_digests = digests
+            self._base_treedef = treedef
         _profiler.bump_elastic("checkpoint_saves",
-                               args={"step": int(step)})
-        self._prune()
-        # checkpoint span (rare path — its own clock reads are fine):
-        # the trace lane sees it while profiling runs, the flight
-        # recorder always, and the goodput run ledger books the wall
-        # time under 'checkpoint'
+                               args={"step": int(step), "kind": kind})
+
+    def _persist_bg(self, step, job):
+        """Persist-thread body: faultpoint (the snapshot→persist gap —
+        a crash here loses exactly one unpublished step), publish,
+        clear the in-flight marker, then re-prune (the prune that was
+        skipped while this step was in flight)."""
+        t0 = time.monotonic()
+        try:
+            if _faultpoint.ACTIVE:
+                _faultpoint.check("checkpoint.persist")
+            self._persist(step, job)
+        except BaseException as e:  # surfaced on next save()/flush()
+            with self._persist_lock:
+                self._persist_exc = e
+                self._persist_step = None
+            _profiler.bump_elastic("persist_failures",
+                                   args={"step": int(step)})
+            return
+        with self._persist_lock:
+            self._persist_step = None
         dur_s = time.monotonic() - t0
-        _profiler.record_op("elastic.checkpoint_save", dur_s * 1e6,
+        _profiler.record_op("elastic.checkpoint_persist", dur_s * 1e6,
                             category="elastic", lane="user",
                             args={"step": int(step)})
         if _goodput.OPEN:
-            _goodput.note_checkpoint(dur_s, "save")
-        return path
+            _goodput.note_checkpoint(dur_s, "persist")
+        self._prune()
+
+    def flush(self, raise_error=True):
+        """Block until any in-flight persist published (or failed);
+        with ``raise_error`` re-raise a recorded persist failure. Call
+        before relying on ``latest_step()`` durability (loop exits,
+        preemption drains)."""
+        t = self._persist_thread
+        if t is not None:
+            t.join()
+            self._persist_thread = None
+        if raise_error:
+            self._raise_persist_error()
+
+    def _raise_persist_error(self):
+        with self._persist_lock:
+            e, self._persist_exc = self._persist_exc, None
+        if e is not None:
+            raise RuntimeError(
+                "async checkpoint persist failed: %s: %s"
+                % (type(e).__name__, e)) from e
 
     def restore(self, step=None):
         """Load the pytree for `step` (newest when None); (None, None)
         when nothing restorable exists. With `step=None` the walk skips
         entries that fail to load (corruption the cheap completeness
         probe missed) and falls back to the next-older complete step —
-        counted as ``elastic.incomplete_skipped``."""
+        counted as ``elastic.incomplete_skipped``. An in-flight async
+        persist is drained first so the newest step is visible; a
+        recorded persist FAILURE does not fail the restore (the walk
+        simply lands on the newest step that did publish)."""
+        self.flush(raise_error=False)
         if _faultpoint.ACTIVE:
             # the restore seam: an injected raise here exercises the
             # caller's recovery path exactly where a real read failure
@@ -234,7 +461,18 @@ class CheckpointManager:
             return state, s
 
         if step is not None:
-            state = self._load(self._step_path(step))
+            path = self._step_path(step)
+            if not self._is_complete(path):
+                # same clear verdict the step=None walk gives: an
+                # incomplete/marker-less candidate is NOT restorable —
+                # without this probe a raw deserialize error (orbax
+                # missing-file, pickle EOF) leaks instead
+                raise FileNotFoundError(
+                    "checkpoint step %d is incomplete or missing (%s): "
+                    "no commit marker / truncated payload — it was "
+                    "never published; pass step=None to restore the "
+                    "newest complete step" % (int(step), path))
+            state = self._load(path)
             _profiler.bump_elastic("restores", args={"step": int(step)})
             return _done(state, int(step))
         for s in reversed(self.all_steps()):
@@ -254,13 +492,36 @@ class CheckpointManager:
         if self._orbax:
             return self._ckptr.restore(path)
         with open(path, "rb") as f:
-            return pickle.load(f)
+            obj = pickle.load(f)
+        if isinstance(obj, dict) and obj.get("__mxtpu_delta__") == 1:
+            # one-hop delta: the base is always a FULL snapshot
+            with open(self._step_path(obj["base"]), "rb") as f:
+                base_state = pickle.load(f)
+            if isinstance(base_state, dict) \
+                    and base_state.get("__mxtpu_delta__") == 1:
+                raise ValueError(
+                    "delta checkpoint base step %d is itself a delta "
+                    "(corrupt chain; deltas are one-hop by contract)"
+                    % obj["base"])
+            leaves, treedef = jax.tree_util.tree_flatten(base_state)
+            if len(leaves) != obj["n"]:
+                raise ValueError(
+                    "delta checkpoint leaf count %d does not match "
+                    "base %d" % (obj["n"], len(leaves)))
+            for i, v in obj["leaves"].items():
+                leaves[int(i)] = v
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+        return obj
 
     def _prune(self):
         """Drop steps beyond `keep` AND every incomplete leftover — a
         `.tmp` from an interrupted save, a marker-less orbax dir, a
         truncated fallback file (the crashed sibling of the step that
-        just published)."""
+        just published). Two steps are NEVER touched: the step a
+        concurrent async persist is about to publish (its `.tmp` is
+        being written right now; the persist re-prunes on completion),
+        and the full base any kept delta references (pinned by its
+        `.base` sidecar — pruning it would orphan the delta)."""
         import shutil
 
         def _rm(p):
@@ -272,23 +533,49 @@ class CheckpointManager:
             except OSError:
                 pass
 
+        with self._persist_lock:
+            inflight = self._persist_step
         complete = set(self.all_steps())
+        kept = set(sorted(complete)[-self.keep:]) if self.keep > 0 \
+            else set(complete)
+        if inflight is not None:
+            kept.add(inflight)
+        protected = set(kept)
+        for s in list(kept):
+            b = self._delta_base_of(s)
+            if b is not None:
+                protected.add(b)
         for n in os.listdir(self.directory):
             if not n.startswith("step_"):
                 continue
             p = os.path.join(self.directory, n)
+            try:
+                s = int(n[5:].split(".")[0])
+            except ValueError:
+                continue
+            if s == inflight:
+                continue
             if n.endswith(".tmp"):
                 _rm(p)
+                continue
+            if s not in complete and not n.endswith(".base"):
+                _rm(p)
+        for s in sorted(complete):
+            if s in protected:
+                continue
+            _rm(self._step_path(s))
+            _rm(self._step_path(s) + ".base")
+        # sidecars whose step vanished (pruned above or crashed before
+        # publishing) are dead weight once no kept step needs them
+        for n in os.listdir(self.directory):
+            if not n.endswith(".base"):
                 continue
             try:
                 s = int(n[5:].split(".")[0])
             except ValueError:
                 continue
-            if s not in complete:
-                _rm(p)
-        steps = sorted(complete)
-        for s in steps[:-self.keep] if self.keep > 0 else []:
-            _rm(self._step_path(s))
+            if s != inflight and s not in complete:
+                _rm(os.path.join(self.directory, n))
 
 
 class PreemptionGuard:
@@ -562,6 +849,82 @@ def shard_for_rank(n_items, world, rank):
     return range(start, stop)
 
 
+def _peer_restore_enabled():
+    return _getenv("MXTPU_PEER_RESTORE", "0") not in ("0", "false",
+                                                      "off")
+
+
+def _snapshot_secret():
+    s = _getenv("MXTPU_PS_SECRET", "")
+    return s.encode() if s else None
+
+
+def publish_peer_snapshot(kv, step, state):
+    """Publish this rank's state to the kvstore snapshot table (ISSUE
+    19c) so a recovering peer can restore from our in-memory replica
+    instead of the filesystem. Best-effort: the blob is the pickled
+    host-staged payload with an HMAC-SHA256 prefix under
+    ``MXTPU_PS_SECRET`` (the ``set_optimizer`` authentication idiom —
+    the server never unpickles, only the restoring CLIENT does, after
+    verifying the MAC). Returns True on publish; every failure path is
+    counted, never raised — losing a snapshot costs a fallback to the
+    filesystem, not the step."""
+    put = getattr(kv, "publish_snapshot", None)
+    secret = _snapshot_secret()
+    if put is None or secret is None:
+        return False
+    try:
+        host = jax.tree_util.tree_map(host_array, state)
+        body = pickle.dumps((int(step), host),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        mac = hmac.new(secret, body, hashlib.sha256).digest()
+        put(int(step), mac + body)
+        return True
+    except Exception:
+        _profiler.bump_elastic("peer_snapshot_failures",
+                               args={"step": int(step)})
+        return False
+
+
+def restore_from_peer(kv):
+    """Ask a live peer for its newest published snapshot; returns
+    ``(state, step)`` or ``None`` (fall back to the filesystem —
+    counted as ``elastic.peer_restore_fallbacks``). ``None`` covers an
+    old server without the snapshot opcode (its ``_RE_ERR`` reply
+    surfaces as the RuntimeError caught here — the v0/v1 interop
+    contract), no live publisher, and a MAC mismatch (an
+    unauthenticated blob must never reach ``pickle.loads``)."""
+    get = getattr(kv, "peer_snapshot", None)
+    secret = _snapshot_secret()
+    if get is None or secret is None:
+        return None
+
+    def _fallback(why):
+        _profiler.bump_elastic("peer_restore_fallbacks",
+                               args={"why": why})
+        return None
+
+    try:
+        got = get()
+    except Exception:
+        return _fallback("transport")
+    if not got:
+        return _fallback("no_snapshot")
+    peer_rank, step, blob = got
+    mac, body = bytes(blob[:32]), bytes(blob[32:])
+    want = hmac.new(secret, body, hashlib.sha256).digest()
+    if not hmac.compare_digest(mac, want):
+        return _fallback("hmac_mismatch")
+    try:
+        sstep, host = pickle.loads(body)
+    except Exception:
+        return _fallback("decode")
+    _profiler.bump_elastic("peer_restores",
+                           args={"step": int(sstep),
+                                 "peer": int(peer_rank)})
+    return host, int(sstep)
+
+
 def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                        max_failures=3, on_restore=None, logger=None,
                        controller=None, data_service=None):
@@ -648,17 +1011,41 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
             _goodput.close_run(outcome="failed")
         raise
 
-    def _save(step):
-        payload = state
+    # peer-restore (ISSUE 19c): publish this rank's state to the
+    # kvstore snapshot table so a recovering peer restores from a live
+    # replica before touching the filesystem. Cadence defaults to every
+    # step — the publish is one wire round trip of host-staged state,
+    # cheap next to a durable write, and a tight cadence is what
+    # shrinks the peer path's rewind_replay below the filesystem's.
+    peer_kv = controller.kv if controller is not None else None
+    peer_on = _peer_restore_enabled() and peer_kv is not None \
+        and hasattr(peer_kv, "publish_snapshot")
+    peer_every = max(1, int(_getenv("MXTPU_PEER_SNAPSHOT_EVERY", "1")))
+
+    def _payload():
         if data_service is not None:
             # the cursor rides INSIDE the params payload: one
             # temp+rename publishes both, so no crash instant can
             # leave params@step paired with an older cursor (which
             # would replay already-trained samples on resume)
-            payload = {"__elastic_state__": state,
-                       "__data_cursor__":
-                           data_service.cursor_for_checkpoint()}
-        ckpt.save(step, payload)
+            return {"__elastic_state__": state,
+                    "__data_cursor__":
+                        data_service.cursor_for_checkpoint()}
+        return state
+
+    def _save(step):
+        ckpt.save(step, _payload())
+
+    def _flush_ckpt():
+        # async-persist drain at loop exits: the durability point the
+        # caller observes. Failures are logged, never raised over the
+        # loop's own exit path.
+        fl = getattr(ckpt, "flush", None)
+        if callable(fl):
+            try:
+                fl()
+            except Exception as e:
+                log.warning("elastic: checkpoint flush failed: %s", e)
 
     def _recover(need_reshard):
         """Reshard (when attributed to a dead rank) then rewind to the
@@ -672,11 +1059,14 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
         nonlocal state
         _goodput.recovery_begin()
         resharded = False
+        via_peer = False
         s0 = None
         ok = False
         try:
+            can_rewind = ckpt.latest_step() is not None \
+                or (peer_on and hi >= start)
             if need_reshard and controller is not None:
-                if ckpt.latest_step() is None:
+                if not can_rewind:
                     # nothing to rewind to: bail BEFORE the reshard
                     # commits a shrunk world the caller can't resume
                     # into
@@ -689,7 +1079,18 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                     # so every survivor computes the identical new
                     # ownership
                     data_service.resize(survivors)
-            restored, s0 = ckpt.restore()
+            restored = None
+            if peer_on:
+                # a live peer's in-memory replica beats the filesystem
+                # twice: no durable-read latency, and a tighter publish
+                # cadence rewinds fewer steps. Every miss falls back to
+                # the filesystem, counted.
+                got = restore_from_peer(peer_kv)
+                if got is not None:
+                    restored, s0 = got
+                    via_peer = True
+            if restored is None:
+                restored, s0 = ckpt.restore()
             if restored is None:
                 return None
             state = _retree(state, _unwrap(restored))
@@ -700,7 +1101,8 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
         finally:
             _watchdog.reset_window()
             _goodput.recovery_end(
-                kind="reshard" if resharded else "restore",
+                kind="peer" if via_peer
+                else ("reshard" if resharded else "restore"),
                 resharded=resharded,
                 restored_step=s0 if ok else None,
                 replay_span=max(0, hi - s0) if ok else 0, ok=ok)
@@ -715,6 +1117,7 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                     last = i - 1
                     if i > start or restored is not None:
                         _save(last)
+                        _flush_ckpt()  # drain: the exit must be durable
                     _profiler.bump_elastic("preemptions",
                                            args={"step": last})
                     _goodput.note_event("preemption", step=last)
@@ -783,12 +1186,15 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
                 hi = max(hi, i)
                 if save_every and i % save_every == 0:
                     _save(i)
+                if peer_on and i % peer_every == 0:
+                    publish_peer_snapshot(peer_kv, i, _payload())
                 i += 1
     except BaseException:
         if own_run is not None:
             _goodput.close_run(outcome="failed")
             own_run = None
         raise
+    _flush_ckpt()
     if own_run is not None:
         _goodput.close_run(outcome="completed")
     return state, len(batches) - 1, True

@@ -232,7 +232,7 @@ def counters():
 # ---------------------------------------------------------------------------
 
 LEDGER_TAGS = ("param", "grad", "opt_state", "activation", "io",
-               "workspace", "other")
+               "workspace", "checkpoint", "other")
 
 _LEDGER_ON = _getenv("MXTPU_MEMLEDGER", "1") not in ("0", "false", "off")
 # emergency bound per pending deque: maxlen drops OLDEST registrations if

@@ -1,0 +1,410 @@
+"""Async snapshot-then-persist checkpoints + delta dedup (ISSUE 19a;
+mxnet_tpu/parallel/elastic.py CheckpointManager).
+
+Four halves:
+
+* async persist semantics — save() blocks only for the device→host
+  snapshot; the durable temp-write + atomic rename + commit runs on a
+  background thread with at-most-one in flight, backpressure counted
+  when the writer falls behind, and a persist failure surfacing on the
+  NEXT save()/flush(), never silently;
+* crash consistency — the ``checkpoint.persist`` faultpoint (the
+  snapshot→persist gap) proves a death there loses exactly the one
+  unpublished step: every previously PUBLISHED step stays restorable;
+* delta checkpoints — unchanged-leaf dedup vs the last published full
+  snapshot, one-hop restore, ``.base`` sidecar pinning the base past
+  the keep policy, full fallback on structure change or >50% churn;
+* the two satellite bugfixes — restore(step=N) probes completeness
+  before loading (clear FileNotFoundError, not a raw pickle EOF), and
+  _prune is in-flight-aware (never deletes the step a concurrent async
+  persist is about to publish; the persist re-prunes on completion);
+
+plus the chaos acceptance pair: a run killed between snapshot and
+persist resumes from the newest published step with the lost work
+booked under recovery, bitwise-identical to an unfaulted twin, while a
+fault-free async twin's blocking ``checkpoint`` seconds drop vs the
+sync baseline at equal cadence.
+"""
+import os
+import pickle
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu import profiler
+from mxnet_tpu._debug import faultpoint, goodput, watchdog
+from mxnet_tpu.parallel.elastic import CheckpointManager, \
+    elastic_train_loop
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_RUNS_DIR", str(tmp_path / "runs"))
+    monkeypatch.delenv("MXTPU_CKPT_ASYNC", raising=False)
+    monkeypatch.delenv("MXTPU_CKPT_DELTA", raising=False)
+    goodput.reset()
+    watchdog.reset()
+    faultpoint.reset()
+    yield
+    faultpoint.reset()
+    goodput.reset()
+    watchdog.reset()
+
+
+def _state(a=1.0, b=2.0):
+    return {"w": jnp.asarray([a, a]), "m": jnp.asarray([b])}
+
+
+def _mgr(tmp_path, **kw):
+    kw.setdefault("use_orbax", False)
+    return CheckpointManager(str(tmp_path / "ck"), **kw)
+
+
+def _leaves_equal(x, y):
+    import jax
+    xs = jax.tree_util.tree_leaves(x)
+    ys = jax.tree_util.tree_leaves(y)
+    assert len(xs) == len(ys)
+    return all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(xs, ys))
+
+
+class TestAsyncPersist:
+    def test_save_blocks_only_for_snapshot(self, tmp_path):
+        """With a 200ms stall injected into the durable write, the
+        async save() returns long before the persist finishes; flush()
+        is the durability point where the step becomes restorable."""
+        m = _mgr(tmp_path, async_persist=True)
+        faultpoint.configure("checkpoint.save=delay:200ms")
+        t0 = time.monotonic()
+        m.save(0, _state())
+        blocked = time.monotonic() - t0
+        assert blocked < 0.15, blocked
+        m.flush()
+        assert m.latest_step() == 0
+        got, s = m.restore()
+        assert s == 0 and _leaves_equal(got, _state())
+
+    def test_at_most_one_inflight_with_backpressure(self, tmp_path):
+        """A second save while the previous persist is still writing
+        joins it first — visible badput on THIS save, counted, never an
+        unbounded queue of persist threads."""
+        m = _mgr(tmp_path, async_persist=True)
+        before = profiler.metrics().get("elastic", {}).get(
+            "checkpoint_backpressure", 0)
+        faultpoint.configure("checkpoint.save=delay:150ms")
+        m.save(0, _state())
+        t0 = time.monotonic()
+        m.save(1, _state(3.0))
+        waited = time.monotonic() - t0
+        assert m.backpressure_waits == 1
+        assert waited > 0.05, waited  # joined the in-flight persist
+        m.flush()
+        assert m.all_steps() == [0, 1]
+        after = profiler.metrics().get("elastic", {}).get(
+            "checkpoint_backpressure", 0)
+        assert after == before + 1
+
+    def test_snapshot_copies_host_leaves(self, tmp_path):
+        """The persist thread must never race the trainer mutating a
+        host-resident numpy leaf: async snapshots deep-copy them."""
+        m = _mgr(tmp_path, async_persist=True)
+        arr = np.ones(4, np.float32)
+        faultpoint.configure("checkpoint.save=delay:100ms")
+        m.save(0, {"w": arr})
+        arr[:] = 7.0  # trainer moves on while the persist writes
+        m.flush()
+        got, _ = m.restore()
+        assert np.array_equal(np.asarray(got["w"]),
+                              np.ones(4, np.float32))
+
+    def test_persist_failure_surfaces_on_next_save(self, tmp_path):
+        m = _mgr(tmp_path, async_persist=True)
+        m.save(0, _state())
+        m.flush()
+        before = profiler.metrics().get("elastic", {}).get(
+            "persist_failures", 0)
+        faultpoint.configure("checkpoint.persist=raise:OSError@n=1")
+        m.save(1, _state(3.0))  # returns fine; the thread dies
+        with pytest.raises(RuntimeError,
+                           match="async checkpoint persist failed"):
+            m.save(2, _state(4.0))
+        assert profiler.metrics().get("elastic", {}).get(
+            "persist_failures", 0) == before + 1
+        # the error is one-shot: the manager keeps working after
+        m.save(3, _state(5.0))
+        m.flush()
+        assert m.latest_step() == 3
+
+    def test_flush_reraises_persist_failure(self, tmp_path):
+        m = _mgr(tmp_path, async_persist=True)
+        faultpoint.configure("checkpoint.persist=raise:OSError@n=1")
+        m.save(0, _state())
+        with pytest.raises(RuntimeError,
+                           match="async checkpoint persist failed"):
+            m.flush()
+
+    def test_env_switch_arms_async(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MXTPU_CKPT_ASYNC", "1")
+        assert _mgr(tmp_path).async_persist
+        monkeypatch.setenv("MXTPU_CKPT_ASYNC", "0")
+        assert not _mgr(tmp_path).async_persist
+
+
+class TestCrashConsistency:
+    def test_crash_in_snapshot_persist_gap_keeps_published(
+            self, tmp_path):
+        """The tentpole faultpoint: a death BETWEEN snapshot and
+        persist (``checkpoint.persist``) loses exactly the one
+        unpublished step — every step that published before it stays
+        restorable, and nothing torn is left behind."""
+        m = _mgr(tmp_path, async_persist=True)
+        m.save(0, _state())
+        m.save(1, _state(3.0))
+        m.flush()
+        faultpoint.configure(
+            "checkpoint.persist=raise:RuntimeError@n=1")
+        m.save(2, _state(9.0))
+        m.flush(raise_error=False)
+        assert m.all_steps() == [0, 1]
+        got, s = m.restore()
+        assert s == 1 and _leaves_equal(got, _state(3.0))
+        # no torn artifact for step 2: the faultpoint fired before the
+        # temp write began, and a mid-write crash leaves only a .tmp
+        # that all_steps()/restore() never consider
+        assert not m._is_complete(m._step_path(2))
+
+    def test_crash_mid_durable_write_keeps_published(self, tmp_path):
+        """Same contract one layer deeper: a crash between temp-write
+        and rename (``checkpoint.save`` inside the persist thread)
+        leaves a .tmp leftover, never a half-published step."""
+        m = _mgr(tmp_path, async_persist=True)
+        m.save(0, _state())
+        m.flush()
+        faultpoint.configure("checkpoint.save=raise:OSError@n=1")
+        m.save(1, _state(3.0))
+        m.flush(raise_error=False)
+        assert m.all_steps() == [0]
+        got, s = m.restore()
+        assert s == 0 and _leaves_equal(got, _state())
+
+
+class TestDelta:
+    def test_delta_roundtrip_and_sidecar(self, tmp_path):
+        m = _mgr(tmp_path, async_persist=False, delta=True)
+        s0 = _state(1.0, 2.0)
+        m.save(0, s0)
+        s1 = {"w": s0["w"], "m": jnp.asarray([7.0])}  # one leaf changed
+        m.save(1, s1)
+        with open(m._step_path(1), "rb") as f:
+            raw = pickle.load(f)
+        assert raw.get("__mxtpu_delta__") == 1 and raw["base"] == 0
+        assert len(raw["leaves"]) == 1  # only the changed leaf shipped
+        assert m._delta_base_of(1) == 0  # .base sidecar pins the base
+        got, s = m.restore()
+        assert s == 1 and _leaves_equal(got, s1)
+
+    def test_big_churn_falls_back_to_full(self, tmp_path):
+        m = _mgr(tmp_path, async_persist=False, delta=True)
+        m.save(0, _state(1.0, 2.0))
+        s1 = _state(5.0, 6.0)  # 2/2 leaves changed > the 50% cap
+        m.save(1, s1)
+        with open(m._step_path(1), "rb") as f:
+            raw = pickle.load(f)
+        assert not (isinstance(raw, dict)
+                    and raw.get("__mxtpu_delta__"))
+        # the new full snapshot becomes the base for later deltas
+        s2 = {"w": s1["w"], "m": jnp.asarray([9.0])}
+        m.save(2, s2)
+        assert m._delta_base_of(2) == 1
+
+    def test_structure_change_falls_back_to_full(self, tmp_path):
+        m = _mgr(tmp_path, async_persist=False, delta=True)
+        m.save(0, _state())
+        s1 = {"w": jnp.asarray([1.0, 1.0]), "m": jnp.asarray([2.0]),
+              "extra": jnp.asarray([0.0])}
+        m.save(1, s1)
+        with open(m._step_path(1), "rb") as f:
+            raw = pickle.load(f)
+        assert not (isinstance(raw, dict)
+                    and raw.get("__mxtpu_delta__"))
+        got, s = m.restore()
+        assert s == 1 and _leaves_equal(got, s1)
+
+    def test_keep_policy_pins_delta_base(self, tmp_path):
+        """keep=2 would normally drop step 0, but steps 1 and 2 are
+        deltas over it — the .base sidecar protects the full base, so
+        every kept delta stays restorable."""
+        m = _mgr(tmp_path, async_persist=False, delta=True, keep=2)
+        s0 = _state(1.0, 2.0)
+        m.save(0, s0)
+        for i, v in ((1, 7.0), (2, 8.0)):
+            m.save(i, {"w": s0["w"], "m": jnp.asarray([v])})
+        assert m.all_steps() == [0, 1, 2]  # 0 pinned by the deltas
+        got, s = m.restore(step=1)
+        assert s == 1 and np.asarray(got["m"])[0] == 7.0
+
+    def test_failed_publish_never_becomes_base(self, tmp_path):
+        """A full snapshot whose persist DIED must not be the base a
+        later delta references — the delta would be unrestorable."""
+        m = _mgr(tmp_path, async_persist=True, delta=True)
+        m.save(0, _state(1.0, 2.0))
+        m.flush()
+        faultpoint.configure(
+            "checkpoint.persist=raise:RuntimeError@n=1")
+        m.save(1, _state(5.0, 6.0))  # full (all leaves changed), dies
+        m.flush(raise_error=False)
+        faultpoint.reset()
+        # the recorded failure surfaces once on the next save, then
+        # the manager keeps working
+        with pytest.raises(RuntimeError,
+                           match="async checkpoint persist failed"):
+            m.save(2, _state())
+        m.save(2, {"w": jnp.asarray([1.0, 1.0]),
+                   "m": jnp.asarray([9.0])})
+        m.flush()
+        assert m._delta_base_of(2) in (None, 0)  # never the dead 1
+        got, s = m.restore()
+        assert s == 2 and np.asarray(got["m"])[0] == 9.0
+
+
+class TestSatelliteBugfixes:
+    def test_restore_explicit_step_missing_is_clear(self, tmp_path):
+        """Satellite 1: restore(step=N) for a step that never published
+        gives the same clear verdict the step=None walk gets, not a raw
+        deserialize error."""
+        m = _mgr(tmp_path)
+        m.save(0, _state())
+        with pytest.raises(FileNotFoundError,
+                           match="incomplete or missing"):
+            m.restore(step=5)
+
+    def test_restore_explicit_step_truncated_is_clear(self, tmp_path):
+        m = _mgr(tmp_path)
+        m.save(3, _state())
+        with open(m._step_path(3), "rb") as f:
+            whole = f.read()
+        with open(m._step_path(3), "wb") as f:
+            f.write(whole[:-1])  # crash mid-write: no STOP opcode
+        with pytest.raises(FileNotFoundError,
+                           match="incomplete or missing"):
+            m.restore(step=3)
+
+    def test_prune_skips_inflight_persist_step(self, tmp_path):
+        """Satellite 2, unit half: a prune running while step 9's
+        persist is in flight must not delete its artifacts (the .tmp
+        being written right now); once nothing is in flight the same
+        leftovers are swept."""
+        m = _mgr(tmp_path, keep=1)
+        m.save(0, _state())
+        tmp9 = m._step_path(9) + ".tmp"
+        with open(tmp9, "wb") as f:
+            f.write(b"partial")
+        m._persist_step = 9
+        m._prune()
+        assert os.path.exists(tmp9)  # in flight: untouched
+        m._persist_step = None
+        m._prune()
+        assert not os.path.exists(tmp9)  # stale leftover: swept
+
+    def test_concurrent_prune_during_persist_end_to_end(self, tmp_path):
+        """Satellite 2, interleaved half: prune fired from the main
+        thread while the persist thread is mid-write; the in-flight
+        step still publishes, and the persist's own re-prune then
+        applies the keep policy."""
+        m = _mgr(tmp_path, async_persist=True, keep=1)
+        m.save(0, _state())
+        m.flush()
+        faultpoint.configure("checkpoint.save=delay:200ms")
+        m.save(1, _state(3.0))
+        m._prune()  # concurrent with the in-flight persist of step 1
+        faultpoint.reset()
+        m.flush()
+        assert m.all_steps() == [1]  # published, then re-pruned 0
+        got, s = m.restore()
+        assert s == 1 and _leaves_equal(got, _state(3.0))
+
+
+def _sleep_step(state, b):
+    time.sleep(0.02)
+    return {"acc": state["acc"] + b}, None
+
+
+class TestChaosAcceptancePair:
+    def test_kill_between_snapshot_and_persist_books_lost_work(
+            self, tmp_path):
+        """Satellite 3: incarnation 1 dies between snapshot and persist
+        (the persist failure surfaces on the next save, felling the
+        loop exactly like a process death would). Incarnation 2 resumes
+        from the newest PUBLISHED step, books the resume under
+        ``recovery``, and finishes bitwise-identical to an unfaulted
+        twin."""
+        batches = [jnp.asarray(float(i)) for i in range(8)]
+        twin, _, done = elastic_train_loop(
+            _sleep_step, {"acc": jnp.asarray(0.0)}, batches,
+            CheckpointManager(str(tmp_path / "twin"), use_orbax=False),
+            save_every=2)
+        assert done
+
+        ck = CheckpointManager(str(tmp_path / "ck"), use_orbax=False,
+                               async_persist=True)
+        # save@0 publishes; save@2's persist dies in the gap; save@4
+        # surfaces the failure and fells incarnation 1
+        faultpoint.configure(
+            "checkpoint.persist=raise:RuntimeError@skip=1@n=1")
+        with pytest.raises(RuntimeError,
+                           match="async checkpoint persist failed"):
+            elastic_train_loop(
+                _sleep_step, {"acc": jnp.asarray(0.0)}, batches, ck,
+                save_every=2)
+        faultpoint.reset()
+        assert goodput.last_manifest()["outcome"] == "failed"
+        assert ck.all_steps() == [0]  # newest PUBLISHED step
+
+        ck2 = CheckpointManager(str(tmp_path / "ck"), use_orbax=False,
+                                async_persist=True)
+        state, last, done = elastic_train_loop(
+            _sleep_step, {"acc": jnp.asarray(0.0)}, batches, ck2,
+            save_every=2)
+        assert done and last == len(batches) - 1
+        m = goodput.last_manifest()
+        assert m["outcome"] == "completed"
+        assert m["counters"]["recoveries"] == 1
+        assert m["categories_s"]["recovery"] > 0.0
+        # resumed training is bitwise-identical to the unfaulted twin
+        assert float(state["acc"]) == float(twin["acc"])
+
+    def test_fault_free_async_twin_checkpoint_drops_vs_sync(
+            self, tmp_path):
+        """The control half: at EQUAL cadence with the same injected
+        30ms durable-write stall, the async twin's blocking
+        ``checkpoint`` seconds collapse (the stall moved off-thread
+        into ``checkpoint_persist_s``) while the sync baseline pays it
+        inline."""
+        batches = [jnp.asarray(float(i)) for i in range(6)]
+        faultpoint.configure("checkpoint.save=delay:30ms")
+        try:
+            elastic_train_loop(
+                _sleep_step, {"acc": jnp.asarray(0.0)}, batches,
+                CheckpointManager(str(tmp_path / "sync"),
+                                  use_orbax=False, async_persist=False),
+                save_every=2)
+            m_sync = goodput.last_manifest()
+            elastic_train_loop(
+                _sleep_step, {"acc": jnp.asarray(0.0)}, batches,
+                CheckpointManager(str(tmp_path / "async"),
+                                  use_orbax=False, async_persist=True),
+                save_every=2)
+            m_async = goodput.last_manifest()
+        finally:
+            faultpoint.reset()
+        sync_s = m_sync["categories_s"]["checkpoint"]
+        async_s = m_async["categories_s"]["checkpoint"]
+        assert sync_s >= 0.09  # 3 saves x 30ms paid inline
+        assert async_s < 0.5 * sync_s, (async_s, sync_s)
+        # the hidden work is accounted, not vanished
+        assert m_async["counters"]["checkpoint_persist_s"] >= 0.09
+        assert m_async["counters"]["checkpoint_saves"] == \
+            m_sync["counters"]["checkpoint_saves"]

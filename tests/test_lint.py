@@ -1661,3 +1661,97 @@ def test_mx022_from_jax_import_jit_detected(tmp_path):
                 return _jit(fn)
             """),))
     assert [f.code for f in findings] == ["MX022"]
+
+
+# -- MX023: zero-badput knob contract (ISSUE 19) -----------------------------
+
+_ZB_DOCS = """\
+# Environment variables
+
+| Variable | Default | Meaning |
+|---|---|---|
+| `MXTPU_CKPT_ASYNC` | `0` | async snapshot-then-persist checkpoints |
+| `MXTPU_COMPILE_CACHE_DIR` | unset | persistent AOT compile cache dir |
+| `MXTPU_PEER_SNAPSHOT_EVERY` | `1` | peer-snapshot publish cadence |
+"""
+
+_ZB_REGISTER = """\
+def register_signature_token(name, default=""):
+    return name
+
+register_signature_token("MXTPU_CKPT_ASYNC", "0")
+"""
+
+
+def _plant_zb_tree(tmp_path, module_rel, body):
+    _plant(tmp_path, "docs/ENV_VARS.md", _ZB_DOCS)
+    _plant(tmp_path, "mxnet_tpu/ndarray/register.py", _ZB_REGISTER)
+    _plant(tmp_path, "mxnet_tpu/base.py",
+           "def getenv(name, default=None):\n    return None\n")
+    _plant(tmp_path, module_rel, body)
+
+
+def test_mx023_doc_and_token_clauses(tmp_path):
+    """One read per contract shape in a zero-badput module: documented
+    + registered is clean, documented-but-unregistered trips the token
+    clause, an unknown knob trips both, a _CADENCE_ONLY knob needs no
+    token, and a knob outside the owned prefixes is not this rule's
+    business (MX015 already covers its doc half)."""
+    _plant_zb_tree(tmp_path, "mxnet_tpu/gluon/compile_cache.py", """\
+        from ..base import getenv as _getenv
+
+        def doc_and_registered():
+            return _getenv("MXTPU_CKPT_ASYNC", "0")        # clean
+
+        def documented_not_registered():
+            return _getenv("MXTPU_COMPILE_CACHE_DIR", "")  # token clause
+
+        def neither():
+            return _getenv("MXTPU_PEER_MAGIC", "0")        # both clauses
+
+        def cadence_only():
+            return _getenv("MXTPU_PEER_SNAPSHOT_EVERY", "1")  # clean
+
+        def not_owned():
+            return _getenv("MXTPU_UNRELATED_KNOB", "0")    # not ours
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX023"})
+    assert [f.code for f in findings] == ["MX023"] * 3
+    msgs = " ".join(f.message for f in findings)
+    assert "MXTPU_COMPILE_CACHE_DIR" in msgs
+    assert "MXTPU_PEER_MAGIC" in msgs
+    assert "MXTPU_UNRELATED_KNOB" not in msgs
+    assert "MXTPU_PEER_SNAPSHOT_EVERY" not in msgs
+    # the unknown knob owes both halves: docs row AND token
+    magic = [f for f in findings if "MXTPU_PEER_MAGIC" in f.message]
+    assert len(magic) == 2
+
+
+def test_mx023_scoped_to_zero_badput_modules(tmp_path):
+    """The same undocumented/unregistered read OUTSIDE the
+    checkpoint/cache/peer plane is not flagged by MX023."""
+    _plant_zb_tree(tmp_path, "mxnet_tpu/thing.py", """\
+        from .base import getenv as _getenv
+
+        def elsewhere():
+            return _getenv("MXTPU_PEER_MAGIC", "0")
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX023"})
+    assert findings == []
+
+
+def test_mx023_real_tree_knobs_hold_the_contract():
+    """The shipped knobs honor what the rule enforces: ENV_VARS.md rows
+    and signature-token registrations for the graph-shaping three, with
+    the cadence knob documented but deliberately token-free."""
+    from mxnet_tpu.ndarray import register as r
+    with open(os.path.join(REPO, "docs", "ENV_VARS.md"),
+              encoding="utf-8") as f:
+        doc = f.read()
+    tokens = r.signature_token_names()
+    for var in ("MXTPU_CKPT_ASYNC", "MXTPU_CKPT_DELTA",
+                "MXTPU_COMPILE_CACHE_DIR", "MXTPU_PEER_RESTORE"):
+        assert "`%s`" % var in doc, var
+        assert var in tokens, var
+    assert "`MXTPU_PEER_SNAPSHOT_EVERY`" in doc
+    assert "MXTPU_PEER_SNAPSHOT_EVERY" not in tokens

@@ -1,0 +1,437 @@
+"""Persistent AOT compile cache + restore-from-peer (ISSUE 19b/19c;
+mxnet_tpu/gluon/compile_cache.py, kvstore snapshot plane,
+parallel/elastic.py peer restore).
+
+Three halves:
+
+* compile cache units — store/load roundtrip of a real compiled
+  executable, the never-fatal contract (miss / corrupt entry / disabled
+  cache all degrade to ``None`` with the right counter, never an
+  exception), and key sensitivity (different signature keys land on
+  different entries); plus the in-process warm path: a second
+  identically-seeded fused trainer replays the first one's executable
+  off disk, bitwise;
+* the snapshot plane — SnapshotTable semantics (newest-step wins,
+  requester exclusion, heartbeat liveness filter, ``stale_timeout <= 0``
+  escape hatch) and the real v1 wire (opcodes 18/19) end to end,
+  including the no-snapshot ``None`` reply;
+* restore_from_peer fallbacks — transport error (the shape a v0
+  server's ``_RE_ERR`` reply surfaces as), no snapshot, HMAC mismatch
+  (an unauthenticated blob must never reach ``pickle.loads``), torn
+  decode, and the missing-secret off switch — every one counted and
+  ``None``, never raised — plus the happy roundtrip and the
+  elastic-loop e2e where a dead rank resumes from its live peer's
+  in-memory state with zero rewind/replay, bitwise-identical to an
+  unfaulted twin.
+"""
+import hmac as _hmac
+import hashlib
+import os
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, profiler
+from mxnet_tpu import kvstore_async as KA
+from mxnet_tpu._debug import faultpoint, goodput, watchdog
+from mxnet_tpu.gluon import compile_cache as CC
+from mxnet_tpu.kvstore_server import SnapshotTable
+from mxnet_tpu.parallel.elastic import CheckpointManager, \
+    ElasticController, elastic_train_loop, publish_peer_snapshot, \
+    restore_from_peer
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_RUNS_DIR", str(tmp_path / "runs"))
+    for var in ("MXTPU_COMPILE_CACHE_DIR", "MXTPU_PEER_RESTORE",
+                "MXTPU_PS_SECRET", "MXTPU_CKPT_ASYNC",
+                "MXTPU_CKPT_DELTA"):
+        monkeypatch.delenv(var, raising=False)
+    CC.reset_stats()
+    goodput.reset()
+    watchdog.reset()
+    faultpoint.reset()
+    yield
+    faultpoint.reset()
+    goodput.reset()
+    watchdog.reset()
+    CC.reset_stats()
+
+
+# -- compile cache units ------------------------------------------------------
+
+def _compiled(mul=2.0):
+    fn = jax.jit(lambda x: x * mul + 1.0)
+    return fn.lower(jnp.arange(4.0)).compile()
+
+
+class TestCompileCacheUnits:
+    def test_disabled_without_env(self):
+        """No MXTPU_COMPILE_CACHE_DIR: the cache is inert — no paths,
+        no counters, store refuses."""
+        assert not CC.enabled()
+        assert CC.cache_path(("k",)) is None
+        assert CC.load(("k",)) is None
+        assert CC.store(("k",), _compiled()) is False
+        assert CC.stats() == {"hits": 0, "misses": 0, "stores": 0,
+                              "deserialize_errors": 0,
+                              "store_errors": 0}
+
+    def test_roundtrip_executable(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cc"))
+        compiled = _compiled()
+        key = ("sig", "avals", "tokens")
+        assert CC.store(key, compiled) is True
+        assert CC.stats()["stores"] == 1
+        loaded = CC.load(key)
+        assert loaded is not None
+        assert CC.stats()["hits"] == 1
+        x = jnp.arange(4.0)
+        np.testing.assert_array_equal(np.asarray(loaded(x)),
+                                      np.asarray(compiled(x)))
+
+    def test_miss_counts(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cc"))
+        assert CC.load(("nope",)) is None
+        assert CC.stats()["misses"] == 1
+
+    def test_corrupt_entry_never_fatal(self, tmp_path, monkeypatch):
+        """A torn/garbage entry is a counted deserialize_error and a
+        ``None`` (fresh compile follows) — never an exception."""
+        monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cc"))
+        key = ("sig",)
+        assert CC.store(key, _compiled())
+        path = CC.cache_path(key)
+        with open(path, "wb") as f:
+            f.write(b"not a pickled executable")
+        assert CC.load(key) is None
+        assert CC.stats()["deserialize_errors"] == 1
+        assert CC.stats()["hits"] == 0
+
+    def test_key_sensitivity(self, tmp_path, monkeypatch):
+        """Different signature keys map to different entries; the same
+        key is stable across calls (the on-disk contract the fused
+        step's full compile signature relies on)."""
+        monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cc"))
+        a = CC.cache_path(("sig", "a"))
+        b = CC.cache_path(("sig", "b"))
+        assert a != b
+        assert a == CC.cache_path(("sig", "a"))
+        assert a.startswith(str(tmp_path / "cc"))
+        assert a.endswith(".xc")
+
+
+def _make_net(seed_from=None):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, in_units=8, activation="relu"))
+        net.add(gluon.nn.Dense(1, in_units=16))
+    net.initialize(mx.init.Uniform(0.1))
+    net.hybridize()
+    if seed_from is not None:
+        for (_, p1), (_, p2) in zip(
+                sorted(seed_from.collect_params().items()),
+                sorted(net.collect_params().items())):
+            p2.set_data(p1.data().astype("float32"))
+    return net
+
+
+class TestWarmFusedStep:
+    def test_second_trainer_replays_cached_executable_bitwise(
+            self, tmp_path, monkeypatch):
+        """Cold fused trainer stores its AOT executable; a second,
+        identically-seeded trainer's compile step serves it from disk
+        (hits counted, nothing re-stored) and trains bitwise-identical
+        to the cold run."""
+        monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cc"))
+        rs = np.random.RandomState(0)
+        x = mx.nd.array(rs.rand(4, 8).astype("float32"))
+        y = mx.nd.array(rs.rand(4, 1).astype("float32"))
+        loss_fn = gluon.loss.L2Loss()
+
+        net_a = _make_net()
+        net_b = _make_net(seed_from=net_a)   # same init, BEFORE stepping
+        tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                             {"learning_rate": 0.1})
+        step_a = gluon.train_step(net_a, loss_fn, tr_a)
+        for _ in range(3):
+            step_a(x, y, batch_size=4)
+        assert step_a.last_mode == "fused"
+        cold = CC.stats()
+        assert cold["stores"] == 1 and cold["hits"] == 0, cold
+
+        tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                             {"learning_rate": 0.1})
+        step_b = gluon.train_step(net_b, loss_fn, tr_b)
+        for _ in range(3):
+            step_b(x, y, batch_size=4)
+        assert step_b.last_mode == "fused"
+        warm = CC.stats()
+        assert warm["hits"] == 1, warm
+        assert warm["stores"] == 1, warm   # cache hit is never re-stored
+        for (_, pa), (_, pb) in zip(
+                sorted(net_a.collect_params().items()),
+                sorted(net_b.collect_params().items())):
+            assert np.array_equal(pa.data().asnumpy(),
+                                  pb.data().asnumpy())
+
+
+# -- snapshot plane -----------------------------------------------------------
+
+class TestSnapshotTable:
+    def test_newest_step_wins_and_requester_excluded(self):
+        t = SnapshotTable()
+        t.put(0, 5, b"r0s5")
+        t.put(1, 3, b"r1s3")
+        assert t.get_newest(2, {}, 0) == (0, 5, b"r0s5")
+        assert t.get_newest(0, {}, 0) == (1, 3, b"r1s3")
+        t.put(1, 9, b"r1s9")             # replace: one slot per rank
+        assert len(t) == 2
+        assert t.get_newest(2, {}, 0) == (1, 9, b"r1s9")
+
+    def test_heartbeat_liveness_filter(self):
+        """A publisher with a stale (or absent) heartbeat is skipped —
+        its snapshot may predate the failure being recovered from;
+        stale_timeout <= 0 disables the filter."""
+        t = SnapshotTable()
+        t.put(1, 7, b"blob")
+        now = time.monotonic()
+        assert t.get_newest(0, {}, 3.0) is None           # no heartbeat
+        assert t.get_newest(0, {1: now - 60.0}, 3.0) is None   # stale
+        assert t.get_newest(0, {1: now}, 3.0) == (1, 7, b"blob")
+        assert t.get_newest(0, {}, 0) == (1, 7, b"blob")  # filter off
+
+    def test_drop(self):
+        t = SnapshotTable()
+        t.put(1, 7, b"blob")
+        t.drop(1)
+        assert len(t) == 0
+        assert t.get_newest(0, {}, 0) is None
+
+
+class TestSnapshotWire:
+    def test_put_get_roundtrip_with_liveness(self):
+        """Opcodes 18/19 end to end: a published snapshot is served to
+        a different rank only while the publisher's heartbeat is fresh
+        (the server-side filter over the real wire); the requester's
+        own slot never comes back."""
+        srv = KA.AsyncPSServer()
+        try:
+            cli0 = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli1 = KA.AsyncPSClient("127.0.0.1", srv.port)
+            assert cli0.get_snapshot(0, stale_timeout=0) is None
+            cli1.put_snapshot(1, 7, b"\x00payload\xff")
+            # no heartbeat from rank 1 yet: default liveness filter
+            # (MXTPU_PS_DEAD_TIMEOUT) must hold the snapshot back
+            assert cli0.get_snapshot(0) is None
+            cli1.heartbeat(1)
+            assert cli0.get_snapshot(0) == (1, 7, b"\x00payload\xff")
+            assert cli0.get_snapshot(0, stale_timeout=0) == \
+                (1, 7, b"\x00payload\xff")
+            # requester exclusion: rank 1 asking only sees OTHER ranks
+            assert cli1.get_snapshot(1, stale_timeout=0) is None
+        finally:
+            srv.stop()
+
+
+# -- restore_from_peer fallbacks ---------------------------------------------
+
+class _CaptureKV:
+    """publish_snapshot/peer_snapshot facade over an in-memory slot —
+    the client-side crypto path without a server."""
+
+    def __init__(self):
+        self.slot = None
+
+    def publish_snapshot(self, step, blob):
+        self.slot = (1, int(step), bytes(blob))
+
+    def peer_snapshot(self, stale_timeout=None):
+        return self.slot
+
+
+def _fallbacks():
+    return profiler.elastic_stats().get("peer_restore_fallbacks", 0)
+
+
+class TestRestoreFromPeer:
+    def test_roundtrip_and_counters(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_SECRET", "s3cret")
+        kv = _CaptureKV()
+        state = {"w": jnp.asarray([1.0, 2.0]), "n": jnp.asarray(3.0)}
+        before = profiler.elastic_stats().get("peer_restores", 0)
+        assert publish_peer_snapshot(kv, 5, state) is True
+        got = restore_from_peer(kv)
+        assert got is not None
+        host, step = got
+        assert step == 5
+        np.testing.assert_array_equal(np.asarray(host["w"]),
+                                      [1.0, 2.0])
+        assert profiler.elastic_stats()["peer_restores"] == before + 1
+
+    def test_no_secret_is_off(self):
+        """Without MXTPU_PS_SECRET neither side participates: publish
+        refuses (an unauthenticated blob must never go out) and restore
+        skips straight to the filesystem."""
+        kv = _CaptureKV()
+        assert publish_peer_snapshot(kv, 1, {"w": jnp.asarray(1.0)}) \
+            is False
+        kv.slot = (1, 1, b"x" * 64)
+        assert restore_from_peer(kv) is None
+
+    def test_kv_without_snapshot_plane(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_SECRET", "s3cret")
+        assert restore_from_peer(object()) is None
+
+    def test_transport_error_falls_back(self, monkeypatch):
+        """The v0-interop shape: an old server answers the unknown
+        opcode with _RE_ERR, which the client surfaces as RuntimeError
+        — counted as a 'transport' fallback, never raised."""
+        monkeypatch.setenv("MXTPU_PS_SECRET", "s3cret")
+
+        class _V0KV:
+            def peer_snapshot(self, stale_timeout=None):
+                raise RuntimeError("server error")
+
+        before = _fallbacks()
+        assert restore_from_peer(_V0KV()) is None
+        assert _fallbacks() == before + 1
+
+    def test_no_snapshot_falls_back(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_SECRET", "s3cret")
+        before = _fallbacks()
+        assert restore_from_peer(_CaptureKV()) is None
+        assert _fallbacks() == before + 1
+
+    def test_hmac_mismatch_never_unpickles(self, monkeypatch):
+        """A tampered blob fails MAC verification BEFORE pickle.loads
+        — the poisoned payload is never deserialized."""
+        monkeypatch.setenv("MXTPU_PS_SECRET", "s3cret")
+        kv = _CaptureKV()
+        assert publish_peer_snapshot(kv, 2, {"w": jnp.asarray(1.0)})
+        rank, step, blob = kv.slot
+        kv.slot = (rank, step, blob[:32] + b"\x00" + blob[33:])
+
+        def _boom(*a, **k):              # pragma: no cover
+            raise AssertionError("pickle.loads reached on bad MAC")
+
+        monkeypatch.setattr(pickle, "loads", _boom)
+        before = _fallbacks()
+        assert restore_from_peer(kv) is None
+        assert _fallbacks() == before + 1
+
+    def test_torn_body_counts_decode(self, monkeypatch):
+        """A correctly-MACed but unpicklable body (torn writer) is a
+        counted 'decode' fallback."""
+        monkeypatch.setenv("MXTPU_PS_SECRET", "s3cret")
+        body = b"this is not a pickle"
+        mac = _hmac.new(b"s3cret", body, hashlib.sha256).digest()
+        kv = _CaptureKV()
+        kv.slot = (1, 4, mac + body)
+        before = _fallbacks()
+        assert restore_from_peer(kv) is None
+        assert _fallbacks() == before + 1
+
+
+# -- elastic-loop e2e: dead rank resumes from its live peer ------------------
+
+class _FakeKV:
+    def __init__(self, nworkers=2):
+        self.dead = []
+        self.num_workers = nworkers
+
+    def dead_nodes(self, timeout=3.0):
+        return list(self.dead)
+
+    def resize(self, n):
+        self.num_workers = int(n)
+
+
+class _PeerKV(_FakeKV):
+    """Dead-table fake whose snapshot plane is the REAL v1 wire."""
+
+    def __init__(self, client, rank, nworkers=2):
+        _FakeKV.__init__(self, nworkers)
+        self._client = client
+        self._rank = int(rank)
+
+    def publish_snapshot(self, step, blob):
+        self._client.put_snapshot(self._rank, step, blob)
+
+    def peer_snapshot(self, stale_timeout=None):
+        return self._client.get_snapshot(self._rank, stale_timeout)
+
+
+def test_loop_restores_from_peer_with_zero_replay(tmp_path,
+                                                  monkeypatch):
+    """Rank 0 dies at batch 5 with checkpoints only at 0 and 3; its
+    DP-identical peer published every step, so recovery restores step 4
+    over the wire — recovery_kind 'peer', replay_span 0 — and the final
+    state is bitwise-identical to an unfaulted twin."""
+    monkeypatch.setenv("MXTPU_PS_SECRET", "zb-test-secret")
+    monkeypatch.setenv("MXTPU_PEER_RESTORE", "1")
+    batches = [jnp.asarray(float(i)) for i in range(8)]
+
+    def base_step(state, b):
+        return {"acc": state["acc"] + b}, None
+
+    # unfaulted twin for the bitwise target
+    twin_state, _, done = elastic_train_loop(
+        base_step, {"acc": jnp.asarray(0.0)}, batches,
+        CheckpointManager(str(tmp_path / "ck_twin"), use_orbax=False),
+        save_every=3, max_failures=0,
+        controller=ElasticController(kvstore=_FakeKV(),
+                                     world=range(2), rank=0,
+                                     poll_interval=0.0))
+    assert done
+
+    goodput.reset()
+    watchdog.reset()
+    srv = KA.AsyncPSServer()
+    try:
+        cli0 = KA.AsyncPSClient("127.0.0.1", srv.port)
+        cli1 = KA.AsyncPSClient("127.0.0.1", srv.port)
+        peer = _PeerKV(cli1, rank=1)
+        kv = _PeerKV(cli0, rank=0)
+        fired = []
+
+        def step(state, b):
+            i = int(b)
+            if i == 5 and not fired:
+                fired.append(1)
+                kv.dead = [1]
+                raise ConnectionError("collective failed: peer gone")
+            ns, met = base_step(state, b)
+            # the DP-identical peer: same post-step state in its own
+            # slot, heartbeat fresh so the liveness filter serves it
+            cli1.heartbeat(1)
+            publish_peer_snapshot(peer, i, ns)
+            return ns, met
+
+        state, _, done = elastic_train_loop(
+            step, {"acc": jnp.asarray(0.0)}, batches,
+            CheckpointManager(str(tmp_path / "ck"), use_orbax=False),
+            save_every=3, max_failures=0,
+            controller=ElasticController(kvstore=kv, world=range(2),
+                                         rank=0, poll_interval=0.0))
+    finally:
+        srv.stop()
+    assert done
+    m = goodput.last_manifest()
+    rec = [e for e in m["events"] if e["kind"] == "recovery"][-1]
+    assert rec["recovery_kind"] == "peer"
+    assert rec["restored_step"] == 4
+    assert rec["replay_span"] == 0
+    assert m["counters"]["peer_restores"] == 1
+    assert float(state["acc"]) == float(twin_state["acc"])

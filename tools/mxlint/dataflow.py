@@ -1000,6 +1000,115 @@ class MX022UnregisteredCompile:
         return out
 
 
+# ---------------------------------------------------------------------------
+# MX023 — zero-badput knobs: documented AND signature-registered
+# ---------------------------------------------------------------------------
+
+# Modules where the zero-badput knob contract is enforced: the
+# checkpoint/recovery plane, the fused step + its persistent compile
+# cache, and the kvstore peer-snapshot plane (ISSUE 19).
+_ZERO_BADPUT_MODULES = (
+    "mxnet_tpu/parallel/elastic.py",
+    "mxnet_tpu/gluon/fused_step.py",
+    "mxnet_tpu/gluon/compile_cache.py",
+    "mxnet_tpu/kvstore_async.py",
+    "mxnet_tpu/kvstore_server.py",
+)
+
+# Name families owned by the zero-badput plane. Any knob in these
+# families flips behavior that either shapes a compiled program (the
+# compile cache key must see it) or changes what a checkpoint contains
+# (a resume under a different setting must recompile/re-key, not
+# silently reuse) — so reading one obliges BOTH contracts below.
+_ZERO_BADPUT_PREFIXES = ("MXTPU_CKPT_", "MXTPU_COMPILE_CACHE",
+                        "MXTPU_PEER_")
+
+# Cadence-only knobs: they schedule WHEN work happens (publish every N
+# steps), never what any traced graph or compile key contains — the
+# documentation clause still applies (via MX015), but signature-token
+# registration would only force spurious recompiles on cadence tuning.
+_CADENCE_ONLY = frozenset((
+    "MXTPU_PEER_SNAPSHOT_EVERY",
+))
+
+
+class MX023ZeroBadputKnobContract:
+    """Every env knob of the zero-badput plane (``MXTPU_CKPT_*``,
+    ``MXTPU_COMPILE_CACHE*``, ``MXTPU_PEER_*``) read in the
+    checkpoint/cache/peer modules must be documented in
+    docs/ENV_VARS.md AND — unless it is a pure cadence knob — appear in
+    the signature-token registry (``register_signature_token``), so
+    flipping it lands later compiles on a fresh signature instead of
+    silently replaying a program compiled under the old setting. MX015
+    already enforces the choke-point + documentation half for all of
+    ``mxnet_tpu/``; this rule adds the registration half that makes the
+    persistent compile cache safe to key off the token snapshot."""
+
+    code = "MX023"
+    summary = "zero-badput env knob undocumented or not a signature token"
+    kind = "python"
+    project = True
+
+    def scope(self, path):
+        # broad: scope() gates project-model fact extraction, and the
+        # token clause needs register.py's registrations in the model;
+        # check_project restricts findings to _ZERO_BADPUT_MODULES
+        return path.startswith("mxnet_tpu/") and path.endswith(".py")
+
+    _doc_cache = None  # (repo_root, frozenset | None)
+
+    def _documented(self):
+        from . import core
+        cached = self._doc_cache
+        if cached is not None and cached[0] == core.REPO_ROOT:
+            return cached[1]
+        doc_path = os.path.join(core.REPO_ROOT, "docs", "ENV_VARS.md")
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                names = frozenset(_DOC_NAME_RE.findall(f.read()))
+        except OSError:
+            names = None  # no contract file: skip the doc clause
+        self._doc_cache = (core.REPO_ROOT, names)
+        return names
+
+    @staticmethod
+    def _owned(name):
+        return isinstance(name, str) and \
+            name.startswith(_ZERO_BADPUT_PREFIXES)
+
+    def check_project(self, model):
+        docs = self._documented()
+        tokens = model.signature_tokens()
+        out = []
+        for mf in sorted(model.modules.values(), key=lambda m: m.path):
+            if mf.path not in _ZERO_BADPUT_MODULES:
+                continue
+            for qual in sorted(mf.functions):
+                fn = mf.functions[qual]
+                for _kind, name, ln, family in fn.env_reads:
+                    lit = name if isinstance(name, str) else family
+                    if not self._owned(lit):
+                        continue
+                    if docs is not None and lit not in docs:
+                        out.append(Finding(
+                            self.code, mf.path, ln,
+                            "zero-badput knob %r is read here but "
+                            "missing from docs/ENV_VARS.md — document "
+                            "it (default + consumer + what it gates)"
+                            % (lit,)))
+                    if lit not in tokens and lit not in _CADENCE_ONLY:
+                        out.append(Finding(
+                            self.code, mf.path, ln,
+                            "zero-badput knob %r changes what a "
+                            "compiled/checkpointed step means but is "
+                            "not a registered signature token — add "
+                            "register_signature_token(%r, ...) so the "
+                            "compile cache and retrace keys see it "
+                            "(or list it in _CADENCE_ONLY with why)"
+                            % (lit, lit)))
+        return out
+
+
 DATAFLOW_RULES = (
     MX014TracedAmbientState(),
     MX015EnvContract(),
@@ -1008,4 +1117,5 @@ DATAFLOW_RULES = (
     MX018UnledgeredBufferCreation(),
     MX019MetricsProviderDocs(),
     MX022UnregisteredCompile(),
+    MX023ZeroBadputKnobContract(),
 )

@@ -2933,6 +2933,294 @@ print(json.dumps({
     }
 
 
+def bench_control_plane():
+    """BENCH_MODEL=control_plane: control-plane survivability (ISSUE 20),
+    the three legs of the kvstore failover + preemption story.
+
+    A. **Journaled failover** — a journaling AsyncPSServer takes real
+       init/push traffic and dies abruptly (no clean stop, so recovery
+       is journal replay, not the compaction snapshot); a standby
+       replays the journal on a reserved port and the client walks its
+       `MXTPU_PS_ENDPOINTS`-style failover list inside the ordinary
+       `_call` retry budget. Gates: the kill→successful-pull window
+       must be ≤ 0.25x the heartbeat dead-timeout (failover must beat
+       the detector that exists to notice dead SERVERS' clients), the
+       replayed value must be bitwise what the dead primary served,
+       and at least one `kvstore.failovers.*` counter must tick.
+    B. **Partition chaos** — an elastic run whose step drives real
+       push/pull wire traffic under `net.delay` on-the-wire chaos,
+       plus one induced rank-death recovery, against a fault-free
+       twin: final state bitwise identical, goodput floor >= 0.95 on
+       the CHAOS manifest (the delays land in-step as compute; the
+       recovery is the only badput), and `goodput_report --compare`
+       must call the direction both ways (clean->chaos regresses on
+       the slowed median step; chaos->clean does not).
+    C. **Coordinated preemption** — SIGTERM lands mid-run under an
+       `MXTPU_PREEMPT_GRACE_S` budget: the run must announce
+       (controller acked), checkpoint the in-flight step, and close
+       `outcome=preempted`; the resumed incarnation must book its
+       resume recovery with **replay_span 0** (the preemption save IS
+       the newest step) and finish bitwise equal to an uninterrupted
+       twin; the `preempt_notice` opcode must make the announced rank
+       visible in a real server's dead-node reply immediately."""
+    import signal as _signal
+    import socket as _socket
+    import tempfile
+    import numpy as np
+    import jax.numpy as jnp
+    from mxnet_tpu import kvstore_async as KA
+    from mxnet_tpu import profiler
+    from mxnet_tpu._debug import faultpoint, goodput, watchdog
+    from mxnet_tpu.parallel.elastic import (
+        CheckpointManager, ElasticController, elastic_train_loop)
+    from tools import goodput_report
+
+    profiler.set_config(
+        filename=os.path.join(tempfile.mkdtemp(), "profile.json"),
+        xprof=False)
+    saved_env = {k: os.environ.get(k) for k in (
+        "MXTPU_RUNS_DIR", "MXTPU_PS_SECRET", "MXTPU_PS_JOURNAL_DIR",
+        "MXTPU_PS_ENDPOINTS", "MXTPU_PS_FENCING",
+        "MXTPU_PS_RECV_TIMEOUT", "MXTPU_PREEMPT_GRACE_S")}
+    runs_dir = tempfile.mkdtemp(prefix="bench_cp_runs_")
+    work = tempfile.mkdtemp(prefix="bench_cp_")
+    os.environ["MXTPU_RUNS_DIR"] = runs_dir
+    for k in ("MXTPU_PS_JOURNAL_DIR", "MXTPU_PS_ENDPOINTS",
+              "MXTPU_PS_FENCING", "MXTPU_PS_RECV_TIMEOUT",
+              "MXTPU_PREEMPT_GRACE_S"):
+        os.environ.pop(k, None)
+    os.environ["MXTPU_PS_SECRET"] = "bench-cp-secret"
+    goodput.reset()
+    watchdog.reset()
+
+    dead_timeout = float(os.environ.get("MXTPU_PS_DEAD_TIMEOUT", "3.0"))
+    sleep_s = 0.05
+
+    def run_dir_of(manifest):
+        return os.path.dirname(goodput.manifest_path(
+            manifest["run_id"]))
+
+    class _CpKV:
+        """Dead-table fake in the PR 14 chaos idiom."""
+
+        def __init__(self, nworkers=2):
+            self.dead = []
+            self.num_workers = nworkers
+            self.resized = []
+
+        def dead_nodes(self, timeout=3.0):
+            return list(self.dead)
+
+        def resize(self, n):
+            self.resized.append(int(n))
+            self.num_workers = int(n)
+
+    try:
+        # -- A. journaled failover ----------------------------------------
+        journal = os.path.join(work, "journal")
+        srv1 = KA.AsyncPSServer(journal_dir=journal)
+        rsv = _socket.socket()
+        rsv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        rsv.bind(("127.0.0.1", 0))
+        standby_port = rsv.getsockname()[1]
+        cli = KA.AsyncPSClient(
+            "127.0.0.1", srv1.port,
+            endpoints=[("127.0.0.1", srv1.port),
+                       ("127.0.0.1", standby_port)])
+        cli.init("w", np.arange(8, dtype=np.float32))
+        for _ in range(5):
+            cli.push("w", np.ones(8, dtype=np.float32))
+        before = np.asarray(cli.pull("w"))
+        fo_base = {k: v for k, v in
+                   profiler.metrics()["counters"].items()
+                   if k.startswith("kvstore.failovers.")}
+        # abrupt death: listener closed, accept loop stopped, the
+        # client's established socket reset — never a clean stop(), so
+        # the standby's state is journal replay, not the snapshot
+        srv1._stop.set()
+        srv1._srv.close()
+        cli._sock.close()
+        rsv.close()
+        t0 = time.perf_counter()
+        srv2 = KA.AsyncPSServer(port=standby_port, journal_dir=journal)
+        after = np.asarray(cli.pull("w"))
+        failover_s = time.perf_counter() - t0
+        fo_now = {k: v for k, v in
+                  profiler.metrics()["counters"].items()
+                  if k.startswith("kvstore.failovers.")}
+        failovers = sum(fo_now.values()) - sum(fo_base.values())
+        replay_bitwise = bool(np.array_equal(before, after))
+        journal_replayed = srv2.journal_replayed
+        cli.stop_server()
+
+        # -- B. partition chaos vs clean twin -----------------------------
+        batches = [jnp.asarray(float(i)) for i in range(30)]
+        srv_b = KA.AsyncPSServer()
+        cli_b = KA.AsyncPSClient("127.0.0.1", srv_b.port)
+        cli_b.init("s", np.zeros(4, dtype=np.float32))
+
+        def wire_step(state, b):
+            # real on-the-wire traffic every step: the net.delay chaos
+            # lands inside these round trips (in-step => compute)
+            cli_b.push("s", np.full(4, float(b), dtype=np.float32))
+            cli_b.pull("s")
+            time.sleep(sleep_s)
+            return {"acc": state["acc"] + b}, None
+
+        def elastic_run(chaos):
+            fired = []
+
+            def step(state, b):
+                i = int(b)
+                if chaos and i == 7 and not fired:
+                    fired.append(1)
+                    kv.dead = [1]
+                    raise ConnectionError(
+                        "collective failed: peer gone")
+                return wire_step(state, b)
+
+            kv = _CpKV()
+            ctl = ElasticController(kvstore=kv, world=range(2), rank=0,
+                                    poll_interval=0.0)
+            ck = CheckpointManager(
+                tempfile.mkdtemp(dir=work, prefix="ck_b_"),
+                use_orbax=False, async_persist=False, delta=False)
+            state, _, done = elastic_train_loop(
+                step, {"acc": jnp.asarray(0.0)}, batches, ck,
+                save_every=2, max_failures=0, controller=ctl)
+            assert done
+            return state, goodput.last_manifest()
+
+        clean_state, m_clean = elastic_run(chaos=False)
+        faultpoint.configure("net.delay=delay:5ms")
+        try:
+            chaos_state, m_chaos = elastic_run(chaos=True)
+        finally:
+            faultpoint.reset()
+        cli_b.stop_server()
+        cc = m_chaos["categories_s"]
+        goodput_floor = (cc["compute"] + cc["input_wait"]) / max(
+            1e-9, m_chaos["wall_s"] - cc["compile"])
+        cmp_clean_to_chaos = goodput_report.main(
+            ["--compare", run_dir_of(m_clean), run_dir_of(m_chaos)])
+        cmp_chaos_to_clean = goodput_report.main(
+            ["--compare", run_dir_of(m_chaos), run_dir_of(m_clean)])
+        chaos_bitwise = float(chaos_state["acc"]) \
+            == float(clean_state["acc"])
+
+        # -- C. coordinated preemption + resume ---------------------------
+        os.environ["MXTPU_PREEMPT_GRACE_S"] = "30"
+        pre_batches = [jnp.asarray(float(i)) for i in range(10)]
+        ck_dir = os.path.join(work, "ck_preempt")
+
+        class _CpPreKV(_CpKV):
+            def __init__(self):
+                _CpKV.__init__(self)
+                self.announced = []
+
+            def announce_preemption(self, step):
+                self.announced.append(int(step))
+                return 1
+
+        def pre_step(state, b):
+            i = int(b)
+            if i == 5:
+                _signal.raise_signal(_signal.SIGTERM)
+            time.sleep(sleep_s)
+            return {"acc": state["acc"] + b}, None
+
+        pre_kv = _CpPreKV()
+        ctl = ElasticController(kvstore=pre_kv, world=range(2), rank=0,
+                                poll_interval=0.0)
+        ck = CheckpointManager(ck_dir, use_orbax=False,
+                               async_persist=True, delta=False)
+        _, pre_last, pre_done = elastic_train_loop(
+            pre_step, {"acc": jnp.asarray(0.0)}, pre_batches, ck,
+            save_every=4, max_failures=0, controller=ctl)
+        m_pre = goodput.last_manifest()
+        os.environ.pop("MXTPU_PREEMPT_GRACE_S", None)
+
+        def plain_step(state, b):
+            time.sleep(sleep_s)
+            return {"acc": state["acc"] + b}, None
+
+        ck = CheckpointManager(ck_dir, use_orbax=False,
+                               async_persist=True, delta=False)
+        res_state, _, res_done = elastic_train_loop(
+            plain_step, {"acc": jnp.asarray(0.0)}, pre_batches, ck,
+            save_every=4, max_failures=0)
+        assert res_done
+        m_res = goodput.last_manifest()
+        resume_rec = [e for e in m_res["events"]
+                      if e["kind"] == "recovery"][-1]
+
+        ck = CheckpointManager(os.path.join(work, "ck_twin"),
+                               use_orbax=False, async_persist=True,
+                               delta=False)
+        twin_state, _, twin_done = elastic_train_loop(
+            plain_step, {"acc": jnp.asarray(0.0)}, pre_batches, ck,
+            save_every=4, max_failures=0)
+        assert twin_done
+        preempt_bitwise = float(res_state["acc"]) \
+            == float(twin_state["acc"])
+
+        # the wire half of the notice: a real server's dead-node reply
+        # includes an announced rank immediately, no heartbeat timeout
+        srv_c = KA.AsyncPSServer()
+        cli_c = KA.AsyncPSClient("127.0.0.1", srv_c.port)
+        cli_c.preempt_notice(3, pre_last)
+        notice_visible = 3 in cli_c.dead_nodes(timeout=dead_timeout)
+        cli_c.stop_server()
+    finally:
+        watchdog.reset()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    gate_ok = bool(
+        failover_s <= 0.25 * dead_timeout
+        and failovers >= 1 and replay_bitwise and journal_replayed > 0
+        and chaos_bitwise and goodput_floor >= 0.95
+        and cmp_clean_to_chaos == 1 and cmp_chaos_to_clean == 0
+        and m_pre["outcome"] == "preempted" and not pre_done
+        and pre_last == 5 and pre_kv.announced == [5]
+        and resume_rec["recovery_kind"] == "resume"
+        and resume_rec["restored_step"] == 5
+        and resume_rec["replay_span"] == 0
+        and preempt_bitwise and notice_visible)
+    return {
+        "metric": "control_plane",
+        "value": round(failover_s, 4),
+        "unit": "s",
+        "failover_s": round(failover_s, 4),
+        "failover_budget_s": round(0.25 * dead_timeout, 4),
+        "failovers": failovers,
+        "journal_replayed": journal_replayed,
+        "replay_bitwise": replay_bitwise,
+        "goodput_floor": round(goodput_floor, 4),
+        "chaos_bitwise": chaos_bitwise,
+        "preempted_outcome": m_pre["outcome"],
+        "preempt_step": pre_last,
+        "preempt_acked": pre_kv.announced,
+        "resume_restored_step": resume_rec["restored_step"],
+        "resume_replay_span": resume_rec["replay_span"],
+        "preempt_bitwise": preempt_bitwise,
+        "notice_visible": notice_visible,
+        "compare_exits": {
+            "clean_to_chaos": cmp_clean_to_chaos,
+            "chaos_to_clean": cmp_chaos_to_clean,
+        },
+        "gate": {
+            "ok": gate_ok,
+            "max_failover_ratio": 0.25,
+            "min_goodput_floor": 0.95,
+            "required_replay_span": 0,
+        },
+    }
+
+
 if __name__ == "__main__":
     which = os.environ.get("BENCH_MODEL", "both")
     if which == "transformer":
@@ -2969,6 +3257,8 @@ if __name__ == "__main__":
         result = bench_perf_attrib()
     elif which == "zero_badput":
         result = bench_zero_badput()
+    elif which == "control_plane":
+        result = bench_control_plane()
     else:
         def _section(fn):
             # retry ONLY transient remote-attach channel drops — a

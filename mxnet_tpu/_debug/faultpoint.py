@@ -61,6 +61,25 @@ failure → behavior → counter table):
                             NaN, any other raise → a finite exponent
                             bit-flip (grads doubled, the pure-SDC
                             shape only the cross-rank digest catches)
+``net.partition``           kvstore frame send/recv seam
+                            (``kvstore_async._send_frame`` /
+                            ``_recv_frame``): a ``raise:
+                            ConnectionError`` models the link going
+                            down mid-frame; ``@skip``/``@p`` shape
+                            asymmetric partitions
+``net.delay``               same seam, ``delay:<t>`` — a slow or
+                            congested link, per frame
+``net.drop``                send seam only: a trigger (any action)
+                            silently swallows the frame — it is sent
+                            locally but never arrives, so the caller
+                            blocks in recv until
+                            ``MXTPU_PS_RECV_TIMEOUT`` surfaces it
+``net.half_open``           recv seam only, ``delay:<silence>`` — the
+                            peer holds the connection open but never
+                            answers for ``<silence>`` seconds; with a
+                            recv timeout configured the seam then
+                            raises the same ``socket.timeout`` a real
+                            silent peer produces
 ==========================  ================================================
 
 Configuration — env var (parsed at import) or programmatic::
@@ -136,6 +155,11 @@ POINTS = frozenset((
     "io.worker.decode",
     "io.service.fetch",
     "health.grad.corrupt",
+    # on-the-wire network chaos (kvstore_async frame send/recv seam)
+    "net.partition",
+    "net.delay",
+    "net.drop",
+    "net.half_open",
 ))
 
 _lock = _locktrace.named_lock("faultpoint.config")
@@ -305,18 +329,21 @@ def check(name):
     point's seeded RNG — whether this hit triggers; a trigger counts,
     emits a trace marker, then sleeps (``delay``) or raises (``raise``)
     the configured exception out of the instrumented seam, exactly where
-    a real failure would surface."""
+    a real failure would surface. Returns True when a non-raising
+    trigger fired (after its sleep) and False otherwise, so seams with
+    behavior beyond sleep-or-raise — the ``net.drop`` /
+    ``net.half_open`` socket shim — can act on the trigger themselves."""
     with _lock:
         rule = _rules.get(name)
         if rule is None:
-            return
+            return False
         if rule.skip > 0:
             rule.skip -= 1
-            return
+            return False
         if rule.remaining is not None and rule.remaining <= 0:
-            return
+            return False
         if rule.p < 1.0 and rule.rng.random() >= rule.p:
-            return
+            return False
         if rule.remaining is not None:
             rule.remaining -= 1
         _counters[name] = _counters.get(name, 0) + 1
@@ -324,7 +351,7 @@ def check(name):
     _mark(name, action)
     if action == "delay":
         time.sleep(delay_s)
-        return
+        return True
     raise exc_type("faultpoint %r injected %s" % (name, exc_type.__name__))
 
 

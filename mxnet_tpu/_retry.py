@@ -20,7 +20,11 @@ way, and is tuned by the same ``MXTPU_PS_RETRY_*`` env knobs
 Jitter is the classic decorrelation trick (up to +50% of each sleep) so
 N workers retrying against one recovering server do not thundering-herd
 in lockstep; it perturbs only *when* a retry happens, never *what* it
-does, so chaos-run results stay deterministic.
+does, so chaos-run results stay deterministic. Under a configured
+``MXNET_FAULTPOINTS_SEED`` the jitter stream itself is seeded per policy
+(the faultpoint ``(seed, name)`` idiom), so a seeded chaos run's backoff
+schedule replays identically run-to-run; unset keeps production
+decorrelation.
 """
 from __future__ import annotations
 
@@ -49,11 +53,18 @@ class RetryPolicy:
             if cap is None else float(cap)
         self.deadline = float(_getenv("MXTPU_PS_RETRY_DEADLINE", "30")) \
             if deadline is None else float(deadline)
+        # chaos determinism (ISSUE 20 satellite): with a faultpoint seed
+        # configured, this policy's jitter draws from its own seeded
+        # stream — two policies built under the same seed replay the
+        # same backoff sequence. Unset (production) keeps the shared
+        # unseeded RNG's decorrelation across workers.
+        seed = _getenv("MXNET_FAULTPOINTS_SEED", "")
+        self._rng = random.Random("%s:retry" % seed) if seed else None
 
     def backoff(self, attempt):
         """Sleep before retry ``attempt`` (1-based), jittered."""
         raw = min(self.cap, self.base * (2.0 ** (attempt - 1)))
-        return raw * (1.0 + 0.5 * random.random())
+        return raw * (1.0 + 0.5 * (self._rng or random).random())
 
 
 def call(fn, retryable=(ConnectionError, OSError), policy=None,

@@ -102,6 +102,17 @@ _OP_HELLO = 17
 # — old-server interop is the degraded path, never a hang or a crash.
 _OP_SNAP_PUT = 18
 _OP_SNAP_GET = 19
+# control-plane survivability (ISSUE 20): fencing epochs + coordinated
+# preemption. _OP_EPOCH proposes/queries the server's monotonic fencing
+# epoch (>q proposed; -1 or any lower value queries, a higher value is
+# adopted and journaled; reply _RE_INT is the committed epoch).
+# _OP_PREEMPT announces a rank is draining after SIGTERM (>qq
+# rank|step): dead-node queries include it immediately so peers reshard
+# proactively instead of burning the heartbeat timeout. Length-gated
+# like every op since PR 8 — a v0 server answers unknown-opcode _RE_ERR
+# and callers count-and-continue.
+_OP_EPOCH = 20
+_OP_PREEMPT = 21
 
 # response opcodes
 _RE_OK = 0x10
@@ -129,7 +140,26 @@ _OP_NAMES = {
     _OP_HEARTBEAT: "heartbeat", _OP_DEADNODES: "dead_nodes",
     _OP_SHAPE: "shape", _OP_BARRIER: "barrier", _OP_HELLO: "hello",
     _OP_SNAP_PUT: "snapshot_put", _OP_SNAP_GET: "snapshot_get",
+    _OP_EPOCH: "fence_epoch", _OP_PREEMPT: "preempt_notice",
 }
+
+# Journal-only record tags (never on the wire — the high range cannot
+# collide with request opcodes): _J_STORE is a store-replace synthetic
+# record compaction writes into table.snap, _J_EPOCH persists a fencing
+# epoch bump, _J_HEALTH persists a rank's newest SDC digest so a
+# restarted server still holds the divergence evidence.
+_J_HEALTH = 0xF0
+_J_EPOCH = 0xF1
+_J_STORE = 0xF2
+
+
+def _fencing_enabled():
+    """MXTPU_PS_FENCING switch (ISSUE 20a): when on, clients stamp every
+    push with their fencing epoch and the server rejects writes stamped
+    below its committed epoch — a rank partitioned across an elastic
+    reshard can never write stale state back into aggregation."""
+    return _getenv("MXTPU_PS_FENCING", "0") not in ("0", "", "false",
+                                                    "off")
 
 
 # Ops whose handler blocks waiting on OTHER workers (cross-worker
@@ -198,7 +228,48 @@ def _unpack_arr(buf, off):
     return arr, off + nbytes
 
 
+def _net_chaos_send():
+    """On-the-wire chaos, send side (ISSUE 20c). Called only when
+    faultpoints are ACTIVE. Returns True when the frame should be
+    silently swallowed (``net.drop``: sent locally, never arrives — the
+    caller then blocks in recv until ``MXTPU_PS_RECV_TIMEOUT`` surfaces
+    it as a counted retry). ``net.partition`` raises its configured
+    exception out of the send seam exactly where a dead link would;
+    ``net.delay`` sleeps in-line (a slow/congested link)."""
+    try:
+        if _faultpoint.check("net.drop"):
+            return True
+    except Exception:
+        # any configured action on net.drop means "drop the frame" —
+        # a raise here would model a *visible* failure, which is what
+        # net.partition is for. Counted: a dropped frame is degradation.
+        _profiler.account("kvstore.net_chaos_drops", 1, emit=False)
+        return True
+    _faultpoint.check("net.partition")
+    _faultpoint.check("net.delay")
+    return False
+
+
+def _net_chaos_recv(sock):
+    """On-the-wire chaos, recv side (ISSUE 20c). ``net.partition`` /
+    ``net.delay`` behave as on the send seam. ``net.half_open`` models a
+    peer that holds the connection open but goes silent: the point's
+    configured delay is the silent period; when the socket carries a
+    recv timeout (``MXTPU_PS_RECV_TIMEOUT``) the seam then raises the
+    same ``socket.timeout`` a real silent peer would produce, otherwise
+    the stall simply passes (slow-but-alive peer)."""
+    _faultpoint.check("net.partition")
+    _faultpoint.check("net.delay")
+    if _faultpoint.check("net.half_open") \
+            and sock.gettimeout() is not None:
+        raise socket.timeout(
+            "faultpoint 'net.half_open': peer went silent past the "
+            "recv timeout")
+
+
 def _send_frame(sock, payload):
+    if _faultpoint.ACTIVE and _net_chaos_send():
+        return
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
@@ -213,6 +284,8 @@ def _recv_exact(sock, n):
 
 
 def _recv_frame(sock):
+    if _faultpoint.ACTIVE:
+        _net_chaos_recv(sock)
     hdr = _recv_exact(sock, 4)
     if hdr is None:
         return None
@@ -367,7 +440,7 @@ class AsyncPSServer:
     interface, so the update endpoint is not exposed beyond the
     training fabric."""
 
-    def __init__(self, port=0, bind_host="127.0.0.1"):
+    def __init__(self, port=0, bind_host="127.0.0.1", journal_dir=None):
         self._store = {}
         self._updater = None
         self._lock = _locktrace.named_lock("kvstore_async.server")
@@ -395,6 +468,26 @@ class AsyncPSServer:
         # pinned at construction: later env mutation must not change
         # what the server trusts
         self._secret = _ps_secret()
+        # control-plane survivability (ISSUE 20a): monotonic fencing
+        # epoch (bumped by _OP_EPOCH on every elastic reshard; writes
+        # stamped below it are rejected), preemption notices (rank ->
+        # (step, arrival) — merged into dead-node replies so peers
+        # reshard proactively), and the optional mutation journal. The
+        # journal REPLAYS before the socket binds: a restarted server
+        # is back at its pre-death state before the first client can
+        # reach it.
+        self._epoch = 0
+        self._preempted = {}
+        self._journal = None
+        self._journal_lock = _locktrace.named_lock(
+            "kvstore_async.journal")
+        self.journal_replayed = 0
+        self.updates_applied = 0          # observability for tests
+        self.workers_done = 0
+        self._journal_dir = (journal_dir if journal_dir is not None
+                             else _getenv("MXTPU_PS_JOURNAL_DIR", ""))
+        if self._journal_dir:
+            self._journal_open()
         self.bind_host = bind_host
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -406,8 +499,6 @@ class AsyncPSServer:
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
-        self.updates_applied = 0          # observability for tests
-        self.workers_done = 0
         _SERVERS.add(self)  # feeds the kvstore_server stats provider
 
     def _accept_loop(self):
@@ -485,10 +576,12 @@ class AsyncPSServer:
             arr, off = _unpack_arr(buf, off)
             with self._lock:
                 self._store.setdefault(key, arr)
+                self._journal_append(buf, maybe_compact=True)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_PUSH:
             key, off = _unpack_key(buf, off)
             grad, off = _unpack_arr(buf, off)
+            self._check_fence(buf, off)
             # IMMEDIATE apply — no cross-worker barrier (async
             # semantics, kvstore_dist_server.h:358)
             with self._lock:
@@ -499,6 +592,7 @@ class AsyncPSServer:
                     # KVStore without an optimizer (kvstore.py push)
                     self._store[key] = grad.copy()
                 self.updates_applied += 1
+                self._journal_append(buf, maybe_compact=True)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_PULL:
             key, off = _unpack_key(buf, off)
@@ -523,6 +617,7 @@ class AsyncPSServer:
             optimizer = pickle.loads(blob)
             self._optimizer = optimizer
             self._updater = opt.get_updater(optimizer)
+            self._journal_append(buf)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_STATS:
             with self._lock:
@@ -538,6 +633,10 @@ class AsyncPSServer:
                     (rank,) = struct.unpack_from(">q", buf, off)
                     self._heartbeats.pop(int(rank), None)
                     self._step_stats.pop(int(rank), None)
+                    # a clean finish WITHDRAWS the preemption notice
+                    # too: the rank drained inside its grace budget,
+                    # so it must not linger in dead-node replies
+                    self._preempted.pop(int(rank), None)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_WAIT_DONE:
             n, timeout = struct.unpack_from(">qd", buf, off)
@@ -559,6 +658,7 @@ class AsyncPSServer:
             key, off = _unpack_key(buf, off)
             rows_idx, off = _unpack_arr(buf, off)
             rows_val, off = _unpack_arr(buf, off)
+            self._check_fence(buf, off)
             with self._lock:
                 dense = self._store[key]
                 ids = rows_idx.astype(np.int64)
@@ -571,6 +671,7 @@ class AsyncPSServer:
                 else:
                     dense[ids] = rows_val
                 self.updates_applied += 1
+                self._journal_append(buf, maybe_compact=True)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_PULL_RSP:
             # pull only the requested rows (row_sparse_pull semantics)
@@ -590,6 +691,7 @@ class AsyncPSServer:
             n, thr = struct.unpack_from(">qd", buf, off)
             off += 16
             words, off = _unpack_arr(buf, off)
+            self._check_fence(buf, off)
             from .pallas_kernels.compression import dequantize_2bit_jnp
             import jax.numpy as jnp
             from . import storage as _storage_mod
@@ -607,6 +709,7 @@ class AsyncPSServer:
                 else:
                     self._store[key] = grad.copy()
                 self.updates_applied += 1
+                self._journal_append(buf, maybe_compact=True)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_SHAPE:
             key, off = _unpack_key(buf, off)
@@ -689,8 +792,17 @@ class AsyncPSServer:
                     # divergence payload (same length-gating contract)
                     hseq, hsum = struct.unpack_from(">qq", buf,
                                                     off + 32)
+                    prev = self._health_stats.get(int(rank))
                     self._health_stats[int(rank)] = (
                         int(hseq), int(hsum), _t.monotonic())
+                    if prev is None or int(hseq) > prev[0]:
+                        # SDC digests are evidence, not liveness:
+                        # journal each NEW digest so a restarted
+                        # server still holds what each rank last
+                        # reported (zero lost digests across failover)
+                        self._journal_append(struct.pack(
+                            ">Bqqq", _J_HEALTH, int(rank), int(hseq),
+                            int(hsum)))
             if len(buf) >= off + 16:
                 # v1 beat carries the client's trace-clock timestamp:
                 # answer with OUR trace clock so the client can estimate
@@ -715,8 +827,13 @@ class AsyncPSServer:
             import time as _t
             now = _t.monotonic()
             with self._lock:
-                dead = sorted(r for r, t in self._heartbeats.items()
-                              if now - t > timeout)
+                # preempt-announced ranks (ISSUE 20b) are merged in
+                # IMMEDIATELY: the notice is the proactive signal that
+                # lets peers reshard without burning the heartbeat
+                # timeout the stale-beat path below still provides
+                dead = sorted(
+                    set(r for r, t in self._heartbeats.items()
+                        if now - t > timeout) | set(self._preempted))
             arr = np.asarray(dead, np.int64)
             _send_frame(conn, bytes([_RE_ARR]) + _pack_arr(arr))
         elif op == _OP_PROFILER:
@@ -743,6 +860,7 @@ class AsyncPSServer:
             # data-plane no-pickle contract holds on this op too.
             rank, step = struct.unpack_from(">qq", buf, off)
             self._snapshots.put(int(rank), int(step), buf[off + 16:])
+            self._journal_append(buf)
             _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_SNAP_GET:
             # >qd exclude_rank|stale_timeout: newest snapshot from a
@@ -762,11 +880,57 @@ class AsyncPSServer:
                 body = struct.pack(">qq", prank, pstep) + blob
                 _send_frame(conn, struct.pack(">BI", _RE_BYTES,
                                               len(body)) + body)
+        elif op == _OP_EPOCH:
+            # fencing-epoch rendezvous (ISSUE 20a): >q proposed. A
+            # proposal ABOVE the committed epoch adopts it (and
+            # journals the bump, so a restarted server keeps fencing
+            # the pre-death partition); -1 or any lower value merely
+            # queries. Reply is the committed epoch either way.
+            (prop,) = struct.unpack_from(">q", buf, off)
+            with self._lock:
+                if int(prop) > self._epoch:
+                    self._epoch = int(prop)
+                    self._journal_append(struct.pack(
+                        ">Bq", _J_EPOCH, self._epoch))
+                cur = self._epoch
+            _send_frame(conn, struct.pack(">Bq", _RE_INT, cur))
+        elif op == _OP_PREEMPT:
+            # coordinated-preemption notice (ISSUE 20b): >qq rank|step.
+            # The rank announces it is draining after SIGTERM; the
+            # _OP_DEADNODES reply includes it from now on so peers
+            # reshard proactively. A clean done() withdraws the notice
+            # along with the heartbeat slot.
+            rank, step = struct.unpack_from(">qq", buf, off)
+            import time as _t
+            with self._lock:
+                self._preempted[int(rank)] = (int(step), _t.monotonic())
+            _send_frame(conn, bytes([_RE_OK]))
         elif op == _OP_STOP:
             _send_frame(conn, bytes([_RE_OK]))
             self._stop.set()
         else:
             raise ValueError("unknown opcode %d" % op)
+
+    def _check_fence(self, buf, off):
+        """Length-gated fencing check (ISSUE 20a): a fencing client
+        appends ``>q epoch`` after a push op's v0 fields; absent tail
+        (v0/unfenced wire) or a negative stamp means unfenced — interop
+        untouched. A stamp BELOW the committed epoch is the signature
+        of a rank partitioned across an elastic reshard: reject before
+        apply, counted, so split-brain can never corrupt aggregation."""
+        if len(buf) < off + 8:
+            return
+        (ep,) = struct.unpack_from(">q", buf, off)
+        if ep < 0:
+            return
+        with self._lock:
+            cur = self._epoch
+        if ep < cur:
+            _profiler.account("kvstore.fenced_writes", 1, emit=False)
+            raise RuntimeError(
+                "fenced epoch %d < server epoch %d: stale write from a "
+                "rank partitioned across an elastic reshard rejected"
+                % (ep, cur))
 
     @staticmethod
     def _profiler_command(cmd, body):
@@ -814,18 +978,243 @@ class AsyncPSServer:
                       g, w)
         self._store[key] = w.asnumpy()
 
+    # -- mutation journal (ISSUE 20a) ------------------------------------
+    # Records are the v0 wire payloads themselves, framed exactly like
+    # the wire (u32_be length | payload) and appended to seg_NNNNNN.jnl
+    # files opened unbuffered, so every applied mutation hits the OS
+    # before the reply goes out and an abrupt server death loses at most
+    # the one in-flight record (the replay tolerates a torn tail).
+    # Compaction rewrites the whole table as synthetic _J_STORE records
+    # into table.tmp and atomically renames it to table.snap (the
+    # CheckpointManager temp+rename publish idiom), then drops the
+    # replayed segments. With a server-side optimizer installed the
+    # updater's state cannot be re-derived from raw store values, so the
+    # event-sourced segments ARE the state: compaction only rotates.
+
+    _JOURNAL_SEG_BYTES = 4 << 20
+
+    def _journal_open(self):
+        """Replay table.snap + every segment in order into the tables,
+        then open a fresh append segment. Runs in __init__ BEFORE the
+        socket binds."""
+        os.makedirs(self._journal_dir, exist_ok=True)
+        snap = os.path.join(self._journal_dir, "table.snap")
+        if os.path.exists(snap):
+            self.journal_replayed += self._journal_replay_file(snap)
+        self._segments = sorted(
+            n for n in os.listdir(self._journal_dir)
+            if n.startswith("seg_") and n.endswith(".jnl"))
+        for n in self._segments:
+            self.journal_replayed += self._journal_replay_file(
+                os.path.join(self._journal_dir, n))
+        self._jseq = max([int(n[4:-4]) for n in self._segments]
+                         or [0]) + 1
+        self._segment_path = os.path.join(
+            self._journal_dir, "seg_%06d.jnl" % self._jseq)
+        self._journal = open(self._segment_path, "ab", buffering=0)
+        self._journal_bytes = 0
+
+    def _journal_replay_file(self, path):
+        """Apply every complete record in one journal file; a torn
+        final record (the mutation in flight when the server died) ends
+        the replay cleanly, and a record that fails to apply is counted
+        (kvstore.journal_skipped) instead of poisoning the rest."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return 0
+        count, off = 0, 0
+        while off + 4 <= len(data):
+            (n,) = struct.unpack_from(">I", data, off)
+            if off + 4 + n > len(data):
+                break  # torn tail
+            try:
+                self._replay_record(data[off + 4:off + 4 + n])
+                count += 1
+            except Exception:  # noqa: BLE001 — skip-and-count
+                _profiler.account("kvstore.journal_skipped", 1,
+                                  emit=False)
+            off += 4 + n
+        return count
+
+    def _replay_record(self, buf):
+        """One journaled mutation, mirroring _handle's apply semantics
+        without a connection. Trailing bytes past the known fields (the
+        fencing-epoch tail a v1.1 client stamps) are ignored exactly as
+        the length-gated wire ignores them."""
+        op, off = buf[0], 1
+        if op == _J_STORE:
+            key, off = _unpack_key(buf, off)
+            arr, _ = _unpack_arr(buf, off)
+            self._store[key] = arr
+        elif op == _J_EPOCH:
+            (ep,) = struct.unpack_from(">q", buf, off)
+            self._epoch = max(self._epoch, int(ep))
+        elif op == _J_HEALTH:
+            rank, hseq, hsum = struct.unpack_from(">qqq", buf, off)
+            self._health_stats[int(rank)] = (
+                int(hseq), int(hsum), _ptime.monotonic())
+        elif op == _OP_INIT:
+            key, off = _unpack_key(buf, off)
+            arr, _ = _unpack_arr(buf, off)
+            self._store.setdefault(key, arr)
+        elif op == _OP_PUSH:
+            key, off = _unpack_key(buf, off)
+            grad, _ = _unpack_arr(buf, off)
+            if self._updater is not None:
+                self._apply(key, grad)
+            else:
+                self._store[key] = grad.copy()
+            self.updates_applied += 1
+        elif op == _OP_PUSH_RSP:
+            key, off = _unpack_key(buf, off)
+            rows_idx, off = _unpack_arr(buf, off)
+            rows_val, _ = _unpack_arr(buf, off)
+            ids = rows_idx.astype(np.int64)
+            if self._updater is not None:
+                self._apply_rows(key, ids, rows_val)
+            else:
+                self._store[key][ids] = rows_val
+            self.updates_applied += 1
+        elif op == _OP_PUSH_2BIT:
+            key, off = _unpack_key(buf, off)
+            n, thr = struct.unpack_from(">qd", buf, off)
+            off += 16
+            words, _ = _unpack_arr(buf, off)
+            from .pallas_kernels.compression import dequantize_2bit_jnp
+            import jax.numpy as jnp
+            from . import storage as _storage_mod
+            packed = jnp.asarray(words)
+            # same ledger choke point as the live handler: transient
+            # dequantize scratch is 'workspace' memory
+            _storage_mod.ledger_register(packed, "workspace",
+                                         site="kvstore.dequantize")
+            grad = np.asarray(dequantize_2bit_jnp(
+                packed, int(n), float(thr)))
+            grad = grad.reshape(self._store[key].shape)
+            if self._updater is not None:
+                self._apply(key, grad)
+            else:
+                self._store[key] = grad.copy()
+            self.updates_applied += 1
+        elif op == _OP_SET_OPT:
+            # the restarted server must re-verify the MAC under ITS
+            # pinned secret: a journal written by a peer with a
+            # different MXTPU_PS_SECRET is not trusted to unpickle
+            mac, blob = buf[off:off + 32], buf[off + 32:]
+            if self._secret is None:
+                raise RuntimeError(
+                    "journaled optimizer but no MXTPU_PS_SECRET")
+            want = hmac.new(self._secret, blob,
+                            hashlib.sha256).digest()
+            if not hmac.compare_digest(mac, want):
+                raise PermissionError(
+                    "journaled set_optimizer HMAC mismatch")
+            import mxnet_tpu.optimizer as opt
+            optimizer = pickle.loads(blob)
+            self._optimizer = optimizer
+            self._updater = opt.get_updater(optimizer)
+        elif op == _OP_SNAP_PUT:
+            rank, step = struct.unpack_from(">qq", buf, off)
+            self._snapshots.put(int(rank), int(step), buf[off + 16:])
+        else:
+            raise ValueError("unknown journal record %d" % op)
+
+    def _journal_append(self, payload, maybe_compact=False):
+        """Durably append one record. ``maybe_compact=True`` is passed
+        only by store-mutation handlers that already hold self._lock
+        (compaction iterates the store, and the self._lock ->
+        _journal_lock nesting order must never reverse)."""
+        if self._journal is None:
+            return
+        with self._journal_lock:
+            try:
+                self._journal.write(
+                    struct.pack(">I", len(payload)) + bytes(payload))
+                self._journal_bytes += 4 + len(payload)
+            except OSError:
+                _profiler.account("kvstore.journal_errors", 1,
+                                  emit=False)
+                return
+            if maybe_compact \
+                    and self._journal_bytes >= self._JOURNAL_SEG_BYTES:
+                self._journal_compact()
+
+    def _journal_rotate(self):
+        # caller holds self._journal_lock
+        self._journal.close()
+        self._jseq += 1
+        self._segment_path = os.path.join(
+            self._journal_dir, "seg_%06d.jnl" % self._jseq)
+        self._journal = open(self._segment_path, "ab", buffering=0)
+        self._journal_bytes = 0
+
+    def _journal_compact(self):
+        # caller holds self._lock and self._journal_lock
+        if self._updater is not None:
+            self._segments.append(os.path.basename(self._segment_path))
+            self._journal_rotate()
+            return
+        tmp = os.path.join(self._journal_dir, "table.tmp")
+        with open(tmp, "wb") as f:
+            def rec(payload):
+                f.write(struct.pack(">I", len(payload)) + payload)
+            for key in sorted(self._store, key=str):
+                rec(bytes([_J_STORE]) + _pack_key(key)
+                    + _pack_arr(np.asarray(self._store[key])))
+            rec(struct.pack(">Bq", _J_EPOCH, self._epoch))
+            for rank, (hseq, hsum, _at) in sorted(
+                    self._health_stats.items()):
+                rec(struct.pack(">Bqqq", _J_HEALTH, int(rank),
+                                int(hseq), int(hsum)))
+            for rank, step, blob in self._snapshots.items():
+                rec(struct.pack(">Bqq", _OP_SNAP_PUT, int(rank),
+                                int(step)) + bytes(blob))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._journal_dir, "table.snap"))
+        done = self._segments + [os.path.basename(self._segment_path)]
+        self._segments = []
+        self._journal_rotate()
+        for n in done:
+            try:
+                os.remove(os.path.join(self._journal_dir, n))
+            except OSError:
+                pass
+        _profiler.account("kvstore.journal_compactions", 1, emit=False)
+
+    def _seal_journal(self):
+        # caller holds self._lock — the same self._lock ->
+        # _journal_lock acquisition order as the mutation handlers'
+        # _journal_append path, so the runtime lock-order graph stays
+        # a straight line
+        with self._journal_lock:
+            self._journal_compact()
+            self._journal.close()
+            self._journal = None
+
     def stop(self):
         self._stop.set()
         try:
             self._srv.close()
         except OSError:
             pass
+        if self._journal is not None:
+            # a CLEAN stop seals the journal into table.snap so the next
+            # start replays one snapshot instead of the event history
+            # (an abrupt death skips this — that is what replay is for)
+            try:
+                with self._lock:
+                    self._seal_journal()
+            except (OSError, ValueError):
+                pass
 
 
 class AsyncPSClient:
     """Worker-side connection (the reference's ps::KVWorker)."""
 
-    def __init__(self, host, port, retries=100):
+    def __init__(self, host, port, retries=100, endpoints=None):
         # connection is LAZY: in a sharded group, the server hosted by a
         # higher rank may not exist yet when lower ranks build their
         # client sets — first use retries until it binds (the ps-lite
@@ -833,7 +1222,10 @@ class AsyncPSClient:
         self._sock = None
         self._retries = retries
         self._lock = _locktrace.named_lock("kvstore_async.client")
-        self._addr = (host, port)
+        # ordered failover list (ISSUE 20a): _addr is the CURRENT
+        # endpoint; a failed connect walks the cursor to the next one
+        self._endpoints = self._resolve_endpoints(host, port, endpoints)
+        self._ep_idx = 0
         self.bytes_pushed = 0  # wire accounting (sparse/compressed tests)
         self._hb_stop = None
         # wire trace-context state: what protocol the peer speaks
@@ -841,6 +1233,58 @@ class AsyncPSClient:
         self._peer_version = 0
         self._rank = int(_getenv("MXTPU_PROC_ID", "0") or 0)
         self._req_id = 0
+        # fencing-epoch stamp for push ops (0 until a reshard commits a
+        # bump through AsyncKVStore.resize; only on the wire when
+        # MXTPU_PS_FENCING is enabled)
+        self._fence_epoch = 0
+
+    @property
+    def _addr(self):
+        return self._endpoints[self._ep_idx]
+
+    @staticmethod
+    def _resolve_endpoints(host, port, endpoints):
+        """The ordered endpoint list this client may fail over across.
+        An explicit ``endpoints`` argument wins; else MXTPU_PS_ENDPOINTS
+        ("host:port,host:port,...") applies when the constructor address
+        is its FIRST entry — the env names the failover chain for the
+        primary control-plane endpoint, and sharded-group clients built
+        against other servers keep their single address; else the
+        constructor address alone (no failover, the pre-ISSUE-20
+        wire)."""
+        if endpoints:
+            return [(h, int(p)) for h, p in endpoints]
+        spec = _getenv("MXTPU_PS_ENDPOINTS", "").strip()
+        if spec:
+            eps = []
+            for part in spec.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                h, _, p = part.rpartition(":")
+                eps.append((h or "127.0.0.1", int(p)))
+            if eps and eps[0] == (host, int(port)):
+                return eps
+        return [(host, int(port))]
+
+    def _failover(self, exc):
+        """Advance the endpoint cursor after a failed attempt against
+        the current endpoint, counting the failover by reason in
+        metrics()['counters'] (kvstore.failovers.<reason>) — the walk
+        lives inside the caller's one retry budget, so a dead primary
+        costs backoff sleeps against the standby, never a second
+        deadline."""
+        self._ep_idx = (self._ep_idx + 1) % len(self._endpoints)
+        if isinstance(exc, ConnectionRefusedError):
+            reason = "refused"
+        elif isinstance(exc, (socket.timeout, TimeoutError)):
+            reason = "timeout"
+        elif isinstance(exc, ConnectionError):
+            reason = "reset"
+        else:
+            reason = "error"
+        _profiler.account("kvstore.failovers.%s" % reason, 1,
+                          emit=False)
 
     def _connect_once(self):
         """One connect attempt (the kvstore.connect fault seam); no
@@ -849,20 +1293,33 @@ class AsyncPSClient:
         MXTPU_PS_RETRY_DEADLINE). A fresh connection re-negotiates the
         protocol version with one _OP_HELLO round trip: a v1 server
         answers its version, an old server answers unknown-opcode
-        _RE_ERR and the client stays on the v0 (unstamped) wire."""
+        _RE_ERR and the client stays on the v0 (unstamped) wire. With
+        MXTPU_PS_RECV_TIMEOUT set the socket carries a recv timeout
+        from before the HELLO, so a half-open peer surfaces as a
+        counted socket.timeout instead of an indefinite block; a
+        transport failure against a multi-endpoint client walks the
+        failover cursor before re-raising into the retry loop."""
         if _faultpoint.ACTIVE:
             _faultpoint.check("kvstore.connect")
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
             sock.connect(self._addr)
+            to = float(_getenv("MXTPU_PS_RECV_TIMEOUT", "0") or 0)
+            if to > 0:
+                sock.settimeout(to)
             _send_frame(sock, struct.pack(">Bq", _OP_HELLO,
                                           _PROTO_VERSION))
             resp = _recv_frame(sock)
-        except BaseException:
+        except BaseException as e:
             sock.close()  # no half-open socket per failed attempt
+            if isinstance(e, (ConnectionError, OSError)) \
+                    and len(self._endpoints) > 1:
+                self._failover(e)
             raise
         if resp is None:
             sock.close()
+            if len(self._endpoints) > 1:
+                self._failover(ConnectionResetError())
             raise ConnectionError(
                 "async PS server closed during version negotiation")
         if resp[0] == _RE_INT:
@@ -1050,13 +1507,44 @@ class AsyncPSClient:
             raise RuntimeError(resp[3:3 + n].decode())
         raise ConnectionError("bad response opcode %d" % code)
 
+    def _fence_tail(self):
+        """Trailing ``>q epoch`` stamp for push ops when MXTPU_PS_FENCING
+        is on — length-gated: a v0 server's handler never reads past its
+        known fields, so the tail is invisible to old peers (the PR 8
+        interop idiom, same as the heartbeat's straggler/SDC extras)."""
+        if not _fencing_enabled():
+            return b""
+        return struct.pack(">q", self._fence_epoch)
+
+    def set_fence_epoch(self, epoch):
+        """Stamp subsequent fenced pushes with ``epoch`` (committed by
+        an elastic reshard via bump_epoch on every server)."""
+        self._fence_epoch = int(epoch)
+
+    def bump_epoch(self, proposed=-1):
+        """Propose a fencing epoch (the server adopts the max and
+        journals the bump); ``-1`` merely queries. Returns the server's
+        committed epoch. RuntimeError against a v0 server (unknown
+        opcode) — callers count and continue unfenced."""
+        return int(self._call(struct.pack(">Bq", _OP_EPOCH,
+                                          int(proposed))))
+
+    def preempt_notice(self, rank, step):
+        """Announce coordinated preemption (ISSUE 20b): this rank is
+        draining after SIGTERM at ``step``. Idempotent (a re-announce
+        replaces the slot). RuntimeError against a v0 server — callers
+        count and continue; peers then fall back to the heartbeat
+        timeout, the pre-ISSUE-20 detection path."""
+        self._call(struct.pack(">Bqq", _OP_PREEMPT, int(rank),
+                               int(step)))
+
     def init(self, key, arr):
         self._call(bytes([_OP_INIT]) + _pack_key(key)
                    + _pack_arr(np.asarray(arr)))
 
     def push(self, key, grad):
         payload = bytes([_OP_PUSH]) + _pack_key(key) \
-            + _pack_arr(np.asarray(grad))
+            + _pack_arr(np.asarray(grad)) + self._fence_tail()
         self.bytes_pushed += len(payload)
         self._call(payload, latency="kvstore.push_rtt")
 
@@ -1065,14 +1553,15 @@ class AsyncPSClient:
         touched rows, not the dense shape."""
         payload = bytes([_OP_PUSH_RSP]) + _pack_key(key) \
             + _pack_arr(np.asarray(row_ids, np.int64)) \
-            + _pack_arr(np.asarray(rows))
+            + _pack_arr(np.asarray(rows)) + self._fence_tail()
         self.bytes_pushed += len(payload)
         self._call(payload, latency="kvstore.push_rtt")
 
     def push_compressed(self, key, words, n, threshold):
         payload = bytes([_OP_PUSH_2BIT]) + _pack_key(key) \
             + struct.pack(">qd", int(n), float(threshold)) \
-            + _pack_arr(np.asarray(words, np.int32))
+            + _pack_arr(np.asarray(words, np.int32)) \
+            + self._fence_tail()
         self.bytes_pushed += len(payload)
         self._call(payload, latency="kvstore.push_rtt")
 
@@ -1099,7 +1588,7 @@ class AsyncPSClient:
         on a DEDICATED connection so the shared one (and the heartbeat
         thread behind its lock) keeps flowing while we wait — a
         barrier-parked worker must not look dead."""
-        tmp = AsyncPSClient(*self._addr)
+        tmp = AsyncPSClient(*self._addr, endpoints=self._endpoints)
         try:
             # non-idempotent: a resent arrival after a lost response
             # could release a rendezvous that never fully assembled
@@ -1270,6 +1759,9 @@ class AsyncKVStore:
         # set is THE elastic signal (counter + trace marker), so the
         # controller and operators see a rank die exactly once
         self._known_dead = set()
+        # committed fencing epoch (ISSUE 20a): bumped on every resize()
+        # when MXTPU_PS_FENCING is on, stamped onto every push
+        self._fence_epoch = 0
         # dense arrays >= this many elements are SPLIT across the server
         # group (ref: kvstore_dist.h:58 MXNET_KVSTORE_BIGARRAY_BOUND)
         self._bigarray_bound = int(_getenv(
@@ -1637,6 +2129,44 @@ class AsyncKVStore:
             raise ValueError("resize needs >= 1 worker, got %d"
                              % num_workers)
         self._num_workers = num_workers
+        if _fencing_enabled():
+            # fencing-epoch bump (ISSUE 20a): every elastic reshard
+            # commits a new epoch on every server in the group. From
+            # here on, a push stamped with the pre-reshard epoch — the
+            # signature of a rank partitioned across this commit — is
+            # rejected server-side and counted (kvstore.fenced_writes),
+            # so split-brain can never corrupt aggregation. The commit
+            # adopts the max the group answers (a server that already
+            # saw a higher epoch from another survivor wins), and a
+            # server that cannot be reached is counted, not fatal: it
+            # will adopt the epoch from the next survivor's bump.
+            new_epoch = self._fence_epoch + 1
+            for c in self._clients:
+                try:
+                    new_epoch = max(new_epoch, c.bump_epoch(new_epoch))
+                except (ConnectionError, OSError, RuntimeError):
+                    _profiler.account("kvstore.epoch_bump_failures", 1,
+                                      emit=False)
+            self._fence_epoch = new_epoch
+            for c in self._clients:
+                c.set_fence_epoch(new_epoch)
+
+    def announce_preemption(self, step):
+        """Broadcast this rank's preemption notice (ISSUE 20b) to every
+        server so peers' dead-node polls include it immediately —
+        proactive reshard instead of a heartbeat-timeout wait. Never
+        raises (the draining rank must reach its checkpoint even when
+        the control plane is unreachable); returns how many servers
+        acknowledged."""
+        acked = 0
+        for c in self._clients:
+            try:
+                c.preempt_notice(self._rank, step)
+                acked += 1
+            except (ConnectionError, OSError, RuntimeError):
+                _profiler.account("kvstore.preempt_notice_failures", 1,
+                                  emit=False)
+        return acked
 
     def publish_snapshot(self, step, blob):
         """Publish this rank's opaque training-state blob to the

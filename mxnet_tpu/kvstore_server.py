@@ -33,7 +33,11 @@ class SnapshotTable:
     run length. ``get_newest`` picks the highest-step snapshot among
     ranks that are both not the requester and alive by the heartbeat
     table the server already keeps — a dead rank's stale snapshot must
-    never win over a live peer's fresher one.
+    never win over a live peer's fresher one. Equal-step candidates
+    tie-break on the LOWEST rank (ISSUE 20 satellite) — the winner is a
+    pure function of the table's contents, never of dict iteration
+    order, so every requester recovering from the same table restores
+    from the same peer.
     """
 
     def __init__(self):
@@ -65,9 +69,17 @@ class SnapshotTable:
                     hb = heartbeats.get(rank)
                     if hb is None or (now - hb) > stale_timeout:
                         continue
-                if best is None or step > best[1]:
+                if best is None or step > best[1] \
+                        or (step == best[1] and rank < best[0]):
                     best = (rank, step, blob)
         return best
+
+    def items(self):
+        """Point-in-time ``[(rank, step, blob)]`` in rank order (the
+        journal-compaction walk, tests)."""
+        with self._lock:
+            return [(r, s, b) for r, (s, b, _ts)
+                    in sorted(self._slots.items())]
 
     def drop(self, rank):
         with self._lock:

@@ -643,6 +643,17 @@ register_signature_token("MXTPU_CKPT_ASYNC", "0")
 register_signature_token("MXTPU_CKPT_DELTA", "0")
 register_signature_token("MXTPU_COMPILE_CACHE_DIR", "")
 register_signature_token("MXTPU_PEER_RESTORE", "0")
+# control-plane survivability legs (ISSUE 20): none of these shape a
+# traced graph, but each changes what recovery/resume semantics a
+# process commits to (journaled vs in-memory server state, fenced vs
+# unfenced writes, drain-vs-die on SIGTERM, single vs chained
+# endpoints) — a resumed or cache-replayed run must agree with the run
+# that wrote its artifacts, so they ride the same registry snapshot the
+# ISSUE 19 knobs do
+register_signature_token("MXTPU_PS_JOURNAL_DIR", "")
+register_signature_token("MXTPU_PS_ENDPOINTS", "")
+register_signature_token("MXTPU_PS_FENCING", "0")
+register_signature_token("MXTPU_PREEMPT_GRACE_S", "0")
 
 # back-compat spelling (PR 9 introduced the kernel-env tuple under this
 # name; the registry supersedes it)

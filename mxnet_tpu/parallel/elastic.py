@@ -587,16 +587,36 @@ class PreemptionGuard:
     (it still runs, exactly once, on the first signal), restores it on
     `__exit__`, and fires at most once per incarnation — repeated
     SIGTERMs while already draining do not re-enter. The handler body
-    only flips flags and tail-calls the chained handler; it takes no
-    locks (a signal interrupting a lock holder must not deadlock)."""
+    only flips flags and stamps the arrival time (time.monotonic is a
+    plain syscall) before tail-calling the chained handler; it takes no
+    locks (a signal interrupting a lock holder must not deadlock).
 
-    def __init__(self, signals=(signal.SIGTERM,)):
+    Grace budget (ISSUE 20b): ``MXTPU_PREEMPT_GRACE_S`` (or the
+    ``grace_s`` argument) is the platform's announced SIGTERM→SIGKILL
+    window. ``grace_left()`` is the drain time remaining; the elastic
+    loop uses it to decide whether a final checkpoint can still land
+    before the kill arrives. 0 (the default) means "no known budget" —
+    the pre-ISSUE-20 behavior, drain unconditionally."""
+
+    def __init__(self, signals=(signal.SIGTERM,), grace_s=None):
         self.preempted = False
+        self.preempted_at = None
+        self.grace_s = float(_getenv("MXTPU_PREEMPT_GRACE_S", "0")
+                             or 0) if grace_s is None else float(grace_s)
         self._fired = False
         self._signals = signals
         self._old = {}
 
+    def grace_left(self):
+        """Seconds of drain budget remaining; ``inf`` before the signal
+        arrived or when no budget is configured."""
+        if self.preempted_at is None or self.grace_s <= 0:
+            return float("inf")
+        return self.grace_s - (time.monotonic() - self.preempted_at)
+
     def _handler(self, signum, frame):
+        if self.preempted_at is None:
+            self.preempted_at = time.monotonic()
         self.preempted = True
         if self._fired:
             return
@@ -1114,13 +1134,47 @@ def elastic_train_loop(step_fn, state, batches, ckpt, save_every=100,
         with PreemptionGuard() as guard:
             while i < len(batches):
                 if guard.preempted:
+                    # the in-flight fused step already finished — the
+                    # guard is checked between steps, so the drain
+                    # below always starts from a step boundary
                     last = i - 1
+                    grace = guard.grace_left()
+                    # coordinated drain (ISSUE 20b), cheapest-first:
+                    # 1) broadcast the preemption notice so peers'
+                    #    dead-node polls see this rank NOW and reshard
+                    #    proactively instead of burning the heartbeat
+                    #    timeout (one wire round trip, never raises);
+                    if peer_kv is not None and hasattr(
+                            peer_kv, "announce_preemption"):
+                        acked = peer_kv.announce_preemption(last)
+                        _profiler.bump_elastic(
+                            "preempt_notices", args={"step": last,
+                                                     "acked": acked})
                     if i > start or restored is not None:
-                        _save(last)
-                        _flush_ckpt()  # drain: the exit must be durable
+                        # 2) make the exit durable while the grace
+                        #    budget lasts: final checkpoint + the peer
+                        #    snapshot a survivor can restore from
+                        #    without touching the filesystem. With the
+                        #    budget already blown (grace <= 0) the
+                        #    save is SKIPPED — a SIGKILL mid-publish
+                        #    would tear it, and the previous published
+                        #    step is the safer resume point.
+                        if grace > 0:
+                            _save(last)
+                            if peer_on:
+                                publish_peer_snapshot(peer_kv, last,
+                                                      _payload())
+                            _flush_ckpt()  # the exit must be durable
+                        else:
+                            _profiler.bump_elastic(
+                                "preempt_grace_exhausted",
+                                args={"step": last})
                     _profiler.bump_elastic("preemptions",
                                            args={"step": last})
-                    _goodput.note_event("preemption", step=last)
+                    _goodput.note_event(
+                        "preemption", step=last,
+                        grace_s=None if grace == float("inf")
+                        else round(grace, 3))
                     log.warning(
                         "elastic: preempted, checkpointed step %d",
                         last)

@@ -1,0 +1,475 @@
+"""Control-plane survivability (ISSUE 20): kvstore server failover with
+journaled state + fencing epochs, coordinated SIGTERM preemption, and
+on-the-wire network chaos.
+
+The chaos acceptance trio from the issue:
+
+- server death mid-train -> journal-replay rejoin, zero lost updates
+- partitioned stale rank fenced, survivors bitwise-identical to an
+  unfaulted twin
+- SIGTERM'd run closes ``outcome=preempted`` and its resume books
+  ``replay_span == 0``
+
+plus the satellites: bounded recv (``MXTPU_PS_RECV_TIMEOUT`` surfacing
+``net.half_open`` as a counted retry), SnapshotTable's deterministic
+lowest-rank tie-break, and the seeded `_retry` jitter stream.
+"""
+import os
+import signal
+import socket
+import struct
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu._retry as _retry
+from mxnet_tpu import kvstore_async as KA
+from mxnet_tpu import profiler
+from mxnet_tpu._debug import faultpoint, goodput
+from mxnet_tpu.kvstore_server import SnapshotTable
+
+
+def _counter(name):
+    return profiler.metrics()["counters"].get(name, 0)
+
+
+def _abrupt_kill(srv, *clients):
+    """Die without stop(): no journal close, no compaction flush — the
+    standby's state must come from journal replay alone. The established
+    client sockets are reset too (their server threads are orphaned)."""
+    srv._stop.set()
+    srv._srv.close()
+    for c in clients:
+        if c._sock is not None:
+            c._sock.close()
+
+
+def _reserve_port():
+    """Pick a port the standby can bind later (closed before use)."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    faultpoint.reset()
+    yield
+    faultpoint.reset()
+
+
+class TestJournal:
+    def test_replay_restores_store_epoch_and_snapshots(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        srv1 = KA.AsyncPSServer(journal_dir=jdir)
+        cli = KA.AsyncPSClient("127.0.0.1", srv1.port)
+        cli.init("w", np.arange(6, dtype=np.float32))
+        cli.push("w", np.full(6, 3.0, np.float32))
+        cli.push("w", np.full(6, 7.0, np.float32))
+        cli.put_snapshot(0, 11, b"peer-state-blob")
+        assert cli.bump_epoch(5) == 5
+        _abrupt_kill(srv1, cli)
+
+        srv2 = KA.AsyncPSServer(journal_dir=jdir)
+        try:
+            assert srv2.journal_replayed > 0
+            # store replayed to the dead primary's exact state
+            np.testing.assert_array_equal(
+                srv2._store["w"], np.full(6, 7.0, np.float32))
+            # fencing epoch survives the restart — a stale pre-reshard
+            # writer stays fenced even across a server death
+            assert srv2._epoch == 5
+            # published peer snapshots replay too (restore-from-peer
+            # survives control-plane failover)
+            assert srv2._snapshots.items() == [(0, 11, b"peer-state-blob")]
+        finally:
+            srv2.stop()
+
+    def test_compaction_then_tail_replay(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        srv1 = KA.AsyncPSServer(journal_dir=jdir)
+        # instance override shadows the 4MiB class default: every
+        # store-mutating append crosses the threshold and compacts
+        srv1._JOURNAL_SEG_BYTES = 1
+        before = _counter("kvstore.journal_compactions")
+        cli = KA.AsyncPSClient("127.0.0.1", srv1.port)
+        cli.init("w", np.zeros(4, np.float32))
+        for v in (1.0, 2.0, 3.0):
+            cli.push("w", np.full(4, v, np.float32))
+        assert _counter("kvstore.journal_compactions") > before
+        assert os.path.exists(os.path.join(jdir, "table.snap"))
+        _abrupt_kill(srv1, cli)
+
+        srv2 = KA.AsyncPSServer(journal_dir=jdir)
+        try:
+            np.testing.assert_array_equal(
+                srv2._store["w"], np.full(4, 3.0, np.float32))
+        finally:
+            srv2.stop()
+
+    def test_torn_tail_ends_replay_cleanly(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        srv1 = KA.AsyncPSServer(journal_dir=jdir)
+        cli = KA.AsyncPSClient("127.0.0.1", srv1.port)
+        cli.init("w", np.zeros(4, np.float32))
+        cli.push("w", np.full(4, 9.0, np.float32))
+        _abrupt_kill(srv1, cli)
+        # the mutation in flight when the server died: a length header
+        # promising 100 bytes with only 2 behind it
+        segs = sorted(n for n in os.listdir(jdir) if n.endswith(".jnl"))
+        with open(os.path.join(jdir, segs[-1]), "ab") as f:
+            f.write(struct.pack(">I", 100) + b"xy")
+
+        srv2 = KA.AsyncPSServer(journal_dir=jdir)
+        try:
+            np.testing.assert_array_equal(
+                srv2._store["w"], np.full(4, 9.0, np.float32))
+        finally:
+            srv2.stop()
+
+
+class TestFailover:
+    def test_client_fails_over_to_journal_replayed_standby(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        srv1 = KA.AsyncPSServer(journal_dir=jdir)
+        standby_port = _reserve_port()
+        cli = KA.AsyncPSClient(
+            "127.0.0.1", srv1.port,
+            endpoints=[("127.0.0.1", srv1.port),
+                       ("127.0.0.1", standby_port)])
+        cli.init("w", np.arange(8, dtype=np.float32))
+        cli.push("w", np.arange(8, dtype=np.float32) * 2)
+        before = np.asarray(cli.pull("w"))
+        fo0 = sum(v for k, v in profiler.metrics()["counters"].items()
+                  if k.startswith("kvstore.failovers."))
+        _abrupt_kill(srv1, cli)
+
+        srv2 = KA.AsyncPSServer(port=standby_port, journal_dir=jdir)
+        try:
+            # same client object: the pull walks the endpoint list
+            # inside its ordinary retry budget — zero lost updates
+            after = np.asarray(cli.pull("w"))
+            np.testing.assert_array_equal(before, after)
+            fo1 = sum(v for k, v in profiler.metrics()["counters"].items()
+                      if k.startswith("kvstore.failovers."))
+            assert fo1 - fo0 >= 1
+            # and the failed-over wire is fully live, not read-only
+            cli.push("w", np.full(8, 5.0, np.float32))
+            np.testing.assert_array_equal(
+                cli.pull("w"), np.full(8, 5.0, np.float32))
+        finally:
+            srv2.stop()
+
+    def test_env_endpoints_require_matching_first_entry(self, monkeypatch):
+        srv = KA.AsyncPSServer()
+        try:
+            spec = "127.0.0.1:%d,127.0.0.1:19999" % srv.port
+            monkeypatch.setenv("MXTPU_PS_ENDPOINTS", spec)
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            assert cli._endpoints == [("127.0.0.1", srv.port),
+                                      ("127.0.0.1", 19999)]
+            # a sharded-group client built against a DIFFERENT server
+            # keeps its single address: the env names the failover
+            # chain for the primary endpoint only
+            monkeypatch.setenv("MXTPU_PS_ENDPOINTS",
+                               "127.0.0.1:19998,127.0.0.1:19999")
+            other = KA.AsyncPSClient("127.0.0.1", srv.port)
+            assert other._endpoints == [("127.0.0.1", srv.port)]
+            cli.stop_server()
+        finally:
+            srv.stop()
+
+
+class TestFencing:
+    def test_stale_epoch_push_rejected_survivor_state_intact(
+            self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_FENCING", "1")
+        srv = KA.AsyncPSServer()
+        try:
+            survivor = KA.AsyncPSClient("127.0.0.1", srv.port)
+            stale = KA.AsyncPSClient("127.0.0.1", srv.port)
+            survivor.init("w", np.zeros(4, np.float32))
+            # both sides at epoch 0: accepted
+            stale.push("w", np.full(4, 1.0, np.float32))
+            # reshard commits epoch 1 on the server and the survivor
+            assert survivor.bump_epoch(1) == 1
+            survivor.set_fence_epoch(1)
+            survivor.push("w", np.full(4, 2.0, np.float32))
+            fenced0 = _counter("kvstore.fenced_writes")
+            with pytest.raises(RuntimeError, match="fenced epoch"):
+                stale.push("w", np.full(4, 99.0, np.float32))
+            assert _counter("kvstore.fenced_writes") - fenced0 >= 1
+            # rejected BEFORE apply: state is bitwise the survivor-only
+            # history, as if the partitioned rank never wrote
+            np.testing.assert_array_equal(
+                survivor.pull("w"), np.full(4, 2.0, np.float32))
+        finally:
+            srv.stop()
+
+    def test_epoch_is_monotonic_and_queryable(self, srv=None):
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            assert cli.bump_epoch() == 0        # -1 merely queries
+            assert cli.bump_epoch(4) == 4
+            assert cli.bump_epoch(2) == 4       # lower proposal: no-op
+            assert cli.bump_epoch() == 4
+        finally:
+            srv.stop()
+
+    def test_v0_unstamped_push_accepted_by_fencing_server(
+            self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_FENCING", "1")
+        srv = KA.AsyncPSServer()
+        try:
+            fenced = KA.AsyncPSClient("127.0.0.1", srv.port)
+            fenced.init("w", np.zeros(4, np.float32))
+            assert fenced.bump_epoch(3) == 3
+            # a v0 peer's push carries no epoch tail; the length-gated
+            # check must wave it through (mixed-version interop), never
+            # misread adjacent bytes as a stale epoch
+            monkeypatch.setenv("MXTPU_PS_FENCING", "0")
+            v0 = KA.AsyncPSClient("127.0.0.1", srv.port)
+            v0.push("w", np.full(4, 6.0, np.float32))
+            np.testing.assert_array_equal(
+                v0.pull("w"), np.full(4, 6.0, np.float32))
+        finally:
+            srv.stop()
+
+
+class TestPreemption:
+    def test_preempt_notice_visible_then_withdrawn(self):
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.preempt_notice(3, 41)
+            # visible immediately — peers reshard proactively instead
+            # of waiting out the heartbeat dead-timeout
+            assert 3 in cli.dead_nodes(timeout=60.0)
+            cli.done(3)  # drain finished: withdraw the notice
+            assert 3 not in cli.dead_nodes(timeout=60.0)
+        finally:
+            srv.stop()
+
+    def test_sigterm_closes_preempted_and_resume_replays_zero(
+            self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+        from mxnet_tpu.parallel.elastic import (
+            CheckpointManager, ElasticController, elastic_train_loop)
+
+        monkeypatch.setenv("MXTPU_PREEMPT_GRACE_S", "30")
+        batches = [jnp.asarray(float(i)) for i in range(8)]
+        ck_dir = str(tmp_path / "ck")
+
+        class _KV:
+            def __init__(self):
+                self.announced = []
+                self.num_workers = 2
+
+            def dead_nodes(self, timeout=3.0):
+                return []
+
+            def resize(self, n):
+                self.num_workers = int(n)
+
+            def announce_preemption(self, step):
+                self.announced.append(int(step))
+                return 1
+
+        def step(state, b):
+            if int(b) == 3:
+                signal.raise_signal(signal.SIGTERM)
+            time.sleep(0.01)
+            return {"acc": state["acc"] + b}, None
+
+        kv = _KV()
+        ctl = ElasticController(kvstore=kv, world=range(2), rank=0,
+                                poll_interval=0.0)
+        ck = CheckpointManager(ck_dir, use_orbax=False,
+                               async_persist=True, delta=False)
+        _, last, done = elastic_train_loop(
+            step, {"acc": jnp.asarray(0.0)}, batches, ck,
+            save_every=100, max_failures=0, controller=ctl)
+        assert not done and last == 3
+        assert kv.announced == [3]  # notice broadcast before draining
+        m = goodput.last_manifest()
+        assert m["outcome"] == "preempted"
+
+        monkeypatch.delenv("MXTPU_PREEMPT_GRACE_S")
+
+        def plain(state, b):
+            time.sleep(0.01)
+            return {"acc": state["acc"] + b}, None
+
+        ck = CheckpointManager(ck_dir, use_orbax=False,
+                               async_persist=True, delta=False)
+        res_state, _, done = elastic_train_loop(
+            plain, {"acc": jnp.asarray(0.0)}, batches, ck,
+            save_every=100, max_failures=0)
+        assert done
+        m = goodput.last_manifest()
+        rec = [e for e in m["events"] if e["kind"] == "recovery"][-1]
+        # the grace-window save IS the newest step: nothing to replay
+        assert rec["recovery_kind"] == "resume"
+        assert rec["restored_step"] == 3
+        assert rec["replay_span"] == 0
+        # bitwise vs an uninterrupted twin
+        ck = CheckpointManager(str(tmp_path / "ck_twin"),
+                               use_orbax=False, async_persist=True,
+                               delta=False)
+        twin_state, _, done = elastic_train_loop(
+            plain, {"acc": jnp.asarray(0.0)}, batches, ck,
+            save_every=100, max_failures=0)
+        assert done
+        assert float(res_state["acc"]) == float(twin_state["acc"])
+
+
+class TestRecvTimeout:
+    def test_half_open_surfaces_as_counted_retry(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_RECV_TIMEOUT", "0.1")
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.init("w", np.full(4, 8.0, np.float32))
+            r0 = _counter("kvstore.transport_retries")
+            # the server's conn thread has at most ONE pending chaos
+            # check (its recv-entry check for the iteration parked
+            # since the init reply), and a server-side trigger cannot
+            # raise (no recv timeout on the conn socket) — so with two
+            # triggers armed the client's own recv seam fires at least
+            # once whatever the interleaving: the silent peer surfaces
+            # as socket.timeout instead of an indefinite block, and
+            # the retry loop resends
+            faultpoint.configure("net.half_open=delay:0ms@n=2")
+            np.testing.assert_array_equal(
+                cli.pull("w"), np.full(4, 8.0, np.float32))
+            assert _counter("kvstore.transport_retries") - r0 >= 1
+            assert faultpoint.triggers("net.half_open") >= 1
+        finally:
+            faultpoint.reset()
+            srv.stop()
+
+    def test_without_timeout_half_open_does_not_raise(self):
+        # off by default: barrier/wait_done park legitimately for
+        # seconds, so the unbounded recv is the v0 contract
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.init("w", np.zeros(2, np.float32))
+            assert cli._sock.gettimeout() is None
+            faultpoint.configure("net.half_open=delay:0ms@n=1")
+            r0 = _counter("kvstore.transport_retries")
+            cli.pull("w")  # trigger fires but cannot raise: no timeout
+            assert _counter("kvstore.transport_retries") == r0
+        finally:
+            faultpoint.reset()
+            srv.stop()
+
+
+class TestNetChaosPoints:
+    def test_partition_retried_to_success(self):
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.init("w", np.full(4, 2.0, np.float32))
+            r0 = _counter("kvstore.transport_retries")
+            faultpoint.configure(
+                "net.partition=raise:ConnectionError@n=1")
+            np.testing.assert_array_equal(
+                cli.pull("w"), np.full(4, 2.0, np.float32))
+            assert _counter("kvstore.transport_retries") - r0 >= 1
+            assert faultpoint.triggers("net.partition") == 1
+        finally:
+            faultpoint.reset()
+            srv.stop()
+
+    def test_drop_swallows_frame_recv_timeout_recovers(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PS_RECV_TIMEOUT", "0.1")
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.init("w", np.full(4, 4.0, np.float32))
+            r0 = _counter("kvstore.transport_retries")
+            faultpoint.configure("net.drop=delay:0ms@n=1")
+            # request frame sent locally, never arrives; the bounded
+            # recv surfaces the silence and the retry resends
+            np.testing.assert_array_equal(
+                cli.pull("w"), np.full(4, 4.0, np.float32))
+            assert _counter("kvstore.transport_retries") - r0 >= 1
+        finally:
+            faultpoint.reset()
+            srv.stop()
+
+    def test_delay_stretches_round_trip(self):
+        srv = KA.AsyncPSServer()
+        try:
+            cli = KA.AsyncPSClient("127.0.0.1", srv.port)
+            cli.init("w", np.zeros(2, np.float32))
+            faultpoint.configure("net.delay=delay:30ms")
+            t0 = time.perf_counter()
+            cli.pull("w")
+            # at minimum the client-side send seam slept once
+            assert time.perf_counter() - t0 >= 0.03
+            assert faultpoint.triggers("net.delay") >= 1
+        finally:
+            faultpoint.reset()
+            srv.stop()
+
+
+class TestSnapshotTieBreak:
+    def test_equal_step_lowest_rank_wins_both_orders(self):
+        for order in ((0, 1), (1, 0)):
+            t = SnapshotTable()
+            for rank in order:
+                t.put(rank, 5, b"blob%d" % rank)
+            got = t.get_newest(exclude_rank=9, heartbeats={},
+                               stale_timeout=0)
+            assert got[0] == 0 and got[2] == b"blob0"
+
+    def test_higher_step_still_beats_lower_rank(self):
+        t = SnapshotTable()
+        t.put(0, 5, b"old")
+        t.put(1, 6, b"new")
+        got = t.get_newest(exclude_rank=9, heartbeats={},
+                           stale_timeout=0)
+        assert got[:2] == (1, 6)
+
+
+class TestRetrySeeded:
+    def test_same_seed_replays_identical_backoff(self, monkeypatch):
+        monkeypatch.setenv("MXNET_FAULTPOINTS_SEED", "1234")
+        a = _retry.RetryPolicy(base=0.01, cap=0.08)
+        b = _retry.RetryPolicy(base=0.01, cap=0.08)
+        seq_a = [a.backoff(i) for i in range(1, 7)]
+        seq_b = [b.backoff(i) for i in range(1, 7)]
+        assert seq_a == seq_b
+        monkeypatch.setenv("MXNET_FAULTPOINTS_SEED", "5678")
+        c = _retry.RetryPolicy(base=0.01, cap=0.08)
+        assert [c.backoff(i) for i in range(1, 7)] != seq_a
+
+    def test_unseeded_policies_share_production_rng(self, monkeypatch):
+        monkeypatch.delenv("MXNET_FAULTPOINTS_SEED", raising=False)
+        assert _retry.RetryPolicy()._rng is None
+
+    def test_deadline_honored_within_one_max_delay(self):
+        policy = _retry.RetryPolicy(max_retries=100, base=0.05,
+                                    cap=0.05, deadline=0.3)
+        calls = []
+
+        def always_fails():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            _retry.call(always_fails, policy=policy)
+        elapsed = time.monotonic() - t0
+        # the loop stops BEFORE a sleep that would cross the deadline,
+        # so worst case is deadline + one jittered cap (1.5x) + slack
+        assert elapsed <= 0.3 + 0.05 * 1.5 + 0.2
+        assert len(calls) > 2  # it did retry, not fail fast

@@ -1755,3 +1755,104 @@ def test_mx023_real_tree_knobs_hold_the_contract():
         assert var in tokens, var
     assert "`MXTPU_PEER_SNAPSHOT_EVERY`" in doc
     assert "MXTPU_PEER_SNAPSHOT_EVERY" not in tokens
+
+
+# -- MX024: wire-opcode contract (ISSUE 20) ----------------------------------
+
+_OPCODE_DOCS = """\
+# Resilience
+
+| Opcode | # | Resend-safe | Fields / notes |
+|---|---|---|---|
+| `_OP_GOOD` | 1 | yes | documented |
+| `_OP_UNDISPATCHED` | 3 | no | documented but no handler arm |
+| `_OP_COMPUTED` | 4 | no | documented but value is computed |
+"""
+
+
+def _plant_wire_tree(tmp_path, body, docs=_OPCODE_DOCS):
+    _plant(tmp_path, "docs/RESILIENCE.md", docs)
+    return _plant(tmp_path, "mxnet_tpu/kvstore_async.py", body)
+
+
+def test_mx024_literal_dispatch_and_doc_clauses(tmp_path):
+    """One opcode per contract shape: literal+dispatched+documented is
+    clean; undocumented trips the doc clause; undispatched trips the
+    dispatch clause; a computed value trips the literal clause. The
+    _OP_NAMES display map is never an opcode."""
+    _plant_wire_tree(tmp_path, """\
+        _OP_GOOD = 1
+        _OP_UNDOC = 2
+        _OP_UNDISPATCHED = 3
+        _OP_COMPUTED = _OP_GOOD + 100
+        _OP_NAMES = {_OP_GOOD: "good"}
+
+        class AsyncPSServer:
+            def _handle(self, conn, buf):
+                op = buf[0]
+                if op == _OP_GOOD:
+                    return 1
+                elif op == _OP_UNDOC:
+                    return 2
+                elif op == _OP_COMPUTED:
+                    return 4
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX024"})
+    assert all(f.code == "MX024" for f in findings)
+    msgs = {f.message.split()[2]: [] for f in findings}
+    for f in findings:
+        msgs[f.message.split()[2]].append(f.message)
+    assert "_OP_GOOD" not in msgs
+    assert "_OP_NAMES" not in msgs
+    assert len(msgs["_OP_UNDOC"]) == 1
+    assert "RESILIENCE.md" in msgs["_OP_UNDOC"][0]
+    assert len(msgs["_OP_UNDISPATCHED"]) == 1
+    assert "_handle" in msgs["_OP_UNDISPATCHED"][0]
+    assert len(msgs["_OP_COMPUTED"]) == 1
+    assert "literal" in msgs["_OP_COMPUTED"][0]
+
+
+def test_mx024_dispatch_must_be_in_handle(tmp_path):
+    """A comparison in some *other* method does not satisfy the
+    dispatch clause — the contract is the server's _handle arm."""
+    _plant_wire_tree(tmp_path, """\
+        _OP_GOOD = 1
+
+        class AsyncPSServer:
+            def _handle(self, conn, buf):
+                return None
+
+            def _replay_record(self, buf):
+                if buf[0] == _OP_GOOD:
+                    return 1
+        """)
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX024"})
+    assert [f.code for f in findings] == ["MX024"]
+    assert "_handle" in findings[0].message
+
+
+def test_mx024_scoped_to_wire_module(tmp_path):
+    """_OP_* constants in any other module are not this rule's
+    business — the wire protocol lives in kvstore_async.py alone."""
+    _plant(tmp_path, "docs/RESILIENCE.md", _OPCODE_DOCS)
+    _plant(tmp_path, "mxnet_tpu/other.py", "_OP_ROGUE = object()\n")
+    findings, _, _, _ = _lint_tree(tmp_path, {"MX024"})
+    assert findings == []
+
+
+def test_mx024_real_tree_opcode_table_is_complete():
+    """The shipped protocol honors the contract: every _OP_* constant
+    in kvstore_async.py is an int literal, dispatched in _handle, and
+    documented in the RESILIENCE.md opcode table — including the
+    ISSUE 20 fence_epoch/preempt_notice pair."""
+    import re as _re
+    import mxnet_tpu.kvstore_async as kva
+    with open(os.path.join(REPO, "docs", "RESILIENCE.md"),
+              encoding="utf-8") as f:
+        doc_ops = set(_re.findall(r"`(_OP_[A-Z0-9_]+)`", f.read()))
+    declared = [n for n in dir(kva)
+                if n.startswith("_OP_") and n != "_OP_NAMES"]
+    assert "_OP_EPOCH" in declared and "_OP_PREEMPT" in declared
+    for name in declared:
+        assert isinstance(getattr(kva, name), int), name
+        assert name in doc_ops, "%s missing from RESILIENCE.md" % name

@@ -1109,6 +1109,126 @@ class MX023ZeroBadputKnobContract:
         return out
 
 
+# ---------------------------------------------------------------------------
+# MX024 — wire-opcode contract: literal, dispatched, documented
+# ---------------------------------------------------------------------------
+
+# The one module that owns the async-PS wire protocol.
+_WIRE_MODULE = "mxnet_tpu/kvstore_async.py"
+
+# Backticked opcode names in the RESILIENCE.md opcode table.
+_OPCODE_DOC_RE = re.compile(r"`(_OP_[A-Z0-9_]+)`")
+
+
+class MX024WireOpcodeContract:
+    """Every ``_OP_*`` wire-opcode constant in ``kvstore_async.py`` must
+    be (a) an integer **literal** — a computed opcode breaks the
+    length-gated interop story because old peers can't be audited
+    against a value that only exists at runtime; (b) **dispatched** in
+    ``AsyncPSServer._handle`` (an ``op == _OP_X`` comparison) — an
+    opcode the server never checks is either dead wire surface or a
+    handler someone forgot, and either way an unknown-opcode ``_RE_ERR``
+    to a live client; and (c) **documented** in docs/RESILIENCE.md's
+    opcode table — the normative registry the resend-safety and
+    length-gating contracts live in. ISSUE 20 satellite: the journal +
+    failover + fencing work tripled the opcode surface; this rule keeps
+    the registry honest as it grows."""
+
+    code = "MX024"
+    summary = "wire opcode computed, undispatched, or undocumented"
+    kind = "python"
+    project = True
+
+    def scope(self, path):
+        return path == _WIRE_MODULE
+
+    _doc_cache = None  # (repo_root, frozenset | None)
+
+    def _documented(self):
+        from . import core
+        cached = self._doc_cache
+        if cached is not None and cached[0] == core.REPO_ROOT:
+            return cached[1]
+        doc_path = os.path.join(core.REPO_ROOT, "docs", "RESILIENCE.md")
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                names = frozenset(_OPCODE_DOC_RE.findall(f.read()))
+        except OSError:
+            names = None  # no contract file: skip the doc clause
+        self._doc_cache = (core.REPO_ROOT, names)
+        return names
+
+    @staticmethod
+    def _dispatched_names(tree):
+        """``_OP_*`` names compared against inside AsyncPSServer._handle."""
+        out = set()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name == "AsyncPSServer"):
+                continue
+            for item in node.body:
+                if not (isinstance(item, ast.FunctionDef)
+                        and item.name == "_handle"):
+                    continue
+                for sub in ast.walk(item):
+                    if not isinstance(sub, ast.Compare):
+                        continue
+                    for n in ast.walk(sub):
+                        if isinstance(n, ast.Name) \
+                                and n.id.startswith("_OP_"):
+                            out.add(n.id)
+        return out
+
+    def check_project(self, model):
+        from . import core
+        if _WIRE_MODULE not in model.modules:
+            return []
+        src_path = os.path.join(core.REPO_ROOT, _WIRE_MODULE)
+        try:
+            with open(src_path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return []
+        declared = {}   # name -> (lineno, is_literal_int)
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                # _OP_NAMES is the display-name map, not an opcode
+                if isinstance(tgt, ast.Name) \
+                        and tgt.id.startswith("_OP_") \
+                        and tgt.id != "_OP_NAMES":
+                    lit = isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, int)
+                    declared[tgt.id] = (node.lineno, lit)
+        dispatched = self._dispatched_names(tree)
+        docs = self._documented()
+        out = []
+        for name in sorted(declared):
+            ln, lit = declared[name]
+            if not lit:
+                out.append(Finding(
+                    self.code, _WIRE_MODULE, ln,
+                    "wire opcode %s is computed, not an integer literal "
+                    "— the length-gated interop contract needs opcode "
+                    "values auditable from the source" % (name,)))
+            if name not in dispatched:
+                out.append(Finding(
+                    self.code, _WIRE_MODULE, ln,
+                    "wire opcode %s is never checked in "
+                    "AsyncPSServer._handle — add the dispatch arm (a "
+                    "live client sending it gets unknown-opcode "
+                    "_RE_ERR) or delete the constant" % (name,)))
+            if docs is not None and name not in docs:
+                out.append(Finding(
+                    self.code, _WIRE_MODULE, ln,
+                    "wire opcode %s is missing from the "
+                    "docs/RESILIENCE.md opcode table — document its "
+                    "fields, resend-safety, and length-gating"
+                    % (name,)))
+        return out
+
+
 DATAFLOW_RULES = (
     MX014TracedAmbientState(),
     MX015EnvContract(),
@@ -1118,4 +1238,5 @@ DATAFLOW_RULES = (
     MX019MetricsProviderDocs(),
     MX022UnregisteredCompile(),
     MX023ZeroBadputKnobContract(),
+    MX024WireOpcodeContract(),
 )
